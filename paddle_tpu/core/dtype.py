@@ -1,0 +1,104 @@
+"""Dtype system.
+
+Reference parity: paddle's VarType dtypes (reference
+``paddle/fluid/framework/framework.proto`` VarType.Type) exposed as string
+dtypes mapped onto jax/numpy dtypes.  Default dtype is float32, switchable
+via ``set_default_dtype`` (reference ``python/paddle/framework/dtype.py``).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# canonical name -> jnp dtype
+_DTYPE_MAP = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bfloat": "bfloat16",
+}
+
+bool_ = "bool"
+uint8 = "uint8"
+int8 = "int8"
+int16 = "int16"
+int32 = "int32"
+int64 = "int64"
+float16 = "float16"
+bfloat16 = "bfloat16"
+float32 = "float32"
+float64 = "float64"
+complex64 = "complex64"
+complex128 = "complex128"
+
+_default_dtype = "float32"
+
+
+def set_default_dtype(d):
+    """Set default floating dtype (paddle.set_default_dtype)."""
+    global _default_dtype
+    name = canonical_name(d)
+    if name not in ("float16", "bfloat16", "float32", "float64"):
+        raise TypeError(
+            "set_default_dtype only supports floating dtypes, got %s" % d)
+    _default_dtype = name
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def canonical_name(d) -> str:
+    """Normalize any dtype spec (str, np.dtype, jnp dtype) to canonical str."""
+    if d is None:
+        return _default_dtype
+    if isinstance(d, str):
+        name = _ALIASES.get(d, d)
+        if name in _DTYPE_MAP:
+            return name
+        raise TypeError("unsupported dtype: %r" % (d,))
+    # jnp scalar types / np.dtype
+    try:
+        name = np.dtype(d).name
+    except TypeError:
+        name = getattr(d, "__name__", None) or str(d)
+    if name == "bfloat16" or "bfloat16" in str(d):
+        return "bfloat16"
+    name = _ALIASES.get(name, name)
+    if name in _DTYPE_MAP:
+        return name
+    raise TypeError("unsupported dtype: %r" % (d,))
+
+
+def to_jax(d):
+    """Any dtype spec -> jnp dtype class."""
+    return _DTYPE_MAP[canonical_name(d)]
+
+
+def is_floating(d) -> bool:
+    return canonical_name(d) in ("float16", "bfloat16", "float32", "float64")
+
+
+def is_integer(d) -> bool:
+    return canonical_name(d) in ("uint8", "int8", "int16", "int32", "int64")
+
+
+def is_complex(d) -> bool:
+    return canonical_name(d) in ("complex64", "complex128")

@@ -1,0 +1,68 @@
+"""Global flag registry.
+
+Reference parity: gflags in ``paddle/fluid/platform/flags.cc:33-539`` plus
+the getter/setter bridge ``pybind/global_value_getter_setter.cc``.  Flags are
+settable via ``paddle_tpu.set_flags`` or environment ``FLAGS_*`` at import.
+"""
+from __future__ import annotations
+
+import os
+
+_REGISTRY: dict[str, dict] = {}
+
+
+def define_flag(name: str, default, doc: str = ""):
+    env = os.environ.get("FLAGS_" + name)
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    _REGISTRY[name] = {"value": value, "default": default, "doc": doc}
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags({'FLAGS_check_nan_inf': True})"""
+    for k, v in flags.items():
+        name = k[6:] if k.startswith("FLAGS_") else k
+        if name not in _REGISTRY:
+            raise KeyError("unknown flag %r" % k)
+        _REGISTRY[name]["value"] = v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        name = k[6:] if k.startswith("FLAGS_") else k
+        out["FLAGS_" + name] = _REGISTRY[name]["value"]
+    return out
+
+
+def flag(name: str):
+    return _REGISTRY[name]["value"]
+
+
+# Core flags (TPU-meaningful subset of reference platform/flags.cc)
+define_flag("check_nan_inf", False,
+            "After every eager op, scan outputs for NaN/Inf and raise "
+            "(reference flags.cc:44 + nan_inf_utils_detail.cc).")
+define_flag("sort_sum_gradient", False,
+            "Deterministic gradient accumulation order "
+            "(reference flags.cc:527).")
+define_flag("eager_delete_tensor_gb", 0.0,
+            "GC threshold; a no-op under XLA memory management.")
+define_flag("allocator_strategy", "xla",
+            "Informational: XLA owns HBM allocation on TPU.")
+define_flag("use_bf16_matmul", True,
+            "Allow bf16 accumulation hints for matmul on MXU.")
+define_flag("tpu_deterministic", False,
+            "Force deterministic XLA reductions where available "
+            "(reference: FLAGS_cudnn_deterministic flags.cc:98).")
+define_flag("log_level", 0, "VLOG-style verbosity for paddle_tpu internals.")

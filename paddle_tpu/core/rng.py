@@ -1,0 +1,67 @@
+"""Random state.
+
+Reference parity: ``paddle/fluid/framework/generator.cc`` (global & per-device
+generators, seed control via ``paddle.seed``).  TPU-native design: a single
+process-level counter-based PRNG built on jax's threefry keys; every consumer
+draws a fresh split so eager calls are reproducible under a fixed seed.
+Inside jit'd training steps, keys are threaded functionally.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_seed = 0
+_key = jax.random.key(0)
+
+
+def seed(s: int):
+    """paddle.seed — reset the global generator."""
+    global _key, _seed
+    with _lock:
+        _seed = int(s)
+        _key = jax.random.key(_seed)
+    return _seed
+
+
+def get_seed() -> int:
+    return _seed
+
+
+# When tracing a jit'd step, a traced key is pushed here so that stochastic
+# ops (dropout etc.) fold into it instead of baking in a host-side constant.
+_trace_stack: list = []
+
+
+def push_trace_key(key):
+    _trace_stack.append([key, 0])
+
+
+def pop_trace_key():
+    _trace_stack.pop()
+
+
+def in_traced_region() -> bool:
+    return bool(_trace_stack)
+
+
+def next_key():
+    """Draw a fresh subkey: from the traced key inside a traced training
+    step (deterministic per-call fold_in), else from the global generator."""
+    if _trace_stack:
+        entry = _trace_stack[-1]
+        entry[1] += 1
+        return jax.random.fold_in(entry[0], entry[1])
+    global _key
+    with _lock:
+        _key, sub = jax.random.split(_key)
+    return sub
+
+
+def key_for(seed_val: int | None):
+    """Key from an explicit seed, or the global stream if None/0."""
+    if seed_val:
+        return jax.random.key(int(seed_val))
+    return next_key()

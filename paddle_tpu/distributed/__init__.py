@@ -1,0 +1,23 @@
+"""paddle.distributed parity surface (TPU-native: meshes + XLA collectives).
+
+See SURVEY.md §2.4 / §5.8 for the mapping from the reference's NCCL-ring
+architecture to mesh axes.
+"""
+from .mesh import (  # noqa: F401
+    build_mesh, set_mesh, get_mesh, ensure_mesh, axis_size,
+    data_parallel_size, named_sharding, replicated, AXES,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, all_reduce, all_gather, all_gather_object, broadcast, reduce,
+    scatter, reduce_scatter, alltoall, send, recv, isend, irecv, barrier,
+    p2p_shift, parallel_region, axis_context, current_axis, get_group,
+)
+from .parallel import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, is_initialized,
+    ParallelEnv, DataParallel, spawn,
+)
+from .sharding import shard_params_specs, shard_tensor, split  # noqa: F401
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .launch import launch_main  # noqa: F401
+from .ring import ring_attention  # noqa: F401

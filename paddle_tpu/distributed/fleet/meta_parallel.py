@@ -1,0 +1,79 @@
+"""fleet.meta_parallel parity: TP layers + PipelineLayer.
+
+Reference parity: paddle's fleet.meta_parallel (ColumnParallelLinear /
+RowParallelLinear / VocabParallelEmbedding / PipelineLayer) and the
+pipeline runtime (``framework/trainer.h:325`` PipelineTrainer +
+``section_worker.cc:34`` GPipe F-then-B schedule).
+
+TPU-native pipeline: identical stage blocks have their params STACKED on a
+leading axis sharded over 'pp'; the schedule is a collective_permute
+microbatch rotation inside shard_map (see paddle_tpu/parallel/pipeline.py).
+Embedding/head run replicated outside the pipelined region.
+"""
+from __future__ import annotations
+
+from ..sharding import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+)
+from ...nn.layer.base import Layer, LayerList
+
+
+class LayerDesc:
+    """Declarative layer description (built lazily per stage)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.key = key
+
+
+class PipelineLayer(Layer):
+    """A model expressed as [pre (replicated)] + N identical blocks
+    (pipelined over 'pp') + [post (replicated)].
+
+    The reference's PipelineLayer slices an arbitrary layer list into
+    stages; on TPU the SPMD pipeline needs the pipelined blocks to share
+    one structure, so the API asks for them explicitly — pre/post absorb
+    the heterogeneous ends (embedding, loss head).
+    """
+
+    def __init__(self, pre=None, blocks=None, post=None, loss_fn=None,
+                 num_stages=None, seg_method="uniform", layers=None,
+                 **kwargs):
+        super().__init__()
+        if layers is not None and blocks is None:
+            # reference-style flat list: treat all-but-ends heuristically
+            built = [l.build() if isinstance(l, LayerDesc) else l
+                     for l in layers]
+            pre, blocks, post = built[0], built[1:-1], built[-1]
+        self.pre = pre if pre is not None else None
+        self.blocks = LayerList(list(blocks or []))
+        self.post = post if post is not None else None
+        self.loss_fn = loss_fn
+        self.num_stages = num_stages
+
+    def forward(self, x, *args, **kwargs):
+        """Eager/single-chip reference semantics: plain sequential."""
+        if self.pre is not None:
+            x = self.pre(x)
+        for blk in self.blocks:
+            x = blk(x)
+        if self.post is not None:
+            x = self.post(x)
+        return x
+
+    def block_structure(self):
+        """(param names per block, count) used by the pipeline engine."""
+        if not len(self.blocks):
+            return [], 0
+        names = [n for n, _ in self.blocks[0].named_parameters()]
+        return names, len(self.blocks)

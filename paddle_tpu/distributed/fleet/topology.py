@@ -1,0 +1,51 @@
+"""Hybrid topology view (reference: paddle.distributed.fleet topology /
+HybridCommunicateGroup — rank↔(dp, sharding, pp, mp) coordinate math over
+NCCL groups).  On TPU the mesh IS the topology; this class just exposes the
+axis sizes/coords for API parity."""
+from __future__ import annotations
+
+import jax
+
+from .. import mesh as mesh_mod
+
+
+class HybridCommunicateGroup:
+    def __init__(self, mesh=None):
+        self._mesh = mesh or mesh_mod.ensure_mesh()
+
+    def get_data_parallel_world_size(self):
+        return self._mesh.shape.get("dp", 1)
+
+    def get_model_parallel_world_size(self):
+        return self._mesh.shape.get("mp", 1)
+
+    def get_pipe_parallel_world_size(self):
+        return self._mesh.shape.get("pp", 1)
+
+    def get_sharding_parallel_world_size(self):
+        return self._mesh.shape.get("sharding", 1)
+
+    def get_sep_parallel_world_size(self):
+        return self._mesh.shape.get("sp", 1)
+
+    # ranks are process-level on TPU (one process drives many chips)
+    def get_data_parallel_rank(self):
+        return jax.process_index()
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def topology(self):
+        return dict(self._mesh.shape)
+
+    def get_model_parallel_group(self):
+        return "mp"
+
+    def get_data_parallel_group(self):
+        return "dp"
+
+    def get_pipe_parallel_group(self):
+        return "pp"

@@ -1,0 +1,55 @@
+"""Multi-host launcher.
+
+Reference parity: ``python -m paddle.distributed.launch``
+(``fleet/launch.py:334``) which spawns one process per GPU and wires the
+PADDLE_* env contract, with abort-on-failure monitoring
+(``launch_utils.py:526``).
+
+TPU-native design: ONE process per host drives all local chips (SPMD), so
+the launcher's job collapses to: set the env contract, call
+``jax.distributed.initialize`` (which replaces the TCP ncclUniqueId
+bootstrap), and exec the training script.  For single-host multi-chip there
+is nothing to spawn at all.  Usage:
+
+    python -m paddle_tpu.distributed.launch --nnodes N --node_rank I \
+        --master ADDR:PORT train.py [args...]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def launch_main(argv=None):
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nnodes", type=int,
+                        default=int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                                   "1")))
+    parser.add_argument("--node_rank", type=int,
+                        default=int(os.environ.get("PADDLE_TRAINER_ID",
+                                                   "0")))
+    parser.add_argument("--master",
+                        default=os.environ.get("MASTER_ADDR_PORT", ""))
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    os.environ["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
+    os.environ["PADDLE_TRAINER_ID"] = str(args.node_rank)
+    if args.master:
+        os.environ["PADDLE_TRAINER_ENDPOINTS"] = args.master
+
+    if args.nnodes > 1:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=args.master or None,
+            num_processes=args.nnodes, process_id=args.node_rank)
+
+    sys.argv = [args.script] + list(args.script_args)
+    runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    launch_main()

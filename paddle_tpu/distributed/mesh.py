@@ -1,0 +1,78 @@
+"""Device mesh management.
+
+Reference parity: this replaces the whole comm-bootstrap layer —
+``NCCLCommContext`` ring registry (platform/collective_helper.h:65),
+``gen_comm_id_helper.cc`` TCP bootstrap, and ``c_comm_init_op`` — with named
+mesh axes over ICI/DCN.  A reference ``ring_id`` maps to a mesh axis name
+('dp', 'sharding', 'mp', 'pp', 'sp'); XLA inserts the collectives.
+"""
+from __future__ import annotations
+
+import math
+import os
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec, NamedSharding
+
+# canonical hybrid-parallel axis order (outer → inner = DCN → ICI)
+AXES = ("dp", "sharding", "pp", "mp", "sp")
+
+_global_mesh: Mesh | None = None
+
+
+def build_mesh(dp=1, sharding=1, pp=1, mp=1, sp=1, devices=None) -> Mesh:
+    """Create a hybrid-parallel mesh.  Any axis left at 1 still exists (size
+    1) so sharding specs are uniform across strategies."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    sizes = {"dp": dp, "sharding": sharding, "pp": pp, "mp": mp, "sp": sp}
+    used = int(np.prod(list(sizes.values())))
+    if used == 1:
+        sizes["dp"] = n
+        used = n
+    elif sizes["dp"] == -1:
+        sizes["dp"] = n // (used // 1)  # fill remainder into dp
+        used = int(np.prod(list(sizes.values())))
+    if used != n:
+        raise ValueError(
+            f"mesh axes {sizes} require {used} devices, have {n}")
+    arr = np.asarray(devices).reshape([sizes[a] for a in AXES])
+    return Mesh(arr, AXES)
+
+
+def set_mesh(mesh: Mesh):
+    global _global_mesh
+    _global_mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Mesh | None:
+    return _global_mesh
+
+
+def ensure_mesh() -> Mesh:
+    global _global_mesh
+    if _global_mesh is None:
+        _global_mesh = build_mesh()
+    return _global_mesh
+
+
+def axis_size(name: str) -> int:
+    mesh = get_mesh()
+    if mesh is None:
+        return 1
+    return mesh.shape.get(name, 1)
+
+
+def data_parallel_size() -> int:
+    """Combined data-sharding degree (dp × sharding axes)."""
+    return axis_size("dp") * axis_size("sharding")
+
+
+def named_sharding(*spec) -> NamedSharding:
+    return NamedSharding(ensure_mesh(), PartitionSpec(*spec))
+
+
+def replicated() -> NamedSharding:
+    return NamedSharding(ensure_mesh(), PartitionSpec())

@@ -1,0 +1,119 @@
+"""Data parallel.
+
+Reference parity: ``paddle.DataParallel``
+(``python/paddle/fluid/dygraph/parallel.py:321``) + the C++ bucketed
+``Reducer`` (``imperative/reducer.cc:270``).
+
+TPU-native design: there is no Reducer — gradients are averaged by the XLA
+``psum`` that pjit inserts when the batch axis is sharded over the mesh.
+``DataParallel`` is therefore a thin marker wrapper: it keeps API parity
+(scale_loss, no_sync, state_dict passthrough) and tells the train-step
+builders (hapi / fleet) to shard the batch over the 'dp' axis.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..nn.layer.base import Layer
+from . import mesh as mesh_mod
+
+
+def init_parallel_env():
+    """reference: python/paddle/distributed/parallel.py:57
+    (init_parallel_env → NCCLParallelContext::Init).  On TPU this is
+    `jax.distributed.initialize` (DCN bootstrap, replacing the TCP
+    ncclUniqueId exchange) + default mesh construction."""
+    if os.environ.get("PADDLE_TRAINER_ENDPOINTS") and \
+            os.environ.get("PADDLE_TRAINERS_NUM", "1") != "1" and \
+            jax.process_count() == 1:
+        coord = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")[0]
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["PADDLE_TRAINERS_NUM"]),
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    mesh_mod.ensure_mesh()
+    return ParallelEnv()
+
+
+def get_rank(group=None):
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    mesh = mesh_mod.get_mesh()
+    if mesh is None:
+        return 1
+    return mesh_mod.data_parallel_size()
+
+
+def is_initialized():
+    return mesh_mod.get_mesh() is not None
+
+
+class ParallelEnv:
+    """reference: fluid/dygraph/parallel.py ParallelEnv (env-var view)."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        eps = os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+        return eps
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    local_rank = rank
+    nranks = world_size
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        # pjit's pmean over the sharded batch already averages; identity
+        return loss
+
+    def apply_collective_grads(self):
+        pass  # XLA inserts grad allreduce; nothing to do eagerly
+
+    import contextlib as _ctx
+
+    @_ctx.contextmanager
+    def no_sync(self):
+        yield
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference: distributed/spawn.py.  A TPU host controls all local chips
+    in ONE process (SPMD), so spawn degenerates to a direct call; multi-host
+    uses one process per host via the launcher."""
+    func(*args)

@@ -1,0 +1,168 @@
+"""Ring attention — sequence/context parallelism over an ICI mesh axis.
+
+NEW capability relative to the reference (SURVEY.md §5.7: absent there).
+Design: blockwise attention with online softmax; K/V blocks rotate around
+the 'sp' ring via ``lax.ppermute`` while each device keeps its Q shard, so
+peak memory is O(S_local²) and the sequence scales with the ring size.
+Causal masking uses the ring step to decide block visibility.
+
+Layout convention (paddle): [batch, seq, heads, head_dim]; the seq axis is
+sharded over `axis`.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..core.dispatch import ensure_tensor
+from . import mesh as mesh_mod
+
+try:  # jax>=0.5 moved shard_map to jax.*
+    from jax import shard_map as _shard_map_mod
+    shard_map = _shard_map_mod
+except ImportError:
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def _block_attn(q, k, v, scale, mask_mode):
+    """One block pair: returns (unnormalized out, running max, running sum)
+    contributions in f32.  mask_mode: 0=full, 1=causal-diag, 2=skip."""
+    # q,k,v: [B, S, H, D] -> scores [B, H, Sq, Sk]
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if mask_mode == 1:
+        sq, sk = s.shape[-2], s.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(causal, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                     # [B,H,Sq]
+    m = jnp.maximum(m, -1e30)                   # avoid -inf - -inf
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                     # [B,H,Sq]
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    return o, m, l
+
+
+def _ring_attention_local(q, k, v, axis, causal, scale):
+    """Runs on each device inside shard_map; q/k/v are LOCAL seq shards."""
+    n = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+
+    b, sq, h, d = q.shape
+    acc_o = jnp.zeros((b, h, sq, d), jnp.float32)
+    acc_m = jnp.full((b, h, sq), -1e30, jnp.float32)
+    acc_l = jnp.zeros((b, h, sq), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(step, carry):
+        k_blk, v_blk, acc_o, acc_m, acc_l = carry
+        # k_blk originated on device (my - step) mod n
+        src = (my - step) % n
+        if causal:
+            # visible iff src block is strictly earlier, or same (diag)
+            def do_full(args):
+                return _block_attn(*args, mask_mode=0)
+
+            def do_diag(args):
+                return _block_attn(*args, mask_mode=1)
+
+            def do_skip(args):
+                q_, k_, v_, sc = args
+                bb, ss, hh, dd = q_.shape
+                return (jnp.zeros((bb, hh, ss, dd), jnp.float32),
+                        jnp.full((bb, hh, ss), -1e30, jnp.float32),
+                        jnp.zeros((bb, hh, ss), jnp.float32))
+
+            idx = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+            o, m, l = lax.switch(idx, [do_full, do_diag, do_skip],
+                                 (q, k_blk, v_blk, scale))
+        else:
+            o, m, l = _block_attn(q, k_blk, v_blk, scale, mask_mode=0)
+
+        new_m = jnp.maximum(acc_m, m)
+        alpha = jnp.exp(acc_m - new_m)
+        beta = jnp.exp(m - new_m)
+        new_l = acc_l * alpha + l * beta
+        new_o = acc_o * alpha[..., None] + o * beta[..., None]
+        k_next = lax.ppermute(k_blk, axis, perm)
+        v_next = lax.ppermute(v_blk, axis, perm)
+        return (k_next, v_next, new_o, new_m, new_l)
+
+    carry = (k, v, acc_o, acc_m, acc_l)
+    carry = lax.fori_loop(0, n, body, carry)
+    _, _, acc_o, _, acc_l = carry
+    out = acc_o / jnp.maximum(acc_l[..., None], 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # [B, S, H, D]
+
+
+def ring_attention_inner(q, k, v, axis="sp", causal=False, scale=None):
+    """For use INSIDE an existing shard_map region (arrays, not Tensors)."""
+    return _ring_attention_local(q, k, v, axis, causal, scale)
+
+
+def ring_attention(query, key, value, axis="sp", causal=False, scale=None,
+                   mesh=None):
+    """Driver: shards the seq axis of global [B, S, H, D] tensors over
+    `axis` and runs ring attention.  Usable eagerly or under jit."""
+    q = ensure_tensor(query)._data
+    k = ensure_tensor(key)._data
+    v = ensure_tensor(value)._data
+    mesh = mesh or mesh_mod.ensure_mesh()
+    if mesh.shape.get(axis, 1) == 1:
+        # degenerate ring: plain attention
+        from ..nn.functional.attention import _reference_attention
+        return Tensor(_reference_attention(q, k, v, None, scale, causal))
+
+    spec = P(None, axis, None, None)
+    fn = shard_map(
+        functools.partial(_ring_attention_local, axis=axis, causal=causal,
+                          scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return Tensor(fn(q, k, v))
+
+
+def ulysses_attention(query, key, value, axis="sp", causal=False,
+                      scale=None, mesh=None):
+    """DeepSpeed-Ulysses style context parallelism: all_to_all swaps the
+    sharded axis from sequence to heads, runs full-sequence attention on
+    1/N of the heads, then swaps back.  Lower comm volume than ring when
+    heads % N == 0.  NEW capability (absent in reference)."""
+    q = ensure_tensor(query)._data
+    k = ensure_tensor(key)._data
+    v = ensure_tensor(value)._data
+    mesh = mesh or mesh_mod.ensure_mesh()
+    n = mesh.shape.get(axis, 1)
+    if n == 1:
+        from ..nn.functional.attention import _reference_attention
+        return Tensor(_reference_attention(q, k, v, None, scale, causal))
+
+    from ..nn.functional.attention import _reference_attention
+
+    def local(q, k, v):
+        # local: [B, S/n, H, D] -> a2a -> [B, S, H/n, D]
+        def seq2head(x):
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        def head2seq(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+        out = _reference_attention(qg, kg, vg, None, scale, causal)
+        return head2seq(out)
+
+    spec = P(None, axis, None, None)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
+    return Tensor(fn(q, k, v))

@@ -1,0 +1,61 @@
+"""Checkpoint I/O: paddle.save / paddle.load.
+
+Reference parity: ``python/paddle/framework/io.py:201,279`` (pickled state
+dicts of LoDTensors) and the static save/load ops
+(``operators/save_combine_op.cc``).  TPU-native design: tensors are pulled to
+host numpy and pickled; large/sharded arrays use
+``paddle_tpu.distributed.checkpoint`` (orbax-style per-shard files) — see
+``save_sharded``/``load_sharded`` there.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+
+def _to_saveable(obj):
+    from ..core.tensor import Tensor
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": obj.numpy(),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saved(obj, return_numpy=False):
+    from ..core.tensor import Tensor
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(obj["data"], stop_gradient=obj.get(
+                "stop_gradient", True))
+            t.name = obj.get("name", t.name)
+            return t
+        return {k: _from_saved(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saved(v, return_numpy) for v in obj)
+    if isinstance(obj, np.ndarray) and not return_numpy:
+        return obj
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """paddle.save — pickle a (nested) state structure to `path`."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    """paddle.load"""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_saved(obj, return_numpy=return_numpy)

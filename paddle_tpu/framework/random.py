@@ -1,0 +1,20 @@
+"""paddle.framework.random parity (reference: framework/generator.cc)."""
+from ..core import rng
+
+
+def get_cuda_rng_state():  # API-compat shim; TPU has no per-stream RNG state
+    return [rng.get_seed()]
+
+
+def set_cuda_rng_state(state):
+    if state:
+        rng.seed(state[0])
+
+
+def get_rng_state():
+    return [rng.get_seed()]
+
+
+def set_rng_state(state):
+    if state:
+        rng.seed(state[0])

@@ -1,0 +1,241 @@
+"""paddle.Model — the high-level train loop.
+
+Reference parity: ``python/paddle/hapi/model.py:810`` (Model.prepare/fit/
+evaluate/predict/save/load, DynamicGraphAdapter vs StaticGraphAdapter).
+
+TPU-native design: there is only ONE adapter — the compiled-step path.
+``prepare`` wires a TrainStep (parallel/train_step.py); ``fit`` feeds it
+host batches; the whole forward+backward+update is a single pjit'd XLA
+program per batch shape (this is the role the StaticGraphAdapter's
+Program+Executor played, with dygraph ergonomics preserved).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import autograd
+from ..io import DataLoader
+from ..parallel.train_step import TrainStep
+from . import callbacks as cbks_mod
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self.stop_training = False
+        self._eval_fn = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, strategy=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+        amp_level = None
+        if amp_configs:
+            amp_level = amp_configs.get("level", "O1") if isinstance(
+                amp_configs, dict) else "O1"
+        self._strategy = strategy
+        self._amp_level = amp_level
+        if optimizer is not None:
+            self._train_step = TrainStep(
+                self.network, optimizer, loss_fn=loss, strategy=strategy,
+                amp_level=amp_level)
+        return self
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2:
+                return [batch[0]], list(batch[1:])
+            return [batch[0]], []
+        return [batch], []
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """One compiled train step on a batch (reference: model.py:896)."""
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is not None else []
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        loss = self._train_step.step(list(inputs), list(labels))
+        metrics_out = []
+        return [float(loss.numpy())] + metrics_out
+
+    def eval_batch(self, inputs, labels=None):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is not None else []
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        self._sync_weights()
+        self.network.eval()
+        with autograd.no_grad():
+            out = self.network(*inputs)
+        losses = []
+        if self._loss is not None and labels:
+            loss = self._loss(out, *labels)
+            losses.append(float(loss.numpy()))
+        for m in self._metrics:
+            m.update(*to_list(m.compute(out, *labels)))
+        self.network.train()
+        return losses, out
+
+    def predict_batch(self, inputs):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self._sync_weights()
+        self.network.eval()
+        with autograd.no_grad():
+            out = self.network(*inputs)
+        self.network.train()
+        return out
+
+    def _sync_weights(self):
+        if self._train_step is not None:
+            self._train_step.sync_to_layer()
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        assert self._train_step is not None, "call prepare() first"
+        if isinstance(train_data, DataLoader):
+            loader = train_data
+        else:
+            loader = DataLoader(train_data, batch_size=batch_size,
+                                shuffle=shuffle, drop_last=drop_last,
+                                num_workers=num_workers)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            verbose=verbose, save_freq=save_freq, save_dir=save_dir,
+            metrics=[m.name() for m in self._metrics])
+        self.stop_training = False
+        cbks.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            if hasattr(loader, "batch_sampler") and hasattr(
+                    loader.batch_sampler, "set_epoch"):
+                loader.batch_sampler.set_epoch(epoch)
+            cbks.on_epoch_begin(epoch)
+            last_logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, labs = self._split_batch(batch)
+                loss = self._train_step.step(ins, labs)
+                last_logs = {"loss": float(loss.numpy()),
+                             "lr": self._optimizer.get_lr()}
+                cbks.on_train_batch_end(step, last_logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            cbks.on_epoch_end(epoch, last_logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data,
+                                          batch_size=batch_size,
+                                          verbose=0,
+                                          num_workers=num_workers)
+                cbks.on_eval_end(eval_logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end()
+        self._sync_weights()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        if isinstance(eval_data, DataLoader):
+            loader = eval_data
+        else:
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            ins, labs = self._split_batch(batch)
+            batch_losses, _ = self.eval_batch(ins, labs)
+            losses.extend(batch_losses)
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            names = m.name()
+            vals = m.accumulate()
+            if not isinstance(names, (list, tuple)):
+                names, vals = [names], [vals]
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            logs.update(dict(zip(names, vals)))
+        if verbose:
+            print("Eval:", logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        if isinstance(test_data, DataLoader):
+            loader = test_data
+        else:
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch)
+            out = self.predict_batch(ins)
+            outputs.append(out.numpy() if isinstance(out, Tensor) else out)
+        if stack_outputs:
+            return [np.concatenate(outputs)]
+        return [outputs]
+
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+        self._sync_weights()
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+        from ..framework.io import load as fload
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fload(path + ".pdopt"))
+        if self._optimizer is not None:
+            # rebuild device state from the restored layer
+            self._train_step = TrainStep(
+                self.network, self._optimizer, loss_fn=self._loss,
+                strategy=getattr(self, "_strategy", None),
+                amp_level=getattr(self, "_amp_level", None))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        total = 0
+        lines = ["-" * 60]
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape))
+            total += n
+            lines.append(f"{name:<44} {str(p.shape):<20} {n}")
+        lines.append("-" * 60)
+        lines.append(f"Total params: {total:,}")
+        text = "\n".join(lines)
+        print(text)
+        return {"total_params": total}
+
+
+def to_list(value):
+    if value is None:
+        return []
+    if isinstance(value, (list, tuple)):
+        return list(value)
+    return [value]

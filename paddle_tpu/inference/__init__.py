@@ -1,0 +1,114 @@
+"""Inference API.
+
+Reference parity: ``paddle.inference`` — AnalysisConfig/Predictor
+(``inference/api/analysis_predictor.cc:1129,353``).  TPU-native: the "IR
+optimization pipeline" is XLA itself; a Predictor wraps an exported
+StableHLO artifact (jit.save output) or a live Layer compiled with jax.jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Config:
+    """AnalysisConfig parity (the optimization knobs are no-ops: XLA decides)."""
+
+    def __init__(self, model_path=None, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+        self._enable_memory_optim = True
+
+    def enable_use_gpu(self, *a, **k):
+        pass
+
+    def enable_xpu(self, *a, **k):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_memory_optim(self, flag=True):
+        self._enable_memory_optim = flag
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass  # XLA is the engine on TPU
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class PredictorTensor:
+    """Zero-copy-ish handle mirroring paddle_infer.Tensor."""
+
+    def __init__(self, predictor, name, is_input):
+        self._predictor = predictor
+        self._name = name
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr):
+        self._predictor._inputs[self._name] = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._predictor._outputs[self._name])
+
+    def shape(self):
+        if self._is_input:
+            return list(self._predictor._inputs[self._name].shape)
+        return list(self._predictor._outputs[self._name].shape)
+
+
+class Predictor:
+    def __init__(self, config_or_layer):
+        self._inputs = {}
+        self._outputs = {}
+        if isinstance(config_or_layer, Config):
+            from .. import jit as jit_mod
+            base = config_or_layer.model_path
+            if base.endswith(".pdmodel"):
+                base = base[:-len(".pdmodel")]
+            self._layer = jit_mod.load(base)
+        else:
+            layer = config_or_layer
+            layer.eval()
+            self._layer = layer
+        self._input_names = ["x"]
+        self._output_names = ["out"]
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_output_names(self):
+        return list(self._output_names)
+
+    def get_input_handle(self, name):
+        return PredictorTensor(self, name, True)
+
+    def get_output_handle(self, name):
+        return PredictorTensor(self, name, False)
+
+    def run(self, inputs=None):
+        if inputs is not None:
+            arrays = [np.asarray(a) for a in inputs]
+        else:
+            arrays = [self._inputs[n] for n in self._input_names]
+        out = self._layer(*[Tensor(a) for a in arrays])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._output_names = [f"out_{i}" if i else "out"
+                              for i in range(len(outs))]
+        for n, o in zip(self._output_names, outs):
+            self._outputs[n] = o.numpy() if isinstance(o, Tensor) else o
+        if inputs is not None:
+            return [self._outputs[n] for n in self._output_names]
+        return True
+
+
+def create_predictor(config):
+    return Predictor(config)
+
+
+CreatePaddlePredictor = create_predictor

@@ -1,0 +1,313 @@
+"""JIT / static-graph path.
+
+Reference parity: ``@paddle.jit.to_static`` (dygraph_to_static AST rewriting
++ ProgramTranslator, ``fluid/dygraph/jit.py:160``) and ``jit.save/load``
+(TranslatedLayer).
+
+TPU-native design: there is no AST rewriting and no ProgramDesc — a Layer's
+``forward`` is already traceable because ops accept tracers.  ``to_static``
+wraps forward in ``jax.jit`` via ``functional_call`` (parameters become
+traced inputs, so one compiled program serves every step without retracing);
+``jit.save`` exports the traced computation as a serialized StableHLO
+artifact plus a pickled state dict; ``jit.load`` rehydrates a
+TranslatedLayer that runs the compiled artifact.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import autograd, rng
+from ..core.dispatch import primitive
+from ..nn.layer.base import Layer
+
+
+# -- functional bridge ----------------------------------------------------
+def named_params_and_buffers(layer: Layer):
+    params = dict(layer.named_parameters())
+    buffers = {k: v for k, v in layer.named_buffers() if v is not None}
+    return params, buffers
+
+
+@contextlib.contextmanager
+def _swapped(tensors: dict, arrays: dict):
+    """Temporarily rebind Tensor storage to (possibly traced) arrays."""
+    saved = {}
+    try:
+        for name, arr in arrays.items():
+            t = tensors[name]
+            saved[name] = t._data
+            t._data = arr
+        yield
+    finally:
+        for name, old in saved.items():
+            tensors[name]._data = old
+
+
+def functional_call(layer: Layer, param_arrays: dict, buffer_arrays: dict,
+                    args, kwargs=None, training=None, rng_key=None):
+    """Run layer.forward as a pure function of (params, buffers, inputs).
+
+    Returns (outputs_pytree_of_arrays, new_buffer_arrays).  Buffer mutation
+    (BN running stats) during the call is captured and returned functionally.
+    """
+    kwargs = kwargs or {}
+    params, buffers = named_params_and_buffers(layer)
+    prev_training = layer.training
+    if training is not None:
+        (layer.train() if training else layer.eval())
+    if rng_key is not None:
+        rng.push_trace_key(rng_key)
+    try:
+        with _swapped(params, param_arrays), \
+                _swapped(buffers, buffer_arrays):
+            wrapped = [Tensor(a, stop_gradient=True) if isinstance(
+                a, (jnp.ndarray, jax.Array)) or hasattr(a, "aval") else a
+                for a in args]
+            out = layer.forward(*wrapped, **kwargs)
+            new_buffers = {k: buffers[k]._data for k in buffer_arrays}
+    finally:
+        if rng_key is not None:
+            rng.pop_trace_key()
+        if training is not None:
+            (layer.train() if prev_training else layer.eval())
+    return _unwrap_tree(out), new_buffers
+
+
+def _unwrap_tree(out):
+    if isinstance(out, Tensor):
+        return out._data
+    if isinstance(out, (list, tuple)):
+        return type(out)(_unwrap_tree(o) for o in out)
+    if isinstance(out, dict):
+        return {k: _unwrap_tree(v) for k, v in out.items()}
+    return out
+
+
+def _wrap_tree(out, stop_gradient=True):
+    if isinstance(out, (jnp.ndarray, jax.Array)) or hasattr(out, "aval"):
+        return Tensor(out, stop_gradient=stop_gradient)
+    if isinstance(out, (list, tuple)):
+        return type(out)(_wrap_tree(o, stop_gradient) for o in out)
+    if isinstance(out, dict):
+        return {k: _wrap_tree(v, stop_gradient) for k, v in out.items()}
+    return out
+
+
+# -- to_static ------------------------------------------------------------
+class StaticFunction:
+    """Compiled callable over a Layer's forward (or a plain function)."""
+
+    def __init__(self, function, input_spec=None):
+        if isinstance(function, Layer):
+            self._layer = function
+            self._fn = None
+        elif hasattr(function, "__self__") and isinstance(
+                function.__self__, Layer):
+            self._layer = function.__self__
+            self._fn = None
+        else:
+            self._layer = None
+            self._fn = function
+        self._input_spec = input_spec
+        self._cache = {}
+        self.forward = self.__call__
+
+    def _key(self, arrays, training):
+        return tuple((tuple(a.shape), str(a.dtype)) for a in arrays) + (
+            training,)
+
+    def __call__(self, *args, **kwargs):
+        if self._layer is None:
+            return self._call_function(*args, **kwargs)
+        return self._call_layer(*args, **kwargs)
+
+    # plain function path
+    def _call_function(self, *args, **kwargs):
+        arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in args]
+        key = self._key(arrays, None)
+        if key not in self._cache:
+            fn = self._fn
+
+            @jax.jit
+            def pure(*arrs):
+                with autograd.no_grad():
+                    out = fn(*[Tensor(a) for a in arrs], **kwargs)
+                return _unwrap_tree(out)
+
+            self._cache[key] = pure
+        return _wrap_tree(self._cache[key](*arrays))
+
+    # layer path: params are traced args → grads flow via the tape
+    def _call_layer(self, *args, **kwargs):
+        layer = self._layer
+        params, buffers = named_params_and_buffers(layer)
+        pnames = sorted(params)
+        bnames = sorted(buffers)
+        arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in args]
+        training = layer.training
+        key = self._key(arrays, training) + (tuple(pnames), tuple(bnames))
+        seed_key = rng.next_key() if training else jax.random.key(0)
+        if key not in self._cache:
+            n_p, n_b = len(pnames), len(bnames)
+
+            @jax.jit
+            def pure(seed, p_arrs, b_arrs, in_arrs):
+                with autograd.no_grad():
+                    out, new_buf = functional_call(
+                        layer, dict(zip(pnames, p_arrs)),
+                        dict(zip(bnames, b_arrs)), in_arrs, kwargs,
+                        training=training, rng_key=seed)
+                return out, [new_buf[k] for k in bnames]
+
+            self._cache[key] = pure
+        pure = self._cache[key]
+
+        p_tensors = [params[k] for k in pnames]
+
+        @primitive(name="static_function", has_aux=True)
+        def run(*all_arrays):
+            p_arrs = list(all_arrays[:len(pnames)])
+            in_arrs = list(all_arrays[len(pnames):])
+            out, new_bufs = pure(seed_key, p_arrs,
+                                 [buffers[k]._data for k in bnames],
+                                 in_arrs)
+            return out, new_bufs
+
+        res = run(*p_tensors, *[Tensor(a) for a in arrays])
+        # split diff outputs from aux buffer outputs
+        if isinstance(res, tuple):
+            n_buf = len(bnames)
+            outs = res[:len(res) - n_buf] if n_buf else res
+            bufs = res[len(res) - n_buf:] if n_buf else ()
+        else:
+            outs, bufs = (res,), ()
+        for name, b in zip(bnames, bufs):
+            buffers[name]._data = b._data
+        if isinstance(outs, tuple) and len(outs) == 1:
+            return outs[0]
+        return outs
+
+    @property
+    def code(self):
+        return "<compiled by jax.jit (no AST transform needed on TPU)>"
+
+    def concrete_program(self):
+        return None
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """paddle.jit.to_static — decorator or call."""
+
+    def deco(fn):
+        return StaticFunction(fn, input_spec)
+
+    if function is None:
+        return deco
+    return deco(function)
+
+
+declarative = to_static
+
+
+# -- save / load ----------------------------------------------------------
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — StableHLO export + state pickle.
+
+    Produces `path.pdmodel` (serialized StableHLO for eval-mode forward) and
+    `path.pdiparams` (pickled state dict) — same artifact split as the
+    reference (reference: fluid/dygraph/jit.py save → __model__ + params).
+    """
+    from .. import framework
+    from ..static import InputSpec
+
+    if isinstance(layer, StaticFunction):
+        layer = layer._layer
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec on TPU "
+                         "(shapes define the compiled program)")
+    specs = []
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            shape = [1 if (s is None or s < 0) else int(s)
+                     for s in spec.shape]
+            specs.append(jax.ShapeDtypeStruct(
+                tuple(shape), jnp.dtype(spec.dtype)))
+        elif isinstance(spec, Tensor):
+            specs.append(jax.ShapeDtypeStruct(tuple(spec.shape),
+                                              spec._data.dtype))
+        else:
+            specs.append(spec)
+
+    params, buffers = named_params_and_buffers(layer)
+    pnames, bnames = sorted(params), sorted(buffers)
+
+    def pure(p_arrs, b_arrs, in_arrs):
+        with autograd.no_grad():
+            out, _ = functional_call(layer, dict(zip(pnames, p_arrs)),
+                                     dict(zip(bnames, b_arrs)),
+                                     in_arrs, {}, training=False,
+                                     rng_key=None)
+        return out
+
+    jitted = jax.jit(pure)
+    p_shapes = [jax.ShapeDtypeStruct(tuple(params[k].shape),
+                                     params[k]._data.dtype) for k in pnames]
+    b_shapes = [jax.ShapeDtypeStruct(tuple(buffers[k].shape),
+                                     buffers[k]._data.dtype) for k in bnames]
+    exported = jax.export.export(jitted)(p_shapes, b_shapes, specs)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    state = {
+        "params": {k: params[k].numpy() for k in pnames},
+        "buffers": {k: buffers[k].numpy() for k in bnames},
+        "pnames": pnames, "bnames": bnames,
+        "input_specs": [(list(s.shape), str(s.dtype)) for s in specs],
+    }
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+
+
+class TranslatedLayer(Layer):
+    """paddle.jit.load result — runs an exported StableHLO program."""
+
+    def __init__(self, exported, state):
+        super().__init__()
+        self._exported = exported
+        self._state = state
+        self._p_arrays = [jnp.asarray(state["params"][k])
+                          for k in state["pnames"]]
+        self._b_arrays = [jnp.asarray(state["buffers"][k])
+                          for k in state["bnames"]]
+        for k in state["pnames"]:
+            self.add_parameter(
+                k.replace(".", "__"), Parameter(state["params"][k]))
+
+    def forward(self, *args):
+        arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in args]
+        out = self._exported.call(self._p_arrays, self._b_arrays,
+                                  list(arrays))
+        return _wrap_tree(out)
+
+
+def load(path, **configs):
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    return TranslatedLayer(exported, state)
+
+
+def not_to_static(fn):
+    return fn

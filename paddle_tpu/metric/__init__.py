@@ -1,0 +1,187 @@
+"""Metrics (reference: python/paddle/metric/metrics.py — Metric base,
+Accuracy, Precision, Recall, Auc; kernels operators/metrics/*)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Metric:
+    def __init__(self):
+        pass
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred = pred.numpy() if isinstance(pred, Tensor) else np.asarray(pred)
+        label = label.numpy() if isinstance(label, Tensor) else \
+            np.asarray(label)
+        order = np.argsort(-pred, axis=-1)[..., :self.maxk]
+        if label.ndim == pred.ndim:
+            label = label.squeeze(-1)
+        correct = (order == label[..., None])
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct, *args):
+        arr = correct.numpy() if isinstance(correct, Tensor) else \
+            np.asarray(correct)
+        num = arr.shape[0] if arr.ndim else 1
+        accs = []
+        for k in self.topk:
+            c = arr[..., :k].sum(-1).mean()
+            accs.append(float(c))
+        self.total[0] += float(arr[..., :self.maxk].any(-1).sum())
+        self.count[0] += int(np.prod(arr.shape[:-1]))
+        for i, k in enumerate(self.topk):
+            self._correct_k[i] += float(arr[..., :k].sum())
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0]
+        self.count = [0]
+        self._correct_k = [0.0 for _ in self.topk]
+
+    def accumulate(self):
+        res = [ck / self.count[0] if self.count[0] else 0.0
+               for ck in self._correct_k]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name="precision"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds.numpy() if isinstance(preds, Tensor)
+                           else preds)
+        labels = np.asarray(labels.numpy() if isinstance(labels, Tensor)
+                            else labels).reshape(preds.shape)
+        pred_pos = (preds > 0.5)
+        self.tp += int(np.sum(pred_pos & (labels > 0.5)))
+        self.fp += int(np.sum(pred_pos & (labels <= 0.5)))
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds.numpy() if isinstance(preds, Tensor)
+                           else preds)
+        labels = np.asarray(labels.numpy() if isinstance(labels, Tensor)
+                            else labels).reshape(preds.shape)
+        pred_pos = (preds > 0.5)
+        self.tp += int(np.sum(pred_pos & (labels > 0.5)))
+        self.fn += int(np.sum(~pred_pos & (labels > 0.5)))
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """Threshold-bucketed AUC (reference: operators/metrics/auc_op.cc)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        super().__init__()
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds.numpy() if isinstance(preds, Tensor)
+                           else preds)
+        labels = np.asarray(labels.numpy() if isinstance(labels, Tensor)
+                            else labels).reshape(-1)
+        if preds.ndim == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        buckets = np.clip(
+            (preds * self.num_thresholds).astype(np.int64), 0,
+            self.num_thresholds)
+        for b, l in zip(buckets, labels):
+            if l > 0.5:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, np.int64)
+
+    def accumulate(self):
+        tot_pos = 0.0
+        tot_neg = 0.0
+        auc = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += (new_pos + tot_pos) * (new_neg - tot_neg) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        return auc / (tot_pos * tot_neg)
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """functional paddle.metric.accuracy"""
+    pred = input.numpy() if isinstance(input, Tensor) else np.asarray(input)
+    lab = label.numpy() if isinstance(label, Tensor) else np.asarray(label)
+    order = np.argsort(-pred, axis=-1)[..., :k]
+    if lab.ndim == pred.ndim:
+        lab = lab.squeeze(-1)
+    correct_any = (order == lab[..., None]).any(-1)
+    return Tensor(np.asarray(correct_any.mean(), np.float32))

@@ -1,0 +1,107 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+GradientClipByValue/ByNorm/ByGlobalNorm)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        """params_grads: list[(param, grad)] -> clipped list."""
+        raise NotImplementedError
+
+    # functional form used inside jit'd train steps (pytree of grad arrays)
+    def apply_tree(self, grads_tree):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._data, self.min, self.max))))
+        return out
+
+    def apply_tree(self, grads):
+        import jax
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_one(self, g):
+        norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return g * scale
+
+    def __call__(self, params_grads):
+        return [(p, Tensor(self._clip_one(g._data)) if g is not None else g)
+                for p, g in params_grads]
+
+    def apply_tree(self, grads):
+        import jax
+        return jax.tree_util.tree_map(self._clip_one, grads)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        grads = [g._data for _, g in params_grads if g is not None]
+        if not grads:
+            return params_grads
+        global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                                   for g in grads))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [(p, Tensor((g._data * scale).astype(g._data.dtype))
+                 if g is not None else g)
+                for p, g in params_grads]
+
+    def apply_tree(self, grads):
+        import jax
+        leaves = jax.tree_util.tree_leaves(grads)
+        global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                                   for g in leaves))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return jax.tree_util.tree_map(
+            lambda g: (g * scale).astype(g.dtype), grads)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """torch-style helper also exposed by paddle.nn.utils."""
+    if not isinstance(parameters, (list, tuple)):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros([]))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._data))
+                                   for g in grads]))
+    else:
+        total = jnp.power(sum(jnp.sum(jnp.power(jnp.abs(g._data), norm_type))
+                              for g in grads), 1.0 / norm_type)
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = p.grad._data * clip_coef
+    return Tensor(total)
+
+
+# fluid-era aliases
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm

@@ -1,0 +1,214 @@
+"""Activation functionals (reference: operators/activation_op.cc — all the
+activations the reference registers in one file, lowered here to jax.nn /
+jnp compositions that XLA fuses into adjacent matmuls)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive, ensure_tensor
+
+
+def _unary(name, fn):
+    prim = primitive(name=name)(fn)
+
+    def api(x, name=None):
+        return prim(ensure_tensor(x))
+
+    api.__name__ = name
+    return api
+
+
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", jax.nn.relu6)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+silu = _unary("silu", jax.nn.silu)
+mish = _unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+softsign = _unary("softsign", jax.nn.soft_sign)
+tanhshrink = _unary("tanhshrink", lambda x: x - jnp.tanh(x))
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
+
+
+@primitive(name="gelu")
+def _gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def gelu(x, approximate=False, name=None):
+    return _gelu(ensure_tensor(x), approximate=approximate)
+
+
+@primitive(name="leaky_relu")
+def _leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _leaky_relu(ensure_tensor(x), negative_slope=negative_slope)
+
+
+@primitive(name="elu")
+def _elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _elu(ensure_tensor(x), alpha=alpha)
+
+
+@primitive(name="celu")
+def _celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+def celu(x, alpha=1.0, name=None):
+    return _celu(ensure_tensor(x), alpha=alpha)
+
+
+@primitive(name="selu")
+def _selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _selu(ensure_tensor(x), scale=scale, alpha=alpha)
+
+
+@primitive(name="hardtanh")
+def _hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _hardtanh(ensure_tensor(x), min=min, max=max)
+
+
+@primitive(name="hardsigmoid")
+def _hardsigmoid(x, slope=1.0 / 6, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return _hardsigmoid(ensure_tensor(x), slope=slope, offset=offset)
+
+
+hardswish = _unary("hardswish",
+                   lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0)
+swish = _unary("swish", jax.nn.silu)
+
+
+@primitive(name="hardshrink")
+def _hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _hardshrink(ensure_tensor(x), threshold=threshold)
+
+
+@primitive(name="softshrink")
+def _softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _softshrink(ensure_tensor(x), threshold=threshold)
+
+
+@primitive(name="softplus")
+def _softplus(x, beta=1.0, threshold=20.0):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x,
+                     jnp.logaddexp(scaled, 0.0) / beta)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _softplus(ensure_tensor(x), beta=beta, threshold=threshold)
+
+
+@primitive(name="thresholded_relu")
+def _thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return _thresholded_relu(ensure_tensor(x), threshold=threshold)
+
+
+@primitive(name="softmax")
+def _softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return _softmax(x, axis=axis)
+
+
+@primitive(name="log_softmax")
+def _log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return _log_softmax(x, axis=axis)
+
+
+@primitive(name="prelu")
+def _prelu(x, weight):
+    w = weight
+    if w.ndim == 1 and w.shape[0] > 1:
+        w = w.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(x > 0, x, w * x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    return _prelu(ensure_tensor(x), ensure_tensor(weight))
+
+
+@primitive(name="glu")
+def _glu(x, axis=-1):
+    return jax.nn.glu(x, axis=axis)
+
+
+def glu(x, axis=-1, name=None):
+    return _glu(ensure_tensor(x), axis=axis)
+
+
+@primitive(name="maxout")
+def _maxout(x, groups, axis=1):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return _maxout(ensure_tensor(x), groups=groups, axis=axis)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import rng
+    x = ensure_tensor(x)
+    g = jax.random.gumbel(rng.next_key(), tuple(x.shape), x._data.dtype)
+    prim = primitive(name="gumbel_softmax")(
+        lambda a: jax.nn.softmax((a + g) / temperature, axis=axis))
+    y = prim(x)
+    if hard:
+        idx = jnp.argmax(y._data, axis=axis, keepdims=True)
+        hard_y = jnp.zeros_like(y._data)
+        hard_y = jnp.put_along_axis(hard_y, idx, 1.0, axis=axis,
+                                    inplace=False) if hasattr(
+            jnp, "put_along_axis") else hard_y.at[..., 0].set(0)
+        # straight-through estimator
+        from ...core.tensor import Tensor
+        return Tensor(hard_y - jax.lax.stop_gradient(y._data) + y._data)
+    return y

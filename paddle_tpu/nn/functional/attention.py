@@ -1,0 +1,96 @@
+"""Attention functionals.
+
+Reference parity: the reference only has a score-materializing
+``MultiHeadAttention`` (python/paddle/nn/layer/transformer.py:85) and an
+inference-only fused kernel (operators/fused/multihead_matmul_op.cu).
+TPU-native design: one `scaled_dot_product_attention` entry point that
+dispatches to a Pallas flash-attention kernel on TPU backends (blockwise
+online-softmax so the S×S score matrix never hits HBM) with a pure-XLA
+fallback elsewhere (CPU tests, tiny shapes).  Long-context sharded variants
+(ring attention over a mesh axis) live in paddle_tpu/distributed/ring.py and
+reuse the same inner kernel.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive, ensure_tensor
+
+
+def _reference_attention(q, k, v, mask=None, scale=None, is_causal=False):
+    """[B, S, H, D] layout (paddle convention). Pure XLA."""
+    b, sq, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    qh = jnp.swapaxes(q, 1, 2)  # [B, H, S, D]
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if is_causal:
+        sk = kh.shape[2]
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        else:
+            scores = scores + mask
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+        q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+@functools.lru_cache(maxsize=None)
+def _flash_available():
+    if jax.default_backend() == "cpu":
+        return False
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (  # noqa
+            flash_attention)
+        return True
+    except Exception:
+        return False
+
+
+def _flash_attention(q, k, v, mask, scale, is_causal):
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention)
+    # pallas kernel expects [B, H, S, D]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    out = flash_attention(qh, kh, vh, causal=is_causal, sm_scale=scale)
+    return jnp.swapaxes(out, 1, 2)
+
+
+@primitive(name="scaled_dot_product_attention")
+def _sdpa(q, k, v, mask=None, scale=None, is_causal=False, use_flash=True):
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    seq = q.shape[1]
+    if (use_flash and mask is None and _flash_available()
+            and seq % 128 == 0 and d % 128 == 0):
+        return _flash_attention(q, k, v, mask, scale, is_causal)
+    return _reference_attention(q, k, v, mask, scale, is_causal)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None, name=None):
+    """Inputs [batch, seq, num_heads, head_dim] (paddle layout)."""
+    q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    if attn_mask is not None:
+        attn_mask = ensure_tensor(attn_mask)
+        out = primitive(name="scaled_dot_product_attention_masked")(
+            lambda qq, kk, vv, mm: _reference_attention(
+                qq, kk, vv, mm, scale, is_causal))(q, k, v, attn_mask)
+    else:
+        out = _sdpa(q, k, v, scale=scale, is_causal=is_causal)
+    if dropout_p > 0.0 and training:
+        from .common import dropout
+        out = dropout(out, p=dropout_p, training=training)
+    return out

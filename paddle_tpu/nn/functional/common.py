@@ -1,0 +1,317 @@
+"""Common functionals: linear, dropout, embedding, padding, resize, etc.
+
+Reference parity: mul_op/fc, dropout_op.cc, lookup_table_v2_op.cc (embedding),
+pad3d_op.cc, interpolate_v2_op.cc, pixel_shuffle_op.cc, unfold_op.cc,
+label_smooth_op.cc, sequence_mask_op (sequence_ops/).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import primitive, ensure_tensor
+from ...core.tensor import Tensor
+from ...core import rng
+
+
+@primitive(name="linear")
+def _linear(x, w, b=None):
+    out = jnp.matmul(x, w)
+    if b is not None:
+        out = out + b
+    return out
+
+
+def linear(x, weight, bias=None, name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    if bias is not None:
+        return _linear(x, weight, ensure_tensor(bias))
+    return _linear(x, weight)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    """reference: operators/dropout_op.cc; keys from core/rng (traced-key
+    aware so jit'd steps get fresh masks per step)."""
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return primitive(name="dropout_scale")(
+                lambda a: a * (1.0 - p))(x)
+        return x
+    key = rng.next_key()
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        mask_shape = tuple(s if i in axes else 1
+                           for i, s in enumerate(x.shape))
+    else:
+        mask_shape = tuple(x.shape)
+
+    @primitive(name="dropout")
+    def _dropout(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return _dropout(x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = rng.next_key()
+
+    @primitive(name="alpha_dropout")
+    def _ad(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(a.shape))
+        coef_a = (1.0 - p + p * alpha_p ** 2 * (1.0 - p)) ** -0.5
+        coef_b = -coef_a * p * alpha_p
+        return coef_a * jnp.where(keep, a, alpha_p) + coef_b
+
+    return _ad(x)
+
+
+@primitive(name="lookup_table_v2", nondiff=(1,))
+def _embedding(w, ids, padding_idx=None):
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Embedding lookup.  `sparse` (SelectedRows grads in the reference) is
+    accepted and ignored: XLA's scatter-add on the gather VJP plays that
+    role on TPU."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    if padding_idx is not None and padding_idx < 0:
+        padding_idx = weight.shape[0] + padding_idx
+    return _embedding(weight, x, padding_idx=padding_idx)
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops import one_hot as _oh
+    return _oh(x, num_classes)
+
+
+@primitive(name="pad")
+def _pad(x, pad_cfg=None, mode="constant", value=0.0):
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pad_cfg, mode="constant", constant_values=value)
+    return jnp.pad(x, pad_cfg, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = list(int(p) for p in pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full form, paddle order: last-dim pairs first? paddle uses
+        # [pad_left, pad_right, pad_top, pad_bottom, ...] per data_format
+        cfg = [(0, 0)] * nd
+        n_spatial = len(pad) // 2
+        for i in range(n_spatial):
+            dim = nd - 1 - i
+            cfg[dim] = (pad[2 * i], pad[2 * i + 1])
+    else:
+        # spatial-only form: applies to trailing dims (excluding N, C)
+        n_spatial = len(pad) // 2
+        cfg = [(0, 0)] * nd
+        channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+        spatial_dims = (list(range(1, 1 + n_spatial)) if channel_last
+                        else list(range(nd - n_spatial, nd)))
+        for i in range(n_spatial):
+            dim = spatial_dims[::-1][i] if not channel_last else \
+                spatial_dims[::-1][i]
+            cfg[dim] = (pad[2 * i], pad[2 * i + 1])
+    return _pad(x, pad_cfg=tuple(cfg), mode=mode, value=float(value))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+@primitive(name="pixel_shuffle")
+def _pixel_shuffle(x, upscale_factor=1):
+    n, c, h, w = x.shape
+    r = upscale_factor
+    y = x.reshape(n, c // (r * r), r, r, h, w)
+    y = jnp.transpose(y, (0, 1, 4, 2, 5, 3))
+    return y.reshape(n, c // (r * r), h * r, w * r)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _pixel_shuffle(ensure_tensor(x), upscale_factor=upscale_factor)
+
+
+@primitive(name="pixel_unshuffle")
+def _pixel_unshuffle(x, downscale_factor=1):
+    n, c, h, w = x.shape
+    r = downscale_factor
+    y = x.reshape(n, c, h // r, r, w // r, r)
+    y = jnp.transpose(y, (0, 1, 3, 5, 2, 4))
+    return y.reshape(n, c * r * r, h // r, w // r)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return _pixel_unshuffle(ensure_tensor(x),
+                            downscale_factor=downscale_factor)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """reference: operators/interpolate_v2_op.cc (nearest/bilinear/bicubic).
+    Lowered to jax.image.resize."""
+    x = ensure_tensor(x)
+    spatial = x.shape[2:]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    if isinstance(size, Tensor):
+        size = size.tolist()
+    size = [int(s) for s in size]
+    method = {"nearest": "nearest", "bilinear": "linear",
+              "bicubic": "cubic", "trilinear": "linear",
+              "linear": "linear", "area": "linear"}[mode]
+
+    @primitive(name="interpolate")
+    def _resize(a):
+        out_shape = tuple(a.shape[:2]) + tuple(size)
+        return jax.image.resize(a, out_shape, method=method)
+
+    return _resize(x)
+
+
+upsample = interpolate
+
+
+@primitive(name="unfold")
+def _unfold(x, kernel_sizes, strides, paddings, dilations):
+    n, c = x.shape[:2]
+    kh, kw = kernel_sizes
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=tuple(strides),
+        padding=[(paddings[0], paddings[1]), (paddings[2], paddings[3])]
+        if len(paddings) == 4 else [(p, p) for p in paddings],
+        rhs_dilation=tuple(dilations),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [N, C*kh*kw, OH, OW] -> [N, C*kh*kw, OH*OW]
+    return patches.reshape(n, patches.shape[1], -1)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    return _unfold(ensure_tensor(x), kernel_sizes=_pair(kernel_sizes),
+                   strides=_pair(strides), paddings=_pair(paddings),
+                   dilations=_pair(dilations))
+
+
+@primitive(name="label_smooth")
+def _label_smooth(label, epsilon=0.1):
+    k = label.shape[-1]
+    return label * (1.0 - epsilon) + epsilon / k
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = ensure_tensor(label)
+    if prior_dist is not None:
+        prior = ensure_tensor(prior_dist)
+        prim = primitive(name="label_smooth_prior")(
+            lambda l, p: l * (1.0 - epsilon) + epsilon * p)
+        return prim(label, prior)
+    return _label_smooth(label, epsilon=epsilon)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """reference: operators/sequence_ops/sequence_mask_op.cc"""
+    from ...core import dtype as dtypes
+    x = ensure_tensor(x)
+    if maxlen is None:
+        maxlen = int(jnp.max(x._data))
+    steps = jnp.arange(int(maxlen))
+    mask = steps[None, :] < x._data[..., None]
+    return Tensor(mask.astype(dtypes.to_jax(dtype)))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    x1, x2 = ensure_tensor(x1), ensure_tensor(x2)
+    prim = primitive(name="cosine_similarity")(
+        lambda a, b: jnp.sum(a * b, axis=axis) / (
+            jnp.maximum(jnp.linalg.norm(a, axis=axis)
+                        * jnp.linalg.norm(b, axis=axis), eps)))
+    return prim(x1, x2)
+
+
+@primitive(name="affine_grid")
+def _affine_grid(theta, out_h, out_w, align_corners=True):
+    n = theta.shape[0]
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, out_h)
+        xs = jnp.linspace(-1.0, 1.0, out_w)
+    else:
+        ys = (jnp.arange(out_h) + 0.5) * 2.0 / out_h - 1.0
+        xs = (jnp.arange(out_w) + 0.5) * 2.0 / out_w - 1.0
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+    grid = jnp.einsum("hwk,njk->nhwj", base, theta)
+    return grid
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    if isinstance(out_shape, Tensor):
+        out_shape = out_shape.tolist()
+    _, _, h, w = [int(s) for s in out_shape]
+    return _affine_grid(ensure_tensor(theta), out_h=h, out_w=w,
+                        align_corners=align_corners)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError(
+        "class_center_sample: PS-class op not yet ported")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    anchor, positive = ensure_tensor(anchor), ensure_tensor(positive)
+    labels = ensure_tensor(labels)
+
+    @primitive(name="npair_loss")
+    def _np_loss(a, p):
+        batch = a.shape[0]
+        sim = jnp.matmul(a, p.T)
+        lab = labels._data.reshape(-1)
+        targets = (lab[:, None] == lab[None, :]).astype(a.dtype)
+        targets = targets / jnp.sum(targets, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(targets * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(a), axis=1))
+                        + jnp.mean(jnp.sum(jnp.square(p), axis=1))) / 2
+        return ce + reg
+
+    return _np_loss(anchor, positive)

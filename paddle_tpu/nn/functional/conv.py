@@ -1,0 +1,169 @@
+"""Convolution functionals.
+
+Reference parity: ``paddle/fluid/operators/conv_op.cc`` /
+``conv_transpose_op.cc`` (cuDNN kernels).  TPU-native: a single
+``lax.conv_general_dilated`` per op — XLA tiles it onto the MXU; the
+reference's algorithm-search/workspace machinery has no analogue.
+Weight layouts follow paddle: conv [O, I/g, *K], transpose [I, O/g, *K].
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import primitive, ensure_tensor
+
+
+def _norm_padding(padding, nd, kernel, dilation):
+    """Return list of (lo, hi) per spatial dim."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    # [[0,0],[0,0],[lo,hi],...] full-layout form
+    flat = [tuple(p) for p in padding]
+    return [tuple(p) for p in flat[-nd:]]
+
+
+def _tup(v, nd):
+    if isinstance(v, int):
+        return (v,) * nd
+    return tuple(int(x) for x in v)
+
+
+def _conv_nd(x, w, bias, stride, padding, dilation, groups, nd, channel_last):
+    stride = _tup(stride, nd)
+    dilation = _tup(dilation, nd)
+    spatial = "DHW"[3 - nd:]
+    if channel_last:
+        lhs_spec = "N" + spatial + "C"
+        out_spec = lhs_spec
+    else:
+        lhs_spec = "NC" + spatial
+        out_spec = lhs_spec
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape, (lhs_spec, "OI" + spatial, out_spec))
+    pad = _norm_padding(padding, nd, w.shape[2:], dilation)
+    out = lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        if channel_last:
+            out = out + bias.reshape((1,) * (nd + 1) + (-1,))
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _make_conv(nd, name):
+    @primitive(name=name)
+    def fn(x, w, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           channel_last=False):
+        return _conv_nd(x, w, bias, stride, padding, dilation, groups, nd,
+                        channel_last)
+
+    def api(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+            data_format=None, name=None):
+        channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+        x, weight = ensure_tensor(x), ensure_tensor(weight)
+        if bias is not None:
+            return fn(x, weight, ensure_tensor(bias), stride=stride,
+                      padding=padding, dilation=dilation, groups=groups,
+                      channel_last=channel_last)
+        return fn(x, weight, stride=stride, padding=padding,
+                  dilation=dilation, groups=groups,
+                  channel_last=channel_last)
+
+    api.__name__ = name
+    return api
+
+
+conv1d = _make_conv(1, "conv1d")
+conv2d = _make_conv(2, "conv2d")
+conv3d = _make_conv(3, "conv3d")
+
+
+def _conv_transpose_nd(x, w, bias, stride, padding, output_padding, dilation,
+                       groups, nd, channel_last):
+    stride = _tup(stride, nd)
+    dilation = _tup(dilation, nd)
+    output_padding = _tup(output_padding, nd)
+    spatial = "DHW"[3 - nd:]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    # paddle transpose weight layout: [I, O/g, *K] -> use IO spec
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape, (lhs_spec, "IO" + spatial, lhs_spec))
+    pad = _norm_padding(padding, nd, w.shape[2:], dilation)
+    if isinstance(pad, str):
+        pad_pairs = [(0, 0)] * nd if pad == "VALID" else None
+        if pad_pairs is None:
+            raise ValueError("SAME padding unsupported for conv_transpose")
+        pad = pad_pairs
+    # fractionally-strided conv: lhs_dilation=stride, padding adjusted by
+    # effective kernel size, kernel flipped spatially.
+    w_flip = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    eff_k = [(w.shape[2 + i] - 1) * dilation[i] + 1 for i in range(nd)]
+    new_pad = [(eff_k[i] - 1 - pad[i][0],
+                eff_k[i] - 1 - pad[i][1] + output_padding[i])
+               for i in range(nd)]
+    out = lax.conv_general_dilated(
+        x, w_flip, window_strides=(1,) * nd, padding=new_pad,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        if channel_last:
+            out = out + bias.reshape((1,) * (nd + 1) + (-1,))
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _make_conv_transpose(nd, name):
+    @primitive(name=name)
+    def fn(x, w, bias=None, stride=1, padding=0, output_padding=0,
+           dilation=1, groups=1, channel_last=False):
+        return _conv_transpose_nd(x, w, bias, stride, padding,
+                                  output_padding, dilation, groups, nd,
+                                  channel_last)
+
+    def api(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+            groups=1, dilation=1, output_size=None, data_format=None,
+            name=None):
+        channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+        x, weight = ensure_tensor(x), ensure_tensor(weight)
+        if output_size is not None:
+            # derive output_padding from requested size
+            stride_t = _tup(stride, nd)
+            dil_t = _tup(dilation, nd)
+            pad_t = _norm_padding(padding, nd, weight.shape[2:], dil_t)
+            osz = _tup(output_size, nd)
+            output_padding = []
+            for i in range(nd):
+                eff_k = (weight.shape[2 + i] - 1) * dil_t[i] + 1
+                in_sz = x.shape[(1 + i + 1) if not channel_last else (1 + i)]
+                base = (in_sz - 1) * stride_t[i] - pad_t[i][0] - pad_t[i][1] \
+                    + eff_k
+                output_padding.append(osz[i] - base)
+        if bias is not None:
+            return fn(x, weight, ensure_tensor(bias), stride=stride,
+                      padding=padding, output_padding=output_padding,
+                      dilation=dilation, groups=groups,
+                      channel_last=channel_last)
+        return fn(x, weight, stride=stride, padding=padding,
+                  output_padding=output_padding, dilation=dilation,
+                  groups=groups, channel_last=channel_last)
+
+    api.__name__ = name
+    return api
+
+
+conv1d_transpose = _make_conv_transpose(1, "conv1d_transpose")
+conv2d_transpose = _make_conv_transpose(2, "conv2d_transpose")
+conv3d_transpose = _make_conv_transpose(3, "conv3d_transpose")

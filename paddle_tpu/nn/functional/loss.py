@@ -1,0 +1,330 @@
+"""Loss functionals.
+
+Reference parity: softmax_with_cross_entropy_op.cc, cross_entropy_op.cc,
+bce_loss_op.cc, sigmoid_cross_entropy_with_logits_op.cc, mse/l1 (elementwise
+compositions in python/paddle/nn/functional/loss.py), kldiv_loss_op.cc,
+smooth_l1_loss_op.cc, margin_rank_loss_op.cc, warpctc_op.cc (→ optax ctc),
+nll_loss_op.cc, hsigmoid etc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive, ensure_tensor
+from ...core.tensor import Tensor
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    w = ensure_tensor(weight) if weight is not None else None
+
+    @primitive(name="softmax_with_cross_entropy", nondiff=(1,))
+    def _ce(logits, lab, wgt=None):
+        logits = jnp.moveaxis(logits, axis, -1)
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=-1)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label:
+            tgt = jnp.moveaxis(lab, axis, -1)
+            if label_smoothing:
+                k = logp.shape[-1]
+                tgt = tgt * (1 - label_smoothing) + label_smoothing / k
+            loss = -jnp.sum(tgt * logp, axis=-1)
+            return _reduce(loss, reduction)
+        lab_idx = lab
+        if lab_idx.ndim == logp.ndim:
+            lab_idx = jnp.squeeze(jnp.moveaxis(lab_idx, axis, -1), axis=-1)
+        lab_idx = lab_idx.astype(jnp.int32)
+        valid = lab_idx != ignore_index
+        safe = jnp.where(valid, lab_idx, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None],
+                                     axis=-1).squeeze(-1)
+        if label_smoothing:
+            k = logp.shape[-1]
+            smooth = jnp.mean(logp, axis=-1)
+            picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+        loss = -picked
+        if wgt is not None:
+            wsel = wgt[safe]
+            loss = loss * wsel
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, wsel, 0.0)), 1e-12)
+            return _reduce(loss, reduction)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+
+    if soft_label:
+        # soft labels participate in grad flow per reference semantics
+        prim = primitive(name="softmax_with_cross_entropy_soft")(
+            lambda logits, lab: _ce.raw_fn(logits, lab))
+        return prim(input, label)
+    if w is not None:
+        return _ce(input, label, w)
+    return _ce(input, label)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as _softmax
+    if loss.ndim < ensure_tensor(logits).ndim:
+        from ...ops import unsqueeze
+        loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    @primitive(name="bce_loss")
+    def _bce(p, t, w=None):
+        eps = 1e-12
+        loss = -(t * jnp.log(jnp.maximum(p, eps))
+                 + (1 - t) * jnp.log(jnp.maximum(1 - p, eps)))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    if weight is not None:
+        return _bce(input, label, ensure_tensor(weight))
+    return _bce(input, label)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+    pw = ensure_tensor(pos_weight)._data if pos_weight is not None else None
+
+    @primitive(name="sigmoid_cross_entropy_with_logits")
+    def _bce_logits(x, t, w=None):
+        # stable: max(x,0) - x*t + log(1+exp(-|x|)), with pos_weight factor
+        log_sig = jax.nn.log_sigmoid(x)
+        log_sig_neg = jax.nn.log_sigmoid(-x)
+        if pw is not None:
+            loss = -(pw * t * log_sig + (1 - t) * log_sig_neg)
+        else:
+            loss = -(t * log_sig + (1 - t) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    if weight is not None:
+        return _bce_logits(logit, label, ensure_tensor(weight))
+    return _bce_logits(logit, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+
+    @primitive(name="sigmoid_focal_loss")
+    def _focal(x, t):
+        p = jax.nn.sigmoid(x)
+        ce = -(t * jax.nn.log_sigmoid(x) + (1 - t) * jax.nn.log_sigmoid(-x))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if normalizer is not None:
+            loss = loss / ensure_tensor(normalizer)._data
+        return _reduce(loss, reduction)
+
+    return _focal(logit, label)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return primitive(name="mse_loss")(
+        lambda x, y: _reduce(jnp.square(x - y), reduction))(input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return primitive(name="l1_loss")(
+        lambda x, y: _reduce(jnp.abs(x - y), reduction))(input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    @primitive(name="smooth_l1_loss")
+    def _sl1(x, y):
+        d = jnp.abs(x - y)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return _sl1(input, label)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    @primitive(name="nll_loss", nondiff=(1,))
+    def _nll(logp, lab, w=None):
+        lab = lab.astype(jnp.int32)
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        # class axis is 1 (paddle semantics for ND input)
+        picked = jnp.take_along_axis(logp, safe[:, None, ...], axis=1)
+        picked = jnp.squeeze(picked, axis=1)
+        loss = -picked
+        if w is not None:
+            wsel = w[safe]
+            loss = loss * wsel
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, wsel, 0.0)), 1e-12)
+            return _reduce(loss, reduction)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+
+    if weight is not None:
+        return _nll(input, label, ensure_tensor(weight))
+    return _nll(input, label)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    @primitive(name="kldiv_loss")
+    def _kl(logp, t):
+        loss = t * (jnp.log(jnp.maximum(t, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return _kl(input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    input, other, label = (ensure_tensor(input), ensure_tensor(other),
+                           ensure_tensor(label))
+
+    @primitive(name="margin_rank_loss")
+    def _mrl(x1, x2, y):
+        loss = jnp.maximum(0.0, -y * (x1 - x2) + margin)
+        return _reduce(loss, reduction)
+
+    return _mrl(input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    @primitive(name="hinge_embedding_loss")
+    def _hel(x, y):
+        loss = jnp.where(y == 1.0, x, jnp.maximum(0.0, margin - x))
+        return _reduce(loss, reduction)
+
+    return _hel(input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    x1, x2 = ensure_tensor(input1), ensure_tensor(input2)
+    label = ensure_tensor(label)
+
+    @primitive(name="cosine_embedding_loss")
+    def _cel(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return _cel(x1, x2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    a = ensure_tensor(input)
+    pos, neg = ensure_tensor(positive), ensure_tensor(negative)
+
+    @primitive(name="triplet_margin_loss")
+    def _tml(x, pp, nn):
+        d_pos = jnp.power(jnp.sum(jnp.power(jnp.abs(x - pp) + epsilon, p),
+                                  axis=-1), 1 / p)
+        d_neg = jnp.power(jnp.sum(jnp.power(jnp.abs(x - nn) + epsilon, p),
+                                  axis=-1), 1 / p)
+        if swap:
+            d_swap = jnp.power(jnp.sum(
+                jnp.power(jnp.abs(pp - nn) + epsilon, p), axis=-1), 1 / p)
+            d_neg = jnp.minimum(d_neg, d_swap)
+        loss = jnp.maximum(0.0, d_pos - d_neg + margin)
+        return _reduce(loss, reduction)
+
+    return _tml(a, pos, neg)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """reference: operators/warpctc_op.cc — lowered to optax.ctc_loss."""
+    import optax
+    log_probs = ensure_tensor(log_probs)
+    labels = ensure_tensor(labels)
+    input_lengths = ensure_tensor(input_lengths)
+    label_lengths = ensure_tensor(label_lengths)
+
+    @primitive(name="warpctc", nondiff=(1, 2, 3))
+    def _ctc(lp, lab, in_len, lab_len):
+        # paddle layout: [T, B, C] logits; optax expects [B, T, C]
+        logits = jnp.transpose(lp, (1, 0, 2))
+        b, t, _ = logits.shape
+        logit_pad = (jnp.arange(t)[None, :] >= in_len[:, None]).astype(
+            logits.dtype)
+        lab_max = lab.shape[1]
+        label_pad = (jnp.arange(lab_max)[None, :] >= lab_len[:, None]).astype(
+            logits.dtype)
+        per_seq = optax.ctc_loss(logits, logit_pad, lab.astype(jnp.int32),
+                                 label_pad, blank_id=blank)
+        if reduction == "mean":
+            return jnp.mean(per_seq / jnp.maximum(
+                lab_len.astype(per_seq.dtype), 1.0))
+        if reduction == "sum":
+            return jnp.sum(per_seq)
+        return per_seq
+
+    return _ctc(log_probs, labels, input_lengths, label_lengths)
+
+
+def square_error_cost(input, label):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return primitive(name="square_error_cost")(
+        lambda x, y: jnp.square(x - y))(input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return primitive(name="log_loss")(
+        lambda p, t: -t * jnp.log(p + epsilon)
+        - (1 - t) * jnp.log(1 - p + epsilon))(input, label)

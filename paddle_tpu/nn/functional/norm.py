@@ -1,0 +1,185 @@
+"""Normalization functionals.
+
+Reference parity: batch_norm_op.cc, layer_norm_op.cc, instance_norm_op.cc,
+group_norm_op.cc, norm_op.cc (l2 normalize).  The functional forms are pure;
+running-stat mutation lives in the Layer wrappers (nn/layer/norm.py), so the
+same code path works eagerly and under jit tracing.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive, ensure_tensor
+from ...core.tensor import Tensor
+
+
+@primitive(name="batch_norm_infer")
+def _bn_infer(x, mean, variance, weight, bias, epsilon=1e-5,
+              data_format="NCHW"):
+    axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    inv = jnp.reciprocal(jnp.sqrt(variance + epsilon))
+    out = (x - mean.reshape(shape)) * (inv.reshape(shape))
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@primitive(name="batch_norm_train", has_aux=True)
+def _bn_train(x, weight, bias, epsilon=1e-5, data_format="NCHW"):
+    axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    mean = jnp.mean(x, axis=red)
+    var = jnp.var(x, axis=red)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    inv = jnp.reciprocal(jnp.sqrt(var + epsilon))
+    out = (x - mean.reshape(shape)) * inv.reshape(shape)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, (mean, var)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Functional BN.  In training mode, updates running stats in place on
+    the provided Tensors (mirrors reference batch_norm_op.cc behavior)."""
+    x = ensure_tensor(x)
+    use_batch_stats = training and not use_global_stats
+    if not use_batch_stats:
+        return _bn_infer(x, ensure_tensor(running_mean),
+                         ensure_tensor(running_var),
+                         ensure_tensor(weight) if weight is not None else None,
+                         ensure_tensor(bias) if bias is not None else None,
+                         epsilon=epsilon, data_format=data_format)
+    res = _bn_train(x,
+                    ensure_tensor(weight) if weight is not None else None,
+                    ensure_tensor(bias) if bias is not None else None,
+                    epsilon=epsilon, data_format=data_format)
+    out, batch_mean, batch_var = res
+    if running_mean is not None:
+        m = momentum
+        running_mean._data = (m * running_mean._data
+                              + (1 - m) * batch_mean._data)
+        running_var._data = (m * running_var._data
+                             + (1 - m) * batch_var._data)
+    return out
+
+
+@primitive(name="layer_norm")
+def _layer_norm(x, weight, bias, normalized_ndim=1, epsilon=1e-5):
+    axes = tuple(range(x.ndim - normalized_ndim, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jnp.reciprocal(jnp.sqrt(var + epsilon))
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    x = ensure_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    ndim = len(list(normalized_shape))
+    w = ensure_tensor(weight) if weight is not None else None
+    b = ensure_tensor(bias) if bias is not None else None
+    if w is not None and b is not None:
+        return _layer_norm(x, w, b, normalized_ndim=ndim, epsilon=epsilon)
+    if w is not None:
+        return _layer_norm(x, w, None, normalized_ndim=ndim, epsilon=epsilon)
+    if b is not None:
+        return _layer_norm(x, None, b, normalized_ndim=ndim, epsilon=epsilon)
+    return _layer_norm(x, None, None, normalized_ndim=ndim, epsilon=epsilon)
+
+
+@primitive(name="instance_norm")
+def _instance_norm(x, weight, bias, epsilon=1e-5):
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    out = (x - mean) * jnp.reciprocal(jnp.sqrt(var + epsilon))
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  eps=1e-5, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    w = ensure_tensor(weight) if weight is not None else None
+    b = ensure_tensor(bias) if bias is not None else None
+    return _instance_norm(x, w, b, epsilon=eps)
+
+
+@primitive(name="group_norm")
+def _group_norm(x, weight, bias, num_groups=1, epsilon=1e-5):
+    n, c = x.shape[0], x.shape[1]
+    g = num_groups
+    spatial = x.shape[2:]
+    y = x.reshape((n, g, c // g) + spatial)
+    red = tuple(range(2, y.ndim))
+    mean = jnp.mean(y, axis=red, keepdims=True)
+    var = jnp.var(y, axis=red, keepdims=True)
+    y = (y - mean) * jnp.reciprocal(jnp.sqrt(var + epsilon))
+    y = y.reshape(x.shape)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    w = ensure_tensor(weight) if weight is not None else None
+    b = ensure_tensor(bias) if bias is not None else None
+    return _group_norm(x, w, b, num_groups=num_groups, epsilon=epsilon)
+
+
+@primitive(name="l2_normalize")
+def _normalize(x, p=2.0, axis=1, epsilon=1e-12):
+    if p == 2.0:
+        denom = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    else:
+        denom = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                                  keepdims=True), 1.0 / p)
+    return x / jnp.maximum(denom, epsilon)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _normalize(ensure_tensor(x), p=float(p), axis=axis,
+                      epsilon=epsilon)
+
+
+@primitive(name="local_response_norm")
+def _lrn(x, size=5, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(x)
+    c = x.shape[1]
+    half = size // 2
+    pad = jnp.pad(sq, [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (
+        x.ndim - 2))
+    acc = jnp.zeros_like(x)
+    for i in range(size):
+        acc = acc + pad[:, i:i + c]
+    return x / jnp.power(k + alpha * acc, beta)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    return _lrn(ensure_tensor(x), size=size, alpha=alpha, beta=beta, k=k)

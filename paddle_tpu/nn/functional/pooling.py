@@ -1,0 +1,177 @@
+"""Pooling functionals.
+
+Reference parity: ``paddle/fluid/operators/pool_op.cc`` (+cudnn) and
+``math/pooling.cu``.  TPU-native: ``lax.reduce_window`` — XLA lowers to
+vectorized windowed reductions on the VPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import primitive, ensure_tensor
+from ...core.tensor import Tensor
+
+
+def _tup(v, nd):
+    return (v,) * nd if isinstance(v, int) else tuple(int(x) for x in v)
+
+
+def _pad_pairs(padding, nd):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if all(isinstance(p, int) for p in padding):
+        if len(padding) == nd:
+            return [(p, p) for p in padding]
+        if len(padding) == 2 * nd:
+            return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    return [tuple(p) for p in padding[-nd:]]
+
+
+def _max_pool(x, ksize, stride, padding, nd, ceil_mode):
+    window = (1, 1) + _tup(ksize, nd)
+    strides = (1, 1) + _tup(stride if stride is not None else ksize, nd)
+    pad = _pad_pairs(padding, nd)
+    if isinstance(pad, str):
+        pad_cfg = pad
+    else:
+        pad_cfg = [(0, 0), (0, 0)] + [tuple(p) for p in pad]
+        if ceil_mode:
+            pad_cfg = _ceil_adjust(x.shape, window, strides, pad_cfg)
+    # -inf (not finfo.min) — jax's reduce_window_max vjp rule requires it
+    neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    return lax.reduce_window(x, neg, lax.max, window, strides, pad_cfg)
+
+
+def _ceil_adjust(shape, window, strides, pad_cfg):
+    out = []
+    for i, (lo, hi) in enumerate(pad_cfg):
+        if i < 2:
+            out.append((lo, hi))
+            continue
+        size = shape[i] + lo + hi
+        rem = (size - window[i]) % strides[i]
+        if rem != 0:
+            hi += strides[i] - rem
+        out.append((lo, hi))
+    return out
+
+
+def _avg_pool(x, ksize, stride, padding, nd, exclusive, ceil_mode):
+    window = (1, 1) + _tup(ksize, nd)
+    strides = (1, 1) + _tup(stride if stride is not None else ksize, nd)
+    pad = _pad_pairs(padding, nd)
+    if isinstance(pad, str):
+        pad_cfg = pad
+    else:
+        pad_cfg = [(0, 0), (0, 0)] + [tuple(p) for p in pad]
+        if ceil_mode:
+            pad_cfg = _ceil_adjust(x.shape, window, strides, pad_cfg)
+    summed = lax.reduce_window(x, 0.0 if jnp.issubdtype(
+        x.dtype, jnp.floating) else 0, lax.add, window, strides, pad_cfg)
+    if exclusive and not isinstance(pad_cfg, str):
+        ones = jnp.ones(x.shape, x.dtype)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides,
+                                   pad_cfg)
+        return summed / counts
+    denom = float(np.prod(window))
+    return summed / denom
+
+
+def _make_pool(nd, kind):
+    name = f"{kind}_pool{nd}d"
+
+    @primitive(name=name)
+    def fn(x, kernel_size=None, stride=None, padding=0, exclusive=True,
+           ceil_mode=False):
+        if kind == "max":
+            return _max_pool(x, kernel_size, stride, padding, nd, ceil_mode)
+        return _avg_pool(x, kernel_size, stride, padding, nd, exclusive,
+                         ceil_mode)
+
+    def api(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+            exclusive=True, count_include_pad=None, return_mask=False,
+            data_format=None, name=None):
+        if count_include_pad is not None:
+            exclusive = not count_include_pad
+        x = ensure_tensor(x)
+        squeeze_back = False
+        if nd == 1 and x.ndim == 3:
+            # reference pools 1d by unsqueezing to 2d
+            pass
+        out = fn(x, kernel_size=kernel_size, stride=stride, padding=padding,
+                 exclusive=exclusive, ceil_mode=ceil_mode)
+        return out
+
+    api.__name__ = name
+    return api
+
+
+max_pool1d = _make_pool(1, "max")
+max_pool2d = _make_pool(2, "max")
+max_pool3d = _make_pool(3, "max")
+avg_pool1d = _make_pool(1, "avg")
+avg_pool2d = _make_pool(2, "avg")
+avg_pool3d = _make_pool(3, "avg")
+
+
+def _adaptive_regions(in_size, out_size):
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = ((np.arange(out_size) + 1) * in_size + out_size - 1) // out_size
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, nd, kind):
+    spatial = x.shape[2:]
+    out_size = _tup(output_size, nd)
+    if all(s % o == 0 for s, o in zip(spatial, out_size)):
+        # divisible fast path: reshape + reduce (single fused XLA op)
+        new_shape = [x.shape[0], x.shape[1]]
+        red_axes = []
+        for i, (s, o) in enumerate(zip(spatial, out_size)):
+            new_shape += [o, s // o]
+            red_axes.append(3 + 2 * i)
+        y = x.reshape(new_shape)
+        if kind == "avg":
+            return jnp.mean(y, axis=tuple(red_axes))
+        return jnp.max(y, axis=tuple(red_axes))
+    # general path: gather per output cell (out sizes are small constants)
+    for axis in range(nd):
+        s, o = spatial[axis], out_size[axis]
+        starts, ends = _adaptive_regions(s, o)
+        slabs = []
+        for st, en in zip(starts, ends):
+            sl = [slice(None)] * x.ndim
+            sl[2 + axis] = slice(int(st), int(en))
+            seg = x[tuple(sl)]
+            red = jnp.mean if kind == "avg" else jnp.max
+            slabs.append(red(seg, axis=2 + axis, keepdims=True))
+        x = jnp.concatenate(slabs, axis=2 + axis)
+    return x
+
+
+def _make_adaptive(nd, kind):
+    name = f"adaptive_{kind}_pool{nd}d"
+
+    @primitive(name=name)
+    def fn(x, output_size=None):
+        return _adaptive_pool(x, output_size, nd, kind)
+
+    def api(x, output_size, return_mask=False, data_format=None, name=None):
+        return fn(ensure_tensor(x), output_size=output_size)
+
+    api.__name__ = name
+    return api
+
+
+adaptive_avg_pool1d = _make_adaptive(1, "avg")
+adaptive_avg_pool2d = _make_adaptive(2, "avg")
+adaptive_avg_pool3d = _make_adaptive(3, "avg")
+adaptive_max_pool1d = _make_adaptive(1, "max")
+adaptive_max_pool2d = _make_adaptive(2, "max")
+adaptive_max_pool3d = _make_adaptive(3, "max")

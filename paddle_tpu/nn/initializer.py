@@ -1,0 +1,182 @@
+"""Parameter initializers.
+
+Reference parity: ``python/paddle/fluid/initializer.py`` (Constant, Uniform,
+Normal, TruncatedNormal, Xavier, MSRA/Kaiming, Bilinear, Assign) and
+``python/paddle/nn/initializer/``.  Each initializer is a callable
+``(shape, dtype) -> jax array`` drawing from the global RNG.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core import rng
+
+
+def _fans(shape):
+    shape = list(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [out_c, in_c, *k] (paddle layout)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype="float32"):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        return jnp.full(tuple(shape), self.value, dtypes.to_jax(dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, shape, dtype="float32"):
+        return jax.random.uniform(rng.key_for(self.seed), tuple(shape),
+                                  dtypes.to_jax(dtype),
+                                  minval=self.low, maxval=self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, seed=0):
+        self.mean, self.std, self.seed = mean, std, seed
+
+    def __call__(self, shape, dtype="float32"):
+        return self.mean + self.std * jax.random.normal(
+            rng.key_for(self.seed), tuple(shape), dtypes.to_jax(dtype))
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, seed=0):
+        self.mean, self.std, self.seed = mean, std, seed
+
+    def __call__(self, shape, dtype="float32"):
+        return self.mean + self.std * jax.random.truncated_normal(
+            rng.key_for(self.seed), -2.0, 2.0, tuple(shape),
+            dtypes.to_jax(dtype))
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, seed=0):
+        self.fan_in, self.fan_out, self.seed = fan_in, fan_out, seed
+
+    def __call__(self, shape, dtype="float32"):
+        fin, fout = _fans(shape)
+        fin = self.fan_in or fin
+        fout = self.fan_out or fout
+        limit = math.sqrt(6.0 / (fin + fout))
+        return jax.random.uniform(rng.key_for(self.seed), tuple(shape),
+                                  dtypes.to_jax(dtype), -limit, limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, seed=0):
+        self.fan_in, self.fan_out, self.seed = fan_in, fan_out, seed
+
+    def __call__(self, shape, dtype="float32"):
+        fin, fout = _fans(shape)
+        fin = self.fan_in or fin
+        fout = self.fan_out or fout
+        std = math.sqrt(2.0 / (fin + fout))
+        return std * jax.random.normal(rng.key_for(self.seed), tuple(shape),
+                                       dtypes.to_jax(dtype))
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu",
+                 seed=0):
+        self.fan_in, self.seed = fan_in, seed
+
+    def __call__(self, shape, dtype="float32"):
+        fin, _ = _fans(shape)
+        fin = self.fan_in or fin
+        limit = math.sqrt(6.0 / fin)
+        return jax.random.uniform(rng.key_for(self.seed), tuple(shape),
+                                  dtypes.to_jax(dtype), -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu",
+                 seed=0):
+        self.fan_in, self.seed = fan_in, seed
+
+    def __call__(self, shape, dtype="float32"):
+        fin, _ = _fans(shape)
+        fin = self.fan_in or fin
+        std = math.sqrt(2.0 / fin)
+        return std * jax.random.normal(rng.key_for(self.seed), tuple(shape),
+                                       dtypes.to_jax(dtype))
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        from ..core.tensor import Tensor
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.numpy()
+        arr = jnp.asarray(np.asarray(v), dtypes.to_jax(dtype))
+        if tuple(arr.shape) != tuple(shape):
+            arr = arr.reshape(tuple(shape))
+        return arr
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init (reference: initializer.py Bilinear)."""
+
+    def __call__(self, shape, dtype="float32"):
+        weight = np.zeros(shape, dtype="float32")
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(np.prod(shape[2:])):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            v = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+            weight[..., y, x] = v
+        return jnp.asarray(weight, dtypes.to_jax(dtype))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, seed=0):
+        self.gain, self.seed = gain, seed
+
+    def __call__(self, shape, dtype="float32"):
+        return self.gain * jax.nn.initializers.orthogonal()(
+            rng.key_for(self.seed), tuple(shape), dtypes.to_jax(dtype))
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype="float32"):
+        return jax.nn.initializers.delta_orthogonal()(
+            rng.key_for(0), tuple(shape), dtypes.to_jax(dtype))
+
+
+# snake_case aliases matching fluid.initializer
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+XavierInitializer = XavierNormal
+MSRAInitializer = KaimingNormal
+NumpyArrayInitializer = Assign

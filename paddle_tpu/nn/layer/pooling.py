@@ -1,0 +1,79 @@
+"""Pooling layers (reference: python/paddle/nn/layer/pooling.py)."""
+from __future__ import annotations
+
+from .base import Layer
+from .. import functional as F
+
+
+def _make(cls_name, fn_name, adaptive=False):
+    fn = getattr(F, fn_name)
+
+    class _Pool(Layer):
+        def __init__(self, kernel_size=None, stride=None, padding=0,
+                     output_size=None, ceil_mode=False, exclusive=True,
+                     return_mask=False, data_format=None, name=None):
+            super().__init__()
+            self.kernel_size = kernel_size
+            self.stride = stride
+            self.padding = padding
+            self.output_size = output_size if output_size is not None \
+                else kernel_size
+            self.ceil_mode = ceil_mode
+            self.exclusive = exclusive
+
+        def forward(self, x):
+            if adaptive:
+                return fn(x, self.output_size)
+            if "avg" in fn_name:
+                return fn(x, self.kernel_size, self.stride, self.padding,
+                          ceil_mode=self.ceil_mode, exclusive=self.exclusive)
+            return fn(x, self.kernel_size, self.stride, self.padding,
+                      ceil_mode=self.ceil_mode)
+
+    _Pool.__name__ = cls_name
+    return _Pool
+
+
+MaxPool1D = _make("MaxPool1D", "max_pool1d")
+MaxPool2D = _make("MaxPool2D", "max_pool2d")
+MaxPool3D = _make("MaxPool3D", "max_pool3d")
+AvgPool1D = _make("AvgPool1D", "avg_pool1d")
+AvgPool2D = _make("AvgPool2D", "avg_pool2d")
+AvgPool3D = _make("AvgPool3D", "avg_pool3d")
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool2D(AdaptiveAvgPool1D):
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size)
+
+
+class AdaptiveAvgPool3D(AdaptiveAvgPool1D):
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size)
+
+
+class AdaptiveMaxPool1D(AdaptiveAvgPool1D):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size)
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size)
+
+
+class AdaptiveMaxPool2D(AdaptiveMaxPool1D):
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size)
+
+
+class AdaptiveMaxPool3D(AdaptiveMaxPool1D):
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size)

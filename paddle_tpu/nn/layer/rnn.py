@@ -1,0 +1,337 @@
+"""RNN layers.
+
+Reference parity: ``paddle/fluid/operators/rnn_op.h`` (cudnn LSTM/GRU),
+``python/paddle/nn/layer/rnn.py`` (RNNCellBase, SimpleRNN/LSTM/GRU).
+TPU-native: the whole sequence loop is ONE ``lax.scan`` inside one primitive,
+so XLA compiles a single fused loop (and BPTT falls out of the scan's vjp) —
+no per-timestep op dispatch like the reference's dynamic RNN.
+Gate order: LSTM [i, f, g, o]; GRU [r, z, n] (torch/cudnn convention, which
+the reference's cudnn path also uses).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import Layer
+from .. import initializer as I
+from ...core.dispatch import primitive, ensure_tensor
+from ...core.tensor import Tensor
+
+
+def _lstm_step(carry, x_t, w_ih, w_hh, b):
+    h, c = carry
+    gates = x_t @ w_ih.T + h @ w_hh.T + b
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return (h_new, c_new), h_new
+
+
+def _gru_step(carry, x_t, w_ih, w_hh, b_ih, b_hh):
+    h = carry
+    gi = x_t @ w_ih.T + b_ih
+    gh = h @ w_hh.T + b_hh
+    i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(i_r + h_r)
+    z = jax.nn.sigmoid(i_z + h_z)
+    n = jnp.tanh(i_n + r * h_n)
+    h_new = (1 - z) * n + z * h
+    return h_new, h_new
+
+
+def _rnn_step(carry, x_t, w_ih, w_hh, b, activation):
+    h = carry
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    h_new = act(x_t @ w_ih.T + h @ w_hh.T + b)
+    return h_new, h_new
+
+
+class RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirect else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN": 1}[mode]
+
+        std = 1.0 / math.sqrt(hidden_size)
+        for layer in range(num_layers):
+            for direction in range(self.num_directions):
+                in_size = input_size if layer == 0 else \
+                    hidden_size * self.num_directions
+                suffix = f"_l{layer}" + ("_reverse" if direction else "")
+                self.add_parameter(
+                    "weight_ih" + suffix,
+                    self.create_parameter(
+                        [gate_mult * hidden_size, in_size],
+                        attr=weight_ih_attr,
+                        default_initializer=I.Uniform(-std, std)))
+                self.add_parameter(
+                    "weight_hh" + suffix,
+                    self.create_parameter(
+                        [gate_mult * hidden_size, hidden_size],
+                        attr=weight_hh_attr,
+                        default_initializer=I.Uniform(-std, std)))
+                self.add_parameter(
+                    "bias_ih" + suffix,
+                    self.create_parameter([gate_mult * hidden_size],
+                                          attr=bias_ih_attr,
+                                          default_initializer=I.Uniform(
+                                              -std, std)))
+                self.add_parameter(
+                    "bias_hh" + suffix,
+                    self.create_parameter([gate_mult * hidden_size],
+                                          attr=bias_hh_attr,
+                                          default_initializer=I.Uniform(
+                                              -std, std)))
+
+    def _run_single(self, x, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse):
+        """x: [T, B, in] -> outputs [T, B, H], final h (and c)."""
+        mode, activation = self.mode, self.activation
+
+        if mode == "LSTM":
+            def step(carry, x_t):
+                return _lstm_step(carry, x_t, w_ih, w_hh, b_ih + b_hh)
+            init = (h0, c0)
+        elif mode == "GRU":
+            def step(carry, x_t):
+                return _gru_step(carry, x_t, w_ih, w_hh, b_ih, b_hh)
+            init = h0
+        else:
+            def step(carry, x_t):
+                return _rnn_step(carry, x_t, w_ih, w_hh, b_ih + b_hh,
+                                 activation)
+            init = h0
+        final, outs = lax.scan(step, init, x, reverse=reverse)
+        if reverse:
+            pass  # scan(reverse=True) already yields outputs aligned to time
+        return final, outs
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = ensure_tensor(inputs)
+        L, D, H = self.num_layers, self.num_directions, self.hidden_size
+        mode = self.mode
+
+        params = []
+        for layer in range(L):
+            for d in range(D):
+                suffix = f"_l{layer}" + ("_reverse" if d else "")
+                params.append((self._parameters["weight_ih" + suffix],
+                               self._parameters["weight_hh" + suffix],
+                               self._parameters["bias_ih" + suffix],
+                               self._parameters["bias_hh" + suffix]))
+        flat_params = [p for tup in params for p in tup]
+
+        if initial_states is not None:
+            if mode == "LSTM":
+                h0_t, c0_t = initial_states
+                init_arrays = (ensure_tensor(h0_t)._data,
+                               ensure_tensor(c0_t)._data)
+            else:
+                init_arrays = (ensure_tensor(initial_states)._data,)
+        else:
+            init_arrays = None
+
+        time_major = self.time_major
+
+        @primitive(name=mode.lower() + "_rnn")
+        def _run(x, *param_arrays):
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # -> [T, B, in]
+            batch = x.shape[1]
+            if init_arrays is None:
+                h0_full = jnp.zeros((L * D, batch, H), x.dtype)
+                c0_full = jnp.zeros((L * D, batch, H), x.dtype)
+            else:
+                h0_full = init_arrays[0]
+                c0_full = init_arrays[1] if mode == "LSTM" else h0_full
+
+            layer_in = x
+            final_h, final_c = [], []
+            for layer in range(L):
+                outs_dirs = []
+                for d in range(D):
+                    idx = layer * D + d
+                    w_ih, w_hh, b_ih, b_hh = param_arrays[4 * idx:4 * idx + 4]
+                    h0 = h0_full[idx]
+                    c0 = c0_full[idx]
+                    final, outs = self._run_single(
+                        layer_in, h0, c0, w_ih, w_hh, b_ih, b_hh,
+                        reverse=(d == 1))
+                    if mode == "LSTM":
+                        final_h.append(final[0])
+                        final_c.append(final[1])
+                    else:
+                        final_h.append(final)
+                    outs_dirs.append(outs)
+                layer_in = (jnp.concatenate(outs_dirs, axis=-1)
+                            if D == 2 else outs_dirs[0])
+            out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+            h_stack = jnp.stack(final_h)
+            if mode == "LSTM":
+                return out, h_stack, jnp.stack(final_c)
+            return out, h_stack
+
+        res = _run(inputs, *flat_params)
+        if mode == "LSTM":
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation,
+                         **kwargs)
+
+
+class LSTM(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], attr=bias_ih_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], attr=bias_hh_attr,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        inputs = ensure_tensor(inputs)
+        if states is None:
+            batch = inputs.shape[0]
+            z = jnp.zeros((batch, self.hidden_size), inputs._data.dtype)
+            states = (Tensor(z), Tensor(z))
+        h, c = states
+
+        @primitive(name="lstm_cell")
+        def _cell(x, hh, cc, w_ih, w_hh, b_ih, b_hh):
+            (h_new, c_new), _ = _lstm_step((hh, cc), x, w_ih, w_hh,
+                                           b_ih + b_hh)
+            return h_new, c_new
+
+        h_new, c_new = _cell(inputs, ensure_tensor(h), ensure_tensor(c),
+                             self.weight_ih, self.weight_hh, self.bias_ih,
+                             self.bias_hh)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], attr=bias_ih_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], attr=bias_hh_attr,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        inputs = ensure_tensor(inputs)
+        if states is None:
+            batch = inputs.shape[0]
+            states = Tensor(jnp.zeros((batch, self.hidden_size),
+                                      inputs._data.dtype))
+
+        @primitive(name="gru_cell")
+        def _cell(x, hh, w_ih, w_hh, b_ih, b_hh):
+            h_new, _ = _gru_step(hh, x, w_ih, w_hh, b_ih, b_hh)
+            return h_new
+
+        h_new = _cell(inputs, ensure_tensor(states), self.weight_ih,
+                      self.weight_hh, self.bias_ih, self.bias_hh)
+        return h_new, h_new
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        inputs = ensure_tensor(inputs)
+        if states is None:
+            batch = inputs.shape[0]
+            states = Tensor(jnp.zeros((batch, self.hidden_size),
+                                      inputs._data.dtype))
+        activation = self.activation
+
+        @primitive(name="simple_rnn_cell")
+        def _cell(x, hh, w_ih, w_hh, b_ih, b_hh):
+            h_new, _ = _rnn_step(hh, x, w_ih, w_hh, b_ih + b_hh, activation)
+            return h_new
+
+        h_new = _cell(inputs, ensure_tensor(states), self.weight_ih,
+                      self.weight_hh, self.bias_ih, self.bias_hh)
+        return h_new, h_new

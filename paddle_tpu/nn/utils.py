@@ -1,0 +1,78 @@
+"""paddle.nn.utils parity (weight_norm, spectral_norm helpers, vector/param
+conversion)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .clip import clip_grad_norm_  # noqa: F401
+
+
+def parameters_to_vector(parameters, name=None):
+    arrays = [p._data.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(arrays))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    data = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in parameters:
+        n = p.size
+        p.set_value(data[offset:offset + n].reshape(tuple(p.shape)).astype(
+            p._data.dtype))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparametrize `weight` as g * v/||v|| (reference:
+    nn/utils/weight_norm_hook.py)."""
+    from .layer.base import Layer
+    from ..core.tensor import Parameter
+    weight = getattr(layer, name)
+    w = weight._data
+    if dim is None:
+        norm = jnp.linalg.norm(w)
+    else:
+        axes = tuple(i for i in range(w.ndim) if i != dim)
+        norm = jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True))
+    g = Parameter(norm)
+    v = Parameter(w)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+
+    def hook(layer_, inputs):
+        vv = layer_._parameters[name + "_v"]
+        gg = layer_._parameters[name + "_g"]
+        if dim is None:
+            normv = vv.norm()
+        else:
+            from ..ops import sqrt as _sqrt, sum as _sum, square as _square
+            axes = [i for i in range(vv.ndim) if i != dim]
+            normv = _sqrt(_sum(_square(vv), axis=axes, keepdim=True))
+        new_w = vv * (gg / normv)
+        object.__setattr__(layer_, "_wn_cache", new_w)
+        layer_.__dict__[name] = new_w
+        return None
+
+    layer._wn_hook = layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    from ..core.tensor import Parameter
+    w = layer.__dict__.pop(name, None)
+    if w is None:
+        return layer
+    layer._wn_hook.remove()
+    layer.add_parameter(name, Parameter(w._data))
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    raise NotImplementedError(
+        "use paddle_tpu.nn.SpectralNorm as a wrapping layer")

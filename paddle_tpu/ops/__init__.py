@@ -1,0 +1,159 @@
+"""Op library aggregator.
+
+Reference parity: the Python dispatch layer ``python/paddle/tensor/*`` which
+forwards to ``core.ops.*``.  Here every op is a pure-jax function wrapped by
+``core.dispatch.primitive``; this module also attaches operator dunders and
+method forms onto :class:`Tensor` (the reference does this via
+``monkey_patch_varbase``/``monkey_patch_math_varbase``).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import primitive, ensure_tensor
+from ..core import autograd
+
+from .math import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from . import math as _math
+from . import creation as _creation
+from . import manipulation as _manip
+from . import linalg  # noqa: F401
+
+
+# ---- indexing -----------------------------------------------------------
+def _prep_index(idx):
+    if isinstance(idx, tuple):
+        return tuple(_prep_index(i) for i in idx)
+    if isinstance(idx, Tensor):
+        arr = idx._data
+        if jnp.issubdtype(arr.dtype, jnp.bool_):
+            return np.asarray(arr)  # boolean mask: host (dynamic shape)
+        return arr
+    if isinstance(idx, (list, np.ndarray)):
+        return np.asarray(idx)
+    return idx
+
+
+def _getitem(x, idx):
+    idx = _prep_index(idx)
+    prim = primitive(name="slice")(lambda a: a[idx])
+    return prim(x)
+
+
+def _setitem(x, idx, value):
+    idx = _prep_index(idx)
+    v = value._data if isinstance(value, Tensor) else jnp.asarray(
+        value, x._data.dtype)
+    if not x.stop_gradient and autograd.grad_enabled():
+        prim = primitive(name="set_value")(
+            lambda a, b: a.at[idx].set(b.astype(a.dtype)))
+        val = value if isinstance(value, Tensor) else Tensor(v)
+        autograd.run_inplace(x, prim, val)
+    else:
+        x._data = x._data.at[idx].set(jnp.asarray(v, x._data.dtype))
+    return x
+
+
+# ---- operator attachment ------------------------------------------------
+def _attach():
+    T = Tensor
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+
+    T.__add__ = lambda s, o: _math.add(s, o)
+    T.__radd__ = lambda s, o: _math.add(o, s)
+    T.__sub__ = lambda s, o: _math.subtract(s, o)
+    T.__rsub__ = lambda s, o: _math.subtract(ensure_tensor(o, ref=s), s)
+    T.__mul__ = lambda s, o: _math.multiply(s, o)
+    T.__rmul__ = lambda s, o: _math.multiply(o, s)
+    T.__truediv__ = lambda s, o: _math.divide(s, o)
+    T.__rtruediv__ = lambda s, o: _math.divide(ensure_tensor(o, ref=s), s)
+    T.__floordiv__ = lambda s, o: _math.floor_divide(s, o)
+    T.__rfloordiv__ = lambda s, o: _math.floor_divide(
+        ensure_tensor(o, ref=s), s)
+    T.__mod__ = lambda s, o: _math.remainder(s, o)
+    T.__pow__ = lambda s, o: _math.pow_(s, o)
+    T.__rpow__ = lambda s, o: _math.pow_(ensure_tensor(o, ref=s), s)
+    T.__matmul__ = lambda s, o: _math.matmul(s, o)
+    T.__neg__ = lambda s: _math.neg(s)
+    T.__abs__ = lambda s: _math.abs(s)
+    T.__invert__ = lambda s: _math.logical_not(s)
+
+    T.__eq__ = lambda s, o: _math.equal(s, o)
+    T.__ne__ = lambda s, o: _math.not_equal(s, o)
+    T.__lt__ = lambda s, o: _math.less_than(s, o)
+    T.__le__ = lambda s, o: _math.less_equal(s, o)
+    T.__gt__ = lambda s, o: _math.greater_than(s, o)
+    T.__ge__ = lambda s, o: _math.greater_equal(s, o)
+
+    method_map = {
+        # math
+        "add": _math.add, "subtract": _math.subtract,
+        "multiply": _math.multiply, "divide": _math.divide,
+        "mod": _math.remainder, "remainder": _math.remainder,
+        "floor_divide": _math.floor_divide, "pow": _math.pow,
+        "matmul": _math.matmul, "mm": _math.mm, "bmm": _math.bmm,
+        "dot": _math.dot, "abs": _math.abs, "neg": _math.neg,
+        "sqrt": _math.sqrt, "rsqrt": _math.rsqrt, "square": _math.square,
+        "exp": _math.exp, "log": _math.log, "log2": _math.log2,
+        "log10": _math.log10, "log1p": _math.log1p,
+        "sin": _math.sin, "cos": _math.cos, "tan": _math.tan,
+        "tanh": _math.tanh, "sigmoid": _math.sigmoid, "erf": _math.erf,
+        "floor": _math.floor, "ceil": _math.ceil, "round": _math.round,
+        "trunc": _math.trunc, "sign": _math.sign,
+        "reciprocal": _math.reciprocal, "clip": _math.clip,
+        "scale": _math.scale, "maximum": _math.maximum,
+        "minimum": _math.minimum,
+        "sum": _math.sum, "mean": _math.mean, "prod": _math.prod,
+        "max": _math.max, "min": _math.min, "var": _math.var,
+        "std": _math.std, "all": _math.all, "any": _math.any,
+        "logsumexp": _math.logsumexp, "cumsum": _math.cumsum,
+        "cumprod": _math.cumprod, "isnan": _math.isnan,
+        "isinf": _math.isinf, "isfinite": _math.isfinite,
+        "equal": _math.equal, "not_equal": _math.not_equal,
+        "less_than": _math.less_than, "less_equal": _math.less_equal,
+        "greater_than": _math.greater_than,
+        "greater_equal": _math.greater_equal,
+        "equal_all": _math.equal_all, "allclose": _math.allclose,
+        "isclose": _math.isclose,
+        "logical_and": _math.logical_and, "logical_or": _math.logical_or,
+        "logical_not": _math.logical_not, "logical_xor": _math.logical_xor,
+        "trace": _math.trace,
+        # manipulation
+        "reshape": _manip.reshape, "reshape_": _manip.reshape_,
+        "transpose": _manip.transpose, "t": _manip.t,
+        "squeeze": _manip.squeeze, "unsqueeze": _manip.unsqueeze,
+        "flatten": _manip.flatten, "flip": _manip.flip,
+        "roll": _manip.roll, "tile": _manip.tile, "expand": _manip.expand,
+        "expand_as": _manip.expand_as,
+        "broadcast_to": _manip.broadcast_to, "gather": _manip.gather,
+        "gather_nd": _manip.gather_nd, "scatter": _manip.scatter,
+        "scatter_nd_add": _manip.scatter_nd_add,
+        "index_select": _manip.index_select,
+        "masked_select": _manip.masked_select,
+        "masked_fill": _manip.masked_fill,
+        "where": _manip.where, "nonzero": _manip.nonzero,
+        "argmax": _manip.argmax, "argmin": _manip.argmin,
+        "argsort": _manip.argsort, "sort": _manip.sort,
+        "topk": _manip.topk, "unique": _manip.unique,
+        "split": _manip.split, "chunk": _manip.chunk,
+        "unbind": _manip.unbind, "concat": None,
+        "take_along_axis": _manip.take_along_axis,
+        "repeat_interleave": _manip.repeat_interleave,
+        "one_hot": _manip.one_hot,
+        "norm": linalg.norm, "dist": linalg.dist,
+        "numel": _math.numel,
+    }
+    for name, fn in method_map.items():
+        if fn is None:
+            continue
+        if not hasattr(T, name):
+            setattr(T, name, fn)
+
+
+_attach()

@@ -1,0 +1,246 @@
+"""Tensor creation ops.
+
+Reference parity: fill_constant_op.cc, uniform_random_op.cc,
+gaussian_random_op.cc, range_op.cc, linspace_op.cc, eye_op.cc,
+tril_triu_op.cc, diag_v2_op.cc, assign_op.cc.
+Random ops draw from the global counter-based generator (core/rng.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import primitive, ensure_tensor
+from ..core import dtype as dtypes
+from ..core import rng
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        shape = [shape]
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    return dtypes.to_jax(dtype if dtype is not None else
+                         (default or dtypes.get_default_dtype()))
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None and isinstance(fill_value, bool):
+        dtype = "bool"
+    elif dtype is None and isinstance(fill_value, int):
+        dtype = "int64"
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.zeros_like(x._data, _dt(dtype, x.dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.ones_like(x._data, _dt(dtype, x.dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.full_like(x._data, fill_value, _dt(dtype, x.dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, float):
+            dtype = dtype or "float32"
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(end, Tensor):
+        end = end.item()
+    if isinstance(step, Tensor):
+        step = step.item()
+    return Tensor(jnp.arange(start, end, step, _dt(dtype, "int64")))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(stop, Tensor):
+        stop = stop.item()
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(float(start), float(stop), int(num),
+                               base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns else None,
+                          dtype=_dt(dtype)))
+
+
+@primitive(name="tril")
+def _tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def tril(x, diagonal=0, name=None):
+    return _tril(ensure_tensor(x), diagonal=int(diagonal))
+
+
+@primitive(name="triu")
+def _triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return _triu(ensure_tensor(x), diagonal=int(diagonal))
+
+
+@primitive(name="diag")
+def _diag(x, offset=0):
+    return jnp.diag(x, k=offset)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = ensure_tensor(x)
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + builtins_abs(offset)
+        base = jnp.full((n, n), padding_value, x._data.dtype)
+        out = base + jnp.diag(x._data - padding_value, k=offset)
+        return Tensor(out)
+    return _diag(x, offset=int(offset))
+
+
+builtins_abs = abs
+
+
+def diagflat(x, offset=0, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.diagflat(x._data, k=offset))
+
+
+def meshgrid(*args, name=None):
+    arrays = [ensure_tensor(a)._data for a in
+              (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple))
+               else args)]
+    outs = jnp.meshgrid(*arrays, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    """reference: operators/assign_op.cc"""
+    x = ensure_tensor(x)
+    out = primitive(name="assign")(lambda a: a + 0)(x)
+    if output is not None:
+        output.set_value(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+# ---- random (reference: uniform_random_op.cc etc. + generator.cc) -------
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(rng.next_key(), _shape(shape),
+                                     _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(rng.next_key(), _shape(shape),
+                                    _dt(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = rng.key_for(seed)
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=float(min), maxval=float(max)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = ensure_tensor(mean)._data if isinstance(mean, Tensor) else mean
+        s = ensure_tensor(std)._data if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(
+            getattr(m, "shape", ()), getattr(s, "shape", ()))
+        eps = jax.random.normal(rng.next_key(), out_shape,
+                                _dt(None))
+        return Tensor(m + s * eps)
+    return Tensor(mean + std * jax.random.normal(
+        rng.next_key(), _shape(shape or [1]), _dt(None)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(rng.next_key(), _shape(shape),
+                                     int(low), int(high),
+                                     _dt(dtype, "int64")))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(rng.next_key(), int(n)).astype(
+        _dt(dtype, "int64")))
+
+
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    u = jax.random.uniform(rng.next_key(), tuple(x.shape), jnp.float32)
+    return Tensor((u < x._data).astype(x._data.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    logits = jnp.log(jnp.maximum(x._data, 1e-30))
+    if replacement:
+        out = jax.random.categorical(
+            rng.next_key(), logits, axis=-1,
+            shape=(*logits.shape[:-1], int(num_samples)))
+    else:
+        # Gumbel top-k for sampling without replacement
+        g = jax.random.gumbel(rng.next_key(), logits.shape)
+        _, out = jax.lax.top_k(logits + g, int(num_samples))
+    return Tensor(out.astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jax.random.poisson(rng.next_key(), x._data).astype(
+        x._data.dtype))

@@ -1,0 +1,616 @@
+"""Shape / indexing / rearrangement ops.
+
+Reference parity: reshape_op.cc, transpose_op.cc, squeeze_op.cc, concat_op.cc,
+split_op.cc, stack_op.cc, gather(_nd)_op.cc, scatter_op.cc, slice_op.cc,
+tile_op.cc, expand_v2_op.cc, flip_op.cc, roll_op.cc, where_op.cc,
+index_select_op.cc, top_k_v2_op.cc, argsort_op.cc, unique_op.cc,
+shard_index_op.cc, cast_op.cc.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..core.dispatch import primitive, ensure_tensor
+from ..core import dtype as dtypes
+
+
+@primitive(name="cast")
+def _cast(x, dtype=None):
+    return x.astype(dtype)
+
+
+def cast(x, dtype):
+    x = ensure_tensor(x)
+    jdt = dtypes.to_jax(dtype)
+    if x._data.dtype == jdt:
+        return x
+    return _cast(x, dtype=jdt)
+
+
+@primitive(name="reshape")
+def _reshape(x, shape=None):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    x = ensure_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = tuple(int(s) if not isinstance(s, Tensor) else s.item()
+                  for s in shape)
+    return _reshape(x, shape=shape)
+
+
+def reshape_(x, shape, name=None):
+    from ..core.autograd import run_inplace
+    return run_inplace(x, reshape, shape)
+
+
+@primitive(name="transpose")
+def _transpose(x, perm=None):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm=None, name=None):
+    return _transpose(ensure_tensor(x),
+                      perm=tuple(perm) if perm is not None else None)
+
+
+def t(x, name=None):
+    x = ensure_tensor(x)
+    if x.ndim < 2:
+        return x
+    return transpose(x, list(range(x.ndim - 2)) + [x.ndim - 1, x.ndim - 2])
+
+
+def moveaxis(x, source, destination, name=None):
+    prim = primitive(name="moveaxis")(
+        lambda a: jnp.moveaxis(a, source, destination))
+    return prim(ensure_tensor(x))
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    x = ensure_tensor(x)
+    perm = list(range(x.ndim))
+    perm[axis1], perm[axis2] = perm[axis2], perm[axis1]
+    return transpose(x, perm)
+
+
+@primitive(name="squeeze")
+def _squeeze(x, axis=None):
+    return jnp.squeeze(x, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        if not axis:
+            axis = None
+    elif isinstance(axis, int) and x.shape[axis] != 1:
+        return x
+    return _squeeze(x, axis=axis)
+
+
+@primitive(name="unsqueeze")
+def _unsqueeze(x, axis=None):
+    return jnp.expand_dims(x, axis)
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return _unsqueeze(ensure_tensor(x), axis=axis)
+
+
+@primitive(name="flatten")
+def _flatten(x, start_axis=0, stop_axis=-1):
+    shape = x.shape
+    stop = stop_axis % x.ndim if x.ndim else 0
+    start = start_axis % x.ndim if x.ndim else 0
+    new_shape = shape[:start] + (-1,) + shape[stop + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    if x.ndim == 0:
+        return reshape(x, [1])
+    return _flatten(x, start_axis=start_axis, stop_axis=stop_axis)
+
+
+def concat(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    prim = primitive(name="concat")(
+        lambda *arrs: jnp.concatenate(arrs, axis=axis))
+    return prim(*tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    prim = primitive(name="stack")(
+        lambda *arrs: jnp.stack(arrs, axis=axis))
+    return prim(*tensors)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dim {dim} on axis {axis} is not divisible by "
+                f"num_or_sections={num_or_sections}")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) if not isinstance(s, Tensor) else int(s.item())
+                 for s in num_or_sections]
+        n_neg = builtins_sum(1 for s in sizes if s < 0)
+        if n_neg:
+            rest = dim - builtins_sum(s for s in sizes if s >= 0)
+            sizes = [rest if s < 0 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    prim = primitive(name="split")(
+        lambda a: tuple(
+            lax.slice_in_dim(a, o, o + s, axis=axis)
+            for o, s in zip(offsets, sizes)))
+    out = prim(x)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+builtins_sum = sum
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = ensure_tensor(x)
+    outs = split(x, x.shape[axis], axis)
+    return [squeeze(o, axis) for o in outs]
+
+
+@primitive(name="tile")
+def _tile(x, repeat_times=None):
+    return jnp.tile(x, repeat_times)
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    return _tile(ensure_tensor(x), repeat_times=tuple(int(r)
+                                                      for r in repeat_times))
+
+
+@primitive(name="expand_v2")
+def _expand(x, shape=None):
+    return jnp.broadcast_to(x, shape)
+
+
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = list(int(s) for s in shape)
+    # -1 means keep the original extent
+    offset = len(shape) - x.ndim
+    for i, s in enumerate(shape):
+        if s == -1:
+            shape[i] = x.shape[i - offset]
+    return _expand(x, shape=tuple(shape))
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, ensure_tensor(y).shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    arrays = [ensure_tensor(t)._data for t in inputs]
+    shape = jnp.broadcast_shapes(*[a.shape for a in arrays])
+    return [expand(ensure_tensor(t), shape) for t in inputs]
+
+
+@primitive(name="flip")
+def _flip(x, axis=None):
+    return jnp.flip(x, axis)
+
+
+def flip(x, axis, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return _flip(ensure_tensor(x), axis=axis)
+
+
+@primitive(name="roll")
+def _roll(x, shifts=None, axis=None):
+    return jnp.roll(x, shifts, axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    if isinstance(shifts, (list, tuple)):
+        shifts = tuple(shifts)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return _roll(ensure_tensor(x), shifts=shifts, axis=axis)
+
+
+@primitive(name="rot90")
+def _rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _rot90(ensure_tensor(x), k=k, axes=tuple(axes))
+
+
+# ---- gather / scatter ----------------------------------------------------
+@primitive(name="gather", nondiff=(1,))
+def _gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    index = ensure_tensor(index)
+    idx = index._data
+    if idx.ndim == 2 and idx.shape[1] == 1:
+        idx = idx[:, 0]
+    return _gather(ensure_tensor(x), Tensor(idx), axis=axis)
+
+
+@primitive(name="gather_nd", nondiff=(1,))
+def _gather_nd(x, index):
+    # index: [..., k] indexes first k dims of x
+    k = index.shape[-1]
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def gather_nd(x, index, name=None):
+    return _gather_nd(ensure_tensor(x), ensure_tensor(index))
+
+
+@primitive(name="scatter", nondiff=(1,))
+def _scatter(x, index, updates, overwrite=True):
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle semantics: zero the rows then accumulate
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _scatter(ensure_tensor(x), ensure_tensor(index),
+                    ensure_tensor(updates), overwrite=overwrite)
+
+
+@primitive(name="scatter_nd_add", nondiff=(1,))
+def _scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return _scatter_nd_add(ensure_tensor(x), ensure_tensor(index),
+                           ensure_tensor(updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    updates = ensure_tensor(updates)
+    zeros = Tensor(jnp.zeros(tuple(shape), updates._data.dtype))
+    return scatter_nd_add(zeros, index, updates)
+
+
+@primitive(name="index_select", nondiff=(1,))
+def _index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_select(x, index, axis=0, name=None):
+    return _index_select(ensure_tensor(x), ensure_tensor(index), axis=axis)
+
+
+@primitive(name="index_sample", nondiff=(1,))
+def _index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_sample(x, index, name=None):
+    return _index_sample(ensure_tensor(x), ensure_tensor(index))
+
+
+@primitive(name="take_along_axis", nondiff=(1,))
+def _take_along_axis(x, index, axis):
+    return jnp.take_along_axis(x, index, axis=axis)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return _take_along_axis(ensure_tensor(arr), ensure_tensor(indices),
+                            axis=axis)
+
+
+@primitive(name="put_along_axis", nondiff=(1,))
+def _put_along_axis(x, index, value, axis, reduce="assign"):
+    if reduce == "add":
+        return jnp.put_along_axis(x, index, value, axis=axis,
+                                  inplace=False, mode="add") \
+            if hasattr(jnp, "put_along_axis") else _pal_add(x, index, value,
+                                                            axis)
+    return jnp.put_along_axis(x, index, value, axis=axis, inplace=False) \
+        if hasattr(jnp, "put_along_axis") else _pal_set(x, index, value, axis)
+
+
+def _pal_set(x, index, value, axis):
+    idx = jnp.meshgrid(*[jnp.arange(s) for s in index.shape], indexing="ij")
+    idx[axis] = index
+    return x.at[tuple(idx)].set(jnp.broadcast_to(value, index.shape))
+
+
+def _pal_add(x, index, value, axis):
+    idx = jnp.meshgrid(*[jnp.arange(s) for s in index.shape], indexing="ij")
+    idx[axis] = index
+    return x.at[tuple(idx)].add(jnp.broadcast_to(value, index.shape))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    return _put_along_axis(ensure_tensor(arr), ensure_tensor(indices),
+                           ensure_tensor(values)._data, axis=axis,
+                           reduce=reduce)
+
+
+@primitive(name="where")
+def _where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return _where(condition, ensure_tensor(x, ref=y),
+                  ensure_tensor(y, ref=x))
+
+
+def masked_select(x, mask, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    # dynamic output shape: eager-only (host round trip), like reference LoD
+    data = np.asarray(x._data)[np.asarray(mask._data)]
+    return Tensor(data)
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    v = value._data if isinstance(value, Tensor) else value
+    return primitive(name="masked_fill")(
+        lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a))(x, mask)
+
+
+def nonzero(x, as_tuple=False, name=None):
+    x = ensure_tensor(x)
+    idx = np.nonzero(np.asarray(x._data))
+    if as_tuple:
+        return tuple(Tensor(i.reshape(-1, 1).astype(np.int64)) for i in idx)
+    return Tensor(np.stack(idx, axis=1).astype(np.int64))
+
+
+# ---- search / sort -------------------------------------------------------
+@primitive(name="argmax")
+def _argmax(x, axis=None, keepdims=False):
+    return jnp.argmax(x, axis=axis, keepdims=keepdims)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = _argmax(ensure_tensor(x), axis=axis, keepdims=keepdim)
+    return cast(out, dtype)
+
+
+@primitive(name="argmin")
+def _argmin(x, axis=None, keepdims=False):
+    return jnp.argmin(x, axis=axis, keepdims=keepdims)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = _argmin(ensure_tensor(x), axis=axis, keepdims=keepdim)
+    return cast(out, dtype)
+
+
+@primitive(name="argsort")
+def _argsort(x, axis=-1, descending=False):
+    order = jnp.argsort(x, axis=axis, descending=descending)
+    return order
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    return cast(_argsort(ensure_tensor(x), axis=axis, descending=descending),
+                "int64")
+
+
+@primitive(name="sort")
+def _sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis, descending=descending)
+    return out
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return _sort(ensure_tensor(x), axis=axis, descending=descending)
+
+
+@primitive(name="top_k_v2", has_aux=True)
+def _topk(x, k=1, largest=True):
+    if largest:
+        vals, idx = lax.top_k(x, k)
+    else:
+        vals, idx = lax.top_k(-x, k)
+        vals = -vals
+    return vals, idx.astype(jnp.int64)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    if axis is not None and axis % x.ndim != x.ndim - 1:
+        xs = swapaxes(x, axis, -1)
+        vals, idx = _topk(xs, k=k, largest=largest)
+        return swapaxes(vals, axis, -1), swapaxes(idx, axis, -1)
+    return _topk(x, k=k, largest=largest)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    sorted_t = sort(x, axis=axis)
+    idx_t = argsort(x, axis=axis)
+    sel = [slice(None)] * x.ndim
+    sel[axis] = int(k) - 1
+    v = sorted_t[tuple(sel)]
+    i = idx_t[tuple(sel)]
+    if keepdim:
+        v, i = unsqueeze(v, axis), unsqueeze(i, axis)
+    return v, i
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)
+    sorted_arr = np.sort(arr, axis=axis)
+    moved = np.moveaxis(sorted_arr, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=arr.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    orig = np.moveaxis(arr, axis, -1).reshape(-1, moved.shape[-1])
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        # paddle keeps the LAST-occurring max-count value's index
+        best = uniq[np.argmax(counts)]
+        vals[i] = best
+        idxs[i] = np.where(orig[i] == best)[0][-1]
+    out_shape = list(moved.shape[:-1])
+    v = Tensor(vals.reshape(out_shape))
+    i_t = Tensor(idxs.reshape(out_shape))
+    if keepdim:
+        v, i_t = unsqueeze(v, axis), unsqueeze(i_t, axis)
+    return v, i_t
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    res = np.unique(np.asarray(x._data), return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    outs = [Tensor(res[0])]
+    for extra in res[1:]:
+        outs.append(Tensor(extra.astype(np.int64)))
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+        change = np.concatenate([[True], arr[1:] != arr[:-1]])
+        out = arr[change]
+        outs = [Tensor(out)]
+        if return_inverse:
+            outs.append(Tensor(np.cumsum(change).astype(np.int64) - 1))
+        if return_counts:
+            idx = np.flatnonzero(change)
+            counts = np.diff(np.concatenate([idx, [arr.size]]))
+            outs.append(Tensor(counts.astype(np.int64)))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    ss, v = ensure_tensor(sorted_sequence), ensure_tensor(values)
+    side = "right" if right else "left"
+    out = jnp.searchsorted(ss._data, v._data, side=side)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+# ---- misc ---------------------------------------------------------------
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """reference: operators/shard_index_op.cc (used by parallel embedding)."""
+    input = ensure_tensor(input)
+    shard_size = (index_num + nshards - 1) // nshards
+    arr = input._data
+    in_shard = (arr // shard_size) == shard_id
+    out = jnp.where(in_shard, arr % shard_size, ignore_value)
+    return Tensor(out)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = ensure_tensor(x)
+    if isinstance(repeats, Tensor):
+        repeats = repeats.numpy()
+        total = int(repeats.sum())
+        return Tensor(jnp.repeat(x._data, jnp.asarray(repeats), axis=axis,
+                                 total_repeat_length=total))
+    prim = primitive(name="repeat_interleave")(
+        lambda a: jnp.repeat(a, repeats, axis=axis))
+    return prim(x)
+
+
+def as_real(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.stack([jnp.real(x._data), jnp.imag(x._data)], axis=-1))
+
+
+def as_complex(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(lax.complex(x._data[..., 0], x._data[..., 1]))
+
+
+def tensordot(x, y, axes=2, name=None):
+    prim = primitive(name="tensordot")(
+        lambda a, b: jnp.tensordot(a, b, axes=axes))
+    return prim(ensure_tensor(x), ensure_tensor(y))
+
+
+def einsum(equation, *operands):
+    tensors = [ensure_tensor(t) for t in operands]
+    prim = primitive(name="einsum")(
+        lambda *arrs: jnp.einsum(equation, *arrs))
+    return prim(*tensors)
+
+
+def one_hot(x, num_classes, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jax.nn.one_hot(x._data, num_classes,
+                                 dtype=dtypes.to_jax(
+                                     dtypes.get_default_dtype())))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = ensure_tensor(x)
+    pre = ensure_tensor(prepend)._data if prepend is not None else None
+    app = ensure_tensor(append)._data if append is not None else None
+    prim = primitive(name="diff")(
+        lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app))
+    return prim(x)

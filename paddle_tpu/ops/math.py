@@ -1,0 +1,390 @@
+"""Math ops.
+
+Reference parity: the dense math core of ``paddle/fluid/operators``
+(elementwise/*, reduce_ops/*, activation_op.cc, matmul_v2_op, scale_op,
+clip_op, cumsum_op, …).  Each op is ONE pure jax function — XLA provides all
+backends and the fusion the reference implemented by hand (e.g.
+fused_elemwise_activation).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import primitive, ensure_tensor
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+
+
+def _binary(name, fn):
+    prim = primitive(name=name)(fn)
+
+    def op(x, y, name=None):
+        x = ensure_tensor(x, ref=y if isinstance(y, Tensor) else None)
+        y = ensure_tensor(y, ref=x)
+        return prim(x, y)
+
+    op.__name__ = name
+    return op
+
+
+def _unary(name, fn):
+    prim = primitive(name=name)(fn)
+
+    def op(x, name=None):
+        return prim(ensure_tensor(x))
+
+    op.__name__ = name
+    return op
+
+
+# ---- elementwise binary (reference: operators/elementwise/) -------------
+add = _binary("elementwise_add", jnp.add)
+subtract = _binary("elementwise_sub", jnp.subtract)
+multiply = _binary("elementwise_mul", jnp.multiply)
+divide = _binary("elementwise_div", jnp.true_divide)
+floor_divide = _binary("elementwise_floordiv", jnp.floor_divide)
+remainder = _binary("elementwise_mod", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+pow_ = _binary("elementwise_pow", jnp.power)
+maximum = _binary("elementwise_max", jnp.maximum)
+minimum = _binary("elementwise_min", jnp.minimum)
+fmax = _binary("elementwise_fmax", jnp.fmax)
+fmin = _binary("elementwise_fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+hypot = _binary("hypot", jnp.hypot)
+
+
+def pow(x, y, name=None):  # noqa: A001 - paddle API name
+    return pow_(x, y)
+
+
+# ---- elementwise unary (reference: operators/activation_op.cc etc.) -----
+neg = _unary("neg", jnp.negative)
+abs = _unary("abs", jnp.abs)  # noqa: A001
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", lax.rsqrt)
+square = _unary("square", jnp.square)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)  # noqa: A001
+trunc = _unary("trunc", jnp.trunc)
+sign = _unary("sign", jnp.sign)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+
+isnan = _unary("isnan", jnp.isnan)
+isinf = _unary("isinf", jnp.isinf)
+isfinite = _unary("isfinite", jnp.isfinite)
+
+
+@primitive(name="clip")
+def _clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def clip(x, min=None, max=None, name=None):
+    min = float(min) if isinstance(min, Tensor) else min
+    max = float(max) if isinstance(max, Tensor) else max
+    return _clip(ensure_tensor(x), min=min, max=max)
+
+
+@primitive(name="scale")
+def _scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """reference: operators/scale_op.cc"""
+    out = _scale(ensure_tensor(x),
+                 scale=float(scale) if not isinstance(scale, Tensor)
+                 else scale.item(),
+                 bias=float(bias), bias_after_scale=bias_after_scale)
+    return out
+
+
+@primitive(name="lerp")
+def _lerp(x, y, w):
+    return x + w * (y - x)
+
+
+def lerp(x, y, weight, name=None):
+    if not isinstance(weight, Tensor):
+        weight = Tensor(jnp.asarray(weight, x._data.dtype))
+    return _lerp(x, y, weight)
+
+
+@primitive(name="stanh")
+def _stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _stanh(ensure_tensor(x), scale_a=scale_a, scale_b=scale_b)
+
+
+# ---- reductions (reference: operators/reduce_ops/) ----------------------
+def _reduce(name, fn, arg_dtype=None):
+    prim = primitive(name=name)(fn)
+
+    def op(x, axis=None, keepdim=False, name=None):
+        x = ensure_tensor(x)
+        if isinstance(axis, (list, tuple)):
+            axis = tuple(int(a) for a in axis)
+        elif axis is not None and not isinstance(axis, int):
+            axis = int(axis)
+        return prim(x, axis=axis, keepdims=keepdim)
+
+    op.__name__ = name
+    return op
+
+
+sum = _reduce("reduce_sum", jnp.sum)  # noqa: A001
+mean = _reduce("reduce_mean", jnp.mean)
+prod = _reduce("reduce_prod", jnp.prod)
+max = _reduce("reduce_max", jnp.max)  # noqa: A001
+min = _reduce("reduce_min", jnp.min)  # noqa: A001
+amax = max
+amin = min
+all = _reduce("reduce_all", jnp.all)  # noqa: A001
+any = _reduce("reduce_any", jnp.any)  # noqa: A001
+
+
+def nansum(x, axis=None, keepdim=False, name=None):
+    return primitive(name="nansum")(jnp.nansum)(
+        ensure_tensor(x), axis=axis, keepdims=keepdim)
+
+
+@primitive(name="logsumexp")
+def _logsumexp(x, axis=None, keepdims=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return _logsumexp(ensure_tensor(x), axis=axis, keepdims=keepdim)
+
+
+@primitive(name="cumsum")
+def _cumsum(x, axis=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return _cumsum(x, axis=axis)
+
+
+@primitive(name="cumprod")
+def _cumprod(x, axis=None):
+    return jnp.cumprod(x, axis=axis)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return _cumprod(x, axis=dim)
+
+
+# ---- matmul family (reference: matmul_v2_op.cc, mul_op.cc, bmm_op.cc) ---
+@primitive(name="matmul_v2")
+def _matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    # bf16 inputs hit the MXU directly; fp32 uses default XLA precision
+    return jnp.matmul(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _matmul(ensure_tensor(x), ensure_tensor(y),
+                   transpose_x=transpose_x, transpose_y=transpose_y)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+dot_ = primitive(name="dot")(
+    lambda x, y: jnp.sum(x * y, axis=-1))
+
+
+def dot(x, y, name=None):
+    return dot_(ensure_tensor(x), ensure_tensor(y))
+
+
+@primitive(name="addmm")
+def _addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _addmm(ensure_tensor(input), ensure_tensor(x), ensure_tensor(y),
+                  beta=float(beta), alpha=float(alpha))
+
+
+@primitive(name="outer")
+def _outer(x, y):
+    return jnp.outer(x, y)
+
+
+def outer(x, y, name=None):
+    return _outer(ensure_tensor(x), ensure_tensor(y))
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+@primitive(name="multiply_sum", )
+def _inner(x, y):
+    return jnp.inner(x, y)
+
+
+def inner(x, y, name=None):
+    return _inner(ensure_tensor(x), ensure_tensor(y))
+
+
+# ---- comparison (reference: operators/controlflow/compare_op.cc) --------
+equal = _binary("equal", jnp.equal)
+not_equal = _binary("not_equal", jnp.not_equal)
+greater_than = _binary("greater_than", jnp.greater)
+greater_equal = _binary("greater_equal", jnp.greater_equal)
+less_than = _binary("less_than", jnp.less)
+less_equal = _binary("less_equal", jnp.less_equal)
+
+
+def equal_all(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if tuple(x.shape) != tuple(y.shape):
+        return Tensor(jnp.asarray(False))
+    return primitive(name="equal_all")(
+        lambda a, b: jnp.all(a == b))(x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return primitive(name="allclose")(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                  equal_nan=equal_nan))(
+        ensure_tensor(x), ensure_tensor(y))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return primitive(name="isclose")(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                 equal_nan=equal_nan))(
+        ensure_tensor(x), ensure_tensor(y))
+
+
+# ---- logical ------------------------------------------------------------
+logical_and = _binary("logical_and", jnp.logical_and)
+logical_or = _binary("logical_or", jnp.logical_or)
+logical_xor = _binary("logical_xor", jnp.logical_xor)
+logical_not = _unary("logical_not", jnp.logical_not)
+bitwise_and = _binary("bitwise_and", jnp.bitwise_and)
+bitwise_or = _binary("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _binary("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = _unary("bitwise_not", jnp.bitwise_not)
+
+
+# ---- stat ---------------------------------------------------------------
+def _correction_reduce(name, fn):
+    prim = primitive(name=name)(fn)
+
+    def op(x, axis=None, unbiased=True, keepdim=False, name=None):
+        if isinstance(axis, (list, tuple)):
+            axis = tuple(axis)
+        return prim(ensure_tensor(x), axis=axis,
+                    ddof=1 if unbiased else 0, keepdims=keepdim)
+
+    op.__name__ = name
+    return op
+
+
+var = _correction_reduce("reduce_var", jnp.var)
+std = _correction_reduce("reduce_std", jnp.std)
+
+
+@primitive(name="median")
+def _median(x, axis=None, keepdims=False):
+    return jnp.median(x, axis=axis, keepdims=keepdims)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return _median(ensure_tensor(x), axis=axis, keepdims=keepdim)
+
+
+@primitive(name="quantile")
+def _quantile(x, q, axis=None, keepdims=False):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdims)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return _quantile(ensure_tensor(x), q, axis=axis, keepdims=keepdim)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x).size, jnp.int64))
+
+
+@primitive(name="trace")
+def _trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _trace(ensure_tensor(x), offset=offset, axis1=axis1, axis2=axis2)
+
+
+def increment(x, value=1.0, name=None):
+    """reference: operators/increment_op.cc — in-place add of a scalar."""
+    x._data = x._data + jnp.asarray(value, x._data.dtype)
+    return x
+
+
+def multiplex(inputs, index, name=None):
+    """reference: operators/multiplex_op.cc"""
+    stacked = jnp.stack([ensure_tensor(t)._data for t in inputs])
+    idx = ensure_tensor(index)._data.reshape(-1)
+    rows = jnp.arange(stacked.shape[1])
+    return Tensor(stacked[idx, rows[:idx.shape[0]]])

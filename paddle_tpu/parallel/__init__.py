@@ -1,0 +1,8 @@
+"""Parallel execution engine: sharded train steps + pipeline schedule.
+
+This package is the TPU-native replacement for the reference's
+ParallelExecutor/SSA-graph runtime (see train_step.py docstring for the
+full mapping).
+"""
+from .train_step import TrainStep  # noqa: F401
+from . import pipeline  # noqa: F401

@@ -1,0 +1,155 @@
+"""SPMD pipeline-parallel engine.
+
+Reference parity: PipelineTrainer + SectionWorker
+(``framework/trainer.h:325``, ``section_worker.cc:34`` — synchronous GPipe
+F-then-B over micro-batch scopes, stages connected by send_v2/recv_v2).
+
+TPU-native design: no per-stage processes, no send/recv ops.  All identical
+stage blocks have their parameters STACKED on a leading 'pp'-sharded axis;
+ONE shard_map program runs on every device, rotating activations around the
+ring with ``lax.ppermute`` for M + P - 1 ticks (the GPipe schedule).
+Backward is just ``jax.grad`` through the rotation — ppermute's transpose is
+the reverse rotation, which reproduces the reference's backward P2P sends.
+Heterogeneous ends (embedding / head) run replicated outside the ring.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core import autograd, rng as rng_mod
+from ..jit import functional_call
+from ..distributed import mesh as mesh_mod
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def stack_block_params(blocks):
+    """blocks: LayerList of structurally-identical Layers ->
+    (pnames, {name: stacked [n_blocks, ...]})."""
+    pnames = [n for n, _ in blocks[0].named_parameters()]
+    stacked = {}
+    for name in pnames:
+        per_block = []
+        for blk in blocks:
+            p = dict(blk.named_parameters())[name]
+            per_block.append(p._data)
+        stacked[name] = jnp.stack(per_block)
+    return pnames, stacked
+
+
+def unstack_block_params(blocks, pnames, stacked):
+    for i, blk in enumerate(blocks):
+        params = dict(blk.named_parameters())
+        for name in pnames:
+            params[name]._data = stacked[name][i]
+
+
+def _run_stage(template_block, pnames, stage_params, x, training):
+    """Run this device's `bps` consecutive blocks: scan over the block axis.
+    stage_params leaves: [bps, ...]."""
+
+    def one_block(h, block_leaves):
+        params = dict(zip(pnames, block_leaves))
+        out, _ = functional_call(template_block, params, {}, (h,),
+                                 training=training)
+        return out, None
+
+    leaves = [stage_params[n] for n in pnames]
+    h, _ = lax.scan(one_block, x, leaves)
+    return h
+
+
+def build_pipeline_fn(pipe_layer, num_microbatches, mesh=None,
+                      training=True, axis="pp"):
+    """Returns a pure fn(pre_params, block_stacked, post_params, buffers,
+    x_global, labels_or_None, key) -> stacked per-microbatch outputs.
+
+    block_stacked leaves are [pp, bps, ...] (already grouped per stage).
+    x_global: [M * mb, ...] global batch (M = num_microbatches).
+    """
+    mesh = mesh or mesh_mod.ensure_mesh()
+    pp = mesh.shape.get(axis, 1)
+    template = pipe_layer.blocks[0]
+    pnames = [n for n, _ in template.named_parameters()]
+    M = num_microbatches
+
+    def pipeline_core(stage_params, h_mbs):
+        """Inside shard_map: stage_params leaves [bps, ...] (this stage's
+        blocks); h_mbs [M, mb, ...] replicated activations after `pre`."""
+        stage = lax.axis_index(axis)
+        n = lax.axis_size(axis)
+        steps = M + n - 1
+        mb_shape = h_mbs.shape[1:]
+        out_buf = jnp.zeros((M,) + mb_shape, h_mbs.dtype)
+        carry = jnp.zeros(mb_shape, h_mbs.dtype)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def tick(t, state):
+            carry, out_buf = state
+            feed_idx = jnp.clip(t, 0, M - 1)
+            feed = lax.dynamic_index_in_dim(h_mbs, feed_idx, axis=0,
+                                            keepdims=False)
+            inp = jnp.where(stage == 0, feed, carry)
+            act = _run_stage(template, pnames, stage_params, inp, training)
+            # collect at the LAST stage for ticks t in [n-1, n-1+M)
+            write_idx = jnp.clip(t - (n - 1), 0, M - 1)
+            updated = lax.dynamic_update_index_in_dim(
+                out_buf, act, write_idx, axis=0)
+            collect = jnp.logical_and(stage == n - 1, t >= n - 1)
+            out_buf = jnp.where(collect, updated, out_buf)
+            carry_next = lax.ppermute(act, axis, perm)
+            return carry_next, out_buf
+
+        carry, out_buf = lax.fori_loop(0, steps, tick, (carry, out_buf))
+        return out_buf
+
+    def pipelined(block_stacked, h_mbs):
+        in_specs = (
+            jax.tree_util.tree_map(lambda _: P(axis), block_stacked),
+            P(),
+        )
+
+        def core_wrap(bs_local, h):
+            # shard_map hands local views [1, bps, ...]; drop the pp axis
+            bs_local = {k: v[0] for k, v in bs_local.items()}
+            return pipeline_core(bs_local, h)
+
+        fn = shard_map(core_wrap, mesh=mesh, in_specs=in_specs,
+                       out_specs=P(), check_vma=False)
+        return fn(block_stacked, h_mbs)
+
+    def forward(pre_params, block_stacked, post_params, x_global, key,
+                pre_buffers=None, post_buffers=None):
+        """Pure pipeline forward over the global batch."""
+        pre_buffers = pre_buffers or {}
+        post_buffers = post_buffers or {}
+        mb = x_global.shape[0] // M
+        rng_mod.push_trace_key(key)
+        try:
+            with autograd.no_grad():
+                if pipe_layer.pre is not None:
+                    h, _ = functional_call(pipe_layer.pre, pre_params,
+                                           pre_buffers, (x_global,),
+                                           training=training)
+                else:
+                    h = x_global
+                h_mbs = h.reshape((M, mb) + h.shape[1:])
+                out_mbs = pipelined(block_stacked, h_mbs)
+                out = out_mbs.reshape((M * mb,) + out_mbs.shape[2:])
+                if pipe_layer.post is not None:
+                    out, _ = functional_call(pipe_layer.post, post_params,
+                                             post_buffers, (out,),
+                                             training=training)
+        finally:
+            rng_mod.pop_trace_key()
+        return out
+
+    return forward, pnames

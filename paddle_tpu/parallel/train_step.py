@@ -1,0 +1,380 @@
+"""Sharded, compiled training steps.
+
+Reference parity: this single builder replaces the reference's execution
+stack — ParallelExecutor + SSA graph executors
+(``parallel_executor.cc:609``, ``fast_threaded_ssa_graph_executor.cc:59``),
+the dygraph DDP Reducer (``reducer.cc:270``), the fleet meta-optimizer
+program rewrites (sharding/amp/recompute/gradient-merge), and the fused
+optimizer passes.  One pjit'd function computes forward, backward, gradient
+reduction (implicit via shardings), and the optimizer update; XLA schedules
+compute/collective overlap that the reference hand-built with op handles
+and comm streams.
+
+Strategy mapping (DistributedStrategy -> jax):
+  dp/sharding axes  -> batch PartitionSpec(('dp','sharding'))
+  sharding stage 2  -> optimizer-state specs sharded, params replicated
+  sharding stage 3  -> parameter specs sharded (ZeRO-3 / FSDP)
+  mp                -> explicit per-param specs from TP layers
+  pp                -> stacked-block pipeline (parallel/pipeline.py)
+  amp               -> bf16 autocast inside the traced step
+  gradient_merge    -> lax.scan micro-batch accumulation
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P, NamedSharding
+
+from ..core.tensor import Tensor
+from ..core import autograd, rng as rng_mod
+from ..jit import functional_call
+from ..distributed import mesh as mesh_mod
+from ..distributed.sharding import shard_params_specs
+from .. import amp as amp_mod
+
+DATA_AXES = ("dp", "sharding")
+
+
+def _batch_spec(ndim):
+    return P(DATA_AXES, *([None] * (ndim - 1)))
+
+
+def _state_spec_like(param_spec, leaf):
+    """Optimizer-state leaf adopts its param's spec when shapes match."""
+    if leaf.ndim == 0:
+        return P()
+    return param_spec
+
+
+class TrainStep:
+    """Compiled train step over a Layer + Optimizer (+ loss)."""
+
+    def __init__(self, model, optimizer, loss_fn=None, strategy=None,
+                 mesh=None, amp_level=None, donate=True, train=True):
+        from ..distributed.parallel import DataParallel
+        from ..distributed.fleet.meta_parallel import PipelineLayer
+        if isinstance(model, DataParallel):
+            model = model._layers
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.strategy = strategy
+        self.mesh = mesh or mesh_mod.ensure_mesh()
+        self.donate = donate
+        self.training = train
+        self._compiled = {}
+
+        s = strategy
+        self.use_amp = bool(amp_level) or bool(s and s.amp)
+        self.amp_level = amp_level or (
+            "O2" if (s and s.amp_configs.get("use_pure_fp16")) else "O1")
+        self.grad_merge_k = 1
+        if s and s.gradient_merge:
+            self.grad_merge_k = int(
+                s.gradient_merge_configs.get("k_steps", 1))
+
+        self.is_pipeline = isinstance(model, PipelineLayer) and \
+            self.mesh.shape.get("pp", 1) > 1
+        if self.is_pipeline:
+            self._init_pipeline_state()
+        else:
+            self._init_flat_state()
+
+    # ------------------------------------------------------------------
+    def _stage(self):
+        s = self.strategy
+        if s is not None and s.sharding:
+            return int(s.sharding_configs.get("stage", 2))
+        return 0
+
+    def _init_flat_state(self):
+        params = dict(self.model.named_parameters())
+        buffers = {k: v for k, v in self.model.named_buffers()
+                   if v is not None}
+        self.pnames = sorted(params)
+        self.bnames = sorted(buffers)
+        stage = self._stage()
+        spec_map = shard_params_specs(
+            self.model, stage=stage if stage else 2,
+            axis="sharding")
+        if stage < 3:
+            # stages 0-2: params replicated unless TP says otherwise
+            for k in self.pnames:
+                if getattr(params[k], "partition_spec", None) is None:
+                    spec_map[k] = P()
+        self.param_specs = {k: spec_map.get(k, P()) for k in self.pnames}
+
+        self.params = {}
+        for k in self.pnames:
+            arr = params[k]._data
+            self.params[k] = jax.device_put(
+                arr, NamedSharding(self.mesh, self.param_specs[k]))
+        self.buffers = {k: jax.device_put(
+            buffers[k]._data, NamedSharding(self.mesh, P()))
+            for k in self.bnames}
+
+        self.opt_state = {k: self.optimizer._init_state(params[k])
+                          for k in self.pnames}
+        # ZeRO stage >= 1: shard optimizer moments over 'sharding'
+        self.opt_specs = {}
+        shard_world = self.mesh.shape.get("sharding", 1)
+        for k in self.pnames:
+            pspec = self.param_specs[k]
+            sub = {}
+            for sk, leaf in self.opt_state[k].items():
+                if leaf.ndim == 0:
+                    sub[sk] = P()
+                elif stage >= 1 and shard_world > 1 and \
+                        pspec == P() and leaf.shape and \
+                        leaf.shape[0] % shard_world == 0:
+                    sub[sk] = P("sharding")
+                else:
+                    sub[sk] = _state_spec_like(pspec, leaf)
+            self.opt_specs[k] = sub
+        self.opt_state = {
+            k: {sk: jax.device_put(leaf, NamedSharding(
+                self.mesh, self.opt_specs[k][sk]))
+                for sk, leaf in sub.items()}
+            for k, sub in self.opt_state.items()}
+        self._trainable = {k: params[k].trainable for k in self.pnames}
+
+    def _init_pipeline_state(self):
+        from .pipeline import stack_block_params, build_pipeline_fn
+        model = self.model
+        pp = self.mesh.shape.get("pp", 1)
+        nblocks = len(model.blocks)
+        assert nblocks % pp == 0, \
+            f"n_blocks {nblocks} must divide pp degree {pp}"
+        self.bps = nblocks // pp
+        self.block_pnames, stacked = stack_block_params(model.blocks)
+        # regroup [nblocks, ...] -> [pp, bps, ...]
+        self.block_params = {
+            k: jax.device_put(
+                v.reshape((pp, self.bps) + v.shape[1:]),
+                NamedSharding(self.mesh, P("pp")))
+            for k, v in stacked.items()}
+        self.pre_params = {}
+        self.post_params = {}
+        if model.pre is not None:
+            self.pre_params = {k: jax.device_put(
+                p._data, NamedSharding(
+                    self.mesh, getattr(p, "partition_spec", None) or P()))
+                for k, p in dict(model.pre.named_parameters()).items()}
+        if model.post is not None:
+            self.post_params = {k: jax.device_put(
+                p._data, NamedSharding(
+                    self.mesh, getattr(p, "partition_spec", None) or P()))
+                for k, p in dict(model.post.named_parameters()).items()}
+        M = 1
+        if self.strategy is not None and self.strategy.pipeline:
+            M = int(self.strategy.pipeline_configs.get(
+                "accumulate_steps", 1))
+        self.num_microbatches = max(M, 1)
+        self.pipe_fn, _ = build_pipeline_fn(
+            model, self.num_microbatches, mesh=self.mesh,
+            training=self.training)
+        # one flat param tree for the optimizer
+        self.params = {"pre": self.pre_params, "block": self.block_params,
+                       "post": self.post_params}
+        self.opt_state = jax.tree_util.tree_map(
+            lambda a: self.optimizer._init_state(Tensor(a)), self.params,
+            is_leaf=lambda x: isinstance(x, jax.Array))
+        self.buffers = {}
+        self.bnames = []
+
+    # ------------------------------------------------------------------
+    def _loss_from_out(self, out, labels):
+        with autograd.no_grad():
+            if self.loss_fn is None:
+                loss_t = out if isinstance(out, Tensor) else Tensor(out)
+            else:
+                wrapped_out = Tensor(out) if not isinstance(out, Tensor) \
+                    else out
+                wrapped_labels = [Tensor(l) for l in labels]
+                loss_t = self.loss_fn(wrapped_out, *wrapped_labels)
+            return loss_t._data if isinstance(loss_t, Tensor) else loss_t
+
+    def _build_flat(self, in_shapes):
+        model = self.model
+        pnames, bnames = self.pnames, self.bnames
+        training = self.training
+        use_amp, amp_level = self.use_amp, self.amp_level
+        n_inputs = in_shapes[0]
+        merge_k = self.grad_merge_k
+
+        def forward_loss(p_arrays, b_arrays, inputs, labels, key):
+            import contextlib
+            ctx = amp_mod.auto_cast(
+                enable=True, level=amp_level) if use_amp else \
+                contextlib.nullcontext()
+            with ctx:
+                with autograd.no_grad():
+                    out, new_buf = functional_call(
+                        model, dict(zip(pnames, p_arrays)),
+                        dict(zip(bnames, b_arrays)), inputs,
+                        training=training, rng_key=key)
+                if isinstance(out, tuple):
+                    out = out[0]
+                loss = self._loss_from_out(out, labels)
+            return loss.astype(jnp.float32), [new_buf[k] for k in bnames]
+
+        trainable = self._trainable
+
+        def step(params, buffers, opt_state, lr, key, inputs, labels):
+            p_list = [params[k] for k in pnames]
+            b_list = [buffers[k] for k in bnames]
+
+            def loss_of(p_sub):
+                merged = [p_sub[k] if trainable[k] else params[k]
+                          for k in pnames]
+                return forward_loss(merged, b_list, inputs, labels, key)
+
+            p_sub = {k: params[k] for k in pnames if trainable[k]}
+            if merge_k > 1:
+                def micro(i, acc):
+                    g_acc, l_acc, buf = acc
+                    mb_in = [a.reshape((merge_k, -1) + a.shape[1:])[i]
+                             for a in inputs]
+                    mb_lab = [a.reshape((merge_k, -1) + a.shape[1:])[i]
+                              for a in labels]
+
+                    def loss_mb(p_sub2):
+                        merged = [p_sub2[k] if trainable[k] else params[k]
+                                  for k in pnames]
+                        return forward_loss(merged, b_list, mb_in, mb_lab,
+                                            jax.random.fold_in(key, i))
+
+                    (l, buf2), g = jax.value_and_grad(
+                        loss_mb, has_aux=True)(p_sub)
+                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                    return g_acc, l_acc + l, buf2
+
+                # unrolled python loop (merge_k is small & static)
+                zero_g = jax.tree_util.tree_map(jnp.zeros_like, p_sub)
+                g_acc, l_acc, buf = zero_g, jnp.zeros([], jnp.float32), \
+                    b_list
+                for i in range(merge_k):
+                    g_acc, l_acc, buf = micro(i, (g_acc, l_acc, buf))
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / merge_k, g_acc)
+                loss = l_acc / merge_k
+                new_b_list = buf
+            else:
+                (loss, new_b_list), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(p_sub)
+
+            new_sub, new_opt_sub = self.optimizer.apply_gradients_tree(
+                p_sub, grads,
+                {k: opt_state[k] for k in p_sub}, lr)
+            new_params = dict(params)
+            new_params.update(new_sub)
+            new_opt = dict(opt_state)
+            new_opt.update(new_opt_sub)
+            # re-pin shardings so XLA keeps the layout stable
+            new_params = {
+                k: jax.lax.with_sharding_constraint(
+                    v, NamedSharding(self.mesh, self.param_specs[k]))
+                for k, v in new_params.items()}
+            new_buffers = dict(zip(bnames, new_b_list))
+            return loss, new_params, new_buffers, new_opt
+
+        in_shardings = (
+            {k: NamedSharding(self.mesh, self.param_specs[k])
+             for k in pnames},
+            {k: NamedSharding(self.mesh, P()) for k in bnames},
+            {k: {sk: NamedSharding(self.mesh, self.opt_specs[k][sk])
+                 for sk in self.opt_specs[k]} for k in pnames},
+            NamedSharding(self.mesh, P()),
+            NamedSharding(self.mesh, P()),
+            [NamedSharding(self.mesh, _batch_spec(nd))
+             for nd in in_shapes[1]],
+            [NamedSharding(self.mesh, _batch_spec(nd))
+             for nd in in_shapes[2]],
+        )
+        donate = (0, 2) if self.donate else ()
+        return jax.jit(step, in_shardings=in_shardings,
+                       donate_argnums=donate)
+
+    def _build_pipeline(self, in_shapes):
+        pipe_fn = self.pipe_fn
+        loss_fn = self.loss_fn
+
+        def step(params, opt_state, lr, key, inputs, labels):
+            def loss_of(p):
+                out = pipe_fn(p["pre"], p["block"], p["post"],
+                              inputs[0], key)
+                return self._loss_from_out(out, labels).astype(jnp.float32)
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            new_params, new_opt = self.optimizer.apply_gradients_tree(
+                params, grads, opt_state, lr)
+            return loss, new_params, new_opt
+
+        donate = (0, 1) if self.donate else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    # ------------------------------------------------------------------
+    def step(self, inputs, labels=()):
+        """Run one optimization step on a global batch."""
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        if not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        in_arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                     for x in inputs]
+        lab_arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                      for x in labels]
+        key = rng_mod.next_key()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        shapes_key = (len(in_arrays),
+                      tuple(a.ndim for a in in_arrays),
+                      tuple(a.ndim for a in lab_arrays),
+                      tuple(tuple(a.shape) for a in in_arrays),
+                      tuple(tuple(a.shape) for a in lab_arrays))
+        if shapes_key not in self._compiled:
+            meta = (len(in_arrays), [a.ndim for a in in_arrays],
+                    [a.ndim for a in lab_arrays])
+            if self.is_pipeline:
+                self._compiled[shapes_key] = self._build_pipeline(meta)
+            else:
+                self._compiled[shapes_key] = self._build_flat(meta)
+        fn = self._compiled[shapes_key]
+        if self.is_pipeline:
+            loss, self.params, self.opt_state = fn(
+                self.params, self.opt_state, lr, key, in_arrays,
+                lab_arrays)
+        else:
+            loss, self.params, self.buffers, self.opt_state = fn(
+                self.params, self.buffers, self.opt_state, lr, key,
+                in_arrays, lab_arrays)
+        self.optimizer._step_count += 1
+        return Tensor(loss)
+
+    # ------------------------------------------------------------------
+    def sync_to_layer(self):
+        """Copy device state back into the Layer's Tensors."""
+        if self.is_pipeline:
+            from .pipeline import unstack_block_params
+            pp = self.mesh.shape.get("pp", 1)
+            flat = {k: np.asarray(v).reshape((-1,) + v.shape[2:])
+                    for k, v in self.params["block"].items()}
+            unstack_block_params(self.model.blocks, self.block_pnames,
+                                 flat)
+            for store, params in (("pre", self.params["pre"]),
+                                  ("post", self.params["post"])):
+                layer = getattr(self.model, store)
+                if layer is not None:
+                    named = dict(layer.named_parameters())
+                    for k, v in params.items():
+                        named[k]._data = v
+            return
+        named = dict(self.model.named_parameters())
+        for k in self.pnames:
+            named[k]._data = self.params[k]
+        named_b = dict(self.model.named_buffers())
+        for k in self.bnames:
+            if k in named_b and named_b[k] is not None:
+                named_b[k]._data = self.buffers[k]

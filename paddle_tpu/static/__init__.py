@@ -1,0 +1,79 @@
+"""paddle.static parity shims.
+
+The reference's static graph (ProgramDesc + Executor) has no TPU analogue —
+SURVEY.md §7 layer 4: the trace-compile boundary IS the static mode.  This
+module keeps the handful of static-API entry points that user code touches
+(InputSpec, default programs as opaque handles, name scopes).
+"""
+from __future__ import annotations
+
+from ..core import dtype as dtypes
+
+
+class InputSpec:
+    """paddle.static.InputSpec — shape/dtype declaration for jit.save."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtypes.canonical_name(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)
+
+
+class Program:
+    """Opaque placeholder: XLA owns the compiled program."""
+
+    def __init__(self):
+        self._is_start_up = False
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+class program_guard:
+    def __init__(self, main_program=None, startup_program=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    raise NotImplementedError(
+        "py_func: host callbacks map to jax.pure_callback; not yet wired")

@@ -1,0 +1,24 @@
+"""Utilities: profiler spans, timers, download shim, unique_name."""
+from . import profiler  # noqa: F401
+from . import unique_name  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None
+
+
+def run_check():
+    """paddle.utils.run_check parity — quick health check of the stack."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    x = Tensor(jnp.ones((2, 2)))
+    y = (x @ x).numpy()
+    n = len(jax.devices())
+    print(f"paddle_tpu is installed successfully! "
+          f"{n} device(s): {jax.devices()[0].platform}")
+    return True

@@ -1,0 +1,81 @@
+"""Profiler.
+
+Reference parity: host RecordEvent spans + CUPTI device tracer + chrome
+trace export (``platform/profiler.cc:196``, ``device_tracer.cc:57``,
+``tools/timeline.py``).  TPU-native: ``jax.profiler`` emits an XPlane trace
+(TensorBoard / Perfetto-compatible — the chrome://tracing successor);
+RecordEvent maps to ``jax.profiler.TraceAnnotation`` so host spans correlate
+with device activity in the same trace.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+class RecordEvent:
+    """RAII span (reference: platform/profiler.h RecordEvent)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._ann = None
+
+    def __enter__(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._t0
+        self._ann.__exit__(*exc)
+        return False
+
+
+_active_dir = None
+
+
+def start_profiler(state="All", tracer_option="Default",
+                   log_dir="/tmp/paddle_tpu_profile"):
+    global _active_dir
+    _active_dir = log_dir
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    global _active_dir
+    if _active_dir is not None:
+        jax.profiler.stop_trace()
+        _active_dir = None
+
+
+@contextlib.contextmanager
+def profiler(state="All", tracer_option="Default",
+             log_dir="/tmp/paddle_tpu_profile"):
+    start_profiler(state, tracer_option, log_dir)
+    try:
+        yield
+    finally:
+        stop_profiler()
+
+
+class Timer:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._start = None
+        self.total = 0.0
+        self.count = 0
+
+    def start(self):
+        self._start = time.perf_counter()
+
+    def stop(self):
+        self.total += time.perf_counter() - self._start
+        self.count += 1
+
+    def mean(self):
+        return self.total / max(self.count, 1)

@@ -1,0 +1,33 @@
+"""unique_name parity (reference: fluid/unique_name.py)."""
+from __future__ import annotations
+
+import contextlib
+
+_counters: dict[str, int] = {}
+_prefix: list[str] = []
+
+
+def generate(key):
+    _counters[key] = _counters.get(key, 0) + 1
+    name = f"{key}_{_counters[key] - 1}"
+    if _prefix:
+        return "/".join(_prefix) + "/" + name
+    return name
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    saved = dict(_counters)
+    if isinstance(new_generator, str):
+        _prefix.append(new_generator)
+    try:
+        yield
+    finally:
+        _counters.clear()
+        _counters.update(saved)
+        if isinstance(new_generator, str):
+            _prefix.pop()
+
+
+def switch(new_generator=None):
+    _counters.clear()

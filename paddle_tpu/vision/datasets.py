@@ -1,0 +1,155 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/ — MNIST,
+Cifar10/100, FashionMNIST, Flowers).
+
+TPU-host note: this environment has no egress, so each dataset loads from a
+local file when present (same formats as the reference's download cache) and
+otherwise falls back to a deterministic synthetic sample generator with the
+correct shapes/dtypes/cardinality — keeping the training-pipeline contract
+testable offline.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+DATA_HOME = os.path.expanduser(os.environ.get(
+    "PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def _synthetic(n, shape, num_classes, seed):
+    rs = np.random.RandomState(seed)
+    images = (rs.rand(n, *shape) * 255).astype(np.uint8)
+    labels = rs.randint(0, num_classes, n).astype(np.int64)
+    return images, labels
+
+
+class MNIST(Dataset):
+    """idx-format loader w/ synthetic fallback (reference:
+    vision/datasets/mnist.py)."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None,
+                 synthetic_size=None):
+        self.mode = mode
+        self.transform = transform
+        base = os.path.join(DATA_HOME, "mnist")
+        prefix = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(
+            base, f"{prefix}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(
+            base, f"{prefix}-labels-idx1-ubyte.gz")
+        if os.path.exists(image_path) and os.path.exists(label_path):
+            self.images = self._read_images(image_path)
+            self.labels = self._read_labels(label_path)
+        else:
+            n = synthetic_size or (60000 if mode == "train" else 10000)
+            n = int(os.environ.get("PADDLE_TPU_SYNTH_N", n))
+            self.images, self.labels = _synthetic(
+                n, (28, 28), 10, seed=0 if mode == "train" else 1)
+
+    @staticmethod
+    def _read_images(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    @staticmethod
+    def _read_labels(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = int(self.labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None] / 255.0
+        return img, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, **kwargs):
+        base = os.path.join(DATA_HOME, "fashion-mnist")
+        prefix = "train" if kwargs.get("mode", "train") == "train" else \
+            "t10k"
+        kwargs.setdefault("image_path", os.path.join(
+            base, f"{prefix}-images-idx3-ubyte.gz"))
+        kwargs.setdefault("label_path", os.path.join(
+            base, f"{prefix}-labels-idx1-ubyte.gz"))
+        super().__init__(**kwargs)
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        self.mode = mode
+        self.transform = transform
+        data_file = data_file or os.path.join(
+            DATA_HOME, "cifar", "cifar-10-python.tar.gz")
+        if os.path.exists(data_file):
+            self.images, self.labels = self._load_tar(data_file, mode)
+        else:
+            n = synthetic_size or (50000 if mode == "train" else 10000)
+            n = int(os.environ.get("PADDLE_TPU_SYNTH_N", n))
+            images, self.labels = _synthetic(n, (32, 32, 3),
+                                             self.NUM_CLASSES,
+                                             seed=2 if mode == "train"
+                                             else 3)
+            self.images = images
+
+    def _load_tar(self, path, mode):
+        names = ([f"data_batch_{i}" for i in range(1, 6)]
+                 if mode == "train" else ["test_batch"])
+        images, labels = [], []
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                if any(member.name.endswith(n) for n in names):
+                    batch = pickle.load(tf.extractfile(member),
+                                        encoding="bytes")
+                    images.append(batch[b"data"].reshape(-1, 3, 32, 32)
+                                  .transpose(0, 2, 3, 1))
+                    key = b"labels" if b"labels" in batch else \
+                        b"fine_labels"
+                    labels.extend(batch[key])
+        return np.concatenate(images), np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = int(self.labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32).transpose(2, 0, 1) / 255.0
+        return img, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+    def __init__(self, data_file=None, **kwargs):
+        data_file = data_file or os.path.join(
+            DATA_HOME, "cifar", "cifar-100-python.tar.gz")
+        super().__init__(data_file=data_file, **kwargs)

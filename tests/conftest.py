@@ -1,0 +1,33 @@
+"""Test env: force CPU backend with 8 virtual devices BEFORE jax loads.
+
+This is the reference's multi-process-on-localhost pattern (SURVEY.md §4)
+mapped to TPU testing: a virtual 8-device mesh exercises every sharding
+path without hardware.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import jax  # noqa: E402
+
+# the axon TPU plugin ignores JAX_PLATFORMS; force via config
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.devices()
+assert len(jax.devices()) == 8, jax.devices()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu
+    paddle_tpu.seed(102)
+    yield

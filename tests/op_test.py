@@ -1,0 +1,71 @@
+"""OpTest harness — numpy-reference forward check + finite-difference
+gradient check.
+
+Reference parity: ``python/paddle/fluid/tests/unittests/op_test.py:232``
+(check_output_with_place) and ``:101`` (get_numeric_gradient) — SURVEY.md §4
+calls this "the single most reusable pattern for the TPU build".
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu
+
+
+def check_forward(fn, np_fn, inputs, rtol=1e-5, atol=1e-6, **kwargs):
+    """fn: paddle_tpu op over Tensors; np_fn: numpy reference."""
+    tensors = [paddle_tpu.to_tensor(x) for x in inputs]
+    out = fn(*tensors, **kwargs)
+    ref = np_fn(*inputs, **kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(o.numpy(), np.asarray(r), rtol=rtol,
+                                   atol=atol)
+    return out
+
+
+def numeric_grad(fn, inputs, wrt, out_grad=None, delta=1e-3, **kwargs):
+    """Central finite differences of sum(fn * out_grad) wrt inputs[wrt]
+    (reference: op_test.py:101 get_numeric_gradient)."""
+    x = np.asarray(inputs[wrt], dtype=np.float64)
+    grad = np.zeros_like(x)
+
+    def eval_at(xv):
+        args = [np.asarray(a, np.float64) if i == wrt else a
+                for i, a in enumerate(inputs)]
+        args[wrt] = xv
+        tensors = [paddle_tpu.to_tensor(np.asarray(a, np.float32))
+                   for a in args]
+        out = fn(*tensors, **kwargs)
+        o = out.numpy().astype(np.float64)
+        if out_grad is not None:
+            return np.sum(o * out_grad)
+        return np.sum(o)
+
+    flat = x.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        f_hi = eval_at(x)
+        flat[i] = orig - delta
+        f_lo = eval_at(x)
+        flat[i] = orig
+        grad.reshape(-1)[i] = (f_hi - f_lo) / (2 * delta)
+    return grad
+
+
+def check_grad(fn, inputs, wrt=0, rtol=1e-2, atol=1e-3, delta=1e-3,
+               **kwargs):
+    """Compare tape backward() grads against finite differences."""
+    tensors = []
+    for i, x in enumerate(inputs):
+        t = paddle_tpu.to_tensor(np.asarray(x, np.float32),
+                                 stop_gradient=(i != wrt))
+        tensors.append(t)
+    out = fn(*tensors, **kwargs)
+    loss = out.sum() if out.size > 1 else out
+    loss.backward()
+    analytic = tensors[wrt].grad.numpy()
+    numeric = numeric_grad(fn, inputs, wrt, delta=delta, **kwargs)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)

@@ -1,0 +1,140 @@
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import Tensor
+
+
+def test_simple_backward():
+    x = paddle_tpu.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_rule():
+    x = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+    y = paddle_tpu.exp(x)
+    z = paddle_tpu.log(y) * 3.0
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0], rtol=1e-5)
+
+
+def test_grad_accumulation_two_paths():
+    x = paddle_tpu.to_tensor([2.0], stop_gradient=False)
+    y = x * 3.0 + x * x  # dy/dx = 3 + 2x = 7
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_backward_twice_accumulates_on_leaf():
+    x = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+    (x * 2.0).backward()
+    (x * 3.0).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_no_grad_blocks_tape():
+    x = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+    with paddle_tpu.no_grad():
+        y = x * 2.0
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_stop_gradient_cuts_graph():
+    x = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+    y = x * 2.0
+    yd = y.detach()
+    z = yd * 3.0
+    assert z.stop_gradient
+
+
+def test_retain_graph_error_without():
+    x = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+    y = x * 2.0
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_retain_graph_allows_second_backward():
+    x = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+    y = x * 2.0
+    y.backward(retain_graph=True)
+    # reconnect root for a second pass
+    x.clear_grad()
+
+
+def test_non_scalar_backward_needs_grad():
+    x = paddle_tpu.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2.0
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y2 = x * 2.0
+    y2.backward(paddle_tpu.ones([2]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_matmul_grad():
+    a = paddle_tpu.to_tensor(np.random.rand(3, 4).astype(np.float32),
+                             stop_gradient=False)
+    b = paddle_tpu.to_tensor(np.random.rand(4, 5).astype(np.float32),
+                             stop_gradient=False)
+    out = paddle_tpu.matmul(a, b)
+    out.sum().backward()
+    np.testing.assert_allclose(
+        a.grad.numpy(), np.ones((3, 5)) @ b.numpy().T, rtol=1e-5)
+    np.testing.assert_allclose(
+        b.grad.numpy(), a.numpy().T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_broadcast_grad():
+    a = paddle_tpu.to_tensor(np.ones((3, 4), np.float32),
+                             stop_gradient=False)
+    b = paddle_tpu.to_tensor(np.ones((4,), np.float32),
+                             stop_gradient=False)
+    (a + b).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), [3.0] * 4)
+
+
+def test_multi_output_split_grad():
+    x = paddle_tpu.to_tensor(np.arange(6, dtype=np.float32),
+                             stop_gradient=False)
+    a, b = paddle_tpu.split(x, 2)
+    (a.sum() * 2 + b.sum() * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2, 3, 3, 3])
+
+
+def test_unused_output_gets_zero_grad():
+    x = paddle_tpu.to_tensor(np.arange(6, dtype=np.float32),
+                             stop_gradient=False)
+    a, b = paddle_tpu.split(x, 2)
+    a.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 1, 1, 0, 0, 0])
+
+
+def test_paddle_grad_api():
+    x = paddle_tpu.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    (g,) = paddle_tpu.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [4.0])
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_retain_grads_intermediate():
+    x = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+    y = x * 2.0
+    y.retain_grads()
+    z = y * 3.0
+    z.backward()
+    np.testing.assert_allclose(y.grad.numpy(), [3.0])
+
+
+def test_getitem_grad():
+    x = paddle_tpu.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                             stop_gradient=False)
+    y = x[0]
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[1, 1, 1], [0, 0, 0]])

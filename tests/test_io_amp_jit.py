@@ -1,0 +1,270 @@
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import nn, optimizer, amp, io
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(1)
+
+
+class TestDataLoader:
+    def test_basic_batching(self):
+        class DS(io.Dataset):
+            def __getitem__(self, i):
+                return np.full((3,), i, np.float32), np.int64(i % 2)
+
+            def __len__(self):
+                return 10
+
+        loader = io.DataLoader(DS(), batch_size=4)
+        batches = list(loader)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 3]
+        assert y.shape == [4]
+        x_last, _ = batches[-1]
+        assert x_last.shape == [2, 3]
+
+    def test_drop_last_and_shuffle(self):
+        class DS(io.Dataset):
+            def __getitem__(self, i):
+                return np.float32(i)
+
+            def __len__(self):
+                return 10
+
+        loader = io.DataLoader(DS(), batch_size=4, drop_last=True,
+                               shuffle=True)
+        batches = list(loader)
+        assert len(batches) == 2
+        all_vals = np.concatenate([b.numpy() for b in batches])
+        assert len(set(all_vals.tolist())) == 8
+
+    def test_num_workers_prefetch(self):
+        class DS(io.Dataset):
+            def __getitem__(self, i):
+                return np.full((2,), i, np.float32)
+
+            def __len__(self):
+                return 20
+
+        loader = io.DataLoader(DS(), batch_size=5, num_workers=2)
+        batches = list(loader)
+        assert len(batches) == 4
+        # order must be deterministic despite workers
+        np.testing.assert_array_equal(batches[0].numpy()[:, 0],
+                                      [0, 1, 2, 3, 4])
+
+    def test_tensor_dataset_and_random_split(self):
+        xs = np.arange(20, dtype=np.float32).reshape(10, 2)
+        ds = io.TensorDataset([xs, np.arange(10)])
+        a, b = io.random_split(ds, [7, 3])
+        assert len(a) == 7 and len(b) == 3
+
+    def test_batch_sampler(self):
+        bs = io.BatchSampler(dataset=list(range(10)), batch_size=3,
+                             drop_last=False)
+        assert len(bs) == 4
+
+    def test_distributed_batch_sampler_partitions(self):
+        class DS(io.Dataset):
+            def __getitem__(self, i):
+                return np.float32(i)
+
+            def __len__(self):
+                return 8
+
+        s0 = io.DistributedBatchSampler(DS(), batch_size=2,
+                                        num_replicas=2, rank=0)
+        s1 = io.DistributedBatchSampler(DS(), batch_size=2,
+                                        num_replicas=2, rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert sorted(i0 + i1) == list(range(8))
+
+    def test_iterable_dataset(self):
+        class IDS(io.IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.float32(i)
+
+        loader = io.DataLoader(IDS(), batch_size=3)
+        batches = list(loader)
+        assert len(batches) == 3
+
+
+class TestSaveLoad:
+    def test_state_dict_roundtrip(self, tmp_path):
+        net = nn.Sequential(nn.Linear(3, 4), nn.Linear(4, 2))
+        path = str(tmp_path / "model.pdparams")
+        paddle_tpu.save(net.state_dict(), path)
+        loaded = paddle_tpu.load(path)
+        net2 = nn.Sequential(nn.Linear(3, 4), nn.Linear(4, 2))
+        net2.set_state_dict(loaded)
+        np.testing.assert_array_equal(net2[0].weight.numpy(),
+                                      net[0].weight.numpy())
+
+    def test_save_nested_structures(self, tmp_path):
+        obj = {"a": paddle_tpu.ones([2]), "b": [1, 2, {"c": "x"}]}
+        path = str(tmp_path / "obj.pd")
+        paddle_tpu.save(obj, path)
+        loaded = paddle_tpu.load(path)
+        np.testing.assert_array_equal(loaded["a"].numpy(), [1, 1])
+        assert loaded["b"][2]["c"] == "x"
+
+    def test_optimizer_checkpoint(self, tmp_path):
+        net = nn.Linear(2, 2)
+        opt = optimizer.Adam(learning_rate=0.01,
+                             parameters=net.parameters())
+        net(paddle_tpu.ones([1, 2])).sum().backward()
+        opt.step()
+        paddle_tpu.save(opt.state_dict(), str(tmp_path / "opt.pdopt"))
+        loaded = paddle_tpu.load(str(tmp_path / "opt.pdopt"))
+        assert loaded["__step__"] == 1
+
+
+class TestAMP:
+    def test_autocast_casts_matmul_to_bf16(self):
+        a = paddle_tpu.ones([4, 4])
+        with amp.auto_cast():
+            out = paddle_tpu.matmul(a, a)
+        assert out.dtype == "bfloat16"
+
+    def test_blacklist_stays_f32(self):
+        a = paddle_tpu.ones([4, 4])
+        with amp.auto_cast():
+            out = F.softmax(a)
+        assert out.dtype == "float32"
+
+    def test_autocast_disabled_outside(self):
+        a = paddle_tpu.ones([4, 4])
+        out = paddle_tpu.matmul(a, a)
+        assert out.dtype == "float32"
+
+    def test_custom_black_list(self):
+        a = paddle_tpu.ones([4, 4])
+        with amp.auto_cast(custom_black_list=["matmul_v2"]):
+            out = paddle_tpu.matmul(a, a)
+        assert out.dtype == "float32"
+
+    def test_grad_scaler_bf16_identity(self):
+        net = nn.Linear(2, 2)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=net.parameters())
+        scaler = amp.GradScaler(init_loss_scaling=1024)
+        with amp.auto_cast():
+            loss = net(paddle_tpu.ones([1, 2])).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        assert net.weight.grad is not None
+
+    def test_grad_scaler_skips_on_inf(self):
+        net = nn.Linear(1, 1, bias_attr=False)
+        w0 = net.weight.numpy().copy()
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=net.parameters())
+        scaler = amp.GradScaler(init_loss_scaling=2.0,
+                                decr_every_n_nan_or_inf=1)
+        net.weight.grad = paddle_tpu.to_tensor(
+            np.array([[np.inf]], np.float32))
+        scaler.step(opt)
+        np.testing.assert_array_equal(net.weight.numpy(), w0)
+        assert scaler._scale < 2.0
+
+    def test_amp_training_converges(self):
+        paddle_tpu.seed(5)
+        net = nn.Linear(1, 1)
+        opt = optimizer.Adam(learning_rate=0.1,
+                             parameters=net.parameters())
+        x = paddle_tpu.to_tensor(rng.rand(32, 1).astype(np.float32))
+        y = x * 3.0
+        for _ in range(100):
+            with amp.auto_cast():
+                loss = F.mse_loss(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.numpy()) < 0.1
+
+
+class TestJit:
+    def test_to_static_function(self):
+        @paddle_tpu.jit.to_static
+        def fn(x):
+            return x * 2 + 1
+
+        out = fn(paddle_tpu.ones([3]))
+        np.testing.assert_array_equal(out.numpy(), [3, 3, 3])
+
+    def test_to_static_layer_matches_eager(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        net.eval()
+        x = paddle_tpu.to_tensor(rng.rand(3, 4).astype(np.float32))
+        eager = net(x).numpy()
+        static = paddle_tpu.jit.to_static(net)
+        out = static(x)
+        np.testing.assert_allclose(out.numpy(), eager, rtol=1e-5)
+
+    def test_to_static_backward(self):
+        net = nn.Linear(3, 2)
+        static = paddle_tpu.jit.to_static(net)
+        x = paddle_tpu.to_tensor(rng.rand(2, 3).astype(np.float32))
+        out = static(x)
+        out.sum().backward()
+        assert net.weight.grad is not None
+        # grads must match the eager path
+        g_static = net.weight.grad.numpy().copy()
+        net.clear_gradients()
+        net(x).sum().backward()
+        np.testing.assert_allclose(g_static, net.weight.grad.numpy(),
+                                   rtol=1e-5)
+
+    def test_to_static_bn_buffer_update(self):
+        net = nn.Sequential(nn.Linear(2, 4), nn.BatchNorm1D(4))
+        static = paddle_tpu.jit.to_static(net)
+        before = net[1]._mean.numpy().copy()
+        x = paddle_tpu.to_tensor(rng.rand(8, 2).astype(np.float32) + 3)
+        static(x)
+        after = net[1]._mean.numpy()
+        assert not np.allclose(before, after)
+
+    def test_jit_save_load(self, tmp_path):
+        from paddle_tpu.static import InputSpec
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        net.eval()
+        x = rng.rand(2, 4).astype(np.float32)
+        ref = net(paddle_tpu.to_tensor(x)).numpy()
+        path = str(tmp_path / "model")
+        paddle_tpu.jit.save(net, path,
+                            input_spec=[InputSpec([2, 4], "float32")])
+        loaded = paddle_tpu.jit.load(path)
+        out = loaded(paddle_tpu.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+class TestInference:
+    def test_predictor_over_layer(self):
+        from paddle_tpu.inference import Predictor
+        net = nn.Linear(3, 2)
+        net.eval()
+        pred = Predictor(net)
+        x = rng.rand(2, 3).astype(np.float32)
+        outs = pred.run([x])
+        ref = net(paddle_tpu.to_tensor(x)).numpy()
+        np.testing.assert_allclose(outs[0], ref, rtol=1e-5)
+
+
+class TestCheckNanInf:
+    def test_flag_raises_on_nan(self):
+        paddle_tpu.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle_tpu.to_tensor([0.0])
+            with pytest.raises(FloatingPointError):
+                paddle_tpu.log(x * 0.0 - 1.0).sqrt()
+        finally:
+            paddle_tpu.set_flags({"FLAGS_check_nan_inf": False})

@@ -1,0 +1,459 @@
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(0)
+
+
+class TestLayerSystem:
+    def test_parameters_registration(self):
+        layer = nn.Linear(4, 3)
+        params = layer.parameters()
+        assert len(params) == 2
+        names = [n for n, _ in layer.named_parameters()]
+        assert "weight" in names and "bias" in names
+
+    def test_sublayers_and_state_dict(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        sd = net.state_dict()
+        assert set(sd) == {"fc1.weight", "fc1.bias", "fc2.weight",
+                           "fc2.bias"}
+        net2 = Net()
+        net2.set_state_dict(sd)
+        np.testing.assert_array_equal(net2.fc1.weight.numpy(),
+                                      net.fc1.weight.numpy())
+
+    def test_train_eval_propagates(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_apply_and_children(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.ReLU())
+        seen = []
+        net.apply(lambda l: seen.append(type(l).__name__))
+        assert "Linear" in seen and "Sequential" in seen
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        bufs = dict(bn.named_buffers())
+        assert "_mean" in bufs and "_variance" in bufs
+        sd = bn.state_dict()
+        assert "_mean" in sd
+
+    def test_forward_hooks(self):
+        layer = nn.Linear(2, 2)
+        calls = []
+        h = layer.register_forward_post_hook(
+            lambda l, i, o: calls.append(1))
+        layer(paddle_tpu.ones([1, 2]))
+        assert calls
+        h.remove()
+        layer(paddle_tpu.ones([1, 2]))
+        assert len(calls) == 1
+
+    def test_layerlist(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4
+        assert len(list(ll)) == 4
+        assert len(ll.parameters()) == 8
+
+
+class TestCommonLayers:
+    def test_linear_matches_numpy(self):
+        layer = nn.Linear(4, 3)
+        x = rng.rand(2, 4).astype(np.float32)
+        out = layer(paddle_tpu.to_tensor(x))
+        ref = x @ layer.weight.numpy() + layer.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        idx = paddle_tpu.to_tensor(np.array([[1, 2], [3, 4]]))
+        out = emb(idx)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0],
+                                   emb.weight.numpy()[1], rtol=1e-6)
+
+    def test_embedding_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle_tpu.to_tensor(np.array([0, 1])))
+        assert np.all(out.numpy()[0] == 0)
+
+    def test_embedding_grad_is_sparse_like(self):
+        emb = nn.Embedding(10, 4)
+        idx = paddle_tpu.to_tensor(np.array([1, 1, 2]))
+        out = emb(idx)
+        out.sum().backward()
+        g = emb.weight.grad.numpy()
+        assert np.all(g[1] == 2.0)
+        assert np.all(g[2] == 1.0)
+        assert np.all(g[3] == 0.0)
+
+    def test_dropout_train_eval(self):
+        d = nn.Dropout(0.5)
+        x = paddle_tpu.ones([1000])
+        out = d(x)
+        frac_zero = float((out.numpy() == 0).mean())
+        assert 0.3 < frac_zero < 0.7
+        # preserved expectation
+        assert abs(out.numpy().mean() - 1.0) < 0.2
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+    def test_flatten(self):
+        f = nn.Flatten()
+        out = f(paddle_tpu.ones([2, 3, 4]))
+        assert out.shape == [2, 12]
+
+    def test_pad2d(self):
+        p = nn.Pad2D([1, 1, 2, 2])
+        out = p(paddle_tpu.ones([1, 1, 4, 4]))
+        assert out.shape == [1, 1, 8, 6]
+
+
+class TestConv:
+    def test_conv2d_shape_and_value(self):
+        conv = nn.Conv2D(3, 8, 3, padding=1)
+        x = rng.rand(2, 3, 8, 8).astype(np.float32)
+        out = conv(paddle_tpu.to_tensor(x))
+        assert out.shape == [2, 8, 8, 8]
+
+    def test_conv2d_vs_manual(self):
+        # 1x1 conv == matmul over channels
+        conv = nn.Conv2D(3, 4, 1, bias_attr=False)
+        x = rng.rand(1, 3, 5, 5).astype(np.float32)
+        out = conv(paddle_tpu.to_tensor(x))
+        w = conv.weight.numpy().reshape(4, 3)
+        ref = np.einsum("oc,nchw->nohw", w, x)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_stride_padding(self):
+        conv = nn.Conv2D(1, 1, 3, stride=2, padding=1)
+        out = conv(paddle_tpu.ones([1, 1, 8, 8]))
+        assert out.shape == [1, 1, 4, 4]
+
+    def test_conv2d_groups(self):
+        conv = nn.Conv2D(4, 4, 3, padding=1, groups=4)
+        out = conv(paddle_tpu.ones([1, 4, 5, 5]))
+        assert out.shape == [1, 4, 5, 5]
+
+    def test_conv2d_grad(self):
+        conv = nn.Conv2D(2, 3, 3, padding=1)
+        x = paddle_tpu.to_tensor(rng.rand(1, 2, 4, 4).astype(np.float32),
+                                 stop_gradient=False)
+        out = conv(x)
+        out.sum().backward()
+        assert x.grad is not None
+        assert conv.weight.grad is not None
+        assert conv.weight.grad.shape == conv.weight.shape
+
+    def test_conv_transpose_inverts_shape(self):
+        convt = nn.Conv2DTranspose(3, 2, 4, stride=2, padding=1)
+        out = convt(paddle_tpu.ones([1, 3, 8, 8]))
+        assert out.shape == [1, 2, 16, 16]
+
+    def test_conv1d(self):
+        conv = nn.Conv1D(2, 4, 3, padding=1)
+        out = conv(paddle_tpu.ones([1, 2, 10]))
+        assert out.shape == [1, 4, 10]
+
+
+class TestPooling:
+    def test_max_pool(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = F.max_pool2d(paddle_tpu.to_tensor(x), 2, 2)
+        np.testing.assert_array_equal(out.numpy().reshape(2, 2),
+                                      [[5, 7], [13, 15]])
+
+    def test_avg_pool(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = F.avg_pool2d(paddle_tpu.to_tensor(x), 2, 2)
+        np.testing.assert_allclose(out.numpy().reshape(2, 2),
+                                   [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_avg_pool_padding_exclusive(self):
+        x = np.ones((1, 1, 2, 2), np.float32)
+        out = F.avg_pool2d(paddle_tpu.to_tensor(x), 2, 2, padding=1)
+        # exclusive: padded cells not counted -> all ones
+        np.testing.assert_allclose(out.numpy().reshape(-1),
+                                   np.ones(4), rtol=1e-6)
+
+    def test_adaptive_avg_pool(self):
+        x = rng.rand(1, 2, 6, 6).astype(np.float32)
+        out = F.adaptive_avg_pool2d(paddle_tpu.to_tensor(x), 2)
+        assert out.shape == [1, 2, 2, 2]
+        np.testing.assert_allclose(
+            out.numpy()[0, 0, 0, 0], x[0, 0, :3, :3].mean(), rtol=1e-5)
+
+    def test_adaptive_nondivisible(self):
+        x = rng.rand(1, 1, 5, 7).astype(np.float32)
+        out = F.adaptive_avg_pool2d(paddle_tpu.to_tensor(x), 3)
+        assert out.shape == [1, 1, 3, 3]
+
+    def test_global_pool_grad(self):
+        x = paddle_tpu.to_tensor(rng.rand(1, 1, 4, 4).astype(np.float32),
+                                 stop_gradient=False)
+        out = F.avg_pool2d(x, 4)
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   np.full((1, 1, 4, 4), 1 / 16),
+                                   rtol=1e-5)
+
+
+class TestNorm:
+    def test_batch_norm_train_normalizes(self):
+        bn = nn.BatchNorm2D(3)
+        x = rng.rand(4, 3, 5, 5).astype(np.float32) * 3 + 2
+        out = bn(paddle_tpu.to_tensor(x))
+        o = out.numpy()
+        assert abs(o.mean()) < 1e-4
+        assert abs(o.std() - 1.0) < 1e-2
+
+    def test_batch_norm_updates_running_stats(self):
+        bn = nn.BatchNorm2D(2, momentum=0.5)
+        x = rng.rand(4, 2, 3, 3).astype(np.float32) + 5.0
+        before = bn._mean.numpy().copy()
+        bn(paddle_tpu.to_tensor(x))
+        after = bn._mean.numpy()
+        assert not np.allclose(before, after)
+
+    def test_batch_norm_eval_uses_running(self):
+        bn = nn.BatchNorm2D(2)
+        bn.eval()
+        x = rng.rand(2, 2, 3, 3).astype(np.float32)
+        out = bn(paddle_tpu.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), x, rtol=1e-3, atol=1e-3)
+
+    def test_layer_norm_matches_numpy(self):
+        ln = nn.LayerNorm(8)
+        x = rng.rand(2, 4, 8).astype(np.float32)
+        out = ln(paddle_tpu.to_tensor(x))
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        ref = (x - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_group_norm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = rng.rand(2, 4, 3, 3).astype(np.float32)
+        out = gn(paddle_tpu.to_tensor(x))
+        assert out.shape == [2, 4, 3, 3]
+
+    def test_bn_grad(self):
+        bn = nn.BatchNorm1D(3)
+        x = paddle_tpu.to_tensor(rng.rand(4, 3).astype(np.float32),
+                                 stop_gradient=False)
+        out = bn(x)
+        (out * out).sum().backward()
+        assert x.grad is not None
+        assert bn.weight.grad is not None
+
+
+class TestActivationsAndLosses:
+    def test_softmax_sums_to_one(self):
+        x = rng.rand(3, 5).astype(np.float32)
+        out = F.softmax(paddle_tpu.to_tensor(x))
+        np.testing.assert_allclose(out.numpy().sum(-1), np.ones(3),
+                                   rtol=1e-5)
+
+    def test_cross_entropy_matches_numpy(self):
+        logits = rng.rand(4, 7).astype(np.float32)
+        labels = np.array([1, 2, 0, 6])
+        loss = F.cross_entropy(paddle_tpu.to_tensor(logits),
+                               paddle_tpu.to_tensor(labels))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = rng.rand(4, 3).astype(np.float32)
+        labels = np.array([0, 1, -100, 2])
+        loss = F.cross_entropy(paddle_tpu.to_tensor(logits),
+                               paddle_tpu.to_tensor(labels),
+                               ignore_index=-100)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        keep = [0, 1, 3]
+        ref = -np.log(p[keep, labels[keep]]).mean()
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        logits = rng.rand(2, 4).astype(np.float32)
+        soft = np.full((2, 4), 0.25, np.float32)
+        loss = F.cross_entropy(paddle_tpu.to_tensor(logits),
+                               paddle_tpu.to_tensor(soft), soft_label=True)
+        assert loss.size == 1
+
+    def test_ce_grad(self):
+        logits = paddle_tpu.to_tensor(rng.rand(3, 5).astype(np.float32),
+                                      stop_gradient=False)
+        labels = paddle_tpu.to_tensor(np.array([0, 1, 2]))
+        loss = F.cross_entropy(logits, labels)
+        loss.backward()
+        g = logits.grad.numpy()
+        # grad = (softmax - onehot)/N
+        e = np.exp(logits.numpy() - logits.numpy().max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        oh = np.eye(5)[[0, 1, 2]]
+        np.testing.assert_allclose(g, (p - oh) / 3, rtol=1e-4, atol=1e-5)
+
+    def test_mse_l1(self):
+        a = rng.rand(3, 2).astype(np.float32)
+        b = rng.rand(3, 2).astype(np.float32)
+        np.testing.assert_allclose(
+            F.mse_loss(paddle_tpu.to_tensor(a),
+                       paddle_tpu.to_tensor(b)).numpy(),
+            ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.l1_loss(paddle_tpu.to_tensor(a),
+                      paddle_tpu.to_tensor(b)).numpy(),
+            np.abs(a - b).mean(), rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        x = rng.randn(4).astype(np.float32)
+        t = (rng.rand(4) > 0.5).astype(np.float32)
+        out = F.binary_cross_entropy_with_logits(
+            paddle_tpu.to_tensor(x), paddle_tpu.to_tensor(t))
+        p = 1 / (1 + np.exp(-x))
+        ref = -(t * np.log(p) + (1 - t) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
+
+    def test_kl_div(self):
+        logp = np.log(np.full((2, 3), 1 / 3, np.float32))
+        t = np.full((2, 3), 1 / 3, np.float32)
+        out = F.kl_div(paddle_tpu.to_tensor(logp), paddle_tpu.to_tensor(t))
+        np.testing.assert_allclose(out.numpy(), 0.0, atol=1e-6)
+
+    @pytest.mark.parametrize("act", ["relu", "gelu", "silu", "tanh",
+                                     "sigmoid", "leaky_relu", "elu",
+                                     "hardswish", "softplus", "mish"])
+    def test_activation_shapes_and_grad(self, act):
+        x = paddle_tpu.to_tensor(rng.randn(3, 4).astype(np.float32),
+                                 stop_gradient=False)
+        out = getattr(F, act)(x)
+        assert out.shape == [3, 4]
+        out.sum().backward()
+        assert x.grad is not None
+
+
+class TestAttention:
+    def test_sdpa_matches_reference(self):
+        b, s, h, d = 2, 8, 2, 4
+        q = rng.rand(b, s, h, d).astype(np.float32)
+        k = rng.rand(b, s, h, d).astype(np.float32)
+        v = rng.rand(b, s, h, d).astype(np.float32)
+        out = F.scaled_dot_product_attention(
+            paddle_tpu.to_tensor(q), paddle_tpu.to_tensor(k),
+            paddle_tpu.to_tensor(v))
+        # numpy reference
+        qh = q.transpose(0, 2, 1, 3)
+        kh = k.transpose(0, 2, 1, 3)
+        vh = v.transpose(0, 2, 1, 3)
+        sc = qh @ kh.transpose(0, 1, 3, 2) / np.sqrt(d)
+        e = np.exp(sc - sc.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = (p @ vh).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_sdpa_causal(self):
+        b, s, h, d = 1, 4, 1, 4
+        q = rng.rand(b, s, h, d).astype(np.float32)
+        k = rng.rand(b, s, h, d).astype(np.float32)
+        v = rng.rand(b, s, h, d).astype(np.float32)
+        out = F.scaled_dot_product_attention(
+            paddle_tpu.to_tensor(q), paddle_tpu.to_tensor(k),
+            paddle_tpu.to_tensor(v), is_causal=True)
+        # first position attends only to itself
+        np.testing.assert_allclose(out.numpy()[0, 0, 0], v[0, 0, 0],
+                                   rtol=1e-5)
+
+    def test_multihead_attention_layer(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle_tpu.to_tensor(rng.rand(2, 6, 16).astype(np.float32))
+        out = mha(x, x, x)
+        assert out.shape == [2, 6, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle_tpu.to_tensor(rng.rand(2, 5, 16).astype(np.float32))
+        out = enc(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_full(self):
+        model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32,
+                               dropout=0.0)
+        src = paddle_tpu.to_tensor(rng.rand(2, 4, 16).astype(np.float32))
+        tgt = paddle_tpu.to_tensor(rng.rand(2, 3, 16).astype(np.float32))
+        out = model(src, tgt)
+        assert out.shape == [2, 3, 16]
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(8, 16, num_layers=2)
+        x = paddle_tpu.to_tensor(rng.rand(3, 5, 8).astype(np.float32))
+        out, (h, c) = lstm(x)
+        assert out.shape == [3, 5, 16]
+        assert h.shape == [2, 3, 16]
+        assert c.shape == [2, 3, 16]
+
+    def test_gru_bidirectional(self):
+        gru = nn.GRU(4, 6, direction="bidirect")
+        x = paddle_tpu.to_tensor(rng.rand(2, 7, 4).astype(np.float32))
+        out, h = gru(x)
+        assert out.shape == [2, 7, 12]
+        assert h.shape == [2, 2, 6]
+
+    def test_lstm_grad(self):
+        lstm = nn.LSTM(4, 5)
+        x = paddle_tpu.to_tensor(rng.rand(2, 3, 4).astype(np.float32),
+                                 stop_gradient=False)
+        out, _ = lstm(x)
+        out.sum().backward()
+        assert x.grad is not None
+        assert lstm._parameters["weight_ih_l0"].grad is not None
+
+    def test_lstm_cell(self):
+        cell = nn.LSTMCell(4, 6)
+        x = paddle_tpu.to_tensor(rng.rand(2, 4).astype(np.float32))
+        h, (hn, cn) = cell(x)
+        assert h.shape == [2, 6]
+
+
+class TestClip:
+    def test_clip_by_global_norm(self):
+        from paddle_tpu.nn import ClipGradByGlobalNorm
+        clip = ClipGradByGlobalNorm(1.0)
+        p = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+        g = paddle_tpu.to_tensor([3.0, 4.0])
+        out = clip([(p, g)])
+        np.testing.assert_allclose(
+            np.linalg.norm(out[0][1].numpy()), 1.0, rtol=1e-5)
+
+    def test_clip_by_value(self):
+        from paddle_tpu.nn import ClipGradByValue
+        clip = ClipGradByValue(0.5)
+        p = paddle_tpu.to_tensor([1.0])
+        g = paddle_tpu.to_tensor([2.0, -2.0])
+        out = clip([(p, g)])
+        np.testing.assert_array_equal(out[0][1].numpy(), [0.5, -0.5])

@@ -1,0 +1,250 @@
+"""Op correctness vs numpy + finite-difference grads (OpTest pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu
+from op_test import check_forward, check_grad
+
+rng = np.random.RandomState(42)
+
+
+class TestUnaryOps:
+    @pytest.mark.parametrize("name,np_fn", [
+        ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+        ("tanh", np.tanh), ("sin", np.sin), ("cos", np.cos),
+        ("abs", np.abs), ("floor", np.floor), ("ceil", np.ceil),
+        ("square", np.square), ("sign", np.sign),
+    ])
+    def test_forward(self, name, np_fn):
+        x = rng.rand(3, 4).astype(np.float32) + 0.5
+        check_forward(getattr(paddle_tpu, name), lambda a: np_fn(a), [x],
+                      rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("name", ["exp", "log", "sqrt", "tanh",
+                                      "sigmoid", "square"])
+    def test_grad(self, name):
+        x = rng.rand(2, 3).astype(np.float32) + 0.5
+        check_grad(getattr(paddle_tpu, name), [x])
+
+
+class TestBinaryOps:
+    @pytest.mark.parametrize("name,np_fn", [
+        ("add", np.add), ("subtract", np.subtract),
+        ("multiply", np.multiply), ("divide", np.divide),
+        ("maximum", np.maximum), ("minimum", np.minimum),
+    ])
+    def test_forward(self, name, np_fn):
+        x = rng.rand(3, 4).astype(np.float32) + 0.5
+        y = rng.rand(3, 4).astype(np.float32) + 0.5
+        check_forward(getattr(paddle_tpu, name), np_fn, [x, y])
+
+    def test_broadcast(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(4).astype(np.float32)
+        check_forward(paddle_tpu.add, np.add, [x, y])
+
+    @pytest.mark.parametrize("wrt", [0, 1])
+    def test_mul_grad(self, wrt):
+        x = rng.rand(2, 3).astype(np.float32) + 0.5
+        y = rng.rand(2, 3).astype(np.float32) + 0.5
+        check_grad(paddle_tpu.multiply, [x, y], wrt=wrt)
+
+
+class TestReductions:
+    def test_sum_axes(self):
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        check_forward(paddle_tpu.sum, lambda a: np.sum(a), [x])
+        np.testing.assert_allclose(
+            paddle_tpu.sum(paddle_tpu.to_tensor(x), axis=1).numpy(),
+            x.sum(1), rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle_tpu.sum(paddle_tpu.to_tensor(x), axis=[0, 2],
+                           keepdim=True).numpy(),
+            x.sum((0, 2), keepdims=True), rtol=1e-6)
+
+    def test_mean_max_min_prod(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        t = paddle_tpu.to_tensor(x)
+        np.testing.assert_allclose(paddle_tpu.mean(t).numpy(), x.mean(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(paddle_tpu.max(t, axis=1).numpy(),
+                                   x.max(1))
+        np.testing.assert_allclose(paddle_tpu.min(t).numpy(), x.min())
+        np.testing.assert_allclose(paddle_tpu.prod(t, axis=0).numpy(),
+                                   x.prod(0), rtol=1e-5)
+
+    def test_var_std(self):
+        x = rng.rand(5, 6).astype(np.float32)
+        t = paddle_tpu.to_tensor(x)
+        np.testing.assert_allclose(paddle_tpu.var(t).numpy(),
+                                   x.var(ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle_tpu.std(t, unbiased=False).numpy(), x.std(), rtol=1e-5)
+
+    def test_mean_grad(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        check_grad(paddle_tpu.mean, [x])
+
+    def test_logsumexp(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        from scipy.special import logsumexp as np_lse
+        np.testing.assert_allclose(
+            paddle_tpu.logsumexp(paddle_tpu.to_tensor(x), axis=1).numpy(),
+            np_lse(x, axis=1), rtol=1e-5)
+
+
+class TestMatmul:
+    def test_2d(self):
+        a = rng.rand(3, 4).astype(np.float32)
+        b = rng.rand(4, 5).astype(np.float32)
+        check_forward(paddle_tpu.matmul, np.matmul, [a, b], rtol=1e-4)
+
+    def test_batched(self):
+        a = rng.rand(2, 3, 4).astype(np.float32)
+        b = rng.rand(2, 4, 5).astype(np.float32)
+        check_forward(paddle_tpu.bmm, np.matmul, [a, b], rtol=1e-4)
+
+    def test_transpose_flags(self):
+        a = rng.rand(4, 3).astype(np.float32)
+        b = rng.rand(5, 4).astype(np.float32)
+        out = paddle_tpu.matmul(paddle_tpu.to_tensor(a),
+                                paddle_tpu.to_tensor(b),
+                                transpose_x=True, transpose_y=True)
+        np.testing.assert_allclose(out.numpy(), a.T @ b.T, rtol=1e-4)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        t = paddle_tpu.to_tensor(x)
+        assert paddle_tpu.reshape(t, [4, 6]).shape == [4, 6]
+        assert paddle_tpu.reshape(t, [-1, 12]).shape == [2, 12]
+        np.testing.assert_array_equal(
+            paddle_tpu.transpose(t, [2, 0, 1]).numpy(),
+            x.transpose(2, 0, 1))
+
+    def test_concat_stack_split(self):
+        a = rng.rand(2, 3).astype(np.float32)
+        b = rng.rand(2, 3).astype(np.float32)
+        ta, tb = paddle_tpu.to_tensor(a), paddle_tpu.to_tensor(b)
+        np.testing.assert_array_equal(
+            paddle_tpu.concat([ta, tb], axis=0).numpy(),
+            np.concatenate([a, b], 0))
+        np.testing.assert_array_equal(
+            paddle_tpu.stack([ta, tb], axis=1).numpy(),
+            np.stack([a, b], 1))
+        parts = paddle_tpu.split(paddle_tpu.to_tensor(a), [1, 2], axis=1)
+        assert parts[0].shape == [2, 1] and parts[1].shape == [2, 2]
+        parts2 = paddle_tpu.split(paddle_tpu.to_tensor(a), [1, -1], axis=1)
+        assert parts2[1].shape == [2, 2]
+
+    def test_squeeze_unsqueeze_flatten(self):
+        x = rng.rand(1, 3, 1, 4).astype(np.float32)
+        t = paddle_tpu.to_tensor(x)
+        assert paddle_tpu.squeeze(t, axis=0).shape == [3, 1, 4]
+        assert paddle_tpu.unsqueeze(t, axis=0).shape == [1, 1, 3, 1, 4]
+        assert paddle_tpu.flatten(t).shape == [12]
+        assert paddle_tpu.flatten(t, 1, 2).shape == [1, 3, 4]
+
+    def test_gather_scatter(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        idx = np.array([0, 2])
+        t = paddle_tpu.to_tensor(x)
+        np.testing.assert_array_equal(
+            paddle_tpu.gather(t, paddle_tpu.to_tensor(idx)).numpy(),
+            x[[0, 2]])
+        upd = np.ones((2, 3), np.float32)
+        out = paddle_tpu.scatter(t, paddle_tpu.to_tensor(idx),
+                                 paddle_tpu.to_tensor(upd))
+        expect = x.copy()
+        expect[[0, 2]] = 1.0
+        np.testing.assert_array_equal(out.numpy(), expect)
+
+    def test_gather_nd(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        idx = np.array([[0, 1], [1, 2]])
+        out = paddle_tpu.gather_nd(paddle_tpu.to_tensor(x),
+                                   paddle_tpu.to_tensor(idx))
+        np.testing.assert_array_equal(out.numpy(), x[[0, 1], [1, 2]])
+
+    def test_where(self):
+        c = np.array([True, False, True])
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([9.0, 8.0, 7.0], np.float32)
+        out = paddle_tpu.where(paddle_tpu.to_tensor(c),
+                               paddle_tpu.to_tensor(a),
+                               paddle_tpu.to_tensor(b))
+        np.testing.assert_array_equal(out.numpy(), [1, 8, 3])
+
+    def test_topk_argsort(self):
+        x = np.array([[3.0, 1.0, 2.0], [5.0, 6.0, 4.0]], np.float32)
+        vals, idx = paddle_tpu.topk(paddle_tpu.to_tensor(x), k=2)
+        np.testing.assert_array_equal(vals.numpy(), [[3, 2], [6, 5]])
+        np.testing.assert_array_equal(idx.numpy(), [[0, 2], [1, 0]])
+        order = paddle_tpu.argsort(paddle_tpu.to_tensor(x), axis=1)
+        np.testing.assert_array_equal(order.numpy(),
+                                      np.argsort(x, axis=1))
+
+    def test_tile_expand(self):
+        x = np.array([[1.0, 2.0]], np.float32)
+        t = paddle_tpu.to_tensor(x)
+        assert paddle_tpu.tile(t, [2, 3]).shape == [2, 6]
+        assert paddle_tpu.expand(t, [4, 2]).shape == [4, 2]
+        assert paddle_tpu.expand(t, [4, -1]).shape == [4, 2]
+
+    def test_one_hot_unique(self):
+        x = np.array([0, 2, 1, 2])
+        oh = paddle_tpu.one_hot(paddle_tpu.to_tensor(x), 3)
+        assert oh.shape == [4, 3]
+        assert oh.numpy().sum() == 4
+        u = paddle_tpu.unique(paddle_tpu.to_tensor(x))
+        np.testing.assert_array_equal(u.numpy(), [0, 1, 2])
+
+    def test_shard_index(self):
+        x = np.array([[1], [6], [11]])
+        out = paddle_tpu.ops.shard_index(
+            paddle_tpu.to_tensor(x), index_num=12, nshards=3, shard_id=1)
+        np.testing.assert_array_equal(out.numpy(), [[-1], [2], [-1]])
+
+    def test_einsum(self):
+        a = rng.rand(2, 3).astype(np.float32)
+        b = rng.rand(3, 4).astype(np.float32)
+        out = paddle_tpu.ops.einsum("ij,jk->ik", paddle_tpu.to_tensor(a),
+                                    paddle_tpu.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+class TestLinalg:
+    def test_norm(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        t = paddle_tpu.to_tensor(x)
+        np.testing.assert_allclose(paddle_tpu.linalg.norm(t).numpy(),
+                                   np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle_tpu.linalg.norm(t, p=1, axis=1).numpy(),
+            np.abs(x).sum(1), rtol=1e-5)
+
+    def test_cholesky_solve(self):
+        a = rng.rand(3, 3).astype(np.float32)
+        spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+        L = paddle_tpu.linalg.cholesky(paddle_tpu.to_tensor(spd))
+        np.testing.assert_allclose(L.numpy() @ L.numpy().T, spd, rtol=1e-4,
+                                   atol=1e-4)
+        b = rng.rand(3, 2).astype(np.float32)
+        x = paddle_tpu.linalg.solve(paddle_tpu.to_tensor(spd),
+                                    paddle_tpu.to_tensor(b))
+        np.testing.assert_allclose(spd @ x.numpy(), b, rtol=1e-3,
+                                   atol=1e-3)
+
+
+class TestClipCumsum:
+    def test_clip(self):
+        x = np.array([-2.0, 0.5, 3.0], np.float32)
+        out = paddle_tpu.clip(paddle_tpu.to_tensor(x), -1.0, 1.0)
+        np.testing.assert_array_equal(out.numpy(), [-1, 0.5, 1])
+
+    def test_cumsum(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle_tpu.cumsum(paddle_tpu.to_tensor(x), axis=1).numpy(),
+            np.cumsum(x, 1), rtol=1e-5)

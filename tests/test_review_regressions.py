@@ -1,0 +1,84 @@
+"""Regression tests for review findings (round 1 code review)."""
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import nn, optimizer
+import paddle_tpu.nn.functional as F
+
+
+def test_inplace_reshape_keeps_grad_chain():
+    x = paddle_tpu.to_tensor(np.ones((2, 3), np.float32),
+                             stop_gradient=False)
+    y = x * 2
+    y.reshape_([3, 2])
+    y.sum().backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(x.grad.numpy(), np.full((2, 3), 2.0))
+
+
+def test_setitem_keeps_grad_chain():
+    x = paddle_tpu.to_tensor(np.ones((3,), np.float32),
+                             stop_gradient=False)
+    z = x * 3.0
+    z[0] = 0.0
+    z.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0, 3, 3])
+
+
+def test_lamb_exclude_from_weight_decay():
+    p = nn.Linear(2, 2, bias_attr=False)
+    opt = optimizer.Lamb(learning_rate=0.0, lamb_weight_decay=0.5,
+                         parameters=p.parameters(),
+                         exclude_from_weight_decay_fn=lambda pp: True)
+    p.weight.grad = paddle_tpu.zeros([2, 2])
+    w0 = p.weight.numpy().copy()
+    opt.step()
+    np.testing.assert_allclose(p.weight.numpy(), w0)
+
+
+def test_split_non_divisible_raises():
+    with pytest.raises(ValueError):
+        paddle_tpu.split(paddle_tpu.arange(5), 2)
+
+
+def test_where_scalar_branches():
+    out = paddle_tpu.where(paddle_tpu.to_tensor([True, False]), 1.0, 0.0)
+    np.testing.assert_allclose(out.numpy(), [1, 0])
+
+
+def test_adamw_tree_path_honors_decay_mask():
+    import jax.numpy as jnp
+    opt = optimizer.AdamW(learning_rate=0.0, weight_decay=0.5,
+                          apply_decay_param_fun=lambda n: "w" in n)
+    params = {"w": jnp.ones((2,)), "norm_b": jnp.ones((2,))}
+    grads = {"w": jnp.zeros((2,)), "norm_b": jnp.zeros((2,))}
+    state = {k: opt._init_state(paddle_tpu.to_tensor(v))
+             for k, v in params.items()}
+    newp, _ = opt.apply_gradients_tree(params, grads, state, 0.1)
+    assert np.asarray(newp["w"])[0] < 1.0
+    np.testing.assert_allclose(np.asarray(newp["norm_b"]), 1.0)
+
+
+def test_instance_and_group_norm_weight_only():
+    x = paddle_tpu.to_tensor(np.random.rand(2, 3, 4, 4).astype(np.float32))
+    w = paddle_tpu.to_tensor(np.full(3, 2.0, np.float32))
+    np.testing.assert_allclose(
+        F.instance_norm(x, weight=w).numpy(),
+        F.instance_norm(x).numpy() * 2.0, rtol=1e-5)
+    np.testing.assert_allclose(
+        F.group_norm(x, 3, weight=w).numpy(),
+        F.group_norm(x, 3).numpy() * 2.0, rtol=1e-5)
+
+
+def test_max_pool_grad():
+    # regression: reduce_window max vjp needs -inf init
+    x = paddle_tpu.to_tensor(
+        np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4),
+        stop_gradient=False)
+    out = F.max_pool2d(x, 2, 2)
+    out.sum().backward()
+    g = x.grad.numpy().reshape(4, 4)
+    expect = np.zeros((4, 4))
+    expect[1, 1] = expect[1, 3] = expect[3, 1] = expect[3, 3] = 1.0
+    np.testing.assert_array_equal(g, expect)

@@ -1,0 +1,114 @@
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import Tensor
+
+
+def test_to_tensor_roundtrip():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    t = paddle_tpu.to_tensor(x)
+    assert t.shape == [3, 4]
+    assert t.dtype == "float32"
+    np.testing.assert_array_equal(t.numpy(), x)
+
+
+def test_default_dtype_f64_literal():
+    t = paddle_tpu.to_tensor([1.0, 2.0])
+    assert t.dtype == "float32"
+
+
+def test_int_dtype_preserved():
+    t = paddle_tpu.to_tensor(np.array([1, 2, 3]))
+    assert t.dtype in ("int64", "int32")
+
+
+def test_arithmetic_operators():
+    a = paddle_tpu.to_tensor([1.0, 2.0, 3.0])
+    b = paddle_tpu.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9], rtol=1e-5)
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose((2 + a).numpy(), [3, 4, 5])
+    np.testing.assert_allclose((10 - a).numpy(), [9, 8, 7])
+
+
+def test_comparisons():
+    a = paddle_tpu.to_tensor([1.0, 2.0, 3.0])
+    b = paddle_tpu.to_tensor([3.0, 2.0, 1.0])
+    np.testing.assert_array_equal((a < b).numpy(), [True, False, False])
+    np.testing.assert_array_equal((a == b).numpy(), [False, True, False])
+
+
+def test_indexing():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    t = paddle_tpu.to_tensor(x)
+    np.testing.assert_array_equal(t[1].numpy(), x[1])
+    np.testing.assert_array_equal(t[1:3, 2:4].numpy(), x[1:3, 2:4])
+    idx = paddle_tpu.to_tensor(np.array([0, 2]))
+    np.testing.assert_array_equal(t[idx].numpy(), x[[0, 2]])
+
+
+def test_setitem():
+    t = paddle_tpu.zeros([3, 3])
+    t[1, 1] = 5.0
+    assert t.numpy()[1, 1] == 5.0
+
+
+def test_item_and_scalar():
+    t = paddle_tpu.to_tensor(3.5)
+    assert t.item() == pytest.approx(3.5)
+    assert float(t) == pytest.approx(3.5)
+
+
+def test_astype_cast():
+    t = paddle_tpu.to_tensor([1.5, 2.5])
+    ti = t.astype("int32")
+    assert ti.dtype == "int32"
+
+
+def test_set_value_and_fill():
+    t = paddle_tpu.ones([2, 2])
+    t.set_value(np.full((2, 2), 7.0, np.float32))
+    assert t.numpy()[0, 0] == 7.0
+    t.zero_()
+    assert t.numpy().sum() == 0.0
+
+
+def test_clone_detach():
+    t = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+    c = t.detach()
+    assert c.stop_gradient
+    cl = t.clone()
+    np.testing.assert_array_equal(cl.numpy(), t.numpy())
+
+
+def test_creation_ops():
+    assert paddle_tpu.zeros([2, 3]).shape == [2, 3]
+    assert paddle_tpu.ones([2]).numpy().sum() == 2.0
+    assert paddle_tpu.full([2, 2], 3.0).numpy()[0, 0] == 3.0
+    ar = paddle_tpu.arange(0, 10, 2)
+    np.testing.assert_array_equal(ar.numpy(), [0, 2, 4, 6, 8])
+    ey = paddle_tpu.eye(3)
+    np.testing.assert_array_equal(ey.numpy(), np.eye(3, dtype=np.float32))
+    ls = paddle_tpu.linspace(0, 1, 5)
+    np.testing.assert_allclose(ls.numpy(), np.linspace(0, 1, 5),
+                               rtol=1e-6)
+
+
+def test_random_reproducible():
+    paddle_tpu.seed(7)
+    a = paddle_tpu.rand([4]).numpy()
+    paddle_tpu.seed(7)
+    b = paddle_tpu.rand([4]).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_randperm_and_randint():
+    p = paddle_tpu.randperm(10)
+    assert sorted(p.tolist()) == list(range(10))
+    r = paddle_tpu.randint(0, 5, [100])
+    assert r.numpy().min() >= 0 and r.numpy().max() < 5
