@@ -1,0 +1,79 @@
+"""Benchmark: GPT-2 345M training throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": "tokens/sec/chip (GPT-2 345M train)", "value": N,
+   "unit": "tokens/s", "vs_baseline": N}
+
+vs_baseline is measured against the BASELINE.md north-star: >=70% of A100
+step-time throughput.  No number is published in the reference repo
+(BASELINE.json.published == {}), so the A100 anchor is taken as 40k
+tokens/s/chip for GPT-2 345M mixed-precision training (Megatron-class
+implementations on A100-40GB); target = 0.7 * 40000 = 28000 tokens/s.
+vs_baseline = measured / 28000.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+A100_ANCHOR_TOKENS_PER_SEC = 40000.0
+TARGET = 0.7 * A100_ANCHOR_TOKENS_PER_SEC
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models import GPTModel, GPTPretrainingCriterion
+    from paddle_tpu.parallel.train_step import TrainStep
+
+    on_tpu = jax.default_backend() != "cpu"
+    if on_tpu:
+        batch, seq, cfg, steps = 8, 1024, "gpt2-medium", 20
+    else:  # CPU smoke fallback so the script always emits a line
+        batch, seq, cfg, steps = 2, 128, "tiny", 3
+
+    paddle.seed(0)
+    model = GPTModel.from_config(cfg, dropout=0.1)
+    # bf16 params: MXU-native storage/compute; optimizer keeps f32 moments
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    crit = GPTPretrainingCriterion()
+    opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                          parameters=model.parameters())
+    step = TrainStep(model, opt, loss_fn=crit)
+
+    rng = np.random.RandomState(0)
+    vocab = 50304 if cfg != "tiny" else 128
+    ids = rng.randint(0, vocab, (batch, seq + 1)).astype(np.int32)
+    x, y = ids[:, :-1], ids[:, 1:]
+
+    # warmup (compile)
+    loss = step.step([x], [y])
+    loss.numpy()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step.step([x], [y])
+    loss.numpy()  # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    result = {
+        "metric": "tokens/sec/chip (GPT-2 345M train)"
+        if on_tpu else "tokens/sec/chip (GPT tiny, CPU smoke)",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / TARGET, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

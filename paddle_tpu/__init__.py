@@ -66,6 +66,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 
 # -- subsystems ----------------------------------------------------------
 from . import nn  # noqa: E402,F401
+from . import models  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
 from . import amp  # noqa: E402,F401
 from . import io  # noqa: E402,F401

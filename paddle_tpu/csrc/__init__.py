@@ -1,0 +1,115 @@
+"""Native components (C++), loaded via ctypes with pure-Python fallback.
+
+Reference parity: the reference's C++ data-path machinery
+(``framework/blocking_queue.h``, ``operators/reader/blocking_queue.h``,
+``buffered_reader.cc``).  ``NativeOrderedQueue`` backs the DataLoader's
+worker→consumer handoff when libptq.so is built (``make -C
+paddle_tpu/csrc``); otherwise the loader uses queue.Queue transparently.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB = None
+_TRIED = False
+
+
+def _lib_path():
+    return os.path.join(os.path.dirname(__file__), "libptq.so")
+
+
+def load(build_if_missing=True):
+    """Load (building on first use) the native queue library, or None."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    path = _lib_path()
+    if not os.path.exists(path) and build_if_missing:
+        try:
+            subprocess.run(["make", "-C", os.path.dirname(__file__)],
+                           check=True, capture_output=True, timeout=120)
+        except Exception:
+            return None
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.ptq_new.restype = ctypes.c_void_p
+    lib.ptq_new.argtypes = [ctypes.c_int64, ctypes.c_int]
+    lib.ptq_put.restype = ctypes.c_int
+    lib.ptq_put.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                            ctypes.c_void_p, ctypes.c_int64]
+    lib.ptq_get.restype = ctypes.c_int
+    lib.ptq_get.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                            ctypes.POINTER(ctypes.c_int64),
+                            ctypes.POINTER(ctypes.c_void_p),
+                            ctypes.POINTER(ctypes.c_int64)]
+    lib.ptq_close.argtypes = [ctypes.c_void_p]
+    lib.ptq_size.restype = ctypes.c_int64
+    lib.ptq_size.argtypes = [ctypes.c_void_p]
+    lib.ptq_free.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return _LIB
+
+
+class NativeOrderedQueue:
+    """Bounded MPMC queue that re-orders by sequence number in native code.
+
+    Payloads are Python objects held in a registry; the native side moves
+    only (seq, slot-id) — the mutex handoff happens outside the GIL.
+    """
+
+    def __init__(self, capacity=8):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("libptq.so unavailable")
+        self._q = ctypes.c_void_p(self._lib.ptq_new(capacity, 1))
+        self._store = {}
+        self._store_lock = threading.Lock()
+        self._next_slot = [0]
+
+    def put(self, seq, obj):
+        with self._store_lock:
+            slot = self._next_slot[0]
+            self._next_slot[0] += 1
+            self._store[slot] = obj
+        rc = self._lib.ptq_put(self._q, seq, ctypes.c_void_p(slot + 1), 0)
+        if rc != 0:
+            with self._store_lock:
+                self._store.pop(slot, None)
+            raise RuntimeError("queue closed")
+
+    def get(self, timeout_ms=-1):
+        seq = ctypes.c_int64()
+        data = ctypes.c_void_p()
+        length = ctypes.c_int64()
+        rc = self._lib.ptq_get(self._q, timeout_ms, ctypes.byref(seq),
+                               ctypes.byref(data), ctypes.byref(length))
+        if rc == -1:
+            raise StopIteration
+        if rc == -2:
+            raise TimeoutError
+        slot = (data.value or 1) - 1
+        with self._store_lock:
+            obj = self._store.pop(slot)
+        return seq.value, obj
+
+    def close(self):
+        self._lib.ptq_close(self._q)
+
+    def __del__(self):
+        try:
+            self._lib.ptq_close(self._q)
+            self._lib.ptq_free(self._q)
+        except Exception:
+            pass
+
+
+def available() -> bool:
+    return load() is not None

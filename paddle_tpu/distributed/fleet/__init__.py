@@ -29,6 +29,7 @@ from .role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker, Role
 from .. import mesh as mesh_mod
 from ..parallel import get_rank, get_world_size
 from . import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
 
 _fleet_state = {
     "initialized": False,

@@ -1,0 +1,61 @@
+"""fleet.utils — recompute (activation checkpointing).
+
+Reference parity: ``paddle.distributed.fleet.utils.recompute`` (dygraph) and
+the static RecomputeOptimizer (``fluid/backward.py:725`` — re-forward of
+checkpoint segments in the grad program).
+
+TPU-native design: ``jax.checkpoint`` (remat) on the block's pure function.
+Inside a traced train step (the only place it matters) the block's params
+are read from the Layer (they hold tracers there), closed into a pure
+function, and remat'd — XLA then recomputes the segment in backward instead
+of stashing activations, trading FLOPs for HBM exactly like the reference's
+checkpoint segments.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...core.tensor import Tensor
+from ...core import autograd
+
+
+def _owning_layer(function):
+    from ...nn.layer.base import Layer
+    if isinstance(function, Layer):
+        return function, function.__call__
+    owner = getattr(function, "__self__", None)
+    if isinstance(owner, Layer):
+        return owner, function
+    return None, function
+
+
+def recompute(function, *args, **kwargs):
+    """Run `function(*args)` so its activations are rematerialized in
+    backward.  `function` must be a Layer or a bound method of a Layer."""
+    layer, call = _owning_layer(function)
+    arrays = [a._data if isinstance(a, Tensor) else a for a in args]
+    traced = any(isinstance(a, jax.core.Tracer) for a in arrays)
+    if layer is None or not traced:
+        # eager (or stateless fn): no memory to save — run directly
+        return function(*args, **kwargs)
+
+    params = dict(layer.named_parameters())
+    pnames = sorted(params)
+    p_arrays = [params[k]._data for k in pnames]
+
+    @jax.checkpoint
+    def pure(p_list, in_list):
+        saved = [params[k]._data for k in pnames]
+        try:
+            for k, a in zip(pnames, p_list):
+                params[k]._data = a
+            wrapped = [Tensor(a) if hasattr(a, "dtype") else a
+                       for a in in_list]
+            out = call(*wrapped, **kwargs)
+        finally:
+            for k, s in zip(pnames, saved):
+                params[k]._data = s
+        return out._data if isinstance(out, Tensor) else out
+
+    out = pure(p_arrays, arrays)
+    return Tensor(out) if hasattr(out, "dtype") else out

@@ -123,6 +123,8 @@ def ring_attention(query, key, value, axis="sp", causal=False, scale=None,
         return Tensor(_reference_attention(q, k, v, None, scale, causal))
 
     spec = P(None, axis, None, None)
+    sharding = jax.sharding.NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(a, sharding) for a in (q, k, v))
     fn = shard_map(
         functools.partial(_ring_attention_local, axis=axis, causal=causal,
                           scale=scale),
@@ -163,6 +165,8 @@ def ulysses_attention(query, key, value, axis="sp", causal=False,
         return head2seq(out)
 
     spec = P(None, axis, None, None)
+    sharding = jax.sharding.NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(a, sharding) for a in (q, k, v))
     fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                    out_specs=spec, check_vma=False)
     return Tensor(fn(q, k, v))

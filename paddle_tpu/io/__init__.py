@@ -279,26 +279,32 @@ def default_collate_fn(batch):
 
 
 class _PrefetchIter:
-    """Worker threads fill a bounded queue (reference: the blocking-queue +
-    buffered_reader double-buffer pipeline)."""
+    """Worker threads fill a bounded ordered queue (reference: the
+    blocking-queue + buffered_reader double-buffer pipeline).  Uses the
+    native C++ queue (paddle_tpu/csrc) when built — sequence reordering and
+    the producer/consumer handoff then run outside the GIL — with a
+    queue.Queue fallback otherwise."""
 
     def __init__(self, loader, batches):
         self.loader = loader
         self.batches = batches
-        self.queue = queue.Queue(maxsize=max(2, loader.prefetch_factor))
-        self.out_queue = queue.Queue()
+        capacity = max(2, loader.prefetch_factor * max(
+            loader.num_workers, 1))
+        self._native = None
+        try:
+            from ..csrc import NativeOrderedQueue
+            self._native = NativeOrderedQueue(capacity)
+        except Exception:
+            self.queue = queue.Queue(maxsize=capacity)
         self._stop = threading.Event()
         self._threads = []
-        self._seq = 0
-        n_workers = loader.num_workers
         self._index_q = queue.Queue()
         for i, b in enumerate(batches):
             self._index_q.put((i, b))
         self._total = len(batches)
         self._results = {}
         self._next_emit = 0
-        self._lock = threading.Lock()
-        for _ in range(n_workers):
+        for _ in range(loader.num_workers):
             t = threading.Thread(target=self._worker, daemon=True)
             t.start()
             self._threads.append(t)
@@ -314,7 +320,13 @@ class _PrefetchIter:
                 data = self.loader.collate_fn(samples)
             except Exception as e:  # propagate to consumer
                 data = e
-            self.queue.put((i, data))
+            if self._native is not None:
+                try:
+                    self._native.put(i, data)
+                except RuntimeError:
+                    return
+            else:
+                self.queue.put((i, data))
 
     def __iter__(self):
         return self
@@ -322,12 +334,19 @@ class _PrefetchIter:
     def __next__(self):
         if self._next_emit >= self._total:
             self._stop.set()
+            if self._native is not None:
+                self._native.close()
             raise StopIteration
-        while self._next_emit not in self._results:
-            i, data = self.queue.get()
-            self._results[i] = data
-        data = self._results.pop(self._next_emit)
-        self._next_emit += 1
+        if self._native is not None:
+            # native queue emits in sequence order already
+            _, data = self._native.get()
+            self._next_emit += 1
+        else:
+            while self._next_emit not in self._results:
+                i, data_i = self.queue.get()
+                self._results[i] = data_i
+            data = self._results.pop(self._next_emit)
+            self._next_emit += 1
         if isinstance(data, Exception):
             self._stop.set()
             raise data

@@ -1,0 +1,12 @@
+"""Model zoo: language models (GPT/BERT) + vision re-exports."""
+from .gpt import (  # noqa: F401
+    GPTModel, GPTBlock, GPTEmbeddings, GPTLMHead, GPTPretrainingCriterion,
+    GPT_CONFIGS, gpt_pipe_model,
+)
+from .bert import (  # noqa: F401
+    BertModel, BertForSequenceClassification, BertForMaskedLM,
+    BertPretrainingCriterion, BERT_CONFIGS,
+)
+from ..vision.models import (  # noqa: F401
+    LeNet, resnet18, resnet50, vgg16, mobilenet_v2,
+)

@@ -1,0 +1,138 @@
+"""BERT family (BASELINE config 3: BERT-base fine-tune).
+
+Reference parity: PaddleNLP-style BERT over the reference's
+``nn.TransformerEncoder`` (``python/paddle/nn/layer/transformer.py:576``):
+embeddings (word+position+token_type -> LayerNorm -> dropout), pre-v2
+post-LN encoder stack, pooler, and task heads (sequence classification,
+masked LM).
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..core.tensor import Tensor
+from ..ops import reshape
+
+BERT_CONFIGS = {
+    "bert-base": dict(num_layers=12, hidden_size=768, num_heads=12,
+                      vocab_size=30522, max_position=512,
+                      type_vocab_size=2, intermediate_size=3072),
+    "bert-large": dict(num_layers=24, hidden_size=1024, num_heads=16,
+                       vocab_size=30522, max_position=512,
+                       type_vocab_size=2, intermediate_size=4096),
+    "tiny": dict(num_layers=2, hidden_size=64, num_heads=4,
+                 vocab_size=128, max_position=64, type_vocab_size=2,
+                 intermediate_size=128),
+}
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, vocab_size, hidden_size, max_position,
+                 type_vocab_size=2, dropout=0.1):
+        super().__init__()
+        init = nn.ParamAttr(initializer=I.Normal(0.0, 0.02))
+        self.word_embeddings = nn.Embedding(vocab_size, hidden_size,
+                                            weight_attr=init)
+        self.position_embeddings = nn.Embedding(max_position, hidden_size,
+                                                weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(type_vocab_size,
+                                                  hidden_size,
+                                                  weight_attr=init)
+        self.layer_norm = nn.LayerNorm(hidden_size, epsilon=1e-12)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        import jax.numpy as jnp
+        seq = input_ids.shape[-1]
+        pos = Tensor(jnp.arange(seq, dtype=jnp.int32))
+        emb = self.word_embeddings(input_ids) + \
+            self.position_embeddings(pos)
+        if token_type_ids is None:
+            import jax.numpy as jnp2
+            token_type_ids = Tensor(
+                jnp.zeros(tuple(input_ids.shape), jnp.int32))
+        emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, hidden_size):
+        super().__init__()
+        self.dense = nn.Linear(hidden_size, hidden_size)
+
+    def forward(self, hidden_states):
+        return F.tanh(self.dense(hidden_states[:, 0]))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, num_layers=12, hidden_size=768, num_heads=12,
+                 vocab_size=30522, max_position=512, type_vocab_size=2,
+                 intermediate_size=3072, dropout=0.1, with_pool=True):
+        super().__init__()
+        self.embeddings = BertEmbeddings(vocab_size, hidden_size,
+                                         max_position, type_vocab_size,
+                                         dropout)
+        enc_layer = nn.TransformerEncoderLayer(
+            hidden_size, num_heads, intermediate_size, dropout=dropout,
+            activation="gelu")
+        self.encoder = nn.TransformerEncoder(enc_layer, num_layers)
+        self.pooler = BertPooler(hidden_size) if with_pool else None
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        emb = self.embeddings(input_ids, token_type_ids)
+        if attention_mask is not None:
+            # [B, S] 1/0 -> additive [B, 1, 1, S]
+            import jax.numpy as jnp
+            m = attention_mask._data.astype(jnp.float32)
+            add = (1.0 - m)[:, None, None, :] * -1e4
+            attention_mask = Tensor(add)
+        out = self.encoder(emb, src_mask=attention_mask)
+        if self.pooler is not None:
+            return out, self.pooler(out)
+        return out
+
+    @classmethod
+    def from_config(cls, name, **overrides):
+        cfg = dict(BERT_CONFIGS[name])
+        cfg.update(overrides)
+        return cls(**cfg)
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, bert: BertModel, num_classes=2, dropout=0.1):
+        super().__init__()
+        self.bert = bert
+        self.dropout = nn.Dropout(dropout)
+        hidden = bert.pooler.dense.out_features
+        self.classifier = nn.Linear(hidden, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertForMaskedLM(nn.Layer):
+    def __init__(self, bert: BertModel):
+        super().__init__()
+        self.bert = bert
+        hidden = bert.pooler.dense.out_features
+        vocab = bert.embeddings.word_embeddings.num_embeddings
+        self.transform = nn.Linear(hidden, hidden)
+        self.layer_norm = nn.LayerNorm(hidden, epsilon=1e-12)
+        self.decoder = nn.Linear(hidden, vocab)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq_out, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        x = self.layer_norm(F.gelu(self.transform(seq_out)))
+        return self.decoder(x)
+
+
+class BertPretrainingCriterion(nn.Layer):
+    def forward(self, prediction_scores, masked_lm_labels,
+                ignore_index=-100):
+        b, s, v = prediction_scores.shape
+        return F.cross_entropy(
+            reshape(prediction_scores, [b * s, v]),
+            reshape(masked_lm_labels, [b * s]),
+            ignore_index=ignore_index)

@@ -1,0 +1,233 @@
+"""GPT family (flagship LM).
+
+Reference parity: PaddleNLP-style GPT built on the reference's
+``nn.TransformerDecoder`` stack (``python/paddle/nn/layer/transformer.py``)
+with Megatron TP via ``paddle.distributed.split``
+(``distributed/collective.py:492,526``).
+
+TPU-native design: pre-LN causal transformer whose attention goes through
+``F.scaled_dot_product_attention`` (Pallas flash kernel on TPU); tensor
+parallelism via Column/RowParallelLinear specs consumed by pjit; the
+``GPTPipe`` variant exposes the identical-block structure the SPMD pipeline
+engine needs (parallel/pipeline.py).  BASELINE configs 4/5 (GPT-2 345M
+sharding stage2, GPT-3 1.3B hybrid) instantiate from ``GPT_CONFIGS``.
+"""
+from __future__ import annotations
+
+import math
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..core.tensor import Tensor
+from ..ops import reshape, transpose, concat
+
+
+GPT_CONFIGS = {
+    # name: (n_layer, hidden, heads, ffn_mult, vocab, max_seq)
+    "gpt2-small": dict(num_layers=12, hidden_size=768, num_heads=12,
+                       vocab_size=50304, max_position=1024),
+    "gpt2-medium": dict(num_layers=24, hidden_size=1024, num_heads=16,
+                        vocab_size=50304, max_position=1024),  # 345M
+    "gpt2-large": dict(num_layers=36, hidden_size=1280, num_heads=20,
+                       vocab_size=50304, max_position=1024),
+    "gpt3-1.3b": dict(num_layers=24, hidden_size=2048, num_heads=16,
+                      vocab_size=50304, max_position=2048),
+    "tiny": dict(num_layers=2, hidden_size=64, num_heads=4,
+                 vocab_size=128, max_position=64),
+}
+
+
+class GPTEmbeddings(nn.Layer):
+    def __init__(self, vocab_size, hidden_size, max_position,
+                 dropout=0.1, use_mp=False):
+        super().__init__()
+        if use_mp:
+            from ..distributed.sharding import VocabParallelEmbedding
+            self.word_embeddings = VocabParallelEmbedding(
+                vocab_size, hidden_size)
+        else:
+            self.word_embeddings = nn.Embedding(
+                vocab_size, hidden_size,
+                weight_attr=nn.ParamAttr(
+                    initializer=I.Normal(0.0, 0.02)))
+        self.position_embeddings = nn.Embedding(
+            max_position, hidden_size,
+            weight_attr=nn.ParamAttr(initializer=I.Normal(0.0, 0.02)))
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, input_ids):
+        import jax.numpy as jnp
+        seq = input_ids.shape[-1]
+        pos = Tensor(jnp.arange(seq, dtype=jnp.int32))
+        emb = self.word_embeddings(input_ids) + \
+            self.position_embeddings(pos)
+        return self.dropout(emb)
+
+
+class GPTAttention(nn.Layer):
+    """Causal self-attention with fused QKV (one MXU matmul)."""
+
+    def __init__(self, hidden_size, num_heads, dropout=0.1, use_mp=False):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = hidden_size // num_heads
+        self.hidden_size = hidden_size
+        self.dropout = dropout
+        init = nn.ParamAttr(initializer=I.Normal(0.0, 0.02))
+        if use_mp:
+            from ..distributed.sharding import (ColumnParallelLinear,
+                                                RowParallelLinear)
+            self.qkv_proj = ColumnParallelLinear(
+                hidden_size, 3 * hidden_size, weight_attr=init,
+                gather_output=False)
+            self.out_proj = RowParallelLinear(
+                hidden_size, hidden_size, weight_attr=init,
+                input_is_parallel=True)
+        else:
+            self.qkv_proj = nn.Linear(hidden_size, 3 * hidden_size,
+                                      weight_attr=init)
+            self.out_proj = nn.Linear(hidden_size, hidden_size,
+                                      weight_attr=init)
+
+    def forward(self, x, cache=None):
+        b, s, _ = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cache is not None:
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+            cache = (k, v)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.dropout,
+            training=self.training)
+        out = reshape(out, [b, s, self.num_heads * self.head_dim])
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, cache
+        return out
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, hidden_size, ffn_hidden=None, dropout=0.1,
+                 use_mp=False):
+        super().__init__()
+        ffn_hidden = ffn_hidden or 4 * hidden_size
+        init = nn.ParamAttr(initializer=I.Normal(0.0, 0.02))
+        if use_mp:
+            from ..distributed.sharding import (ColumnParallelLinear,
+                                                RowParallelLinear)
+            self.fc1 = ColumnParallelLinear(hidden_size, ffn_hidden,
+                                            weight_attr=init,
+                                            gather_output=False)
+            self.fc2 = RowParallelLinear(ffn_hidden, hidden_size,
+                                         weight_attr=init,
+                                         input_is_parallel=True)
+        else:
+            self.fc1 = nn.Linear(hidden_size, ffn_hidden, weight_attr=init)
+            self.fc2 = nn.Linear(ffn_hidden, hidden_size, weight_attr=init)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(F.gelu(self.fc1(x),
+                                            approximate=True)))
+
+
+class GPTBlock(nn.Layer):
+    """Pre-LN transformer block — the pipelined unit for GPTPipe."""
+
+    def __init__(self, hidden_size, num_heads, dropout=0.1, use_mp=False,
+                 use_recompute=False):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(hidden_size)
+        self.attn = GPTAttention(hidden_size, num_heads, dropout, use_mp)
+        self.ln2 = nn.LayerNorm(hidden_size)
+        self.mlp = GPTMLP(hidden_size, dropout=dropout, use_mp=use_mp)
+        self.use_recompute = use_recompute
+
+    def _inner(self, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+    def forward(self, x):
+        if self.use_recompute:
+            from ..distributed.fleet.utils import recompute
+            # bound method → recompute collects params from `self`
+            return recompute(self._inner, x)
+        return self._inner(x)
+
+
+class GPTLMHead(nn.Layer):
+    def __init__(self, hidden_size, vocab_size, use_mp=False):
+        super().__init__()
+        self.ln_f = nn.LayerNorm(hidden_size)
+        init = nn.ParamAttr(initializer=I.Normal(0.0, 0.02))
+        if use_mp:
+            from ..distributed.sharding import ColumnParallelLinear
+            self.lm_head = ColumnParallelLinear(
+                hidden_size, vocab_size, weight_attr=init, has_bias=False,
+                gather_output=True)
+        else:
+            self.lm_head = nn.Linear(hidden_size, vocab_size,
+                                     weight_attr=init, bias_attr=False)
+
+    def forward(self, x):
+        return self.lm_head(self.ln_f(x))
+
+
+class GPTModel(nn.Layer):
+    """Decoder-only LM returning logits [B, S, V]."""
+
+    def __init__(self, num_layers=12, hidden_size=768, num_heads=12,
+                 vocab_size=50304, max_position=1024, dropout=0.1,
+                 use_mp=False, use_recompute=False):
+        super().__init__()
+        self.embeddings = GPTEmbeddings(vocab_size, hidden_size,
+                                        max_position, dropout, use_mp)
+        self.blocks = nn.LayerList([
+            GPTBlock(hidden_size, num_heads, dropout, use_mp,
+                     use_recompute)
+            for _ in range(num_layers)])
+        self.head = GPTLMHead(hidden_size, vocab_size, use_mp)
+
+    def forward(self, input_ids):
+        x = self.embeddings(input_ids)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.head(x)
+
+    @classmethod
+    def from_config(cls, name, **overrides):
+        cfg = dict(GPT_CONFIGS[name])
+        cfg.update(overrides)
+        return cls(**cfg)
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Next-token CE over shifted logits (PaddleNLP GPT criterion shape)."""
+
+    def forward(self, logits, labels):
+        b, s, v = logits.shape
+        return F.cross_entropy(reshape(logits, [b * s, v]),
+                               reshape(labels, [b * s]))
+
+
+def gpt_pipe_model(name="gpt2-medium", **overrides):
+    """Build the PipelineLayer form: pre=embeddings, blocks, post=head."""
+    from ..distributed.fleet.meta_parallel import PipelineLayer
+    cfg = dict(GPT_CONFIGS[name])
+    cfg.update(overrides)
+    num_layers = cfg.pop("num_layers")
+    hidden = cfg.pop("hidden_size")
+    heads = cfg.pop("num_heads")
+    vocab = cfg.pop("vocab_size")
+    max_pos = cfg.pop("max_position")
+    dropout = cfg.pop("dropout", 0.1)
+    use_mp = cfg.pop("use_mp", False)
+    pre = GPTEmbeddings(vocab, hidden, max_pos, dropout, use_mp)
+    blocks = [GPTBlock(hidden, heads, dropout, use_mp)
+              for _ in range(num_layers)]
+    post = GPTLMHead(hidden, vocab, use_mp)
+    return PipelineLayer(pre=pre, blocks=blocks, post=post)

@@ -109,7 +109,9 @@ def build_pipeline_fn(pipe_layer, num_microbatches, mesh=None,
             return carry_next, out_buf
 
         carry, out_buf = lax.fori_loop(0, steps, tick, (carry, out_buf))
-        return out_buf
+        # only the last stage holds data; psum over the ring replicates it
+        # (other stages contribute zeros) so out_specs=P() is truthful
+        return lax.psum(out_buf, axis)
 
     def pipelined(block_stacked, h_mbs):
         in_specs = (

@@ -97,9 +97,13 @@ class TrainStep:
         self.pnames = sorted(params)
         self.bnames = sorted(buffers)
         stage = self._stage()
+        min_size = 1024
+        if self.strategy is not None:
+            min_size = int(self.strategy.sharding_configs.get(
+                "min_shard_size", 1024))
         spec_map = shard_params_specs(
             self.model, stage=stage if stage else 2,
-            axis="sharding")
+            axis="sharding", min_size=min_size)
         if stage < 3:
             # stages 0-2: params replicated unless TP says otherwise
             for k in self.pnames:
@@ -202,7 +206,6 @@ class TrainStep:
         pnames, bnames = self.pnames, self.bnames
         training = self.training
         use_amp, amp_level = self.use_amp, self.amp_level
-        n_inputs = in_shapes[0]
         merge_k = self.grad_merge_k
 
         def forward_loss(p_arrays, b_arrays, inputs, labels, key):
@@ -281,6 +284,18 @@ class TrainStep:
             new_buffers = dict(zip(bnames, new_b_list))
             return loss, new_params, new_buffers, new_opt
 
+        data_world = 1
+        for ax in DATA_AXES:
+            data_world *= self.mesh.shape.get(ax, 1)
+
+        def batch_sharding(shape):
+            # non-divisible batches fall back to replicated (correct, just
+            # not data-parallel) — mirrors DistributedBatchSampler padding
+            # being the "right" fix upstream
+            if shape and shape[0] % data_world == 0:
+                return NamedSharding(self.mesh, _batch_spec(len(shape)))
+            return NamedSharding(self.mesh, P())
+
         in_shardings = (
             {k: NamedSharding(self.mesh, self.param_specs[k])
              for k in pnames},
@@ -289,10 +304,8 @@ class TrainStep:
                  for sk in self.opt_specs[k]} for k in pnames},
             NamedSharding(self.mesh, P()),
             NamedSharding(self.mesh, P()),
-            [NamedSharding(self.mesh, _batch_spec(nd))
-             for nd in in_shapes[1]],
-            [NamedSharding(self.mesh, _batch_spec(nd))
-             for nd in in_shapes[2]],
+            [batch_sharding(s) for s in in_shapes[1]],
+            [batch_sharding(s) for s in in_shapes[2]],
         )
         donate = (0, 2) if self.donate else ()
         return jax.jit(step, in_shardings=in_shardings,
@@ -335,8 +348,8 @@ class TrainStep:
                       tuple(tuple(a.shape) for a in in_arrays),
                       tuple(tuple(a.shape) for a in lab_arrays))
         if shapes_key not in self._compiled:
-            meta = (len(in_arrays), [a.ndim for a in in_arrays],
-                    [a.ndim for a in lab_arrays])
+            meta = (len(in_arrays), [tuple(a.shape) for a in in_arrays],
+                    [tuple(a.shape) for a in lab_arrays])
             if self.is_pipeline:
                 self._compiled[shapes_key] = self._build_pipeline(meta)
             else:

@@ -1,0 +1,164 @@
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import nn, optimizer
+from paddle_tpu.models import (
+    GPTModel, GPTPretrainingCriterion, BertModel,
+    BertForSequenceClassification, BertPretrainingCriterion, LeNet,
+    resnet18, gpt_pipe_model,
+)
+from paddle_tpu.parallel.train_step import TrainStep
+import paddle_tpu.distributed as dist
+
+rng = np.random.RandomState(11)
+
+
+class TestGPT:
+    def test_forward_shapes(self):
+        model = GPTModel.from_config("tiny")
+        ids = rng.randint(0, 128, (2, 16)).astype(np.int64)
+        logits = model(paddle_tpu.to_tensor(ids))
+        assert logits.shape == [2, 16, 128]
+
+    def test_train_step_converges(self):
+        paddle_tpu.seed(1)
+        model = GPTModel.from_config("tiny", dropout=0.0)
+        crit = GPTPretrainingCriterion()
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        step = TrainStep(model, opt, loss_fn=crit)
+        ids = rng.randint(0, 128, (4, 17)).astype(np.int64)
+        x, y = ids[:, :-1], ids[:, 1:]
+        first = float(step.step([x], [y]).numpy())
+        for _ in range(30):
+            last = float(step.step([x], [y]).numpy())
+        assert last < first * 0.8, (first, last)
+
+    def test_causality(self):
+        """Changing a future token must not affect earlier logits."""
+        model = GPTModel.from_config("tiny", dropout=0.0)
+        model.eval()
+        ids = rng.randint(0, 128, (1, 8)).astype(np.int64)
+        out1 = model(paddle_tpu.to_tensor(ids)).numpy()
+        ids2 = ids.copy()
+        ids2[0, -1] = (ids2[0, -1] + 1) % 128
+        out2 = model(paddle_tpu.to_tensor(ids2)).numpy()
+        np.testing.assert_allclose(out1[0, :-1], out2[0, :-1], rtol=1e-4,
+                                   atol=1e-5)
+        assert not np.allclose(out1[0, -1], out2[0, -1])
+
+    def test_gpt_pipe_structure(self):
+        pipe = gpt_pipe_model("tiny", dropout=0.0)
+        assert len(pipe.blocks) == 2
+        ids = rng.randint(0, 128, (2, 8)).astype(np.int64)
+        pipe.eval()
+        out = pipe(paddle_tpu.to_tensor(ids))
+        assert out.shape == [2, 8, 128]
+
+    def test_gpt_hybrid_dp_mp_train(self):
+        mesh = dist.build_mesh(dp=2, mp=4)
+        dist.set_mesh(mesh)
+        try:
+            paddle_tpu.seed(2)
+            model = GPTModel.from_config("tiny", dropout=0.0, use_mp=True)
+            crit = GPTPretrainingCriterion()
+            opt = optimizer.AdamW(learning_rate=1e-3,
+                                  parameters=model.parameters())
+            step = TrainStep(model, opt, loss_fn=crit, donate=False)
+            ids = rng.randint(0, 128, (8, 9)).astype(np.int64)
+            first = float(step.step([ids[:, :-1]], [ids[:, 1:]]).numpy())
+            for _ in range(10):
+                last = float(step.step([ids[:, :-1]],
+                                       [ids[:, 1:]]).numpy())
+            assert last < first
+        finally:
+            dist.set_mesh(None)
+
+
+class TestBert:
+    def test_forward_shapes(self):
+        model = BertModel.from_config("tiny")
+        ids = rng.randint(0, 128, (2, 12)).astype(np.int64)
+        seq, pooled = model(paddle_tpu.to_tensor(ids))
+        assert seq.shape == [2, 12, 64]
+        assert pooled.shape == [2, 64]
+
+    def test_attention_mask(self):
+        model = BertModel.from_config("tiny", dropout=0.0)
+        model.eval()
+        ids = rng.randint(0, 128, (1, 8)).astype(np.int64)
+        mask = np.ones((1, 8), np.float32)
+        mask[0, 6:] = 0
+        out1, _ = model(paddle_tpu.to_tensor(ids),
+                        attention_mask=paddle_tpu.to_tensor(mask))
+        # changing masked-out tokens must not change visible outputs
+        ids2 = ids.copy()
+        ids2[0, 7] = (ids2[0, 7] + 3) % 128
+        out2, _ = model(paddle_tpu.to_tensor(ids2),
+                        attention_mask=paddle_tpu.to_tensor(mask))
+        np.testing.assert_allclose(out1.numpy()[0, :6], out2.numpy()[0, :6],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_cls_fine_tune_converges(self):
+        paddle_tpu.seed(3)
+        bert = BertModel.from_config("tiny", dropout=0.0)
+        model = BertForSequenceClassification(bert, num_classes=2,
+                                              dropout=0.0)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        step = TrainStep(model, opt, loss_fn=nn.CrossEntropyLoss())
+        ids = rng.randint(0, 128, (8, 12)).astype(np.int64)
+        labels = (ids[:, 0] % 2).astype(np.int64)
+        first = float(step.step([ids], [labels]).numpy())
+        for _ in range(40):
+            last = float(step.step([ids], [labels]).numpy())
+        assert last < first * 0.5
+
+    def test_mlm_criterion_ignores_unmasked(self):
+        crit = BertPretrainingCriterion()
+        logits = paddle_tpu.to_tensor(
+            rng.rand(1, 4, 128).astype(np.float32))
+        labels = np.full((1, 4), -100, np.int64)
+        labels[0, 1] = 5
+        loss = crit(logits, paddle_tpu.to_tensor(labels))
+        assert np.isfinite(loss.numpy())
+
+
+class TestVisionModels:
+    def test_lenet_forward(self):
+        model = LeNet()
+        out = model(paddle_tpu.ones([2, 1, 28, 28]))
+        assert out.shape == [2, 10]
+
+    def test_resnet18_forward_and_train(self):
+        model = resnet18(num_classes=10)
+        x = rng.rand(2, 3, 32, 32).astype(np.float32)
+        out = model(paddle_tpu.to_tensor(x))
+        assert out.shape == [2, 10]
+        # one train step through TrainStep
+        opt = optimizer.Momentum(learning_rate=0.01,
+                                 parameters=model.parameters())
+        step = TrainStep(model, opt, loss_fn=nn.CrossEntropyLoss())
+        labels = np.array([1, 2], np.int64)
+        loss = step.step([x], [labels])
+        assert np.isfinite(loss.numpy())
+
+    def test_recompute_block(self):
+        from paddle_tpu.models.gpt import GPTModel
+        paddle_tpu.seed(4)
+        m1 = GPTModel.from_config("tiny", dropout=0.0)
+        paddle_tpu.seed(4)
+        m2 = GPTModel.from_config("tiny", dropout=0.0,
+                                  use_recompute=True)
+        crit = GPTPretrainingCriterion()
+        ids = rng.randint(0, 128, (2, 9)).astype(np.int64)
+        o1 = optimizer.SGD(learning_rate=0.1,
+                           parameters=m1.parameters())
+        o2 = optimizer.SGD(learning_rate=0.1,
+                           parameters=m2.parameters())
+        s1 = TrainStep(m1, o1, loss_fn=crit)
+        s2 = TrainStep(m2, o2, loss_fn=crit)
+        l1 = float(s1.step([ids[:, :-1]], [ids[:, 1:]]).numpy())
+        l2 = float(s2.step([ids[:, :-1]], [ids[:, 1:]]).numpy())
+        np.testing.assert_allclose(l1, l2, rtol=1e-4)
