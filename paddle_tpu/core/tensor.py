@@ -27,8 +27,9 @@ Value = object  # jax.Array | tracer
 class Tensor:
     _next_id = [0]
 
-    __slots__ = ("_data", "stop_gradient", "grad", "_grad_node", "_retain_grad",
-                 "name", "persistable", "trainable", "__weakref__", "__dict__")
+    __slots__ = ("_data", "_stop_gradient", "grad", "_grad_node",
+                 "_retain_grad", "name", "persistable", "__weakref__",
+                 "__dict__")
 
     def __init__(self, data, dtype=None, place=None, stop_gradient=True,
                  name=None):
@@ -48,16 +49,34 @@ class Tensor:
         elif dtype is not None:
             data = data.astype(dtypes.to_jax(dtype))
         self._data = data
-        self.stop_gradient = stop_gradient
+        self._stop_gradient = stop_gradient
         self.grad = None
         self._grad_node = None
         self._retain_grad = False
         Tensor._next_id[0] += 1
         self.name = name or f"tensor_{Tensor._next_id[0]}"
         self.persistable = False
-        self.trainable = not stop_gradient
 
     # -- metadata ---------------------------------------------------------
+    # paddle semantics: `trainable` is the inverse alias of `stop_gradient`
+    # (fluid Parameter keeps them in sync); one backing slot avoids the two
+    # flags drifting apart when users flip stop_gradient after construction.
+    @property
+    def stop_gradient(self):
+        return self._stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, value):
+        self._stop_gradient = bool(value)
+
+    @property
+    def trainable(self):
+        return not self._stop_gradient
+
+    @trainable.setter
+    def trainable(self, value):
+        self._stop_gradient = not value
+
     @property
     def data(self):
         return self
@@ -213,7 +232,6 @@ class Parameter(Tensor):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable,
                          name=name)
         self.persistable = True
-        self.trainable = trainable
 
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()

@@ -2,6 +2,7 @@
 cholesky_op.cc, svd helpers in math/, paddle.linalg namespace)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -174,3 +175,86 @@ def bincount(x, weights=None, minlength=0, name=None):
     return Tensor(jnp.bincount(x._data.reshape(-1), weights=w,
                                minlength=int(minlength),
                                length=None))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    """paddle.linalg.lstsq — least-squares solution (reference lstsq_op).
+
+    Returns (solution, residuals, rank, singular_values) like paddle 2.x.
+    Accepts batched (*, M, N) inputs via vmap over the leading dims; the
+    `driver` knob is a LAPACK-backend selector with no XLA analogue and is
+    ignored.
+    """
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    a, b = x._data, y._data
+    solver = lambda ai, bi: jnp.linalg.lstsq(ai, bi, rcond=rcond)
+    for _ in range(a.ndim - 2):
+        solver = jax.vmap(solver)
+    sol, res, rank, sv = solver(a, b)
+    return (Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """paddle.linalg.lu — LU factorization (packed LU + pivots).
+
+    Pivots are 1-based (paddle convention: 1 <= pivots[i] <= m); infos[i]>0
+    flags a zero pivot on the diagonal (singular factorization).
+    """
+    import jax.scipy.linalg as jsl
+    x = ensure_tensor(x)
+    lu_mat, piv = jsl.lu_factor(x._data)
+    piv = piv + 1
+    if get_infos:
+        diag = jnp.diagonal(lu_mat, axis1=-2, axis2=-1)
+        zero = diag == 0
+        # first zero-pivot index + 1, or 0 when none (LAPACK getrf contract)
+        first = jnp.argmax(zero, axis=-1) + 1
+        info = jnp.where(jnp.any(zero, axis=-1), first, 0).astype(jnp.int32)
+        return Tensor(lu_mat), Tensor(piv), Tensor(info)
+    return Tensor(lu_mat), Tensor(piv)
+
+
+def _complex_of(dt):
+    return jnp.complex128 if dt == jnp.float64 else jnp.complex64
+
+
+def eig(x, name=None):
+    """paddle.linalg.eig — general eigendecomposition.  XLA has no TPU
+    lowering for nonsymmetric eig (the reference's eig_op is CPU-only too):
+    eager calls run numpy on host; traced calls go through jax.pure_callback
+    (supported on the CPU backend; the axon TPU plugin lacks host callbacks,
+    so keep eig outside jit there)."""
+    import numpy as np
+    x = ensure_tensor(x)
+    a = x._data
+    cdt = _complex_of(a.dtype)
+
+    def host_eig(m):
+        w, v = np.linalg.eig(np.asarray(m))
+        return w.astype(cdt), v.astype(cdt)
+
+    if isinstance(a, jax.core.Tracer):
+        w_shape = jax.ShapeDtypeStruct(a.shape[:-1], cdt)
+        v_shape = jax.ShapeDtypeStruct(a.shape, cdt)
+        w, v = jax.pure_callback(host_eig, (w_shape, v_shape), a)
+    else:
+        # complex results stay on CPU: the axon TPU backend can't hold
+        # complex dtypes (readback would raise UNIMPLEMENTED)
+        cpu = jax.devices("cpu")[0]
+        w, v = host_eig(a)
+        w, v = jax.device_put(w, cpu), jax.device_put(v, cpu)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    x = ensure_tensor(x)
+    a = x._data
+    cdt = _complex_of(a.dtype)
+    host = lambda m: np.linalg.eigvals(np.asarray(m)).astype(cdt)
+    if isinstance(a, jax.core.Tracer):
+        w = jax.pure_callback(
+            host, jax.ShapeDtypeStruct(a.shape[:-1], cdt), a)
+    else:
+        w = jax.device_put(host(a), jax.devices("cpu")[0])
+    return Tensor(w)

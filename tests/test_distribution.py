@@ -1,0 +1,120 @@
+"""paddle.distribution + linalg breadth tests.
+
+Reference test model: unittests/test_distribution.py (sample shapes,
+log_prob/entropy vs scipy-style closed forms), test_linalg_* (vs numpy).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import Normal, Uniform, Categorical, kl_divergence
+
+
+def test_normal_sample_logprob_entropy():
+    paddle.seed(0)
+    d = Normal(loc=1.0, scale=2.0)
+    s = d.sample((10000,))
+    assert s.shape == [10000]
+    arr = s.numpy()
+    assert abs(arr.mean() - 1.0) < 0.1
+    assert abs(arr.std() - 2.0) < 0.1
+    lp = d.log_prob(paddle.to_tensor(1.0)).numpy()
+    # N(1,2) at x=1: -log(2*sqrt(2pi))
+    assert np.allclose(lp, -np.log(2.0 * np.sqrt(2 * np.pi)), atol=1e-5)
+    ent = d.entropy().numpy()
+    expect = 0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0)
+    assert np.allclose(ent, expect, atol=1e-5)
+
+
+def test_normal_kl():
+    p = Normal(0.0, 1.0)
+    q = Normal(1.0, 2.0)
+    kl = kl_divergence(p, q).numpy()
+    # closed form: log(s2/s1) + (s1^2 + (m1-m2)^2)/(2 s2^2) - 0.5
+    expect = np.log(2.0) + (1.0 + 1.0) / 8.0 - 0.5
+    assert np.allclose(kl, expect, atol=1e-5)
+
+
+def test_uniform():
+    paddle.seed(0)
+    d = Uniform(low=-1.0, high=3.0)
+    s = d.sample((5000,))
+    arr = s.numpy()
+    assert arr.min() >= -1.0 and arr.max() < 3.0
+    assert abs(arr.mean() - 1.0) < 0.1
+    assert np.allclose(d.entropy().numpy(), np.log(4.0), atol=1e-5)
+    assert np.allclose(d.log_prob(paddle.to_tensor(0.0)).numpy(),
+                       -np.log(4.0), atol=1e-5)
+    assert d.log_prob(paddle.to_tensor(5.0)).numpy() == -np.inf
+
+
+def test_categorical():
+    # reference-parity semantics: sample/probs/log_prob linearly normalize
+    # the weights; entropy/kl use softmax(logits) (distribution.py quirk)
+    paddle.seed(0)
+    w = np.array([0.1, 0.2, 0.7], np.float32)
+    d = Categorical(paddle.to_tensor(w))
+    s = d.sample((20000,))
+    counts = np.bincount(s.numpy(), minlength=3) / 20000.0
+    assert np.allclose(counts, [0.1, 0.2, 0.7], atol=0.02)
+    lp = d.log_prob(paddle.to_tensor(np.array([2], np.int64))).numpy()
+    assert np.allclose(lp, np.log(0.7), atol=1e-5)
+    pr = d.probs(paddle.to_tensor(np.array([0, 2], np.int64))).numpy()
+    assert np.allclose(pr, [0.1, 0.7], atol=1e-5)
+
+    def softmax(z):
+        e = np.exp(z - z.max())
+        return e / e.sum()
+
+    sp = softmax(w)
+    ent = d.entropy().numpy()
+    assert np.allclose(ent, -(sp * np.log(sp)).sum(), atol=1e-5)
+    d2 = Categorical(paddle.to_tensor(np.ones(3, np.float32)))
+    kl = d.kl_divergence(d2).numpy()
+    expect = (sp * (np.log(sp) - np.log(1 / 3))).sum()
+    assert np.allclose(kl, expect, atol=1e-5)
+
+
+def test_categorical_log_prob_gradient():
+    """REINFORCE-style gradient flows into the weights (eager tape)."""
+    w = paddle.to_tensor(np.array([0.2, 0.3, 0.5], np.float32))
+    w.stop_gradient = False
+    d = Categorical(w)
+    lp = d.log_prob(paddle.to_tensor(np.array([2], np.int64)))
+    lp.sum().backward()
+    # d/dw log(w2/sum) = [-1/sum, -1/sum, 1/w2 - 1/sum]; sum = 1
+    assert np.allclose(w.grad.numpy(), [-1.0, -1.0, 1.0], atol=1e-4)
+
+
+def test_uniform_boundary_strict():
+    d = Uniform(0.0, 1.0)
+    assert d.log_prob(paddle.to_tensor(0.0)).numpy() == -np.inf
+    assert np.allclose(d.log_prob(paddle.to_tensor(0.5)).numpy(), 0.0)
+
+
+def test_lstsq_lu_eig():
+    rng = np.random.RandomState(0)
+    a = rng.rand(6, 3).astype(np.float32)
+    b = rng.rand(6, 2).astype(np.float32)
+    sol, _, rank, _ = paddle.ops.linalg.lstsq(
+        paddle.to_tensor(a), paddle.to_tensor(b))
+    expect = np.linalg.lstsq(a, b, rcond=None)[0]
+    assert np.allclose(sol.numpy(), expect, atol=1e-4)
+
+    ab = np.stack([a, a + 0.5])
+    bb = np.stack([b, b * 2])
+    solb, _, _, _ = paddle.ops.linalg.lstsq(
+        paddle.to_tensor(ab), paddle.to_tensor(bb))
+    for i in range(2):
+        assert np.allclose(solb.numpy()[i],
+                           np.linalg.lstsq(ab[i], bb[i], rcond=None)[0],
+                           atol=1e-4)
+
+    m = rng.rand(4, 4).astype(np.float32) + np.eye(4, dtype=np.float32)
+    lu_mat, piv = paddle.ops.linalg.lu(paddle.to_tensor(m))
+    assert lu_mat.shape == [4, 4] and piv.shape == [4]
+
+    w, v = paddle.ops.linalg.eig(paddle.to_tensor(m))
+    # eigenpairs satisfy A v = w v
+    recon = m.astype(np.complex64) @ v.numpy()
+    assert np.allclose(recon, v.numpy() * w.numpy()[None, :], atol=1e-3)
