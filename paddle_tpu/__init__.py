@@ -77,6 +77,7 @@ from . import vision  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
+from . import text  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
 from .framework.io import save, load  # noqa: E402,F401

@@ -17,3 +17,7 @@ from .norm import (  # noqa: F401
 )
 from .loss import *  # noqa: F401,F403
 from .attention import scaled_dot_product_attention  # noqa: F401
+from .sequence import (  # noqa: F401
+    sequence_pad, sequence_unpad, sequence_pool, sequence_softmax,
+    sequence_expand, sequence_reverse, edit_distance,
+)

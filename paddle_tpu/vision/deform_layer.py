@@ -1,0 +1,35 @@
+"""DeformConv2D layer (reference: python/paddle/vision/ops.py:594)."""
+from __future__ import annotations
+
+from ..nn.layer.base import Layer
+from ..nn import initializer as init
+from ..core.tensor import Parameter
+from . import ops as vops
+
+
+class DeformConv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        fan_in = in_channels * kernel_size[0] * kernel_size[1] // groups
+        self.weight = self.create_parameter(
+            shape=[out_channels, in_channels // groups, *kernel_size],
+            attr=weight_attr,
+            default_initializer=init.XavierUniform(fan_in=fan_in))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            shape=[out_channels], attr=bias_attr, is_bias=True))
+
+    def forward(self, x, offset, mask=None):
+        return vops.deform_conv2d(
+            x, offset, self.weight, bias=self.bias, stride=self._stride,
+            padding=self._padding, dilation=self._dilation,
+            deformable_groups=self._deformable_groups, groups=self._groups,
+            mask=mask)

@@ -1,0 +1,677 @@
+"""Vision / detection ops.
+
+Reference parity: ``python/paddle/vision/ops.py`` (yolo_box, yolo_loss,
+deform_conv2d) and ``python/paddle/fluid/layers/detection.py`` (prior_box,
+box_coder, multiclass_nms) over the C++ kernels in
+``paddle/fluid/operators/detection/`` (yolo_box_op.h, roi_align_op.h,
+roi_pool_op, prior_box_op, box_coder_op, nms util).
+
+TPU-native design: every op is a fixed-shape vectorized jnp computation —
+no per-box host loops, no dynamic output shapes.  NMS-style ops return
+padded fixed-size results plus a valid-count (the reference returns LoD
+tensors; XLA needs static shapes, so callers slice by the count).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import primitive, ensure_tensor
+from ..core.tensor import Tensor
+
+
+# ---- yolo_box (reference: operators/detection/yolo_box_op.h) ------------
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    """Decode YOLOv3 head output into boxes + per-class scores.
+
+    x: [N, A*(5+C), H, W]; img_size: [N, 2] (h, w).
+    Returns boxes [N, A*H*W, 4], scores [N, A*H*W, C].
+    Numerics follow yolo_box_op.h GetYoloBox/CalcDetectionBox: boxes with
+    conf <= conf_thresh are zeroed.
+    """
+    x = ensure_tensor(x)
+    img_size = ensure_tensor(img_size)
+    anchors = list(anchors)
+    an_num = len(anchors) // 2
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+
+    def fn(xa, imgs):
+        n, _, h, w = xa.shape
+        input_h = downsample_ratio * h
+        input_w = downsample_ratio * w
+        xa = xa.reshape(n, an_num, 5 + class_num, h, w)
+        # entries: 0,1 = xy; 2,3 = wh; 4 = objectness; 5: = class logits
+        grid_x = jnp.arange(w, dtype=xa.dtype)[None, :]
+        grid_y = jnp.arange(h, dtype=xa.dtype)[:, None]
+        img_h = imgs[:, 0].astype(xa.dtype)[:, None, None, None]
+        img_w = imgs[:, 1].astype(xa.dtype)[:, None, None, None]
+        aw = jnp.asarray(anchors[0::2], xa.dtype)[None, :, None, None]
+        ah = jnp.asarray(anchors[1::2], xa.dtype)[None, :, None, None]
+
+        cx = ((grid_x + jax.nn.sigmoid(xa[:, :, 0]) * scale + bias)
+              * img_w / w)
+        cy = ((grid_y + jax.nn.sigmoid(xa[:, :, 1]) * scale + bias)
+              * img_h / h)
+        bw = jnp.exp(xa[:, :, 2]) * aw * img_w / input_w
+        bh = jnp.exp(xa[:, :, 3]) * ah * img_h / input_h
+
+        x1, y1 = cx - bw / 2, cy - bh / 2
+        x2, y2 = cx + bw / 2, cy + bh / 2
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, None)
+            y1 = jnp.clip(y1, 0, None)
+            x2 = jnp.minimum(x2, img_w - 1)
+            y2 = jnp.minimum(y2, img_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [N, A, H, W, 4]
+
+        conf = jax.nn.sigmoid(xa[:, :, 4])
+        keep = conf > conf_thresh
+        boxes = jnp.where(keep[..., None], boxes, 0.0)
+        scores = (conf[..., None]
+                  * jax.nn.sigmoid(jnp.moveaxis(xa[:, :, 5:], 2, -1)))
+        scores = jnp.where(keep[..., None], scores, 0.0)
+        return (boxes.reshape(n, an_num * h * w, 4),
+                scores.reshape(n, an_num * h * w, class_num))
+
+    prim = primitive(name="yolo_box", nondiff=(1,))(fn)
+    return prim(x, img_size)
+
+
+# ---- yolo_loss (reference: operators/detection/yolov3_loss_op.h) --------
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss.  x: [N, M*(5+C), H, W]; gt_box: [N, B, 4]
+    (cx, cy, w, h normalized to [0,1]); gt_label: [N, B] int.
+    Returns per-image loss [N].  Numerics follow yolov3_loss_op.h: sigmoid
+    CE on xy/objectness/class, L1 on wh, ignore mask via IoU > thresh,
+    per-gt best-anchor matching, optional mixup gt_score weighting.
+    """
+    x = ensure_tensor(x)
+    gt_box = ensure_tensor(gt_box)
+    gt_label = ensure_tensor(gt_label)
+    anchors = [float(a) for a in anchors]
+    anchor_mask = [int(m) for m in anchor_mask]
+    an_num = len(anchors) // 2
+    mask_num = len(anchor_mask)
+    if gt_score is None:
+        gt_score = Tensor(jnp.ones(gt_box._data.shape[:2], jnp.float32))
+    else:
+        gt_score = ensure_tensor(gt_score)
+
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+
+    def sce(logit, label):
+        # SigmoidCrossEntropy (yolov3_loss_op.h:74)
+        return (jnp.clip(logit, 0, None) - logit * label
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    def iou_cwh(b1, b2):
+        """IoU of center-format boxes; b* = (cx, cy, w, h) arrays."""
+        l = jnp.maximum(b1[..., 0] - b1[..., 2] / 2,
+                        b2[..., 0] - b2[..., 2] / 2)
+        r = jnp.minimum(b1[..., 0] + b1[..., 2] / 2,
+                        b2[..., 0] + b2[..., 2] / 2)
+        t = jnp.maximum(b1[..., 1] - b1[..., 3] / 2,
+                        b2[..., 1] - b2[..., 3] / 2)
+        b = jnp.minimum(b1[..., 1] + b1[..., 3] / 2,
+                        b2[..., 1] + b2[..., 3] / 2)
+        iw = jnp.clip(r - l, 0.0, None)
+        ih = jnp.clip(b - t, 0.0, None)
+        inter = iw * ih
+        union = (b1[..., 2] * b1[..., 3] + b2[..., 2] * b2[..., 3]
+                 - inter)
+        return jnp.where(union > 0, inter / union, 0.0)
+
+    if use_label_smooth:
+        smooth = min(1.0 / class_num, 1.0 / 40)
+        label_pos, label_neg = 1.0 - smooth, smooth
+    else:
+        label_pos, label_neg = 1.0, 0.0
+
+    def fn(xa, gtb, gtl, gts):
+        n, _, h, w = xa.shape
+        input_size = downsample_ratio * h
+        xa = xa.reshape(n, mask_num, 5 + class_num, h, w)
+        amask = jnp.asarray(anchor_mask, jnp.int32)
+        aw_all = jnp.asarray(anchors[0::2], jnp.float32)
+        ah_all = jnp.asarray(anchors[1::2], jnp.float32)
+
+        def per_image(xi, gtbi, gtli, gtsi):
+            # --- ignore mask: best IoU of each pred box vs valid gts ----
+            grid_x = jnp.arange(w, dtype=xi.dtype)[None, None, :]
+            grid_y = jnp.arange(h, dtype=xi.dtype)[None, :, None]
+            px = (grid_x + jax.nn.sigmoid(xi[:, 0]) * scale + bias) / w
+            py = (grid_y + jax.nn.sigmoid(xi[:, 1]) * scale + bias) / h
+            pw = (jnp.exp(xi[:, 2]) * aw_all[amask][:, None, None]
+                  / input_size)
+            ph_ = (jnp.exp(xi[:, 3]) * ah_all[amask][:, None, None]
+                   / input_size)
+            pred = jnp.stack([px, py, pw, ph_], axis=-1)  # [M, H, W, 4]
+            valid = (gtbi[:, 2] > 0) & (gtbi[:, 3] > 0)
+            ious = iou_cwh(pred[..., None, :],
+                           gtbi[None, None, None, :, :])  # [M,H,W,B]
+            best = jnp.where(valid[None, None, None, :], ious, 0.0) \
+                .max(axis=-1)
+            obj_mask0 = jnp.where(best > ignore_thresh, -1.0, 0.0)
+
+            # --- per-gt positive assignment (scan keeps overwrite order)
+            def body(carry, t):
+                obj_mask, loss = carry
+                g = gtbi[t]
+                sc = gtsi[t]
+                ok = valid[t]
+                gi = jnp.clip((g[0] * w).astype(jnp.int32), 0, w - 1)
+                gj = jnp.clip((g[1] * h).astype(jnp.int32), 0, h - 1)
+                # best anchor by wh IoU (shifted to origin)
+                an_iou = iou_cwh(
+                    jnp.stack([jnp.zeros(an_num), jnp.zeros(an_num),
+                               aw_all / input_size, ah_all / input_size],
+                              axis=-1),
+                    jnp.concatenate([jnp.zeros(2), g[2:4]])[None, :])
+                best_n = jnp.argmax(an_iou)
+                in_mask = (amask == best_n)
+                mask_idx = jnp.where(in_mask.any(),
+                                     jnp.argmax(in_mask), -1)
+                matched = ok & (mask_idx >= 0)
+                mi = jnp.clip(mask_idx, 0, mask_num - 1)
+
+                tx = g[0] * w - gi.astype(g.dtype)
+                ty = g[1] * h - gj.astype(g.dtype)
+                tw = jnp.log(g[2] * input_size / aw_all[best_n])
+                th = jnp.log(g[3] * input_size / ah_all[best_n])
+                loc_scale = (2.0 - g[2] * g[3]) * sc
+                entry = xi[mi, :, gj, gi]  # [5+C]
+                loc = (sce(entry[0], tx) + sce(entry[1], ty)
+                       + jnp.abs(entry[2] - tw) + jnp.abs(entry[3] - th)
+                       ) * loc_scale
+                onehot = jnp.where(
+                    jnp.arange(class_num) == gtli[t], label_pos, label_neg)
+                lab = (sce(entry[5:], onehot) * sc).sum()
+                loss = loss + jnp.where(matched, loc + lab, 0.0)
+                obj_mask = lax.cond(
+                    matched,
+                    lambda m: m.at[mi, gj, gi].set(sc),
+                    lambda m: m, obj_mask)
+                return (obj_mask, loss), None
+
+            (obj_mask, loss), _ = lax.scan(
+                body, (obj_mask0, jnp.zeros((), xi.dtype)),
+                jnp.arange(gtbi.shape[0]))
+
+            # --- objectness loss over final mask ------------------------
+            obj_logit = xi[:, 4]
+            pos = obj_mask > 1e-5
+            neg = (~pos) & (obj_mask > -0.5)
+            loss = loss + jnp.where(
+                pos, sce(obj_logit, 1.0) * obj_mask, 0.0).sum()
+            loss = loss + jnp.where(neg, sce(obj_logit, 0.0), 0.0).sum()
+            return loss
+
+        return jax.vmap(per_image)(xa, gtb, gtl, gts)
+
+    prim = primitive(name="yolo_loss", nondiff=(1, 2, 3))(fn)
+    return prim(x, gt_box, gt_label, gt_score)
+
+
+# ---- roi_align (reference: operators/roi_align_op.h) --------------------
+def roi_align(x, boxes, boxes_index=None, output_size=1, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=False, name=None):
+    """Bilinear ROI align.  x: [N, C, H, W]; boxes: [K, 4] (x1,y1,x2,y2 in
+    un-scaled image coords); boxes_index: [K] batch index per box.
+
+    sampling_ratio<=0 uses a fixed 2x2 sample grid per bin (the reference
+    adapts the grid per ROI — data-dependent shapes XLA can't express; 2 is
+    its value for typical FPN bins).
+    """
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    s = sampling_ratio if sampling_ratio > 0 else 2
+    x = ensure_tensor(x)
+    boxes = ensure_tensor(boxes)
+    if boxes_index is None:
+        boxes_index = Tensor(jnp.zeros(boxes._data.shape[0], jnp.int32))
+    else:
+        boxes_index = ensure_tensor(boxes_index)
+
+    def fn(feat, rois, idx):
+        n, c, h, w = feat.shape
+        offset = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - offset
+        y1 = rois[:, 1] * spatial_scale - offset
+        x2 = rois[:, 2] * spatial_scale - offset
+        y2 = rois[:, 3] * spatial_scale - offset
+        roi_w, roi_h = x2 - x1, y2 - y1
+        if not aligned:  # legacy: force minimum ROI of 1x1
+            roi_w = jnp.maximum(roi_w, 1.0)
+            roi_h = jnp.maximum(roi_h, 1.0)
+        bin_h, bin_w = roi_h / ph, roi_w / pw
+
+        # sample coords: [K, ph*s] x [K, pw*s]
+        iy = (jnp.arange(ph * s) // s).astype(feat.dtype)
+        fy = ((jnp.arange(ph * s) % s).astype(feat.dtype) + 0.5) / s
+        ys = y1[:, None] + (iy + fy)[None, :] * bin_h[:, None]
+        ix = (jnp.arange(pw * s) // s).astype(feat.dtype)
+        fx = ((jnp.arange(pw * s) % s).astype(feat.dtype) + 0.5) / s
+        xs = x1[:, None] + (ix + fx)[None, :] * bin_w[:, None]
+
+        def bilinear(fmap, yy, xx):
+            """fmap [C,H,W]; yy [PY], xx [PX] -> [C, PY, PX]"""
+            valid_y = (yy >= -1.0) & (yy <= h)
+            valid_x = (xx >= -1.0) & (xx <= w)
+            yy = jnp.clip(yy, 0.0, None)
+            xx = jnp.clip(xx, 0.0, None)
+            y0 = jnp.clip(yy.astype(jnp.int32), 0, h - 1)
+            x0 = jnp.clip(xx.astype(jnp.int32), 0, w - 1)
+            y1i = jnp.minimum(y0 + 1, h - 1)
+            x1i = jnp.minimum(x0 + 1, w - 1)
+            ly = jnp.clip(yy - y0.astype(yy.dtype), 0.0, 1.0)
+            lx = jnp.clip(xx - x0.astype(xx.dtype), 0.0, 1.0)
+            v00 = fmap[:, y0][:, :, x0]
+            v01 = fmap[:, y0][:, :, x1i]
+            v10 = fmap[:, y1i][:, :, x0]
+            v11 = fmap[:, y1i][:, :, x1i]
+            wy, wx = ly[None, :, None], lx[None, None, :]
+            out = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                   + v10 * wy * (1 - wx) + v11 * wy * wx)
+            mask = (valid_y[None, :, None] & valid_x[None, None, :])
+            return jnp.where(mask, out, 0.0)
+
+        def per_roi(b, yy, xx):
+            fmap = feat[b]  # gather batch
+            sampled = bilinear(fmap, yy, xx)  # [C, ph*s, pw*s]
+            return sampled.reshape(c, ph, s, pw, s).mean(axis=(2, 4))
+
+        return jax.vmap(per_roi)(idx, ys, xs)  # [K, C, ph, pw]
+
+    prim = primitive(name="roi_align", nondiff=(1, 2))(fn)
+    return prim(x, boxes, boxes_index)
+
+
+def roi_pool(x, boxes, boxes_index=None, output_size=1, spatial_scale=1.0,
+             name=None):
+    """Max-pool ROI pooling (reference roi_pool_op): integer bin edges,
+    empty bins yield 0."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x = ensure_tensor(x)
+    boxes = ensure_tensor(boxes)
+    if boxes_index is None:
+        boxes_index = Tensor(jnp.zeros(boxes._data.shape[0], jnp.int32))
+    else:
+        boxes_index = ensure_tensor(boxes_index)
+
+    def fn(feat, rois, idx):
+        n, c, h, w = feat.shape
+        x1 = jnp.round(rois[:, 0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(rois[:, 1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(rois[:, 2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(rois[:, 3] * spatial_scale).astype(jnp.int32)
+        roi_h = jnp.maximum(y2 - y1 + 1, 1)
+        roi_w = jnp.maximum(x2 - x1 + 1, 1)
+
+        hh = jnp.arange(h)
+        ww = jnp.arange(w)
+        pb = jnp.arange(ph)
+        qb = jnp.arange(pw)
+
+        def per_roi(b, x1i, y1i, rh, rw):
+            fmap = feat[b]
+            # bin edges (floor/ceil of fractional bin size), clipped to map
+            hstart = jnp.clip(y1i + (pb * rh) // ph, 0, h)
+            hend = jnp.clip(y1i + -(-((pb + 1) * rh) // ph), 0, h)
+            wstart = jnp.clip(x1i + (qb * rw) // pw, 0, w)
+            wend = jnp.clip(x1i + -(-((qb + 1) * rw) // pw), 0, w)
+            memb_h = (hh[None, :] >= hstart[:, None]) & \
+                     (hh[None, :] < hend[:, None])      # [ph, H]
+            memb_w = (ww[None, :] >= wstart[:, None]) & \
+                     (ww[None, :] < wend[:, None])      # [pw, W]
+            mask = memb_h[:, None, :, None] & memb_w[None, :, None, :]
+            vals = jnp.where(mask[None], fmap[:, None, None, :, :],
+                             -jnp.inf)
+            out = vals.max(axis=(-2, -1))               # [C, ph, pw]
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+
+        return jax.vmap(per_roi)(idx, x1, y1, roi_h, roi_w)
+
+    prim = primitive(name="roi_pool", nondiff=(1, 2))(fn)
+    return prim(x, boxes, boxes_index)
+
+
+# ---- prior_box (reference: operators/detection/prior_box_op) ------------
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes.  Returns (boxes [H, W, P, 4], variances same)."""
+    input = ensure_tensor(input)
+    image = ensure_tensor(image)
+    _, _, fh, fw = input._data.shape
+    _, _, ih, iw = image._data.shape
+    step_w = steps[0] if steps[0] else float(iw) / fw
+    step_h = steps[1] if steps[1] else float(ih) / fh
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - e) > 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    min_sizes = [float(m) for m in min_sizes]
+    max_sizes = [float(m) for m in (max_sizes or [])]
+
+    whs = []  # per prior (w, h) in pixels
+    for k, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                big = math.sqrt(ms * max_sizes[k])
+                whs.append((big, big))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+            if max_sizes:
+                big = math.sqrt(ms * max_sizes[k])
+                whs.append((big, big))
+    whs = jnp.asarray(whs, jnp.float32)  # [P, 2]
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [fh, fw]
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    bw = whs[None, None, :, 0] / 2.0
+    bh = whs[None, None, :, 1] / 2.0
+    boxes = jnp.stack([(cxg - bw) / iw, (cyg - bh) / ih,
+                       (cxg + bw) / iw, (cyg + bh) / ih], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           boxes.shape)
+    return Tensor(boxes), Tensor(var)
+
+
+# ---- box_coder (reference: operators/detection/box_coder_op) ------------
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (SSD/R-CNN box regression)."""
+    pb = ensure_tensor(prior_box)._data
+    tb = ensure_tensor(target_box)._data
+    pbv = None
+    if prior_box_var is not None:
+        pbv = (ensure_tensor(prior_box_var)._data
+               if not isinstance(prior_box_var, (list, tuple))
+               else jnp.asarray(prior_box_var, jnp.float32))
+
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph_ = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph_ / 2
+
+    if code_type == "encode_center_size":
+        # target [M, 4], priors [N, 4] -> [M, N, 4]
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw / 2
+        tcy = tb[:, 1] + th / 2
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph_[None, :]
+        dw = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        dh = jnp.log(jnp.abs(th[:, None] / ph_[None, :]))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        if pbv is not None:
+            out = out / (pbv if pbv.ndim == 1 else pbv[None, :, :])
+        return Tensor(out)
+    elif code_type == "decode_center_size":
+        # target [N, M, 4] deltas, priors broadcast along `axis`
+        if tb.ndim == 2:
+            tb = tb[:, None, :]
+        if pbv is None:
+            pbv = jnp.ones(4, jnp.float32)
+        if pbv.ndim == 1:
+            pbv = pbv[None, :]
+        exp = (lambda a: a[None, :]) if axis == 0 else (lambda a: a[:, None])
+        var = pbv[None] if pbv.ndim == 2 else pbv
+        dcx = exp(pcx) + tb[..., 0] * var[..., 0] * exp(pw)
+        dcy = exp(pcy) + tb[..., 1] * var[..., 1] * exp(ph_)
+        dw = jnp.exp(tb[..., 2] * var[..., 2]) * exp(pw)
+        dh = jnp.exp(tb[..., 3] * var[..., 3]) * exp(ph_)
+        out = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                         dcx + dw / 2 - norm, dcy + dh / 2 - norm], axis=-1)
+        return Tensor(out)
+    raise ValueError(f"unknown code_type {code_type}")
+
+
+# ---- NMS family ---------------------------------------------------------
+def _iou_matrix(boxes, box_normalized=True):
+    norm = 0.0 if box_normalized else 1.0
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = (x2 - x1 + norm) * (y2 - y1 + norm)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    iw = jnp.clip(ix2 - ix1 + norm, 0.0, None)
+    ih = jnp.clip(iy2 - iy1 + norm, 0.0, None)
+    inter = iw * ih
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def nms(boxes, scores, iou_threshold=0.3, score_threshold=None, top_k=None,
+        box_normalized=True, _iou=None):
+    """Hard NMS.  Returns kept indices (descending score), padded with -1 to
+    a static length (top_k or len(boxes)) — XLA-friendly fixed shape.
+
+    Matches the reference NMSFast: only the top_k highest-scoring candidates
+    enter suppression (lower-ranked boxes can never be emitted).
+    _iou: optional precomputed [N, N] IoU matrix in ORIGINAL box order
+    (shared across classes by multiclass_nms).
+    """
+    boxes = ensure_tensor(boxes)._data
+    scores = ensure_tensor(scores)._data
+    n = boxes.shape[0]
+    k = n if top_k is None else min(int(top_k), n)
+
+    order = jnp.argsort(-scores)[:k]
+    if _iou is None:
+        iou = _iou_matrix(boxes[order], box_normalized)
+    else:
+        iou = _iou[order][:, order]
+    alive0 = jnp.ones(k, bool)
+    if score_threshold is not None:
+        alive0 = alive0 & (scores[order] > score_threshold)
+
+    def body(i, alive):
+        # if candidate i survives, kill its high-IoU successors
+        sup = (iou[i] > iou_threshold) & (jnp.arange(k) > i) & alive[i]
+        return alive & ~sup
+
+    alive = lax.fori_loop(0, k, body, alive0)
+    kept = jnp.where(alive, order, -1)
+    # compact: kept indices first, -1 padding after
+    sortkey = jnp.where(alive, jnp.arange(k), k)
+    kept = kept[jnp.argsort(sortkey)]
+    return Tensor(kept)
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
+                   keep_top_k, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, name=None):
+    """Reference fluid.layers.multiclass_nms, XLA-shaped: returns
+    (out [keep_top_k, 6] rows = [label, score, x1, y1, x2, y2] padded with
+    -1, valid_count scalar).  Single-image input: bboxes [M, 4],
+    scores [C, M].
+    """
+    bboxes_t = ensure_tensor(bboxes)._data
+    scores_t = ensure_tensor(scores)._data
+    c, m = scores_t.shape
+    iou = _iou_matrix(bboxes_t, normalized)  # shared across classes
+    rows = []
+    for cls in range(c):
+        if cls == background_label:
+            continue
+        keep = nms(Tensor(bboxes_t), Tensor(scores_t[cls]),
+                   iou_threshold=nms_threshold,
+                   score_threshold=score_threshold,
+                   top_k=min(nms_top_k, m) if nms_top_k > 0 else None,
+                   box_normalized=normalized, _iou=iou)._data
+        valid = keep >= 0
+        idx = jnp.clip(keep, 0, m - 1)
+        rows.append(jnp.concatenate([
+            jnp.where(valid, cls, -1.0)[:, None],
+            jnp.where(valid, scores_t[cls][idx], -1.0)[:, None],
+            jnp.where(valid[:, None], bboxes_t[idx], -1.0)], axis=1))
+    if not rows:  # only the background class exists
+        return (Tensor(jnp.full((keep_top_k, 6), -1.0, bboxes_t.dtype)),
+                Tensor(jnp.zeros((), jnp.int32)))
+    allrows = jnp.concatenate(rows, axis=0)
+    if allrows.shape[0] < keep_top_k:  # keep the promised static shape
+        pad = jnp.full((keep_top_k - allrows.shape[0], 6), -1.0,
+                       allrows.dtype)
+        allrows = jnp.concatenate([allrows, pad], axis=0)
+    valid = allrows[:, 0] >= 0
+    order = jnp.argsort(jnp.where(valid, -allrows[:, 1], jnp.inf))
+    allrows = allrows[order]
+    valid = allrows[:, 0] >= 0
+    out = allrows[:keep_top_k]
+    count = jnp.minimum(valid.sum(), keep_top_k)
+    return Tensor(out), Tensor(count.astype(jnp.int32))
+
+
+# ---- deform_conv2d (reference: vision/ops.py:394, deformable_conv_op) ---
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1 (mask=None) / v2 (modulated).
+
+    x [N, Cin, H, W]; offset [N, 2*DG*Kh*Kw, Ho, Wo];
+    mask [N, DG*Kh*Kw, Ho, Wo]; weight [Cout, Cin/g, Kh, Kw].
+    Implemented as bilinear sampling at offset kernel taps followed by a
+    1x1 contraction — the im2col+gemm structure of the reference CUDA
+    kernel, expressed as one XLA einsum.
+    """
+    x = ensure_tensor(x)
+    offset = ensure_tensor(offset)
+    weight = ensure_tensor(weight)
+    mask_t = ensure_tensor(mask) if mask is not None else None
+
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+
+    def fn(xa, off, wt, mk=None):
+        n, cin, h, w = xa.shape
+        cout, cin_g, kh, kw = wt.shape
+        ho = (h + 2 * padding[0] - (dilation[0] * (kh - 1) + 1)) \
+            // stride[0] + 1
+        wo = (w + 2 * padding[1] - (dilation[1] * (kw - 1) + 1)) \
+            // stride[1] + 1
+        dg = deformable_groups
+        off = off.reshape(n, dg, kh * kw, 2, ho, wo)
+        if mk is not None:
+            mk = mk.reshape(n, dg, kh * kw, ho, wo)
+
+        base_y = (jnp.arange(ho) * stride[0] - padding[0])
+        base_x = (jnp.arange(wo) * stride[1] - padding[1])
+
+        def sample(fmap, yy, xx):
+            """fmap [C,H,W], yy/xx [ho, wo] -> [C, ho, wo] bilinear, 0 pad"""
+            valid = (yy > -1.0) & (yy < h) & (xx > -1.0) & (xx < w)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            ly = yy - y0
+            lx = xx - x0
+
+            def tap(yi, xi):
+                inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+                yc = jnp.clip(yi, 0, h - 1)
+                xc = jnp.clip(xi, 0, w - 1)
+                v = fmap[:, yc, xc]  # [C, ho, wo] advanced indexing
+                return jnp.where(inb[None], v, 0.0)
+
+            out = (tap(y0, x0) * (1 - ly) * (1 - lx)
+                   + tap(y0, x0 + 1) * (1 - ly) * lx
+                   + tap(y0 + 1, x0) * ly * (1 - lx)
+                   + tap(y0 + 1, x0 + 1) * ly * lx)
+            return jnp.where(valid[None], out, 0.0)
+
+        cpg = cin // dg  # channels per deformable group
+
+        def per_image(img, off_i, mk_i):
+            cols = []
+            for ki in range(kh * kw):
+                i, j = ki // kw, ki % kw
+                taps = []
+                for g in range(dg):
+                    yy = (base_y[:, None] + i * dilation[0]
+                          + off_i[g, ki, 0])
+                    xx = (base_x[None, :] + j * dilation[1]
+                          + off_i[g, ki, 1])
+                    v = sample(img[g * cpg:(g + 1) * cpg], yy, xx)
+                    if mk_i is not None:
+                        v = v * mk_i[g, ki][None]
+                    taps.append(v)
+                cols.append(jnp.concatenate(taps, axis=0))  # [Cin, ho, wo]
+            return jnp.stack(cols, axis=1)  # [Cin, K, ho, wo]
+
+        if mk is not None:
+            cols = jax.vmap(per_image)(xa, off, mk)
+        else:
+            cols = jax.vmap(lambda img, off_i: per_image(img, off_i, None)
+                            )(xa, off)
+        # grouped contraction: weight [Cout, Cin/g, kh*kw]
+        wt2 = wt.reshape(cout, cin_g, kh * kw)
+        if groups == 1:
+            out = jnp.einsum("nckhw,ock->nohw", cols, wt2)
+        else:
+            cg_in = cin // groups
+            cg_out = cout // groups
+            outs = []
+            for g in range(groups):
+                outs.append(jnp.einsum(
+                    "nckhw,ock->nohw",
+                    cols[:, g * cg_in:(g + 1) * cg_in],
+                    wt2[g * cg_out:(g + 1) * cg_out]))
+            out = jnp.concatenate(outs, axis=1)
+        return out
+
+    if mask_t is not None:
+        prim = primitive(name="deform_conv2d")(fn)
+        out = prim(x, offset, weight, mask_t)
+    else:
+        prim = primitive(name="deform_conv2d")(
+            lambda xa, off, wt: fn(xa, off, wt, None))
+        out = prim(x, offset, weight)
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        add = primitive(name="deform_conv2d_bias")(
+            lambda o, b: o + b[None, :, None, None])
+        out = add(out, bias)
+    return out
+
+
+def __getattr__(name):
+    # lazy re-export: the layer lives in deform_layer.py because importing
+    # nn at module import time would cycle (nn -> vision -> nn)
+    if name == "DeformConv2D":
+        from .deform_layer import DeformConv2D
+        return DeformConv2D
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

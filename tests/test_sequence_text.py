@@ -1,0 +1,141 @@
+"""Sequence ops + text datasets tests (reference: sequence_ops/*,
+edit_distance_op, python/paddle/text/datasets)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import text
+from paddle_tpu.io import DataLoader
+
+
+def test_sequence_pad_unpad_roundtrip():
+    seqs = [np.arange(3 * 2).reshape(3, 2).astype(np.float32),
+            np.ones((1, 2), np.float32),
+            np.full((2, 2), 7.0, np.float32)]
+    padded, lens = F.sequence_pad(seqs, pad_value=0.0)
+    assert padded.shape == [3, 3, 2]
+    assert list(lens.numpy()) == [3, 1, 2]
+    assert np.all(padded.numpy()[1, 1:] == 0)
+    flat = F.sequence_unpad(padded, lens)
+    assert np.allclose(flat.numpy(), np.concatenate(seqs, axis=0))
+
+
+def test_sequence_pool_types():
+    x = np.array([[[1.0], [2.0], [3.0]],
+                  [[4.0], [5.0], [0.0]]], np.float32)
+    lens = np.array([3, 2], np.int64)
+    xp, lp = paddle.to_tensor(x), paddle.to_tensor(lens)
+    assert np.allclose(F.sequence_pool(xp, "sum", lp).numpy(),
+                       [[6.0], [9.0]])
+    assert np.allclose(F.sequence_pool(xp, "average", lp).numpy(),
+                       [[2.0], [4.5]])
+    assert np.allclose(F.sequence_pool(xp, "max", lp).numpy(),
+                       [[3.0], [5.0]])
+    assert np.allclose(F.sequence_pool(xp, "sqrt", lp).numpy(),
+                       [[6 / np.sqrt(3)], [9 / np.sqrt(2)]])
+    assert np.allclose(F.sequence_pool(xp, "first", lp).numpy(),
+                       [[1.0], [4.0]])
+    assert np.allclose(F.sequence_pool(xp, "last", lp).numpy(),
+                       [[3.0], [5.0]])
+
+
+def test_sequence_pool_gradient_masks_padding():
+    x = paddle.to_tensor(np.ones((2, 3, 1), np.float32))
+    x.stop_gradient = False
+    lens = paddle.to_tensor(np.array([3, 1], np.int64))
+    F.sequence_pool(x, "sum", lens).sum().backward()
+    g = x.grad.numpy()[:, :, 0]
+    assert np.allclose(g, [[1, 1, 1], [1, 0, 0]])
+
+
+def test_sequence_softmax_and_reverse():
+    x = np.array([[1.0, 2.0, 3.0], [1.0, 1.0, 9.0]], np.float32)
+    lens = np.array([3, 2], np.int64)
+    p = F.sequence_softmax(paddle.to_tensor(x), paddle.to_tensor(lens))
+    assert np.allclose(p.numpy()[0], np.exp(x[0]) / np.exp(x[0]).sum(),
+                       atol=1e-5)
+    assert np.allclose(p.numpy()[1], [0.5, 0.5, 0.0])
+
+    r = F.sequence_reverse(paddle.to_tensor(x[..., None]),
+                           paddle.to_tensor(lens))
+    assert np.allclose(r.numpy()[0, :, 0], [3.0, 2.0, 1.0])
+    assert np.allclose(r.numpy()[1, :, 0], [1.0, 1.0, 9.0])
+
+
+def test_sequence_expand():
+    x = np.array([[1.0], [2.0]], np.float32)
+    out = F.sequence_expand(paddle.to_tensor(x),
+                            paddle.to_tensor(np.array([2, 3], np.int64)))
+    assert np.allclose(out.numpy()[:, 0], [1, 1, 2, 2, 2])
+
+
+def test_edit_distance():
+    # "kitten" -> "sitting" distance 3 (classic)
+    hyp = np.array([[ord(c) for c in "kitten "]], np.int64)
+    ref = np.array([[ord(c) for c in "sitting"]], np.int64)
+    d, n = F.edit_distance(paddle.to_tensor(hyp), paddle.to_tensor(ref),
+                           normalized=False,
+                           input_length=paddle.to_tensor(
+                               np.array([6], np.int64)),
+                           label_length=paddle.to_tensor(
+                               np.array([7], np.int64)))
+    assert d.numpy()[0, 0] == 3.0
+    assert n.numpy()[0] == 1
+    dn, _ = F.edit_distance(paddle.to_tensor(hyp), paddle.to_tensor(ref),
+                            normalized=True,
+                            input_length=paddle.to_tensor(
+                                np.array([6], np.int64)),
+                            label_length=paddle.to_tensor(
+                                np.array([7], np.int64)))
+    assert np.allclose(dn.numpy()[0, 0], 3.0 / 7.0)
+
+
+def test_text_datasets_shapes(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_SYNTH_N", "32")
+    imdb = text.Imdb(mode="train")
+    doc, label = imdb[0]
+    assert doc.dtype == np.int64 and label.shape == (1,)
+    assert len(imdb) == 32
+
+    ngram = text.Imikolov(mode="train", window_size=5)
+    item = ngram[0]
+    assert len(item) == 5
+
+    conll = text.Conll05st(mode="train")
+    rec = conll[0]
+    assert len(rec) == 9  # words + 5 ctx + pred + mark + labels
+    words, labels = rec[0], rec[-1]
+    assert words.shape == labels.shape
+
+    ml = text.Movielens(mode="train")
+    assert len(ml[0]) == 8
+
+    housing = text.UCIHousing(mode="train")
+    feat, price = housing[0]
+    assert feat.shape == (13,) and price.shape == (1,)
+
+    wmt = text.WMT14(mode="train", dict_size=1000)
+    src, trg, trg_next = wmt[0]
+    assert trg[0] == 0 and trg_next[-1] == 1  # <s> ... </s>
+    assert len(trg) == len(trg_next)
+
+
+def test_uci_housing_trains(monkeypatch):
+    """End-to-end: linear regression on synthetic UCIHousing converges."""
+    monkeypatch.setenv("PADDLE_TPU_SYNTH_N", "256")
+    paddle.seed(0)
+    ds = text.UCIHousing(mode="train")
+    from paddle_tpu import nn, optimizer
+    net = nn.Linear(13, 1)
+    opt = optimizer.Adam(learning_rate=0.01,
+                         parameters=net.parameters())
+    loader = DataLoader(ds, batch_size=32, shuffle=True)
+    losses = []
+    for epoch in range(5):
+        for feat, price in loader:
+            loss = ((net(feat) - price) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]

@@ -1,0 +1,304 @@
+"""Detection/vision op tests — numpy references mirror the C++ kernels
+(yolo_box_op.h, roi_align_op.h, roi_pool_op, box_coder_op, nms)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+import paddle_tpu.nn.functional as F
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_yolo_box_matches_numpy_kernel():
+    rng = np.random.RandomState(0)
+    n, an_num, cls, h, w = 2, 3, 4, 5, 5
+    anchors = [10, 13, 16, 30, 33, 23]
+    ds = 32
+    x = rng.randn(n, an_num * (5 + cls), h, w).astype(np.float32)
+    img_size = np.array([[160, 160], [120, 140]], np.int32)
+
+    boxes, scores = vops.yolo_box(
+        paddle.to_tensor(x), paddle.to_tensor(img_size), anchors, cls,
+        conf_thresh=0.1, downsample_ratio=ds, clip_bbox=True)
+
+    # numpy reference (GetYoloBox / CalcDetectionBox / CalcLabelScore)
+    xa = x.reshape(n, an_num, 5 + cls, h, w)
+    input_h = input_w = ds * h
+    ref_boxes = np.zeros((n, an_num, h, w, 4), np.float32)
+    ref_scores = np.zeros((n, an_num, h, w, cls), np.float32)
+    for b in range(n):
+        ih, iw = img_size[b]
+        for a in range(an_num):
+            for i in range(h):
+                for j in range(w):
+                    conf = _sigmoid(xa[b, a, 4, i, j])
+                    if conf <= 0.1:
+                        continue
+                    cx = (j + _sigmoid(xa[b, a, 0, i, j])) * iw / w
+                    cy = (i + _sigmoid(xa[b, a, 1, i, j])) * ih / h
+                    bw = np.exp(xa[b, a, 2, i, j]) * anchors[2*a] * iw \
+                        / input_w
+                    bh = np.exp(xa[b, a, 3, i, j]) * anchors[2*a+1] * ih \
+                        / input_h
+                    x1 = max(cx - bw / 2, 0)
+                    y1 = max(cy - bh / 2, 0)
+                    x2 = min(cx + bw / 2, iw - 1)
+                    y2 = min(cy + bh / 2, ih - 1)
+                    ref_boxes[b, a, i, j] = [x1, y1, x2, y2]
+                    ref_scores[b, a, i, j] = conf * _sigmoid(xa[b, a, 5:,
+                                                               i, j])
+    assert np.allclose(boxes.numpy(),
+                       ref_boxes.reshape(n, -1, 4), atol=1e-3)
+    assert np.allclose(scores.numpy(),
+                       ref_scores.reshape(n, -1, cls), atol=1e-4)
+
+
+def test_roi_align_whole_map_avg():
+    # one ROI covering the full map, 1x1 output, aligned sampling ≈ mean
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    boxes = np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)
+    out = vops.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         output_size=2, sampling_ratio=2, aligned=False)
+    assert out.shape == [1, 1, 2, 2]
+    # each 2x2 output bin averages bilinear samples inside its quadrant;
+    # with exact grid alignment samples average to the quadrant centers
+    ref = np.zeros((2, 2), np.float32)
+    for ph in range(2):
+        for pw in range(2):
+            acc = 0.0
+            for iy in range(2):
+                for ix in range(2):
+                    y = ph * 2 + (iy + 0.5)
+                    xx = pw * 2 + (ix + 0.5)
+                    y0, x0 = int(y), int(xx)
+                    ly, lx = y - y0, xx - x0
+                    y1, x1 = min(y0 + 1, 3), min(x0 + 1, 3)
+                    v = (x[0, 0, y0, x0] * (1-ly) * (1-lx)
+                         + x[0, 0, y0, x1] * (1-ly) * lx
+                         + x[0, 0, y1, x0] * ly * (1-lx)
+                         + x[0, 0, y1, x1] * ly * lx)
+                    acc += v
+            ref[ph, pw] = acc / 4
+    assert np.allclose(out.numpy()[0, 0], ref, atol=1e-4)
+
+
+def test_roi_align_gradient_flows():
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .rand(1, 2, 6, 6).astype(np.float32))
+    x.stop_gradient = False
+    boxes = paddle.to_tensor(np.array([[1.0, 1.0, 5.0, 5.0]], np.float32))
+    out = vops.roi_align(x, boxes, output_size=2, sampling_ratio=2)
+    out.sum().backward()
+    g = x.grad.numpy()
+    assert g.shape == (1, 2, 6, 6) and np.abs(g).sum() > 0
+
+
+def test_roi_pool_max():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    boxes = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+    out = vops.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                        output_size=1)
+    assert out.numpy().reshape(-1)[0] == 15.0
+    out2 = vops.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         output_size=2)
+    assert np.allclose(out2.numpy()[0, 0], [[5, 7], [13, 15]])
+
+
+def test_prior_box():
+    inp = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    boxes, var = vops.prior_box(inp, img, min_sizes=[4.0],
+                                aspect_ratios=[1.0, 2.0], flip=True,
+                                clip=True)
+    assert boxes.shape == [2, 2, 3, 4]  # ar 1, 2, 1/2
+    assert var.shape == [2, 2, 3, 4]
+    b = boxes.numpy()
+    # cell (0,0) center = (8, 8); ar=1 prior is 4x4 -> [6,6,10,10]/32
+    assert np.allclose(b[0, 0, 0], np.array([6, 6, 10, 10]) / 32.0,
+                       atol=1e-5)
+    assert (b >= 0).all() and (b <= 1).all()
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(0)
+    priors = np.abs(rng.rand(5, 4).astype(np.float32))
+    priors[:, 2:] += priors[:, :2] + 0.1
+    targets = np.abs(rng.rand(3, 4).astype(np.float32))
+    targets[:, 2:] += targets[:, :2] + 0.1
+    var = [0.1, 0.1, 0.2, 0.2]
+    enc = vops.box_coder(paddle.to_tensor(priors), var,
+                         paddle.to_tensor(targets),
+                         code_type="encode_center_size")
+    assert enc.shape == [3, 5, 4]
+    # decode row j of enc against priors -> recovers targets
+    dec = vops.box_coder(paddle.to_tensor(priors), var,
+                         paddle.to_tensor(enc.numpy()[:, :, :]),
+                         code_type="decode_center_size", axis=0)
+    for j in range(3):
+        assert np.allclose(dec.numpy()[j, 0], targets[j], atol=1e-3)
+
+
+def test_nms():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+                      [0, 0, 9, 9]], np.float32)
+    scores = np.array([0.9, 0.8, 0.7, 0.6], np.float32)
+    keep = vops.nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                    iou_threshold=0.5).numpy()
+    # box1 overlaps box0 (IoU≈0.68) -> suppressed; box3 IoU with box0 = 0.81
+    assert list(keep[keep >= 0]) == [0, 2]
+
+
+def test_multiclass_nms():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                     np.float32)
+    scores = np.array([[0.05, 0.05, 0.05],     # background
+                       [0.9, 0.85, 0.1],
+                       [0.02, 0.03, 0.95]], np.float32)
+    out, count = vops.multiclass_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.1, nms_top_k=10, keep_top_k=5,
+        nms_threshold=0.5, background_label=0)
+    n = int(count.numpy())
+    rows = out.numpy()[:n]
+    # class1 keeps box0 (0.9, suppresses box1), class2 keeps box2 (0.95)
+    assert n == 2
+    assert np.allclose(sorted(rows[:, 1]), [0.9, 0.95])
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 4, 7, 7).astype(np.float32)
+    w = rng.rand(6, 4, 3, 3).astype(np.float32)
+    offset = np.zeros((2, 2 * 9, 7, 7), np.float32)
+    out = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                             paddle.to_tensor(w), stride=1, padding=1)
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), stride=1,
+                   padding=1)
+    assert np.allclose(out.numpy(), ref.numpy(), atol=1e-4)
+
+
+def test_deform_conv2d_mask_and_layer():
+    rng = np.random.RandomState(1)
+    x = rng.rand(1, 4, 5, 5).astype(np.float32)
+    w = rng.rand(2, 4, 3, 3).astype(np.float32)
+    offset = np.zeros((1, 18, 5, 5), np.float32)
+    mask = np.full((1, 9, 5, 5), 0.5, np.float32)
+    out = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                             paddle.to_tensor(w), padding=1,
+                             mask=paddle.to_tensor(mask))
+    ref = F.conv2d(paddle.to_tensor(x * 1.0), paddle.to_tensor(w),
+                   padding=1)
+    assert np.allclose(out.numpy(), ref.numpy() * 0.5, atol=1e-4)
+
+    layer = vops.DeformConv2D(4, 2, 3, padding=1)
+    y = layer(paddle.to_tensor(x), paddle.to_tensor(offset))
+    assert y.shape == [1, 2, 5, 5]
+    assert len(list(layer.parameters())) == 2
+
+
+def test_yolo_loss_numpy_reference():
+    """Mirror yolov3_loss_op.h on a tiny case."""
+    rng = np.random.RandomState(0)
+    n, mask_num, cls, h, w = 1, 2, 3, 4, 4
+    anchors = [10, 14, 23, 27, 37, 58]
+    anchor_mask = [0, 1]
+    ds = 32
+    x = rng.randn(n, mask_num * (5 + cls), h, w).astype(np.float32) * 0.5
+    gt_box = np.array([[[0.3, 0.3, 0.1, 0.12],
+                        [0.7, 0.6, 0.2, 0.18],
+                        [0.0, 0.0, 0.0, 0.0]]], np.float32)  # last invalid
+    gt_label = np.array([[1, 2, 0]], np.int32)
+
+    loss = vops.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt_box),
+                          paddle.to_tensor(gt_label), anchors, anchor_mask,
+                          cls, ignore_thresh=0.7, downsample_ratio=ds,
+                          use_label_smooth=False)
+    assert loss.shape == [1]
+
+    # numpy reference
+    def sigmoid(v):
+        return 1 / (1 + np.exp(-v))
+
+    def sce(logit, label):
+        return max(logit, 0) - logit * label + np.log1p(np.exp(-abs(logit)))
+
+    def iou_cwh(b1, b2):
+        l = max(b1[0]-b1[2]/2, b2[0]-b2[2]/2)
+        r = min(b1[0]+b1[2]/2, b2[0]+b2[2]/2)
+        t = max(b1[1]-b1[3]/2, b2[1]-b2[3]/2)
+        b = min(b1[1]+b1[3]/2, b2[1]+b2[3]/2)
+        iw, ih = max(r-l, 0), max(b-t, 0)
+        inter = iw*ih
+        u = b1[2]*b1[3] + b2[2]*b2[3] - inter
+        return inter/u if u > 0 else 0.0
+
+    input_size = ds * h
+    an_num = len(anchors)//2
+    xa = x.reshape(n, mask_num, 5+cls, h, w)
+    obj_mask = np.zeros((mask_num, h, w))
+    expect = 0.0
+    # ignore mask
+    for m in range(mask_num):
+        for j in range(h):
+            for i in range(w):
+                px = (i + sigmoid(xa[0, m, 0, j, i])) / w
+                py = (j + sigmoid(xa[0, m, 1, j, i])) / h
+                pw = np.exp(xa[0, m, 2, j, i]) * anchors[2*anchor_mask[m]] \
+                    / input_size
+                ph = np.exp(xa[0, m, 3, j, i]) * \
+                    anchors[2*anchor_mask[m]+1] / input_size
+                best = max(iou_cwh((px, py, pw, ph), g)
+                           for g in gt_box[0][:2])
+                if best > 0.7:
+                    obj_mask[m, j, i] = -1
+    # positives
+    for t in range(2):
+        g = gt_box[0, t]
+        gi, gj = int(g[0]*w), int(g[1]*h)
+        best_iou, best_n = 0, 0
+        for a in range(an_num):
+            iou = iou_cwh((0, 0, anchors[2*a]/input_size,
+                           anchors[2*a+1]/input_size),
+                          (0, 0, g[2], g[3]))
+            if iou > best_iou:
+                best_iou, best_n = iou, a
+        if best_n not in anchor_mask:
+            continue
+        mi = anchor_mask.index(best_n)
+        tx, ty = g[0]*w - gi, g[1]*h - gj
+        tw = np.log(g[2]*input_size/anchors[2*best_n])
+        th = np.log(g[3]*input_size/anchors[2*best_n+1])
+        s = 2.0 - g[2]*g[3]
+        e = xa[0, mi, :, gj, gi]
+        expect += (sce(e[0], tx) + sce(e[1], ty)
+                   + abs(e[2]-tw) + abs(e[3]-th)) * s
+        obj_mask[mi, gj, gi] = 1.0
+        for c in range(cls):
+            expect += sce(e[5+c], 1.0 if c == gt_label[0, t] else 0.0)
+    # objectness
+    for m in range(mask_num):
+        for j in range(h):
+            for i in range(w):
+                o = obj_mask[m, j, i]
+                logit = xa[0, m, 4, j, i]
+                if o > 1e-5:
+                    expect += sce(logit, 1.0) * o
+                elif o > -0.5:
+                    expect += sce(logit, 0.0)
+    assert np.allclose(loss.numpy()[0], expect, rtol=1e-4), \
+        (loss.numpy(), expect)
+
+
+def test_yolo_loss_gradient():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(1, 16, 4, 4).astype(np.float32))
+    x.stop_gradient = False
+    gt = paddle.to_tensor(np.array([[[0.4, 0.4, 0.3, 0.3]]], np.float32))
+    lbl = paddle.to_tensor(np.array([[1]], np.int32))
+    loss = vops.yolo_loss(x, gt, lbl, [10, 14, 23, 27], [0, 1], 3,
+                          ignore_thresh=0.7, downsample_ratio=32)
+    loss.sum().backward()
+    assert np.abs(x.grad.numpy()).sum() > 0
