@@ -21,3 +21,4 @@ from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .launch import launch_main  # noqa: F401
 from .ring import ring_attention  # noqa: F401
+from .moe import MoELayer, ExpertFFN, top_k_gating  # noqa: F401

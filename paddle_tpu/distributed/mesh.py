@@ -4,7 +4,7 @@ Reference parity: this replaces the whole comm-bootstrap layer —
 ``NCCLCommContext`` ring registry (platform/collective_helper.h:65),
 ``gen_comm_id_helper.cc`` TCP bootstrap, and ``c_comm_init_op`` — with named
 mesh axes over ICI/DCN.  A reference ``ring_id`` maps to a mesh axis name
-('dp', 'sharding', 'mp', 'pp', 'sp'); XLA inserts the collectives.
+('dp', 'sharding', 'mp', 'pp', 'sp', 'ep'); XLA inserts the collectives.
 """
 from __future__ import annotations
 
@@ -16,23 +16,29 @@ import jax
 from jax.sharding import Mesh, PartitionSpec, NamedSharding
 
 # canonical hybrid-parallel axis order (outer → inner = DCN → ICI)
-AXES = ("dp", "sharding", "pp", "mp", "sp")
+AXES = ("dp", "sharding", "pp", "mp", "sp", "ep")
 
 _global_mesh: Mesh | None = None
 
 
-def build_mesh(dp=1, sharding=1, pp=1, mp=1, sp=1, devices=None) -> Mesh:
+def build_mesh(dp=1, sharding=1, pp=1, mp=1, sp=1, ep=1,
+               devices=None) -> Mesh:
     """Create a hybrid-parallel mesh.  Any axis left at 1 still exists (size
     1) so sharding specs are uniform across strategies."""
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
-    sizes = {"dp": dp, "sharding": sharding, "pp": pp, "mp": mp, "sp": sp}
+    sizes = {"dp": dp, "sharding": sharding, "pp": pp, "mp": mp, "sp": sp,
+             "ep": ep}
     used = int(np.prod(list(sizes.values())))
     if used == 1:
         sizes["dp"] = n
         used = n
     elif sizes["dp"] == -1:
-        sizes["dp"] = n // (used // 1)  # fill remainder into dp
+        rest = int(np.prod([v for k, v in sizes.items() if k != "dp"]))
+        if rest == 0 or n % rest != 0:
+            raise ValueError(
+                f"cannot fill dp: {n} devices not divisible by {rest}")
+        sizes["dp"] = n // rest  # fill remainder into dp
         used = int(np.prod(list(sizes.values())))
     if used != n:
         raise ValueError(

@@ -83,6 +83,20 @@ def _constraint(arr, *spec):
     mesh = mesh_mod.get_mesh()
     if mesh is None or not isinstance(arr, jax.core.Tracer):
         return arr
+    # drop axes the current mesh doesn't actually split (size 1): the
+    # constraint would be a no-op under pjit but *fails* in an eager vjp
+    # trace, where the array lives on one device (e.g. a lazily-built
+    # default mesh with mp=ep=1 while running an eager MoE/TP forward).
+    # Unknown axis names still raise — that's a typo, not a size-1 mesh.
+    for a in spec:
+        if a is not None and a not in mesh.shape:
+            raise ValueError(
+                f"sharding axis {a!r} not in mesh axes "
+                f"{tuple(mesh.shape)}")
+    spec = tuple(a if (a is not None and mesh.shape[a] > 1) else None
+                 for a in spec)
+    if all(a is None for a in spec):
+        return arr
     return jax.lax.with_sharding_constraint(
         arr, mesh_mod.named_sharding(*spec))
 

@@ -138,12 +138,16 @@ class GPTBlock(nn.Layer):
     """Pre-LN transformer block — the pipelined unit for GPTPipe."""
 
     def __init__(self, hidden_size, num_heads, dropout=0.1, use_mp=False,
-                 use_recompute=False):
+                 use_recompute=False, moe_experts=0):
         super().__init__()
         self.ln1 = nn.LayerNorm(hidden_size)
         self.attn = GPTAttention(hidden_size, num_heads, dropout, use_mp)
         self.ln2 = nn.LayerNorm(hidden_size)
-        self.mlp = GPTMLP(hidden_size, dropout=dropout, use_mp=use_mp)
+        if moe_experts:
+            from ..distributed.moe import MoELayer
+            self.mlp = MoELayer(hidden_size, num_experts=moe_experts)
+        else:
+            self.mlp = GPTMLP(hidden_size, dropout=dropout, use_mp=use_mp)
         self.use_recompute = use_recompute
 
     def _inner(self, x):
@@ -182,14 +186,23 @@ class GPTModel(nn.Layer):
 
     def __init__(self, num_layers=12, hidden_size=768, num_heads=12,
                  vocab_size=50304, max_position=1024, dropout=0.1,
-                 use_mp=False, use_recompute=False):
+                 use_mp=False, use_recompute=False, moe_experts=0,
+                 moe_every=2):
         super().__init__()
         self.embeddings = GPTEmbeddings(vocab_size, hidden_size,
                                         max_position, dropout, use_mp)
+        # moe_experts>0: every `moe_every`-th block (1-based) swaps its FFN
+        # for an expert-parallel MoE layer; moe_every=1 -> every block
+        if moe_experts and moe_every < 1:
+            raise ValueError(f"moe_every must be >= 1, got {moe_every}")
         self.blocks = nn.LayerList([
             GPTBlock(hidden_size, num_heads, dropout, use_mp,
-                     use_recompute)
-            for _ in range(num_layers)])
+                     use_recompute,
+                     moe_experts=(moe_experts
+                                  if moe_experts
+                                  and (i + 1) % moe_every == 0
+                                  else 0))
+            for i in range(num_layers)])
         self.head = GPTLMHead(hidden_size, vocab_size, use_mp)
 
     def forward(self, input_ids):

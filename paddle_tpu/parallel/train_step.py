@@ -222,6 +222,14 @@ class TrainStep:
                 if isinstance(out, tuple):
                     out = out[0]
                 loss = self._loss_from_out(out, labels)
+                # expert-parallel models: add MoE load-balancing aux loss
+                # (already scaled by each layer's aux_weight)
+                from ..distributed.moe import collect_moe_aux_loss
+                aux = collect_moe_aux_loss(model)
+                if aux is not None:
+                    # loss is a raw array here (see _loss_from_out)
+                    loss = loss + (aux._data if isinstance(aux, Tensor)
+                                   else aux)
             return loss.astype(jnp.float32), [new_buf[k] for k in bnames]
 
         trainable = self._trainable

@@ -51,7 +51,7 @@ class TestMesh:
     def test_build_hybrid(self):
         mesh = dist.build_mesh(dp=2, mp=2, pp=2)
         assert mesh.shape == {"dp": 2, "sharding": 1, "pp": 2, "mp": 2,
-                              "sp": 1}
+                              "sp": 1, "ep": 1}
 
     def test_mismatch_raises(self):
         with pytest.raises(ValueError):
