@@ -163,13 +163,13 @@ class MoELayer(Layer):
         if self._last_aux is None:
             return None
         import jax
-        data = self._last_aux._data
-        if isinstance(data, jax.core.Tracer) and \
-                jax.core.trace_state_clean():
-            self._last_aux = None  # stale tracer from a completed trace
-            return None
         from ..ops.math import multiply
-        return multiply(self._last_aux, self.aux_weight)
+        try:
+            return multiply(self._last_aux, self.aux_weight)
+        except jax.errors.UnexpectedTracerError:
+            # Stale tracer from a completed trace — drop it.
+            self._last_aux = None
+            return None
 
 
 def collect_moe_aux_loss(layer: Layer):
