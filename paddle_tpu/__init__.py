@@ -81,6 +81,9 @@ from . import text  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
 from .framework.io import save, load  # noqa: E402,F401
+from .static import (enable_static, disable_static,  # noqa: E402,F401
+                     in_dynamic_mode)
+from .static.program import in_static_mode  # noqa: E402,F401
 from .hapi.model import Model  # noqa: E402,F401
 from .nn.layer.base import Layer  # noqa: E402,F401
 from . import framework  # noqa: E402,F401

@@ -31,6 +31,12 @@ from . import dtype as dtypes
 # set by paddle_tpu.amp at import; fn(op_name, arrays) -> arrays
 amp_input_hook = None
 
+# set by paddle_tpu.static at import; fn(op_name, raw_fn, args, kwargs,
+# has_aux) -> recorded Variables, or NotImplemented to run eagerly.  This is
+# the single switch between the two execution modes the reference needed two
+# runtimes for (imperative/tracer.cc vs framework/executor.cc).
+static_record_hook = None
+
 
 def _unwrap(x):
     return x._data if isinstance(x, Tensor) else x
@@ -66,6 +72,10 @@ def primitive(name=None, nondiff=(), has_aux=False):
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
+            if static_record_hook is not None:
+                rec = static_record_hook(op_name, fn, args, kwargs, has_aux)
+                if rec is not NotImplemented:
+                    return rec
             arrays = [_unwrap(a) for a in args]
             if amp_input_hook is not None:
                 arrays = amp_input_hook(op_name, arrays)

@@ -60,6 +60,23 @@ def next_key():
     return sub
 
 
+def op_key(*inputs):
+    """Key for a stochastic op over the given Tensor inputs.
+
+    If any input is a static-graph Variable, returns a symbolic key that
+    the Executor replaces with a fresh key each run (so e.g. dropout masks
+    differ per iteration — reference dropout_op.cc per-execution seeds);
+    otherwise draws from the global/traced stream."""
+    try:
+        from ..static import program as sprog
+        if sprog.in_static_mode() and any(
+                isinstance(a, sprog.Variable) for a in inputs):
+            return sprog.default_main_program().rng_key_var()
+    except ImportError:
+        pass
+    return next_key()
+
+
 def key_for(seed_val: int | None):
     """Key from an explicit seed, or the global stream if None/0."""
     if seed_val:

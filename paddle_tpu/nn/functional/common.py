@@ -41,7 +41,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             return primitive(name="dropout_scale")(
                 lambda a: a * (1.0 - p))(x)
         return x
-    key = rng.next_key()
+    key = rng.op_key(x)
     if axis is not None:
         axes = [axis] if isinstance(axis, int) else list(axis)
         mask_shape = tuple(s if i in axes else 1
@@ -49,14 +49,14 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
     else:
         mask_shape = tuple(x.shape)
 
-    @primitive(name="dropout")
-    def _dropout(a):
-        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+    @primitive(name="dropout", nondiff=(1,))
+    def _dropout(a, k):
+        keep = jax.random.bernoulli(k, 1.0 - p, mask_shape)
         if mode == "upscale_in_train":
             return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
         return jnp.where(keep, a, 0.0).astype(a.dtype)
 
-    return _dropout(x)
+    return _dropout(x, key)
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):

@@ -7,6 +7,7 @@ same code path works eagerly and under jit tracing.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ...core.dispatch import primitive, ensure_tensor
@@ -65,11 +66,27 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     out, batch_mean, batch_var = res
     if running_mean is not None:
         m = momentum
-        running_mean._data = (m * running_mean._data
-                              + (1 - m) * batch_mean._data)
-        running_var._data = (m * running_var._data
-                             + (1 - m) * batch_var._data)
+        if isinstance(getattr(batch_mean, "_data", None),
+                      jax.ShapeDtypeStruct):
+            # static graph mode: record moving-average writebacks into the
+            # persistable stats (reference: batch_norm_op MeanOut/VarianceOut)
+            from ...static import program as sprog
+            prog = sprog.default_main_program()
+            prog.record_assign(running_mean,
+                               _ema(running_mean, batch_mean, momentum=m))
+            prog.record_assign(running_var,
+                               _ema(running_var, batch_var, momentum=m))
+        else:
+            running_mean._data = (m * running_mean._data
+                                  + (1 - m) * batch_mean._data)
+            running_var._data = (m * running_var._data
+                                 + (1 - m) * batch_var._data)
     return out
+
+
+@primitive(name="bn_moving_stat")
+def _ema(running, batch, momentum=0.9):
+    return running * momentum + batch * (1 - momentum)
 
 
 @primitive(name="layer_norm")

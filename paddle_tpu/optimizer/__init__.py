@@ -135,6 +135,15 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static import program as sprog
+        if isinstance(loss, sprog.Variable):
+            # static graph mode (reference: Optimizer.minimize appending
+            # grad + optimize ops to the program, fluid/optimizer.py)
+            pairs = sprog.append_backward(
+                loss,
+                parameter_list=parameters or self._parameter_list or None)
+            sprog.append_optimize(self, loss, pairs)
+            return None, pairs
         params = [p for p in self._params() if p.trainable]
         if builtins_all(p.grad is None for p in params) and \
                 loss._grad_node is not None:

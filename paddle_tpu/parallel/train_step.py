@@ -292,17 +292,7 @@ class TrainStep:
             new_buffers = dict(zip(bnames, new_b_list))
             return loss, new_params, new_buffers, new_opt
 
-        data_world = 1
-        for ax in DATA_AXES:
-            data_world *= self.mesh.shape.get(ax, 1)
-
-        def batch_sharding(shape):
-            # non-divisible batches fall back to replicated (correct, just
-            # not data-parallel) — mirrors DistributedBatchSampler padding
-            # being the "right" fix upstream
-            if shape and shape[0] % data_world == 0:
-                return NamedSharding(self.mesh, _batch_spec(len(shape)))
-            return NamedSharding(self.mesh, P())
+        batch_sharding = self._data_sharding
 
         in_shardings = (
             {k: NamedSharding(self.mesh, self.param_specs[k])
@@ -338,6 +328,17 @@ class TrainStep:
         return jax.jit(step, donate_argnums=donate)
 
     # ------------------------------------------------------------------
+    def _data_sharding(self, shape):
+        # non-divisible batches fall back to replicated (correct, just not
+        # data-parallel) — mirrors DistributedBatchSampler padding being
+        # the "right" fix upstream
+        data_world = 1
+        for ax in DATA_AXES:
+            data_world *= self.mesh.shape.get(ax, 1)
+        if shape and shape[0] % data_world == 0:
+            return NamedSharding(self.mesh, _batch_spec(len(shape)))
+        return NamedSharding(self.mesh, P())
+
     def step(self, inputs, labels=()):
         """Run one optimization step on a global batch."""
         if not isinstance(inputs, (list, tuple)):
@@ -348,6 +349,14 @@ class TrainStep:
                      for x in inputs]
         lab_arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
                       for x in labels]
+        if not self.is_pipeline:
+            # batches may arrive committed to one device (DataLoader
+            # Tensors); re-place them on the mesh so they match the step's
+            # declared in_shardings
+            in_arrays = [jax.device_put(a, self._data_sharding(a.shape))
+                         for a in in_arrays]
+            lab_arrays = [jax.device_put(a, self._data_sharding(a.shape))
+                          for a in lab_arrays]
         key = rng_mod.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         shapes_key = (len(in_arrays),
@@ -392,10 +401,19 @@ class TrainStep:
                     for k, v in params.items():
                         named[k]._data = v
             return
+        # re-place on one device: the Layer copy serves eager eval/predict,
+        # where mixing mesh-committed and single-device arrays is an error
+        dev = next(iter(self.mesh.devices.flat))
+
+        def _local(a):
+            if isinstance(a, jax.Array) and len(a.devices()) > 1:
+                return jax.device_put(np.asarray(a), dev)
+            return a
+
         named = dict(self.model.named_parameters())
         for k in self.pnames:
-            named[k]._data = self.params[k]
+            named[k]._data = _local(self.params[k])
         named_b = dict(self.model.named_buffers())
         for k in self.bnames:
             if k in named_b and named_b[k] is not None:
-                named_b[k]._data = self.buffers[k]
+                named_b[k]._data = _local(self.buffers[k])

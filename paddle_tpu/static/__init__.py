@@ -1,13 +1,27 @@
-"""paddle.static parity shims.
+"""paddle.static — declarative graph mode, TPU-native.
 
-The reference's static graph (ProgramDesc + Executor) has no TPU analogue —
-SURVEY.md §7 layer 4: the trace-compile boundary IS the static mode.  This
-module keeps the handful of static-API entry points that user code touches
-(InputSpec, default programs as opaque handles, name scopes).
+Reference parity: the static-graph half of the reference (``fluid/
+framework.py`` Program/Block/Variable, ``fluid/executor.py``,
+``fluid/backward.py``, ``fluid/layers/nn.py``).  See program.py /
+executor.py docstrings for the design mapping (deferred op graph → one
+jax.jit'd function instead of ProgramDesc → op-by-op interpreter).
 """
 from __future__ import annotations
 
 from ..core import dtype as dtypes
+from ..core import dispatch as _dispatch
+
+from .program import (Program, Variable, Block, enable_static,  # noqa: F401
+                      disable_static, in_static_mode, in_dynamic_mode,
+                      default_main_program, default_startup_program,
+                      program_guard, data, global_scope, scope_guard,
+                      Scope, append_backward, append_optimize,
+                      _record_hook)
+from .executor import Executor, save, load  # noqa: F401
+from . import nn  # noqa: F401
+
+# NOTE: the op-dispatch recorder hook is installed by enable_static() and
+# removed by disable_static(), so dynamic mode pays no dispatch overhead.
 
 
 class InputSpec:
@@ -27,42 +41,6 @@ class InputSpec:
         return cls(tensor.shape, tensor.dtype, name)
 
 
-class Program:
-    """Opaque placeholder: XLA owns the compiled program."""
-
-    def __init__(self):
-        self._is_start_up = False
-
-    def global_block(self):
-        return self
-
-    def clone(self, for_test=False):
-        return self
-
-
-_default_main = Program()
-_default_startup = Program()
-
-
-def default_main_program():
-    return _default_main
-
-
-def default_startup_program():
-    return _default_startup
-
-
-class program_guard:
-    def __init__(self, main_program=None, startup_program=None):
-        pass
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *a):
-        return False
-
-
 class name_scope:
     def __init__(self, prefix=None):
         pass
@@ -77,3 +55,25 @@ class name_scope:
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     raise NotImplementedError(
         "py_func: host callbacks map to jax.pure_callback; not yet wired")
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference fluid/backward.py gradients() — static grad query.
+
+    Multiple targets are summed (matching the reference's accumulation of
+    grad contributions across targets)."""
+    if target_gradients is not None:
+        raise NotImplementedError(
+            "static.gradients: target_gradients (custom output cotangents) "
+            "is not supported yet")
+    if no_grad_set:
+        raise NotImplementedError(
+            "static.gradients: no_grad_set is not supported yet; pass only "
+            "the wanted inputs instead")
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    total = targets[0]
+    for t in targets[1:]:
+        total = total + t
+    pairs = append_backward(total, parameter_list=list(inputs))
+    return [g for _, g in pairs]

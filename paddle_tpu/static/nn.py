@@ -1,0 +1,107 @@
+"""paddle.static.nn — graph-building layer functions.
+
+Reference parity: ``python/paddle/fluid/layers/nn.py`` (fc, conv2d,
+batch_norm, embedding…) — the declarative twins of the nn.functional ops.
+Each call creates eager Parameters (persistables) and applies the same
+``primitive``-wrapped functionals, which record into the default Program
+when handed symbolic Variables.  One op library serves both modes — the
+reference needed per-op OpMaker+InferShape+kernels for this.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+from ..nn import initializer as init_mod
+from ..nn import functional as F
+from ..utils import unique_name
+from . import program as prog_mod
+
+
+def _make_param(shape, dtype, attr, default_init, name_hint):
+    name = None
+    initializer = default_init
+    if attr is not None and not isinstance(attr, bool):
+        name = getattr(attr, "name", None)
+        if getattr(attr, "initializer", None) is not None:
+            initializer = attr.initializer
+    return Parameter(initializer(shape, dtype),
+                     name=name or unique_name.generate(name_hint))
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference: fluid/layers/nn.py fc — x @ W + b (+activation)."""
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    w = _make_param([in_dim, size], "float32", weight_attr,
+                    init_mod.XavierUniform(), "fc_w")
+    from .. import ops
+    xf = ops.reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim]) \
+        if len(x.shape) > num_flatten_dims + 1 else x
+    out = ops.matmul(xf, w)
+    if bias_attr is not False:
+        b = _make_param([size], "float32", bias_attr,
+                        init_mod.Constant(0.0), "fc_b")
+        out = out + b
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    w = _make_param([num_filters, in_ch // groups] + list(filter_size),
+                    "float32", param_attr, init_mod.XavierUniform(),
+                    "conv_w")
+    b = None
+    if bias_attr is not False:
+        b = _make_param([num_filters], "float32", bias_attr,
+                        init_mod.Constant(0.0), "conv_b")
+    out = F.conv2d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups,
+                   data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               moving_mean_name=None, moving_variance_name=None, name=None):
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    weight = _make_param([c], "float32", param_attr, init_mod.Constant(1.0),
+                         "bn_scale")
+    bias = _make_param([c], "float32", bias_attr, init_mod.Constant(0.0),
+                       "bn_bias")
+    mean = Tensor(np.zeros([c], "float32"),
+                  name=moving_mean_name or unique_name.generate("bn_mean"))
+    var = Tensor(np.ones([c], "float32"),
+                 name=moving_variance_name or unique_name.generate("bn_var"))
+    mean.persistable = var.persistable = True
+    out = F.batch_norm(input, mean, var, weight, bias, training=not is_test,
+                       momentum=momentum, epsilon=epsilon,
+                       data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    w = _make_param(list(size), dtype, param_attr, init_mod.Normal(0., .02),
+                    "emb_w")
+    return F.embedding(input, w, padding_idx=padding_idx, sparse=is_sparse)
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, seed=None, name=None):
+    return F.dropout(x, p=dropout_prob, training=not is_test)
+
+
+# control flow: symbolic cond/while over recorded subgraphs is intentionally
+# NOT rebuilt (reference: operators/controlflow/conditional_block_op.cc,
+# while_op.cc).  TPU-native control flow happens inside jitted fns with
+# lax.cond/lax.while_loop via paddle.jit / dygraph-to-static.

@@ -1,0 +1,502 @@
+"""Static graph: Program / Block / Variable and the op recorder.
+
+Reference parity: the declarative ("static graph") mode —
+``python/paddle/fluid/framework.py`` Program (:4127) / Block (:2641) /
+Operator (:2042) / Variable (:978), built by the same layer code that runs
+eagerly, then executed by an Executor.
+
+TPU-native design: a Program is NOT a serialized ProgramDesc interpreted op
+by op (the reference's ``framework.proto`` + ``executor.cc:166`` path).  It
+is a deferred op graph: every ``core.dispatch.primitive`` call whose inputs
+contain a symbolic :class:`Variable` appends an :class:`OpNode` (the pure
+jax function + argument bindings) instead of executing.  Shape inference is
+``jax.eval_shape`` over the recorded function — the exact analogue of the
+reference's compile-time InferShape (``framework/shape_inference.h``).  The
+Executor then composes the node list into one Python function and hands it
+to ``jax.jit``: XLA plays the role of ParallelExecutor + all 142 IR passes.
+
+Parameters created while building (eager Tensors) are captured as named
+*persistables* — the analogue of scope-resident variables
+(``framework/scope.h:52``); optimizer updates write back into them.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+from ..utils import unique_name
+
+# ---------------------------------------------------------------------------
+# mode switch (paddle.enable_static / paddle.disable_static)
+
+_static_mode = [False]
+
+
+def enable_static():
+    _static_mode[0] = True
+    # install the recorder only while static mode is on so dynamic-mode op
+    # dispatch pays zero overhead (mirrors amp_input_hook gating)
+    from ..core import dispatch as _dispatch
+    _dispatch.static_record_hook = _record_hook
+
+
+def disable_static():
+    _static_mode[0] = False
+    from ..core import dispatch as _dispatch
+    _dispatch.static_record_hook = None
+
+
+def in_static_mode():
+    return _static_mode[0]
+
+
+def in_dynamic_mode():
+    return not _static_mode[0]
+
+
+# ---------------------------------------------------------------------------
+
+
+class Variable(Tensor):
+    """Symbolic tensor inside a Program (reference: framework.py:978).
+
+    ``_data`` is a ``jax.ShapeDtypeStruct`` so shape/dtype propagate through
+    the same Tensor-facing code paths that eager arrays use.
+    """
+
+    def __init__(self, block, shape, dtype, name=None):
+        # deliberately does NOT call Tensor.__init__ (no concrete storage)
+        self._data = jax.ShapeDtypeStruct(
+            tuple(int(s) for s in shape), dtypes.to_jax(dtype))
+        self._stop_gradient = True
+        self.grad = None
+        self._grad_node = None
+        self._retain_grad = False
+        self.name = name or unique_name.generate("var")
+        self.persistable = False
+        self.block = block
+        self._vid = block.program._new_vid(self)
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' is symbolic (static graph mode); run it "
+            "through Executor.run(fetch_list=[...]) to get a value. "
+            "(reference parity: fluid Variables have no data until run)")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+    __str__ = __repr__
+
+
+class OpNode:
+    """One recorded op: pure jax fn + bindings (reference: Operator)."""
+
+    __slots__ = ("op_name", "fn", "kwargs", "in_refs", "out_vids", "has_aux")
+
+    def __init__(self, op_name, fn, kwargs, in_refs, out_vids, has_aux):
+        self.op_name = op_name
+        self.fn = fn
+        self.kwargs = kwargs
+        self.in_refs = in_refs    # list of ('v', vid) | ('p', name) | ('c', x)
+        self.out_vids = out_vids
+        self.has_aux = has_aux
+
+
+class AssignNode:
+    """Write a graph value back into a persistable (e.g. BN moving stats)."""
+
+    __slots__ = ("capture_name", "vid")
+
+    def __init__(self, capture_name, vid):
+        self.capture_name = capture_name
+        self.vid = vid
+
+
+class BackwardNode:
+    """append_backward marker (reference: fluid/backward.py:1337).
+
+    At execution the composed forward up to ``loss_vid`` runs under
+    ``jax.value_and_grad`` w.r.t. the listed persistable parameters and/or
+    symbolic Variables — the TPU-native replacement for per-op grad-op
+    descs.
+    """
+
+    __slots__ = ("loss_vid", "param_names", "grad_vids", "var_vids")
+
+    def __init__(self, loss_vid, param_names, grad_vids, var_vids=None):
+        self.loss_vid = loss_vid
+        self.param_names = param_names       # capture names (trainable)
+        self.grad_vids = grad_vids           # {param_name: vid of X@GRAD}
+        self.var_vids = var_vids or {}       # {input vid: vid of X@GRAD}
+
+
+class OptimizeNode:
+    """Optimizer update over (param, grad) pairs + persistable opt state."""
+
+    __slots__ = ("optimizer", "entries")
+
+    def __init__(self, optimizer, entries):
+        # entries: list of (param_name, grad_vid, {slot: state_capture_name})
+        self.optimizer = optimizer
+        self.entries = entries
+
+
+class Block:
+    """reference framework.py:2641 — flat op list (single block per program;
+    control flow maps to lax.cond/scan inside recorded fns, not sub-blocks).
+    """
+
+    def __init__(self, program, idx=0):
+        self.program = program
+        self.idx = idx
+        self.ops = program.nodes
+
+    def var(self, name):
+        return self.program.var(name)
+
+    def all_parameters(self):
+        return [t for t in self.program.captures.values()
+                if t.persistable and t.trainable]
+
+
+class Program:
+    """reference framework.py:4127."""
+
+    def __init__(self):
+        self.nodes = []
+        self.vars = {}           # vid -> Variable
+        self.captures = {}       # name -> eager Tensor (persistable)
+        self._capture_by_id = {} # id(tensor) -> name
+        self.feed_vars = {}      # name -> Variable
+        self.rng_vids = []       # vids fed a fresh PRNG key every run
+        self.version = 0
+        self._next_vid = [0]
+        self.blocks = [Block(self)]
+        self.random_seed = None
+
+    # -- structure ---------------------------------------------------------
+    def global_block(self):
+        return self.blocks[0]
+
+    def current_block(self):
+        return self.blocks[0]
+
+    def block(self, i):
+        return self.blocks[i]
+
+    @property
+    def num_blocks(self):
+        return len(self.blocks)
+
+    def all_parameters(self):
+        return self.global_block().all_parameters()
+
+    def list_vars(self):
+        return list(self.vars.values())
+
+    def var(self, name):
+        for v in self.vars.values():
+            if v.name == name:
+                return v
+        if name in self.captures:
+            return self.captures[name]
+        raise KeyError(f"no variable named {name!r} in program")
+
+    def clone(self, for_test=False):
+        # The graph is pure w.r.t. the recorded fns; test-mode differences
+        # (dropout off, BN eval) must be built under a test-mode guard the
+        # way the reference rebuilds with is_test=True.
+        return self
+
+    # -- recording ---------------------------------------------------------
+    def _new_vid(self, var):
+        vid = self._next_vid[0]
+        self._next_vid[0] += 1
+        self.vars[vid] = var
+        return vid
+
+    def capture(self, tensor):
+        """Register an eager Tensor as a named persistable input."""
+        key = id(tensor)
+        if key in self._capture_by_id:
+            return self._capture_by_id[key]
+        name = tensor.name or unique_name.generate("persist")
+        while name in self.captures:
+            name = unique_name.generate(name)
+        self.captures[name] = tensor
+        self._capture_by_id[key] = name
+        return name
+
+    def record_call(self, op_name, fn, args, kwargs, has_aux=False):
+        in_refs, abstract = [], []
+        for a in args:
+            if isinstance(a, Variable):
+                in_refs.append(("v", a._vid))
+                abstract.append(a._data)
+            elif isinstance(a, Tensor):
+                name = self.capture(a)
+                in_refs.append(("p", name))
+                abstract.append(jax.ShapeDtypeStruct(
+                    tuple(a._data.shape), a._data.dtype))
+            else:
+                in_refs.append(("c", a))
+                abstract.append(a)
+        out_struct = jax.eval_shape(
+            lambda *xs: fn(*xs, **kwargs), *abstract)
+        leaves = _flatten_result(out_struct, has_aux)
+        out_vars = [Variable(self.global_block(), l.shape, l.dtype,
+                             name=unique_name.generate(op_name))
+                    for l in leaves]
+        self.nodes.append(OpNode(op_name, fn, kwargs, in_refs,
+                                 [v._vid for v in out_vars], has_aux))
+        self.version += 1
+        return tuple(out_vars) if len(out_vars) > 1 else out_vars[0]
+
+    def record_assign(self, tensor, var):
+        name = self.capture(tensor)
+        self.nodes.append(AssignNode(name, var._vid))
+        self.version += 1
+
+    def rng_key_var(self):
+        """A symbolic PRNG key replaced with a fresh key at every run
+        (stochastic ops in graphs: dropout etc. — reference dropout_op.cc
+        draws per-execution seeds the same way)."""
+        import jax.random as jrandom
+        struct = jax.eval_shape(lambda: jrandom.key(0))
+        v = Variable.__new__(Variable)
+        v._data = struct
+        v._stop_gradient = True
+        v.grad = None
+        v._grad_node = None
+        v._retain_grad = False
+        v.name = unique_name.generate("rng_key")
+        v.persistable = False
+        v.block = self.global_block()
+        v._vid = self._new_vid(v)
+        self.rng_vids.append(v._vid)
+        return v
+
+    def _find_backward(self):
+        for n in self.nodes:
+            if isinstance(n, BackwardNode):
+                return n
+        return None
+
+    def __repr__(self):
+        kinds = [type(n).__name__ if not isinstance(n, OpNode) else n.op_name
+                 for n in self.nodes]
+        return (f"Program(ops={len(self.nodes)}, vars={len(self.vars)}, "
+                f"persistables={len(self.captures)})\n  " + " -> ".join(kinds))
+
+
+def _flatten_result(res, has_aux):
+    if has_aux:
+        out, aux = res
+        return _leaves(out) + _leaves(aux)
+    return _leaves(res)
+
+
+def _leaves(o):
+    return list(o) if isinstance(o, (tuple, list)) else [o]
+
+
+# ---------------------------------------------------------------------------
+# default programs + guards (reference: framework.py default_main_program)
+
+_default_main = [Program()]
+_default_startup = [Program()]
+
+
+def default_main_program():
+    return _default_main[0]
+
+
+def default_startup_program():
+    return _default_startup[0]
+
+
+class program_guard:
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+
+    def __enter__(self):
+        if self._main is not None:
+            self._old_main = _default_main[0]
+            _default_main[0] = self._main
+        if self._startup is not None:
+            self._old_startup = _default_startup[0]
+            _default_startup[0] = self._startup
+        return self
+
+    def __exit__(self, *a):
+        if self._main is not None:
+            _default_main[0] = self._old_main
+        if self._startup is not None:
+            _default_startup[0] = self._old_startup
+        return False
+
+
+# ---------------------------------------------------------------------------
+# dispatch hook (installed into core.dispatch at import)
+
+def _record_hook(op_name, fn, args, kwargs, has_aux):
+    """Called by core.dispatch.primitive while static mode is enabled."""
+    if not any(isinstance(a, Variable) for a in args):
+        return NotImplemented    # pure-eager subexpression (e.g. param init)
+    return default_main_program().record_call(
+        op_name, fn, args, kwargs, has_aux)
+
+
+# -- graph inputs -----------------------------------------------------------
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """paddle.static.data (reference: fluid/data.py).
+
+    XLA requires static shapes, and op wrappers bake input shapes into
+    attributes at graph-build time (e.g. dropout mask shapes), so dynamic
+    (None/-1) dims are rejected rather than silently guessed.  Declare the
+    full batch shape; feeding a different batch size recompiles, matching
+    XLA's per-shape compilation model.
+    """
+    if any(s is None or (isinstance(s, int) and s < 0) for s in shape):
+        raise ValueError(
+            f"static.data('{name}', shape={shape}): dynamic dims "
+            "(None/-1) are not supported on the TPU backend — declare the "
+            "concrete batch size (different sizes recompile per shape)")
+    prog = default_main_program()
+    v = Variable(prog.global_block(), shape, dtype, name=name)
+    prog.feed_vars[name] = v
+    return v
+
+
+# -- scope ------------------------------------------------------------------
+
+class _ScopeVarHandle:
+    def __init__(self, tensor):
+        self._t = tensor
+
+    def get_tensor(self):
+        return self._t.numpy()
+
+    def set(self, value, place=None):
+        self._t.set_value(np.asarray(value))
+
+
+class Scope:
+    """reference framework/scope.h:52 — name → persistable lookup."""
+
+    def find_var(self, name):
+        prog = default_main_program()
+        if name in prog.captures:
+            return _ScopeVarHandle(prog.captures[name])
+        return None
+
+    def var(self, name):
+        return self.find_var(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+    return contextlib.nullcontext(scope)
+
+
+# -- autodiff ---------------------------------------------------------------
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """reference fluid/backward.py:1337 — returns [(param, grad_var)].
+
+    parameter_list entries may be persistable Tensors (parameters), names,
+    or symbolic Variables (grad w.r.t. an input / intermediate value).
+    """
+    prog = default_main_program()
+    if not isinstance(loss, Variable):
+        raise TypeError("append_backward expects a symbolic loss Variable")
+    names, sym_vars = [], []
+    if parameter_list is not None:
+        for p in parameter_list:
+            if isinstance(p, Variable):
+                sym_vars.append(p)
+            elif isinstance(p, str):
+                names.append(p)
+            else:
+                names.append(prog.capture(p))
+    else:
+        # only parameters in the loss's dependency cone (reference
+        # append_backward walks the grad graph; unrelated params must not
+        # receive zero-grad updates / weight decay)
+        reachable = _reachable_captures(prog, loss._vid)
+        names = [n for n, t in prog.captures.items()
+                 if t.trainable and n in reachable]
+    grad_vids, var_vids, pairs = {}, {}, []
+    for n in names:
+        t = prog.captures[n]
+        gv = Variable(prog.global_block(), t._data.shape, t._data.dtype,
+                      name=n + "@GRAD")
+        grad_vids[n] = gv._vid
+        pairs.append((t, gv))
+    for v in sym_vars:
+        gv = Variable(prog.global_block(), v._data.shape, v._data.dtype,
+                      name=v.name + "@GRAD")
+        var_vids[v._vid] = gv._vid
+        pairs.append((v, gv))
+    prog.nodes.append(BackwardNode(loss._vid, names, grad_vids, var_vids))
+    prog.version += 1
+    return pairs
+
+
+def _reachable_captures(prog, loss_vid):
+    """Capture names in the dependency cone of ``loss_vid``."""
+    producer = {}
+    for node in prog.nodes:
+        if isinstance(node, OpNode):
+            for vid in node.out_vids:
+                producer[vid] = node
+    reachable, stack, seen = set(), [loss_vid], set()
+    while stack:
+        vid = stack.pop()
+        if vid in seen:
+            continue
+        seen.add(vid)
+        node = producer.get(vid)
+        if node is None:
+            continue
+        for kind, ref in node.in_refs:
+            if kind == "v":
+                stack.append(ref)
+            elif kind == "p":
+                reachable.add(ref)
+    return reachable
+
+
+def append_optimize(optimizer, loss, param_grad_pairs):
+    """Record optimizer updates (used by Optimizer.minimize in static mode)."""
+    prog = default_main_program()
+    bw = prog._find_backward()
+    assert bw is not None
+    entries = []
+    for param, gvar in param_grad_pairs:
+        pname = prog.capture(param)
+        state = optimizer._init_state(param)
+        slot_names = {}
+        for slot, arr in state.items():
+            st = Tensor(arr, stop_gradient=True,
+                        name=f"{pname}@{slot}")
+            st.persistable = True
+            slot_names[slot] = prog.capture(st)
+        entries.append((pname, gvar._vid, slot_names))
+    prog.nodes.append(OptimizeNode(optimizer, entries))
+    prog.version += 1

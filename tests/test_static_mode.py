@@ -1,0 +1,273 @@
+"""Static graph mode: Program recording, Executor, append_backward, minimize.
+
+Mirrors the reference's static-mode tests (fluid/tests/unittests/
+test_program.py, test_executor_*, book/ examples): build a graph with
+static.nn layers, train with Executor.run, compare against the identical
+dygraph model.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture(autouse=True)
+def _static_guard():
+    main, startup = static.Program(), static.Program()
+    paddle.enable_static()
+    with static.program_guard(main, startup):
+        yield main
+    paddle.disable_static()
+
+
+def test_record_and_run(_static_guard):
+    x = static.data("x", [4, 3])
+    y = x * 2.0 + 1.0
+    assert isinstance(y, static.Variable)
+    assert y.shape == [4, 3]
+    exe = static.Executor()
+    xv = np.random.rand(4, 3).astype("float32")
+    out, = exe.run(feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, xv * 2 + 1, rtol=1e-6)
+
+
+def test_feed_shape_recompile(_static_guard):
+    x = static.data("x", [4, 8])
+    y = paddle.sum(x)
+    exe = static.Executor()
+    for n in (4, 6):
+        xv = np.ones((n, 8), "float32")
+        out, = exe.run(feed={"x": xv}, fetch_list=[y])
+        assert out == pytest.approx(n * 8)
+
+
+def test_fc_and_backward_training(_static_guard):
+    paddle.seed(0)
+    x = static.data("x", [16, 4])
+    label = static.data("label", [16, 1])
+    h = static.nn.fc(x, 8, activation="relu")
+    pred = static.nn.fc(h, 1)
+    loss = paddle.mean((pred - label) ** 2)
+    from paddle_tpu import optimizer
+    opt = optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+
+    exe = static.Executor()
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 4).astype("float32")
+    w_true = rng.rand(4, 1).astype("float32")
+    lv = xv @ w_true
+    losses = []
+    for _ in range(60):
+        lval, = exe.run(feed={"x": xv, "label": lv}, fetch_list=[loss])
+        losses.append(float(lval))
+    assert losses[-1] < losses[0] * 0.1, losses[::20]
+
+
+def test_static_matches_dygraph_linear(_static_guard):
+    # identical init -> identical forward values
+    w = np.random.RandomState(1).rand(3, 2).astype("float32")
+    x = static.data("x", [5, 3])
+    import paddle_tpu.nn.functional as F
+    wt = paddle.to_tensor(w)
+    out = F.linear(x, wt)
+    exe = static.Executor()
+    xv = np.random.RandomState(2).rand(5, 3).astype("float32")
+    got, = exe.run(feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(got, xv @ w, rtol=1e-5)
+
+
+def test_adam_minimize_and_scope(_static_guard):
+    x = static.data("x", [8, 2])
+    y = static.nn.fc(x, 1, bias_attr=False)
+    loss = paddle.mean(y * y)
+    from paddle_tpu import optimizer
+    opt = optimizer.Adam(learning_rate=0.05)
+    opt.minimize(loss)
+    exe = static.Executor()
+    xv = np.ones((8, 2), "float32")
+    first, = exe.run(feed={"x": xv}, fetch_list=[loss])
+    for _ in range(30):
+        last, = exe.run(feed={"x": xv}, fetch_list=[loss])
+    assert float(last) < float(first)
+    # scope lookup reaches the persistable weight
+    prog = static.default_main_program()
+    params = prog.all_parameters()
+    assert len(params) == 1
+    handle = static.global_scope().find_var(params[0].name)
+    assert handle is not None
+    assert handle.get_tensor().shape == (2, 1)
+
+
+def test_batch_norm_records_moving_stats(_static_guard):
+    x = static.data("x", [4, 3, 8, 8])
+    out = static.nn.batch_norm(x)
+    loss = paddle.mean(out)
+    exe = static.Executor()
+    prog = static.default_main_program()
+    stats = [t for n, t in prog.captures.items() if "bn_mean" in n]
+    assert len(stats) == 1
+    before = stats[0].numpy().copy()
+    xv = np.random.RandomState(0).rand(4, 3, 8, 8).astype("float32") + 3.0
+    exe.run(feed={"x": xv}, fetch_list=[loss])
+    after = stats[0].numpy()
+    assert not np.allclose(before, after)  # writeback happened
+    assert np.all(after > 0)  # moved toward batch mean (~3.5)
+
+
+def test_conv_pool_graph(_static_guard):
+    x = static.data("x", [2, 1, 8, 8])
+    c = static.nn.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                         act="relu")
+    assert list(c.shape) == [2, 4, 8, 8]
+    exe = static.Executor()
+    out, = exe.run(feed={"x": np.ones((2, 1, 8, 8), "float32")},
+                   fetch_list=[c])
+    assert out.shape == (2, 4, 8, 8)
+    assert np.all(out >= 0)
+
+
+def test_embedding_graph(_static_guard):
+    ids = static.data("ids", [4, 6], dtype="int32")
+    emb = static.nn.embedding(ids, size=[10, 16])
+    assert list(emb.shape) == [4, 6, 16]
+
+
+def test_program_save_load(tmp_path, _static_guard):
+    x = static.data("x", [2, 3])
+    out = static.nn.fc(x, 4)
+    prog = static.default_main_program()
+    exe = static.Executor()
+    xv = np.ones((2, 3), "float32")
+    ref, = exe.run(feed={"x": xv}, fetch_list=[out])
+    path = str(tmp_path / "model")
+    static.save(prog, path)
+    # perturb, then restore
+    for t in prog.captures.values():
+        t.set_value(np.zeros_like(t.numpy()))
+    static.load(prog, path)
+    got, = exe.run(feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_gradients_api(_static_guard):
+    x = static.data("x", [3, 3])
+    w = paddle.to_tensor(np.eye(3, dtype="float32"))
+    w.stop_gradient = False
+    y = paddle.sum(paddle.matmul(x, w) ** 2)
+    grads = static.gradients(y, [w])
+    exe = static.Executor()
+    xv = np.random.RandomState(0).rand(3, 3).astype("float32")
+    g, = exe.run(feed={"x": xv}, fetch_list=grads)
+    # d/dw sum((xw)^2) = 2 x^T (x w)
+    np.testing.assert_allclose(g, 2 * xv.T @ (xv @ np.eye(3)), rtol=1e-4)
+
+
+def test_variable_numpy_raises(_static_guard):
+    x = static.data("x", [2, 2])
+    with pytest.raises(RuntimeError):
+        (x + 1).numpy()
+
+
+# ---- regressions from code review ----------------------------------------
+
+def test_bn_with_trainable_params_and_minimize(_static_guard):
+    # AssignNodes recorded before BackwardNode must not leak tracers
+    x = static.data("x", [4, 3, 8, 8])
+    label = static.data("label", [4, 1])
+    c = static.nn.conv2d(x, num_filters=2, filter_size=3, padding=1)
+    b = static.nn.batch_norm(c)
+    pred = static.nn.fc(b, 1)
+    loss = paddle.mean((pred - label) ** 2)
+    from paddle_tpu import optimizer
+    optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = static.Executor()
+    xv = np.random.RandomState(0).rand(4, 3, 8, 8).astype("float32")
+    lv = np.ones((4, 1), "float32")
+    l1, = exe.run(feed={"x": xv, "label": lv}, fetch_list=[loss])
+    l2, = exe.run(feed={"x": xv, "label": lv}, fetch_list=[loss])
+    assert float(l2) < float(l1)
+
+
+def test_gradient_wrt_input_variable(_static_guard):
+    x = static.data("x", [3, 3])
+    y = paddle.sum(x * x)
+    g, = static.gradients(y, [x])
+    exe = static.Executor()
+    xv = np.random.RandomState(0).rand(3, 3).astype("float32")
+    gv, = exe.run(feed={"x": xv}, fetch_list=[g])
+    np.testing.assert_allclose(gv, 2 * xv, rtol=1e-5)
+
+
+def test_static_dropout_fresh_mask_per_run(_static_guard):
+    x = static.data("x", [64, 64])
+    import paddle_tpu.nn.functional as F
+    y = F.dropout(x, p=0.5, training=True)
+    exe = static.Executor()
+    xv = np.ones((64, 64), "float32")
+    a, = exe.run(feed={"x": xv}, fetch_list=[y])
+    b, = exe.run(feed={"x": xv}, fetch_list=[y])
+    assert not np.array_equal(a, b)          # mask changes per run
+    assert abs((a > 0).mean() - 0.5) < 0.1   # ~p kept
+
+
+def test_gradients_multi_target_sum(_static_guard):
+    x = static.data("x", [2, 2])
+    t1 = paddle.sum(x * 2.0)
+    t2 = paddle.sum(x * 3.0)
+    g, = static.gradients([t1, t2], [x])
+    exe = static.Executor()
+    gv, = exe.run(feed={"x": np.ones((2, 2), "float32")}, fetch_list=[g])
+    np.testing.assert_allclose(gv, np.full((2, 2), 5.0), rtol=1e-6)
+
+
+def test_gradients_then_minimize_same_program(_static_guard):
+    x = static.data("x", [2, 2])
+    w = paddle.to_tensor(np.ones((2, 2), "float32"))
+    w.stop_gradient = False
+    loss = paddle.mean(paddle.matmul(x, w) ** 2)
+    static.gradients(loss, [x])
+    from paddle_tpu import optimizer
+    optimizer.SGD(learning_rate=0.1).minimize(loss)  # must not raise
+    exe = static.Executor()
+    l1, = exe.run(feed={"x": np.ones((2, 2), "float32")},
+                  fetch_list=[loss])
+    l2, = exe.run(feed={"x": np.ones((2, 2), "float32")},
+                  fetch_list=[loss])
+    assert float(l2) < float(l1)
+
+
+def test_fetch_persistable_by_name(_static_guard):
+    x = static.data("x", [2, 3])
+    static.nn.fc(x, 4, bias_attr=False)
+    prog = static.default_main_program()
+    wname = prog.all_parameters()[0].name
+    exe = static.Executor()
+    w, = exe.run(feed={"x": np.ones((2, 3), "float32")},
+                 fetch_list=[wname])
+    assert w.shape == (3, 4)
+
+
+def test_static_data_rejects_dynamic_dims(_static_guard):
+    with pytest.raises(ValueError):
+        static.data("x", [None, 64])
+    with pytest.raises(ValueError):
+        static.data("y", [-1, 64])
+
+
+def test_minimize_only_touches_loss_params(_static_guard):
+    x = static.data("x", [4, 3])
+    h1 = static.nn.fc(x, 2, bias_attr=False)   # in the loss
+    static.nn.fc(x, 2, bias_attr=False)        # unrelated head
+    loss = paddle.mean(h1 * h1)
+    from paddle_tpu import optimizer
+    optimizer.SGD(learning_rate=0.1, weight_decay=0.01).minimize(loss)
+    prog = static.default_main_program()
+    params = prog.all_parameters()
+    assert len(params) == 2
+    other = params[1]
+    before = other.numpy().copy()
+    exe = static.Executor()
+    exe.run(feed={"x": np.ones((4, 3), "float32")}, fetch_list=[loss])
+    np.testing.assert_array_equal(other.numpy(), before)
