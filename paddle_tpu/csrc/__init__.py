@@ -40,6 +40,16 @@ def load(build_if_missing=True):
         lib = ctypes.CDLL(path)
     except OSError:
         return None
+    if not hasattr(lib, "ptds_reset_order"):
+        # stale library from an older source tree: force a rebuild once
+        try:
+            subprocess.run(["make", "-B", "-C", os.path.dirname(__file__)],
+                           check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(path)
+        except Exception:
+            return None
+        if not hasattr(lib, "ptds_reset_order"):
+            return None
     lib.ptq_new.restype = ctypes.c_void_p
     lib.ptq_new.argtypes = [ctypes.c_int64, ctypes.c_int]
     lib.ptq_put.restype = ctypes.c_int
@@ -54,6 +64,26 @@ def load(build_if_missing=True):
     lib.ptq_size.restype = ctypes.c_int64
     lib.ptq_size.argtypes = [ctypes.c_void_p]
     lib.ptq_free.argtypes = [ctypes.c_void_p]
+    # dataset engine (dataset.cc)
+    lib.ptds_new.restype = ctypes.c_void_p
+    lib.ptds_new.argtypes = []
+    lib.ptds_free.argtypes = [ctypes.c_void_p]
+    lib.ptds_set_filelist.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int]
+    lib.ptds_load_into_memory.restype = ctypes.c_int64
+    lib.ptds_load_into_memory.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+    lib.ptds_num_records.restype = ctypes.c_int64
+    lib.ptds_num_records.argtypes = [ctypes.c_void_p]
+    lib.ptds_local_shuffle.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.ptds_get_batch.restype = ctypes.c_int64
+    lib.ptds_get_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float)]
+    lib.ptds_shard.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                               ctypes.c_int64]
+    lib.ptds_reset_order.argtypes = [ctypes.c_void_p]
+    lib.ptds_release_memory.argtypes = [ctypes.c_void_p]
     _LIB = lib
     return _LIB
 

@@ -128,13 +128,29 @@ def get_strategy():
 
 def build_train_step(model, optimizer, loss_fn=None, strategy=None,
                      **kwargs):
-    """The fleet path into the sharded train-step builder."""
+    """The fleet path into the sharded train-step builder.
+
+    Mirrors the reference's meta-optimizer selection
+    (``base/meta_optimizer_factory.py:21`` + ``strategy_compiler.py:89``):
+    the strategy flags pick which step builder handles the program.
+    """
     from ...parallel.train_step import TrainStep
+    from .meta_optimizers import LocalSGDStep, DGCStep, FP16AllReduceStep
     strategy = strategy or _fleet_state["strategy"] or DistributedStrategy()
     if isinstance(optimizer, DistributedOptimizer):
         optimizer = optimizer.inner_opt
+    mesh = kwargs.pop("mesh", None)
+    if strategy.localsgd:
+        return LocalSGDStep(model, optimizer, loss_fn=loss_fn, mesh=mesh,
+                            k_steps=strategy.localsgd_configs.get(
+                                "k_steps", 2))
+    if strategy.dgc:
+        return DGCStep(model, optimizer, loss_fn=loss_fn, mesh=mesh)
+    if strategy.fp16_allreduce:
+        return FP16AllReduceStep(model, optimizer, loss_fn=loss_fn,
+                                 mesh=mesh)
     return TrainStep(model, optimizer, loss_fn=loss_fn, strategy=strategy,
-                     **kwargs)
+                     mesh=mesh, **kwargs)
 
 
 # checkpoint helpers (reference: fleet_base.py:518,549)

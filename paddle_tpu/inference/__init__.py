@@ -1,11 +1,17 @@
 """Inference API.
 
 Reference parity: ``paddle.inference`` — AnalysisConfig/Predictor
-(``inference/api/analysis_predictor.cc:1129,353``).  TPU-native: the "IR
-optimization pipeline" is XLA itself; a Predictor wraps an exported
-StableHLO artifact (jit.save output) or a live Layer compiled with jax.jit.
+(``inference/api/analysis_predictor.cc:1129,353``, pybind surface
+``pybind/inference_api.cc``).  TPU-native: the "IR optimization pipeline"
+is XLA itself; a Predictor runs an exported StableHLO artifact (from
+``paddle.jit.save`` or ``paddle.static.save_inference_model``) or a live
+Layer compiled on first use.  TensorRT/MKLDNN knobs are accepted and
+ignored — there is no separate engine to delegate to.
 """
 from __future__ import annotations
+
+import os
+import pickle
 
 import numpy as np
 
@@ -13,9 +19,11 @@ from ..core.tensor import Tensor
 
 
 class Config:
-    """AnalysisConfig parity (the optimization knobs are no-ops: XLA decides)."""
+    """AnalysisConfig parity (optimization knobs are no-ops: XLA decides)."""
 
     def __init__(self, model_path=None, params_path=None):
+        if model_path and model_path.endswith(".pdmodel"):
+            model_path = model_path[:-len(".pdmodel")]
         self.model_path = model_path
         self.params_path = params_path
         self._enable_memory_optim = True
@@ -43,7 +51,7 @@ class Config:
 
 
 class PredictorTensor:
-    """Zero-copy-ish handle mirroring paddle_infer.Tensor."""
+    """Handle mirroring paddle_infer.Tensor (zero-copy where possible)."""
 
     def __init__(self, predictor, name, is_input):
         self._predictor = predictor
@@ -63,21 +71,40 @@ class PredictorTensor:
 
 
 class Predictor:
+    """Runs a saved artifact (static or jit export) or a live Layer."""
+
     def __init__(self, config_or_layer):
         self._inputs = {}
         self._outputs = {}
+        self._static_prog = None
+        self._layer = None
         if isinstance(config_or_layer, Config):
-            from .. import jit as jit_mod
             base = config_or_layer.model_path
-            if base.endswith(".pdmodel"):
-                base = base[:-len(".pdmodel")]
-            self._layer = jit_mod.load(base)
+            meta = None
+            if os.path.exists(base + ".pdmeta"):
+                with open(base + ".pdmeta", "rb") as f:
+                    meta = pickle.load(f)
+            if meta and meta.get("kind") == "static_inference":
+                from ..static.io import load_inference_model
+                prog, feeds, _ = load_inference_model(base)
+                self._static_prog = prog
+                self._input_names = feeds
+                self._output_names = [f"out_{i}" if i else "out"
+                                      for i in range(prog.n_fetch)]
+            else:
+                from .. import jit as jit_mod
+                self._layer = jit_mod.load(base)
+                feeds = (meta or {}).get("feed_names") or ["x"]
+                n_out = (meta or {}).get("n_fetch", 1)
+                self._input_names = list(feeds)
+                self._output_names = [f"out_{i}" if i else "out"
+                                      for i in range(n_out)]
         else:
             layer = config_or_layer
             layer.eval()
             self._layer = layer
-        self._input_names = ["x"]
-        self._output_names = ["out"]
+            self._input_names = ["x"]
+            self._output_names = ["out"]
 
     def get_input_names(self):
         return list(self._input_names)
@@ -96,14 +123,21 @@ class Predictor:
             arrays = [np.asarray(a) for a in inputs]
         else:
             arrays = [self._inputs[n] for n in self._input_names]
-        out = self._layer(*[Tensor(a) for a in arrays])
-        outs = out if isinstance(out, (list, tuple)) else [out]
+        if self._static_prog is not None:
+            outs = self._static_prog.run(dict(zip(self._input_names,
+                                                  arrays)))
+            outs = [np.asarray(o) for o in outs]
+        else:
+            out = self._layer(*[Tensor(a) for a in arrays])
+            raw = out if isinstance(out, (list, tuple)) else [out]
+            outs = [o.numpy() if isinstance(o, Tensor) else np.asarray(o)
+                    for o in raw]
         self._output_names = [f"out_{i}" if i else "out"
                               for i in range(len(outs))]
         for n, o in zip(self._output_names, outs):
-            self._outputs[n] = o.numpy() if isinstance(o, Tensor) else o
+            self._outputs[n] = o
         if inputs is not None:
-            return [self._outputs[n] for n in self._output_names]
+            return outs
         return True
 
 

@@ -422,3 +422,7 @@ class DataLoader:
 
 def get_worker_info():
     return None  # thread-based workers share the process
+
+
+from .native_dataset import (InMemoryDataset, QueueDataset,  # noqa: E402
+                             DatasetFactory)

@@ -276,6 +276,15 @@ def save(layer, path, input_spec=None, **configs):
     }
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(state, f, protocol=4)
+    meta = {
+        "kind": "jit",
+        "feed_names": [getattr(s, "name", None) or f"x{i}"
+                       for i, s in enumerate(input_spec)],
+        "feed_specs": [(list(s.shape), str(s.dtype)) for s in specs],
+        "n_fetch": len(exported.out_avals),
+    }
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f, protocol=4)
 
 
 class TranslatedLayer(Layer):

@@ -18,6 +18,9 @@ from .program import (Program, Variable, Block, enable_static,  # noqa: F401
                       Scope, append_backward, append_optimize,
                       _record_hook)
 from .executor import Executor, save, load  # noqa: F401
+from .io import (save_inference_model, load_inference_model,  # noqa: F401
+                 InferenceProgram)
+from . import io  # noqa: F401
 from . import nn  # noqa: F401
 
 # NOTE: the op-dispatch recorder hook is installed by enable_static() and

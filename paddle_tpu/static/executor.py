@@ -40,6 +40,19 @@ class Executor:
             program = prog_mod.default_main_program()
         feed = feed or {}
         fetch_list = fetch_list or []
+        from .io import InferenceProgram
+        if isinstance(program, InferenceProgram):
+            outs = program.run(feed)
+            if fetch_list:
+                for i in fetch_list:
+                    if not isinstance(i, (int, np.integer)):
+                        raise TypeError(
+                            "fetch_list for a loaded inference program "
+                            "holds output indices (as returned by "
+                            f"load_inference_model), got {type(i).__name__}")
+                outs = [outs[int(i)] for i in fetch_list]
+            return [np.asarray(o) for o in outs] if return_numpy else \
+                [Tensor(o) for o in outs]
         if not program.nodes and not fetch_list:
             return []          # e.g. startup program: params already init'd
 
@@ -83,6 +96,32 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return [Tensor(f) for f in fetches]
+
+    # ------------------------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """reference: executor.py:1427 _run_from_dataset → the C++ Trainer/
+        DeviceWorker path (trainer.h:53, device_worker.h).  TPU-native: the
+        native dataset engine gathers batches off the GIL; each batch runs
+        through the same compiled program as Executor.run."""
+        if program is None:
+            program = prog_mod.default_main_program()
+        if dataset is None:
+            raise ValueError("train_from_dataset requires a dataset")
+        feed_names = [v.name for v in dataset._use_vars]
+        results = []
+        for step, slots in enumerate(dataset):
+            feed = dict(zip(feed_names, slots))
+            out = self.run(program, feed=feed, fetch_list=fetch_list)
+            if fetch_list and debug and step % print_period == 0:
+                print(f"step {step}:", [np.asarray(o).mean() for o in out])
+            if fetch_list:
+                results.append(out)
+        return results
+
+    def infer_from_dataset(self, program=None, dataset=None, **kwargs):
+        return self.train_from_dataset(program, dataset, **kwargs)
 
     # ------------------------------------------------------------------
     def _compose(self, program, fetch_refs):
