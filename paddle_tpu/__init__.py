@@ -8,7 +8,7 @@ registries) is replaced by XLA compilation over device meshes.
 """
 from __future__ import annotations
 
-__version__ = "0.1.0"
+__version__ = "2.0.0-tpu"  # tracks the reference's 2.0 API surface
 
 # -- core ----------------------------------------------------------------
 from .core.tensor import Tensor, Parameter, to_tensor  # noqa: F401
@@ -84,6 +84,116 @@ from .framework.io import save, load  # noqa: E402,F401
 from .static import (enable_static, disable_static,  # noqa: E402,F401
                      in_dynamic_mode)
 from .static.program import in_static_mode  # noqa: E402,F401
+
+# ---- 1.x-compat aliases & auxiliary modules (reference __init__.py
+# DEFINE_ALIAS block + module imports) ------------------------------------
+from .ops.compat_ops import (  # noqa: E402,F401
+    add_n, kron, broadcast_shape, rank, shape, is_tensor, is_empty,
+    unstack, slice, strided_slice, crop_tensor, fill_constant,
+    create_global_var, create_parameter, has_inf, has_nan,
+    elementwise_add, elementwise_sub, elementwise_mul, elementwise_div,
+    elementwise_pow, elementwise_mod, elementwise_floordiv,
+    elementwise_max, elementwise_min,
+    reduce_sum, reduce_mean, reduce_max, reduce_min, reduce_prod,
+    tanh_, squeeze_, unsqueeze_, scatter_, exp_, sqrt_, ceil_, floor_,
+    round_, clip_, subtract_, add_, set_printoptions)
+from .ops.linalg import (cholesky, cross, dist, histogram,  # noqa: E402,F401
+                         inverse, norm, bincount)
+from . import device  # noqa: E402,F401
+from . import regularizer  # noqa: E402,F401
+from . import compat  # noqa: E402,F401
+from . import sysconfig  # noqa: E402,F401
+from . import onnx  # noqa: E402,F401
+from . import incubate  # noqa: E402,F401
+from .batch import batch  # noqa: E402,F401
+from .nn.param_attr import ParamAttr  # noqa: E402,F401
+from .core.tensor import Tensor as VarBase  # noqa: E402,F401
+from .core.tensor import Tensor as LoDTensor  # noqa: E402,F401
+from .hapi import callbacks  # noqa: E402,F401
+from . import ops as tensor  # noqa: E402,F401  (paddle.tensor alias)
+from .static import data  # noqa: E402,F401
+
+LoDTensorArray = list  # reference: vector<LoDTensor> bound to a list
+
+full_version = __version__
+commit = "tpu-native"
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """SelectedRows (sparse row-set grads) have no TPU analogue — embedding
+    grads are dense scatter-adds (see nn/functional/common.py embedding);
+    the accessor degenerates to identity."""
+    return x
+
+
+def in_dygraph_mode():
+    return in_dynamic_mode()
+
+
+def enable_dygraph(place=None):
+    disable_static()
+
+
+def disable_dygraph():
+    enable_static()
+
+
+class CUDAPlace:
+    """Accepted for API compat; placement is XLA's job on TPU."""
+
+    def __init__(self, dev_id=0):
+        self.dev_id = dev_id
+
+
+class CUDAPinnedPlace:
+    pass
+
+
+class XPUPlace:
+    def __init__(self, dev_id=0):
+        self.dev_id = dev_id
+
+
+def get_cudnn_version():
+    return None  # no cuDNN on TPU
+
+
+def get_cuda_rng_state():
+    from .core import rng as _rng
+    return [_rng.get_seed()]
+
+
+def set_cuda_rng_state(state):
+    from .core import rng as _rng
+    if state:
+        _rng.seed(int(state[0]))
+
+
+def monkey_patch_variable():
+    pass  # operators are attached at import time (ops/__init__.py)
+
+
+def monkey_patch_math_varbase():
+    pass
+
+
+def summary(net, input_size=None, dtypes=None):
+    """paddle.summary (reference: hapi/model_summary.py)."""
+    from .hapi.model import Model
+    return Model(net).summary(input_size, dtype=dtypes)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough FLOPs count: 2*params per MAC-dominated layer (reference:
+    hapi/dynamic_flops.py walks per-layer hooks; here dense/conv params
+    dominate on the MXU)."""
+    import numpy as _np
+    total = 0
+    for _, p in net.named_parameters():
+        n = int(_np.prod(p.shape))
+        if len(p.shape) >= 2:
+            total += 2 * n
+    return total
 from .hapi.model import Model  # noqa: E402,F401
 from .nn.layer.base import Layer  # noqa: E402,F401
 from . import framework  # noqa: E402,F401

@@ -1,0 +1,32 @@
+"""paddle.compat (reference: python/paddle/compat.py) — py2/3 text utils
+kept for API parity."""
+from __future__ import annotations
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    if isinstance(obj, (list, set, tuple)):
+        return type(obj)(to_text(o, encoding) for o in obj)
+    return obj
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    if isinstance(obj, (list, set, tuple)):
+        return type(obj)(to_bytes(o, encoding) for o in obj)
+    return obj
+
+
+def round(x, d=0):
+    import builtins
+    return float(builtins.round(x, d))
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

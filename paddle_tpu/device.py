@@ -1,0 +1,38 @@
+"""paddle.device (reference: python/paddle/device.py).
+
+Device management over jax devices; "gpu:0"-style strings map to the TPU
+chips XLA exposes.
+"""
+from __future__ import annotations
+
+from .core.device import (set_device, get_device,  # noqa: F401
+                          is_compiled_with_cuda, is_compiled_with_xpu,
+                          is_compiled_with_tpu)
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def XPUPlace(dev_id=0):  # noqa: N802 — reference place-factory casing
+    from .core import device as d
+    return d.current_place()
+
+
+def cuda_device_count():
+    import jax
+    return len([d for d in jax.devices() if d.platform != "cpu"])

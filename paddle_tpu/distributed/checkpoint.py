@@ -1,9 +1,14 @@
 """Sharded checkpointing.
 
-Reference parity: fleet save/load (``fleet_base.py:518,549``) + save/load
-ops (``operators/save_combine_op.cc``) + PS table persistence.
-TPU-native: orbax-style per-array checkpointing of sharded jax arrays so a
-multi-host job saves/restores without gathering to one host.
+Reference parity: fleet save/load (``fleet_base.py:518,549``), save/load
+ops (``operators/save_combine_op.cc``), PS table persistence, and the
+optimizer-state halves of ``paddle.save/load``.
+TPU-native: orbax-backed per-array checkpointing of sharded jax arrays —
+each host writes its own shards, and restore re-places arrays on the mesh
+without gathering to one host.  Falls back to host-gathered pickle when
+orbax is unavailable.  Arbitrary pytrees (nested dicts of params +
+optimizer slots) are supported, so a TrainStep's full device state
+round-trips.
 """
 from __future__ import annotations
 
@@ -13,30 +18,94 @@ import pickle
 import numpy as np
 import jax
 
+from ..core.tensor import Tensor
 
-def save_sharded(state: dict, path: str):
-    """Save a (possibly sharded) state dict; each host writes its shards."""
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda v: v._data if isinstance(v, Tensor) else v, tree,
+        is_leaf=lambda v: isinstance(v, Tensor))
+
+
+def save_sharded(state, path: str):
+    """Save a (possibly sharded, possibly nested) state tree; each host
+    writes its own shards when orbax drives the save."""
+    arrays = _unwrap_tree(state)
     try:
         import orbax.checkpoint as ocp
         ckptr = ocp.PyTreeCheckpointer()
-        arrays = {k: (v._data if hasattr(v, "_data") else v)
-                  for k, v in state.items()}
         ckptr.save(os.path.abspath(path), arrays, force=True)
         return
     except Exception:
         pass
     # fallback: host-gathered pickle
-    from ..framework.io import save as _save
-    _save(state, path + ".pdparams")
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    flat, treedef = jax.tree_util.tree_flatten(arrays)
+    with open(path + ".pdckpt", "wb") as f:
+        pickle.dump({"leaves": [np.asarray(a) for a in flat],
+                     "treedef": treedef}, f, protocol=4)
 
 
-def load_sharded(path: str, template: dict | None = None):
+def load_sharded(path: str, template=None, shardings=None):
+    """Restore a state tree.  With ``shardings`` (a matching pytree of
+    NamedSharding / None), arrays are placed directly on the mesh."""
+    restored = None
     try:
         import orbax.checkpoint as ocp
         ckptr = ocp.PyTreeCheckpointer()
         restored = ckptr.restore(os.path.abspath(path))
-        from ..core.tensor import Tensor
-        return {k: Tensor(np.asarray(v)) for k, v in restored.items()}
     except Exception:
-        from ..framework.io import load as _load
-        return _load(path + ".pdparams")
+        pdckpt = path + ".pdckpt"
+        if os.path.exists(pdckpt):
+            with open(pdckpt, "rb") as f:
+                data = pickle.load(f)
+            restored = jax.tree_util.tree_unflatten(
+                data["treedef"], data["leaves"])
+        else:
+            from ..framework.io import load as _load
+            return _load(path + ".pdparams")
+    if shardings is not None:
+        restored = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, s) if s is not None else a,
+            restored, shardings)
+    if template is not None and isinstance(template, dict) and all(
+            isinstance(v, Tensor) for v in template.values()):
+        return {k: Tensor(np.asarray(v)) for k, v in restored.items()}
+    return restored
+
+
+# -- TrainStep state (params + optimizer moments + step counter) ----------
+
+def save_train_state(step, path: str):
+    """Persist a TrainStep/meta-optimizer step's full device state."""
+    state = {"params": step.params, "opt_state": step.opt_state,
+             "step_count": np.asarray(step.optimizer._step_count)}
+    if hasattr(step, "buffers") and step.buffers:
+        state["buffers"] = step.buffers
+    if hasattr(step, "dgc_state"):
+        state["dgc_state"] = step.dgc_state
+    save_sharded(state, path)
+
+
+def load_train_state(step, path: str):
+    """Restore a TrainStep's state in place, re-sharding onto its mesh."""
+    restored = load_sharded(path)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def replace(dst, src):
+        # device_put re-shards directly (jax or numpy source) — no forced
+        # host gather of already-restored arrays
+        return jax.tree_util.tree_map(
+            lambda d, s: jax.device_put(
+                s, d.sharding if isinstance(d, jax.Array)
+                and hasattr(d, "sharding") else
+                NamedSharding(step.mesh, P())), dst, src)
+
+    step.params = replace(step.params, restored["params"])
+    step.opt_state = replace(step.opt_state, restored["opt_state"])
+    if "buffers" in restored and hasattr(step, "buffers"):
+        step.buffers = replace(step.buffers, restored["buffers"])
+    if "dgc_state" in restored and hasattr(step, "dgc_state"):
+        step.dgc_state = replace(step.dgc_state, restored["dgc_state"])
+    step.optimizer._step_count = int(restored["step_count"])

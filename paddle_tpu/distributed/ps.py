@@ -1,0 +1,187 @@
+"""Parameter-server analogue: mesh-sharded sparse embedding tables.
+
+Reference parity: the PS stack is the reference's largest subsystem —
+brpc services (``distributed/service/brpc_ps_server.cc``), the Table
+hierarchy (``distributed/table/common_sparse_table.cc:40`` shard-locked
+dense-block storage with per-row SGD/Adam rules in
+``table/depends/dense.h``), the trainer-side communicator
+(``operators/distributed/communicator.cc``), and the
+``the_one_ps.py:378`` runtime facade.
+
+TPU-native design (SURVEY.md §5.8): there are no server processes — a
+"table" is a dense ``[rows, dim]`` array row-sharded over the mesh
+(``PartitionSpec('sharding')``), pull is a sharded gather, push is a
+scatter-add with the optimizer rule applied per touched row, and XLA's
+collectives play the role of brpc.  Scope reduction vs the reference is
+explicit: capacity is fixed at construction (no unbounded hash growth /
+SSD spill), and geo-async replication has no analogue because there are
+no asynchronous replicas under SPMD.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P, NamedSharding
+
+from ..core.tensor import Tensor
+from ..core.dispatch import primitive
+from . import mesh as mesh_mod
+
+
+class SparseTable:
+    """Row-sharded embedding table with per-row optimizer state
+    (reference: CommonSparseTable + its sgd/adam rules)."""
+
+    def __init__(self, name, rows, dim, optimizer="sgd", lr=0.01,
+                 initializer=None, mesh=None):
+        self.name = name
+        self.rows = int(rows)
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.mesh = mesh or mesh_mod.ensure_mesh()
+        shard_world = self.mesh.shape.get("sharding", 1)
+        spec = P("sharding") if self.rows % max(shard_world, 1) == 0 \
+            else P()
+        self._sharding = NamedSharding(self.mesh, spec)
+        if initializer is None:
+            scale = 1.0 / np.sqrt(self.dim)
+            from ..core import rng as rng_mod
+            w = jax.random.uniform(rng_mod.next_key(),
+                                   (self.rows, self.dim), jnp.float32,
+                                   -scale, scale)
+        else:
+            w = jnp.asarray(initializer((self.rows, self.dim), "float32"))
+        self.weight = jax.device_put(w, self._sharding)
+        if optimizer == "adam":
+            self.state = {
+                "m": jax.device_put(jnp.zeros_like(w), self._sharding),
+                "v": jax.device_put(jnp.zeros_like(w), self._sharding),
+                "t": jnp.zeros([], jnp.int32),
+            }
+        else:
+            self.state = {}
+
+    # -- RPC-shaped API (reference PsService pull/push, sendrecv.proto) --
+    def pull(self, ids):
+        """Gather rows for ids (trainer 'pull_sparse')."""
+        ids = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+        return Tensor(jnp.take(self.weight, ids, axis=0))
+
+    def push(self, ids, grads):
+        """Apply grads to touched rows (trainer 'push_sparse').  Repeated
+        ids accumulate (scatter-add), matching SelectedRows merge-add."""
+        ids = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+        g = grads._data if isinstance(grads, Tensor) else jnp.asarray(grads)
+        ids = ids.reshape(-1)
+        g = g.reshape(-1, self.dim)
+        dense_g = jnp.zeros_like(self.weight).at[ids].add(g)
+        touched = jnp.zeros((self.rows,), bool).at[ids].set(True)
+        if self.optimizer == "adam":
+            t = self.state["t"] + 1
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            m = jnp.where(touched[:, None],
+                          b1 * self.state["m"] + (1 - b1) * dense_g,
+                          self.state["m"])
+            v = jnp.where(touched[:, None],
+                          b2 * self.state["v"] + (1 - b2) * dense_g ** 2,
+                          self.state["v"])
+            mhat = m / (1 - b1 ** t)
+            vhat = v / (1 - b2 ** t)
+            upd = self.lr * mhat / (jnp.sqrt(vhat) + eps)
+            self.weight = jnp.where(touched[:, None], self.weight - upd,
+                                    self.weight)
+            self.state = {"m": m, "v": v, "t": t}
+        else:
+            self.weight = self.weight - self.lr * dense_g
+        self.weight = jax.device_put(self.weight, self._sharding)
+
+    # -- persistence (reference: table save/load to dirname shards) ------
+    def save(self, dirname):
+        os.makedirs(dirname, exist_ok=True)
+        with open(os.path.join(dirname, f"{self.name}.table"), "wb") as f:
+            pickle.dump({"weight": np.asarray(self.weight),
+                         "state": {k: np.asarray(v)
+                                   for k, v in self.state.items()},
+                         "rows": self.rows, "dim": self.dim,
+                         "optimizer": self.optimizer, "lr": self.lr},
+                        f, protocol=4)
+
+    def load(self, dirname):
+        with open(os.path.join(dirname, f"{self.name}.table"), "rb") as f:
+            data = pickle.load(f)
+        self.weight = jax.device_put(jnp.asarray(data["weight"]),
+                                     self._sharding)
+        self.state = {k: jnp.asarray(v) for k, v in data["state"].items()}
+
+
+class DistributedEmbedding:
+    """Trainer-side embedding over a SparseTable (reference:
+    ``distributed_lookup_table_op`` + communicator push/pull).  Forward
+    pulls; ``apply_gradients`` pushes — the explicit analogue of the
+    async communicator's send queue."""
+
+    def __init__(self, table: SparseTable):
+        self.table = table
+        self._last_ids = None
+
+    def __call__(self, ids):
+        self._last_ids = ids
+        return self.table.pull(ids)
+
+    def apply_gradients(self, grads, ids=None):
+        ids = ids if ids is not None else self._last_ids
+        self.table.push(ids, grads)
+
+
+class TheOnePS:
+    """Runtime facade (reference: fleet/runtime/the_one_ps.py:378).
+
+    Servers don't exist under SPMD; init_server/run_server keep the
+    call-sequence contract (warm-start load, table registry, barrier) so
+    PS-style training scripts run unchanged.
+    """
+
+    def __init__(self):
+        self.tables = {}
+
+    def create_table(self, name, rows, dim, **kwargs):
+        table = SparseTable(name, rows, dim, **kwargs)
+        self.tables[name] = table
+        return table
+
+    # -- server contract -------------------------------------------------
+    def init_server(self, dirname=None, var_names=None, **kwargs):
+        if dirname:
+            for name, table in self.tables.items():
+                path = os.path.join(dirname, f"{name}.table")
+                if os.path.exists(path):
+                    table.load(dirname)
+
+    def run_server(self):
+        pass  # nothing to serve: tables live on the mesh
+
+    def init_worker(self):
+        pass
+
+    def stop_worker(self):
+        pass
+
+    # -- persistence ------------------------------------------------------
+    def save_persistables(self, executor=None, dirname=None, **kwargs):
+        for table in self.tables.values():
+            table.save(dirname)
+
+    def save_inference_model(self, *args, **kwargs):
+        self.save_persistables(*args, **kwargs)
+
+
+_runtime = TheOnePS()
+
+
+def get_ps_runtime():
+    return _runtime

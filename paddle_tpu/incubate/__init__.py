@@ -1,0 +1,4 @@
+"""paddle.incubate (reference: python/paddle/incubate/)."""
+from __future__ import annotations
+
+from . import checkpoint  # noqa: F401

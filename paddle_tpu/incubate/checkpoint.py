@@ -1,0 +1,129 @@
+"""Job-level auto-checkpoint (recover an interrupted training job).
+
+Reference parity: ``fluid/incubate/checkpoint/auto_checkpoint.py`` —
+env-gated (``PADDLE_RUNNING_ENV=PADDLE_EDL_AUTO_CHECKPOINT``),
+``train_epoch_range`` wraps the epoch loop, snapshots program persistables
++ the epoch cursor after each epoch (reference: TrainEpochRange :265,
+Executor.run hook executor.py:1212), and resumes from the last snapshot on
+relaunch.  HDFS in the reference; local/NFS path here
+(``PADDLE_CHECKPOINT_DIR``, default ``./auto_checkpoint``).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+
+def _enabled():
+    return os.environ.get("PADDLE_RUNNING_ENV") == \
+        "PADDLE_EDL_AUTO_CHECKPOINT"
+
+
+def _ckpt_dir():
+    return os.environ.get("PADDLE_CHECKPOINT_DIR", "./auto_checkpoint")
+
+
+_current = [None]
+
+
+class TrainEpochRange:
+    """Iterate epochs with automatic snapshot/restore of training state.
+
+    State captured per epoch: every persistable of the default static
+    Program (params + optimizer slots) or, in dygraph, the state_dicts of
+    layers/optimizers registered via ``attach``.
+    """
+
+    def __init__(self, max_epoch_num, name="default", save_inter=None):
+        self.max_epoch_num = int(max_epoch_num)
+        self.name = name
+        self._layers = []
+        self._optimizers = []
+        self._start = 0
+        self._dir = os.path.join(_ckpt_dir(), name)
+        if _enabled():
+            self._start = self._restore()
+
+    # -- dygraph attachments -------------------------------------------
+    def attach(self, layer=None, optimizer=None):
+        if layer is not None:
+            self._layers.append(layer)
+        if optimizer is not None:
+            self._optimizers.append(optimizer)
+        if _enabled() and self._start > 0:
+            self._load_attachments()
+        return self
+
+    # -- iteration ------------------------------------------------------
+    def get(self):
+        for epoch in range(self._start, self.max_epoch_num):
+            _current[0] = self
+            yield epoch
+            if _enabled():
+                self._save(epoch)
+        _current[0] = None
+
+    __iter__ = get
+
+    # -- snapshot machinery ---------------------------------------------
+    def _state(self):
+        state = {"epoch": None, "static": {}, "layers": [], "optimizers": []}
+        from ..static import program as sprog
+        prog = sprog.default_main_program()
+        state["static"] = {n: np.asarray(t._data)
+                           for n, t in prog.captures.items()}
+        state["layers"] = [
+            {k: v.numpy() for k, v in layer.state_dict().items()}
+            for layer in self._layers]
+        state["optimizers"] = [opt.state_dict()
+                               for opt in self._optimizers]
+        return state
+
+    def _save(self, epoch):
+        os.makedirs(self._dir, exist_ok=True)
+        state = self._state()
+        state["epoch"] = epoch
+        tmp = os.path.join(self._dir, "ckpt.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f, protocol=4)
+        os.replace(tmp, os.path.join(self._dir, "ckpt.pkl"))
+
+    def _load(self):
+        path = os.path.join(self._dir, "ckpt.pkl")
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    def _restore(self):
+        state = self._load()
+        if state is None:
+            return 0
+        from ..static import program as sprog
+        prog = sprog.default_main_program()
+        for n, arr in state["static"].items():
+            if n in prog.captures:
+                prog.captures[n].set_value(arr)
+        self._saved_state = state
+        return int(state["epoch"]) + 1
+
+    def _load_attachments(self):
+        state = getattr(self, "_saved_state", None) or self._load()
+        if state is None:
+            return
+        for layer, sd in zip(self._layers, state.get("layers", [])):
+            layer.set_state_dict(sd)
+        for opt, sd in zip(self._optimizers, state.get("optimizers", [])):
+            opt.set_state_dict(sd)
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=None,
+                      name="default"):
+    """reference auto_checkpoint.py:_get_train_epoch_range generator API."""
+    return TrainEpochRange(max_epoch_num, name=name,
+                           save_inter=save_checkpoint_inter).get()
+
+
+auto_checkpoint = TrainEpochRange  # module-style alias

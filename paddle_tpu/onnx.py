@@ -1,0 +1,21 @@
+"""paddle.onnx (reference: python/paddle/onnx/export.py, delegating to the
+external paddle2onnx package).
+
+The TPU build's portable artifact is StableHLO (paddle.jit.save), which is
+what XLA-family runtimes consume; ONNX export would need an external
+converter that is not vendored, so export() saves the StableHLO artifact
+and says so rather than silently writing a different format.
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    from . import jit as jit_mod
+    if path.endswith(".onnx"):
+        path = path[:-len(".onnx")]
+    jit_mod.save(layer, path, input_spec=input_spec)
+    raise NotImplementedError(
+        "ONNX serialization requires the external paddle2onnx converter "
+        "(not available in this environment). The model WAS exported as a "
+        f"portable StableHLO artifact at '{path}.pdmodel' — load it with "
+        "paddle.jit.load or paddle.inference.Predictor.")

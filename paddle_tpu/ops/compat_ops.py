@@ -1,0 +1,235 @@
+"""Legacy / 1.x-style API names kept at the paddle top level.
+
+Reference parity: the alias block of ``python/paddle/__init__.py``
+(DEFINE_ALIAS entries) plus fluid-era layers that survived into 2.0:
+``elementwise_*`` / ``reduce_*`` (fluid/layers/nn.py), ``fill_constant`` /
+``create_global_var`` / ``create_parameter`` (fluid/layers/tensor.py),
+``has_inf/has_nan/isfinite`` (fluid/layers/ops), in-place variants
+(``tanh_`` etc., dygraph inplace API), ``set_printoptions``.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core.dispatch import primitive, ensure_tensor
+from ..core import dtype as dtypes
+
+
+# ---- aggregation / shape helpers -----------------------------------------
+
+@primitive(name="add_n")
+def _add_n(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def add_n(inputs, name=None):
+    """reference: sum_op.cc (paddle.add_n)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    return _add_n(*[ensure_tensor(x) for x in inputs])
+
+
+@primitive(name="kron")
+def _kron(a, b):
+    return jnp.kron(a, b)
+
+
+def kron(x, y, name=None):
+    return _kron(ensure_tensor(x), ensure_tensor(y))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def rank(input):
+    return ensure_tensor(input).ndim
+
+
+def shape(input):
+    """reference shape_op: returns the shape as a 1-D int32 tensor."""
+    return Tensor(np.asarray(ensure_tensor(input).shape, np.int32))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    return Tensor(np.asarray(ensure_tensor(x).size == 0))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = ensure_tensor(x)
+    n = num or x.shape[axis]
+    from .manipulation import split, squeeze
+    parts = split(x, n, axis=axis)
+    return [squeeze(p, axis=axis) for p in parts]
+
+
+def slice(input, axes, starts, ends):
+    """reference slice_op.cc."""
+    x = ensure_tensor(input)
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(int(s), int(e))
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = ensure_tensor(x)
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(int(s), int(e), int(st))
+    return x[tuple(idx)]
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    x = ensure_tensor(x)
+    offsets = offsets or [0] * x.ndim
+    idx = tuple(builtins.slice(int(o), int(o) + int(s))
+                for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+# ---- fluid-era creation ---------------------------------------------------
+
+def fill_constant(shape, dtype, value, name=None, out=None):
+    from .creation import full
+    res = full(shape, value, dtype=dtype)
+    if out is not None:
+        out.set_value(res)
+        return out
+    return res
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    t = Tensor(np.full(shape, value, dtypes.to_numpy(dtype)
+                       if hasattr(dtypes, "to_numpy") else dtype), name=name)
+    t.persistable = persistable
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..nn import initializer as I
+    init = default_initializer or (
+        I.Constant(0.0) if is_bias else I.XavierUniform())
+    return Parameter(init(shape, dtype), name=name)
+
+
+# ---- numeric checks -------------------------------------------------------
+
+@primitive(name="has_inf")
+def _has_inf(x):
+    return jnp.isinf(x).any()
+
+
+@primitive(name="has_nan")
+def _has_nan(x):
+    return jnp.isnan(x).any()
+
+
+def has_inf(x):
+    return _has_inf(ensure_tensor(x))
+
+
+def has_nan(x):
+    return _has_nan(ensure_tensor(x))
+
+
+# ---- elementwise_* / reduce_* legacy names -------------------------------
+
+def _elementwise(op_name):
+    def op(x, y, axis=-1, act=None, name=None):
+        from . import math as M
+        fn = getattr(M, op_name)
+        out = fn(ensure_tensor(x), ensure_tensor(y))
+        if act:
+            from ..nn import functional as F
+            out = getattr(F, act)(out)
+        return out
+    op.__name__ = "elementwise_" + op_name
+    return op
+
+
+elementwise_add = _elementwise("add")
+elementwise_sub = _elementwise("subtract")
+elementwise_mul = _elementwise("multiply")
+elementwise_div = _elementwise("divide")
+elementwise_pow = _elementwise("pow")
+elementwise_mod = _elementwise("mod")
+elementwise_floordiv = _elementwise("floor_divide")
+elementwise_max = _elementwise("maximum")
+elementwise_min = _elementwise("minimum")
+
+
+def _reduce(op_name):
+    def op(input, dim=None, keep_dim=False, name=None):
+        from . import math as M
+        return getattr(M, op_name)(ensure_tensor(input), axis=dim,
+                                   keepdim=keep_dim)
+    op.__name__ = "reduce_" + op_name
+    return op
+
+
+reduce_sum = _reduce("sum")
+reduce_mean = _reduce("mean")
+reduce_max = _reduce("max")
+reduce_min = _reduce("min")
+reduce_prod = _reduce("prod")
+
+
+# ---- in-place variants (dygraph inplace API) ------------------------------
+
+def _inplace(fn_name):
+    def op(x, *args, **kwargs):
+        from .. import ops as O
+        res = getattr(O, fn_name)(x, *args, **kwargs)
+        x._data = res._data
+        return x
+    op.__name__ = fn_name + "_"
+    return op
+
+
+tanh_ = _inplace("tanh")
+squeeze_ = _inplace("squeeze")
+unsqueeze_ = _inplace("unsqueeze")
+scatter_ = _inplace("scatter")
+exp_ = _inplace("exp")
+sqrt_ = _inplace("sqrt")
+ceil_ = _inplace("ceil")
+floor_ = _inplace("floor")
+round_ = _inplace("round")
+clip_ = _inplace("clip")
+subtract_ = _inplace("subtract")
+add_ = _inplace("add")
+
+
+# ---- printing -------------------------------------------------------------
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """paddle.set_printoptions → numpy printoptions (Tensor repr uses
+    np.array2string)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)

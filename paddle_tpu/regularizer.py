@@ -1,0 +1,23 @@
+"""paddle.regularizer (reference: python/paddle/regularizer.py, fluid
+regularizer.py).  Consumed by Optimizer weight_decay via the `_coeff`
+attribute; L1 is applied as a grad transform in the optimizer base."""
+from __future__ import annotations
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+        self.coeff = self._coeff
+
+    def __repr__(self):
+        return f"L2Decay({self._coeff})"
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+        self.coeff = self._coeff
+        self.l1 = True
+
+    def __repr__(self):
+        return f"L1Decay({self._coeff})"

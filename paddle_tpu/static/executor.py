@@ -85,8 +85,11 @@ class Executor:
         captures = {n: program.captures[n]._data for n in cap_names}
         lrs = tuple(jnp.asarray(n.optimizer.get_lr(), jnp.float32)
                     for n in program.nodes if isinstance(n, OptimizeNode))
-        fetches, updated = fn(feed_arrays, captures, lrs,
-                              rng_mod.next_key())
+        # draw from the global stream only if the program has stochastic
+        # ops — a deterministic program must not perturb the RNG sequence
+        rkey = rng_mod.next_key() if program.rng_vids else \
+            jax.random.key(0)
+        fetches, updated = fn(feed_arrays, captures, lrs, rkey)
 
         for name, arr in updated.items():
             program.captures[name]._data = arr

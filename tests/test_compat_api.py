@@ -1,0 +1,138 @@
+"""1.x-compat aliases and auxiliary modules (reference: the DEFINE_ALIAS
+block of python/paddle/__init__.py)."""
+import os
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_elementwise_and_reduce_aliases():
+    x = paddle.to_tensor(np.array([[1., 2.], [3., 4.]], "float32"))
+    y = paddle.to_tensor(np.array([[1., 1.], [1., 1.]], "float32"))
+    np.testing.assert_allclose(paddle.elementwise_add(x, y).numpy(),
+                               [[2, 3], [4, 5]])
+    np.testing.assert_allclose(paddle.elementwise_div(x, y).numpy(),
+                               x.numpy())
+    assert float(paddle.reduce_mean(x).numpy()) == 2.5
+    np.testing.assert_allclose(
+        paddle.reduce_max(x, dim=0).numpy(), [3, 4])
+
+
+def test_slice_ops():
+    x = paddle.to_tensor(np.arange(24).reshape(2, 3, 4).astype("float32"))
+    s = paddle.slice(x, axes=[1, 2], starts=[0, 1], ends=[2, 3])
+    assert s.shape == [2, 2, 2]
+    ss = paddle.strided_slice(x, axes=[2], starts=[0], ends=[4],
+                              strides=[2])
+    assert ss.shape == [2, 3, 2]
+    c = paddle.crop_tensor(x, shape=[1, 2, 2], offsets=[0, 1, 1])
+    assert c.shape == [1, 2, 2]
+    parts = paddle.unstack(x, axis=0)
+    assert len(parts) == 2 and parts[0].shape == [3, 4]
+
+
+def test_creation_compat():
+    t = paddle.fill_constant([2, 2], "float32", 3.0)
+    np.testing.assert_allclose(t.numpy(), np.full((2, 2), 3.0))
+    g = paddle.create_global_var([3], 1.5, "float32", persistable=True)
+    assert g.persistable
+    p = paddle.create_parameter([4, 4], "float32")
+    assert p.trainable
+
+
+def test_nan_inf_checks():
+    x = paddle.to_tensor(np.array([1.0, np.inf], "float32"))
+    assert bool(paddle.has_inf(x).numpy())
+    assert not bool(paddle.has_nan(x).numpy())
+
+
+def test_inplace_variants():
+    x = paddle.to_tensor(np.array([4.0], "float32"))
+    y = paddle.sqrt_(x)
+    assert y is x
+    assert float(x.numpy()) == 2.0
+
+
+def test_regularizer_weight_decay():
+    from paddle_tpu import optimizer, regularizer, nn
+    net = nn.Linear(2, 2)
+    opt = optimizer.Momentum(learning_rate=0.1,
+                             weight_decay=regularizer.L2Decay(1e-4),
+                             parameters=net.parameters())
+    assert opt._weight_decay == pytest.approx(1e-4)
+
+
+def test_batch_reader():
+    def reader():
+        for i in range(7):
+            yield i
+    b = paddle.batch(reader, 3)
+    batches = list(b())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    b2 = paddle.batch(reader, 3, drop_last=True)
+    assert list(b2()) == [[0, 1, 2], [3, 4, 5]]
+
+
+def test_dygraph_mode_toggles():
+    assert paddle.in_dygraph_mode()
+    paddle.disable_dygraph()
+    assert not paddle.in_dygraph_mode()
+    paddle.enable_dygraph()
+    assert paddle.in_dygraph_mode()
+
+
+def test_summary_and_flops():
+    from paddle_tpu import nn
+    net = nn.Sequential(nn.Linear(8, 4), nn.ReLU())
+    info = paddle.summary(net)
+    assert info["total_params"] == 8 * 4 + 4
+    assert paddle.flops(net, None) == 2 * 8 * 4
+
+
+def test_auto_checkpoint_roundtrip(tmp_path, monkeypatch):
+    from paddle_tpu.incubate.checkpoint import TrainEpochRange
+    from paddle_tpu import nn, optimizer
+    monkeypatch.setenv("PADDLE_RUNNING_ENV", "PADDLE_EDL_AUTO_CHECKPOINT")
+    monkeypatch.setenv("PADDLE_CHECKPOINT_DIR", str(tmp_path))
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    r = TrainEpochRange(5, name="job1").attach(net, opt)
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    done = []
+    w_after_epoch1 = None
+    for epoch in r.get():
+        loss = paddle.mean(net(x) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        done.append(epoch)
+        if epoch == 1:
+            w_after_epoch1 = dict(
+                net.named_parameters())["weight"].numpy().copy()
+        if epoch == 2:
+            break  # crash mid-epoch-2: its snapshot never happens
+    # epochs 0..1 snapshotted (break skips epoch 2's save)
+    assert done == [0, 1, 2]
+
+    # relaunch: a fresh layer resumes from the last snapshot (epoch 1)
+    paddle.seed(0)
+    net2 = nn.Linear(4, 2)
+    opt2 = optimizer.SGD(learning_rate=0.1, parameters=net2.parameters())
+    r2 = TrainEpochRange(5, name="job1").attach(net2, opt2)
+    epochs2 = list(r2.get().__iter__().__next__() for _ in range(1))
+    assert epochs2[0] == 2  # resumes at epoch 2
+    np.testing.assert_allclose(
+        dict(net2.named_parameters())["weight"].numpy(), w_after_epoch1,
+        rtol=1e-6)
+
+
+def test_misc_shims():
+    assert paddle.get_cudnn_version() is None
+    assert paddle.VarBase is paddle.Tensor
+    assert isinstance(paddle.compat.to_text(b"abc"), str)
+    x = paddle.to_tensor([1.0])
+    assert paddle.get_tensor_from_selected_rows(x) is x
+    assert paddle.__version__.startswith("2.")

@@ -1,0 +1,55 @@
+"""Sharded checkpoint: TrainStep state roundtrip on the 8-device mesh
+(reference: fleet save/load + save_combine_op persistence)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu import distributed as dist
+from paddle_tpu.distributed.checkpoint import (
+    save_sharded, load_sharded, save_train_state, load_train_state)
+from paddle_tpu.parallel.train_step import TrainStep
+
+
+class MSE(nn.Layer):
+    def forward(self, p, l):
+        return paddle.mean((p - l) ** 2)
+
+
+def test_nested_tree_roundtrip(tmp_path):
+    state = {"a": {"w": paddle.to_tensor(np.ones((2, 3), "float32")),
+                   "m": paddle.to_tensor(np.zeros((3,), "float32"))},
+             "b": paddle.to_tensor(np.arange(4, dtype="float32"))}
+    path = str(tmp_path / "ck")
+    save_sharded(state, path)
+    restored = load_sharded(path)
+    np.testing.assert_allclose(np.asarray(restored["a"]["w"]),
+                               np.ones((2, 3)))
+    np.testing.assert_allclose(np.asarray(restored["b"]), np.arange(4))
+
+
+def test_train_state_roundtrip(tmp_path):
+    mesh = dist.build_mesh(dp=4, sharding=2)
+    x = np.random.RandomState(0).rand(32, 8).astype("float32")
+    y = np.random.RandomState(1).rand(32, 1).astype("float32")
+    paddle.seed(0)
+    net = nn.Linear(8, 1)
+    step = TrainStep(net, optimizer.Adam(learning_rate=0.01,
+                                         parameters=net.parameters()),
+                     loss_fn=MSE(), mesh=mesh,
+                     strategy=None)
+    for _ in range(5):
+        step.step([x], [y])
+    path = str(tmp_path / "train_ck")
+    save_train_state(step, path)
+    l_next = float(step.step([x], [y]).numpy())
+
+    # fresh model + step restores and continues identically
+    paddle.seed(999)  # different init — must be overwritten by restore
+    net2 = nn.Linear(8, 1)
+    step2 = TrainStep(net2, optimizer.Adam(learning_rate=0.01,
+                                           parameters=net2.parameters()),
+                      loss_fn=MSE(), mesh=mesh, strategy=None)
+    load_train_state(step2, path)
+    l2 = float(step2.step([x], [y]).numpy())
+    assert l2 == pytest.approx(l_next, rel=1e-5)
