@@ -96,7 +96,8 @@ from .ops.compat_ops import (  # noqa: E402,F401
     elementwise_max, elementwise_min,
     reduce_sum, reduce_mean, reduce_max, reduce_min, reduce_prod,
     tanh_, squeeze_, unsqueeze_, scatter_, exp_, sqrt_, ceil_, floor_,
-    round_, clip_, subtract_, add_, set_printoptions)
+    round_, clip_, subtract_, add_, set_printoptions,
+    create_array, array_write, array_read, array_length)
 from .ops.linalg import (cholesky, cross, dist, histogram,  # noqa: E402,F401
                          inverse, norm, bincount)
 from . import device  # noqa: E402,F401

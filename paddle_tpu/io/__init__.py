@@ -426,3 +426,4 @@ def get_worker_info():
 
 from .native_dataset import (InMemoryDataset, QueueDataset,  # noqa: E402
                              DatasetFactory)
+

@@ -185,3 +185,6 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
         lab = lab.squeeze(-1)
     correct_any = (order == lab[..., None]).any(-1)
     return Tensor(np.asarray(correct_any.mean(), np.float32))
+
+import sys as _sys
+metrics = _sys.modules[__name__]  # reference: paddle.metric.metrics module alias

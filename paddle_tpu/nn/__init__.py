@@ -32,10 +32,13 @@ from .layer.loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     KLDivLoss, SmoothL1Loss, MarginRankingLoss, CTCLoss,
     HingeEmbeddingLoss, CosineEmbeddingLoss, TripletMarginLoss,
+    PairwiseDistance, HSigmoidLoss, NCELoss,
 )
 from .layer.rnn import (  # noqa: F401
     SimpleRNN, LSTM, GRU, LSTMCell, GRUCell, SimpleRNNCell,
+    RNNCellBase, RNN, BiRNN,
 )
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,
@@ -47,3 +50,10 @@ from .clip import (  # noqa: F401
     ClipGradByNorm, ClipGradByValue, ClipGradByGlobalNorm, clip_grad_norm_,
 )
 from . import utils  # noqa: F401
+
+# submodule aliases matching the reference layout (nn/functional/common.py
+# etc. are importable module paths there)
+from .functional import common, conv, loss, norm, extension  # noqa: F401
+from .layer import rnn  # noqa: F401
+from .layer import common as _layer_common  # noqa: F401
+vision = extension  # detection/vision functionals live there + vision.ops

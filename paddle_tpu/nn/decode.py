@@ -1,0 +1,129 @@
+"""Sequence decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference parity: ``python/paddle/nn/decode.py`` (BeamSearchDecoder over an
+RNN cell with tiled beams, driven by ``dynamic_decode``) and the fluid
+``beam_search`` / ``gather_tree`` ops (``operators/math/beam_search.cc``,
+``gather_tree_op.cc``).
+
+TPU-native: beam state is dense ``[batch*beam, ...]`` arrays; each step is
+one batched cell call + a top-k over ``beam*vocab`` — MXU-friendly, no
+LoD.  The step loop is a Python loop (max_step_num is static), so the
+whole decode jit-compiles as one program when called under jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import ensure_tensor
+
+
+def _tile_beam(x, beam_size):
+    """[B, ...] -> [B*beam, ...] (reference: BeamSearchDecoder
+    tile_beam_merge_with_batch)."""
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    arr = jnp.repeat(arr, beam_size, axis=0)
+    return Tensor(arr)
+
+
+class BeamSearchDecoder:
+    """reference nn/decode.py:BeamSearchDecoder."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        return _tile_beam(x, beam_size)
+
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(
+            lambda s: _tile_beam(s, self.beam_size), initial_cell_states,
+            is_leaf=lambda s: isinstance(s, Tensor))
+        batch_beam = None
+        for leaf in jax.tree_util.tree_leaves(
+                jax.tree_util.tree_map(
+                    lambda s: s._data, states,
+                    is_leaf=lambda s: isinstance(s, Tensor))):
+            batch_beam = leaf.shape[0]
+            break
+        batch = batch_beam // self.beam_size
+        ids = jnp.full((batch, self.beam_size), self.start_token,
+                       jnp.int32)
+        # first expansion: only beam 0 is live so beams diverge
+        log_probs = jnp.tile(
+            jnp.array([0.0] + [-1e9] * (self.beam_size - 1), jnp.float32),
+            (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        return ids, states, log_probs, finished
+
+    def step(self, inputs, states):
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(inputs)
+        out, new_states = self.cell(inputs, states)
+        logits = self.output_fn(out) if self.output_fn is not None else out
+        return logits, new_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=20, output_time_major=False,
+                   return_length=False, **kwargs):
+    """reference nn/decode.py:dynamic_decode — drive the decoder until all
+    beams finish or max_step_num; returns (ids [B, beam, T], lengths)."""
+    ids0, states, log_probs, finished = decoder.initialize(inits)
+    batch, beam = ids0.shape
+    tokens = ids0  # current token per beam
+    step_ids, step_parents = [], []
+
+    for _ in range(max_step_num):
+        flat_tokens = Tensor(tokens.reshape(-1))
+        logits, states = decoder.step(flat_tokens, states)
+        logits = logits._data if isinstance(logits, Tensor) else logits
+        vocab = logits.shape[-1]
+        logp = jax.nn.log_softmax(
+            logits.astype(jnp.float32), axis=-1).reshape(batch, beam,
+                                                         vocab)
+        # finished beams only extend with end_token at zero cost
+        fin_mask = jnp.full((vocab,), -1e9).at[decoder.end_token].set(0.0)
+        logp = jnp.where(finished[:, :, None], fin_mask[None, None, :],
+                         logp)
+        total = log_probs[:, :, None] + logp           # [B, beam, V]
+        flat = total.reshape(batch, beam * vocab)
+        log_probs, idx = jax.lax.top_k(flat, beam)     # [B, beam]
+        parents = idx // vocab
+        tokens = (idx % vocab).astype(jnp.int32)
+        # reorder cell states by chosen parent beams
+        gather = (jnp.arange(batch)[:, None] * beam + parents).reshape(-1)
+        states = jax.tree_util.tree_map(
+            lambda s: Tensor(jnp.take(s._data, gather, axis=0)), states,
+            is_leaf=lambda s: isinstance(s, Tensor))
+        finished = jnp.take_along_axis(finished, parents, axis=1) | (
+            tokens == decoder.end_token)
+        step_ids.append(tokens)
+        step_parents.append(parents)
+        # early exit only outside jit (under a trace `finished` is abstract)
+        if not isinstance(finished, jax.core.Tracer) and \
+                bool(jnp.all(finished)):
+            break
+
+    # backtrace through parent pointers (reference gather_tree)
+    from .functional.extension import gather_tree
+    ids_arr = jnp.stack(step_ids)                      # [T, B, beam]
+    parents_arr = jnp.stack(step_parents)
+    seqs_t = gather_tree(Tensor(ids_arr), Tensor(parents_arr))._data
+    seqs_b = jnp.transpose(seqs_t, (1, 2, 0))          # [B, beam, T]
+    is_end = seqs_b == decoder.end_token
+    has_end = jnp.any(is_end, axis=-1)
+    first_end = jnp.argmax(is_end.astype(jnp.int32), axis=-1)
+    lengths = jnp.where(has_end, first_end + 1, seqs_b.shape[-1])
+    seqs = seqs_t if output_time_major else seqs_b
+    if return_length:
+        return Tensor(seqs), Tensor(lengths)
+    return Tensor(seqs)

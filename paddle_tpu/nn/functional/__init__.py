@@ -21,3 +21,46 @@ from .sequence import (  # noqa: F401
     sequence_pad, sequence_unpad, sequence_pool, sequence_softmax,
     sequence_expand, sequence_reverse, edit_distance,
 )
+from .extension import (  # noqa: F401
+    grid_sample, diag_embed, gather_tree, bilinear,
+    bilinear_tensor_product, dice_loss, npair_loss,
+)
+
+# -- fluid-era functional aliases (reference fluid/layers re-exports) ------
+from .common import interpolate as image_resize  # noqa: F401
+from .common import pad as pad2d  # noqa: F401
+from ...ops.math import erf  # noqa: F401
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           data_format="NCHW", name=None):
+    """reference: fluid/layers/nn.py pool2d."""
+    from . import pooling as _pooling
+    if global_pooling:
+        fn = (_pooling.adaptive_max_pool2d if pool_type == "max"
+              else _pooling.adaptive_avg_pool2d)
+        return fn(input, output_size=1)
+    fn = _pooling.max_pool2d if pool_type == "max" else _pooling.avg_pool2d
+    return fn(input, kernel_size=pool_size, stride=pool_stride,
+              padding=pool_padding, ceil_mode=ceil_mode,
+              data_format=data_format)
+
+
+def _vision_alias(name):
+    def fn(*args, **kwargs):
+        from ...vision import ops as vops
+        return getattr(vops, name)(*args, **kwargs)
+    fn.__name__ = name
+    return fn
+
+
+# detection heads live in paddle.vision.ops; the reference also re-exports
+# them through the functional namespace (fluid/layers/detection.py)
+yolo_box = _vision_alias("yolo_box")
+prior_box = _vision_alias("prior_box")
+box_coder = _vision_alias("box_coder")
+multiclass_nms = _vision_alias("multiclass_nms")
+roi_align = _vision_alias("roi_align")
+roi_pool = _vision_alias("roi_pool")
+deformable_conv = _vision_alias("deform_conv2d")

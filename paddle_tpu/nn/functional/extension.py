@@ -1,0 +1,206 @@
+"""Extension functionals: grid_sample, diag_embed, gather_tree, bilinear,
+dice_loss, npair_loss + fluid-era functional aliases.
+
+Reference parity: grid_sampler_op.cc, diag_embed_op.cc,
+gather_tree_op.cc (beam-search backtrace), bilinear_tensor_product_op.cc,
+and the ``fluid/layers/nn.py`` functional surface re-exported by
+``paddle.nn.functional`` (pad2d, image_resize, pool2d, …).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive, ensure_tensor
+from ...core.tensor import Tensor
+
+
+# ---- grid_sample ---------------------------------------------------------
+
+@primitive(name="grid_sample")
+def _grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                 align_corners=True):
+    """x [N,C,H,W], grid [N,Hg,Wg,2] in [-1,1] (xy order)."""
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+
+    def unnorm(coord, size):
+        if align_corners:
+            return (coord + 1) * (size - 1) / 2
+        return ((coord + 1) * size - 1) / 2
+
+    fx = unnorm(gx, w)
+    fy = unnorm(gy, h)
+
+    if padding_mode == "reflection":
+        def reflect(coord, size):
+            if align_corners:
+                span = 2 * (size - 1)
+                if span == 0:
+                    return jnp.zeros_like(coord)
+                m = jnp.mod(jnp.abs(coord), span)
+                return jnp.where(m > size - 1, span - m, m)
+            span = 2 * size
+            c = jnp.mod(jnp.abs(coord + 0.5), span)
+            c = jnp.where(c > size, span - c, c) - 0.5
+            return jnp.clip(c, 0, size - 1)
+
+        fx = reflect(fx, w)
+        fy = reflect(fy, h)
+
+    def sample(ix, iy):
+        # gather with border/zeros handling
+        ix_c = jnp.clip(ix, 0, w - 1)
+        iy_c = jnp.clip(iy, 0, h - 1)
+        batch = jnp.arange(n).reshape(n, 1, 1)
+        vals = x[batch, :, iy_c, ix_c]          # [N,Hg,Wg,C]
+        if padding_mode == "zeros":
+            valid = ((ix >= 0) & (ix <= w - 1) & (iy >= 0)
+                     & (iy <= h - 1))
+            vals = jnp.where(valid[..., None], vals, 0.0)
+        return vals
+
+    if mode == "nearest":
+        out = sample(jnp.round(fx).astype(jnp.int32),
+                     jnp.round(fy).astype(jnp.int32))
+    else:  # bilinear
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = fx - x0
+        wy = fy - y0
+        v00 = sample(x0, y0)
+        v01 = sample(x1, y0)
+        v10 = sample(x0, y1)
+        v11 = sample(x1, y1)
+        out = (v00 * ((1 - wx) * (1 - wy))[..., None]
+               + v01 * (wx * (1 - wy))[..., None]
+               + v10 * ((1 - wx) * wy)[..., None]
+               + v11 * (wx * wy)[..., None])
+    return jnp.transpose(out, (0, 3, 1, 2))      # [N,C,Hg,Wg]
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    return _grid_sample(ensure_tensor(x), ensure_tensor(grid), mode=mode,
+                        padding_mode=padding_mode,
+                        align_corners=align_corners)
+
+
+# ---- diag_embed ----------------------------------------------------------
+
+@primitive(name="diag_embed")
+def _diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    if x.ndim > 1:
+        out = jax.vmap(jnp.diag, in_axes=0)(x.reshape(-1, x.shape[-1]))
+        n = x.shape[-1]
+        out = out.reshape(x.shape[:-1] + (n, n))
+    else:
+        out = jnp.diag(x)
+        n = x.shape[-1]
+    if offset != 0:
+        pad = abs(offset)
+        big = jnp.zeros(out.shape[:-2] + (n + pad, n + pad), x.dtype)
+        if offset > 0:
+            big = big.at[..., :n, pad:].set(out)
+        else:
+            big = big.at[..., pad:, :n].set(out)
+        out = big
+    # the new diagonal dims were appended at (-2, -1); honor dim1/dim2
+    nd = out.ndim
+    d1 = dim1 if dim1 >= 0 else nd + dim1
+    d2 = dim2 if dim2 >= 0 else nd + dim2
+    if (d1, d2) != (nd - 2, nd - 1):
+        out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+    return out
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    return _diag_embed(ensure_tensor(input), offset=offset, dim1=dim1,
+                       dim2=dim2)
+
+
+# ---- gather_tree (beam search backtrace) ---------------------------------
+
+@primitive(name="gather_tree", nondiff=(0, 1))
+def _gather_tree(ids, parents):
+    """ids/parents [T, B, beam] -> full predicted sequences.
+    reference: gather_tree_op.cc."""
+    T = ids.shape[0]
+
+    def body(t, out):
+        # out[t+1:] already filled; trace parent pointers at step t
+        idx = out[1]
+        gathered = jnp.take_along_axis(ids[t], idx, axis=-1)
+        parent = jnp.take_along_axis(parents[t], idx, axis=-1)
+        res = out[0].at[t].set(gathered)
+        return (res, parent)
+
+    init = (jnp.zeros_like(ids),
+            jnp.broadcast_to(jnp.arange(ids.shape[2]), ids.shape[1:]))
+    out, _ = jax.lax.fori_loop(
+        0, T, lambda i, o: body(T - 1 - i, o), init)
+    return out
+
+
+def gather_tree(ids, parents):
+    return _gather_tree(ensure_tensor(ids), ensure_tensor(parents))
+
+
+# ---- bilinear tensor product ---------------------------------------------
+
+@primitive(name="bilinear")
+def _bilinear(x1, x2, weight, bias=None):
+    # weight [out, d1, d2]
+    out = jnp.einsum("bd,ode,be->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """reference: bilinear_tensor_product_op.cc."""
+    args = [ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)]
+    if bias is not None:
+        return _bilinear(*args, ensure_tensor(bias))
+    return _bilinear(*args)
+
+
+bilinear_tensor_product = bilinear
+
+
+# ---- losses --------------------------------------------------------------
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """reference: fluid/layers/nn.py dice_loss."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+
+    @primitive(name="dice_loss", nondiff=(1,))
+    def _dice(x, y):
+        yf = jax.nn.one_hot(y.squeeze(-1), x.shape[-1], dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * yf, axis=red)
+        union = jnp.sum(x, axis=red) + jnp.sum(yf, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return _dice(input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    anchor, positive = ensure_tensor(anchor), ensure_tensor(positive)
+    labels = ensure_tensor(labels)
+
+    @primitive(name="npair_loss", nondiff=(2,))
+    def _npair(a, p, lab):
+        sim = a @ p.T
+        lab = lab.reshape(-1)
+        tgt = (lab[:, None] == lab[None, :]).astype(a.dtype)
+        tgt = tgt / tgt.sum(-1, keepdims=True)
+        ce = jnp.mean(jnp.sum(
+            -tgt * jax.nn.log_softmax(sim, axis=-1), axis=-1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1))
+                        + jnp.mean(jnp.sum(p * p, -1))) * 0.25
+        return ce + reg
+
+    return _npair(anchor, positive, labels)

@@ -1,8 +1,14 @@
 """Loss layers (reference: python/paddle/nn/layer/loss.py)."""
 from __future__ import annotations
 
+import numpy as np
+import jax
+import jax.numpy as jnp
+
 from .base import Layer
 from .. import functional as F
+from .. import initializer as I
+from ...core.dispatch import primitive, ensure_tensor
 
 
 class CrossEntropyLoss(Layer):
@@ -159,3 +165,132 @@ class TripletMarginLoss(Layer):
         margin, p, eps, swap, reduction = self.args
         return F.triplet_margin_loss(input, positive, negative, margin, p,
                                      eps, swap, reduction)
+
+
+class PairwiseDistance(Layer):
+    """reference: nn/layer/distance.py — p-norm of x - y."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        x, y = ensure_tensor(x), ensure_tensor(y)
+        p, eps, keep = self.p, self.epsilon, self.keepdim
+
+        @primitive(name="pairwise_distance")
+        def _dist(a, b):
+            d = a - b + eps
+            return jnp.sum(jnp.abs(d) ** p, axis=-1,
+                           keepdims=keep) ** (1.0 / p)
+
+        return _dist(x, y)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid over a complete binary tree
+    (reference: hierarchical_sigmoid_op.cc with default tree; the custom
+    path/code inputs of the reference are not supported — pass
+    is_custom=False trees only)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "HSigmoidLoss custom trees: supply your own path codes via "
+                "the functional form")
+        self.num_classes = num_classes
+        d = int(np.ceil(np.log2(max(num_classes, 2))))
+        self.depth = d
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size],
+            default_initializer=I.Uniform(-0.5 / feature_size,
+                                          0.5 / feature_size))
+        self.bias = self.create_parameter(
+            [num_classes - 1], is_bias=True,
+            default_initializer=I.Constant(0.0))
+        # complete-binary-tree paths: node ids and left/right codes per class
+        paths = np.zeros((num_classes, d), np.int32)
+        codes = np.zeros((num_classes, d), np.float32)
+        mask = np.zeros((num_classes, d), np.float32)
+        for c in range(num_classes):
+            node = c + num_classes  # leaves at [num_classes, 2*num_classes)
+            lvl = 0
+            while node > 1 and lvl < d:
+                parent = node // 2
+                paths[c, lvl] = parent - 1       # internal nodes 1-indexed
+                codes[c, lvl] = float(node % 2)  # right child -> 1
+                mask[c, lvl] = 1.0
+                node = parent
+                lvl += 1
+        self._paths = jnp.asarray(paths)
+        self._codes = jnp.asarray(codes)
+        self._mask = jnp.asarray(mask)
+
+    def forward(self, input, label):
+        input, label = ensure_tensor(input), ensure_tensor(label)
+        paths, codes, mask = self._paths, self._codes, self._mask
+
+        @primitive(name="hsigmoid_loss", nondiff=(1,))
+        def _hs(x, y, w, b):
+            y = y.reshape(-1)
+            node_ids = paths[y]                   # [B, depth]
+            node_codes = codes[y]
+            node_mask = mask[y]
+            wv = w[node_ids]                      # [B, depth, feat]
+            bv = b[node_ids]
+            logits = jnp.einsum("bdf,bf->bd", wv, x) + bv
+            # BCE per tree node: code==1 means "go right"
+            losses = node_mask * (
+                jax.nn.softplus(logits) - node_codes * logits)
+            return jnp.sum(losses, axis=-1, keepdims=True)
+
+        return _hs(input, label, self.weight, self.bias)
+
+
+class NCELoss(Layer):
+    """Noise-contrastive estimation with a uniform sampler
+    (reference: nce_op.cc; only the 'uniform' sampler is implemented)."""
+
+    def __init__(self, feature_size, num_classes, num_neg_samples=10,
+                 sampler="uniform", weight_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        if sampler != "uniform":
+            raise NotImplementedError(
+                "NCELoss: only the uniform sampler is implemented "
+                "(reference custom_dist/log_uniform samplers)")
+        self.num_classes = num_classes
+        self.num_neg = num_neg_samples
+        self.weight = self.create_parameter(
+            [num_classes, feature_size],
+            default_initializer=I.Uniform(-0.01, 0.01))
+        self.bias = self.create_parameter(
+            [num_classes], is_bias=True,
+            default_initializer=I.Constant(0.0))
+
+    def forward(self, input, label, sample_weight=None):
+        from ...core import rng as rng_mod
+        input, label = ensure_tensor(input), ensure_tensor(label)
+        key = rng_mod.op_key(input, label)
+        num_neg, num_classes = self.num_neg, self.num_classes
+
+        @primitive(name="nce_loss", nondiff=(1, 4))
+        def _nce(x, y, w, b, k):
+            y = y.reshape(-1)
+            batch = x.shape[0]
+            neg = jax.random.randint(k, (batch, num_neg), 0, num_classes)
+            pos_logit = jnp.einsum("bf,bf->b", x, w[y]) + b[y]
+            neg_logit = jnp.einsum("bf,bnf->bn", x, w[neg]) + b[neg]
+            # NCE posterior uses k*q(w) (reference nce_op multiplies the
+            # sampler prob by num_neg_samples)
+            log_q = jnp.log(num_neg / num_classes)
+            pos_loss = jax.nn.softplus(-(pos_logit - log_q))
+            neg_loss = jnp.sum(jax.nn.softplus(neg_logit - log_q), axis=-1)
+            return (pos_loss + neg_loss).reshape(-1, 1)
+
+        return _nce(input, label, self.weight, self.bias, key)

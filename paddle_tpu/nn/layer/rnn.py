@@ -335,3 +335,90 @@ class SimpleRNNCell(Layer):
         h_new = _cell(inputs, ensure_tensor(states), self.weight_ih,
                       self.weight_hh, self.bias_ih, self.bias_hh)
         return h_new, h_new
+
+
+class RNNCellBase(Layer):
+    """reference: nn/layer/rnn.py RNNCellBase — get_initial_states helper."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        h = self.hidden_size if shape is None else shape[-1]
+        z = jnp.full((batch, h), init_value, jnp.float32)
+        return Tensor(z)
+
+
+class RNN(Layer):
+    """Generic cell driver (reference: nn/layer/rnn.py RNN): runs `cell`
+    over the time axis; python loop — XLA unrolls under jit, matching the
+    dygraph semantics of the reference."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import jax
+        from ... import ops
+        inputs = ensure_tensor(inputs)
+        if self.time_major:
+            inputs = ops.transpose(inputs, [1, 0, 2])
+        if sequence_length is not None:
+            sequence_length = ensure_tensor(sequence_length)
+        steps = range(inputs.shape[1])
+        if self.is_reverse:
+            steps = reversed(list(steps))
+        states = initial_states
+        outs = []
+
+        def _mask_states(new, old, valid):
+            # freeze states of finished sequences (reference: RNN masks
+            # steps past sequence_length; outputs zeroed, states held)
+            if old is None:
+                return new
+            return jax.tree_util.tree_map(
+                lambda n, o: Tensor(jnp.where(
+                    valid._data.reshape((-1,) + (1,) * (n._data.ndim - 1)),
+                    n._data, o._data)),
+                new, old, is_leaf=lambda x: isinstance(x, Tensor))
+
+        for t in steps:
+            out, new_states = self.cell(inputs[:, t], states)
+            if sequence_length is not None:
+                valid = Tensor(
+                    (t < sequence_length._data).astype(jnp.int32))
+                out = Tensor(jnp.where(
+                    valid._data.reshape((-1,) + (1,) * (out.ndim - 1))
+                    .astype(bool), out._data, 0.0))
+                states = _mask_states(new_states, states, Tensor(
+                    valid._data.astype(bool)))
+            else:
+                states = new_states
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        outputs = ops.stack(outs, axis=1)
+        if self.time_major:
+            outputs = ops.transpose(outputs, [1, 0, 2])
+        return outputs, states
+
+
+class BiRNN(Layer):
+    """reference: nn/layer/rnn.py BiRNN — concat of fw/bw cell runs."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            states_fw = states_bw = None
+        else:
+            states_fw, states_bw = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        from ... import ops
+        return ops.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

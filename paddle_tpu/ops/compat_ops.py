@@ -233,3 +233,28 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None,
     if sci_mode is not None:
         kw["suppress"] = not sci_mode
     np.set_printoptions(**kw)
+
+
+# ---- LoDTensorArray ops (reference: fluid/layers/control_flow.py) ---------
+
+def create_array(dtype="float32", initialized_list=None):
+    """reference: create_array — dygraph uses a plain list."""
+    return list(initialized_list or [])
+
+
+def array_write(x, i, array=None):
+    i = int(i)
+    if array is None:
+        array = []
+    while len(array) <= i:
+        array.append(None)
+    array[i] = ensure_tensor(x)
+    return array
+
+
+def array_read(array, i):
+    return array[int(i)]
+
+
+def array_length(array):
+    return Tensor(np.asarray(len(array), np.int64))

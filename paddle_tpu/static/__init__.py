@@ -22,6 +22,16 @@ from .io import (save_inference_model, load_inference_model,  # noqa: F401
                  InferenceProgram)
 from . import io  # noqa: F401
 from . import nn  # noqa: F401
+from .compat import (  # noqa: F401
+    BuildStrategy, ExecutionStrategy, CompiledProgram, ParallelExecutor,
+    cpu_places, cuda_places, xpu_places, device_guard,
+    WeightNormParamAttr, accuracy, auc, Print,
+    serialize_program, deserialize_program, serialize_persistables,
+    deserialize_persistables, save_to_file, load_from_file,
+    load_program_state, set_program_state, save_vars, load_vars)
+from . import amp  # noqa: F401
+from ..ops.compat_ops import (  # noqa: F401
+    create_global_var, create_parameter)
 
 # NOTE: the op-dispatch recorder hook is installed by enable_static() and
 # removed by disable_static(), so dynamic mode pays no dispatch overhead.

@@ -1,0 +1,66 @@
+"""paddle.static.amp (reference: contrib/mixed_precision/decorator.py).
+
+On TPU the static executor computes in the declared dtypes and bf16 needs
+no loss scaling, so ``decorate`` records the config and returns an
+optimizer whose ``amp_init`` casts eligible persistables to bf16 when
+``use_bf16``/pure-fp16 mode is requested.  The white/black lists mirror
+``contrib/mixed_precision/fp16_lists.py``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+white_list = {"conv2d", "matmul", "matmul_v2", "mul"}
+black_list = {"exp", "square", "log", "mean", "sum", "softmax",
+              "softmax_with_cross_entropy", "cross_entropy"}
+
+
+class CustomOpLists:
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(white_list) | set(custom_white_list or ())
+        self.black_list = set(black_list) | set(custom_black_list or ())
+
+
+AutoMixedPrecisionLists = CustomOpLists
+
+
+class OptimizerWithMixedPrecision:
+    def __init__(self, optimizer, amp_lists=None, init_loss_scaling=2.**15,
+                 use_dynamic_loss_scaling=True, use_pure_fp16=False,
+                 use_bf16=True, **kwargs):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or CustomOpLists()
+        self._use_pure_fp16 = use_pure_fp16
+        self._use_bf16 = use_bf16
+        self._loss_scaling = init_loss_scaling
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._optimizer.minimize(loss, startup_program,
+                                        parameter_list, no_grad_set)
+
+    def amp_init(self, place=None, scope=None, test_program=None,
+                 use_fp16_test=False):
+        """Cast matmul/conv persistables to bf16 for pure low-precision
+        runs (reference: decorator.py amp_init casting to fp16)."""
+        if not (self._use_pure_fp16 and self._use_bf16):
+            return
+        from . import program as prog_mod
+        import jax.numpy as jnp
+        prog = prog_mod.default_main_program()
+        for name, t in prog.captures.items():
+            if t.trainable and t._data.ndim >= 2:
+                t._data = t._data.astype(jnp.bfloat16)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.**15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True, use_pure_fp16=False,
+             use_fp16_guard=None, use_bf16=True):
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, init_loss_scaling,
+        use_dynamic_loss_scaling, use_pure_fp16, use_bf16)

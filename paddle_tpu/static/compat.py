@@ -1,0 +1,285 @@
+"""Static-mode compatibility surface.
+
+Reference parity: the remaining ``paddle.static`` exports —
+CompiledProgram/BuildStrategy/ExecutionStrategy/ParallelExecutor
+(``fluid/compiler.py``, ``details/build_strategy.cc``), place lists,
+``device_guard``, program/persistable (de)serialization (``static/io.py``),
+program-state save/load, and the static metric ops (accuracy/auc).
+
+On TPU these knobs have one honest mapping: XLA already performs the
+optimizations BuildStrategy toggles pick between, so the strategy objects
+are accepted and recorded but do not change compilation; CompiledProgram
+is the same Program with a strategy attached.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive, ensure_tensor
+from ..core.tensor import Tensor
+from ..nn.param_attr import ParamAttr
+from . import program as prog_mod
+
+
+class BuildStrategy:
+    """reference: details/build_strategy.h (pybind surface)."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    """reference: details/execution_strategy.h."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+
+
+class CompiledProgram:
+    """reference: fluid/compiler.py:164 — XLA is the compiler, so this
+    carries the program + strategies; Executor.run unwraps it."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = None
+        self._places = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._exec_strategy = exec_strategy
+        self._places = places
+        return self
+
+
+class ParallelExecutor:
+    """Legacy multi-device runner (reference parallel_executor.cc:609);
+    delegates to Executor — device parallelism comes from shardings."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, scope=None,
+                 share_vars_from=None):
+        from .executor import Executor
+        self._exe = Executor()
+        self._program = main_program
+
+    def run(self, fetch_list=None, feed=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+# -- places ----------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    from ..core import device as device_mod
+    n = device_count or 1
+    return [device_mod.current_place() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    import jax as _jax
+    ids = device_ids if device_ids is not None else \
+        range(len(_jax.devices()))
+    from ..core import device as device_mod
+    return [device_mod.current_place() for _ in ids]
+
+
+xpu_places = cuda_places
+
+
+class device_guard:
+    """reference: fluid/framework.py device_guard — placement hints are
+    XLA's job; accepted and ignored."""
+
+    def __init__(self, device=None):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class WeightNormParamAttr(ParamAttr):
+    """reference: fluid/param_attr.py WeightNormParamAttr."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+
+# -- static metric ops ------------------------------------------------------
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """reference: metrics/accuracy_op.cc — top-k accuracy as a graph op."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    @primitive(name="accuracy", nondiff=(0, 1))
+    def _acc(x, y):
+        topk = jnp.argsort(-x, axis=-1)[..., :k]
+        hit = jnp.any(topk == y.reshape(-1, 1), axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return _acc(input, label)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """reference: metrics/auc_op.cc — batch AUC (the reference's global
+    accumulator states live in scope vars; here each call computes the
+    batch statistic, matching the common fetch usage)."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    @primitive(name="auc", nondiff=(0, 1))
+    def _auc(x, y):
+        pos_score = x[:, 1] if x.ndim == 2 and x.shape[1] == 2 else \
+            x.reshape(-1)
+        y = y.reshape(-1).astype(jnp.float32)
+        thresholds = jnp.linspace(0.0, 1.0, num_thresholds)
+        pred_pos = pos_score[None, :] >= thresholds[:, None]
+        tp = jnp.sum(pred_pos * y[None, :], axis=1)
+        fp = jnp.sum(pred_pos * (1 - y)[None, :], axis=1)
+        P = jnp.maximum(jnp.sum(y), 1e-6)
+        N = jnp.maximum(jnp.sum(1 - y), 1e-6)
+        tpr = tp / P
+        fpr = fp / N
+        return -jnp.trapezoid(tpr, fpr)
+
+    return _auc(input, label)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """reference: print_op.cc — identity that prints at execution."""
+    input = ensure_tensor(input)
+    msg = message or "Print"
+
+    @primitive(name="print")
+    def _print(x):
+        jax.debug.print(msg + ": {}", x)
+        return x
+
+    return _print(input)
+
+
+# -- (de)serialization (reference: static/io.py serialize_*) ----------------
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    from .io import _compose_inference
+    prog = prog_mod.default_main_program()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    fn = _compose_inference(prog, feed_vars, fetch_vars)
+    specs = [jax.ShapeDtypeStruct(tuple(v._data.shape), v._data.dtype)
+             for v in feed_vars]
+    exported = jax.export.export(jax.jit(fn))(*specs)
+    header = pickle.dumps({
+        "feed_names": [v.name for v in feed_vars],
+        "n_fetch": len(fetch_vars)})
+    return len(header).to_bytes(8, "little") + header + \
+        exported.serialize()
+
+
+def deserialize_program(data):
+    from .io import InferenceProgram
+    hlen = int.from_bytes(data[:8], "little")
+    header = pickle.loads(data[8:8 + hlen])
+    exported = jax.export.deserialize(data[8 + hlen:])
+    return InferenceProgram(exported, header["feed_names"], None,
+                            header["n_fetch"])
+
+
+def serialize_persistables(feed_vars, fetch_vars, **kwargs):
+    prog = prog_mod.default_main_program()
+    return pickle.dumps({n: np.asarray(t._data)
+                         for n, t in prog.captures.items()})
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = pickle.loads(data)
+    for n, t in program.captures.items():
+        if n in state:
+            t.set_value(state[n])
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+# -- program state (reference: static/io.py load/set_program_state) ---------
+
+def load_program_state(model_path, var_list=None):
+    path = model_path if model_path.endswith(".pdparams") else \
+        model_path + ".pdparams"
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state):
+    for n, t in program.captures.items():
+        if n in state:
+            t.set_value(state[n])
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    import os
+    prog = main_program or prog_mod.default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    names = [v if isinstance(v, str) else v.name for v in (vars or [])] \
+        or list(prog.captures)
+    state = {n: np.asarray(prog.captures[n]._data) for n in names
+             if n in prog.captures}
+    with open(os.path.join(dirname, filename or "vars.pdparams"),
+              "wb") as f:
+        pickle.dump(state, f, protocol=4)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    import os
+    prog = main_program or prog_mod.default_main_program()
+    with open(os.path.join(dirname, filename or "vars.pdparams"),
+              "rb") as f:
+        state = pickle.load(f)
+    for n, t in prog.captures.items():
+        if n in state:
+            t.set_value(state[n])

@@ -38,6 +38,9 @@ class Executor:
             return_numpy=True):
         if program is None:
             program = prog_mod.default_main_program()
+        from .compat import CompiledProgram
+        if isinstance(program, CompiledProgram):
+            program = program._program    # XLA is the compiler already
         feed = feed or {}
         fetch_list = fetch_list or []
         from .io import InferenceProgram

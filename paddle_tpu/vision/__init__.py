@@ -4,3 +4,5 @@ from .models import (  # noqa: F401
     VGG, vgg11, vgg13, vgg16, vgg19, MobileNetV1, MobileNetV2,
     mobilenet_v1, mobilenet_v2,
 )
+
+from . import transforms as image  # reference: paddle.vision.image utilities
