@@ -124,6 +124,12 @@ class StaticFunction:
             training,)
 
     def __call__(self, *args, **kwargs):
+        if not ProgramTranslator._enabled:
+            # ProgramTranslator().enable(False): run the original dygraph
+            # code uncompiled (reference: program_translator.py enable)
+            if self._layer is not None:
+                return self._layer.forward(*args, **kwargs)
+            return self._fn(*args, **kwargs)
         if self._layer is None:
             return self._call_function(*args, **kwargs)
         return self._call_layer(*args, **kwargs)
@@ -320,3 +326,36 @@ def load(path, **configs):
 
 def not_to_static(fn):
     return fn
+
+
+class ProgramTranslator:
+    """reference: dygraph_to_static/program_translator.py:756.
+
+    The TPU build has no AST rewriting — jax tracing handles Python
+    control flow via lax primitives (see static.nn.cond/while_loop) — so
+    the translator reduces to a global enable/disable switch for
+    to_static, mirroring ``ProgramTranslator().enable(False)`` usage.
+    """
+
+    _instance = None
+    _enabled = True
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static=True):
+        ProgramTranslator._enabled = bool(enable_to_static)
+
+    @property
+    def enable_to_static(self):
+        return ProgramTranslator._enabled
+
+
+def enable_to_static(flag=True):
+    ProgramTranslator().enable(flag)

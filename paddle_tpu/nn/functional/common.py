@@ -298,20 +298,7 @@ def class_center_sample(label, num_classes, num_samples, group=None):
 
 
 def npair_loss(anchor, positive, labels, l2_reg=0.002):
-    anchor, positive = ensure_tensor(anchor), ensure_tensor(positive)
-    labels = ensure_tensor(labels)
-
-    @primitive(name="npair_loss")
-    def _np_loss(a, p):
-        batch = a.shape[0]
-        sim = jnp.matmul(a, p.T)
-        lab = labels._data.reshape(-1)
-        targets = (lab[:, None] == lab[None, :]).astype(a.dtype)
-        targets = targets / jnp.sum(targets, axis=1, keepdims=True)
-        logp = jax.nn.log_softmax(sim, axis=1)
-        ce = -jnp.mean(jnp.sum(targets * logp, axis=1))
-        reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(a), axis=1))
-                        + jnp.mean(jnp.sum(jnp.square(p), axis=1))) / 2
-        return ce + reg
-
-    return _np_loss(anchor, positive)
+    # single implementation lives in extension.py (reference 0.25*l2_reg
+    # regularizer factor, fluid/layers/loss.py npair_loss)
+    from .extension import npair_loss as _impl
+    return _impl(anchor, positive, labels, l2_reg=l2_reg)

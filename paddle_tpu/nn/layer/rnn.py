@@ -375,9 +375,16 @@ class RNN(Layer):
 
         def _mask_states(new, old, valid):
             # freeze states of finished sequences (reference: RNN masks
-            # steps past sequence_length; outputs zeroed, states held)
+            # steps past sequence_length; outputs zeroed, states held).
+            # With no prior state the cell's implicit initial state is
+            # zeros, so invalid first steps mask back to zero.
             if old is None:
-                return new
+                return jax.tree_util.tree_map(
+                    lambda n: Tensor(jnp.where(
+                        valid._data.reshape(
+                            (-1,) + (1,) * (n._data.ndim - 1)),
+                        n._data, jnp.zeros_like(n._data))),
+                    new, is_leaf=lambda x: isinstance(x, Tensor))
             return jax.tree_util.tree_map(
                 lambda n, o: Tensor(jnp.where(
                     valid._data.reshape((-1,) + (1,) * (n._data.ndim - 1)),
@@ -418,7 +425,9 @@ class BiRNN(Layer):
             states_fw = states_bw = None
         else:
             states_fw, states_bw = initial_states
-        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
-        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw,
+                                    sequence_length=sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw,
+                                    sequence_length=sequence_length)
         from ... import ops
         return ops.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

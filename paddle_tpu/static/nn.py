@@ -101,7 +101,122 @@ def dropout(x, dropout_prob=0.5, is_test=False, seed=None, name=None):
     return F.dropout(x, p=dropout_prob, training=not is_test)
 
 
-# control flow: symbolic cond/while over recorded subgraphs is intentionally
-# NOT rebuilt (reference: operators/controlflow/conditional_block_op.cc,
-# while_op.cc).  TPU-native control flow happens inside jitted fns with
-# lax.cond/lax.while_loop via paddle.jit / dygraph-to-static.
+# ---- control flow ---------------------------------------------------------
+# reference: operators/controlflow/conditional_block_op.cc, while_op.cc,
+# fluid/layers/control_flow.py cond/while_loop/case/switch_case.
+# TPU-native: eager mode evaluates the Python predicate directly; under a
+# jit trace the branches lower to lax.cond/lax.while_loop.  (The deferred
+# record-mode Program does not support symbolic control flow — build such
+# models under paddle.jit instead, where XLA traces them natively.)
+
+def _unwrap_cf(x):
+    from ..core.tensor import Tensor as _T
+    return x._data if isinstance(x, _T) else x
+
+
+def _wrap_cf(x):
+    import jax
+    from ..core.tensor import Tensor as _T
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap_cf(v) for v in x)
+    if hasattr(x, "dtype") and hasattr(x, "shape"):
+        return _T(x)
+    return x
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None,
+         return_names=None):
+    import jax
+    p = _unwrap_cf(pred)
+    if isinstance(p, jax.ShapeDtypeStruct):
+        raise NotImplementedError(
+            "static.nn.cond inside a recorded Program: express the model "
+            "with paddle.jit (XLA traces lax.cond natively)")
+    if not isinstance(p, jax.core.Tracer):
+        return true_fn() if bool(p) else (
+            false_fn() if false_fn is not None else None)
+    if false_fn is None:
+        raise ValueError(
+            "cond under jit requires both branches (lax.cond needs "
+            "matching output structures); pass a false_fn")
+
+    def _branch(fn):
+        def run(_):
+            out = fn()
+            return jax.tree_util.tree_map(
+                _unwrap_cf, out,
+                is_leaf=lambda v: hasattr(v, "_data"))
+        return run
+
+    out = jax.lax.cond(p, _branch(true_fn), _branch(false_fn), 0)
+    return _wrap_cf(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    import jax
+    arrs = [_unwrap_cf(v) for v in loop_vars]
+    traced = any(isinstance(a, jax.core.Tracer) for a in arrs)
+    if not traced:
+        vals = list(loop_vars)
+        while bool(_unwrap_cf(cond_fn(*vals))):
+            out = body_fn(*vals)
+            vals = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vals
+
+    def c(vs):
+        return _unwrap_cf(cond_fn(*_wrap_cf(list(vs))))
+
+    def b(vs):
+        out = body_fn(*_wrap_cf(list(vs)))
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(_unwrap_cf(o) for o in out)
+
+    res = jax.lax.while_loop(c, b, tuple(arrs))
+    return _wrap_cf(list(res))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    import jax
+    for i, (pred, fn) in enumerate(pred_fn_pairs):
+        p = _unwrap_cf(pred)
+        if isinstance(p, jax.core.Tracer):
+            # chain into nested lax.cond
+            rest = pred_fn_pairs[i + 1:]
+            if rest:
+                nxt = lambda: case(rest, default)  # noqa: E731
+            elif default is not None:
+                nxt = default
+            else:
+                raise ValueError(
+                    "case under jit requires a default branch (lax.cond "
+                    "needs an else)")
+            return cond(pred, fn, nxt)
+        if bool(p):
+            return fn()
+    return default() if default is not None else None
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    import jax
+    import jax.numpy as _jnp
+    idx = _unwrap_cf(branch_index)
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) \
+        else branch_fns
+    keys = sorted(fns)
+    if default is None:
+        # reference semantics (fluid/layers/control_flow.py switch_case):
+        # without a default, the LAST branch serves as the default
+        default = fns[keys[-1]]
+    if not isinstance(idx, jax.core.Tracer):
+        return fns.get(int(idx), default)()
+    branches = [lambda _, f=fns[k]: jax.tree_util.tree_map(
+        _unwrap_cf, f(), is_leaf=lambda v: hasattr(v, "_data"))
+        for k in keys]
+    branches.append(lambda _: jax.tree_util.tree_map(
+        _unwrap_cf, default(), is_leaf=lambda v: hasattr(v, "_data")))
+    # exact-match dispatch: any non-member index takes the default branch
+    matches = _jnp.asarray(keys) == idx
+    pos = _jnp.where(_jnp.any(matches), _jnp.argmax(matches),
+                     len(branches) - 1)
+    out = jax.lax.switch(pos, branches, 0)
+    return _wrap_cf(out)

@@ -1,0 +1,142 @@
+"""Control flow: cond/while_loop/case/switch_case, eager and traced
+(reference: test_cond.py, test_while_loop_op.py, test_case.py,
+test_switch_case.py)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+
+def test_cond_eager():
+    t = snn.cond(paddle.to_tensor(True), lambda: paddle.to_tensor(1.0),
+                 lambda: paddle.to_tensor(2.0))
+    assert float(t.numpy()) == 1.0
+    f = snn.cond(paddle.to_tensor(False), lambda: paddle.to_tensor(1.0),
+                 lambda: paddle.to_tensor(2.0))
+    assert float(f.numpy()) == 2.0
+
+
+def test_cond_traced_both_branches():
+    def f(x):
+        t = paddle.Tensor(x)
+        return snn.cond(t > 0, lambda: t * 2, lambda: t - 1)._data
+
+    jf = jax.jit(f)
+    assert float(jf(jnp.asarray(3.0))) == 6.0
+    assert float(jf(jnp.asarray(-3.0))) == -4.0
+
+
+def test_while_loop_eager_and_traced():
+    vals = snn.while_loop(lambda i: i < 5, lambda i: i + 1,
+                          [paddle.to_tensor(0)])
+    assert int(vals[0].numpy()) == 5
+
+    def g(n):
+        vals = snn.while_loop(lambda i: i < 10, lambda i: i * 2,
+                              [paddle.Tensor(n)])
+        return vals[0]._data
+
+    assert int(jax.jit(g)(jnp.asarray(3))) == 12
+
+
+def test_while_loop_multiple_vars():
+    i0 = paddle.to_tensor(0)
+    s0 = paddle.to_tensor(0.0)
+    i, s = snn.while_loop(lambda i, s: i < 4,
+                          lambda i, s: (i + 1, s + 2.0), [i0, s0])
+    assert int(i.numpy()) == 4
+    assert float(s.numpy()) == 8.0
+
+
+def test_case_and_switch_case():
+    r = snn.case([(paddle.to_tensor(False), lambda: paddle.to_tensor(1.0)),
+                  (paddle.to_tensor(True), lambda: paddle.to_tensor(2.0))],
+                 default=lambda: paddle.to_tensor(3.0))
+    assert float(r.numpy()) == 2.0
+    r2 = snn.switch_case(paddle.to_tensor(5),
+                         {1: lambda: paddle.to_tensor(10.0),
+                          5: lambda: paddle.to_tensor(50.0)},
+                         default=lambda: paddle.to_tensor(-1.0))
+    assert float(r2.numpy()) == 50.0
+    # traced switch
+    def h(i):
+        return snn.switch_case(
+            paddle.Tensor(i),
+            {0: lambda: paddle.to_tensor(10.0),
+             1: lambda: paddle.to_tensor(20.0)})._data
+    assert float(jax.jit(h)(jnp.asarray(1))) == 20.0
+
+
+def test_program_translator_shim():
+    pt = paddle.jit.ProgramTranslator()
+    assert pt is paddle.jit.ProgramTranslator.get_instance()
+    pt.enable(False)
+    assert not pt.enable_to_static
+    pt.enable(True)
+    assert pt.enable_to_static
+
+
+# ---- regressions from code review ----------------------------------------
+
+def test_switch_case_traced_nonmatching_goes_default():
+    def h(i):
+        return snn.switch_case(
+            paddle.Tensor(i),
+            {1: lambda: paddle.to_tensor(10.0),
+             5: lambda: paddle.to_tensor(50.0)},
+            default=lambda: paddle.to_tensor(-1.0))._data
+    jh = jax.jit(h)
+    assert float(jh(jnp.asarray(1))) == 10.0
+    assert float(jh(jnp.asarray(5))) == 50.0
+    assert float(jh(jnp.asarray(0))) == -1.0   # non-member -> default
+    assert float(jh(jnp.asarray(2))) == -1.0
+
+
+def test_switch_case_no_default_uses_last_branch():
+    # reference: without default the last branch serves as default
+    r = snn.switch_case(paddle.to_tensor(99),
+                        {1: lambda: paddle.to_tensor(10.0),
+                         5: lambda: paddle.to_tensor(50.0)})
+    assert float(r.numpy()) == 50.0
+
+
+def test_cond_traced_without_false_fn_raises():
+    def f(x):
+        t = paddle.Tensor(x)
+        return snn.cond(t > 0, lambda: t * 2)
+    with pytest.raises(ValueError):
+        jax.jit(f)(jnp.asarray(1.0))
+
+
+def test_case_traced_without_default_raises():
+    def f(x):
+        t = paddle.Tensor(x)
+        return snn.case([(t > 0, lambda: t * 2)])
+    with pytest.raises(ValueError):
+        jax.jit(f)(jnp.asarray(1.0))
+
+
+def test_program_translator_disable_runs_dygraph():
+    from paddle_tpu import nn
+    net = nn.Linear(2, 2)
+    sf = paddle.jit.to_static(net)
+    calls = []
+    orig_forward = net.forward
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig_forward(*a, **k)
+
+    net.forward = spy
+    paddle.jit.enable_to_static(False)
+    try:
+        x = paddle.to_tensor(np.ones((1, 2), "float32"))
+        sf(x)
+        assert calls  # dygraph forward ran directly
+    finally:
+        paddle.jit.enable_to_static(True)
+        net.forward = orig_forward

@@ -313,3 +313,41 @@ def test_nce_log_q_includes_sample_count():
         math.log1p(math.exp(math.log(5/100)))
         + 5 * math.log1p(math.exp(-math.log(5/100))), rel=1e-3)
     assert v20 != pytest.approx(v5, rel=1e-2)
+
+
+def test_birnn_sequence_length_passthrough():
+    paddle.seed(11)
+    cell_fw, cell_bw = nn.GRUCell(3, 4), nn.GRUCell(3, 4)
+    bi = nn.BiRNN(cell_fw, cell_bw)
+    x = np.random.RandomState(12).rand(2, 5, 3).astype("float32")
+    lens = np.array([2, 5], "int64")
+    out, _ = bi(paddle.to_tensor(x),
+                sequence_length=paddle.to_tensor(lens))
+    # both directions zero the padded steps of sequence 0
+    np.testing.assert_array_equal(out.numpy()[0, 2:], 0.0)
+
+
+def test_reverse_rnn_sequence_length_ignores_padding():
+    paddle.seed(12)
+    cell = nn.GRUCell(3, 4)
+    x = np.random.RandomState(13).rand(1, 6, 3).astype("float32")
+    lens = np.array([3], "int64")
+    rnn_rev = nn.RNN(cell, is_reverse=True)
+    out, final = rnn_rev(paddle.to_tensor(x),
+                         sequence_length=paddle.to_tensor(lens))
+    # reverse run over only the valid prefix gives the same final state
+    out_ref, final_ref = nn.RNN(cell, is_reverse=True)(
+        paddle.to_tensor(x[:, :3]))
+    np.testing.assert_allclose(final.numpy(), final_ref.numpy(), rtol=1e-5)
+
+
+def test_npair_loss_single_implementation():
+    import paddle_tpu.nn.functional as FF
+    a = paddle.to_tensor(np.random.RandomState(1).rand(4, 8)
+                         .astype("float32"))
+    p = paddle.to_tensor(np.random.RandomState(2).rand(4, 8)
+                         .astype("float32"))
+    lab = paddle.to_tensor(np.array([0, 1, 0, 1], "int64"))
+    v1 = float(FF.npair_loss(a, p, lab).numpy())
+    v2 = float(FF.common.npair_loss(a, p, lab).numpy())
+    assert v1 == pytest.approx(v2, rel=1e-6)
