@@ -23,7 +23,8 @@ from .sequence import (  # noqa: F401
 )
 from .extension import (  # noqa: F401
     grid_sample, diag_embed, gather_tree, bilinear,
-    bilinear_tensor_product, dice_loss, npair_loss,
+    bilinear_tensor_product, dice_loss, npair_loss, affine_grid,
+    linear_chain_crf, viterbi_decode,
 )
 
 # -- fluid-era functional aliases (reference fluid/layers re-exports) ------

@@ -204,3 +204,142 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
         return ce + reg
 
     return _npair(anchor, positive, labels)
+
+
+# ---- affine_grid (pairs with grid_sample) ---------------------------------
+
+@primitive(name="affine_grid")
+def _affine_grid(theta, out_h=1, out_w=1, align_corners=True):
+    """theta [N, 2, 3] -> sampling grid [N, H, W, 2]
+    (reference: affine_grid_op.cc)."""
+    n = theta.shape[0]
+    if align_corners:
+        ys = jnp.linspace(-1, 1, out_h)
+        xs = jnp.linspace(-1, 1, out_w)
+    else:
+        ys = (jnp.arange(out_h) * 2 + 1) / out_h - 1
+        xs = (jnp.arange(out_w) * 2 + 1) / out_w - 1
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)        # [H, W, 3]
+    return jnp.einsum("nij,hwj->nhwi", theta, base)  # [N, H, W, 2]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    if len(out_shape) != 4:
+        raise NotImplementedError(
+            "affine_grid: only 4-D (N, C, H, W) output shapes are "
+            "supported; 3-D volumetric grids (N, C, D, H, W) are not "
+            "implemented")
+    n, c, h, w = out_shape
+    return _affine_grid(ensure_tensor(theta), out_h=h, out_w=w,
+                        align_corners=align_corners)
+
+
+# ---- linear-chain CRF -----------------------------------------------------
+# reference: linear_chain_crf_op.cc (training loss) + crf_decoding_op.cc
+# (viterbi).  Transition layout follows the reference: [num_tags+2,
+# num_tags]; row 0 = start weights, row 1 = stop weights, rows 2.. =
+# transition[from][to].  Dense [B, T] batches with a lengths vector replace
+# the reference's LoD sequences.
+
+@primitive(name="linear_chain_crf", nondiff=(2, 3))
+def _crf_nll(emission, transition, label, lengths):
+    b, t, n = emission.shape
+    start_w = transition[0]
+    stop_w = transition[1]
+    trans = transition[2:]
+
+    def per_seq(em, lab, ln):
+        # gold path score
+        idx = jnp.arange(t)
+        emit_score = jnp.where(idx < ln, em[idx, lab], 0.0).sum()
+        pair_valid = (idx[1:] < ln)
+        trans_score = jnp.where(pair_valid,
+                                trans[lab[:-1], lab[1:]], 0.0).sum()
+        last = jnp.maximum(ln - 1, 0)
+        gold = emit_score + trans_score + start_w[lab[0]] + \
+            stop_w[lab[last]]
+
+        # partition via forward algorithm
+        def step(carry, i):
+            alpha = carry
+            new = jax.nn.logsumexp(
+                alpha[:, None] + trans, axis=0) + em[i]
+            alpha = jnp.where(i < ln, new, alpha)
+            return alpha, None
+
+        alpha0 = start_w + em[0]
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t))
+        logz = jax.nn.logsumexp(alpha + stop_w)
+        return logz - gold
+
+    return jax.vmap(per_seq)(emission, label, lengths)
+
+
+def linear_chain_crf(emission, transition, label, length, name=None):
+    """Negative log-likelihood per sequence [B, 1]."""
+    out = _crf_nll(ensure_tensor(emission), ensure_tensor(transition),
+                   ensure_tensor(label), ensure_tensor(length))
+    from ...ops.manipulation import unsqueeze
+    return unsqueeze(out, axis=-1)
+
+
+@primitive(name="viterbi_decode", nondiff=(0, 1, 2))
+def _viterbi(emission, transition, lengths, include_bos_eos_tag=True):
+    """paddle.text contract: transition is SQUARE [num_tags, num_tags];
+    with include_bos_eos_tag the last two tags are the start (n-2) and
+    stop (n-1) tags (reference: crf_decoding_op.cc / text.ViterbiDecoder).
+    (The fluid linear_chain_crf op below uses its own [n+2, n] layout.)"""
+    b, t, n = emission.shape
+    trans = transition
+    if include_bos_eos_tag:
+        start_w = transition[n - 2]      # BOS -> tag
+        stop_w = transition[:, n - 1]    # tag -> EOS
+    else:
+        start_w = jnp.zeros(n)
+        stop_w = jnp.zeros(n)
+
+    def per_seq(em, ln):
+        def step(carry, i):
+            score = carry
+            cand = score[:, None] + trans + em[i][None, :]
+            new = cand.max(axis=0)
+            back = cand.argmax(axis=0)
+            score = jnp.where(i < ln, new, score)
+            # padded steps: identity backpointer (backtrace passes through)
+            back = jnp.where(i < ln, back, jnp.arange(n))
+            return score, back
+
+        score0 = start_w + em[0]
+        score, backs = jax.lax.scan(step, score0, jnp.arange(1, t))
+        score = score + stop_w
+        best_last = jnp.argmax(score)
+        best_score = score[best_last]
+
+        def backtrace(carry, back):
+            tag = carry
+            prev = back[tag]
+            return prev, tag
+
+        # reverse scan: output slot i holds the tag at position i+1 and
+        # the final carry is the tag at position 0
+        first_tag, path_tail = jax.lax.scan(backtrace, best_last, backs,
+                                            reverse=True)
+        path = jnp.concatenate([first_tag[None], path_tail])
+        # positions past length keep the last valid tag (harmless filler)
+        return best_score, path
+
+    scores, paths = jax.vmap(per_seq)(emission, lengths)
+    return scores, paths
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Best tag path + score (reference: crf_decoding_op.cc)."""
+    return _viterbi(ensure_tensor(potentials),
+                    ensure_tensor(transition_params),
+                    ensure_tensor(lengths),
+                    include_bos_eos_tag=include_bos_eos_tag)

@@ -6,4 +6,18 @@ from .datasets import (  # noqa: F401
 )
 
 __all__ = ["Imdb", "Imikolov", "Conll05st", "Movielens", "UCIHousing",
-           "WMT14", "WMT16"]
+           "WMT14", "WMT16", "viterbi_decode", "ViterbiDecoder"]
+
+from ..nn.functional.extension import viterbi_decode  # noqa: E402,F401
+
+
+class ViterbiDecoder:
+    """paddle.text.ViterbiDecoder — stateful wrapper over viterbi_decode."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -22,3 +22,4 @@ def run_check():
     print(f"paddle_tpu is installed successfully! "
           f"{n} device(s): {jax.devices()[0].platform}")
     return True
+from . import dlpack  # noqa: E402,F401

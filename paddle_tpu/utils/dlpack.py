@@ -1,0 +1,22 @@
+"""paddle.utils.dlpack (reference: framework/dlpack_tensor.cc,
+pybind tensor.to_dlpack) — zero-copy tensor exchange.
+
+Modern dlpack exchanges protocol-carrying objects (``__dlpack__`` /
+``__dlpack_device__``) rather than raw capsules; ``to_dlpack`` returns the
+underlying jax array, which any dlpack consumer (torch, numpy, cupy…)
+accepts directly, and ``from_dlpack`` accepts any dlpack-capable object.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+def to_dlpack(x):
+    arr = x._data if isinstance(x, Tensor) else x
+    return arr  # jax.Array implements __dlpack__/__dlpack_device__
+
+
+def from_dlpack(data):
+    return Tensor(jax.dlpack.from_dlpack(data))

@@ -5,14 +5,24 @@ trace export (``platform/profiler.cc:196``, ``device_tracer.cc:57``,
 ``tools/timeline.py``).  TPU-native: ``jax.profiler`` emits an XPlane trace
 (TensorBoard / Perfetto-compatible — the chrome://tracing successor);
 RecordEvent maps to ``jax.profiler.TraceAnnotation`` so host spans correlate
-with device activity in the same trace.
+with device activity in the same trace.  Host spans are additionally
+collected in-process so ``stop_profiler(profile_path=...)`` can write a
+standalone chrome://tracing JSON and print the reference-style summary
+table (sorted by total time) without TensorBoard.
 """
 from __future__ import annotations
 
 import contextlib
+import json
+import os
+import threading
 import time
 
 import jax
+
+_host_events = []        # (name, t0, dur) while profiling is active
+_collecting = False
+_lock = threading.Lock()
 
 
 class RecordEvent:
@@ -30,6 +40,9 @@ class RecordEvent:
 
     def __exit__(self, *exc):
         self.elapsed = time.perf_counter() - self._t0
+        if _collecting:
+            with _lock:
+                _host_events.append((self.name, self._t0, self.elapsed))
         self._ann.__exit__(*exc)
         return False
 
@@ -39,26 +52,62 @@ _active_dir = None
 
 def start_profiler(state="All", tracer_option="Default",
                    log_dir="/tmp/paddle_tpu_profile"):
-    global _active_dir
+    global _active_dir, _collecting
     _active_dir = log_dir
+    with _lock:
+        _host_events.clear()
+    _collecting = True
     jax.profiler.start_trace(log_dir)
 
 
-def stop_profiler(sorted_key=None, profile_path=None):
-    global _active_dir
-    if _active_dir is not None:
-        jax.profiler.stop_trace()
-        _active_dir = None
+def stop_profiler(sorted_key="total", profile_path=None):
+    """Stop tracing; optionally write a chrome://tracing JSON of host spans
+    (reference: tools/timeline.py output) and print the summary table."""
+    global _active_dir, _collecting
+    if _active_dir is None:
+        return
+    jax.profiler.stop_trace()
+    _active_dir = None
+    _collecting = False
+    with _lock:
+        events = list(_host_events)
+    if profile_path:
+        trace = {"traceEvents": [
+            {"name": name, "ph": "X", "pid": 0, "tid": 0,
+             "ts": t0 * 1e6, "dur": dur * 1e6, "cat": "host"}
+            for name, t0, dur in events]}
+        os.makedirs(os.path.dirname(os.path.abspath(profile_path)),
+                    exist_ok=True)
+        with open(profile_path, "w") as f:
+            json.dump(trace, f)
+    if events:
+        agg = {}
+        for name, _, dur in events:
+            tot, cnt = agg.get(name, (0.0, 0))
+            agg[name] = (tot + dur, cnt + 1)
+        sort_fns = {"total": lambda kv: -kv[1][0],
+                    "calls": lambda kv: -kv[1][1],
+                    "ave": lambda kv: -(kv[1][0] / kv[1][1]),
+                    "max": lambda kv: -kv[1][0],
+                    "min": lambda kv: kv[1][0]}
+        rows = sorted(agg.items(),
+                      key=sort_fns.get(sorted_key or "total",
+                                       sort_fns["total"]))
+        print(f"{'Event':<40} {'Calls':>8} {'Total(ms)':>12} {'Avg(ms)':>12}")
+        for name, (tot, cnt) in rows:
+            print(f"{name:<40} {cnt:>8} {tot * 1e3:>12.3f} "
+                  f"{tot / cnt * 1e3:>12.3f}")
+    return events
 
 
 @contextlib.contextmanager
 def profiler(state="All", tracer_option="Default",
-             log_dir="/tmp/paddle_tpu_profile"):
+             log_dir="/tmp/paddle_tpu_profile", profile_path=None):
     start_profiler(state, tracer_option, log_dir)
     try:
         yield
     finally:
-        stop_profiler()
+        stop_profiler(profile_path=profile_path)
 
 
 class Timer:

@@ -136,3 +136,29 @@ def test_misc_shims():
     x = paddle.to_tensor([1.0])
     assert paddle.get_tensor_from_selected_rows(x) is x
     assert paddle.__version__.startswith("2.")
+
+
+def test_profiler_chrome_trace_export(tmp_path, capsys):
+    from paddle_tpu.utils import profiler as prof
+    import json as _json
+    path = str(tmp_path / "trace.json")
+    prof.start_profiler(log_dir=str(tmp_path / "xplane"))
+    with prof.RecordEvent("step"):
+        paddle.to_tensor([1.0]) + 1.0
+    with prof.RecordEvent("step"):
+        pass
+    events = prof.stop_profiler(profile_path=path)
+    assert len(events) == 2
+    trace = _json.load(open(path))
+    assert len(trace["traceEvents"]) == 2
+    assert trace["traceEvents"][0]["name"] == "step"
+    out = capsys.readouterr().out
+    assert "step" in out and "Calls" in out
+
+
+def test_dlpack_roundtrip():
+    from paddle_tpu.utils import dlpack
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    cap = dlpack.to_dlpack(x)
+    y = dlpack.from_dlpack(cap)
+    np.testing.assert_array_equal(y.numpy(), x.numpy())

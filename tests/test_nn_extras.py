@@ -351,3 +351,94 @@ def test_npair_loss_single_implementation():
     v1 = float(FF.npair_loss(a, p, lab).numpy())
     v2 = float(FF.common.npair_loss(a, p, lab).numpy())
     assert v1 == pytest.approx(v2, rel=1e-6)
+
+
+def test_affine_grid_identity_transform():
+    theta = np.array([[[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]], "float32")
+    grid = F.affine_grid(paddle.to_tensor(theta), [1, 1, 4, 4])
+    assert grid.shape == [1, 4, 4, 2]
+    # identity theta + grid_sample reproduces the input
+    x = np.random.RandomState(3).rand(1, 2, 4, 4).astype("float32")
+    out = F.grid_sample(paddle.to_tensor(x), grid)
+    np.testing.assert_allclose(out.numpy(), x, atol=1e-5)
+
+
+def test_viterbi_square_layout_matches_bruteforce():
+    # paddle.text contract: SQUARE transitions, BOS = n-2, EOS = n-1
+    from itertools import product
+    B, T, N = 2, 4, 5
+    rng = np.random.RandomState(0)
+    em = paddle.to_tensor(rng.rand(B, T, N).astype("float32"))
+    tr = rng.rand(N, N).astype("float32")
+    lens_np = np.array([4, 2], "int32")
+    score, path = F.viterbi_decode(em, paddle.to_tensor(tr),
+                                   paddle.to_tensor(lens_np))
+    for bi in range(B):
+        T_eff = int(lens_np[bi])
+        e0 = em.numpy()[bi]
+        best, bpath = -1e9, None
+        for p in product(range(N), repeat=T_eff):
+            s = tr[N - 2, p[0]] + e0[0, p[0]]
+            for i in range(1, T_eff):
+                s += tr[p[i - 1], p[i]] + e0[i, p[i]]
+            s += tr[p[-1], N - 1]
+            if s > best:
+                best, bpath = s, p
+        assert float(score.numpy()[bi]) == pytest.approx(best, rel=1e-4)
+        assert list(path.numpy()[bi][:T_eff]) == list(bpath)
+
+
+def test_linear_chain_crf_nll_nonnegative():
+    B, T, N = 2, 4, 3
+    rng = np.random.RandomState(0)
+    em = paddle.to_tensor(rng.rand(B, T, N).astype("float32"))
+    trans = paddle.to_tensor(rng.rand(N + 2, N).astype("float32"))
+    lens = paddle.to_tensor(np.array([4, 2], "int32"))
+    lab = paddle.to_tensor(rng.randint(0, N, (B, T)).astype("int32"))
+    nll = F.linear_chain_crf(em, trans, lab, lens)
+    assert nll.shape == [B, 1]
+    assert (nll.numpy() >= 0).all()
+
+
+def _fluid_to_square(trans_fluid, N):
+    """[N+2, N] fluid CRF layout -> square [(N+2), (N+2)] text layout."""
+    n = N + 2
+    sq = np.full((n, n), -1e9, "float32")
+    sq[:N, :N] = trans_fluid[2:]
+    sq[n - 2, :N] = trans_fluid[0]       # BOS -> tag
+    sq[:N, n - 1] = trans_fluid[1]       # tag -> EOS
+    return sq
+
+
+def test_crf_loss_trains():
+    # transition + emission params learn to predict a fixed tag sequence
+    paddle.seed(13)
+    B, T, N = 4, 5, 3
+    rng = np.random.RandomState(14)
+    feats = paddle.to_tensor(rng.rand(B, T, 8).astype("float32"))
+    labels = paddle.to_tensor(
+        np.tile(np.array([0, 1, 2, 1, 0], "int32"), (B, 1)))
+    lens = paddle.to_tensor(np.full((B,), T, "int32"))
+    proj = nn.Linear(8, N)
+    trans = paddle.create_parameter([N + 2, N], "float32")
+    from paddle_tpu import optimizer
+    opt = optimizer.Adam(learning_rate=0.05,
+                         parameters=proj.parameters() + [trans])
+    first = last = None
+    for _ in range(30):
+        em = proj(feats)
+        loss = paddle.mean(F.linear_chain_crf(em, trans, labels, lens))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss)
+        last = float(loss)
+    assert last < first * 0.5
+    # decoding recovers the trained sequence (convert fluid layout to the
+    # square text layout, pad emissions for BOS/EOS tags)
+    sq = paddle.to_tensor(_fluid_to_square(trans.numpy(), N))
+    em = proj(feats).numpy()
+    em_pad = np.concatenate(
+        [em, np.full((B, T, 2), -1e9, "float32")], axis=-1)
+    _, path = F.viterbi_decode(paddle.to_tensor(em_pad), sq, lens)
+    np.testing.assert_array_equal(path.numpy(), labels.numpy())
