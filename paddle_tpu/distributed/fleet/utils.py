@@ -59,3 +59,6 @@ def recompute(function, *args, **kwargs):
 
     out = pure(p_arrays, arrays)
     return Tensor(out) if hasattr(out, "dtype") else out
+
+
+from .fs import LocalFS, HDFSClient, ExecuteError  # noqa: E402,F401

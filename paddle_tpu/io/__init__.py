@@ -427,3 +427,24 @@ def get_worker_info():
 from .native_dataset import (InMemoryDataset, QueueDataset,  # noqa: E402
                              DatasetFactory)
 
+
+
+class DataFeeder:
+    """Legacy feeder (reference: fluid/data_feeder.py) — converts a list of
+    per-sample tuples into the feed dict a static program expects."""
+
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_names = [v if isinstance(v, str) else v.name
+                           for v in feed_list]
+
+    def feed(self, iterable):
+        columns = list(zip(*iterable))
+        if len(columns) != len(self.feed_names):
+            raise ValueError(
+                f"DataFeeder: each sample has {len(columns)} fields but "
+                f"{len(self.feed_names)} feed names were declared "
+                f"({self.feed_names})")
+        out = {}
+        for name, col in zip(self.feed_names, columns):
+            out[name] = np.stack([np.asarray(s) for s in col])
+        return out

@@ -56,10 +56,10 @@ class GPTEmbeddings(nn.Layer):
             weight_attr=nn.ParamAttr(initializer=I.Normal(0.0, 0.02)))
         self.dropout = nn.Dropout(dropout)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, position_offset=0):
         import jax.numpy as jnp
         seq = input_ids.shape[-1]
-        pos = Tensor(jnp.arange(seq, dtype=jnp.int32))
+        pos = Tensor(jnp.arange(seq, dtype=jnp.int32) + position_offset)
         emb = self.word_embeddings(input_ids) + \
             self.position_embeddings(pos)
         return self.dropout(emb)
@@ -155,7 +155,12 @@ class GPTBlock(nn.Layer):
         x = x + self.mlp(self.ln2(x))
         return x
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
+        if cache is not None:
+            attn_out, cache = self.attn(self.ln1(x), cache=cache)
+            x = x + attn_out
+            x = x + self.mlp(self.ln2(x))
+            return x, cache
         if self.use_recompute:
             from ..distributed.fleet.utils import recompute
             # bound method → recompute collects params from `self`
@@ -205,11 +210,82 @@ class GPTModel(nn.Layer):
             for i in range(num_layers)])
         self.head = GPTLMHead(hidden_size, vocab_size, use_mp)
 
-    def forward(self, input_ids):
-        x = self.embeddings(input_ids)
+    def forward(self, input_ids, caches=None, position_offset=0):
+        x = self.embeddings(input_ids, position_offset=position_offset)
+        if caches is not None:
+            new_caches = []
+            for blk, cache in zip(self.blocks, caches):
+                x, cache = blk(x, cache=cache)
+                new_caches.append(cache)
+            return self.head(x), new_caches
         for blk in self.blocks:
             x = blk(x)
         return self.head(x)
+
+    def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
+                 top_k=0, eos_token_id=None, seed=None):
+        """KV-cached autoregressive decoding (greedy / top-k sampling).
+
+        The reference snapshot has no generation loop (PaddleNLP-era
+        feature); provided here because incremental decode is the natural
+        consumer of the attention cache.  Returns [B, S + new] ids.
+        """
+        import jax
+        import jax.numpy as jnp
+        from ..core import rng as rng_mod, autograd
+        from ..core.tensor import Tensor as T
+
+        ids = input_ids._data if hasattr(input_ids, "_data") else \
+            jnp.asarray(input_ids)
+        b, s = ids.shape
+        max_position = self.embeddings.position_embeddings.weight.shape[0]
+        if s + max_new_tokens > max_position:
+            raise ValueError(
+                f"generate: prompt ({s}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_position "
+                f"({max_position}) — positions past the table would "
+                "silently clamp")
+        nh = self.blocks[0].attn.num_heads
+        hd = self.blocks[0].attn.head_dim
+        kv_dtype = self.blocks[0].attn.qkv_proj.weight._data.dtype
+        # sampling whenever temperature/top_k ask for it; greedy otherwise
+        do_sample = (top_k and top_k > 0) or temperature != 1.0
+        was_training = self.training
+        self.eval()
+        try:
+            with autograd.no_grad():
+                # prefill: empty caches grow from zero-length k/v
+                empty = (T(jnp.zeros((b, 0, nh, hd), kv_dtype)),
+                         T(jnp.zeros((b, 0, nh, hd), kv_dtype)))
+                caches = [empty for _ in self.blocks]
+                logits, caches = self.forward(T(ids), caches=caches)
+                out = [ids]
+                key = rng_mod.key_for(seed)
+                for step in range(max_new_tokens):
+                    last = logits._data[:, -1, :].astype(jnp.float32)
+                    if do_sample:
+                        if temperature != 1.0:
+                            last = last / temperature
+                        if top_k and top_k > 0:
+                            kth = jax.lax.top_k(last, top_k)[0][:, -1:]
+                            last = jnp.where(last < kth, -1e9, last)
+                        key, sub = jax.random.split(key)
+                        nxt = jax.random.categorical(sub, last, axis=-1)
+                    else:
+                        nxt = jnp.argmax(last, axis=-1)
+                    nxt = nxt.astype(ids.dtype).reshape(b, 1)
+                    out.append(nxt)
+                    if eos_token_id is not None and bool(
+                            jnp.all(nxt == eos_token_id)):
+                        break
+                    if step == max_new_tokens - 1:
+                        break  # last token emitted; skip the dead forward
+                    logits, caches = self.forward(
+                        T(nxt), caches=caches, position_offset=s + step)
+        finally:
+            if was_training:
+                self.train()
+        return T(jnp.concatenate(out, axis=1))
 
     @classmethod
     def from_config(cls, name, **overrides):

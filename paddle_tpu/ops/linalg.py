@@ -82,9 +82,11 @@ def slogdet(x, name=None):
 
 
 def svd(x, full_matrices=False, name=None):
+    """x = U @ diag(S) @ VH (paddle.linalg.svd convention: the third
+    output is VH, not V)."""
     x = ensure_tensor(x)
     u, s, vh = jnp.linalg.svd(x._data, full_matrices=full_matrices)
-    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2))
+    return Tensor(u), Tensor(s), Tensor(vh)
 
 
 def qr(x, mode="reduced", name=None):

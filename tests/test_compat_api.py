@@ -162,3 +162,32 @@ def test_dlpack_roundtrip():
     cap = dlpack.to_dlpack(x)
     y = dlpack.from_dlpack(cap)
     np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+
+def test_local_fs_operations(tmp_path):
+    from paddle_tpu.distributed.fleet.utils import LocalFS
+    fs = LocalFS()
+    d = str(tmp_path / "dir")
+    fs.mkdirs(d)
+    assert fs.is_dir(d)
+    f = str(tmp_path / "dir" / "a.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    dirs, files = fs.ls_dir(str(tmp_path / "dir"))
+    assert files == ["a.txt"]
+    fs.mv(f, str(tmp_path / "dir" / "b.txt"))
+    assert fs.is_exist(str(tmp_path / "dir" / "b.txt"))
+    fs.delete(d)
+    assert not fs.is_exist(d)
+
+
+def test_data_feeder():
+    from paddle_tpu.io import DataFeeder
+
+    class V:
+        name = "x"
+
+    feeder = DataFeeder(feed_list=[V(), "y"])
+    batch = feeder.feed([(np.ones(3), 0), (np.zeros(3), 1)])
+    assert batch["x"].shape == (2, 3)
+    assert list(batch["y"]) == [0, 1]

