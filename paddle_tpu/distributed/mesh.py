@@ -18,6 +18,9 @@ from jax.sharding import Mesh, PartitionSpec, NamedSharding
 # canonical hybrid-parallel axis order (outer → inner = DCN → ICI)
 AXES = ("dp", "sharding", "pp", "mp", "sp", "ep")
 
+# the axes a data batch's leading dim shards over (dp + ZeRO sharding)
+DATA_AXES = ("dp", "sharding")
+
 _global_mesh: Mesh | None = None
 
 
@@ -82,3 +85,56 @@ def named_sharding(*spec) -> NamedSharding:
 
 def replicated() -> NamedSharding:
     return NamedSharding(ensure_mesh(), PartitionSpec())
+
+
+def data_axes_size(mesh=None) -> int:
+    mesh = mesh or ensure_mesh()
+    n = 1
+    for ax in DATA_AXES:
+        n *= mesh.shape.get(ax, 1)
+    return n
+
+
+def batch_partition_spec(shape, mesh=None) -> PartitionSpec:
+    """Leading-dim data sharding when divisible, replicated otherwise
+    (the single source of the batch-spec policy; TrainStep reuses it)."""
+    if shape and shape[0] % data_axes_size(mesh) == 0:
+        return PartitionSpec(DATA_AXES, *([None] * (len(shape) - 1)))
+    return PartitionSpec()
+
+
+def host_local_to_global(array, mesh=None, *spec):
+    """Assemble per-host local batches into one global array (multi-host:
+    each process feeds its shard; reference equivalent is each trainer
+    reading its own data partition).  No-op in single-process jobs.
+
+    0-d arrays are replicated (they must be identical on every host).
+    A local batch that does not divide evenly across the data axes is an
+    error here — unlike single-host, a multi-host partial batch cannot
+    silently fall back to replication (each host holds different rows);
+    pad or drop_last upstream.
+    """
+    from ..core.tensor import Tensor
+    arr = array._data if isinstance(array, Tensor) else array
+    if jax.process_count() == 1:
+        return arr
+    mesh = mesh or ensure_mesh()
+    from jax.experimental import multihost_utils
+    arr = np.asarray(arr)
+    if not spec:
+        if arr.ndim == 0:
+            pspec = PartitionSpec()
+        else:
+            local_per_host = data_axes_size(mesh) // jax.process_count()
+            if local_per_host and arr.shape[0] % local_per_host != 0:
+                raise ValueError(
+                    f"multi-host batch: local leading dim {arr.shape[0]} "
+                    f"does not divide across the per-host data-parallel "
+                    f"degree {local_per_host}; pad the batch or use "
+                    "drop_last=True")
+            pspec = PartitionSpec(DATA_AXES,
+                                  *([None] * (arr.ndim - 1)))
+    else:
+        pspec = PartitionSpec(*spec)
+    return multihost_utils.host_local_array_to_global_array(
+        arr, mesh, pspec)

@@ -35,7 +35,7 @@ from ..distributed import mesh as mesh_mod
 from ..distributed.sharding import shard_params_specs
 from .. import amp as amp_mod
 
-DATA_AXES = ("dp", "sharding")
+DATA_AXES = mesh_mod.DATA_AXES  # single source: distributed/mesh.py
 
 
 def _batch_spec(ndim):
@@ -330,14 +330,10 @@ class TrainStep:
     # ------------------------------------------------------------------
     def _data_sharding(self, shape):
         # non-divisible batches fall back to replicated (correct, just not
-        # data-parallel) — mirrors DistributedBatchSampler padding being
-        # the "right" fix upstream
-        data_world = 1
-        for ax in DATA_AXES:
-            data_world *= self.mesh.shape.get(ax, 1)
-        if shape and shape[0] % data_world == 0:
-            return NamedSharding(self.mesh, _batch_spec(len(shape)))
-        return NamedSharding(self.mesh, P())
+        # data-parallel) — policy lives in mesh.batch_partition_spec
+        return NamedSharding(self.mesh,
+                             mesh_mod.batch_partition_spec(shape,
+                                                           self.mesh))
 
     def step(self, inputs, labels=()):
         """Run one optimization step on a global batch."""
@@ -345,18 +341,29 @@ class TrainStep:
             inputs = [inputs]
         if not isinstance(labels, (list, tuple)):
             labels = [labels]
-        in_arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        # np.asarray: no device commit yet — placement happens below
+        in_arrays = [x._data if isinstance(x, Tensor) else np.asarray(x)
                      for x in inputs]
-        lab_arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        lab_arrays = [x._data if isinstance(x, Tensor) else np.asarray(x)
                       for x in labels]
         if not self.is_pipeline:
-            # batches may arrive committed to one device (DataLoader
-            # Tensors); re-place them on the mesh so they match the step's
-            # declared in_shardings
-            in_arrays = [jax.device_put(a, self._data_sharding(a.shape))
-                         for a in in_arrays]
-            lab_arrays = [jax.device_put(a, self._data_sharding(a.shape))
-                          for a in lab_arrays]
+            if jax.process_count() > 1:
+                # multi-host: each process holds its LOCAL batch shard;
+                # assemble the global array (reference: per-trainer data
+                # partitions feeding one NCCL job)
+                in_arrays = [mesh_mod.host_local_to_global(a, self.mesh)
+                             for a in in_arrays]
+                lab_arrays = [mesh_mod.host_local_to_global(a, self.mesh)
+                              for a in lab_arrays]
+            else:
+                # batches may arrive committed to one device (DataLoader
+                # Tensors); re-place them on the mesh so they match the
+                # step's declared in_shardings
+                in_arrays = [jax.device_put(a, self._data_sharding(a.shape))
+                             for a in in_arrays]
+                lab_arrays = [jax.device_put(a,
+                                             self._data_sharding(a.shape))
+                              for a in lab_arrays]
         key = rng_mod.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         shapes_key = (len(in_arrays),
