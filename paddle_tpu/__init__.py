@@ -106,6 +106,7 @@ from . import compat  # noqa: E402,F401
 from . import sysconfig  # noqa: E402,F401
 from . import onnx  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
+from . import version  # noqa: E402,F401
 from .batch import batch  # noqa: E402,F401
 from .nn.param_attr import ParamAttr  # noqa: E402,F401
 from .core.tensor import Tensor as VarBase  # noqa: E402,F401
@@ -211,3 +212,7 @@ def _late_bind():
 
 _late_bind()
 del _late_bind
+
+
+# fluid namespace last: it re-exports names defined above (places, etc.)
+from . import fluid  # noqa: E402,F401

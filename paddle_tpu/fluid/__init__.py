@@ -1,0 +1,56 @@
+"""``paddle.fluid`` compatibility namespace.
+
+Reference parity: 1.x/2.0-era user code imports ``paddle.fluid as fluid``
+pervasively (``python/paddle/fluid/__init__.py``).  This module re-exports
+the modern equivalents under the fluid names so that era's scripts run:
+``fluid.layers`` → static.nn + functional ops, ``fluid.dygraph`` → eager
+mode helpers, ``fluid.Executor``/``fluid.data``/places → paddle.static.
+"""
+from __future__ import annotations
+
+from ..static import (  # noqa: F401
+    Program, Executor, program_guard, default_main_program,
+    default_startup_program, global_scope, scope_guard, data,
+    CompiledProgram, BuildStrategy, ExecutionStrategy, ParallelExecutor,
+    device_guard)
+from ..core.tensor import Tensor, Parameter  # noqa: F401
+from ..nn.param_attr import ParamAttr  # noqa: F401
+from ..core.device import (  # noqa: F401
+    set_device, get_device, is_compiled_with_cuda)
+from .. import (  # noqa: F401
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace,
+    LoDTensor, LoDTensorArray)
+from ..framework.io import save, load  # noqa: F401
+from .. import optimizer  # noqa: F401
+from .. import io  # noqa: F401
+from .. import regularizer  # noqa: F401
+from ..nn import initializer  # noqa: F401
+from ..nn import clip  # noqa: F401
+from ..io.native_dataset import DatasetFactory  # noqa: F401
+from . import layers  # noqa: F401
+from . import dygraph  # noqa: F401
+
+
+def enable_dygraph(place=None):
+    from ..static.program import disable_static
+    disable_static()
+
+
+def disable_dygraph():
+    from ..static.program import enable_static
+    enable_static()
+
+
+def in_dygraph_mode():
+    from ..static.program import in_dynamic_mode
+    return in_dynamic_mode()
+
+
+def cuda_places(device_ids=None):
+    from ..static.compat import cuda_places as _cp
+    return _cp(device_ids)
+
+
+def cpu_places(device_count=None):
+    from ..static.compat import cpu_places as _cp
+    return _cp(device_count)

@@ -1,0 +1,63 @@
+"""``fluid.dygraph`` — 1.x eager-mode namespace.
+
+Reference parity: ``python/paddle/fluid/dygraph/`` (guard, to_variable,
+Layer, layer containers, jit helpers).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..nn.layer.base import Layer, LayerList, Sequential  # noqa: F401
+from ..nn import ParamAttr  # noqa: F401
+from ..core.tensor import Tensor, to_tensor  # noqa: F401
+from ..distributed.parallel import DataParallel, ParallelEnv  # noqa: F401
+from ..jit import to_static as declarative  # noqa: F401
+from ..jit import ProgramTranslator  # noqa: F401
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """1.x dygraph guard: eager mode within the block."""
+    from ..static.program import (in_static_mode, enable_static,
+                                  disable_static)
+    was_static = in_static_mode()
+    disable_static()
+    try:
+        yield
+    finally:
+        if was_static:
+            enable_static()
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    return to_tensor(value, dtype=dtype)
+
+
+def enabled():
+    from ..static.program import in_dynamic_mode
+    return in_dynamic_mode()
+
+
+# 1.x layer-class aliases
+from ..nn import (  # noqa: F401,E402
+    Linear, Embedding, Conv2D, BatchNorm, LayerNorm, Dropout,
+)
+
+
+class Pool2D(Layer):
+    """1.x Pool2D layer (reference: fluid/dygraph/nn.py Pool2D)."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, ceil_mode=False,
+                 exclusive=True, data_format="NCHW"):
+        super().__init__()
+        self._args = (pool_size, pool_type, pool_stride, pool_padding,
+                      global_pooling, ceil_mode, exclusive, data_format)
+
+    def forward(self, x):
+        from ..nn.functional import pool2d
+        (size, ptype, stride, pad, gp, ceil, excl, fmt) = self._args
+        return pool2d(x, pool_size=size, pool_type=ptype,
+                      pool_stride=stride, pool_padding=pad,
+                      global_pooling=gp, ceil_mode=ceil,
+                      exclusive=excl, data_format=fmt)

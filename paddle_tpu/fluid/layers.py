@@ -1,0 +1,79 @@
+"""``fluid.layers`` — the 1.x layer/op namespace.
+
+Reference parity: ``python/paddle/fluid/layers/`` (nn.py, tensor.py,
+control_flow.py, detection.py…), the surface 1.x model code builds on.
+Everything maps to the modern ops; graph building works because the ops
+record into the default Program under ``paddle.enable_static()``.
+"""
+from __future__ import annotations
+
+# graph-building layers (create parameters)
+from ..static.nn import (  # noqa: F401
+    fc, conv2d, batch_norm, embedding, dropout,
+    cond, while_loop, case, switch_case)
+
+# tensor ops under their fluid names
+from ..ops.compat_ops import (  # noqa: F401
+    fill_constant, create_global_var, create_parameter, elementwise_add,
+    elementwise_sub, elementwise_mul, elementwise_div, elementwise_pow,
+    elementwise_mod, elementwise_floordiv, elementwise_max,
+    elementwise_min, reduce_sum, reduce_mean, reduce_max, reduce_min,
+    reduce_prod, has_inf, has_nan, shape, slice, strided_slice,
+    crop_tensor, unstack, create_array, array_write, array_read,
+    array_length)
+from ..ops.math import (  # noqa: F401
+    abs, exp, log, sqrt, square, sin, cos, tanh, sigmoid, clip, scale,
+    cumsum, pow, matmul)
+from ..ops.creation import (  # noqa: F401
+    zeros, ones, full, arange, linspace, assign)
+from ..ops.manipulation import (  # noqa: F401
+    concat, split, reshape, transpose, squeeze, unsqueeze, stack,
+    gather, gather_nd, scatter, expand_as, cast, one_hot, topk, argsort,
+    where)
+from ..nn.functional import (  # noqa: F401
+    relu, softmax, cross_entropy, log_softmax, pad, pool2d,
+    image_resize, grid_sample, bilinear_tensor_product, dice_loss,
+    linear_chain_crf)
+from ..nn.functional.loss import (  # noqa: F401
+    square_error_cost, softmax_with_cross_entropy)
+from ..static.compat import accuracy, auc, Print  # noqa: F401
+from ..vision.ops import (  # noqa: F401
+    yolo_box, prior_box, box_coder, multiclass_nms, roi_align, roi_pool)
+
+# sequence layers
+from ..nn.functional.sequence import (  # noqa: F401
+    sequence_pad, sequence_unpad, sequence_pool, sequence_softmax,
+    sequence_expand, sequence_reverse)
+
+
+def mean(x, name=None):
+    from ..ops.math import mean as _mean
+    return _mean(x)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """reference mul_op.cc: flatten x after x_num_col_dims and y after
+    y_num_col_dims, 2-D matmul, restore the leading dims."""
+    import numpy as _np
+    from ..ops.math import matmul as _matmul
+    from ..ops.manipulation import reshape as _reshape
+    x_lead = list(x.shape[:x_num_col_dims])
+    x_flat = _reshape(x, [int(_np.prod(x_lead) or 1), -1])
+    y_tail = list(y.shape[y_num_col_dims:])
+    y_flat = _reshape(y, [-1, int(_np.prod(y_tail) or 1)])
+    out = _matmul(x_flat, y_flat)
+    return _reshape(out, x_lead + y_tail)
+
+
+def data(name, shape, dtype="float32", lod_level=0,
+         append_batch_size=True):
+    """fluid.layers.data prepends the batch dim when append_batch_size
+    (1.x convention); dynamic dims are rejected on TPU — declare the
+    batch size explicitly."""
+    from ..static.program import data as _data
+    if append_batch_size:
+        raise ValueError(
+            "fluid.layers.data(append_batch_size=True) implies a dynamic "
+            "batch dim, unsupported on the TPU backend; pass the full "
+            "shape and append_batch_size=False")
+    return _data(name, shape, dtype)

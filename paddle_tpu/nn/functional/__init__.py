@@ -35,17 +35,22 @@ from ...ops.math import erf  # noqa: F401
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, ceil_mode=False,
-           data_format="NCHW", name=None):
+           exclusive=True, data_format="NCHW", name=None):
     """reference: fluid/layers/nn.py pool2d."""
     from . import pooling as _pooling
     if global_pooling:
         fn = (_pooling.adaptive_max_pool2d if pool_type == "max"
               else _pooling.adaptive_avg_pool2d)
         return fn(input, output_size=1)
-    fn = _pooling.max_pool2d if pool_type == "max" else _pooling.avg_pool2d
-    return fn(input, kernel_size=pool_size, stride=pool_stride,
-              padding=pool_padding, ceil_mode=ceil_mode,
-              data_format=data_format)
+    if pool_type == "max":
+        return _pooling.max_pool2d(
+            input, kernel_size=pool_size, stride=pool_stride,
+            padding=pool_padding, ceil_mode=ceil_mode,
+            data_format=data_format)
+    return _pooling.avg_pool2d(
+        input, kernel_size=pool_size, stride=pool_stride,
+        padding=pool_padding, ceil_mode=ceil_mode, exclusive=exclusive,
+        data_format=data_format)
 
 
 def _vision_alias(name):

@@ -341,11 +341,23 @@ class TrainStep:
             inputs = [inputs]
         if not isinstance(labels, (list, tuple)):
             labels = [labels]
-        # np.asarray: no device commit yet — placement happens below
-        in_arrays = [x._data if isinstance(x, Tensor) else np.asarray(x)
-                     for x in inputs]
-        lab_arrays = [x._data if isinstance(x, Tensor) else np.asarray(x)
-                      for x in labels]
+        def _as_array(x):
+            # Tensors/jax arrays stay on device; everything else becomes
+            # numpy WITHOUT a device commit (placement happens below)
+            if isinstance(x, Tensor):
+                return x._data
+            if isinstance(x, jax.Array):
+                return x
+            return np.asarray(x)
+
+        in_arrays = [_as_array(x) for x in inputs]
+        lab_arrays = [_as_array(x) for x in labels]
+        if self.is_pipeline and jax.process_count() > 1:
+            raise NotImplementedError(
+                "pipeline TrainStep on a multi-host mesh: global batch "
+                "assembly for the pipeline path is not implemented — "
+                "feed pre-assembled global arrays or keep pp within one "
+                "host")
         if not self.is_pipeline:
             if jax.process_count() > 1:
                 # multi-host: each process holds its LOCAL batch shard;

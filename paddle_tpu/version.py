@@ -1,0 +1,18 @@
+"""paddle.version (reference: generated python/paddle/version.py)."""
+full_version = "2.0.0-tpu"
+major = "2"
+minor = "0"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native"
+with_mkl = "OFF"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+
+
+def mkl():
+    return with_mkl

@@ -1,0 +1,132 @@
+"""paddle.fluid compatibility namespace: 1.x-era scripts run unchanged
+(reference: python/paddle/fluid/__init__.py surface)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu import optimizer
+
+
+def test_fluid_static_one_x_style():
+    paddle.enable_static()
+    main = fluid.Program()
+    try:
+        with fluid.program_guard(main):
+            x = fluid.data("x", [8, 4])
+            y = fluid.data("y", [8, 1])
+            h = fluid.layers.fc(x, 16, activation="relu")
+            pred = fluid.layers.fc(h, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            optimizer.SGD(learning_rate=0.1).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            rng = np.random.RandomState(0)
+            xv = rng.rand(8, 4).astype("float32")
+            yv = rng.rand(8, 1).astype("float32")
+            l0 = exe.run(main, feed={"x": xv, "y": yv},
+                         fetch_list=[loss])[0]
+            for _ in range(30):
+                l1 = exe.run(main, feed={"x": xv, "y": yv},
+                             fetch_list=[loss])[0]
+        assert float(l1) < float(l0)
+    finally:
+        paddle.disable_static()
+
+
+def test_fluid_dygraph_guard():
+    with fluid.dygraph.guard():
+        net = fluid.dygraph.Linear(4, 2)
+        v = fluid.dygraph.to_variable(np.ones((3, 4), "float32"))
+        out = net(v)
+        assert out.shape == [3, 2]
+        assert fluid.dygraph.enabled()
+
+
+def test_fluid_dygraph_pool2d_and_containers():
+    with fluid.dygraph.guard():
+        pool = fluid.dygraph.Pool2D(pool_size=2, pool_type="avg",
+                                    pool_stride=2)
+        x = fluid.dygraph.to_variable(np.ones((1, 1, 4, 4), "float32"))
+        assert pool(x).shape == [1, 1, 2, 2]
+        seq = fluid.dygraph.Sequential(fluid.dygraph.Linear(4, 8),
+                                       fluid.dygraph.Linear(8, 2))
+        assert seq(fluid.dygraph.to_variable(
+            np.ones((2, 4), "float32"))).shape == [2, 2]
+
+
+def test_fluid_layers_ops_eager():
+    a = paddle.to_tensor(np.array([[1.0, 2.0]], "float32"))
+    b = paddle.to_tensor(np.array([[3.0], [4.0]], "float32"))
+    out = fluid.layers.matmul(a, b)
+    assert float(out.numpy()) == pytest.approx(11.0)
+    s = fluid.layers.reduce_sum(fluid.layers.elementwise_add(a, a))
+    assert float(s.numpy()) == pytest.approx(6.0)
+    arr = fluid.layers.create_array()
+    fluid.layers.array_write(a, 0, arr)
+    assert int(fluid.layers.array_length(arr).numpy()) == 1
+
+
+def test_fluid_layers_data_rejects_appended_batch():
+    paddle.enable_static()
+    try:
+        with pytest.raises(ValueError):
+            fluid.layers.data("x", [4], append_batch_size=True)
+    finally:
+        paddle.disable_static()
+
+
+def test_version_module():
+    from paddle_tpu import version
+    assert version.full_version == paddle.__version__
+    version.show()
+
+
+# ---- regressions from code review ----------------------------------------
+
+def test_fluid_mul_num_col_dims():
+    # 1.x mul flattens x after x_num_col_dims (reference mul_op.cc)
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 4).astype("float32")
+    y = rng.rand(12, 5).astype("float32")
+    out = fluid.layers.mul(paddle.to_tensor(x), paddle.to_tensor(y),
+                           x_num_col_dims=1)
+    np.testing.assert_allclose(out.numpy(),
+                               x.reshape(2, 12) @ y, rtol=1e-5)
+    out2 = fluid.layers.mul(paddle.to_tensor(x), paddle.to_tensor(y.reshape(4, 3, 5)),
+                            x_num_col_dims=2, y_num_col_dims=1)
+    assert out2.shape == [2, 3, 3, 5]
+
+
+def test_pool2d_exclusive_divisor():
+    # exclusive=False includes padding in the average divisor
+    x = paddle.to_tensor(np.ones((1, 1, 2, 2), "float32"))
+    from paddle_tpu.nn.functional import pool2d
+    incl = pool2d(x, pool_size=2, pool_type="avg", pool_stride=1,
+                  pool_padding=1, exclusive=False)
+    excl = pool2d(x, pool_size=2, pool_type="avg", pool_stride=1,
+                  pool_padding=1, exclusive=True)
+    # corner: 1 valid cell of 4 -> 0.25 vs 1.0
+    assert float(incl.numpy()[0, 0, 0, 0]) == pytest.approx(0.25)
+    assert float(excl.numpy()[0, 0, 0, 0]) == pytest.approx(1.0)
+
+
+def test_train_step_accepts_device_arrays():
+    import jax.numpy as jnp
+    from paddle_tpu.parallel.train_step import TrainStep
+    from paddle_tpu import nn, optimizer, distributed as dist
+
+    class MSE(nn.Layer):
+        def forward(self, p, l):
+            return paddle.mean((p - l) ** 2)
+
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+    step = TrainStep(net, optimizer.SGD(learning_rate=0.1,
+                                        parameters=net.parameters()),
+                     loss_fn=MSE(), mesh=dist.build_mesh(dp=8))
+    x = jnp.ones((8, 4))     # raw device arrays, not Tensors
+    y = jnp.zeros((8, 1))
+    l0 = float(step.step([x], [y]).numpy())
+    l1 = float(step.step([x], [y]).numpy())
+    assert l1 < l0
