@@ -149,11 +149,15 @@ class RandomSampler(Sampler):
         super().__init__(data_source)
         self.replacement = replacement
         self.num_samples = num_samples or len(data_source)
+        self._epoch = 0
 
     def __iter__(self):
         n = len(self.data_source)
+        # deterministic under paddle.seed, fresh permutation per epoch
+        # (an id(self)-based seed would change between runs)
         rs = np.random.RandomState(
-            (rng_mod.get_seed() + id(self)) % (2 ** 31))
+            (rng_mod.get_seed() + self._epoch * 1315423911) % (2 ** 31))
+        self._epoch += 1
         if self.replacement:
             return iter(rs.randint(0, n, self.num_samples).tolist())
         return iter(rs.permutation(n)[:self.num_samples].tolist())

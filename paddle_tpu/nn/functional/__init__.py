@@ -19,7 +19,7 @@ from .loss import *  # noqa: F401,F403
 from .attention import scaled_dot_product_attention  # noqa: F401
 from .sequence import (  # noqa: F401
     sequence_pad, sequence_unpad, sequence_pool, sequence_softmax,
-    sequence_expand, sequence_reverse, edit_distance,
+    sequence_expand, sequence_reverse, edit_distance, row_conv,
 )
 from .extension import (  # noqa: F401
     grid_sample, diag_embed, gather_tree, bilinear,

@@ -211,3 +211,26 @@ def edit_distance(input, label, normalized=True, input_length=None,
             d = d / max(float(rl[i]), 1.0)
         out[i, 0] = d
     return Tensor(out), Tensor(np.array([b], np.int64))
+
+
+@primitive(name="row_conv")
+def _row_conv(x, w):
+    """x [B, T, D], w [future_context+1, D]: y[t] = sum_i w[i]*x[t+i]
+    (reference: row_conv_op.cc — lookahead convolution for streaming
+    speech models)."""
+    ctx = w.shape[0]
+    t = x.shape[1]
+    pad = jnp.pad(x, ((0, 0), (0, ctx - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for i in range(ctx):
+        out = out + pad[:, i:i + t, :] * w[i][None, None, :]
+    return out
+
+
+def row_conv(x, weight, act=None, name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    out = _row_conv(x, weight)
+    if act:
+        from . import activation as _act
+        out = getattr(_act, act)(out)
+    return out

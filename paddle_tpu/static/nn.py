@@ -220,3 +220,265 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
                      len(branches) - 1)
     out = jax.lax.switch(pos, branches, 0)
     return _wrap_cf(out)
+
+
+# ---- remaining static.nn graph builders (reference static/nn/__init__) ----
+
+def _graph_norm(norm_layer_cls, input, *cls_args, act=None, **cls_kwargs):
+    layer = norm_layer_cls(*cls_args, **cls_kwargs)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ..nn import LayerNorm
+    return _graph_norm(
+        LayerNorm, input, input.shape[begin_norm_axis:], act=act,
+        epsilon=epsilon,
+        weight_attr=(param_attr if scale else False),
+        bias_attr=(bias_attr if shift else False))
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    if data_layout != "NCHW":
+        raise NotImplementedError(
+            "static.nn.group_norm: only NCHW is supported (channel-last "
+            "normalization would silently use the wrong axis)")
+    from ..nn import GroupNorm
+    return _graph_norm(GroupNorm, input, groups, input.shape[1], act=act,
+                       epsilon=epsilon)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn import InstanceNorm2D
+    return _graph_norm(InstanceNorm2D, input, input.shape[1],
+                       epsilon=epsilon)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..nn import SpectralNorm
+    return SpectralNorm(weight.shape, axis=dim,
+                        power_iters=power_iters, epsilon=eps)(weight)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None, name=None,
+              **kwargs):
+    """reference data_norm_op: normalization by ACCUMULATED stats (never
+    the current minibatch) — served by batch_norm in global-stats mode;
+    the reference's online accumulation of batch_sum/batch_square_sum is
+    not reproduced."""
+    return batch_norm(input, act=act, epsilon=epsilon,
+                      param_attr=param_attr, is_test=True)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW", name=None):
+    if filter_size is None:
+        raise ValueError(
+            "conv2d_transpose: filter_size is required (deriving it from "
+            "output_size is not supported — pass the kernel explicitly)")
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    w = _make_param([in_ch, num_filters // groups] + list(filter_size),
+                    "float32", param_attr, init_mod.XavierUniform(),
+                    "convT_w")
+    b = None
+    if bias_attr is not False:
+        b = _make_param([num_filters], "float32", bias_attr,
+                        init_mod.Constant(0.0), "convT_b")
+    out = F.conv2d_transpose(input, w, bias=b, stride=stride,
+                             padding=padding, dilation=dilation,
+                             groups=groups, data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format="NCDHW", name=None):
+    if data_format != "NCDHW":
+        raise NotImplementedError(
+            "static.nn.conv3d: only NCDHW is supported")
+    if isinstance(filter_size, int):
+        filter_size = [filter_size] * 3
+    in_ch = input.shape[1]
+    w = _make_param([num_filters, in_ch // groups] + list(filter_size),
+                    "float32", param_attr, init_mod.XavierUniform(),
+                    "conv3d_w")
+    b = None
+    if bias_attr is not False:
+        b = _make_param([num_filters], "float32", bias_attr,
+                        init_mod.Constant(0.0), "conv3d_b")
+    out = F.conv3d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, stride=1, padding=0, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None, act=None,
+                     data_format="NCDHW", name=None):
+    if data_format != "NCDHW":
+        raise NotImplementedError(
+            "static.nn.conv3d_transpose: only NCDHW is supported")
+    if filter_size is None:
+        raise ValueError(
+            "conv3d_transpose: filter_size is required (deriving it from "
+            "output_size is not supported — pass the kernel explicitly)")
+    if isinstance(filter_size, int):
+        filter_size = [filter_size] * 3
+    in_ch = input.shape[1]
+    w = _make_param([in_ch, num_filters // groups] + list(filter_size),
+                    "float32", param_attr, init_mod.XavierUniform(),
+                    "conv3dT_w")
+    b = None
+    if bias_attr is not False:
+        b = _make_param([num_filters], "float32", bias_attr,
+                        init_mod.Constant(0.0), "conv3dT_b")
+    out = F.conv3d_transpose(input, w, bias=b, stride=stride,
+                             padding=padding, dilation=dilation,
+                             groups=groups)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    if mode == "element":
+        # per-element alpha broadcasts over batch only; F.prelu's 1-D
+        # channel reshape does not apply here
+        alpha = _make_param([1] + list(x.shape[1:]), "float32",
+                            param_attr, init_mod.Constant(0.25),
+                            "prelu_alpha")
+        from .. import ops
+        zero = 0.0
+        return ops.maximum(x, zero) + alpha * ops.minimum(x, zero)
+    n_alpha = 1 if mode == "all" else x.shape[1]
+    alpha = _make_param([n_alpha], "float32", param_attr,
+                        init_mod.Constant(0.25), "prelu_alpha")
+    return F.prelu(x, alpha)
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    w = _make_param([size, x.shape[-1], y.shape[-1]], "float32",
+                    param_attr, init_mod.XavierUniform(), "bilinear_w")
+    b = None
+    if bias_attr is not False:
+        b = _make_param([size], "float32", bias_attr,
+                        init_mod.Constant(0.0), "bilinear_b")
+    out = F.bilinear(x, y, w, b)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def deform_conv2d(input, offset, mask=None, num_filters=1, filter_size=3,
+                  stride=1, padding=0, dilation=1, groups=1,
+                  deformable_groups=1, param_attr=None, bias_attr=None,
+                  name=None):
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    in_ch = input.shape[1]
+    w = _make_param([num_filters, in_ch // groups] + list(filter_size),
+                    "float32", param_attr, init_mod.XavierUniform(),
+                    "dcn_w")
+    b = None
+    if bias_attr is not False:
+        b = _make_param([num_filters], "float32", bias_attr,
+                        init_mod.Constant(0.0), "dcn_b")
+    from ..vision.ops import deform_conv2d as _dcn
+    return _dcn(input, offset, w, bias=b, stride=stride, padding=padding,
+                dilation=dilation, deformable_groups=deformable_groups,
+                groups=groups, mask=mask)
+
+
+def crf_decoding(input, param_attr=None, length=None, label=None,
+                 name=None, transition=None):
+    """reference crf_decoding_op — viterbi over a trained transition.
+    Works on eager tensors AND symbolic Variables (the viterbi primitive
+    records like any other op)."""
+    if transition is None:
+        raise ValueError(
+            "crf_decoding: pass transition= (the linear_chain_crf "
+            "parameter); the reference reads it from param_attr's scope "
+            "entry, which has no analogue here")
+    import numpy as _np
+    n = int(input.shape[-1])
+    tr = transition.numpy() if hasattr(transition, "numpy") else \
+        _np.asarray(transition)
+    # fluid [n+2, n] CRF layout -> the square layout _viterbi expects
+    sq = _np.full((n + 2, n + 2), -1e9, _np.float32)
+    sq[:n, :n] = tr[2:]
+    sq[n, :n] = tr[0]
+    sq[:n, n + 1] = tr[1]
+    from ..core.tensor import Tensor as _T
+    from .. import ops
+    pad = _T(_np.full(tuple(input.shape[:-1]) + (2,), -1e9, _np.float32))
+    em_pad = ops.concat([input, pad], axis=-1)
+    if length is None:
+        length = _np.full((int(input.shape[0]),), int(input.shape[1]),
+                          _np.int32)
+    length = length if isinstance(length, Tensor) else _T(
+        _np.asarray(length))
+    from ..nn.functional.extension import viterbi_decode
+    _, path = viterbi_decode(em_pad, _T(sq), length)
+    return path
+
+
+def sparse_embedding(input, size, padding_idx=None, param_attr=None,
+                     dtype="float32", name=None, is_test=False,
+                     entry=None):
+    """reference: PS distributed_lookup_table path → mesh-sharded table
+    (distributed/ps.py) for the huge-vocab case; plain embedding here."""
+    return embedding(input, size, is_sparse=True,
+                     padding_idx=padding_idx, param_attr=param_attr,
+                     dtype=dtype)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    w = _make_param([future_context_size + 1, input.shape[-1]],
+                    "float32", param_attr, init_mod.XavierUniform(),
+                    "row_conv_w")
+    from ..nn.functional.sequence import row_conv as _rc
+    out = _rc(input, w)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    from ..nn import NCELoss
+    layer = NCELoss(input.shape[-1], num_total_classes,
+                    num_neg_samples=num_neg_samples, sampler=sampler)
+    return layer(input, label)
+
+
+def multi_box_head(*args, **kwargs):
+    raise NotImplementedError(
+        "multi_box_head (SSD head): compose prior_box + conv heads from "
+        "paddle.vision.ops directly — the monolithic fluid layer is not "
+        "reimplemented")
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    raise NotImplementedError(
+        "py_func: host callbacks map to jax.pure_callback; not yet wired")
+
+
+from ..ops.compat_ops import create_parameter  # noqa: E402,F401

@@ -271,3 +271,77 @@ def test_minimize_only_touches_loss_params(_static_guard):
     exe = static.Executor()
     exe.run(feed={"x": np.ones((4, 3), "float32")}, fetch_list=[loss])
     np.testing.assert_array_equal(other.numpy(), before)
+
+
+def test_static_nn_extended_builders(_static_guard):
+    x = static.data("x", [2, 3, 8, 8])
+    ct = static.nn.conv2d_transpose(x, 4, filter_size=3, stride=2,
+                                    padding=1)
+    gn = static.nn.group_norm(ct, groups=2)
+    pr = static.nn.prelu(gn, mode="all")
+    inorm = static.nn.instance_norm(ct)
+    ln_in = static.data("ln", [2, 6])
+    ln = static.nn.layer_norm(ln_in)
+    exe = static.Executor()
+    out, lnv, inv = exe.run(
+        feed={"x": np.ones((2, 3, 8, 8), "float32"),
+              "ln": np.ones((2, 6), "float32")},
+        fetch_list=[pr, ln, inorm])
+    assert out.shape == (2, 4, 15, 15)
+    assert lnv.shape == (2, 6)
+    assert inv.shape == (2, 4, 15, 15)
+
+
+def test_static_nn_bilinear_and_conv3d(_static_guard):
+    a = static.data("a", [4, 5])
+    b = static.data("b", [4, 6])
+    out = static.nn.bilinear_tensor_product(a, b, size=3)
+    v = static.data("v", [1, 2, 4, 4, 4])
+    c3 = static.nn.conv3d(v, 3, 2)
+    exe = static.Executor()
+    o1, o2 = exe.run(feed={"a": np.ones((4, 5), "float32"),
+                           "b": np.ones((4, 6), "float32"),
+                           "v": np.ones((1, 2, 4, 4, 4), "float32")},
+                     fetch_list=[out, c3])
+    assert o1.shape == (4, 3)
+    assert o2.shape == (1, 3, 3, 3, 3)
+
+
+def test_static_nn_review_regressions(_static_guard):
+    # spectral_norm callable with defaults
+    import paddle_tpu
+    w = paddle_tpu.create_parameter([6, 4], "float32")
+    sn = static.nn.spectral_norm(w)
+    assert sn.shape == [6, 4]
+    # prelu element mode broadcasts per element
+    x = static.data("xe", [2, 3, 4, 4])
+    pe = static.nn.prelu(x, mode="element")
+    exe = static.Executor()
+    out, = exe.run(feed={"xe": -np.ones((2, 3, 4, 4), "float32")},
+                   fetch_list=[pe])
+    np.testing.assert_allclose(out, -0.25 * np.ones((2, 3, 4, 4)),
+                               rtol=1e-6)
+    # group_norm NHWC rejected loudly
+    with pytest.raises(NotImplementedError):
+        static.nn.group_norm(x, groups=1, data_layout="NHWC")
+    # conv3d_transpose missing kernel raises clearly
+    v = static.data("v", [1, 2, 4, 4, 4])
+    with pytest.raises(ValueError):
+        static.nn.conv3d_transpose(v, 3)
+
+
+def test_crf_decoding_records_into_program(_static_guard):
+    import paddle_tpu
+    e = static.data("e", [2, 5, 3])
+    trans = paddle_tpu.to_tensor(
+        np.random.RandomState(3).rand(5, 3).astype("float32"))
+    path = static.nn.crf_decoding(e, transition=trans)
+    assert isinstance(path, static.Variable)   # recorded, not eager
+    exe = static.Executor()
+    ev = np.random.RandomState(2).rand(2, 5, 3).astype("float32")
+    got, = exe.run(feed={"e": ev}, fetch_list=[path])
+    assert got.shape == (2, 5)
+    # matches the eager decode of the same inputs
+    eager = static.nn.crf_decoding(paddle_tpu.to_tensor(ev),
+                                   transition=trans)
+    np.testing.assert_array_equal(got, eager.numpy())
