@@ -171,6 +171,7 @@ class GPTBlock(nn.Layer):
 class GPTLMHead(nn.Layer):
     def __init__(self, hidden_size, vocab_size, use_mp=False):
         super().__init__()
+        self.use_mp = use_mp
         self.ln_f = nn.LayerNorm(hidden_size)
         init = nn.ParamAttr(initializer=I.Normal(0.0, 0.02))
         if use_mp:
@@ -192,8 +193,9 @@ class GPTModel(nn.Layer):
     def __init__(self, num_layers=12, hidden_size=768, num_heads=12,
                  vocab_size=50304, max_position=1024, dropout=0.1,
                  use_mp=False, use_recompute=False, moe_experts=0,
-                 moe_every=2):
+                 moe_every=2, fused_loss=False):
         super().__init__()
+        self.fused_loss = fused_loss
         self.embeddings = GPTEmbeddings(vocab_size, hidden_size,
                                         max_position, dropout, use_mp)
         # moe_experts>0: every `moe_every`-th block (1-based) swaps its FFN
@@ -210,7 +212,8 @@ class GPTModel(nn.Layer):
             for i in range(num_layers)])
         self.head = GPTLMHead(hidden_size, vocab_size, use_mp)
 
-    def forward(self, input_ids, caches=None, position_offset=0):
+    def forward(self, input_ids, labels=None, caches=None,
+                position_offset=0):
         x = self.embeddings(input_ids, position_offset=position_offset)
         if caches is not None:
             new_caches = []
@@ -220,7 +223,18 @@ class GPTModel(nn.Layer):
             return self.head(x), new_caches
         for blk in self.blocks:
             x = blk(x)
-        return self.head(x)
+        if labels is not None and self.fused_loss and not self.head.use_mp:
+            # head + CE fused per sequence chunk: the [B, S, vocab] logits
+            # never hit HBM (see F.fused_linear_cross_entropy)
+            h = self.head.ln_f(x)
+            return F.fused_linear_cross_entropy(
+                h, self.head.lm_head.weight, labels)
+        logits = self.head(x)
+        if labels is not None:
+            b, s, v = logits.shape
+            return F.cross_entropy(reshape(logits, [b * s, v]),
+                                   reshape(labels, [b * s]))
+        return logits
 
     def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
                  top_k=0, eos_token_id=None, seed=None):

@@ -328,3 +328,39 @@ def log_loss(input, label, epsilon=1e-4, name=None):
     return primitive(name="log_loss")(
         lambda p, t: -t * jnp.log(p + epsilon)
         - (1 - t) * jnp.log(1 - p + epsilon))(input, label)
+
+
+@primitive(name="fused_linear_cross_entropy", nondiff=(2,))
+def _fused_linear_ce(h, w, labels, chunk=128):
+    """Sequence-chunked LM-head + softmax-CE: the [B, S, vocab] logits
+    tensor never materializes — each scan step computes one [B, chunk,
+    vocab] slice and jax.checkpoint recomputes it in backward.  Trades
+    FLOPs for HBM exactly like the reference's recompute pass, but at the
+    loss, where the vocab-sized activation dominates peak memory."""
+    b, s, hidden = h.shape
+    chunk = min(chunk, s)
+    while s % chunk:          # largest divisor of s not above the request
+        chunk -= 1
+    n_chunks = s // chunk
+    hr = jnp.moveaxis(h.reshape(b, n_chunks, chunk, hidden), 1, 0)
+    lr = jnp.moveaxis(labels.reshape(b, n_chunks, chunk), 1, 0)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        hc, lc = xs
+        logits = (hc @ w).astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, lc[..., None].astype(jnp.int32),
+                                   axis=-1)[..., 0]
+        return carry + jnp.sum(logz - gold), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros([], jnp.float32), (hr, lr))
+    return total / (b * s)
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, chunk_size=128,
+                               name=None):
+    """CE(softmax(hidden @ weight), labels) without materializing the full
+    logits (weight [hidden, vocab] — nn.Linear layout)."""
+    return _fused_linear_ce(ensure_tensor(hidden), ensure_tensor(weight),
+                            ensure_tensor(labels), chunk=chunk_size)

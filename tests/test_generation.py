@@ -85,3 +85,55 @@ def test_data_feeder_mismatch_raises():
     feeder = DataFeeder(feed_list=["x", "y"])
     with pytest.raises(ValueError):
         feeder.feed([(np.ones(3),), (np.zeros(3),)])
+
+
+def test_fused_loss_matches_unfused(tiny_gpt):
+    from paddle_tpu.models import GPTPretrainingCriterion
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, 128, (2, 8)).astype(np.int32)
+    lab = rng.randint(0, 128, (2, 8)).astype(np.int32)
+    tiny_gpt.fused_loss = True
+    try:
+        fused = tiny_gpt(paddle.to_tensor(ids),
+                         labels=paddle.to_tensor(lab))
+        tiny_gpt.fused_loss = False
+        logits = tiny_gpt(paddle.to_tensor(ids))
+        ref = GPTPretrainingCriterion()(logits, paddle.to_tensor(lab))
+        assert float(fused.numpy()) == pytest.approx(float(ref.numpy()),
+                                                     rel=1e-5)
+    finally:
+        tiny_gpt.fused_loss = False
+
+
+def test_fused_loss_trains():
+    from paddle_tpu.parallel.train_step import TrainStep
+    from paddle_tpu import optimizer
+    paddle.seed(3)
+    m = GPTModel.from_config("tiny", dropout=0.0, fused_loss=True)
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, 128, (2, 8)).astype(np.int32)
+    lab = rng.randint(0, 128, (2, 8)).astype(np.int32)
+    step = TrainStep(m, optimizer.AdamW(learning_rate=1e-3,
+                     parameters=m.parameters()), loss_fn=None)
+    l0 = float(step.step([ids, lab]).numpy())
+    for _ in range(8):
+        l1 = float(step.step([ids, lab]).numpy())
+    assert l1 < l0
+
+
+def test_fused_loss_non_divisible_seq(tiny_gpt):
+    from paddle_tpu.models import GPTPretrainingCriterion
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, 128, (1, 10)).astype(np.int32)   # 10 % 128 != 0
+    lab = rng.randint(0, 128, (1, 10)).astype(np.int32)
+    tiny_gpt.fused_loss = True
+    try:
+        fused = tiny_gpt(paddle.to_tensor(ids),
+                         labels=paddle.to_tensor(lab))
+        tiny_gpt.fused_loss = False
+        ref = GPTPretrainingCriterion()(
+            tiny_gpt(paddle.to_tensor(ids)), paddle.to_tensor(lab))
+        assert float(fused.numpy()) == pytest.approx(float(ref.numpy()),
+                                                     rel=1e-5)
+    finally:
+        tiny_gpt.fused_loss = False
