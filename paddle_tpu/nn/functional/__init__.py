@@ -24,7 +24,8 @@ from .sequence import (  # noqa: F401
 from .extension import (  # noqa: F401
     grid_sample, diag_embed, gather_tree, bilinear,
     bilinear_tensor_product, dice_loss, npair_loss, affine_grid,
-    linear_chain_crf, viterbi_decode,
+    linear_chain_crf, viterbi_decode, add_position_encoding,
+    pad_constant_like, fsp_matrix, im2sequence, hash,
 )
 
 # -- fluid-era functional aliases (reference fluid/layers re-exports) ------

@@ -343,3 +343,106 @@ def viterbi_decode(potentials, transition_params, lengths,
                     ensure_tensor(transition_params),
                     ensure_tensor(lengths),
                     include_bos_eos_tag=include_bos_eos_tag)
+
+
+# ---- fluid long-tail functionals ------------------------------------------
+
+@primitive(name="add_position_encoding")
+def _add_pos_enc(x, alpha=1.0, beta=1.0):
+    """reference: add_position_encoding_op.cc (sinusoidal)."""
+    b, t, d = x.shape
+    half = d // 2
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos / div[None, :]
+    enc = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+    if enc.shape[-1] < d:
+        enc = jnp.pad(enc, ((0, 0), (0, d - enc.shape[-1])))
+    return alpha * x + beta * enc[None, :, :].astype(x.dtype)
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    return _add_pos_enc(ensure_tensor(input), alpha=alpha, beta=beta)
+
+
+@primitive(name="pad_constant_like")
+def _pad_like(x, y, pad_value=0.0):
+    pads = [(0, int(xs) - int(ys)) for xs, ys in zip(x.shape, y.shape)]
+    return jnp.pad(y, pads, constant_values=pad_value)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y up to x's shape (reference: pad_constant_like_op.cc)."""
+    return _pad_like(ensure_tensor(x), ensure_tensor(y),
+                     pad_value=pad_value)
+
+
+@primitive(name="fsp_matrix")
+def _fsp(x, y):
+    """Flow-of-solution-procedure matrix (reference: fsp_op.cc —
+    distillation): [B, C1, H, W] x [B, C2, H, W] -> [B, C1, C2]."""
+    b, c1, h, w = x.shape
+    c2 = y.shape[1]
+    xf = x.reshape(b, c1, h * w)
+    yf = y.reshape(b, c2, h * w)
+    return jnp.einsum("bcm,bdm->bcd", xf, yf) / (h * w)
+
+
+def fsp_matrix(x, y):
+    return _fsp(ensure_tensor(x), ensure_tensor(y))
+
+
+@primitive(name="im2sequence")
+def _im2seq(x, filter_size=(1, 1), stride=(1, 1),
+            padding=((0, 0), (0, 0))):
+    """reference: im2sequence_op.cc — sliding blocks to sequence rows.
+    One fused patch-extraction op (same machinery as unfold), not a
+    Python loop over output positions."""
+    n, c, h, w = x.shape
+    fh, fw = filter_size
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (fh, fw), tuple(stride), padding=tuple(padding))
+    # [N, C*fh*fw, OH, OW] -> [N*OH*OW, C*fh*fw]
+    oh, ow = patches.shape[2], patches.shape[3]
+    return jnp.transpose(patches, (0, 2, 3, 1)).reshape(
+        n * oh * ow, c * fh * fw)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None,
+                input_image_size=None, out_stride=1):
+    if input_image_size is not None:
+        raise NotImplementedError(
+            "im2sequence: per-image input_image_size/out_stride (real-"
+            "size mode) is not implemented — pad to a uniform size "
+            "upstream")
+    fs = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    if isinstance(padding, int):
+        pd = ((padding, padding), (padding, padding))
+    elif len(padding) == 2:
+        pd = ((padding[0], padding[0]), (padding[1], padding[1]))
+    elif len(padding) == 4:
+        # reference order: [up, left, down, right]
+        pd = ((padding[0], padding[2]), (padding[1], padding[3]))
+    else:
+        raise ValueError(f"im2sequence: bad padding {padding!r}")
+    return _im2seq(ensure_tensor(input), filter_size=fs, stride=st,
+                   padding=pd)
+
+
+@primitive(name="hash_bucket", nondiff=(0,))
+def _hash_bucket(ids, hash_size=1, num_hash=1):
+    out = []
+    for i in range(num_hash):
+        mixed = (ids.astype(jnp.uint32) * jnp.uint32(2654435761)
+                 + jnp.uint32(i * 0x9E3779B9))
+        out.append((mixed % jnp.uint32(hash_size)).astype(jnp.int32))
+    return jnp.stack(out, axis=-1)
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """reference: hash_op.cc (xxhash mod table-size for sparse ids);
+    a multiplicative hash keeps the contract (deterministic bucketing)."""
+    return _hash_bucket(ensure_tensor(input), hash_size=hash_size,
+                        num_hash=num_hash)

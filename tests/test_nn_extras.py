@@ -442,3 +442,51 @@ def test_crf_loss_trains():
         [em, np.full((B, T, 2), -1e9, "float32")], axis=-1)
     _, path = F.viterbi_decode(paddle.to_tensor(em_pad), sq, lens)
     np.testing.assert_array_equal(path.numpy(), labels.numpy())
+
+
+def test_long_tail_functionals():
+    pe = F.add_position_encoding(
+        paddle.to_tensor(np.zeros((2, 4, 6), "float32")))
+    # position 0: sin(0)=0 for first half, cos(0)=1 for second half
+    np.testing.assert_allclose(pe.numpy()[0, 0, :3], 0.0, atol=1e-6)
+    np.testing.assert_allclose(pe.numpy()[0, 0, 3:], 1.0, atol=1e-6)
+
+    big = paddle.to_tensor(np.ones((2, 5), "float32"))
+    small = paddle.to_tensor(np.ones((1, 3), "float32"))
+    padded = F.pad_constant_like(big, small, pad_value=7.0)
+    assert padded.shape == [2, 5]
+    assert float(padded.numpy()[1, 4]) == 7.0
+
+    fsp = F.fsp_matrix(
+        paddle.to_tensor(np.ones((1, 2, 3, 3), "float32")),
+        paddle.to_tensor(np.ones((1, 4, 3, 3), "float32")))
+    np.testing.assert_allclose(fsp.numpy(), np.ones((1, 2, 4)),
+                               rtol=1e-6)
+
+    seq = F.im2sequence(
+        paddle.to_tensor(np.arange(16, dtype="float32")
+                         .reshape(1, 1, 4, 4)), filter_size=2, stride=2)
+    assert seq.shape == [4, 4]
+    np.testing.assert_array_equal(seq.numpy()[0], [0, 1, 4, 5])
+
+    h = F.hash(paddle.to_tensor(np.array([1, 2, 3], "int64")),
+               hash_size=100, num_hash=2)
+    assert h.shape == [3, 2]
+    assert (h.numpy() >= 0).all() and (h.numpy() < 100).all()
+    # deterministic
+    h2 = F.hash(paddle.to_tensor(np.array([1, 2, 3], "int64")),
+                hash_size=100, num_hash=2)
+    np.testing.assert_array_equal(h.numpy(), h2.numpy())
+
+
+def test_im2sequence_asymmetric_padding():
+    x = paddle.to_tensor(np.arange(16, dtype="float32")
+                         .reshape(1, 1, 4, 4))
+    # pad top only (reference order [up, left, down, right])
+    s = F.im2sequence(x, filter_size=2, stride=2,
+                      padding=[2, 0, 0, 0])
+    # height becomes 6 -> oh = 3
+    assert s.shape == [3 * 2, 4]
+    np.testing.assert_array_equal(s.numpy()[0], [0, 0, 0, 0])  # pad rows
+    with pytest.raises(NotImplementedError):
+        F.im2sequence(x, filter_size=2, input_image_size=x)
