@@ -373,8 +373,12 @@ def _pad_like(x, y, pad_value=0.0):
 
 def pad_constant_like(x, y, pad_value=0.0, name=None):
     """Pad y up to x's shape (reference: pad_constant_like_op.cc)."""
-    return _pad_like(ensure_tensor(x), ensure_tensor(y),
-                     pad_value=pad_value)
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if any(int(xs) < int(ys) for xs, ys in zip(x.shape, y.shape)):
+        raise ValueError(
+            f"pad_constant_like requires x.shape >= y.shape elementwise, "
+            f"got x {x.shape} vs y {y.shape}")
+    return _pad_like(x, y, pad_value=pad_value)
 
 
 @primitive(name="fsp_matrix")
@@ -435,8 +439,9 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, name=None,
 def _hash_bucket(ids, hash_size=1, num_hash=1):
     out = []
     for i in range(num_hash):
+        salt = (i * 0x9E3779B9) & 0xFFFFFFFF
         mixed = (ids.astype(jnp.uint32) * jnp.uint32(2654435761)
-                 + jnp.uint32(i * 0x9E3779B9))
+                 + jnp.uint32(salt))
         out.append((mixed % jnp.uint32(hash_size)).astype(jnp.int32))
     return jnp.stack(out, axis=-1)
 

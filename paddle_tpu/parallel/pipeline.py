@@ -68,7 +68,7 @@ def _run_stage(template_block, pnames, stage_params, x, training):
 
 
 def build_pipeline_fn(pipe_layer, num_microbatches, mesh=None,
-                      training=True, axis="pp"):
+                      training=True, axis="pp", use_recompute=False):
     """Returns a pure fn(pre_params, block_stacked, post_params, buffers,
     x_global, labels_or_None, key) -> stacked per-microbatch outputs.
 
@@ -80,6 +80,16 @@ def build_pipeline_fn(pipe_layer, num_microbatches, mesh=None,
     template = pipe_layer.blocks[0]
     pnames = [n for n, _ in template.named_parameters()]
     M = num_microbatches
+    run_stage = _run_stage
+    if use_recompute:
+        # remat each pipeline tick: backward recomputes the stage forward
+        # instead of storing M+P-1 ticks of activations (the GPipe memory
+        # fix the reference gets from RecomputeOptimizer stacking)
+        def run_stage(template, pnames, stage_params, x, training):
+            fn = jax.checkpoint(
+                lambda sp, xx: _run_stage(template, pnames, sp, xx,
+                                          training))
+            return fn(stage_params, x)
 
     def pipeline_core(stage_params, h_mbs):
         """Inside shard_map: stage_params leaves [bps, ...] (this stage's
@@ -98,7 +108,7 @@ def build_pipeline_fn(pipe_layer, num_microbatches, mesh=None,
             feed = lax.dynamic_index_in_dim(h_mbs, feed_idx, axis=0,
                                             keepdims=False)
             inp = jnp.where(stage == 0, feed, carry)
-            act = _run_stage(template, pnames, stage_params, inp, training)
+            act = run_stage(template, pnames, stage_params, inp, training)
             # collect at the LAST stage for ticks t in [n-1, n-1+M)
             write_idx = jnp.clip(t - (n - 1), 0, M - 1)
             updated = lax.dynamic_update_index_in_dim(

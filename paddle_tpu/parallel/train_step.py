@@ -177,9 +177,10 @@ class TrainStep:
             M = int(self.strategy.pipeline_configs.get(
                 "accumulate_steps", 1))
         self.num_microbatches = max(M, 1)
+        use_remat = bool(self.strategy and self.strategy.recompute)
         self.pipe_fn, _ = build_pipeline_fn(
             model, self.num_microbatches, mesh=self.mesh,
-            training=self.training)
+            training=self.training, use_recompute=use_remat)
         # one flat param tree for the optimizer
         self.params = {"pre": self.pre_params, "block": self.block_params,
                        "post": self.post_params}

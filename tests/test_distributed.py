@@ -470,3 +470,37 @@ class TestGradientMerge:
         step_b.sync_to_layer()
         np.testing.assert_allclose(net_a[0].weight.numpy(),
                                    net_b[0].weight.numpy(), rtol=1e-4)
+
+
+def test_pipeline_recompute_matches_plain():
+    """Per-tick remat must not change pipeline numerics (only memory)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.models.gpt import gpt_pipe_model
+    from paddle_tpu.parallel.train_step import TrainStep
+
+    mesh = dist.build_mesh(pp=2, dp=4)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 128, (8, 16 + 1)).astype(np.int32)
+    x, y = ids[:, :-1], ids[:, 1:]
+
+    losses = {}
+    for remat in (False, True):
+        paddle.seed(0)
+        model = gpt_pipe_model("tiny", dropout=0.0)
+        strategy = DistributedStrategy()
+        strategy.pipeline = True
+        strategy.pipeline_configs = {"accumulate_steps": 2}
+        strategy.recompute = remat
+        from paddle_tpu.models import GPTPretrainingCriterion
+        step = TrainStep(model, optimizer.SGD(
+            learning_rate=0.1, parameters=model.parameters()),
+            loss_fn=GPTPretrainingCriterion(), strategy=strategy,
+            mesh=mesh)
+        vals = [float(step.step([x], [y]).numpy()) for _ in range(3)]
+        losses[remat] = vals
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+    assert losses[False][-1] < losses[False][0]

@@ -490,3 +490,13 @@ def test_im2sequence_asymmetric_padding():
     np.testing.assert_array_equal(s.numpy()[0], [0, 0, 0, 0])  # pad rows
     with pytest.raises(NotImplementedError):
         F.im2sequence(x, filter_size=2, input_image_size=x)
+
+
+def test_hash_many_and_pad_like_validation():
+    h = F.hash(paddle.to_tensor(np.array([1, 2, 3], "int64")),
+               hash_size=50, num_hash=4)   # was OverflowError for >= 3
+    assert h.shape == [3, 4]
+    with pytest.raises(ValueError):
+        F.pad_constant_like(
+            paddle.to_tensor(np.ones((2, 3), "float32")),
+            paddle.to_tensor(np.ones((3, 2), "float32")))
