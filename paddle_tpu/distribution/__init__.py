@@ -205,3 +205,58 @@ class Categorical(Distribution):
 def kl_divergence(p: Distribution, q: Distribution):
     """paddle.distribution.kl_divergence(p, q)."""
     return p.kl_divergence(q)
+
+
+class MultivariateNormalDiag:
+    """reference: distribution.py MultivariateNormalDiag (loc + diagonal
+    scale)."""
+
+    def __init__(self, loc, scale):
+        from ..core.dispatch import ensure_tensor
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)  # diagonal entries [..., D, D]
+
+    def _diag(self):
+        import jax.numpy as jnp
+        return jnp.diagonal(self.scale._data, axis1=-2, axis2=-1)
+
+    def sample(self, shape=()):
+        import jax.numpy as jnp
+        from ..core import rng as rng_mod
+        from ..core.tensor import Tensor
+        import jax
+        d = self._diag()
+        eps = jax.random.normal(
+            rng_mod.next_key(), tuple(shape) + self.loc._data.shape)
+        return Tensor(self.loc._data + eps * d)
+
+    def entropy(self):
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        import math
+        d = self._diag()
+        k = d.shape[-1]
+        return Tensor(0.5 * k * (1.0 + math.log(2 * math.pi))
+                      + jnp.sum(jnp.log(d), axis=-1))
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+        from ..core.dispatch import ensure_tensor
+        from ..core.tensor import Tensor
+        import math
+        v = ensure_tensor(value)._data
+        d = self._diag()
+        z = (v - self.loc._data) / d
+        k = d.shape[-1]
+        return Tensor(-0.5 * jnp.sum(z * z, -1)
+                      - jnp.sum(jnp.log(d), -1)
+                      - 0.5 * k * math.log(2 * math.pi))
+
+    def kl_divergence(self, other):
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        d1, d2 = self._diag(), other._diag()
+        mu = self.loc._data - other.loc._data
+        return Tensor(0.5 * jnp.sum(
+            (d1 / d2) ** 2 + (mu / d2) ** 2 - 1
+            + 2 * (jnp.log(d2) - jnp.log(d1)), axis=-1))

@@ -12,7 +12,8 @@ from ..nn import ParamAttr  # noqa: F401
 from ..core.tensor import Tensor, to_tensor  # noqa: F401
 from ..distributed.parallel import DataParallel, ParallelEnv  # noqa: F401
 from ..jit import to_static as declarative  # noqa: F401
-from ..jit import ProgramTranslator  # noqa: F401
+from ..jit import ProgramTranslator, TracedLayer  # noqa: F401
+from ..optimizer.lr import LearningRateDecay  # noqa: F401
 
 
 @contextlib.contextmanager

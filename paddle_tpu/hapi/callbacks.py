@@ -175,3 +175,93 @@ class CallbackList:
             for c in self.callbacks:
                 getattr(c, name)(*args, **kwargs)
         return call
+
+
+class ReduceLROnPlateau(Callback):
+    """reference: hapi/callbacks.py ReduceLROnPlateau — shrink LR when the
+    monitored metric stops improving."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        lower_better = mode == "min" or (mode == "auto"
+                                         and "acc" not in monitor)
+        self._better = ((lambda a, b: a < b - min_delta) if lower_better
+                        else (lambda a, b: a > b + min_delta))
+        self._best = None
+        self._wait = 0
+        self._cooldown_left = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur)
+        if self._best is None or self._better(cur, self._best):
+            self._best = cur
+            self._wait = 0
+            return
+        if self._cooldown_left > 0:
+            # patience accounting pauses while the reduced LR takes effect
+            self._cooldown_left -= 1
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = self.model._optimizer
+            old = float(opt.get_lr())
+            new = max(old * self.factor, self.min_lr)
+            if new < old:
+                opt.set_lr(new)
+                if self.verbose:
+                    print(f"Epoch {epoch}: ReduceLROnPlateau reducing "
+                          f"learning rate to {new}.")
+            self._cooldown_left = self.cooldown
+            self._wait = 0
+
+
+class VisualDL(Callback):
+    """reference: hapi/callbacks.py VisualDLCallback.  The visualdl
+    package is not vendored; scalars stream to JSON-lines under log_dir
+    (one record per step/epoch), which its UI and any reader can ingest."""
+
+    def __init__(self, log_dir="./log"):
+        self.log_dir = log_dir
+        self._fh = None
+        self._step = 0
+
+    def on_train_begin(self, logs=None):
+        import os
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._fh = open(os.path.join(self.log_dir, "scalars.jsonl"), "a")
+
+    def _write(self, tag, logs):
+        import json as _json
+        if not self._fh or not logs:
+            return
+        rec = {"step": self._step, "tag": tag}
+        rec.update({k: float(v) for k, v in logs.items()
+                    if isinstance(v, (int, float))})
+        self._fh.write(_json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self._step % 10 == 0:
+            self._write("train", logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._write("epoch", logs)
+
+    def on_train_end(self, logs=None):
+        if self._fh:
+            self._fh.close()
+            self._fh = None

@@ -359,3 +359,31 @@ class ProgramTranslator:
 
 def enable_to_static(flag=True):
     ProgramTranslator().enable(flag)
+
+
+class TracedLayer:
+    """reference: fluid/dygraph/jit.py TracedLayer — trace(layer, inputs)
+    returns a compiled callable + save_inference_model."""
+
+    def __init__(self, layer, inputs):
+        self._layer = layer
+        self._static = StaticFunction(layer)
+        self._example = inputs
+
+    @classmethod
+    def trace(cls, layer, inputs):
+        traced = cls(layer, inputs)
+        outs = traced(*inputs)
+        return (outs if isinstance(outs, (list, tuple)) else [outs],
+                traced)
+
+    def __call__(self, *args):
+        return self._static(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        if feed is not None or fetch is not None:
+            raise NotImplementedError(
+                "TracedLayer.save_inference_model: feed/fetch subset "
+                "selection is not supported — the full traced signature "
+                "is exported")
+        save(self._layer, path, input_spec=list(self._example))

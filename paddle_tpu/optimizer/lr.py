@@ -277,3 +277,7 @@ class ReduceOnPlateau(LRScheduler):
             self.last_lr = new_lr
             self.cooldown_counter = self.cooldown
             self.num_bad = 0
+
+
+# 1.x alias (reference: fluid/dygraph/learning_rate_scheduler.py base)
+LearningRateDecay = LRScheduler

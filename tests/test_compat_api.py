@@ -191,3 +191,103 @@ def test_data_feeder():
     batch = feeder.feed([(np.ones(3), 0), (np.zeros(3), 1)])
     assert batch["x"].shape == (2, 3)
     assert list(batch["y"]) == [0, 1]
+
+
+def test_reduce_lr_on_plateau_callback():
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+    net = nn.Linear(2, 1)
+    opt = optimizer.SGD(learning_rate=1.0, parameters=net.parameters())
+
+    class FakeModel:
+        _optimizer = opt
+
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                           verbose=0)
+    cb.model = FakeModel()
+    cb.on_epoch_end(0, {"loss": 1.0})
+    cb.on_epoch_end(1, {"loss": 1.0})   # wait 1
+    cb.on_epoch_end(2, {"loss": 1.0})   # wait 2 -> reduce
+    assert opt.get_lr() == pytest.approx(0.5)
+    cb.on_epoch_end(3, {"loss": 0.2})   # improvement resets
+    cb.on_epoch_end(4, {"loss": 0.2})
+    assert opt.get_lr() == pytest.approx(0.5)
+
+
+def test_visualdl_callback(tmp_path):
+    import json
+    from paddle_tpu.hapi.callbacks import VisualDL
+    cb = VisualDL(log_dir=str(tmp_path))
+    cb.on_train_begin()
+    for i in range(10):
+        cb.on_train_batch_end(i, {"loss": 1.0 - i * 0.01})
+    cb.on_epoch_end(0, {"loss": 0.9, "acc": 0.5})
+    cb.on_train_end()
+    lines = [json.loads(l) for l in
+             open(tmp_path / "scalars.jsonl").read().splitlines()]
+    assert any(r["tag"] == "train" for r in lines)
+    assert any(r["tag"] == "epoch" and r["acc"] == 0.5 for r in lines)
+
+
+def test_multivariate_normal_diag():
+    import math
+    from paddle_tpu.distribution import MultivariateNormalDiag
+    loc = paddle.to_tensor(np.zeros(3, "float32"))
+    scale = paddle.to_tensor(np.diag([1.0, 2.0, 0.5]).astype("float32"))
+    d = MultivariateNormalDiag(loc, scale)
+    s = d.sample([100])
+    assert s.shape == [100, 3]
+    lp = float(d.log_prob(paddle.to_tensor(np.zeros(3, "float32"))).numpy())
+    expect = -0.5 * 3 * math.log(2 * math.pi) - math.log(1 * 2 * 0.5)
+    assert lp == pytest.approx(expect, rel=1e-5)
+    ent = float(d.entropy().numpy())
+    assert ent == pytest.approx(
+        0.5 * 3 * (1 + math.log(2 * math.pi)) + math.log(1.0),
+        rel=1e-5)
+
+
+def test_traced_layer(tmp_path):
+    from paddle_tpu import nn
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    outs, traced = paddle.jit.TracedLayer.trace(net, [x])
+    np.testing.assert_allclose(outs[0].numpy(), net(x).numpy(),
+                               rtol=1e-5)
+    traced.save_inference_model(str(tmp_path / "traced"))
+    loaded = paddle.jit.load(str(tmp_path / "traced"))
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                               rtol=1e-5)
+
+
+def test_learning_rate_decay_alias():
+    from paddle_tpu.optimizer.lr import LearningRateDecay, LRScheduler
+    assert LearningRateDecay is LRScheduler
+
+
+def test_reduce_lr_cooldown_pauses_patience():
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+    net = nn.Linear(2, 1)
+    opt = optimizer.SGD(learning_rate=1.0, parameters=net.parameters())
+
+    class FakeModel:
+        _optimizer = opt
+
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                           cooldown=3, verbose=0)
+    cb.model = FakeModel()
+    for epoch in range(5):   # constant loss, never improves
+        cb.on_epoch_end(epoch, {"loss": 1.0})
+    # epoch0 sets best; epoch1 reduces (patience 1); epochs 2-4 drain the
+    # 3-epoch cooldown with NO further reduction
+    assert opt.get_lr() == pytest.approx(0.5)
+    cb.on_epoch_end(5, {"loss": 1.0})    # cooldown over: reduces again
+    assert opt.get_lr() == pytest.approx(0.25)
+
+
+def test_fluid_dygraph_one_x_exports():
+    import paddle_tpu.fluid as fluid
+    assert hasattr(fluid.dygraph, "TracedLayer")
+    assert hasattr(fluid.dygraph, "LearningRateDecay")
