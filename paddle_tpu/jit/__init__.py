@@ -130,9 +130,30 @@ class StaticFunction:
             if self._layer is not None:
                 return self._layer.forward(*args, **kwargs)
             return self._fn(*args, **kwargs)
-        if self._layer is None:
-            return self._call_function(*args, **kwargs)
-        return self._call_layer(*args, **kwargs)
+        try:
+            if self._layer is None:
+                return self._call_function(*args, **kwargs)
+            return self._call_layer(*args, **kwargs)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.TracerIntegerConversionError) as e:
+            # the reference rewrites `if tensor:` via AST transforms; the
+            # TPU build asks for explicit structured control flow instead
+            raise TypeError(
+                "@to_static: this forward uses a Tensor's VALUE in Python "
+                "control flow (`if`/`while`/`range`/indexing), which "
+                "cannot be traced. Rewrite the branch with "
+                "paddle.static.nn.cond / while_loop (lowered to "
+                "lax.cond/lax.while_loop), or run eagerly via "
+                "paddle.jit.enable_to_static(False). "
+                "(reference: dygraph_to_static AST transformers)") from e
+        except jax.errors.TracerArrayConversionError as e:
+            raise TypeError(
+                "@to_static: this forward converts a Tensor to a host "
+                "value (numpy()/item()/bool()) mid-trace. Remove the host "
+                "conversion from the compiled path — or, if it implements "
+                "value-dependent control flow, use paddle.static.nn.cond "
+                "/ while_loop; to debug eagerly call "
+                "paddle.jit.enable_to_static(False).") from e
 
     # plain function path
     def _call_function(self, *args, **kwargs):

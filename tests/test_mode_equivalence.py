@@ -150,3 +150,20 @@ def test_grad_equivalence_eager_vs_jax_grad():
     jax_grad = jax.grad(f)(jnp.asarray(x))
     np.testing.assert_allclose(tape_grad, np.asarray(jax_grad),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_to_static_value_branch_gives_helpful_error():
+    class Branchy(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 1)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.sum() > 0:          # value-dependent Python branch
+                return h * 2
+            return h
+
+    sf = paddle.jit.to_static(Branchy())
+    with pytest.raises(TypeError, match="cond"):
+        sf(paddle.to_tensor(np.ones((2, 4), "float32")))

@@ -255,3 +255,65 @@ class TestNNFunctionalSweep:
         x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
         out = F.unfold(paddle_tpu.to_tensor(x), kernel_sizes=2)
         assert out.shape == [1, 4, 9]
+
+
+class TestNNGradSweep:
+    """Finite-difference grad checks for the structured nn ops
+    (reference: per-op OpTest check_grad)."""
+
+    def test_conv2d_grad(self):
+        import paddle_tpu.nn.functional as F
+        x = R.rand(2, 2, 6, 6).astype("float32")
+        w = R.rand(3, 2, 3, 3).astype("float32")
+        check_grad(lambda a, b: F.conv2d(a, b, padding=1), [x, w],
+                   wrt=0, rtol=2e-2, atol=2e-3)
+        check_grad(lambda a, b: F.conv2d(a, b, padding=1), [x, w],
+                   wrt=1, rtol=2e-2, atol=2e-3)
+
+    def test_avg_pool_grad(self):
+        import paddle_tpu.nn.functional as F
+        x = R.rand(1, 2, 4, 4).astype("float32")
+        check_grad(lambda a: F.avg_pool2d(a, kernel_size=2), [x],
+                   rtol=2e-2, atol=2e-3)
+
+    def test_max_pool_grad(self):
+        import paddle_tpu.nn.functional as F
+        # distinct values so the argmax is stable under the fd delta
+        x = (np.arange(32, dtype="float32").reshape(1, 2, 4, 4) * 0.37
+             + R.rand(1, 2, 4, 4) * 1e-3)
+        check_grad(lambda a: F.max_pool2d(a, kernel_size=2), [x],
+                   rtol=2e-2, atol=2e-3)
+
+    def test_layer_norm_grad(self):
+        import paddle_tpu.nn.functional as F
+        x = R.rand(3, 8).astype("float32")
+        check_grad(lambda a: F.layer_norm(a, [8]), [x], rtol=2e-2,
+                   atol=2e-3)
+
+    def test_embedding_grad_scatters(self):
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu
+        w = paddle_tpu.to_tensor(R.rand(6, 4).astype("float32"),
+                                 stop_gradient=False)
+        ids = paddle_tpu.to_tensor(np.array([1, 1, 3], "int64"))
+        out = F.embedding(ids, w)
+        out.sum().backward()
+        g = w.grad.numpy()
+        np.testing.assert_allclose(g[1], 2.0)   # row hit twice
+        np.testing.assert_allclose(g[3], 1.0)
+        np.testing.assert_allclose(g[0], 0.0)
+
+    def test_softmax_ce_grad(self):
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu
+        logits = R.rand(4, 5).astype("float32")
+        labels = np.array([0, 2, 1, 4], "int64")
+        t = paddle_tpu.to_tensor(logits, stop_gradient=False)
+        loss = F.cross_entropy(t, paddle_tpu.to_tensor(labels))
+        loss.backward()
+        # analytic: (softmax - onehot) / batch
+        p = np.exp(logits - logits.max(1, keepdims=True))
+        p = p / p.sum(1, keepdims=True)
+        onehot = np.eye(5)[labels]
+        np.testing.assert_allclose(t.grad.numpy(), (p - onehot) / 4,
+                                   rtol=1e-4, atol=1e-5)
