@@ -1,0 +1,20 @@
+"""KV-cached autoregressive generation (greedy and top-k sampling)."""
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTModel
+
+
+def main():
+    paddle.seed(0)
+    model = GPTModel.from_config("tiny", dropout=0.0)
+    model.eval()
+    prompt = np.array([[1, 5, 9, 2]], np.int32)
+    greedy = model.generate(paddle.to_tensor(prompt), max_new_tokens=12)
+    sampled = model.generate(paddle.to_tensor(prompt), max_new_tokens=12,
+                             temperature=0.8, top_k=10, seed=42)
+    print("greedy :", greedy.numpy()[0].tolist())
+    print("sampled:", sampled.numpy()[0].tolist())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Declarative (static graph) mode: build a Program, train with the
+Executor, export the inference subgraph as a StableHLO artifact."""
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import static, optimizer
+
+
+def main():
+    paddle.enable_static()
+    main_prog = static.Program()
+    with static.program_guard(main_prog):
+        x = static.data("x", [32, 16])
+        y = static.data("y", [32, 1])
+        h = static.nn.fc(x, 64, activation="relu")
+        pred = static.nn.fc(h, 1)
+        loss = paddle.mean((pred - y) ** 2)
+        optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+        exe = static.Executor()
+        rng = np.random.RandomState(0)
+        xv = rng.rand(32, 16).astype("float32")
+        yv = (xv @ rng.rand(16, 1)).astype("float32")
+        for it in range(100):
+            lv, = exe.run(main_prog, feed={"x": xv, "y": yv},
+                          fetch_list=[loss])
+            if it % 20 == 0:
+                print(f"iter {it} loss {float(lv):.5f}")
+
+        static.save_inference_model("/tmp/static_model", [x], [pred], exe)
+    paddle.disable_static()
+
+    # reload and serve
+    from paddle_tpu import inference
+    predictor = inference.create_predictor(
+        inference.Config("/tmp/static_model.pdmodel"))
+    out, = predictor.run([xv])
+    print("served output shape:", out.shape)
+
+
+if __name__ == "__main__":
+    main()

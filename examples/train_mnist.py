@@ -1,0 +1,39 @@
+"""MNIST two ways: an eager (dygraph) loop, then Model.fit."""
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def main():
+    paddle.seed(0)
+    # offline-friendly: vision datasets fall back to synthetic samples
+    from paddle_tpu.vision.datasets import MNIST
+    train = MNIST(mode="train")
+
+    net = nn.Sequential(nn.Flatten(), nn.Linear(784, 256), nn.ReLU(),
+                        nn.Linear(256, 10))
+    opt = optimizer.Adam(learning_rate=1e-3,
+                         parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+
+    # -- eager loop ----------------------------------------------------
+    loader = paddle.io.DataLoader(train, batch_size=64, shuffle=True)
+    for step, (img, label) in enumerate(loader):
+        loss = loss_fn(net(img), label)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if step % 50 == 0:
+            print(f"step {step} loss {float(loss.numpy()):.4f}")
+        if step >= 200:
+            break
+
+    # -- or the high-level API (compiled train step under the hood) ----
+    model = paddle.Model(net)
+    model.prepare(opt, loss_fn, paddle.metric.Accuracy())
+    model.fit(train, epochs=1, batch_size=64, verbose=1)
+    paddle.save(net.state_dict(), "/tmp/mnist.pdparams")
+
+
+if __name__ == "__main__":
+    main()

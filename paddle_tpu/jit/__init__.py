@@ -134,26 +134,29 @@ class StaticFunction:
             if self._layer is None:
                 return self._call_function(*args, **kwargs)
             return self._call_layer(*args, **kwargs)
-        except (jax.errors.TracerBoolConversionError,
-                jax.errors.TracerIntegerConversionError) as e:
-            # the reference rewrites `if tensor:` via AST transforms; the
-            # TPU build asks for explicit structured control flow instead
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError) as e:
+            # every tracer->host concretization failure: bool/int paths
+            # subclass ConcretizationTypeError; the numpy()/__array__
+            # path (which Tensor.__bool__ funnels through) raises
+            # TracerArrayConversionError, a sibling in jax's hierarchy.
+            # The reference rewrites such code via AST transforms; the
+            # TPU build asks for explicit structured control flow.
+            if isinstance(e, jax.errors.TracerArrayConversionError):
+                detail = ("converts a Tensor to a host value "
+                          "(numpy()/item()/bool()) mid-trace — often a "
+                          "Python `if`/`while` on a Tensor's value")
+            else:
+                detail = ("uses a Tensor's VALUE in Python control flow "
+                          "(`if`/`while`/`range`/indexing)")
             raise TypeError(
-                "@to_static: this forward uses a Tensor's VALUE in Python "
-                "control flow (`if`/`while`/`range`/indexing), which "
-                "cannot be traced. Rewrite the branch with "
+                f"@to_static: this forward {detail}, which cannot be "
+                "traced. For value-dependent control flow use "
                 "paddle.static.nn.cond / while_loop (lowered to "
-                "lax.cond/lax.while_loop), or run eagerly via "
+                "lax.cond/lax.while_loop); remove stray host conversions "
+                "from the compiled path; or debug eagerly via "
                 "paddle.jit.enable_to_static(False). "
                 "(reference: dygraph_to_static AST transformers)") from e
-        except jax.errors.TracerArrayConversionError as e:
-            raise TypeError(
-                "@to_static: this forward converts a Tensor to a host "
-                "value (numpy()/item()/bool()) mid-trace. Remove the host "
-                "conversion from the compiled path — or, if it implements "
-                "value-dependent control flow, use paddle.static.nn.cond "
-                "/ while_loop; to debug eagerly call "
-                "paddle.jit.enable_to_static(False).") from e
 
     # plain function path
     def _call_function(self, *args, **kwargs):
