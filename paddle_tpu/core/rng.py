@@ -14,7 +14,10 @@ import jax
 
 _lock = threading.Lock()
 _seed = 0
-_key = jax.random.key(0)
+# created lazily: building a key runs a jit computation, and importing the
+# package must not initialize the jax backend (embedded/C-API callers select
+# the platform after import)
+_key = None
 
 
 def seed(s: int):
@@ -56,6 +59,8 @@ def next_key():
         return jax.random.fold_in(entry[0], entry[1])
     global _key
     with _lock:
+        if _key is None:
+            _key = jax.random.key(_seed)
         _key, sub = jax.random.split(_key)
     return sub
 

@@ -1,0 +1,369 @@
+// C inference API over an embedded CPython interpreter.
+//
+// Reference parity: paddle/fluid/inference/capi/ (pd_config.cc,
+// pd_predictor.cc, pd_tensor.cc).  There the C API wraps the C++
+// AnalysisPredictor directly; here the predictor lives in Python (the
+// framework's single execution engine is XLA behind the Python API), so the
+// C layer embeds CPython once per process and forwards through
+// paddle_tpu.inference.capi_bridge.  All Python objects are confined to
+// this file; callers see only plain C buffers.
+
+#include "paddle_capi.h"
+
+#include <Python.h>
+
+#include <dlfcn.h>
+
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::mutex g_mu;
+std::string g_last_error;
+PyObject* g_bridge = nullptr;  // capi_bridge module, owned
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+// Record the active Python exception into g_last_error and clear it.
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+// Directory containing this shared library -> repo root is two levels up
+// (paddle_tpu/csrc/libpaddle_capi.so).
+std::string repo_root() {
+  Dl_info info;
+  if (dladdr(reinterpret_cast<void*>(&repo_root), &info) && info.dli_fname) {
+    std::string p(info.dli_fname);
+    for (int i = 0; i < 3; ++i) {  // strip lib name, csrc, paddle_tpu
+      auto pos = p.rfind('/');
+      if (pos == std::string::npos) break;
+      p.erase(pos);
+    }
+    if (!p.empty()) return p;
+  }
+  return ".";
+}
+
+// Initialize the interpreter and import the bridge.  Returns false (with
+// g_last_error set) on failure.  Caller holds g_mu.
+bool ensure_python() {
+  if (g_bridge) return true;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // Release the GIL so PyGILState_Ensure works from any caller thread.
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  bool ok = false;
+  do {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    if (!sys_path) {
+      set_error("sys.path unavailable");
+      break;
+    }
+    const char* env_root = std::getenv("PADDLE_TPU_ROOT");
+    std::string root = env_root ? env_root : repo_root();
+    PyObject* root_s = PyUnicode_FromString(root.c_str());
+    if (root_s) {
+      PyList_Insert(sys_path, 0, root_s);
+      Py_DECREF(root_s);
+    }
+    g_bridge = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+    if (!g_bridge) {
+      set_error_from_python();
+      break;
+    }
+    ok = true;
+  } while (false);
+  PyGILState_Release(gil);
+  return ok;
+}
+
+// Call bridge.<fn>(*args) with the GIL held; returns new ref or null.
+PyObject* bridge_call(const char* fn, PyObject* args) {
+  PyObject* f = PyObject_GetAttrString(g_bridge, fn);
+  if (!f) {
+    Py_XDECREF(args);
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* result = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (!result) set_error_from_python();
+  return result;
+}
+
+struct OutputBuffer {
+  PyObject* bytes = nullptr;  // owns the data
+  std::vector<int64_t> shape;
+  PD_DataType dtype = PD_FLOAT32;
+};
+
+}  // namespace
+
+struct PD_Config {
+  std::string model_path;
+  std::string params_path;
+};
+
+struct PD_Predictor {
+  long handle = 0;
+  std::vector<std::string> input_names;
+  std::vector<std::string> output_names;
+  std::map<std::string, OutputBuffer> outputs;
+};
+
+extern "C" {
+
+PD_Config* PD_NewConfig(void) { return new PD_Config(); }
+
+void PD_DeleteConfig(PD_Config* config) { delete config; }
+
+void PD_ConfigSetModel(PD_Config* config, const char* model_path,
+                       const char* params_path) {
+  if (!config) return;
+  config->model_path = model_path ? model_path : "";
+  config->params_path = params_path ? params_path : "";
+}
+
+static bool fill_names(PD_Predictor* pred) {
+  const struct {
+    const char* fn;
+    std::vector<std::string>* out;
+  } jobs[] = {{"input_names", &pred->input_names},
+              {"output_names", &pred->output_names}};
+  for (const auto& job : jobs) {
+    PyObject* names =
+        bridge_call(job.fn, Py_BuildValue("(l)", pred->handle));
+    if (!names) return false;
+    job.out->clear();
+    Py_ssize_t n = PySequence_Size(names);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* item = PySequence_GetItem(names, i);
+      const char* c = item ? PyUnicode_AsUTF8(item) : nullptr;
+      // keep index alignment even on a bad entry, and never leave a
+      // pending exception behind this frame
+      job.out->push_back(c ? c : "");
+      if (!c) PyErr_Clear();
+      Py_XDECREF(item);
+    }
+    Py_DECREF(names);
+  }
+  return true;
+}
+
+// Drop the bridge-side predictor for a handle (used on error unwind).
+static void bridge_release(long handle) {
+  PyObject* r = bridge_call("delete_predictor", Py_BuildValue("(l)", handle));
+  Py_XDECREF(r);
+}
+
+PD_Predictor* PD_NewPredictor(const PD_Config* config) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!config || config->model_path.empty()) {
+    set_error("PD_NewPredictor: config with a model path is required");
+    return nullptr;
+  }
+  if (!ensure_python()) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PD_Predictor* pred = nullptr;
+  PyObject* h = bridge_call(
+      "new_predictor",
+      Py_BuildValue("(ss)", config->model_path.c_str(),
+                    config->params_path.c_str()));
+  if (h) {
+    pred = new PD_Predictor();
+    pred->handle = PyLong_AsLong(h);
+    Py_DECREF(h);
+    if (!fill_names(pred)) {
+      bridge_release(pred->handle);
+      delete pred;
+      pred = nullptr;
+    }
+  }
+  PyGILState_Release(gil);
+  return pred;
+}
+
+void PD_DeletePredictor(PD_Predictor* predictor) {
+  if (!predictor) return;
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (g_bridge) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    PyObject* r = bridge_call("delete_predictor",
+                              Py_BuildValue("(l)", predictor->handle));
+    Py_XDECREF(r);
+    for (auto& kv : predictor->outputs) Py_XDECREF(kv.second.bytes);
+    PyGILState_Release(gil);
+  }
+  delete predictor;
+}
+
+int PD_GetInputNum(const PD_Predictor* predictor) {
+  return predictor ? static_cast<int>(predictor->input_names.size()) : 0;
+}
+
+int PD_GetOutputNum(const PD_Predictor* predictor) {
+  return predictor ? static_cast<int>(predictor->output_names.size()) : 0;
+}
+
+const char* PD_GetInputName(const PD_Predictor* predictor, int index) {
+  if (!predictor || index < 0 ||
+      index >= static_cast<int>(predictor->input_names.size()))
+    return nullptr;
+  return predictor->input_names[index].c_str();
+}
+
+const char* PD_GetOutputName(const PD_Predictor* predictor, int index) {
+  if (!predictor || index < 0 ||
+      index >= static_cast<int>(predictor->output_names.size()))
+    return nullptr;
+  return predictor->output_names[index].c_str();
+}
+
+static int64_t dtype_size(PD_DataType dtype) {
+  switch (dtype) {
+    case PD_FLOAT32:
+    case PD_INT32:
+      return 4;
+    case PD_INT64:
+      return 8;
+    case PD_UINT8:
+      return 1;
+    case PD_FLOAT16:
+      return 2;
+  }
+  return 0;
+}
+
+int PD_SetInput(PD_Predictor* predictor, const char* name, const void* data,
+                const int64_t* shape, int ndim, PD_DataType dtype) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!predictor || !name || !data || (ndim > 0 && !shape)) {
+    set_error("PD_SetInput: null argument");
+    return -1;
+  }
+  int64_t elems = 1;
+  for (int i = 0; i < ndim; ++i) elems *= shape[i];
+  int64_t nbytes = elems * dtype_size(dtype);
+  if (nbytes <= 0) {
+    set_error("PD_SetInput: empty tensor or unknown dtype");
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* mv = PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)), nbytes, PyBUF_READ);
+  PyObject* shape_list = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyList_SetItem(shape_list, i, PyLong_FromLongLong(shape[i]));
+  if (mv && shape_list) {
+    PyObject* r = bridge_call(
+        "set_input", Py_BuildValue("(lsOOi)", predictor->handle, name, mv,
+                                   shape_list, static_cast<int>(dtype)));
+    if (r) {
+      rc = 0;
+      Py_DECREF(r);
+    }
+  } else {
+    set_error_from_python();
+  }
+  Py_XDECREF(mv);
+  Py_XDECREF(shape_list);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int PD_Run(PD_Predictor* predictor) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!predictor) {
+    set_error("PD_Run: null predictor");
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = bridge_call("run", Py_BuildValue("(l)", predictor->handle));
+  if (r) {
+    rc = 0;
+    Py_DECREF(r);
+    // run() may re-derive output names (n_fetch discovered at first run)
+    if (!fill_names(predictor)) rc = -1;
+    for (auto& kv : predictor->outputs) Py_XDECREF(kv.second.bytes);
+    predictor->outputs.clear();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int PD_GetOutput(PD_Predictor* predictor, const char* name,
+                 const void** data, const int64_t** shape, int* ndim,
+                 PD_DataType* dtype) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!predictor || !name || !data || !shape || !ndim || !dtype) {
+    set_error("PD_GetOutput: null argument");
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = bridge_call(
+      "get_output", Py_BuildValue("(ls)", predictor->handle, name));
+  if (r && PyTuple_Check(r) && PyTuple_Size(r) == 3) {
+    PyObject* bytes = PyTuple_GetItem(r, 0);       // borrowed
+    PyObject* shape_list = PyTuple_GetItem(r, 1);  // borrowed
+    PyObject* code = PyTuple_GetItem(r, 2);        // borrowed
+    OutputBuffer& buf = predictor->outputs[name];
+    Py_XDECREF(buf.bytes);
+    Py_INCREF(bytes);
+    buf.bytes = bytes;
+    buf.shape.clear();
+    Py_ssize_t n = PySequence_Size(shape_list);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* item = PySequence_GetItem(shape_list, i);
+      buf.shape.push_back(PyLong_AsLongLong(item));
+      Py_XDECREF(item);
+    }
+    buf.dtype = static_cast<PD_DataType>(PyLong_AsLong(code));
+    *data = PyBytes_AsString(buf.bytes);
+    *shape = buf.shape.data();
+    *ndim = static_cast<int>(buf.shape.size());
+    *dtype = buf.dtype;
+    rc = 0;
+  } else if (r) {
+    set_error("get_output returned unexpected value");
+  }
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+const char* PD_LastError(void) {
+  // copy under the lock into thread-local storage: writers reassign
+  // g_last_error under g_mu, so the pointer we hand out must not alias the
+  // shared string
+  thread_local std::string local;
+  std::lock_guard<std::mutex> lock(g_mu);
+  local = g_last_error;
+  return local.c_str();
+}
+
+}  // extern "C"

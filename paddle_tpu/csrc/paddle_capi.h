@@ -1,0 +1,76 @@
+/* C inference API.
+ *
+ * Reference parity: paddle/fluid/inference/capi/paddle_c_api.h
+ * (PD_NewAnalysisConfig / PD_NewPredictor / PD_PredictorRun surface).
+ * TPU-native: the predictor runs an exported artifact (StableHLO via
+ * paddle.jit.save or static.save_inference_model) through an embedded
+ * CPython interpreter; XLA is the optimization pipeline, so the config
+ * carries only the model/params paths.
+ *
+ * Build: make -C paddle_tpu/csrc libpaddle_capi.so
+ * Link:  -lpaddle_capi -lpython3.X (see Makefile `capi` target).
+ */
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+
+/* Matches capi_bridge._CODE_TO_DTYPE. */
+typedef enum PD_DataType {
+  PD_FLOAT32 = 0,
+  PD_INT64 = 1,
+  PD_INT32 = 2,
+  PD_UINT8 = 3,
+  PD_FLOAT16 = 4,
+} PD_DataType;
+
+/* All functions returning int use 0 = success, -1 = failure; call
+ * PD_LastError() for the message (valid until the next failing call). */
+
+PD_Config* PD_NewConfig(void);
+void PD_DeleteConfig(PD_Config* config);
+/* params_file may be NULL (single-artifact exports). */
+void PD_ConfigSetModel(PD_Config* config, const char* model_path,
+                       const char* params_path);
+
+PD_Predictor* PD_NewPredictor(const PD_Config* config);
+void PD_DeletePredictor(PD_Predictor* predictor);
+
+int PD_GetInputNum(const PD_Predictor* predictor);
+int PD_GetOutputNum(const PD_Predictor* predictor);
+const char* PD_GetInputName(const PD_Predictor* predictor, int index);
+const char* PD_GetOutputName(const PD_Predictor* predictor, int index);
+
+/* Copies `data` (row-major, `shape[0..ndim)` elements of `dtype`) into the
+ * named input slot. */
+int PD_SetInput(PD_Predictor* predictor, const char* name, const void* data,
+                const int64_t* shape, int ndim, PD_DataType dtype);
+
+int PD_Run(PD_Predictor* predictor);
+
+/* Fetches the named output.  *data / *shape point into predictor-owned
+ * storage valid until the next PD_GetOutput for the same name, the next
+ * PD_Run, or PD_DeletePredictor. */
+int PD_GetOutput(PD_Predictor* predictor, const char* name,
+                 const void** data, const int64_t** shape, int* ndim,
+                 PD_DataType* dtype);
+
+const char* PD_LastError(void);
+
+/* Reference-familiar aliases (paddle_c_api.h names). */
+#define PD_NewAnalysisConfig PD_NewConfig
+#define PD_DeleteAnalysisConfig PD_DeleteConfig
+#define PD_SetModel PD_ConfigSetModel
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_CAPI_H_ */

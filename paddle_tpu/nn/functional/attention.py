@@ -56,6 +56,13 @@ def _flash_available():
         return False
 
 
+# Flash engages at seq >= this (tunable; bench/perf experiments override).
+# Below it, XLA's fused naive path wins on TPU unless memory forces flash.
+FLASH_MIN_SEQ = 2048
+# block sizes for the pallas kernel; None = kernel defaults
+FLASH_BLOCK_SIZES = None
+
+
 def _flash_attention(q, k, v, mask, scale, is_causal):
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         flash_attention)
@@ -63,7 +70,11 @@ def _flash_attention(q, k, v, mask, scale, is_causal):
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
-    out = flash_attention(qh, kh, vh, causal=is_causal, sm_scale=scale)
+    kwargs = {}
+    if FLASH_BLOCK_SIZES is not None:
+        kwargs["block_sizes"] = FLASH_BLOCK_SIZES
+    out = flash_attention(qh, kh, vh, causal=is_causal, sm_scale=scale,
+                          **kwargs)
     return jnp.swapaxes(out, 1, 2)
 
 
@@ -77,7 +88,7 @@ def _sdpa(q, k, v, mask=None, scale=None, is_causal=False, use_flash=True):
     # TPU (measured: GPT-2 S=1024 trains ~1.7x faster via XLA than via the
     # pallas kernel, which pays layout transposes + bwd recompute).
     if (use_flash and mask is None and _flash_available()
-            and seq >= 2048 and seq % 128 == 0 and d % 64 == 0):
+            and seq >= FLASH_MIN_SEQ and seq % 128 == 0 and d % 64 == 0):
         return _flash_attention(q, k, v, mask, scale, is_causal)
     return _reference_attention(q, k, v, mask, scale, is_causal)
 
