@@ -191,13 +191,22 @@ reduce_prod = _reduce("prod")
 
 # ---- in-place variants (dygraph inplace API) ------------------------------
 
-def _inplace(fn_name):
+def _inplace(fn_name, fn=None):
+    """Build an in-place variant that keeps the autograd chain intact:
+    the op consumes a snapshot of x's graph identity and x adopts the
+    result's node (core/autograd.py snapshot_for_inplace/adopt_result),
+    so backward applies the op's VJP instead of an identity."""
     def op(x, *args, **kwargs):
         from .. import ops as O
-        res = getattr(O, fn_name)(x, *args, **kwargs)
-        x._data = res._data
+        from ..core import autograd
+        from ..core.dispatch import ensure_tensor
+        x = ensure_tensor(x)
+        f = fn or getattr(O, fn_name)
+        old = autograd.snapshot_for_inplace(x)
+        res = f(old, *args, **kwargs)
+        autograd.adopt_result(x, res)
         return x
-    op.__name__ = fn_name + "_"
+    op.__name__ = fn_name + "_" if fn is None else fn_name
     return op
 
 
