@@ -135,11 +135,18 @@ def build_train_step(model, optimizer, loss_fn=None, strategy=None,
     the strategy flags pick which step builder handles the program.
     """
     from ...parallel.train_step import TrainStep
-    from .meta_optimizers import LocalSGDStep, DGCStep, FP16AllReduceStep
+    from .meta_optimizers import (LocalSGDStep, AdaptiveLocalSGDStep,
+                                  DGCStep, FP16AllReduceStep)
     strategy = strategy or _fleet_state["strategy"] or DistributedStrategy()
     if isinstance(optimizer, DistributedOptimizer):
         optimizer = optimizer.inner_opt
     mesh = kwargs.pop("mesh", None)
+    if getattr(strategy, "adaptive_localsgd", False):
+        cfg = strategy.adaptive_localsgd_configs
+        return AdaptiveLocalSGDStep(
+            model, optimizer, loss_fn=loss_fn, mesh=mesh,
+            init_k_steps=cfg.get("init_k_steps", 1),
+            begin_step=cfg.get("begin_step", 1))
     if strategy.localsgd:
         return LocalSGDStep(model, optimizer, loss_fn=loss_fn, mesh=mesh,
                             k_steps=strategy.localsgd_configs.get(

@@ -132,7 +132,7 @@ class _PerRankStep:
 class LocalSGDStep(_PerRankStep):
     """k local optimizer steps per rank, then parameter averaging
     (reference: localsgd_optimizer.py LocalSGDOptimizer; the adaptive
-    variant's step scheduling is not implemented — k is fixed)."""
+    variant is AdaptiveLocalSGDStep below)."""
 
     def __init__(self, model, optimizer, loss_fn=None, mesh=None,
                  k_steps=2):
@@ -173,6 +173,94 @@ class LocalSGDStep(_PerRankStep):
             return losses.mean(), synced, new_s
 
         return step
+
+
+class AdaptiveLocalSGDStep(_PerRankStep):
+    """LocalSGD with an adaptive communication interval.
+
+    Reference parity: ``AdaptiveLocalSGDOptimizer``
+    (``fleet/meta_optimizers/localsgd_optimizer.py:195``): every iteration
+    is one local step per rank; parameters are averaged when
+    ``step - last_sync >= k``, and after each sync the next interval is
+    ``clip(ceil(sqrt(lr_0 * loss / (lr * loss_0) * init_k_steps)), 1, 16)``
+    (``:417-433``) with ``lr_0``/``loss_0`` captured at the first step
+    (``:353-357``).  The interval logic runs on the host (it is control
+    flow between compiled programs, not inside one), so only two programs
+    ever compile: the local step and the sync.
+    """
+
+    def __init__(self, model, optimizer, loss_fn=None, mesh=None,
+                 init_k_steps=1, begin_step=1, max_k_steps=16):
+        super().__init__(model, optimizer, loss_fn, mesh,
+                         stack_params=True)
+        self.init_k_steps = max(int(init_k_steps), 1)
+        self.k_steps = self.init_k_steps
+        self.begin_step = max(int(begin_step), 1)
+        self.max_k_steps = max(int(max_k_steps), 1)
+        self._iter = 0
+        self._last_sync = 0
+        self._loss0 = None
+        self._lr0 = None
+        self._sync_fn = None
+
+    def _build(self):
+        pnames, dp = self.pnames, self.dp
+        opt = self.optimizer
+
+        def step(params, opt_state, lr, key, ins, labs):
+            ins_r = self._shard_batch(ins)
+            labs_r = self._shard_batch(labs)
+            ranks = jnp.arange(dp)
+
+            def local(rank, p, s, mb, lab):
+                kk = jax.random.fold_in(key, rank)
+                loss, g = jax.value_and_grad(
+                    lambda pp: self._loss(
+                        dict(zip(pnames, [pp[k2] for k2 in pnames])),
+                        mb, lab, kk))(p)
+                p, s = opt.apply_gradients_tree(p, g, s, lr)
+                return loss, p, s
+
+            losses, new_p, new_s = jax.vmap(local)(
+                ranks, params, opt_state, ins_r, labs_r)
+            return losses.mean(), new_p, new_s
+
+        return step
+
+    def _sync_params(self):
+        if self._sync_fn is None:
+            pnames = self.pnames
+
+            def sync(params):
+                return {
+                    k: jnp.broadcast_to(
+                        params[k].mean(axis=0)[None], params[k].shape)
+                    if jnp.issubdtype(params[k].dtype, jnp.floating)
+                    else params[k]
+                    for k in pnames}
+
+            self._sync_fn = jax.jit(sync, donate_argnums=(0,))
+        self.params = self._sync_fn(self.params)
+
+    def step(self, inputs, labels=()):
+        loss = super().step(inputs, labels)
+        self._iter += 1
+        lr = max(float(self.optimizer.get_lr()), 1e-12)
+        if self._loss0 is None:
+            # one host sync at step 1 to anchor loss_0/lr_0 (reference
+            # `initialize` branch); steps between syncs stay async
+            self._loss0 = max(float(loss.numpy()), 1e-12)
+            self._lr0 = lr
+        if (self._iter >= self.begin_step
+                and self._iter - self._last_sync >= self.k_steps):
+            self._sync_params()
+            self._last_sync = self._iter
+            loss_val = max(float(loss.numpy()), 0.0)
+            ratio = (self._lr0 * loss_val) / (lr * self._loss0)
+            self.k_steps = int(np.clip(
+                np.ceil(np.sqrt(ratio * self.init_k_steps)),
+                1, self.max_k_steps))
+        return loss
 
 
 class DGCStep(_PerRankStep):

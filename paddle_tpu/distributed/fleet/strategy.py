@@ -50,6 +50,9 @@ class DistributedStrategy:
         # comm reduction
         self.localsgd = False
         self.localsgd_configs = {"k_steps": 1}
+        self.adaptive_localsgd = False
+        self.adaptive_localsgd_configs = {"init_k_steps": 1,
+                                          "begin_step": 1}
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
         self.fp16_allreduce = False

@@ -54,3 +54,154 @@ def cuda_places(device_ids=None):
 def cpu_places(device_count=None):
     from ..static.compat import cpu_places as _cp
     return _cp(device_count)
+
+# -- remaining 1.x submodules ---------------------------------------------
+from . import nets  # noqa: E402,F401
+from ..utils import unique_name  # noqa: E402,F401
+from .. import incubate  # noqa: E402,F401
+from .. import metric as metrics  # noqa: E402,F401
+from ..utils import profiler  # noqa: E402,F401
+from ..io import native_dataset as dataset  # noqa: E402,F401
+from ..core import rng as generator  # noqa: E402,F401
+
+import sys as _sys
+import types as _types
+
+
+def _submodule(name, **attrs):
+    m = _types.ModuleType(f"{__name__}.{name}")
+    for k, v in attrs.items():
+        setattr(m, k, v)
+    _sys.modules[m.__name__] = m
+    globals()[name] = m
+    return m
+
+
+# fluid.backward (append_backward/gradients over the deferred graph)
+from ..static.program import append_backward as _ab  # noqa: E402
+from ..static import gradients as _grads  # noqa: E402
+backward = _submodule("backward", append_backward=_ab, gradients=_grads)
+
+# fluid.executor / fluid.framework / fluid.compiler mirror the reference
+# module split (executor.py / framework.py / compiler.py)
+from ..static import (  # noqa: E402
+    Program as _Prog, Executor as _Exe, global_scope as _gs,
+    scope_guard as _sg, program_guard as _pg,
+    default_main_program as _dmp, default_startup_program as _dsp,
+    CompiledProgram as _CP, BuildStrategy as _BS,
+    ExecutionStrategy as _ES, ParallelExecutor as _PE)
+executor = _submodule("executor", Executor=_Exe, global_scope=_gs,
+                      scope_guard=_sg)
+framework = _submodule(
+    "framework", Program=_Prog, program_guard=_pg,
+    default_main_program=_dmp, default_startup_program=_dsp,
+    in_dygraph_mode=in_dygraph_mode, Parameter=Parameter)
+compiler = _submodule("compiler", CompiledProgram=_CP, BuildStrategy=_BS,
+                      ExecutionStrategy=_ES)
+parallel_executor = _submodule("parallel_executor", ParallelExecutor=_PE)
+
+
+# fluid.average (WeightedAverage)
+class WeightedAverage:
+    """reference: fluid/average.py — streaming weighted mean."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._total = 0.0
+        self._weight = 0.0
+
+    def add(self, value, weight=1):
+        import numpy as _np
+        self._total += float(_np.asarray(value).sum()) * float(weight)
+        self._weight += float(weight)
+
+    def eval(self):
+        if self._weight <= 0:
+            raise ValueError(
+                "WeightedAverage.eval(): no values added yet "
+                "(reference fluid/average.py enforce)")
+        return self._total / self._weight
+
+
+average = _submodule("average", WeightedAverage=WeightedAverage)
+
+
+class _DeprecatedLookupError(AttributeError, NotImplementedError):
+    """AttributeError so hasattr/dir feature-probing stays protocol-
+    correct; NotImplementedError so direct use reads as a scope note."""
+
+
+def _deprecated_module(name, why):
+    m = _submodule(name)
+
+    def _getattr(attr, _why=why, _name=name):
+        raise _DeprecatedLookupError(f"fluid.{_name}.{attr}: {_why}")
+    m.__getattr__ = _getattr
+    return m
+
+
+# deprecated-in-reference or PS-era descriptors: kept as named modules with
+# actionable errors
+_deprecated_module(
+    "evaluator", "fluid.evaluator was deprecated in the reference; use "
+    "paddle.metric")
+_deprecated_module(
+    "data_feed_desc", "dataset descriptors are internal to the native "
+    "dataset engine (io/native_dataset.py)")
+_deprecated_module(
+    "trainer_desc", "trainer descriptors are internal to "
+    "Executor.train_from_dataset")
+_deprecated_module(
+    "distribute_lookup_table", "distributed lookup tables live in "
+    "paddle.distributed.ps (SparseTable)")
+
+
+# fluid.transpiler: the legacy PS program rewriter — map the entry points
+# onto the modern fleet/ps machinery
+class DistributeTranspilerConfig:
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = None
+        self.min_block_size = 8192
+        self.sync_mode = True
+
+
+class DistributeTranspiler:
+    """reference: fluid/transpiler/distribute_transpiler.py — rewrote
+    programs into trainer/pserver pairs.  Under SPMD there is no program
+    split; use paddle.distributed.fleet (the_one_ps path) instead."""
+
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+
+    def transpile(self, *a, **k):
+        raise NotImplementedError(
+            "DistributeTranspiler.transpile: the grpc PS program rewrite "
+            "has no SPMD analogue — use paddle.distributed.fleet with a "
+            "DistributedStrategy (a_sync for the async-PS semantics); "
+            "sparse tables live in paddle.distributed.ps")
+
+
+transpiler = _submodule(
+    "transpiler", DistributeTranspiler=DistributeTranspiler,
+    DistributeTranspilerConfig=DistributeTranspilerConfig,
+    HashName=None, RoundRobin=None)
+DistributeTranspiler_ = DistributeTranspiler
+
+
+install_check = _submodule("install_check")
+
+
+def _install_run_check():
+    from ..utils import run_check as _rc
+    return _rc()
+
+
+install_check.run_check = _install_run_check
+
+# fluid.contrib: mixed-precision decorator path used by 1.x AMP scripts
+from ..static import amp as _static_amp  # noqa: E402
+contrib = _submodule("contrib", mixed_precision=_static_amp)
+_sys.modules[f"{__name__}.contrib.mixed_precision"] = _static_amp

@@ -77,3 +77,526 @@ def data(name, shape, dtype="float32", lod_level=0,
             "batch dim, unsupported on the TPU backend; pass the full "
             "shape and append_batch_size=False")
     return _data(name, shape, dtype)
+
+
+# -- name-resolution chain -------------------------------------------------
+# fluid.layers at v1.x exported ~290 symbols, most of which live on in the
+# 2.0 surface under paddle.* / paddle.nn.functional / static.nn /
+# vision.ops.  Rather than enumerate every alias, resolve through the same
+# chain the reference's DEFINE_ALIAS machinery flattened.
+def __getattr__(name):
+    import paddle_tpu as _p
+    from ..nn import functional as _F
+    from ..static import nn as _snn
+    from ..vision import ops as _vops
+    from ..ops import compat_ops as _compat
+    from .. import nn as _nn
+    for src in (_F, _snn, _vops, _compat, _p, _nn):
+        if hasattr(src, name):
+            return getattr(src, name)
+    # control-flow / decode classes kept under their 2.0 homes
+    from ..nn import decode as _decode
+    if hasattr(_decode, name):
+        return getattr(_decode, name)
+    raise AttributeError(
+        f"module 'paddle.fluid.layers' has no attribute '{name}'")
+
+
+# -- 1.x-convention wrappers (names with no 2.0 twin) ---------------------
+import builtins as _builtins
+
+
+def range(start, end, step, dtype, name=None):  # noqa: A001
+    import paddle_tpu as _p
+    return _p.arange(start, end, step, dtype)
+
+
+def reverse(x, axis, name=None):
+    import paddle_tpu as _p
+    return _p.flip(x, axis)
+
+
+def size(input, name=None):
+    import paddle_tpu as _p
+    return _p.numel(input)
+
+
+def sums(input, out=None):
+    import paddle_tpu as _p
+    res = _p.add_n(list(input))
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """1.x assign-target creation — a zero scalar of the dtype."""
+    import paddle_tpu as _p
+    return _p.zeros([1], dtype)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
+                   name=None):
+    import paddle_tpu as _p
+    return _p.uniform(shape, dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    name=None):
+    import paddle_tpu as _p
+    return _p.normal(mean=mean, std=std, shape=shape).astype(dtype)
+
+
+def _batch_size_like(fn, input, shape, input_dim_idx=0, output_dim_idx=0):
+    shape = list(shape)
+    shape[output_dim_idx] = int(input.shape[input_dim_idx])
+    return fn(shape)
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  force_cpu=False):
+    import paddle_tpu as _p
+    return _batch_size_like(lambda s: _p.full(s, value, dtype), input,
+                            shape, input_dim_idx, output_dim_idx)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    import paddle_tpu as _p
+    return _batch_size_like(
+        lambda s: _p.uniform(s, dtype, min=min, max=max, seed=seed),
+        input, shape, input_dim_idx, output_dim_idx)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    import paddle_tpu as _p
+    return _batch_size_like(
+        lambda s: _p.normal(mean=mean, std=std, shape=s).astype(dtype),
+        input, shape, input_dim_idx, output_dim_idx)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    import paddle_tpu as _p
+    return _p.all(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    import paddle_tpu as _p
+    return _p.any(input, axis=dim, keepdim=keep_dim)
+
+
+def unique_with_counts(x, dtype="int32"):
+    import paddle_tpu as _p
+    out, index, counts = _p.unique(x, return_inverse=True,
+                                   return_counts=True)
+    return out, index.astype(dtype), counts.astype(dtype)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    import paddle_tpu as _p
+    return _p.crop_tensor(x, shape=shape, offsets=offsets)
+
+
+def resize_linear(input, out_shape=None, scale=None, name=None,
+                  align_corners=True, align_mode=1, data_format="NCW"):
+    from ..nn.functional.common import interpolate
+    return interpolate(input, size=out_shape, scale_factor=scale,
+                       mode="linear", align_corners=align_corners,
+                       align_mode=align_mode, data_format=data_format)
+
+
+def grid_sampler(x, grid, name=None):
+    from ..nn.functional import grid_sample
+    return grid_sample(x, grid)
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max",
+                    require_index=False, name=None):
+    from ..nn import functional as _F
+    if pool_type == "max":
+        return _F.adaptive_max_pool2d(input, pool_size,
+                                      return_mask=require_index)
+    return _F.adaptive_avg_pool2d(input, pool_size)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max",
+                    require_index=False, name=None):
+    from ..nn import functional as _F
+    if pool_type == "max":
+        return _F.adaptive_max_pool3d(input, pool_size,
+                                      return_mask=require_index)
+    return _F.adaptive_avg_pool3d(input, pool_size)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    from ..nn.functional import normalize
+    return normalize(x, p=2, axis=axis, epsilon=epsilon)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format="NCHW"):
+    from ..nn.functional import local_response_norm
+    # both lrn_op.cc and this backend's local_response_norm apply alpha to
+    # the raw window sum — pass it through unchanged
+    return local_response_norm(input, size=n, alpha=alpha, beta=beta,
+                               k=k, data_format=data_format)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    import paddle_tpu as _p
+    return _p.clip(x, t_min, t_max)
+
+
+def hard_shrink(x, threshold=0.5):
+    from ..nn.functional import hardshrink
+    return hardshrink(x, threshold)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    import paddle_tpu as _p
+    # fluid's parametric form (2.0 fixes slope=1/6, offset=0.5)
+    return _p.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    import paddle_tpu as _p
+    return x * _p.clip(x + offset, 0.0, threshold) / scale
+
+
+def clip_by_norm(x, max_norm, name=None):
+    from ..core.dispatch import primitive, ensure_tensor
+    import jax.numpy as jnp
+    x = ensure_tensor(x)
+
+    def fn(a):
+        norm = jnp.sqrt(jnp.sum(a * a))
+        return a * (max_norm / jnp.maximum(norm, max_norm))
+
+    return primitive(name="clip_by_norm")(fn)(x)
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    from ..nn.functional import kl_div
+    return kl_div(x, target, reduction=reduction)
+
+
+def huber_loss(input, label, delta):
+    """reference huber_loss_op.cc: elementwise huber, [N, 1] outputs."""
+    from ..core.dispatch import primitive, ensure_tensor
+    import jax.numpy as jnp
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    d = float(delta)
+
+    def fn(x, y):
+        r = jnp.abs(y - x)
+        return jnp.where(r <= d, 0.5 * r * r, d * (r - 0.5 * d))
+
+    return primitive(name="huber_loss")(fn)(input, label)
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """reference margin_rank_loss_op.cc: max(0, -label*(left-right)+m)."""
+    from ..core.dispatch import primitive, ensure_tensor
+    import jax.numpy as jnp
+    label = ensure_tensor(label)
+    left, right = ensure_tensor(left), ensure_tensor(right)
+
+    def fn(lab, lf, rt):
+        return jnp.maximum(0.0, -lab * (lf - rt) + margin)
+
+    return primitive(name="margin_rank_loss")(fn)(label, left, right)
+
+
+def rank_loss(label, left, right, name=None):
+    """reference rank_loss_op.cc: sigmoid-CE on o = left - right with
+    soft label P."""
+    from ..core.dispatch import primitive, ensure_tensor
+    import jax
+    import jax.numpy as jnp
+    label = ensure_tensor(label)
+    left, right = ensure_tensor(left), ensure_tensor(right)
+
+    def fn(p, lf, rt):
+        o = lf - rt
+        return jax.nn.softplus(o) - p * o
+
+    return primitive(name="rank_loss")(fn)(label, left, right)
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      name=None, normalize=False):
+    """reference sigmoid_cross_entropy_with_logits_op.cc (elementwise,
+    ignore_index masking, optional normalize-by-valid-count)."""
+    from ..core.dispatch import primitive, ensure_tensor
+    import jax
+    import jax.numpy as jnp
+    x, label = ensure_tensor(x), ensure_tensor(label)
+
+    def fn(z, t):
+        per = jax.nn.softplus(z) - t * z  # = max(z,0)-z*t+log(1+e^-|z|)
+        valid = t != ignore_index
+        per = jnp.where(valid, per, 0.0)
+        if normalize:
+            per = per / jnp.maximum(valid.sum().astype(per.dtype), 1.0)
+        return per
+
+    return primitive(name="sigmoid_cross_entropy_with_logits")(fn)(x, label)
+
+
+def cos_sim(X, Y):
+    from ..nn.functional import cosine_similarity
+    import paddle_tpu as _p
+    return _p.unsqueeze(cosine_similarity(X, Y, axis=1), [1])
+
+
+def mean_iou(input, label, num_classes):
+    """reference mean_iou_op.cc: (mean_iou, out_wrong, out_correct)."""
+    from ..core.dispatch import primitive, ensure_tensor
+    import jax.numpy as jnp
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    nc = int(num_classes)
+
+    def fn(pred, lab):
+        pred = pred.reshape(-1).astype(jnp.int32)
+        lab = lab.reshape(-1).astype(jnp.int32)
+        correct = jnp.zeros((nc,), jnp.int32).at[lab].add(
+            (pred == lab).astype(jnp.int32))
+        pred_cnt = jnp.zeros((nc,), jnp.int32).at[pred].add(1)
+        lab_cnt = jnp.zeros((nc,), jnp.int32).at[lab].add(1)
+        union = pred_cnt + lab_cnt - correct
+        present = union > 0
+        iou = jnp.where(present, correct / jnp.maximum(union, 1), 0.0)
+        miou = iou.sum() / jnp.maximum(present.sum(), 1)
+        wrong = lab_cnt - correct
+        return miou.astype(jnp.float32), wrong, correct
+
+    prim = primitive(name="mean_iou", nondiff=(0, 1))(fn)
+    return prim(input, label)
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU matrix [N, M] (reference: detection/iou_similarity_op)."""
+    from ..core.dispatch import primitive, ensure_tensor
+    import jax.numpy as jnp
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    off = 0.0 if box_normalized else 1.0
+
+    def fn(a, b):
+        ax1, ay1, ax2, ay2 = a[:, 0], a[:, 1], a[:, 2], a[:, 3]
+        bx1, by1, bx2, by2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+        area_a = (ax2 - ax1 + off) * (ay2 - ay1 + off)
+        area_b = (bx2 - bx1 + off) * (by2 - by1 + off)
+        ix1 = jnp.maximum(ax1[:, None], bx1[None])
+        iy1 = jnp.maximum(ay1[:, None], by1[None])
+        ix2 = jnp.minimum(ax2[:, None], bx2[None])
+        iy2 = jnp.minimum(ay2[:, None], by2[None])
+        iw = jnp.maximum(ix2 - ix1 + off, 0.0)
+        ih = jnp.maximum(iy2 - iy1 + off, 0.0)
+        inter = iw * ih
+        return inter / jnp.maximum(
+            area_a[:, None] + area_b[None] - inter, 1e-10)
+
+    return primitive(name="iou_similarity")(fn)(x, y)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    """Sample one category id per row from probability rows
+    (reference sampling_id_op.cc)."""
+    import jax
+    from ..core import rng as _rng
+    from ..core.dispatch import primitive, ensure_tensor
+    x = ensure_tensor(x)
+    key = (jax.random.key(seed) if seed else _rng.next_key())
+
+    def fn(p):
+        return jax.random.categorical(key, jnp_log(p), axis=-1)
+
+    import jax.numpy as _jnp
+
+    def jnp_log(p):
+        return _jnp.log(_jnp.maximum(p, 1e-20))
+
+    return primitive(name="sampling_id", nondiff=(0,))(fn)(x).astype(dtype)
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """Greedy CTC decode (reference ctc_align_op.cc): argmax per step,
+    merge repeats, drop blanks.  Dense form: returns (decoded [B, T],
+    out_lengths [B])."""
+    from ..core.dispatch import primitive, ensure_tensor
+    import jax.numpy as jnp
+    input = ensure_tensor(input)
+    t_extent = int(input.shape[1])
+    args = [input]
+    if input_length is not None:
+        args.append(ensure_tensor(input_length))
+
+    def fn(x, *ln):
+        ids = jnp.argmax(x, axis=-1)  # [B, T]
+        prev = jnp.concatenate(
+            [jnp.full_like(ids[:, :1], -1), ids[:, :-1]], axis=1)
+        keep = (ids != blank) & (ids != prev)
+        if ln:
+            valid = (jnp.arange(t_extent)[None, :]
+                     < ln[0].reshape(-1, 1).astype(jnp.int32))
+            keep = keep & valid
+        # stable-compact kept ids to the front of each row
+        pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+        dest = jnp.where(keep, pos, t_extent)
+        out = jnp.full((ids.shape[0], t_extent + 1), padding_value,
+                       ids.dtype)
+        b = jnp.broadcast_to(
+            jnp.arange(ids.shape[0], dtype=jnp.int32)[:, None], ids.shape)
+        out = out.at[b, dest].set(jnp.where(keep, ids, padding_value))
+        return out[:, :t_extent], keep.sum(axis=1)
+
+    prim = primitive(name="ctc_greedy_decoder",
+                     nondiff=tuple(_builtins.range(len(args))))(fn)
+    return prim(*args)
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """Dense+lengths form: re-interpret x with new lengths (reference
+    lod_reset_op.cc).  Returns (x, lengths) — lengths from `y`'s second
+    element / a lengths Tensor / the target_lod offsets list."""
+    import numpy as _np
+    from ..core.tensor import Tensor as _T
+    if y is not None:
+        lengths = y[1] if isinstance(y, (tuple, list)) else y
+        return x, lengths
+    if target_lod is not None:
+        off = _np.asarray(target_lod, _np.int64)
+        return x, _T(off[1:] - off[:-1])
+    raise ValueError("lod_reset: provide y or target_lod")
+
+
+def lod_append(x, level):
+    raise NotImplementedError(
+        "lod_append: multi-level LoD has no dense analogue — track "
+        "nested lengths explicitly (see nn/functional/sequence.py "
+        "conventions)")
+
+
+def inplace_abn(input, act=None, **kwargs):
+    from ..nn import functional as _F
+    out = _F.batch_norm(input, **{k: v for k, v in kwargs.items()
+                                  if k in ("running_mean", "running_var",
+                                           "weight", "bias", "training",
+                                           "momentum", "epsilon")})
+    if act:
+        out = getattr(_F, act)(out)
+    return out
+
+
+def hsigmoid(input, label, num_classes, weight=None, bias=None,
+             name=None, **kwargs):
+    from ..nn import functional as _F
+    if weight is None:
+        raise ValueError(
+            "hsigmoid: pass weight ([num_classes-1, D]) explicitly — "
+            "param_attr creation belongs to nn.HSigmoidLoss here")
+    return _F.hsigmoid_loss(input, label, num_classes, weight, bias)
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, seed=0, **kwargs):
+    raise NotImplementedError(
+        "sampled_softmax_with_cross_entropy: use the full "
+        "softmax_with_cross_entropy — on TPU the full softmax over the "
+        "MXU is typically faster than sampled variants "
+        "(reference: sample_logits_op.cc)")
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference: detection/matrix_nms_op.cc) — parallel
+    soft-suppression by pairwise IoU decay.  Eager numpy."""
+    import numpy as _np
+    from ..core.dispatch import ensure_tensor
+    bb = _np.asarray(ensure_tensor(bboxes).numpy(), _np.float32)
+    sc = _np.asarray(ensure_tensor(scores).numpy(), _np.float32)
+    outs, idxs, counts = [], [], []
+    off = 0.0 if normalized else 1.0
+    for b in _builtins.range(bb.shape[0]):
+        dets = []
+        for c in _builtins.range(sc.shape[1]):
+            if c == background_label:
+                continue
+            s = sc[b, c]
+            keep = _np.where(s > score_threshold)[0]
+            if not len(keep):
+                continue
+            order = keep[_np.argsort(-s[keep])][:nms_top_k]
+            boxes, ss = bb[b][order], s[order]
+            x1, y1, x2, y2 = boxes.T
+            area = (x2 - x1 + off) * (y2 - y1 + off)
+            ix1 = _np.maximum(x1[:, None], x1[None])
+            iy1 = _np.maximum(y1[:, None], y1[None])
+            ix2 = _np.minimum(x2[:, None], x2[None])
+            iy2 = _np.minimum(y2[:, None], y2[None])
+            iw = _np.maximum(ix2 - ix1 + off, 0)
+            ih = _np.maximum(iy2 - iy1 + off, 0)
+            iou = iw * ih / _np.maximum(
+                area[:, None] + area[None] - iw * ih, 1e-10)
+            iou = _np.triu(iou, k=1)
+            iou_cmax = iou.max(axis=0)
+            # decay_j = min_i f(iou_ij, compensate_i): the compensation is
+            # the SUPPRESSOR's own max-IoU (matrix_nms_op.cc), row axis
+            if use_gaussian:
+                decay = _np.exp(-(iou ** 2 - iou_cmax[:, None] ** 2)
+                                / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1 - iou) / _np.maximum(
+                    1 - iou_cmax[:, None], 1e-10)).min(axis=0)
+            ds = ss * decay
+            sel = ds > post_threshold
+            for i in _np.where(sel)[0]:
+                dets.append([c, ds[i], *boxes[i], order[i]])
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:keep_top_k] if keep_top_k > 0 else dets
+        outs.append(_np.asarray([d[:6] for d in dets], _np.float32)
+                    if dets else _np.zeros((0, 6), _np.float32))
+        idxs.append(_np.asarray([d[6] for d in dets], _np.int32))
+        counts.append(len(dets))
+    from ..core.tensor import Tensor as _T
+    out = _T(_np.concatenate(outs, axis=0))
+    res = [out]
+    if return_index:
+        res.append(_T(_np.concatenate(idxs, axis=0)[:, None]))
+    if return_rois_num:
+        res.append(_T(_np.asarray(counts, _np.int32)))
+    return tuple(res) if len(res) > 1 else out
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    raise NotImplementedError(
+        "locality_aware_nms (EAST text merging): compose a score-weighted "
+        "merge of adjacent boxes with paddle.vision.ops.nms "
+        "(reference: detection/locality_aware_nms_op.cc)")
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, **kwargs):
+    raise NotImplementedError(
+        "ssd_loss: compose iou_similarity + bipartite_match + "
+        "target_assign + smooth_l1/softmax_with_cross_entropy — the "
+        "monolithic op is a composition in the reference too "
+        "(fluid/layers/detection.py ssd_loss)")
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    raise NotImplementedError(
+        "chunk_eval (NER chunking F1): evaluate on the host with "
+        "seqeval-style python over decoded tags "
+        "(reference: chunk_eval_op.cc)")

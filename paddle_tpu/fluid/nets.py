@@ -1,0 +1,111 @@
+"""``fluid.nets`` — composite network builders.
+
+Reference parity: ``python/paddle/fluid/nets.py`` (simple_img_conv_pool,
+img_conv_group, sequence_conv_pool, glu, scaled_dot_product_attention) —
+pure compositions of layers, reimplemented over the modern builders.
+"""
+from __future__ import annotations
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1, conv_groups=1,
+                         param_attr=None, bias_attr=None, act=None,
+                         use_cudnn=True):
+    """reference: fluid/nets.py simple_img_conv_pool."""
+    from ..static.nn import conv2d
+    from ..nn import functional as F
+    conv = conv2d(input, num_filters=num_filters, filter_size=filter_size,
+                  stride=conv_stride, padding=conv_padding,
+                  dilation=conv_dilation, groups=conv_groups,
+                  param_attr=param_attr, bias_attr=bias_attr, act=act)
+    return F.pool2d(conv, pool_size=pool_size, pool_type=pool_type,
+                    pool_stride=pool_stride, pool_padding=pool_padding,
+                    global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    """reference: fluid/nets.py img_conv_group (VGG-style conv stack)."""
+    from ..static.nn import conv2d, batch_norm, dropout
+    from ..nn import functional as F
+
+    def expand(v, n):
+        return v if isinstance(v, (list, tuple)) else [v] * n
+
+    n = len(conv_num_filter)
+    paddings = expand(conv_padding, n)
+    fsizes = expand(conv_filter_size, n)
+    with_bn = expand(conv_with_batchnorm, n)
+    drops = expand(conv_batchnorm_drop_rate, n)
+    attrs = param_attr if isinstance(param_attr, (list, tuple)) \
+        else [param_attr] * n
+    tmp = input
+    for i in range(n):
+        tmp = conv2d(tmp, num_filters=conv_num_filter[i],
+                     filter_size=fsizes[i], padding=paddings[i],
+                     param_attr=attrs[i],
+                     act=None if with_bn[i] else conv_act)
+        if with_bn[i]:
+            tmp = batch_norm(tmp, act=conv_act)
+            if drops[i] > 0:
+                tmp = dropout(tmp, dropout_prob=drops[i])
+    return F.pool2d(tmp, pool_size=pool_size, pool_stride=pool_stride,
+                    pool_type=pool_type)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None,
+                       lengths=None):
+    """reference: fluid/nets.py sequence_conv_pool — context conv over
+    time then sequence pooling.  Dense form: input [B, T, D] + lengths;
+    weights created here like the reference's param_attr path."""
+    import numpy as np
+    from ..core.tensor import Parameter
+    from ..nn import functional as F
+    from ..core.dispatch import ensure_tensor
+    x = ensure_tensor(input)
+    d = int(x.shape[-1])
+    rng = np.random.RandomState(0)
+    bound = 1.0 / np.sqrt(filter_size * d)
+    w = Parameter(rng.uniform(-bound, bound,
+                              (filter_size * d, num_filters)).astype(
+                                  "float32"))
+    conv = F.sequence_conv(x, w, context_length=filter_size,
+                           lengths=lengths)
+    if act is not None:
+        conv = getattr(F, act)(conv)
+    return F.sequence_pool(conv, pool_type, lengths=lengths)
+
+
+def glu(input, dim=-1):
+    """reference: fluid/nets.py glu — gated linear unit split."""
+    from ..nn import functional as F
+    return F.glu(input, axis=dim)
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """reference: fluid/nets.py scaled_dot_product_attention ([B, S, D]
+    inputs, multi-head internally)."""
+    from ..nn import functional as F
+    from ..ops.manipulation import reshape
+    from ..core.dispatch import ensure_tensor
+    q = ensure_tensor(queries)
+    k = ensure_tensor(keys)
+    v = ensure_tensor(values)
+    b, sq, d = [int(s) for s in q.shape]
+    sk = int(k.shape[1])
+    dv = int(v.shape[-1])
+    if d % num_heads or dv % num_heads:
+        raise ValueError(
+            f"hidden sizes ({d}, {dv}) must divide num_heads {num_heads}")
+    qh = reshape(q, [b, sq, num_heads, d // num_heads])
+    kh = reshape(k, [b, sk, num_heads, d // num_heads])
+    vh = reshape(v, [b, sk, num_heads, dv // num_heads])
+    out = F.scaled_dot_product_attention(qh, kh, vh,
+                                         dropout_p=dropout_rate)
+    return reshape(out, [b, sq, dv])

@@ -265,11 +265,35 @@ def save(layer, path, input_spec=None, **configs):
     if input_spec is None:
         raise ValueError("jit.save requires input_spec on TPU "
                          "(shapes define the compiled program)")
+    # Dynamic dims (None/-1) export as shape polymorphism (jax.export
+    # symbolic shapes) — matching the reference's batch-polymorphic
+    # save_inference_model.  Axis 0 shares one "batch" symbol across all
+    # inputs (paired feeds almost always co-vary there); other dynamic
+    # axes get independent symbols.
+    scope = None
+    if any(isinstance(s, InputSpec)
+           and any(d is None or (isinstance(d, int) and d < 0)
+                   for d in s.shape)
+           for s in input_spec):
+        scope = jax.export.SymbolicScope()
     specs = []
-    for spec in input_spec:
+    for i, spec in enumerate(input_spec):
         if isinstance(spec, InputSpec):
-            shape = [1 if (s is None or s < 0) else int(s)
-                     for s in spec.shape]
+            dyn = [d is None or (isinstance(d, int) and d < 0)
+                   for d in spec.shape]
+            if any(dyn):
+                parts = []
+                for j, (d, is_dyn) in enumerate(zip(spec.shape, dyn)):
+                    if not is_dyn:
+                        parts.append(str(int(d)))
+                    elif j == 0:
+                        parts.append("batch")
+                    else:
+                        parts.append(f"dyn{i}_{j}")
+                shape = jax.export.symbolic_shape(
+                    ", ".join(parts), scope=scope)
+            else:
+                shape = tuple(int(s) for s in spec.shape)
             specs.append(jax.ShapeDtypeStruct(
                 tuple(shape), jnp.dtype(spec.dtype)))
         elif isinstance(spec, Tensor):
@@ -294,7 +318,17 @@ def save(layer, path, input_spec=None, **configs):
                                      params[k]._data.dtype) for k in pnames]
     b_shapes = [jax.ShapeDtypeStruct(tuple(buffers[k].shape),
                                      buffers[k]._data.dtype) for k in bnames]
-    exported = jax.export.export(jitted)(p_shapes, b_shapes, specs)
+    try:
+        exported = jax.export.export(jitted)(p_shapes, b_shapes, specs)
+    except Exception as e:
+        if scope is not None:
+            raise RuntimeError(
+                f"{e}\n[paddle_tpu] export with dynamic dims failed while "
+                "tracing with symbolic shapes — if the model's control "
+                "flow or reshapes need concrete sizes, pass fully "
+                "concrete shapes in input_spec (each batch size compiles "
+                "separately at load time)") from e
+        raise
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path + ".pdmodel", "wb") as f:
         f.write(exported.serialize())
@@ -302,7 +336,9 @@ def save(layer, path, input_spec=None, **configs):
         "params": {k: params[k].numpy() for k in pnames},
         "buffers": {k: buffers[k].numpy() for k in bnames},
         "pnames": pnames, "bnames": bnames,
-        "input_specs": [(list(s.shape), str(s.dtype)) for s in specs],
+        "input_specs": [([d if isinstance(d, int) else str(d)
+                          for d in s.shape], str(s.dtype))
+                        for s in specs],
     }
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(state, f, protocol=4)
@@ -310,7 +346,9 @@ def save(layer, path, input_spec=None, **configs):
         "kind": "jit",
         "feed_names": [getattr(s, "name", None) or f"x{i}"
                        for i, s in enumerate(input_spec)],
-        "feed_specs": [(list(s.shape), str(s.dtype)) for s in specs],
+        "feed_specs": [([d if isinstance(d, int) else str(d)
+                         for d in s.shape], str(s.dtype))
+                       for s in specs],
         "n_fetch": len(exported.out_avals),
     }
     with open(path + ".pdmeta", "wb") as f:
@@ -411,3 +449,41 @@ class TracedLayer:
                 "selection is not supported — the full traced signature "
                 "is exported")
         save(self._layer, path, input_spec=list(self._example))
+
+
+# -- dygraph_to_static logging shims --------------------------------------
+# reference: fluid/dygraph/dygraph_to_static/logging_utils.py:182,221 —
+# verbosity/code-level logging for the AST transformer pipeline.  The TPU
+# build has no AST transformers (tracing is native), so these configure a
+# plain logger for trace diagnostics.
+import logging as _logging
+
+_D2S_LOGGER = _logging.getLogger("paddle_tpu.jit")
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference: logging_utils.set_verbosity."""
+    _D2S_LOGGER.setLevel(max(_logging.ERROR - int(level) * 10,
+                             _logging.DEBUG))
+    if also_to_stdout and not _D2S_LOGGER.handlers:
+        _D2S_LOGGER.addHandler(_logging.StreamHandler())
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """reference: logging_utils.set_code_level — in the reference this
+    prints transformed source per AST pass; there is no transformed code
+    here, so it toggles trace-cache diagnostics instead."""
+    set_verbosity(level if level < 100 else 9, also_to_stdout)
+
+
+class _Dy2StaticModule:
+    """`paddle.jit.dy2static` namespace shim (the reference exposes the
+    transformer utilities; here conversion is tracing, so the operators
+    used by converted code map to their lax-backed equivalents)."""
+
+    @staticmethod
+    def convert_call(fn):
+        return fn
+
+
+dy2static = _Dy2StaticModule()

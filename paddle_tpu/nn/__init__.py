@@ -57,3 +57,4 @@ from .functional import common, conv, loss, norm, extension  # noqa: F401
 from .layer import rnn  # noqa: F401
 from .layer import common as _layer_common  # noqa: F401
 vision = extension  # detection/vision functionals live there + vision.ops
+from . import utils as weight_norm_hook  # noqa: F401  (reference module name)

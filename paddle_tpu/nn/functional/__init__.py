@@ -71,3 +71,128 @@ multiclass_nms = _vision_alias("multiclass_nms")
 roi_align = _vision_alias("roi_align")
 roi_pool = _vision_alias("roi_pool")
 deformable_conv = _vision_alias("deform_conv2d")
+
+# -- transitional fluid-era surface (reference nn/functional/__init__.py
+# re-exports these from fluid.layers at v2.0) ------------------------------
+from .legacy import (  # noqa: F401
+    relu_, elu_, softmax_, soft_relu,
+    smooth_l1, bpr_loss, teacher_student_sigmoid_loss, center_loss,
+    affine_channel, space_to_depth, shuffle_channel, temporal_shift,
+    image_resize_short, resize_bilinear, resize_nearest, resize_trilinear,
+    pool3d, random_crop, merge_selected_rows, tensor_array_to_tensor,
+    box_clip, anchor_generator, density_prior_box, bipartite_match,
+    target_assign, polygon_box_transform, distribute_fpn_proposals,
+    collect_fpn_proposals, generate_proposals, detection_output,
+    psroi_pool, filter_by_instag, continuous_value_model,
+    similarity_focus, reorder_lod_tensor_by_rank, prroi_pool,
+    roi_perspective_transform, deformable_roi_pooling,
+    generate_proposal_labels, generate_mask_labels, rpn_target_assign,
+    retinanet_detection_output, retinanet_target_assign,
+    box_decoder_and_assign,
+    rnn, birnn, gru_unit, lstm_unit, dynamic_gru, dynamic_lstm,
+    dynamic_lstmp, lstm,
+)
+from .sequence import (  # noqa: F401
+    sequence_first_step, sequence_last_step, sequence_concat,
+    sequence_expand_as, sequence_slice, sequence_scatter,
+    sequence_enumerate, sequence_reshape, sequence_conv,
+)
+from ...vision.ops import yolo_loss as yolov3_loss  # noqa: F401
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """reference: warpctc_op.cc — routed to the native CTC loss."""
+    from .loss import ctc_loss
+    return ctc_loss(input, label, input_length, label_length, blank=blank,
+                    reduction="none")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Functional hierarchical sigmoid (reference:
+    hierarchical_sigmoid_op.cc; default complete-binary tree — custom
+    path_table/path_code inputs are not supported)."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "hsigmoid_loss custom trees (path_table/path_code) are not "
+            "supported — use the default complete-binary tree")
+    from ..layer.loss import HSigmoidLoss as _HS
+    from ...core.dispatch import ensure_tensor as _et
+    weight = _et(weight)
+    mod = _HS.__new__(_HS)
+    from ..layer.base import Layer as _Layer
+    _Layer.__init__(mod)
+    import numpy as _np
+    feature_size = int(weight.shape[1])
+    mod.num_classes = num_classes
+    d = int(_np.ceil(_np.log2(max(num_classes, 2))))
+    mod.depth = d
+    mod.weight = weight
+    mod.bias = (_et(bias) if bias is not None
+                else _et(_np.zeros([num_classes - 1], _np.float32)))
+    _HS._build_tree(mod)
+    return mod.forward(input, label)
+
+
+# parameter-creating builders shared with the static-graph surface
+def _static_nn_alias(name):
+    def fn(*args, **kwargs):
+        from ...static import nn as snn
+        return getattr(snn, name)(*args, **kwargs)
+    fn.__name__ = name
+    return fn
+
+
+fc = _static_nn_alias("fc")
+data_norm = _static_nn_alias("data_norm")
+nce = _static_nn_alias("nce")
+multi_box_head = _static_nn_alias("multi_box_head")
+spectral_norm = _static_nn_alias("spectral_norm")
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Process-global step counter (reference: fluid/layers/tensor.py
+    autoincreased_step_counter — a persistable int var bumped per run).
+    Host-side here: it increments per CALL, so read it once per step on
+    the host rather than inside a traced program."""
+    from ...core.tensor import Tensor as _T
+    import numpy as _np
+    key = counter_name or "@STEP_COUNTER@"
+    val = _STEP_COUNTERS.get(key, begin - step) + step
+    _STEP_COUNTERS[key] = val
+    return _T(_np.asarray([val], _np.int64))
+
+
+_STEP_COUNTERS = {}
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """SelectedRows are dense here — identity
+    (reference: get_tensor_from_selected_rows_op.cc)."""
+    from ...core.dispatch import ensure_tensor as _et
+    return _et(x)
+
+
+def array_read(array, i):
+    from ... import ops as _ops
+    return _ops.compat_ops.array_read(array, i)
+
+
+def array_write(x, i, array=None):
+    from ... import ops as _ops
+    return _ops.compat_ops.array_write(x, i, array)
+
+
+def array_length(array):
+    from ... import ops as _ops
+    return _ops.compat_ops.array_length(array)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    from ... import ops as _ops
+    return _ops.compat_ops.create_array(dtype, initialized_list)
+
+
+from ...ops.compat_ops import tanh_ as tanh_  # noqa: F401

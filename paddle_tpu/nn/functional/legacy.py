@@ -1,0 +1,895 @@
+"""Transitional (fluid-era) functionals re-exported by paddle.nn.functional.
+
+Reference parity: ``python/paddle/nn/functional/__init__.py`` at v2.0 still
+re-exports a large block of ``fluid.layers`` names (activation variants,
+image ops, detection helpers, legacy RNN units).  This module provides those
+names over dense arrays: LoD-shaped inputs use the (padded dense, lengths)
+convention from ``sequence.py``; ops whose reference form *creates*
+parameters internally (param_attr) instead take the weights explicitly —
+parameter creation belongs to the Layer / static.nn world here.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive, ensure_tensor
+from ...core.tensor import Tensor
+
+
+# -- inplace activation variants (grad-correct via the shared helper) -----
+def relu_(x, name=None):
+    from ...ops.compat_ops import _inplace
+    from .activation import relu
+    return _inplace("relu_", relu)(x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    from ...ops.compat_ops import _inplace
+    from .activation import elu
+    return _inplace("elu_", elu)(x, alpha)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...ops.compat_ops import _inplace
+    from .activation import softmax
+    return _inplace("softmax_", softmax)(x, axis)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    """reference: fluid/layers/nn.py:9853 (ln(1 + e^clip(x, -t, t)))."""
+    x = ensure_tensor(x)
+    return primitive(name="soft_relu")(
+        lambda a: jnp.log1p(jnp.exp(jnp.clip(a, -threshold, threshold))))(x)
+
+
+# -- losses ---------------------------------------------------------------
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    """Per-instance summed smooth-L1, shape [N, 1]
+    (reference: fluid/layers/nn.py:5787, smooth_l1_loss_op)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    sigma = 1.0 if sigma is None else float(sigma)
+    s2 = sigma * sigma
+    args = [x, y]
+    if inside_weight is not None:
+        args.append(ensure_tensor(inside_weight))
+    if outside_weight is not None:
+        args.append(ensure_tensor(outside_weight))
+
+    def fn(xa, ya, *w):
+        diff = xa - ya
+        if inside_weight is not None:
+            diff = diff * w[0]
+        ad = jnp.abs(diff)
+        per = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff,
+                        ad - 0.5 / s2)
+        if outside_weight is not None:
+            per = per * w[-1]
+        return per.reshape(per.shape[0], -1).sum(axis=1, keepdims=True)
+
+    return primitive(name="smooth_l1")(fn)(*args)
+
+
+def bpr_loss(input, label, name=None):
+    """Bayesian Personalized Ranking loss, [N, 1]
+    (reference: fluid/layers/loss.py:153, bpr_loss_op)."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(x, lab):
+        n, d = x.shape
+        lab = lab.reshape(n).astype(jnp.int32)
+        pos = jnp.take_along_axis(x, lab[:, None], axis=1)
+        diff = pos - x
+        logsig = jax.nn.log_sigmoid(diff)
+        mask = jnp.arange(d)[None, :] != lab[:, None]
+        return (-(logsig * mask).sum(axis=1, keepdims=True)
+                / jnp.maximum(d - 1, 1))
+
+    return primitive(name="bpr_loss")(fn)(input, label)
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """reference: fluid/layers/loss.py:1465
+    (teacher_student_sigmoid_loss_op.cc semantics, per-element)."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    ub, lb = float(soft_max_up_bound), float(soft_max_lower_bound)
+
+    def fn(x, z):
+        x = jnp.clip(x, lb, ub)
+        z = z.astype(x.dtype).reshape(x.shape)
+        # reference kernel: label<-2 => sigmoid only; -2<=label<-1 =>
+        # teacher absent (clk from label); else student + teacher terms
+        ce = jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        clk = jnp.where(z > -1.0, jnp.minimum(z, 1.0), z + 2.0)
+        student = ce - x * jnp.clip(clk, 0.0, 1.0)
+        teacher_z = jnp.where(z > 0.0, z - jnp.floor(z), 0.0)
+        teacher = jnp.where(z > -1.0, ce - x * teacher_z, 0.0)
+        return student + teacher
+
+    return primitive(name="teacher_student_sigmoid_loss")(fn)(input, label)
+
+
+def center_loss(input, label, num_classes, alpha, centers,
+                update_center=True):
+    """Center loss (reference: fluid/layers/loss.py center_loss,
+    center_loss_op.cc).  The reference creates the `centers` variable from
+    param_attr; here the caller owns it (pass a [num_classes, D] Tensor) —
+    returns (loss [N, 1], updated_centers)."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    centers = ensure_tensor(centers)
+
+    def fn(x, lab, c):
+        lab = lab.reshape(-1).astype(jnp.int32)
+        cx = c[lab]
+        diff = x - cx
+        loss = 0.5 * (diff * diff).reshape(x.shape[0], -1).sum(
+            axis=1, keepdims=True)
+        if not update_center:
+            return loss, c
+        # center update: c_j -= alpha * sum_{i: y_i=j}(c_j - x_i) / (1+n_j)
+        counts = jnp.zeros((c.shape[0],), x.dtype).at[lab].add(1.0)
+        delta = jnp.zeros_like(c).at[lab].add(-diff)
+        new_c = c - alpha * delta / (1.0 + counts)[:, None]
+        return loss, new_c
+
+    loss, new_c = primitive(name="center_loss")(fn)(input, label, centers)
+    return loss, new_c
+
+
+# -- image / channel ops --------------------------------------------------
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW",
+                   act=None, name=None):
+    """Per-channel scale+bias (reference: fluid/layers/nn.py:12655,
+    affine_channel_op.cc)."""
+    x = ensure_tensor(x)
+    args, have = [x], []
+    if scale is not None:
+        args.append(ensure_tensor(scale)); have.append("scale")
+    if bias is not None:
+        args.append(ensure_tensor(bias)); have.append("bias")
+    c_axis = 1 if data_layout == "NCHW" else -1
+
+    def fn(a, *sb):
+        shape = [1] * a.ndim
+        shape[c_axis] = a.shape[c_axis]
+        out = a
+        i = 0
+        if "scale" in have:
+            out = out * sb[i].reshape(shape); i += 1
+        if "bias" in have:
+            out = out + sb[i].reshape(shape)
+        return out
+
+    out = primitive(name="affine_channel")(fn)(*args)
+    if act is not None:
+        from . import activation as A
+        out = getattr(A, act)(out)
+    return out
+
+
+def space_to_depth(x, blocksize, name=None):
+    """NCHW [N,C,H,W] -> [N, C*b*b, H/b, W/b]
+    (reference: fluid/layers/nn.py:12549, space_to_depth_op.cc)."""
+    x = ensure_tensor(x)
+    b = int(blocksize)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        if h % b or w % b:
+            raise ValueError(
+                f"space_to_depth: H/W ({h},{w}) not divisible by "
+                f"blocksize {b}")
+        a = a.reshape(n, c, h // b, b, w // b, b)
+        a = a.transpose(0, 3, 5, 1, 2, 4)
+        return a.reshape(n, c * b * b, h // b, w // b)
+
+    return primitive(name="space_to_depth")(fn)(x)
+
+
+def shuffle_channel(x, group, name=None):
+    """Channel shuffle (reference: fluid/layers/nn.py:13264)."""
+    x = ensure_tensor(x)
+    g = int(group)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, g, c // g, h, w)
+        return a.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+    return primitive(name="shuffle_channel")(fn)(x)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    """TSM shift (reference: fluid/layers/nn.py:13337,
+    temporal_shift_op.cc): input [N*T, C, H, W]; first fold of channels
+    shifts backward in time, second fold forward, rest unshifted."""
+    x = ensure_tensor(x)
+    t = int(seg_num)
+
+    def fn(a):
+        nt, c, h, w = a.shape
+        n = nt // t
+        a = a.reshape(n, t, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.concatenate(
+            [a[:, 1:, :c1], jnp.zeros_like(a[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(a[:, :1, c1:c2]), a[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([back, fwd, a[:, :, c2:]], axis=2)
+        return out.reshape(nt, c, h, w)
+
+    return primitive(name="temporal_shift")(fn)(x)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORT side equals out_short_len
+    (reference: fluid/layers/nn.py:8201)."""
+    from .common import interpolate
+    input = ensure_tensor(input)
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short = min(h, w)
+    scale = float(out_short_len) / float(short)
+    out_hw = [int(round(h * scale)), int(round(w * scale))]
+    mode = {"BILINEAR": "bilinear", "NEAREST": "nearest"}[resample]
+    return interpolate(input, size=out_hw, mode=mode)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1,
+                    data_format="NCHW"):
+    from .common import interpolate
+    return interpolate(input, size=out_shape, scale_factor=scale,
+                       mode="bilinear", align_corners=align_corners,
+                       align_mode=align_mode, data_format=data_format)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True,
+                   data_format="NCHW"):
+    from .common import interpolate
+    return interpolate(input, size=out_shape, scale_factor=scale,
+                       mode="nearest", align_corners=align_corners,
+                       data_format=data_format)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    from .common import interpolate
+    return interpolate(input, size=out_shape, scale_factor=scale,
+                       mode="trilinear", align_corners=align_corners,
+                       align_mode=align_mode, data_format=data_format)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True,
+           data_format="NCDHW"):
+    """reference: fluid/layers/nn.py pool3d -> pool_op.cc (3D)."""
+    from .pooling import max_pool3d, avg_pool3d
+    input = ensure_tensor(input)
+    if global_pooling:
+        pool_size = list(input.shape[2:])
+        pool_padding = 0
+    if pool_type == "max":
+        return max_pool3d(input, pool_size, stride=pool_stride,
+                          padding=pool_padding, ceil_mode=ceil_mode)
+    return avg_pool3d(input, pool_size, stride=pool_stride,
+                      padding=pool_padding, ceil_mode=ceil_mode,
+                      exclusive=exclusive)
+
+
+def random_crop(x, shape, seed=None):
+    """Random crop to `shape` (reference: fluid/layers/nn.py:8615).
+    Crop offsets are drawn on the host per call (eager semantics)."""
+    from ...core import rng as rng_mod
+    x = ensure_tensor(x)
+    shape = [int(s) for s in shape]
+    nd = len(shape)
+    full = [int(s) for s in x.shape]
+    lead = full[:len(full) - nd]
+    if seed is None:
+        r = np.random.RandomState(
+            np.asarray(jax.random.key_data(rng_mod.next_key()))[-1]
+            % (2**31))
+    else:
+        r = np.random.RandomState(int(seed) % (2**31))
+    offs = [r.randint(0, full[len(lead) + i] - shape[i] + 1)
+            for i in range(nd)]
+    idx = tuple([slice(None)] * len(lead)
+                + [slice(o, o + s) for o, s in zip(offs, shape)])
+    return primitive(name="random_crop")(lambda a: a[idx])(x)
+
+
+# -- selected-rows shims (dense storage: identity) -----------------------
+def merge_selected_rows(x, name=None):
+    """SelectedRows are stored dense here (COVERAGE.md §2.1) — merge of
+    duplicate rows is a no-op on the dense form."""
+    return ensure_tensor(x)
+
+
+# -- tensor-array ---------------------------------------------------------
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """Concat/stack a python-list tensor array
+    (reference: fluid/layers/tensor.py tensor_array_to_tensor)."""
+    from ... import ops as _ops
+    arrs = [ensure_tensor(t) for t in input]
+    if use_stack:
+        out = _ops.stack(arrs, axis=axis)
+    else:
+        out = _ops.concat(arrs, axis=axis)
+    sizes = np.asarray([int(t.shape[axis]) if not use_stack else 1
+                        for t in arrs], np.int32)
+    return out, Tensor(sizes)
+
+
+# -- detection helpers ----------------------------------------------------
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image bounds (reference: detection/box_clip_op.cc).
+    input [N, 4] or [B, N, 4]; im_info [B, 3] (h, w, scale)."""
+    input = ensure_tensor(input)
+    im_info = ensure_tensor(im_info)
+
+    def fn(boxes, info):
+        squeeze = boxes.ndim == 2
+        if squeeze:
+            boxes = boxes[None]
+        h = info[:, 0] / info[:, 2]
+        w = info[:, 1] / info[:, 2]
+        hm = (h - 1.0)[:, None]
+        wm = (w - 1.0)[:, None]
+        x1 = jnp.clip(boxes[..., 0], 0.0, wm)
+        y1 = jnp.clip(boxes[..., 1], 0.0, hm)
+        x2 = jnp.clip(boxes[..., 2], 0.0, wm)
+        y2 = jnp.clip(boxes[..., 3], 0.0, hm)
+        out = jnp.stack([x1, y1, x2, y2], axis=-1)
+        return out[0] if squeeze else out
+
+    return primitive(name="box_clip")(fn)(input, im_info)
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None,
+                     offset=0.5, name=None):
+    """RPN anchors per feature-map location
+    (reference: detection/anchor_generator_op.cc).  Returns
+    (anchors [H, W, A, 4], variances [H, W, A, 4])."""
+    input = ensure_tensor(input)
+    h, w = int(input.shape[2]), int(input.shape[3])
+    sizes = [float(s) for s in (anchor_sizes or [64., 128., 256., 512.])]
+    ratios = [float(r) for r in (aspect_ratios or [0.5, 1.0, 2.0])]
+    sx, sy = (float(stride[0]), float(stride[1])) if stride else (16., 16.)
+    base = []
+    for r in ratios:
+        for s in sizes:
+            area = sx * sy
+            ws = np.round(np.sqrt(area / r))
+            hs = np.round(ws * r)
+            scale_w = s / sx
+            scale_h = s / sy
+            ws, hs = scale_w * ws, scale_h * hs
+            base.append([(sx * offset) - 0.5 * (ws - 1),
+                         (sy * offset) - 0.5 * (hs - 1),
+                         (sx * offset) + 0.5 * (ws - 1),
+                         (sy * offset) + 0.5 * (hs - 1)])
+    base = np.asarray(base, np.float32)  # [A, 4]
+    shift_x = np.arange(w, dtype=np.float32) * sx
+    shift_y = np.arange(h, dtype=np.float32) * sy
+    gx, gy = np.meshgrid(shift_x, shift_y)
+    shifts = np.stack([gx, gy, gx, gy], axis=-1)  # [H, W, 4]
+    anchors = shifts[:, :, None, :] + base[None, None]
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          anchors.shape).copy()
+    return Tensor(anchors), Tensor(var)
+
+
+def density_prior_box(input, image=None, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    """Densified SSD priors (reference: detection/density_prior_box_op.cc).
+    Returns (boxes, variances), [H, W, P, 4] (or [HWP, 4] flattened)."""
+    input = ensure_tensor(input)
+    image = ensure_tensor(image) if image is not None else None
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih = int(image.shape[2]) if image is not None else fh
+    iw = int(image.shape[3]) if image is not None else fw
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    densities = [int(d) for d in (densities or [])]
+    fixed_sizes = [float(s) for s in (fixed_sizes or [])]
+    fixed_ratios = [float(r) for r in (fixed_ratios or [1.0])]
+    boxes = []
+    for k, (dens, fs) in enumerate(zip(densities, fixed_sizes)):
+        for ratio in fixed_ratios:
+            bw = fs * np.sqrt(ratio)
+            bh = fs / np.sqrt(ratio)
+            shift = fs / dens
+            for di in range(dens):
+                for dj in range(dens):
+                    cx_off = (dj + 0.5) * shift - fs / 2.0
+                    cy_off = (di + 0.5) * shift - fs / 2.0
+                    boxes.append((cx_off, cy_off, bw, bh))
+    out = np.zeros((fh, fw, len(boxes), 4), np.float32)
+    for yy in range(fh):
+        for xx in range(fw):
+            c_x = (xx + offset) * step_w
+            c_y = (yy + offset) * step_h
+            for p, (ox, oy, bw, bh) in enumerate(boxes):
+                out[yy, xx, p] = [(c_x + ox - bw / 2.) / iw,
+                                  (c_y + oy - bh / 2.) / ih,
+                                  (c_x + ox + bw / 2.) / iw,
+                                  (c_y + oy + bh / 2.) / ih]
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    if flatten_to_2d:
+        out = out.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return Tensor(out), Tensor(var)
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """Greedy bipartite matching (reference:
+    detection/bipartite_match_op.cc).  dist_matrix [M, N] (rows: ground
+    truth, cols: priors); returns (match_indices [1, N] int32,
+    match_dist [1, N])."""
+    d = np.asarray(ensure_tensor(dist_matrix).numpy(), np.float32).copy()
+    m, n = d.shape
+    match_idx = -np.ones((n,), np.int32)
+    match_dist = np.zeros((n,), np.float32)
+    work = d.copy()
+    for _ in range(min(m, n)):
+        r, c = np.unravel_index(np.argmax(work), work.shape)
+        if work[r, c] <= 0:
+            break
+        match_idx[c] = r
+        match_dist[c] = d[r, c]
+        work[r, :] = -1.0
+        work[:, c] = -1.0
+    if match_type == "per_prediction":
+        thr = dist_threshold if dist_threshold is not None else 0.5
+        for c in range(n):
+            if match_idx[c] == -1:
+                r = int(np.argmax(d[:, c]))
+                if d[r, c] >= thr:
+                    match_idx[c] = r
+                    match_dist[c] = d[r, c]
+    return Tensor(match_idx[None]), Tensor(match_dist[None])
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    """Gather targets by match indices (reference:
+    detection/target_assign_op.cc).  input [M, K], matched_indices
+    [1 or B, N] -> (out [B, N, K], out_weight [B, N, 1])."""
+    input = ensure_tensor(input)
+    matched = ensure_tensor(matched_indices)
+
+    def fn(x, idx):
+        idx2 = idx.astype(jnp.int32)
+        safe = jnp.clip(idx2, 0, x.shape[0] - 1)
+        out = x[safe]  # [B, N, K]
+        miss = (idx2 == -1)[..., None]
+        fill = jnp.asarray(0 if mismatch_value is None else mismatch_value,
+                           x.dtype)
+        out = jnp.where(miss, fill, out)
+        weight = jnp.where(miss, 0.0, 1.0).astype(jnp.float32)
+        return out, weight
+
+    return primitive(name="target_assign")(fn)(input, matched)
+
+
+def polygon_box_transform(input, name=None):
+    """EAST geometry head transform (reference:
+    detection/polygon_box_transform_op.cc): channel 2k is x-offset,
+    2k+1 y-offset; output = pixel coord minus 4*offset."""
+    input = ensure_tensor(input)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        xs = jnp.arange(w, dtype=a.dtype)[None, None, None, :]
+        ys = jnp.arange(h, dtype=a.dtype)[None, None, :, None]
+        is_x = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+        grid = jnp.where(is_x, xs, ys)
+        return grid - 4.0 * a
+
+    return primitive(name="polygon_box_transform")(fn)(input)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """Route RoIs to FPN levels by scale (reference:
+    detection/distribute_fpn_proposals_op.cc).  Eager (shapes are
+    data-dependent)."""
+    rois = np.asarray(ensure_tensor(fpn_rois).numpy(), np.float32)
+    ws = np.maximum(rois[:, 2] - rois[:, 0] + 1, 0)
+    hs = np.maximum(rois[:, 3] - rois[:, 1] + 1, 0)
+    scale = np.sqrt(ws * hs)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, restore = [], np.zeros(len(rois), np.int32)
+    order = []
+    for L in range(min_level, max_level + 1):
+        idx = np.where(lvl == L)[0]
+        outs.append(Tensor(rois[idx]))
+        order.append(idx)
+    order = np.concatenate(order) if order else np.zeros(0, np.int64)
+    restore[order] = np.arange(len(rois), dtype=np.int32)
+    return outs, Tensor(restore[:, None])
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """Merge per-level RoIs by score (reference:
+    detection/collect_fpn_proposals_op.cc).  Eager."""
+    rois = np.concatenate(
+        [np.asarray(ensure_tensor(r).numpy(), np.float32)
+         for r in multi_rois], axis=0)
+    scores = np.concatenate(
+        [np.asarray(ensure_tensor(s).numpy(), np.float32).reshape(-1)
+         for s in multi_scores], axis=0)
+    k = min(int(post_nms_top_n), len(scores))
+    top = np.argsort(-scores)[:k]
+    return Tensor(rois[top])
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=False, name=None):
+    """RPN proposal generation (reference:
+    detection/generate_proposals_op.cc).  Eager numpy composition of
+    decode + clip + filter + NMS, single image (B=1) per call semantics
+    preserved by looping over the batch."""
+    from ...vision.ops import nms as _nms
+    scores_np = np.asarray(ensure_tensor(scores).numpy(), np.float32)
+    deltas_np = np.asarray(ensure_tensor(bbox_deltas).numpy(), np.float32)
+    im_np = np.asarray(ensure_tensor(im_info).numpy(), np.float32)
+    anchors_np = np.asarray(ensure_tensor(anchors).numpy(),
+                            np.float32).reshape(-1, 4)
+    var_np = np.asarray(ensure_tensor(variances).numpy(),
+                        np.float32).reshape(-1, 4)
+    b = scores_np.shape[0]
+    all_rois, all_counts = [], []
+    for i in range(b):
+        sc = scores_np[i].transpose(1, 2, 0).reshape(-1)
+        dl = deltas_np[i].transpose(1, 2, 0).reshape(-1, 4)
+        k = min(int(pre_nms_top_n), len(sc))
+        top = np.argsort(-sc)[:k]
+        sc, dl = sc[top], dl[top]
+        an, vr = anchors_np[top], var_np[top]
+        # decode (variance-scaled xywh deltas, detection box_coder rule)
+        aw = an[:, 2] - an[:, 0] + 1.0
+        ah = an[:, 3] - an[:, 1] + 1.0
+        ax = an[:, 0] + aw * 0.5
+        ay = an[:, 1] + ah * 0.5
+        cx = vr[:, 0] * dl[:, 0] * aw + ax
+        cy = vr[:, 1] * dl[:, 1] * ah + ay
+        w = np.exp(np.minimum(vr[:, 2] * dl[:, 2], np.log(1000. / 16.))) \
+            * aw
+        h = np.exp(np.minimum(vr[:, 3] * dl[:, 3], np.log(1000. / 16.))) \
+            * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - 1, cy + h / 2 - 1], axis=1)
+        hh = im_np[i, 0] / im_np[i, 2]
+        ww = im_np[i, 1] / im_np[i, 2]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, ww - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, hh - 1)
+        ms = min_size * im_np[i, 2]
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1 >= ms)
+                & (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
+        boxes, sc = boxes[keep], sc[keep]
+        if len(boxes):
+            kept = np.asarray(_nms(Tensor(boxes), iou_threshold=nms_thresh,
+                                   scores=Tensor(sc),
+                                   top_k=post_nms_top_n).numpy())
+            boxes = boxes[kept]
+        all_rois.append(boxes)
+        all_counts.append(len(boxes))
+    rois = Tensor(np.concatenate(all_rois, axis=0)
+                  if all_rois else np.zeros((0, 4), np.float32))
+    counts = Tensor(np.asarray(all_counts, np.int32))
+    if return_rois_num:
+        return rois, counts
+    return rois
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """SSD head decode + multiclass NMS (reference:
+    detection/detection_output (multiclass_nms + box_coder composition))."""
+    from ...vision.ops import box_coder, multiclass_nms
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size", box_normalized=True)
+    return multiclass_nms(decoded, scores,
+                          background_label=background_label,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, nms_threshold=nms_threshold,
+                          keep_top_k=keep_top_k, nms_eta=nms_eta,
+                          return_index=return_index)
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None, name=None):
+    """Position-sensitive RoI average pooling (reference:
+    detection/psroi_pool_op.cc).  rois_num maps each RoI to its batch
+    image (all RoIs read image 0 when omitted, the single-image case)."""
+    input = ensure_tensor(input)
+    rois = ensure_tensor(rois)
+    if rois_num is not None:
+        counts = np.asarray(ensure_tensor(rois_num).numpy(),
+                            np.int64).reshape(-1)
+        batch_idx = np.repeat(np.arange(len(counts)), counts)
+    else:
+        batch_idx = np.zeros(int(rois.shape[0]), np.int64)
+    batch_idx = jnp.asarray(batch_idx, jnp.int32)
+
+    def fn(x, r):
+        n_rois = r.shape[0]
+        ph, pw = int(pooled_height), int(pooled_width)
+        oc = int(output_channels)
+
+        def one(roi, img):
+            x1 = roi[0] * spatial_scale
+            y1 = roi[1] * spatial_scale
+            x2 = roi[2] * spatial_scale
+            y2 = roi[3] * spatial_scale
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            bin_w, bin_h = rw / pw, rh / ph
+            hh, ww = x.shape[2], x.shape[3]
+            ys = jnp.arange(hh, dtype=x.dtype)
+            xs = jnp.arange(ww, dtype=x.dtype)
+            outs = []
+            for i in range(ph):
+                for j in range(pw):
+                    y_lo = y1 + i * bin_h
+                    y_hi = y1 + (i + 1) * bin_h
+                    x_lo = x1 + j * bin_w
+                    x_hi = x1 + (j + 1) * bin_w
+                    my = ((ys[:, None] >= jnp.floor(y_lo))
+                          & (ys[:, None] < jnp.ceil(y_hi)))
+                    mx = ((xs[None, :] >= jnp.floor(x_lo))
+                          & (xs[None, :] < jnp.ceil(x_hi)))
+                    mask = (my & mx).astype(x.dtype)
+                    area = jnp.maximum(mask.sum(), 1.0)
+                    # channel block (i, j) feeds output channel plane
+                    blk = x[img, (i * pw + j) * oc:
+                            (i * pw + j + 1) * oc]
+                    v = (blk * mask[None]).sum(axis=(1, 2)) / area
+                    outs.append(v)
+            out = jnp.stack(outs, axis=1).reshape(oc, ph, pw)
+            return out
+
+        return jax.vmap(one)(r, batch_idx) if n_rois else jnp.zeros(
+            (0, int(output_channels), int(pooled_height),
+             int(pooled_width)), x.dtype)
+
+    return primitive(name="psroi_pool")(fn)(input, rois)
+
+
+# -- stubs: ads/LoD-rank machinery with no dense analogue ----------------
+def _no_dense_analogue(name, why):
+    def op(*args, **kwargs):
+        raise NotImplementedError(
+            f"{name}: {why} (reference op kept for API compatibility; "
+            "file an issue with your use case)")
+    op.__name__ = name
+    return op
+
+
+filter_by_instag = _no_dense_analogue(
+    "filter_by_instag", "instag filtering produces data-dependent shapes "
+    "tied to LoD storage; batch your data by tag on the host instead")
+continuous_value_model = _no_dense_analogue(
+    "continuous_value_model", "CVM feature stripping is specific to the "
+    "ads PS pipeline; slice the show/click columns directly")
+similarity_focus = _no_dense_analogue(
+    "similarity_focus", "rank-ordered LoD walk; no XLA-friendly form yet")
+reorder_lod_tensor_by_rank = _no_dense_analogue(
+    "reorder_lod_tensor_by_rank", "LoD rank-table reordering — sort the "
+    "(dense, lengths) pair with argsort instead")
+prroi_pool = _no_dense_analogue(
+    "prroi_pool", "precise RoI pooling's exact integral form is pending; "
+    "use roi_align (paddle.vision.ops.roi_align)")
+roi_perspective_transform = _no_dense_analogue(
+    "roi_perspective_transform", "use grid_sample with a perspective grid")
+deformable_roi_pooling = _no_dense_analogue(
+    "deformable_roi_pooling", "use deform_conv2d + roi_align")
+generate_proposal_labels = _no_dense_analogue(
+    "generate_proposal_labels", "training-time sampling with "
+    "data-dependent shapes; sample on the host")
+generate_mask_labels = _no_dense_analogue(
+    "generate_mask_labels", "training-time sampling with data-dependent "
+    "shapes; sample on the host")
+rpn_target_assign = _no_dense_analogue(
+    "rpn_target_assign", "training-time sampling with data-dependent "
+    "shapes; compose bipartite_match + target_assign on the host")
+retinanet_detection_output = _no_dense_analogue(
+    "retinanet_detection_output", "compose yolo-style decode + "
+    "multiclass_nms; focal-loss head decode pending")
+retinanet_target_assign = _no_dense_analogue(
+    "retinanet_target_assign", "training-time sampling; compose "
+    "bipartite_match + target_assign on the host")
+box_decoder_and_assign = _no_dense_analogue(
+    "box_decoder_and_assign", "compose paddle.vision.ops.box_coder with "
+    "argmax assignment")
+multi_box_head = None  # bound in __init__ from static.nn
+
+
+# -- functional RNN drivers & units --------------------------------------
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Functional RNN driver over any cell
+    (reference: paddle.nn.functional.rnn -> fluid/layers/rnn.py rnn)."""
+    from ..layer.rnn import RNN as _RNN
+    drv = _RNN(cell, is_reverse=is_reverse, time_major=time_major)
+    return drv(ensure_tensor(inputs), initial_states=initial_states,
+               sequence_length=sequence_length)
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    """Bidirectional functional driver (reference: F.birnn)."""
+    from ..layer.rnn import BiRNN as _BiRNN
+    drv = _BiRNN(cell_fw, cell_bw, time_major=time_major)
+    return drv(ensure_tensor(inputs), initial_states=initial_states,
+               sequence_length=sequence_length)
+
+
+def gru_unit(input, hidden, weight_hh, bias_hh=None,
+             activation="tanh", gate_activation="sigmoid"):
+    """One GRU step over pre-projected gate input
+    (reference: gru_unit_op.cc — `input` is x@W_ih already [N, 3D]).
+    Returns (new_hidden, reset_hidden_prev, gate)."""
+    input = ensure_tensor(input)
+    hidden = ensure_tensor(hidden)
+    weight_hh = ensure_tensor(weight_hh)
+    args = [input, hidden, weight_hh]
+    if bias_hh is not None:
+        args.append(ensure_tensor(bias_hh))
+
+    def fn(x, h, whh, *b):
+        d = h.shape[-1]
+        hh = h @ whh
+        if b:
+            hh = hh + b[0]
+        xr, xz, xn = jnp.split(x, 3, axis=-1)
+        hr, hz, hn = jnp.split(hh, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        new_h = (1.0 - z) * n + z * h
+        del d
+        return new_h, r * h, jnp.concatenate([r, z, n], axis=-1)
+
+    return primitive(name="gru_unit")(fn)(*args)
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None, weight=None,
+              bias=None):
+    """One LSTM step (reference: fluid/layers/rnn.py lstm_unit).  The
+    reference creates its projection weights from param_attr; pass them
+    explicitly as `weight` [D_in + D_h, 4*D_h] and `bias` [4*D_h]."""
+    x_t = ensure_tensor(x_t)
+    h_prev = ensure_tensor(hidden_t_prev)
+    c_prev = ensure_tensor(cell_t_prev)
+    if weight is None:
+        raise ValueError(
+            "lstm_unit: pass `weight` ([D_in+D_h, 4*D_h]) and optionally "
+            "`bias` — parameter creation from param_attr belongs to "
+            "nn.LSTMCell here")
+    weight = ensure_tensor(weight)
+    args = [x_t, h_prev, c_prev, weight]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+
+    def fn(x, h, c, w, *b):
+        z = jnp.concatenate([x, h], axis=-1) @ w
+        if b:
+            z = z + b[0]
+        i, f, cc, o = jnp.split(z, 4, axis=-1)
+        f = jax.nn.sigmoid(f + forget_bias)
+        new_c = f * c + jax.nn.sigmoid(i) * jnp.tanh(cc)
+        new_h = jax.nn.sigmoid(o) * jnp.tanh(new_c)
+        return new_h, new_c
+
+    return primitive(name="lstm_unit")(fn)(*args)
+
+
+def dynamic_gru(input, size, weight, bias=None, is_reverse=False,
+                h_0=None, origin_mode=False, lengths=None, name=None,
+                **kwargs):
+    """GRU over a padded batch (reference: gru_op.cc dynamic_gru; LoD
+    input -> (dense [B, T, 3*size] pre-projected gates, lengths)).
+    `weight` is the hidden-hidden matrix [size, 3*size]."""
+    from ..layer.rnn import GRUCell, RNN as _RNN
+    import jax.numpy as _j
+    input = ensure_tensor(input)
+    weight = ensure_tensor(weight)
+    d = int(size)
+    cell = GRUCell(3 * d, d)
+    # route the caller's weights into the cell (input is pre-projected:
+    # identity input projection)
+    cell.weight_ih._data = _j.eye(3 * d, dtype=weight._data.dtype)
+    cell.weight_hh._data = weight._data.T
+    if bias is not None:
+        cell.bias_hh._data = ensure_tensor(bias)._data.reshape(-1)
+        cell.bias_ih._data = jnp.zeros_like(cell.bias_ih._data)
+    else:
+        cell.bias_hh._data = jnp.zeros_like(cell.bias_hh._data)
+        cell.bias_ih._data = jnp.zeros_like(cell.bias_ih._data)
+    drv = _RNN(cell, is_reverse=is_reverse)
+    init = None
+    if h_0 is not None:
+        init = ensure_tensor(h_0)
+    out, _ = drv(input, initial_states=init, sequence_length=lengths)
+    return out
+
+
+def dynamic_lstm(input, size, weight, bias=None, use_peepholes=False,
+                 is_reverse=False, h_0=None, c_0=None, lengths=None,
+                 name=None, **kwargs):
+    """LSTM over a padded batch (reference: lstm_op.cc dynamic_lstm;
+    input is pre-projected [B, T, 4*hidden]).  `weight` [hidden, 4*hidden]
+    is the recurrent matrix.  Peephole connections are not supported
+    (use_peepholes=True raises)."""
+    from ..layer.rnn import LSTMCell, RNN as _RNN
+    import jax.numpy as _j
+    if use_peepholes:
+        raise NotImplementedError(
+            "dynamic_lstm(use_peepholes=True): peephole weights are not "
+            "implemented — set use_peepholes=False")
+    input = ensure_tensor(input)
+    weight = ensure_tensor(weight)
+    d = int(size) // 4
+    cell = LSTMCell(4 * d, d)
+    cell.weight_ih._data = _j.eye(4 * d, dtype=weight._data.dtype)
+    cell.weight_hh._data = weight._data.T
+    cell.bias_ih._data = jnp.zeros_like(cell.bias_ih._data)
+    if bias is not None:
+        cell.bias_hh._data = ensure_tensor(bias)._data.reshape(-1)[:4 * d]
+    else:
+        cell.bias_hh._data = jnp.zeros_like(cell.bias_hh._data)
+    drv = _RNN(cell, is_reverse=is_reverse)
+    init = None
+    if h_0 is not None and c_0 is not None:
+        init = (ensure_tensor(h_0), ensure_tensor(c_0))
+    out, (h, c) = drv(input, initial_states=init, sequence_length=lengths)
+    return out, c
+
+
+def dynamic_lstmp(input, size, proj_size, weight, proj_weight, bias=None,
+                  is_reverse=False, lengths=None, name=None, **kwargs):
+    """Projected LSTM (reference: lstmp_op.cc): LSTM then a linear
+    projection of the hidden state each step."""
+    out, c = dynamic_lstm(input, size, weight, bias=bias,
+                          is_reverse=is_reverse, lengths=lengths)
+    proj_weight = ensure_tensor(proj_weight)
+    proj = primitive(name="lstmp_projection")(
+        lambda h, w: h @ w)(out, proj_weight)
+    return proj, c
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """cudnn-style multi-layer LSTM (reference: cudnn_lstm_op.cu).
+    Re-routed to the nn.LSTM layer: the reference's flat-weight cudnn
+    API has no XLA analogue, so build an nn.LSTM and call it instead."""
+    raise NotImplementedError(
+        "fluid.layers.lstm (cudnn flat-weight API): construct "
+        "paddle.nn.LSTM(input_size, hidden_size, num_layers, "
+        "direction='bidirect' if is_bidirec else 'forward') and call it — "
+        "same math, explicit parameters (reference: cudnn_lstm_op.cu)")

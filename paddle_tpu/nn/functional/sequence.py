@@ -234,3 +234,218 @@ def row_conv(x, weight, act=None, name=None):
         from . import activation as _act
         out = getattr(_act, act)(out)
     return out
+
+
+def _default_lengths(x, lengths):
+    if lengths is None:
+        return Tensor(jnp.full(x._data.shape[0], x._data.shape[1],
+                               jnp.int32))
+    return ensure_tensor(lengths)
+
+
+def sequence_first_step(input, lengths=None, name=None):
+    """First valid timestep per sequence (reference
+    sequence_pool_op FIRST strategy)."""
+    return sequence_pool(input, "first", lengths=lengths)
+
+
+def sequence_last_step(input, lengths=None, name=None):
+    """Last valid timestep per sequence (reference
+    sequence_pool_op LAST strategy)."""
+    return sequence_pool(input, "last", lengths=lengths)
+
+
+def sequence_concat(input, lengths=None, name=None):
+    """Per-sequence concatenation of N (dense, lengths) batches
+    (reference sequence_concat_op): for each batch row i the valid
+    prefixes are concatenated.  `input` is a list of [B, T_k, ...]
+    tensors; `lengths` the matching list of [B] length vectors (None ->
+    full).  Returns (dense [B, sum T_k, ...], lengths)."""
+    xs = [ensure_tensor(x) for x in input]
+    lens = [_default_lengths(x, L) for x, L in zip(
+        xs, lengths if lengths is not None else [None] * len(xs))]
+
+    def fn(*args):
+        n = len(args) // 2
+        arrs, lns = args[:n], args[n:]
+        total_t = sum(a.shape[1] for a in arrs)
+        b = arrs[0].shape[0]
+        starts = []
+        acc = jnp.zeros((b,), jnp.int32)
+        for ln in lns:
+            starts.append(acc)
+            acc = acc + ln.astype(jnp.int32)
+        feat_shape = arrs[0].shape[2:]
+        out = jnp.zeros((b, total_t) + feat_shape, arrs[0].dtype)
+        for a, ln, st in zip(arrs, lns, starts):
+            tpos = jnp.arange(a.shape[1], dtype=jnp.int32)[None, :]
+            valid = tpos < ln.astype(jnp.int32)[:, None]
+            dest = st[:, None] + tpos  # [B, T_k]
+            dest = jnp.where(valid, dest, total_t)  # park invalid writes
+            pad = jnp.zeros((b, 1) + feat_shape, a.dtype)
+            out_ext = jnp.concatenate([out, pad], axis=1)
+            bidx = jnp.broadcast_to(
+                jnp.arange(b, dtype=jnp.int32)[:, None], dest.shape)
+            out = out_ext.at[bidx, dest].set(a)[:, :total_t]
+        return out, acc
+
+    flat = fn  # traced through primitive for tape integration
+    prim = primitive(name="sequence_concat",
+                     nondiff=tuple(range(len(xs), 2 * len(xs))))(flat)
+    out, total = prim(*xs, *lens)
+    return out, total
+
+
+def sequence_expand_as(x, y_lengths, name=None):
+    """Expand each row of x to as many timesteps as y has
+    (reference sequence_expand_as_op): x [B, ...] -> [B, T, ...] with
+    each row repeated along the new time axis, masked by y_lengths."""
+    x = ensure_tensor(x)
+    y_lengths = ensure_tensor(y_lengths)
+    # max length must be concrete (it is the output's time extent)
+    t = int(np.asarray(y_lengths.numpy()).reshape(-1).max())
+
+    def fn(xa, ln):
+        rep = jnp.repeat(xa[:, None], t, axis=1)
+        mask = jnp.arange(t)[None, :] < ln.astype(jnp.int32)[:, None]
+        return jnp.where(
+            mask.reshape(mask.shape + (1,) * (rep.ndim - 2)), rep, 0)
+
+    prim = primitive(name="sequence_expand_as", nondiff=(1,))(fn)
+    return prim(x, y_lengths)
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-sequence slice (reference sequence_slice_op): for row i take
+    `length[i]` steps starting at `offset[i]`.  Output is padded dense
+    [B, max(length), ...] + lengths."""
+    input = ensure_tensor(input)
+    offset = ensure_tensor(offset)
+    length = ensure_tensor(length)
+    max_out = int(np.asarray(length.numpy()).reshape(-1).max())
+
+    def fn(xa, off, ln):
+        off = off.reshape(-1).astype(jnp.int32)
+        ln = ln.reshape(-1).astype(jnp.int32)
+        tpos = jnp.arange(max_out, dtype=jnp.int32)[None, :]
+        src = jnp.clip(off[:, None] + tpos, 0, xa.shape[1] - 1)
+        gathered = jnp.take_along_axis(
+            xa, src.reshape(src.shape + (1,) * (xa.ndim - 2)), axis=1)
+        mask = tpos < ln[:, None]
+        out = jnp.where(
+            mask.reshape(mask.shape + (1,) * (xa.ndim - 2)), gathered, 0)
+        return out, ln
+
+    prim = primitive(name="sequence_slice", nondiff=(1, 2))(fn)
+    return prim(input, offset, length)
+
+
+def sequence_scatter(input, index, updates, lengths=None, name=None):
+    """Scatter updates into per-sequence positions (reference
+    sequence_scatter_op): out[i, index[i, j]] += updates[i, j] for valid
+    j < lengths[i]."""
+    input = ensure_tensor(input)
+    index = ensure_tensor(index)
+    updates = ensure_tensor(updates)
+    lengths = _default_lengths(index, lengths)
+
+    def fn(xa, idx, upd, ln):
+        idx = idx.astype(jnp.int32)
+        mask = (jnp.arange(idx.shape[1], dtype=jnp.int32)[None, :]
+                < ln.astype(jnp.int32)[:, None])
+        upd = jnp.where(mask.reshape(
+            mask.shape + (1,) * (upd.ndim - 2)), upd, 0)
+        b = jnp.arange(xa.shape[0], dtype=jnp.int32)[:, None]
+        b = jnp.broadcast_to(b, idx.shape)
+        return xa.at[b, idx].add(upd)
+
+    prim = primitive(name="sequence_scatter", nondiff=(1, 3))(fn)
+    return prim(input, index, updates, lengths)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, lengths=None,
+                       name=None):
+    """Sliding windows of ids (reference sequence_enumerate_op):
+    [B, T] int -> [B, T, win_size] where out[i, t] =
+    input[i, t:t+win] (pad past the valid length)."""
+    input = ensure_tensor(input)
+    lengths = _default_lengths(input, lengths)
+    win = int(win_size)
+
+    def fn(xa, ln):
+        t = xa.shape[1]
+        tpos = jnp.arange(t, dtype=jnp.int32)[:, None]  # [T, 1]
+        wpos = jnp.arange(win, dtype=jnp.int32)[None, :]  # [1, W]
+        src = tpos + wpos  # [T, W]
+        valid = src[None] < ln.astype(jnp.int32)[:, None, None]
+        src_c = jnp.clip(src, 0, t - 1)
+        gathered = xa[:, src_c]  # [B, T, W]
+        return jnp.where(valid, gathered,
+                         jnp.asarray(pad_value, xa.dtype))
+
+    prim = primitive(name="sequence_enumerate", nondiff=(1,))(fn)
+    return prim(input, lengths)
+
+
+def sequence_reshape(input, new_dim, lengths=None, name=None):
+    """Reshape the feature dim by regrouping timesteps (reference
+    sequence_reshape_op).  Dense form: requires T*D divisible by
+    new_dim; lengths scale by D/new_dim."""
+    input = ensure_tensor(input)
+    lengths = _default_lengths(input, lengths)
+    d = int(input.shape[-1])
+    nd = int(new_dim)
+    t = int(input.shape[1])
+    if (t * d) % nd != 0:
+        raise ValueError(
+            f"sequence_reshape: T*D ({t}*{d}) not divisible by new_dim "
+            f"{nd} (reference sequence_reshape_op enforce)")
+
+    def fn(xa, ln):
+        b = xa.shape[0]
+        out = xa.reshape(b, (t * d) // nd, nd)
+        new_len = (ln.astype(jnp.int32) * d) // nd
+        return out, new_len
+
+    prim = primitive(name="sequence_reshape", nondiff=(1,))(fn)
+    return prim(input, lengths)
+
+
+def sequence_conv(input, weight, bias=None, context_length=3,
+                  context_start=None, padding_value=0.0, lengths=None,
+                  name=None):
+    """Context-window conv over time (reference sequence_conv_op):
+    each step concatenates `context_length` neighbouring frames starting
+    at `context_start` (default -(len-1)//2) and projects by `weight`
+    [context_length * D, M].  The reference creates weight from
+    param_attr; pass it explicitly."""
+    input = ensure_tensor(input)
+    weight = ensure_tensor(weight)
+    lengths = _default_lengths(input, lengths)
+    cl = int(context_length)
+    cs = -((cl - 1) // 2) if context_start is None else int(context_start)
+    args = [input, weight, lengths]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+
+    def fn(xa, w, ln, *b):
+        bsz, t, d = xa.shape
+        tpos = jnp.arange(t, dtype=jnp.int32)[:, None]
+        wpos = jnp.arange(cl, dtype=jnp.int32)[None, :]
+        src = tpos + wpos + cs  # [T, CL]
+        src_c = jnp.clip(src, 0, t - 1)
+        ctx = xa[:, src_c]  # [B, T, CL, D]
+        # a context frame is real iff 0 <= src < length_i; else pad value
+        in_seq = ((src[None] >= 0)
+                  & (src[None] < ln.astype(jnp.int32)[:, None, None]))
+        ctx = jnp.where(in_seq[..., None], ctx,
+                        jnp.asarray(padding_value, xa.dtype))
+        out = ctx.reshape(bsz, t, cl * d) @ w
+        if b:
+            out = out + b[0]
+        valid_t = (jnp.arange(t, dtype=jnp.int32)[None, :]
+                   < ln.astype(jnp.int32)[:, None])
+        return jnp.where(valid_t[..., None], out, 0)
+
+    prim = primitive(name="sequence_conv", nondiff=(2,))(fn)
+    return prim(*args)

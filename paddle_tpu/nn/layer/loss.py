@@ -213,7 +213,12 @@ class HSigmoidLoss(Layer):
         self.bias = self.create_parameter(
             [num_classes - 1], is_bias=True,
             default_initializer=I.Constant(0.0))
-        # complete-binary-tree paths: node ids and left/right codes per class
+        self._build_tree()
+
+    def _build_tree(self):
+        """Complete-binary-tree paths: node ids and left/right codes per
+        class (also called by the functional hsigmoid_loss)."""
+        num_classes, d = self.num_classes, self.depth
         paths = np.zeros((num_classes, d), np.int32)
         codes = np.zeros((num_classes, d), np.float32)
         mask = np.zeros((num_classes, d), np.float32)

@@ -130,3 +130,73 @@ def test_train_step_accepts_device_arrays():
     l0 = float(step.step([x], [y]).numpy())
     l1 = float(step.step([x], [y]).numpy())
     assert l1 < l0
+
+
+class TestFluidSubmodules:
+    def test_nets_simple_img_conv_pool(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.fluid as fluid
+        import numpy as np
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                img = fluid.layers.data("img", [2, 1, 8, 8],
+                                        append_batch_size=False)
+                out = fluid.nets.simple_img_conv_pool(
+                    img, num_filters=4, filter_size=3, pool_size=2,
+                    pool_stride=2, act="relu")
+            exe = fluid.Executor()
+            exe.run(startup)
+            (res,) = exe.run(
+                main,
+                feed={"img": np.random.RandomState(0).randn(
+                    2, 1, 8, 8).astype("float32")},
+                fetch_list=[out])
+            assert np.asarray(res).shape == (2, 4, 3, 3)
+        finally:
+            paddle.disable_static()
+
+    def test_nets_glu_and_attention(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.fluid as fluid
+        import numpy as np
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 6).astype("float32"))
+        out = fluid.nets.glu(x, dim=-1)
+        assert out.shape == [2, 3]
+        q = paddle.to_tensor(
+            np.random.RandomState(2).randn(2, 5, 8).astype("float32"))
+        att = fluid.nets.scaled_dot_product_attention(q, q, q, num_heads=2)
+        assert att.shape == [2, 5, 8]
+
+    def test_average(self):
+        import paddle_tpu.fluid as fluid
+        wa = fluid.average.WeightedAverage()
+        wa.add(2.0, weight=1)
+        wa.add(4.0, weight=3)
+        assert abs(wa.eval() - 3.5) < 1e-6
+
+    def test_backward_module(self):
+        import paddle_tpu.fluid as fluid
+        assert callable(fluid.backward.append_backward)
+        assert callable(fluid.backward.gradients)
+
+    def test_unique_name(self):
+        import paddle_tpu.fluid as fluid
+        a = fluid.unique_name.generate("w")
+        b = fluid.unique_name.generate("w")
+        assert a != b
+
+    def test_transpiler_errors_helpfully(self):
+        import paddle_tpu.fluid as fluid
+        import pytest
+        t = fluid.transpiler.DistributeTranspiler()
+        with pytest.raises(NotImplementedError, match="fleet"):
+            t.transpile(0)
+
+    def test_deprecated_modules_error(self):
+        import paddle_tpu.fluid as fluid
+        import pytest
+        with pytest.raises(NotImplementedError, match="paddle.metric"):
+            fluid.evaluator.ChunkEvaluator

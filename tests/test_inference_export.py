@@ -73,3 +73,44 @@ def test_inference_artifact_ignores_later_param_updates(static_artifact):
     prog, feed_names, fetch_targets = static.load_inference_model(prefix)
     got1 = prog.run({"x": xv})[0]
     np.testing.assert_allclose(np.asarray(got1), ref, rtol=1e-5)
+
+
+class TestDynamicBatchExport:
+    """None/-1 dims export as shape-polymorphic StableHLO (reference:
+    save_inference_model supports batch-polymorphic feeds)."""
+
+    def test_jit_save_dynamic_batch_roundtrip(self, tmp_path):
+        import paddle_tpu
+        from paddle_tpu import nn, static
+        paddle_tpu.seed(11)
+        net = nn.Sequential(nn.Linear(6, 12), nn.ReLU(), nn.Linear(12, 2))
+        net.eval()
+        prefix = str(tmp_path / "dyn")
+        paddle_tpu.jit.save(
+            net, prefix,
+            input_spec=[static.InputSpec([None, 6], "float32", "x")])
+        loaded = paddle_tpu.jit.load(prefix)
+        for b in (1, 3, 17):
+            x = np.random.RandomState(b).randn(b, 6).astype("float32")
+            np.testing.assert_allclose(
+                loaded(paddle_tpu.to_tensor(x)).numpy(),
+                net(paddle_tpu.to_tensor(x)).numpy(),
+                rtol=1e-5, atol=1e-5)
+
+    def test_predictor_on_dynamic_artifact(self, tmp_path):
+        import paddle_tpu
+        from paddle_tpu import nn, static
+        from paddle_tpu.inference import Config, create_predictor
+        paddle_tpu.seed(12)
+        net = nn.Linear(5, 4)
+        net.eval()
+        prefix = str(tmp_path / "dynp")
+        paddle_tpu.jit.save(
+            net, prefix,
+            input_spec=[static.InputSpec([None, 5], "float32", "x")])
+        pred = create_predictor(Config(prefix))
+        x = np.random.RandomState(0).randn(7, 5).astype("float32")
+        (out,) = pred.run([x])
+        np.testing.assert_allclose(
+            out, net(paddle_tpu.to_tensor(x)).numpy(),
+            rtol=1e-5, atol=1e-5)

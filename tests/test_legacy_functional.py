@@ -1,0 +1,415 @@
+"""Transitional fluid-era functionals (nn/functional/legacy.py, the new
+sequence ops, and the fluid.layers 1.x wrappers).
+
+Mirrors the reference's OpTest pattern: numpy reference values, plus the
+fluid.layers resolution-chain behavior."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+T = paddle.to_tensor
+
+
+class TestActivationVariants:
+    def test_soft_relu(self):
+        x = np.array([[-50.0, 0.0, 2.0, 50.0]], np.float32)
+        out = F.soft_relu(T(x), threshold=40.0).numpy()
+        want = np.log1p(np.exp(np.clip(x, -40, 40)))
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    def test_inplace_relu(self):
+        x = T(np.array([-1.0, 2.0], np.float32))
+        y = F.relu_(x)
+        assert y is x
+        np.testing.assert_allclose(x.numpy(), [0.0, 2.0])
+
+    def test_tanh_alias(self):
+        x = T(np.array([0.5], np.float32))
+        F.tanh_(x)
+        np.testing.assert_allclose(x.numpy(), np.tanh([0.5]), rtol=1e-6)
+
+
+class TestLosses:
+    def test_smooth_l1(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 3).astype(np.float32)
+        y = rng.randn(4, 3).astype(np.float32)
+        out = F.smooth_l1(T(x), T(y)).numpy()
+        d = x - y
+        ad = np.abs(d)
+        per = np.where(ad < 1, 0.5 * d * d, ad - 0.5)
+        np.testing.assert_allclose(out, per.sum(1, keepdims=True),
+                                   rtol=1e-5)
+        assert out.shape == (4, 1)
+
+    def test_bpr_loss(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(3, 5).astype(np.float32)
+        lab = np.array([[0], [2], [4]], np.int64)
+        out = F.bpr_loss(T(x), T(lab)).numpy()
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+        want = np.zeros((3, 1), np.float32)
+        for i in range(3):
+            s = 0.0
+            for j in range(5):
+                if j != lab[i, 0]:
+                    s += np.log(sig(x[i, lab[i, 0]] - x[i, j]))
+            want[i, 0] = -s / 4
+        np.testing.assert_allclose(out, want, rtol=1e-4)
+
+    def test_huber_loss(self):
+        x = np.array([[0.0], [3.0]], np.float32)
+        y = np.array([[0.5], [0.0]], np.float32)
+        out = fluid.layers.huber_loss(T(x), T(y), delta=1.0).numpy()
+        np.testing.assert_allclose(out, [[0.125], [2.5]], rtol=1e-5)
+
+    def test_center_loss_updates_centers(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(4, 6).astype(np.float32)
+        lab = np.array([0, 1, 0, 2], np.int64)
+        centers = np.zeros((3, 6), np.float32)
+        loss, new_c = F.center_loss(T(x), T(lab), 3, 0.5, T(centers))
+        assert loss.shape == [4, 1]
+        assert not np.allclose(new_c.numpy(), centers)
+
+    def test_sigmoid_ce_with_logits_ignore(self):
+        x = np.array([[0.5, -1.0]], np.float32)
+        lab = np.array([[1.0, -100.0]], np.float32)
+        out = fluid.layers.sigmoid_cross_entropy_with_logits(
+            T(x), T(lab), ignore_index=-100).numpy()
+        want0 = np.log1p(np.exp(-0.5))
+        np.testing.assert_allclose(out[0, 0], want0, rtol=1e-5)
+        assert out[0, 1] == 0.0
+
+    def test_rank_and_margin_rank(self):
+        lab = np.array([[1.0]], np.float32)
+        left = np.array([[2.0]], np.float32)
+        right = np.array([[1.0]], np.float32)
+        r = fluid.layers.rank_loss(T(lab), T(left), T(right)).numpy()
+        np.testing.assert_allclose(r, np.log1p(np.exp(1.0)) - 1.0,
+                                   rtol=1e-5)
+        m = fluid.layers.margin_rank_loss(
+            T(lab), T(left), T(right), margin=0.5).numpy()
+        np.testing.assert_allclose(m, [[0.0]])
+
+
+class TestChannelOps:
+    def test_affine_channel(self):
+        x = np.arange(12, dtype=np.float32).reshape(1, 3, 2, 2)
+        s = np.array([1.0, 2.0, 0.5], np.float32)
+        b = np.array([0.0, 1.0, -1.0], np.float32)
+        out = F.affine_channel(T(x), T(s), T(b)).numpy()
+        want = x * s[None, :, None, None] + b[None, :, None, None]
+        np.testing.assert_allclose(out, want)
+
+    def test_space_to_depth_roundtrip_shape(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = F.space_to_depth(T(x), 2)
+        assert out.shape == [1, 4, 2, 2]
+
+    def test_shuffle_channel(self):
+        x = np.arange(8, dtype=np.float32).reshape(1, 4, 1, 2)
+        out = F.shuffle_channel(T(x), 2).numpy()
+        # groups [0,1] [2,3] -> interleaved [0,2,1,3]
+        np.testing.assert_allclose(out[0, :, 0, 0], [0, 4, 2, 6])
+
+    def test_temporal_shift_identity_shape(self):
+        x = np.random.RandomState(0).randn(6, 4, 2, 2).astype(np.float32)
+        out = F.temporal_shift(T(x), seg_num=2, shift_ratio=0.25).numpy()
+        assert out.shape == x.shape
+        # last un-shifted channels pass through
+        np.testing.assert_allclose(out[:, 2:], x.reshape(3, 2, 4, 2, 2)
+                                   [:, :, 2:].reshape(6, 2, 2, 2))
+
+
+class TestSequenceOps:
+    def test_first_last_step(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        ln = np.array([2, 3], np.int64)
+        first = F.sequence_first_step(T(x), lengths=T(ln)).numpy()
+        last = F.sequence_last_step(T(x), lengths=T(ln)).numpy()
+        np.testing.assert_allclose(first, x[:, 0])
+        np.testing.assert_allclose(last[0], x[0, 1])
+        np.testing.assert_allclose(last[1], x[1, 2])
+
+    def test_sequence_concat(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)[:, :, None]
+        b = 10 + np.arange(4, dtype=np.float32).reshape(2, 2)[:, :, None]
+        la = np.array([2, 1], np.int64)
+        lb = np.array([1, 2], np.int64)
+        out, ln = F.sequence_concat([T(a), T(b)], lengths=[T(la), T(lb)])
+        np.testing.assert_allclose(ln.numpy(), [3, 3])
+        np.testing.assert_allclose(out.numpy()[0, :3, 0], [0, 1, 10])
+        np.testing.assert_allclose(out.numpy()[1, :3, 0], [3, 12, 13])
+
+    def test_sequence_slice(self):
+        x = np.arange(20, dtype=np.float32).reshape(2, 5, 2)
+        off = np.array([1, 0], np.int64)
+        ln = np.array([2, 3], np.int64)
+        out, lens = F.sequence_slice(T(x), T(off), T(ln))
+        np.testing.assert_allclose(lens.numpy(), [2, 3])
+        np.testing.assert_allclose(out.numpy()[0, :2], x[0, 1:3])
+        np.testing.assert_allclose(out.numpy()[1], x[1, 0:3])
+
+    def test_sequence_enumerate(self):
+        x = np.array([[1, 2, 3, 0]], np.int64)
+        ln = np.array([3], np.int64)
+        out = F.sequence_enumerate(T(x), 2, pad_value=0,
+                                   lengths=T(ln)).numpy()
+        np.testing.assert_allclose(out[0, 0], [1, 2])
+        np.testing.assert_allclose(out[0, 1], [2, 3])
+        np.testing.assert_allclose(out[0, 2], [3, 0])
+
+    def test_sequence_expand_as(self):
+        x = np.array([[1.0], [2.0]], np.float32)
+        yl = np.array([2, 3], np.int64)
+        out = F.sequence_expand_as(T(x), T(yl)).numpy()
+        assert out.shape == (2, 3, 1)
+        np.testing.assert_allclose(out[0, :, 0], [1, 1, 0])
+        np.testing.assert_allclose(out[1, :, 0], [2, 2, 2])
+
+    def test_sequence_scatter(self):
+        x = np.zeros((1, 5), np.float32)
+        idx = np.array([[1, 3]], np.int64)
+        upd = np.array([[5.0, 7.0]], np.float32)
+        out = F.sequence_scatter(T(x), T(idx), T(upd)).numpy()
+        np.testing.assert_allclose(out[0], [0, 5, 0, 7, 0])
+
+    def test_sequence_reshape(self):
+        x = np.arange(12, dtype=np.float32).reshape(1, 3, 4)
+        ln = np.array([2], np.int64)
+        out, new_ln = F.sequence_reshape(T(x), 6, lengths=T(ln))
+        assert out.shape == [1, 2, 6]
+        np.testing.assert_allclose(new_ln.numpy(), [1])  # 2*4//6 -> 1
+
+    def test_sequence_conv_identity_kernel(self):
+        x = np.random.RandomState(0).randn(1, 4, 3).astype(np.float32)
+        ln = np.array([3], np.int64)
+        # context window 1 with identity weight reproduces valid steps
+        w = np.eye(3, dtype=np.float32)
+        out = F.sequence_conv(T(x), T(w), context_length=1,
+                              context_start=0, lengths=T(ln)).numpy()
+        np.testing.assert_allclose(out[0, :3], x[0, :3], rtol=1e-5)
+        np.testing.assert_allclose(out[0, 3], 0.0)
+
+
+class TestDetectionHelpers:
+    def test_box_clip(self):
+        boxes = np.array([[-5.0, -5.0, 20.0, 20.0]], np.float32)
+        im = np.array([[10.0, 10.0, 1.0]], np.float32)
+        out = F.box_clip(T(boxes), T(im)).numpy()
+        np.testing.assert_allclose(out, [[0, 0, 9, 9]])
+
+    def test_iou_similarity(self):
+        a = np.array([[0, 0, 2, 2]], np.float32)
+        b = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+        out = fluid.layers.iou_similarity(T(a), T(b)).numpy()
+        np.testing.assert_allclose(out[0, 0], 1.0, rtol=1e-5)
+        np.testing.assert_allclose(out[0, 1], 1.0 / 7.0, rtol=1e-4)
+
+    def test_bipartite_match_and_target_assign(self):
+        d = np.array([[0.9, 0.1], [0.2, 0.8]], np.float32)
+        idx, dist = F.bipartite_match(T(d))
+        np.testing.assert_allclose(idx.numpy(), [[0, 1]])
+        np.testing.assert_allclose(dist.numpy(), [[0.9, 0.8]], rtol=1e-6)
+        tgt = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        out, w = F.target_assign(T(tgt), idx)
+        np.testing.assert_allclose(out.numpy()[0], tgt)
+        np.testing.assert_allclose(w.numpy()[0, :, 0], [1, 1])
+
+    def test_anchor_generator_shapes(self):
+        x = paddle.zeros([1, 8, 4, 4])
+        anchors, var = F.anchor_generator(
+            x, anchor_sizes=[64.0], aspect_ratios=[1.0], stride=[16, 16])
+        assert anchors.shape == [4, 4, 1, 4]
+        assert var.shape == [4, 4, 1, 4]
+
+    def test_matrix_nms_smoke(self):
+        boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                           [20, 20, 30, 30]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.85, 0.8]
+        out, nums = fluid.layers.matrix_nms(
+            T(boxes), T(scores), score_threshold=0.1, post_threshold=0.0,
+            nms_top_k=10, keep_top_k=5, background_label=0)
+        assert out.numpy().shape[1] == 6
+        assert int(nums.numpy()[0]) == 3
+
+    def test_mean_iou(self):
+        pred = np.array([0, 1, 1, 0], np.int64)
+        lab = np.array([0, 1, 0, 0], np.int64)
+        miou, wrong, correct = fluid.layers.mean_iou(T(pred), T(lab), 2)
+        # class0: inter 2, union 3; class1: inter 1, union 2
+        np.testing.assert_allclose(miou.numpy(),
+                                   (2 / 3 + 1 / 2) / 2, rtol=1e-5)
+
+    def test_ctc_greedy_decoder(self):
+        probs = np.zeros((1, 5, 3), np.float32)
+        # argmax path: 1 1 0(blank) 2 2 -> decode [1, 2]
+        for t, c in enumerate([1, 1, 0, 2, 2]):
+            probs[0, t, c] = 1.0
+        out, ln = fluid.layers.ctc_greedy_decoder(T(probs), blank=0)
+        assert int(ln.numpy()[0]) == 2
+        np.testing.assert_allclose(out.numpy()[0, :2], [1, 2])
+
+
+class TestRNNUnits:
+    def test_gru_unit_matches_cell_math(self):
+        rng = np.random.RandomState(3)
+        d = 4
+        x = rng.randn(2, 3 * d).astype(np.float32)
+        h = rng.randn(2, d).astype(np.float32)
+        whh = rng.randn(d, 3 * d).astype(np.float32)
+        new_h, rh, gate = F.gru_unit(T(x), T(h), T(whh))
+        assert new_h.shape == [2, d]
+        hh = h @ whh
+        xr, xz, xn = np.split(x, 3, axis=1)
+        hr, hz, hn = np.split(hh, 3, axis=1)
+        sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+        r, z = sig(xr + hr), sig(xz + hz)
+        n = np.tanh(xn + r * hn)
+        np.testing.assert_allclose(new_h.numpy(), (1 - z) * n + z * h,
+                                   rtol=1e-4)
+
+    def test_lstm_unit(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, 3).astype(np.float32)
+        h = rng.randn(2, 4).astype(np.float32)
+        c = rng.randn(2, 4).astype(np.float32)
+        w = rng.randn(7, 16).astype(np.float32)
+        nh, nc = F.lstm_unit(T(x), T(h), T(c), weight=T(w))
+        assert nh.shape == [2, 4] and nc.shape == [2, 4]
+
+    def test_dynamic_gru_runs(self):
+        rng = np.random.RandomState(5)
+        d = 3
+        x = rng.randn(2, 4, 3 * d).astype(np.float32)
+        w = rng.randn(d, 3 * d).astype(np.float32)
+        out = F.dynamic_gru(T(x), d, T(w))
+        assert out.shape == [2, 4, d]
+
+    def test_functional_rnn_driver(self):
+        cell = nn.GRUCell(4, 5)
+        x = np.random.RandomState(6).randn(2, 3, 4).astype(np.float32)
+        out, state = F.rnn(cell, T(x))
+        assert out.shape == [2, 3, 5]
+
+
+class TestHSigmoidFunctional:
+    def test_matches_layer(self):
+        paddle.seed(0)
+        layer = nn.HSigmoidLoss(6, 8)
+        x = np.random.RandomState(7).randn(3, 6).astype(np.float32)
+        lab = np.array([1, 5, 7], np.int64)
+        want = layer(T(x), T(lab)).numpy()
+        got = F.hsigmoid_loss(T(x), T(lab), 8, layer.weight,
+                              layer.bias).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+class TestFluidLayerChain:
+    def test_resolution_chain(self):
+        # names resolved through the 2.0 surface
+        assert callable(fluid.layers.gelu)
+        assert callable(fluid.layers.argmax)
+        assert callable(fluid.layers.hard_swish)
+        with pytest.raises(AttributeError):
+            fluid.layers.definitely_not_an_op  # noqa: B018
+
+    def test_batch_size_like(self):
+        x = paddle.zeros([5, 2])
+        out = fluid.layers.fill_constant_batch_size_like(
+            x, [1, 7], "float32", 3.0)
+        assert out.shape == [5, 7]
+        np.testing.assert_allclose(out.numpy()[0, 0], 3.0)
+
+    def test_misc_wrappers(self):
+        out = fluid.layers.range(0, 6, 2, "int64")
+        np.testing.assert_allclose(out.numpy(), [0, 2, 4])
+        x = paddle.to_tensor(np.array([[1.0, 2.0]], np.float32))
+        assert int(fluid.layers.size(x).numpy()) == 2
+        r = fluid.layers.reverse(paddle.to_tensor(
+            np.array([1.0, 2.0, 3.0], np.float32)), axis=0)
+        np.testing.assert_allclose(r.numpy(), [3, 2, 1])
+        u, idx, cnt = fluid.layers.unique_with_counts(
+            paddle.to_tensor(np.array([1, 1, 2], np.int64)))
+        np.testing.assert_allclose(cnt.numpy(), [2, 1])
+
+    def test_clip_by_norm(self):
+        x = np.array([3.0, 4.0], np.float32)
+        out = fluid.layers.clip_by_norm(T(x), 1.0).numpy()
+        np.testing.assert_allclose(np.linalg.norm(out), 1.0, rtol=1e-5)
+
+    def test_step_counter(self):
+        a = int(F.autoincreased_step_counter("t_ctr").numpy()[0])
+        b = int(F.autoincreased_step_counter("t_ctr").numpy()[0])
+        assert b == a + 1
+
+    def test_warpctc_alias(self):
+        # paddle CTC layout: [T, B, C]
+        logits = np.random.RandomState(8).randn(8, 2, 5).astype(np.float32)
+        labels = np.array([[1, 2], [3, 4]], np.int64)
+        ll = np.array([8, 8], np.int64)
+        tl = np.array([2, 2], np.int64)
+        out = F.warpctc(T(logits), T(labels), blank=0,
+                        input_length=T(ll), label_length=T(tl))
+        assert out.shape[0] == 2
+
+
+class TestReviewRegressions2:
+    def test_inplace_ops_keep_gradients(self):
+        # relu_ must contribute its VJP, not an identity (review finding)
+        x = T(np.array([-1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        z = x * 3.0
+        F.relu_(z)
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 3.0])
+
+    def test_tanh_inplace_grad(self):
+        x = T(np.array([0.3, -0.7], np.float32))
+        x.stop_gradient = False
+        z = x * 1.0
+        paddle.tanh_(z)
+        z.sum().backward()
+        np.testing.assert_allclose(
+            x.grad.numpy(), 1 - np.tanh([0.3, -0.7]) ** 2, rtol=1e-5)
+
+    def test_matrix_nms_suppresses_duplicates(self):
+        # overlapping same-class boxes must decay (axis bug regression)
+        boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10.000001]]],
+                         np.float32)
+        scores = np.zeros((1, 2, 2), np.float32)
+        scores[0, 1] = [0.9, 0.9]
+        out, nums = fluid.layers.matrix_nms(
+            T(boxes), T(scores), score_threshold=0.1, post_threshold=0.5,
+            nms_top_k=10, keep_top_k=5, background_label=0)
+        assert int(nums.numpy()[0]) == 1  # duplicate decayed below 0.5
+
+    def test_psroi_pool_batch_mapping(self):
+        x = np.zeros((2, 4, 4, 4), np.float32)
+        x[1] = 1.0
+        rois = np.array([[0., 0., 3., 3.], [0., 0., 3., 3.]], np.float32)
+        out = F.psroi_pool(
+            T(x), T(rois), 1, 1.0, 2, 2,
+            rois_num=T(np.array([1, 1], np.int64))).numpy()
+        assert np.allclose(out[0], 0.0) and np.all(out[1] > 0)
+
+    def test_lrn_matches_direct_formula(self):
+        x = np.random.RandomState(0).rand(1, 4, 3, 3).astype(np.float32)
+        out = fluid.layers.lrn(T(x), n=3, k=1.0, alpha=0.1,
+                               beta=0.75).numpy()
+        # direct: x / (k + alpha * sum_{window} x^2)^beta
+        sq = x ** 2
+        acc = np.zeros_like(x)
+        for c in range(4):
+            lo, hi = max(0, c - 1), min(4, c + 2)
+            acc[:, c] = sq[:, lo:hi].sum(axis=1)
+        want = x / (1.0 + 0.1 * acc) ** 0.75
+        np.testing.assert_allclose(out, want, rtol=1e-4)

@@ -126,3 +126,43 @@ def test_fleet_builder_selects_meta_optimizer(mesh):
         _model(8), optimizer.SGD(learning_rate=0.1), loss_fn=MSE(),
         strategy=strategy2, mesh=mesh)
     assert isinstance(step2, DGCStep)
+
+
+def test_adaptive_localsgd_trains_and_adapts(mesh):
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        AdaptiveLocalSGDStep)
+    x, y = _problem(2)
+    net = _model(5)
+    step = AdaptiveLocalSGDStep(
+        net, optimizer.SGD(learning_rate=0.1,
+                           parameters=net.parameters()),
+        loss_fn=MSE(), mesh=mesh, init_k_steps=2)
+    l0 = float(step.step([x], [y]).numpy())
+    for _ in range(30):
+        l = float(step.step([x], [y]).numpy())
+    assert l < l0 * 0.5
+    # interval adapted: as loss falls with fixed lr, the reference rule
+    # k = ceil(sqrt(lr0*loss/(lr*loss0) * init_k)) shrinks toward 1
+    assert 1 <= step.k_steps <= step.max_k_steps
+    assert step._last_sync > 0
+    # ranks hold identical params right after a forced sync
+    step._sync_params()
+    w = np.asarray(step.params[step.pnames[0]])
+    for r in range(1, w.shape[0]):
+        np.testing.assert_allclose(w[r], w[0], rtol=1e-6)
+
+
+def test_fleet_builder_selects_adaptive_localsgd(mesh):
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        AdaptiveLocalSGDStep)
+    strategy = fleet.DistributedStrategy()
+    strategy.adaptive_localsgd = True
+    strategy.adaptive_localsgd_configs = {"init_k_steps": 2,
+                                          "begin_step": 1}
+    net = _model(9)
+    step = fleet.build_train_step(
+        net, optimizer.SGD(learning_rate=0.1,
+                           parameters=net.parameters()),
+        loss_fn=MSE(), strategy=strategy, mesh=mesh)
+    assert isinstance(step, AdaptiveLocalSGDStep)
+    assert step.init_k_steps == 2
