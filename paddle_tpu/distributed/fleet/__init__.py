@@ -170,3 +170,48 @@ def save_inference_model(model, dirname, input_spec=None, **kwargs):
     from ... import jit as jit_mod
     jit_mod.save(model, os.path.join(dirname, "model"),
                  input_spec=input_spec)
+
+
+# -- 1.x-visible classes & modules ----------------------------------------
+from .util import UtilBase  # noqa: E402,F401
+from .data_generator import (  # noqa: E402,F401
+    DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator)
+from . import util as metrics  # noqa: E402,F401
+from . import data_generator  # noqa: E402,F401
+
+# fleet.util — UtilBase singleton attribute (reference: fleet_base.py
+# exposes `util` as a property on the fleet object, so user code writes
+# `fleet.util.all_reduce(...)`)
+util = UtilBase()
+_util_instance = util
+
+
+class Fleet:
+    """Class facade over this module's singleton state (the reference's
+    ``fleet`` object is a Fleet instance; here the module IS the
+    singleton, and this class delegates for scripts that instantiate or
+    isinstance-check it)."""
+
+    def __init__(self):
+        self.util = _util_instance
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        return init(role_maker, is_collective, strategy)
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def worker_index(self):
+        return worker_index()
+
+    def worker_num(self):
+        return worker_num()
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    def save_persistables(self, *a, **k):
+        return save_persistables(*a, **k)

@@ -21,7 +21,7 @@ from .. import (  # noqa: F401
     CPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace,
     LoDTensor, LoDTensorArray)
 from ..framework.io import save, load  # noqa: F401
-from .. import optimizer  # noqa: F401
+from . import optimizer  # noqa: F401  (1.x *Optimizer names + EMA etc.)
 from .. import io  # noqa: F401
 from .. import regularizer  # noqa: F401
 from ..nn import initializer  # noqa: F401

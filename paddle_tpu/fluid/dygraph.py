@@ -62,3 +62,239 @@ class Pool2D(Layer):
                       pool_stride=stride, pool_padding=pad,
                       global_pooling=gp, ceil_mode=ceil,
                       exclusive=excl, data_format=fmt)
+
+
+# -- base mode switches (reference: fluid/dygraph/base.py) ----------------
+def enable_dygraph(place=None):
+    from ..static.program import disable_static
+    disable_static()
+
+
+def disable_dygraph():
+    from ..static.program import enable_static
+    enable_static()
+
+
+def grad(*args, **kwargs):
+    import paddle_tpu as _p
+    return _p.grad(*args, **kwargs)
+
+
+def no_grad(fn=None):
+    from ..core import autograd
+    if fn is None:
+        return autograd.no_grad()
+    return autograd.no_grad()(fn)
+
+
+no_grad_ = no_grad
+
+
+# -- 1.x dygraph nn layer names (reference: fluid/dygraph/nn.py) ----------
+from ..nn import (  # noqa: F401,E402
+    Conv2DTranspose, Conv3D, Conv3DTranspose,
+    Flatten, GroupNorm, SpectralNorm, ParameterList, Sequential as _Seq)
+from ..nn import Bilinear as BilinearTensorProduct  # noqa: F401,E402
+from ..nn import PReLU as PRelu  # noqa: F401,E402
+from ..nn import InstanceNorm2D as InstanceNorm  # noqa: F401,E402
+from ..nn import NCELoss as NCE  # noqa: F401,E402
+
+
+class GRUUnit(Layer):
+    """1.x GRUUnit layer (reference: fluid/dygraph/nn.py GRUUnit over
+    gru_unit_op) — single GRU step on pre-projected gate input."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__()
+        d = size // 3
+        self._size = d
+        self._activation = activation
+        self._gate_activation = gate_activation
+        self._origin_mode = origin_mode
+        from ..nn import initializer as I
+        self.weight = self.create_parameter(
+            [d, 3 * d], attr=param_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter([3 * d], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, hidden):
+        from ..nn.functional import gru_unit
+        return gru_unit(input, hidden, self.weight, self.bias,
+                        activation=self._activation,
+                        gate_activation=self._gate_activation,
+                        origin_mode=self._origin_mode)
+
+
+class TreeConv(Layer):
+    """Tree-based convolution (reference: fluid/dygraph/nn.py TreeConv
+    over tree_conv_op.cc): continuous binary-tree patch conv.  Nodes
+    [B, N, D] with adjacency edges [B, E, 2]; each node aggregates its
+    children through 3 positional weight matrices."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        from ..nn import initializer as I
+        self.max_depth = max_depth
+        self.act = act
+        # 3 positional roles (self / left-weighted / right-weighted)
+        self.weight = self.create_parameter(
+            [3, feature_size, num_filters * output_size], attr=param_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter(
+            [num_filters * output_size], attr=bias_attr, is_bias=True)
+        self._out = (num_filters, output_size)
+
+    def forward(self, nodes_vector, edge_set):
+        import jax.numpy as jnp
+        from ..core.dispatch import primitive, ensure_tensor
+        nodes = ensure_tensor(nodes_vector)
+        edges = ensure_tensor(edge_set)
+        nf, out = self._out
+
+        @primitive(name="tree_conv", nondiff=(1,))
+        def fn(x, e, w, b):
+            bsz, n, d = x.shape
+            e = e.astype(jnp.int32)
+            parent, child = e[..., 0], e[..., 1]
+            deg = jnp.zeros((bsz, n), x.dtype)
+            bidx = jnp.broadcast_to(
+                jnp.arange(bsz)[:, None], parent.shape)
+            deg = deg.at[bidx, parent].add(1.0)
+            # aggregate children features per parent
+            agg = jnp.zeros_like(x)
+            agg = agg.at[bidx, parent].add(
+                jnp.take_along_axis(x, child[..., None], axis=1))
+            self_t = x @ w[0]
+            left_t = agg @ w[1]
+            right_t = (agg / jnp.maximum(deg, 1.0)[..., None]) @ w[2]
+            y = self_t + left_t + right_t + b
+            return y.reshape(bsz, n, nf, out).max(axis=2)
+
+        y = fn(nodes, edges, self.weight, self.bias)
+        if self.act:
+            from ..nn import functional as F
+            y = getattr(F, self.act)(y)
+        return y
+
+
+# -- 1.x LR scheduler names (reference: dygraph/learning_rate_scheduler.py)
+from ..optimizer.lr import (  # noqa: F401,E402
+    ExponentialDecay, InverseTimeDecay, LambdaDecay, MultiStepDecay,
+    NaturalExpDecay, NoamDecay, PiecewiseDecay, PolynomialDecay,
+    StepDecay)
+from ..optimizer.lr import CosineAnnealingDecay as CosineDecay  # noqa: F401,E402
+from ..optimizer.lr import LinearWarmup as LinearLrWarmup  # noqa: F401,E402
+from ..optimizer.lr import ReduceOnPlateau as ReduceLROnPlateau  # noqa: F401,E402
+
+
+class StaticModelRunner:
+    """reference: fluid/dygraph/static_runner.py — runs a saved inference
+    program inside dygraph; jit.load returns the modern equivalent."""
+
+    def __new__(cls, model_dir, model_filename=None, params_filename=None):
+        from .. import jit as _jit
+        import os as _os
+        base = model_dir
+        if model_filename:
+            base = _os.path.join(model_dir, model_filename)
+            if base.endswith(".pdmodel"):
+                base = base[:-len(".pdmodel")]
+        return _jit.load(base)
+
+
+# -- checkpoint helpers (reference: fluid/dygraph/checkpoint.py) ----------
+def save_dygraph(state_dict, model_path):
+    """reference: checkpoint.py save_dygraph — .pdparams/.pdopt suffix
+    chosen by content; optimizer state dicts always carry the '__step__'
+    counter (optimizer/__init__.py state_dict)."""
+    from ..framework.io import save as _save
+    is_opt = "__step__" in state_dict or "LR_Scheduler" in state_dict
+    suffix = ".pdopt" if is_opt else ".pdparams"
+    _save(state_dict, model_path + suffix)
+
+
+def load_dygraph(model_path):
+    """reference: checkpoint.py load_dygraph -> (param_dict, opt_dict)."""
+    import os as _os
+    from ..framework.io import load as _load
+    params = opt = None
+    if _os.path.exists(model_path + ".pdparams"):
+        params = _load(model_path + ".pdparams")
+    if _os.path.exists(model_path + ".pdopt"):
+        opt = _load(model_path + ".pdopt")
+    if params is None and opt is None:
+        raise ValueError(
+            f"load_dygraph: neither {model_path}.pdparams nor .pdopt "
+            "exists")
+    return params, opt
+
+
+# -- submodule layout parity (reference: fluid/dygraph/ is a package) -----
+import sys as _sys
+import types as _types
+
+
+def _dy_submodule(name, **attrs):
+    m = _types.ModuleType(f"{__name__}.{name}")
+    for k, v in attrs.items():
+        setattr(m, k, v)
+    _sys.modules[m.__name__] = m
+    globals()[name] = m
+    return m
+
+
+from ..core import autograd as _autograd  # noqa: E402
+from .. import jit as _jit_mod  # noqa: E402
+from .. import amp as _amp_mod  # noqa: E402
+from ..framework import io as _fio  # noqa: E402
+from ..nn.layer import rnn as _rnn_mod  # noqa: E402
+from ..distributed import parallel as _par_mod  # noqa: E402
+
+_dy_submodule("base", enable_dygraph=enable_dygraph,
+              disable_dygraph=disable_dygraph, grad=grad,
+              no_grad=no_grad, no_grad_=no_grad_,
+              to_variable=to_variable, guard=guard, enabled=enabled)
+_dy_submodule("nn", Linear=Linear, Embedding=Embedding, Conv2D=Conv2D,
+              BatchNorm=BatchNorm, LayerNorm=LayerNorm, Dropout=Dropout,
+              Pool2D=Pool2D, BilinearTensorProduct=BilinearTensorProduct,
+              Conv2DTranspose=Conv2DTranspose, Conv3D=Conv3D,
+              Conv3DTranspose=Conv3DTranspose, Flatten=Flatten,
+              GroupNorm=GroupNorm, InstanceNorm=InstanceNorm,
+              SpectralNorm=SpectralNorm, PRelu=PRelu, NCE=NCE,
+              GRUUnit=GRUUnit, TreeConv=TreeConv)
+_dy_submodule("container", Sequential=_Seq, ParameterList=ParameterList,
+              LayerList=LayerList)
+_dy_submodule("learning_rate_scheduler",
+              LearningRateDecay=LearningRateDecay,
+              ExponentialDecay=ExponentialDecay,
+              InverseTimeDecay=InverseTimeDecay, LambdaDecay=LambdaDecay,
+              MultiStepDecay=MultiStepDecay,
+              NaturalExpDecay=NaturalExpDecay, NoamDecay=NoamDecay,
+              PiecewiseDecay=PiecewiseDecay,
+              PolynomialDecay=PolynomialDecay, StepDecay=StepDecay,
+              CosineDecay=CosineDecay, LinearLrWarmup=LinearLrWarmup,
+              ReduceLROnPlateau=ReduceLROnPlateau)
+_dy_submodule("parallel", DataParallel=DataParallel,
+              ParallelEnv=ParallelEnv,
+              prepare_context=getattr(_par_mod, "prepare_context", None))
+_dy_submodule("jit", save=_jit_mod.save, load=_jit_mod.load,
+              to_static=_jit_mod.to_static, TracedLayer=TracedLayer)
+_dy_submodule("amp", auto_cast=_amp_mod.auto_cast,
+              amp_guard=_amp_mod.auto_cast,
+              GradScaler=_amp_mod.GradScaler)
+_dy_submodule("checkpoint", save_dygraph=save_dygraph,
+              load_dygraph=load_dygraph)
+_dy_submodule("io", save_dygraph=save_dygraph,
+              load_dygraph=load_dygraph)
+_dy_submodule("rnn", LSTMCell=_rnn_mod.LSTMCell,
+              GRUCell=_rnn_mod.GRUCell)
+_dy_submodule("tracer", Tracer=None)
+_dy_submodule("layers", Layer=Layer)
+_dy_submodule("dygraph_to_static",
+              ProgramTranslator=ProgramTranslator)
+_dy_submodule("static_runner", StaticModelRunner=StaticModelRunner)

@@ -1,0 +1,101 @@
+"""``fluid.optimizer`` — the 1.x optimizer surface.
+
+Reference parity: ``python/paddle/fluid/optimizer.py`` — the *Optimizer
+class names taking ``parameter_list`` (2.0 renamed it ``parameters``) and
+``regularization`` (→ ``weight_decay``), plus the utilities that file
+hosts (EMA, ModelAverage, Lookahead, Recompute, Pipeline).
+"""
+from __future__ import annotations
+
+import logging
+
+from ..optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adadelta, Adagrad,
+    RMSProp, Lamb, LarsMomentum, LRScheduler)
+from ..optimizer import lr  # noqa: F401
+from ..optimizer.extras import (  # noqa: F401
+    DecayedAdagrad, Ftrl, Dpsgd, ExponentialMovingAverage, ModelAverage,
+    LookaheadOptimizer)
+
+_LOG = logging.getLogger("paddle_tpu.fluid")
+
+
+def _compat(cls):
+    """Wrap a 2.0 optimizer class with the 1.x kwarg names."""
+
+    class Compat(cls):
+        def __init__(self, *args, **kwargs):
+            if "parameter_list" in kwargs:
+                kwargs["parameters"] = kwargs.pop("parameter_list")
+            if "regularization" in kwargs:
+                kwargs["weight_decay"] = kwargs.pop("regularization")
+            super().__init__(*args, **kwargs)
+
+    Compat.__name__ = cls.__name__ + "Optimizer"
+    Compat.__qualname__ = Compat.__name__
+    Compat.__doc__ = (f"1.x alias of paddle.optimizer.{cls.__name__} "
+                      "(parameter_list/regularization kwargs)")
+    return Compat
+
+
+SGDOptimizer = _compat(SGD)
+MomentumOptimizer = _compat(Momentum)
+AdagradOptimizer = _compat(Adagrad)
+AdamOptimizer = _compat(Adam)
+AdamaxOptimizer = _compat(Adamax)
+AdadeltaOptimizer = _compat(Adadelta)
+RMSPropOptimizer = _compat(RMSProp)
+LambOptimizer = _compat(Lamb)
+LarsMomentumOptimizer = _compat(LarsMomentum)
+DecayedAdagradOptimizer = _compat(DecayedAdagrad)
+FtrlOptimizer = _compat(Ftrl)
+DpsgdOptimizer = _compat(Dpsgd)
+
+
+class RecomputeOptimizer:
+    """reference: fluid/optimizer.py RecomputeOptimizer — rebuilt the
+    backward pass re-forwarding checkpoint segments.  Rematerialization is
+    a transform here (``fleet.utils.recompute`` / ``jax.checkpoint`` on
+    the segment), so this wrapper keeps the API and delegates the actual
+    optimization to the inner optimizer."""
+
+    def __init__(self, optimizer):
+        self.inner_optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+        _LOG.info(
+            "RecomputeOptimizer: wrap the checkpointed segments with "
+            "paddle.distributed.fleet.utils.recompute (jax.checkpoint) — "
+            "the backward rewrite is a transform, not a program pass")
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self.inner_optimizer.minimize(loss)
+
+
+class PipelineOptimizer:
+    """reference: fluid/optimizer.py:3718 PipelineOptimizer (GPipe
+    sections over device_guard programs).  The SPMD engine lives in
+    ``paddle_tpu.parallel.pipeline`` (PipelineLayer + TrainStep); this
+    wrapper keeps 1.x scripts importable and optimizes un-pipelined when
+    invoked directly."""
+
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        self.inner_optimizer = optimizer
+        self.num_microbatches = num_microbatches
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        _LOG.warning(
+            "PipelineOptimizer.minimize: running un-pipelined — build the "
+            "model as fleet.meta_parallel.PipelineLayer and train through "
+            "TrainStep for the SPMD pipeline schedule")
+        return self.inner_optimizer.minimize(loss)

@@ -1,1 +1,51 @@
 from . import io, random  # noqa: F401
+
+# reference: python/paddle/framework/__init__.py re-exports this core set
+from .io import save, load  # noqa: F401
+from ..core.device import CPUPlace, TPUPlace  # noqa: F401
+
+
+def _lazy():
+    import paddle_tpu as p
+    return p
+
+
+def get_default_dtype():
+    import paddle_tpu as p
+    return p.get_default_dtype()
+
+
+def set_default_dtype(d):
+    import paddle_tpu as p
+    return p.set_default_dtype(d)
+
+
+def create_parameter(*args, **kwargs):
+    import paddle_tpu as p
+    return p.create_parameter(*args, **kwargs)
+
+
+def grad(*args, **kwargs):
+    import paddle_tpu as p
+    return p.grad(*args, **kwargs)
+
+
+def seed(s):
+    import paddle_tpu as p
+    return p.seed(s)
+
+
+def no_grad(fn=None):
+    from ..core import autograd
+    return autograd.no_grad() if fn is None else autograd.no_grad()(fn)
+
+
+def __getattr__(name):
+    # CUDAPlace/CUDAPinnedPlace/ParamAttr/DataParallel/VarBase… live at
+    # the top level (LayerList under nn); resolve through them (PEP 562)
+    import paddle_tpu as p
+    for src in (p, p.nn):
+        if hasattr(src, name):
+            return getattr(src, name)
+    raise AttributeError(
+        f"module 'paddle.framework' has no attribute '{name}'")

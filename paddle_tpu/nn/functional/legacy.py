@@ -747,30 +747,44 @@ def birnn(cell_fw, cell_bw, inputs, initial_states=None,
                sequence_length=sequence_length)
 
 
+_GRU_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda v: v,
+}
+
+
 def gru_unit(input, hidden, weight_hh, bias_hh=None,
-             activation="tanh", gate_activation="sigmoid"):
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
     """One GRU step over pre-projected gate input
-    (reference: gru_unit_op.cc — `input` is x@W_ih already [N, 3D]).
+    (reference: gru_unit_op.cc — `input` is x@W_ih already [N, 3D];
+    origin_mode selects h' = z*h_prev + (1-z)*n vs the default
+    h' = (1-z)*n + z*h_prev ... the kernel's two update orders).
     Returns (new_hidden, reset_hidden_prev, gate)."""
     input = ensure_tensor(input)
     hidden = ensure_tensor(hidden)
     weight_hh = ensure_tensor(weight_hh)
+    act = _GRU_ACTS[activation]
+    gate_act = _GRU_ACTS[gate_activation]
     args = [input, hidden, weight_hh]
     if bias_hh is not None:
         args.append(ensure_tensor(bias_hh))
 
     def fn(x, h, whh, *b):
-        d = h.shape[-1]
         hh = h @ whh
         if b:
             hh = hh + b[0]
         xr, xz, xn = jnp.split(x, 3, axis=-1)
         hr, hz, hn = jnp.split(hh, 3, axis=-1)
-        r = jax.nn.sigmoid(xr + hr)
-        z = jax.nn.sigmoid(xz + hz)
-        n = jnp.tanh(xn + r * hn)
-        new_h = (1.0 - z) * n + z * h
-        del d
+        r = gate_act(xr + hr)
+        z = gate_act(xz + hz)
+        n = act(xn + r * hn)
+        if origin_mode:
+            new_h = z * h + (1.0 - z) * n
+        else:
+            new_h = (1.0 - z) * h + z * n
         return new_h, r * h, jnp.concatenate([r, z, n], axis=-1)
 
     return primitive(name="gru_unit")(fn)(*args)
@@ -809,33 +823,69 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
 
 
 def dynamic_gru(input, size, weight, bias=None, is_reverse=False,
-                h_0=None, origin_mode=False, lengths=None, name=None,
+                h_0=None, origin_mode=False, lengths=None,
+                activation="tanh", gate_activation="sigmoid", name=None,
                 **kwargs):
     """GRU over a padded batch (reference: gru_op.cc dynamic_gru; LoD
     input -> (dense [B, T, 3*size] pre-projected gates, lengths)).
-    `weight` is the hidden-hidden matrix [size, 3*size]."""
-    from ..layer.rnn import GRUCell, RNN as _RNN
-    import jax.numpy as _j
+    `weight` is the hidden-hidden matrix [size, 3*size]; the update order
+    follows gru_unit's origin_mode semantics."""
+    from jax import lax
     input = ensure_tensor(input)
     weight = ensure_tensor(weight)
     d = int(size)
-    cell = GRUCell(3 * d, d)
-    # route the caller's weights into the cell (input is pre-projected:
-    # identity input projection)
-    cell.weight_ih._data = _j.eye(3 * d, dtype=weight._data.dtype)
-    cell.weight_hh._data = weight._data.T
+    act = _GRU_ACTS[activation]
+    gate_act = _GRU_ACTS[gate_activation]
+    args = [input, weight]
     if bias is not None:
-        cell.bias_hh._data = ensure_tensor(bias)._data.reshape(-1)
-        cell.bias_ih._data = jnp.zeros_like(cell.bias_ih._data)
-    else:
-        cell.bias_hh._data = jnp.zeros_like(cell.bias_hh._data)
-        cell.bias_ih._data = jnp.zeros_like(cell.bias_ih._data)
-    drv = _RNN(cell, is_reverse=is_reverse)
-    init = None
+        args.append(ensure_tensor(bias))
     if h_0 is not None:
-        init = ensure_tensor(h_0)
-    out, _ = drv(input, initial_states=init, sequence_length=lengths)
-    return out
+        args.append(ensure_tensor(h_0))
+    if lengths is not None:
+        args.append(ensure_tensor(lengths))
+
+    def fn(x, whh, *rest):
+        rest = list(rest)
+        b_arr = rest.pop(0) if bias is not None else None
+        h0 = rest.pop(0) if h_0 is not None else \
+            jnp.zeros((x.shape[0], d), x.dtype)
+        ln = rest.pop(0) if lengths is not None else None
+        xs = jnp.swapaxes(x, 0, 1)  # [T, B, 3D]
+        if is_reverse:
+            xs = xs[::-1]
+
+        def step(h, inp):
+            x_t, t = inp
+            hh = h @ whh
+            if b_arr is not None:
+                hh = hh + b_arr.reshape(-1)
+            xr, xz, xn = jnp.split(x_t, 3, axis=-1)
+            hr, hz, hn = jnp.split(hh, 3, axis=-1)
+            r = gate_act(xr + hr)
+            z = gate_act(xz + hz)
+            n = act(xn + r * hn)
+            if origin_mode:
+                new_h = z * h + (1.0 - z) * n
+            else:
+                new_h = (1.0 - z) * h + z * n
+            if ln is not None:
+                # hold state at padded steps (reference LoD semantics)
+                alive = (t < ln.astype(jnp.int32))[:, None]
+                new_h = jnp.where(alive, new_h, h)
+            return new_h, new_h
+
+        ts = jnp.arange(xs.shape[0], dtype=jnp.int32)
+        if is_reverse:
+            ts = ts[::-1]
+        _, outs = lax.scan(step, h0, (xs, ts))
+        if is_reverse:
+            outs = outs[::-1]
+        return jnp.swapaxes(outs, 0, 1)
+
+    nondiff = ()
+    if lengths is not None:
+        nondiff = (len(args) - 1,)
+    return primitive(name="dynamic_gru", nondiff=nondiff)(fn)(*args)
 
 
 def dynamic_lstm(input, size, weight, bias=None, use_peepholes=False,

@@ -180,3 +180,46 @@ TruncatedNormalInitializer = TruncatedNormal
 XavierInitializer = XavierNormal
 MSRAInitializer = KaimingNormal
 NumpyArrayInitializer = Assign
+
+
+# 1.x facade classes: fluid.initializer.Xavier/MSRA take a `uniform` flag
+class Xavier(Initializer):
+    """reference: fluid/initializer.py XavierInitializer(uniform=...)."""
+
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self._impl = (XavierUniform(fan_in, fan_out, seed=seed)
+                      if uniform else
+                      XavierNormal(fan_in, fan_out, seed=seed))
+
+    def __call__(self, shape, dtype="float32"):
+        return self._impl(shape, dtype)
+
+
+class MSRA(Initializer):
+    """reference: fluid/initializer.py MSRAInitializer(uniform=...)."""
+
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        self._impl = (KaimingUniform(fan_in, seed=seed) if uniform
+                      else KaimingNormal(fan_in, seed=seed))
+
+    def __call__(self, shape, dtype="float32"):
+        return self._impl(shape, dtype)
+
+
+BilinearInitializer = Bilinear
+
+# global default initializers (reference: initializer.py
+# set_global_initializer) — consulted by Layer.create_parameter when the
+# ParamAttr carries no initializer
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def global_initializer(is_bias):
+    return _global_bias_init if is_bias else _global_weight_init

@@ -41,8 +41,14 @@ class Layer:
         attr = ParamAttr._to_attr(attr)
         if attr is False:
             return None
+        # priority mirrors the reference: ParamAttr.initializer >
+        # set_global_initializer > the layer's default
         init = (attr.initializer if attr and attr.initializer is not None
-                else default_initializer)
+                else None)
+        if init is None:
+            init = I.global_initializer(is_bias)
+        if init is None:
+            init = default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         data = init(shape, dtype)

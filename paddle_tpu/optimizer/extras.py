@@ -1,0 +1,257 @@
+"""Long-tail optimizers and parameter-averaging utilities.
+
+Reference parity: ``python/paddle/fluid/optimizer.py`` hosts
+ExponentialMovingAverage / ModelAverage / LookaheadOptimizer and the
+DecayedAdagrad / Ftrl / Dpsgd update rules (kernels in
+``operators/optimizers/``).  The update rules follow this package's pure
+``_update`` protocol; the averaging utilities operate eagerly on the
+Layer's parameter Tensors (the reference manipulates scope vars the same
+way, just through program ops).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import Optimizer
+
+
+class DecayedAdagrad(Optimizer):
+    """reference: operators/optimizers/decayed_adagrad_op.cc —
+    m = decay*m + (1-decay)*g^2."""
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _init_state(self, param):
+        return {"moment": jnp.zeros_like(param._data if isinstance(
+            param, Tensor) else param)}
+
+    def _update(self, param, grad, state, lr):
+        if self._weight_decay:
+            grad = grad + self._weight_decay * param
+        m = self._decay * state["moment"] + \
+            (1.0 - self._decay) * jnp.square(grad)
+        new_param = param - lr * grad / (jnp.sqrt(m) + self._epsilon)
+        return new_param, {"moment": m}
+
+
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference: operators/optimizers/ftrl_op.cc with
+    lr_power=-0.5)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _init_state(self, param):
+        z = jnp.zeros_like(param._data if isinstance(param, Tensor)
+                           else param)
+        return {"squared": z, "linear": z}
+
+    def _update(self, param, grad, state, lr):
+        sq, lin = state["squared"], state["linear"]
+        new_sq = sq + jnp.square(grad)
+        p = -self._lr_power
+        sigma = (new_sq ** p - sq ** p) / lr
+        new_lin = lin + grad - sigma * param
+        pre = -(new_lin - jnp.sign(new_lin) * self._l1) / (
+            new_sq ** p / lr + self._l2)
+        new_param = jnp.where(jnp.abs(new_lin) > self._l1, pre,
+                              jnp.zeros_like(param))
+        return new_param, {"squared": new_sq, "linear": new_lin}
+
+
+class Dpsgd(Optimizer):
+    """Differentially-private SGD (reference:
+    operators/optimizers/dpsgd_op.cc): per-update clip to ``clip`` then
+    add N(0, sigma*clip) noise.  Noise is drawn from a counter-based key
+    so the rule stays a pure function of (param, grad, state)."""
+
+    def __init__(self, learning_rate, clip=10.0, batch_size=16.0,
+                 sigma=1.0, parameters=None, seed=0, name=None):
+        super().__init__(learning_rate, parameters, None, None)
+        self._clip = clip
+        self._batch = batch_size
+        self._sigma = sigma
+        self._seed = seed
+
+    def _init_state(self, param):
+        return {"t": jnp.zeros((), jnp.int32)}
+
+    def _update(self, param, grad, state, lr):
+        t = state["t"]
+        norm = jnp.sqrt(jnp.sum(jnp.square(grad)))
+        scaled = grad * (self._clip / jnp.maximum(norm, self._clip))
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.key(self._seed), t),
+            param.size)
+        noise = jax.random.normal(key, param.shape, param.dtype) * (
+            self._sigma * self._clip / self._batch)
+        new_param = param - lr * (scaled + noise)
+        return new_param, {"t": t + 1}
+
+
+class ExponentialMovingAverage:
+    """reference: fluid/optimizer.py ExponentialMovingAverage —
+    ``update()`` after each optimizer step; ``apply()`` as a context
+    manager swaps EMA weights in (bias-corrected), restoring on exit."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+        self._params = {}
+
+    def _register(self, layer_or_params):
+        params = (list(layer_or_params.parameters())
+                  if hasattr(layer_or_params, "parameters")
+                  else list(layer_or_params))
+        for i, p in enumerate(params):
+            self._params[i] = p
+            if i not in self._shadow:
+                self._shadow[i] = jnp.array(p._data)
+
+    def update(self, layer_or_params=None):
+        if layer_or_params is not None or not self._params:
+            if layer_or_params is None:
+                raise ValueError(
+                    "ExponentialMovingAverage.update: pass the Layer (or "
+                    "parameter list) on first use")
+            self._register(layer_or_params)
+        self._step += 1
+        d = self._decay
+        for i, p in self._params.items():
+            self._shadow[i] = d * self._shadow[i] + (1.0 - d) * p._data
+
+    def apply(self, executor=None, need_restore=True):
+        ema = self
+
+        class _Guard:
+            def __enter__(self):
+                bias = 1.0 - ema._decay ** max(ema._step, 1)
+                for i, p in ema._params.items():
+                    ema._backup[i] = p._data
+                    p._data = ema._shadow[i] / bias
+                return ema
+
+            def __exit__(self, *exc):
+                if need_restore:
+                    ema.restore()
+                return False
+
+        return _Guard()
+
+    def restore(self, executor=None):
+        for i, p in self._params.items():
+            if i in self._backup:
+                p._data = self._backup.pop(i)
+
+
+class ModelAverage:
+    """reference: fluid/optimizer.py ModelAverage — accumulate parameter
+    sums over a sliding window; ``apply()`` swaps in the window mean."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, parameters=None, name=None):
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        self._params = list(parameters or [])
+        self._sum = [jnp.zeros_like(p._data) for p in self._params]
+        self._count = 0
+        self._backup = {}
+
+    def update(self):
+        window = max(self._min_w,
+                     min(self._max_w, int(self._count * self._rate) or 1))
+        if self._count >= window:
+            self._sum = [jnp.zeros_like(p._data) for p in self._params]
+            self._count = 0
+        for i, p in enumerate(self._params):
+            self._sum[i] = self._sum[i] + p._data
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        ma = self
+
+        class _Guard:
+            def __enter__(self):
+                n = max(ma._count, 1)
+                for i, p in enumerate(ma._params):
+                    ma._backup[i] = p._data
+                    p._data = ma._sum[i] / n
+                return ma
+
+            def __exit__(self, *exc):
+                if need_restore:
+                    ma.restore()
+                return False
+
+        return _Guard()
+
+    def restore(self, executor=None):
+        for i, p in enumerate(self._params):
+            if i in self._backup:
+                p._data = self._backup.pop(i)
+
+
+class LookaheadOptimizer:
+    """reference: fluid/optimizer.py LookaheadOptimizer — fast weights
+    step with the inner optimizer; every k steps the slow weights move
+    alpha toward the fast ones and the fast weights reset to them."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._slow = None
+        self._steps = 0
+
+    def _params(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        if self._slow is None:
+            self._slow = [jnp.array(p._data) for p in self._params()]
+        self._steps += 1
+        if self._steps % self.k == 0:
+            for i, p in enumerate(self._params()):
+                self._slow[i] = self._slow[i] + self.alpha * (
+                    p._data - self._slow[i])
+                p._data = self._slow[i]
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        out = self.inner_optimizer.minimize(loss)
+        self._steps += 1
+        if self._slow is None:
+            self._slow = [jnp.array(p._data) for p in self._params()]
+        if self._steps % self.k == 0:
+            for i, p in enumerate(self._params()):
+                self._slow[i] = self._slow[i] + self.alpha * (
+                    p._data - self._slow[i])
+                p._data = self._slow[i]
+        return out

@@ -21,3 +21,27 @@ class ViterbiDecoder:
     def __call__(self, potentials, lengths):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+# package-style submodule aliases (reference text/datasets/ has one module
+# per dataset)
+import sys as _sys
+import types as _types
+from . import datasets as _d
+
+
+def _alias(name, **attrs):
+    m = _types.ModuleType(f"{__name__}.datasets.{name}")
+    for k, v in attrs.items():
+        setattr(m, k, v)
+    _sys.modules[m.__name__] = m
+    setattr(_d, name, m)
+    return m
+
+
+_alias("imdb", Imdb=_d.Imdb)
+_alias("imikolov", Imikolov=_d.Imikolov)
+_alias("conll05", Conll05st=_d.Conll05st)
+_alias("movielens", Movielens=_d.Movielens)
+_alias("uci_housing", UCIHousing=_d.UCIHousing)
+_alias("wmt14", WMT14=_d.WMT14)
+_alias("wmt16", WMT16=_d.WMT16)

@@ -23,3 +23,93 @@ def run_check():
           f"{n} device(s): {jax.devices()[0].platform}")
     return True
 from . import dlpack  # noqa: E402,F401
+
+
+def deprecated(update_to="", since="", reason=""):
+    """reference: utils/deprecated.py — decorator emitting a
+    DeprecationWarning on first call."""
+    import functools
+    import warnings
+
+    def decorator(fn):
+        msg = f"API '{fn.__module__}.{fn.__name__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use '{update_to}' instead"
+        if reason:
+            msg += f". Reason: {reason}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def require_version(min_version, max_version=None):
+    """reference: utils/install_check-adjacent require_version — compare
+    against this package's version."""
+    from ..version import full_version
+
+    def parse(v):
+        return [int(x) for x in str(v).split(".")[:3] if x.isdigit()]
+
+    cur = parse(full_version)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {full_version} < required minimum "
+            f"{min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {full_version} > required maximum "
+            f"{max_version}")
+    return True
+
+
+def dump_config(config=None):
+    """reference: print build/config info."""
+    import jax
+    from ..version import full_version
+    print(f"paddle_tpu {full_version}; jax {jax.__version__}; "
+          f"backend {jax.default_backend()}")
+
+
+def load_op_library(path):
+    raise NotImplementedError(
+        "load_op_library loads CUDA custom-op .so files; on this backend "
+        "write custom ops as jax.custom_vjp functions or Pallas kernels "
+        "(see nn/functional/attention.py for the pattern)")
+
+
+# download module (reference: utils/download.py). No network egress in
+# this environment: resolves cache hits, errors actionably on misses.
+import sys as _sys
+import types as _types
+
+download = _types.ModuleType(__name__ + ".download")
+
+
+def _get_weights_path_from_url(url, md5sum=None):
+    import os
+    cache = os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                         "weights")
+    fname = os.path.join(cache, url.split("/")[-1])
+    if os.path.exists(fname):
+        return fname
+    raise RuntimeError(
+        f"weights for {url} not in cache ({cache}) and this environment "
+        "has no network egress — place the file there manually")
+
+
+download.get_weights_path_from_url = _get_weights_path_from_url
+download.get_path_from_url = _get_weights_path_from_url
+_sys.modules[download.__name__] = download
+
+# profiler class aliases (reference: utils/profiler.py Profiler API)
+Profiler = profiler.Profiler if hasattr(profiler, "Profiler") else None
+ProfilerOptions = getattr(profiler, "ProfilerOptions", None)
+get_profiler = getattr(profiler, "get_profiler", None)

@@ -6,3 +6,64 @@ from .models import (  # noqa: F401
 )
 
 from . import transforms as image  # reference: paddle.vision.image utilities
+
+# submodule-name parity (reference vision/{datasets,models}/ are packages
+# with per-family modules; here classes live in one module each — expose
+# the package-style names as aliases)
+import sys as _sys
+import types as _types
+
+
+def _alias_module(name, **attrs):
+    m = _types.ModuleType(f"{__name__}.{name}")
+    for k, v in attrs.items():
+        setattr(m, k, v)
+    _sys.modules[m.__name__] = m
+    return m
+
+
+from . import datasets as _ds  # noqa: E402
+from . import models as _md  # noqa: E402
+from . import transforms as _tr  # noqa: E402
+
+datasets.mnist = _alias_module("datasets.mnist", MNIST=_ds.MNIST,
+                               FashionMNIST=getattr(_ds, "FashionMNIST",
+                                                    None))
+datasets.cifar = _alias_module("datasets.cifar", Cifar10=_ds.Cifar10,
+                               Cifar100=_ds.Cifar100)
+datasets.flowers = _alias_module("datasets.flowers", Flowers=_ds.Flowers)
+datasets.folder = _alias_module("datasets.folder",
+                                DatasetFolder=_ds.DatasetFolder,
+                                ImageFolder=_ds.ImageFolder)
+datasets.voc2012 = _alias_module("datasets.voc2012", VOC2012=_ds.VOC2012)
+models.lenet = _alias_module("models.lenet", LeNet=_md.LeNet)
+models.resnet = _alias_module(
+    "models.resnet", ResNet=_md.ResNet, resnet18=_md.resnet18,
+    resnet34=_md.resnet34, resnet50=_md.resnet50,
+    resnet101=_md.resnet101, resnet152=_md.resnet152)
+models.vgg = _alias_module(
+    "models.vgg", VGG=_md.VGG, vgg11=_md.vgg11, vgg13=_md.vgg13,
+    vgg16=_md.vgg16, vgg19=_md.vgg19)
+models.mobilenetv1 = _alias_module(
+    "models.mobilenetv1", MobileNetV1=_md.MobileNetV1,
+    mobilenet_v1=_md.mobilenet_v1)
+models.mobilenetv2 = _alias_module(
+    "models.mobilenetv2", MobileNetV2=_md.MobileNetV2,
+    mobilenet_v2=_md.mobilenet_v2)
+# transforms package exposes .transforms and .functional submodules;
+# functional aliases the module-level fns transforms.py already defines
+# (HWC numpy convention throughout)
+transforms.transforms = _tr
+if not hasattr(transforms, "functional"):
+    import numpy as _np
+
+    def _tf_crop(img, top, left, height, width):
+        # HWC (or HW) numpy image
+        return _np.asarray(img)[top:top + height, left:left + width].copy()
+
+    tf_mod = _alias_module(
+        "transforms.functional",
+        to_tensor=_tr.to_tensor, normalize=_tr.normalize,
+        resize=_tr.resize, hflip=_tr.hflip, vflip=_tr.vflip,
+        crop=_tf_crop)
+    transforms.functional = tf_mod

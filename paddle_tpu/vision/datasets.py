@@ -153,3 +153,160 @@ class Cifar100(Cifar10):
         data_file = data_file or os.path.join(
             DATA_HOME, "cifar", "cifar-100-python.tar.gz")
         super().__init__(data_file=data_file, **kwargs)
+
+
+class Flowers(Dataset):
+    """Oxford-102 flowers (reference: vision/datasets/flowers.py) —
+    local .tgz/.mat cache when present, synthetic fallback otherwise."""
+
+    NUM_CLASSES = 102
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None,
+                 synthetic_size=None):
+        self.mode = mode
+        self.transform = transform
+        base = os.path.join(DATA_HOME, "flowers")
+        data_file = data_file or os.path.join(base, "102flowers.tgz")
+        if os.path.exists(data_file):
+            raise NotImplementedError(
+                "Flowers: .tgz/.mat parsing for a local cache is not "
+                "implemented — extract to numpy and pass image arrays, "
+                "or rely on the synthetic fallback")
+        n = synthetic_size or {"train": 6149, "valid": 1020,
+                               "test": 1020}.get(mode, 1020)
+        n = int(os.environ.get("PADDLE_TPU_SYNTH_N", n))
+        self.images, self.labels = _synthetic(
+            n, (224, 224, 3), self.NUM_CLASSES,
+            seed={"train": 10, "valid": 11, "test": 12}.get(mode, 12))
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = int(self.labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype("float32")
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (reference:
+    vision/datasets/voc2012.py) — synthetic (image, mask) fallback."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        self.mode = mode
+        self.transform = transform
+        n = synthetic_size or {"train": 1464, "valid": 1449,
+                               "test": 1456}.get(mode, 1449)
+        n = int(os.environ.get("PADDLE_TPU_SYNTH_N", n))
+        rs = np.random.RandomState(
+            {"train": 20, "valid": 21, "test": 22}.get(mode, 22))
+        self.images = (rs.rand(n, 224, 224, 3) * 255).astype(np.uint8)
+        self.masks = rs.randint(0, 21, (n, 224, 224)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img, mask = self.images[idx], self.masks[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype("float32")
+        return img, mask
+
+    def __len__(self):
+        return len(self.images)
+
+
+def _default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+        with open(path, "rb") as f:
+            return np.asarray(Image.open(f).convert("RGB"))
+    except ImportError:
+        raise RuntimeError(
+            "reading image files needs PIL; store .npy arrays instead "
+            "(DatasetFolder accepts a custom `loader`)")
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class image folder (reference:
+    vision/datasets/folder.py DatasetFolder): root/class_x/xxx.ext."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        extensions = tuple(extensions or IMG_EXTENSIONS)
+        classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"DatasetFolder: no class folders in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(dirpath, fname)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fname.lower().endswith(extensions))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise ValueError(
+                f"DatasetFolder: no files with extensions {extensions} "
+                f"under {root}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(target)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat or nested folder of images, no labels (reference:
+    vision/datasets/folder.py ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        extensions = tuple(extensions or IMG_EXTENSIONS)
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(extensions))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise ValueError(f"ImageFolder: no images under {root}")
+
+    def __getitem__(self, idx):
+        path = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

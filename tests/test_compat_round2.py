@@ -1,0 +1,269 @@
+"""Round-2 compat surfaces: fluid.optimizer 1.x classes + EMA/ModelAverage/
+Lookahead, fluid.dygraph submodules & 1.x layers, fleet Fleet/UtilBase/
+data generators/metrics, utils helpers, paddle.framework re-exports,
+vision/text dataset families."""
+import io
+import contextlib
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu import nn
+
+T = paddle.to_tensor
+
+
+def _problem():
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 4).astype("float32")
+    y = rng.rand(16, 1).astype("float32")
+    return T(x), T(y)
+
+
+class TestFluidOptimizers:
+    @pytest.mark.parametrize("name", [
+        "SGDOptimizer", "MomentumOptimizer", "AdagradOptimizer",
+        "AdamOptimizer", "AdamaxOptimizer", "RMSPropOptimizer",
+        "LambOptimizer", "DecayedAdagradOptimizer", "FtrlOptimizer",
+    ])
+    def test_1x_optimizers_train(self, name):
+        x, y = _problem()
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        cls = getattr(fluid.optimizer, name)
+        kwargs = dict(learning_rate=0.05,
+                      parameter_list=net.parameters())
+        if name == "MomentumOptimizer":
+            kwargs["momentum"] = 0.9
+        opt = cls(**kwargs)
+        first = last = None
+        for _ in range(12):
+            loss = paddle.mean((net(x) - y) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            v = float(loss.numpy())
+            first = first if first is not None else v
+            last = v
+        assert last < first, (name, first, last)
+
+    def test_ema_apply_restore(self):
+        x, y = _problem()
+        paddle.seed(1)
+        net = nn.Linear(4, 1)
+        opt = fluid.optimizer.SGDOptimizer(
+            learning_rate=0.1, parameter_list=net.parameters())
+        ema = fluid.optimizer.ExponentialMovingAverage(0.5)
+        for _ in range(5):
+            loss = paddle.mean((net(x) - y) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ema.update(net)
+        raw = net(x).numpy()
+        with ema.apply():
+            inside = net(x).numpy()
+        after = net(x).numpy()
+        assert not np.allclose(raw, inside)
+        np.testing.assert_allclose(raw, after)  # restored
+
+    def test_model_average(self):
+        x, y = _problem()
+        paddle.seed(2)
+        net = nn.Linear(4, 1)
+        ma = fluid.optimizer.ModelAverage(
+            0.15, min_average_window=2, max_average_window=4,
+            parameters=net.parameters())
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        for _ in range(6):
+            loss = paddle.mean((net(x) - y) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ma.update()
+        raw = net(x).numpy()
+        with ma.apply():
+            avg = net(x).numpy()
+        assert not np.allclose(raw, avg)
+
+    def test_lookahead_converges(self):
+        x, y = _problem()
+        paddle.seed(3)
+        net = nn.Linear(4, 1)
+        look = fluid.optimizer.LookaheadOptimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters()),
+            alpha=0.5, k=3)
+        first = last = None
+        for _ in range(15):
+            loss = paddle.mean((net(x) - y) ** 2)
+            loss.backward()
+            look.step()
+            look.clear_grad()
+            v = float(loss.numpy())
+            first = first if first is not None else v
+            last = v
+        assert last < first
+
+    def test_recompute_pipeline_wrappers(self):
+        inner = paddle.optimizer.SGD(learning_rate=0.1)
+        rec = fluid.optimizer.RecomputeOptimizer(inner)
+        rec._set_checkpoints([])
+        assert rec.get_lr() == pytest.approx(0.1)
+        pipe = fluid.optimizer.PipelineOptimizer(inner, num_microbatches=4)
+        assert pipe.num_microbatches == 4
+
+
+class TestDygraphCompat:
+    def test_lr_scheduler_names(self):
+        dg = fluid.dygraph
+        s = dg.CosineDecay(0.1, T_max=10)
+        assert callable(s)
+        r = dg.ReduceLROnPlateau(learning_rate=0.1)
+        assert hasattr(r, "step")
+        w = dg.LinearLrWarmup(0.1, warmup_steps=5, start_lr=0.0,
+                              end_lr=0.1)
+        assert callable(w)
+
+    def test_layer_aliases_forward(self):
+        dg = fluid.dygraph
+        x = T(np.random.RandomState(0).randn(2, 3, 8, 8).astype("float32"))
+        layer = dg.nn.InstanceNorm(3)
+        assert layer(x).shape == [2, 3, 8, 8]
+        pr = dg.nn.PRelu(num_parameters=1)
+        assert pr(x).shape == [2, 3, 8, 8]
+
+    def test_save_load_dygraph(self, tmp_path):
+        net = nn.Linear(3, 2)
+        p = str(tmp_path / "m")
+        fluid.dygraph.save_dygraph(net.state_dict(), p)
+        params, opt = fluid.dygraph.load_dygraph(p)
+        assert opt is None
+        assert set(params) == set(net.state_dict())
+
+    def test_no_grad(self):
+        x = T(np.ones(2, "float32"))
+        x.stop_gradient = False
+        with fluid.dygraph.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_set_global_initializer(self):
+        from paddle_tpu.nn import initializer as I
+        I.set_global_initializer(I.Constant(0.5), I.Constant(-0.5))
+        try:
+            lin = nn.Linear(3, 2)
+            np.testing.assert_allclose(lin.weight.numpy(), 0.5)
+            np.testing.assert_allclose(lin.bias.numpy(), -0.5)
+        finally:
+            I.set_global_initializer(None, None)
+
+    def test_xavier_msra_facades(self):
+        from paddle_tpu.nn import initializer as I
+        w = I.Xavier(uniform=True)([64, 64])
+        assert np.asarray(w).std() > 0
+        m = I.MSRA(uniform=False)([64, 64])
+        assert np.asarray(m).std() > 0
+
+
+class TestFleetRound2:
+    def test_fleet_class_and_util(self):
+        from paddle_tpu.distributed import fleet
+        f = fleet.Fleet()
+        assert f.worker_num() >= 1
+        # reference style: fleet.util is the UtilBase instance
+        assert fleet.util.get_file_shard(["a", "b", "c"]) == \
+            ["a", "b", "c"]
+        assert float(fleet.util.all_reduce(np.asarray([2.0]))) == 2.0
+        assert f.util is fleet.util
+
+    def test_data_generator_format(self):
+        from paddle_tpu.distributed import fleet
+
+        class G(fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    yield [("words", [3, 4]), ("label", [1])]
+                return it
+
+        g = G()
+        g.set_batch(1)
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            g.run_from_memory()
+        assert buf.getvalue().strip() == "2 3 4 1 1"
+
+    def test_metrics(self):
+        from paddle_tpu.distributed import fleet
+        assert fleet.metrics.acc(9, 10) == pytest.approx(0.9)
+        pos = np.zeros(10)
+        neg = np.zeros(10)
+        pos[9] = 5
+        neg[0] = 5
+        assert fleet.metrics.auc(pos, neg) == pytest.approx(1.0)
+        assert fleet.metrics.rmse(np.asarray([4.0]), 4) == pytest.approx(1)
+
+
+class TestUtilsFramework:
+    def test_deprecated_decorator(self):
+        @paddle.utils.deprecated(update_to="paddle.new_op", since="2.0")
+        def old_op():
+            return 42
+
+        with pytest.warns(DeprecationWarning):
+            assert old_op() == 42
+
+    def test_require_version(self):
+        assert paddle.utils.require_version("0.0.1")
+        with pytest.raises(Exception):
+            paddle.utils.require_version("999.0.0")
+
+    def test_framework_reexports(self):
+        import paddle_tpu.framework as fw
+        assert fw.get_default_dtype() == "float32"
+        t = fw.create_parameter([2, 2], "float32")
+        assert t.shape == [2, 2]
+        assert fw.CPUPlace is not None and fw.LayerList is not None
+
+
+class TestDatasetFamilies:
+    def test_flowers_voc_synthetic(self):
+        os.environ["PADDLE_TPU_SYNTH_N"] = "8"
+        try:
+            from paddle_tpu.vision import datasets as vds
+            fl = vds.Flowers(mode="test")
+            img, lab = fl[0]
+            assert img.shape == (224, 224, 3)
+            voc = vds.VOC2012(mode="valid")
+            im, mask = voc[1]
+            assert mask.shape == (224, 224)
+        finally:
+            os.environ.pop("PADDLE_TPU_SYNTH_N", None)
+
+    def test_folder_datasets(self, tmp_path):
+        from paddle_tpu.vision import datasets as vds
+        for c in ("a", "b"):
+            (tmp_path / c).mkdir()
+            for i in range(2):
+                np.save(str(tmp_path / c / f"{i}.npy"),
+                        np.random.rand(4, 4, 3).astype("float32"))
+        df = vds.DatasetFolder(str(tmp_path))
+        assert len(df) == 4 and df.classes == ["a", "b"]
+        x, y = df[3]
+        assert x.shape == (4, 4, 3) and int(y) == 1
+        imf = vds.ImageFolder(str(tmp_path))
+        (sample,) = imf[0]
+        assert sample.shape == (4, 4, 3)
+
+    def test_submodule_aliases(self):
+        import paddle_tpu as p
+        assert p.vision.datasets.mnist.MNIST is p.vision.datasets.MNIST
+        assert p.vision.models.resnet.resnet50 is p.vision.models.resnet50
+        assert p.text.datasets.imdb.Imdb is not None
+        tf = p.vision.transforms.functional
+        out = tf.to_tensor(np.random.rand(6, 6, 3).astype("float32"))
+        assert np.asarray(out).shape == (3, 6, 6)

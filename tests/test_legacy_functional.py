@@ -260,21 +260,26 @@ class TestDetectionHelpers:
 
 
 class TestRNNUnits:
-    def test_gru_unit_matches_cell_math(self):
+    def test_gru_unit_matches_kernel_math(self):
         rng = np.random.RandomState(3)
         d = 4
         x = rng.randn(2, 3 * d).astype(np.float32)
         h = rng.randn(2, d).astype(np.float32)
         whh = rng.randn(d, 3 * d).astype(np.float32)
-        new_h, rh, gate = F.gru_unit(T(x), T(h), T(whh))
-        assert new_h.shape == [2, d]
         hh = h @ whh
         xr, xz, xn = np.split(x, 3, axis=1)
         hr, hz, hn = np.split(hh, 3, axis=1)
         sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
         r, z = sig(xr + hr), sig(xz + hz)
         n = np.tanh(xn + r * hn)
-        np.testing.assert_allclose(new_h.numpy(), (1 - z) * n + z * h,
+        # default: h' = (1-u)h + u*n  (gru_kernel.h gru_finalOutput else)
+        new_h, rh, gate = F.gru_unit(T(x), T(h), T(whh))
+        assert new_h.shape == [2, d]
+        np.testing.assert_allclose(new_h.numpy(), (1 - z) * h + z * n,
+                                   rtol=1e-4)
+        # origin_mode: h' = u*h + (1-u)*n
+        new_o, _, _ = F.gru_unit(T(x), T(h), T(whh), origin_mode=True)
+        np.testing.assert_allclose(new_o.numpy(), z * h + (1 - z) * n,
                                    rtol=1e-4)
 
     def test_lstm_unit(self):
@@ -286,13 +291,28 @@ class TestRNNUnits:
         nh, nc = F.lstm_unit(T(x), T(h), T(c), weight=T(w))
         assert nh.shape == [2, 4] and nc.shape == [2, 4]
 
-    def test_dynamic_gru_runs(self):
+    def test_dynamic_gru_matches_unit_scan(self):
         rng = np.random.RandomState(5)
         d = 3
         x = rng.randn(2, 4, 3 * d).astype(np.float32)
         w = rng.randn(d, 3 * d).astype(np.float32)
-        out = F.dynamic_gru(T(x), d, T(w))
-        assert out.shape == [2, 4, d]
+        out = F.dynamic_gru(T(x), d, T(w)).numpy()
+        assert out.shape == (2, 4, d)
+        # step-by-step via gru_unit reproduces the scan
+        h = np.zeros((2, d), np.float32)
+        for t in range(4):
+            h = F.gru_unit(T(x[:, t]), T(h), T(w))[0].numpy()
+            np.testing.assert_allclose(out[:, t], h, rtol=1e-4)
+
+    def test_dynamic_gru_length_masking(self):
+        rng = np.random.RandomState(6)
+        d = 2
+        x = rng.randn(1, 3, 3 * d).astype(np.float32)
+        w = rng.randn(d, 3 * d).astype(np.float32)
+        ln = np.array([2], np.int64)
+        out = F.dynamic_gru(T(x), d, T(w), lengths=T(ln)).numpy()
+        # state holds after the valid prefix
+        np.testing.assert_allclose(out[0, 2], out[0, 1], rtol=1e-6)
 
     def test_functional_rnn_driver(self):
         cell = nn.GRUCell(4, 5)
