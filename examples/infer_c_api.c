@@ -1,0 +1,58 @@
+/* C-API inference example.
+ *
+ * Build (after exporting a model with paddle.jit.save, e.g. via
+ * examples/train_mnist.py + jit.save):
+ *
+ *   make -C ../paddle_tpu/csrc capi
+ *   gcc -x c++ infer_c_api.c -o infer \
+ *       -I../paddle_tpu/csrc -L../paddle_tpu/csrc \
+ *       -lpaddle_capi -Wl,-rpath,$PWD/../paddle_tpu/csrc
+ *   PADDLE_TPU_ROOT=$PWD/.. ./infer /path/to/exported/model_prefix
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include "paddle_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <model_prefix>\n", argv[0]);
+    return 1;
+  }
+  PD_Config* cfg = PD_NewConfig();
+  PD_ConfigSetModel(cfg, argv[1], NULL);
+  PD_Predictor* pred = PD_NewPredictor(cfg);
+  if (!pred) {
+    fprintf(stderr, "create predictor: %s\n", PD_LastError());
+    return 2;
+  }
+  printf("inputs: %d  outputs: %d\n", PD_GetInputNum(pred),
+         PD_GetOutputNum(pred));
+
+  /* feed a 1x4 float input named by the artifact's first feed */
+  float data[4] = {0.1f, 0.2f, 0.3f, 0.4f};
+  int64_t shape[2] = {1, 4};
+  if (PD_SetInput(pred, PD_GetInputName(pred, 0), data, shape, 2,
+                  PD_FLOAT32) ||
+      PD_Run(pred)) {
+    fprintf(stderr, "run: %s\n", PD_LastError());
+    return 3;
+  }
+  const void* out;
+  const int64_t* oshape;
+  int ndim;
+  PD_DataType dt;
+  if (PD_GetOutput(pred, PD_GetOutputName(pred, 0), &out, &oshape, &ndim,
+                   &dt)) {
+    fprintf(stderr, "fetch: %s\n", PD_LastError());
+    return 4;
+  }
+  long total = 1;
+  for (int i = 0; i < ndim; ++i) total *= oshape[i];
+  printf("output[0..%ld):", total);
+  for (long i = 0; i < total && i < 8; ++i)
+    printf(" %f", ((const float*)out)[i]);
+  printf("\n");
+  PD_DeletePredictor(pred);
+  PD_DeleteConfig(cfg);
+  return 0;
+}

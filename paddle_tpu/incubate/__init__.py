@@ -2,3 +2,7 @@
 from __future__ import annotations
 
 from . import checkpoint  # noqa: F401
+
+# reference: python/paddle/incubate/__init__.py exposes optimizer/reader
+from . import optimizer, reader  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401

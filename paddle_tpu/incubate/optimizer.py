@@ -1,0 +1,6 @@
+"""paddle.incubate.optimizer (reference: incubate exposes LookAhead /
+ModelAverage; implementations live in optimizer/extras.py)."""
+from ..optimizer.extras import (  # noqa: F401
+    LookaheadOptimizer as LookAhead, ModelAverage)
+
+LookaheadOptimizer = LookAhead

@@ -1,0 +1,8 @@
+"""paddle.incubate.reader helpers (reference: incubate reader utils)."""
+
+
+def sample_list_to_batch(samples):
+    """Stack a list of per-sample field tuples into batched arrays."""
+    import numpy as np
+    cols = list(zip(*samples))
+    return [np.stack([np.asarray(c) for c in col]) for col in cols]

@@ -11,6 +11,15 @@ ring with ``lax.ppermute`` for M + P - 1 ticks (the GPipe schedule).
 Backward is just ``jax.grad`` through the rotation — ppermute's transpose is
 the reverse rotation, which reproduces the reference's backward P2P sends.
 Heterogeneous ends (embedding / head) run replicated outside the ring.
+
+On 1F1B: a hand-scheduled 1F1B (one backward interleaved per forward after
+warm-up) would cap live activations at P microbatches instead of M, but
+requires replacing ``jax.grad`` with explicit per-tick VJPs whose residuals
+are threaded through the loop carry.  With ``use_recompute=True`` (per-tick
+``jax.checkpoint``, the path TrainStep enables for strategy.recompute) the
+stored state is already only the M+P-1 tick INPUTS — within M/P of 1F1B's
+footprint at identical FLOPs — so the schedule upgrade buys little on TPU
+HBM and is deliberately deferred; this note records the analysis.
 """
 from __future__ import annotations
 

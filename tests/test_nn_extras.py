@@ -500,3 +500,21 @@ def test_hash_many_and_pad_like_validation():
         F.pad_constant_like(
             paddle.to_tensor(np.ones((2, 3), "float32")),
             paddle.to_tensor(np.ones((3, 2), "float32")))
+
+
+def test_flash_default_block_sizes_clamp():
+    """Tuned pallas block defaults clamp to the sequence extent
+    (v5e measurement: 2.9x over kernel defaults at S=4096)."""
+    from paddle_tpu.nn.functional import attention as att
+    bs = att._default_block_sizes(512, 4096)
+    assert bs.block_q == 512 and bs.block_k == 1024
+    bs2 = att._default_block_sizes(8192, 8192)
+    assert bs2.block_q == 1024 and bs2.block_k_major == 1024
+
+
+def test_flash_block_sizes_divide_sequence():
+    """Blocks must divide the sequence (pallas _verify_block); 2560 is
+    gate-admitted (divisible by 128) but not by 1024."""
+    from paddle_tpu.nn.functional import attention as att
+    for seq, want in ((2560, 512), (2176, 128), (3584, 512), (7680, 512)):
+        assert att._default_block_sizes(seq, seq).block_q == want, seq
