@@ -3,8 +3,23 @@ Run on CPU with a virtual mesh:
   XLA_FLAGS=--xla_force_host_platform_device_count=8 python distributed_hybrid.py
 """
 import os
+import sys
+
+# allow running as `python examples/<script>.py` from a repo checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
 os.environ.setdefault("XLA_FLAGS",
                       "--xla_force_host_platform_device_count=8")
+import jax
+
+# This demo needs an 8-device mesh.  Default to the virtual CPU mesh;
+# on a real multi-chip TPU slice run with PADDLE_TPU_REAL_MESH=1.
+# (The platform must be chosen before the backend initializes, so this
+# cannot be decided by counting devices first.)
+if os.environ.get("PADDLE_TPU_REAL_MESH") != "1":
+    jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu import optimizer

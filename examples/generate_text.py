@@ -1,4 +1,11 @@
 """KV-cached autoregressive generation (greedy and top-k sampling)."""
+import os
+import sys
+
+# allow running as `python examples/<script>.py` from a repo checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
 import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu.models import GPTModel

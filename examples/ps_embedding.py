@@ -1,5 +1,12 @@
 """Parameter-server-style training: a mesh-sharded sparse table with
 per-row optimizer state, pull/push API (reference: the_one_ps)."""
+import os
+import sys
+
+# allow running as `python examples/<script>.py` from a repo checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
 import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu import distributed as dist

@@ -1,5 +1,12 @@
 """Declarative (static graph) mode: build a Program, train with the
 Executor, export the inference subgraph as a StableHLO artifact."""
+import os
+import sys
+
+# allow running as `python examples/<script>.py` from a repo checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
 import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu import static, optimizer

@@ -1,5 +1,12 @@
 """GPT-2 pretraining step: ONE pjit'd XLA program for forward + backward
 + optimizer update, bf16 params, fused chunked head+CE loss."""
+import os
+import sys
+
+# allow running as `python examples/<script>.py` from a repo checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
 import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu import optimizer

@@ -1,4 +1,11 @@
 """MNIST two ways: an eager (dygraph) loop, then Model.fit."""
+import os
+import sys
+
+# allow running as `python examples/<script>.py` from a repo checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
 import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu import nn, optimizer

@@ -24,3 +24,5 @@ from .ring import ring_attention  # noqa: F401
 from .moe import MoELayer, ExpertFFN, top_k_gating  # noqa: F401
 from .ps import (SparseTable, DistributedEmbedding,  # noqa: F401
                  TheOnePS, get_ps_runtime)
+from ..io.native_dataset import (  # noqa: F401
+    InMemoryDataset, QueueDataset)

@@ -22,7 +22,7 @@ from .. import (  # noqa: F401
     LoDTensor, LoDTensorArray)
 from ..framework.io import save, load  # noqa: F401
 from . import optimizer  # noqa: F401  (1.x *Optimizer names + EMA etc.)
-from .. import io  # noqa: F401
+from . import io  # noqa: F401  (1.x save/load_params surface)
 from .. import regularizer  # noqa: F401
 from ..nn import initializer  # noqa: F401
 from ..nn import clip  # noqa: F401
@@ -59,7 +59,7 @@ def cpu_places(device_count=None):
 from . import nets  # noqa: E402,F401
 from ..utils import unique_name  # noqa: E402,F401
 from .. import incubate  # noqa: E402,F401
-from .. import metric as metrics  # noqa: E402,F401
+from . import metrics  # noqa: E402,F401
 from ..utils import profiler  # noqa: E402,F401
 from ..io import native_dataset as dataset  # noqa: E402,F401
 from ..core import rng as generator  # noqa: E402,F401
@@ -146,6 +146,7 @@ def _deprecated_module(name, why):
 # actionable errors
 _deprecated_module(
     "evaluator", "fluid.evaluator was deprecated in the reference; use "
+    "fluid.metrics (ChunkEvaluator/EditDistance/DetectionMAP) or "
     "paddle.metric")
 _deprecated_module(
     "data_feed_desc", "dataset descriptors are internal to the native "
@@ -205,3 +206,6 @@ install_check.run_check = _install_run_check
 from ..static import amp as _static_amp  # noqa: E402
 contrib = _submodule("contrib", mixed_precision=_static_amp)
 _sys.modules[f"{__name__}.contrib.mixed_precision"] = _static_amp
+
+
+from ..io import DataFeeder  # noqa: E402,F401  (shared legacy feeder)

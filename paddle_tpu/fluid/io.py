@@ -1,0 +1,107 @@
+"""``fluid.io`` — the 1.x save/load + reader surface.
+
+Reference parity: ``python/paddle/fluid/io.py`` (save/load_params,
+save/load_persistables, save/load_vars, save/load_inference_model,
+program-state helpers, ``batch``) plus the Dataset/DataLoader re-exports
+the reference module carries.  Persistable state here is the static
+Program's parameter dict (static/program.py), so every variant below is a
+view over the same dict-save machinery.
+"""
+from __future__ import annotations
+
+import os
+
+from ..io import *  # noqa: F401,F403  (full paddle.io surface: loaders,
+#                      samplers, dataset combinators, DataFeeder, native
+#                      dataset engine — the reference fluid.io re-exports
+#                      the reader stack the same way)
+from ..io import DataFeeder, DatasetFactory  # noqa: F401
+from ..io import InMemoryDataset, QueueDataset  # noqa: F401
+from ..static.io import (  # noqa: F401
+    save_inference_model as _save_inference_model,
+    load_inference_model as _load_inference_model)
+from ..static.compat import (  # noqa: F401
+    save_vars, load_vars, set_program_state, load_program_state)
+from ..static.executor import save as _program_save
+from ..static.executor import load as _program_load
+
+
+def batch(reader, batch_size, drop_last=False):
+    """1.x reader decorator: group a sample generator into batches
+    (reference: fluid/io.py batch)."""
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def save(program, model_path, protocol=4, **configs):
+    return _program_save(program, model_path, protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    return _program_load(program, model_path)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    """reference: fluid/io.py save_params (static captures hold exactly
+    the program's parameters here, so params == persistables)."""
+    return save_vars(executor, dirname, main_program, filename=filename)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """reference: fluid/io.py:621 — every persistable var (params +
+    optimizer state)."""
+    return save_vars(executor, dirname, main_program, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program, filename=filename)
+
+
+def get_program_parameter(program):
+    """reference: fluid/io.py get_program_parameter."""
+    state = getattr(program, "state_dict", None)
+    if state is None:
+        return []
+    return list(program.state_dict().keys())
+
+
+def get_program_persistable_vars(program):
+    return get_program_parameter(program)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, **kwargs):
+    """1.x signature (reference: fluid/io.py:1199) over the modern
+    static.io exporter: dirname becomes the artifact prefix."""
+    prefix = os.path.join(dirname, model_filename or "model")
+    if prefix.endswith(".pdmodel"):
+        prefix = prefix[:-len(".pdmodel")]
+    from ..static import default_main_program
+    program = main_program or default_main_program()
+    feed_vars = [program.var(n) if hasattr(program, "var") else n
+                 for n in feeded_var_names]
+    return _save_inference_model(prefix, feed_vars, target_vars,
+                                 executor=executor, program=program)
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, **kwargs):
+    prefix = os.path.join(dirname, model_filename or "model")
+    if prefix.endswith(".pdmodel"):
+        prefix = prefix[:-len(".pdmodel")]
+    return _load_inference_model(prefix, executor=executor)

@@ -596,7 +596,23 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
 
 def chunk_eval(input, label, chunk_scheme, num_chunk_types,
                excluded_chunk_types=None, seq_length=None):
-    raise NotImplementedError(
-        "chunk_eval (NER chunking F1): evaluate on the host with "
-        "seqeval-style python over decoded tags "
-        "(reference: chunk_eval_op.cc)")
+    """Chunk precision/recall/F1 for one batch of tag rows (reference:
+    chunk_eval_op.cc).  Eager host computation; returns the op's 6
+    outputs (precision, recall, f1, num_infer, num_label, num_correct)."""
+    import numpy as _np
+    from ..core.dispatch import ensure_tensor
+    from ..core.tensor import Tensor as _T
+    from .metrics import chunk_count
+    inf = _np.asarray(ensure_tensor(input).numpy())
+    lab = _np.asarray(ensure_tensor(label).numpy())
+    lens = (_np.asarray(ensure_tensor(seq_length).numpy()).reshape(-1)
+            if seq_length is not None else None)
+    ni, nl, nc = chunk_count(inf, lab, chunk_scheme, num_chunk_types,
+                             excluded_chunk_types, lens)
+    precision = nc / ni if ni else 0.0
+    recall = nc / nl if nl else 0.0
+    f1 = 2 * precision * recall / (precision + recall) if nc else 0.0
+    mk = lambda v, dt: _T(_np.asarray([v], dt))  # noqa: E731
+    return (mk(precision, _np.float32), mk(recall, _np.float32),
+            mk(f1, _np.float32), mk(ni, _np.int64), mk(nl, _np.int64),
+            mk(nc, _np.int64))

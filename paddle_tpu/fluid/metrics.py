@@ -1,0 +1,399 @@
+"""``fluid.metrics`` — the 1.x streaming metric classes.
+
+Reference parity: ``python/paddle/fluid/metrics.py`` (MetricBase,
+CompositeMetric, Precision, Recall, Accuracy, ChunkEvaluator,
+EditDistance, DetectionMAP, Auc).  These are host-side accumulators fed
+with numpy batches; chunk extraction mirrors ``chunk_eval_op.cc`` and the
+mAP computation ``detection_map_op.cc`` (integral + 11-point modes).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+    def get_config(self):
+        return {"name": self._name}
+
+
+class CompositeMetric(MetricBase):
+    """Bundle several metrics sharing update arguments (reference
+    :CompositeMetric)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        if not isinstance(metric, MetricBase):
+            raise ValueError("add_metric expects a MetricBase")
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds=preds, labels=labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Precision(MetricBase):
+    """Binary precision over 0/1 preds (reference :Precision)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def eval(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def eval(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Accuracy(MetricBase):
+    """Weighted streaming accuracy (reference :Accuracy — fed with the
+    accuracy op's minibatch value)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1):
+        if weight < 0:
+            raise ValueError("weight must be nonnegative")
+        self.value += float(np.asarray(value).mean()) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("Accuracy: no batches accumulated")
+        return self.value / self.weight
+
+
+def extract_chunks(tags, chunk_scheme, num_chunk_types,
+                   excluded_chunk_types=None):
+    """Decode (begin, end, type) spans from a tag sequence
+    (reference: chunk_eval_op.cc tag layouts).
+
+    Tag id layout per scheme (type-major):
+      IOB:   type*2 + {B:0, I:1}
+      IOE:   type*2 + {I:0, E:1}
+      IOBES: type*4 + {B:0, I:1, E:2, S:3}
+      plain: tag == type
+    The 'outside' tag is the largest id (num_chunk_types * tag_num).
+    """
+    excluded = set(excluded_chunk_types or [])
+    scheme = chunk_scheme.upper()
+    n_pos = {"IOB": 2, "IOE": 2, "IOBES": 4, "PLAIN": 1}[scheme]
+    outside = num_chunk_types * n_pos
+    chunks = []
+    start = None
+    cur_type = None
+
+    def close(end):
+        nonlocal start, cur_type
+        if start is not None and cur_type not in excluded:
+            chunks.append((start, end, cur_type))
+        start, cur_type = None, None
+
+    for i, tag in enumerate(list(tags) + [outside]):
+        tag = int(tag)
+        if tag >= outside or tag < 0:
+            close(i)
+            continue
+        ctype, pos = divmod(tag, n_pos)
+        if scheme == "PLAIN":
+            if cur_type != ctype:
+                close(i)
+                start, cur_type = i, ctype
+        elif scheme == "IOB":
+            if pos == 0 or cur_type != ctype:  # B or type switch
+                close(i)
+                start, cur_type = i, ctype
+        elif scheme == "IOE":
+            if cur_type != ctype:
+                close(i)
+                start, cur_type = i, ctype
+            if pos == 1:  # E ends the chunk inclusively
+                close(i + 1)
+        else:  # IOBES
+            if pos == 0:  # B
+                close(i)
+                start, cur_type = i, ctype
+            elif pos == 1:  # I
+                if cur_type != ctype:
+                    close(i)
+                    start, cur_type = i, ctype
+            elif pos == 2:  # E
+                if cur_type != ctype:
+                    close(i)
+                    start, cur_type = i, ctype
+                close(i + 1)
+            else:  # S: single-token chunk
+                close(i)
+                if ctype not in excluded:
+                    chunks.append((i, i + 1, ctype))
+    return chunks
+
+
+def chunk_count(infer, label, chunk_scheme, num_chunk_types,
+                excluded_chunk_types=None, lengths=None):
+    """(num_infer, num_label, num_correct) chunk counts for a batch of
+    tag rows (the chunk_eval op's outputs)."""
+    infer = np.asarray(infer)
+    label = np.asarray(label)
+    if infer.ndim == 1:
+        infer, label = infer[None], label[None]
+    n_inf = n_lab = n_cor = 0
+    for i in range(infer.shape[0]):
+        ln = int(lengths[i]) if lengths is not None else infer.shape[1]
+        ci = extract_chunks(infer[i, :ln], chunk_scheme, num_chunk_types,
+                            excluded_chunk_types)
+        cl = extract_chunks(label[i, :ln], chunk_scheme, num_chunk_types,
+                            excluded_chunk_types)
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_cor += len(set(ci) & set(cl))
+    return n_inf, n_lab, n_cor
+
+
+class ChunkEvaluator(MetricBase):
+    """Streaming chunk precision/recall/F1 (reference :ChunkEvaluator;
+    counts via chunk_count above, the chunk_eval op analogue)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).sum())
+        self.num_label_chunks += int(np.asarray(num_label_chunks).sum())
+        self.num_correct_chunks += int(
+            np.asarray(num_correct_chunks).sum())
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+class EditDistance(MetricBase):
+    """Streaming average edit distance + instance error rate
+    (reference :EditDistance fed by the edit_distance op)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num=None):
+        d = np.asarray(distances, np.float64).reshape(-1)
+        self.total_distance += float(d.sum())
+        self.seq_num += int(seq_num if seq_num is not None else len(d))
+        self.instance_error += int((d > 0).sum())
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("EditDistance: no data added")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class DetectionMAP(MetricBase):
+    """Mean average precision for detection (reference :DetectionMAP /
+    detection_map_op.cc).  update() takes per-image detections
+    [[label, score, x1, y1, x2, y2], ...] and ground truths
+    [[label, x1, y1, x2, y2], ...] (+ optional difficult flags)."""
+
+    def __init__(self, name=None, overlap_threshold=0.5,
+                 evaluate_difficult=False, ap_version="integral"):
+        super().__init__(name)
+        if ap_version not in ("integral", "11point"):
+            raise ValueError(f"unknown ap_version {ap_version}")
+        self.overlap_threshold = overlap_threshold
+        self.evaluate_difficult = evaluate_difficult
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        self._dets = []   # (img_id, label, score, box)
+        self._gts = []    # (img_id, label, box, difficult)
+        self._img = 0
+
+    def update(self, detections, gt_boxes, difficult=None):
+        detections = np.asarray(detections, np.float64).reshape(-1, 6)
+        gt_boxes = np.asarray(gt_boxes, np.float64).reshape(-1, 5)
+        if difficult is None:
+            difficult = np.zeros(len(gt_boxes), bool)
+        else:
+            difficult = np.asarray(difficult).astype(bool).reshape(-1)
+        for d in detections:
+            self._dets.append((self._img, int(d[0]), float(d[1]), d[2:6]))
+        for g, hard in zip(gt_boxes, difficult):
+            self._gts.append((self._img, int(g[0]), g[1:5], bool(hard)))
+        self._img += 1
+
+    @staticmethod
+    def _iou(a, b):
+        ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+        ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+        iw, ih = max(ix2 - ix1, 0.0), max(iy2 - iy1, 0.0)
+        inter = iw * ih
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def _average_precision(self, recall, precision):
+        if self.ap_version == "11point":
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                mask = recall >= t
+                ap += (precision[mask].max() if mask.any() else 0.0) / 11
+            return ap
+        # integral (VOC-style): sum precision over recall increments
+        mrec = np.concatenate([[0.0], recall, [1.0]])
+        mpre = np.concatenate([[0.0], precision, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = np.where(mrec[1:] != mrec[:-1])[0]
+        return float(((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]).sum())
+
+    def eval(self):
+        labels = sorted({g[1] for g in self._gts})
+        aps = []
+        for cls in labels:
+            gts = [g for g in self._gts if g[1] == cls]
+            if not self.evaluate_difficult:
+                n_pos = sum(1 for g in gts if not g[3])
+            else:
+                n_pos = len(gts)
+            dets = sorted((d for d in self._dets if d[1] == cls),
+                          key=lambda d: -d[2])
+            matched = set()
+            tp = np.zeros(len(dets))
+            fp = np.zeros(len(dets))
+            for i, (img, _lab, _score, box) in enumerate(dets):
+                cand = [(j, g) for j, g in enumerate(gts) if g[0] == img]
+                best, best_iou = None, self.overlap_threshold
+                for j, g in cand:
+                    iou = self._iou(box, g[2])
+                    if iou >= best_iou:
+                        best, best_iou = j, iou
+                if best is None:
+                    fp[i] = 1
+                elif gts[best][3] and not self.evaluate_difficult:
+                    pass  # difficult boxes neither reward nor punish
+                elif best in matched:
+                    fp[i] = 1
+                else:
+                    matched.add(best)
+                    tp[i] = 1
+            if n_pos == 0:
+                continue
+            ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+            recall = ctp / n_pos
+            precision = ctp / np.maximum(ctp + cfp, 1e-12)
+            aps.append(self._average_precision(recall, precision))
+        return float(np.mean(aps)) if aps else 0.0
+
+
+class Auc(MetricBase):
+    """Streaming ROC AUC from score buckets (reference :Auc)."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._num = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self._num + 1, np.int64)
+        self._stat_neg = np.zeros(self._num + 1, np.int64)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim == 2:
+            preds = preds[:, -1]
+        labels = np.asarray(labels).reshape(-1).astype(bool)
+        idx = np.clip((preds * self._num).astype(np.int64), 0, self._num)
+        self._stat_pos += np.bincount(idx[labels],
+                                      minlength=self._num + 1)
+        self._stat_neg += np.bincount(idx[~labels],
+                                      minlength=self._num + 1)
+
+    def eval(self):
+        # bucket-walk trapezoid integral (same rule as
+        # distributed/fleet/util.py auc, without the cross-worker reduce)
+        area = 0.0
+        tp = fp = 0.0
+        pos, neg = self._stat_pos, self._stat_neg
+        for i in range(len(pos) - 1, -1, -1):
+            new_tp = tp + pos[i]
+            new_fp = fp + neg[i]
+            area += (new_fp - fp) * (tp + new_tp) / 2.0
+            tp, fp = new_tp, new_fp
+        if tp == 0 or fp == 0:
+            return 0.5
+        return float(area / (tp * fp))

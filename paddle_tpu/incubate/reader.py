@@ -2,7 +2,7 @@
 
 
 def sample_list_to_batch(samples):
-    """Stack a list of per-sample field tuples into batched arrays."""
-    import numpy as np
-    cols = list(zip(*samples))
-    return [np.stack([np.asarray(c) for c in col]) for col in cols]
+    """Stack a list of per-sample field tuples into batched arrays
+    (delegates to the shared default_collate_fn)."""
+    from ..io import default_collate_fn
+    return default_collate_fn(samples)

@@ -105,3 +105,37 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
 GradientClipByValue = ClipGradByValue
 GradientClipByNorm = ClipGradByNorm
 GradientClipByGlobalNorm = ClipGradByGlobalNorm
+
+
+class ErrorClipByValue:
+    """reference: fluid/clip.py ErrorClipByValue — clips the GRADIENT of
+    a specific var during backward (attached via var.error_clip).  Kept
+    as a value-clipping callable here."""
+
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, grad):
+        from ..ops.math import clip as _clip
+        return _clip(grad, self.min, self.max)
+
+
+_global_gradient_clip = None
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """reference: fluid/clip.py set_gradient_clip — registers a default
+    gradient clip consumed by optimizers created WITHOUT an explicit
+    grad_clip (the reference attaches it to program params the same
+    way)."""
+    global _global_gradient_clip
+    _global_gradient_clip = clip
+    if param_list:
+        for p in param_list:
+            p.grad_clip = clip
+    return clip
+
+
+def get_gradient_clip():
+    return _global_gradient_clip

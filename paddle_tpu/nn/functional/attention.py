@@ -73,7 +73,8 @@ def _default_block_sizes(seq_q, seq_kv):
 
     def pick(seq):
         # largest 128-multiple block that DIVIDES seq (the kernel rejects
-        # non-dividing blocks); the gate guarantees seq % 128 == 0
+        # non-dividing blocks); the dispatch gate guarantees both seq_q
+        # and seq_kv are multiples of 128, so 128 always divides
         for b in (1024, 512, 256, 128):
             if seq % b == 0:
                 return b
@@ -115,8 +116,10 @@ def _sdpa(q, k, v, mask=None, scale=None, is_causal=False, use_flash=True):
     # (long sequences); at short seq XLA's fused naive path is faster on
     # TPU (measured: GPT-2 S=1024 trains ~1.7x faster via XLA than via the
     # pallas kernel, which pays layout transposes + bwd recompute).
+    seq_kv = k.shape[1]
     if (use_flash and mask is None and _flash_available()
-            and seq >= FLASH_MIN_SEQ and seq % 128 == 0 and d % 64 == 0):
+            and seq >= FLASH_MIN_SEQ and seq % 128 == 0
+            and seq_kv % 128 == 0 and d % 64 == 0):
         return _flash_attention(q, k, v, mask, scale, is_causal)
     return _reference_attention(q, k, v, mask, scale, is_causal)
 

@@ -29,6 +29,12 @@ class Optimizer:
             parameters = list(parameters)
         self._parameter_list = parameters
         self._learning_rate = learning_rate
+        if grad_clip is None:
+            # 1.x fluid.clip.set_gradient_clip registers a process-wide
+            # default consumed by optimizers built without an explicit
+            # grad_clip (reference: fluid/clip.py set_gradient_clip)
+            from ..nn import clip as _clip_mod
+            grad_clip = _clip_mod.get_gradient_clip()
         self._grad_clip = grad_clip
         self._weight_decay = self._parse_wd(weight_decay)
         self._accumulators: dict[int, dict] = {}

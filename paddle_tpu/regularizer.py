@@ -21,3 +21,8 @@ class L1Decay:
 
     def __repr__(self):
         return f"L1Decay({self._coeff})"
+
+
+# 1.x class names (reference: fluid/regularizer.py)
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay

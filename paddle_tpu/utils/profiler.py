@@ -128,3 +128,23 @@ class Timer:
 
     def mean(self):
         return self.total / max(self.count, 1)
+
+
+def reset_profiler():
+    """reference: fluid/profiler.py reset_profiler — drop collected
+    host events."""
+    _host_events.clear()
+
+
+class cuda_profiler:
+    """reference: fluid/profiler.py cuda_profiler — CUDA-specific nvprof
+    control; a no-op context on TPU (jax.profiler covers device traces)."""
+
+    def __init__(self, *a, **k):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

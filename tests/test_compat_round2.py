@@ -267,3 +267,126 @@ class TestDatasetFamilies:
         tf = p.vision.transforms.functional
         out = tf.to_tensor(np.random.rand(6, 6, 3).astype("float32"))
         assert np.asarray(out).shape == (3, 6, 6)
+
+
+class TestFluidMetricsIo:
+    def test_chunk_evaluator_iob(self):
+        from paddle_tpu.fluid.metrics import ChunkEvaluator, chunk_count
+        m = ChunkEvaluator()
+        # IOB, 1 type: B=0 I=1 Outside=2
+        ni, nl, nc = chunk_count([0, 1, 2, 0], [0, 1, 2, 0], "IOB", 1)
+        m.update(ni, nl, nc)
+        assert m.eval() == (1.0, 1.0, 1.0)
+        ni2, nl2, nc2 = chunk_count([0, 2, 2, 0], [0, 1, 2, 0], "IOB", 1)
+        assert (ni2, nl2, nc2) == (2, 2, 1)
+
+    def test_chunk_eval_layer(self):
+        import paddle_tpu.fluid as fluid
+        pre, rec, f1, ni, nl, nc = fluid.layers.chunk_eval(
+            paddle.to_tensor(np.array([[0, 1, 2, 0]], np.int64)),
+            paddle.to_tensor(np.array([[0, 1, 2, 0]], np.int64)),
+            "IOB", 1)
+        assert float(f1.numpy()[0]) == 1.0
+        assert int(nc.numpy()[0]) == 2
+
+    def test_detection_map(self):
+        from paddle_tpu.fluid.metrics import DetectionMAP
+        d = DetectionMAP()
+        d.update([[0, 0.9, 0, 0, 10, 10]], [[0, 0, 0, 10, 10]])
+        d.update([[0, 0.8, 50, 50, 60, 60]], [[0, 0, 0, 10, 10]])
+        assert d.eval() == pytest.approx(0.5, abs=1e-6)
+        d11 = DetectionMAP(ap_version="11point")
+        d11.update([[0, 0.9, 0, 0, 10, 10]], [[0, 0, 0, 10, 10]])
+        assert d11.eval() > 0.9
+
+    def test_edit_distance_and_auc_metrics(self):
+        from paddle_tpu.fluid.metrics import EditDistance, Auc
+        e = EditDistance()
+        e.update([0.0, 2.0])
+        assert e.eval() == (1.0, 0.5)
+        a = Auc()
+        a.update(np.array([0.9, 0.1]), np.array([1, 0]))
+        assert a.eval() == 1.0
+
+    def test_fluid_io_params_roundtrip(self, tmp_path):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu import static
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = static.data("x", [4, 3], "float32")
+                out = fluid.layers.fc(x, 2)
+            exe = fluid.Executor()
+            exe.run(startup)
+            xv = np.random.RandomState(0).rand(4, 3).astype("float32")
+            (before,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+            fluid.io.save_params(exe, str(tmp_path), main_program=main)
+            # perturb then restore
+            for t in main.captures.values():
+                t.set_value(np.zeros_like(np.asarray(t.numpy())))
+            fluid.io.load_params(exe, str(tmp_path), main_program=main)
+            (after,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+            np.testing.assert_allclose(np.asarray(before),
+                                       np.asarray(after), rtol=1e-6)
+        finally:
+            paddle.disable_static()
+
+    def test_batch_reader(self):
+        import paddle_tpu.fluid as fluid
+
+        def reader():
+            yield from range(7)
+
+        batches = list(fluid.io.batch(reader, 3)())
+        assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+        batches2 = list(fluid.io.batch(reader, 3, drop_last=True)())
+        assert batches2 == [[0, 1, 2], [3, 4, 5]]
+
+    def test_data_feeder(self):
+        import paddle_tpu.fluid as fluid
+        fd = fluid.DataFeeder(feed_list=["img", "label"])
+        feed = fd.feed([(np.zeros((2, 2)), 1), (np.ones((2, 2)), 0)])
+        assert feed["img"].shape == (2, 2, 2)
+        assert feed["label"].tolist() == [1, 0]
+
+
+class TestReviewRegressions3:
+    def test_set_gradient_clip_consumed_by_optimizer(self):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu import nn as _nn, optimizer as _opt
+        clip = _nn.ClipGradByGlobalNorm(1e-8)  # effectively zeroes grads
+        fluid.clip.set_gradient_clip(clip)
+        try:
+            net = _nn.Linear(4, 1)
+            opt = _opt.SGD(learning_rate=1.0,
+                           parameters=net.parameters())
+            assert opt._grad_clip is clip
+            w0 = net.weight.numpy().copy()
+            x = T(np.ones((2, 4), "float32"))
+            loss = paddle.mean(net(x))
+            loss.backward()
+            opt.step()
+            # clipped to ~0 norm: weights barely move despite lr=1.0
+            assert np.abs(net.weight.numpy() - w0).max() < 1e-6
+        finally:
+            fluid.clip.set_gradient_clip(None)
+
+    def test_fluid_io_full_surface(self):
+        import paddle_tpu.fluid as fluid
+        for name in ("DataLoader", "Dataset", "BatchSampler",
+                     "DataFeeder", "InMemoryDataset", "QueueDataset",
+                     "save_params", "load_persistables", "batch"):
+            assert hasattr(fluid.io, name), name
+        import paddle_tpu as p
+        assert fluid.DataFeeder is p.io.DataFeeder
+
+    def test_auc_vectorized_update(self):
+        from paddle_tpu.fluid.metrics import Auc
+        a = Auc()
+        rng = np.random.RandomState(0)
+        preds = rng.rand(1000)
+        labels = (preds + 0.3 * rng.randn(1000)) > 0.5
+        a.update(preds, labels)
+        v = a.eval()
+        assert 0.8 < v <= 1.0
