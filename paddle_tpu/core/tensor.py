@@ -198,11 +198,25 @@ class Tensor:
     def cpu(self):
         return self
 
+    def cuda(self, device_id=None, blocking=True):
+        # reference VarBase.cuda; placement is XLA's job on this backend
+        return self
+
     def to(self, *a, **k):
         return self
 
     def pin_memory(self):
         return self
+
+    def value(self):
+        # reference VarBase.value() returns the underlying Variable; the
+        # Tensor IS the value holder here
+        return self
+
+    def gradient(self):
+        """reference varbase_patch_methods gradient() — numpy grad or
+        None."""
+        return None if self.grad is None else self.grad.numpy()
 
     def contiguous(self):
         return self

@@ -29,6 +29,20 @@ class Model:
         self._train_step = None
         self.stop_training = False
         self._eval_fn = None
+        self._mode = "train"
+
+    @property
+    def mode(self):
+        """reference: hapi/model.py:256 — 'train' / 'eval' / 'test'."""
+        return self._mode
+
+    @mode.setter
+    def mode(self, value):
+        self._mode = value
+        if value == "train":
+            self.network.train()
+        else:
+            self.network.eval()
 
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None, strategy=None):
@@ -72,7 +86,8 @@ class Model:
         labels = labels if labels is not None else []
         labels = labels if isinstance(labels, (list, tuple)) else [labels]
         self._sync_weights()
-        self.network.eval()
+        prev = self.mode
+        self.mode = "eval"
         with autograd.no_grad():
             out = self.network(*inputs)
         losses = []
@@ -81,16 +96,17 @@ class Model:
             losses.append(float(loss.numpy()))
         for m in self._metrics:
             m.update(*to_list(m.compute(out, *labels)))
-        self.network.train()
+        self.mode = prev
         return losses, out
 
     def predict_batch(self, inputs):
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         self._sync_weights()
-        self.network.eval()
+        prev = self.mode
+        self.mode = "test"
         with autograd.no_grad():
             out = self.network(*inputs)
-        self.network.train()
+        self.mode = prev
         return out
 
     def _sync_weights(self):

@@ -390,3 +390,22 @@ class TestReviewRegressions3:
         a.update(preds, labels)
         v = a.eval()
         assert 0.8 < v <= 1.0
+
+
+class TestTensorModelMethodParity:
+    def test_tensor_varbase_methods(self):
+        t = T(np.ones((2, 2), "float32"))
+        assert t.cuda() is t and t.value() is t
+        assert t.gradient() is None
+        t.stop_gradient = False
+        (t * 3).sum().backward()
+        np.testing.assert_allclose(t.gradient(), 3.0)
+
+    def test_model_mode(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m = paddle.Model(net)
+        assert m.mode == "train"
+        m.mode = "eval"
+        assert not net.training
+        m.mode = "train"
+        assert net.training
