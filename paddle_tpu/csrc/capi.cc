@@ -15,6 +15,7 @@
 #include <dlfcn.h>
 
 #include <cstring>
+#include <deque>
 #include <map>
 #include <mutex>
 #include <string>
@@ -128,8 +129,10 @@ struct PD_Config {
 
 struct PD_Predictor {
   long handle = 0;
-  std::vector<std::string> input_names;
-  std::vector<std::string> output_names;
+  // deque: growth never moves existing elements, so const char* from
+  // PD_GetInputName/PD_GetOutputName stays valid across PD_Run
+  std::deque<std::string> input_names;
+  std::deque<std::string> output_names;
   std::map<std::string, OutputBuffer> outputs;
 };
 
@@ -149,21 +152,30 @@ void PD_ConfigSetModel(PD_Config* config, const char* model_path,
 static bool fill_names(PD_Predictor* pred) {
   const struct {
     const char* fn;
-    std::vector<std::string>* out;
+    std::deque<std::string>* out;
   } jobs[] = {{"input_names", &pred->input_names},
               {"output_names", &pred->output_names}};
   for (const auto& job : jobs) {
     PyObject* names =
         bridge_call(job.fn, Py_BuildValue("(l)", pred->handle));
     if (!names) return false;
-    job.out->clear();
     Py_ssize_t n = PySequence_Size(names);
+    if (n < 0) {
+      PyErr_Clear();
+      Py_DECREF(names);
+      set_error("fill_names: bridge returned a non-sequence");
+      return false;
+    }
+    // Compare-and-keep: const char* from PD_GetInputName/PD_GetOutputName
+    // must stay valid across PD_Run (the reference C API keeps name storage
+    // stable), so only touch entries whose value actually changed.
+    job.out->resize(n);
     for (Py_ssize_t i = 0; i < n; ++i) {
       PyObject* item = PySequence_GetItem(names, i);
       const char* c = item ? PyUnicode_AsUTF8(item) : nullptr;
       // keep index alignment even on a bad entry, and never leave a
       // pending exception behind this frame
-      job.out->push_back(c ? c : "");
+      if (c && (*job.out)[i] != c) (*job.out)[i] = c;
       if (!c) PyErr_Clear();
       Py_XDECREF(item);
     }

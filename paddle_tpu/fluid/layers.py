@@ -125,7 +125,8 @@ def sums(input, out=None):
     import paddle_tpu as _p
     res = _p.add_n(list(input))
     if out is not None:
-        out._data = res._data
+        from ..core import autograd
+        autograd.adopt_result(out, res)
         return out
     return res
 

@@ -121,7 +121,10 @@ class ExponentialMovingAverage:
         for i, p in enumerate(params):
             self._params[i] = p
             if i not in self._shadow:
-                self._shadow[i] = jnp.array(p._data)
+                # Zero-init to match the reference (_create_ema_vars inits the
+                # EMA var to 0.0), which is what justifies apply()'s division
+                # by the bias-correction factor 1 - decay^t.
+                self._shadow[i] = jnp.zeros_like(p._data)
 
     def update(self, layer_or_params=None):
         if layer_or_params is not None or not self._params:

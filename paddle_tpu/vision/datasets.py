@@ -14,6 +14,7 @@ import os
 import pickle
 import struct
 import tarfile
+import warnings
 
 import numpy as np
 
@@ -167,12 +168,18 @@ class Flowers(Dataset):
         self.mode = mode
         self.transform = transform
         base = os.path.join(DATA_HOME, "flowers")
+        explicit = data_file is not None
         data_file = data_file or os.path.join(base, "102flowers.tgz")
         if os.path.exists(data_file):
-            raise NotImplementedError(
-                "Flowers: .tgz/.mat parsing for a local cache is not "
-                "implemented — extract to numpy and pass image arrays, "
-                "or rely on the synthetic fallback")
+            if explicit:
+                raise NotImplementedError(
+                    "Flowers: .tgz/.mat parsing for a local cache is not "
+                    "implemented — extract to numpy and pass image arrays, "
+                    "or omit data_file to use the synthetic fallback")
+            warnings.warn(
+                "Flowers: found a cached archive at %s but .tgz/.mat parsing "
+                "is not implemented; falling back to synthetic data"
+                % data_file)
         n = synthetic_size or {"train": 6149, "valid": 1020,
                                "test": 1020}.get(mode, 1020)
         n = int(os.environ.get("PADDLE_TPU_SYNTH_N", n))

@@ -66,9 +66,38 @@ class TestFluidOptimizers:
         raw = net(x).numpy()
         with ema.apply():
             inside = net(x).numpy()
+            # EMA weights must stay the same order of magnitude as the raw
+            # weights (the round-1 bug scaled them ~1/(1-0.999^N))
+            for i, p in ema._params.items():
+                w = np.asarray(p._data)
+                b = np.asarray(ema._backup[i])
+                assert np.abs(w).max() <= 10 * max(np.abs(b).max(), 1e-6), (
+                    "EMA apply() produced runaway-scaled weights")
         after = net(x).numpy()
         assert not np.allclose(raw, inside)
         np.testing.assert_allclose(raw, after)  # restored
+
+    def test_ema_matches_hand_computation(self):
+        paddle.seed(3)
+        net = nn.Linear(3, 2)
+        decay = 0.9
+        ema = fluid.optimizer.ExponentialMovingAverage(decay)
+        hand = None
+        steps = 4
+        for t in range(steps):
+            # mutate params deterministically, then update the EMA
+            for p in net.parameters():
+                p._data = p._data + 0.1
+            ema.update(net)
+            vals = [np.asarray(p._data) for p in net.parameters()]
+            if hand is None:
+                hand = [np.zeros_like(v) for v in vals]
+            hand = [decay * h + (1 - decay) * v for h, v in zip(hand, vals)]
+        bias = 1.0 - decay ** steps
+        with ema.apply():
+            for p, h in zip(net.parameters(), hand):
+                np.testing.assert_allclose(
+                    np.asarray(p._data), h / bias, rtol=1e-5)
 
     def test_model_average(self):
         x, y = _problem()
