@@ -38,21 +38,22 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     """paddle.grad — gradients of outputs wrt inputs via the eager tape.
 
     Implemented by running backward with retain_graph and reading the leaf
-    grads; create_graph (double grad) is served by the jit/functional path
-    (jax.grad of jax.grad), not the eager tape.
+    grads.  ``create_graph=True`` records the backward itself on the tape
+    (reference: imperative/partial_grad_engine.cc), so the returned grads
+    are differentiable — gradient-penalty / double-grad training works.
     """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use paddle_tpu.jit functional transforms "
-            "(jax.grad composition) for higher-order gradients")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if retain_graph is None:
+        retain_graph = create_graph
     saved = [(t.grad, t._retain_grad) for t in inputs]
     for t in inputs:
         t.grad = None
         t._retain_grad = True
     _autograd.backward(list(outputs), grad_outputs,
-                       retain_graph=bool(retain_graph))
+                       retain_graph=bool(retain_graph),
+                       create_graph=create_graph,
+                       _leaf_targets={id(t) for t in inputs})
     grads = []
     for t, (old, old_retain) in zip(inputs, saved):
         g = t.grad

@@ -68,7 +68,8 @@ class GradNode:
     """
 
     __slots__ = ("id", "inputs", "out_refs", "out_meta", "vjp_fn", "name",
-                 "__weakref__")
+                 "primal_fn", "primal_in", "out_container",
+                 "primal_has_aux", "__weakref__")
 
     def __init__(self, inputs, outputs, vjp_fn, name=""):
         _node_counter[0] += 1
@@ -78,6 +79,14 @@ class GradNode:
         self.out_meta = [(o.shape, o._data.dtype) for o in outputs]
         self.vjp_fn = vjp_fn                      # cotangents tuple -> input grads
         self.name = name
+        # double-grad support (reference: imperative/partial_grad_engine.cc):
+        # the dispatcher stashes the op's pure forward + primal arrays so
+        # create_graph=True can re-derive d(vjp)/d(primal) — the term a
+        # closure-only vjp application would silently drop.
+        self.primal_fn = None     # pure fn(*primal_in) -> out structure
+        self.primal_in = None     # tuple of arrays at record time
+        self.out_container = None  # tuple/list type of fn output, or None
+        self.primal_has_aux = False
 
     def outputs_alive(self):
         return [r() for r in self.out_refs]
@@ -148,11 +157,18 @@ def _collect_nodes(root_nodes):
     return sorted(seen.values(), key=lambda n: -n.id)
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False):
+def backward(tensors, grad_tensors=None, retain_graph=False,
+             create_graph=False, _leaf_targets=None):
     """Run reverse mode from `tensors` (reference: basic_engine.cc:265).
 
     Leaf tensors (stop_gradient=False, no grad node) receive ``.grad``.
     Non-leaf tensors receive ``.grad`` only if ``retain_grads()`` was called.
+    With ``create_graph=True`` the backward computation itself is recorded
+    on the tape (reference: imperative/partial_grad_engine.cc — double
+    grad), so the produced ``.grad`` tensors are differentiable.
+    ``_leaf_targets`` (set of tensor ids) restricts which tensors receive
+    ``.grad`` — ``paddle.grad`` uses it so leaves outside ``inputs`` are
+    not polluted (PartialGradEngine semantics).
     """
     from .tensor import Tensor
 
@@ -162,6 +178,9 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         grad_tensors = [None] * len(tensors)
     elif not isinstance(grad_tensors, (list, tuple)):
         grad_tensors = [grad_tensors]
+    if create_graph:
+        return _backward_create_graph(tensors, grad_tensors, retain_graph,
+                                      _leaf_targets)
 
     # cotangent store keyed by id(tensor); tensors kept alive by node refs
     grads: dict[int, jax.Array] = {}
@@ -176,10 +195,13 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
         grads[id(t)] = grads.get(id(t), 0) + g_arr
 
+    def _want(t):
+        return _leaf_targets is None or id(t) in _leaf_targets
+
     roots = [t._grad_node for t in tensors if t._grad_node is not None]
     # seed leaves passed directly
     for t in tensors:
-        if t._grad_node is None and not t.stop_gradient:
+        if t._grad_node is None and not t.stop_gradient and _want(t):
             _accumulate_leaf(t, grads[id(t)])
 
     for node in _collect_nodes(roots):
@@ -203,21 +225,163 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             if g is None:
                 continue
             if t._grad_node is None:
-                _accumulate_leaf(t, g)
+                if _want(t):
+                    _accumulate_leaf(t, g)
             else:
                 grads[id(t)] = _sum(grads.get(id(t)), g)
-                if t._retain_grad:
+                if t._retain_grad and _want(t):
                     _accumulate_leaf(t, g)
         if not retain_graph:
             # keep the node (so a second backward raises via _freed_vjp)
-            # but drop the closure and its forward residuals
+            # but drop the closures and their forward residuals
             node.vjp_fn = _freed_vjp
+            node.primal_fn = None
+            node.primal_in = None
 
 
 def _freed_vjp(*_):
     raise RuntimeError(
         "Trying to backward through the graph a second time; "
         "pass retain_graph=True to backward() if needed.")
+
+
+# ---------------------------------------------------------------------------
+# double grad (create_graph=True)
+#
+# Reference: imperative/partial_grad_engine.cc — PartialGradEngine builds
+# grad-of-grad nodes.  Here each node's vjp application is re-dispatched as
+# a RECORDED op over (cotangents, original primal inputs): jax re-derives
+# the vjp from the stashed pure forward, so the produced gradients depend
+# differentiably on BOTH the cotangents and the primals (the x-dependence a
+# closure-only vjp application would treat as constant).
+
+def _apply_grad_op(node, ct_tensors):
+    from .tensor import Tensor
+    container = node.out_container
+    n_ct = len(ct_tensors)
+
+    def gop(*flat):
+        cts, prim = flat[:n_ct], flat[n_ct:]
+        if node.primal_has_aux:
+            _, vjp2, _ = jax.vjp(node.primal_fn, *prim, has_aux=True)
+        else:
+            _, vjp2 = jax.vjp(node.primal_fn, *prim)
+        ct_struct = container(cts) if container is not None else cts[0]
+        return tuple(vjp2(ct_struct))
+
+    inputs_all = list(ct_tensors) + list(node.inputs)
+    arrays_all = [t._data for t in ct_tensors] + list(node.primal_in)
+    diff_idx = [i for i, t in enumerate(inputs_all)
+                if not t.stop_gradient and
+                jnp.issubdtype(t._data.dtype, jnp.floating)]
+    if not (diff_idx and grad_enabled()):
+        return [Tensor(o, stop_gradient=True) for o in gop(*arrays_all)]
+
+    def closed(*diff_arrays):
+        full = list(arrays_all)
+        for i, d in zip(diff_idx, diff_arrays):
+            full[i] = d
+        return gop(*full)
+
+    primal_in = tuple(arrays_all[i] for i in diff_idx)
+    out, vjp_fn = jax.vjp(closed, *primal_in)
+    out_t = [Tensor(o, stop_gradient=False) for o in out]
+    node2 = record([inputs_all[i] for i in diff_idx], out_t,
+                   lambda ct: vjp_fn(ct if isinstance(ct, tuple)
+                                     else (ct,)),
+                   (node.name or "op") + "_grad")
+    node2.primal_fn = closed
+    node2.primal_in = primal_in
+    node2.out_container = tuple
+    return out_t
+
+
+def _backward_create_graph(tensors, grad_tensors, retain_graph,
+                           _leaf_targets=None):
+    from .tensor import Tensor
+
+    grads: dict[int, "Tensor"] = {}
+
+    def _want(t):
+        return _leaf_targets is None or id(t) in _leaf_targets
+
+    def _tadd(a, b):
+        return b if a is None else a + b  # Tensor add: recorded
+
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "backward() on a non-scalar tensor requires explicit "
+                    "grad_tensors (got shape %s)" % (t.shape,))
+            g_t = Tensor(jnp.ones(t.shape, t._data.dtype),
+                         stop_gradient=True)
+        else:
+            g_t = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g),
+                                                         stop_gradient=True)
+        grads[id(t)] = _tadd(grads.get(id(t)), g_t)
+
+    roots = [t._grad_node for t in tensors if t._grad_node is not None]
+    for t in tensors:
+        if t._grad_node is None and not t.stop_gradient and _want(t):
+            _accumulate_leaf_tensor(t, grads[id(t)])
+
+    for node in _collect_nodes(roots):
+        if node.vjp_fn is _freed_vjp:
+            _freed_vjp()
+        outs = node.outputs_alive()
+        cotangents = []
+        any_seed = False
+        for ref, (shape, dtype) in zip(outs, node.out_meta):
+            g = grads.pop(id(ref), None) if ref is not None else None
+            if g is None:
+                cotangents.append(Tensor(jnp.zeros(shape, dtype),
+                                         stop_gradient=True))
+            else:
+                any_seed = True
+                if g._data.dtype != dtype:
+                    g = _recorded_cast(g, dtype)
+                cotangents.append(g)
+        if not any_seed:
+            continue
+        if node.primal_fn is None:
+            raise RuntimeError(
+                f"double grad through op '{node.name}': no primal record "
+                "(create_graph=True requires dispatcher-recorded ops)")
+        in_grads = _apply_grad_op(node, cotangents)
+        for t, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            if t._grad_node is None:
+                if _want(t):
+                    _accumulate_leaf_tensor(t, g)
+            else:
+                grads[id(t)] = _tadd(grads.get(id(t)), g)
+                if t._retain_grad and _want(t):
+                    _accumulate_leaf_tensor(t, g)
+        # nodes are never freed under create_graph: the produced grad
+        # graph references them for the next-order backward
+
+
+def _recorded_cast(g, dtype):
+    """Cast through the dispatched op so a graph-carrying gradient keeps
+    its differentiable history (a bare Tensor(asarray(...)) would drop the
+    grad node and silently zero higher-order terms)."""
+    from .tensor import Tensor
+    if g.stop_gradient and g._grad_node is None:
+        return Tensor(jnp.asarray(g._data, dtype), stop_gradient=True)
+    from ..ops import cast as ops_cast
+    return ops_cast(g, jnp.dtype(dtype).name)
+
+
+def _accumulate_leaf_tensor(t, g):
+    """Accumulate a (possibly graph-carrying) Tensor gradient."""
+    if g._data.dtype != t._data.dtype:
+        g = _recorded_cast(g, t._data.dtype)
+    if t.grad is None:
+        t.grad = g
+    else:
+        t.grad = t.grad + g
 
 
 def _sum(a, b):

@@ -108,8 +108,13 @@ def primitive(name=None, nondiff=(), has_aux=False):
                 out, vjp_fn, aux = *jax.vjp(closed, *primal_in), None
 
             out_tensors = _wrap_out(op_name, out, False)
-            autograd.record([args[i] for i in diff_idx], out_tensors,
-                            _structured_vjp(vjp_fn, out), op_name)
+            node = autograd.record([args[i] for i in diff_idx], out_tensors,
+                                   _structured_vjp(vjp_fn, out), op_name)
+            node.primal_fn = closed
+            node.primal_in = primal_in
+            node.out_container = type(out) if isinstance(
+                out, (tuple, list)) else None
+            node.primal_has_aux = has_aux
             res = list(out_tensors)
             if aux is not None:
                 res += _wrap_out(op_name, aux, True)

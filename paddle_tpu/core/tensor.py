@@ -139,8 +139,10 @@ class Tensor:
         return int(self.item())
 
     # -- autograd ---------------------------------------------------------
-    def backward(self, grad_tensor=None, retain_graph=False):
-        autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
+    def backward(self, grad_tensor=None, retain_graph=False,
+                 create_graph=False):
+        autograd.backward([self], [grad_tensor], retain_graph=retain_graph,
+                          create_graph=create_graph)
 
     def retain_grads(self):
         self._retain_grad = True

@@ -65,6 +65,7 @@ class SparseTable:
             }
         else:
             self.state = {}
+        self._push_fn = self._build_push()
 
     # -- RPC-shaped API (reference PsService pull/push, sendrecv.proto) --
     def pull(self, ids):
@@ -72,51 +73,129 @@ class SparseTable:
         ids = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
         return Tensor(jnp.take(self.weight, ids, axis=0))
 
+    def _build_push(self):
+        """O(batch) push: unique-ids + segment-sum merge + gather → update
+        → delta-scatter, jitted with the table buffers DONATED so XLA
+        updates rows in place — per-push cost is independent of table size
+        (reference: common_sparse_table.cc:40 updates only touched shards;
+        the round-1 dense ``zeros_like(weight)`` materialization was
+        O(rows·dim) per push)."""
+        rows, lr, optimizer = self.rows, self.lr, self.optimizer
+
+        def push_fn(weight, state, ids, g):
+            n = ids.shape[0]
+            uids, inv = jnp.unique(ids, size=n, fill_value=rows,
+                                   return_inverse=True)
+            merged = jax.ops.segment_sum(g, inv.reshape(-1),
+                                         num_segments=n)
+            valid = (uids < rows)[:, None]
+            uc = jnp.where(uids < rows, uids, 0)
+            w_rows = weight[uc]
+            if optimizer == "adam":
+                t = state["t"] + 1
+                b1, b2, eps = 0.9, 0.999, 1e-8
+                m_rows = state["m"][uc]
+                v_rows = state["v"][uc]
+                m_new = b1 * m_rows + (1 - b1) * merged
+                v_new = b2 * v_rows + (1 - b2) * merged ** 2
+                mhat = m_new / (1 - b1 ** t)
+                vhat = v_new / (1 - b2 ** t)
+                new_rows = w_rows - lr * mhat / (jnp.sqrt(vhat) + eps)
+                # delta-adds: padded slots add 0, so a colliding clamp
+                # index never overwrites a real update
+                new_m = state["m"].at[uc].add(
+                    jnp.where(valid, m_new - m_rows, 0.0))
+                new_v = state["v"].at[uc].add(
+                    jnp.where(valid, v_new - v_rows, 0.0))
+                new_state = {"m": new_m, "v": new_v, "t": t}
+            else:
+                new_rows = w_rows - lr * merged
+                new_state = state
+            new_w = weight.at[uc].add(
+                jnp.where(valid, new_rows - w_rows, 0.0))
+            return new_w, new_state
+
+        return jax.jit(push_fn, donate_argnums=(0, 1))
+
     def push(self, ids, grads):
         """Apply grads to touched rows (trainer 'push_sparse').  Repeated
         ids accumulate (scatter-add), matching SelectedRows merge-add."""
         ids = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
         g = grads._data if isinstance(grads, Tensor) else jnp.asarray(grads)
         ids = ids.reshape(-1)
-        g = g.reshape(-1, self.dim)
-        dense_g = jnp.zeros_like(self.weight).at[ids].add(g)
-        touched = jnp.zeros((self.rows,), bool).at[ids].set(True)
-        if self.optimizer == "adam":
-            t = self.state["t"] + 1
-            b1, b2, eps = 0.9, 0.999, 1e-8
-            m = jnp.where(touched[:, None],
-                          b1 * self.state["m"] + (1 - b1) * dense_g,
-                          self.state["m"])
-            v = jnp.where(touched[:, None],
-                          b2 * self.state["v"] + (1 - b2) * dense_g ** 2,
-                          self.state["v"])
-            mhat = m / (1 - b1 ** t)
-            vhat = v / (1 - b2 ** t)
-            upd = self.lr * mhat / (jnp.sqrt(vhat) + eps)
-            self.weight = jnp.where(touched[:, None], self.weight - upd,
-                                    self.weight)
-            self.state = {"m": m, "v": v, "t": t}
-        else:
-            self.weight = self.weight - self.lr * dense_g
-        self.weight = jax.device_put(self.weight, self._sharding)
+        g = g.reshape(-1, self.dim).astype(self.weight.dtype)
+        self.weight, self.state = self._push_fn(self.weight, self.state,
+                                                ids, g)
 
-    # -- persistence (reference: table save/load to dirname shards) ------
-    def save(self, dirname):
+    # -- persistence (reference: table save/load to dirname shards;
+    # common_sparse_table.cc Save writes one file per shard) -------------
+    def save(self, dirname, num_shards=None):
+        """Write the table as ``num_shards`` row-range shard files
+        (default: one per mesh 'sharding' slice), so a table larger than
+        one host's memory can be dumped/restored piecewise."""
         os.makedirs(dirname, exist_ok=True)
-        with open(os.path.join(dirname, f"{self.name}.table"), "wb") as f:
-            pickle.dump({"weight": np.asarray(self.weight),
-                         "state": {k: np.asarray(v)
-                                   for k, v in self.state.items()},
-                         "rows": self.rows, "dim": self.dim,
-                         "optimizer": self.optimizer, "lr": self.lr},
-                        f, protocol=4)
+        if num_shards is None:
+            num_shards = max(self.mesh.shape.get("sharding", 1), 1)
+        bounds = np.linspace(0, self.rows, num_shards + 1, dtype=np.int64)
+        meta = {"rows": self.rows, "dim": self.dim,
+                "optimizer": self.optimizer, "lr": self.lr,
+                "num_shards": int(num_shards),
+                "bounds": bounds.tolist(),
+                "state_t": int(self.state.get("t", 0))
+                if self.optimizer == "adam" else 0}
+        with open(os.path.join(dirname, f"{self.name}.meta"), "wb") as f:
+            pickle.dump(meta, f, protocol=4)
+        for s in range(num_shards):
+            lo, hi = int(bounds[s]), int(bounds[s + 1])
+            blob = {"weight": np.asarray(self.weight[lo:hi])}
+            for k in ("m", "v"):
+                if k in self.state:
+                    blob[k] = np.asarray(self.state[k][lo:hi])
+            with open(os.path.join(
+                    dirname, f"{self.name}.shard{s}"), "wb") as f:
+                pickle.dump(blob, f, protocol=4)
 
     def load(self, dirname):
-        with open(os.path.join(dirname, f"{self.name}.table"), "rb") as f:
-            data = pickle.load(f)
-        self.weight = jax.device_put(jnp.asarray(data["weight"]),
-                                     self._sharding)
-        self.state = {k: jnp.asarray(v) for k, v in data["state"].items()}
+        meta_path = os.path.join(dirname, f"{self.name}.meta")
+        legacy = os.path.join(dirname, f"{self.name}.table")
+        if not os.path.exists(meta_path) and os.path.exists(legacy):
+            with open(legacy, "rb") as f:  # round-1 single-file format
+                data = pickle.load(f)
+            self.weight = jax.device_put(jnp.asarray(data["weight"]),
+                                         self._sharding)
+            self.state = {k: jnp.asarray(v)
+                          for k, v in data["state"].items()}
+            return
+        with open(meta_path, "rb") as f:
+            meta = pickle.load(f)
+        if (meta["rows"], meta["dim"]) != (self.rows, self.dim):
+            raise ValueError(
+                f"table {self.name}: stored shape "
+                f"({meta['rows']},{meta['dim']}) != constructed "
+                f"({self.rows},{self.dim})")
+        bounds = meta["bounds"]
+        w = np.empty((self.rows, self.dim), np.float32)
+        state_np = {k: np.empty((self.rows, self.dim), np.float32)
+                    for k in ("m", "v")} if self.optimizer == "adam" else {}
+        for s in range(meta["num_shards"]):
+            lo, hi = int(bounds[s]), int(bounds[s + 1])
+            with open(os.path.join(
+                    dirname, f"{self.name}.shard{s}"), "rb") as f:
+                blob = pickle.load(f)
+            w[lo:hi] = blob["weight"]
+            for k in state_np:
+                state_np[k][lo:hi] = blob[k]
+        self.weight = jax.device_put(jnp.asarray(w), self._sharding)
+        if self.optimizer == "adam":
+            self.state = {
+                "m": jax.device_put(jnp.asarray(state_np["m"]),
+                                    self._sharding),
+                "v": jax.device_put(jnp.asarray(state_np["v"]),
+                                    self._sharding),
+                "t": jnp.asarray(meta.get("state_t", 0), jnp.int32),
+            }
+        else:
+            self.state = {}
 
 
 class DistributedEmbedding:
@@ -158,8 +237,8 @@ class TheOnePS:
     def init_server(self, dirname=None, var_names=None, **kwargs):
         if dirname:
             for name, table in self.tables.items():
-                path = os.path.join(dirname, f"{name}.table")
-                if os.path.exists(path):
+                if any(os.path.exists(os.path.join(dirname, f"{name}{ext}"))
+                       for ext in (".meta", ".table")):
                     table.load(dirname)
 
     def run_server(self):

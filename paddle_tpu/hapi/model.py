@@ -133,6 +133,16 @@ class Model:
             callbacks, model=self, epochs=epochs, steps=steps,
             verbose=verbose, save_freq=save_freq, save_dir=save_dir,
             metrics=[m.name() for m in self._metrics])
+        # async device prefetch (reference: buffered_reader.cc double
+        # buffer): batches are already en route to the mesh, pre-placed
+        # with the step's data sharding, while the previous step runs
+        import jax as _jax
+        feed = loader
+        if _jax.process_count() == 1 and not self._train_step.is_pipeline:
+            from ..io import DeviceLoader
+            feed = DeviceLoader(
+                loader, buffer_size=2,
+                sharding_fn=self._train_step._data_sharding)
         self.stop_training = False
         cbks.on_train_begin()
         it = 0
@@ -142,7 +152,7 @@ class Model:
                 loader.batch_sampler.set_epoch(epoch)
             cbks.on_epoch_begin(epoch)
             last_logs = {}
-            for step, batch in enumerate(loader):
+            for step, batch in enumerate(feed):
                 cbks.on_train_batch_begin(step)
                 ins, labs = self._split_batch(batch)
                 loss = self._train_step.step(ins, labs)

@@ -376,11 +376,22 @@ class DataLoader:
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False):
+        import os as _os
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = max(0, num_workers)
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        # process workers are the default (reference: dataloader_iter.py
+        # _DataLoaderIterMultiProcess); threads remain as an opt-out for
+        # unpicklable/fork-hostile setups
+        self._use_threads = _os.environ.get(
+            "PADDLE_TPU_THREAD_WORKERS", "0") == "1"
+        self._pool = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -410,13 +421,53 @@ class DataLoader:
         if batch and not getattr(self, "drop_last", False):
             yield _to_tensors(self.collate_fn(batch), self.return_list)
 
+    def _get_pool(self):
+        from .worker import WorkerPool
+        if self._pool is not None and not self._pool._closed and \
+                not self._pool.busy:
+            return self._pool
+        # a second concurrent iterator gets its OWN pool: sharing one
+        # result queue across generations would drop/unlink each other's
+        # batches and deadlock both iterators
+        pool = WorkerPool(self)
+        if self._pool is None or self._pool._closed:
+            self._pool = pool
+        return pool
+
     def __iter__(self):
+        from .worker import MultiprocessMapIter, MultiprocessIterableIter
         if self._iterable_mode:
+            if self.num_workers > 0 and not self._use_threads:
+                mp_it = MultiprocessIterableIter(self)
+                return (_to_tensors(d, self.return_list) for d in mp_it)
             return self._iter_iterable()
         batches = list(self.batch_sampler)
         if self.num_workers > 0:
-            return _PrefetchIter(self, batches)
+            if self._use_threads:
+                return _PrefetchIter(self, batches)
+            pool = self._get_pool()
+            mp_it = MultiprocessMapIter(self, batches, pool)
+            return self._wrap_mp(mp_it, pool)
         return self._iter_sync(batches)
+
+    def _wrap_mp(self, mp_it, pool):
+        try:
+            for data in mp_it:
+                yield _to_tensors(data, self.return_list)
+        finally:
+            pool.busy = False
+            if not self.persistent_workers:
+                pool.close()
+                if self._pool is pool:
+                    self._pool = None
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            try:
+                pool.close()
+            except Exception:
+                pass
 
     def _iter_sync(self, batches):
         for idx_batch in batches:
@@ -424,9 +475,8 @@ class DataLoader:
             yield _to_tensors(self.collate_fn(samples), self.return_list)
 
 
-def get_worker_info():
-    return None  # thread-based workers share the process
-
+from .worker import get_worker_info, WorkerInfo  # noqa: E402
+from .prefetch import DeviceLoader  # noqa: E402
 
 from .native_dataset import (InMemoryDataset, QueueDataset,  # noqa: E402
                              DatasetFactory)

@@ -118,6 +118,24 @@ class StaticFunction:
         self._input_spec = input_spec
         self._cache = {}
         self.forward = self.__call__
+        # AST control-flow conversion (reference: dygraph_to_static
+        # program_translator + ifelse/loop transformers): rewrite tensor-
+        # dependent if/while into converter calls.  Semantics-preserving
+        # eagerly, so the converted forward replaces the original for both
+        # modes; tracing stays the fallback when there is nothing to
+        # convert or the source is unavailable.
+        from . import dy2static as _d2s
+        if self._layer is not None:
+            fwd = type(self._layer).forward
+            if not getattr(fwd, "__wrapped_by_dy2static__", False):
+                conv = _d2s.convert_function(fwd)
+                if conv is not None:
+                    self._layer.forward = conv.__get__(self._layer)
+        elif self._fn is not None and not getattr(
+                self._fn, "__wrapped_by_dy2static__", False):
+            conv = _d2s.convert_function(self._fn)
+            if conv is not None:
+                self._fn = conv
 
     def _key(self, arrays, training):
         return tuple((tuple(a.shape), str(a.dtype)) for a in arrays) + (
@@ -476,14 +494,6 @@ def set_code_level(level=100, also_to_stdout=False):
     set_verbosity(level if level < 100 else 9, also_to_stdout)
 
 
-class _Dy2StaticModule:
-    """`paddle.jit.dy2static` namespace shim (the reference exposes the
-    transformer utilities; here conversion is tracing, so the operators
-    used by converted code map to their lax-backed equivalents)."""
-
-    @staticmethod
-    def convert_call(fn):
-        return fn
-
-
-dy2static = _Dy2StaticModule()
+# `paddle.jit.dy2static` namespace: the real AST-conversion module
+# (convert_ifelse/convert_while_loop/convert_logical_* + convert_function)
+from . import dy2static  # noqa: E402,F401

@@ -1,0 +1,544 @@
+"""dygraph→static AST conversion: tensor-dependent Python control flow.
+
+Reference parity: ``fluid/dygraph/dygraph_to_static/`` — the AST
+transformer pipeline (``program_translator.py:756``; ifelse_transformer,
+loop_transformer, logical_transformer).  The reference rewrites ``if``/
+``while``/``and``/``or``/``not`` into ``convert_ifelse``/
+``convert_while_loop``/``convert_logical_*`` calls that dispatch on
+whether the condition is a Variable.
+
+TPU-native design: same two-stage shape — an ``ast.NodeTransformer``
+rewrites the decorated function once, and the runtime converters dispatch:
+plain Python values take the original Python control flow, traced Tensors
+lower to ``lax.cond`` / ``lax.while_loop`` (via static.nn).  Conversion is
+semantics-preserving eagerly, so a converted forward runs identically
+eager and under ``@to_static`` — the dygraph↔static equivalence contract
+(reference test suite: unittests/dygraph_to_static/, 72 files).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+# ---------------------------------------------------------------------------
+# runtime converters (reference: dygraph_to_static/convert_operators.py)
+
+def _is_traced_tensor(x):
+    return isinstance(x, Tensor) and isinstance(x._data, jax.core.Tracer)
+
+
+def _to_bool_pred(x):
+    """Scalar-ify a tensor predicate (paddle requires numel()==1 here)."""
+    import jax.numpy as jnp
+    arr = x._data
+    if arr.ndim:
+        arr = jnp.reshape(arr, ())
+    return arr.astype(bool)
+
+
+def convert_ifelse(pred, true_fn, false_fn):
+    """reference: convert_operators.convert_ifelse.
+
+    Traced path: both branches are traced and merged leafwise with
+    ``lax.select`` (the canonical XLA lowering of a scalar-predicated
+    branch; avoids lax.cond's pytree-structure pitfalls while XLA still
+    DCEs whichever side is dead under constant folding)."""
+    if _is_traced_tensor(pred):
+        import jax.numpy as jnp
+        from ..ops import where as _ops_where, reshape as _ops_reshape
+        from ..ops import cast as _ops_cast
+
+        p_t = pred if pred.ndim == 0 else _ops_reshape(pred, [])
+        if str(p_t.dtype) != "bool":
+            p_t = _ops_cast(p_t, "bool")
+        t_out = true_fn()
+        f_out = false_fn()
+        t_flat, t_isseq = _flatten_branch(t_out)
+        f_flat, _ = _flatten_branch(f_out)
+        if len(t_flat) != len(f_flat):
+            raise UnsupportedControlFlow(
+                "if/else branches produce different numbers of values")
+        merged = []
+        for tv, fv in zip(t_flat, f_flat):
+            tu, fu = _unwrap(tv), _unwrap(fv)
+            if isinstance(tu, _Undefined) or isinstance(fu, _Undefined):
+                missing = tu if isinstance(tu, _Undefined) else fu
+                if isinstance(tu, _Undefined) and isinstance(fu, _Undefined):
+                    merged.append(tu)  # untouched on both sides
+                    continue
+                raise UnsupportedControlFlow(
+                    f"variable {missing!r} is assigned in only one branch "
+                    "of a tensor-predicated if/else — initialize it before "
+                    "the if (reference: ifelse_transformer)")
+            if hasattr(tu, "dtype") or hasattr(fu, "dtype") or \
+                    isinstance(tu, (int, float, bool)):
+                if jnp.asarray(tu).shape != jnp.asarray(fu).shape or \
+                        jnp.asarray(tu).dtype != jnp.asarray(fu).dtype:
+                    raise UnsupportedControlFlow(
+                        "if/else branch outputs disagree in shape/dtype: "
+                        f"{jnp.asarray(tu).shape}/{jnp.asarray(tu).dtype} "
+                        f"vs {jnp.asarray(fu).shape}/{jnp.asarray(fu).dtype}")
+                # merge through the DISPATCHED where op so the eager tape
+                # (when grad is enabled during the trace) records the
+                # select — raw jnp.where would sever backward at the if
+                tt = tv if isinstance(tv, Tensor) else Tensor(tu)
+                ft = fv if isinstance(fv, Tensor) else Tensor(fu)
+                merged.append(_ops_where(p_t, tt, ft))
+            else:
+                if tu is not fu and tu != fu:
+                    raise UnsupportedControlFlow(
+                        "if/else branches bind a non-tensor value "
+                        f"differently ({tu!r} vs {fu!r}) under a tensor "
+                        "predicate")
+                merged.append(tu)
+        return tuple(merged) if t_isseq else merged[0]
+    if isinstance(pred, Tensor):
+        pred = bool(pred.numpy().reshape(()))
+    return true_fn() if pred else false_fn()
+
+
+def _flatten_branch(out):
+    if isinstance(out, tuple):
+        return list(out), True
+    return [out], False
+
+
+def _unwrap(out):
+    if isinstance(out, Tensor):
+        return out._data
+    if isinstance(out, (tuple, list)):
+        return type(out)(_unwrap(o) for o in out)
+    return out
+
+
+def _rewrap(out):
+    if isinstance(out, (tuple, list)):
+        return type(out)(_rewrap(o) for o in out)
+    if hasattr(out, "dtype"):
+        return Tensor(out, stop_gradient=True)
+    return out
+
+
+def convert_while_loop(cond_fn, body_fn, loop_vars, names=()):
+    """reference: convert_operators.convert_while_loop."""
+    probe = cond_fn(*loop_vars)
+    if _is_traced_tensor(probe) or any(
+            _is_traced_tensor(v) for v in loop_vars):
+        from jax import lax
+
+        for i, v in enumerate(loop_vars):
+            if isinstance(v, _Undefined):
+                nm = v.name or (names[i] if i < len(names) else f"#{i}")
+                raise UnsupportedControlFlow(
+                    f"variable '{nm}' is created inside a tensor-"
+                    "predicated while body — initialize it before the "
+                    "loop so its shape/dtype is known "
+                    "(reference: loop_transformer)")
+        init = tuple(_unwrap(v) for v in loop_vars)
+
+        def cond(state):
+            return _to_bool_pred_arr(
+                _unwrap(cond_fn(*[_rewrap_one(s) for s in state])))
+
+        def body(state):
+            out = body_fn(*[_rewrap_one(s) for s in state])
+            if not isinstance(out, tuple):
+                out = (out,)
+            return tuple(_unwrap(o) for o in out)
+
+        final = lax.while_loop(cond, body, init)
+        return tuple(_rewrap_one(f) for f in final)
+    # plain Python loop
+    vals = tuple(loop_vars)
+    while _plain_bool(cond_fn(*vals)):
+        out = body_fn(*vals)
+        vals = out if isinstance(out, tuple) else (out,)
+    return vals
+
+
+def _rewrap_one(x):
+    return Tensor(x, stop_gradient=True) if hasattr(x, "dtype") else x
+
+
+def _to_bool_pred_arr(arr):
+    import jax.numpy as jnp
+    if hasattr(arr, "ndim") and arr.ndim:
+        arr = jnp.reshape(arr, ())
+    return arr.astype(bool) if hasattr(arr, "astype") else bool(arr)
+
+
+def _plain_bool(x):
+    if isinstance(x, Tensor):
+        return bool(x.numpy().reshape(()))
+    return bool(x)
+
+
+class _Undefined:
+    """Sentinel for names not yet bound when a converted region starts
+    (reference: dygraph_to_static UndefinedVar)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name=""):
+        self.name = name
+
+    def __repr__(self):
+        return f"<undefined '{self.name}'>"
+
+
+UNDEFINED = _Undefined()
+
+
+def lookup(name, local_map):
+    """Preamble helper: current binding of ``name`` or an UNDEFINED
+    sentinel (emitted by the transformer before converted regions)."""
+    v = local_map.get(name, UNDEFINED)
+    return _Undefined(name) if v is UNDEFINED else v
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    """reference: convert_operators.convert_logical_and (short-circuit
+    preserved for plain Python values)."""
+    lhs = lhs_fn()
+    if isinstance(lhs, Tensor):
+        rhs = rhs_fn()
+        from ..ops import logical_and as _land
+        return _land(_as_bool_tensor(lhs), _as_bool_tensor(rhs))
+    return lhs and rhs_fn()
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if _is_traced_tensor(lhs) or isinstance(lhs, Tensor):
+        rhs = rhs_fn()
+        from ..ops import logical_or as _lor
+        return _lor(_as_bool_tensor(lhs), _as_bool_tensor(rhs))
+    return lhs or rhs_fn()
+
+
+def convert_call(fn):
+    """reference: convert_operators.convert_call — recursively convert a
+    callee.  Conversion here is per-decorated-function; callees trace."""
+    return fn
+
+
+def convert_logical_not(x):
+    if isinstance(x, Tensor):
+        from ..ops import logical_not as _lnot
+        return _lnot(_as_bool_tensor(x))
+    return not x
+
+
+def _as_bool_tensor(x):
+    if isinstance(x, Tensor):
+        if str(x.dtype) != "bool":
+            from ..ops import cast
+            return cast(x, "bool")
+        return x
+    return x
+
+
+# ---------------------------------------------------------------------------
+# AST transformer
+
+class UnsupportedControlFlow(Exception):
+    pass
+
+
+class _AssignedNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names = []
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._collect(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._collect(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._collect(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._collect(node.target)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self.names.append(node.name)  # the def itself binds a name
+
+    def _collect(self, target):
+        if isinstance(target, ast.Name):
+            if target.id not in self.names:
+                self.names.append(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._collect(e)
+        # subscript/attribute targets mutate objects, not names
+
+
+def _assigned_names(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+class _LoadedNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.names.add(node.id)
+
+
+def _loaded_names(nodes):
+    v = _LoadedNames()
+    for n in nodes:
+        v.visit(n)
+    return v.names
+
+
+def _has(stmts, kinds):
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, kinds):
+                return True
+    return False
+
+
+_JST = "_paddle_tpu_jst"
+
+
+def _jst_call(fn_name, args):
+    return ast.Call(
+        func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                           attr=fn_name, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _preamble(names, n):
+    """``x = _JST.lookup('x', dict(locals()))`` per name: binds names that
+    may not exist yet to an UNDEFINED sentinel (reference: UndefinedVar),
+    so converted closures can always read them."""
+    map_name = f"__d2s_map_{n}"
+    stmts = [ast.Assign(
+        targets=[ast.Name(id=map_name, ctx=ast.Store())],
+        value=ast.Call(func=ast.Name(id="dict", ctx=ast.Load()),
+                       args=[ast.Call(func=ast.Name(id="locals",
+                                                    ctx=ast.Load()),
+                                      args=[], keywords=[])],
+                       keywords=[]))]
+    for name in names:
+        stmts.append(ast.Assign(
+            targets=[ast.Name(id=name, ctx=ast.Store())],
+            value=_jst_call("lookup",
+                            [ast.Constant(name),
+                             ast.Name(id=map_name, ctx=ast.Load())])))
+    return stmts
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites If / While / BoolOp / Not into converter calls.
+
+    ``if``/``while`` whose condition could be tensor-valued become closure
+    pairs + a converter call; names assigned inside become the
+    returned/threaded variables (the reference's ifelse/loop transformers).
+    """
+
+    def __init__(self):
+        self.counter = 0
+        self._ret_flags = []
+
+    # -- if/else ----------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        n = self.counter
+        self.counter += 1
+        body, orelse = node.body, node.orelse or [ast.Pass()]
+
+        if _has(body + orelse, (ast.Break, ast.Continue)):
+            # leave untouched: converter can't thread break/continue —
+            # tracing will raise the helpful error if the pred is a tensor
+            return node
+        # returns are only convertible in the symmetric both-branches-end-
+        # with-return form; ANY other return (nested in for/with/try, or
+        # asymmetric) keeps Python semantics — a return inside a closure
+        # would silently exit the closure instead of the function
+        body_returns = isinstance(body[-1], ast.Return)
+        else_returns = isinstance(orelse[-1], ast.Return)
+        nested_returns = (_has(body[:-1] if body_returns else body,
+                               ast.Return) or
+                          _has(orelse[:-1] if else_returns else orelse,
+                               ast.Return))
+        if nested_returns or body_returns != else_returns:
+            return node
+
+        ret_name = f"__d2s_ret_{n}"
+        if body_returns:
+            body = [*body[:-1], ast.Assign(
+                targets=[ast.Name(id=ret_name, ctx=ast.Store())],
+                value=body[-1].value or ast.Constant(None))]
+            orelse = [*orelse[:-1], ast.Assign(
+                targets=[ast.Name(id=ret_name, ctx=ast.Store())],
+                value=orelse[-1].value or ast.Constant(None))]
+
+        assigned = _assigned_names(body + orelse)
+        true_name, false_name = f"__d2s_true_{n}", f"__d2s_false_{n}"
+        ret_tuple = ast.Tuple(
+            elts=[ast.Name(id=a, ctx=ast.Load()) for a in assigned],
+            ctx=ast.Load())
+
+        def mkfn(name, stmts):
+            # each assigned name becomes a defaulted parameter seeded from
+            # the enclosing binding (the preamble guarantees it exists),
+            # so a conditionally-bound name inside the closure can never
+            # raise UnboundLocalError — it keeps its pre-if value, exactly
+            # as the original straight-line code would
+            return ast.FunctionDef(
+                name=name,
+                args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg=a) for a in assigned],
+                    kwonlyargs=[], kw_defaults=[],
+                    defaults=[ast.Name(id=a, ctx=ast.Load())
+                              for a in assigned]),
+                body=[*stmts, ast.Return(value=ret_tuple)],
+                decorator_list=[])
+
+        call = _jst_call("convert_ifelse",
+                         [node.test,
+                          ast.Name(id=true_name, ctx=ast.Load()),
+                          ast.Name(id=false_name, ctx=ast.Load())])
+        target = ast.Tuple(
+            elts=[ast.Name(id=a, ctx=ast.Store()) for a in assigned],
+            ctx=ast.Store())
+        out = [*_preamble(assigned, n),
+               mkfn(true_name, body), mkfn(false_name, orelse),
+               ast.Assign(targets=[target], value=call)
+               if assigned else ast.Expr(value=call)]
+        if body_returns:
+            out.append(ast.Return(value=ast.Name(id=ret_name,
+                                                 ctx=ast.Load())))
+        return [ast.fix_missing_locations(ast.copy_location(s, node))
+                for s in out]
+
+    # -- while ------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has(node.body, (ast.Break, ast.Continue,
+                                           ast.Return)):
+            return node  # tracing will raise the guided error if needed
+        n = self.counter
+        self.counter += 1
+        # loop state = names assigned in the body (they must pre-exist;
+        # the preamble binds missing ones to the UNDEFINED sentinel and
+        # the converter raises a named error on the traced path)
+        loop_vars = _assigned_names(node.body)
+        cond_name, body_name = f"__d2s_cond_{n}", f"__d2s_body_{n}"
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=a) for a in loop_vars],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        ret_tuple = ast.Tuple(
+            elts=[ast.Name(id=a, ctx=ast.Load()) for a in loop_vars],
+            ctx=ast.Load())
+        cond_fn = ast.FunctionDef(
+            name=cond_name, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        body_fn = ast.FunctionDef(
+            name=body_name, args=args,
+            body=[*node.body, ast.Return(value=ret_tuple)],
+            decorator_list=[])
+        call = _jst_call(
+            "convert_while_loop",
+            [ast.Name(id=cond_name, ctx=ast.Load()),
+             ast.Name(id=body_name, ctx=ast.Load()),
+             ast.Tuple(elts=[ast.Name(id=a, ctx=ast.Load())
+                             for a in loop_vars], ctx=ast.Load()),
+             ast.Tuple(elts=[ast.Constant(a) for a in loop_vars],
+                       ctx=ast.Load())])
+        target = ast.Tuple(
+            elts=[ast.Name(id=a, ctx=ast.Store()) for a in loop_vars],
+            ctx=ast.Store())
+        out = [*_preamble(loop_vars, n), cond_fn, body_fn,
+               ast.Assign(targets=[target], value=call)
+               if loop_vars else ast.Expr(value=call)]
+        return [ast.fix_missing_locations(ast.copy_location(s, node))
+                for s in out]
+
+    # -- bool ops ---------------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        expr = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            thunk = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=expr)
+            lhs_thunk = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=v)
+            expr = _jst_call(fn, [lhs_thunk, thunk])
+        return ast.copy_location(expr, node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.copy_location(
+                _jst_call("convert_logical_not", [node.operand]), node)
+        return node
+
+
+def convert_function(fn):
+    """AST-convert ``fn``; returns the converted function or None when the
+    source is unavailable/unconvertible (caller falls back to tracing)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return None
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    func_def = tree.body[0]
+    if not isinstance(func_def, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    func_def.decorator_list = []  # run once, undecorated
+    transformer = _ControlFlowTransformer()
+    new_tree = transformer.visit(tree)
+    if transformer.counter == 0:
+        return None  # nothing to convert — tracing alone is enough
+    ast.fix_missing_locations(new_tree)
+    code = compile(new_tree, f"<dy2static:{fn.__qualname__}>", "exec")
+    gl = dict(fn.__globals__)
+    from . import dy2static as _self
+    gl[_JST] = _self
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                gl[name] = cell.cell_contents
+            except ValueError:
+                pass
+    loc = {}
+    exec(code, gl, loc)
+    converted = loc[func_def.name]
+    converted = functools.wraps(fn)(converted)
+    converted.__wrapped_by_dy2static__ = True
+    if fn.__defaults__:
+        converted.__defaults__ = fn.__defaults__
+    return converted

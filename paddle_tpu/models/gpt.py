@@ -74,27 +74,50 @@ class GPTAttention(nn.Layer):
         self.head_dim = hidden_size // num_heads
         self.hidden_size = hidden_size
         self.dropout = dropout
+        self.use_mp = use_mp
         init = nn.ParamAttr(initializer=I.Normal(0.0, 0.02))
         if use_mp:
-            from ..distributed.sharding import (ColumnParallelLinear,
-                                                RowParallelLinear)
-            self.qkv_proj = ColumnParallelLinear(
-                hidden_size, 3 * hidden_size, weight_attr=init,
-                gather_output=False)
-            self.out_proj = RowParallelLinear(
-                hidden_size, hidden_size, weight_attr=init,
-                input_is_parallel=True)
+            # Einsum-form head-parallel projections: weights carry the head
+            # axis explicitly ([E, 3, H, hd] / [H, hd, E]) so the 'mp'
+            # sharding lives on H end-to-end and NO reshape ever crosses a
+            # sharded dim.  The [b,s,3E]->[b,s,3,H,hd] reshape of the fused
+            # layout forced XLA SPMD into "involuntary full
+            # rematerialization" (it cannot re-tile an E split into an H
+            # split without replicating); see MULTICHIP_r01.json.
+            from jax.sharding import PartitionSpec as P
+            self.qkv_weight = self.create_parameter(
+                [hidden_size, 3, num_heads, self.head_dim], attr=init)
+            self.qkv_weight.partition_spec = P(None, None, "mp", None)
+            self.qkv_weight.is_distributed = True
+            self.qkv_bias = self.create_parameter(
+                [3, 1, num_heads, self.head_dim], is_bias=True)
+            self.qkv_bias.partition_spec = P(None, None, "mp", None)
+            self.qkv_bias.is_distributed = True
+            self.out_weight = self.create_parameter(
+                [num_heads, self.head_dim, hidden_size], attr=init)
+            self.out_weight.partition_spec = P("mp", None, None)
+            self.out_weight.is_distributed = True
+            self.out_bias = self.create_parameter(
+                [hidden_size], is_bias=True)
         else:
             self.qkv_proj = nn.Linear(hidden_size, 3 * hidden_size,
                                       weight_attr=init)
             self.out_proj = nn.Linear(hidden_size, hidden_size,
                                       weight_attr=init)
 
+    def _qkv_mp(self, x):
+        from ..ops import einsum
+        qkv = einsum("bse,ethd->btshd", x, self.qkv_weight) + self.qkv_bias
+        return qkv[:, 0], qkv[:, 1], qkv[:, 2]
+
     def forward(self, x, cache=None):
         b, s, _ = x.shape
-        qkv = self.qkv_proj(x)
-        qkv = reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.use_mp:
+            q, k, v = self._qkv_mp(x)
+        else:
+            qkv = self.qkv_proj(x)
+            qkv = reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if cache is not None:
             k = concat([cache[0], k], axis=1)
             v = concat([cache[1], v], axis=1)
@@ -102,8 +125,15 @@ class GPTAttention(nn.Layer):
         out = F.scaled_dot_product_attention(
             q, k, v, is_causal=True, dropout_p=self.dropout,
             training=self.training)
-        out = reshape(out, [b, s, self.num_heads * self.head_dim])
-        out = self.out_proj(out)
+        if self.use_mp:
+            from ..ops import einsum
+            # contraction over (H, hd): XLA turns the 'mp'-sharded H
+            # contraction into a psum — the row-parallel allreduce
+            out = einsum("bshd,hde->bse", out, self.out_weight) + \
+                self.out_bias
+        else:
+            out = reshape(out, [b, s, self.num_heads * self.head_dim])
+            out = self.out_proj(out)
         if cache is not None:
             return out, cache
         return out

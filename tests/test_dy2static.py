@@ -1,0 +1,256 @@
+"""dygraph→static AST control-flow conversion.
+
+Reference parity: unittests/dygraph_to_static/ — run the same nn.Layer
+eagerly and via @to_static, asserting numerical equality (the reference's
+72-file equivalence suite pattern), now including tensor-dependent
+if/while that the round-1 trace-only to_static rejected.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.dy2static import (convert_function, convert_ifelse,
+                                      convert_while_loop,
+                                      UnsupportedControlFlow)
+
+
+class BranchNet(nn.Layer):
+    """Tensor-dependent if/else (reference: test_ifelse.py patterns)."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if paddle.mean(h) > 0:
+            y = h * 2.0
+        else:
+            y = h - 1.0
+        return paddle.sum(y)
+
+
+class LoopNet(nn.Layer):
+    """Tensor-dependent while (reference: test_loop.py patterns)."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(3, 3)
+
+    def forward(self, x):
+        h = self.fc(x)
+        i = paddle.to_tensor(np.zeros((), np.float32))
+        s = paddle.zeros([3], "float32")
+        while i < 4.0:
+            s = s + paddle.mean(h, axis=0) * (i + 1.0)
+            i = i + 1.0
+        return paddle.sum(s)
+
+
+class ReturnBranchNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 2)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if paddle.sum(h) > 0:
+            return h * 3.0
+        else:
+            return h - 5.0
+
+
+def _eager_vs_static(net_cls, x):
+    paddle.seed(42)
+    net = net_cls()
+    eager = net.forward(paddle.to_tensor(x))
+    static_net = to_static(net)
+    static = static_net(paddle.to_tensor(x))
+    e = np.asarray(eager.numpy())
+    s = np.asarray(static.numpy())
+    np.testing.assert_allclose(e, s, rtol=1e-5, atol=1e-6)
+    return net, static_net
+
+
+class TestDy2StaticEquivalence:
+    def test_ifelse_true_branch(self):
+        x = np.full((2, 4), 0.5, np.float32)
+        _eager_vs_static(BranchNet, x)
+
+    def test_ifelse_false_branch(self):
+        x = np.full((2, 4), -0.5, np.float32)
+        _eager_vs_static(BranchNet, x)
+
+    def test_branches_actually_differ(self):
+        paddle.seed(1)
+        net = BranchNet()
+        st = to_static(net)
+        a = float(st(paddle.to_tensor(
+            np.full((2, 4), 2.0, np.float32))).numpy())
+        b = float(st(paddle.to_tensor(
+            np.full((2, 4), -2.0, np.float32))).numpy())
+        # same compiled program, both branch results reachable
+        assert not np.isclose(a, b)
+
+    def test_while_loop(self):
+        x = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+        _eager_vs_static(LoopNet, x)
+
+    def test_return_in_both_branches(self):
+        x = np.full((2, 4), 1.0, np.float32)
+        _eager_vs_static(ReturnBranchNet, x)
+        x = np.full((2, 4), -1.0, np.float32)
+        _eager_vs_static(ReturnBranchNet, x)
+
+    def test_plain_function_conversion(self):
+        @to_static
+        def f(x):
+            if paddle.sum(x) > 0:
+                y = x * 10.0
+            else:
+                y = x / 10.0
+            return paddle.mean(y)
+
+        pos = f(paddle.to_tensor(np.ones((3,), np.float32)))
+        neg = f(paddle.to_tensor(-np.ones((3,), np.float32)))
+        np.testing.assert_allclose(float(pos.numpy()), 10.0, rtol=1e-5)
+        np.testing.assert_allclose(float(neg.numpy()), -0.1, rtol=1e-5)
+
+    def test_python_bool_control_flow_still_python(self):
+        """Non-tensor predicates keep exact Python semantics."""
+
+        def g(x, flag):
+            if flag:
+                y = x + 1
+            else:
+                y = x - 1
+            return y
+
+        conv = convert_function(g)
+        assert conv is not None
+        assert conv(5, True) == 6
+        assert conv(5, False) == 4
+
+    def test_bool_ops_on_tensors(self):
+        def h(a, b):
+            return convert_ifelse(
+                paddle.to_tensor(True), lambda: a, lambda: b)
+
+        def f(x):
+            if (paddle.sum(x) > 0) and (paddle.max(x) < 10):
+                y = x * 2.0
+            else:
+                y = x * 0.5
+            return y
+
+        conv = convert_function(f)
+        assert conv is not None
+        out = conv(paddle.to_tensor(np.ones((2,), np.float32)))
+        np.testing.assert_allclose(out.numpy(), [2.0, 2.0], rtol=1e-6)
+
+    def test_nothing_to_convert_returns_none(self):
+        def f(x):
+            return x + 1
+
+        assert convert_function(f) is None
+
+    def test_grad_flows_through_converted_branch(self):
+        paddle.seed(3)
+        net = BranchNet()
+        st = to_static(net)
+        x = paddle.to_tensor(np.full((2, 4), 1.5, np.float32))
+        loss = st(x)
+        loss.backward()
+        grads = [p.grad for p in net.parameters()]
+        assert any(g is not None and np.abs(g.numpy()).sum() > 0
+                   for g in grads)
+
+    def test_undefined_in_one_branch_raises_helpfully(self):
+        def f(x):
+            if paddle.sum(x) > 0:
+                z = x * 2.0
+            else:
+                w = x * 3.0  # noqa: F841 — different name on purpose
+            return x
+
+        conv = convert_function(f)
+        assert conv is not None
+        import jax
+
+        with pytest.raises(UnsupportedControlFlow, match="only one branch"):
+            jax.jit(lambda a: conv(
+                paddle.to_tensor(a))._data)(np.ones((2,), np.float32))
+
+    def test_while_uninitialized_var_raises_helpfully(self):
+        def cond(i):
+            return i < 3
+
+        def body(i):
+            return (i + 1,)
+
+        from paddle_tpu.jit.dy2static import _Undefined
+        import jax
+
+        with pytest.raises(UnsupportedControlFlow, match="initialize"):
+            jax.jit(lambda a: convert_while_loop(
+                lambda u: paddle.to_tensor(a).sum() > 0,
+                lambda u: (u,), (_Undefined("tmp"),), ("tmp",)))(
+                np.ones((2,), np.float32))
+
+
+class TestReviewRegressions:
+    def test_nested_return_keeps_python_semantics(self):
+        """A return nested under for/with inside an if must NOT be moved
+        into a closure (it would exit the closure, not the function)."""
+
+        def f(x, flag):
+            if flag:
+                for i in range(2):
+                    return x + i
+            return x - 1
+
+        conv = convert_function(f)
+        # either unconverted (None) or converted with identical semantics
+        g = conv or f
+        assert g(10, True) == 10
+        assert g(10, False) == 9
+
+    def test_conditionally_bound_name_no_unbound_error(self):
+        def f(x, items):
+            if x > 0:
+                total = 0
+                for i in items:
+                    total += i
+                y = total
+            else:
+                y = -1
+            return y
+
+        conv = convert_function(f)
+        assert conv is not None
+        assert conv(1, []) == 0       # empty loop: i never binds
+        assert conv(1, [5, 6]) == 11
+        assert conv(-1, [5]) == -1
+
+    def test_grad_flows_through_tensor_if_in_train_step(self):
+        """convert_ifelse merges via the dispatched where op, so jax.grad
+        through the compiled step sees the select (non-zero grads)."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.jit.dy2static import convert_ifelse
+        from paddle_tpu.core.tensor import Tensor
+
+        def loss_fn(w):
+            wt = Tensor(w, stop_gradient=True)
+            pred = paddle.sum(wt) > 0
+            out = convert_ifelse(pred, lambda: (wt * 2.0,),
+                                 lambda: (wt * 3.0,))[0]
+            return jnp.sum(out._data ** 2)
+
+        w = np.full((3,), 2.0, np.float32)
+        g = jax.grad(loss_fn)(w)
+        np.testing.assert_allclose(np.asarray(g), 8.0 * w, rtol=1e-5)
+        g2 = jax.grad(loss_fn)(-w)
+        np.testing.assert_allclose(np.asarray(g2), 18.0 * -w, rtol=1e-5)

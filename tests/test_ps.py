@@ -85,3 +85,71 @@ def test_table_is_sharded_over_mesh(mesh):
     t = SparseTable("emb5", rows=16, dim=4, mesh=mesh)
     sh = t.weight.sharding
     assert sh.spec[0] == "sharding"  # row-sharded placement
+
+
+def test_push_matches_numpy_adam_with_dups(mesh):
+    """Dedup + segment-sum path vs a straight numpy reference."""
+    paddle.seed(4)
+    t = SparseTable("emb6", rows=12, dim=3, optimizer="adam", lr=0.05,
+                    mesh=mesh)
+    w = np.asarray(t.weight).copy()
+    m = np.zeros_like(w); v = np.zeros_like(w)
+    rs = np.random.RandomState(0)
+    for step in range(1, 4):
+        ids = rs.randint(0, 12, (6,)).astype(np.int32)
+        g = rs.randn(6, 3).astype(np.float32)
+        t.push(ids, g)
+        merged = np.zeros_like(w)
+        np.add.at(merged, ids, g)
+        touched = np.zeros(12, bool); touched[ids] = True
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        m[touched] = b1 * m[touched] + (1 - b1) * merged[touched]
+        v[touched] = b2 * v[touched] + (1 - b2) * merged[touched] ** 2
+        mhat = m[touched] / (1 - b1 ** step)
+        vhat = v[touched] / (1 - b2 ** step)
+        w[touched] -= 0.05 * mhat / (np.sqrt(vhat) + eps)
+        np.testing.assert_allclose(np.asarray(t.weight), w, rtol=2e-4,
+                                   atol=1e-5)
+
+
+def test_sharded_save_load_multiple_files(tmp_path, mesh):
+    paddle.seed(5)
+    t = SparseTable("emb7", rows=20, dim=4, optimizer="adam", lr=0.1,
+                    mesh=mesh)
+    t.push(np.arange(10, dtype=np.int32), np.ones((10, 4), np.float32))
+    ref_w = np.asarray(t.weight).copy()
+    ref_m = np.asarray(t.state["m"]).copy()
+    t.save(str(tmp_path), num_shards=4)
+    import os
+    files = sorted(os.listdir(tmp_path))
+    assert sum(f.startswith("emb7.shard") for f in files) == 4
+    t2 = SparseTable("emb7", rows=20, dim=4, optimizer="adam", lr=0.1,
+                     mesh=mesh)
+    t2.load(str(tmp_path))
+    np.testing.assert_allclose(np.asarray(t2.weight), ref_w, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(t2.state["m"]), ref_m, rtol=1e-6)
+    assert int(t2.state["t"]) == 1
+
+
+def test_push_cost_independent_of_table_size(mesh):
+    """VERDICT #6 'done' criterion: push cost O(batch), not O(table).
+    Compare wall time of a warmed push on a 200k-row vs 2k-row table —
+    the round-1 dense-materialization implementation was ~100x apart."""
+    import time
+    paddle.seed(6)
+    small = SparseTable("s", rows=2_000, dim=32, optimizer="adam", mesh=mesh)
+    big = SparseTable("b", rows=200_000, dim=32, optimizer="adam", mesh=mesh)
+    ids = np.random.RandomState(1).randint(0, 2_000, (128,)).astype(np.int32)
+    g = np.ones((128, 32), np.float32)
+
+    def timed(t):
+        t.push(ids, g)  # warm/compile
+        np.asarray(t.weight[0])
+        t0 = time.perf_counter()
+        for _ in range(20):
+            t.push(ids, g)
+        np.asarray(t.weight[0])
+        return time.perf_counter() - t0
+
+    ts, tb = timed(small), timed(big)
+    assert tb < ts * 10, (ts, tb)
