@@ -1,0 +1,43 @@
+// Package paddle: Go inference binding over the paddle_tpu C API.
+//
+// Reference parity: go/paddle/config.go (cgo wrapper over the reference
+// C API).  This wrapper targets paddle_tpu/csrc/paddle_capi.h — build
+// libpaddle_capi.so first (`make capi` in paddle_tpu/csrc), then:
+//
+//	CGO_CFLAGS="-I${REPO}/paddle_tpu/csrc" \
+//	CGO_LDFLAGS="-L${REPO}/paddle_tpu/csrc -lpaddle_capi" \
+//	go build ./go/paddle
+package paddle
+
+// #cgo CFLAGS: -I../../paddle_tpu/csrc
+// #cgo LDFLAGS: -L../../paddle_tpu/csrc -lpaddle_capi
+// #include <stdlib.h>
+// #include "paddle_capi.h"
+import "C"
+import "unsafe"
+
+// Config mirrors the reference AnalysisConfig surface.
+type Config struct {
+	c *C.PD_Config
+}
+
+func NewConfig() *Config {
+	return &Config{c: C.PD_NewConfig()}
+}
+
+// SetModel points the predictor at a jit.save / save_inference_model
+// artifact pair (model path without suffix, params path or "").
+func (cfg *Config) SetModel(model, params string) {
+	cm := C.CString(model)
+	cp := C.CString(params)
+	defer C.free(unsafe.Pointer(cm))
+	defer C.free(unsafe.Pointer(cp))
+	C.PD_ConfigSetModel(cfg.c, cm, cp)
+}
+
+func (cfg *Config) Delete() {
+	if cfg.c != nil {
+		C.PD_DeleteConfig(cfg.c)
+		cfg.c = nil
+	}
+}

@@ -1,0 +1,116 @@
+// Reference parity: go/paddle/predictor.go.
+package paddle
+
+// #include <stdlib.h>
+// #include "paddle_capi.h"
+import "C"
+import (
+	"errors"
+	"unsafe"
+)
+
+type Predictor struct {
+	p *C.PD_Predictor
+}
+
+func lastError() error {
+	return errors.New(C.GoString(C.PD_LastError()))
+}
+
+func NewPredictor(cfg *Config) (*Predictor, error) {
+	p := C.PD_NewPredictor(cfg.c)
+	if p == nil {
+		return nil, lastError()
+	}
+	return &Predictor{p: p}, nil
+}
+
+func (pr *Predictor) Delete() {
+	if pr.p != nil {
+		C.PD_DeletePredictor(pr.p)
+		pr.p = nil
+	}
+}
+
+func (pr *Predictor) GetInputNum() int {
+	return int(C.PD_GetInputNum(pr.p))
+}
+
+func (pr *Predictor) GetOutputNum() int {
+	return int(C.PD_GetOutputNum(pr.p))
+}
+
+func (pr *Predictor) GetInputName(i int) string {
+	return C.GoString(C.PD_GetInputName(pr.p, C.int(i)))
+}
+
+func (pr *Predictor) GetOutputName(i int) string {
+	return C.GoString(C.PD_GetOutputName(pr.p, C.int(i)))
+}
+
+// SetInput feeds a float32 tensor (the common case; SetInputTyped covers
+// the full PD_DataType range).
+func (pr *Predictor) SetInput(name string, data []float32,
+	shape []int64) error {
+	return pr.setInput(name, unsafe.Pointer(&data[0]), shape,
+		C.PD_FLOAT32)
+}
+
+func (pr *Predictor) SetInputInt64(name string, data []int64,
+	shape []int64) error {
+	return pr.setInput(name, unsafe.Pointer(&data[0]), shape, C.PD_INT64)
+}
+
+func (pr *Predictor) SetInputInt32(name string, data []int32,
+	shape []int64) error {
+	return pr.setInput(name, unsafe.Pointer(&data[0]), shape, C.PD_INT32)
+}
+
+func (pr *Predictor) setInput(name string, ptr unsafe.Pointer,
+	shape []int64, dtype C.PD_DataType) error {
+	cn := C.CString(name)
+	defer C.free(unsafe.Pointer(cn))
+	rc := C.PD_SetInput(pr.p, cn, ptr,
+		(*C.int64_t)(unsafe.Pointer(&shape[0])), C.int(len(shape)),
+		dtype)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+func (pr *Predictor) Run() error {
+	if C.PD_Run(pr.p) != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// GetOutputFloat32 copies one named output into a Go slice + shape.
+func (pr *Predictor) GetOutputFloat32(name string) ([]float32, []int64,
+	error) {
+	cn := C.CString(name)
+	defer C.free(unsafe.Pointer(cn))
+	var data unsafe.Pointer
+	var shapePtr *C.int64_t
+	var ndim C.int
+	var dtype C.PD_DataType
+	rc := C.PD_GetOutput(pr.p, cn, &data, &shapePtr, &ndim, &dtype)
+	if rc != 0 {
+		return nil, nil, lastError()
+	}
+	if dtype != C.PD_FLOAT32 {
+		return nil, nil, errors.New("output is not float32")
+	}
+	n := int(ndim)
+	shape := make([]int64, n)
+	total := int64(1)
+	sp := unsafe.Slice((*int64)(unsafe.Pointer(shapePtr)), n)
+	for i := 0; i < n; i++ {
+		shape[i] = sp[i]
+		total *= sp[i]
+	}
+	vals := make([]float32, total)
+	copy(vals, unsafe.Slice((*float32)(data), total))
+	return vals, shape, nil
+}

@@ -113,14 +113,18 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        self._found_inf = False
         inv = 1.0 / self._scale
+        # one fused finite-check across ALL grads, one device->host sync
+        # (reference: check_finite_and_unscale_op batches the whole grad
+        # list; the per-parameter bool() loop synced once per param)
+        flags = []
         for p in optimizer._params():
             if p.grad is not None:
                 g = p.grad._data * inv
-                if bool(jnp.any(~jnp.isfinite(g))):
-                    self._found_inf = True
+                flags.append(jnp.any(~jnp.isfinite(g)))
                 p.grad._data = g
+        self._found_inf = bool(
+            jnp.any(jnp.stack(flags))) if flags else False
 
     def step(self, optimizer):
         if not self._enable:

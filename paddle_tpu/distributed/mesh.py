@@ -138,3 +138,26 @@ def host_local_to_global(array, mesh=None, *spec):
         pspec = PartitionSpec(*spec)
     return multihost_utils.host_local_array_to_global_array(
         arr, mesh, pspec)
+
+
+def global_from_replicated(array, mesh=None, *spec):
+    """Build a mesh-sharded global array from a batch every process holds
+    IN FULL.  This is the multi-host feeding contract when the data axes
+    do not split process-contiguously — e.g. pipeline parallelism whose
+    'pp' ring spans hosts, where a single dp row-block lives on several
+    processes (Megatron semantics: ranks in one dp group read identical
+    data).  Works for any device permutation because each process cuts
+    its addressable shards out of the full copy."""
+    from ..core.tensor import Tensor
+    arr = array._data if isinstance(array, Tensor) else array
+    arr = np.asarray(arr)
+    mesh = mesh or ensure_mesh()
+    if spec:
+        pspec = PartitionSpec(*spec)
+    else:
+        pspec = batch_partition_spec(arr.shape, mesh)
+    sharding = jax.sharding.NamedSharding(mesh, pspec)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])

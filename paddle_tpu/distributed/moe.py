@@ -72,6 +72,41 @@ def top_k_gating(logits, k, capacity, dtype=jnp.float32):
     return dispatch, combine, aux
 
 
+def top_k_routing(logits, k, capacity):
+    """Sort-based top-k routing: O(T·k) state instead of the [T, E, C]
+    one-hot dispatch tensors (top_k_gating) — scales to real T·E.
+
+    Returns (choice [T, k] expert ids, pos [T, k] slot within expert,
+    keep [T, k] bool, gates [T, k] router probs, aux scalar).  Capacity
+    priority matches top_k_gating: round r of every token claims slots
+    before round r+1 (round-major ordering within each expert's queue).
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top1 = jnp.argmax(probs, axis=-1)
+    frac_tokens = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32),
+                           axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+
+    gates, choice = jax.lax.top_k(probs, k)              # [T, k]
+    # round-major flatten => stable sort groups by expert, then round,
+    # then token — exactly the dense path's slot-claim order
+    flat_choice = choice.T.reshape(-1)                   # [k*T]
+    order = jnp.argsort(flat_choice, stable=True)
+    sorted_e = flat_choice[order]
+    idx = jnp.arange(t * k)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_e[1:] != sorted_e[:-1]])
+    group_start = jax.lax.cummax(jnp.where(is_start, idx, 0))
+    pos_sorted = idx - group_start
+    pos_flat = jnp.zeros((t * k,), jnp.int32).at[order].set(
+        pos_sorted.astype(jnp.int32))
+    pos = pos_flat.reshape(k, t).T                       # [T, k]
+    keep = pos < capacity
+    return choice, pos, keep, gates, aux
+
+
 class ExpertFFN(Layer):
     """E stacked feed-forward experts; weights sharded over 'ep'."""
 
@@ -130,11 +165,20 @@ class MoELayer(Layer):
             capacity = max(1, int(cap_f * t * k / e))
             tokens = xa.reshape(t, d)
             logits = tokens @ gate_w.astype(xa.dtype)
-            dispatch, combine, aux = top_k_gating(
-                logits, k, capacity, dtype=xa.dtype)
-            # [E, C, D]: expert-major buffer — sharded over 'ep' so the
-            # einsum lowers to an all-to-all token shuffle
-            xs = jnp.einsum("tec,td->ecd", dispatch, tokens)
+            choice, pos, keep, gates, aux = top_k_routing(
+                logits, k, capacity)
+            # scatter tokens into the [E, C, D] expert-major buffer
+            # (mode='drop' discards over-capacity slots) — O(T·k·D) work,
+            # no [T, E, C] one-hot materialization
+            slot = choice * capacity + pos                    # [T, k]
+            slot_f = jnp.where(keep, slot, e * capacity).reshape(-1)
+            tok_f = jnp.broadcast_to(jnp.arange(t)[:, None],
+                                     (t, k)).reshape(-1)
+            xs = jnp.zeros((e * capacity, d), xa.dtype).at[slot_f].add(
+                tokens[tok_f], mode="drop")
+            xs = xs.reshape(e, capacity, d)
+            # sharded over 'ep': XLA materializes the token shuffle as an
+            # all-to-all over ICI
             xs = _constraint(xs, "ep", None, None)
             h = jax.nn.gelu(
                 jnp.einsum("ecd,edh->ech", xs, w1.astype(xa.dtype))
@@ -142,7 +186,12 @@ class MoELayer(Layer):
             ys = (jnp.einsum("ech,ehd->ecd", h, w2.astype(xa.dtype))
                   + b2[:, None, :].astype(xa.dtype))
             ys = _constraint(ys, "ep", None, None)
-            out = jnp.einsum("tec,ecd->td", combine, ys)
+            # combine: gather each (token, round)'s slot, weight by gate
+            got = ys.reshape(e * capacity, d)[
+                jnp.clip(slot_f, 0, e * capacity - 1)]
+            wts = (gates.astype(xa.dtype).reshape(-1) *
+                   keep.reshape(-1).astype(xa.dtype))
+            out = (got * wts[:, None]).reshape(t, k, d).sum(axis=1)
             # aux loss folded into output via straight-through trick is
             # wrong; expose it as a side output instead
             return out.reshape(b, s, d), aux.astype(xa.dtype)

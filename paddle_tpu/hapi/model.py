@@ -20,6 +20,17 @@ from ..parallel.train_step import TrainStep
 from . import callbacks as cbks_mod
 
 
+def _metric_to_host(x):
+    """Metric inputs from a multi-host mesh are globally sharded — no
+    single process can np.asarray them; allgather the global value."""
+    import jax
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x,
+                                                            tiled=True))
+    return np.asarray(x)
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -60,7 +71,7 @@ class Model:
         if optimizer is not None:
             self._train_step = TrainStep(
                 self.network, optimizer, loss_fn=loss, strategy=strategy,
-                amp_level=amp_level)
+                amp_level=amp_level, metrics=self._metrics)
         return self
 
     # ------------------------------------------------------------------
@@ -73,12 +84,18 @@ class Model:
         return [batch], []
 
     def train_batch(self, inputs, labels=None, update=True):
-        """One compiled train step on a batch (reference: model.py:896)."""
+        """One compiled train step on a batch (reference: model.py:896).
+        Metrics are computed INSIDE the compiled step (model.py:1495
+        threads prepared metrics through train) and accumulated here."""
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if labels is not None else []
         labels = labels if isinstance(labels, (list, tuple)) else [labels]
         loss = self._train_step.step(list(inputs), list(labels))
         metrics_out = []
+        for m, mo in zip(self._metrics,
+                         self._train_step.last_metric_outs):
+            m.update(*[_metric_to_host(x) for x in mo])
+            metrics_out.append(m.accumulate())
         return [float(loss.numpy())] + metrics_out
 
     def eval_batch(self, inputs, labels=None):
@@ -151,6 +168,8 @@ class Model:
                     loader.batch_sampler, "set_epoch"):
                 loader.batch_sampler.set_epoch(epoch)
             cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
             last_logs = {}
             for step, batch in enumerate(feed):
                 cbks.on_train_batch_begin(step)
@@ -158,6 +177,15 @@ class Model:
                 loss = self._train_step.step(ins, labs)
                 last_logs = {"loss": float(loss.numpy()),
                              "lr": self._optimizer.get_lr()}
+                for m, mo in zip(self._metrics,
+                                 self._train_step.last_metric_outs):
+                    m.update(*[_metric_to_host(x) for x in mo])
+                    names, vals = m.name(), m.accumulate()
+                    if not isinstance(names, (list, tuple)):
+                        names, vals = [names], [vals]
+                    if not isinstance(vals, (list, tuple)):
+                        vals = [vals]
+                    last_logs.update(dict(zip(names, vals)))
                 cbks.on_train_batch_end(step, last_logs)
                 it += 1
                 if num_iters is not None and it >= num_iters:
@@ -257,6 +285,46 @@ class Model:
         text = "\n".join(lines)
         print(text)
         return {"total_params": total}
+
+    def flops(self, inputs=None, input_size=None, dtype="float32",
+              print_detail=False):
+        """FLOPs of one eval-mode forward, from XLA's own cost analysis
+        of the compiled program (reference: hapi paddle.flops sums
+        per-layer hook estimates; the compiler's count is exact for the
+        fused program that actually runs)."""
+        import jax
+        import jax.numpy as jnp
+        from ..jit import functional_call
+
+        if inputs is None:
+            if input_size is None:
+                raise ValueError("flops: pass example inputs or "
+                                 "input_size")
+            shapes = input_size if isinstance(input_size[0],
+                                              (list, tuple)) \
+                else [input_size]
+            inputs = [jnp.zeros(tuple(s), jnp.dtype(dtype))
+                      for s in shapes]
+        else:
+            inputs = inputs if isinstance(inputs, (list, tuple)) \
+                else [inputs]
+            inputs = [i._data if isinstance(i, Tensor)
+                      else jnp.asarray(i) for i in inputs]
+        net = self.network
+        params = {k: p._data for k, p in net.named_parameters()}
+        buffers = {k: b._data for k, b in net.named_buffers()
+                   if b is not None}
+
+        def fwd(p, b, xs):
+            out, _ = functional_call(net, p, b, xs, training=False)
+            return out
+
+        lowered = jax.jit(fwd).lower(params, buffers, list(inputs))
+        analysis = lowered.compile().cost_analysis() or {}
+        total = int(analysis.get("flops", 0))
+        if print_detail:
+            print(f"FLOPs (XLA cost analysis, eval forward): {total:,}")
+        return total
 
 
 def to_list(value):

@@ -502,3 +502,8 @@ class DataFeeder:
         for name, col in zip(self.feed_names, columns):
             out[name] = np.stack([np.asarray(s) for s in col])
         return out
+
+from .bucketing import (  # noqa: E402
+    BucketedBatchSampler, bucketed_collate, pad_to_bucket, bucket_for,
+    DEFAULT_BUCKETS,
+)

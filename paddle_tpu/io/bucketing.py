@@ -1,0 +1,147 @@
+"""Variable-length batching: length buckets + padding for static shapes.
+
+Reference parity: the reference absorbs ragged data with LoDTensor +
+``sequence_ops`` kernels (SURVEY.md §2.3) — shape-dynamic by design.  XLA
+compiles one program per shape, so unconstrained dynamic lengths cause a
+recompilation storm (SURVEY.md §7 hard-part 5).
+
+TPU-native design: quantize lengths to a SMALL FIXED SET of buckets.
+Every batch is padded up to its bucket's length, so the whole run
+compiles at most ``len(buckets)`` step variants; masks/lengths carry the
+real extents (the framework's dense+lengths convention from
+nn/functional/sequence.py).
+
+- ``bucket_for(length, buckets)``        — smallest bucket >= length
+- ``pad_to_bucket(arrays, buckets)``     — pad a list of [Li, ...] to one
+  [B, Lb, ...] + lengths
+- ``BucketedBatchSampler``               — groups same-bucket samples so a
+  batch never mixes buckets (minimises padding waste)
+- ``bucketed_collate(buckets)``          — DataLoader collate_fn factory
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from . import BatchSampler, RandomSampler, SequenceSampler
+
+
+DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+def bucket_for(length, buckets=DEFAULT_BUCKETS):
+    """Smallest bucket >= length (the compile-variant this length runs
+    in).  Lengths beyond the largest bucket raise — silently growing the
+    shape would trigger the recompile storm bucketing exists to prevent."""
+    for b in buckets:
+        if length <= b:
+            return int(b)
+    raise ValueError(
+        f"sequence length {length} exceeds the largest bucket "
+        f"{buckets[-1]}; extend `buckets` (each new bucket costs one "
+        "compile) or truncate upstream")
+
+
+def pad_to_bucket(arrays, buckets=DEFAULT_BUCKETS, axis=0, pad_value=0,
+                  dtype=None):
+    """Pad a list of per-sample arrays (ragged along ``axis``) into one
+    stacked batch at the COMMON bucket of the longest sample.
+
+    Returns (batch [N, ..., Lb, ...], lengths [N] int64).
+    """
+    arrays = [np.asarray(a) for a in arrays]
+    lengths = np.asarray([a.shape[axis] for a in arrays], np.int64)
+    lb = bucket_for(int(lengths.max()), buckets)
+    out = []
+    for a in arrays:
+        pad = [(0, 0)] * a.ndim
+        pad[axis] = (0, lb - a.shape[axis])
+        out.append(np.pad(a, pad, constant_values=pad_value))
+    batch = np.stack(out)
+    if dtype is not None:
+        batch = batch.astype(dtype)
+    return batch, lengths
+
+
+class BucketedBatchSampler(BatchSampler):
+    """Batch sampler that never mixes buckets inside a batch.
+
+    ``length_fn(i)`` maps a dataset index to its sequence length (default:
+    ``len(dataset[i][0])``).  Batches are formed within each bucket, so a
+    training run compiles at most ``len(buckets)`` step variants instead
+    of one per distinct length (reference: LoD tensors made this a
+    non-issue on CPU/GPU; on TPU the bucket set IS the contract)."""
+
+    def __init__(self, dataset, batch_size=1, buckets=DEFAULT_BUCKETS,
+                 length_fn=None, shuffle=False, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.buckets = tuple(buckets)
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        if length_fn is None:
+            def length_fn(i):
+                sample = dataset[i]
+                first = sample[0] if isinstance(sample, (tuple, list)) \
+                    else sample
+                return len(first)
+        self.length_fn = length_fn
+        self.sampler = (RandomSampler(dataset) if shuffle
+                        else SequenceSampler(dataset))
+        self._len_cache = None
+
+    def __iter__(self):
+        pools = {b: [] for b in self.buckets}
+        for idx in self.sampler:
+            b = bucket_for(self.length_fn(idx), self.buckets)
+            pools[b].append(idx)
+            if len(pools[b]) == self.batch_size:
+                yield pools[b]
+                pools[b] = []
+        if not self.drop_last:
+            for b in self.buckets:
+                if pools[b]:
+                    yield pools[b]
+
+    def __len__(self):
+        # computed once: the default length_fn materializes samples, and
+        # fit/callbacks call len(loader) every epoch
+        if self._len_cache is None:
+            counts = {b: 0 for b in self.buckets}
+            for i in range(len(self.dataset)):
+                counts[bucket_for(self.length_fn(i), self.buckets)] += 1
+            total = 0
+            for c in counts.values():
+                total += (c // self.batch_size if self.drop_last
+                          else math.ceil(c / self.batch_size))
+            self._len_cache = total
+        return self._len_cache
+
+
+def bucketed_collate(buckets=DEFAULT_BUCKETS, pad_value=0,
+                     ragged_fields=(0,), axis=0):
+    """collate_fn factory: pads the ragged fields of each sample tuple to
+    the batch's bucket and appends a lengths array per ragged field.
+
+    Sample = tuple of arrays; fields in ``ragged_fields`` are ragged
+    along ``axis``.  Batch = (*padded_or_stacked_fields, *lengths)."""
+
+    def collate(samples):
+        n_fields = len(samples[0]) if isinstance(samples[0],
+                                                 (tuple, list)) else 1
+        if n_fields == 1 and not isinstance(samples[0], (tuple, list)):
+            samples = [(s,) for s in samples]
+        out, lens = [], []
+        for f in range(n_fields):
+            col = [np.asarray(s[f]) for s in samples]
+            if f in ragged_fields:
+                batch, lengths = pad_to_bucket(col, buckets, axis=axis,
+                                               pad_value=pad_value)
+                out.append(batch)
+                lens.append(lengths)
+            else:
+                out.append(np.stack(col))
+        return tuple(out) + tuple(lens)
+
+    return collate

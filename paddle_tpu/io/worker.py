@@ -206,9 +206,9 @@ def _iterable_worker_loop(dataset, result_q, collate_fn, use_shared_memory,
         if batch and not drop_last:
             _emit_iterable(result_q, collate_fn(batch), use_shared_memory)
     except Exception as e:
-        result_q.put((0, None, _ExceptionWrapper(e), False))
+        result_q.put((worker_id, None, _ExceptionWrapper(e), False))
     finally:
-        result_q.put((0, None, None, False))  # done marker
+        result_q.put((worker_id, None, None, False))  # done marker
 
 
 def _emit_iterable(result_q, data, use_shared_memory):
@@ -296,12 +296,20 @@ class WorkerPool:
             pass
 
 
+_pool_seq = itertools.count()
+
+
 def _base_seed():
+    """Distinct per pool instance: a fresh (non-persistent) pool per
+    epoch must NOT replay the previous epoch's augmentation randomness
+    (the classic identical-worker-seed bug); deterministic under
+    paddle.seed because the counter ticks deterministically."""
     from ..core import rng as rng_mod
     try:
-        return int(rng_mod.get_seed())
+        base = int(rng_mod.get_seed())
     except Exception:
-        return 0
+        base = 0
+    return base + 7919 * next(_pool_seq)
 
 
 class MultiprocessMapIter:
@@ -364,7 +372,12 @@ class MultiprocessMapIter:
                         f"waiting for batch {self.next_emit}")
                 continue
             if isinstance(payload, _ExceptionWrapper):
-                payload.reraise()
+                # gen=None: worker init failure (always fatal); otherwise
+                # only this generation's exceptions propagate — a stale
+                # failure from an abandoned epoch must not kill this one
+                if gen is None or gen == self.gen:
+                    payload.reraise()
+                continue
             if gen != self.gen:  # stale result from an abandoned epoch
                 if is_shm:
                     _ShmBatch.unlink_unseen(payload[1])
@@ -398,7 +411,7 @@ class MultiprocessIterableIter:
         self.result_q = self.ctx.Queue(
             maxsize=max(2, loader.prefetch_factor * loader.num_workers))
         self.procs = []
-        self.done = 0
+        self.done_ids = set()
         self.timeout = loader.timeout or None
         for wid in range(loader.num_workers):
             p = self.ctx.Process(
@@ -416,19 +429,35 @@ class MultiprocessIterableIter:
         return self
 
     def __next__(self):
+        waited = 0.0
         while True:
-            if self.done >= len(self.procs):
+            if len(self.done_ids) >= len(self.procs):
                 self._shutdown()
                 raise StopIteration
+            slice_t = min(self.timeout, 5.0) if self.timeout else 5.0
             try:
-                _, _, payload, is_shm = self.result_q.get(
-                    timeout=self.timeout)
+                wid, _, payload, is_shm = self.result_q.get(
+                    timeout=slice_t)
             except queue_mod.Empty:
-                self._shutdown()
-                raise RuntimeError(
-                    "DataLoader (iterable) timed out waiting for workers")
+                waited += slice_t
+                # a SIGKILLed worker never sends its done marker: only
+                # workers that are dead AND never finished count as lost
+                # (a normally-exited worker is both dead and done)
+                lost = [w for w, p in enumerate(self.procs)
+                        if not p.is_alive() and w not in self.done_ids]
+                if lost and self.result_q.empty():
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader (iterable) worker(s) {lost} died "
+                        "before finishing their stream")
+                if self.timeout and waited >= self.timeout:
+                    self._shutdown()
+                    raise RuntimeError(
+                        "DataLoader (iterable) timed out waiting for "
+                        "workers")
+                continue
             if payload is None:
-                self.done += 1
+                self.done_ids.add(wid)
                 continue
             if isinstance(payload, _ExceptionWrapper):
                 self._shutdown()

@@ -127,7 +127,14 @@ def _rewrap(out):
 
 
 def convert_while_loop(cond_fn, body_fn, loop_vars, names=()):
-    """reference: convert_operators.convert_while_loop."""
+    """reference: convert_operators.convert_while_loop.
+
+    Traced tensor-predicated loops lower to ``lax.while_loop``, which is
+    FORWARD-ONLY in reverse-mode autodiff (jax raises if a gradient path
+    crosses it).  Trainable loops need a static trip count — write
+    ``for i in range(n)`` (trace-unrolled) or use lax.scan via
+    static.nn.while_loop's scan form — matching the reference's
+    while_op, whose grad also requires recorded-iteration replay."""
     probe = cond_fn(*loop_vars)
     if _is_traced_tensor(probe) or any(
             _is_traced_tensor(v) for v in loop_vars):
@@ -275,10 +282,16 @@ class _AssignedNames(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_FunctionDef(self, node):
-        self.names.append(node.name)  # the def itself binds a name
+        if not node.name.startswith("__d2s_"):
+            self.names.append(node.name)  # the def itself binds a name
 
     def _collect(self, target):
         if isinstance(target, ast.Name):
+            # generated helper names (__d2s_*) from already-transformed
+            # nested regions are implementation detail, not user state —
+            # threading them would poison the branch-merge/loop-vars
+            if target.id.startswith("__d2s_"):
+                return
             if target.id not in self.names:
                 self.names.append(target.id)
         elif isinstance(target, (ast.Tuple, ast.List)):
@@ -311,10 +324,28 @@ def _loaded_names(nodes):
 
 
 def _has(stmts, kinds):
-    for s in stmts:
-        for node in ast.walk(s):
-            if isinstance(node, kinds):
+    """True if any node of ``kinds`` appears in ``stmts`` WITHOUT
+    crossing into a nested function scope (a return inside a nested def
+    — e.g. an already-converted inner region's closure — exits that def,
+    not the function being analyzed)."""
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, kinds):
                 return True
+            if walk(child):
+                return True
+        return False
+
+    for s in stmts:
+        if isinstance(s, kinds):
+            return True
+        if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and walk(s):
+            return True
     return False
 
 
@@ -395,6 +426,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 value=orelse[-1].value or ast.Constant(None))]
 
         assigned = _assigned_names(body + orelse)
+        if body_returns:
+            # the return-value carrier is generated (filtered by the
+            # __d2s_ guard) but must thread through the branch closures
+            assigned.append(ret_name)
         true_name, false_name = f"__d2s_true_{n}", f"__d2s_false_{n}"
         ret_tuple = ast.Tuple(
             elts=[ast.Name(id=a, ctx=ast.Load()) for a in assigned],
@@ -518,7 +553,24 @@ def convert_function(fn):
     func_def = tree.body[0]
     if not isinstance(func_def, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
+    # only the to_static-family decorators may be stripped; any OTHER
+    # decorator (autocast wrappers, caches...) would silently disappear
+    # from the recompiled function — bail to the trace path instead
+    for dec in func_def.decorator_list:
+        name = dec
+        while isinstance(name, (ast.Call, ast.Attribute)):
+            name = name.func if isinstance(name, ast.Call) else name.attr
+        dec_name = name if isinstance(name, str) else getattr(
+            name, "id", "")
+        if dec_name not in ("to_static", "declarative", "not_to_static"):
+            return None
     func_def.decorator_list = []  # run once, undecorated
+    if fn.__code__.co_freevars:
+        # closures (including the implicit __class__ cell behind
+        # zero-arg super()) cannot be faithfully rebuilt by exec — cells
+        # would freeze to decoration-time snapshots and super() would
+        # lose its cell entirely.  Fall back to the trace path.
+        return None
     transformer = _ControlFlowTransformer()
     new_tree = transformer.visit(tree)
     if transformer.counter == 0:
@@ -528,12 +580,6 @@ def convert_function(fn):
     gl = dict(fn.__globals__)
     from . import dy2static as _self
     gl[_JST] = _self
-    if fn.__closure__:
-        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
-            try:
-                gl[name] = cell.cell_contents
-            except ValueError:
-                pass
     loc = {}
     exec(code, gl, loc)
     converted = loc[func_def.name]

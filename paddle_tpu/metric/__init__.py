@@ -36,14 +36,18 @@ class Accuracy(Metric):
         self.reset()
 
     def compute(self, pred, label, *args):
-        pred = pred.numpy() if isinstance(pred, Tensor) else np.asarray(pred)
-        label = label.numpy() if isinstance(label, Tensor) else \
-            np.asarray(label)
-        order = np.argsort(-pred, axis=-1)[..., :self.maxk]
-        if label.ndim == pred.ndim:
-            label = label.squeeze(-1)
-        correct = (order == label[..., None])
-        return Tensor(correct.astype(np.float32))
+        # jnp (not numpy) so this traces inside the compiled train step
+        # (TrainStep computes prepared metrics in-graph; reference:
+        # hapi/model.py:1495)
+        import jax.numpy as jnp
+        p = pred._data if isinstance(pred, Tensor) else jnp.asarray(pred)
+        lab = label._data if isinstance(label, Tensor) else \
+            jnp.asarray(label)
+        order = jnp.argsort(-p, axis=-1)[..., :self.maxk]
+        if lab.ndim == p.ndim:
+            lab = lab.squeeze(-1)
+        correct = (order == lab[..., None])
+        return Tensor(correct.astype(jnp.float32))
 
     def update(self, correct, *args):
         arr = correct.numpy() if isinstance(correct, Tensor) else \

@@ -168,7 +168,8 @@ class GPTBlock(nn.Layer):
     """Pre-LN transformer block — the pipelined unit for GPTPipe."""
 
     def __init__(self, hidden_size, num_heads, dropout=0.1, use_mp=False,
-                 use_recompute=False, moe_experts=0):
+                 use_recompute=False, moe_experts=0,
+                 recompute_policy=None):
         super().__init__()
         self.ln1 = nn.LayerNorm(hidden_size)
         self.attn = GPTAttention(hidden_size, num_heads, dropout, use_mp)
@@ -179,6 +180,7 @@ class GPTBlock(nn.Layer):
         else:
             self.mlp = GPTMLP(hidden_size, dropout=dropout, use_mp=use_mp)
         self.use_recompute = use_recompute
+        self.recompute_policy = recompute_policy
 
     def _inner(self, x):
         x = x + self.attn(self.ln1(x))
@@ -194,7 +196,8 @@ class GPTBlock(nn.Layer):
         if self.use_recompute:
             from ..distributed.fleet.utils import recompute
             # bound method → recompute collects params from `self`
-            return recompute(self._inner, x)
+            return recompute(self._inner, x,
+                             policy=self.recompute_policy)
         return self._inner(x)
 
 
@@ -223,7 +226,7 @@ class GPTModel(nn.Layer):
     def __init__(self, num_layers=12, hidden_size=768, num_heads=12,
                  vocab_size=50304, max_position=1024, dropout=0.1,
                  use_mp=False, use_recompute=False, moe_experts=0,
-                 moe_every=2, fused_loss=False):
+                 moe_every=2, fused_loss=False, recompute_policy=None):
         super().__init__()
         self.fused_loss = fused_loss
         self.embeddings = GPTEmbeddings(vocab_size, hidden_size,
@@ -238,7 +241,8 @@ class GPTModel(nn.Layer):
                      moe_experts=(moe_experts
                                   if moe_experts
                                   and (i + 1) % moe_every == 0
-                                  else 0))
+                                  else 0),
+                     recompute_policy=recompute_policy)
             for i in range(num_layers)])
         self.head = GPTLMHead(hidden_size, vocab_size, use_mp)
 
@@ -291,7 +295,9 @@ class GPTModel(nn.Layer):
                 "silently clamp")
         nh = self.blocks[0].attn.num_heads
         hd = self.blocks[0].attn.head_dim
-        kv_dtype = self.blocks[0].attn.qkv_proj.weight._data.dtype
+        attn0 = self.blocks[0].attn
+        kv_dtype = (attn0.qkv_weight if attn0.use_mp
+                    else attn0.qkv_proj.weight)._data.dtype
         # sampling whenever temperature/top_k ask for it; greedy otherwise
         do_sample = (top_k and top_k > 0) or temperature != 1.0
         was_training = self.training

@@ -53,7 +53,8 @@ class TrainStep:
     """Compiled train step over a Layer + Optimizer (+ loss)."""
 
     def __init__(self, model, optimizer, loss_fn=None, strategy=None,
-                 mesh=None, amp_level=None, donate=True, train=True):
+                 mesh=None, amp_level=None, donate=True, train=True,
+                 metrics=None):
         from ..distributed.parallel import DataParallel
         from ..distributed.fleet.meta_parallel import PipelineLayer
         if isinstance(model, DataParallel):
@@ -65,6 +66,12 @@ class TrainStep:
         self.mesh = mesh or mesh_mod.ensure_mesh()
         self.donate = donate
         self.training = train
+        # metrics computed INSIDE the compiled step (reference:
+        # hapi/model.py:1495 threads prepared metrics through train);
+        # each step stashes the per-batch metric inputs (e.g. Accuracy's
+        # correct matrix) in self.last_metric_outs
+        self.metrics = list(metrics or [])
+        self.last_metric_outs = []
         self._compiled = {}
 
         s = strategy
@@ -146,7 +153,8 @@ class TrainStep:
         self._trainable = {k: params[k].trainable for k in self.pnames}
 
     def _init_pipeline_state(self):
-        from .pipeline import stack_block_params, build_pipeline_fn
+        from .pipeline import (stack_block_params, stack_block_buffers,
+                               build_pipeline_fn, build_pipeline_1f1b_fn)
         model = self.model
         pp = self.mesh.shape.get("pp", 1)
         nblocks = len(model.blocks)
@@ -154,12 +162,18 @@ class TrainStep:
             f"n_blocks {nblocks} must divide pp degree {pp}"
         self.bps = nblocks // pp
         self.block_pnames, stacked = stack_block_params(model.blocks)
+        self.block_bnames, stacked_bufs = stack_block_buffers(model.blocks)
         # regroup [nblocks, ...] -> [pp, bps, ...]
         self.block_params = {
             k: jax.device_put(
                 v.reshape((pp, self.bps) + v.shape[1:]),
                 NamedSharding(self.mesh, P("pp")))
             for k, v in stacked.items()}
+        self.block_buffers = {
+            k: jax.device_put(
+                v.reshape((pp, self.bps) + v.shape[1:]),
+                NamedSharding(self.mesh, P("pp")))
+            for k, v in stacked_bufs.items()}
         self.pre_params = {}
         self.post_params = {}
         if model.pre is not None:
@@ -173,14 +187,28 @@ class TrainStep:
                     self.mesh, getattr(p, "partition_spec", None) or P()))
                 for k, p in dict(model.post.named_parameters()).items()}
         M = 1
+        schedule = "F-then-B"
         if self.strategy is not None and self.strategy.pipeline:
             M = int(self.strategy.pipeline_configs.get(
                 "accumulate_steps", 1))
+            schedule = str(self.strategy.pipeline_configs.get(
+                "schedule_mode",
+                self.strategy.pipeline_configs.get("schedule",
+                                                   "F-then-B")))
         self.num_microbatches = max(M, 1)
+        self.pipe_schedule = "1F1B" if schedule.upper() == "1F1B" \
+            else "F-then-B"
         use_remat = bool(self.strategy and self.strategy.recompute)
-        self.pipe_fn, _ = build_pipeline_fn(
-            model, self.num_microbatches, mesh=self.mesh,
-            training=self.training, use_recompute=use_remat)
+        if self.pipe_schedule == "1F1B":
+            self.pipe_1f1b, _, _ = build_pipeline_1f1b_fn(
+                model, self.num_microbatches, self.loss_fn,
+                mesh=self.mesh, training=self.training)
+            self.pipe_fn = None
+        else:
+            self.pipe_fn, _, _ = build_pipeline_fn(
+                model, self.num_microbatches, mesh=self.mesh,
+                training=self.training, use_recompute=use_remat)
+            self.pipe_1f1b = None
         # one flat param tree for the optimizer
         self.params = {"pre": self.pre_params, "block": self.block_params,
                        "post": self.post_params}
@@ -209,6 +237,8 @@ class TrainStep:
         use_amp, amp_level = self.use_amp, self.amp_level
         merge_k = self.grad_merge_k
 
+        metrics = self.metrics
+
         def forward_loss(p_arrays, b_arrays, inputs, labels, key):
             import contextlib
             ctx = amp_mod.auto_cast(
@@ -231,7 +261,21 @@ class TrainStep:
                     # loss is a raw array here (see _loss_from_out)
                     loss = loss + (aux._data if isinstance(aux, Tensor)
                                    else aux)
-            return loss.astype(jnp.float32), [new_buf[k] for k in bnames]
+                metric_outs = []
+                if metrics:
+                    with autograd.no_grad():
+                        out_t = out if isinstance(out, Tensor) \
+                            else Tensor(out)
+                        lab_t = [Tensor(l) for l in labels]
+                        for m in metrics:
+                            mo = m.compute(out_t, *lab_t)
+                            mo = mo if isinstance(mo, (list, tuple)) \
+                                else [mo]
+                            metric_outs.append(
+                                [x._data if isinstance(x, Tensor) else x
+                                 for x in mo])
+            return loss.astype(jnp.float32), (
+                [new_buf[k] for k in bnames], metric_outs)
 
         trainable = self._trainable
 
@@ -259,24 +303,37 @@ class TrainStep:
                         return forward_loss(merged, b_list, mb_in, mb_lab,
                                             jax.random.fold_in(key, i))
 
-                    (l, buf2), g = jax.value_and_grad(
+                    (l, (buf2, mo)), g = jax.value_and_grad(
                         loss_mb, has_aux=True)(p_sub)
                     g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
-                    return g_acc, l_acc + l, buf2
+                    return (g_acc, l_acc + l, buf2), mo
 
                 # unrolled python loop (merge_k is small & static)
                 zero_g = jax.tree_util.tree_map(jnp.zeros_like, p_sub)
                 g_acc, l_acc, buf = zero_g, jnp.zeros([], jnp.float32), \
                     b_list
+                metric_parts = []
                 for i in range(merge_k):
-                    g_acc, l_acc, buf = micro(i, (g_acc, l_acc, buf))
+                    (g_acc, l_acc, buf), mo = micro(
+                        i, (g_acc, l_acc, buf))
+                    metric_parts.append(mo)
                 grads = jax.tree_util.tree_map(
                     lambda g: g / merge_k, g_acc)
                 loss = l_acc / merge_k
                 new_b_list = buf
+                # concat per-micro metric inputs along batch dim
+                metric_outs = []
+                if metric_parts and metric_parts[0]:
+                    for mi in range(len(metric_parts[0])):
+                        metric_outs.append([
+                            jnp.concatenate(
+                                [mp[mi][j] for mp in metric_parts])
+                            if metric_parts[0][mi][j].ndim else
+                            metric_parts[-1][mi][j]
+                            for j in range(len(metric_parts[0][mi]))])
             else:
-                (loss, new_b_list), grads = jax.value_and_grad(
-                    loss_of, has_aux=True)(p_sub)
+                (loss, (new_b_list, metric_outs)), grads = \
+                    jax.value_and_grad(loss_of, has_aux=True)(p_sub)
 
             new_sub, new_opt_sub = self.optimizer.apply_gradients_tree(
                 p_sub, grads,
@@ -291,7 +348,7 @@ class TrainStep:
                     v, NamedSharding(self.mesh, self.param_specs[k]))
                 for k, v in new_params.items()}
             new_buffers = dict(zip(bnames, new_b_list))
-            return loss, new_params, new_buffers, new_opt
+            return loss, new_params, new_buffers, new_opt, metric_outs
 
         batch_sharding = self._data_sharding
 
@@ -311,21 +368,46 @@ class TrainStep:
                        donate_argnums=donate)
 
     def _build_pipeline(self, in_shapes):
+        if self.pipe_schedule == "1F1B":
+            return self._build_pipeline_1f1b(in_shapes)
         pipe_fn = self.pipe_fn
-        loss_fn = self.loss_fn
 
-        def step(params, opt_state, lr, key, inputs, labels):
+        def step(params, buffers, opt_state, lr, key, inputs, labels):
             def loss_of(p):
-                out = pipe_fn(p["pre"], p["block"], p["post"],
-                              inputs[0], key)
-                return self._loss_from_out(out, labels).astype(jnp.float32)
+                out, new_bufs = pipe_fn(p["pre"], p["block"], p["post"],
+                                        inputs[0], key,
+                                        block_buffers=buffers)
+                loss = self._loss_from_out(out, labels).astype(
+                    jnp.float32)
+                return loss, new_bufs
 
-            loss, grads = jax.value_and_grad(loss_of)(params)
+            (loss, new_bufs), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
             new_params, new_opt = self.optimizer.apply_gradients_tree(
                 params, grads, opt_state, lr)
-            return loss, new_params, new_opt
+            return loss, new_params, new_bufs, new_opt
 
-        donate = (0, 1) if self.donate else ()
+        donate = (0, 2) if self.donate else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def _build_pipeline_1f1b(self, in_shapes):
+        pipe_1f1b = self.pipe_1f1b
+
+        def step(params, buffers, opt_state, lr, key, inputs, labels):
+            if self.loss_fn is not None and len(labels) != 1:
+                raise ValueError(
+                    "1F1B pipeline expects exactly one labels array "
+                    f"(got {len(labels)}); GPipe (schedule_mode="
+                    "'F-then-B') supports multi-label losses")
+            loss, g_pre, g_block, g_post, new_bufs = pipe_1f1b(
+                params["pre"], params["block"], params["post"], buffers,
+                inputs[0], labels[0] if labels else None, key)
+            grads = {"pre": g_pre, "block": g_block, "post": g_post}
+            new_params, new_opt = self.optimizer.apply_gradients_tree(
+                params, grads, opt_state, lr)
+            return loss, new_params, new_bufs, new_opt
+
+        donate = (0, 2) if self.donate else ()
         return jax.jit(step, donate_argnums=donate)
 
     # ------------------------------------------------------------------
@@ -354,11 +436,15 @@ class TrainStep:
         in_arrays = [_as_array(x) for x in inputs]
         lab_arrays = [_as_array(x) for x in labels]
         if self.is_pipeline and jax.process_count() > 1:
-            raise NotImplementedError(
-                "pipeline TrainStep on a multi-host mesh: global batch "
-                "assembly for the pipeline path is not implemented — "
-                "feed pre-assembled global arrays or keep pp within one "
-                "host")
+            # multi-host pipeline: the pp ring may span hosts, so a dp
+            # row-block can live on several processes — every process
+            # must feed the identical GLOBAL batch (Megatron semantics:
+            # ranks within a dp group read the same data) and each cuts
+            # out its addressable shards
+            in_arrays = [mesh_mod.global_from_replicated(a, self.mesh)
+                         for a in in_arrays]
+            lab_arrays = [mesh_mod.global_from_replicated(a, self.mesh)
+                          for a in lab_arrays]
         if not self.is_pipeline:
             if jax.process_count() > 1:
                 # multi-host: each process holds its LOCAL batch shard;
@@ -393,11 +479,12 @@ class TrainStep:
                 self._compiled[shapes_key] = self._build_flat(meta)
         fn = self._compiled[shapes_key]
         if self.is_pipeline:
-            loss, self.params, self.opt_state = fn(
-                self.params, self.opt_state, lr, key, in_arrays,
-                lab_arrays)
+            loss, self.params, self.block_buffers, self.opt_state = fn(
+                self.params, self.block_buffers, self.opt_state, lr, key,
+                in_arrays, lab_arrays)
         else:
-            loss, self.params, self.buffers, self.opt_state = fn(
+            (loss, self.params, self.buffers, self.opt_state,
+             self.last_metric_outs) = fn(
                 self.params, self.buffers, self.opt_state, lr, key,
                 in_arrays, lab_arrays)
         self.optimizer._step_count += 1
@@ -407,18 +494,30 @@ class TrainStep:
     def sync_to_layer(self):
         """Copy device state back into the Layer's Tensors."""
         if self.is_pipeline:
-            from .pipeline import unstack_block_params
+            from .pipeline import unstack_block_params, \
+                unstack_block_buffers
             pp = self.mesh.shape.get("pp", 1)
             flat = {k: np.asarray(v).reshape((-1,) + v.shape[2:])
                     for k, v in self.params["block"].items()}
             unstack_block_params(self.model.blocks, self.block_pnames,
                                  flat)
+            flat_b = {k: np.asarray(v).reshape((-1,) + v.shape[2:])
+                      for k, v in self.block_buffers.items()}
+            unstack_block_buffers(self.model.blocks, self.block_bnames,
+                                  flat_b)
+            # pre/post params are mesh-committed; re-place on one device
+            # so eager eval/predict after training works (same policy as
+            # the flat path below)
+            dev0 = next(iter(self.mesh.devices.flat))
             for store, params in (("pre", self.params["pre"]),
                                   ("post", self.params["post"])):
                 layer = getattr(self.model, store)
                 if layer is not None:
                     named = dict(layer.named_parameters())
                     for k, v in params.items():
+                        if isinstance(v, jax.Array) and \
+                                len(v.devices()) > 1:
+                            v = jax.device_put(np.asarray(v), dev0)
                         named[k]._data = v
             return
         # re-place on one device: the Layer copy serves eager eval/predict,

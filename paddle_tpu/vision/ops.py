@@ -675,3 +675,336 @@ def __getattr__(name):
         from .deform_layer import DeformConv2D
         return DeformConv2D
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+# ---- generate_proposals (reference: detection/generate_proposals_op.cc) --
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                      pre_nms_top_n=6000, post_nms_top_n=1000,
+                      nms_thresh=0.5, min_size=0.1, eta=1.0,
+                      pixel_offset=True, return_rois_num=True, name=None):
+    """RPN proposal generation, XLA-shaped.
+
+    scores [N, A, H, W], bbox_deltas [N, 4A, H, W], img_size [N, 2] (h, w),
+    anchors [H, W, A, 4] (or [H*W*A, 4]), variances like anchors.
+    Returns (rois [N, post_nms_top_n, 4] padded with 0, roi_probs
+    [N, post_nms_top_n, 1], rois_num [N]) — the reference emits LoD rows;
+    static shapes + counts here.
+    """
+    scores_t = ensure_tensor(scores)._data
+    deltas_t = ensure_tensor(bbox_deltas)._data
+    img_t = ensure_tensor(img_size)._data.astype(jnp.float32)
+    anchors_t = ensure_tensor(anchors)._data.reshape(-1, 4)
+    var_t = ensure_tensor(variances)._data.reshape(-1, 4)
+    n, a, h, w = scores_t.shape
+    total = a * h * w
+    offset = 1.0 if pixel_offset else 0.0
+
+    def one_image(sc, dl, im):
+        # [A, H, W] -> [H*W*A] to match anchor layout [H, W, A, 4]
+        sc = sc.transpose(1, 2, 0).reshape(-1)
+        dl = dl.reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        k = min(int(pre_nms_top_n), total) if pre_nms_top_n > 0 else total
+        top = jnp.argsort(-sc)[:k]
+        sc_k, dl_k = sc[top], dl[top]
+        an_k, vr_k = anchors_t[top], var_t[top]
+        # decode (reference box_coder decode_center_size w/ variances)
+        aw = an_k[:, 2] - an_k[:, 0] + offset
+        ah = an_k[:, 3] - an_k[:, 1] + offset
+        acx = an_k[:, 0] + 0.5 * aw
+        acy = an_k[:, 1] + 0.5 * ah
+        cx = vr_k[:, 0] * dl_k[:, 0] * aw + acx
+        cy = vr_k[:, 1] * dl_k[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(vr_k[:, 2] * dl_k[:, 2],
+                                 math.log(1000.0 / 16.0))) * aw
+        bh = jnp.exp(jnp.minimum(vr_k[:, 3] * dl_k[:, 3],
+                                 math.log(1000.0 / 16.0))) * ah
+        x1 = cx - 0.5 * bw
+        y1 = cy - 0.5 * bh
+        x2 = cx + 0.5 * bw - offset
+        y2 = cy + 0.5 * bh - offset
+        im_h, im_w = im[0], im[1]
+        x1 = jnp.clip(x1, 0.0, im_w - offset)
+        y1 = jnp.clip(y1, 0.0, im_h - offset)
+        x2 = jnp.clip(x2, 0.0, im_w - offset)
+        y2 = jnp.clip(y2, 0.0, im_h - offset)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=1)
+        keep_wh = ((x2 - x1 + offset >= min_size) &
+                   (y2 - y1 + offset >= min_size))
+        sc_k = jnp.where(keep_wh, sc_k, -jnp.inf)
+        kept = nms(Tensor(boxes), Tensor(sc_k),
+                   iou_threshold=nms_thresh,
+                   top_k=k, box_normalized=not pixel_offset)._data
+        kept = kept[:post_nms_top_n]
+        valid = (kept >= 0) & (sc_k[jnp.clip(kept, 0, k - 1)] > -jnp.inf)
+        idx = jnp.clip(kept, 0, k - 1)
+        rois_i = jnp.where(valid[:, None], boxes[idx], 0.0)
+        probs_i = jnp.where(valid, sc_k[idx], 0.0)
+        pad = post_nms_top_n - rois_i.shape[0]
+        if pad > 0:
+            rois_i = jnp.concatenate(
+                [rois_i, jnp.zeros((pad, 4), rois_i.dtype)])
+            probs_i = jnp.concatenate(
+                [probs_i, jnp.zeros((pad,), probs_i.dtype)])
+            valid = jnp.concatenate([valid, jnp.zeros((pad,), bool)])
+        return rois_i, probs_i[:, None], valid.sum().astype(jnp.int32)
+
+    rois, probs, nums = jax.vmap(one_image)(scores_t, deltas_t, img_t)
+    if return_rois_num:
+        return Tensor(rois), Tensor(probs), Tensor(nums)
+    return Tensor(rois), Tensor(probs)
+
+
+# ---- matrix_nms (reference: detection/matrix_nms_op.cc, SOLOv2) ----------
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS: parallel soft-suppression via the IoU-decay matrix
+    (no sequential suppression loop — inherently MXU/vector friendly).
+
+    Single image: bboxes [M, 4], scores [C, M].  Returns (out
+    [keep_top_k, 6] rows [label, score, x1, y1, x2, y2] padded -1,
+    index [keep_top_k], rois_num scalar).
+    """
+    bboxes_t = ensure_tensor(bboxes)._data
+    scores_t = ensure_tensor(scores)._data
+    c, m = scores_t.shape
+    k = min(int(nms_top_k), m) if nms_top_k > 0 else m
+    iou_full = _iou_matrix(bboxes_t, normalized)
+
+    rows, idxs = [], []
+    for cls in range(c):
+        if cls == background_label:
+            continue
+        sc = scores_t[cls]
+        order = jnp.argsort(-sc)[:k]
+        sc_k = sc[order]
+        valid0 = sc_k > score_threshold
+        iou = iou_full[order][:, order]
+        tri = jnp.tril(iou, -1)  # iou with higher-scored boxes only
+        # for each j: max IoU with any higher-scored box
+        max_iou = tri.max(axis=1)
+        if use_gaussian:
+            decay = jnp.exp(-(tri ** 2 - max_iou[None, :] ** 2)
+                            / gaussian_sigma)
+        else:
+            decay = (1.0 - tri) / (1.0 - max_iou[None, :] + 1e-10)
+        # row i decayed by the most suppressive higher-scored box
+        decay = jnp.where(jnp.tril(jnp.ones((k, k), bool), -1),
+                          decay, jnp.inf).min(axis=1)
+        decay = jnp.where(jnp.isinf(decay), 1.0, decay)
+        new_sc = jnp.where(valid0, sc_k * decay, -1.0)
+        keep = new_sc > post_threshold
+        rows.append(jnp.concatenate([
+            jnp.where(keep, cls, -1.0)[:, None],
+            jnp.where(keep, new_sc, -1.0)[:, None],
+            jnp.where(keep[:, None], bboxes_t[order], -1.0)], axis=1))
+        idxs.append(jnp.where(keep, order, -1))
+    if not rows:
+        z6 = jnp.full((keep_top_k, 6), -1.0, bboxes_t.dtype)
+        zi = jnp.full((keep_top_k,), -1, jnp.int32)
+        zc = jnp.zeros((), jnp.int32)
+        out = (Tensor(z6),)
+        if return_index:
+            out += (Tensor(zi),)
+        if return_rois_num:
+            out += (Tensor(zc),)
+        return out if len(out) > 1 else out[0]
+    allrows = jnp.concatenate(rows, axis=0)
+    allidx = jnp.concatenate(idxs, axis=0)
+    order = jnp.argsort(jnp.where(allrows[:, 0] >= 0,
+                                  -allrows[:, 1], jnp.inf))
+    allrows, allidx = allrows[order], allidx[order]
+    if allrows.shape[0] < keep_top_k:
+        pad = keep_top_k - allrows.shape[0]
+        allrows = jnp.concatenate(
+            [allrows, jnp.full((pad, 6), -1.0, allrows.dtype)])
+        allidx = jnp.concatenate(
+            [allidx, jnp.full((pad,), -1, allidx.dtype)])
+    out_rows = allrows[:keep_top_k]
+    out_idx = allidx[:keep_top_k].astype(jnp.int32)
+    count = (out_rows[:, 0] >= 0).sum().astype(jnp.int32)
+    result = (Tensor(out_rows),)
+    if return_index:
+        result += (Tensor(out_idx),)
+    if return_rois_num:
+        result += (Tensor(count),)
+    return result if len(result) > 1 else result[0]
+
+
+# ---- distribute_fpn_proposals (reference: distribute_fpn_proposals_op.cc)
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=True,
+                             rois_num=None, name=None):
+    """Assign each RoI to an FPN level by its scale.
+
+    fpn_rois [M, 4].  Returns (multi_rois: list of [M, 4] per level with
+    rows zeroed where not assigned, restore_index [M, 1], per-level
+    rois_num list) — fixed-shape analogue of the reference's LoD splits:
+    each level keeps the full M rows COMPACTED to the front.
+    """
+    rois = ensure_tensor(fpn_rois)._data
+    m = rois.shape[0]
+    offset = 1.0 if pixel_offset else 0.0
+    wid = rois[:, 2] - rois[:, 0] + offset
+    hei = rois[:, 3] - rois[:, 1] + offset
+    scale = jnp.sqrt(jnp.clip(wid, 0) * jnp.clip(hei, 0))
+    lvl = jnp.floor(jnp.log2(scale / float(refer_scale) + 1e-8)) + \
+        refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    n_levels = max_level - min_level + 1
+    multi_rois, level_nums = [], []
+    pos_in_out = jnp.zeros((m,), jnp.int32)
+    base = jnp.zeros((), jnp.int32)
+    for i in range(n_levels):
+        mask = lvl == (min_level + i)
+        order = jnp.argsort(~mask)  # assigned rows first, stable
+        compact = jnp.where(mask[order][:, None], rois[order], 0.0)
+        cnt = mask.sum().astype(jnp.int32)
+        multi_rois.append(Tensor(compact))
+        level_nums.append(Tensor(cnt))
+        # restore index: position of each original roi in the concatenated
+        # per-level output
+        rank_in_level = jnp.cumsum(mask) - 1
+        pos_in_out = jnp.where(mask, base + rank_in_level.astype(jnp.int32),
+                               pos_in_out)
+        base = base + cnt
+    restore = jnp.zeros((m,), jnp.int32)
+    restore = restore.at[pos_in_out].set(jnp.arange(m, dtype=jnp.int32))
+    return multi_rois, Tensor(restore[:, None]), level_nums
+
+
+# ---- collect_fpn_proposals (reference: collect_fpn_proposals_op.cc) ------
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """Merge per-level proposals and keep the global top
+    ``post_nms_top_n`` by score.  Each level: rois [Mi, 4], scores [Mi].
+    Returns (rois [post_nms_top_n, 4], rois_num scalar)."""
+    rois = jnp.concatenate([ensure_tensor(r)._data for r in multi_rois])
+    scores = jnp.concatenate([ensure_tensor(s)._data.reshape(-1)
+                              for s in multi_scores])
+    if rois_num_per_level is not None:
+        # mask out per-level padding rows
+        masks = []
+        for r, cnt in zip(multi_rois, rois_num_per_level):
+            mi = ensure_tensor(r)._data.shape[0]
+            cnt_v = ensure_tensor(cnt)._data
+            masks.append(jnp.arange(mi) < cnt_v)
+        valid = jnp.concatenate(masks)
+        scores = jnp.where(valid, scores, -jnp.inf)
+    k = min(int(post_nms_top_n), rois.shape[0])
+    top = jnp.argsort(-scores)[:k]
+    sel = rois[top]
+    good = jnp.isfinite(scores[top])
+    sel = jnp.where(good[:, None], sel, 0.0)
+    if k < post_nms_top_n:
+        sel = jnp.concatenate(
+            [sel, jnp.zeros((post_nms_top_n - k, 4), sel.dtype)])
+        good = jnp.concatenate(
+            [good, jnp.zeros((post_nms_top_n - k,), bool)])
+    return Tensor(sel), Tensor(good.sum().astype(jnp.int32))
+
+
+# ---- psroi_pool (reference: detection/psroi_pool_op.cc) ------------------
+def psroi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+               output_channels=None, name=None):
+    """Position-sensitive RoI average pooling (R-FCN).
+
+    x [N, C, H, W] with C = output_channels * ph * pw; boxes [R, 4] on
+    image scale (all from batch image 0 unless boxes_num maps them).
+    Output [R, output_channels, ph, pw]: bin (i, j) of output channel c
+    pools input channel c*ph*pw + i*pw + j over the bin region.
+    """
+    x_t = ensure_tensor(x)._data
+    boxes_t = ensure_tensor(boxes)._data
+    ph = pw = int(output_size) if not isinstance(output_size, (tuple, list)) \
+        else None
+    if ph is None:
+        ph, pw = output_size
+    n, c, hh, ww = x_t.shape
+    out_c = output_channels or c // (ph * pw)
+    assert out_c * ph * pw == c, (c, out_c, ph, pw)
+    r = boxes_t.shape[0]
+    if boxes_num is None:
+        img_idx = jnp.zeros((r,), jnp.int32)
+    else:
+        cnts = ensure_tensor(boxes_num)._data
+        img_idx = jnp.repeat(jnp.arange(cnts.shape[0], dtype=jnp.int32),
+                             cnts, total_repeat_length=r)
+
+    ys = jnp.arange(hh, dtype=jnp.float32)
+    xs = jnp.arange(ww, dtype=jnp.float32)
+
+    def one_roi(box, bi):
+        # reference rounds roi to integral grid and forces >=0.1 size
+        x1 = jnp.round(box[0]) * spatial_scale
+        y1 = jnp.round(box[1]) * spatial_scale
+        x2 = jnp.round(box[2] + 1.0) * spatial_scale
+        y2 = jnp.round(box[3] + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        img = x_t[bi]
+
+        def one_bin(i, j):
+            hs = jnp.floor(y1 + i * bin_h)
+            he = jnp.ceil(y1 + (i + 1) * bin_h)
+            ws = jnp.floor(x1 + j * bin_w)
+            we = jnp.ceil(x1 + (j + 1) * bin_w)
+            hmask = (ys >= jnp.clip(hs, 0, hh)) & (ys < jnp.clip(he, 0, hh))
+            wmask = (xs >= jnp.clip(ws, 0, ww)) & (xs < jnp.clip(we, 0, ww))
+            mask = hmask[:, None] & wmask[None, :]
+            area = jnp.maximum(mask.sum(), 1)
+            chans = jnp.arange(out_c) * (ph * pw) + i * pw + j
+            vals = img[chans]  # [out_c, H, W]
+            return jnp.where(mask[None], vals, 0.0).sum((1, 2)) / area
+
+        bins = jnp.stack([jnp.stack([one_bin(i, j) for j in range(pw)],
+                                    axis=-1) for i in range(ph)], axis=-2)
+        return bins  # [out_c, ph, pw]
+
+    out = jax.vmap(one_roi)(boxes_t, img_idx)
+    return Tensor(out)
+
+
+# ---- retinanet_detection_output (reference:
+#      detection/retinanet_detection_output_op.cc) ------------------------
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0, name=None):
+    """Decode per-FPN-level RetinaNet heads and run class-wise NMS.
+
+    Lists per level: bboxes[i] [Mi, 4] deltas, scores[i] [Mi, C],
+    anchors[i] [Mi, 4]; im_info [1, 3] (h, w, scale).  Returns
+    (out [keep_top_k, 6], count) like multiclass_nms.
+    """
+    im = ensure_tensor(im_info)._data.reshape(-1)[:2]
+    decoded, merged_scores = [], []
+    for dl, sc, an in zip(bboxes, scores, anchors):
+        dl = ensure_tensor(dl)._data
+        sc = ensure_tensor(sc)._data
+        an = ensure_tensor(an)._data
+        aw = an[:, 2] - an[:, 0] + 1.0
+        ah = an[:, 3] - an[:, 1] + 1.0
+        acx = an[:, 0] + 0.5 * aw
+        acy = an[:, 1] + 0.5 * ah
+        cx = dl[:, 0] * aw + acx
+        cy = dl[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(dl[:, 2], math.log(1000. / 16.))) * aw
+        bh = jnp.exp(jnp.minimum(dl[:, 3], math.log(1000. / 16.))) * ah
+        x1 = jnp.clip(cx - 0.5 * bw, 0, im[1] - 1)
+        y1 = jnp.clip(cy - 0.5 * bh, 0, im[0] - 1)
+        x2 = jnp.clip(cx + 0.5 * bw - 1, 0, im[1] - 1)
+        y2 = jnp.clip(cy + 0.5 * bh - 1, 0, im[0] - 1)
+        decoded.append(jnp.stack([x1, y1, x2, y2], axis=1))
+        merged_scores.append(sc)
+    allboxes = jnp.concatenate(decoded)
+    allscores = jnp.concatenate(merged_scores)  # [M, C]
+    return multiclass_nms(Tensor(allboxes), Tensor(allscores.T),
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          normalized=False, background_label=-1)

@@ -1,0 +1,99 @@
+"""Length-bucket batching: the TPU answer to LoD dynamic shapes
+(SURVEY.md §7 hard-part 5 — bounded compile variants + padding)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import io
+from paddle_tpu.io import (BucketedBatchSampler, bucketed_collate,
+                           pad_to_bucket, bucket_for)
+
+
+class RaggedDataset(io.Dataset):
+    def __init__(self, lengths):
+        self.lengths = lengths
+
+    def __getitem__(self, i):
+        L = self.lengths[i]
+        return (np.full((L,), i, np.int64), np.asarray(i % 2, np.int64))
+
+    def __len__(self):
+        return len(self.lengths)
+
+
+def test_bucket_for():
+    assert bucket_for(1, (8, 16)) == 8
+    assert bucket_for(8, (8, 16)) == 8
+    assert bucket_for(9, (8, 16)) == 16
+    with pytest.raises(ValueError, match="largest bucket"):
+        bucket_for(17, (8, 16))
+
+
+def test_pad_to_bucket_shapes_and_lengths():
+    arrays = [np.ones((5, 3)), np.ones((7, 3)), np.ones((2, 3))]
+    batch, lengths = pad_to_bucket(arrays, buckets=(8, 16), axis=0)
+    assert batch.shape == (3, 8, 3)
+    np.testing.assert_array_equal(lengths, [5, 7, 2])
+    assert batch[2, 2:].sum() == 0  # padded region
+
+def test_sampler_never_mixes_buckets():
+    lengths = [5, 30, 6, 31, 7, 60, 8, 61]
+    ds = RaggedDataset(lengths)
+    sampler = BucketedBatchSampler(ds, batch_size=2, buckets=(8, 32, 64))
+    batches = list(sampler)
+    assert sorted(i for b in batches for i in b) == list(range(8))
+    for b in batches:
+        bks = {bucket_for(lengths[i], (8, 32, 64)) for i in b}
+        assert len(bks) == 1, (b, bks)
+
+
+def test_dataloader_with_buckets_bounded_shapes():
+    lengths = [3, 9, 4, 10, 5, 17, 6, 18, 30, 29]
+    ds = RaggedDataset(lengths)
+    loader = io.DataLoader(
+        ds, batch_sampler=BucketedBatchSampler(ds, batch_size=2,
+                                               buckets=(8, 16, 32)),
+        collate_fn=bucketed_collate(buckets=(8, 16, 32)))
+    seen_shapes = set()
+    rows = 0
+    for x, y, lens in loader:
+        seen_shapes.add(tuple(np.asarray(x.numpy()).shape[1:]))
+        rows += np.asarray(x.numpy()).shape[0]
+        # padding is zero beyond each row's length
+        xn, ln = np.asarray(x.numpy()), np.asarray(lens.numpy())
+        for r in range(xn.shape[0]):
+            assert (xn[r, ln[r]:] == 0).all()
+    assert rows == len(lengths)
+    # at most one shape per bucket — the bounded-compile contract
+    assert seen_shapes <= {(8,), (16,), (32,)}, seen_shapes
+
+
+def test_bucketed_training_compiles_per_bucket_only():
+    from paddle_tpu import nn
+    lengths = [4, 5, 12, 13, 4, 12, 5, 13]
+    ds = RaggedDataset(lengths)
+    loader = io.DataLoader(
+        ds, batch_sampler=BucketedBatchSampler(ds, batch_size=2,
+                                               buckets=(8, 16)),
+        collate_fn=bucketed_collate(buckets=(8, 16)))
+    paddle.seed(0)
+    net = nn.Sequential(nn.Embedding(64, 8))
+
+    class MeanPoolNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(64, 8)
+            self.fc = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc(paddle.mean(self.emb(x), axis=1))
+
+    from paddle_tpu.parallel.train_step import TrainStep
+    net = MeanPoolNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    step = TrainStep(net, opt, loss_fn=nn.CrossEntropyLoss())
+    for x, y, lens in loader:
+        step.step([x], [y])
+    # one compiled variant per bucket, not per distinct raw length
+    assert len(step._compiled) == 2, len(step._compiled)

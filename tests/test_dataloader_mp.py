@@ -226,3 +226,80 @@ class TestReviewRegressions:
         with pytest.raises(RuntimeError, match="died"):
             for _ in loader:
                 pass
+
+    def test_stale_exception_does_not_kill_next_epoch(self):
+        class LateFail(io.Dataset):
+            def __getitem__(self, i):
+                if i == 10:
+                    raise ValueError("late boom")
+                return np.zeros((2,), np.float32)
+
+            def __len__(self):
+                return 12
+
+        loader = io.DataLoader(LateFail(), batch_size=2, num_workers=2,
+                               persistent_workers=True)
+        it = iter(loader)
+        next(it)  # batch 0; batch with idx 10 may fail in-flight
+        del it    # abandon epoch, stale exception may sit in result_q
+        import time
+        time.sleep(0.3)
+        # next epoch over only-good indices must not see the stale error
+        good = io.DataLoader(
+            LateFail(), batch_sampler=io.BatchSampler(
+                sampler=io.SequenceSampler(list(range(8))), batch_size=2),
+        )
+        # reuse the SAME pool: manual generation bump over the same loader
+        from paddle_tpu.io.worker import MultiprocessMapIter
+        batches = [[0, 1], [2, 3], [4, 5]]
+        out = list(MultiprocessMapIter(loader, batches,
+                                       loader._get_pool()))
+        assert len(out) == 3
+        loader._pool.close()
+
+    def test_iterable_dead_worker_raises(self):
+        import os as _os
+
+        class KillerIterable(io.IterableDataset):
+            def __iter__(self):
+                info = io.get_worker_info()
+                if info is not None and info.id == 0:
+                    _os._exit(1)
+                for i in range(4):
+                    yield np.zeros((2,), np.float32)
+
+        loader = io.DataLoader(KillerIterable(), batch_size=2,
+                               num_workers=1)
+        with pytest.raises(RuntimeError, match="died|dead"):
+            for _ in loader:
+                pass
+
+    def test_fresh_pools_get_fresh_augmentation_seeds(self):
+        class AugDataset(io.Dataset):
+            def __getitem__(self, i):
+                return np.random.rand(3).astype(np.float32)
+
+            def __len__(self):
+                return 4
+
+        loader = io.DataLoader(AugDataset(), batch_size=4, num_workers=1)
+        e1 = next(iter(loader)).numpy()
+        e2 = next(iter(loader)).numpy()
+        assert not np.allclose(e1, e2), "epochs replayed identical RNG"
+
+    def test_iterable_early_finisher_not_flagged_dead(self):
+        import time as _t
+
+        class Uneven(io.IterableDataset):
+            def __iter__(self):
+                info = io.get_worker_info()
+                if info.id == 0:
+                    return iter(())  # finishes instantly
+                for i in range(2):
+                    _t.sleep(6)  # slower than the 5s poll slice
+                    yield np.asarray([i], np.int64)
+
+        loader = io.DataLoader(Uneven(), batch_size=1, num_workers=2,
+                               use_shared_memory=False)
+        got = [int(b.numpy().ravel()[0]) for b in loader]
+        assert sorted(got) == [0, 1]

@@ -363,8 +363,8 @@ class TestPipeline:
         pipe = PipelineLayer(pre=None, blocks=blocks, post=None)
         pipe.eval()
         M = 2
-        fwd, pnames = build_pipeline_fn(pipe, num_microbatches=M,
-                                        mesh=hybrid_mesh, training=False)
+        fwd, pnames, bnames = build_pipeline_fn(
+            pipe, num_microbatches=M, mesh=hybrid_mesh, training=False)
         _, stacked = stack_block_params(pipe.blocks)
         pp = hybrid_mesh.shape["pp"]
         block_stacked = {k: v.reshape((pp, len(blocks) // pp)
@@ -372,7 +372,7 @@ class TestPipeline:
                          for k, v in stacked.items()}
         x = rng.rand(4, 8).astype(np.float32)
         key = jax.random.key(0)
-        out = jax.jit(lambda bs, xx: fwd({}, bs, {}, xx, key))(
+        out, _ = jax.jit(lambda bs, xx: fwd({}, bs, {}, xx, key))(
             block_stacked, jnp.asarray(x))
         ref = pipe(paddle_tpu.to_tensor(x)).numpy()
         np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,

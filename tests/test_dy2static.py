@@ -254,3 +254,72 @@ class TestReviewRegressions:
         np.testing.assert_allclose(np.asarray(g), 8.0 * w, rtol=1e-5)
         g2 = jax.grad(loss_fn)(-w)
         np.testing.assert_allclose(np.asarray(g2), 18.0 * -w, rtol=1e-5)
+
+    def test_nested_tensor_if_inside_branch(self):
+        """Generated __d2s_* helpers from a nested transform must not be
+        threaded as branch variables (review finding)."""
+
+        def f(x):
+            if paddle.sum(x) > 0:
+                if paddle.max(x) > 2.0:
+                    y = x * 4.0
+                else:
+                    y = x * 2.0
+            else:
+                y = x * 0.5
+            return y
+
+        conv = convert_function(f)
+        assert conv is not None
+        import jax
+
+        def run(a):
+            return conv(paddle.to_tensor(a))._data
+
+        out = jax.jit(run)(np.ones((2,), np.float32))
+        np.testing.assert_allclose(np.asarray(out), [2.0, 2.0], rtol=1e-6)
+        out = jax.jit(run)(np.full((2,), 3.0, np.float32))
+        np.testing.assert_allclose(np.asarray(out), [12.0, 12.0],
+                                   rtol=1e-6)
+        out = jax.jit(run)(np.full((2,), -1.0, np.float32))
+        np.testing.assert_allclose(np.asarray(out), [-0.5, -0.5],
+                                   rtol=1e-6)
+
+    def test_foreign_decorator_bails_to_trace(self):
+        import functools
+
+        def mydeco(fn):
+            @functools.wraps(fn)
+            def inner(*a, **k):
+                return fn(*a, **k)
+            return inner
+
+        @mydeco
+        def f(x):
+            if paddle.sum(x) > 0:
+                y = x * 2.0
+            else:
+                y = x * 3.0
+            return y
+
+        assert convert_function(f) is None
+
+    def test_super_in_forward_bails_to_trace(self):
+        class Base(nn.Layer):
+            def forward(self, x):
+                return x * 2.0
+
+        class Child(Base):
+            def forward(self, x):
+                if paddle.sum(x) > 0:
+                    y = super().forward(x)
+                else:
+                    y = x * 3.0
+                return y
+
+        # zero-arg super() => __class__ freevar => must NOT convert
+        assert convert_function(Child.forward) is None
+        # eager behavior intact
+        c = Child()
+        out = c(paddle.to_tensor(np.ones((2,), np.float32)))
+        np.testing.assert_allclose(out.numpy(), [2.0, 2.0])

@@ -1,0 +1,36 @@
+"""Go binding consistency: the image has no Go toolchain, so validate the
+cgo wrapper STATICALLY against the C API header (every C symbol the Go
+code calls must exist in paddle_capi.h with matching names)."""
+import os
+import re
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(p):
+    with open(os.path.join(ROOT, p)) as f:
+        return f.read()
+
+
+def test_go_calls_match_c_header():
+    header = _read("paddle_tpu/csrc/paddle_capi.h")
+    declared = set(re.findall(r"\bPD_\w+", header))
+    go_src = ""
+    godir = os.path.join(ROOT, "go", "paddle")
+    for fn in os.listdir(godir):
+        if fn.endswith(".go"):
+            go_src += _read(os.path.join("go", "paddle", fn))
+    used = set(re.findall(r"C\.(PD_\w+)", go_src))
+    missing = used - declared
+    assert not missing, f"Go binding calls undeclared C symbols: {missing}"
+    # the core surface must be wrapped
+    for sym in ("PD_NewConfig", "PD_ConfigSetModel", "PD_NewPredictor",
+                "PD_SetInput", "PD_Run", "PD_GetOutput", "PD_LastError"):
+        assert sym in used, f"Go binding does not wrap {sym}"
+
+
+def test_go_files_have_cgo_preamble():
+    pred = _read("go/paddle/predictor.go")
+    cfg = _read("go/paddle/config.go")
+    assert '#include "paddle_capi.h"' in pred
+    assert "-lpaddle_capi" in cfg

@@ -1,0 +1,80 @@
+"""hapi train-loop metrics + flops (VERDICT round-1 item #8).
+
+Reference parity: hapi/model.py:1495 threads prepared metrics through
+the train loop; paddle.flops.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, metric
+from paddle_tpu.io import TensorDataset
+
+
+def _problem(n=128):
+    rs = np.random.RandomState(0)
+    x = rs.randn(n, 8).astype("float32")
+    y = (x[:, :4].sum(1) > x[:, 4:].sum(1)).astype("int64")
+    return x, y
+
+
+def _model():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+    m = paddle.Model(net)
+    m.prepare(paddle.optimizer.Adam(learning_rate=5e-3,
+                                    parameters=net.parameters()),
+              nn.CrossEntropyLoss(), metrics=metric.Accuracy())
+    return m
+
+
+class TestTrainMetrics:
+    def test_train_batch_returns_metrics(self):
+        m = _model()
+        x, y = _problem()
+        out = m.train_batch([x[:32]], [y[:32]])
+        assert len(out) == 2  # [loss, acc]
+        assert 0.0 <= out[1] <= 1.0
+
+    def test_fit_accumulates_train_accuracy(self):
+        m = _model()
+        x, y = _problem()
+        ds = TensorDataset([x, y])
+        seen = []
+
+        class Probe(paddle.hapi.callbacks.Callback):
+            def on_train_batch_end(self, step, logs=None):
+                if logs and "acc" in logs:
+                    seen.append(logs["acc"])
+
+        m.fit(ds, batch_size=32, epochs=6, verbose=0,
+              callbacks=[Probe()])
+        assert seen, "no train acc in batch logs"
+        # accuracy should end well above chance on this separable problem
+        assert seen[-1] > 0.7, seen[-5:]
+        # and match a fresh eval pass within a reasonable window
+        logs = m.evaluate(ds, batch_size=32, verbose=0)
+        assert abs(logs["acc"] - seen[-1]) < 0.15, (logs, seen[-1])
+
+    def test_metrics_reset_per_epoch(self):
+        m = _model()
+        x, y = _problem(64)
+        ds = TensorDataset([x, y])
+        m.fit(ds, batch_size=32, epochs=2, verbose=0)
+        acc_metric = m._metrics[0]
+        # after fit, the metric holds only the LAST epoch's counts
+        assert acc_metric.total[0] <= 64
+
+
+class TestFlops:
+    def test_flops_counts_matmuls(self):
+        m = _model()
+        flops = m.flops(input_size=[1, 8])
+        # 8x32 + 32x2 matmuls => at least 2*(8*32 + 32*2) = 640
+        assert flops >= 2 * (8 * 32 + 32 * 2), flops
+
+    def test_flops_scales_with_batch(self):
+        m = _model()
+        f1 = m.flops(input_size=[1, 8])
+        f8 = m.flops(input_size=[8, 8])
+        assert f8 >= 4 * f1, (f1, f8)

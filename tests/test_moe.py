@@ -88,3 +88,36 @@ def test_moe_params_sharded_over_ep():
         assert spec[0] == "ep"
     finally:
         dist.set_mesh(None)
+
+
+def test_sort_routing_matches_dense_gating():
+    """top_k_routing (sort-based, O(T·k)) must produce the same routed
+    computation as top_k_gating's dense [T,E,C] dispatch/combine."""
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.moe import top_k_routing
+    rs = np.random.RandomState(3)
+    t, e, k, cap = 12, 4, 2, 4
+    logits = jnp.asarray(rs.randn(t, e).astype(np.float32))
+    tokens = jnp.asarray(rs.randn(t, 5).astype(np.float32))
+
+    dispatch, combine, aux_d = top_k_gating(logits, k, cap)
+    xs_dense = jnp.einsum("tec,td->ecd", dispatch, tokens)
+    ys = xs_dense * 2.0 + 1.0  # stand-in expert fn (linear per slot)
+    out_dense = jnp.einsum("tec,ecd->td", combine, ys)
+
+    choice, pos, keep, gates, aux_s = top_k_routing(logits, k, cap)
+    slot = choice * cap + pos
+    slot_f = jnp.where(keep, slot, e * cap).reshape(-1)
+    tok_f = jnp.broadcast_to(jnp.arange(t)[:, None], (t, k)).reshape(-1)
+    xs = jnp.zeros((e * cap, 5)).at[slot_f].add(tokens[tok_f],
+                                                mode="drop")
+    np.testing.assert_allclose(np.asarray(xs.reshape(e, cap, 5)),
+                               np.asarray(xs_dense), rtol=1e-5, atol=1e-6)
+    ys2 = xs.reshape(e, cap, 5) * 2.0 + 1.0
+    got = ys2.reshape(e * cap, 5)[jnp.clip(slot_f, 0, e * cap - 1)]
+    wts = gates.reshape(-1) * keep.reshape(-1)
+    out_sort = (got * wts[:, None]).reshape(t, k, 5).sum(1)
+    np.testing.assert_allclose(np.asarray(out_sort),
+                               np.asarray(out_dense), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(float(aux_d), float(aux_s), rtol=1e-6)

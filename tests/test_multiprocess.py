@@ -8,6 +8,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 
 def _free_port():
     s = socket.socket()
@@ -50,3 +52,13 @@ def test_two_process_psum_and_dp_training():
     l1 = [float(v) for v in extract(outs[1], "losses").split(",")]
     assert l0 == pytest.approx(l1, rel=1e-5)   # same global computation
     assert l0[-1] < l0[0]                      # and it actually trains
+    # multi-host pipeline (pp spans the two processes), both schedules;
+    # the two schedules must also agree with each other
+    p0 = [float(v) for v in extract(outs[0], "pp_gpipe").split(",")]
+    p1 = [float(v) for v in extract(outs[1], "pp_gpipe").split(",")]
+    f0 = [float(v) for v in extract(outs[0], "pp_1f1b").split(",")]
+    f1 = [float(v) for v in extract(outs[1], "pp_1f1b").split(",")]
+    assert p0 == pytest.approx(p1, rel=1e-5)
+    assert f0 == pytest.approx(f1, rel=1e-5)
+    assert f0 == pytest.approx(p0, rel=1e-3, abs=1e-4)
+    assert p0[-1] < p0[0]
