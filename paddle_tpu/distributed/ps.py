@@ -220,13 +220,27 @@ class DistributedEmbedding:
 class TheOnePS:
     """Runtime facade (reference: fleet/runtime/the_one_ps.py:378).
 
-    Servers don't exist under SPMD; init_server/run_server keep the
-    call-sequence contract (warm-start load, table registry, barrier) so
-    PS-style training scripts run unchanged.
+    What is REAL here: the table registry, warm-start load from sharded
+    files (``init_server(dirname)``), sharded persistence
+    (``save_persistables``), and a mesh-wide ``barrier``.  What is a
+    deliberate no-op: ``run_server``/``init_worker``/``stop_worker`` —
+    there are no server processes under SPMD (tables live sharded on the
+    mesh and pull/push are collective array ops), and geo-async
+    replication has no analogue because there are no stale replicas to
+    reconcile.  The call-sequence contract is kept so PS-style training
+    scripts run unchanged.
     """
 
     def __init__(self):
         self.tables = {}
+
+    def barrier(self):
+        """Block until every process reaches this point (reference:
+        BarrierTable / fleet.barrier)."""
+        import jax
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("the_one_ps_barrier")
 
     def create_table(self, name, rows, dim, **kwargs):
         table = SparseTable(name, rows, dim, **kwargs)

@@ -41,6 +41,7 @@ class Model:
         self.stop_training = False
         self._eval_fn = None
         self._mode = "train"
+        self._eval_cache = {}
 
     @property
     def mode(self):
@@ -98,6 +99,45 @@ class Model:
             metrics_out.append(m.accumulate())
         return [float(loss.numpy())] + metrics_out
 
+    def _forward_eval(self, inputs):
+        """Compiled eval forward (the role of the reference
+        StaticGraphAdapter's eval program): one jax.jit per input shape,
+        params passed as arguments so weight updates never retrace.
+        Falls back to eager for untraceable forwards."""
+        import jax
+        import jax.numpy as jnp
+        from ..jit import functional_call, _wrap_tree
+
+        net = self.network
+        params = {k: p._data for k, p in net.named_parameters()}
+        buffers = {k: b._data for k, b in net.named_buffers()
+                   if b is not None}
+        try:
+            arrays = [i._data if isinstance(i, Tensor)
+                      else jnp.asarray(i) for i in inputs]
+        except Exception:
+            return None
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in arrays) + (
+            len(params), len(buffers))
+        if key not in self._eval_cache:
+            pn, bn = sorted(params), sorted(buffers)
+
+            @jax.jit
+            def fwd(p_list, b_list, xs):
+                with autograd.no_grad():
+                    out, _ = functional_call(
+                        net, dict(zip(pn, p_list)),
+                        dict(zip(bn, b_list)), xs, training=False)
+                return out
+            self._eval_cache[key] = (fwd, pn, bn)
+        fwd, pn, bn = self._eval_cache[key]
+        try:
+            out = fwd([params[k] for k in pn],
+                      [buffers[k] for k in bn], arrays)
+        except Exception:
+            return None
+        return _wrap_tree(out)
+
     def eval_batch(self, inputs, labels=None):
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if labels is not None else []
@@ -105,8 +145,10 @@ class Model:
         self._sync_weights()
         prev = self.mode
         self.mode = "eval"
-        with autograd.no_grad():
-            out = self.network(*inputs)
+        out = self._forward_eval(inputs)
+        if out is None:  # untraceable forward: eager fallback
+            with autograd.no_grad():
+                out = self.network(*inputs)
         losses = []
         if self._loss is not None and labels:
             loss = self._loss(out, *labels)
@@ -121,8 +163,10 @@ class Model:
         self._sync_weights()
         prev = self.mode
         self.mode = "test"
-        with autograd.no_grad():
-            out = self.network(*inputs)
+        out = self._forward_eval(inputs)
+        if out is None:
+            with autograd.no_grad():
+                out = self.network(*inputs)
         self.mode = prev
         return out
 

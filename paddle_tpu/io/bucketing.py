@@ -86,7 +86,19 @@ class BucketedBatchSampler(BatchSampler):
                 first = sample[0] if isinstance(sample, (tuple, list)) \
                     else sample
                 return len(first)
-        self.length_fn = length_fn
+        # memoize per index: lengths are static for a map dataset, and
+        # the default length_fn materializes the sample — without the
+        # cache every epoch (and every len()) re-decodes the dataset in
+        # the MAIN process, serializing ahead of the workers
+        raw_length_fn = length_fn
+        self._length_memo = {}
+
+        def cached_length_fn(i):
+            if i not in self._length_memo:
+                self._length_memo[i] = raw_length_fn(i)
+            return self._length_memo[i]
+
+        self.length_fn = cached_length_fn
         self.sampler = (RandomSampler(dataset) if shuffle
                         else SequenceSampler(dataset))
         self._len_cache = None

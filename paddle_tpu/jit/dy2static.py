@@ -210,6 +210,21 @@ def lookup(name, local_map):
     return _Undefined(name) if v is UNDEFINED else v
 
 
+def range_cond(i, stop, step):
+    """Loop-continue predicate for a converted for-range: direction-aware
+    like Python's range (empty when step moves away from stop)."""
+    if isinstance(i, Tensor) or isinstance(stop, Tensor) or \
+            isinstance(step, Tensor):
+        from ..ops import logical_and as _land, logical_or as _lor
+        from ..core.dispatch import ensure_tensor
+        i_t, stop_t = ensure_tensor(i), ensure_tensor(stop)
+        step_t = ensure_tensor(step)
+        fwd = _land(step_t > 0, i_t < stop_t)
+        bwd = _land(step_t < 0, i_t > stop_t)
+        return _lor(fwd, bwd)
+    return (step > 0 and i < stop) or (step < 0 and i > stop)
+
+
 def convert_logical_and(lhs_fn, rhs_fn):
     """reference: convert_operators.convert_logical_and (short-circuit
     preserved for plain Python values)."""
@@ -510,6 +525,89 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         out = [*_preamble(loop_vars, n), cond_fn, body_fn,
                ast.Assign(targets=[target], value=call)
                if loop_vars else ast.Expr(value=call)]
+        return [ast.fix_missing_locations(ast.copy_location(s, node))
+                for s in out]
+
+    # -- for over range() -------------------------------------------------
+    def visit_For(self, node):
+        """``for i in range(...)`` → while form (reference:
+        loop_transformer converts for→while); a tensor bound then lowers
+        through convert_while_loop.  Non-range iterables (lists,
+        LayerList, tensors) keep Python semantics — iterating a module
+        list is the common case and must trace-unroll.
+
+        Known divergence (same as the reference's transformer): after
+        the loop the induction variable holds the one-past value
+        (start + step*n), not Python's last-yielded value."""
+        self.generic_visit(node)
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3
+                and isinstance(node.target, ast.Name)
+                and not node.orelse
+                and not _has(node.body, (ast.Break, ast.Continue,
+                                         ast.Return))):
+            return node
+        n = self.counter
+        self.counter += 1
+        i_name = node.target.id
+        if len(it.args) == 1:
+            start, stop, step = (ast.Constant(0), it.args[0],
+                                 ast.Constant(1))
+        elif len(it.args) == 2:
+            start, stop, step = (it.args[0], it.args[1], ast.Constant(1))
+        else:
+            start, stop, step = it.args[0], it.args[1], it.args[2]
+        stop_name, step_name = f"__d2s_stop_{n}", f"__d2s_step_{n}"
+        init = [
+            ast.Assign(targets=[ast.Name(id=stop_name, ctx=ast.Store())],
+                       value=stop),
+            ast.Assign(targets=[ast.Name(id=step_name, ctx=ast.Store())],
+                       value=step),
+            ast.Assign(targets=[ast.Name(id=i_name, ctx=ast.Store())],
+                       value=start),
+        ]
+        loop_vars = [i_name] + [a for a in _assigned_names(node.body)
+                                if a != i_name]
+        cond_name, body_name = f"__d2s_fcond_{n}", f"__d2s_fbody_{n}"
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=a) for a in loop_vars],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        cond_fn = ast.FunctionDef(
+            name=cond_name, args=args,
+            body=[ast.Return(value=_jst_call(
+                "range_cond",
+                [ast.Name(id=i_name, ctx=ast.Load()),
+                 ast.Name(id=stop_name, ctx=ast.Load()),
+                 ast.Name(id=step_name, ctx=ast.Load())]))],
+            decorator_list=[])
+        incr = ast.Assign(
+            targets=[ast.Name(id=i_name, ctx=ast.Store())],
+            value=ast.BinOp(left=ast.Name(id=i_name, ctx=ast.Load()),
+                            op=ast.Add(),
+                            right=ast.Name(id=step_name, ctx=ast.Load())))
+        ret_tuple = ast.Tuple(
+            elts=[ast.Name(id=a, ctx=ast.Load()) for a in loop_vars],
+            ctx=ast.Load())
+        body_fn = ast.FunctionDef(
+            name=body_name, args=args,
+            body=[*node.body, incr, ast.Return(value=ret_tuple)],
+            decorator_list=[])
+        call = _jst_call(
+            "convert_while_loop",
+            [ast.Name(id=cond_name, ctx=ast.Load()),
+             ast.Name(id=body_name, ctx=ast.Load()),
+             ast.Tuple(elts=[ast.Name(id=a, ctx=ast.Load())
+                             for a in loop_vars], ctx=ast.Load()),
+             ast.Tuple(elts=[ast.Constant(a) for a in loop_vars],
+                       ctx=ast.Load())])
+        target = ast.Tuple(
+            elts=[ast.Name(id=a, ctx=ast.Store()) for a in loop_vars],
+            ctx=ast.Store())
+        out = [*_preamble([a for a in loop_vars if a != i_name], n),
+               *init, cond_fn, body_fn,
+               ast.Assign(targets=[target], value=call)]
         return [ast.fix_missing_locations(ast.copy_location(s, node))
                 for s in out]
 

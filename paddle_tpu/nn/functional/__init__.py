@@ -96,6 +96,7 @@ from .sequence import (  # noqa: F401
     sequence_first_step, sequence_last_step, sequence_concat,
     sequence_expand_as, sequence_slice, sequence_scatter,
     sequence_enumerate, sequence_reshape, sequence_conv,
+    sequence_erase, sequence_topk_avg_pooling,
 )
 from ...vision.ops import yolo_loss as yolov3_loss  # noqa: F401
 

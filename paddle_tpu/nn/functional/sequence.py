@@ -449,3 +449,74 @@ def sequence_conv(input, weight, bias=None, context_length=3,
 
     prim = primitive(name="sequence_conv", nondiff=(2,))(fn)
     return prim(*args)
+
+
+def sequence_erase(input, tokens, lengths=None, name=None):
+    """Remove listed tokens from each sequence (reference:
+    sequence_ops/sequence_erase_op.cc).  Dense+lengths form: erased slots
+    are compacted to the front, the tail is zero-padded, and the new
+    per-row length is returned.
+
+    input [B, S] int; tokens: list of token ids.  Returns (out [B, S],
+    new_lengths [B]).
+    """
+    import jax.numpy as jnp
+    from ...core.dispatch import ensure_tensor, primitive
+    from ...core.tensor import Tensor
+
+    tokens = tuple(int(t) for t in (tokens if isinstance(
+        tokens, (list, tuple)) else [tokens]))
+    x = ensure_tensor(input)._data
+    b, s = x.shape
+    if lengths is None:
+        lens = jnp.full((b,), s, jnp.int32)
+    else:
+        lens = ensure_tensor(lengths)._data.astype(jnp.int32)
+    valid = jnp.arange(s)[None, :] < lens[:, None]
+    keep = valid
+    for t in tokens:
+        keep = keep & (x != t)
+    # stable compaction: kept entries first (argsort of ~keep is stable)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    new_len = keep.sum(axis=1).astype(jnp.int32)
+    out = jnp.where(jnp.arange(s)[None, :] < new_len[:, None],
+                    compacted, 0)
+    return Tensor(out), Tensor(new_len)
+
+
+def sequence_topk_avg_pooling(input, row_lengths, col_lengths, topks,
+                              channel_num=1, name=None):
+    """Per-row top-k average pooling over a [B, C, R, Cm] score map
+    (reference: sequence_ops/sequence_topk_avg_pooling_op.cc, used by
+    match-matrix text models).  Dense form: masked positions excluded;
+    returns [B, R, C * len(topks)].
+    """
+    import jax.numpy as jnp
+    from ...core.dispatch import ensure_tensor
+    from ...core.tensor import Tensor
+
+    x = ensure_tensor(input)._data
+    b, c, r, cm = x.shape
+    row_l = ensure_tensor(row_lengths)._data.astype(jnp.int32)
+    col_l = ensure_tensor(col_lengths)._data.astype(jnp.int32)
+    col_mask = jnp.arange(cm)[None, None, None, :] < \
+        col_l[:, None, None, None]
+    neg = jnp.finfo(x.dtype).min
+    masked = jnp.where(col_mask, x, neg)
+    sorted_desc = -jnp.sort(-masked, axis=-1)  # [B, C, R, Cm] descending
+    outs = []
+    for k in topks:
+        k = int(k)
+        topk = sorted_desc[..., :k]
+        kk = jnp.minimum(col_l, k).astype(x.dtype)  # valid count per row
+        pos_ok = jnp.arange(k)[None, None, None, :] < \
+            jnp.minimum(col_l, k)[:, None, None, None]
+        summed = jnp.where(pos_ok, topk, 0).sum(-1)
+        avg = summed / jnp.maximum(kk, 1)[:, None, None]
+        outs.append(avg)  # [B, C, R]
+    out = jnp.stack(outs, axis=-1)           # [B, C, R, K]
+    out = out.transpose(0, 2, 1, 3).reshape(b, r, c * len(topks))
+    row_mask = jnp.arange(r)[None, :] < row_l[:, None]
+    out = jnp.where(row_mask[:, :, None], out, 0)
+    return Tensor(out)

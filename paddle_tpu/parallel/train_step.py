@@ -321,7 +321,10 @@ class TrainStep:
                     lambda g: g / merge_k, g_acc)
                 loss = l_acc / merge_k
                 new_b_list = buf
-                # concat per-micro metric inputs along batch dim
+                # combine per-micro metric inputs: batch-dim concat for
+                # arrays, stack for scalars (all microbatches reach
+                # m.update(); taking only the last would drop 1-1/k of
+                # the batch)
                 metric_outs = []
                 if metric_parts and metric_parts[0]:
                     for mi in range(len(metric_parts[0])):
@@ -329,7 +332,7 @@ class TrainStep:
                             jnp.concatenate(
                                 [mp[mi][j] for mp in metric_parts])
                             if metric_parts[0][mi][j].ndim else
-                            metric_parts[-1][mi][j]
+                            jnp.stack([mp[mi][j] for mp in metric_parts])
                             for j in range(len(metric_parts[0][mi]))])
             else:
                 (loss, (new_b_list, metric_outs)), grads = \
@@ -372,6 +375,8 @@ class TrainStep:
             return self._build_pipeline_1f1b(in_shapes)
         pipe_fn = self.pipe_fn
 
+        metrics = self.metrics
+
         def step(params, buffers, opt_state, lr, key, inputs, labels):
             def loss_of(p):
                 out, new_bufs = pipe_fn(p["pre"], p["block"], p["post"],
@@ -379,13 +384,25 @@ class TrainStep:
                                         block_buffers=buffers)
                 loss = self._loss_from_out(out, labels).astype(
                     jnp.float32)
-                return loss, new_bufs
+                metric_outs = []
+                if metrics:
+                    with autograd.no_grad():
+                        out_t = Tensor(out)
+                        lab_t = [Tensor(l) for l in labels]
+                        for m in metrics:
+                            mo = m.compute(out_t, *lab_t)
+                            mo = mo if isinstance(mo, (list, tuple)) \
+                                else [mo]
+                            metric_outs.append(
+                                [x._data if isinstance(x, Tensor) else x
+                                 for x in mo])
+                return loss, (new_bufs, metric_outs)
 
-            (loss, new_bufs), grads = jax.value_and_grad(
+            (loss, (new_bufs, metric_outs)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
             new_params, new_opt = self.optimizer.apply_gradients_tree(
                 params, grads, opt_state, lr)
-            return loss, new_params, new_bufs, new_opt
+            return loss, new_params, new_bufs, new_opt, metric_outs
 
         donate = (0, 2) if self.donate else ()
         return jax.jit(step, donate_argnums=donate)
@@ -405,8 +422,16 @@ class TrainStep:
             grads = {"pre": g_pre, "block": g_block, "post": g_post}
             new_params, new_opt = self.optimizer.apply_gradients_tree(
                 params, grads, opt_state, lr)
-            return loss, new_params, new_bufs, new_opt
+            return loss, new_params, new_bufs, new_opt, []
 
+        if self.metrics:
+            import warnings
+            warnings.warn(
+                "TrainStep(metrics=...) under the 1F1B schedule: the "
+                "model output never materializes (loss is consumed "
+                "per-microbatch inside the schedule), so in-graph "
+                "metrics are not computed — use GPipe "
+                "(schedule_mode='F-then-B') or evaluate() for metrics")
         donate = (0, 2) if self.donate else ()
         return jax.jit(step, donate_argnums=donate)
 
@@ -440,7 +465,26 @@ class TrainStep:
             # row-block can live on several processes — every process
             # must feed the identical GLOBAL batch (Megatron semantics:
             # ranks within a dp group read the same data) and each cuts
-            # out its addressable shards
+            # out its addressable shards.  Verify the contract once: a
+            # per-host local shard fed here would silently train on
+            # inconsistent data.
+            if not getattr(self, "_mh_feed_checked", False):
+                self._mh_feed_checked = True
+                import hashlib
+                from jax.experimental import multihost_utils
+                digest = hashlib.sha256()
+                for a in in_arrays + lab_arrays:
+                    digest.update(np.ascontiguousarray(a).tobytes())
+                h = np.frombuffer(digest.digest()[:8], np.int64)
+                gathered = np.asarray(
+                    multihost_utils.process_allgather(h))
+                if not (gathered == gathered[0]).all():
+                    raise ValueError(
+                        "multi-host pipeline: processes fed DIFFERENT "
+                        "batches. The pp ring spans hosts, so every "
+                        "process must feed the identical GLOBAL batch "
+                        "(not its local dp shard) — load the same data "
+                        "on all ranks of a dp group")
             in_arrays = [mesh_mod.global_from_replicated(a, self.mesh)
                          for a in in_arrays]
             lab_arrays = [mesh_mod.global_from_replicated(a, self.mesh)
@@ -479,7 +523,8 @@ class TrainStep:
                 self._compiled[shapes_key] = self._build_flat(meta)
         fn = self._compiled[shapes_key]
         if self.is_pipeline:
-            loss, self.params, self.block_buffers, self.opt_state = fn(
+            (loss, self.params, self.block_buffers, self.opt_state,
+             self.last_metric_outs) = fn(
                 self.params, self.block_buffers, self.opt_state, lr, key,
                 in_arrays, lab_arrays)
         else:

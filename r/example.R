@@ -1,0 +1,31 @@
+# Drive paddle_tpu inference from R via reticulate
+# (reference parity: r/example/mobilenet.r — reticulate over the Python
+# predictor API).
+library(reticulate)
+
+# repo root on the Python path
+repo <- normalizePath(file.path(dirname(sys.frame(1)$ofile %||% "."), ".."))
+sys <- import("sys")
+sys$path$insert(0L, repo)
+
+paddle <- import("paddle_tpu")
+inference <- import("paddle_tpu.inference")
+
+# a jit.save / save_inference_model artifact prefix
+model_path <- Sys.getenv("PADDLE_TPU_MODEL", "/tmp/r_demo_model")
+
+config <- inference$Config(model_path)
+predictor <- inference$create_predictor(config)
+
+input_names <- predictor$get_input_names()
+handle <- predictor$get_input_handle(input_names[[1]])
+
+np <- import("numpy")
+x <- np$ones(c(1L, 4L), dtype = "float32")
+handle$copy_from_cpu(x)
+
+predictor$run()
+
+out_names <- predictor$get_output_names()
+out <- predictor$get_output_handle(out_names[[1]])$copy_to_cpu()
+print(out)

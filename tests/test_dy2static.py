@@ -323,3 +323,57 @@ class TestReviewRegressions:
         c = Child()
         out = c(paddle.to_tensor(np.ones((2,), np.float32)))
         np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+
+    def test_for_range_tensor_bound(self):
+        """for i in range(tensor) converts (reference loop_transformer
+        for->while) and produces identical accumulation."""
+
+        def f(x, n):
+            acc = paddle.zeros([2], "float32")
+            for i in range(n):
+                acc = acc + x * (float(1.0) + i)
+            return acc
+
+        conv = convert_function(f)
+        assert conv is not None
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        # python int bound: python-path while, same math as the original
+        np.testing.assert_allclose(
+            conv(x, 3).numpy(), f(x, 3).numpy(), rtol=1e-6)
+        # tensor bound under jit: lax.while_loop path
+        import jax
+
+        def run(nv):
+            n_t = paddle.to_tensor(nv)
+            return conv(x, n_t)._data
+
+        out = jax.jit(run)(np.asarray(3, np.int32))
+        np.testing.assert_allclose(np.asarray(out), f(x, 3).numpy(),
+                                   rtol=1e-5)
+
+    def test_for_over_layerlist_untouched(self):
+        """for blk in self.blocks must stay a Python loop (trace
+        unrolls it) — only range() iterations convert."""
+
+        class Stack(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.blocks = nn.LayerList([nn.Linear(3, 3)
+                                            for _ in range(2)])
+
+            def forward(self, x):
+                if paddle.sum(x) > 0:   # ensures counter > 0
+                    y = x * 1.0
+                else:
+                    y = x * 2.0
+                for blk in self.blocks:
+                    y = blk(y)
+                return paddle.sum(y)
+
+        paddle.seed(9)
+        net = Stack()
+        xv = paddle.to_tensor(np.ones((2, 3), np.float32))
+        eager = float(net.forward(xv).numpy())
+        st = to_static(net)
+        static = float(st(xv).numpy())
+        np.testing.assert_allclose(eager, static, rtol=1e-5)

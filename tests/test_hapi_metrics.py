@@ -78,3 +78,78 @@ class TestFlops:
         f1 = m.flops(input_size=[1, 8])
         f8 = m.flops(input_size=[8, 8])
         assert f8 >= 4 * f1, (f1, f8)
+
+
+class TestPipelineMetrics:
+    def test_gpipe_train_metrics(self):
+        """Prepared metrics work under the GPipe pipeline schedule
+        (review finding: they were silently dropped)."""
+        import jax
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.distributed.fleet.meta_parallel import \
+            PipelineLayer
+        from paddle_tpu.parallel.train_step import TrainStep
+
+        mesh = dist.build_mesh(dp=2, pp=4, devices=jax.devices()[:8])
+        dist.set_mesh(mesh)
+        try:
+            paddle.seed(0)
+            blocks = [nn.Sequential(nn.Linear(8, 8), nn.Tanh())
+                      for _ in range(4)]
+            pipe = PipelineLayer(pre=nn.Linear(8, 8), blocks=blocks,
+                                 post=nn.Linear(8, 2))
+            s = DistributedStrategy()
+            s.pipeline = True
+            s.pipeline_configs["accumulate_steps"] = 2
+            acc = metric.Accuracy()
+            opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=pipe.parameters())
+            st = TrainStep(pipe, opt, loss_fn=nn.CrossEntropyLoss(),
+                           strategy=s, donate=False, metrics=[acc])
+            rs = np.random.RandomState(0)
+            xb = rs.rand(8, 8).astype("float32")
+            yb = rs.randint(0, 2, (8,)).astype("int64")
+            st.step([xb], [yb])
+            assert st.last_metric_outs, "pipeline metrics dropped"
+            acc.update(*[np.asarray(v)
+                         for v in st.last_metric_outs[0]])
+            assert 0.0 <= acc.accumulate() <= 1.0
+        finally:
+            dist.set_mesh(None)
+
+    def test_1f1b_metrics_warns(self):
+        import warnings as _w
+        import jax
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.distributed.fleet.meta_parallel import \
+            PipelineLayer
+        from paddle_tpu.parallel.train_step import TrainStep
+
+        mesh = dist.build_mesh(dp=2, pp=4, devices=jax.devices()[:8])
+        dist.set_mesh(mesh)
+        try:
+            paddle.seed(0)
+            blocks = [nn.Sequential(nn.Linear(8, 8), nn.Tanh())
+                      for _ in range(4)]
+            pipe = PipelineLayer(pre=nn.Linear(8, 8), blocks=blocks,
+                                 post=nn.Linear(8, 2))
+            s = DistributedStrategy()
+            s.pipeline = True
+            s.pipeline_configs.update({"accumulate_steps": 2,
+                                       "schedule_mode": "1F1B"})
+            opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=pipe.parameters())
+            st = TrainStep(pipe, opt, loss_fn=nn.CrossEntropyLoss(),
+                           strategy=s, donate=False,
+                           metrics=[metric.Accuracy()])
+            rs = np.random.RandomState(0)
+            xb = rs.rand(8, 8).astype("float32")
+            yb = rs.randint(0, 2, (8,)).astype("int64")
+            with _w.catch_warnings(record=True) as rec:
+                _w.simplefilter("always")
+                st.step([xb], [yb])
+            assert any("1F1B" in str(r.message) for r in rec)
+        finally:
+            dist.set_mesh(None)

@@ -139,3 +139,31 @@ def test_uci_housing_trains(monkeypatch):
             opt.clear_grad()
         losses.append(float(loss.numpy()))
     assert losses[-1] < losses[0]
+
+
+def test_sequence_erase_matches_numpy():
+    from paddle_tpu.nn.functional.sequence import sequence_erase
+    x = np.array([[2, 1, 3, 1, 5], [1, 1, 2, 0, 0]], np.int64)
+    lens = np.array([5, 3], np.int64)
+    out, new_len = sequence_erase(x, [1], lengths=lens)
+    np.testing.assert_array_equal(new_len.numpy(), [3, 1])
+    np.testing.assert_array_equal(out.numpy()[0, :3], [2, 3, 5])
+    np.testing.assert_array_equal(out.numpy()[1, :1], [2])
+    assert (out.numpy()[0, 3:] == 0).all()
+
+
+def test_sequence_topk_avg_pooling_basic():
+    from paddle_tpu.nn.functional.sequence import sequence_topk_avg_pooling
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 3, 4, 6).astype(np.float32)
+    row_l = np.array([4, 2], np.int64)
+    col_l = np.array([6, 3], np.int64)
+    out = sequence_topk_avg_pooling(x, row_l, col_l, topks=[1, 3],
+                                    channel_num=3)
+    assert out.shape == [2, 4, 6]  # [B, R, C*K]
+    # numpy check for batch 0, channel 1, row 2, k=3
+    ref = np.sort(x[0, 1, 2])[::-1][:3].mean()
+    np.testing.assert_allclose(out.numpy()[0, 2, 1 * 2 + 1], ref,
+                               rtol=1e-5)
+    # masked rows are zero
+    assert (out.numpy()[1, 2:] == 0).all()
