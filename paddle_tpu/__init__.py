@@ -84,13 +84,15 @@ from . import utils  # noqa: E402,F401
 from .framework.io import save, load  # noqa: E402,F401
 from .static import (enable_static, disable_static,  # noqa: E402,F401
                      in_dynamic_mode)
+from .ops.manipulation import flip as reverse  # noqa: E402,F401
 from .static.program import in_static_mode  # noqa: E402,F401
 
 # ---- 1.x-compat aliases & auxiliary modules (reference __init__.py
 # DEFINE_ALIAS block + module imports) ------------------------------------
 from .ops.compat_ops import (  # noqa: E402,F401
     add_n, kron, broadcast_shape, rank, shape, is_tensor, is_empty,
-    unstack, slice, strided_slice, crop_tensor, fill_constant,
+    unstack, slice, strided_slice, crop_tensor, crop_tensor as crop,
+    fill_constant,
     create_global_var, create_parameter, has_inf, has_nan,
     elementwise_add, elementwise_sub, elementwise_mul, elementwise_div,
     elementwise_pow, elementwise_mod, elementwise_floordiv,
@@ -109,6 +111,7 @@ from . import onnx  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import version  # noqa: E402,F401
 from .batch import batch  # noqa: E402,F401
+from . import reader  # noqa: E402,F401
 from .nn.param_attr import ParamAttr  # noqa: E402,F401
 from .core.tensor import Tensor as VarBase  # noqa: E402,F401
 from .core.tensor import Tensor as LoDTensor  # noqa: E402,F401

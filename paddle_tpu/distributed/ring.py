@@ -4,7 +4,10 @@ NEW capability relative to the reference (SURVEY.md §5.7: absent there).
 Design: blockwise attention with online softmax; K/V blocks rotate around
 the 'sp' ring via ``lax.ppermute`` while each device keeps its Q shard, so
 peak memory is O(S_local²) and the sequence scales with the ring size.
-Causal masking uses the ring step to decide block visibility.
+Causal masking uses the ring step to decide block visibility.  The ring
+loop is a ``lax.scan``, so the whole kernel is reverse-mode
+differentiable — sequence-parallel TRAINING works through plain
+``jax.grad`` (the scan transpose rotates cotangents on the reverse ring).
 
 Layout convention (paddle): [batch, seq, heads, head_dim]; the seq axis is
 sharded over `axis`.
@@ -13,6 +16,8 @@ from __future__ import annotations
 
 import functools
 import math
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +55,9 @@ def _block_attn(q, k, v, scale, mask_mode):
     return o, m, l
 
 
+_ring_jit_cache: dict = {}
+
+
 def _ring_attention_local(q, k, v, axis, causal, scale):
     """Runs on each device inside shard_map; q/k/v are LOCAL seq shards."""
     n = lax.axis_size(axis)
@@ -63,28 +71,32 @@ def _ring_attention_local(q, k, v, axis, causal, scale):
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def body(step, carry):
+    def body(carry, step):
+        # lax.scan (NOT fori_loop): scan is reverse-mode differentiable,
+        # so ring attention TRAINS through plain jax.grad — the backward
+        # is the transposed scan with reverse ppermutes (fori_loop lowers
+        # to while_loop, which has no reverse rule)
         k_blk, v_blk, acc_o, acc_m, acc_l = carry
         # k_blk originated on device (my - step) mod n
         src = (my - step) % n
         if causal:
-            # visible iff src block is strictly earlier, or same (diag)
-            def do_full(args):
-                return _block_attn(*args, mask_mode=0)
-
-            def do_diag(args):
-                return _block_attn(*args, mask_mode=1)
-
-            def do_skip(args):
-                q_, k_, v_, sc = args
-                bb, ss, hh, dd = q_.shape
-                return (jnp.zeros((bb, hh, ss, dd), jnp.float32),
-                        jnp.full((bb, hh, ss), -1e30, jnp.float32),
-                        jnp.zeros((bb, hh, ss), jnp.float32))
-
-            idx = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
-            o, m, l = lax.switch(idx, [do_full, do_diag, do_skip],
-                                 (q, k_blk, v_blk, scale))
+            # visible iff src block is strictly earlier, or same (diag).
+            # compute full + diag variants and select — cheaper than
+            # lax.switch under vjp (both run anyway in backward) and
+            # keeps every branch differentiable
+            o_f, m_f, l_f = _block_attn(q, k_blk, v_blk, scale,
+                                        mask_mode=0)
+            o_d, m_d, l_d = _block_attn(q, k_blk, v_blk, scale,
+                                        mask_mode=1)
+            bb, hh, ss = m_f.shape
+            zero_o = jnp.zeros_like(o_f)
+            skip_m = jnp.full_like(m_f, -1e30)
+            zero_l = jnp.zeros_like(l_f)
+            is_full = (src < my)
+            is_diag = (src == my)
+            o = jnp.where(is_full, o_f, jnp.where(is_diag, o_d, zero_o))
+            m = jnp.where(is_full, m_f, jnp.where(is_diag, m_d, skip_m))
+            l = jnp.where(is_full, l_f, jnp.where(is_diag, l_d, zero_l))
         else:
             o, m, l = _block_attn(q, k_blk, v_blk, scale, mask_mode=0)
 
@@ -95,10 +107,10 @@ def _ring_attention_local(q, k, v, axis, causal, scale):
         new_o = acc_o * alpha[..., None] + o * beta[..., None]
         k_next = lax.ppermute(k_blk, axis, perm)
         v_next = lax.ppermute(v_blk, axis, perm)
-        return (k_next, v_next, new_o, new_m, new_l)
+        return (k_next, v_next, new_o, new_m, new_l), None
 
     carry = (k, v, acc_o, acc_m, acc_l)
-    carry = lax.fori_loop(0, n, body, carry)
+    carry, _ = lax.scan(body, carry, jnp.arange(n))
     _, _, acc_o, _, acc_l = carry
     out = acc_o / jnp.maximum(acc_l[..., None], 1e-30)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # [B, S, H, D]
@@ -122,15 +134,46 @@ def ring_attention(query, key, value, axis="sp", causal=False, scale=None,
         from ..nn.functional.attention import _reference_attention
         return Tensor(_reference_attention(q, k, v, None, scale, causal))
 
-    spec = P(None, axis, None, None)
-    sharding = jax.sharding.NamedSharding(mesh, spec)
-    q, k, v = (jax.device_put(a, sharding) for a in (q, k, v))
-    fn = shard_map(
-        functools.partial(_ring_attention_local, axis=axis, causal=causal,
-                          scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
-    return Tensor(fn(q, k, v))
+    if not isinstance(q, jax.core.Tracer):
+        # eager: place the seq shards; batch/heads replicated
+        spec = P(None, axis, None, None)
+        sharding = jax.sharding.NamedSharding(mesh, spec)
+        q, k, v = (jax.device_put(a, sharding) for a in (q, k, v))
+    else:
+        # under jit (TrainStep): keep the surrounding batch (dp/sharding)
+        # and head (mp) shardings — declaring them replicated would force
+        # an all-gather at the shard_map boundary.  Only claim an axis
+        # when the dim actually divides by it (small eager-in-grad tests
+        # use batches below the dp degree).
+        batch_axes = tuple(a for a in mesh_mod.DATA_AXES
+                           if mesh.shape.get(a, 1) > 1)
+        bsz = int(np.prod([mesh.shape[a] for a in batch_axes])) \
+            if batch_axes else 1
+        if not batch_axes or q.shape[0] % bsz != 0:
+            batch_axes = None
+        mp_n = mesh.shape.get("mp", 1)
+        head_ax = "mp" if mp_n > 1 and q.shape[2] % mp_n == 0 else None
+        spec = P(batch_axes, axis, head_ax, None)
+        # concrete operands mixed into a traced call (e.g. constant K/V
+        # under eager jax.grad) may be committed to one device; place
+        # them on the mesh explicitly
+        sharding = jax.sharding.NamedSharding(mesh, spec)
+        q, k, v = (a if isinstance(a, jax.core.Tracer)
+                   else jax.device_put(np.asarray(a), sharding)
+                   for a in (q, k, v))
+    # jit wrapper (cached by config: jit's own cache keys on function
+    # identity, so a fresh wrapper per call would recompile the ring
+    # kernel every invocation): places single-device/host operands onto
+    # the mesh automatically. Under an outer pjit this inlines.
+    key = (id(mesh), axis, bool(causal), scale, spec)
+    if key not in _ring_jit_cache:
+        fn = shard_map(
+            functools.partial(_ring_attention_local, axis=axis,
+                              causal=causal, scale=scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        _ring_jit_cache[key] = jax.jit(fn)
+    return Tensor(_ring_jit_cache[key](q, k, v))
 
 
 def ulysses_attention(query, key, value, axis="sp", causal=False,

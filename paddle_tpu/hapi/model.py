@@ -119,6 +119,8 @@ class Model:
             return None
         key = tuple((tuple(a.shape), str(a.dtype)) for a in arrays) + (
             len(params), len(buffers))
+        if self._eval_cache.get(key) == "untraceable":
+            return None  # don't pay a failing re-trace per batch
         if key not in self._eval_cache:
             pn, bn = sorted(params), sorted(buffers)
 
@@ -135,6 +137,9 @@ class Model:
             out = fwd([params[k] for k in pn],
                       [buffers[k] for k in bn], arrays)
         except Exception:
+            # remember the failure: jax does not cache failed traces, so
+            # each batch would re-pay the full trace before falling back
+            self._eval_cache[key] = "untraceable"
             return None
         return _wrap_tree(out)
 

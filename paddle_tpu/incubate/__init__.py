@@ -6,3 +6,57 @@ from . import checkpoint  # noqa: F401
 # reference: python/paddle/incubate/__init__.py exposes optimizer/reader
 from . import optimizer, reader  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+
+class LayerHelper:
+    """reference: fluid/layer_helper.py — op-assembly helper used by
+    custom-layer authors.  The TPU build has no OpDesc assembly; the
+    helper keeps the create_parameter/append_activation surface that
+    custom layers actually use, backed by the Layer machinery."""
+
+    _param_registry: dict = {}
+
+    def __init__(self, layer_type, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+
+    def create_parameter(self, attr=None, shape=None, dtype="float32",
+                         is_bias=False, default_initializer=None):
+        """Create (or, for a NAMED attr, fetch the existing) parameter.
+
+        The reference memoizes by block variable name, so a per-forward
+        ``create_parameter`` with a named attr reuses one weight.  An
+        UNNAMED attr creates a fresh parameter each call — call it at
+        layer-construction time (or name it), never per forward, or the
+        weight silently reinitializes every step."""
+        name = getattr(attr, "name", None) if attr is not None else None
+        key = (self.layer_type, name, tuple(shape or ()), str(dtype),
+               bool(is_bias))
+        if name is not None and key in LayerHelper._param_registry:
+            return LayerHelper._param_registry[key]
+        from ..nn.layer.base import Layer
+        holder = Layer()
+        p = holder.create_parameter(
+            shape, attr=attr, dtype=dtype, is_bias=is_bias,
+            default_initializer=default_initializer)
+        if name is not None:
+            LayerHelper._param_registry[key] = p
+        return p
+
+    def append_activation(self, x, act=None):
+        if act is None:
+            act = self.kwargs.get("act")
+        if act is None:
+            return x
+        from ..nn import functional as F
+        return getattr(F, act)(x)
+
+
+def load_op_library(path):
+    """reference: fluid.load_op_library — dlopen a custom C++ op library.
+    Custom ops on TPU are jax-traceable Python functions (wrap with
+    core.dispatch.primitive); there is no kernel .so to load."""
+    raise NotImplementedError(
+        "load_op_library: custom C++ op libraries have no analogue under "
+        "XLA — implement the op as a jax function and register it with "
+        "paddle_tpu.core.dispatch.primitive")

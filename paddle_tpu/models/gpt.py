@@ -68,13 +68,24 @@ class GPTEmbeddings(nn.Layer):
 class GPTAttention(nn.Layer):
     """Causal self-attention with fused QKV (one MXU matmul)."""
 
-    def __init__(self, hidden_size, num_heads, dropout=0.1, use_mp=False):
+    def __init__(self, hidden_size, num_heads, dropout=0.1, use_mp=False,
+                 use_sp=False):
         super().__init__()
         self.num_heads = num_heads
         self.head_dim = hidden_size // num_heads
         self.hidden_size = hidden_size
         self.dropout = dropout
         self.use_mp = use_mp
+        # sequence parallelism: attention dropout is skipped under sp
+        # (the ring kernel has no per-block dropout)
+        self.use_sp = use_sp
+        if use_sp and dropout:
+            import warnings
+            warnings.warn(
+                "GPTAttention(use_sp=True): attention-probability "
+                f"dropout ({dropout}) is skipped under sequence "
+                "parallelism (the ring kernel has no per-block dropout); "
+                "residual/embedding dropout still applies")
         init = nn.ParamAttr(initializer=I.Normal(0.0, 0.02))
         if use_mp:
             # Einsum-form head-parallel projections: weights carry the head
@@ -122,9 +133,17 @@ class GPTAttention(nn.Layer):
             k = concat([cache[0], k], axis=1)
             v = concat([cache[1], v], axis=1)
             cache = (k, v)
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=self.dropout,
-            training=self.training)
+        if self.use_sp and cache is None:
+            # sequence/context parallelism: blockwise ring attention over
+            # the 'sp' mesh axis — seq stays sharded end-to-end, K/V
+            # blocks rotate on the ICI ring (differentiable: the ring is
+            # a lax.scan).  NEW capability vs the reference (§5.7).
+            from ..distributed.ring import ring_attention
+            out = ring_attention(q, k, v, axis="sp", causal=True)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=self.dropout,
+                training=self.training)
         if self.use_mp:
             from ..ops import einsum
             # contraction over (H, hd): XLA turns the 'mp'-sharded H
@@ -169,10 +188,11 @@ class GPTBlock(nn.Layer):
 
     def __init__(self, hidden_size, num_heads, dropout=0.1, use_mp=False,
                  use_recompute=False, moe_experts=0,
-                 recompute_policy=None):
+                 recompute_policy=None, use_sp=False):
         super().__init__()
         self.ln1 = nn.LayerNorm(hidden_size)
-        self.attn = GPTAttention(hidden_size, num_heads, dropout, use_mp)
+        self.attn = GPTAttention(hidden_size, num_heads, dropout, use_mp,
+                                 use_sp=use_sp)
         self.ln2 = nn.LayerNorm(hidden_size)
         if moe_experts:
             from ..distributed.moe import MoELayer
@@ -226,7 +246,8 @@ class GPTModel(nn.Layer):
     def __init__(self, num_layers=12, hidden_size=768, num_heads=12,
                  vocab_size=50304, max_position=1024, dropout=0.1,
                  use_mp=False, use_recompute=False, moe_experts=0,
-                 moe_every=2, fused_loss=False, recompute_policy=None):
+                 moe_every=2, fused_loss=False, recompute_policy=None,
+                 use_sp=False):
         super().__init__()
         self.fused_loss = fused_loss
         self.embeddings = GPTEmbeddings(vocab_size, hidden_size,
@@ -242,7 +263,8 @@ class GPTModel(nn.Layer):
                                   if moe_experts
                                   and (i + 1) % moe_every == 0
                                   else 0),
-                     recompute_policy=recompute_policy)
+                     recompute_policy=recompute_policy,
+                     use_sp=use_sp)
             for i in range(num_layers)])
         self.head = GPTLMHead(hidden_size, vocab_size, use_mp)
 

@@ -438,3 +438,38 @@ class TestTensorModelMethodParity:
         assert not net.training
         m.mode = "train"
         assert net.training
+
+
+class TestIncubateHelpers:
+    def test_layer_helper_create_parameter_and_activation(self):
+        from paddle_tpu.incubate import LayerHelper
+        h = LayerHelper("custom_fc", act="relu")
+        w = h.create_parameter(shape=[3, 4], dtype="float32")
+        assert list(w.shape) == [3, 4]
+        out = h.append_activation(paddle.to_tensor(
+            np.array([-1.0, 2.0], "float32")))
+        np.testing.assert_allclose(out.numpy(), [0.0, 2.0])
+
+    def test_load_op_library_guides_to_primitive(self):
+        from paddle_tpu.incubate import load_op_library
+        with pytest.raises(NotImplementedError, match="primitive"):
+            load_op_library("/tmp/libfoo.so")
+
+
+class TestReaderNamespace:
+    def test_reader_reachable_from_root(self):
+        assert hasattr(paddle, "reader")
+        assert callable(paddle.reader.shuffle)
+
+    def test_layer_helper_named_attr_memoizes(self):
+        """A NAMED attr returns the same Parameter across calls
+        (reference: block-variable reuse); unnamed stays fresh."""
+        from paddle_tpu.incubate import LayerHelper
+        h = LayerHelper("memo_fc")
+        attr = nn.ParamAttr(name="memo_fc_w")
+        p1 = h.create_parameter(attr=attr, shape=[2, 2])
+        p2 = h.create_parameter(attr=attr, shape=[2, 2])
+        assert p1 is p2
+        q1 = h.create_parameter(shape=[2, 2])
+        q2 = h.create_parameter(shape=[2, 2])
+        assert q1 is not q2

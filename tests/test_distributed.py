@@ -504,3 +504,68 @@ def test_pipeline_recompute_matches_plain():
         losses[remat] = vals
     np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
     assert losses[False][-1] < losses[False][0]
+
+
+class TestRingAttentionTraining:
+    """Round-2: the ring loop is a lax.scan, so ring attention is
+    reverse-differentiable — sequence parallelism trains (round-1 was
+    forward-only)."""
+
+    def test_grads_match_dense(self):
+        import jax.numpy as jnp
+        from paddle_tpu.distributed.ring import ring_attention
+        from paddle_tpu.nn.functional.attention import \
+            _reference_attention
+        mesh = dist.build_mesh(dp=4, sp=2)
+        dist.set_mesh(mesh)
+        try:
+            rs = np.random.RandomState(0)
+            q = rs.randn(2, 16, 2, 8).astype(np.float32)
+            k = rs.randn(2, 16, 2, 8).astype(np.float32)
+            v = rs.randn(2, 16, 2, 8).astype(np.float32)
+            for causal in (False, True):
+                def loss_ring(qq):
+                    return jnp.sum(ring_attention(
+                        qq, k, v, axis="sp", causal=causal)._data ** 2)
+
+                def loss_ref(qq):
+                    return jnp.sum(_reference_attention(
+                        qq, jnp.asarray(k), jnp.asarray(v), None, None,
+                        causal) ** 2)
+
+                g_ring = jax.grad(loss_ring)(jnp.asarray(q))
+                g_ref = jax.grad(loss_ref)(jnp.asarray(q))
+                np.testing.assert_allclose(np.asarray(g_ring),
+                                           np.asarray(g_ref),
+                                           rtol=2e-3, atol=2e-4)
+        finally:
+            dist.set_mesh(None)
+
+    def test_sp_model_trains_and_matches_dense(self):
+        from paddle_tpu.models import GPTModel, GPTPretrainingCriterion
+        ids = np.random.RandomState(0).randint(0, 128, (4, 33)) \
+            .astype(np.int64)
+
+        def run(use_sp, sp):
+            mesh = dist.build_mesh(dp=8 // sp, sp=sp)
+            dist.set_mesh(mesh)
+            paddle_tpu.seed(0)
+            model = GPTModel.from_config("tiny", dropout=0.0,
+                                         use_sp=use_sp)
+            opt = optimizer.AdamW(learning_rate=1e-3,
+                                  parameters=model.parameters())
+            step = TrainStep(model, opt,
+                             loss_fn=GPTPretrainingCriterion(),
+                             donate=False)
+            return [float(step.step([ids[:, :-1]],
+                                    [ids[:, 1:]]).numpy())
+                    for _ in range(3)]
+
+        try:
+            sp_losses = run(True, 4)
+            dense_losses = run(False, 1)
+            assert sp_losses[-1] < sp_losses[0]
+            np.testing.assert_allclose(sp_losses, dense_losses,
+                                       rtol=2e-3, atol=2e-3)
+        finally:
+            dist.set_mesh(None)

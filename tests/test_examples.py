@@ -18,6 +18,8 @@ SCRIPTS = [
     "generate_text.py",
     "train_gpt2.py",
     "distributed_hybrid.py",
+    "pipeline_1f1b.py",
+    "ragged_text_buckets.py",
 ]
 
 

@@ -1,0 +1,67 @@
+"""paddle.reader decorator combinators (reference:
+python/paddle/reader/decorator.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import reader
+
+
+def _r(n=10):
+    def rd():
+        return iter(range(n))
+    return rd
+
+
+def test_cache_and_firstn():
+    calls = []
+
+    def rd():
+        calls.append(1)
+        return iter(range(5))
+
+    c = reader.cache(rd)
+    assert list(c()) == list(range(5))
+    assert list(c()) == list(range(5))
+    assert len(calls) == 1
+    assert list(reader.firstn(_r(), 3)()) == [0, 1, 2]
+
+
+def test_map_chain_compose():
+    assert list(reader.map_readers(lambda a, b: a + b, _r(3), _r(3))()) \
+        == [0, 2, 4]
+    assert list(reader.chain(_r(2), _r(2))()) == [0, 1, 0, 1]
+    out = list(reader.compose(_r(2), _r(2))())
+    assert out == [(0, 0), (1, 1)]
+    with pytest.raises(reader.ComposeNotAligned):
+        list(reader.compose(_r(2), _r(3))())
+    # misaligned OK when check_alignment=False
+    assert list(reader.compose(_r(2), _r(3),
+                               check_alignment=False)()) == [(0, 0),
+                                                             (1, 1)]
+
+
+def test_shuffle_buffered_complete():
+    import random
+    random.seed(0)
+    out = sorted(reader.shuffle(_r(20), 7)())
+    assert out == list(range(20))
+    assert sorted(reader.buffered(_r(20), 4)()) == list(range(20))
+
+
+def test_xmap_ordered_and_unordered():
+    sq = reader.xmap_readers(lambda x: x * x, _r(16), 4, 8, order=True)
+    assert list(sq()) == [i * i for i in range(16)]
+    sq2 = reader.xmap_readers(lambda x: x * x, _r(16), 4, 8, order=False)
+    assert sorted(sq2()) == sorted(i * i for i in range(16))
+
+
+def test_multiprocess_reader_merges():
+    out = sorted(reader.multiprocess_reader([_r(5), _r(5)])())
+    assert out == sorted(list(range(5)) * 2)
+
+
+def test_batch_with_reader_pipeline():
+    batched = paddle.batch(reader.shuffle(_r(10), 10), batch_size=4)
+    sizes = [len(b) for b in batched()]
+    assert sizes == [4, 4, 2]

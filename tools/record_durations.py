@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Record per-file test durations for split_tests.py.
+
+    python tools/record_durations.py  # runs the fast suite, writes
+                                      # tools/test_durations.json
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+
+def main():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests_dir = os.path.join(root, "tests")
+    files = sorted(f for f in os.listdir(tests_dir)
+                   if f.startswith("test_") and f.endswith(".py"))
+    out = {}
+    for f in files:
+        t0 = time.perf_counter()
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest",
+             os.path.join("tests", f), "-q", "-m", "not slow"],
+            cwd=root, capture_output=True, text=True)
+        out[f] = round(time.perf_counter() - t0, 2)
+        status = "ok" if r.returncode in (0, 5) else "FAIL"
+        print(f"{f}: {out[f]}s {status}", flush=True)
+    with open(os.path.join(root, "tools", "test_durations.json"),
+              "w") as fh:
+        json.dump(out, fh, indent=1, sort_keys=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""CI test sharding (reference parity: tools/parallel_UT_rule.py — the
+reference partitions its 916-file suite into parallel CI buckets).
+
+Usage:  python tools/split_tests.py NUM_SHARDS SHARD_INDEX
+Prints the test files for that shard, balanced by historical duration
+when tools/test_durations.json exists (write it with
+`pytest --store-durations` style timing or the helper below), else by
+file size as a proxy.
+
+    pytest $(python tools/split_tests.py 4 0)
+"""
+import json
+import os
+import sys
+
+
+def main():
+    n = int(sys.argv[1])
+    idx = int(sys.argv[2])
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests_dir = os.path.join(root, "tests")
+    files = sorted(f for f in os.listdir(tests_dir)
+                   if f.startswith("test_") and f.endswith(".py"))
+    durations_path = os.path.join(root, "tools", "test_durations.json")
+    if os.path.exists(durations_path):
+        with open(durations_path) as fh:
+            durations = json.load(fh)
+        weight = {f: float(durations.get(f, 1.0)) for f in files}
+    else:
+        weight = {f: os.path.getsize(os.path.join(tests_dir, f))
+                  for f in files}
+    # longest-processing-time greedy balance
+    shards = [[] for _ in range(n)]
+    loads = [0.0] * n
+    for f in sorted(files, key=lambda f: -weight[f]):
+        k = loads.index(min(loads))
+        shards[k].append(f)
+        loads[k] += weight[f]
+    print(" ".join(os.path.join("tests", f) for f in sorted(shards[idx])))
+
+
+if __name__ == "__main__":
+    main()
