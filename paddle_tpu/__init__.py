@@ -8,6 +8,19 @@ registries) is replaced by XLA compilation over device meshes.
 """
 from __future__ import annotations
 
+import os as _os
+
+# Honor an explicit platform selection BEFORE any jax backend init.  The
+# axon TPU plugin ignores the JAX_PLATFORMS env var, so subprocesses
+# (examples, CI, DataLoader-adjacent tools) that must stay off the TPU —
+# e.g. while another process holds the chip — set PADDLE_TPU_PLATFORM=cpu
+# and this config (which axon does respect) applies it.
+if _os.environ.get("PADDLE_TPU_PLATFORM"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms",
+                       _os.environ["PADDLE_TPU_PLATFORM"])
+
 __version__ = "2.0.0-tpu"  # tracks the reference's 2.0 API surface
 
 # -- core ----------------------------------------------------------------

@@ -40,6 +40,14 @@ def seed(s: int):
     with _lock:
         _seed = int(s)
         _key = jax.random.key(_seed)
+    # a fresh seed promises fresh initialization: drop memoized named
+    # parameters (incubate.LayerHelper) so rebuilt models don't silently
+    # reuse trained weights from a previous model's life
+    try:
+        from ..incubate import LayerHelper
+        LayerHelper.clear_registry()
+    except ImportError:
+        pass
     return _seed
 
 

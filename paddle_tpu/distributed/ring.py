@@ -55,7 +55,12 @@ def _block_attn(q, k, v, scale, mask_mode):
     return o, m, l
 
 
-_ring_jit_cache: dict = {}
+# per-mesh jit cache: WeakKeyDictionary so dropping a Mesh releases its
+# compiled ring executables (an id()-keyed dict would pin every mesh a
+# test suite or notebook ever built)
+import weakref
+
+_ring_jit_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 def _ring_attention_local(q, k, v, axis, causal, scale):
@@ -88,7 +93,6 @@ def _ring_attention_local(q, k, v, axis, causal, scale):
                                         mask_mode=0)
             o_d, m_d, l_d = _block_attn(q, k_blk, v_blk, scale,
                                         mask_mode=1)
-            bb, hh, ss = m_f.shape
             zero_o = jnp.zeros_like(o_f)
             skip_m = jnp.full_like(m_f, -1e30)
             zero_l = jnp.zeros_like(l_f)
@@ -165,15 +169,16 @@ def ring_attention(query, key, value, axis="sp", causal=False, scale=None,
     # identity, so a fresh wrapper per call would recompile the ring
     # kernel every invocation): places single-device/host operands onto
     # the mesh automatically. Under an outer pjit this inlines.
-    key = (id(mesh), axis, bool(causal), scale, spec)
-    if key not in _ring_jit_cache:
+    per_mesh = _ring_jit_cache.setdefault(mesh, {})
+    key = (axis, bool(causal), scale, spec)
+    if key not in per_mesh:
         fn = shard_map(
             functools.partial(_ring_attention_local, axis=axis,
                               causal=causal, scale=scale),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)
-        _ring_jit_cache[key] = jax.jit(fn)
-    return Tensor(_ring_jit_cache[key](q, k, v))
+        per_mesh[key] = jax.jit(fn)
+    return Tensor(per_mesh[key](q, k, v))
 
 
 def ulysses_attention(query, key, value, axis="sp", causal=False,

@@ -137,10 +137,15 @@ class Model:
             out = fwd([params[k] for k in pn],
                       [buffers[k] for k in bn], arrays)
         except Exception:
-            # remember the failure: jax does not cache failed traces, so
-            # each batch would re-pay the full trace before falling back
-            self._eval_cache[key] = "untraceable"
+            # mark untraceable ONLY if this shape never succeeded — a
+            # transient runtime failure (device busy/OOM) on a working
+            # compiled fn must not permanently disable the jit path
+            if key not in getattr(self, "_eval_ok", set()):
+                self._eval_cache[key] = "untraceable"
             return None
+        if not hasattr(self, "_eval_ok"):
+            self._eval_ok = set()
+        self._eval_ok.add(key)
         return _wrap_tree(out)
 
     def eval_batch(self, inputs, labels=None):
@@ -323,17 +328,86 @@ class Model:
         return self.network.parameters(*args, **kwargs)
 
     def summary(self, input_size=None, dtype=None):
+        """Layer-by-layer summary (reference: hapi summary.py prints
+        Layer (type), Output Shape, Param #).  With ``input_size`` a
+        shape-only eval-mode forward (jax.eval_shape — no FLOPs run)
+        records every sublayer's output shape; without it, falls back to
+        the parameter table."""
         total = 0
-        lines = ["-" * 60]
+        if input_size is not None:
+            import jax
+            import jax.numpy as jnp
+            from ..jit import functional_call
+
+            shapes = input_size if isinstance(input_size[0],
+                                              (list, tuple)) \
+                else [input_size]
+            dt = jnp.dtype(dtype or "float32")
+            net = self.network
+            records = []
+            handles = []
+
+            def mk_hook(name, layer):
+                def hook(lyr, inputs, outputs):
+                    out = outputs[0] if isinstance(outputs, (tuple, list)) \
+                        else outputs
+                    shape = list(getattr(out, "shape", []) or [])
+                    n_params = sum(int(np.prod(p.shape))
+                                   for p in lyr.parameters(
+                                       include_sublayers=False))
+                    records.append((name, type(lyr).__name__, shape,
+                                    n_params))
+                    return outputs
+                return hook
+
+            for name, sub in net.named_sublayers():
+                handles.append(sub.register_forward_post_hook(
+                    mk_hook(name, sub)))
+            try:
+                params = {k: p._data
+                          for k, p in net.named_parameters()}
+                buffers = {k: b._data for k, b in net.named_buffers()
+                           if b is not None}
+
+                def fwd(p, b, xs):
+                    out, _ = functional_call(net, p, b, xs,
+                                             training=False)
+                    return out
+
+                jax.eval_shape(fwd, params, buffers,
+                               [jax.ShapeDtypeStruct(tuple(s), dt)
+                                for s in shapes])
+            finally:
+                for h in handles:
+                    h.remove()
+            lines = ["-" * 76,
+                     f"{'Layer (type)':<36}{'Output Shape':<24}"
+                     f"{'Param #':>12}",
+                     "=" * 76]
+            for name, tname, shape, n_params in records:
+                lines.append(f"{name + ' (' + tname + ')':<36}"
+                             f"{str(shape):<24}{n_params:>12,}")
+        else:
+            # no input_size: the per-parameter table
+            lines = ["-" * 76,
+                     f"{'Parameter':<44}{'Shape':<20}{'Count':>12}",
+                     "=" * 76]
+            for name, p in self.network.named_parameters():
+                lines.append(f"{name:<44}{str(p.shape):<20}"
+                             f"{int(np.prod(p.shape)):>12,}")
         for name, p in self.network.named_parameters():
-            n = int(np.prod(p.shape))
-            total += n
-            lines.append(f"{name:<44} {str(p.shape):<20} {n}")
-        lines.append("-" * 60)
-        lines.append(f"Total params: {total:,}")
+            total += int(np.prod(p.shape))
+        trainable = sum(int(np.prod(p.shape))
+                        for p in self.network.parameters()
+                        if getattr(p, "trainable", True))
+        lines += ["=" * 76,
+                  f"Total params: {total:,}",
+                  f"Trainable params: {trainable:,}",
+                  f"Non-trainable params: {total - trainable:,}",
+                  "-" * 76]
         text = "\n".join(lines)
         print(text)
-        return {"total_params": total}
+        return {"total_params": total, "trainable_params": trainable}
 
     def flops(self, inputs=None, input_size=None, dtype="float32",
               print_detail=False):

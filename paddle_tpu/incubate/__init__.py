@@ -14,7 +14,14 @@ class LayerHelper:
     helper keeps the create_parameter/append_activation surface that
     custom layers actually use, backed by the Layer machinery."""
 
+    # process-level memo for NAMED attrs (the reference scopes this to a
+    # program/block; here paddle.seed() clears it so model re-creation
+    # under a fresh seed reinitializes — see core/rng.py seed hook)
     _param_registry: dict = {}
+
+    @classmethod
+    def clear_registry(cls):
+        cls._param_registry.clear()
 
     def __init__(self, layer_type, **kwargs):
         self.layer_type = layer_type

@@ -102,6 +102,10 @@ def buffered(reader, size):
     class _End:
         pass
 
+    class _Err:
+        def __init__(self, e):
+            self.e = e
+
     def buffered_reader():
         q = queue_mod.Queue(maxsize=size)
 
@@ -109,8 +113,10 @@ def buffered(reader, size):
             try:
                 for item in reader():
                     q.put(item)
-            finally:
-                q.put(_End)
+            except Exception as e:  # surface in the consumer, not the
+                q.put(_Err(e))      # daemon thread (silent truncation)
+                return
+            q.put(_End)
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
@@ -118,6 +124,8 @@ def buffered(reader, size):
             item = q.get()
             if item is _End:
                 return
+            if isinstance(item, _Err):
+                raise item.e
             yield item
 
     return buffered_reader
@@ -142,9 +150,14 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         out_q = queue_mod.Queue(buffer_size)
         END = object()
 
+        ERR = []
+
         def feed():
-            for i, item in enumerate(reader()):
-                in_q.put((i, item))
+            try:
+                for i, item in enumerate(reader()):
+                    in_q.put((i, item))
+            except Exception as e:
+                ERR.append(e)
             for _ in range(process_num):
                 in_q.put(END)
 
@@ -155,7 +168,12 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     out_q.put(END)
                     return
                 i, item = job
-                out_q.put((i, mapper(item)))
+                try:
+                    out_q.put((i, mapper(item)))
+                except Exception as e:
+                    ERR.append(e)
+                    out_q.put(END)
+                    return
 
         threading.Thread(target=feed, daemon=True).start()
         for _ in range(process_num):
@@ -168,22 +186,27 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     finished += 1
                     continue
                 yield res[1]
+            if ERR:
+                raise ERR[0]
             return
         pending = {}
         next_i = 0
-        while finished < process_num or pending:
-            if next_i in pending:
-                yield pending.pop(next_i)
-                next_i += 1
-                continue
+        # drain until every worker ENDed — never block on results a dead
+        # worker can no longer produce
+        while finished < process_num:
             res = out_q.get()
             if res is END:
                 finished += 1
                 continue
             pending[res[0]] = res[1]
+            while next_i in pending:
+                yield pending.pop(next_i)
+                next_i += 1
         while next_i in pending:
             yield pending.pop(next_i)
             next_i += 1
+        if ERR:
+            raise ERR[0]
 
     return xreader
 
@@ -197,12 +220,15 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
         q = queue_mod.Queue(queue_size)
         END = object()
 
+        errors = []
+
         def pump(r):
             try:
                 for item in r():
                     q.put(item)
-            finally:
-                q.put(END)
+            except Exception as e:
+                errors.append(e)
+            q.put(END)
 
         for r in readers:
             threading.Thread(target=pump, args=(r,), daemon=True).start()
@@ -213,5 +239,7 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
                 finished += 1
                 continue
             yield item
+        if errors:
+            raise errors[0]
 
     return merged_reader

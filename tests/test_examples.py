@@ -28,6 +28,9 @@ def test_example_runs(script):
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
+        # axon ignores JAX_PLATFORMS; the framework honors this one in
+        # code, keeping example subprocesses off the (possibly busy) TPU
+        PADDLE_TPU_PLATFORM="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=8",
         PADDLE_TPU_SYNTH_N="96",
     )
