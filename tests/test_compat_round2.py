@@ -473,3 +473,12 @@ class TestReaderNamespace:
         q1 = h.create_parameter(shape=[2, 2])
         q2 = h.create_parameter(shape=[2, 2])
         assert q1 is not q2
+
+    def test_layer_helper_registry_cleared_by_seed(self):
+        from paddle_tpu.incubate import LayerHelper
+        h = LayerHelper("seed_fc")
+        attr = nn.ParamAttr(name="seed_fc_w")
+        p1 = h.create_parameter(attr=attr, shape=[2, 2])
+        paddle.seed(123)
+        p2 = h.create_parameter(attr=attr, shape=[2, 2])
+        assert p1 is not p2  # fresh seed => fresh parameters

@@ -65,3 +65,25 @@ def test_batch_with_reader_pipeline():
     batched = paddle.batch(reader.shuffle(_r(10), 10), batch_size=4)
     sizes = [len(b) for b in batched()]
     assert sizes == [4, 4, 2]
+
+
+def test_worker_exceptions_propagate():
+    def bad():
+        yield 1
+        raise ValueError("reader boom")
+
+    with pytest.raises(ValueError, match="reader boom"):
+        list(reader.buffered(bad, 4)())
+    with pytest.raises(ValueError, match="reader boom"):
+        list(reader.multiprocess_reader([bad])())
+
+    def bad_mapper(x):
+        if x == 5:
+            raise ValueError("mapper boom")
+        return x
+
+    with pytest.raises(ValueError, match="mapper boom"):
+        list(reader.xmap_readers(bad_mapper, _r(10), 2, 4, order=True)())
+    with pytest.raises(ValueError, match="mapper boom"):
+        list(reader.xmap_readers(bad_mapper, _r(10), 2, 4,
+                                 order=False)())
