@@ -55,12 +55,52 @@ def _block_attn(q, k, v, scale, mask_mode):
     return o, m, l
 
 
-# per-mesh jit cache: WeakKeyDictionary so dropping a Mesh releases its
-# compiled ring executables (an id()-keyed dict would pin every mesh a
-# test suite or notebook ever built)
-import weakref
+# Bounded LRU of jitted shard_map calls.  The compiled fn closes over the
+# Mesh (shard_map), so weak keying cannot work — instead cap the entry
+# count; eviction drops the executable AND its mesh reference together.
+from collections import OrderedDict
 
-_ring_jit_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_RING_CACHE_CAP = 16
+_ring_jit_cache: "OrderedDict" = OrderedDict()
+
+
+def _cached_sp_call(mesh, subkey, build):
+    key = (id(mesh), subkey)
+    if key in _ring_jit_cache:
+        _ring_jit_cache.move_to_end(key)
+        return _ring_jit_cache[key][1]
+    fn = build()
+    _ring_jit_cache[key] = (mesh, fn)  # keep mesh alive while cached
+    while len(_ring_jit_cache) > _RING_CACHE_CAP:
+        _ring_jit_cache.popitem(last=False)
+    return fn
+
+
+def _sp_place_and_spec(mesh, axis, q, k, v, claim_mp_heads):
+    """Shared placement logic for the sequence-parallel drivers:
+    tracer-aware specs (keep surrounding batch/mp shardings under pjit,
+    only when the dims divide) + explicit mesh placement of concrete
+    operands mixed into a traced call."""
+    if not isinstance(q, jax.core.Tracer):
+        spec = P(None, axis, None, None)
+        sharding = jax.sharding.NamedSharding(mesh, spec)
+        q, k, v = (jax.device_put(a, sharding) for a in (q, k, v))
+        return spec, q, k, v
+    batch_axes = tuple(a for a in mesh_mod.DATA_AXES
+                       if mesh.shape.get(a, 1) > 1)
+    bsz = int(np.prod([mesh.shape[a] for a in batch_axes])) \
+        if batch_axes else 1
+    if not batch_axes or q.shape[0] % bsz != 0:
+        batch_axes = None
+    mp_n = mesh.shape.get("mp", 1)
+    head_ax = "mp" if (claim_mp_heads and mp_n > 1
+                       and q.shape[2] % mp_n == 0) else None
+    spec = P(batch_axes, axis, head_ax, None)
+    sharding = jax.sharding.NamedSharding(mesh, spec)
+    q, k, v = (a if isinstance(a, jax.core.Tracer)
+               else jax.device_put(np.asarray(a), sharding)
+               for a in (q, k, v))
+    return spec, q, k, v
 
 
 def _ring_attention_local(q, k, v, axis, causal, scale):
@@ -138,47 +178,24 @@ def ring_attention(query, key, value, axis="sp", causal=False, scale=None,
         from ..nn.functional.attention import _reference_attention
         return Tensor(_reference_attention(q, k, v, None, scale, causal))
 
-    if not isinstance(q, jax.core.Tracer):
-        # eager: place the seq shards; batch/heads replicated
-        spec = P(None, axis, None, None)
-        sharding = jax.sharding.NamedSharding(mesh, spec)
-        q, k, v = (jax.device_put(a, sharding) for a in (q, k, v))
-    else:
-        # under jit (TrainStep): keep the surrounding batch (dp/sharding)
-        # and head (mp) shardings — declaring them replicated would force
-        # an all-gather at the shard_map boundary.  Only claim an axis
-        # when the dim actually divides by it (small eager-in-grad tests
-        # use batches below the dp degree).
-        batch_axes = tuple(a for a in mesh_mod.DATA_AXES
-                           if mesh.shape.get(a, 1) > 1)
-        bsz = int(np.prod([mesh.shape[a] for a in batch_axes])) \
-            if batch_axes else 1
-        if not batch_axes or q.shape[0] % bsz != 0:
-            batch_axes = None
-        mp_n = mesh.shape.get("mp", 1)
-        head_ax = "mp" if mp_n > 1 and q.shape[2] % mp_n == 0 else None
-        spec = P(batch_axes, axis, head_ax, None)
-        # concrete operands mixed into a traced call (e.g. constant K/V
-        # under eager jax.grad) may be committed to one device; place
-        # them on the mesh explicitly
-        sharding = jax.sharding.NamedSharding(mesh, spec)
-        q, k, v = (a if isinstance(a, jax.core.Tracer)
-                   else jax.device_put(np.asarray(a), sharding)
-                   for a in (q, k, v))
-    # jit wrapper (cached by config: jit's own cache keys on function
-    # identity, so a fresh wrapper per call would recompile the ring
-    # kernel every invocation): places single-device/host operands onto
-    # the mesh automatically. Under an outer pjit this inlines.
-    per_mesh = _ring_jit_cache.setdefault(mesh, {})
-    key = (axis, bool(causal), scale, spec)
-    if key not in per_mesh:
+    spec, q, k, v = _sp_place_and_spec(mesh, axis, q, k, v,
+                                       claim_mp_heads=True)
+
+    def build():
         fn = shard_map(
             functools.partial(_ring_attention_local, axis=axis,
                               causal=causal, scale=scale),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)
-        per_mesh[key] = jax.jit(fn)
-    return Tensor(per_mesh[key](q, k, v))
+        return jax.jit(fn)
+
+    # jit wrapper (cached by config: jit's own cache keys on function
+    # identity, so a fresh wrapper per call would recompile the ring
+    # kernel every invocation); it also places single-device/host
+    # operands onto the mesh.  Under an outer pjit this inlines.
+    call = _cached_sp_call(mesh, ("ring", axis, bool(causal), scale,
+                                  spec), build)
+    return Tensor(call(q, k, v))
 
 
 def ulysses_attention(query, key, value, axis="sp", causal=False,
@@ -212,9 +229,24 @@ def ulysses_attention(query, key, value, axis="sp", causal=False,
         out = _reference_attention(qg, kg, vg, None, scale, causal)
         return head2seq(out)
 
-    spec = P(None, axis, None, None)
-    sharding = jax.sharding.NamedSharding(mesh, spec)
-    q, k, v = (jax.device_put(a, sharding) for a in (q, k, v))
-    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec, check_vma=False)
-    return Tensor(fn(q, k, v))
+    spec, q, k, v = _sp_place_and_spec(mesh, axis, q, k, v,
+                                       claim_mp_heads=True)
+    # the all_to_all splits each device's LOCAL head count across the sp
+    # ring — guard divisibility here rather than dying in XLA lowering
+    local_heads = q.shape[2]
+    if spec[2] == "mp":
+        local_heads //= mesh.shape.get("mp", 1)
+    if local_heads % n != 0:
+        raise ValueError(
+            f"ulysses_attention: local head count {local_heads} is not "
+            f"divisible by the '{axis}' degree {n} — use ring attention "
+            "(use_sp=True) for head counts the all-to-all cannot split")
+
+    def build():
+        fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+        return jax.jit(fn)
+
+    call = _cached_sp_call(mesh, ("ulysses", axis, bool(causal), scale,
+                                  spec), build)
+    return Tensor(call(q, k, v))

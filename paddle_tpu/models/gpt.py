@@ -134,12 +134,18 @@ class GPTAttention(nn.Layer):
             v = concat([cache[1], v], axis=1)
             cache = (k, v)
         if self.use_sp and cache is None:
-            # sequence/context parallelism: blockwise ring attention over
-            # the 'sp' mesh axis — seq stays sharded end-to-end, K/V
-            # blocks rotate on the ICI ring (differentiable: the ring is
-            # a lax.scan).  NEW capability vs the reference (§5.7).
-            from ..distributed.ring import ring_attention
-            out = ring_attention(q, k, v, axis="sp", causal=True)
+            # sequence/context parallelism over the 'sp' mesh axis — seq
+            # stays sharded end-to-end.  use_sp=True/'ring': K/V blocks
+            # rotate on the ICI ring (differentiable: the ring is a
+            # lax.scan).  use_sp='ulysses': all-to-all swaps seq<->head
+            # sharding (lower comm volume when heads % sp == 0).  NEW
+            # capability vs the reference (§5.7).
+            if self.use_sp == "ulysses":
+                from ..distributed.ring import ulysses_attention
+                out = ulysses_attention(q, k, v, axis="sp", causal=True)
+            else:
+                from ..distributed.ring import ring_attention
+                out = ring_attention(q, k, v, axis="sp", causal=True)
         else:
             out = F.scaled_dot_product_attention(
                 q, k, v, is_causal=True, dropout_p=self.dropout,

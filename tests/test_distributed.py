@@ -541,31 +541,41 @@ class TestRingAttentionTraining:
         finally:
             dist.set_mesh(None)
 
-    def test_sp_model_trains_and_matches_dense(self):
+    @staticmethod
+    def _run_sp_losses(use_sp, sp, ids):
         from paddle_tpu.models import GPTModel, GPTPretrainingCriterion
+        mesh = dist.build_mesh(dp=8 // sp, sp=sp)
+        dist.set_mesh(mesh)
+        paddle_tpu.seed(0)
+        model = GPTModel.from_config("tiny", dropout=0.0, use_sp=use_sp)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        step = TrainStep(model, opt, loss_fn=GPTPretrainingCriterion(),
+                         donate=False)
+        return [float(step.step([ids[:, :-1]], [ids[:, 1:]]).numpy())
+                for _ in range(3)]
+
+    @pytest.mark.parametrize("use_sp,sp", [(True, 4), ("ulysses", 2)])
+    def test_sp_model_trains_and_matches_dense(self, use_sp, sp):
         ids = np.random.RandomState(0).randint(0, 128, (4, 33)) \
             .astype(np.int64)
-
-        def run(use_sp, sp):
-            mesh = dist.build_mesh(dp=8 // sp, sp=sp)
-            dist.set_mesh(mesh)
-            paddle_tpu.seed(0)
-            model = GPTModel.from_config("tiny", dropout=0.0,
-                                         use_sp=use_sp)
-            opt = optimizer.AdamW(learning_rate=1e-3,
-                                  parameters=model.parameters())
-            step = TrainStep(model, opt,
-                             loss_fn=GPTPretrainingCriterion(),
-                             donate=False)
-            return [float(step.step([ids[:, :-1]],
-                                    [ids[:, 1:]]).numpy())
-                    for _ in range(3)]
-
         try:
-            sp_losses = run(True, 4)
-            dense_losses = run(False, 1)
+            sp_losses = self._run_sp_losses(use_sp, sp, ids)
+            dense_losses = self._run_sp_losses(False, 1, ids)
             assert sp_losses[-1] < sp_losses[0]
             np.testing.assert_allclose(sp_losses, dense_losses,
                                        rtol=2e-3, atol=2e-3)
+        finally:
+            dist.set_mesh(None)
+
+    def test_ulysses_indivisible_heads_clear_error(self):
+        from paddle_tpu.distributed.ring import ulysses_attention
+        mesh = dist.build_mesh(dp=2, sp=4)
+        dist.set_mesh(mesh)
+        try:
+            rs = np.random.RandomState(0)
+            q = rs.randn(2, 16, 3, 8).astype(np.float32)  # 3 heads, sp=4
+            with pytest.raises(ValueError, match="not\\s+divisible"):
+                ulysses_attention(q, q, q, axis="sp")
         finally:
             dist.set_mesh(None)
