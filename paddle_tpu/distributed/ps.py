@@ -61,7 +61,16 @@ class SparseTable:
             self.state = {
                 "m": jax.device_put(jnp.zeros_like(w), self._sharding),
                 "v": jax.device_put(jnp.zeros_like(w), self._sharding),
-                "t": jnp.zeros([], jnp.int32),
+                # PER-ROW step counts (reference: CommonSparseTable keeps
+                # per-row optimizer state; a global t mis-corrects rows
+                # touched at different frequencies) — co-sharded with the
+                # table rows
+                "t": jax.device_put(
+                    jnp.zeros((self.rows,), jnp.int32),
+                    jax.sharding.NamedSharding(
+                        self.mesh,
+                        jax.sharding.PartitionSpec(
+                            *self._sharding.spec[:1]))),
             }
         else:
             self.state = {}
@@ -92,14 +101,17 @@ class SparseTable:
             uc = jnp.where(uids < rows, uids, 0)
             w_rows = weight[uc]
             if optimizer == "adam":
-                t = state["t"] + 1
                 b1, b2, eps = 0.9, 0.999, 1e-8
+                # per-row step counts: each touched row advances its own
+                # t and bias-corrects with it (reference per-row state)
+                t_rows = state["t"][uc] + 1
                 m_rows = state["m"][uc]
                 v_rows = state["v"][uc]
                 m_new = b1 * m_rows + (1 - b1) * merged
                 v_new = b2 * v_rows + (1 - b2) * merged ** 2
-                mhat = m_new / (1 - b1 ** t)
-                vhat = v_new / (1 - b2 ** t)
+                tf = t_rows.astype(jnp.float32)[:, None]
+                mhat = m_new / (1 - b1 ** tf)
+                vhat = v_new / (1 - b2 ** tf)
                 new_rows = w_rows - lr * mhat / (jnp.sqrt(vhat) + eps)
                 # delta-adds: padded slots add 0, so a colliding clamp
                 # index never overwrites a real update
@@ -107,7 +119,9 @@ class SparseTable:
                     jnp.where(valid, m_new - m_rows, 0.0))
                 new_v = state["v"].at[uc].add(
                     jnp.where(valid, v_new - v_rows, 0.0))
-                new_state = {"m": new_m, "v": new_v, "t": t}
+                new_t = state["t"].at[uc].add(
+                    jnp.where(valid[:, 0], 1, 0))
+                new_state = {"m": new_m, "v": new_v, "t": new_t}
             else:
                 new_rows = w_rows - lr * merged
                 new_state = state
@@ -141,14 +155,13 @@ class SparseTable:
                 "optimizer": self.optimizer, "lr": self.lr,
                 "num_shards": int(num_shards),
                 "bounds": bounds.tolist(),
-                "state_t": int(self.state.get("t", 0))
-                if self.optimizer == "adam" else 0}
+                }
         with open(os.path.join(dirname, f"{self.name}.meta"), "wb") as f:
             pickle.dump(meta, f, protocol=4)
         for s in range(num_shards):
             lo, hi = int(bounds[s]), int(bounds[s + 1])
             blob = {"weight": np.asarray(self.weight[lo:hi])}
-            for k in ("m", "v"):
+            for k in ("m", "v", "t"):
                 if k in self.state:
                     blob[k] = np.asarray(self.state[k][lo:hi])
             with open(os.path.join(
@@ -165,6 +178,10 @@ class SparseTable:
                                          self._sharding)
             self.state = {k: jnp.asarray(v)
                           for k, v in data["state"].items()}
+            if "t" in self.state and self.state["t"].ndim == 0:
+                # legacy scalar step count -> per-row
+                self.state["t"] = jnp.full((self.rows,),
+                                           self.state["t"], jnp.int32)
             return
         with open(meta_path, "rb") as f:
             meta = pickle.load(f)
@@ -175,8 +192,10 @@ class SparseTable:
                 f"({self.rows},{self.dim})")
         bounds = meta["bounds"]
         w = np.empty((self.rows, self.dim), np.float32)
+        adam = self.optimizer == "adam"
         state_np = {k: np.empty((self.rows, self.dim), np.float32)
-                    for k in ("m", "v")} if self.optimizer == "adam" else {}
+                    for k in ("m", "v")} if adam else {}
+        t_np = np.zeros((self.rows,), np.int32) if adam else None
         for s in range(meta["num_shards"]):
             lo, hi = int(bounds[s]), int(bounds[s + 1])
             with open(os.path.join(
@@ -185,14 +204,23 @@ class SparseTable:
             w[lo:hi] = blob["weight"]
             for k in state_np:
                 state_np[k][lo:hi] = blob[k]
+            if adam:
+                if "t" in blob:
+                    t_np[lo:hi] = blob["t"]
+                else:  # shards written before per-row counts
+                    t_np[lo:hi] = int(meta.get("state_t", 0))
         self.weight = jax.device_put(jnp.asarray(w), self._sharding)
-        if self.optimizer == "adam":
+        if adam:
             self.state = {
                 "m": jax.device_put(jnp.asarray(state_np["m"]),
                                     self._sharding),
                 "v": jax.device_put(jnp.asarray(state_np["v"]),
                                     self._sharding),
-                "t": jnp.asarray(meta.get("state_t", 0), jnp.int32),
+                "t": jax.device_put(
+                    jnp.asarray(t_np),
+                    jax.sharding.NamedSharding(
+                        self.mesh, jax.sharding.PartitionSpec(
+                            *self._sharding.spec[:1]))),
             }
         else:
             self.state = {}

@@ -78,6 +78,10 @@ class GPTAttention(nn.Layer):
         self.use_mp = use_mp
         # sequence parallelism: attention dropout is skipped under sp
         # (the ring kernel has no per-block dropout)
+        if use_sp not in (False, True, "ring", "ulysses"):
+            raise ValueError(
+                f"use_sp={use_sp!r}: expected False, True/'ring', or "
+                "'ulysses'")
         self.use_sp = use_sp
         if use_sp and dropout:
             import warnings

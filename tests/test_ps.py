@@ -88,28 +88,34 @@ def test_table_is_sharded_over_mesh(mesh):
 
 
 def test_push_matches_numpy_adam_with_dups(mesh):
-    """Dedup + segment-sum path vs a straight numpy reference."""
+    """Dedup + segment-sum path vs a straight numpy reference with
+    PER-ROW step counts (reference: per-row optimizer state in
+    CommonSparseTable)."""
     paddle.seed(4)
     t = SparseTable("emb6", rows=12, dim=3, optimizer="adam", lr=0.05,
                     mesh=mesh)
     w = np.asarray(t.weight).copy()
     m = np.zeros_like(w); v = np.zeros_like(w)
+    t_rows = np.zeros(12, np.int64)
     rs = np.random.RandomState(0)
-    for step in range(1, 4):
+    for _ in range(3):
         ids = rs.randint(0, 12, (6,)).astype(np.int32)
         g = rs.randn(6, 3).astype(np.float32)
         t.push(ids, g)
         merged = np.zeros_like(w)
         np.add.at(merged, ids, g)
         touched = np.zeros(12, bool); touched[ids] = True
+        t_rows[touched] += 1
         b1, b2, eps = 0.9, 0.999, 1e-8
         m[touched] = b1 * m[touched] + (1 - b1) * merged[touched]
         v[touched] = b2 * v[touched] + (1 - b2) * merged[touched] ** 2
-        mhat = m[touched] / (1 - b1 ** step)
-        vhat = v[touched] / (1 - b2 ** step)
-        w[touched] -= 0.05 * mhat / (np.sqrt(vhat) + eps)
+        bias1 = 1 - b1 ** t_rows[touched][:, None]
+        bias2 = 1 - b2 ** t_rows[touched][:, None]
+        w[touched] -= 0.05 * (m[touched] / bias1) / (
+            np.sqrt(v[touched] / bias2) + eps)
         np.testing.assert_allclose(np.asarray(t.weight), w, rtol=2e-4,
                                    atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(t.state["t"]), t_rows)
 
 
 def test_sharded_save_load_multiple_files(tmp_path, mesh):
@@ -128,7 +134,9 @@ def test_sharded_save_load_multiple_files(tmp_path, mesh):
     t2.load(str(tmp_path))
     np.testing.assert_allclose(np.asarray(t2.weight), ref_w, rtol=1e-6)
     np.testing.assert_allclose(np.asarray(t2.state["m"]), ref_m, rtol=1e-6)
-    assert int(t2.state["t"]) == 1
+    tcounts = np.asarray(t2.state["t"])
+    np.testing.assert_array_equal(tcounts[:10], 1)  # pushed rows
+    np.testing.assert_array_equal(tcounts[10:], 0)
 
 
 def test_push_cost_independent_of_table_size(mesh):
