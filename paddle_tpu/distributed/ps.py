@@ -176,12 +176,19 @@ class SparseTable:
                 data = pickle.load(f)
             self.weight = jax.device_put(jnp.asarray(data["weight"]),
                                          self._sharding)
-            self.state = {k: jnp.asarray(v)
-                          for k, v in data["state"].items()}
-            if "t" in self.state and self.state["t"].ndim == 0:
-                # legacy scalar step count -> per-row
-                self.state["t"] = jnp.full((self.rows,),
-                                           self.state["t"], jnp.int32)
+            row_sharding = NamedSharding(self.mesh,
+                                         P(*self._sharding.spec[:1]))
+            self.state = {}
+            for k, v in data["state"].items():
+                arr = jnp.asarray(v)
+                if k == "t" and arr.ndim == 0:
+                    # legacy scalar step count -> per-row
+                    arr = jnp.full((self.rows,), arr, jnp.int32)
+                if k == "t":
+                    arr = jax.device_put(arr, row_sharding)
+                elif arr.ndim == 2:
+                    arr = jax.device_put(arr, self._sharding)
+                self.state[k] = arr
             return
         with open(meta_path, "rb") as f:
             meta = pickle.load(f)

@@ -125,6 +125,52 @@ class GPTAttention(nn.Layer):
         qkv = einsum("bse,ethd->btshd", x, self.qkv_weight) + self.qkv_bias
         return qkv[:, 0], qkv[:, 1], qkv[:, 2]
 
+    def decode(self, x, k_buf, v_buf, pos):
+        """One-token decode against FIXED-SIZE cache buffers (compiled
+        generation): writes this token's k/v at ``pos`` via
+        dynamic_update_slice and attends over positions <= pos.  Static
+        shapes throughout — one XLA program decodes every step.
+
+        x: Tensor [B, 1, E]; k_buf/v_buf: [B, L, H, hd] arrays;
+        pos: traced int scalar.  Returns (out Tensor, k_buf, v_buf).
+        """
+        import math as _math
+        import jax
+        import jax.numpy as jnp
+
+        if self.use_mp:
+            q, k, v = self._qkv_mp(x)
+        else:
+            b = x.shape[0]
+            qkv = self.qkv_proj(x)
+            qkv = reshape(qkv, [b, 1, 3, self.num_heads, self.head_dim])
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        qa, ka, va = q._data, k._data, v._data
+        k_buf = jax.lax.dynamic_update_slice(
+            k_buf, ka.astype(k_buf.dtype), (0, pos, 0, 0))
+        v_buf = jax.lax.dynamic_update_slice(
+            v_buf, va.astype(v_buf.dtype), (0, pos, 0, 0))
+        scale = 1.0 / _math.sqrt(self.head_dim)
+        scores = jnp.einsum("bqhd,bkhd->bhqk",
+                            qa.astype(jnp.float32),
+                            k_buf.astype(jnp.float32)) * scale
+        L = k_buf.shape[1]
+        visible = jnp.arange(L) <= pos                    # [L]
+        scores = jnp.where(visible[None, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                         v_buf.astype(jnp.float32)).astype(qa.dtype)
+        out = Tensor(ctx)
+        if self.use_mp:
+            from ..ops import einsum
+            out = einsum("bshd,hde->bse", out, self.out_weight) + \
+                self.out_bias
+        else:
+            b = x.shape[0]
+            out = reshape(out, [b, 1, self.num_heads * self.head_dim])
+            out = self.out_proj(out)
+        return out, k_buf, v_buf
+
     def forward(self, x, cache=None):
         b, s, _ = x.shape
         if self.use_mp:
@@ -217,6 +263,14 @@ class GPTBlock(nn.Layer):
         x = x + self.mlp(self.ln2(x))
         return x
 
+    def decode(self, x, k_buf, v_buf, pos):
+        """Fixed-buffer one-token decode (see GPTAttention.decode)."""
+        attn_out, k_buf, v_buf = self.attn.decode(self.ln1(x), k_buf,
+                                                  v_buf, pos)
+        x = x + attn_out
+        x = x + self.mlp(self.ln2(x))
+        return x, k_buf, v_buf
+
     def forward(self, x, cache=None):
         if cache is not None:
             attn_out, cache = self.attn(self.ln1(x), cache=cache)
@@ -302,13 +356,54 @@ class GPTModel(nn.Layer):
                                    reshape(labels, [b * s]))
         return logits
 
+    def _compiled_decode_fn(self, pnames, params, cache_key):
+        """Build (or fetch) the jitted one-token decode step: (p_list,
+        k_bufs, v_bufs, tok [B,1], pos) -> (last_logits [B,V], k_bufs,
+        v_bufs).  Fixed shapes — ONE XLA program serves every decode
+        step (the eager path re-dispatches every op per token).  K/V
+        buffers are DONATED (in-place update, no per-token copy); the
+        jitted fn is cached on the model so repeated generate() calls
+        never recompile."""
+        import jax
+        from ..core import autograd
+        from ..jit import _swapped
+
+        cache = getattr(self, "_decode_fn_cache", None)
+        if cache is None:
+            cache = self._decode_fn_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        model = self
+
+        def pure(p_list, k_bufs, v_bufs, tok, pos):
+            with _swapped(params, dict(zip(pnames, p_list))):
+                with autograd.no_grad():
+                    x = model.embeddings(Tensor(tok),
+                                         position_offset=pos)
+                    new_k, new_v = [], []
+                    for i, blk in enumerate(model.blocks):
+                        x, kb, vb = blk.decode(x, k_bufs[i], v_bufs[i],
+                                               pos)
+                        new_k.append(kb)
+                        new_v.append(vb)
+                    logits = model.head(x)
+            return logits._data[:, -1, :], new_k, new_v
+
+        fn = jax.jit(pure, donate_argnums=(1, 2))
+        cache[cache_key] = fn
+        return fn
+
     def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
-                 top_k=0, eos_token_id=None, seed=None):
+                 top_k=0, eos_token_id=None, seed=None, compiled=False):
         """KV-cached autoregressive decoding (greedy / top-k sampling).
 
         The reference snapshot has no generation loop (PaddleNLP-era
         feature); provided here because incremental decode is the natural
-        consumer of the attention cache.  Returns [B, S + new] ids.
+        consumer of the attention cache.  ``compiled=True`` decodes
+        through ONE jitted fixed-shape step (dynamic_update_slice into
+        preallocated K/V buffers) instead of per-token eager dispatch.
+        Returns [B, S + new] ids.
         """
         import jax
         import jax.numpy as jnp
@@ -343,8 +438,26 @@ class GPTModel(nn.Layer):
                 logits, caches = self.forward(T(ids), caches=caches)
                 out = [ids]
                 key = rng_mod.key_for(seed)
-                for step in range(max_new_tokens):
-                    last = logits._data[:, -1, :].astype(jnp.float32)
+
+                step_fn = None
+                if compiled:
+                    # fixed-size buffers: prompt k/v padded to L
+                    L = s + max_new_tokens
+                    k_bufs, v_bufs = [], []
+                    for ck, cv in caches:
+                        pad = ((0, 0), (0, L - s), (0, 0), (0, 0))
+                        k_bufs.append(jnp.pad(ck._data, pad))
+                        v_bufs.append(jnp.pad(cv._data, pad))
+                    params = dict(self.named_parameters())
+                    pnames = sorted(params)
+                    step_fn = self._compiled_decode_fn(
+                        pnames, params,
+                        (b, L, str(kv_dtype), tuple(pnames)))
+                    p_list = [params[k2]._data for k2 in pnames]
+
+                def sample(last):
+                    nonlocal key
+                    last = last.astype(jnp.float32)
                     if do_sample:
                         if temperature != 1.0:
                             last = last / temperature
@@ -355,15 +468,26 @@ class GPTModel(nn.Layer):
                         nxt = jax.random.categorical(sub, last, axis=-1)
                     else:
                         nxt = jnp.argmax(last, axis=-1)
-                    nxt = nxt.astype(ids.dtype).reshape(b, 1)
+                    return nxt.astype(ids.dtype).reshape(b, 1)
+
+                last = logits._data[:, -1, :]
+                for step in range(max_new_tokens):
+                    nxt = sample(last)
                     out.append(nxt)
                     if eos_token_id is not None and bool(
                             jnp.all(nxt == eos_token_id)):
                         break
                     if step == max_new_tokens - 1:
                         break  # last token emitted; skip the dead forward
-                    logits, caches = self.forward(
-                        T(nxt), caches=caches, position_offset=s + step)
+                    if compiled:
+                        last, k_bufs, v_bufs = step_fn(
+                            p_list, k_bufs, v_bufs, nxt,
+                            jnp.asarray(s + step, jnp.int32))
+                    else:
+                        logits, caches = self.forward(
+                            T(nxt), caches=caches,
+                            position_offset=s + step)
+                        last = logits._data[:, -1, :]
         finally:
             if was_training:
                 self.train()
