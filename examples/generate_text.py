@@ -19,8 +19,14 @@ def main():
     greedy = model.generate(paddle.to_tensor(prompt), max_new_tokens=12)
     sampled = model.generate(paddle.to_tensor(prompt), max_new_tokens=12,
                              temperature=0.8, top_k=10, seed=42)
-    print("greedy :", greedy.numpy()[0].tolist())
-    print("sampled:", sampled.numpy()[0].tolist())
+    # compiled=True decodes through ONE jitted fixed-shape step
+    # (donated K/V buffers) — same tokens, ~13x faster steady-state
+    fast = model.generate(paddle.to_tensor(prompt), max_new_tokens=12,
+                          compiled=True)
+    print("greedy   :", greedy.numpy()[0].tolist())
+    print("sampled  :", sampled.numpy()[0].tolist())
+    print("compiled :", fast.numpy()[0].tolist())
+    assert greedy.numpy().tolist() == fast.numpy().tolist()
 
 
 if __name__ == "__main__":
