@@ -35,9 +35,14 @@ except ImportError:
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 
-def _block_attn(q, k, v, scale, mask_mode):
+def _block_attn(q, k, v, scale, mask_mode, drop_key=None, dropout_p=0.0):
     """One block pair: returns (unnormalized out, running max, running sum)
-    contributions in f32.  mask_mode: 0=full, 1=causal-diag, 2=skip."""
+    contributions in f32.  mask_mode: 0=full, 1=causal-diag, 2=skip.
+
+    Attention dropout composes with the online softmax: the mask applies
+    only to the ``o`` accumulation (probs→dropout→@v), while ``m``/``l``
+    stay undropped — (p·mask/(1-pd)) @ v / l == dropout(softmax(s)) @ v.
+    """
     # q,k,v: [B, S, H, D] -> scores [B, H, Sq, Sk]
     qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
     kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
@@ -51,6 +56,9 @@ def _block_attn(q, k, v, scale, mask_mode):
     m = jnp.maximum(m, -1e30)                   # avoid -inf - -inf
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)                     # [B,H,Sq]
+    if drop_key is not None and dropout_p > 0.0:
+        keep = jax.random.bernoulli(drop_key, 1.0 - dropout_p, p.shape)
+        p = p * keep / (1.0 - dropout_p)
     o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
     return o, m, l
 
@@ -62,6 +70,14 @@ from collections import OrderedDict
 
 _RING_CACHE_CAP = 16
 _ring_jit_cache: "OrderedDict" = OrderedDict()
+_placeholder_key = None
+
+
+def _get_placeholder_key():
+    global _placeholder_key
+    if _placeholder_key is None:
+        _placeholder_key = jax.random.key(0)
+    return _placeholder_key
 
 
 def _cached_sp_call(mesh, subkey, build):
@@ -74,6 +90,19 @@ def _cached_sp_call(mesh, subkey, build):
     while len(_ring_jit_cache) > _RING_CACHE_CAP:
         _ring_jit_cache.popitem(last=False)
     return fn
+
+
+def _localize_eager(out, ref):
+    """Eager results leave the shard_map mesh-sharded; surrounding eager
+    code (residual adds, numpy()) works on single-device arrays — pull
+    the result back to the reference operand's device."""
+    if isinstance(ref, jax.core.Tracer) or not isinstance(out, jax.Array):
+        return out
+    devs = getattr(ref, "devices", lambda: set())()
+    if len(devs) == 1 and len(out.devices()) > 1:
+        # on-device gather (no host round-trip)
+        return jax.device_put(out, next(iter(devs)))
+    return out
 
 
 def _sp_place_and_spec(mesh, axis, q, k, v, claim_mp_heads):
@@ -103,7 +132,8 @@ def _sp_place_and_spec(mesh, axis, q, k, v, claim_mp_heads):
     return spec, q, k, v
 
 
-def _ring_attention_local(q, k, v, axis, causal, scale):
+def _ring_attention_local(q, k, v, axis, causal, scale, key=None,
+                          dropout_p=0.0, fold_axes=()):
     """Runs on each device inside shard_map; q/k/v are LOCAL seq shards."""
     n = lax.axis_size(axis)
     my = lax.axis_index(axis)
@@ -124,15 +154,27 @@ def _ring_attention_local(q, k, v, axis, causal, scale):
         k_blk, v_blk, acc_o, acc_m, acc_l = carry
         # k_blk originated on device (my - step) mod n
         src = (my - step) % n
+        # per-(device, ring-step) dropout key: deterministic fold, so the
+        # scan transpose (backward) regenerates the identical mask
+        dkey = None
+        if key is not None and dropout_p > 0.0:
+            dkey = jax.random.fold_in(jax.random.fold_in(key, my), step)
+            # decorrelate across the OTHER mesh axes (dp/sharding/mp):
+            # replicas holding different data/head shards must not share
+            # a mask
+            for fa in fold_axes:
+                dkey = jax.random.fold_in(dkey, lax.axis_index(fa))
         if causal:
             # visible iff src block is strictly earlier, or same (diag).
             # compute full + diag variants and select — cheaper than
             # lax.switch under vjp (both run anyway in backward) and
             # keeps every branch differentiable
             o_f, m_f, l_f = _block_attn(q, k_blk, v_blk, scale,
-                                        mask_mode=0)
+                                        mask_mode=0, drop_key=dkey,
+                                        dropout_p=dropout_p)
             o_d, m_d, l_d = _block_attn(q, k_blk, v_blk, scale,
-                                        mask_mode=1)
+                                        mask_mode=1, drop_key=dkey,
+                                        dropout_p=dropout_p)
             zero_o = jnp.zeros_like(o_f)
             skip_m = jnp.full_like(m_f, -1e30)
             zero_l = jnp.zeros_like(l_f)
@@ -142,7 +184,8 @@ def _ring_attention_local(q, k, v, axis, causal, scale):
             m = jnp.where(is_full, m_f, jnp.where(is_diag, m_d, skip_m))
             l = jnp.where(is_full, l_f, jnp.where(is_diag, l_d, zero_l))
         else:
-            o, m, l = _block_attn(q, k_blk, v_blk, scale, mask_mode=0)
+            o, m, l = _block_attn(q, k_blk, v_blk, scale, mask_mode=0,
+                                  drop_key=dkey, dropout_p=dropout_p)
 
         new_m = jnp.maximum(acc_m, m)
         alpha = jnp.exp(acc_m - new_m)
@@ -166,27 +209,51 @@ def ring_attention_inner(q, k, v, axis="sp", causal=False, scale=None):
 
 
 def ring_attention(query, key, value, axis="sp", causal=False, scale=None,
-                   mesh=None):
+                   mesh=None, dropout_p=0.0, rng_key=None):
     """Driver: shards the seq axis of global [B, S, H, D] tensors over
-    `axis` and runs ring attention.  Usable eagerly or under jit."""
+    `axis` and runs ring attention.  Usable eagerly or under jit.
+    ``dropout_p``/``rng_key``: attention-probability dropout, applied
+    per ring block with deterministic per-(device, step) keys."""
     q = ensure_tensor(query)._data
     k = ensure_tensor(key)._data
     v = ensure_tensor(value)._data
     mesh = mesh or mesh_mod.ensure_mesh()
     if mesh.shape.get(axis, 1) == 1:
-        # degenerate ring: plain attention
+        # degenerate ring (one block): single-block attention with
+        # probs-dropout — the same math the ring applies per block
+        if dropout_p > 0.0 and rng_key is not None:
+            o, m, l = _block_attn(q, k, v,
+                                  scale if scale is not None else
+                                  1.0 / math.sqrt(q.shape[-1]),
+                                  mask_mode=1 if causal else 0,
+                                  drop_key=rng_key,
+                                  dropout_p=dropout_p)
+            out = (o / jnp.maximum(l[..., None], 1e-30))
+            return Tensor(jnp.swapaxes(out, 1, 2).astype(q.dtype))
         from ..nn.functional.attention import _reference_attention
         return Tensor(_reference_attention(q, k, v, None, scale, causal))
 
+    orig = q
     spec, q, k, v = _sp_place_and_spec(mesh, axis, q, k, v,
                                        claim_mp_heads=True)
+    use_drop = dropout_p > 0.0 and rng_key is not None
+    if not use_drop:
+        rng_key = _get_placeholder_key()  # ignored by the kernel
 
     def build():
-        fn = shard_map(
-            functools.partial(_ring_attention_local, axis=axis,
-                              causal=causal, scale=scale),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False)
+        fold_axes = tuple(a for a in mesh.shape
+                          if mesh.shape[a] > 1 and a != axis)
+
+        def local(qq, kk, vv, rk):
+            return _ring_attention_local(
+                qq, kk, vv, axis=axis, causal=causal, scale=scale,
+                key=rk if use_drop else None,
+                dropout_p=dropout_p if use_drop else 0.0,
+                fold_axes=fold_axes if use_drop else ())
+
+        fn = shard_map(local, mesh=mesh,
+                       in_specs=(spec, spec, spec, P()),
+                       out_specs=spec, check_vma=False)
         return jax.jit(fn)
 
     # jit wrapper (cached by config: jit's own cache keys on function
@@ -194,8 +261,9 @@ def ring_attention(query, key, value, axis="sp", causal=False, scale=None,
     # kernel every invocation); it also places single-device/host
     # operands onto the mesh.  Under an outer pjit this inlines.
     call = _cached_sp_call(mesh, ("ring", axis, bool(causal), scale,
-                                  spec), build)
-    return Tensor(call(q, k, v))
+                                  spec, use_drop,
+                                  dropout_p if use_drop else 0.0), build)
+    return Tensor(_localize_eager(call(q, k, v, rng_key), orig))
 
 
 def ulysses_attention(query, key, value, axis="sp", causal=False,
@@ -229,6 +297,7 @@ def ulysses_attention(query, key, value, axis="sp", causal=False,
         out = _reference_attention(qg, kg, vg, None, scale, causal)
         return head2seq(out)
 
+    orig = q
     spec, q, k, v = _sp_place_and_spec(mesh, axis, q, k, v,
                                        claim_mp_heads=True)
     # the all_to_all splits each device's LOCAL head count across the sp
@@ -249,4 +318,4 @@ def ulysses_attention(query, key, value, axis="sp", causal=False,
 
     call = _cached_sp_call(mesh, ("ulysses", axis, bool(causal), scale,
                                   spec), build)
-    return Tensor(call(q, k, v))
+    return Tensor(_localize_eager(call(q, k, v), orig))

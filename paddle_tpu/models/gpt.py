@@ -76,20 +76,20 @@ class GPTAttention(nn.Layer):
         self.hidden_size = hidden_size
         self.dropout = dropout
         self.use_mp = use_mp
-        # sequence parallelism: attention dropout is skipped under sp
-        # (the ring kernel has no per-block dropout)
+        # sequence parallelism: the ring variant applies per-block
+        # attention-probability dropout; ulysses skips it (warned below)
         if use_sp not in (False, True, "ring", "ulysses"):
             raise ValueError(
                 f"use_sp={use_sp!r}: expected False, True/'ring', or "
                 "'ulysses'")
         self.use_sp = use_sp
-        if use_sp and dropout:
+        if use_sp == "ulysses" and dropout:
             import warnings
             warnings.warn(
-                "GPTAttention(use_sp=True): attention-probability "
-                f"dropout ({dropout}) is skipped under sequence "
-                "parallelism (the ring kernel has no per-block dropout); "
-                "residual/embedding dropout still applies")
+                "GPTAttention(use_sp='ulysses'): attention-probability "
+                f"dropout ({dropout}) is skipped under the all-to-all "
+                "variant; the ring variant (use_sp=True) applies "
+                "per-block probs dropout")
         init = nn.ParamAttr(initializer=I.Normal(0.0, 0.02))
         if use_mp:
             # Einsum-form head-parallel projections: weights carry the head
@@ -195,7 +195,18 @@ class GPTAttention(nn.Layer):
                 out = ulysses_attention(q, k, v, axis="sp", causal=True)
             else:
                 from ..distributed.ring import ring_attention
-                out = ring_attention(q, k, v, axis="sp", causal=True)
+                from ..core import rng as _rng
+                dp = self.dropout if (self.training and self.dropout) \
+                    else 0.0
+                rk = _rng.op_key(q) if dp else None
+                try:
+                    from ..static import program as _sprog
+                    if isinstance(rk, _sprog.Variable):
+                        rk, dp = None, 0.0  # static-graph symbolic key
+                except ImportError:
+                    pass
+                out = ring_attention(q, k, v, axis="sp", causal=True,
+                                     dropout_p=dp, rng_key=rk)
         else:
             out = F.scaled_dot_product_attention(
                 q, k, v, is_causal=True, dropout_p=self.dropout,

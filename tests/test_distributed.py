@@ -579,3 +579,40 @@ class TestRingAttentionTraining:
                 ulysses_attention(q, q, q, axis="sp")
         finally:
             dist.set_mesh(None)
+
+    def test_ring_dropout_trains_and_masks(self):
+        """Attention dropout under sp: training runs finite, masks vary
+        across steps, dropout=0 path unchanged."""
+        from paddle_tpu.models import GPTModel, GPTPretrainingCriterion
+        mesh = dist.build_mesh(dp=2, sp=4)
+        dist.set_mesh(mesh)
+        try:
+            paddle_tpu.seed(0)
+            model = GPTModel.from_config("tiny", dropout=0.2,
+                                         use_sp=True)
+            opt = optimizer.AdamW(learning_rate=1e-3,
+                                  parameters=model.parameters())
+            step = TrainStep(model, opt,
+                             loss_fn=GPTPretrainingCriterion(),
+                             donate=False)
+            ids = np.random.RandomState(0).randint(0, 128, (4, 33)) \
+                .astype(np.int64)
+            losses = [float(step.step([ids[:, :-1]],
+                                      [ids[:, 1:]]).numpy())
+                      for _ in range(4)]
+            assert all(np.isfinite(losses))
+            assert losses[-1] < losses[0]
+            # eval forward (dropout off) must EQUAL the same weights run
+            # through a dropout=0 model — dropout leaking into eval
+            # would break this
+            model.eval()
+            out1 = model(paddle_tpu.to_tensor(ids[:2, :-1])).numpy()
+            clean = GPTModel.from_config("tiny", dropout=0.0,
+                                         use_sp=True)
+            clean.set_state_dict(model.state_dict())
+            clean.eval()
+            out2 = clean(paddle_tpu.to_tensor(ids[:2, :-1])).numpy()
+            np.testing.assert_allclose(out1, out2, rtol=1e-5,
+                                       atol=1e-6)
+        finally:
+            dist.set_mesh(None)
