@@ -1,74 +1,68 @@
-"""Benchmark: GPT-2 345M training throughput on one TPU chip.
+"""Benchmark: training throughput on one TPU chip, driver-capturable.
 
 Prints ONE JSON line:
   {"metric": "tokens/sec/chip (GPT-2 345M train)", "value": N,
-   "unit": "tokens/s", "vs_baseline": N}
+   "unit": "tokens/s", "vs_baseline": N, "models": {...}}
 
-vs_baseline is measured against the BASELINE.md north-star: >=70% of A100
-step-time throughput.  No number is published in the reference repo
-(BASELINE.json.published == {}), so the A100 anchor is taken as 40k
-tokens/s/chip for GPT-2 345M mixed-precision training (Megatron-class
-implementations on A100-40GB); target = 0.7 * 40000 = 28000 tokens/s.
-vs_baseline = measured / 28000.
+Headline metric is GPT-2 345M train tokens/s.  vs_baseline is against the
+BASELINE.md north-star: >=70% of A100 step-time throughput.  No number is
+published in the reference repo (BASELINE.json.published == {}), so the
+A100 anchor is 40k tokens/s/chip for GPT-2 345M mixed-precision training
+(Megatron-class implementations on A100-40GB); target = 0.7*40000 = 28000.
+The "models" key carries the other BASELINE configs (ResNet-50, BERT-base)
+so every driver-run leaves a verifiable multi-model record.
+
+Hardening (round 3): the axon tunnel can hang *indefinitely* at client
+init (observed after a killed remote compile — BENCH_r02 recorded value=0
+this way).  The parent process therefore NEVER imports jax.  Each model
+benchmark runs in its own child process (own session, killable as a
+group) with a timeout, and the headline benchmark retries with
+exponential backoff — a hung child is SIGKILLed and cannot poison the
+next attempt, because the next attempt is a brand-new process and the
+TPU client only ever lived in the dead child.
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
+import signal
+import subprocess
 import sys
+import tempfile
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-
-import numpy as np
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
 A100_ANCHOR_TOKENS_PER_SEC = 40000.0
 TARGET = 0.7 * A100_ANCHOR_TOKENS_PER_SEC
 
-
-def _backend_or_die(timeout_s=600):
-    """The axon tunnel can hang indefinitely on client creation (seen
-    after a killed remote compile).  Probe backend init on a daemon
-    thread; on timeout emit an explanatory JSON line and hard-exit so
-    the driver's bench run never stalls."""
-    import threading
-
-    got = []
-
-    def probe():
-        try:
-            # importing paddle_tpu applies the PADDLE_TPU_PLATFORM
-            # override exactly like the benchmark itself will — one
-            # implementation, no drift
-            import paddle_tpu  # noqa: F401
-            import jax
-            got.append(("ok", jax.default_backend()))
-        except Exception as e:  # init failure is NOT a hang
-            got.append(("err", repr(e)))
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if not got or got[0][0] == "err":
-        reason = ("axon tunnel hung at client init for "
-                  f"{timeout_s}s" if not got
-                  else f"backend init failed: {got[0][1][:200]}")
-        print(json.dumps({
-            "metric": "tokens/sec/chip (GPT-2 345M train)",
-            "value": 0,
-            "unit": "tokens/s",
-            "vs_baseline": 0,
-            "note": f"TPU backend unavailable ({reason}); see "
-                    "BASELINE.md round-2 measurements: 32,486 tok/s "
-                    "when the chip was reachable",
-        }), flush=True)
-        os._exit(3)
-    return got[0][1]
+# (timeout_s, sleep_before_s) per attempt for the headline benchmark.
+# First compile through the tunnel is slow (~20-40s warm, minutes cold),
+# so timeouts are generous; backoff gives a flapping tunnel time to
+# recover between attempts.
+GPT2_ATTEMPTS = [(600, 0), (600, 60), (900, 240)]
+SECONDARY_ATTEMPTS = [(600, 0), (600, 60)]
 
 
-def main():
-    _backend_or_die()
+# --------------------------------------------------------------------------
+# Child benchmarks: each runs in a fresh process that owns the TPU client.
+# --------------------------------------------------------------------------
+
+def _timed_steps(fn, steps, sync):
+    fn()  # one extra un-timed step after compile (pipeline settle)
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        fn()
+    sync()
+    return time.perf_counter() - t0
+
+
+def bench_gpt2():
     import jax
+    import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu import optimizer
     from paddle_tpu.models import GPTModel
@@ -96,25 +90,213 @@ def main():
     ids = rng.randint(0, vocab, (batch, seq + 1)).astype(np.int32)
     x, y = ids[:, :-1], ids[:, 1:]
 
-    # warmup (compile)
     loss = step.step([x, y])
-    loss.numpy()
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step.step([x, y])
-    loss.numpy()  # sync
-    dt = time.perf_counter() - t0
-
-    tokens_per_sec = batch * seq * steps / dt
-    result = {
+    loss.numpy()  # compile + sync
+    dt = _timed_steps(lambda: step.step([x, y]), steps,
+                      lambda: step.step([x, y]).numpy())
+    # the sync closure above runs one extra step; subtract it from count
+    tokens_per_sec = batch * seq * (steps + 1) / dt
+    return {
         "metric": "tokens/sec/chip (GPT-2 345M train)"
         if on_tpu else "tokens/sec/chip (GPT tiny, CPU smoke)",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(tokens_per_sec / TARGET, 4),
+        "on_tpu": on_tpu,
+        "config": {"batch": batch, "seq": seq, "model": cfg,
+                   "dtype": "bfloat16" if on_tpu else "float32",
+                   "optimizer": "AdamW", "fused_loss": True},
     }
-    print(json.dumps(result))
+
+
+def bench_resnet50():
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.parallel.train_step import TrainStep
+    from paddle_tpu.vision.models import resnet50
+
+    on_tpu = jax.default_backend() != "cpu"
+    batch, steps = (64, 20) if on_tpu else (4, 2)
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+    step = TrainStep(model, opt, loss_fn=nn.CrossEntropyLoss(),
+                     amp_level="O1")
+
+    rng = np.random.RandomState(0)
+    size = 224 if on_tpu else 32
+    x = rng.rand(batch, 3, size, size).astype(np.float32)
+    y = rng.randint(0, 1000, (batch,)).astype(np.int64)
+    # device-resident inputs: isolates compute from the dev tunnel's
+    # post-compile H2D collapse (BASELINE.md forensics)
+    xd = jax.device_put(x, step._data_sharding(x.shape))
+    yd = jax.device_put(y, step._data_sharding(y.shape))
+
+    loss = step.step([xd], [yd])
+    loss.numpy()
+    dt = _timed_steps(lambda: step.step([xd], [yd]), steps,
+                      lambda: step.step([xd], [yd]).numpy())
+    sps = batch * (steps + 1) / dt
+    return {"metric": "samples/sec/chip (ResNet-50 train, device-resident)",
+            "value": round(sps, 1), "unit": "samples/s", "on_tpu": on_tpu,
+            "config": {"batch": batch, "image": size, "amp": "O1",
+                       "optimizer": "Momentum"}}
+
+
+def bench_bert():
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.bert import (BertForSequenceClassification,
+                                        BertModel)
+    from paddle_tpu.parallel.train_step import TrainStep
+
+    on_tpu = jax.default_backend() != "cpu"
+    if on_tpu:
+        batch, seq, cfg, steps = 32, 128, "bert-base", 20
+    else:
+        batch, seq, cfg, steps = 2, 32, "tiny", 2
+
+    paddle.seed(0)
+    model = BertForSequenceClassification(BertModel.from_config(cfg),
+                                          num_classes=2)
+    opt = optimizer.AdamW(learning_rate=2e-5,
+                          parameters=model.parameters())
+    import paddle_tpu.nn as nn
+    step = TrainStep(model, opt, loss_fn=nn.CrossEntropyLoss(),
+                     amp_level="O1")
+
+    rng = np.random.RandomState(0)
+    vocab = 30522 if cfg != "tiny" else 128
+    ids = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
+    y = rng.randint(0, 2, (batch,)).astype(np.int64)
+
+    loss = step.step([ids], [y])
+    loss.numpy()
+    dt = _timed_steps(lambda: step.step([ids], [y]), steps,
+                      lambda: step.step([ids], [y]).numpy())
+    sps = batch * (steps + 1) / dt
+    return {"metric": "samples/sec/chip (BERT-base seq-128 fine-tune)",
+            "value": round(sps, 1), "unit": "samples/s", "on_tpu": on_tpu,
+            "config": {"batch": batch, "seq": seq, "amp": "O1",
+                       "optimizer": "AdamW"}}
+
+
+CHILD_BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
+                 "bert": bench_bert}
+
+
+def child_main(name, out_path):
+    # Import paddle_tpu first: it applies the PADDLE_TPU_PLATFORM override
+    # exactly like user code will — one implementation, no drift.
+    import paddle_tpu  # noqa: F401
+    result = CHILD_BENCHES[name]()
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+
+
+# --------------------------------------------------------------------------
+# Parent orchestrator: never imports jax; children are killable as groups.
+# --------------------------------------------------------------------------
+
+def _run_child(name, attempts):
+    """Run one benchmark in an isolated child with timeout+backoff retry.
+
+    Returns (result_dict | None, note | None)."""
+    last_note = None
+    for i, (timeout_s, sleep_s) in enumerate(attempts):
+        if sleep_s:
+            time.sleep(sleep_s)
+        fd, out_path = tempfile.mkstemp(prefix=f"bench_{name}_",
+                                        suffix=".json")
+        os.close(fd)
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--child", name, "--out", out_path],
+            start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        try:
+            _, err = proc.communicate(timeout=timeout_s)
+            if proc.returncode == 0:
+                with open(out_path) as f:
+                    return json.load(f), None
+            tail = (err or b"").decode(errors="replace").strip()[-300:]
+            last_note = (f"attempt {i + 1}: child exited "
+                         f"rc={proc.returncode}: {tail}")
+        except subprocess.TimeoutExpired:
+            # Kill the whole session: the hung TPU client lives only in
+            # this child, so the next attempt starts clean.
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.wait()
+            last_note = f"attempt {i + 1}: killed after {timeout_s}s hang"
+        finally:
+            if os.path.exists(out_path):
+                os.unlink(out_path)
+    return None, last_note
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--child", choices=sorted(CHILD_BENCHES))
+    parser.add_argument("--out")
+    parser.add_argument("--only", choices=sorted(CHILD_BENCHES),
+                        help="run a single benchmark (still isolated)")
+    args = parser.parse_args()
+
+    if args.child:
+        if not args.out:
+            parser.error("--child requires --out")
+        child_main(args.child, args.out)
+        return
+
+    names = [args.only] if args.only else ["gpt2", "resnet50", "bert"]
+    results, notes = {}, {}
+    for name in names:
+        attempts = GPT2_ATTEMPTS if name == "gpt2" else SECONDARY_ATTEMPTS
+        res, note = _run_child(name, attempts)
+        if res is not None:
+            results[name] = res
+        else:
+            notes[name] = note
+
+    # Headline = gpt2 normally, or the single requested benchmark under
+    # --only so a successful run never reports value=0.
+    head_name = "gpt2" if "gpt2" in names else names[0]
+    head = results.get(head_name)
+    line = {
+        "metric": head["metric"] if head
+        else "tokens/sec/chip (GPT-2 345M train)",
+        "value": head["value"] if head else 0,
+        "unit": head["unit"] if head else "tokens/s",
+        "vs_baseline": round(head["value"] / TARGET, 4)
+        if head and head_name == "gpt2" else 0,
+    }
+    models = {}
+    for name, res in results.items():
+        if name == head_name:
+            continue
+        models[name] = {k: res[k] for k in
+                        ("metric", "value", "unit", "config")}
+    if models:
+        line["models"] = models
+    if notes:
+        line["note"] = "; ".join(f"{k}: {v}" for k, v in notes.items())
+        # Only blame the backend when NOTHING reached the device —
+        # a single failing model with others succeeding is model-specific.
+        if not results:
+            line["note"] += ("; TPU backend unavailable — see BASELINE.md "
+                             "round-2 measurements: 32,486 tok/s when the "
+                             "chip was reachable")
+    print(json.dumps(line), flush=True)
+    if head is None:
+        sys.exit(3)
 
 
 if __name__ == "__main__":
