@@ -139,9 +139,13 @@ commit = "tpu-native"
 
 
 def get_tensor_from_selected_rows(x, name=None):
-    """SelectedRows (sparse row-set grads) have no TPU analogue — embedding
-    grads are dense scatter-adds (see nn/functional/common.py embedding);
-    the accessor degenerates to identity."""
+    """Densify a SelectedRows gradient (reference:
+    get_tensor_from_selected_rows_op.cc).  Eager ``nn.Embedding(...,
+    sparse=True)`` grads are ``core.selected_rows.SelectedRows``; this
+    returns their scatter-added dense form.  Dense tensors pass through."""
+    from .core.selected_rows import SelectedRows
+    if isinstance(x, SelectedRows):
+        return Tensor(x._data, stop_gradient=True)
     return x
 
 

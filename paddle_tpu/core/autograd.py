@@ -214,6 +214,11 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
                 cotangents.append(jnp.zeros(shape, dtype))
             else:
                 any_seed = True
+                if _is_selected_rows(g):
+                    # sparse cotangent flowing INTO an op (the consumed
+                    # tensor was itself produced by an op): densify — only
+                    # leaf accumulation stays sparse end-to-end
+                    g = g._data
                 cotangents.append(jnp.asarray(g, dtype))
         if not any_seed:
             continue
@@ -384,12 +389,35 @@ def _accumulate_leaf_tensor(t, g):
         t.grad = t.grad + g
 
 
+def _is_selected_rows(g):
+    from .selected_rows import SelectedRows
+    return isinstance(g, SelectedRows)
+
+
 def _sum(a, b):
-    return b if a is None else a + b
+    if a is None:
+        return b
+    # sparse/sparse accumulation stays sparse (reference:
+    # gradient_accumulator.cc SelectedRows path); mixed densifies
+    if _is_selected_rows(a) and _is_selected_rows(b):
+        return a.append(b)
+    if _is_selected_rows(a):
+        a = a._data
+    if _is_selected_rows(b):
+        b = b._data
+    return a + b
 
 
 def _accumulate_leaf(t, g):
     from .tensor import Tensor
+    if _is_selected_rows(g):
+        if t.grad is None:
+            t.grad = g
+            return
+        if _is_selected_rows(t.grad):
+            t.grad = t.grad.append(g)
+            return
+        g = g._data  # mixed: fall through to dense accumulation
     g = jnp.asarray(g, t._data.dtype)
     if t.grad is None:
         t.grad = Tensor(g, stop_gradient=True)

@@ -1,0 +1,156 @@
+"""Sparse row-wise gradients — the SelectedRows analogue.
+
+Reference parity: ``paddle/fluid/framework/selected_rows.h`` (rows + value
+tensor over a dense height) and ``imperative/gradient_accumulator.cc``
+(SelectedRows-aware grad summing).  In the reference, ``nn.Embedding(...,
+sparse=True)`` makes the lookup_table backward emit SelectedRows so a
+vocab-sized dense cotangent never materializes; optimizer sparse kernels
+(adam/sgd with SelectedRows input) then update only the touched rows.
+
+TPU-native design: a ``SelectedRows`` IS a Tensor whose dense form is
+computed lazily.  Sparse-aware consumers (the eager tape's leaf
+accumulator, ``Optimizer.step``, ``ClipGradByGlobalNorm``) read
+``.rows()`` / ``.merged()`` and never densify; any unaware consumer that
+touches ``._data`` (user ``.numpy()``, an optimizer without a sparse rule)
+transparently gets the scatter-added dense array — correctness everywhere,
+sparsity where it matters.  Under jit/static the tape is off and XLA's
+fused scatter-add on the gather VJP plays this role instead (one kernel,
+no intermediate), so this class is an eager-path construct by design.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+# the base class's slot descriptor for ``_data`` — the subclass property
+# shadows it, so dense storage goes through the descriptor explicitly
+_DENSE = Tensor.__dict__["_data"]
+
+
+class SelectedRows(Tensor):
+    """{rows, values} over a dense ``[height, *dim]`` gradient.
+
+    ``rows`` may contain duplicates (one entry per lookup); ``merged()``
+    returns the deduplicated, segment-summed form that sparse optimizer
+    rules consume (reference: operators/math/selected_rows_functor.cc
+    MergeAdd).
+    """
+
+    def __init__(self, rows, values, height, name=None):
+        self._rows = jnp.asarray(rows).reshape(-1)
+        self._values = jnp.asarray(values)
+        if self._values.shape[0] != self._rows.shape[0]:
+            raise ValueError(
+                "SelectedRows: values.shape[0] (%d) != len(rows) (%d)"
+                % (self._values.shape[0], self._rows.shape[0]))
+        self._height = int(height)
+        self._merged_cache = None
+        _DENSE.__set__(self, None)
+        self._stop_gradient = True
+        self.grad = None
+        self._grad_node = None
+        self._retain_grad = False
+        Tensor._next_id[0] += 1
+        self.name = name or f"selected_rows_{Tensor._next_id[0]}"
+        self.persistable = False
+
+    # -- sparse surface ---------------------------------------------------
+    @property
+    def rows(self):
+        return self._rows
+
+    @property
+    def values(self):
+        return self._values
+
+    @property
+    def height(self):
+        return self._height
+
+    def merged(self):
+        """(unique_rows, segment-summed values); cached."""
+        if self._merged_cache is None:
+            uniq, inv = jnp.unique(self._rows, return_inverse=True)
+            vals = jax.ops.segment_sum(
+                self._values, inv.reshape(-1),
+                num_segments=int(uniq.shape[0]))
+            self._merged_cache = (uniq, vals)
+        return self._merged_cache
+
+    def append(self, other: "SelectedRows") -> "SelectedRows":
+        """Sparse + sparse accumulation: concatenate (reference:
+        gradient_accumulator.cc keeps a row list and merges lazily)."""
+        if other._height != self._height or \
+                other._values.shape[1:] != self._values.shape[1:]:
+            raise ValueError("SelectedRows shape mismatch in accumulation")
+        return SelectedRows(
+            jnp.concatenate([self._rows, other._rows]),
+            jnp.concatenate([self._values,
+                             other._values.astype(self._values.dtype)]),
+            self._height)
+
+    def is_densified(self):
+        return _DENSE.__get__(self) is not None
+
+    @classmethod
+    def from_merged(cls, rows, values, height):
+        """Construct from rows already known unique — primes the merged
+        cache so consumers skip the unique+segment_sum pass."""
+        out = cls(rows, values, height)
+        out._merged_cache = (out._rows, out._values)
+        return out
+
+    # -- Tensor compatibility --------------------------------------------
+    @property
+    def _data(self):
+        d = _DENSE.__get__(self)
+        if d is None:
+            d = jnp.zeros((self._height,) + tuple(self._values.shape[1:]),
+                          self._values.dtype)
+            d = d.at[self._rows].add(self._values)
+            _DENSE.__set__(self, d)
+        return d
+
+    @_data.setter
+    def _data(self, v):
+        # In-place grad mutators (amp.GradScaler.unscale_, clip_grad_norm_)
+        # assign the dense array directly.  The sparse view must follow or
+        # sparse-aware consumers (Optimizer.step via merged()) would keep
+        # applying the STALE pre-mutation values — so densification is the
+        # representation from here on: rows become [0..height), values the
+        # dense array, and merged() is free (already unique).
+        v = jnp.asarray(v)
+        _DENSE.__set__(self, v)
+        self._rows = jnp.arange(self._height, dtype=jnp.int32)
+        self._values = v
+        self._merged_cache = (self._rows, self._values)
+
+    @property
+    def shape(self):
+        # metadata must not force densification
+        return [self._height] + list(self._values.shape[1:])
+
+    @property
+    def ndim(self):
+        return self._values.ndim
+
+    @property
+    def size(self):
+        import numpy as np
+        return int(np.prod(self.shape))
+
+    @property
+    def dtype(self):
+        from . import dtype as dtypes
+        return dtypes.canonical_name(self._values.dtype)
+
+    def __len__(self):
+        return self._height
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self._height}, "
+                f"nnz_rows={int(self._rows.shape[0])}, "
+                f"dim={list(self._values.shape[1:])}, "
+                f"dtype={self._values.dtype})")

@@ -60,15 +60,34 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.clip_norm = float(clip_norm)
 
     def __call__(self, params_grads):
-        grads = [g._data for _, g in params_grads if g is not None]
+        from ..core.selected_rows import SelectedRows
+
+        def _sq_sum(g):
+            # SelectedRows: norm over MERGED rows (duplicate lookups sum
+            # in the dense form, so raw values would overcount) — no
+            # densification (reference: clip.py squared_l2_norm on the
+            # merged SelectedRows)
+            if isinstance(g, SelectedRows):
+                _, vals = g.merged()
+                return jnp.sum(jnp.square(vals.astype(jnp.float32)))
+            return jnp.sum(jnp.square(g._data.astype(jnp.float32)))
+
+        grads = [g for _, g in params_grads if g is not None]
         if not grads:
             return params_grads
-        global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                                   for g in grads))
+        global_norm = jnp.sqrt(sum(_sq_sum(g) for g in grads))
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
-        return [(p, Tensor((g._data * scale).astype(g._data.dtype))
-                 if g is not None else g)
-                for p, g in params_grads]
+
+        def _scaled(g):
+            if g is None:
+                return g
+            if isinstance(g, SelectedRows):
+                rows, vals = g.merged()
+                return SelectedRows.from_merged(
+                    rows, (vals * scale).astype(vals.dtype), g.height)
+            return Tensor((g._data * scale).astype(g._data.dtype))
+
+        return [(p, _scaled(g)) for p, g in params_grads]
 
     def apply_tree(self, grads):
         import jax

@@ -170,10 +170,11 @@ _STEP_COUNTERS = {}
 
 
 def get_tensor_from_selected_rows(x, name=None):
-    """SelectedRows are dense here — identity
+    """Densify a SelectedRows grad; dense input passes through as Tensor
     (reference: get_tensor_from_selected_rows_op.cc)."""
     from ...core.dispatch import ensure_tensor as _et
-    return _et(x)
+    from ... import get_tensor_from_selected_rows as _impl
+    return _impl(_et(x), name)
 
 
 def array_read(array, i):

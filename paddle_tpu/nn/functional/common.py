@@ -98,13 +98,68 @@ def _embedding(w, ids, padding_idx=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    """Embedding lookup.  `sparse` (SelectedRows grads in the reference) is
-    accepted and ignored: XLA's scatter-add on the gather VJP plays that
-    role on TPU."""
+    """Embedding lookup.
+
+    ``sparse=True`` (reference: lookup_table_v2 emitting SelectedRows,
+    ``framework/selected_rows.h``) makes the EAGER backward carry a
+    {rows, values} cotangent instead of materializing the dense
+    [vocab, dim] array — ``weight.grad`` becomes a
+    ``core.selected_rows.SelectedRows`` that sparse-aware optimizers
+    apply row-wise.  Under jit/static the flag is a no-op by design:
+    XLA fuses the scatter-add on the gather VJP, which already never
+    materializes an intermediate."""
     x, weight = ensure_tensor(x), ensure_tensor(weight)
     if padding_idx is not None and padding_idx < 0:
         padding_idx = weight.shape[0] + padding_idx
+    if sparse and _sparse_grad_applicable(weight):
+        return _embedding_sparse(weight, x, padding_idx)
     return _embedding(weight, x, padding_idx=padding_idx)
+
+
+def _sparse_grad_applicable(weight):
+    from ...core import autograd, dispatch
+    return (dispatch.static_record_hook is None
+            and autograd.grad_enabled()
+            and isinstance(weight, Tensor)
+            and not weight.stop_gradient
+            and jnp.issubdtype(weight._data.dtype, jnp.floating))
+
+
+def _embedding_sparse(weight, ids, padding_idx):
+    """Eager lookup recording a SelectedRows-producing vjp on the tape."""
+    from ...core import autograd
+    from ...core.selected_rows import SelectedRows
+
+    w, idx = weight._data, ids._data
+    out = jnp.take(w, idx, axis=0)
+    if padding_idx is not None:
+        out = jnp.where((idx == padding_idx)[..., None], 0.0, out)
+    out_t = Tensor(out, stop_gradient=False)
+    dim = w.shape[1:]
+
+    def vjp_fn(ct):
+        ct = ct[0] if isinstance(ct, tuple) else ct
+        rows = idx.reshape(-1)
+        vals = ct.reshape((-1,) + dim).astype(w.dtype)
+        if padding_idx is not None:
+            vals = jnp.where((rows == padding_idx)[:, None], 0.0, vals)
+        return (SelectedRows(rows, vals, w.shape[0]),)
+
+    node = autograd.record([weight], [out_t], vjp_fn, "lookup_table_v2")
+    # double-grad (create_graph=True) re-derives through the dense primal —
+    # the lookup is linear in w, so the dense fallback is exact; only the
+    # first-order eager path carries the sparse representation.
+
+    def primal(wa):
+        o = jnp.take(wa, idx, axis=0)
+        if padding_idx is not None:
+            o = jnp.where((idx == padding_idx)[..., None], 0.0, o)
+        return o
+
+    node.primal_fn = primal
+    node.primal_in = (w,)
+    node.out_container = None
+    return out_t
 
 
 def one_hot(x, num_classes, name=None):

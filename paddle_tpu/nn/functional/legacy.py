@@ -305,10 +305,14 @@ def random_crop(x, shape, seed=None):
     return primitive(name="random_crop")(lambda a: a[idx])(x)
 
 
-# -- selected-rows shims (dense storage: identity) -----------------------
 def merge_selected_rows(x, name=None):
-    """SelectedRows are stored dense here (COVERAGE.md §2.1) — merge of
-    duplicate rows is a no-op on the dense form."""
+    """Merge duplicate rows of a SelectedRows grad (reference:
+    merge_selected_rows_op.cc / math/selected_rows_functor.cc MergeAdd).
+    Dense tensors pass through unchanged."""
+    from ...core.selected_rows import SelectedRows
+    if isinstance(x, SelectedRows):
+        rows, vals = x.merged()
+        return SelectedRows.from_merged(rows, vals, x.height)
     return ensure_tensor(x)
 
 

@@ -78,6 +78,15 @@ class Optimizer:
         (new_param, new_state)."""
         raise NotImplementedError
 
+    def _update_sparse(self, param, rows, vals, state, lr):
+        """Sparse (SelectedRows) update: `rows` are unique indices into
+        dim 0 of `param`, `vals` the merged per-row gradients (reference:
+        sparse kernels in operators/optimizers/, e.g. adam_op.h
+        SparseAdamFunctor).  Base fallback densifies — correct for every
+        rule; SGD/Momentum/Adam override with row-wise math."""
+        g = jnp.zeros(param.shape, vals.dtype).at[rows].add(vals)
+        return self._update(param, g, state, lr)
+
     # -- pytree API for jit'd train steps ---------------------------------
     def init_state_tree(self, params_tree):
         return jax.tree_util.tree_map(
@@ -125,6 +134,7 @@ class Optimizer:
         pg = [(p, p.grad) for p in params if p.grad is not None]
         if self._grad_clip is not None:
             pg = self._grad_clip(pg)
+        from ..core.selected_rows import SelectedRows
         with autograd.no_grad():
             for p, g in pg:
                 if g is None:
@@ -133,9 +143,15 @@ class Optimizer:
                 if key not in self._accumulators:
                     self._accumulators[key] = self._init_state(p)
                 state = self._accumulators[key]
-                new_param, new_state = self._update(
-                    p._data, g._data.astype(p._data.dtype), state,
-                    self._get_param_lr(p))
+                if isinstance(g, SelectedRows):
+                    rows, vals = g.merged()
+                    new_param, new_state = self._update_sparse(
+                        p._data, rows, vals.astype(p._data.dtype), state,
+                        self._get_param_lr(p))
+                else:
+                    new_param, new_state = self._update(
+                        p._data, g._data.astype(p._data.dtype), state,
+                        self._get_param_lr(p))
                 p._data = new_param
                 self._accumulators[key] = new_state
 
@@ -208,6 +224,15 @@ class SGD(Optimizer):
             grad = grad + self._weight_decay * param
         return param - lr * grad, state
 
+    def _update_sparse(self, param, rows, vals, state, lr):
+        # reference: sgd_op.h SelectedRows branch — scatter-subtract the
+        # touched rows only.  With weight_decay, decay applies to touched
+        # rows (the reference rejects regularizers on sparse params
+        # outright; scoping decay to touched rows is the sparse semantic).
+        if self._weight_decay:
+            vals = vals + self._weight_decay * param[rows]
+        return param.at[rows].add(-lr * vals), state
+
 
 class Momentum(Optimizer):
     """reference: operators/optimizers/momentum_op.cc"""
@@ -236,6 +261,22 @@ class Momentum(Optimizer):
             new_param = param - lr * v
         return new_param, {"velocity": v}
 
+    def _update_sparse(self, param, rows, vals, state, lr):
+        # reference: momentum_op.h SparseMomentumFunctor — missing rows
+        # carry zero grad, so velocity still decays everywhere; grads and
+        # decay land only on the touched rows.  Matches the dense rule
+        # exactly when weight_decay == 0.
+        if self._weight_decay:
+            vals = vals + self._weight_decay * param[rows]
+        v = self._momentum * state["velocity"]
+        v = v.at[rows].add(vals)
+        if self._nesterov:
+            new_param = param - lr * self._momentum * v
+            new_param = new_param.at[rows].add(-lr * vals)
+        else:
+            new_param = param - lr * v
+        return new_param, {"velocity": v}
+
 
 class Adam(Optimizer):
     """reference: operators/optimizers/adam_op.cc (with bias correction)."""
@@ -249,6 +290,7 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy = bool(lazy_mode)
 
     def _init_state(self, param):
         shape = param.shape if hasattr(param, "shape") else ()
@@ -261,6 +303,51 @@ class Adam(Optimizer):
                 "moment2": jnp.zeros(shape, mdtype),
                 "beta1_pow": jnp.ones([], jnp.float32),
                 "beta2_pow": jnp.ones([], jnp.float32)}
+
+    def _update_sparse(self, param, rows, vals, state, lr):
+        """reference: adam_op.h SparseAdamFunctor.  lazy_mode=True (the
+        flag the dense path ignores) updates moments and param ONLY at the
+        touched rows — O(batch) work, the embedding-table fast path.
+        lazy_mode=False reproduces the dense rule exactly: missing rows
+        see zero grad, so their moments decay and bias-corrected updates
+        still move them."""
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        mdtype = state["moment1"].dtype
+        g = vals.astype(mdtype)
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        decay = self._weight_decay if isinstance(self, AdamW) else 0.0
+        if not isinstance(self, AdamW) and self._weight_decay:
+            # L2-reg folds into the gradient; sparse semantic scopes it
+            # to touched rows (see SGD._update_sparse note)
+            g = g + self._weight_decay * param[rows].astype(mdtype)
+        if self._lazy:
+            m_r = b1 * state["moment1"][rows] + (1 - b1) * g
+            v_r = b2 * state["moment2"][rows] + (1 - b2) * jnp.square(g)
+            update = (m_r / (1 - b1p)) / (jnp.sqrt(v_r / (1 - b2p)) + eps)
+            p_r = param[rows].astype(update.dtype)
+            if decay and self._decay_allows_rows(param):
+                update = update + decay * p_r
+            new_param = param.at[rows].set(
+                (p_r - lr * update).astype(param.dtype))
+            m = state["moment1"].at[rows].set(m_r)
+            v = state["moment2"].at[rows].set(v_r)
+        else:
+            m = b1 * state["moment1"]
+            m = m.at[rows].add((1 - b1) * g)
+            v = b2 * state["moment2"]
+            v = v.at[rows].add((1 - b2) * jnp.square(g))
+            update = (m / (1 - b1p)) / (jnp.sqrt(v / (1 - b2p)) + eps)
+            if decay and self._decay_allows_rows(param):
+                update = update + decay * param.astype(update.dtype)
+            new_param = (param.astype(update.dtype) - lr * update).astype(
+                param.dtype)
+        return new_param, {"moment1": m, "moment2": v, "beta1_pow": b1p,
+                           "beta2_pow": b2p}
+
+    def _decay_allows_rows(self, param):
+        fn = getattr(self, "_apply_decay_fn", None)
+        return fn is None or fn(param)
 
     def _update(self, param, grad, state, lr, decay_on=True):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon

@@ -1,0 +1,256 @@
+"""Sparse (SelectedRows) embedding gradients on the eager tape.
+
+Reference parity: nn.Embedding(sparse=True) -> lookup_table_v2 emitting
+SelectedRows (framework/selected_rows.h, imperative/gradient_accumulator.cc
+SelectedRows path) consumed by sparse optimizer kernels
+(operators/optimizers/adam_op.h SparseAdamFunctor, sgd_op.h, momentum_op.h).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.core.selected_rows import SelectedRows
+
+VOCAB, DIM = 64, 8
+
+
+def _make_pair(seed=0, sparse=True, vocab=VOCAB, dim=DIM):
+    """Two identical embeddings, one sparse one dense."""
+    paddle.seed(seed)
+    emb_s = nn.Embedding(vocab, dim, sparse=sparse)
+    emb_d = nn.Embedding(vocab, dim)
+    emb_d.weight.set_value(emb_s.weight.numpy())
+    return emb_s, emb_d
+
+
+def _ids(shape=(4, 6), seed=0, vocab=VOCAB):
+    rng = np.random.RandomState(seed)
+    return paddle.to_tensor(rng.randint(0, vocab, shape).astype(np.int64))
+
+
+class TestSparseGradRepresentation:
+    def test_backward_produces_selected_rows(self):
+        emb, _ = _make_pair()
+        x = _ids()
+        loss = emb(x).sum()
+        loss.backward()
+        g = emb.weight.grad
+        assert isinstance(g, SelectedRows)
+        # O(batch*seq) values, not O(vocab)
+        assert list(g.values.shape) == [4 * 6, DIM]
+        assert g.height == VOCAB
+        assert not g.is_densified()
+
+    def test_matches_dense_gradient(self):
+        emb_s, emb_d = _make_pair()
+        x = _ids()
+        (emb_s(x) ** 2).sum().backward()
+        (emb_d(x) ** 2).sum().backward()
+        np.testing.assert_allclose(emb_s.weight.grad.numpy(),
+                                   emb_d.weight.grad.numpy(), rtol=1e-6)
+
+    def test_padding_idx_rows_zero(self):
+        paddle.seed(0)
+        emb = nn.Embedding(VOCAB, DIM, padding_idx=3, sparse=True)
+        x = paddle.to_tensor(np.array([[1, 3, 5, 3]], np.int64))
+        emb(x).sum().backward()
+        g = emb.weight.grad
+        assert isinstance(g, SelectedRows)
+        dense = g.numpy()
+        np.testing.assert_array_equal(dense[3], np.zeros(DIM))
+        assert np.abs(dense[1]).sum() > 0
+
+    def test_accumulation_stays_sparse(self):
+        emb, emb_d = _make_pair()
+        x1, x2 = _ids(seed=1), _ids(seed=2)
+        emb(x1).sum().backward()
+        emb(x2).sum().backward()
+        g = emb.weight.grad
+        assert isinstance(g, SelectedRows)
+        assert list(g.values.shape) == [2 * 4 * 6, DIM]
+        emb_d(x1).sum().backward()
+        emb_d(x2).sum().backward()
+        np.testing.assert_allclose(g.numpy(), emb_d.weight.grad.numpy(),
+                                   rtol=1e-6)
+
+    def test_merged_dedups(self):
+        g = SelectedRows(np.array([2, 5, 2]),
+                         np.array([[1.0], [2.0], [3.0]], np.float32), 10)
+        rows, vals = g.merged()
+        np.testing.assert_array_equal(np.asarray(rows), [2, 5])
+        np.testing.assert_allclose(np.asarray(vals), [[4.0], [2.0]])
+
+    def test_jit_path_unaffected(self):
+        # under the functional/jit path sparse=True must fall back to the
+        # dense primitive (XLA fuses the scatter-add)
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.nn import functional as F
+
+        w = jnp.ones((VOCAB, DIM), jnp.float32)
+        ids = jnp.zeros((2, 3), jnp.int32)
+
+        def f(w):
+            from paddle_tpu.core.tensor import Tensor
+            t = F.embedding(Tensor(ids), Tensor(w, stop_gradient=True),
+                            sparse=True)
+            return t._data.sum()
+
+        out = jax.jit(jax.grad(f))(w)
+        assert out.shape == (VOCAB, DIM)
+
+
+class TestSparseOptimizers:
+    @pytest.mark.parametrize("make_opt", [
+        lambda ps: optimizer.SGD(learning_rate=0.1, parameters=ps),
+        lambda ps: optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                      parameters=ps),
+        lambda ps: optimizer.Adam(learning_rate=0.05, parameters=ps),
+        lambda ps: optimizer.AdamW(learning_rate=0.05, weight_decay=0.01,
+                                   parameters=ps),
+        # no sparse override -> base densifying fallback
+        lambda ps: optimizer.RMSProp(learning_rate=0.05, parameters=ps),
+    ], ids=["sgd", "momentum", "adam", "adamw", "rmsprop-fallback"])
+    def test_matches_dense_update(self, make_opt):
+        emb_s, emb_d = _make_pair()
+        opt_s = make_opt([emb_s.weight])
+        opt_d = make_opt([emb_d.weight])
+        for step in range(3):
+            x = _ids(seed=step)
+            (emb_s(x) ** 2).sum().backward()
+            (emb_d(x) ** 2).sum().backward()
+            opt_s.step()
+            opt_d.step()
+            opt_s.clear_grad()
+            opt_d.clear_grad()
+        np.testing.assert_allclose(emb_s.weight.numpy(),
+                                   emb_d.weight.numpy(), rtol=2e-5,
+                                   atol=1e-6)
+
+    def test_lazy_adam_touches_only_rows(self):
+        paddle.seed(0)
+        emb = nn.Embedding(VOCAB, DIM, sparse=True)
+        w0 = emb.weight.numpy().copy()
+        opt = optimizer.Adam(learning_rate=0.1, parameters=[emb.weight],
+                             lazy_mode=True)
+        x = paddle.to_tensor(np.array([[1, 2, 3]], np.int64))
+        emb(x).sum().backward()
+        opt.step()
+        w1 = emb.weight.numpy()
+        touched = {1, 2, 3}
+        for r in range(VOCAB):
+            if r in touched:
+                assert np.abs(w1[r] - w0[r]).max() > 0
+            else:
+                np.testing.assert_array_equal(w1[r], w0[r])
+        # untouched moments stay zero
+        state = opt._accumulators[id(emb.weight)]
+        m1 = np.asarray(state["moment1"])
+        assert np.abs(m1[[r for r in range(VOCAB)
+                          if r not in touched]]).max() == 0
+
+    def test_never_densified_through_full_step(self):
+        """The memory claim: grad -> clip -> optimizer applies without ever
+        materializing the [vocab, dim] dense gradient."""
+        emb, _ = _make_pair()
+        opt = optimizer.Adam(
+            learning_rate=0.1, parameters=[emb.weight], lazy_mode=True,
+            grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        emb(_ids()).sum().backward()
+        g = emb.weight.grad
+        opt.step()
+        opt.clear_grad()
+        assert isinstance(g, SelectedRows) and not g.is_densified()
+
+    def test_clip_matches_dense(self):
+        emb_s, emb_d = _make_pair()
+        clip_s = nn.ClipGradByGlobalNorm(0.01)
+        clip_d = nn.ClipGradByGlobalNorm(0.01)
+        opt_s = optimizer.SGD(learning_rate=1.0, parameters=[emb_s.weight],
+                              grad_clip=clip_s)
+        opt_d = optimizer.SGD(learning_rate=1.0, parameters=[emb_d.weight],
+                              grad_clip=clip_d)
+        x = _ids()
+        (emb_s(x) ** 2).sum().backward()
+        (emb_d(x) ** 2).sum().backward()
+        opt_s.step()
+        opt_d.step()
+        np.testing.assert_allclose(emb_s.weight.numpy(),
+                                   emb_d.weight.numpy(), rtol=1e-5)
+
+
+class TestDenseMutation:
+    def test_data_setter_resyncs_sparse_view(self):
+        """In-place dense mutation (GradScaler.unscale_, clip_grad_norm_
+        write g._data) must be visible to sparse consumers — a stale
+        merged() would apply pre-mutation values."""
+        g = SelectedRows(np.array([1, 1, 3]),
+                         np.array([[1.0], [2.0], [4.0]], np.float32), 5)
+        g._data = g._data * 0.5
+        rows, vals = g.merged()
+        dense = np.zeros((5, 1), np.float32)
+        for r, v in zip(rows, np.asarray(vals)):
+            dense[int(r)] = v
+        np.testing.assert_allclose(dense[1], [1.5])
+        np.testing.assert_allclose(dense[3], [2.0])
+
+    def test_grad_scaler_unscale_applies_to_sparse_step(self):
+        from paddle_tpu import amp
+        emb_s, emb_d = _make_pair()
+        opt_s = optimizer.SGD(learning_rate=0.1,
+                              parameters=[emb_s.weight])
+        opt_d = optimizer.SGD(learning_rate=0.1,
+                              parameters=[emb_d.weight])
+        scaler = amp.GradScaler(init_loss_scaling=1024.0)
+        x = _ids()
+        scaler.scale((emb_s(x) ** 2).sum()).backward()
+        scaler.step(opt_s)
+        scaler.update()
+        (emb_d(x) ** 2).sum().backward()
+        opt_d.step()
+        np.testing.assert_allclose(emb_s.weight.numpy(),
+                                   emb_d.weight.numpy(), rtol=1e-5)
+
+    def test_clip_grad_norm_applies_to_sparse_step(self):
+        emb, _ = _make_pair()
+        opt = optimizer.SGD(learning_rate=1.0, parameters=[emb.weight])
+        (emb(_ids()) ** 2).sum().backward()
+        w0 = emb.weight.numpy().copy()
+        nn.utils.clip_grad_norm_([emb.weight], max_norm=1e-4)
+        opt.step()
+        # with the tiny clip the update must be tiny too
+        assert np.abs(emb.weight.numpy() - w0).max() < 1e-3
+
+
+class TestCompatShims:
+    def test_get_tensor_from_selected_rows(self):
+        g = SelectedRows(np.array([0, 2]),
+                         np.array([[1.0, 1.0], [2.0, 2.0]], np.float32), 4)
+        t = paddle.get_tensor_from_selected_rows(g)
+        assert not isinstance(t, SelectedRows)
+        assert t.shape == [4, 2]
+        np.testing.assert_allclose(t.numpy()[2], [2.0, 2.0])
+
+    def test_merge_selected_rows_legacy(self):
+        from paddle_tpu.nn.functional import legacy
+        g = SelectedRows(np.array([1, 1]),
+                         np.array([[1.0], [2.0]], np.float32), 4)
+        m = legacy.merge_selected_rows(g)
+        assert isinstance(m, SelectedRows)
+        np.testing.assert_array_equal(np.asarray(m.rows), [1])
+        np.testing.assert_allclose(np.asarray(m.values), [[3.0]])
+
+
+class TestDoubleGrad:
+    def test_create_graph_falls_back_dense(self):
+        paddle.seed(0)
+        emb = nn.Embedding(8, 4, sparse=True)
+        x = paddle.to_tensor(np.array([[1, 2]], np.int64))
+        out = emb(x)
+        loss = (out ** 2).sum()
+        (g,) = paddle.grad([loss], [emb.weight], create_graph=True)
+        # second order: d/dw of sum(g*g) = ... runs through dense primal
+        gg = (g ** 2).sum()
+        gg.backward()
+        assert emb.weight.grad is not None
