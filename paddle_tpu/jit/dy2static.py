@@ -77,6 +77,15 @@ def convert_ifelse(pred, true_fn, false_fn):
                     f"variable {missing!r} is assigned in only one branch "
                     "of a tensor-predicated if/else — initialize it before "
                     "the if (reference: ifelse_transformer)")
+            if (tu is None) != (fu is None):
+                raise UnsupportedControlFlow(
+                    "a tensor-predicated if/else merges a tensor with "
+                    "None — e.g. a return value that exists on only one "
+                    "path, or a variable pre-initialized to None.  "
+                    "Initialize it to a tensor of the final shape/dtype "
+                    "before the branch (for return-in-loop: assign a "
+                    "result variable and break instead; reference: "
+                    "return_transformer.py)")
             if hasattr(tu, "dtype") or hasattr(fu, "dtype") or \
                     isinstance(tu, (int, float, bool)):
                 if jnp.asarray(tu).shape != jnp.asarray(fu).shape or \
@@ -160,11 +169,36 @@ def convert_while_loop(cond_fn, body_fn, loop_vars, names=()):
                 out = (out,)
             return tuple(_unwrap(o) for o in out)
 
-        final = lax.while_loop(cond, body, init)
+        try:
+            final = lax.while_loop(cond, body, init)
+        except TypeError as e:
+            msg = str(e)
+            if not any(k in msg for k in ("carry", "body_fun", "body "
+                                          "function", "while_loop")):
+                raise  # a genuine user TypeError from tracing the body
+            raise UnsupportedControlFlow(
+                "tensor-predicated loop carry changed structure/dtype "
+                "between iterations (e.g. a variable first bound inside "
+                "the loop, or a return-in-loop whose value has no "
+                "pre-loop binding).  Initialize every loop-carried "
+                f"variable before the loop.  [{e}]") from e
         return tuple(_rewrap_one(f) for f in final)
-    # plain Python loop
+    # plain Python loop.  The condition may BECOME traced mid-loop even
+    # though every initial loop var was concrete — e.g. an exit-flag
+    # rewrite whose break predicate reads a traced activation sets the
+    # flag to a where-merged tracer on iteration 1.  Iterations already
+    # executed are simply unrolled into the trace; the remainder
+    # re-dispatches onto the lax.while_loop path with the current values
+    # as the carry.
     vals = tuple(loop_vars)
-    while _plain_bool(cond_fn(*vals)):
+    while True:
+        c = cond_fn(*vals)
+        if _is_traced_tensor(c):
+            # (a traced accumulator with a still-Python condition keeps
+            # unrolling — that path stays differentiable)
+            return convert_while_loop(cond_fn, body_fn, vals, names)
+        if not _plain_bool(c):
+            break
         out = body_fn(*vals)
         vals = out if isinstance(out, tuple) else (out,)
     return vals
@@ -265,6 +299,25 @@ def _as_bool_tensor(x):
             return cast(x, "bool")
         return x
     return x
+
+
+def merge_return(ret_flag, ret_val, rest_fn):
+    """Post-loop merge for return-in-loop (reference:
+    dygraph_to_static/return_transformer.py RETURN_VALUE flag): if the
+    early-exit flag is set, the loop returned; otherwise run the rest of
+    the function.  A TRACED flag cannot pick a Python path — raise the
+    guided error (restructure with break + a pre-initialized result
+    variable, which threads through lax.while_loop)."""
+    if _is_traced_tensor(ret_flag):
+        raise UnsupportedControlFlow(
+            "return inside a loop with a tensor-dependent exit cannot be "
+            "lowered: the return value has no pre-loop binding for the "
+            "lax.while_loop carry.  Initialize a result variable before "
+            "the loop, assign it and `break` instead of returning "
+            "(reference: return_transformer.py)")
+    if _plain_bool(ret_flag):
+        return ret_val
+    return rest_fn()
 
 
 # ---------------------------------------------------------------------------
@@ -393,6 +446,312 @@ def _preamble(names, n):
                             [ast.Constant(name),
                              ast.Name(id=map_name, ctx=ast.Load())])))
     return stmts
+
+
+class _Exits:
+    __slots__ = ("brk", "cont", "ret_own", "ret_nested")
+
+    def __init__(self):
+        self.brk = self.cont = self.ret_own = self.ret_nested = False
+
+
+def _scan_exits(stmts):
+    """Exit statements of a loop body: break/continue bound to THIS loop
+    vs return in this loop's own scope vs return hiding inside a nested
+    loop.  Nested function scopes never count; nested loops capture
+    break/continue but not return."""
+    ex = _Exits()
+
+    def walk(node, in_nested_loop):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Break):
+                ex.brk = ex.brk or not in_nested_loop
+            elif isinstance(child, ast.Continue):
+                ex.cont = ex.cont or not in_nested_loop
+            elif isinstance(child, ast.Return):
+                if in_nested_loop:
+                    ex.ret_nested = True
+                else:
+                    ex.ret_own = True
+            walk(child, in_nested_loop
+                 or isinstance(child, (ast.While, ast.For)))
+
+    root = ast.Module(body=list(stmts), type_ignores=[])
+    walk(root, False)
+    return ex
+
+
+def _name(n, ctx=None):
+    return ast.Name(id=n, ctx=ctx or ast.Load())
+
+
+def _assign(n, value):
+    return ast.Assign(targets=[_name(n, ast.Store())], value=value)
+
+
+class _LoopBailout(Exception):
+    """Internal: this loop cannot be flag-rewritten; leave it as-is."""
+
+
+def _is_range_for(node):
+    """``for <Name> in range(a[, b[, c]]):`` with no else clause."""
+    it = node.iter
+    return (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+            and it.func.id == "range" and not it.keywords
+            and 1 <= len(it.args) <= 3
+            and isinstance(node.target, ast.Name) and not node.orelse)
+
+
+def _for_range_to_while(node, tag):
+    """Desugar ``for i in range(...)`` into (init stmts, While test,
+    i-binding stmt, increment stmt) — the single range-for lowering shared
+    by the exit pre-pass and the main transformer (reference:
+    loop_transformer converts for→while).  ``tag`` namespaces the
+    generated bindings.
+
+    A hidden iterator variable carries the position; ``i = _it`` at the
+    top of each iteration keeps the user's induction variable at the
+    LAST-YIELDED value after the loop (matching Python — including after
+    ``break``, where the unconditional increment only advances the hidden
+    variable).  Sole divergence: an empty range leaves ``i`` at start
+    instead of unbound."""
+    it = node.iter
+    i_name = node.target.id
+    if len(it.args) == 1:
+        start, stop, step = ast.Constant(0), it.args[0], ast.Constant(1)
+    elif len(it.args) == 2:
+        start, stop, step = it.args[0], it.args[1], ast.Constant(1)
+    else:
+        start, stop, step = it.args
+    stop_name, step_name = f"_d2s_stop{tag}", f"_d2s_step{tag}"
+    it_name = f"_d2s_it{tag}"
+    init = [_assign(stop_name, stop), _assign(step_name, step),
+            _assign(it_name, start), _assign(i_name, _name(it_name))]
+    test = _jst_call("range_cond", [_name(it_name), _name(stop_name),
+                                    _name(step_name)])
+    bind_i = _assign(i_name, _name(it_name))
+    incr = _assign(it_name, ast.BinOp(left=_name(it_name), op=ast.Add(),
+                                      right=_name(step_name)))
+    return init, test, bind_i, incr
+
+
+class _LoopExitTransformer(ast.NodeTransformer):
+    """Rewrites break / continue / return-in-loop into flag variables so
+    the main transformer sees exit-free loops (reference:
+    dygraph_to_static/break_continue_transformer.py and
+    return_transformer.py run before loop_transformer for the same
+    reason).
+
+    * ``break``    -> ``brk = True``; the loop condition gains a
+                      ``not brk and`` conjunct.
+    * ``continue`` -> ``cont = True``; ``cont`` resets each iteration and
+      statements after any flag-setting statement are wrapped in
+      ``if not (brk or cont):`` — the guard bubbles through enclosing
+      if/with blocks exactly like the reference's bubbling guards.
+    * ``return e`` -> ``ret, rv, brk = True, e, True``; handled only for
+      loops that are direct statements of the function body, where the
+      trailing code becomes a ``__d2s_rest`` closure merged via
+      ``_JST.merge_return`` after the loop.
+
+    The rewrite is semantics-preserving for plain Python execution, so
+    eager and converted runs stay identical; tensor-predicated flags then
+    lower through the ordinary if/while converters.
+    """
+
+    def __init__(self):
+        self.counter = 0
+        self.changed = False
+
+    # -- helpers ----------------------------------------------------------
+    def _flags(self):
+        n = self.counter
+        self.counter += 1
+        return (f"_d2s_brk{n}", f"_d2s_cont{n}", f"_d2s_ret{n}",
+                f"_d2s_rv{n}", n)
+
+    def _guard_test(self, flags_set):
+        """``not (f1 or f2)`` over the flags that may be set."""
+        flags = sorted(flags_set)
+        expr = _name(flags[0])
+        for f in flags[1:]:
+            expr = ast.BoolOp(op=ast.Or(), values=[expr, _name(f)])
+        return ast.UnaryOp(op=ast.Not(), operand=expr)
+
+    def _rewrite_block(self, stmts, brk, cont, ret, rv):
+        """Returns (new_stmts, set_flags) — set_flags nonempty when any
+        path through these statements may set an exit flag, in which case
+        the caller's trailing statements were already folded under a
+        guard here."""
+        out = []
+        for idx, s in enumerate(stmts):
+            new_s, set_flags = self._rewrite_stmt(s, brk, cont, ret, rv)
+            out.extend(new_s)
+            if set_flags:
+                rest = stmts[idx + 1:]
+                if rest:
+                    rest_new, rest_flags = self._rewrite_block(
+                        rest, brk, cont, ret, rv)
+                    out.append(ast.If(test=self._guard_test(set_flags),
+                                      body=rest_new, orelse=[]))
+                    set_flags = set_flags | rest_flags
+                return out, set_flags
+        return out, set()
+
+    def _rewrite_stmt(self, s, brk, cont, ret, rv):
+        if isinstance(s, ast.Break):
+            if brk is None:
+                raise _LoopBailout  # can't happen: scan found breaks
+            return [_assign(brk, ast.Constant(True))], {brk}
+        if isinstance(s, ast.Continue):
+            return [_assign(cont, ast.Constant(True))], {cont}
+        if isinstance(s, ast.Return):
+            if ret is None:
+                # return in a loop we chose not to convert for returns
+                raise _LoopBailout
+            return [_assign(ret, ast.Constant(True)),
+                    _assign(rv, s.value or ast.Constant(None)),
+                    _assign(brk, ast.Constant(True))], {brk}
+        if isinstance(s, ast.If):
+            body, bf = self._rewrite_block(s.body, brk, cont, ret, rv)
+            orelse, of = (self._rewrite_block(s.orelse, brk, cont, ret, rv)
+                          if s.orelse else ([], set()))
+            if not (bf or of):
+                return [s], set()
+            return [ast.If(test=s.test, body=body, orelse=orelse)], bf | of
+        if isinstance(s, ast.With):
+            body, bf = self._rewrite_block(s.body, brk, cont, ret, rv)
+            if not bf:
+                return [s], set()
+            return [ast.With(items=s.items, body=body)], bf
+        if isinstance(s, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+            # exits inside try/finally interact with handler semantics;
+            # leave such loops to the trace path
+            if _has([s], (ast.Break, ast.Continue, ast.Return)):
+                raise _LoopBailout
+            return [s], set()
+        # nested loops own their break/continue (already rewritten
+        # bottom-up); returns inside them were bailed on by the caller
+        return [s], set()
+
+    def _convert_loop(self, node, with_return):
+        """Common flag rewrite for While and desugared For-range."""
+        brk, cont, ret, rv, n = self._flags()
+        ex = _scan_exits(node.body)
+        has_brk = ex.brk or (with_return and ex.ret_own)
+        body, _ = self._rewrite_block(
+            node.body, brk if has_brk else None, cont,
+            ret if with_return else None, rv)
+        new_body = ([_assign(cont, ast.Constant(False))] if ex.cont
+                    else []) + body
+        test = node.test
+        if has_brk:
+            test = ast.BoolOp(
+                op=ast.And(),
+                values=[ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
+                        test])
+        pre = []
+        if has_brk:
+            pre.append(_assign(brk, ast.Constant(False)))
+        if ex.cont:
+            # the reset inside the body makes cont loop-carried state; it
+            # needs a pre-loop binding for the traced while carry
+            pre.append(_assign(cont, ast.Constant(False)))
+        if with_return:
+            pre.extend([_assign(ret, ast.Constant(False)),
+                        _assign(rv, ast.Constant(None))])
+        new_loop = ast.While(test=test, body=new_body, orelse=[])
+        self.changed = True
+        return pre, new_loop, (ret, rv, n)
+
+    # -- loop visitors (break/continue only; returns handled at the
+    #    function level where the trailing code is visible) --------------
+    def _maybe_convert(self, node, with_return=False):
+        ex = _scan_exits(node.body)
+        if ex.ret_nested or (ex.ret_own and not with_return):
+            return None  # leave untouched -> trace fallback
+        if not (ex.brk or ex.cont or (with_return and ex.ret_own)):
+            return None
+        if isinstance(node, ast.While):
+            if node.orelse:
+                return None
+            try:
+                return self._convert_loop(node, with_return and ex.ret_own)
+            except _LoopBailout:
+                return None
+        if isinstance(node, ast.For):
+            if not _is_range_for(node):
+                return None  # python-iterable loops keep native exits
+            init, test, bind_i, incr = _for_range_to_while(
+                node, self.counter)
+            # bind_i runs before the (guard-rewritten) user body; the
+            # hidden-iterator increment runs unconditionally after it —
+            # break leaves the user's induction variable at its
+            # break-iteration value while only _it advances
+            as_while = ast.While(test=test, body=[bind_i, *node.body],
+                                 orelse=[])
+            try:
+                pre, loop, retinfo = self._convert_loop(
+                    as_while, with_return and ex.ret_own)
+            except _LoopBailout:
+                return None
+            loop.body.append(incr)
+            return init + pre, loop, retinfo
+        return None
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        res = self._maybe_convert(node)
+        if res is None:
+            return node
+        pre, loop, _ = res
+        return [ast.fix_missing_locations(ast.copy_location(s, node))
+                for s in (*pre, loop)]
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        res = self._maybe_convert(node)
+        if res is None:
+            return node
+        pre, loop, _ = res
+        return [ast.fix_missing_locations(ast.copy_location(s, node))
+                for s in (*pre, loop)]
+
+    def visit_FunctionDef(self, node):
+        """Top-level loops may additionally convert `return`: the code
+        after the loop becomes a closure merged through merge_return."""
+        self.generic_visit(node)  # converts break/continue everywhere
+        body = node.body
+        for idx, s in enumerate(body):
+            if not isinstance(s, (ast.While, ast.For)):
+                continue
+            ex = _scan_exits(s.body)
+            if not ex.ret_own or ex.ret_nested:
+                continue
+            res = self._maybe_convert(s, with_return=True)
+            if res is None:
+                continue
+            pre, loop, retinfo = res
+            ret, rv, n = retinfo
+            rest_stmts = body[idx + 1:] or [ast.Pass()]
+            rest_name = f"__d2s_rest_{n}"
+            rest_fn = ast.FunctionDef(
+                name=rest_name,
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=list(rest_stmts), decorator_list=[])
+            # a later return-loop now lives inside the closure — convert
+            # it there too (idempotent on already-rewritten loops)
+            rest_fn = self.visit_FunctionDef(rest_fn)
+            merge = ast.Return(value=_jst_call(
+                "merge_return", [_name(ret), _name(rv), _name(rest_name)]))
+            new_body = [*body[:idx], *pre, loop, rest_fn, merge]
+            node.body = [ast.fix_missing_locations(
+                ast.copy_location(st, s)) for st in new_body]
+            break
+        return node
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
@@ -530,86 +889,34 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     # -- for over range() -------------------------------------------------
     def visit_For(self, node):
-        """``for i in range(...)`` → while form (reference:
-        loop_transformer converts for→while); a tensor bound then lowers
-        through convert_while_loop.  Non-range iterables (lists,
-        LayerList, tensors) keep Python semantics — iterating a module
-        list is the common case and must trace-unroll.
+        """``for i in range(...)`` → while form via the shared
+        ``_for_range_to_while`` desugar (reference: loop_transformer
+        converts for→while); a tensor bound then lowers through
+        convert_while_loop.  Non-range iterables (lists, LayerList,
+        tensors) keep Python semantics — iterating a module list is the
+        common case and must trace-unroll.
 
-        Known divergence (same as the reference's transformer): after
-        the loop the induction variable holds the one-past value
-        (start + step*n), not Python's last-yielded value."""
+        After the loop the induction variable holds Python's
+        last-yielded value (the hidden-iterator desugar); the sole
+        divergence is an empty range, which leaves it at start instead
+        of unbound."""
         self.generic_visit(node)
-        it = node.iter
-        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
-                and it.func.id == "range" and not it.keywords
-                and 1 <= len(it.args) <= 3
-                and isinstance(node.target, ast.Name)
-                and not node.orelse
+        if not (_is_range_for(node)
                 and not _has(node.body, (ast.Break, ast.Continue,
                                          ast.Return))):
             return node
-        n = self.counter
-        self.counter += 1
-        i_name = node.target.id
-        if len(it.args) == 1:
-            start, stop, step = (ast.Constant(0), it.args[0],
-                                 ast.Constant(1))
-        elif len(it.args) == 2:
-            start, stop, step = (it.args[0], it.args[1], ast.Constant(1))
-        else:
-            start, stop, step = it.args[0], it.args[1], it.args[2]
-        stop_name, step_name = f"__d2s_stop_{n}", f"__d2s_step_{n}"
-        init = [
-            ast.Assign(targets=[ast.Name(id=stop_name, ctx=ast.Store())],
-                       value=stop),
-            ast.Assign(targets=[ast.Name(id=step_name, ctx=ast.Store())],
-                       value=step),
-            ast.Assign(targets=[ast.Name(id=i_name, ctx=ast.Store())],
-                       value=start),
-        ]
-        loop_vars = [i_name] + [a for a in _assigned_names(node.body)
-                                if a != i_name]
-        cond_name, body_name = f"__d2s_fcond_{n}", f"__d2s_fbody_{n}"
-        args = ast.arguments(
-            posonlyargs=[], args=[ast.arg(arg=a) for a in loop_vars],
-            kwonlyargs=[], kw_defaults=[], defaults=[])
-        cond_fn = ast.FunctionDef(
-            name=cond_name, args=args,
-            body=[ast.Return(value=_jst_call(
-                "range_cond",
-                [ast.Name(id=i_name, ctx=ast.Load()),
-                 ast.Name(id=stop_name, ctx=ast.Load()),
-                 ast.Name(id=step_name, ctx=ast.Load())]))],
-            decorator_list=[])
-        incr = ast.Assign(
-            targets=[ast.Name(id=i_name, ctx=ast.Store())],
-            value=ast.BinOp(left=ast.Name(id=i_name, ctx=ast.Load()),
-                            op=ast.Add(),
-                            right=ast.Name(id=step_name, ctx=ast.Load())))
-        ret_tuple = ast.Tuple(
-            elts=[ast.Name(id=a, ctx=ast.Load()) for a in loop_vars],
-            ctx=ast.Load())
-        body_fn = ast.FunctionDef(
-            name=body_name, args=args,
-            body=[*node.body, incr, ast.Return(value=ret_tuple)],
-            decorator_list=[])
-        call = _jst_call(
-            "convert_while_loop",
-            [ast.Name(id=cond_name, ctx=ast.Load()),
-             ast.Name(id=body_name, ctx=ast.Load()),
-             ast.Tuple(elts=[ast.Name(id=a, ctx=ast.Load())
-                             for a in loop_vars], ctx=ast.Load()),
-             ast.Tuple(elts=[ast.Constant(a) for a in loop_vars],
-                       ctx=ast.Load())])
-        target = ast.Tuple(
-            elts=[ast.Name(id=a, ctx=ast.Store()) for a in loop_vars],
-            ctx=ast.Store())
-        out = [*_preamble([a for a in loop_vars if a != i_name], n),
-               *init, cond_fn, body_fn,
-               ast.Assign(targets=[target], value=call)]
-        return [ast.fix_missing_locations(ast.copy_location(s, node))
-                for s in out]
+        # "c"-tagged stop/step names cannot collide with the exit
+        # pre-pass's numeric tags
+        init, test, bind_i, incr = _for_range_to_while(
+            node, f"c{self.counter}")
+        as_while = ast.While(test=test, body=[bind_i, *node.body, incr],
+                             orelse=[])
+        converted = self.visit_While(ast.copy_location(as_while, node))
+        if converted is as_while:  # visit_While bailed (cannot happen for
+            return node            # exit-free bodies, but stay safe)
+        init = [ast.fix_missing_locations(ast.copy_location(s, node))
+                for s in init]
+        return [*init, *converted]
 
     # -- bool ops ---------------------------------------------------------
     def visit_BoolOp(self, node):
@@ -669,9 +976,11 @@ def convert_function(fn):
         # would freeze to decoration-time snapshots and super() would
         # lose its cell entirely.  Fall back to the trace path.
         return None
+    exits = _LoopExitTransformer()
+    tree = exits.visit(tree)
     transformer = _ControlFlowTransformer()
     new_tree = transformer.visit(tree)
-    if transformer.counter == 0:
+    if transformer.counter == 0 and not exits.changed:
         return None  # nothing to convert — tracing alone is enough
     ast.fix_missing_locations(new_tree)
     code = compile(new_tree, f"<dy2static:{fn.__qualname__}>", "exec")

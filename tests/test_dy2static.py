@@ -377,3 +377,318 @@ class TestReviewRegressions:
         st = to_static(net)
         static = float(st(xv).numpy())
         np.testing.assert_allclose(eager, static, rtol=1e-5)
+
+
+class BreakWhileNet(nn.Layer):
+    """Tensor-dependent break (reference:
+    break_continue_transformer.py test_break_continue.py patterns)."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(3, 3)
+
+    def forward(self, x):
+        h = self.fc(x)
+        s = paddle.zeros([3], "float32")
+        i = paddle.to_tensor(np.zeros((), np.float32))
+        while i < 10.0:
+            s = s + paddle.mean(h, axis=0)
+            if paddle.sum(s) > 3.0:
+                break
+            i = i + 1.0
+        return paddle.sum(s) + i
+
+
+class ContinueForNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(3, 3)
+
+    def forward(self, x):
+        h = self.fc(x)
+        s = paddle.zeros([], "float32")
+        t = paddle.zeros([], "float32")
+        for i in range(6):
+            if paddle.sum(h) > 0:
+                s = s + paddle.mean(h)
+                continue
+            t = t + 1.0
+        return s - t
+
+
+class BreakContinueForNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(3, 3)
+
+    def forward(self, x):
+        h = self.fc(x)
+        s = paddle.zeros([], "float32")
+        skipped = paddle.zeros([], "float32")
+        for i in range(8):
+            if paddle.mean(h) * (i + 1) > 2.0:
+                break
+            if paddle.sum(h) < 0:
+                skipped = skipped + 1.0
+                continue
+            s = s + paddle.mean(h)
+        return s * 10.0 + skipped
+
+
+class TestBreakContinue:
+    def test_tensor_break_in_while(self):
+        x = np.random.RandomState(3).randn(2, 3).astype(np.float32)
+        _eager_vs_static(BreakWhileNet, x)
+
+    def test_tensor_continue_in_for(self):
+        for seed in (0, 7):  # exercises both the continue and else path
+            x = np.random.RandomState(seed).randn(2, 3).astype(np.float32)
+            _eager_vs_static(ContinueForNet, x)
+
+    def test_tensor_break_and_continue_in_for(self):
+        for seed in (0, 5, 11):
+            x = np.random.RandomState(seed).randn(2, 3).astype(np.float32)
+            _eager_vs_static(BreakContinueForNet, x)
+
+    def test_python_break_continue_semantics_preserved(self):
+        """The flag rewrite must be a no-op semantically for plain
+        Python values (conversion happens, control flow identical)."""
+
+        def g(n):
+            total = 0
+            hit = 0
+            for i in range(n):
+                if i == 3:
+                    continue
+                if i > 6:
+                    break
+                total = total + i
+            while total > 0:
+                total = total - 5
+                if total < -2:
+                    break
+                hit = hit + 1
+            return total, hit
+
+        conv = convert_function(g)
+        assert conv is not None
+        for n in (0, 1, 5, 10):
+            assert conv(n) == g(n), n
+
+    def test_nested_loop_break_binds_inner(self):
+        def g(n):
+            out = []
+            for i in range(n):
+                for j in range(10):
+                    if j >= i:
+                        break
+                    out.append((i, j))
+                if i > 2:
+                    break
+            return out
+
+        conv = convert_function(g)
+        assert conv is not None
+        assert conv(6) == g(6)
+
+    def test_trailing_statements_guarded(self):
+        """Statements after a conditional break must not run once the
+        flag is set — the bubbling guard."""
+
+        def g(xs):
+            seen = 0
+            for i in range(len(xs)):
+                if xs[i] < 0:
+                    break
+                seen = seen + 1
+            return seen
+
+        conv = convert_function(g)
+        assert conv is not None
+        assert conv([1, 2, -1, 4]) == 2
+        assert conv([1, 2]) == 2
+
+    def test_break_in_try_falls_back(self):
+        """Exits inside try interact with handler semantics — the loop
+        stays unconverted (Python behavior preserved)."""
+
+        def g(n):
+            s = 0
+            for i in range(n):
+                try:
+                    if i > 2:
+                        break
+                    s += i
+                except ValueError:
+                    pass
+            return s
+
+        conv = convert_function(g)
+        # conversion may return None (nothing else converted); either
+        # way Python semantics hold
+        fn = conv or g
+        assert fn(6) == g(6)
+
+
+class TestReturnInLoop:
+    def test_python_pred_return_in_loop(self):
+        def g(n):
+            acc = 0
+            for i in range(n):
+                acc = acc + i
+                if acc > 5:
+                    return acc * 100
+            return acc
+
+        conv = convert_function(g)
+        assert conv is not None
+        for n in (0, 2, 4, 8):
+            assert conv(n) == g(n), n
+
+    def test_return_in_while_with_trailing_code(self):
+        def g(x):
+            i = 0
+            while i < 10:
+                i = i + 1
+                if i * x > 12:
+                    return -1
+            y = i * 2
+            return y
+
+        conv = convert_function(g)
+        assert conv is not None
+        assert conv(5) == g(5) == -1
+        assert conv(0) == g(0) == 20
+
+    def test_eager_tensor_pred_return_in_loop(self):
+        """Eager (concrete) tensor predicates pick real branches, so
+        return-in-loop works without tracing."""
+
+        def g(h):
+            s = paddle.zeros([], "float32")
+            for i in range(6):
+                s = s + paddle.mean(h)
+                if paddle.sum(s) > 2.0:
+                    return s * 10.0
+            return s
+
+        conv = convert_function(g)
+        assert conv is not None
+        h = paddle.to_tensor(np.full((3,), 1.5, np.float32))
+        np.testing.assert_allclose(conv(h).numpy(), g(h).numpy())
+        h2 = paddle.to_tensor(np.full((3,), -0.1, np.float32))
+        np.testing.assert_allclose(conv(h2).numpy(), g(h2).numpy())
+
+    def test_traced_tensor_return_raises_guided(self):
+        class RetNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(3, 3)
+
+            def forward(self, x):
+                h = self.fc(x)
+                s = paddle.zeros([], "float32")
+                for i in range(4):
+                    s = s + paddle.mean(h)
+                    if paddle.sum(s) > 1.0:
+                        return s * 2.0
+                return s
+
+        paddle.seed(0)
+        net = RetNet()
+        st = to_static(net)
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        with pytest.raises(Exception) as ei:
+            st(x)
+        assert "result variable" in str(ei.value) or \
+            "pre-loop binding" in str(ei.value) or \
+            "Initialize" in str(ei.value)
+
+    def test_return_in_nested_loop_falls_back(self):
+        def g(n):
+            for i in range(n):
+                for j in range(n):
+                    if i * j > 4:
+                        return i + j
+            return -1
+
+        conv = convert_function(g)
+        fn = conv or g
+        assert fn(4) == g(4)
+        assert fn(1) == g(1)
+
+
+class TestExitReviewRegressions:
+    def test_induction_value_after_break(self):
+        """break leaves i at the break-iteration value, not one-past."""
+
+        def g():
+            for i in range(10):
+                if i == 3:
+                    break
+            return i
+
+        conv = convert_function(g)
+        assert conv is not None
+        assert conv() == g() == 3
+
+    def test_induction_value_after_break_negative_step(self):
+        def g():
+            for i in range(9, -1, -2):
+                if i < 4:
+                    break
+            return i
+
+        conv = convert_function(g)
+        assert conv is not None
+        assert conv() == g() == 3
+
+    def test_tensor_break_without_tensor_carry(self):
+        """Loop vars start all-Python; the flag becomes traced on
+        iteration 1 and the loop must re-dispatch, not crash."""
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(3, 3)
+
+            def forward(self, x):
+                h = self.fc(x)
+                for i in range(8):
+                    if paddle.mean(h) * (i + 1) > 2.0:
+                        break
+                return paddle.mean(h) * i
+
+        for seed, scale in ((0, 3.0), (1, 0.01)):
+            x = np.full((2, 3), scale, np.float32)
+            paddle.seed(seed)
+            net = Net()
+            eager = float(net(x if isinstance(x, np.ndarray) else x).numpy()
+                          if not isinstance(x, np.ndarray)
+                          else net(paddle.to_tensor(x)).numpy())
+            st = to_static(net)
+            comp = float(st(paddle.to_tensor(x)).numpy())
+            np.testing.assert_allclose(eager, comp, rtol=1e-5)
+
+    def test_user_typeerror_not_relabeled(self):
+        """A genuine TypeError from the loop body surfaces as-is, not as
+        the carry-mismatch guidance."""
+
+        def g(x):
+            i = paddle.to_tensor(np.zeros((), np.float32))
+            while i < 3.0:
+                len(None)  # user bug
+                i = i + 1.0
+            return i
+
+        conv = convert_function(g)
+        assert conv is not None
+        import jax
+
+        def traced(a):
+            from paddle_tpu.core.tensor import Tensor
+            return conv(Tensor(a))
+
+        with pytest.raises(TypeError) as ei:
+            jax.eval_shape(traced, jax.ShapeDtypeStruct((), np.float32))
+        assert "loop carry" not in str(ei.value)
