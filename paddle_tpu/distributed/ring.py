@@ -50,7 +50,10 @@ def _block_attn(q, k, v, scale, mask_mode, drop_key=None, dropout_p=0.0):
     s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
     if mask_mode == 1:
         sq, sk = s.shape[-2], s.shape[-1]
-        causal = jnp.tril(jnp.ones((sq, sk), bool))
+        # sk - sq offset aligns the diagonal when query/key lengths
+        # differ (decode-style calls); identical to _reference_attention.
+        # Ring blocks always have sq == sk, where this is plain tril.
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         s = jnp.where(causal, s, -jnp.inf)
     m = jnp.max(s, axis=-1)                     # [B,H,Sq]
     m = jnp.maximum(m, -1e30)                   # avoid -inf - -inf
@@ -61,6 +64,18 @@ def _block_attn(q, k, v, scale, mask_mode, drop_key=None, dropout_p=0.0):
         p = p * keep / (1.0 - dropout_p)
     o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
     return o, m, l
+
+
+def _single_block_attention(q, k, v, scale, causal, drop_key, dropout_p):
+    """Full (non-ring) attention with probs-dropout in [B, S, H, D]
+    layout — the degenerate-ring and Ulysses-local code path."""
+    o, _, l = _block_attn(q, k, v,
+                          scale if scale is not None else
+                          1.0 / math.sqrt(q.shape[-1]),
+                          mask_mode=1 if causal else 0,
+                          drop_key=drop_key, dropout_p=dropout_p)
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
 # Bounded LRU of jitted shard_map calls.  The compiled fn closes over the
@@ -80,8 +95,16 @@ def _get_placeholder_key():
     return _placeholder_key
 
 
+def _mesh_cache_key(mesh):
+    """Value-based mesh identity: axis names/sizes + device ids.  Keying
+    on id(mesh) would let a recreated mesh at a recycled address alias a
+    stale compiled entry."""
+    return (tuple(mesh.shape.items()),
+            tuple(d.id for d in mesh.devices.flat))
+
+
 def _cached_sp_call(mesh, subkey, build):
-    key = (id(mesh), subkey)
+    key = (_mesh_cache_key(mesh), subkey)
     if key in _ring_jit_cache:
         _ring_jit_cache.move_to_end(key)
         return _ring_jit_cache[key][1]
@@ -222,14 +245,8 @@ def ring_attention(query, key, value, axis="sp", causal=False, scale=None,
         # degenerate ring (one block): single-block attention with
         # probs-dropout — the same math the ring applies per block
         if dropout_p > 0.0 and rng_key is not None:
-            o, m, l = _block_attn(q, k, v,
-                                  scale if scale is not None else
-                                  1.0 / math.sqrt(q.shape[-1]),
-                                  mask_mode=1 if causal else 0,
-                                  drop_key=rng_key,
-                                  dropout_p=dropout_p)
-            out = (o / jnp.maximum(l[..., None], 1e-30))
-            return Tensor(jnp.swapaxes(out, 1, 2).astype(q.dtype))
+            return Tensor(_single_block_attention(
+                q, k, v, scale, causal, rng_key, dropout_p))
         from ..nn.functional.attention import _reference_attention
         return Tensor(_reference_attention(q, k, v, None, scale, causal))
 
@@ -267,35 +284,33 @@ def ring_attention(query, key, value, axis="sp", causal=False, scale=None,
 
 
 def ulysses_attention(query, key, value, axis="sp", causal=False,
-                      scale=None, mesh=None):
+                      scale=None, mesh=None, dropout_p=0.0, rng_key=None):
     """DeepSpeed-Ulysses style context parallelism: all_to_all swaps the
     sharded axis from sequence to heads, runs full-sequence attention on
     1/N of the heads, then swaps back.  Lower comm volume than ring when
-    heads % N == 0.  NEW capability (absent in reference)."""
+    heads % N == 0.  NEW capability (absent in reference).
+
+    ``dropout_p``/``rng_key``: attention-probability dropout applied in
+    the LOCAL attention after the all-to-all — each device drops its own
+    head shard with a key folded over its mesh coordinates (this axis
+    plus every other >1 axis), so no two shards share a mask and the
+    global pattern matches single-device semantics (independent
+    Bernoulli per (b, h, q, k))."""
     q = ensure_tensor(query)._data
     k = ensure_tensor(key)._data
     v = ensure_tensor(value)._data
     mesh = mesh or mesh_mod.ensure_mesh()
     n = mesh.shape.get(axis, 1)
+    use_drop = dropout_p > 0.0 and rng_key is not None
     if n == 1:
+        if use_drop:
+            # same probs-dropout math the sharded path applies locally
+            return Tensor(_single_block_attention(
+                q, k, v, scale, causal, rng_key, dropout_p))
         from ..nn.functional.attention import _reference_attention
         return Tensor(_reference_attention(q, k, v, None, scale, causal))
 
     from ..nn.functional.attention import _reference_attention
-
-    def local(q, k, v):
-        # local: [B, S/n, H, D] -> a2a -> [B, S, H/n, D]
-        def seq2head(x):
-            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
-                                  tiled=True)
-
-        def head2seq(x):
-            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
-                                  tiled=True)
-
-        qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
-        out = _reference_attention(qg, kg, vg, None, scale, causal)
-        return head2seq(out)
 
     orig = q
     spec, q, k, v = _sp_place_and_spec(mesh, axis, q, k, v,
@@ -310,12 +325,40 @@ def ulysses_attention(query, key, value, axis="sp", causal=False,
             f"ulysses_attention: local head count {local_heads} is not "
             f"divisible by the '{axis}' degree {n} — use ring attention "
             "(use_sp=True) for head counts the all-to-all cannot split")
+    if not use_drop:
+        rng_key = _get_placeholder_key()  # ignored by the kernel
 
     def build():
-        fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+        fold_axes = tuple(a for a in mesh.shape
+                          if mesh.shape[a] > 1 and a != axis)
+
+        def local(q, k, v, rk):
+            # local: [B, S/n, H, D] -> a2a -> [B, S, H/n, D]
+            def seq2head(x):
+                return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+            def head2seq(x):
+                return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+            qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+            if use_drop:
+                dkey = jax.random.fold_in(rk, lax.axis_index(axis))
+                for fa in fold_axes:
+                    dkey = jax.random.fold_in(dkey, lax.axis_index(fa))
+                out = _single_block_attention(qg, kg, vg, scale, causal,
+                                              dkey, dropout_p)
+            else:
+                out = _reference_attention(qg, kg, vg, None, scale, causal)
+            return head2seq(out)
+
+        fn = shard_map(local, mesh=mesh,
+                       in_specs=(spec, spec, spec, P()),
                        out_specs=spec, check_vma=False)
         return jax.jit(fn)
 
     call = _cached_sp_call(mesh, ("ulysses", axis, bool(causal), scale,
-                                  spec), build)
-    return Tensor(_localize_eager(call(q, k, v), orig))
+                                  spec, use_drop,
+                                  dropout_p if use_drop else 0.0), build)
+    return Tensor(_localize_eager(call(q, k, v, rng_key), orig))

@@ -76,20 +76,14 @@ class GPTAttention(nn.Layer):
         self.hidden_size = hidden_size
         self.dropout = dropout
         self.use_mp = use_mp
-        # sequence parallelism: the ring variant applies per-block
-        # attention-probability dropout; ulysses skips it (warned below)
+        # sequence parallelism: both variants apply attention-probability
+        # dropout — the ring per (device, ring-step) block, ulysses in the
+        # local attention after the all-to-all (distributed/ring.py)
         if use_sp not in (False, True, "ring", "ulysses"):
             raise ValueError(
                 f"use_sp={use_sp!r}: expected False, True/'ring', or "
                 "'ulysses'")
         self.use_sp = use_sp
-        if use_sp == "ulysses" and dropout:
-            import warnings
-            warnings.warn(
-                "GPTAttention(use_sp='ulysses'): attention-probability "
-                f"dropout ({dropout}) is skipped under the all-to-all "
-                "variant; the ring variant (use_sp=True) applies "
-                "per-block probs dropout")
         init = nn.ParamAttr(initializer=I.Normal(0.0, 0.02))
         if use_mp:
             # Einsum-form head-parallel projections: weights carry the head
@@ -190,21 +184,23 @@ class GPTAttention(nn.Layer):
             # lax.scan).  use_sp='ulysses': all-to-all swaps seq<->head
             # sharding (lower comm volume when heads % sp == 0).  NEW
             # capability vs the reference (§5.7).
+            from ..core import rng as _rng
+            dp = self.dropout if (self.training and self.dropout) else 0.0
+            rk = _rng.op_key(q) if dp else None
+            try:
+                from ..static import program as _sprog
+                if isinstance(rk, _sprog.Variable):
+                    rk, dp = None, 0.0  # static-graph symbolic key
+            except ImportError:
+                pass
             if self.use_sp == "ulysses":
+                # probs-dropout applies in the local attention after the
+                # all-to-all, per-device keys folded over mesh coords
                 from ..distributed.ring import ulysses_attention
-                out = ulysses_attention(q, k, v, axis="sp", causal=True)
+                out = ulysses_attention(q, k, v, axis="sp", causal=True,
+                                        dropout_p=dp, rng_key=rk)
             else:
                 from ..distributed.ring import ring_attention
-                from ..core import rng as _rng
-                dp = self.dropout if (self.training and self.dropout) \
-                    else 0.0
-                rk = _rng.op_key(q) if dp else None
-                try:
-                    from ..static import program as _sprog
-                    if isinstance(rk, _sprog.Variable):
-                        rk, dp = None, 0.0  # static-graph symbolic key
-                except ImportError:
-                    pass
                 out = ring_attention(q, k, v, axis="sp", causal=True,
                                      dropout_p=dp, rng_key=rk)
         else:
