@@ -22,8 +22,26 @@ import jax
 _prng_impl = os.environ.get("PADDLE_TPU_PRNG", "rbg")
 if _prng_impl != "threefry":
     try:
+        # an import side effect that changes random streams process-wide
+        # deserves a trace: WARNING (visible under default logging) when
+        # it clobbers a value someone else configured, INFO otherwise —
+        # a stderr line on every ordinary import would be noise
+        import logging
+        _prev = getattr(jax.config, "jax_default_prng_impl",
+                        "threefry2x32")
         jax.config.update("jax_default_prng_impl", _prng_impl)
-    except Exception:
+        _log = logging.getLogger(__name__)
+        _msg = ("paddle_tpu set jax_default_prng_impl=%s (TPU hardware "
+                "RNG; random streams differ from threefry-based runs — "
+                "opt out with PADDLE_TPU_PRNG=threefry)")
+        if _prev not in ("threefry2x32", _prng_impl):
+            _log.warning(_msg + " [overrode existing setting %r]",
+                         _prng_impl, _prev)
+        else:
+            _log.info(_msg, _prng_impl)
+    except AttributeError:
+        # only "this jax has no such config knob" is ignorable; anything
+        # else (e.g. an invalid PADDLE_TPU_PRNG value) must surface
         pass
 
 _lock = threading.Lock()

@@ -392,6 +392,7 @@ class DataLoader:
         self._use_threads = _os.environ.get(
             "PADDLE_TPU_THREAD_WORKERS", "0") == "1"
         self._pool = None
+        self._live_pools = []  # every pool ever spawned and not yet closed
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -423,13 +424,18 @@ class DataLoader:
 
     def _get_pool(self):
         from .worker import WorkerPool
-        if self._pool is not None and not self._pool._closed and \
-                not self._pool.busy:
-            return self._pool
-        # a second concurrent iterator gets its OWN pool: sharing one
-        # result queue across generations would drop/unlink each other's
-        # batches and deadlock both iterators
+        # reuse ANY idle live pool (not just self._pool): with
+        # persistent_workers, the extra pools spawned for concurrent
+        # iterators must be recycled, not accumulate one per epoch
+        self._live_pools = [p for p in self._live_pools if not p._closed]
+        for pool in self._live_pools:
+            if not pool.busy:
+                return pool
+        # all pools busy: a second concurrent iterator gets its OWN pool —
+        # sharing one result queue across generations would drop/unlink
+        # each other's batches and deadlock both iterators
         pool = WorkerPool(self)
+        self._live_pools.append(pool)
         if self._pool is None or self._pool._closed:
             self._pool = pool
         return pool
@@ -447,23 +453,13 @@ class DataLoader:
                 return _PrefetchIter(self, batches)
             pool = self._get_pool()
             mp_it = MultiprocessMapIter(self, batches, pool)
-            return self._wrap_mp(mp_it, pool)
+            return _MPIterGuard(self, mp_it, pool)
         return self._iter_sync(batches)
 
-    def _wrap_mp(self, mp_it, pool):
-        try:
-            for data in mp_it:
-                yield _to_tensors(data, self.return_list)
-        finally:
-            pool.busy = False
-            if not self.persistent_workers:
-                pool.close()
-                if self._pool is pool:
-                    self._pool = None
-
     def __del__(self):
-        pool = getattr(self, "_pool", None)
-        if pool is not None:
+        # close EVERY pool this loader ever spawned — extra pools created
+        # for concurrent iterators must not outlive the loader
+        for pool in list(getattr(self, "_live_pools", ())):
             try:
                 pool.close()
             except Exception:
@@ -473,6 +469,54 @@ class DataLoader:
         for idx_batch in batches:
             samples = [self.dataset[i] for i in idx_batch]
             yield _to_tensors(self.collate_fn(samples), self.return_list)
+
+
+class _MPIterGuard:
+    """Deterministic WorkerPool release for a multiprocess iterator.
+
+    A plain generator's ``finally`` only runs once the generator has
+    STARTED — an iterator obtained and then abandoned before the first
+    ``next()`` would leave ``pool.busy`` stuck True, so every later epoch
+    spawned (and leaked) a fresh pool of worker processes.  This wrapper
+    releases the pool on exhaustion AND on garbage collection, started or
+    not."""
+
+    def __init__(self, loader, mp_it, pool):
+        self.loader = loader
+        self.mp_it = mp_it
+        self.pool = pool
+        self._released = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return _to_tensors(next(self.mp_it), self.loader.return_list)
+        except BaseException:
+            self._release()
+            raise
+
+    def _release(self):
+        if self._released:
+            return
+        self._released = True
+        loader, pool = self.loader, self.pool
+        pool.busy = False
+        if not loader.persistent_workers:
+            try:
+                pool.close()
+            finally:
+                if loader._pool is pool:
+                    loader._pool = None
+                if pool in loader._live_pools:
+                    loader._live_pools.remove(pool)
+
+    def __del__(self):
+        try:
+            self._release()
+        except Exception:
+            pass
 
 
 from .worker import get_worker_info, WorkerInfo  # noqa: E402

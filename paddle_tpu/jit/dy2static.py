@@ -49,7 +49,18 @@ def convert_ifelse(pred, true_fn, false_fn):
     Traced path: both branches are traced and merged leafwise with
     ``lax.select`` (the canonical XLA lowering of a scalar-predicated
     branch; avoids lax.cond's pytree-structure pitfalls while XLA still
-    DCEs whichever side is dead under constant folding)."""
+    DCEs whichever side is dead under constant folding).
+
+    .. warning:: Under a TRACED tensor predicate BOTH branches always
+       execute — unlike the reference's real-branch dispatch.  A branch
+       guarding numerically unsafe math (``if s > 0: y = 1/s``) still
+       evaluates the unsafe side, and the where-gradient trap propagates
+       NaN/Inf *gradients* from the unselected branch even though the
+       forward value is correct.  Guard unsafe math inside the branch
+       itself (``1/jnp.where(s > 0, s, 1)``-style "double-where"), or
+       keep the predicate a Python value so the branch dispatches for
+       real.  Eager (concrete) tensor predicates are unaffected — they
+       pick one branch."""
     if _is_traced_tensor(pred):
         import jax.numpy as jnp
         from ..ops import where as _ops_where, reshape as _ops_reshape

@@ -273,6 +273,41 @@ def build_pipeline_1f1b_fn(pipe_layer, num_microbatches, loss_fn,
     def stage_fwd(sp, sb, x):
         return _run_stage(template, pnames, bnames, sp, sb, x, training)
 
+    _buf_check_done = []
+
+    def _check_recompute_buffer_safety(sp, sb, x_example):
+        """The backward recompute replays the stage forward against
+        step-start buffers while the forward sub-tick used
+        per-microbatch-advanced ones.  Sound ONLY when the training
+        forward's ACTIVATION never reads buffer values (it may still
+        WRITE running stats — BN does exactly that, normalizing with
+        batch stats).  Verified mechanically once per build: DCE the
+        stage jaxpr keeping just the activation output and confirm no
+        buffer input survives."""
+        if _buf_check_done or not bnames or not training:
+            return
+        from jax.interpreters import partial_eval as pe
+        jaxpr = jax.make_jaxpr(
+            lambda p, b, x: stage_fwd(p, b, x)[0])(sp, sb, x_example)
+        n_p = len(jax.tree_util.tree_leaves(sp))
+        n_b = len(jax.tree_util.tree_leaves(sb))
+        _, used = pe.dce_jaxpr(jaxpr.jaxpr,
+                               [True] * len(jaxpr.jaxpr.outvars))
+        buf_used = used[n_p:n_p + n_b]
+        if any(buf_used):
+            # dict pytrees flatten in sorted-key order
+            names = [n for n, u in zip(sorted(sb), buf_used) if u]
+            raise NotImplementedError(
+                "1F1B: this stage's TRAINING forward reads buffer "
+                f"values ({names}); the per-tick recompute would replay "
+                "it against step-start buffers and silently diverge "
+                "from the actual forward.  Use the GPipe schedule "
+                "(which stores no stale snapshots) for buffer-READING "
+                "training forwards.")
+        # marked done only AFTER passing — a caught-and-retried failing
+        # first step must re-run the guard, not skip into unsound math
+        _buf_check_done.append(True)
+
     def head_loss(post_params, out_mb, label_mb):
         with autograd.no_grad():
             if pipe_layer.post is not None:
@@ -290,6 +325,7 @@ def build_pipeline_1f1b_fn(pipe_layer, num_microbatches, loss_fn,
 
     def core(stage_params, stage_bufs, post_params, h_mbs, labels_mbs,
              key):
+        _check_recompute_buffer_safety(stage_params, stage_bufs, h_mbs[0])
         stage = lax.axis_index(axis)
         n = pp
         mb_shape = h_mbs.shape[1:]

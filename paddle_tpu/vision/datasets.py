@@ -157,8 +157,14 @@ class Cifar100(Cifar10):
 
 
 class Flowers(Dataset):
-    """Oxford-102 flowers (reference: vision/datasets/flowers.py) —
-    local .tgz/.mat cache when present, synthetic fallback otherwise."""
+    """Oxford-102 flowers (reference: vision/datasets/flowers.py).
+
+    .tgz/.mat parsing is NOT implemented: with no cached archive the
+    dataset serves deterministic synthetic samples (size via
+    ``synthetic_size`` or ``PADDLE_TPU_SYNTH_N``); a PRESENT archive
+    raises instead of silently training on fabricated data — remove or
+    rename it to opt into the synthetic fallback, or load the real
+    images yourself and wrap them in a custom ``io.Dataset``."""
 
     NUM_CLASSES = 102
 
@@ -168,18 +174,18 @@ class Flowers(Dataset):
         self.mode = mode
         self.transform = transform
         base = os.path.join(DATA_HOME, "flowers")
-        explicit = data_file is not None
         data_file = data_file or os.path.join(base, "102flowers.tgz")
         if os.path.exists(data_file):
-            if explicit:
-                raise NotImplementedError(
-                    "Flowers: .tgz/.mat parsing for a local cache is not "
-                    "implemented — extract to numpy and pass image arrays, "
-                    "or omit data_file to use the synthetic fallback")
-            warnings.warn(
-                "Flowers: found a cached archive at %s but .tgz/.mat parsing "
-                "is not implemented; falling back to synthetic data"
-                % data_file)
+            # a REAL downloaded archive exists (however the path was
+            # derived): silently training on synthetic samples instead
+            # would fabricate results — refuse.  The synthetic fallback
+            # is only for the no-archive (no-egress) environment.
+            raise NotImplementedError(
+                "Flowers: found a cached archive at %s but .tgz/.mat "
+                "parsing is not implemented — remove or rename the "
+                "archive to use the synthetic no-data fallback, or load "
+                "the real images yourself and wrap them in a custom "
+                "io.Dataset" % data_file)
         n = synthetic_size or {"train": 6149, "valid": 1020,
                                "test": 1020}.get(mode, 1020)
         n = int(os.environ.get("PADDLE_TPU_SYNTH_N", n))

@@ -303,3 +303,67 @@ class TestReviewRegressions:
                                use_shared_memory=False)
         got = [int(b.numpy().ravel()[0]) for b in loader]
         assert sorted(got) == [0, 1]
+
+
+class TestPoolLifecycle:
+    def test_abandoned_unstarted_iterator_releases_pool(self):
+        """An iterator obtained but never advanced must release its
+        claim on GC — previously pool.busy stayed True forever and each
+        epoch leaked a fresh worker pool (advisor round-2 finding)."""
+        import gc
+        loader = io.DataLoader(SquareDataset(16), batch_size=4,
+                               num_workers=2, persistent_workers=True)
+        it = iter(loader)          # claims the pool, never started
+        pool = loader._pool
+        assert pool is not None and pool.busy
+        del it
+        gc.collect()
+        assert not pool.busy       # released on GC
+        # next epoch reuses the SAME pool — no leak
+        n = sum(1 for _ in loader)
+        assert n == 4
+        assert loader._pool is pool
+        assert len(loader._live_pools) == 1
+        loader._pool.close()
+
+    def test_abandoned_mid_iteration_releases_pool(self):
+        import gc
+        loader = io.DataLoader(SquareDataset(32), batch_size=4,
+                               num_workers=2, persistent_workers=True)
+        it = iter(loader)
+        next(it)                   # started, then abandoned
+        pool = loader._pool
+        del it
+        gc.collect()
+        assert not pool.busy
+        assert sum(1 for _ in loader) == 8
+        loader._pool.close()
+
+    def test_del_closes_every_spawned_pool(self):
+        import gc
+        loader = io.DataLoader(SquareDataset(16), batch_size=4,
+                               num_workers=2, persistent_workers=True)
+        it1 = iter(loader)
+        it2 = iter(loader)         # concurrent: second pool
+        pools = list(loader._live_pools)
+        assert len(pools) == 2
+        del it1, it2
+        gc.collect()
+        loader.__del__()
+        assert all(p._closed for p in pools)
+
+    def test_persistent_concurrent_pools_recycled(self):
+        """With persistent_workers, the extra pool spawned for a second
+        concurrent iterator must be REUSED by later epochs, not leak one
+        pool per epoch (review finding)."""
+        import gc
+        loader = io.DataLoader(SquareDataset(16), batch_size=4,
+                               num_workers=2, persistent_workers=True)
+        for _ in range(3):
+            it1, it2 = iter(loader), iter(loader)
+            next(it1), next(it2)
+            del it1, it2
+            gc.collect()
+        assert len(loader._live_pools) == 2, len(loader._live_pools)
+        for p in list(loader._live_pools):
+            p.close()

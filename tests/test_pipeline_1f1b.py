@@ -174,3 +174,62 @@ class TestPipelineBuffers:
         bn_mean = dict(blocks[0].named_buffers())["bn._mean"]
         assert bn_mean is not None
         assert not np.allclose(np.asarray(bn_mean._data), 0.0, atol=1e-7)
+
+
+class _BufReadingBlock(nn.Layer):
+    """Training forward READS a buffer value — unsound for 1F1B's
+    frozen-buffer recompute."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(6, 6)
+        self.register_buffer("scale_buf",
+                             paddle.to_tensor(np.ones(6, np.float32)))
+
+    def forward(self, x):
+        return self.fc(x) * self.scale_buf
+
+
+class TestRecomputeBufferGuard:
+    def test_buffer_reading_forward_rejected_under_1f1b(self, pp_mesh):
+        """advisor round-2: the per-tick recompute replays against
+        step-start buffers; a buffer-READING training forward must be
+        rejected, not silently diverge."""
+        paddle.seed(23)
+        blocks = [_BufReadingBlock() for _ in range(4)]
+        pipe = PipelineLayer(pre=None, blocks=blocks,
+                             post=nn.Linear(6, 2))
+        strategy = DistributedStrategy()
+        strategy.pipeline = True
+        strategy.pipeline_configs["accumulate_steps"] = 2
+        strategy.pipeline_configs["schedule_mode"] = "1F1B"
+        opt = optimizer.SGD(learning_rate=0.01,
+                            parameters=pipe.parameters())
+        step = TrainStep(pipe, opt, loss_fn=nn.MSELoss(),
+                         strategy=strategy, donate=False)
+        rs = np.random.RandomState(3)
+        x = rs.rand(8, 6).astype(np.float32)
+        y = rs.rand(8, 2).astype(np.float32)
+        with pytest.raises(Exception, match="reads buffer|buffer.*READ"):
+            step.step([x], [y])
+
+    def test_bn_block_passes_guard(self, pp_mesh):
+        """BN WRITES running stats but normalizes with batch stats —
+        the guard must not reject it (covered further by
+        TestPipelineBuffers, but assert the first step succeeds)."""
+        paddle.seed(24)
+        blocks = [_BNBlock() for _ in range(4)]
+        pipe = PipelineLayer(pre=None, blocks=blocks,
+                             post=nn.Linear(6, 2))
+        strategy = DistributedStrategy()
+        strategy.pipeline = True
+        strategy.pipeline_configs["accumulate_steps"] = 2
+        strategy.pipeline_configs["schedule_mode"] = "1F1B"
+        opt = optimizer.SGD(learning_rate=0.01,
+                            parameters=pipe.parameters())
+        step = TrainStep(pipe, opt, loss_fn=nn.MSELoss(),
+                         strategy=strategy, donate=False)
+        rs = np.random.RandomState(3)
+        loss = step.step([rs.rand(8, 6).astype(np.float32)],
+                         [rs.rand(8, 2).astype(np.float32)])
+        assert np.isfinite(float(loss.numpy()))
