@@ -491,6 +491,12 @@ class _MPIterGuard:
         return self
 
     def __next__(self):
+        if self._released:
+            # the pool may already be claimed by another iterator;
+            # touching mp_it after release would make both drain the
+            # same result queue (matches the old generator wrapper,
+            # which was dead after its finally ran)
+            raise StopIteration
         try:
             return _to_tensors(next(self.mp_it), self.loader.return_list)
         except BaseException:

@@ -163,17 +163,53 @@ class InMemoryDataset:
 
     def global_shuffle(self, fleet=None, thread_num=None):
         """Shared-seed shuffle + per-rank sharding (the reference moves
-        records between nodes via the fleet; with a shared seed every rank
-        derives the same permutation so sharding replaces data motion).
-        Re-derives from the full record set each call, so per-epoch calls
-        produce fresh partitions instead of shrinking the shard."""
+        records between nodes via the fleet — ``data_set.cc``
+        GlobalShuffle; with a shared seed every rank derives the same
+        permutation so sharding replaces data motion).  Re-derives from
+        the full record set each call, so per-epoch calls produce fresh
+        partitions instead of shrinking the shard.
+
+        CONTRACT: every rank must have loaded the IDENTICAL record set in
+        identical order (same ``set_filelist`` on all ranks) — the shared
+        permutation only partitions correctly when all ranks agree on the
+        full set.  Ranks with unequal local data need the reference's
+        record-exchange semantics, which this redesign deliberately
+        replaces.  Enforced cross-host via a record digest when
+        ``jax.process_count() > 1``."""
         from ..distributed import parallel as dist_parallel
         rank = dist_parallel.get_rank()
         world = dist_parallel.get_world_size()
         self._engine.reset_order()
+        self._check_identical_records()
         self._engine.shuffle(12345 + self._gs_epoch)
         self._gs_epoch += 1
         self._engine.shard(rank, world)
+
+    def _check_identical_records(self):
+        """Digest (count, head/tail sums in load order) allgathered over
+        hosts; mismatch means the identical-file-list contract is broken
+        and shards would overlap/miss records."""
+        import jax
+        if jax.process_count() == 1:
+            return
+        from jax.experimental import multihost_utils
+        n = self._engine.num()
+        k = min(n, 4)
+        head = self._engine.batch(0, k) if k else np.zeros((0, 1))
+        tail = self._engine.batch(n - k, k) if k else np.zeros((0, 1))
+        digest = np.asarray([float(n),
+                             float(np.sum(head, dtype=np.float64)),
+                             float(np.sum(tail, dtype=np.float64))],
+                            np.float64)
+        gathered = np.asarray(multihost_utils.process_allgather(digest))
+        if not np.allclose(gathered, gathered[0]):
+            raise RuntimeError(
+                "global_shuffle: ranks hold DIFFERENT record sets "
+                f"(per-host [count, head-sum, tail-sum] = {gathered}).  "
+                "The shared-seed redesign requires the identical file "
+                "list on every rank (see docstring); feed all ranks the "
+                "same set_filelist, or shard files yourself with "
+                "fleet.util.get_file_shard and skip global_shuffle.")
 
     def release_memory(self):
         self._engine.release()

@@ -14,7 +14,6 @@ import os
 import pickle
 import struct
 import tarfile
-import warnings
 
 import numpy as np
 

@@ -1,0 +1,41 @@
+"""Fused-CE chunk size sweep at the bench config."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import numpy as np
+
+def run(chunk, steps=10):
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.functional import loss as L
+    orig = L.fused_linear_cross_entropy
+    def patched(hidden, weight, labels, chunk_size=128, name=None):
+        return orig(hidden, weight, labels, chunk_size=chunk)
+    L.fused_linear_cross_entropy = patched
+    import paddle_tpu.models.gpt as gpt
+    gpt.F.fused_linear_cross_entropy = patched
+    from paddle_tpu import optimizer
+    from paddle_tpu.models import GPTModel
+    from paddle_tpu.parallel.train_step import TrainStep
+    paddle.seed(0)
+    model = GPTModel.from_config("gpt2-medium", dropout=0.1,
+                                 fused_loss=True)
+    model.to(dtype="bfloat16")
+    opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                          parameters=model.parameters())
+    step = TrainStep(model, opt, loss_fn=None)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50304, (8, 1025)).astype(np.int32)
+    x, y = ids[:, :-1], ids[:, 1:]
+    step.step([x, y]).numpy()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step.step([x, y])
+    loss.numpy()
+    dt = time.perf_counter() - t0
+    print(f"chunk={chunk}: {8*1024*steps/dt:.0f} tok/s", flush=True)
+
+if __name__ == "__main__":
+    for c in (256, 512, 64):
+        try:
+            run(c)
+        except Exception as e:
+            print(f"chunk={c}: FAILED {type(e).__name__}", flush=True)

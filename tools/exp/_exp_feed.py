@@ -1,0 +1,170 @@
+"""Measure DataLoader-fed vs device-resident training throughput on the
+real chip (VERDICT round-1 item #1).
+
+Pipeline under test: process workers (shared memory) -> DeviceLoader async
+H2D double buffer -> TrainStep.  Also measures the raw H2D bandwidth bound
+so pipeline efficiency = fed_rate / min(compute_rate, transfer_bound) is
+explicit.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import numpy as np
+
+
+def timed(fn, n, sync):
+    fn()  # warm
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    sync()
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer, nn, io
+    from paddle_tpu.parallel.train_step import TrainStep
+
+    on_tpu = jax.default_backend() != "cpu"
+    print("backend:", jax.default_backend())
+    out = {}
+
+    # ---- raw H2D bandwidth bound --------------------------------------
+    arr = np.random.rand(64, 3, 224, 224).astype(np.float32)  # 38.5 MB
+    dev = jax.devices()[0]
+
+    def put():
+        jax.device_put(arr, dev).block_until_ready()
+
+    dt = timed(put, 5, lambda: None)
+    out["h2d_MBps"] = round(arr.nbytes / dt / 1e6, 1)
+    out["h2d_sec_per_resnet_batch"] = round(dt, 4)
+
+    # ---- ResNet-50 -----------------------------------------------------
+    from paddle_tpu.vision.models import resnet50
+    paddle.seed(0)
+    batch = 64
+    model = resnet50(num_classes=1000)
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+    step = TrainStep(model, opt, loss_fn=nn.CrossEntropyLoss(),
+                     amp_level="O1")
+
+    rng = np.random.RandomState(0)
+    x_host = rng.rand(batch, 3, 224, 224).astype(np.float32)
+    y_host = rng.randint(0, 1000, (batch,)).astype(np.int64)
+
+    # device-resident: same arrays already on device
+    x_dev = jax.device_put(x_host, step._data_sharding(x_host.shape))
+    y_dev = jax.device_put(y_host, step._data_sharding(y_host.shape))
+    loss = step.step([x_dev], [y_dev]); loss.numpy()  # compile
+
+    n = 20 if on_tpu else 3
+    dt = timed(lambda: step.step([x_dev], [y_dev]), n,
+               lambda: step.params["fc.weight"].block_until_ready())
+    out["resnet_device_resident_sps"] = round(batch / dt, 1)
+
+    # sync feed: host numpy each step (round-1's 27/s path)
+    dt = timed(lambda: step.step([x_host], [y_host]), max(3, n // 4),
+               lambda: step.params["fc.weight"].block_until_ready())
+    out["resnet_sync_feed_sps"] = round(batch / dt, 1)
+
+    # full pipeline: mp DataLoader + DeviceLoader prefetch
+    class SynthImages(io.Dataset):
+        def __init__(self, nitems):
+            self.n = nitems
+
+        def __getitem__(self, i):
+            rs = np.random.RandomState(i)
+            return (rs.rand(3, 224, 224).astype(np.float32),
+                    np.asarray(rs.randint(1000), np.int64))
+
+        def __len__(self):
+            return self.n
+
+    steps_total = n
+    loader = io.DataLoader(SynthImages(batch * steps_total),
+                           batch_size=batch, num_workers=8,
+                           prefetch_factor=2, drop_last=True)
+    devloader = io.DeviceLoader(loader, buffer_size=2,
+                                sharding_fn=step._data_sharding,
+                                wrap=False)
+    # warm one epoch-start (workers spin up)
+    t0 = time.perf_counter()
+    seen = 0
+    for bx, by in devloader:
+        loss = step.step([bx], [by])
+        seen += batch
+    loss.numpy()
+    dt_all = time.perf_counter() - t0
+    out["resnet_pipelined_fed_sps"] = round(seen / dt_all, 1)
+    out["resnet_fed_vs_resident"] = round(
+        out["resnet_pipelined_fed_sps"] /
+        out["resnet_device_resident_sps"], 3)
+    bound = min(out["resnet_device_resident_sps"],
+                out["h2d_MBps"] * 1e6 / (x_host.nbytes / batch))
+    out["resnet_fed_vs_bound"] = round(
+        out["resnet_pipelined_fed_sps"] / bound, 3)
+
+    # ---- GPT-2 (fed) ---------------------------------------------------
+    from paddle_tpu.models import GPTModel
+    if on_tpu:
+        gbatch, gseq, cfg, gsteps = 8, 1024, "gpt2-medium", 20
+    else:
+        gbatch, gseq, cfg, gsteps = 2, 128, "tiny", 3
+    paddle.seed(0)
+    gmodel = GPTModel.from_config(cfg, dropout=0.1, fused_loss=True)
+    if on_tpu:
+        gmodel.to(dtype="bfloat16")
+    gopt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                           parameters=gmodel.parameters())
+    gstep = TrainStep(gmodel, gopt, loss_fn=None)
+    vocab = 50304 if cfg != "tiny" else 128
+
+    class SynthTokens(io.Dataset):
+        def __init__(self, nitems):
+            self.n = nitems
+
+        def __getitem__(self, i):
+            rs = np.random.RandomState(i)
+            ids = rs.randint(0, vocab, (gseq + 1,)).astype(np.int32)
+            return ids[:-1], ids[1:]
+
+        def __len__(self):
+            return self.n
+
+    ids = np.random.RandomState(0).randint(
+        0, vocab, (gbatch, gseq + 1)).astype(np.int32)
+    gx, gy = ids[:, :-1], ids[:, 1:]
+    gx_d = jax.device_put(gx, gstep._data_sharding(gx.shape))
+    gy_d = jax.device_put(gy, gstep._data_sharding(gy.shape))
+    l = gstep.step([gx_d, gy_d]); l.numpy()
+    dt = timed(lambda: gstep.step([gx_d, gy_d]), gsteps, lambda: None)
+    out["gpt2_device_resident_tps"] = round(gbatch * gseq / dt, 1)
+
+    gloader = io.DataLoader(SynthTokens(gbatch * gsteps),
+                            batch_size=gbatch, num_workers=4,
+                            drop_last=True)
+    gdev = io.DeviceLoader(gloader, buffer_size=2,
+                           sharding_fn=gstep._data_sharding, wrap=False)
+    t0 = time.perf_counter()
+    tok = 0
+    for bx, by in gdev:
+        l = gstep.step([bx, by])
+        tok += gbatch * gseq
+    l.numpy()
+    out["gpt2_pipelined_fed_tps"] = round(tok / (time.perf_counter() - t0), 1)
+    out["gpt2_fed_vs_resident"] = round(
+        out["gpt2_pipelined_fed_tps"] / out["gpt2_device_resident_tps"], 3)
+
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

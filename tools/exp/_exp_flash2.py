@@ -1,0 +1,47 @@
+"""Flash block-size tuning at seq 1024, batch 8."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import numpy as np
+
+def run(block_q, block_k, steps=10):
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.functional import attention as att
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+    att.FLASH_MIN_SEQ = 0
+    att.FLASH_BLOCK_SIZES = BlockSizes(
+        block_q=block_q, block_k_major=block_k, block_k=block_k,
+        block_b=1,
+        block_q_major_dkv=block_q, block_k_major_dkv=block_k,
+        block_k_dkv=block_k, block_q_dkv=block_q,
+        block_k_major_dq=block_k, block_k_dq=block_k,
+        block_q_dq=block_q)
+    from paddle_tpu import optimizer
+    from paddle_tpu.models import GPTModel
+    from paddle_tpu.parallel.train_step import TrainStep
+    paddle.seed(0)
+    model = GPTModel.from_config("gpt2-medium", dropout=0.1,
+                                 fused_loss=True)
+    model.to(dtype="bfloat16")
+    opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                          parameters=model.parameters())
+    step = TrainStep(model, opt, loss_fn=None)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50304, (8, 1025)).astype(np.int32)
+    x, y = ids[:, :-1], ids[:, 1:]
+    loss = step.step([x, y]); loss.numpy()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step.step([x, y])
+    loss.numpy()
+    dt = time.perf_counter() - t0
+    print(f"bq={block_q} bk={block_k}: {8*1024*steps/dt:.0f} tok/s",
+          flush=True)
+
+if __name__ == "__main__":
+    for bq, bk in [(512, 1024), (1024, 512), (512, 512)]:
+        try:
+            run(bq, bk)
+        except Exception as e:
+            print(f"bq={bq} bk={bk}: FAILED {type(e).__name__}", flush=True)
+

@@ -1,0 +1,49 @@
+"""Long-context flash tuning: seq 4096, batch 2."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import numpy as np
+
+def run(blocks, steps=6, seq=4096, batch=2):
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.functional import attention as att
+    att.FLASH_MIN_SEQ = 2048
+    if blocks:
+        from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+        bq, bk = blocks
+        att.FLASH_BLOCK_SIZES = BlockSizes(
+            block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+            block_q_major_dkv=bq, block_k_major_dkv=bk,
+            block_k_dkv=bk, block_q_dkv=bq,
+            block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq)
+    else:
+        att.FLASH_BLOCK_SIZES = None
+    from paddle_tpu import optimizer
+    from paddle_tpu.models import GPTModel
+    from paddle_tpu.parallel.train_step import TrainStep
+    paddle.seed(0)
+    model = GPTModel.from_config("gpt2-medium", dropout=0.1,
+                                 fused_loss=True, max_position=seq)
+    model.to(dtype="bfloat16")
+    opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                          parameters=model.parameters())
+    step = TrainStep(model, opt, loss_fn=None)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50304, (batch, seq + 1)).astype(np.int32)
+    x, y = ids[:, :-1], ids[:, 1:]
+    loss = step.step([x, y]); loss.numpy()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step.step([x, y])
+    loss.numpy()
+    dt = time.perf_counter() - t0
+    tag = f"bq={blocks[0]} bk={blocks[1]}" if blocks else "default"
+    print(f"seq={seq} batch={batch} {tag}: "
+          f"{batch*seq*steps/dt:.0f} tok/s", flush=True)
+
+if __name__ == "__main__":
+    for blocks in (None, (1024, 512), (2048, 512), (1024, 1024)):
+        try:
+            run(blocks)
+        except Exception as e:
+            print(f"{blocks}: FAILED {type(e).__name__}: {e}"[:200],
+                  flush=True)

@@ -1,0 +1,36 @@
+"""Validate the new default blocks; try batch 4 and seq 8192."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import numpy as np
+
+def run(seq, batch, steps=6):
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models import GPTModel
+    from paddle_tpu.parallel.train_step import TrainStep
+    paddle.seed(0)
+    model = GPTModel.from_config("gpt2-medium", dropout=0.1,
+                                 fused_loss=True, max_position=seq)
+    model.to(dtype="bfloat16")
+    opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                          parameters=model.parameters())
+    step = TrainStep(model, opt, loss_fn=None)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50304, (batch, seq + 1)).astype(np.int32)
+    x, y = ids[:, :-1], ids[:, 1:]
+    loss = step.step([x, y]); loss.numpy()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step.step([x, y])
+    loss.numpy()
+    dt = time.perf_counter() - t0
+    print(f"seq={seq} batch={batch}: {batch*seq*steps/dt:.0f} tok/s",
+          flush=True)
+
+if __name__ == "__main__":
+    for seq, batch in [(4096, 2), (4096, 4), (8192, 1), (8192, 2)]:
+        try:
+            run(seq, batch)
+        except Exception as e:
+            print(f"seq={seq} b={batch}: FAILED {type(e).__name__}",
+                  flush=True)

@@ -1,0 +1,56 @@
+"""Single-chip MFU sweep: batch x remat-policy on GPT-2 345M (VERDICT #7)."""
+import json, sys, time, os
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import numpy as np
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models import GPTModel
+    from paddle_tpu.parallel.train_step import TrainStep
+
+    assert jax.default_backend() != "cpu"
+    seq, vocab = 1024, 50304
+    rng = np.random.RandomState(0)
+    results = []
+    configs = [
+        (8,  False, None),
+        (10, False, None),
+        (12, True, "dots"),
+        (16, True, "dots"),
+        (24, True, "dots"),
+        (16, True, "full"),
+        (32, True, "dots"),
+    ]
+    for batch, remat, policy in configs:
+        try:
+            paddle.seed(0)
+            model = GPTModel.from_config("gpt2-medium", dropout=0.1,
+                                         fused_loss=True,
+                                         use_recompute=remat,
+                                         recompute_policy=policy)
+            model.to(dtype="bfloat16")
+            opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                                  parameters=model.parameters())
+            step = TrainStep(model, opt, loss_fn=None)
+            ids = rng.randint(0, vocab, (batch, seq + 1)).astype(np.int32)
+            x, y = ids[:, :-1], ids[:, 1:]
+            xd = jax.device_put(x, step._data_sharding(x.shape))
+            yd = jax.device_put(y, step._data_sharding(y.shape))
+            loss = step.step([xd, yd]); loss.numpy()
+            t0 = time.perf_counter()
+            for _ in range(15):
+                loss = step.step([xd, yd])
+            loss.numpy()
+            tps = batch * seq * 15 / (time.perf_counter() - t0)
+            results.append((batch, remat, policy, round(tps, 1)))
+            print(f"b{batch} remat={remat} policy={policy}: {tps:,.0f} tok/s",
+                  flush=True)
+            del step, model, opt
+        except Exception as e:
+            print(f"b{batch} remat={remat} policy={policy}: FAIL "
+                  f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+    print(json.dumps(results))
+
+main()

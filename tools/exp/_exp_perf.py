@@ -1,0 +1,53 @@
+"""Scratch perf experiment: GPT-2 345M step time vs batch size."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import numpy as np
+
+def run(batch, seq=1024, steps=10, fused_loss=True, flash=False):
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.functional import attention as att
+    att.FLASH_MIN_SEQ = 0 if flash else 10**9
+    if os.environ.get("EXP_ATT_REMAT", "0") == "1":
+        orig = att._reference_attention
+
+        def remat_ref(q, k, v, mask=None, scale=None, is_causal=False):
+            return jax.checkpoint(
+                lambda qq, kk, vv: orig(qq, kk, vv, mask, scale,
+                                        is_causal))(q, k, v)
+
+        att._reference_attention = remat_ref
+    from paddle_tpu import optimizer
+    from paddle_tpu.models import GPTModel
+    from paddle_tpu.parallel.train_step import TrainStep
+    paddle.seed(0)
+    remat = os.environ.get("EXP_REMAT", "0") == "1"
+    model = GPTModel.from_config("gpt2-medium", dropout=0.1,
+                                 fused_loss=fused_loss,
+                                 use_recompute=remat)
+    model.to(dtype="bfloat16")
+    opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                          parameters=model.parameters())
+    step = TrainStep(model, opt, loss_fn=None)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50304, (batch, seq + 1)).astype(np.int32)
+    x, y = ids[:, :-1], ids[:, 1:]
+    loss = step.step([x, y]); loss.numpy()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step.step([x, y])
+    loss.numpy()
+    dt = time.perf_counter() - t0
+    tps = batch * seq * steps / dt
+    print(f"batch={batch} seq={seq} fused={fused_loss} flash={flash}: "
+          f"{tps:.0f} tok/s  ({dt/steps*1000:.1f} ms/step)", flush=True)
+    return tps
+
+if __name__ == "__main__":
+    flash = os.environ.get("EXP_FLASH", "0") == "1"
+    for b in (int(a) for a in sys.argv[1:] or ["8", "16", "32"]):
+        try:
+            run(b, flash=flash)
+        except Exception as e:
+            print(f"batch={b} flash={flash}: FAILED {type(e).__name__}",
+                  flush=True)
