@@ -97,3 +97,52 @@ def test_bucketed_training_compiles_per_bucket_only():
         step.step([x], [y])
     # one compiled variant per bucket, not per distinct raw length
     assert len(step._compiled) == 2, len(step._compiled)
+
+
+class TestRaggedSkewStress:
+    """VERDICT round-2 missing #1: the dense+lengths reduction must hold
+    at realistic length skew.  Full measured table (8192-doc lognormal,
+    wall-clock legs): BASELINE.md 'Ragged skew' section +
+    tools/exp/_exp_ragged.py."""
+
+    def _corpus(self, n=2048):
+        import sys, os
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools", "exp"))
+        from _exp_ragged import make_corpus, analytic, quantile_ladder
+        return make_corpus(n), analytic, quantile_ladder
+
+    def test_bucketing_bounds_compiles_and_waste_under_skew(self):
+        from paddle_tpu.io.bucketing import (BucketedBatchSampler,
+                                             DEFAULT_BUCKETS, bucket_for)
+        (docs, lengths), analytic, _ = self._corpus()
+
+        class DS:
+            def __getitem__(self, i):
+                return docs[i]
+
+            def __len__(self):
+                return len(docs)
+
+        sampler = BucketedBatchSampler(
+            DS(), batch_size=8, buckets=DEFAULT_BUCKETS,
+            length_fn=lambda i: int(lengths[i]), shuffle=True)
+        batches = [list(b) for b in sampler]
+        import numpy as np
+        r = analytic(lengths, [np.asarray(b) for b in batches],
+                     lambda bl: bucket_for(int(bl.max()), DEFAULT_BUCKETS),
+                     "bucketed")
+        # compile variants bounded by 2 x ladder size (full + remainder
+        # batch per bucket), NOT by the number of distinct lengths
+        assert r["compiles"] <= 2 * len(DEFAULT_BUCKETS), r
+        # padding waste stays moderate under heavy lognormal skew
+        assert r["padding_waste_pct"] < 25.0, r
+        # vs naive global-max padding (~85% waste on this distribution)
+        naive = analytic(lengths,
+                         [np.arange(i, min(i + 8, len(docs)))
+                          for i in range(0, len(docs), 8)],
+                         lambda bl: int(lengths.max()), "naive")
+        assert naive["padding_waste_pct"] > 3 * r["padding_waste_pct"]
+        # every sample appears exactly once
+        seen = sorted(i for b in batches for i in b)
+        assert seen == list(range(len(docs)))
