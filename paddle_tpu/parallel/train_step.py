@@ -443,8 +443,9 @@ class TrainStep:
                              mesh_mod.batch_partition_spec(shape,
                                                            self.mesh))
 
-    def step(self, inputs, labels=()):
-        """Run one optimization step on a global batch."""
+    def _place_inputs(self, inputs, labels):
+        """Normalize + place a global batch exactly as the compiled step
+        consumes it (single source for step() and aot_compile)."""
         if not isinstance(inputs, (list, tuple)):
             inputs = [inputs]
         if not isinstance(labels, (list, tuple)):
@@ -507,6 +508,11 @@ class TrainStep:
                 lab_arrays = [jax.device_put(a,
                                              self._data_sharding(a.shape))
                               for a in lab_arrays]
+        return in_arrays, lab_arrays
+
+    def step(self, inputs, labels=()):
+        """Run one optimization step on a global batch."""
+        in_arrays, lab_arrays = self._place_inputs(inputs, labels)
         key = rng_mod.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         shapes_key = (len(in_arrays),
@@ -534,6 +540,37 @@ class TrainStep:
                 in_arrays, lab_arrays)
         self.optimizer._step_count += 1
         return Tensor(loss)
+
+    def aot_compile(self, inputs, labels=()):
+        """AOT lower + compile the step for these batch shapes WITHOUT
+        executing it (jax ahead-of-time API).  Returns
+        ``(lowered_seconds, compiled_seconds, compiled)`` — use
+        ``compiled.memory_analysis()`` / ``cost_analysis()`` to bound
+        HBM and XLA time before committing a real device step.  This is
+        the big-model rehearsal path: a killed mid-compile on a remote
+        chip can wedge the device (observed with GPT-3 1.3B through the
+        dev tunnel), so measure compile on a cheap backend first."""
+        import time as _time
+        # same placement/global-assembly as step(): the rehearsal must
+        # lower the SAME program the real step will compile
+        in_arrays, lab_arrays = self._place_inputs(inputs, labels)
+        meta = (len(in_arrays), [tuple(a.shape) for a in in_arrays],
+                [tuple(a.shape) for a in lab_arrays])
+        fn = (self._build_pipeline(meta) if self.is_pipeline
+              else self._build_flat(meta))
+        # fixed dummy key: the key only shapes the trace, and advancing
+        # the global stream from a compile-only rehearsal would silently
+        # change every subsequent step's randomness
+        key = jax.random.key(0)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        state = self.block_buffers if self.is_pipeline else self.buffers
+        t0 = _time.perf_counter()
+        lowered = fn.lower(self.params, state, self.opt_state, lr, key,
+                           in_arrays, lab_arrays)
+        t_lower = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        compiled = lowered.compile()
+        return t_lower, _time.perf_counter() - t0, compiled
 
     # ------------------------------------------------------------------
     def sync_to_layer(self):
