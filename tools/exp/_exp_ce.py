@@ -1,6 +1,7 @@
 """Fused-CE chunk size sweep at the bench config."""
 import os, sys, time
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
 import numpy as np
 
 def run(chunk, steps=10):

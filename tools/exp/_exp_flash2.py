@@ -1,6 +1,7 @@
 """Flash block-size tuning at seq 1024, batch 8."""
 import os, sys, time
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
 import numpy as np
 
 def run(block_q, block_k, steps=10):

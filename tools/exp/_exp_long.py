@@ -1,6 +1,7 @@
 """Long-context flash tuning: seq 4096, batch 2."""
 import os, sys, time
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
 import numpy as np
 
 def run(blocks, steps=6, seq=4096, batch=2):

@@ -1,6 +1,7 @@
 """Validate the new default blocks; try batch 4 and seq 8192."""
 import os, sys, time
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
 import numpy as np
 
 def run(seq, batch, steps=6):

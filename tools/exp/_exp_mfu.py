@@ -1,6 +1,7 @@
 """Single-chip MFU sweep: batch x remat-policy on GPT-2 345M (VERDICT #7)."""
 import json, sys, time, os
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
 import numpy as np
 
 def main():

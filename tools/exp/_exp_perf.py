@@ -1,6 +1,7 @@
 """Scratch perf experiment: GPT-2 345M step time vs batch size."""
 import os, sys, time
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
 import numpy as np
 
 def run(batch, seq=1024, steps=10, fused_loss=True, flash=False):

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Run the round-3 TPU measurement backlog the moment the tunnel recovers.
+# ONE process may use the TPU at a time; steps run strictly sequentially
+# and each is subprocess-isolated so a hang cannot poison the next.
+# Usage:  bash tools/exp/tpu_recovery_runbook.sh [outdir]
+set -u
+OUT=${1:-/tmp/tpu_r3}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/../.."
+
+run() {  # run NAME TIMEOUT CMD...
+  local name=$1 t=$2; shift 2
+  echo "=== $name (timeout ${t}s)"
+  timeout "$t" "$@" > "$OUT/$name.json" 2> "$OUT/$name.err"
+  echo "rc=$? -> $OUT/$name.json"
+}
+
+# 0) probe (cheap, bounded)
+run probe 240 python -c "import jax; print(jax.devices())"
+grep -q TPU "$OUT/probe.json" || { echo "TPU not reachable; abort"; exit 1; }
+
+# 1) the driver-visible headline: all three models via hardened bench.py
+run bench 3600 python bench.py
+
+# 2) GPT-3 1.3B single-chip: compile rehearsal on device, then measure.
+#    (CPU rehearsal already bounded XLA time; see BASELINE.md round 3.)
+run 13b_compile 2400 python tools/exp/_exp_13b.py --compile-only --batch 1 --seq 1024
+run 13b_b1 2400 python tools/exp/_exp_13b.py --batch 1 --seq 1024 --steps 10
+run 13b_b2 2400 python tools/exp/_exp_13b.py --batch 2 --seq 1024 --steps 10
+run 13b_b4 2400 python tools/exp/_exp_13b.py --batch 4 --seq 1024 --steps 10
+
+# 3) profiler trace for the MFU breakdown (VERDICT round-2 #3)
+run prof 1800 python tools/exp/_exp_prof.py
+
+# 4) compiled generation prefill+decode (VERDICT round-2 #8)
+run gen 1800 python tools/exp/_exp_gen_tpu.py
+
+# 5) ragged wall-clock leg on hardware (BASELINE round-3 table)
+run ragged 2400 python tools/exp/_exp_ragged.py --docs 512 --batch 8 --steps-cap 24
+
+echo "=== backlog complete; fold results into BASELINE.md"
