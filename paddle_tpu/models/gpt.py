@@ -402,8 +402,10 @@ class GPTModel(nn.Layer):
         return fn
 
     def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
-                 top_k=0, eos_token_id=None, seed=None, compiled=False):
-        """KV-cached autoregressive decoding (greedy / top-k sampling).
+                 top_k=0, top_p=1.0, eos_token_id=None, seed=None,
+                 compiled=False):
+        """KV-cached autoregressive decoding (greedy / top-k / top-p
+        nucleus sampling; ``top_p<=0`` degenerates to top-1).
 
         The reference snapshot has no generation loop (PaddleNLP-era
         feature); provided here because incremental decode is the natural
@@ -432,8 +434,10 @@ class GPTModel(nn.Layer):
         attn0 = self.blocks[0].attn
         kv_dtype = (attn0.qkv_weight if attn0.use_mp
                     else attn0.qkv_proj.weight)._data.dtype
-        # sampling whenever temperature/top_k ask for it; greedy otherwise
-        do_sample = (top_k and top_k > 0) or temperature != 1.0
+        # sampling whenever temperature/top_k/top_p ask for it; greedy
+        # otherwise
+        do_sample = ((top_k and top_k > 0) or temperature != 1.0
+                     or top_p < 1.0)
         was_training = self.training
         self.eval()
         try:
@@ -471,6 +475,25 @@ class GPTModel(nn.Layer):
                         if top_k and top_k > 0:
                             kth = jax.lax.top_k(last, top_k)[0][:, -1:]
                             last = jnp.where(last < kth, -1e9, last)
+                        if top_p < 1.0:
+                            # clamp so top_p <= 0 means "top token only"
+                            # (the keep-mask below would otherwise mask
+                            # EVERYTHING and sample uniformly)
+                            p_eff = max(float(top_p), 1e-9)
+                            # nucleus filtering: mask tokens outside the
+                            # smallest set whose cumulative probability
+                            # reaches top_p (sorted descending; the top
+                            # token always survives)
+                            srt = jnp.sort(last, axis=-1)[:, ::-1]
+                            probs = jax.nn.softmax(srt, axis=-1)
+                            cum = jnp.cumsum(probs, axis=-1)
+                            # keep entries whose PREFIX (exclusive) mass
+                            # is still < top_p
+                            keep = (cum - probs) < p_eff
+                            cutoff = jnp.min(
+                                jnp.where(keep, srt, jnp.inf), axis=-1,
+                                keepdims=True)
+                            last = jnp.where(last < cutoff, -1e9, last)
                         key, sub = jax.random.split(key)
                         nxt = jax.random.categorical(sub, last, axis=-1)
                     else:

@@ -164,3 +164,35 @@ def test_compiled_generate_matches_eager():
     np.testing.assert_array_equal(s1, s2)
     # the repeat call reused the cached jitted step (no new entry)
     assert len(model._decode_fn_cache) == n_cached
+
+
+def test_generate_top_p_nucleus(tiny_gpt):
+    """top_p < 1 filters to the nucleus: reproducible with a seed, and
+    top_p ~ 0 degenerates to greedy (only the top token survives)."""
+    ids = np.zeros((1, 3), np.int32)
+    a = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                          top_p=0.9, seed=7)
+    b = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                          top_p=0.9, seed=7)
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+    assert a.shape == [1, 9]
+    greedy = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=6)
+    tiny_p = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                               top_p=1e-6, seed=7)
+    np.testing.assert_array_equal(tiny_p.numpy(), greedy.numpy())
+    # top_p=0 (common 'greedy' convention) must also be top-1, not a
+    # uniform sample over a fully-masked vocab
+    zero_p = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                               top_p=0.0, seed=7)
+    np.testing.assert_array_equal(zero_p.numpy(), greedy.numpy())
+
+
+def test_generate_top_p_compiled_consistent(tiny_gpt):
+    """top_p sampling works through the compiled decode path too and
+    matches the eager path token-for-token (same seed, same filter)."""
+    ids = np.zeros((2, 3), np.int32)
+    eager = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                              top_p=0.8, seed=11, compiled=False)
+    comp = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                             top_p=0.8, seed=11, compiled=True)
+    np.testing.assert_array_equal(eager.numpy(), comp.numpy())
