@@ -57,6 +57,7 @@ def cpu_places(device_count=None):
 
 # -- remaining 1.x submodules ---------------------------------------------
 from . import nets  # noqa: E402,F401
+from . import contrib  # noqa: E402,F401  (slim.quantization QAT)
 from ..utils import unique_name  # noqa: E402,F401
 from .. import incubate  # noqa: E402,F401
 from . import metrics  # noqa: E402,F401

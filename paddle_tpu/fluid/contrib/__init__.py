@@ -1,0 +1,2 @@
+"""fluid.contrib namespace (reference: python/paddle/fluid/contrib/)."""
+from . import slim  # noqa: F401

@@ -1,0 +1,2 @@
+"""fluid.contrib.slim (reference: python/paddle/fluid/contrib/slim/)."""
+from . import quantization  # noqa: F401

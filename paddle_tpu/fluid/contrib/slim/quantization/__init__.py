@@ -1,0 +1,8 @@
+"""1.x import path for the quantization subsystem (reference:
+fluid/contrib/slim/quantization/imperative/qat.py) — the implementation
+lives in paddle_tpu.quantization."""
+from paddle_tpu.quantization import (  # noqa: F401
+    ImperativeQuantAware, ImperativeCalcOutScale,
+    FakeQuantAbsMax, FakeQuantMovingAverage,
+    QuantizedLinear, QuantizedConv2D, MovingAverageAbsMaxScale,
+)

@@ -1,0 +1,272 @@
+"""Quantization-aware training + scale observation (imperative).
+
+Reference parity: ``python/paddle/fluid/contrib/slim/quantization/
+imperative/qat.py`` (ImperativeQuantAware, ImperativeCalcOutScale) and
+``imperative/quant_nn.py`` (FakeQuantAbsMax, FakeQuantMovingAverage,
+QuantizedLinear, QuantizedConv2D, MovingAverageAbsMaxScale).
+
+TPU-native notes: fake-quant stays float (quantize->round->dequantize
+with straight-through gradients — see functional.py); the MXU consumes
+bf16, so QAT's product on TPU is int8-READY weights/scales at export
+plus the regularization effect, not int8 matmuls.  Layer surgery swaps
+``nn.Linear``/``nn.Conv2D`` sublayers for Quantized* wrappers in place,
+exactly like the reference's _get_quantized_counterpart walk.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from . import functional as F  # noqa: N812
+from .functional import (  # noqa: F401
+    fake_quantize_dequantize_abs_max,
+    fake_channel_wise_quantize_dequantize_abs_max,
+    fake_quantize_dequantize_moving_average_abs_max,
+    quantize_dequantize_with_scale,
+)
+
+__all__ = [
+    "ImperativeQuantAware", "ImperativeCalcOutScale",
+    "FakeQuantAbsMax", "FakeQuantMovingAverage", "QuantizedLinear",
+    "QuantizedConv2D", "MovingAverageAbsMaxScale",
+    "fake_quantize_dequantize_abs_max",
+    "fake_channel_wise_quantize_dequantize_abs_max",
+    "fake_quantize_dequantize_moving_average_abs_max",
+    "quantize_dequantize_with_scale",
+]
+
+
+class FakeQuantAbsMax(nn.Layer):
+    """Stateless per-tensor (or per-channel) abs-max quantizer —
+    reference quant_nn.py FakeQuantAbsMax."""
+
+    def __init__(self, bits=8, channel_wise=False, quant_axis=0,
+                 num_channels=None):
+        super().__init__()
+        self.bits = bits
+        self.channel_wise = channel_wise
+        self.quant_axis = quant_axis
+        # last observed scale, as a BUFFER: a plain attribute assigned
+        # inside a compiled TrainStep trace would leak a tracer; a
+        # buffer threads through the functional step like BN stats
+        shape = [num_channels] if channel_wise and num_channels else []
+        self.register_buffer("scale",
+                             Tensor(jnp.ones(shape, jnp.float32)))
+
+    def forward(self, x):
+        if self.channel_wise:
+            out, scale = fake_channel_wise_quantize_dequantize_abs_max(
+                x, self.bits, self.quant_axis)
+        else:
+            out, scale = fake_quantize_dequantize_abs_max(x, self.bits)
+        import jax as _jax
+        if tuple(scale._data.shape) == tuple(self.scale._data.shape) \
+                or not isinstance(scale._data, _jax.core.Tracer):
+            # eager adopts the true shape; under a trace a shape-changing
+            # buffer cannot thread, so only matching shapes record
+            self.scale._data = scale._data
+        return out
+
+
+class FakeQuantMovingAverage(nn.Layer):
+    """EMA-scale activation quantizer: trains the scale, evals against
+    the frozen one — reference quant_nn.py FakeQuantMovingAverage."""
+
+    def __init__(self, bits=8, moving_rate=0.9):
+        super().__init__()
+        self.bits = bits
+        self.moving_rate = moving_rate
+        self.register_buffer("accum", Tensor(jnp.ones([], jnp.float32)))
+        self.register_buffer("state", Tensor(jnp.ones([], jnp.float32)))
+        self.register_buffer("scale", Tensor(jnp.ones([], jnp.float32)))
+
+    def forward(self, x):
+        if self.training:
+            out, accum, state, scale = \
+                fake_quantize_dequantize_moving_average_abs_max(
+                    x, self.accum, self.state, self.scale,
+                    self.bits, self.moving_rate)
+            self.accum._data = accum._data
+            self.state._data = state._data
+            self.scale._data = scale._data
+            return out
+        return quantize_dequantize_with_scale(x, self.scale, self.bits)
+
+
+class MovingAverageAbsMaxScale(nn.Layer):
+    """Observer only: tracks the EMA abs-max of what flows through it
+    without changing the value (reference quant_nn.py
+    MovingAverageAbsMaxScale; used by ImperativeCalcOutScale)."""
+
+    def __init__(self, moving_rate=0.9):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.register_buffer("accum", Tensor(jnp.ones([], jnp.float32)))
+        self.register_buffer("state", Tensor(jnp.ones([], jnp.float32)))
+        self.register_buffer("scale", Tensor(jnp.ones([], jnp.float32)))
+
+    def forward(self, x):
+        if self.training:
+            absmax = jnp.maximum(jnp.max(jnp.abs(x._data)), 1e-8)
+            self.accum._data = self.moving_rate * self.accum._data + absmax
+            self.state._data = self.moving_rate * self.state._data + 1.0
+            self.scale._data = self.accum._data / self.state._data
+        return x
+
+
+def _make_weight_quantizer(quant_type, bits, quant_axis, num_channels):
+    if quant_type == "abs_max":
+        return FakeQuantAbsMax(bits)
+    if quant_type == "channel_wise_abs_max":
+        return FakeQuantAbsMax(bits, channel_wise=True,
+                               quant_axis=quant_axis,
+                               num_channels=num_channels)
+    raise ValueError(
+        f"weight_quantize_type {quant_type!r}: supported are 'abs_max' "
+        "and 'channel_wise_abs_max' (reference qat.py supports abs_max)")
+
+
+def _make_act_quantizer(quant_type, bits, moving_rate):
+    if quant_type == "moving_average_abs_max":
+        return FakeQuantMovingAverage(bits, moving_rate)
+    if quant_type == "abs_max":
+        return FakeQuantAbsMax(bits)
+    raise ValueError(
+        f"activation_quantize_type {quant_type!r}: supported are "
+        "'abs_max' and 'moving_average_abs_max'")
+
+
+class QuantizedLinear(nn.Layer):
+    """reference quant_nn.py:412 QuantizedLinear — fake-quant the input
+    activation and the weight, then run the float matmul."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 moving_rate=0.9):
+        super().__init__()
+        self.inner = layer
+        # Linear weight is [in, out]; channels live on axis 1
+        self.weight_quanter = _make_weight_quantizer(
+            weight_quantize_type, weight_bits, quant_axis=1,
+            num_channels=layer.weight.shape[1])
+        self.act_quanter = _make_act_quantizer(
+            activation_quantize_type, activation_bits, moving_rate)
+
+    def forward(self, x):
+        from ..nn import functional as NF
+        x = self.act_quanter(x)
+        w = self.weight_quanter(self.inner.weight)
+        return NF.linear(x, w, self.inner.bias)
+
+
+class QuantizedConv2D(nn.Layer):
+    """reference quant_nn.py:323 QuantizedConv2D."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 moving_rate=0.9):
+        super().__init__()
+        self.inner = layer
+        # Conv2D weight is [out_c, in_c, kh, kw]; channels on axis 0
+        self.weight_quanter = _make_weight_quantizer(
+            weight_quantize_type, weight_bits, quant_axis=0,
+            num_channels=layer.weight.shape[0])
+        self.act_quanter = _make_act_quantizer(
+            activation_quantize_type, activation_bits, moving_rate)
+
+    def forward(self, x):
+        from ..nn import functional as NF
+        inner = self.inner
+        x = self.act_quanter(x)
+        w = self.weight_quanter(inner.weight)
+        return NF.conv2d(x, w, inner.bias, stride=inner.stride,
+                         padding=inner.padding, dilation=inner.dilation,
+                         groups=inner.groups,
+                         data_format=inner.data_format)
+
+
+_QUANTIZABLE = {"Linear": (nn.Linear, QuantizedLinear),
+                "Conv2D": (nn.Conv2D, QuantizedConv2D)}
+
+
+class ImperativeQuantAware:
+    """reference qat.py:54 — swap quantizable sublayers for fake-quant
+    wrappers, in place."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 moving_rate=0.9,
+                 quantizable_layer_type=("Conv2D", "Linear")):
+        for t in quantizable_layer_type:
+            if t not in _QUANTIZABLE:
+                raise ValueError(
+                    f"quantizable_layer_type {t!r}: supported are "
+                    f"{sorted(_QUANTIZABLE)}")
+        self._cfg = dict(weight_bits=weight_bits,
+                         activation_bits=activation_bits,
+                         weight_quantize_type=weight_quantize_type,
+                         activation_quantize_type=activation_quantize_type,
+                         moving_rate=moving_rate)
+        self._types = tuple(_QUANTIZABLE[t] for t in quantizable_layer_type)
+
+    def quantize(self, model):
+        """In-place layer surgery; returns the model (reference returns
+        None; returning the model keeps call-chaining convenient)."""
+        for parent in model.sublayers(include_self=True):
+            if isinstance(parent, (QuantizedLinear, QuantizedConv2D,
+                                   _ObservedLayer)):
+                continue  # never re-wrap a wrapper's internals
+            for name, child in list(parent.named_children()):
+                # isinstance, like the reference: subclasses of Linear/
+                # Conv2D quantize too (their forward is replaced by the
+                # wrapper's quant->float-op form, same as qat.py)
+                for base, wrapper in self._types:
+                    if isinstance(child, base):
+                        setattr(parent, name, wrapper(child, **self._cfg))
+                        break
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        """Export via the standard StableHLO path — scales live in the
+        checkpointed buffers (reference froze a Program; one IR here)."""
+        from .. import jit
+        model.eval()
+        return jit.save(model, path, input_spec=input_spec)
+
+
+class ImperativeCalcOutScale:
+    """reference qat.py ImperativeCalcOutScale — attach output-scale
+    observers to quantizable layers so export carries out-scales."""
+
+    def __init__(self, moving_rate=0.9):
+        self._rate = moving_rate
+
+    def calc_out_scale(self, model):
+        for parent in model.sublayers(include_self=True):
+            if isinstance(parent, (QuantizedLinear, QuantizedConv2D,
+                                   _ObservedLayer)):
+                # a wrapper's internals (inner/quanters) are part of its
+                # forward contract — observing them would shadow
+                # attributes the wrapper reads (e.g. inner.weight)
+                continue
+            for name, child in list(parent.named_children()):
+                if isinstance(child, (nn.Linear, nn.Conv2D,
+                                      QuantizedLinear, QuantizedConv2D)) \
+                        and not isinstance(child, _ObservedLayer):
+                    setattr(parent, name,
+                            _ObservedLayer(child, self._rate))
+        return model
+
+
+class _ObservedLayer(nn.Layer):
+    def __init__(self, layer, moving_rate):
+        super().__init__()
+        self.inner = layer
+        self.out_scale = MovingAverageAbsMaxScale(moving_rate)
+
+    def forward(self, *args, **kwargs):
+        return self.out_scale(self.inner(*args, **kwargs))

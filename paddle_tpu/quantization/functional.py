@@ -1,0 +1,182 @@
+"""Fake-quantization functionals (QAT/PTQ building blocks).
+
+Reference parity: ``paddle/fluid/operators/fake_quantize_op.cc`` —
+fake_quantize_dequantize_abs_max, fake_channel_wise_quantize_dequantize
+_abs_max, fake_quantize_dequantize_moving_average_abs_max.
+
+TPU-native design: each op is a pure jax function with a
+``jax.custom_vjp`` STRAIGHT-THROUGH estimator (gradient passes through
+inside the clip range, zero outside — the round() itself is invisible
+to the backward), wrapped by the standard ``primitive`` dispatcher so
+the eager tape, AMP and the static recorder all see an ordinary op.
+Quantize-dequantize stays in float throughout: on TPU the win is
+smaller comms/checkpoints and int8-ready scales at export, not int8
+matmuls (the MXU consumes bf16; true int8 kernels would be a Pallas
+add-on).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive, ensure_tensor
+
+
+def _qrange(bits):
+    return float((1 << (bits - 1)) - 1)
+
+
+# -- abs_max (per tensor) --------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _fq_abs_max(x, bits):
+    scale = jnp.max(jnp.abs(x))
+    scale = jnp.maximum(scale, 1e-8)
+    r = _qrange(bits)
+    q = jnp.round(jnp.clip(x, -scale, scale) / scale * r)
+    return q / r * scale, scale
+
+
+def _fq_abs_max_fwd(x, bits):
+    out = _fq_abs_max(x, bits)
+    return out, (x, out[1])
+
+
+def _fq_abs_max_bwd(bits, res, g):
+    x, scale = res
+    gy, _ = g
+    # STE: pass-through inside the representable range
+    return (jnp.where(jnp.abs(x) <= scale, gy, 0.0),)
+
+
+_fq_abs_max.defvjp(_fq_abs_max_fwd, _fq_abs_max_bwd)
+
+
+@primitive(name="fake_quantize_dequantize_abs_max")
+def _fq_abs_max_op(x, bits=8):
+    return _fq_abs_max(x, bits)
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length=8, name=None):
+    """-> (quant-dequant x, scale).  reference: fake_quantize_op.cc
+    FakeQuantizeDequantizeAbsMaxOp."""
+    return _fq_abs_max_op(ensure_tensor(x), bits=bit_length)
+
+
+# -- channel-wise abs_max --------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _fq_channel(x, bits, axis):
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    scale = jnp.maximum(scale, 1e-8)
+    r = _qrange(bits)
+    q = jnp.round(jnp.clip(x, -scale, scale) / scale * r)
+    return q / r * scale, scale.reshape(x.shape[axis])
+
+
+def _fq_channel_fwd(x, bits, axis):
+    out = _fq_channel(x, bits, axis)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    return out, (x, out[1].reshape(shape))
+
+
+def _fq_channel_bwd(bits, axis, res, g):
+    x, scale = res
+    gy, _ = g
+    return (jnp.where(jnp.abs(x) <= scale, gy, 0.0),)
+
+
+_fq_channel.defvjp(_fq_channel_fwd, _fq_channel_bwd)
+
+
+@primitive(name="fake_channel_wise_quantize_dequantize_abs_max")
+def _fq_channel_op(x, bits=8, quant_axis=0):
+    return _fq_channel(x, bits, quant_axis)
+
+
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
+                                                  quant_axis=0, name=None):
+    """-> (quant-dequant x, per-channel scales [C]).  reference:
+    fake_quantize_op.cc FakeChannelWiseQuantizeDequantizeAbsMaxOp."""
+    return _fq_channel_op(ensure_tensor(x), bits=bit_length,
+                          quant_axis=quant_axis)
+
+
+# -- moving-average abs_max ------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fq_moving(x, accum, state, scale, bits, rate):
+    """paddle's accumulator form: accum = rate*accum + absmax,
+    state = rate*state + 1, scale = accum/state (fake_quantize_op.h
+    FindMovingAverageAbsMaxFunctor)."""
+    absmax = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    new_accum = rate * accum + absmax
+    new_state = rate * state + 1.0
+    new_scale = new_accum / new_state
+    r = _qrange(bits)
+    q = jnp.round(jnp.clip(x, -new_scale, new_scale) / new_scale * r)
+    return q / r * new_scale, new_accum, new_state, new_scale
+
+
+def _fq_moving_fwd(x, accum, state, scale, bits, rate):
+    out = _fq_moving(x, accum, state, scale, bits, rate)
+    return out, (x, out[3])
+
+
+def _fq_moving_bwd(bits, rate, res, g):
+    x, scale = res
+    gy = g[0]
+    return (jnp.where(jnp.abs(x) <= scale, gy, 0.0), None, None, None)
+
+
+_fq_moving.defvjp(_fq_moving_fwd, _fq_moving_bwd)
+
+
+@primitive(name="fake_quantize_dequantize_moving_average_abs_max",
+           nondiff=(1, 2, 3))
+def _fq_moving_op(x, accum, state, scale, bits=8, rate=0.9):
+    return _fq_moving(x, accum, state, scale, bits, rate)
+
+
+def fake_quantize_dequantize_moving_average_abs_max(
+        x, accum, state, scale, bit_length=8, moving_rate=0.9, name=None):
+    """-> (quant-dequant x, new_accum, new_state, new_scale)."""
+    return _fq_moving_op(ensure_tensor(x), ensure_tensor(accum),
+                         ensure_tensor(state), ensure_tensor(scale),
+                         bits=bit_length, rate=moving_rate)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _qds(x, scale, bits):
+    r = _qrange(bits)
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.round(jnp.clip(x, -scale, scale) / scale * r)
+    return q / r * scale
+
+
+def _qds_fwd(x, scale, bits):
+    return _qds(x, scale, bits), (x, jnp.maximum(scale, 1e-8))
+
+
+def _qds_bwd(bits, res, gy):
+    x, scale = res
+    return (jnp.where(jnp.abs(x) <= scale, gy, 0.0), None)
+
+
+_qds.defvjp(_qds_fwd, _qds_bwd)
+
+
+@primitive(name="quantize_with_scale", nondiff=(1,))
+def _quant_with_scale(x, scale, bits=8):
+    return _qds(x, scale, bits)
+
+
+def quantize_dequantize_with_scale(x, scale, bit_length=8):
+    """Eval-time quant-dequant against a FIXED scale (the trained
+    moving-average scale; reference: quant_nn.py eval branch)."""
+    return _quant_with_scale(ensure_tensor(x), ensure_tensor(scale),
+                             bits=bit_length)
