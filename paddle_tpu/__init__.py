@@ -237,3 +237,5 @@ del _late_bind
 
 # fluid namespace last: it re-exports names defined above (places, etc.)
 from . import fluid  # noqa: E402,F401
+from . import dataset  # noqa: E402,F401  (1.x reader factories)
+from . import quantization  # noqa: E402,F401

@@ -1,0 +1,30 @@
+"""1.x ``paddle.dataset`` namespace — reader-generator factories.
+
+Reference parity: ``python/paddle/dataset/`` (mnist/cifar/uci_housing/
+imdb/imikolov/movielens/conll05/wmt14/wmt16/voc2012/image/common).
+Each module exposes the reference's ``train()``/``test()`` factories
+returning zero-arg generators of sample tuples.
+
+TPU-native/no-egress design: everything delegates to the class-style
+datasets (``vision/datasets.py``, ``text/datasets.py``) which load a
+local cache when present and otherwise synthesize deterministic samples
+with the correct shapes/dtypes — the 1.x reader surface is an adapter,
+not a second implementation.
+"""
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import movielens  # noqa: F401
+from . import conll05  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401
+from . import voc2012  # noqa: F401
+from . import flowers  # noqa: F401
+from . import image  # noqa: F401
+
+__all__ = ["common", "mnist", "cifar", "uci_housing", "imdb",
+           "imikolov", "movielens", "conll05", "wmt14", "wmt16",
+           "voc2012", "flowers", "image"]

@@ -1,0 +1,33 @@
+"""reference: python/paddle/dataset/cifar.py — yields
+(image[3072] float32 in [0, 1], label int)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _reader(cls_name, mode):
+    def reader():
+        from ..vision import datasets as vds
+        ds = getattr(vds, cls_name)(mode=mode)
+        for i in range(len(ds)):
+            img, label = ds[i]
+            yield np.asarray(img, np.float32).reshape(-1), int(label)
+    return reader
+
+
+def train10():
+    return _reader("Cifar10", "train")
+
+
+def test10():
+    return _reader("Cifar10", "test")
+
+
+def train100():
+    return _reader("Cifar100", "train")
+
+
+def test100():
+    return _reader("Cifar100", "test")

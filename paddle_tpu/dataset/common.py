@@ -1,0 +1,47 @@
+"""reference: python/paddle/dataset/common.py — cache-home helpers.
+
+No-egress environment: ``download`` NEVER fetches; it returns the local
+cache path when the file exists and raises a guided error otherwise
+(the class-style datasets' synthetic fallbacks are the offline path)."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+def data_home():
+    """Cache root, resolved at CALL time so PADDLE_TPU_DATA_HOME set
+    after import still applies — the single definition every dataset
+    module (vision/text/1.x readers) shares."""
+    return os.path.expanduser(os.environ.get(
+        "PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+DATA_HOME = data_home()
+
+__all__ = ["DATA_HOME", "data_home", "md5file", "download"]
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    dirname = os.path.join(data_home(), module_name)
+    filename = os.path.join(
+        dirname, save_name or url.split("/")[-1])
+    if os.path.exists(filename):
+        if not md5sum or md5file(filename) == md5sum:
+            return filename
+        raise RuntimeError(
+            f"paddle.dataset: {filename} exists but its md5 does not "
+            f"match {md5sum} — the file is corrupt or truncated; "
+            "replace it (this environment cannot re-download)")
+    raise RuntimeError(
+        f"paddle.dataset: {filename} is not cached and this environment "
+        "has no network egress — place the file there manually, or use "
+        "the paddle_tpu.vision/text dataset classes, whose synthetic "
+        "fallback needs no data")

@@ -1,0 +1,18 @@
+"""reference: python/paddle/dataset/conll05.py — SRL tuples."""
+from __future__ import annotations
+
+__all__ = ["get_dict", "test"]
+
+
+def get_dict():
+    from ..text.datasets import Conll05st
+    return Conll05st().get_dict()
+
+
+def test():
+    def reader():
+        from ..text.datasets import Conll05st
+        ds = Conll05st()
+        for i in range(len(ds)):
+            yield ds[i]
+    return reader

@@ -1,0 +1,25 @@
+"""reference: python/paddle/dataset/flowers.py — (image, label)."""
+from __future__ import annotations
+
+__all__ = ["train", "test", "valid"]
+
+
+def _reader(mode):
+    def reader():
+        from ..vision.datasets import Flowers
+        ds = Flowers(mode=mode)
+        for i in range(len(ds)):
+            yield ds[i]
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader("train")
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader("test")
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader("valid")

@@ -1,0 +1,92 @@
+"""reference: python/paddle/dataset/image.py — numpy image transforms.
+
+The reference shells out to cv2; these are pure-numpy equivalents
+(bilinear resize, crops, flip, CHW conversion, simple_transform) so the
+1.x reader pipelines work without OpenCV.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["resize_short", "center_crop", "random_crop",
+           "left_right_flip", "to_chw", "simple_transform"]
+
+
+def _resize_bilinear(img, h, w):
+    """img [H, W, C] (or [H, W]) -> [h, w, ...] bilinear."""
+    img = np.asarray(img)
+    H, W = img.shape[:2]
+    if (H, W) == (h, w):
+        return img
+    ys = (np.arange(h) + 0.5) * H / h - 0.5
+    xs = (np.arange(w) + 0.5) * W / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, H - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, W - 1)
+    y1 = np.clip(y0 + 1, 0, H - 1)
+    x1 = np.clip(x0 + 1, 0, W - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :]
+    if img.ndim == 3:
+        wy = wy[..., None]
+        wx = wx[..., None]
+    a = img[y0][:, x0]
+    b = img[y0][:, x1]
+    c = img[y1][:, x0]
+    d = img[y1][:, x1]
+    top = a * (1 - wx) + b * wx
+    bot = c * (1 - wx) + d * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(img.dtype) if np.issubdtype(img.dtype, np.integer) \
+        else out
+
+
+def resize_short(im, size):
+    """Scale so the SHORTER edge equals ``size`` (image.py:resize_short)."""
+    h, w = im.shape[:2]
+    if h < w:
+        return _resize_bilinear(im, size, int(round(w * size / h)))
+    return _resize_bilinear(im, int(round(h * size / w)), size)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = max((h - size) // 2, 0)
+    w0 = max((w - size) // 2, 0)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = np.random.randint(0, max(h - size, 0) + 1)
+    w0 = np.random.randint(0, max(w - size, 0) + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return im.transpose(order)
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize-short -> crop (+random flip in train) -> CHW -> -mean
+    (image.py:simple_transform)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        if mean.ndim == 1 and im.ndim == 3:
+            mean = mean[:, None, None]
+        im -= mean
+    return im

@@ -1,0 +1,31 @@
+"""reference: python/paddle/dataset/imdb.py — yields
+(word_id list, 0/1 label)."""
+from __future__ import annotations
+
+__all__ = ["build_dict", "train", "test", "word_dict"]
+
+
+def word_dict(cutoff=150):
+    from ..text.datasets import Imdb
+    return Imdb(mode="train", cutoff=cutoff).word_idx
+
+
+build_dict = word_dict
+
+
+def _reader(mode):
+    def reader():
+        from ..text.datasets import Imdb
+        ds = Imdb(mode=mode)
+        for i in range(len(ds)):
+            doc, label = ds[i]
+            yield list(int(w) for w in doc), int(label[0])
+    return reader
+
+
+def train(word_idx=None):
+    return _reader("train")
+
+
+def test(word_idx=None):
+    return _reader("test")

@@ -1,0 +1,30 @@
+"""reference: python/paddle/dataset/imikolov.py — PTB n-grams."""
+from __future__ import annotations
+
+__all__ = ["build_dict", "train", "test"]
+
+
+def build_dict(min_word_freq=50):
+    from ..text.datasets import Imikolov
+    ds = Imikolov(mode="train", min_word_freq=min_word_freq)
+    return {i: i for i in range(ds.VOCAB)}
+
+
+def _reader(mode, word_idx, n, data_type):
+    def reader():
+        from ..text.datasets import Imikolov
+        dt = "NGRAM" if str(data_type).upper().startswith("N") or \
+            data_type == 1 else "SEQ"
+        ds = Imikolov(mode=mode, data_type=dt, window_size=n)
+        for i in range(len(ds)):
+            yield tuple(int(x) if getattr(x, "ndim", 1) == 0 else x
+                        for x in ds[i])
+    return reader
+
+
+def train(word_idx, n, data_type="NGRAM"):
+    return _reader("train", word_idx, n, data_type)
+
+
+def test(word_idx, n, data_type="NGRAM"):
+    return _reader("test", word_idx, n, data_type)

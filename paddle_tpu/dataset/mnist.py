@@ -1,0 +1,35 @@
+"""reference: python/paddle/dataset/mnist.py — yields
+(image[784] float32 in [-1, 1], label int)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test"]
+
+
+def _reader(mode):
+    def reader():
+        from ..vision.datasets import MNIST
+        ds = MNIST(mode=mode)
+        for i in range(len(ds)):
+            img, label = ds[i]
+            flat = np.asarray(img, np.float32).reshape(-1)
+            # reference scaling: idx bytes / 127.5 - 1; the class dataset
+            # already divides by 255, so rescale to [-1, 1]
+            yield flat * 2.0 - 1.0, int(label)
+    return reader
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")
+
+
+def fetch():
+    """Materialize both splits (cache validation / synthetic warm-up) —
+    generators are lazy, so actually pull one sample from each."""
+    next(iter(train()()))
+    next(iter(test()()))

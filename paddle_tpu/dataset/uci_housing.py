@@ -1,0 +1,23 @@
+"""reference: python/paddle/dataset/uci_housing.py — yields
+(features[13] f32 normalized, price[1] f32)."""
+from __future__ import annotations
+
+__all__ = ["train", "test"]
+
+
+def _reader(mode):
+    def reader():
+        from ..text.datasets import UCIHousing
+        ds = UCIHousing(mode=mode)
+        for i in range(len(ds)):
+            feats, price = ds[i]
+            yield feats, price
+    return reader
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")

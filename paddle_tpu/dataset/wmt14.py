@@ -1,0 +1,21 @@
+"""reference: python/paddle/dataset/wmt14.py — (src, trg, trg_next)."""
+from __future__ import annotations
+
+__all__ = ["train", "test"]
+
+
+def _reader(mode, dict_size):
+    def reader():
+        from ..text.datasets import WMT14
+        ds = WMT14(mode=mode, dict_size=dict_size)
+        for i in range(len(ds)):
+            yield ds[i]
+    return reader
+
+
+def train(dict_size=30000):
+    return _reader("train", dict_size)
+
+
+def test(dict_size=30000):
+    return _reader("test", dict_size)

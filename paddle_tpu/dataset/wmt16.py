@@ -1,0 +1,22 @@
+"""reference: python/paddle/dataset/wmt16.py — (src, trg, trg_next)."""
+from __future__ import annotations
+
+__all__ = ["train", "test"]
+
+
+def _reader(mode, src_dict_size, trg_dict_size):
+    def reader():
+        from ..text.datasets import WMT16
+        ds = WMT16(mode=mode, src_dict_size=src_dict_size,
+                   trg_dict_size=trg_dict_size)
+        for i in range(len(ds)):
+            yield ds[i]
+    return reader
+
+
+def train(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return _reader("train", src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return _reader("test", src_dict_size, trg_dict_size)

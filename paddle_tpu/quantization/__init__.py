@@ -44,6 +44,13 @@ class FakeQuantAbsMax(nn.Layer):
     def __init__(self, bits=8, channel_wise=False, quant_axis=0,
                  num_channels=None):
         super().__init__()
+        if channel_wise and not num_channels:
+            # a scalar scale buffer could never record the per-channel
+            # scales under a compiled step (shape mismatch is silently
+            # skipped there) — exported scales would stay at init
+            raise ValueError(
+                "FakeQuantAbsMax(channel_wise=True) requires "
+                "num_channels (the size of quant_axis)")
         self.bits = bits
         self.channel_wise = channel_wise
         self.quant_axis = quant_axis
@@ -108,10 +115,11 @@ class MovingAverageAbsMaxScale(nn.Layer):
 
     def forward(self, x):
         if self.training:
-            absmax = jnp.maximum(jnp.max(jnp.abs(x._data)), 1e-8)
-            self.accum._data = self.moving_rate * self.accum._data + absmax
-            self.state._data = self.moving_rate * self.state._data + 1.0
-            self.scale._data = self.accum._data / self.state._data
+            accum, state, scale = F.moving_average_abs_max_scale(
+                x, self.accum, self.state, self.moving_rate)
+            self.accum._data = accum._data
+            self.state._data = state._data
+            self.scale._data = scale._data
         return x
 
 
@@ -217,8 +225,7 @@ class ImperativeQuantAware:
         """In-place layer surgery; returns the model (reference returns
         None; returning the model keeps call-chaining convenient)."""
         for parent in model.sublayers(include_self=True):
-            if isinstance(parent, (QuantizedLinear, QuantizedConv2D,
-                                   _ObservedLayer)):
+            if isinstance(parent, (QuantizedLinear, QuantizedConv2D)):
                 continue  # never re-wrap a wrapper's internals
             for name, child in list(parent.named_children()):
                 # isinstance, like the reference: subclasses of Linear/
@@ -226,7 +233,23 @@ class ImperativeQuantAware:
                 # wrapper's quant->float-op form, same as qat.py)
                 for base, wrapper in self._types:
                     if isinstance(child, base):
-                        setattr(parent, name, wrapper(child, **self._cfg))
+                        w = wrapper(child, **self._cfg)
+                        if hasattr(child, "_out_scale"):
+                            # observer hooks fire on __call__, which the
+                            # wrapper's direct functional form bypasses —
+                            # re-observe on the wrapper (stats restart;
+                            # the reference order is quantize() first,
+                            # then calc_out_scale())
+                            import warnings
+                            warnings.warn(
+                                "calc_out_scale() ran before quantize(): "
+                                "output-scale stats reset on the "
+                                "quantized wrapper; prefer quantize() "
+                                "-> calc_out_scale()")
+                            w._out_scale = MovingAverageAbsMaxScale(
+                                child._out_scale.moving_rate)
+                            w.register_forward_post_hook(_observe_output)
+                        setattr(parent, name, w)
                         break
         return model
 
@@ -238,35 +261,29 @@ class ImperativeQuantAware:
         return jit.save(model, path, input_spec=input_spec)
 
 
+def _observe_output(layer, inputs, output):
+    return layer._out_scale(output)
+
+
 class ImperativeCalcOutScale:
     """reference qat.py ImperativeCalcOutScale — attach output-scale
-    observers to quantizable layers so export carries out-scales."""
+    observers to quantizable layers so export carries out-scales.
+
+    Layer IDENTITY is preserved (the reference uses forward post-hooks
+    for the same reason): the observer is registered as a child module
+    named ``_out_scale`` (so its EMA buffers live in state_dict under
+    the layer's own prefix) and runs via register_forward_post_hook —
+    ``net.fc`` stays a Linear, float checkpoints keep their keys, and a
+    later ``quantize()`` still recognizes the layer."""
 
     def __init__(self, moving_rate=0.9):
         self._rate = moving_rate
 
     def calc_out_scale(self, model):
-        for parent in model.sublayers(include_self=True):
-            if isinstance(parent, (QuantizedLinear, QuantizedConv2D,
-                                   _ObservedLayer)):
-                # a wrapper's internals (inner/quanters) are part of its
-                # forward contract — observing them would shadow
-                # attributes the wrapper reads (e.g. inner.weight)
-                continue
-            for name, child in list(parent.named_children()):
-                if isinstance(child, (nn.Linear, nn.Conv2D,
-                                      QuantizedLinear, QuantizedConv2D)) \
-                        and not isinstance(child, _ObservedLayer):
-                    setattr(parent, name,
-                            _ObservedLayer(child, self._rate))
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, (nn.Linear, nn.Conv2D,
+                                  QuantizedLinear, QuantizedConv2D)) \
+                    and not hasattr(layer, "_out_scale"):
+                layer._out_scale = MovingAverageAbsMaxScale(self._rate)
+                layer.register_forward_post_hook(_observe_output)
         return model
-
-
-class _ObservedLayer(nn.Layer):
-    def __init__(self, layer, moving_rate):
-        super().__init__()
-        self.inner = layer
-        self.out_scale = MovingAverageAbsMaxScale(moving_rate)
-
-    def forward(self, *args, **kwargs):
-        return self.out_scale(self.inner(*args, **kwargs))

@@ -108,15 +108,20 @@ def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
 
 # -- moving-average abs_max ------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _fq_moving(x, accum, state, scale, bits, rate):
+def _ema_absmax(x, accum, state, rate):
     """paddle's accumulator form: accum = rate*accum + absmax,
     state = rate*state + 1, scale = accum/state (fake_quantize_op.h
-    FindMovingAverageAbsMaxFunctor)."""
+    FindMovingAverageAbsMaxFunctor).  The ONE implementation — the
+    fake-quant op and the pure observer both use it."""
     absmax = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
     new_accum = rate * accum + absmax
     new_state = rate * state + 1.0
-    new_scale = new_accum / new_state
+    return new_accum, new_state, new_accum / new_state
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fq_moving(x, accum, state, scale, bits, rate):
+    new_accum, new_state, new_scale = _ema_absmax(x, accum, state, rate)
     r = _qrange(bits)
     q = jnp.round(jnp.clip(x, -new_scale, new_scale) / new_scale * r)
     return q / r * new_scale, new_accum, new_state, new_scale
@@ -148,6 +153,20 @@ def fake_quantize_dequantize_moving_average_abs_max(
     return _fq_moving_op(ensure_tensor(x), ensure_tensor(accum),
                          ensure_tensor(state), ensure_tensor(scale),
                          bits=bit_length, rate=moving_rate)
+
+
+@primitive(name="moving_average_abs_max_scale", nondiff=(0, 1, 2))
+def _maams_op(x, accum, state, rate=0.9):
+    return _ema_absmax(x, accum, state, rate)
+
+
+def moving_average_abs_max_scale(x, accum, state, moving_rate=0.9):
+    """Observer form: update the EMA abs-max WITHOUT quantizing
+    (reference: moving_average_abs_max_scale op used by
+    MovingAverageAbsMaxScale).  -> (new_accum, new_state, new_scale);
+    all inputs non-differentiable — observation never shapes grads."""
+    return _maams_op(ensure_tensor(x), ensure_tensor(accum),
+                     ensure_tensor(state), rate=moving_rate)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))

@@ -18,8 +18,9 @@ import numpy as np
 
 from ..io import Dataset
 
-DATA_HOME = os.path.expanduser(os.environ.get(
-    "PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+from ..dataset.common import data_home as _data_home
+
+DATA_HOME = _data_home()  # snapshot for back-compat importers
 
 
 def _synth_n(default=512):
@@ -159,7 +160,7 @@ class UCIHousing(Dataset):
 
     def __init__(self, data_file=None, mode="train", download=True):
         assert mode in ("train", "test")
-        path = data_file or os.path.join(DATA_HOME, "uci_housing",
+        path = data_file or os.path.join(_data_home(), "uci_housing",
                                          "housing.data")
         if os.path.exists(path):
             raw = np.loadtxt(path).astype(np.float32)

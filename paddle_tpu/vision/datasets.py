@@ -19,8 +19,9 @@ import numpy as np
 
 from ..io import Dataset
 
-DATA_HOME = os.path.expanduser(os.environ.get(
-    "PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+from ..dataset.common import data_home as _data_home
+
+DATA_HOME = _data_home()  # snapshot for back-compat importers
 
 
 def _synthetic(n, shape, num_classes, seed):
@@ -41,7 +42,7 @@ class MNIST(Dataset):
                  synthetic_size=None):
         self.mode = mode
         self.transform = transform
-        base = os.path.join(DATA_HOME, "mnist")
+        base = os.path.join(_data_home(), "mnist")
         prefix = "train" if mode == "train" else "t10k"
         image_path = image_path or os.path.join(
             base, f"{prefix}-images-idx3-ubyte.gz")
@@ -87,7 +88,7 @@ class MNIST(Dataset):
 
 class FashionMNIST(MNIST):
     def __init__(self, **kwargs):
-        base = os.path.join(DATA_HOME, "fashion-mnist")
+        base = os.path.join(_data_home(), "fashion-mnist")
         prefix = "train" if kwargs.get("mode", "train") == "train" else \
             "t10k"
         kwargs.setdefault("image_path", os.path.join(
@@ -105,7 +106,7 @@ class Cifar10(Dataset):
         self.mode = mode
         self.transform = transform
         data_file = data_file or os.path.join(
-            DATA_HOME, "cifar", "cifar-10-python.tar.gz")
+            _data_home(), "cifar", "cifar-10-python.tar.gz")
         if os.path.exists(data_file):
             self.images, self.labels = self._load_tar(data_file, mode)
         else:
@@ -151,7 +152,7 @@ class Cifar100(Cifar10):
 
     def __init__(self, data_file=None, **kwargs):
         data_file = data_file or os.path.join(
-            DATA_HOME, "cifar", "cifar-100-python.tar.gz")
+            _data_home(), "cifar", "cifar-100-python.tar.gz")
         super().__init__(data_file=data_file, **kwargs)
 
 
@@ -172,7 +173,7 @@ class Flowers(Dataset):
                  synthetic_size=None):
         self.mode = mode
         self.transform = transform
-        base = os.path.join(DATA_HOME, "flowers")
+        base = os.path.join(_data_home(), "flowers")
         data_file = data_file or os.path.join(base, "102flowers.tgz")
         if os.path.exists(data_file):
             # a REAL downloaded archive exists (however the path was

@@ -1,0 +1,124 @@
+"""1.x paddle.dataset reader factories (reference: python/paddle/dataset/
+— mnist/cifar/uci_housing/imdb/imikolov/movielens/conll05/wmt/voc2012/
+image).  Adapters over the class-style datasets; each reader yields the
+reference's tuple shapes."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import dataset
+
+os.environ.setdefault("PADDLE_TPU_SYNTH_N", "32")
+
+
+def _take(reader, n=3):
+    out = []
+    for i, sample in enumerate(reader()):
+        out.append(sample)
+        if i + 1 >= n:
+            break
+    return out
+
+
+class TestReaders:
+    def test_mnist_shapes_and_range(self):
+        for s in _take(dataset.mnist.train()):
+            img, label = s
+            assert img.shape == (784,) and img.dtype == np.float32
+            assert -1.0 <= img.min() and img.max() <= 1.0
+            assert 0 <= label <= 9
+
+    def test_cifar_shapes(self):
+        for img, label in _take(dataset.cifar.train10()):
+            assert img.shape == (3072,)
+            assert 0 <= label <= 9
+        for img, label in _take(dataset.cifar.test100()):
+            assert 0 <= label <= 99
+
+    def test_uci_housing(self):
+        for feats, price in _take(dataset.uci_housing.train()):
+            assert feats.shape == (13,) and price.shape == (1,)
+
+    def test_imdb(self):
+        wd = dataset.imdb.word_dict()
+        assert len(wd) > 100
+        for doc, label in _take(dataset.imdb.train(wd)):
+            assert isinstance(doc, list) and label in (0, 1)
+
+    def test_imikolov_ngram(self):
+        wd = dataset.imikolov.build_dict()
+        for gram in _take(dataset.imikolov.train(wd, 5)):
+            assert len(gram) == 5
+
+    def test_movielens(self):
+        assert dataset.movielens.max_user_id() == 6040
+        for row in _take(dataset.movielens.train()):
+            assert len(row) == 8
+
+    def test_conll05(self):
+        w, v, l = dataset.conll05.get_dict()
+        assert len(l) == 59
+        for rec in _take(dataset.conll05.test()):
+            assert len(rec) == 9
+
+    def test_wmt(self):
+        for src, trg, trg_next in _take(dataset.wmt14.train(1000)):
+            assert len(trg) == len(trg_next)
+        for rec in _take(dataset.wmt16.test()):
+            assert len(rec) == 3
+
+    def test_voc2012_and_flowers(self):
+        img, mask = next(iter(dataset.voc2012.val()()))
+        assert img.shape[-2:] == mask.shape[-2:] or \
+            img.shape[:2] == mask.shape[:2]
+        img, label = next(iter(dataset.flowers.test()()))
+        assert int(label) < 102
+
+    def test_common_download_cached_and_missing(self, tmp_path):
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"hello")
+        os.environ["PADDLE_TPU_DATA_HOME"] = str(tmp_path)
+        import importlib
+        from paddle_tpu.dataset import common as c
+        importlib.reload(c)
+        try:
+            (tmp_path / "mod").mkdir()
+            (tmp_path / "mod" / "x.bin").write_bytes(b"hi")
+            got = c.download("http://x/x.bin", "mod", c.md5file(
+                str(tmp_path / "mod" / "x.bin")))
+            assert got.endswith("x.bin")
+            with pytest.raises(RuntimeError, match="no network egress"):
+                c.download("http://x/missing.bin", "mod", "")
+        finally:
+            os.environ.pop("PADDLE_TPU_DATA_HOME")
+            importlib.reload(c)
+
+
+class TestImageTransforms:
+    def test_resize_short_and_crops(self):
+        from paddle_tpu.dataset import image as I
+        im = np.arange(20 * 30 * 3, dtype=np.uint8).reshape(20, 30, 3)
+        r = I.resize_short(im, 10)
+        assert min(r.shape[:2]) == 10 and r.shape[1] == 15
+        c = I.center_crop(r, 8)
+        assert c.shape[:2] == (8, 8)
+        f = I.left_right_flip(c)
+        np.testing.assert_array_equal(np.asarray(f)[:, ::-1], c)
+
+    def test_simple_transform_chw_mean(self):
+        from paddle_tpu.dataset import image as I
+        im = np.random.RandomState(0).randint(
+            0, 255, (40, 50, 3)).astype(np.uint8)
+        out = I.simple_transform(im, 32, 24, is_train=False,
+                                 mean=[1.0, 2.0, 3.0])
+        assert out.shape == (3, 24, 24) and out.dtype == np.float32
+
+    def test_resize_bilinear_values(self):
+        from paddle_tpu.dataset import image as I
+        im = np.array([[0.0, 10.0], [20.0, 30.0]], np.float32)
+        r = I._resize_bilinear(im, 4, 4)
+        assert r.shape == (4, 4)
+        assert r[0, 0] <= r[-1, -1]
+        np.testing.assert_allclose(r.mean(), im.mean(), atol=2.0)

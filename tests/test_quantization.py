@@ -149,7 +149,7 @@ class TestImperativeQAT:
             np.random.RandomState(3).rand(4, 1, 8, 8).astype(np.float32))
         net.train()
         net(x)
-        scale = float(net.fc.out_scale.scale.numpy())
+        scale = float(net.fc._out_scale.scale.numpy())
         assert scale != 1.0 and np.isfinite(scale)
 
     def test_fluid_contrib_slim_import_path(self):
@@ -183,8 +183,9 @@ class TestImperativeQAT:
 
 class TestReviewRegressions:
     def test_quantize_then_calc_out_scale(self):
-        """The reference workflow quantize() -> calc_out_scale() must not
-        wrap a Quantized wrapper's internals."""
+        """The reference workflow quantize() -> calc_out_scale(): layer
+        identity is preserved via forward post-hooks (no wrapper around
+        wrapper internals) and the observer actually collects."""
         paddle.seed(5)
         net = _ConvNet()
         ImperativeQuantAware().quantize(net)
@@ -193,6 +194,25 @@ class TestReviewRegressions:
             np.random.RandomState(6).rand(2, 1, 8, 8).astype(np.float32))
         out = net(x)  # must not raise
         assert np.isfinite(out.numpy()).all()
+        assert float(net.fc._out_scale.scale.numpy()) != 1.0
+        # identity preserved: still the Quantized wrapper, weight visible
+        assert isinstance(net.fc, QuantizedLinear)
+        assert net.fc.inner.weight is not None
+
+    def test_observe_preserves_float_checkpoint_keys(self):
+        """calc_out_scale must not shift existing state_dict keys (the
+        old wrapper approach renamed fc.weight -> fc.inner.weight)."""
+        paddle.seed(7)
+        net = _ConvNet()
+        keys_before = set(net.state_dict().keys())
+        ImperativeCalcOutScale().calc_out_scale(net)
+        keys_after = set(net.state_dict().keys())
+        assert keys_before <= keys_after
+        # a float checkpoint still loads
+        net2 = _ConvNet()
+        sd = net2.state_dict()
+        net.set_state_dict(sd)
+        assert net.fc.weight.shape == net2.fc.weight.shape
 
     def test_linear_subclass_quantizes(self):
         class MyLinear(nn.Linear):
