@@ -4,22 +4,25 @@ from __future__ import annotations
 __all__ = ["train", "test", "valid"]
 
 
-def _reader(mode):
+def _reader(mode, mapper):
     def reader():
         from ..vision.datasets import Flowers
         ds = Flowers(mode=mode)
         for i in range(len(ds)):
-            yield ds[i]
+            sample = ds[i]
+            # the reference applies mapper per sample (typically the
+            # dataset.image transforms)
+            yield mapper(sample) if mapper is not None else sample
     return reader
 
 
 def train(mapper=None, buffered_size=1024, use_xmap=False):
-    return _reader("train")
+    return _reader("train", mapper)
 
 
 def test(mapper=None, buffered_size=1024, use_xmap=False):
-    return _reader("test")
+    return _reader("test", mapper)
 
 
 def valid(mapper=None, buffered_size=1024, use_xmap=False):
-    return _reader("valid")
+    return _reader("valid", mapper)

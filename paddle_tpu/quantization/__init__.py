@@ -233,22 +233,33 @@ class ImperativeQuantAware:
                 # wrapper's quant->float-op form, same as qat.py)
                 for base, wrapper in self._types:
                     if isinstance(child, base):
-                        w = wrapper(child, **self._cfg)
                         if hasattr(child, "_out_scale"):
                             # observer hooks fire on __call__, which the
                             # wrapper's direct functional form bypasses —
-                            # re-observe on the wrapper (stats restart;
-                            # the reference order is quantize() first,
-                            # then calc_out_scale())
+                            # MOVE the observer to the wrapper (stats
+                            # reset; the reference order is quantize()
+                            # first, then calc_out_scale()) and strip
+                            # the child's copy so no frozen buffers leak
+                            # into state_dict
                             import warnings
                             warnings.warn(
                                 "calc_out_scale() ran before quantize(): "
                                 "output-scale stats reset on the "
                                 "quantized wrapper; prefer quantize() "
                                 "-> calc_out_scale()")
-                            w._out_scale = MovingAverageAbsMaxScale(
-                                child._out_scale.moving_rate)
-                            w.register_forward_post_hook(_observe_output)
+                            rate = child._out_scale.moving_rate
+                            hook = getattr(child, "_out_scale_hook", None)
+                            if hook is not None:
+                                hook.remove()
+                                del child._out_scale_hook
+                            del child._out_scale
+                            w = wrapper(child, **self._cfg)
+                            w._out_scale = MovingAverageAbsMaxScale(rate)
+                            w._out_scale_hook = \
+                                w.register_forward_post_hook(
+                                    _observe_output)
+                        else:
+                            w = wrapper(child, **self._cfg)
                         setattr(parent, name, w)
                         break
         return model
@@ -280,10 +291,19 @@ class ImperativeCalcOutScale:
         self._rate = moving_rate
 
     def calc_out_scale(self, model):
+        # wrapper INTERNALS never observe: QuantizedLinear.forward calls
+        # the functional directly, so a hook on .inner would never fire —
+        # it would only ship frozen init-value buffers in state_dict
+        inner_ids = {id(lay.inner)
+                     for lay in model.sublayers(include_self=True)
+                     if isinstance(lay, (QuantizedLinear, QuantizedConv2D))}
         for layer in model.sublayers(include_self=True):
+            if id(layer) in inner_ids:
+                continue
             if isinstance(layer, (nn.Linear, nn.Conv2D,
                                   QuantizedLinear, QuantizedConv2D)) \
                     and not hasattr(layer, "_out_scale"):
                 layer._out_scale = MovingAverageAbsMaxScale(self._rate)
-                layer.register_forward_post_hook(_observe_output)
+                layer._out_scale_hook = \
+                    layer.register_forward_post_hook(_observe_output)
         return model

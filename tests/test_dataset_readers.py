@@ -76,24 +76,20 @@ class TestReaders:
         img, label = next(iter(dataset.flowers.test()()))
         assert int(label) < 102
 
-    def test_common_download_cached_and_missing(self, tmp_path):
-        p = tmp_path / "f.bin"
-        p.write_bytes(b"hello")
-        os.environ["PADDLE_TPU_DATA_HOME"] = str(tmp_path)
-        import importlib
+    def test_common_download_cached_and_missing(self, tmp_path,
+                                                 monkeypatch):
+        # data_home() resolves at call time, so monkeypatch alone works
+        monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
         from paddle_tpu.dataset import common as c
-        importlib.reload(c)
-        try:
-            (tmp_path / "mod").mkdir()
-            (tmp_path / "mod" / "x.bin").write_bytes(b"hi")
-            got = c.download("http://x/x.bin", "mod", c.md5file(
-                str(tmp_path / "mod" / "x.bin")))
-            assert got.endswith("x.bin")
-            with pytest.raises(RuntimeError, match="no network egress"):
-                c.download("http://x/missing.bin", "mod", "")
-        finally:
-            os.environ.pop("PADDLE_TPU_DATA_HOME")
-            importlib.reload(c)
+        (tmp_path / "mod").mkdir()
+        (tmp_path / "mod" / "x.bin").write_bytes(b"hi")
+        got = c.download("http://x/x.bin", "mod", c.md5file(
+            str(tmp_path / "mod" / "x.bin")))
+        assert got.endswith("x.bin")
+        with pytest.raises(RuntimeError, match="no network egress"):
+            c.download("http://x/missing.bin", "mod", "")
+        with pytest.raises(RuntimeError, match="md5"):
+            c.download("http://x/x.bin", "mod", "0" * 32)
 
 
 class TestImageTransforms:

@@ -20,6 +20,7 @@ SCRIPTS = [
     "distributed_hybrid.py",
     "pipeline_1f1b.py",
     "ragged_text_buckets.py",
+    "quant_aware_training.py",
 ]
 
 

@@ -247,3 +247,35 @@ class TestReviewRegressions:
         step.sync_to_layer()
         s = float(net.fc.weight_quanter.scale.numpy())  # must not raise
         assert np.isfinite(s) and s > 0
+
+    def test_no_dead_observers_on_wrapper_internals(self):
+        """quantize() -> calc_out_scale(): the wrapper's inner layer must
+        NOT get an observer (its hook would never fire; frozen buffers
+        would pollute state_dict)."""
+        paddle.seed(8)
+        net = _ConvNet()
+        ImperativeQuantAware().quantize(net)
+        ImperativeCalcOutScale().calc_out_scale(net)
+        assert hasattr(net.fc, "_out_scale")
+        assert not hasattr(net.fc.inner, "_out_scale")
+        assert not any("inner._out_scale" in k
+                       for k in net.state_dict())
+
+    def test_observe_then_quantize_strips_stale_observer(self):
+        """calc_out_scale() -> quantize(): the child's observer moves to
+        the wrapper; no frozen buffers remain on the inner layer."""
+        import warnings as w
+        paddle.seed(9)
+        net = _ConvNet()
+        ImperativeCalcOutScale().calc_out_scale(net)
+        with w.catch_warnings(record=True) as rec:
+            w.simplefilter("always")
+            ImperativeQuantAware().quantize(net)
+        assert any("calc_out_scale" in str(r.message) for r in rec)
+        assert hasattr(net.fc, "_out_scale")
+        assert not hasattr(net.fc.inner, "_out_scale")
+        x = paddle.to_tensor(
+            np.random.RandomState(10).rand(2, 1, 8, 8).astype(np.float32))
+        net.train()
+        net(x)
+        assert float(net.fc._out_scale.scale.numpy()) != 1.0
