@@ -6,3 +6,4 @@ from paddle_tpu.quantization import (  # noqa: F401
     FakeQuantAbsMax, FakeQuantMovingAverage,
     QuantizedLinear, QuantizedConv2D, MovingAverageAbsMaxScale,
 )
+from paddle_tpu.quantization import PostTrainingQuantization  # noqa: F401

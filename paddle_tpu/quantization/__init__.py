@@ -27,6 +27,7 @@ from .functional import (  # noqa: F401
 )
 
 __all__ = [
+    "PostTrainingQuantization",
     "ImperativeQuantAware", "ImperativeCalcOutScale",
     "FakeQuantAbsMax", "FakeQuantMovingAverage", "QuantizedLinear",
     "QuantizedConv2D", "MovingAverageAbsMaxScale",
@@ -307,3 +308,6 @@ class ImperativeCalcOutScale:
                 layer._out_scale_hook = \
                     layer.register_forward_post_hook(_observe_output)
         return model
+
+
+from .ptq import PostTrainingQuantization  # noqa: E402,F401

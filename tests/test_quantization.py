@@ -279,3 +279,182 @@ class TestReviewRegressions:
         net.train()
         net(x)
         assert float(net.fc._out_scale.scale.numpy()) != 1.0
+
+
+class TestPostTrainingQuantization:
+    def _loader(self, n=6, seed=0):
+        rs = np.random.RandomState(seed)
+        return [paddle.to_tensor(rs.rand(4, 1, 8, 8).astype(np.float32))
+                for _ in range(n)]
+
+    def test_abs_max_calibration_scale(self):
+        from paddle_tpu.quantization import PostTrainingQuantization
+        paddle.seed(10)
+        net = _ConvNet()
+        data = self._loader()
+        # expected input scale for conv = global abs max of the data
+        expect = max(float(np.abs(x.numpy()).max()) for x in data)
+        ptq = PostTrainingQuantization(net, data_loader=data,
+                                       algo="abs_max")
+        q = ptq.quantize()
+        got = float(q.conv.act_quanter.scale.numpy())
+        np.testing.assert_allclose(got, expect, rtol=1e-6)
+        assert isinstance(q.conv, QuantizedConv2D)
+        assert isinstance(q.fc, QuantizedLinear)
+
+    def test_quantized_output_close_to_float(self):
+        from paddle_tpu.quantization import PostTrainingQuantization
+        paddle.seed(11)
+        netf = _ConvNet()
+        paddle.seed(11)
+        netq = _ConvNet()
+        data = self._loader(seed=1)
+        x = data[0]
+        ref = netf(x).numpy()
+        PostTrainingQuantization(netq, data_loader=data,
+                                 algo="abs_max").quantize()
+        netq.eval()
+        out = netq(x).numpy()
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.05, rel
+
+    @pytest.mark.parametrize("algo", ["avg", "KL"])
+    def test_algos_produce_sane_scales(self, algo):
+        from paddle_tpu.quantization import PostTrainingQuantization
+        paddle.seed(12)
+        net = _ConvNet()
+        data = self._loader(seed=2)
+        absmax = max(float(np.abs(x.numpy()).max()) for x in data)
+        q = PostTrainingQuantization(net, data_loader=data,
+                                     algo=algo).quantize()
+        s = float(q.conv.act_quanter.scale.numpy())
+        assert 0 < s <= absmax * 1.001, (algo, s, absmax)
+
+    def test_kl_clips_outliers(self):
+        """A distribution with one huge outlier: the KL threshold lands
+        well below the raw abs-max."""
+        from paddle_tpu.quantization.ptq import _ActStats
+        rs = np.random.RandomState(3)
+        st = _ActStats("KL")
+        bulk = rs.randn(20000).astype(np.float32)
+        first = np.concatenate([bulk, [1000.0]]).astype(np.float32)
+        st.update(first)
+        for _ in range(3):
+            st.update(rs.randn(20000).astype(np.float32))
+        assert st.scale() < 100.0  # not dominated by the 1000.0 outlier
+
+    def test_batch_nums_and_empty_loader(self):
+        from paddle_tpu.quantization import PostTrainingQuantization
+        paddle.seed(13)
+        net = _ConvNet()
+        with pytest.raises(ValueError, match="calibration data"):
+            PostTrainingQuantization(net)
+        with pytest.raises(ValueError, match="no batches"):
+            PostTrainingQuantization(net, data_loader=[]).quantize()
+
+    def test_save_quantized_model(self, tmp_path):
+        from paddle_tpu.quantization import PostTrainingQuantization
+        from paddle_tpu.static import InputSpec
+        paddle.seed(14)
+        net = _ConvNet()
+        ptq = PostTrainingQuantization(net, data_loader=self._loader(2))
+        ptq.quantize()
+        path = str(tmp_path / "ptq_model")
+        ptq.save_quantized_model(
+            path, input_spec=[InputSpec([4, 1, 8, 8], "float32")])
+        import os
+        assert os.path.exists(path + ".pdmodel")
+
+    def test_batch_nums_truncates(self):
+        from paddle_tpu.quantization import PostTrainingQuantization
+        paddle.seed(15)
+        net = _ConvNet()
+        seen = []
+
+        class CountingLoader:
+            def __iter__(self):
+                rs = np.random.RandomState(9)
+                for i in range(10):
+                    seen.append(i)
+                    yield paddle.to_tensor(
+                        rs.rand(2, 1, 8, 8).astype(np.float32))
+
+        PostTrainingQuantization(net, data_loader=CountingLoader(),
+                                 batch_nums=3).quantize()
+        assert len(seen) <= 4  # 3 consumed (+ at most one lookahead)
+        # batch_nums=0 means zero batches -> the no-batches error
+        net2 = _ConvNet()
+        with pytest.raises(ValueError, match="no batches"):
+            PostTrainingQuantization(net2, data_loader=CountingLoader(),
+                                     batch_nums=0).quantize()
+
+    def test_kl_survives_zero_first_batch(self):
+        from paddle_tpu.quantization.ptq import _ActStats
+        st = _ActStats("KL")
+        st.update(np.zeros(100, np.float32))   # degenerate first batch
+        rs = np.random.RandomState(4)
+        for _ in range(4):
+            st.update(rs.rand(1000).astype(np.float32))
+        assert 0.5 < st.scale() <= 1.01
+
+    def test_kl_rebins_on_growing_range(self):
+        from paddle_tpu.quantization.ptq import _ActStats
+        st = _ActStats("KL")
+        rs = np.random.RandomState(5)
+        st.update(rs.rand(1000).astype(np.float32))        # range ~1
+        st.update((rs.rand(1000) * 10).astype(np.float32))  # range ~10
+        s = st.scale()
+        assert 1.0 < s <= 10.1
+
+    def test_uncalibrated_layer_warns(self):
+        import warnings as w
+        from paddle_tpu.quantization import PostTrainingQuantization
+
+        class TwoHead(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.used = nn.Linear(4, 4)
+                self.unused = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.used(x)
+
+        paddle.seed(16)
+        net = TwoHead()
+        data = [paddle.to_tensor(
+            np.random.RandomState(6).rand(2, 4).astype(np.float32))]
+        with w.catch_warnings(record=True) as rec:
+            w.simplefilter("always")
+            PostTrainingQuantization(net, data_loader=data).quantize()
+        assert any("never executed" in str(r.message) for r in rec)
+
+    def test_invalid_args_raise_at_init(self):
+        from paddle_tpu.quantization import PostTrainingQuantization
+        net = _ConvNet()
+        with pytest.raises(ValueError, match="quantizable_layer_type"):
+            PostTrainingQuantization(
+                net, data_loader=[1],
+                quantizable_layer_type=("Conv2DTranspose",))
+        with pytest.raises(ValueError, match="weight_quantize_type"):
+            PostTrainingQuantization(
+                net, data_loader=[1],
+                weight_quantize_type="range_abs_max")
+
+    def test_multi_input_model_calibrates(self):
+        from paddle_tpu.quantization import PostTrainingQuantization
+
+        class TwoIn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, a, b):
+                return self.fc(a + b)
+
+        paddle.seed(17)
+        net = TwoIn()
+        rs = np.random.RandomState(7)
+        data = [(rs.rand(2, 4).astype(np.float32),
+                 rs.rand(2, 4).astype(np.float32)) for _ in range(2)]
+        q = PostTrainingQuantization(net, data_loader=data).quantize()
+        assert float(q.fc.act_quanter.scale.numpy()) > 0.5
