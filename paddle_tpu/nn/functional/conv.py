@@ -37,7 +37,10 @@ def _tup(v, nd):
     return tuple(int(x) for x in v)
 
 
-def _conv_nd(x, w, bias, stride, padding, dilation, groups, nd, channel_last):
+def _conv_nd(x, w, bias, stride, padding, dilation, groups, nd,
+             channel_last, acc_dtype=None):
+    """``acc_dtype``: accumulator override (int8 inference passes int32 —
+    the MXU's native int8×int8→int32 form)."""
     stride = _tup(stride, nd)
     dilation = _tup(dilation, nd)
     spatial = "DHW"[3 - nd:]
@@ -53,7 +56,7 @@ def _conv_nd(x, w, bias, stride, padding, dilation, groups, nd, channel_last):
     out = lax.conv_general_dilated(
         x, w, window_strides=stride, padding=pad,
         rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups)
+        feature_group_count=groups, preferred_element_type=acc_dtype)
     if bias is not None:
         if channel_last:
             out = out + bias.reshape((1,) * (nd + 1) + (-1,))

@@ -28,6 +28,7 @@ from .functional import (  # noqa: F401
 
 __all__ = [
     "PostTrainingQuantization",
+    "convert_to_int8", "Int8Linear", "Int8Conv2D",
     "ImperativeQuantAware", "ImperativeCalcOutScale",
     "FakeQuantAbsMax", "FakeQuantMovingAverage", "QuantizedLinear",
     "QuantizedConv2D", "MovingAverageAbsMaxScale",
@@ -311,3 +312,4 @@ class ImperativeCalcOutScale:
 
 
 from .ptq import PostTrainingQuantization  # noqa: E402,F401
+from .int8 import convert_to_int8, Int8Linear, Int8Conv2D  # noqa: E402,F401

@@ -1,0 +1,168 @@
+"""True-int8 inference conversion (reference: quantization_pass.py
+ConvertToInt8Pass).
+
+After QAT or PTQ produced calibrated scales, ``convert_to_int8``
+replaces every Quantized wrapper with a layer that stores int8 weights
+and executes an int8×int8→int32 matmul/conv, dequantizing the
+accumulator by ``(s_x · s_w / 127²)``.  On TPU the MXU consumes int8
+natively at twice the bf16 rate, so unlike the fake-quant layers (float
+math, scales as metadata) these run genuinely quantized — and the
+numerics equal the fake-quant path exactly up to float reassociation,
+because the weight codes are produced by the SAME quantizer
+configuration the wrapper used (per-tensor or per-channel, 8 bit) and
+the integer inner product of those codes is exact.
+
+Inference-only: activations quantize against the FROZEN calibrated
+scale (dynamic abs_max activation quantizers cannot convert — raise),
+and no gradients flow.  Only 8-bit quanters convert; other widths have
+no int8 executable form and raise.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+
+
+def _quantize_arr(arr, scale, axis=None):
+    """-> int8 codes for ``arr`` at ``scale`` (scalar or per-axis)."""
+    if axis is not None:
+        shape = [1] * arr.ndim
+        shape[axis] = -1
+        scale = scale.reshape(shape)
+    q = jnp.round(jnp.clip(arr, -scale, scale) / scale * 127.0)
+    return q.astype(jnp.int8)
+
+
+def _check_bits(quanter, what):
+    bits = getattr(quanter, "bits", 8)
+    if bits != 8:
+        raise ValueError(
+            f"convert_to_int8: {what} was quantized at {bits} bits — "
+            "only 8-bit quanters have an int8 executable form (scales "
+            f"learned for a {2 ** (bits - 1) - 1}-level grid do not "
+            "transfer to 127 levels)")
+
+
+def _act_scale_of(quanter):
+    """Extract the frozen activation scale; reject dynamic quantizers."""
+    from . import FakeQuantAbsMax, FakeQuantMovingAverage
+    from .ptq import _StaticScaleQuantizer
+    _check_bits(quanter, "an activation")
+    if isinstance(quanter, (FakeQuantMovingAverage,
+                            _StaticScaleQuantizer)):
+        return jnp.asarray(quanter.scale._data, jnp.float32)
+    if isinstance(quanter, FakeQuantAbsMax):
+        raise ValueError(
+            "convert_to_int8: this layer's activation quantizer is "
+            "dynamic abs_max — int8 inference needs a FROZEN scale; "
+            "use activation_quantize_type='moving_average_abs_max' "
+            "(QAT) or PostTrainingQuantization calibration")
+    raise ValueError(
+        f"convert_to_int8: unrecognized activation quantizer "
+        f"{type(quanter).__name__}")
+
+
+def _weight_codes(w, weight_quanter, channel_axis):
+    """int8 codes + scale matching the WRAPPER's weight-quant config —
+    per-tensor or per-channel, exactly what the fake-quant forward used,
+    so converted numerics track the trained/calibrated model."""
+    _check_bits(weight_quanter, "a weight")
+    if getattr(weight_quanter, "channel_wise", False):
+        red = tuple(i for i in range(w.ndim) if i != channel_axis)
+        scale = jnp.maximum(jnp.max(jnp.abs(w), axis=red), 1e-8)
+        return _quantize_arr(w, scale, axis=channel_axis), scale
+    scale = jnp.maximum(jnp.max(jnp.abs(w)), 1e-8)
+    return _quantize_arr(w, scale), scale
+
+
+class Int8Linear(nn.Layer):
+    """int8 GEMM inference form of a calibrated QuantizedLinear."""
+
+    def __init__(self, qlinear):
+        super().__init__()
+        inner = qlinear.inner
+        w = inner.weight._data.astype(jnp.float32)
+        codes, w_scale = _weight_codes(w, qlinear.weight_quanter,
+                                       channel_axis=1)
+        self.register_buffer("weight_int8", Tensor(codes))
+        self.register_buffer("weight_scale", Tensor(w_scale))
+        self.register_buffer(
+            "act_scale", Tensor(_act_scale_of(qlinear.act_quanter)))
+        self.bias = inner.bias  # stays float
+        self.out_features = w.shape[1]
+
+    def forward(self, x):
+        data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        sx = self.act_scale._data
+        xq = _quantize_arr(data.astype(jnp.float32), sx)
+        acc = jnp.matmul(xq, self.weight_int8._data,
+                         preferred_element_type=jnp.int32)
+        # weight_scale is scalar (per-tensor) or [out] (per-channel);
+        # both broadcast over the trailing out axis
+        out = acc.astype(jnp.float32) * (
+            sx * self.weight_scale._data / (127.0 * 127.0))
+        if self.bias is not None:
+            out = out + self.bias._data.astype(jnp.float32)
+        return Tensor(out, stop_gradient=True)
+
+
+class Int8Conv2D(nn.Layer):
+    """int8 convolution inference form of a calibrated QuantizedConv2D,
+    running through the SAME conv plumbing as the float path
+    (``_conv_nd`` with an int32 accumulator) — layouts, padding forms
+    and groups behave identically."""
+
+    def __init__(self, qconv):
+        super().__init__()
+        inner = qconv.inner
+        w = inner.weight._data.astype(jnp.float32)
+        codes, w_scale = _weight_codes(w, qconv.weight_quanter,
+                                       channel_axis=0)
+        self.register_buffer("weight_int8", Tensor(codes))
+        self.register_buffer("weight_scale", Tensor(w_scale))
+        self.register_buffer(
+            "act_scale", Tensor(_act_scale_of(qconv.act_quanter)))
+        self.bias = inner.bias
+        self.stride = inner.stride
+        self.padding = inner.padding
+        self.dilation = inner.dilation
+        self.groups = inner.groups
+        self.data_format = inner.data_format
+
+    def forward(self, x):
+        from ..nn.functional.conv import _conv_nd
+        data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        sx = self.act_scale._data
+        xq = _quantize_arr(data.astype(jnp.float32), sx)
+        channel_last = self.data_format in ("NHWC",)
+        acc = _conv_nd(xq, self.weight_int8._data, None, self.stride,
+                       self.padding, self.dilation, self.groups, nd=2,
+                       channel_last=channel_last,
+                       acc_dtype=jnp.int32)
+        scale = sx * self.weight_scale._data / (127.0 * 127.0)
+        if jnp.ndim(scale):  # per-channel: align with the channel dim
+            scale = scale.reshape((1, 1, 1, -1) if channel_last
+                                  else (1, -1, 1, 1))
+        out = acc.astype(jnp.float32) * scale
+        if self.bias is not None:
+            b = self.bias._data.astype(jnp.float32)
+            out = out + (b.reshape(1, 1, 1, -1) if channel_last
+                         else b.reshape(1, -1, 1, 1))
+        return Tensor(out, stop_gradient=True)
+
+
+def convert_to_int8(model):
+    """Swap calibrated Quantized wrappers for true-int8 inference layers
+    (reference: quantization_pass.py ConvertToInt8Pass), in place."""
+    from . import QuantizedConv2D, QuantizedLinear
+    model.eval()
+    for parent in model.sublayers(include_self=True):
+        for name, child in list(parent.named_children()):
+            if isinstance(child, QuantizedLinear):
+                setattr(parent, name, Int8Linear(child))
+            elif isinstance(child, QuantizedConv2D):
+                setattr(parent, name, Int8Conv2D(child))
+    return model

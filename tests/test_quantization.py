@@ -458,3 +458,151 @@ class TestPostTrainingQuantization:
                  rs.rand(2, 4).astype(np.float32)) for _ in range(2)]
         q = PostTrainingQuantization(net, data_loader=data).quantize()
         assert float(q.fc.act_quanter.scale.numpy()) > 0.5
+
+
+class TestInt8Conversion:
+    def _calibrated_net(self, seed=20):
+        from paddle_tpu.quantization import PostTrainingQuantization
+        paddle.seed(seed)
+        net = _ConvNet()
+        rs = np.random.RandomState(seed)
+        data = [paddle.to_tensor(rs.rand(4, 1, 8, 8).astype(np.float32))
+                for _ in range(4)]
+        PostTrainingQuantization(net, data_loader=data).quantize()
+        return net, data
+
+    def test_int8_matches_fake_quant(self):
+        """int8 inference equals the fake-quant float path up to float
+        reassociation — same codes, exact integer inner product."""
+        from paddle_tpu.quantization import convert_to_int8
+        net, data = self._calibrated_net()
+        net.eval()
+        x = data[0]
+        fq_out = net(x).numpy()
+        convert_to_int8(net)
+        from paddle_tpu.quantization import Int8Conv2D, Int8Linear
+        assert isinstance(net.conv, Int8Conv2D)
+        assert isinstance(net.fc, Int8Linear)
+        int8_out = net(x).numpy()
+        np.testing.assert_allclose(int8_out, fq_out, rtol=2e-2,
+                                   atol=2e-3)
+
+    def test_int8_weights_are_int8(self):
+        from paddle_tpu.quantization import convert_to_int8
+        net, _ = self._calibrated_net(seed=21)
+        convert_to_int8(net)
+        assert str(net.fc.weight_int8._data.dtype) == "int8"
+        assert str(net.conv.weight_int8._data.dtype) == "int8"
+        # 1 byte per element: 4x smaller storage than f32
+        assert net.fc.weight_int8._data.nbytes == \
+            net.fc.weight_int8._data.size
+
+    def test_dynamic_act_quantizer_rejected(self):
+        from paddle_tpu.quantization import (ImperativeQuantAware,
+                                             convert_to_int8)
+        paddle.seed(22)
+        net = _ConvNet()
+        ImperativeQuantAware(
+            activation_quantize_type="abs_max").quantize(net)
+        with pytest.raises(ValueError, match="FROZEN scale"):
+            convert_to_int8(net)
+
+    def test_qat_then_int8(self):
+        """QAT (moving-average scales) -> int8 conversion end-to-end."""
+        from paddle_tpu.quantization import (ImperativeQuantAware,
+                                             convert_to_int8)
+        paddle.seed(23)
+        rs = np.random.RandomState(23)
+        net = _ConvNet()
+        ImperativeQuantAware().quantize(net)
+        opt = optimizer.Adam(learning_rate=1e-3,
+                             parameters=net.parameters())
+        lossf = nn.CrossEntropyLoss()
+        x = paddle.to_tensor(rs.rand(8, 1, 8, 8).astype(np.float32))
+        y = paddle.to_tensor((rs.rand(8) * 10).astype(np.int64))
+        for _ in range(5):
+            loss = lossf(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        net.eval()
+        fq = net(x).numpy()
+        convert_to_int8(net)
+        q = net(x).numpy()
+        rel = np.abs(q - fq).max() / (np.abs(fq).max() + 1e-9)
+        assert rel < 0.05, rel
+
+    def test_int8_jit_compiles(self):
+        """The int8 layers trace under jax.jit (inference deployment)."""
+        import jax
+        from paddle_tpu.quantization import convert_to_int8
+        net, data = self._calibrated_net(seed=24)
+        convert_to_int8(net)
+
+        def f(a):
+            return net(Tensor(a))._data
+
+        from paddle_tpu.core.tensor import Tensor
+        out = jax.jit(f)(data[0]._data)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_int8_respects_per_tensor_weight_config(self):
+        """Default QAT uses PER-TENSOR weight abs_max; the int8 codes
+        must use the same granularity or numerics diverge on nets with
+        wildly different per-channel magnitudes."""
+        from paddle_tpu.quantization import (PostTrainingQuantization,
+                                             convert_to_int8)
+        paddle.seed(25)
+        net = _ConvNet()
+        # exaggerate per-channel spread: one output column 100x larger
+        w = net.fc.weight.numpy().copy()
+        w[:, 0] *= 100
+        net.fc.weight.set_value(w)
+        rs = np.random.RandomState(25)
+        data = [paddle.to_tensor(rs.rand(4, 1, 8, 8).astype(np.float32))
+                for _ in range(3)]
+        PostTrainingQuantization(net, data_loader=data,
+                                 weight_quantize_type="abs_max"
+                                 ).quantize()
+        net.eval()
+        fq = net(data[0]).numpy()
+        convert_to_int8(net)
+        # per-tensor config -> scalar weight scale buffer
+        assert net.fc.weight_scale._data.ndim == 0
+        q = net(data[0]).numpy()
+        np.testing.assert_allclose(q, fq, rtol=2e-2, atol=2e-3)
+
+    def test_int8_rejects_non8bit(self):
+        from paddle_tpu.quantization import (ImperativeQuantAware,
+                                             convert_to_int8)
+        paddle.seed(26)
+        net = _ConvNet()
+        ImperativeQuantAware(weight_bits=4).quantize(net)
+        with pytest.raises(ValueError, match="8 bits|4 bits"):
+            convert_to_int8(net)
+
+    def test_int8_nhwc_conv(self):
+        from paddle_tpu.quantization import (PostTrainingQuantization,
+                                             convert_to_int8)
+
+        class NHWCNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(3, 4, 3, padding=1,
+                                      data_format="NHWC")
+
+            def forward(self, x):
+                return self.conv(x)
+
+        paddle.seed(27)
+        net = NHWCNet()
+        rs = np.random.RandomState(27)
+        data = [paddle.to_tensor(rs.rand(2, 8, 8, 3).astype(np.float32))
+                for _ in range(2)]
+        PostTrainingQuantization(net, data_loader=data).quantize()
+        net.eval()
+        fq = net(data[0]).numpy()
+        convert_to_int8(net)
+        q = net(data[0]).numpy()
+        assert q.shape == fq.shape == (2, 8, 8, 4)
+        np.testing.assert_allclose(q, fq, rtol=2e-2, atol=2e-3)
