@@ -203,9 +203,11 @@ def _install_run_check():
 
 install_check.run_check = _install_run_check
 
-# fluid.contrib: mixed-precision decorator path used by 1.x AMP scripts
+# fluid.contrib.mixed_precision: the decorator path 1.x AMP scripts use
+# — attached onto the REAL contrib package (imported above; a synthetic
+# stub here would shadow contrib.layers / contrib.slim)
 from ..static import amp as _static_amp  # noqa: E402
-contrib = _submodule("contrib", mixed_precision=_static_amp)
+contrib.mixed_precision = _static_amp
 _sys.modules[f"{__name__}.contrib.mixed_precision"] = _static_amp
 
 

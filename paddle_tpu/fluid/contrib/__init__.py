@@ -1,2 +1,3 @@
 """fluid.contrib namespace (reference: python/paddle/fluid/contrib/)."""
 from . import slim  # noqa: F401
+from . import layers  # noqa: F401

@@ -1,0 +1,197 @@
+"""fluid.contrib.layers — the contrib op surface.
+
+Reference parity: ``python/paddle/fluid/contrib/layers/nn.py`` (the
+general-purpose subset: fused_elemwise_activation, fused_bn_add_act,
+shuffle_batch, partial_concat, partial_sum, batch_fc) plus re-exports
+of contrib names whose implementations live elsewhere in this
+framework (sequence_topk_avg_pooling, tree_conv, sparse_embedding).
+
+The CTR-serving long tail (tdm_child/tdm_sampler, search_pyramid_hash,
+rank_attention, var_conv_2d, match_matrix_tensor, bilateral_slice,
+correlation, _pull_box_extended_sparse) is tied to the reference's
+parameter-server serving stack and is NOT implemented; calling them
+raises with that scope note rather than silently degrading.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.dispatch import ensure_tensor
+from ...core import rng as rng_mod
+from ... import ops
+from ...nn import functional as F
+
+__all__ = [
+    "fused_elemwise_activation", "fused_bn_add_act", "shuffle_batch",
+    "partial_concat", "partial_sum", "batch_fc",
+    "sequence_topk_avg_pooling", "tree_conv", "sparse_embedding",
+    "multiclass_nms2",
+]
+
+
+_BINARY = {"elementwise_add": ops.add, "elementwise_mul": ops.multiply}
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """reference contrib/layers/nn.py:65 — Unary(Binary(x, y)) (or
+    Binary(x, Unary(y))).  XLA fuses the chain anyway; the op exists for
+    API parity."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if len(functor_list) != 2:
+        raise ValueError("functor_list must have exactly two entries")
+    a, b = functor_list
+    if a in _BINARY:
+        return _apply_unary(_BINARY[a](x, y), b, scale)
+    if b in _BINARY:
+        return _BINARY[b](x, _apply_unary(y, a, scale))
+    raise ValueError(
+        f"functor_list {functor_list}: one entry must be a binary "
+        f"functor ({sorted(_BINARY)})")
+
+
+def _apply_unary(t, name, scale):
+    if name == "scale":
+        return t * scale
+    fn = getattr(F, name, None)
+    if fn is None:
+        raise ValueError(f"unknown unary functor {name!r}")
+    return fn(t)
+
+
+def fused_bn_add_act(x, y, momentum=0.9, epsilon=1e-5, param_attr=None,
+                     bias_attr=None, moving_mean_name=None,
+                     moving_variance_name=None, act="relu", name=None):
+    """reference contrib/layers/nn.py fused_bn_add_act —
+    act(batch_norm(x) + y); the reference fuses for cuDNN, XLA fuses
+    the same chain automatically."""
+    from ...static import nn as static_nn
+    out = static_nn.batch_norm(x, momentum=momentum, epsilon=epsilon,
+                               param_attr=param_attr,
+                               bias_attr=bias_attr)
+    out = out + ensure_tensor(y)
+    return getattr(F, act)(out) if act else out
+
+
+def shuffle_batch(x, seed=None):
+    """reference contrib/layers/nn.py shuffle_batch — random permutation
+    along dim 0 (CTR in-batch negative sampling)."""
+    x = ensure_tensor(x)
+    n = x.shape[0]
+    from ...core.tensor import Tensor
+    if seed is not None:
+        perm = np.random.RandomState(int(seed)).permutation(n)
+        return ops.gather(x, Tensor(perm.astype(np.int64)))
+    import jax
+    key = rng_mod.next_key()
+    idx = jax.random.permutation(key, n)
+    return ops.gather(x, Tensor(idx, stop_gradient=True))
+
+
+def _partial_slices(inputs, start_index, length):
+    outs = []
+    for t in inputs:
+        t = ensure_tensor(t)
+        if len(t.shape) != 2:
+            raise ValueError(
+                "partial_concat/partial_sum support 2-D inputs only "
+                "(reference: partial_concat_op.cc)")
+        width = t.shape[1]
+        start = start_index if start_index >= 0 else width + start_index
+        stop = width if length < 0 else start + length
+        outs.append(t[:, start:stop])
+    return outs
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """reference contrib/layers/nn.py:849 — slice each input's second
+    dim [start, start+length) and concat along dim 1."""
+    return ops.concat(_partial_slices(input, start_index, length),
+                      axis=1)
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """reference contrib/layers/nn.py partial_sum — same slicing,
+    elementwise-summed."""
+    outs = _partial_slices(input, start_index, length)
+    total = outs[0]
+    for t in outs[1:]:
+        total = total + t
+    return total
+
+
+def batch_fc(input, param_size, param_attr=None, bias_size=None,
+             bias_attr=None, act=None):
+    """reference contrib/layers/nn.py:1381 — per-slot FC: input
+    [B, M, K] @ w [B, K, N] + b [B, 1, N] (a batched matmul; the
+    reference's custom CUDA kernel is one jnp.matmul here)."""
+    from ...static.nn import _make_param
+    from ...nn import initializer as I
+    input = ensure_tensor(input)
+    w = _make_param(list(param_size), "float32", param_attr,
+                    I.XavierUniform(), "batch_fc_w")
+    out = ops.matmul(input, w)
+    if bias_size is not None:
+        b = _make_param(list(bias_size), "float32", bias_attr,
+                        I.Constant(0.0), "batch_fc_b")
+        out = out + b
+    return getattr(F, act)(out) if act else out
+
+
+# -- re-exports: contrib names implemented elsewhere -----------------------
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
+    from ...nn.functional.sequence import sequence_topk_avg_pooling as impl
+    return impl(input, row, col, topks, channel_num)
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    from ..dygraph import TreeConv
+    layer = TreeConv(int(nodes_vector.shape[-1]), output_size,
+                     num_filters=num_filters, max_depth=max_depth,
+                     act=act, param_attr=param_attr,
+                     bias_attr=bias_attr, name=name)
+    return layer(ensure_tensor(nodes_vector), ensure_tensor(edge_set))
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, param_attr=None, dtype="float32"):
+    from ...static.nn import sparse_embedding as impl
+    return impl(input, size, padding_idx=padding_idx,
+                param_attr=param_attr, dtype=dtype)
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k,
+                    keep_top_k, nms_threshold=0.3, normalized=True,
+                    nms_eta=1.0, background_label=0,
+                    return_index=False, name=None):
+    if return_index:
+        raise NotImplementedError(
+            "multiclass_nms2(return_index=True): the XLA-shaped nms "
+            "returns padded [keep_top_k, 6] rows without source indices")
+    from ...vision.ops import multiclass_nms as impl
+    return impl(bboxes, scores, score_threshold=score_threshold,
+                nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                nms_threshold=nms_threshold, normalized=normalized,
+                nms_eta=nms_eta, background_label=background_label)
+
+
+def _ps_serving_stub(name):
+    def fn(*args, **kwargs):
+        raise NotImplementedError(
+            f"fluid.contrib.layers.{name} belongs to the reference's "
+            "parameter-server CTR serving stack (tree-based matching / "
+            "pyramid hashing over distributed tables), which this "
+            "framework's reduced PS scope does not include — see "
+            "COVERAGE.md §2.3 'PS ops'")
+    fn.__name__ = name
+    return fn
+
+
+for _n in ("tdm_child", "tdm_sampler", "search_pyramid_hash",
+           "rank_attention", "var_conv_2d", "match_matrix_tensor",
+           "bilateral_slice", "correlation",
+           "_pull_box_extended_sparse"):
+    globals()[_n] = _ps_serving_stub(_n)

@@ -1,0 +1,76 @@
+"""fluid.contrib.layers (reference: fluid/contrib/layers/nn.py — the
+general-purpose subset; PS-serving CTR ops raise with scope notes)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid.contrib import layers as cl
+
+
+class TestContribLayers:
+    def test_fused_elemwise_activation(self):
+        x = paddle.to_tensor(np.array([[1.0, -2.0]], np.float32))
+        y = paddle.to_tensor(np.array([[0.5, 0.5]], np.float32))
+        out = cl.fused_elemwise_activation(
+            x, y, ["elementwise_add", "relu"])
+        np.testing.assert_allclose(out.numpy(), [[1.5, 0.0]])
+        out2 = cl.fused_elemwise_activation(
+            x, y, ["relu", "elementwise_mul"])
+        np.testing.assert_allclose(out2.numpy(), [[0.5, -1.0]])
+        with pytest.raises(ValueError, match="binary"):
+            cl.fused_elemwise_activation(x, y, ["relu", "tanh"])
+
+    def test_shuffle_batch(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32)
+                             .reshape(6, 2))
+        out = cl.shuffle_batch(x, seed=3)
+        a, b = np.sort(out.numpy(), axis=0), np.sort(x.numpy(), axis=0)
+        np.testing.assert_array_equal(a, b)  # a permutation of rows
+        out2 = cl.shuffle_batch(x, seed=3)
+        np.testing.assert_array_equal(out.numpy(), out2.numpy())
+
+    def test_partial_concat_and_sum(self):
+        x = paddle.to_tensor(np.array([[0, 1, 2], [3, 4, 5]],
+                                      np.float32))
+        y = paddle.to_tensor(np.array([[6, 7, 8], [9, 10, 11]],
+                                      np.float32))
+        out = cl.partial_concat([x, y], start_index=0, length=2)
+        np.testing.assert_array_equal(
+            out.numpy(), [[0, 1, 6, 7], [3, 4, 9, 10]])
+        s = cl.partial_sum([x, y], start_index=1, length=2)
+        np.testing.assert_array_equal(s.numpy(), [[8, 10], [14, 16]])
+
+    def test_batch_fc(self):
+        paddle.seed(0)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(4, 2, 3).astype(np.float32))
+        out = cl.batch_fc(x, param_size=[4, 3, 5], bias_size=[4, 1, 5],
+                          act="relu")
+        assert out.shape == [4, 2, 5]
+        assert (out.numpy() >= 0).all()
+
+    def test_fused_bn_add_act(self):
+        paddle.seed(1)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).rand(2, 3, 4, 4).astype(np.float32))
+        y = paddle.to_tensor(
+            np.random.RandomState(2).rand(2, 3, 4, 4).astype(np.float32))
+        out = cl.fused_bn_add_act(x, y)
+        assert out.shape == [2, 3, 4, 4]
+        assert (out.numpy() >= 0).all()
+
+    def test_ps_serving_stubs_raise_with_scope(self):
+        with pytest.raises(NotImplementedError, match="PS"):
+            cl.tdm_sampler()
+        with pytest.raises(NotImplementedError, match="COVERAGE"):
+            cl.search_pyramid_hash()
+
+    def test_reexports_callable(self):
+        # smoke the delegations that have implementations elsewhere
+        assert callable(cl.sequence_topk_avg_pooling)
+        assert callable(cl.tree_conv)
+        assert callable(cl.sparse_embedding)
+        assert callable(cl.multiclass_nms2)
+        with pytest.raises(NotImplementedError, match="return_index"):
+            cl.multiclass_nms2(None, None, 0.1, 10, 10,
+                               return_index=True)
