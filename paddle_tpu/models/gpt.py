@@ -365,12 +365,15 @@ class GPTModel(nn.Layer):
 
     def _compiled_decode_fn(self, pnames, params, cache_key):
         """Build (or fetch) the jitted one-token decode step: (p_list,
-        k_bufs, v_bufs, tok [B,1], pos) -> (last_logits [B,V], k_bufs,
-        v_bufs).  Fixed shapes — ONE XLA program serves every decode
-        step (the eager path re-dispatches every op per token).  K/V
-        buffers are DONATED (in-place update, no per-token copy); the
-        jitted fn is cached on the model so repeated generate() calls
-        never recompile."""
+        b_list, k_bufs, v_bufs, tok [B,1], pos) -> (last_logits [B,V],
+        k_bufs, v_bufs).  Fixed shapes — ONE XLA program serves every
+        decode step (the eager path re-dispatches every op per token).
+        K/V buffers are DONATED (in-place update, no per-token copy);
+        the jitted fn is cached on the model so repeated generate()
+        calls never recompile.  Model BUFFERS (e.g. weight-only-int8
+        codes) are threaded as arguments, not closed over — closure
+        capture would bake them into the executable as XLA constants,
+        doubling their HBM footprint."""
         import jax
         from ..core import autograd
         from ..jit import _swapped
@@ -382,9 +385,12 @@ class GPTModel(nn.Layer):
             return cache[cache_key]
 
         model = self
+        mbuffers = dict(self.named_buffers())
+        bnames = sorted(mbuffers)
 
-        def pure(p_list, k_bufs, v_bufs, tok, pos):
-            with _swapped(params, dict(zip(pnames, p_list))):
+        def pure(p_list, b_list, k_bufs, v_bufs, tok, pos):
+            with _swapped(params, dict(zip(pnames, p_list))), \
+                    _swapped(mbuffers, dict(zip(bnames, b_list))):
                 with autograd.no_grad():
                     x = model.embeddings(Tensor(tok),
                                          position_offset=pos)
@@ -397,9 +403,9 @@ class GPTModel(nn.Layer):
                     logits = model.head(x)
             return logits._data[:, -1, :], new_k, new_v
 
-        fn = jax.jit(pure, donate_argnums=(1, 2))
-        cache[cache_key] = fn
-        return fn
+        fn = jax.jit(pure, donate_argnums=(2, 3))
+        cache[cache_key] = (fn, bnames, mbuffers)
+        return cache[cache_key]
 
     def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
                  top_k=0, top_p=1.0, eos_token_id=None, seed=None,
@@ -432,8 +438,14 @@ class GPTModel(nn.Layer):
         nh = self.blocks[0].attn.num_heads
         hd = self.blocks[0].attn.head_dim
         attn0 = self.blocks[0].attn
-        kv_dtype = (attn0.qkv_weight if attn0.use_mp
-                    else attn0.qkv_proj.weight)._data.dtype
+        if attn0.use_mp:
+            kv_dtype = attn0.qkv_weight._data.dtype
+        else:
+            # compute_dtype first: a weight-only-int8 projection's
+            # .weight property would MATERIALIZE the dequantized matrix
+            # just to answer this dtype probe
+            kv_dtype = getattr(attn0.qkv_proj, "compute_dtype", None) \
+                or attn0.qkv_proj.weight._data.dtype
         # sampling whenever temperature/top_k/top_p ask for it; greedy
         # otherwise
         do_sample = ((top_k and top_k > 0) or temperature != 1.0
@@ -461,10 +473,13 @@ class GPTModel(nn.Layer):
                         v_bufs.append(jnp.pad(cv._data, pad))
                     params = dict(self.named_parameters())
                     pnames = sorted(params)
-                    step_fn = self._compiled_decode_fn(
-                        pnames, params,
-                        (b, L, str(kv_dtype), tuple(pnames)))
+                    step_fn, dec_bnames, dec_bufs = \
+                        self._compiled_decode_fn(
+                            pnames, params,
+                            (b, L, str(kv_dtype), tuple(pnames),
+                             tuple(sorted(dict(self.named_buffers())))))
                     p_list = [params[k2]._data for k2 in pnames]
+                    b_list = [dec_bufs[k2]._data for k2 in dec_bnames]
 
                 def sample(last):
                     nonlocal key
@@ -511,7 +526,7 @@ class GPTModel(nn.Layer):
                         break  # last token emitted; skip the dead forward
                     if compiled:
                         last, k_bufs, v_bufs = step_fn(
-                            p_list, k_bufs, v_bufs, nxt,
+                            p_list, b_list, k_bufs, v_bufs, nxt,
                             jnp.asarray(s + step, jnp.int32))
                     else:
                         logits, caches = self.forward(

@@ -29,6 +29,7 @@ from .functional import (  # noqa: F401
 __all__ = [
     "PostTrainingQuantization",
     "convert_to_int8", "Int8Linear", "Int8Conv2D",
+    "quantize_weights_int8", "WeightOnlyInt8Linear",
     "ImperativeQuantAware", "ImperativeCalcOutScale",
     "FakeQuantAbsMax", "FakeQuantMovingAverage", "QuantizedLinear",
     "QuantizedConv2D", "MovingAverageAbsMaxScale",
@@ -313,3 +314,5 @@ class ImperativeCalcOutScale:
 
 from .ptq import PostTrainingQuantization  # noqa: E402,F401
 from .int8 import convert_to_int8, Int8Linear, Int8Conv2D  # noqa: E402,F401
+from .weight_only import (  # noqa: E402,F401
+    quantize_weights_int8, WeightOnlyInt8Linear)

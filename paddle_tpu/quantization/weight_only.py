@@ -1,0 +1,90 @@
+"""Weight-only int8 quantization — the LLM-decode memory-bandwidth play.
+
+NEW capability (the reference snapshot predates LLM serving).  On TPU,
+autoregressive decode is HBM-bandwidth-bound: every generated token
+streams the full weight matrix out of HBM, so halving weight bytes
+(int8 codes + per-output-channel f32 scales instead of bf16/f32)
+approaches 2× decode throughput.  Activations stay full precision and
+NO calibration is needed — per-channel abs-max weight codes are
+computed directly from the trained weights, making this applicable to
+any checkpoint as-is (contrast PTQ/QAT, which need activation scales).
+
+The dequant (codes.astype(compute_dtype) * scale) sits adjacent to the
+matmul so XLA fuses it into the operand read; the matmul itself runs in
+the activation dtype on the MXU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+
+
+class WeightOnlyInt8Linear(nn.Layer):
+    """Drop-in Linear with int8-coded weights, dequantized per forward.
+
+    Built from a trained ``nn.Linear``; bias stays in its dtype.  The
+    layer is inference-oriented but remains differentiable w.r.t.
+    nothing (codes are buffers) — use it for generation/serving."""
+
+    def __init__(self, linear, compute_dtype=None):
+        super().__init__()
+        w = linear.weight._data
+        if w.ndim != 2:
+            raise ValueError(
+                "WeightOnlyInt8Linear expects a 2-D [in, out] Linear "
+                f"weight, got shape {list(w.shape)} — conv/other layer "
+                "kernels need their own quantized form "
+                "(quantization.int8.Int8Conv2D for calibrated conv)")
+        self.compute_dtype = compute_dtype or w.dtype
+        wf = w.astype(jnp.float32)
+        # one quantizer implementation framework-wide (int8.py)
+        from .int8 import _quantize_arr
+        scale = jnp.maximum(jnp.max(jnp.abs(wf), axis=0), 1e-8)  # [out]
+        codes = _quantize_arr(wf, scale, axis=1)
+        self.register_buffer("weight_int8", Tensor(codes))
+        self.register_buffer("weight_scale",
+                             Tensor((scale / 127.0).astype(jnp.float32)))
+        self.bias = linear.bias
+        self.in_features = w.shape[0]
+        self.out_features = w.shape[1]
+
+    @property
+    def weight(self):
+        """Dequantized view for code that reflects on ``.weight``
+        (dtype probes, summaries) — materializes on access; the forward
+        path never calls it."""
+        return Tensor(
+            self.weight_int8._data.astype(self.compute_dtype)
+            * self.weight_scale._data.astype(self.compute_dtype),
+            stop_gradient=True)
+
+    def forward(self, x):
+        data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        # dequant adjacent to the matmul: XLA folds the convert+scale
+        # into the weight read — HBM traffic is the int8 codes
+        w = (self.weight_int8._data.astype(self.compute_dtype)
+             * self.weight_scale._data.astype(self.compute_dtype))
+        out = jnp.matmul(data.astype(self.compute_dtype), w)
+        if self.bias is not None:
+            out = out + self.bias._data.astype(self.compute_dtype)
+        return Tensor(out, stop_gradient=True)
+
+
+def quantize_weights_int8(model, layer_types=(nn.Linear,),
+                          min_features=0, compute_dtype=None):
+    """Swap every matching Linear for its weight-only-int8 form, in
+    place.  ``min_features`` skips small layers (heads/gates) where the
+    dequant overhead outweighs the bandwidth saving."""
+    for parent in model.sublayers(include_self=True):
+        if isinstance(parent, WeightOnlyInt8Linear):
+            continue
+        for name, child in list(parent.named_children()):
+            if isinstance(child, tuple(layer_types)) and \
+                    not isinstance(child, WeightOnlyInt8Linear):
+                if min(child.weight.shape) < min_features:
+                    continue
+                setattr(parent, name,
+                        WeightOnlyInt8Linear(child, compute_dtype))
+    return model

@@ -606,3 +606,71 @@ class TestInt8Conversion:
         q = net(data[0]).numpy()
         assert q.shape == fq.shape == (2, 8, 8, 4)
         np.testing.assert_allclose(q, fq, rtol=2e-2, atol=2e-3)
+
+
+class TestWeightOnlyInt8:
+    def test_linear_close_to_float(self):
+        from paddle_tpu.quantization import WeightOnlyInt8Linear
+        paddle.seed(30)
+        lin = nn.Linear(32, 16)
+        x = paddle.to_tensor(
+            np.random.RandomState(30).randn(4, 32).astype(np.float32))
+        ref = lin(x).numpy()
+        q = WeightOnlyInt8Linear(lin)
+        out = q(x).numpy()
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.02, rel
+        assert str(q.weight_int8._data.dtype) == "int8"
+
+    def test_gpt_decode_after_weight_only(self):
+        """Weight-only int8 GPT generates: same API, token stream close
+        to float greedy (small logit perturbation can flip near-ties, so
+        assert high token agreement, not equality)."""
+        from paddle_tpu.models import GPTModel
+        from paddle_tpu.quantization import quantize_weights_int8
+        paddle.seed(31)
+        m = GPTModel.from_config("tiny", dropout=0.0)
+        m.eval()
+        ids = paddle.to_tensor(
+            np.random.RandomState(31).randint(0, 128, (2, 6))
+            .astype(np.int32))
+        ref = m.generate(ids, max_new_tokens=10, compiled=True).numpy()
+        quantize_weights_int8(m)
+        from paddle_tpu.quantization import WeightOnlyInt8Linear
+        assert isinstance(m.blocks[0].attn.qkv_proj,
+                          WeightOnlyInt8Linear)
+        # no manual cache reset: the decode cache key includes the
+        # parameter AND buffer name sets, which quantization changes
+        out = m.generate(ids, max_new_tokens=10, compiled=True).numpy()
+        agree = (out == ref).mean()
+        assert agree > 0.7, agree
+
+    def test_min_features_skips_small(self):
+        from paddle_tpu.quantization import (WeightOnlyInt8Linear,
+                                             quantize_weights_int8)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.big = nn.Linear(256, 256)
+                self.small = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.small(self.big(x))
+
+        paddle.seed(32)
+        net = Net()
+        quantize_weights_int8(net, min_features=16)
+        assert isinstance(net.big, WeightOnlyInt8Linear)
+        assert isinstance(net.small, nn.Linear)
+
+    def test_weight_bytes_halved(self):
+        from paddle_tpu.quantization import WeightOnlyInt8Linear
+        paddle.seed(33)
+        lin = nn.Linear(128, 128)
+        lin.weight.set_value(lin.weight.numpy())  # f32
+        q = WeightOnlyInt8Linear(lin)
+        f32_bytes = 128 * 128 * 4
+        q_bytes = q.weight_int8._data.nbytes + \
+            q.weight_scale._data.nbytes
+        assert q_bytes < f32_bytes / 3.5  # ~4x smaller vs f32, ~2x vs bf16
