@@ -6,11 +6,13 @@ shuffle_batch, partial_concat, partial_sum, batch_fc) plus re-exports
 of contrib names whose implementations live elsewhere in this
 framework (sequence_topk_avg_pooling, tree_conv, sparse_embedding).
 
-The CTR-serving long tail (tdm_child/tdm_sampler, search_pyramid_hash,
-rank_attention, var_conv_2d, match_matrix_tensor, bilateral_slice,
-correlation, _pull_box_extended_sparse) is tied to the reference's
-parameter-server serving stack and is NOT implemented; calling them
-raises with that scope note rather than silently degrading.
+Real implementations include the CTR matching/tree ops
+(match_matrix_tensor, tdm_child, rank_attention — numpy-oracle-checked
+against the reference unittests' reference computations).  The
+remaining serving tail (tdm_sampler, search_pyramid_hash, var_conv_2d,
+bilateral_slice, correlation, _pull_box_extended_sparse) is tied to
+the reference's parameter-server/CUDA serving stack and raises with a
+scope note rather than silently degrading.
 """
 from __future__ import annotations
 
@@ -24,6 +26,7 @@ from ...nn import functional as F
 __all__ = [
     "fused_elemwise_activation", "fused_bn_add_act", "shuffle_batch",
     "partial_concat", "partial_sum", "batch_fc",
+    "match_matrix_tensor", "tdm_child", "rank_attention",
     "sequence_topk_avg_pooling", "tree_conv", "sparse_embedding",
     "multiclass_nms2",
 ]
@@ -190,8 +193,125 @@ def _ps_serving_stub(name):
     return fn
 
 
-for _n in ("tdm_child", "tdm_sampler", "search_pyramid_hash",
-           "rank_attention", "var_conv_2d", "match_matrix_tensor",
+for _n in ("tdm_sampler", "search_pyramid_hash", "var_conv_2d",
            "bilateral_slice", "correlation",
            "_pull_box_extended_sparse"):
     globals()[_n] = _ps_serving_stub(_n)
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None, x_lengths=None,
+                        y_lengths=None, w_param=None):
+    """reference contrib/layers/nn.py match_matrix_tensor
+    (match_matrix_tensor_op.cc): per-pair bilinear match
+    ``out[b, t] = x_b @ W_t @ y_b^T`` over ``channel_num`` channels.
+
+    Dense+lengths convention (COVERAGE.md LoD reduction): ``x``
+    [B, Lx, h], ``y`` [B, Ly, h]; positions beyond ``*_lengths`` are
+    masked to zero.  Returns (out [B, channel_num, Lx, Ly],
+    tmp [B, Lx, channel_num, h]) like the reference's (Out, Tmp)."""
+    import jax.numpy as jnp
+    from ...static.nn import _make_param
+    from ...nn import initializer as I
+    from ...core.tensor import Tensor
+
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    h = x.shape[-1]
+    w = ensure_tensor(w_param) if w_param is not None else _make_param(
+        [h, channel_num, h], dtype, param_attr, I.XavierUniform(),
+        "match_matrix_w")
+    tmp = ops.einsum("blh,hck->blck", x, w)
+    out = ops.einsum("blck,bmk->bclm", tmp, y)
+    if x_lengths is not None:
+        xl = ensure_tensor(x_lengths)._data.reshape(-1, 1)
+        mx = (jnp.arange(x.shape[1])[None, :] < xl)
+        out = out * Tensor(mx[:, None, :, None].astype(out._data.dtype))
+    if y_lengths is not None:
+        yl = ensure_tensor(y_lengths)._data.reshape(-1, 1)
+        my = (jnp.arange(y.shape[1])[None, :] < yl)
+        out = out * Tensor(my[:, None, None, :].astype(out._data.dtype))
+    if act:
+        out = getattr(F, act)(out)
+    return out, tmp
+
+
+def tdm_child(x, node_nums, child_nums, param_attr=None, dtype="int32",
+              tree_info=None):
+    # (dtype governs the tree table and outputs, like the reference)
+    """reference contrib/layers/nn.py tdm_child (tdm_child_op.cc):
+    gather each node's children + leaf mask from the tree table.
+
+    ``tree_info`` [node_nums, 3 + child_nums] rows =
+    [item_id, layer_id, parent, child_0..child_{n-1}]; node 0 is the
+    null node.  Returns (child [..., child_nums],
+    leaf_mask [..., child_nums] — 1 iff the child is a leaf, i.e. its
+    item_id != 0).  ``tree_info`` may be passed directly (array) or
+    created as a parameter via ``param_attr`` initializer like the
+    reference."""
+    import jax.numpy as jnp
+    from ...core.tensor import Tensor
+    from ...static.nn import _make_param
+    from ...nn import initializer as I
+
+    x = ensure_tensor(x)
+    out_dtype = jnp.int64 if str(dtype) in ("int64", "paddle.int64") \
+        else jnp.int32
+    if tree_info is None:
+        # integer storage: float32 would corrupt ids beyond 2^24
+        info = _make_param([node_nums, 3 + child_nums], str(dtype),
+                           param_attr, I.Constant(0.0), "tdm_tree_info")
+        info_arr = info._data.astype(out_dtype)
+    else:
+        info_arr = ensure_tensor(tree_info)._data.astype(out_dtype)
+    ids = x._data.astype(out_dtype)
+    children = info_arr[ids, 3:3 + child_nums]          # [..., C]
+    children = jnp.where((ids != 0)[..., None], children, 0)
+    leaf_mask = (info_arr[children, 0] != 0)
+    return (Tensor(children.astype(out_dtype)),
+            Tensor(leaf_mask.astype(out_dtype)))
+
+
+def rank_attention(input, rank_offset, rank_param_shape, rank_param_attr,
+                   max_rank=3, max_size=0, rank_param=None):
+    """reference contrib/layers/nn.py rank_attention
+    (rank_attention_op.cu): CTR rank-pair attention — each instance with
+    page-view rank ``l`` gathers, for every rank ``k`` present in its
+    page view, the history instance at that rank and the weight block
+    ``W[(l-1)·max_rank + (k-1)]``, then contracts.
+
+    ``rank_offset`` [n, 1 + 2·max_rank] int: col 0 = 1-based instance
+    rank (<=0 invalid); pairs (rank_k, row_index_k) follow.
+    ``rank_param_shape`` = [max_rank² · d, out_col].  ``rank_param``
+    may be passed directly for testing; otherwise created via attr."""
+    import jax.numpy as jnp
+    from ...core.tensor import Tensor
+    from ...static.nn import _make_param
+    from ...nn import initializer as I
+
+    input = ensure_tensor(input)
+    ro = ensure_tensor(rank_offset)._data.astype(jnp.int32)
+    n, d = input.shape
+    if rank_param is None:
+        param = _make_param(list(rank_param_shape), "float32",
+                            rank_param_attr, I.XavierUniform(),
+                            "rank_attention_w")
+    else:
+        param = ensure_tensor(rank_param)
+    pcol = param.shape[1]
+
+    lower = ro[:, 0] - 1                      # [n]
+    faster = ro[:, 1::2] - 1                  # [n, max_rank]
+    index = ro[:, 2::2]                       # [n, max_rank]
+    # ranks beyond max_rank carry no weight block — invalid like <=0
+    valid = ((lower[:, None] >= 0) & (lower[:, None] < max_rank)
+             & (faster >= 0) & (faster < max_rank))
+    gathered = input._data[jnp.clip(index, 0, n - 1)]    # [n, K, d]
+    gathered = jnp.where(valid[..., None], gathered, 0.0)
+    # weight blocks [max_rank*max_rank, d, pcol]
+    pblocks = param._data.reshape(max_rank * max_rank, d, pcol)
+    sel = jnp.where(valid, lower[:, None] * max_rank + faster, 0)
+    # invalid pairs already contribute zero: `gathered` is masked and
+    # `sel` clamps to block 0 — no second mask over the big pb buffer
+    pb = pblocks[sel]                                    # [n, K, d, pcol]
+    out = jnp.einsum("nkd,nkdc->nc", gathered, pb)
+    return Tensor(out.astype(input._data.dtype))

@@ -74,3 +74,121 @@ class TestContribLayers:
         with pytest.raises(NotImplementedError, match="return_index"):
             cl.multiclass_nms2(None, None, 0.1, 10, 10,
                                return_index=True)
+
+
+def _np_match_matrix(x, y, w, xl, yl):
+    B, Lx, h = x.shape
+    _, Ly, _ = y.shape
+    dim_t = w.shape[1]
+    out = np.zeros((B, dim_t, Lx, Ly), np.float32)
+    for b in range(B):
+        xs, ys = x[b, :xl[b]], y[b, :yl[b]]
+        tmp = np.einsum("lh,hck->lck", xs, w)
+        o = np.einsum("lck,mk->clm", tmp, ys)
+        out[b, :, :xl[b], :yl[b]] = o
+    return out
+
+
+class TestCtrOps:
+    def test_match_matrix_tensor_vs_numpy(self):
+        """Mirrors the reference test_match_matrix_tensor_op.py oracle
+        (per-pair x @ W_t @ y^T) in the dense+lengths convention."""
+        rs = np.random.RandomState(0)
+        B, Lx, Ly, h, dim_t = 3, 4, 5, 6, 2
+        x = rs.rand(B, Lx, h).astype(np.float32)
+        y = rs.rand(B, Ly, h).astype(np.float32)
+        xl = np.array([2, 4, 3])
+        yl = np.array([5, 1, 4])
+        w = rs.rand(h, dim_t, h).astype(np.float32)
+        out, tmp = cl.match_matrix_tensor(
+            paddle.to_tensor(x), paddle.to_tensor(y), dim_t,
+            x_lengths=paddle.to_tensor(xl),
+            y_lengths=paddle.to_tensor(yl),
+            w_param=paddle.to_tensor(w))
+        np.testing.assert_allclose(
+            out.numpy(), _np_match_matrix(x, y, w, xl, yl),
+            rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(
+            tmp.numpy(), np.einsum("blh,hck->blck", x, w), rtol=1e-5)
+
+    def test_tdm_child_vs_reference_tree(self):
+        """The exact tree + expectation from the reference
+        test_tdm_child_op.py."""
+        tree_info = np.array([
+            [0, 0, 0, 1, 2], [0, 1, 0, 3, 4], [0, 1, 0, 5, 6],
+            [0, 2, 1, 7, 8], [0, 2, 1, 9, 10], [0, 2, 2, 11, 12],
+            [0, 2, 2, 13, 0], [0, 3, 3, 14, 15], [0, 3, 3, 16, 17],
+            [0, 3, 4, 18, 19], [0, 3, 4, 20, 21], [0, 3, 5, 22, 23],
+            [0, 3, 5, 24, 25], [12, 3, 6, 0, 0], [0, 4, 7, 0, 0],
+            [1, 4, 7, 0, 0], [2, 4, 8, 0, 0], [3, 4, 8, 0, 0],
+            [4, 4, 9, 0, 0], [5, 4, 9, 0, 0], [6, 4, 10, 0, 0],
+            [7, 4, 10, 0, 0], [8, 4, 11, 0, 0], [9, 4, 11, 0, 0],
+            [10, 4, 12, 0, 0], [11, 4, 12, 0, 0]], np.int32)
+        rs = np.random.RandomState(1)
+        x = rs.randint(0, 26, (10, 20)).astype(np.int32)
+        child, mask = cl.tdm_child(paddle.to_tensor(x), 26, 2,
+                                   tree_info=paddle.to_tensor(tree_info))
+        # numpy oracle (reference test computation)
+        exp_child = np.zeros((10, 20, 2), np.int32)
+        exp_mask = np.zeros((10, 20, 2), np.int32)
+        for i in range(10):
+            for j in range(20):
+                node = x[i, j]
+                cs = ([tree_info[node][3], tree_info[node][4]]
+                      if node != 0 else [0, 0])
+                exp_child[i, j] = cs
+                exp_mask[i, j] = [int(tree_info[c][0] != 0) for c in cs]
+        np.testing.assert_array_equal(child.numpy(), exp_child)
+        np.testing.assert_array_equal(mask.numpy(), exp_mask)
+
+    def test_rank_attention_vs_reference_oracle(self):
+        """Mirrors np_rank_attention from the reference
+        test_rank_attention_op.py."""
+        import random as pyrandom
+
+        def np_rank_attention(inp, rank_offset, rank_para, max_rank):
+            input_row, input_col = inp.shape
+            res = np.zeros((input_row, rank_para.shape[1]))
+            for i in range(input_row):
+                lower = rank_offset[i, 0] - 1
+                if lower < 0 or lower >= max_rank:
+                    continue
+                for k in range(max_rank):
+                    faster = rank_offset[i, 2 * k + 1] - 1
+                    if faster < 0 or faster >= max_rank:
+                        continue
+                    idx = rank_offset[i, 2 * k + 2]
+                    block = rank_para[
+                        (lower * max_rank + faster) * input_col:
+                        (lower * max_rank + faster + 1) * input_col]
+                    res[i] += inp[idx] @ block
+            return res
+
+        rs = np.random.RandomState(2)
+        pyrandom.seed(2)
+        max_rank, d, pcol = 3, 5, 4
+        # build rank_offset like the reference's gen_rank_offset
+        rows = []
+        for _ in range(4):  # page views
+            ins_pv = rs.randint(1, max_rank + 2)
+            ranks = list(range(1, ins_pv + 1))
+            pyrandom.shuffle(ranks)
+            start = len(rows)
+            for r in ranks:
+                row = [-1] * (2 * max_rank + 1)
+                row[0] = r
+                for k, rk in enumerate(ranks):
+                    if rk <= max_rank:
+                        row[2 * (rk - 1) + 1] = rk
+                        row[2 * (rk - 1) + 2] = start + k
+                rows.append(row)
+        ro = np.array(rows, np.int32)
+        n = len(rows)
+        inp = rs.rand(n, d).astype(np.float32)
+        param = rs.rand(max_rank * max_rank * d, pcol).astype(np.float32)
+        exp = np_rank_attention(inp, ro, param, max_rank)
+        out = cl.rank_attention(
+            paddle.to_tensor(inp), paddle.to_tensor(ro),
+            [max_rank * max_rank * d, pcol], None, max_rank=max_rank,
+            rank_param=paddle.to_tensor(param))
+        np.testing.assert_allclose(out.numpy(), exp, rtol=1e-5, atol=1e-5)
