@@ -7,9 +7,9 @@ of contrib names whose implementations live elsewhere in this
 framework (sequence_topk_avg_pooling, tree_conv, sparse_embedding).
 
 Real implementations include the CTR matching/tree ops
-(match_matrix_tensor, tdm_child, rank_attention — numpy-oracle-checked
-against the reference unittests' reference computations).  The
-remaining serving tail (tdm_sampler, search_pyramid_hash, var_conv_2d,
+(match_matrix_tensor, tdm_child, tdm_sampler, rank_attention —
+checked against the reference unittests' numpy oracles / validation
+rules).  The remaining serving tail (search_pyramid_hash, var_conv_2d,
 bilateral_slice, correlation, _pull_box_extended_sparse) is tied to
 the reference's parameter-server/CUDA serving stack and raises with a
 scope note rather than silently degrading.
@@ -26,7 +26,8 @@ from ...nn import functional as F
 __all__ = [
     "fused_elemwise_activation", "fused_bn_add_act", "shuffle_batch",
     "partial_concat", "partial_sum", "batch_fc",
-    "match_matrix_tensor", "tdm_child", "rank_attention",
+    "match_matrix_tensor", "tdm_child", "tdm_sampler",
+    "rank_attention",
     "sequence_topk_avg_pooling", "tree_conv", "sparse_embedding",
     "multiclass_nms2",
 ]
@@ -193,7 +194,7 @@ def _ps_serving_stub(name):
     return fn
 
 
-for _n in ("tdm_sampler", "search_pyramid_hash", "var_conv_2d",
+for _n in ("search_pyramid_hash", "var_conv_2d",
            "bilateral_slice", "correlation",
            "_pull_box_extended_sparse"):
     globals()[_n] = _ps_serving_stub(_n)
@@ -315,3 +316,94 @@ def rank_attention(input, rank_offset, rank_param_shape, rank_param_attr,
     pb = pblocks[sel]                                    # [n, K, d, pcol]
     out = jnp.einsum("nkd,nkdc->nc", gathered, pb)
     return Tensor(out.astype(input._data.dtype))
+
+
+def tdm_sampler(x, neg_samples_num_list, layer_node_num_list,
+                leaf_node_num, tree_travel_attr=None, tree_layer_attr=None,
+                output_positive=True, output_list=True, seed=0,
+                tree_dtype="int32", dtype="int32",
+                travel=None, layer=None, name=None):
+    """reference contrib/layers/nn.py tdm_sampler (tdm_sampler_op.cc):
+    layer-wise negative sampling over a TDM tree.
+
+    For each leaf item in ``x`` [B, 1] and each tree layer i: emit the
+    item's ancestor on that layer (the positive, label 1, mask 0 when
+    the travel entry is padding 0) plus ``neg_samples_num_list[i]``
+    negatives drawn WITHOUT replacement from that layer's other nodes
+    (label 0).  Sampling is host-side numpy — this op builds training
+    DATA (it feeds the loader, like the reference's CPU-only kernel),
+    so it is not traced.  ``travel`` [leaf_node_num, n_layers] and
+    ``layer`` (flat node list) may be passed directly; otherwise they
+    are created as parameters from the attrs like the reference."""
+    from ...core.tensor import Tensor
+    from ...static.nn import _make_param
+    from ...nn import initializer as I
+
+    n_layers = len(layer_node_num_list)
+    if len(neg_samples_num_list) != n_layers:
+        raise ValueError(
+            "neg_samples_num_list and layer_node_num_list must have one "
+            "entry per tree layer")
+    x_np = np.asarray(ensure_tensor(x).numpy()).reshape(-1).astype(
+        np.int64)
+    if x_np.size and (x_np.min() < 0 or x_np.max() >= leaf_node_num):
+        raise ValueError(
+            f"tdm_sampler: leaf ids must be in [0, {leaf_node_num}) — "
+            f"got range [{x_np.min()}, {x_np.max()}] (the reference "
+            "kernel enforces the same bound)")
+    if travel is None:
+        travel = _make_param([leaf_node_num, n_layers], tree_dtype,
+                             tree_travel_attr, I.Constant(0.0),
+                             "tdm_travel")
+    travel_np = np.asarray(ensure_tensor(travel).numpy()).astype(
+        np.int64)
+    if layer is None:
+        layer = _make_param([sum(layer_node_num_list), 1],
+                            tree_dtype, tree_layer_attr,
+                            I.Constant(0.0), "tdm_layer")
+    layer_np = np.asarray(ensure_tensor(layer).numpy()).reshape(-1) \
+        .astype(np.int64)
+    if len(layer_np) != sum(layer_node_num_list):
+        raise ValueError(
+            f"tdm_sampler: layer table has {len(layer_np)} nodes but "
+            f"layer_node_num_list sums to {sum(layer_node_num_list)}")
+    offs = np.cumsum([0] + list(layer_node_num_list))
+    layers = [layer_np[offs[i]:offs[i + 1]] for i in range(n_layers)]
+    for i, k in enumerate(neg_samples_num_list):
+        if k >= layer_node_num_list[i]:
+            raise ValueError(
+                f"layer {i}: {k} negatives requested but the layer has "
+                f"only {layer_node_num_list[i]} nodes (sampling is "
+                "without replacement, excluding the positive)")
+
+    rs = np.random.RandomState(seed or None)
+    np_dtype = np.int64 if str(dtype) == "int64" else np.int32
+    outs, labels, masks = [], [], []
+    for i in range(n_layers):
+        k = neg_samples_num_list[i]
+        width = (1 if output_positive else 0) + k
+        o = np.zeros((len(x_np), width), np_dtype)
+        lab = np.zeros_like(o)
+        msk = np.zeros_like(o)
+        for b, leaf in enumerate(x_np):
+            pos = int(travel_np[leaf, i])
+            if pos == 0:
+                continue  # padded travel: whole row stays 0/0/0
+            cand = layers[i][layers[i] != pos]
+            negs = rs.choice(cand, size=k, replace=False) if k else \
+                np.empty(0, np.int64)
+            row = ([pos] if output_positive else []) + list(negs)
+            o[b, :len(row)] = row
+            if output_positive:
+                lab[b, 0] = 1
+            msk[b, :len(row)] = 1
+        outs.append(Tensor(o))
+        labels.append(Tensor(lab))
+        masks.append(Tensor(msk))
+    if output_list:
+        return outs, labels, masks
+
+    def cat(ts):
+        return Tensor(np.concatenate([t.numpy() for t in ts], axis=1))
+
+    return cat(outs), cat(labels), cat(masks)

@@ -61,7 +61,7 @@ class TestContribLayers:
 
     def test_ps_serving_stubs_raise_with_scope(self):
         with pytest.raises(NotImplementedError, match="PS"):
-            cl.tdm_sampler()
+            cl.bilateral_slice()
         with pytest.raises(NotImplementedError, match="COVERAGE"):
             cl.search_pyramid_hash()
 
@@ -192,3 +192,68 @@ class TestCtrOps:
             [max_rank * max_rank * d, pcol], None, max_rank=max_rank,
             rank_param=paddle.to_tensor(param))
         np.testing.assert_allclose(out.numpy(), exp, rtol=1e-5, atol=1e-5)
+
+    def test_tdm_sampler_reference_properties(self):
+        """Mirrors the reference test_tdm_sampler_op.py validation:
+        per-layer uniqueness, layer-legality, label/mask rules."""
+        travel = np.array(
+            [[1, 3, 7, 14], [1, 3, 7, 15], [1, 3, 8, 16], [1, 3, 8, 17],
+             [1, 4, 9, 18], [1, 4, 9, 19], [1, 4, 10, 20],
+             [1, 4, 10, 21], [2, 5, 11, 22], [2, 5, 11, 23],
+             [2, 5, 12, 24], [2, 5, 12, 25], [2, 6, 13, 0]], np.int32)
+        tree_layer = [[1, 2], [3, 4, 5, 6],
+                      [7, 8, 9, 10, 11, 12, 13],
+                      list(range(14, 26))]
+        layer_flat = np.concatenate(
+            [np.asarray(l) for l in tree_layer]).astype(np.int32)
+        neg = [1, 2, 3, 4]
+        rs = np.random.RandomState(3)
+        x = rs.randint(0, 13, (10, 1)).astype(np.int32)
+        outs, labels, masks = cl.tdm_sampler(
+            paddle.to_tensor(x), neg, [len(l) for l in tree_layer], 13,
+            seed=7, travel=paddle.to_tensor(travel),
+            layer=paddle.to_tensor(layer_flat.reshape(-1, 1)))
+        assert len(outs) == 4
+        for i, (o, lab, msk) in enumerate(zip(outs, labels, masks)):
+            o, lab, msk = o.numpy(), lab.numpy(), msk.numpy()
+            assert o.shape == (10, 1 + neg[i])
+            for b in range(10):
+                pos = travel[x[b, 0], i]
+                row = o[b].tolist()
+                if pos == 0:
+                    assert set(row) == {0} and msk[b].sum() == 0
+                    continue
+                assert row[0] == pos and lab[b, 0] == 1
+                assert len(set(row)) == len(row)  # unique incl. pos
+                for node in row:
+                    assert node in tree_layer[i]
+                assert (lab[b, 1:] == 0).all()
+                assert (msk[b] == 1).all()
+        # concatenated form
+        out_c, lab_c, msk_c = cl.tdm_sampler(
+            paddle.to_tensor(x), neg, [len(l) for l in tree_layer], 13,
+            seed=7, output_list=False, travel=paddle.to_tensor(travel),
+            layer=paddle.to_tensor(layer_flat.reshape(-1, 1)))
+        assert out_c.shape == [10, 4 + sum(neg)]
+
+    def test_tdm_sampler_rejects_oversampling(self):
+        with pytest.raises(ValueError, match="without replacement"):
+            cl.tdm_sampler(paddle.to_tensor(np.zeros((2, 1), np.int32)),
+                           [5], [3], 4,
+                           travel=paddle.to_tensor(
+                               np.ones((4, 1), np.int32)),
+                           layer=paddle.to_tensor(
+                               np.arange(1, 4, dtype=np.int32)
+                               .reshape(-1, 1)))
+
+    def test_tdm_sampler_bounds_and_table_checks(self):
+        travel = paddle.to_tensor(np.ones((4, 2), np.int32))
+        layer = paddle.to_tensor(
+            np.arange(1, 7, dtype=np.int32).reshape(-1, 1))
+        bad_x = paddle.to_tensor(np.array([[4]], np.int32))  # == leaf_num
+        with pytest.raises(ValueError, match="leaf ids"):
+            cl.tdm_sampler(bad_x, [0, 0], [3, 3], 4,
+                           travel=travel, layer=layer)
+        with pytest.raises(ValueError, match="layer table"):
+            cl.tdm_sampler(paddle.to_tensor(np.zeros((1, 1), np.int32)),
+                           [0, 0], [3, 4], 4, travel=travel, layer=layer)
