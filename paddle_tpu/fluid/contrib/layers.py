@@ -10,7 +10,7 @@ Real implementations include the CTR matching/tree ops
 (match_matrix_tensor, tdm_child, tdm_sampler, rank_attention —
 checked against the reference unittests' numpy oracles / validation
 rules).  The remaining serving tail (search_pyramid_hash, var_conv_2d,
-bilateral_slice, correlation, _pull_box_extended_sparse) is tied to
+bilateral_slice, _pull_box_extended_sparse) is tied to
 the reference's parameter-server/CUDA serving stack and raises with a
 scope note rather than silently degrading.
 """
@@ -27,7 +27,7 @@ __all__ = [
     "fused_elemwise_activation", "fused_bn_add_act", "shuffle_batch",
     "partial_concat", "partial_sum", "batch_fc",
     "match_matrix_tensor", "tdm_child", "tdm_sampler",
-    "rank_attention",
+    "rank_attention", "correlation",
     "sequence_topk_avg_pooling", "tree_conv", "sparse_embedding",
     "multiclass_nms2",
 ]
@@ -195,9 +195,84 @@ def _ps_serving_stub(name):
 
 
 for _n in ("search_pyramid_hash", "var_conv_2d",
-           "bilateral_slice", "correlation",
-           "_pull_box_extended_sparse"):
+           "bilateral_slice", "_pull_box_extended_sparse"):
     globals()[_n] = _ps_serving_stub(_n)
+
+
+def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1):
+    """reference contrib/layers/nn.py correlation (correlation_op.cu —
+    the FlowNet cost-volume layer; CUDA-only there, one fused XLA
+    program here, with the EXACT kernel geometry):
+
+    * displacement grid: radius ``max_displacement // stride2``, step
+      ``stride2`` (channel idx = row-disp-major, col-disp fastest);
+    * output spatial size ``ceil((H + 2·pad − 2·(kernel_rad +
+      max_displacement)) / stride1)`` with windows CENTERED at
+      ``o·stride1 + max_displacement`` in padded coordinates;
+    * every window divides by ``K²·C`` (pad zeros count — the kernel
+      never truncates).
+    """
+    import math
+    import jax.numpy as jnp
+    from jax import lax
+    from ...core.tensor import Tensor
+
+    if corr_type_multiply != 1:
+        raise NotImplementedError(
+            "correlation: only corr_type_multiply=1 (the multiply form) "
+            "is implemented — the reference CUDA kernel ignores other "
+            "values too, but refusing beats silently diverging")
+    if kernel_size % 2 == 0:
+        raise ValueError(
+            "correlation: kernel_size must be odd — the reference "
+            "kernel's window is [-(K-1)//2, (K-1)//2], which for even K "
+            "covers only (K-1)^2 taps while still dividing by K^2; "
+            "refusing beats replicating that truncation silently")
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+    xa, ya = x._data, y._data
+    if tuple(xa.shape) != tuple(ya.shape):
+        raise ValueError(
+            f"correlation: inputs must have identical shapes, got "
+            f"{list(xa.shape)} vs {list(ya.shape)} (the reference op "
+            "enforces the same)")
+    B, C, H, W = xa.shape
+    p, K, d = pad_size, kernel_size, max_displacement
+    kernel_rad = (K - 1) // 2
+    disp_rad = d // stride2
+    Hp, Wp = H + 2 * p, W + 2 * p
+    out_h = math.ceil((Hp - 2 * (kernel_rad + d)) / stride1)
+    out_w = math.ceil((Wp - 2 * (kernel_rad + d)) / stride1)
+    anchor = d - kernel_rad  # first window's top-left in padded coords
+    if out_h <= 0 or out_w <= 0 or anchor < 0:
+        raise ValueError(
+            f"correlation: geometry is empty/out-of-bounds for H={H} "
+            f"W={W} pad={p} kernel={K} max_displacement={d} — the "
+            "reference kernel would read out of range here "
+            f"(out={out_h}x{out_w}, first window offset {anchor})")
+    xp = jnp.pad(xa, ((0, 0), (0, 0), (p, p), (p, p)))
+    yp = jnp.pad(ya, ((0, 0), (0, 0), (p, p), (p, p)))
+    # zero-filled shift workspace (roll would WRAP edge values)
+    sh = disp_rad * stride2
+    yp2 = jnp.pad(yp, ((0, 0), (0, 0), (sh, sh), (sh, sh)))
+    outs = []
+    denom = float(K * K * C)
+    for tj in range(-disp_rad, disp_rad + 1):      # row displacement
+        for ti in range(-disp_rad, disp_rad + 1):  # col displacement
+            dy, dx = tj * stride2, ti * stride2
+            shifted = yp2[:, :, sh + dy:sh + dy + Hp,
+                          sh + dx:sh + dx + Wp]
+            # channel-sum BEFORE the windowed reduction: the two sums
+            # commute and this does 1/C of the window work
+            prod = jnp.sum(xp * shifted, axis=1)   # [B, Hp, Wp]
+            win = lax.reduce_window(
+                prod, 0.0, lax.add, (1, K, K), (1, 1, 1), "valid")
+            out_kl = win[:, anchor:anchor + out_h * stride1:stride1,
+                         anchor:anchor + out_w * stride1:stride1] / denom
+            outs.append(out_kl)
+    stacked = jnp.stack(outs, axis=1)  # row-disp-major, col fastest
+    return Tensor(stacked.astype(xa.dtype))
 
 
 def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,

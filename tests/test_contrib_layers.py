@@ -257,3 +257,72 @@ class TestCtrOps:
         with pytest.raises(ValueError, match="layer table"):
             cl.tdm_sampler(paddle.to_tensor(np.zeros((1, 1), np.int32)),
                            [0, 0], [3, 4], 4, travel=travel, layer=layer)
+
+    def test_correlation_vs_reference_oracle(self):
+        """Oracle transliterated from the reference CUDA kernel
+        (correlation_op.cu correlation_forward): centered windows at
+        o*stride1 + max_displacement in padded coords, displacement
+        radius d//stride2, /= K*K*C always.  The K=1 pad=d subset
+        coincides with the reference contrib test's python oracle."""
+
+        def corr_np(x1, x2, p, K, d, s1, s2):
+            import math
+            B, C, H, W = x1.shape
+            krad = (K - 1) // 2
+            drad = d // s2
+            D = 2 * drad + 1
+            Hp, Wp = H + 2 * p, W + 2 * p
+            oh = math.ceil((Hp - 2 * (krad + d)) / s1)
+            ow = math.ceil((Wp - 2 * (krad + d)) / s1)
+            r1 = np.pad(x1, ((0, 0), (0, 0), (p, p), (p, p)))
+            r2 = np.pad(x2, ((0, 0), (0, 0), (p, p), (p, p)))
+            out = np.zeros((B, D * D, oh, ow), np.float32)
+            for b in range(B):
+                for oi in range(oh):
+                    for oj in range(ow):
+                        h1 = oi * s1 + d
+                        w1 = oj * s1 + d
+                        for tj in range(-drad, drad + 1):
+                            for ti in range(-drad, drad + 1):
+                                h2, w2 = h1 + tj * s2, w1 + ti * s2
+                                acc = 0.0
+                                for j in range(-krad, krad + 1):
+                                    for i in range(-krad, krad + 1):
+                                        acc += float(np.dot(
+                                            r1[b, :, h1 + j, w1 + i],
+                                            r2[b, :, h2 + j, w2 + i]))
+                                idx = (tj + drad) * D + (ti + drad)
+                                out[b, idx, oi, oj] = acc / (K * K * C)
+            return out
+
+        rs = np.random.RandomState(4)
+        x1 = rs.rand(2, 3, 6, 7).astype(np.float32)
+        x2 = rs.rand(2, 3, 6, 7).astype(np.float32)
+        for p, K, d, s1, s2 in ((4, 1, 4, 1, 1), (2, 1, 2, 1, 1),
+                                (4, 3, 2, 1, 1), (4, 1, 4, 2, 2),
+                                (3, 3, 2, 2, 1)):
+            out = cl.correlation(paddle.to_tensor(x1),
+                                 paddle.to_tensor(x2),
+                                 pad_size=p, kernel_size=K,
+                                 max_displacement=d, stride1=s1,
+                                 stride2=s2)
+            ref = corr_np(x1, x2, p, K, d, s1, s2)
+            assert list(out.shape) == list(ref.shape), (p, K, d, s1, s2)
+            np.testing.assert_allclose(
+                out.numpy(), ref, rtol=1e-5, atol=1e-6,
+                err_msg=f"p={p} K={K} d={d} s1={s1} s2={s2}")
+
+    def test_correlation_rejects_multiply_type_and_bad_geometry(self):
+        x = paddle.to_tensor(np.zeros((1, 1, 4, 4), np.float32))
+        with pytest.raises(NotImplementedError, match="corr_type"):
+            cl.correlation(x, x, 4, 1, 4, 1, 1, corr_type_multiply=2)
+        with pytest.raises(ValueError, match="geometry"):
+            cl.correlation(x, x, 0, 1, 4, 1, 1)  # empty output
+
+    def test_correlation_rejects_even_kernel_and_shape_mismatch(self):
+        x = paddle.to_tensor(np.zeros((1, 3, 6, 6), np.float32))
+        y = paddle.to_tensor(np.zeros((1, 1, 6, 6), np.float32))
+        with pytest.raises(ValueError, match="odd"):
+            cl.correlation(x, x, 3, 2, 2, 1, 1)
+        with pytest.raises(ValueError, match="identical shapes"):
+            cl.correlation(x, y, 4, 1, 4, 1, 1)
