@@ -7,12 +7,12 @@ of contrib names whose implementations live elsewhere in this
 framework (sequence_topk_avg_pooling, tree_conv, sparse_embedding).
 
 Real implementations include the CTR matching/tree ops
-(match_matrix_tensor, tdm_child, tdm_sampler, rank_attention —
-checked against the reference unittests' numpy oracles / validation
-rules).  The remaining serving tail (search_pyramid_hash, var_conv_2d,
-bilateral_slice, _pull_box_extended_sparse) is tied to
-the reference's parameter-server/CUDA serving stack and raises with a
-scope note rather than silently degrading.
+(match_matrix_tensor, tdm_child, tdm_sampler, rank_attention,
+correlation, bilateral_slice — checked against the reference
+unittests' numpy oracles / validation rules).  The remaining serving
+tail (search_pyramid_hash, var_conv_2d, _pull_box_extended_sparse) is
+tied to the reference's parameter-server/LoD serving stack and raises
+with a scope note rather than silently degrading.
 """
 from __future__ import annotations
 
@@ -27,7 +27,7 @@ __all__ = [
     "fused_elemwise_activation", "fused_bn_add_act", "shuffle_batch",
     "partial_concat", "partial_sum", "batch_fc",
     "match_matrix_tensor", "tdm_child", "tdm_sampler",
-    "rank_attention", "correlation",
+    "rank_attention", "correlation", "bilateral_slice",
     "sequence_topk_avg_pooling", "tree_conv", "sparse_embedding",
     "multiclass_nms2",
 ]
@@ -195,7 +195,7 @@ def _ps_serving_stub(name):
 
 
 for _n in ("search_pyramid_hash", "var_conv_2d",
-           "bilateral_slice", "_pull_box_extended_sparse"):
+           "_pull_box_extended_sparse"):
     globals()[_n] = _ps_serving_stub(_n)
 
 
@@ -482,3 +482,74 @@ def tdm_sampler(x, neg_samples_num_list, layer_node_num_list,
         return Tensor(np.concatenate([t.numpy() for t in ts], axis=1))
 
     return cat(outs), cat(labels), cat(masks)
+
+
+def bilateral_slice(x, guide, grid, has_offset=False, name=None):
+    """reference contrib/layers/nn.py bilateral_slice
+    (bilateral_slice_op.cu — HDRNet's guided bilateral-grid slicing;
+    CUDA-only there, one fused XLA gather/lerp program here).
+
+    ``x`` [B, Cin, H, W]; ``guide`` [B, H, W] in [0, 1); ``grid``
+    [B, Cg, gd, gh, gw] with ``Cg = Cout·Cin`` (+``Cout`` when
+    ``has_offset``).  Each pixel trilinearly samples an affine
+    transform from the grid at (guide-depth, y, x) — tent weights with
+    clamped corner indices, matching the reference kernel exactly —
+    and applies it to the input channels."""
+    import jax.numpy as jnp
+    from ...core.tensor import Tensor
+
+    x = ensure_tensor(x)
+    guide = ensure_tensor(guide)
+    grid = ensure_tensor(grid)
+    xa, ga, gr = x._data, guide._data, grid._data
+    B, Cin, H, W = xa.shape
+    if tuple(ga.shape) != (B, H, W):
+        raise ValueError(
+            f"bilateral_slice: guide must be [B, H, W] = {[B, H, W]}, "
+            f"got {list(ga.shape)}")
+    if gr.ndim != 5 or gr.shape[0] != B:
+        raise ValueError(
+            f"bilateral_slice: grid must be [B, Cg, gd, gh, gw] with "
+            f"batch {B}, got {list(gr.shape)}")
+    _, Cg, gd, gh, gw = gr.shape
+    stride = Cin + (1 if has_offset else 0)
+    if Cg % stride:
+        raise ValueError(
+            f"bilateral_slice: grid channels ({Cg}) not divisible by "
+            f"input_chans{'+1' if has_offset else ''} ({stride})")
+    Cout = Cg // stride
+
+    gx = (jnp.arange(W) + 0.5) * gw / W                  # [W]
+    gy = (jnp.arange(H) + 0.5) * gh / H                  # [H]
+    gz = ga * gd                                         # [B, H, W]
+    gxb = jnp.broadcast_to(gx[None, None, :], (B, H, W))
+    gyb = jnp.broadcast_to(gy[None, :, None], (B, H, W))
+
+    fx = jnp.floor(gxb - 0.5)
+    fy = jnp.floor(gyb - 0.5)
+    fz = jnp.floor(gz - 0.5)
+
+    coeff = jnp.zeros((B, H, W, Cg), jnp.float32)
+    bidx = jnp.arange(B)[:, None, None]
+    for dz in (0, 1):
+        zz = fz + dz
+        z_ = jnp.clip(zz, 0, gd - 1).astype(jnp.int32)
+        wz = jnp.maximum(1.0 - jnp.sqrt((zz + 0.5 - gz) ** 2 + 1e-8),
+                         0.0)
+        for dy in (0, 1):
+            yy = fy + dy
+            y_ = jnp.clip(yy, 0, gh - 1).astype(jnp.int32)
+            wy = jnp.maximum(1.0 - jnp.abs(yy + 0.5 - gyb), 0.0)
+            for dx in (0, 1):
+                xx = fx + dx
+                x_ = jnp.clip(xx, 0, gw - 1).astype(jnp.int32)
+                wx = jnp.maximum(1.0 - jnp.abs(xx + 0.5 - gxb), 0.0)
+                corner = gr[bidx, :, z_, y_, x_]     # [B, H, W, Cg]
+                coeff = coeff + corner * (wx * wy * wz)[..., None]
+
+    coeff = coeff.reshape(B, H, W, Cout, stride)
+    xin = jnp.moveaxis(xa, 1, -1)                        # [B, H, W, Cin]
+    out = jnp.einsum("bhwoc,bhwc->bhwo", coeff[..., :Cin], xin)
+    if has_offset:
+        out = out + coeff[..., Cin]
+    return Tensor(jnp.moveaxis(out, -1, 1).astype(xa.dtype))

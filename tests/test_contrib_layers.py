@@ -61,7 +61,7 @@ class TestContribLayers:
 
     def test_ps_serving_stubs_raise_with_scope(self):
         with pytest.raises(NotImplementedError, match="PS"):
-            cl.bilateral_slice()
+            cl.var_conv_2d()
         with pytest.raises(NotImplementedError, match="COVERAGE"):
             cl.search_pyramid_hash()
 
@@ -326,3 +326,82 @@ class TestCtrOps:
             cl.correlation(x, x, 3, 2, 2, 1, 1)
         with pytest.raises(ValueError, match="identical shapes"):
             cl.correlation(x, y, 4, 1, 4, 1, 1)
+
+    def test_bilateral_slice_vs_reference_oracle(self):
+        """Transliterated naive_bilateral_slice from the reference
+        test_bilateral_slice_op.py (tent weights, clamped corners,
+        weight_z's sqrt-smoothed |.|)."""
+
+        def naive(x, guide, grid, has_offset):
+            bs, input_chans, h, w = x.shape
+            coeffs_chans = grid.shape[1]
+            stride = input_chans + (1 if has_offset else 0)
+            output_chans = coeffs_chans // stride
+            gd, gh, gw = grid.shape[2:]
+            out = np.zeros((bs, output_chans, h, w), np.float32)
+            import math
+            for b in range(bs):
+                for oc in range(output_chans):
+                    for y in range(h):
+                        for xx_ in range(w):
+                            gx = (xx_ + 0.5) * gw / w
+                            gy = (y + 0.5) * gh / h
+                            gz = guide[b, y, xx_] * gd
+                            fx = int(np.floor(gx - 0.5))
+                            fy = int(np.floor(gy - 0.5))
+                            fz = int(np.floor(gz - 0.5))
+                            value = 0.0
+                            for ic in range(stride):
+                                cs = 0.0
+                                for xc in range(fx, fx + 2):
+                                    x2 = max(min(xc, gw - 1), 0)
+                                    wx = max(1.0 - abs(xc + 0.5 - gx), 0.0)
+                                    for yc in range(fy, fy + 2):
+                                        y2 = max(min(yc, gh - 1), 0)
+                                        wy = max(1.0 - abs(yc + 0.5 - gy),
+                                                 0.0)
+                                        for zc in range(fz, fz + 2):
+                                            z2 = max(min(zc, gd - 1), 0)
+                                            az = math.sqrt(
+                                                (zc + 0.5 - gz) ** 2
+                                                + 1e-8)
+                                            wz = max(1.0 - az, 0.0)
+                                            c_ = stride * oc + ic
+                                            cs += grid[b, c_, z2, y2,
+                                                       x2] * wx * wy * wz
+                                if ic < input_chans:
+                                    value += cs * x[b, ic, y, xx_]
+                                else:
+                                    value += cs
+                            out[b, oc, y, xx_] = value
+            return out
+
+        rs = np.random.RandomState(5)
+        for has_offset, cin, cout in ((False, 2, 3), (True, 2, 3),
+                                      (True, 1, 1)):
+            stride = cin + (1 if has_offset else 0)
+            x = rs.rand(2, cin, 6, 5).astype(np.float32)
+            guide = rs.rand(2, 6, 5).astype(np.float32)
+            grid = rs.rand(2, cout * stride, 4, 3, 3).astype(np.float32)
+            out = cl.bilateral_slice(paddle.to_tensor(x),
+                                     paddle.to_tensor(guide),
+                                     paddle.to_tensor(grid),
+                                     has_offset=has_offset)
+            ref = naive(x, guide, grid, has_offset)
+            np.testing.assert_allclose(
+                out.numpy(), ref, rtol=1e-4, atol=1e-5,
+                err_msg=f"has_offset={has_offset} cin={cin}")
+
+    def test_bilateral_slice_bad_grid_channels(self):
+        x = paddle.to_tensor(np.zeros((1, 2, 4, 4), np.float32))
+        g = paddle.to_tensor(np.zeros((1, 4, 4), np.float32))
+        grid = paddle.to_tensor(np.zeros((1, 5, 2, 2, 2), np.float32))
+        with pytest.raises(ValueError, match="divisible"):
+            cl.bilateral_slice(x, g, grid, has_offset=False)
+
+    def test_bilateral_slice_guide_shape_checked(self):
+        x = paddle.to_tensor(np.zeros((1, 2, 4, 5), np.float32))
+        grid = paddle.to_tensor(np.zeros((1, 4, 2, 2, 2), np.float32))
+        bad_guide = paddle.to_tensor(np.zeros((4, 5), np.float32))
+        with pytest.raises(ValueError, match="guide must be"):
+            cl.bilateral_slice(x, bad_guide, grid)
