@@ -10,9 +10,9 @@ Real implementations include the CTR matching/tree ops
 (match_matrix_tensor, tdm_child, tdm_sampler, rank_attention,
 correlation, bilateral_slice — checked against the reference
 unittests' numpy oracles / validation rules).  The remaining serving
-tail (search_pyramid_hash, var_conv_2d, _pull_box_extended_sparse) is
-tied to the reference's parameter-server/LoD serving stack and raises
-with a scope note rather than silently degrading.
+tail (search_pyramid_hash, _pull_box_extended_sparse) is tied to the
+reference's parameter-server hashing stack and raises with a scope
+note rather than silently degrading.
 """
 from __future__ import annotations
 
@@ -28,6 +28,7 @@ __all__ = [
     "partial_concat", "partial_sum", "batch_fc",
     "match_matrix_tensor", "tdm_child", "tdm_sampler",
     "rank_attention", "correlation", "bilateral_slice",
+    "var_conv_2d",
     "sequence_topk_avg_pooling", "tree_conv", "sparse_embedding",
     "multiclass_nms2",
 ]
@@ -194,8 +195,7 @@ def _ps_serving_stub(name):
     return fn
 
 
-for _n in ("search_pyramid_hash", "var_conv_2d",
-           "_pull_box_extended_sparse"):
+for _n in ("search_pyramid_hash", "_pull_box_extended_sparse"):
     globals()[_n] = _ps_serving_stub(_n)
 
 
@@ -553,3 +553,75 @@ def bilateral_slice(x, guide, grid, has_offset=False, name=None):
     if has_offset:
         out = out + coeff[..., Cin]
     return Tensor(jnp.moveaxis(out, -1, 1).astype(xa.dtype))
+
+
+def var_conv_2d(x, row_lengths, col_lengths, input_channel,
+                output_channel, filter_size, stride=1, param_attr=None,
+                act=None, dtype="float32", name=None, w_param=None):
+    """reference contrib/layers/nn.py var_conv_2d (var_conv_2d_op.cc):
+    per-sample 2-D conv over VARIABLE H_i x W_i feature maps.
+
+    Dense+lengths redesign of the LoD original (COVERAGE.md reduction):
+    ``x`` [B, C, Hmax, Wmax] with per-sample ``row_lengths``/
+    ``col_lengths``; windows are centered (pad K//2, exactly the
+    reference's half-kernel anchoring) and read ZEROS beyond a sample's
+    own bounds — masking the canvas makes the batched conv equal the
+    reference's per-sample im2col, because zero pixels contribute
+    nothing.  Output [B, out_ch, ceil(Hmax/s), ceil(Wmax/s)], zeroed
+    beyond each sample's ceil(h_i/s) x ceil(w_i/s) region.  Weight
+    layout follows the reference: [out_ch, C*Kh*Kw] in (c, ky, kx)
+    order."""
+    import jax.numpy as jnp
+    from jax import lax
+    from ...core.tensor import Tensor
+    from ...static.nn import _make_param
+    from ...nn import initializer as I
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = _pair(filter_size)
+    sh, sw = _pair(stride)
+    x = ensure_tensor(x)
+    xa = x._data
+    B, C, H, W = xa.shape
+    if C != input_channel:
+        raise ValueError(
+            f"var_conv_2d: x has {C} channels, input_channel says "
+            f"{input_channel}")
+    rl = ensure_tensor(row_lengths)._data.reshape(-1)
+    cl_ = ensure_tensor(col_lengths)._data.reshape(-1)
+    if rl.shape[0] != B or cl_.shape[0] != B:
+        raise ValueError(
+            f"var_conv_2d: row_lengths/col_lengths must have one entry "
+            f"per sample (batch {B}), got {rl.shape[0]}/{cl_.shape[0]}")
+    if w_param is not None:
+        w = ensure_tensor(w_param)
+    else:
+        w = _make_param([output_channel, C * kh * kw], dtype, param_attr,
+                        I.XavierUniform(), "var_conv_w")
+    w4 = w._data.reshape(output_channel, C, kh, kw)
+
+    # zero beyond each sample's own extent: the conv then reads zeros
+    # exactly where the reference's bounds check skips
+    ri = jnp.arange(H)[None, :, None]
+    ci = jnp.arange(W)[None, None, :]
+    valid = (ri < rl[:, None, None]) & (ci < cl_[:, None, None])
+    xm = xa * valid[:, None, :, :].astype(xa.dtype)
+
+    out_h = -(-H // sh)
+    out_w = -(-W // sw)
+    lo_h, lo_w = kh // 2, kw // 2
+    hi_h = max(0, (out_h - 1) * sh + kh - lo_h - H)
+    hi_w = max(0, (out_w - 1) * sw + kw - lo_w - W)
+    acc = lax.conv_general_dilated(
+        xm, w4, (sh, sw), ((lo_h, hi_h), (lo_w, hi_w)))
+    # zero beyond each sample's ceil(h_i/s) x ceil(w_i/s) output region
+    orow = -(-rl // sh)
+    ocol = -(-cl_ // sw)
+    ro = jnp.arange(out_h)[None, :, None]
+    co = jnp.arange(out_w)[None, None, :]
+    ovalid = (ro < orow[:, None, None]) & (co < ocol[:, None, None])
+    out = acc * ovalid[:, None, :, :].astype(acc.dtype)
+    out_t = Tensor(out)
+    return getattr(F, act)(out_t) if act else out_t

@@ -61,7 +61,7 @@ class TestContribLayers:
 
     def test_ps_serving_stubs_raise_with_scope(self):
         with pytest.raises(NotImplementedError, match="PS"):
-            cl.var_conv_2d()
+            cl._pull_box_extended_sparse()
         with pytest.raises(NotImplementedError, match="COVERAGE"):
             cl.search_pyramid_hash()
 
@@ -405,3 +405,64 @@ class TestCtrOps:
         bad_guide = paddle.to_tensor(np.zeros((4, 5), np.float32))
         with pytest.raises(ValueError, match="guide must be"):
             cl.bilateral_slice(x, bad_guide, grid)
+
+    def test_var_conv_2d_vs_reference_oracle(self):
+        """Per-sample oracle transliterated from the reference
+        test_var_conv_2d.py Im2Col+gemm (centered windows, zeros beyond
+        the sample's own bounds, out = ceil(dim/stride))."""
+
+        def sample_oracle(img, w, kh, kw, sh, sw):
+            C, h, wd = img.shape
+            out_ch = w.shape[0]
+            oh = (h - 1) // sh + 1
+            ow = (wd - 1) // sw + 1
+            w4 = w.reshape(out_ch, C, kh, kw)
+            out = np.zeros((out_ch, oh, ow), np.float32)
+            for oc in range(out_ch):
+                for y in range(0, h, sh):
+                    for xx_ in range(0, wd, sw):
+                        acc = 0.0
+                        for c in range(C):
+                            for ky in range(kh):
+                                for kx in range(kw):
+                                    iy = y + ky - kh // 2
+                                    ix = xx_ + kx - kw // 2
+                                    if 0 <= iy < h and 0 <= ix < wd:
+                                        acc += w4[oc, c, ky, kx] * \
+                                            img[c, iy, ix]
+                        out[oc, y // sh, xx_ // sw] = acc
+            return out
+
+        rs = np.random.RandomState(6)
+        C, out_ch = 3, 2
+        for kh, kw, sh, sw in ((2, 3, 1, 1), (3, 3, 2, 2), (1, 1, 1, 2)):
+            rows = np.array([2, 4, 3])
+            cols = np.array([3, 2, 4])
+            Hm, Wm = rows.max(), cols.max()
+            x = np.zeros((3, C, Hm, Wm), np.float32)
+            samples = []
+            for b in range(3):
+                img = rs.rand(C, rows[b], cols[b]).astype(np.float32)
+                samples.append(img)
+                x[b, :, :rows[b], :cols[b]] = img
+            w = rs.rand(out_ch, C * kh * kw).astype(np.float32)
+            out = cl.var_conv_2d(
+                paddle.to_tensor(x), paddle.to_tensor(rows),
+                paddle.to_tensor(cols), C, out_ch, (kh, kw), (sh, sw),
+                w_param=paddle.to_tensor(w)).numpy()
+            for b in range(3):
+                ref = sample_oracle(samples[b], w, kh, kw, sh, sw)
+                oh, ow = ref.shape[1:]
+                np.testing.assert_allclose(
+                    out[b, :, :oh, :ow], ref, rtol=1e-5, atol=1e-5,
+                    err_msg=f"k=({kh},{kw}) s=({sh},{sw}) b={b}")
+                # beyond the sample's output region: zero
+                assert np.abs(out[b, :, oh:, :]).max(initial=0) == 0
+                assert np.abs(out[b, :, :, ow:]).max(initial=0) == 0
+
+    def test_var_conv_2d_lengths_batch_checked(self):
+        x = paddle.to_tensor(np.zeros((3, 1, 4, 4), np.float32))
+        with pytest.raises(ValueError, match="one entry"):
+            cl.var_conv_2d(x, paddle.to_tensor(np.array([2])),
+                           paddle.to_tensor(np.array([2, 2, 2])), 1, 2,
+                           2)
