@@ -9,10 +9,8 @@ framework (sequence_topk_avg_pooling, tree_conv, sparse_embedding).
 Real implementations include the CTR matching/tree ops
 (match_matrix_tensor, tdm_child, tdm_sampler, rank_attention,
 correlation, bilateral_slice — checked against the reference
-unittests' numpy oracles / validation rules).  The remaining serving
-tail (search_pyramid_hash, _pull_box_extended_sparse) is tied to the
-reference's parameter-server hashing stack and raises with a scope
-note rather than silently degrading.
+unittests' numpy oracles / validation rules).  Only _pull_box_extended_sparse
+(BoxPS hardware-coupled embedding pull) remains a raising stub.
 """
 from __future__ import annotations
 
@@ -28,7 +26,7 @@ __all__ = [
     "partial_concat", "partial_sum", "batch_fc",
     "match_matrix_tensor", "tdm_child", "tdm_sampler",
     "rank_attention", "correlation", "bilateral_slice",
-    "var_conv_2d",
+    "var_conv_2d", "search_pyramid_hash",
     "sequence_topk_avg_pooling", "tree_conv", "sparse_embedding",
     "multiclass_nms2",
 ]
@@ -195,7 +193,7 @@ def _ps_serving_stub(name):
     return fn
 
 
-for _n in ("search_pyramid_hash", "_pull_box_extended_sparse"):
+for _n in ("_pull_box_extended_sparse",):
     globals()[_n] = _ps_serving_stub(_n)
 
 
@@ -451,7 +449,7 @@ def tdm_sampler(x, neg_samples_num_list, layer_node_num_list,
                 f"only {layer_node_num_list[i]} nodes (sampling is "
                 "without replacement, excluding the positive)")
 
-    rs = np.random.RandomState(seed or None)
+    rs = np.random.RandomState(seed)  # seed=0 IS a seed
     np_dtype = np.int64 if str(dtype) == "int64" else np.int32
     outs, labels, masks = [], [], []
     for i in range(n_layers):
@@ -625,3 +623,100 @@ def var_conv_2d(x, row_lengths, col_lengths, input_channel,
     out = acc * ovalid[:, None, :, :].astype(acc.dtype)
     out_t = Tensor(out)
     return getattr(F, act)(out_t) if act else out_t
+
+
+def search_pyramid_hash(input, num_emb, space_len, pyramid_layer, rand_len,
+                        drop_out_percent, is_training, use_filter,
+                        white_list_len, black_list_len, seed, lr,
+                        param_attr=None, param_attr_wl=None,
+                        param_attr_bl=None, name=None,
+                        distribute_update_vars=None, lengths=None,
+                        weights=None):
+    """reference contrib/layers/nn.py search_pyramid_hash
+    (pyramid_hash_op.cc): hash-embedding of every 2..pyramid_layer-gram.
+
+    Exact kernel semantics: ids are converted to float32 and each
+    n-gram's RAW BYTES are XXH32-hashed once per rand_len-chunk
+    (chunk at offset j uses hash seed j, modulo space_len) to index a
+    contiguous slice of the weight table; every surviving n-gram emits
+    one embedding row.  Host-side numpy+xxhash by design — this is a
+    data-prep op like tdm_sampler (the reference kernel is CPU-only).
+
+    Dense+lengths convention: ``input`` [B, L] int32 with optional
+    ``lengths`` [B]; returns (emb [B, M, num_emb] zero-padded,
+    kept_counts [B]) — a sequence with no surviving n-gram contributes
+    one ZERO row, exactly like the reference's LoD output.  Training
+    dropout keeps each n-gram with prob 1-drop_out_percent (numpy RNG;
+    the reference uses rand_r, so the MASK differs while eval output is
+    bit-exact).  ``use_filter=True`` (bloom white/black lists stored as
+    binary blobs) is out of scope and raises."""
+    import numpy as np
+    import xxhash
+    from ...core.tensor import Tensor
+    from ...static.nn import _make_param
+    from ...nn import initializer as I
+
+    if use_filter:
+        raise NotImplementedError(
+            "search_pyramid_hash(use_filter=True): bloom-filter white/"
+            "black lists are binary blobs of the reference's PS stack; "
+            "filterless hashing is supported")
+    if num_emb % rand_len:
+        raise ValueError(
+            f"search_pyramid_hash: num_emb ({num_emb}) must be a "
+            f"multiple of rand_len ({rand_len}) — the kernel copies "
+            "rand_len-sized chunks")
+    x = ensure_tensor(input)
+    ids = np.asarray(x.numpy()).astype(np.int32)
+    if ids.ndim == 1:
+        ids = ids[None, :]
+    B, L = ids.shape
+    lens = (np.asarray(ensure_tensor(lengths).numpy()).reshape(-1)
+            if lengths is not None else np.full(B, L))
+    if lens.size and (lens.min() < 0 or lens.max() > L):
+        raise ValueError(
+            f"search_pyramid_hash: lengths must be in [0, {L}] "
+            f"(the padded width), got range [{lens.min()}, "
+            f"{lens.max()}]")
+    if weights is not None:
+        w_np = np.asarray(ensure_tensor(weights).numpy())
+    else:
+        w = _make_param([space_len + rand_len, 1], "float32", param_attr,
+                        I.XavierUniform(), "pyramid_hash_w")
+        w_np = np.asarray(w.numpy())
+    w_flat = w_np.reshape(-1).astype(np.float32)
+    if len(w_flat) < space_len + rand_len:
+        raise ValueError(
+            f"search_pyramid_hash: weight table needs space_len + "
+            f"rand_len = {space_len + rand_len} entries, got "
+            f"{len(w_flat)} (chunks are read CONTIGUOUSLY from the "
+            "hashed position)")
+
+    rs = np.random.RandomState(seed)  # seed=0 IS a seed
+    per_seq = []
+    for b in range(B):
+        w_len = int(lens[b])
+        fids = ids[b, :w_len].astype(np.float32)
+        rows = []
+        if w_len >= 2:
+            for ilayer in range(1, min(pyramid_layer, w_len)):
+                for l in range(w_len - ilayer):
+                    if is_training and \
+                            rs.rand() < drop_out_percent:
+                        continue
+                    gram = fids[l:l + ilayer + 1].tobytes()
+                    emb = np.empty(num_emb, np.float32)
+                    for j in range(0, num_emb, rand_len):
+                        pos = xxhash.xxh32(gram, seed=j).intdigest() \
+                            % space_len
+                        emb[j:j + rand_len] = w_flat[pos:pos + rand_len]
+                    rows.append(emb)
+        if not rows:
+            rows = [np.zeros(num_emb, np.float32)]
+        per_seq.append(np.stack(rows))
+    counts = np.array([len(r) for r in per_seq], np.int64)
+    M = counts.max() if counts.size else 0
+    out = np.zeros((B, int(M), num_emb), np.float32)
+    for b, r in enumerate(per_seq):
+        out[b, :len(r)] = r
+    return Tensor(out), Tensor(counts)

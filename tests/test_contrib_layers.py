@@ -60,10 +60,10 @@ class TestContribLayers:
         assert (out.numpy() >= 0).all()
 
     def test_ps_serving_stubs_raise_with_scope(self):
-        with pytest.raises(NotImplementedError, match="PS"):
+        # the one remaining stub: scope note names both PS and COVERAGE
+        with pytest.raises(NotImplementedError,
+                           match="(?s)PS.*COVERAGE"):
             cl._pull_box_extended_sparse()
-        with pytest.raises(NotImplementedError, match="COVERAGE"):
-            cl.search_pyramid_hash()
 
     def test_reexports_callable(self):
         # smoke the delegations that have implementations elsewhere
@@ -466,3 +466,71 @@ class TestCtrOps:
             cl.var_conv_2d(x, paddle.to_tensor(np.array([2])),
                            paddle.to_tensor(np.array([2, 2, 2])), 1, 2,
                            2)
+
+    def test_search_pyramid_hash_exact_kernel_semantics(self):
+        """Eval-mode output is bit-exact vs a manual transliteration of
+        hash_embedding_ff (XXH32 over float32 n-gram bytes, chunk j
+        seeded with j, contiguous rand_len slices)."""
+        import xxhash
+        rs = np.random.RandomState(7)
+        space_len, rand_len, num_emb, pyr = 64, 4, 12, 3
+        wtab = rs.rand(space_len + rand_len).astype(np.float32)
+        ids = np.array([[5, 9, 2, 7], [1, 3, 0, 0]], np.int32)
+        lens = np.array([4, 2])
+        emb, counts = cl.search_pyramid_hash(
+            paddle.to_tensor(ids), num_emb, space_len, pyr, rand_len,
+            0.5, is_training=0, use_filter=False, white_list_len=0,
+            black_list_len=0, seed=1, lr=0.1,
+            lengths=paddle.to_tensor(lens),
+            weights=paddle.to_tensor(wtab))
+        # seq 0: bigrams (3) + trigrams (2) = 5; seq 1: 1 bigram
+        np.testing.assert_array_equal(counts.numpy(), [5, 1])
+
+        def manual(gram_ids):
+            g = np.asarray(gram_ids, np.float32).tobytes()
+            e = np.empty(num_emb, np.float32)
+            for j in range(0, num_emb, rand_len):
+                pos = xxhash.xxh32(g, seed=j).intdigest() % space_len
+                e[j:j + rand_len] = wtab[pos:pos + rand_len]
+            return e
+
+        e = emb.numpy()
+        np.testing.assert_array_equal(e[0, 0], manual([5, 9]))
+        np.testing.assert_array_equal(e[0, 2], manual([2, 7]))
+        np.testing.assert_array_equal(e[0, 3], manual([5, 9, 2]))
+        np.testing.assert_array_equal(e[1, 0], manual([1, 3]))
+        assert (e[1, 1:] == 0).all()  # padding rows zero
+
+    def test_search_pyramid_hash_edges(self):
+        wtab = paddle.to_tensor(np.zeros(20, np.float32))
+        one = paddle.to_tensor(np.array([[3]], np.int32))
+        emb, counts = cl.search_pyramid_hash(
+            one, 8, 16, 3, 4, 0.0, 0, False, 0, 0, 0, 0.1,
+            weights=wtab)
+        # w < 2: one zero row, like the reference
+        np.testing.assert_array_equal(counts.numpy(), [1])
+        assert (emb.numpy() == 0).all()
+        with pytest.raises(NotImplementedError, match="bloom"):
+            cl.search_pyramid_hash(one, 8, 16, 3, 4, 0.0, 0, True,
+                                   10, 0, 0, 0.1, weights=wtab)
+        with pytest.raises(ValueError, match="multiple of rand_len"):
+            cl.search_pyramid_hash(one, 10, 16, 3, 4, 0.0, 0, False,
+                                   0, 0, 0, 0.1, weights=wtab)
+        with pytest.raises(ValueError, match="lengths must be"):
+            cl.search_pyramid_hash(
+                one, 8, 16, 3, 4, 0.0, 0, False, 0, 0, 0, 0.1,
+                lengths=paddle.to_tensor(np.array([5])), weights=wtab)
+        # empty batch returns empty tensors, not a crash
+        emb0, c0 = cl.search_pyramid_hash(
+            paddle.to_tensor(np.zeros((0, 3), np.int32)), 8, 16, 3, 4,
+            0.0, 0, False, 0, 0, 0, 0.1, weights=wtab)
+        assert list(emb0.shape) == [0, 0, 8] and list(c0.shape) == [0]
+        # training dropout with seed=0 is deterministic
+        ids2 = paddle.to_tensor(
+            np.arange(8, dtype=np.int32).reshape(1, 8))
+        wt2 = paddle.to_tensor(np.arange(20, dtype=np.float32))
+        a1 = cl.search_pyramid_hash(ids2, 8, 16, 3, 4, 0.5, 1, False,
+                                    0, 0, 0, 0.1, weights=wt2)
+        a2 = cl.search_pyramid_hash(ids2, 8, 16, 3, 4, 0.5, 1, False,
+                                    0, 0, 0, 0.1, weights=wt2)
+        np.testing.assert_array_equal(a1[0].numpy(), a2[0].numpy())
