@@ -36,12 +36,27 @@ def _reshape(x, shape=None):
     return jnp.reshape(x, shape)
 
 
+def _dim(s):
+    """One reshape dim: Tensor -> concrete int; plain numbers -> int;
+    anything else (jax shape-poly symbolic dims under `jax.export` with
+    dynamic batch) passes through for jnp to consume — forcing int()
+    would break dynamic-dim export of the common
+    ``x.reshape([x.shape[0], -1])`` pattern."""
+    if isinstance(s, Tensor):
+        return s.item()
+    try:
+        return int(s)
+    except Exception:
+        # symbolic dims raise InconclusiveDimensionOperation from
+        # __int__; jnp.reshape validates whatever passes through
+        return s
+
+
 def reshape(x, shape, name=None):
     x = ensure_tensor(x)
     if isinstance(shape, Tensor):
         shape = shape.tolist()
-    shape = tuple(int(s) if not isinstance(s, Tensor) else s.item()
-                  for s in shape)
+    shape = tuple(_dim(s) for s in shape)
     return _reshape(x, shape=shape)
 
 

@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
-from paddle_tpu import static, nn, inference
+from paddle_tpu import static, nn, inference, jit
 
 
 @pytest.fixture()
@@ -114,3 +114,38 @@ class TestDynamicBatchExport:
         np.testing.assert_allclose(
             out, net(paddle_tpu.to_tensor(x)).numpy(),
             rtol=1e-5, atol=1e-5)
+
+
+def test_dynamic_batch_export_with_flatten_reshape(tmp_path):
+    """The x.reshape([x.shape[0], -1]) pattern (every CNN classifier)
+    must export with a symbolic batch dim — reshape passes jax
+    shape-poly dims through instead of forcing int()."""
+    from paddle_tpu.static import InputSpec
+    paddle.seed(0)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2D(1, 4, 3, padding=1)
+            self.fc = nn.Linear(4 * 8 * 8, 10)
+
+        def forward(self, x):
+            h = nn.functional.relu(self.conv(x))
+            return self.fc(h.reshape([x.shape[0], -1]))
+
+    net = Net()
+    net.eval()
+    path = str(tmp_path / "dyn")
+    jit.save(net, path, input_spec=[InputSpec([None, 1, 8, 8],
+                                              "float32")])
+    loaded = jit.load(path)
+    for b in (1, 3, 7):
+        x = paddle.to_tensor(
+            np.random.RandomState(b).rand(b, 1, 8, 8)
+            .astype(np.float32))
+        out = loaded(x)
+        assert list(out.shape) == [b, 10]
+        # value parity vs the eager net catches scrambled flattening,
+        # not just a lucky shape
+        np.testing.assert_allclose(out.numpy(), net(x).numpy(),
+                                   rtol=1e-5, atol=1e-6)
