@@ -79,9 +79,11 @@ class RaggedTensor:
             d.reshape((B * L,) + d.shape[2:]))
         return cls(Tensor(flat[:cap]), Tensor(splits))
 
-    @classmethod
-    def from_rows(cls, rows, capacity=None):
-        """list of per-row numpy/array values -> ragged (host-side)."""
+    @staticmethod
+    def pack_rows_numpy(rows, capacity=None):
+        """Pure-numpy packing -> (flat [cap, ...], row_splits [B+1]).
+        DataLoader collate fns use THIS (workers must never touch jax —
+        io/worker.py's fork-safety contract)."""
         rows = [np.asarray(r) for r in rows]
         lens = np.array([len(r) for r in rows], np.int32)
         total = int(lens.sum())
@@ -97,6 +99,12 @@ class RaggedTensor:
             flat[off:off + len(r)] = r
             off += len(r)
         splits = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+        return flat, splits
+
+    @classmethod
+    def from_rows(cls, rows, capacity=None):
+        """list of per-row numpy/array values -> ragged (host-side)."""
+        flat, splits = cls.pack_rows_numpy(rows, capacity)
         return cls(Tensor(flat), Tensor(splits))
 
     # -- views ------------------------------------------------------------
@@ -119,11 +127,19 @@ class RaggedTensor:
                          self.nrows)
 
     def to_padded(self, max_len, pad_value=0.0):
-        """ragged -> ([B, max_len, ...], lengths)."""
+        """ragged -> ([B, max_len, ...], lengths).  Raises (concrete
+        path) when a row exceeds ``max_len`` — silent truncation with
+        un-clamped lengths would poison every dense+lengths consumer."""
         v = self.values._data
         s = self.row_splits._data
         B = self.nrows
         lens = s[1:] - s[:-1]
+        if not isinstance(lens, jax.core.Tracer) and B:
+            longest = int(jnp.max(lens))
+            if longest > max_len:
+                raise ValueError(
+                    f"to_padded: a row has {longest} tokens > max_len "
+                    f"{max_len} — raise max_len or slice rows upstream")
         pos = s[:-1][:, None] + jnp.arange(max_len)[None, :]
         valid = jnp.arange(max_len)[None, :] < lens[:, None]
         gathered = v[jnp.clip(pos, 0, self.capacity - 1)]

@@ -79,7 +79,37 @@ def pad_to_bucket(arrays, buckets=DEFAULT_BUCKETS, axis=0, pad_value=0,
     return batch, lengths
 
 
-class BucketedBatchSampler(BatchSampler):
+
+class _LengthAwareSampler(BatchSampler):
+    """Shared plumbing for length-aware batch samplers: default
+    length_fn, per-index memoization (the default materializes samples
+    — uncached, every epoch and every len() would re-decode the dataset
+    in the MAIN process, serializing ahead of the workers), and
+    shuffled/sequential index order."""
+
+    def _init_lengths(self, dataset, length_fn, shuffle):
+        self.dataset = dataset
+        self.shuffle = shuffle
+        if length_fn is None:
+            def length_fn(i):
+                sample = dataset[i]
+                first = sample[0] if isinstance(sample, (tuple, list)) \
+                    else sample
+                return len(first)
+        raw = length_fn
+        self._length_memo = {}
+
+        def cached(i):
+            if i not in self._length_memo:
+                self._length_memo[i] = raw(i)
+            return self._length_memo[i]
+
+        self.length_fn = cached
+        self.sampler = (RandomSampler(dataset) if shuffle
+                        else SequenceSampler(dataset))
+
+
+class BucketedBatchSampler(_LengthAwareSampler):
     """Batch sampler that never mixes buckets inside a batch.
 
     ``length_fn(i)`` maps a dataset index to its sequence length (default:
@@ -90,32 +120,10 @@ class BucketedBatchSampler(BatchSampler):
 
     def __init__(self, dataset, batch_size=1, buckets=DEFAULT_BUCKETS,
                  length_fn=None, shuffle=False, drop_last=False):
-        self.dataset = dataset
         self.batch_size = batch_size
         self.buckets = tuple(buckets)
         self.drop_last = drop_last
-        self.shuffle = shuffle
-        if length_fn is None:
-            def length_fn(i):
-                sample = dataset[i]
-                first = sample[0] if isinstance(sample, (tuple, list)) \
-                    else sample
-                return len(first)
-        # memoize per index: lengths are static for a map dataset, and
-        # the default length_fn materializes the sample — without the
-        # cache every epoch (and every len()) re-decodes the dataset in
-        # the MAIN process, serializing ahead of the workers
-        raw_length_fn = length_fn
-        self._length_memo = {}
-
-        def cached_length_fn(i):
-            if i not in self._length_memo:
-                self._length_memo[i] = raw_length_fn(i)
-            return self._length_memo[i]
-
-        self.length_fn = cached_length_fn
-        self.sampler = (RandomSampler(dataset) if shuffle
-                        else SequenceSampler(dataset))
+        self._init_lengths(dataset, length_fn, shuffle)
         self._len_cache = None
 
     def __iter__(self):
@@ -170,5 +178,107 @@ def bucketed_collate(buckets=DEFAULT_BUCKETS, pad_value=0,
             else:
                 out.append(np.stack(col))
         return tuple(out) + tuple(lens)
+
+    return collate
+
+
+class TokenBudgetBatchSampler(_LengthAwareSampler):
+    """Pack sequences into batches by TOKEN budget, not sample count
+    (the LLM data path for `core/ragged.py` RaggedTensor: compute is
+    proportional to total tokens, so a fixed token capacity gives
+    near-zero waste at ANY length skew — strictly better than bucketed
+    padding's ~17% at the BASELINE round-3 distribution).
+
+    Packing is pooled first-fit: up to ``num_open`` batches stay open
+    and each sample lands in the first one with room, so a long
+    document no longer force-closes a half-empty batch (measured on
+    the BASELINE round-3 skew: ~2% waste vs 8% for the one-open greedy
+    packer and 17% for bucketed padding).  A sample longer than the
+    budget raises (truncate upstream, like bucket_for's contract).
+    Batches also cap at ``max_batch_size`` rows so row-indexed state
+    (labels, row_splits) stays bounded."""
+
+    def __init__(self, dataset, token_budget, length_fn=None,
+                 max_batch_size=None, shuffle=False, drop_last=False,
+                 num_open=8):
+        self.token_budget = int(token_budget)
+        self.max_batch_size = max_batch_size
+        self.drop_last = drop_last
+        self.num_open = max(1, int(num_open))
+        self._init_lengths(dataset, length_fn, shuffle)
+        self._pending = None
+
+    def _batches(self):
+        open_batches = []  # [indices, used_tokens]
+        for idx in self.sampler:
+            n = self.length_fn(idx)
+            if n > self.token_budget:
+                raise ValueError(
+                    f"TokenBudgetBatchSampler: sample {idx} has {n} "
+                    f"tokens > budget {self.token_budget}; truncate "
+                    "upstream or raise the budget")
+            placed = False
+            for entry in open_batches:
+                if entry[1] + n <= self.token_budget and not (
+                        self.max_batch_size
+                        and len(entry[0]) >= self.max_batch_size):
+                    entry[0].append(idx)
+                    entry[1] += n
+                    placed = True
+                    break
+            if not placed:
+                if len(open_batches) >= self.num_open:
+                    # emit the fullest bin to make room
+                    k = max(range(len(open_batches)),
+                            key=lambda i: open_batches[i][1])
+                    yield open_batches.pop(k)[0]
+                open_batches.append([[idx], n])
+        # end-of-epoch flush: pooled packing keeps up to num_open bins
+        # open; dropping them ALL under drop_last would lose a biased
+        # slice (bins stay open precisely when nearly full), so
+        # drop_last only discards bins under half the budget
+        for entry in sorted(open_batches, key=lambda e: -e[1]):
+            if not self.drop_last or \
+                    entry[1] * 2 >= self.token_budget:
+                yield entry[0]
+
+    def _materialize(self):
+        return list(self._batches())
+
+    def __iter__(self):
+        # packing is ORDER-dependent, so len() and the next iteration
+        # must see the SAME permutation: whoever runs first materializes
+        # the epoch's batches; __iter__ consumes them (and the next
+        # epoch reshuffles)
+        batches = self._pending or self._materialize()
+        self._pending = None
+        return iter(batches)
+
+    def __len__(self):
+        if self._pending is None:
+            self._pending = self._materialize()
+        return len(self._pending)
+
+
+def ragged_collate(capacity, value_field=0, extra_fields=()):
+    """collate_fn factory producing (ragged values [capacity, ...],
+    row_splits [B+1], *extras-stacked) per batch — the RaggedTensor
+    feed for a TokenBudgetBatchSampler.  ``capacity`` must cover the
+    sampler's token budget (equal is the zero-waste setting)."""
+    import numpy as np
+
+    def collate(samples):
+        # PURE numpy: collate runs inside DataLoader workers, which by
+        # the io/worker.py fork-safety contract never touch jax
+        from ..core.ragged import RaggedTensor
+        rows, extras = [], [[] for _ in extra_fields]
+        for s in samples:
+            tup = s if isinstance(s, (tuple, list)) else (s,)
+            rows.append(np.asarray(tup[value_field]))
+            for k, f in enumerate(extra_fields):
+                extras[k].append(np.asarray(tup[f]))
+        flat, splits = RaggedTensor.pack_rows_numpy(rows,
+                                                    capacity=capacity)
+        return (flat, splits) + tuple(np.stack(e) for e in extras)
 
     return collate

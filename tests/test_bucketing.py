@@ -146,3 +146,121 @@ class TestRaggedSkewStress:
         # every sample appears exactly once
         seen = sorted(i for b in batches for i in b)
         assert seen == list(range(len(docs)))
+
+
+class TestTokenBudgetBatching:
+    def _ds(self, lens):
+        class DS:
+            def __getitem__(self, i):
+                return (np.zeros(lens[i], np.int64),
+                        np.int64(i % 3))
+
+            def __len__(self):
+                return len(lens)
+        return DS()
+
+    def test_packs_to_budget(self):
+        from paddle_tpu.io.bucketing import TokenBudgetBatchSampler
+        lens = [5, 9, 3, 8, 2, 2, 7]
+        s = TokenBudgetBatchSampler(self._ds(lens), token_budget=12)
+        batches = list(s)
+        seen = sorted(i for b in batches for i in b)
+        assert seen == list(range(7))
+        for b in batches:
+            assert sum(lens[i] for i in b) <= 12
+        assert len(s) == len(batches)
+
+    def test_oversized_sample_raises(self):
+        from paddle_tpu.io.bucketing import TokenBudgetBatchSampler
+        s = TokenBudgetBatchSampler(self._ds([4, 20]), token_budget=12)
+        with pytest.raises(ValueError, match="truncate"):
+            list(s)
+
+    def test_max_batch_size_caps_rows(self):
+        from paddle_tpu.io.bucketing import TokenBudgetBatchSampler
+        s = TokenBudgetBatchSampler(self._ds([1] * 10), token_budget=100,
+                                    max_batch_size=4)
+        for b in s:
+            assert len(b) <= 4
+
+    def test_ragged_collate_end_to_end(self):
+        from paddle_tpu import io
+        from paddle_tpu.io.bucketing import (TokenBudgetBatchSampler,
+                                             ragged_collate)
+        from paddle_tpu.core.ragged import RaggedTensor, sequence_pool
+        lens = [5, 9, 3, 8, 2, 2, 7]
+        ds = self._ds(lens)
+        sampler = TokenBudgetBatchSampler(ds, token_budget=12)
+        loader = io.DataLoader(ds, batch_sampler=sampler,
+                               collate_fn=ragged_collate(
+                                   capacity=12, extra_fields=(1,)),
+                               num_workers=0)
+        total = 0
+        for values, splits, labels in loader:
+            rt = RaggedTensor(values, splits)
+            pooled = sequence_pool(rt, "sum")
+            assert pooled.shape[0] == len(labels)
+            assert values.shape[0] == 12  # fixed capacity: ONE compile
+            total += int(np.asarray(splits.numpy())[-1])
+        assert total == sum(lens)
+
+    def test_zero_waste_vs_bucketed_padding(self):
+        """At the BASELINE round-3 skew, token budgeting wastes only the
+        final-batch remainder — far below padded bucketing's 17%."""
+        import sys, os
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools", "exp"))
+        from _exp_ragged import make_corpus
+        from paddle_tpu.io.bucketing import TokenBudgetBatchSampler
+        (docs, lengths) = make_corpus(1024)
+
+        class DS:
+            def __getitem__(self, i):
+                return docs[i]
+
+            def __len__(self):
+                return len(docs)
+
+        budget = 4096
+        s = TokenBudgetBatchSampler(
+            DS(), token_budget=budget,
+            length_fn=lambda i: int(lengths[i]), shuffle=True)
+        batches = list(s)
+        used = [sum(int(lengths[i]) for i in b) for b in batches]
+        waste = 1 - sum(used) / (len(batches) * budget)
+        assert waste < 0.02, waste  # vs 0.171 for the x1.5 ladder
+
+    def test_len_matches_next_iteration_under_shuffle(self):
+        from paddle_tpu.io.bucketing import TokenBudgetBatchSampler
+        lens = list(np.random.RandomState(0).randint(1, 10, 40))
+        s = TokenBudgetBatchSampler(self._ds(lens), token_budget=16,
+                                    shuffle=True)
+        for _ in range(3):
+            n = len(s)
+            assert n == sum(1 for _ in s)  # same permutation as len()
+
+    def test_drop_last_keeps_fullish_bins(self):
+        from paddle_tpu.io.bucketing import TokenBudgetBatchSampler
+        # one nearly-full bin (9/10) + one sparse bin (2/10)
+        lens = [9, 2]
+        s = TokenBudgetBatchSampler(self._ds(lens), token_budget=10,
+                                    drop_last=True)
+        batches = list(s)
+        kept = [i for b in batches for i in b]
+        assert 0 in kept and 1 not in kept
+
+    def test_collate_is_pure_numpy(self):
+        """Workers never touch jax: the collate output must be numpy."""
+        from paddle_tpu.io.bucketing import ragged_collate
+        c = ragged_collate(capacity=12, extra_fields=(1,))
+        out = c([(np.zeros(3, np.int64), np.int64(1)),
+                 (np.zeros(5, np.int64), np.int64(0))])
+        for o in out:
+            assert type(o).__module__ == "numpy", type(o)
+
+    def test_to_padded_overflow_raises(self):
+        from paddle_tpu.core.ragged import RaggedTensor
+        rt = RaggedTensor.from_rows(
+            [np.zeros((9, 1), np.float32)])
+        with pytest.raises(ValueError, match="max_len"):
+            rt.to_padded(max_len=7)
