@@ -252,19 +252,37 @@ class TokenBudgetBatchSampler(_LengthAwareSampler):
         # epoch reshuffles)
         batches = self._pending or self._materialize()
         self._pending = None
+        self._current_len = len(batches)
         return iter(batches)
 
     def __len__(self):
-        if self._pending is None:
-            self._pending = self._materialize()
+        """Batch count of the pending epoch if len() runs first, else
+        of the RUNNING/last epoch — never a permutation the iterator
+        will not see (shuffled counts vary by ±a few batches across
+        epochs; progress consumers get the live epoch's number)."""
+        if self._pending is not None:
+            return len(self._pending)
+        if getattr(self, "_current_len", None) is not None:
+            return self._current_len
+        self._pending = self._materialize()
         return len(self._pending)
 
 
-def ragged_collate(capacity, value_field=0, extra_fields=()):
+def ragged_collate(capacity, value_field=0, extra_fields=(),
+                   max_rows=None):
     """collate_fn factory producing (ragged values [capacity, ...],
-    row_splits [B+1], *extras-stacked) per batch — the RaggedTensor
-    feed for a TokenBudgetBatchSampler.  ``capacity`` must cover the
-    sampler's token budget (equal is the zero-waste setting)."""
+    row_splits, *extras-stacked) per batch — the RaggedTensor feed for
+    a TokenBudgetBatchSampler.  ``capacity`` must cover the sampler's
+    token budget (equal is the zero-waste setting).
+
+    ``max_rows`` (recommended: the sampler's max_batch_size) FIXES the
+    row dimension too: row_splits pads to [max_rows+1] by repeating the
+    total (trailing zero-length rows, which the trash-segment design
+    already tolerates) and extras zero-pad to [max_rows] — without it,
+    each distinct packed row count is a new shape and the jitted step
+    recompiles per batch, the exact storm the fixed-capacity values
+    side exists to prevent.  Mask padded rows downstream via
+    ``RaggedTensor.lengths() == 0``."""
     import numpy as np
 
     def collate(samples):
@@ -279,6 +297,19 @@ def ragged_collate(capacity, value_field=0, extra_fields=()):
                 extras[k].append(np.asarray(tup[f]))
         flat, splits = RaggedTensor.pack_rows_numpy(rows,
                                                     capacity=capacity)
-        return (flat, splits) + tuple(np.stack(e) for e in extras)
+        outs = [np.stack(e) for e in extras]
+        if max_rows is not None:
+            b = len(rows)
+            if b > max_rows:
+                raise ValueError(
+                    f"ragged_collate: batch has {b} rows > max_rows "
+                    f"{max_rows} (set the sampler's max_batch_size)")
+            splits = np.concatenate(
+                [splits, np.full(max_rows - b, splits[-1],
+                                 splits.dtype)])
+            outs = [np.concatenate(
+                [e, np.zeros((max_rows - b,) + e.shape[1:], e.dtype)])
+                for e in outs]
+        return (flat, splits) + tuple(outs)
 
     return collate

@@ -56,10 +56,14 @@ class GPTEmbeddings(nn.Layer):
             weight_attr=nn.ParamAttr(initializer=I.Normal(0.0, 0.02)))
         self.dropout = nn.Dropout(dropout)
 
-    def forward(self, input_ids, position_offset=0):
+    def forward(self, input_ids, position_offset=0, position_ids=None):
         import jax.numpy as jnp
-        seq = input_ids.shape[-1]
-        pos = Tensor(jnp.arange(seq, dtype=jnp.int32) + position_offset)
+        if position_ids is None:
+            seq = input_ids.shape[-1]
+            pos = Tensor(jnp.arange(seq, dtype=jnp.int32)
+                         + position_offset)
+        else:
+            pos = position_ids  # packed sequences: per-doc reset
         emb = self.word_embeddings(input_ids) + \
             self.position_embeddings(pos)
         return self.dropout(emb)
@@ -165,8 +169,13 @@ class GPTAttention(nn.Layer):
             out = self.out_proj(out)
         return out, k_buf, v_buf
 
-    def forward(self, x, cache=None):
+    def forward(self, x, cache=None, doc_segments=None):
         b, s, _ = x.shape
+        if doc_segments is not None and self.use_sp and cache is None:
+            raise NotImplementedError(
+                "packed-sequence attention is not supported under "
+                "sequence parallelism (the ring/all-to-all kernels "
+                "build their own causal masks)")
         if self.use_mp:
             q, k, v = self._qkv_mp(x)
         else:
@@ -205,8 +214,8 @@ class GPTAttention(nn.Layer):
                                      dropout_p=dp, rng_key=rk)
         else:
             out = F.scaled_dot_product_attention(
-                q, k, v, is_causal=True, dropout_p=self.dropout,
-                training=self.training)
+                q, k, v, segment_ids=doc_segments, is_causal=True,
+                dropout_p=self.dropout, training=self.training)
         if self.use_mp:
             from ..ops import einsum
             # contraction over (H, hd): XLA turns the 'mp'-sharded H
@@ -265,8 +274,8 @@ class GPTBlock(nn.Layer):
         self.use_recompute = use_recompute
         self.recompute_policy = recompute_policy
 
-    def _inner(self, x):
-        x = x + self.attn(self.ln1(x))
+    def _inner(self, x, doc_segments=None):
+        x = x + self.attn(self.ln1(x), doc_segments=doc_segments)
         x = x + self.mlp(self.ln2(x))
         return x
 
@@ -278,7 +287,7 @@ class GPTBlock(nn.Layer):
         x = x + self.mlp(self.ln2(x))
         return x, k_buf, v_buf
 
-    def forward(self, x, cache=None):
+    def forward(self, x, cache=None, doc_segments=None):
         if cache is not None:
             attn_out, cache = self.attn(self.ln1(x), cache=cache)
             x = x + attn_out
@@ -287,9 +296,9 @@ class GPTBlock(nn.Layer):
         if self.use_recompute:
             from ..distributed.fleet.utils import recompute
             # bound method → recompute collects params from `self`
-            return recompute(self._inner, x,
+            return recompute(self._inner, x, doc_segments,
                              policy=self.recompute_policy)
-        return self._inner(x)
+        return self._inner(x, doc_segments)
 
 
 class GPTLMHead(nn.Layer):
@@ -340,8 +349,28 @@ class GPTModel(nn.Layer):
         self.head = GPTLMHead(hidden_size, vocab_size, use_mp)
 
     def forward(self, input_ids, labels=None, caches=None,
-                position_offset=0):
-        x = self.embeddings(input_ids, position_offset=position_offset)
+                position_offset=0, doc_lens=None):
+        doc_segments = position_ids = None
+        if doc_lens is not None:
+            if caches is not None:
+                raise ValueError(
+                    "doc_lens (packed sequences) cannot combine with "
+                    "KV-cache decoding")
+            position_ids, doc_segments, label_keep = packed_doc_inputs(
+                doc_lens, input_ids.shape[-1])
+            if labels is not None:
+                # a document's last token must not be scored against the
+                # NEXT document's first token; positions past the packed
+                # total are padding — both become ignore_index
+                import jax.numpy as _jnp
+                from ..core.dispatch import ensure_tensor as _et
+                from ..ops import where as _where
+                labels = _et(labels)
+                labels = _where(label_keep, labels,
+                                Tensor(_jnp.full((), -100,
+                                                 labels._data.dtype)))
+        x = self.embeddings(input_ids, position_offset=position_offset,
+                            position_ids=position_ids)
         if caches is not None:
             new_caches = []
             for blk, cache in zip(self.blocks, caches):
@@ -349,8 +378,12 @@ class GPTModel(nn.Layer):
                 new_caches.append(cache)
             return self.head(x), new_caches
         for blk in self.blocks:
-            x = blk(x)
-        if labels is not None and self.fused_loss and not self.head.use_mp:
+            x = blk(x, doc_segments=doc_segments)
+        # the fused chunked head+CE has no ignore_index path, and packed
+        # labels need it — fall through to the standard CE (whose
+        # default ignore_index is already -100) when doc_lens is given
+        if labels is not None and self.fused_loss \
+                and not self.head.use_mp and doc_lens is None:
             # head + CE fused per sequence chunk: the [B, S, vocab] logits
             # never hit HBM (see F.fused_linear_cross_entropy)
             h = self.head.ln_f(x)
@@ -571,3 +604,55 @@ def gpt_pipe_model(name="gpt2-medium", **overrides):
               for _ in range(num_layers)]
     post = GPTLMHead(hidden, vocab, use_mp)
     return PipelineLayer(pre=pre, blocks=blocks, post=post)
+
+
+def packed_doc_inputs(doc_lens, seq):
+    """Packed-sequence (multi-document-per-row) attention inputs.
+
+    ``doc_lens`` [B, D] int (zero-padded document lengths per row,
+    summing <= seq — enforced on the concrete path; the
+    TokenBudgetBatchSampler/RaggedTensor layout).  Returns
+    (position_ids [B, seq] — resetting to 0 at each document start;
+    doc_segments [B, seq] int32 — the per-position document id consumed
+    by attention as flash SegmentIds (long seq: block-diagonal masking
+    inside the kernel, no S×S tensor) or a derived dense mask (short
+    seq/CPU); label_keep [B, seq] bool — False at each document's last
+    token and at padding, whose next-token target belongs to a
+    different document).  Padding positions get the one-past id D,
+    which matches no live document.  NEW capability vs the reference
+    (packed pretraining is a post-snapshot LLM practice)."""
+    import jax
+    import jax.numpy as jnp
+
+    dl = (doc_lens._data if isinstance(doc_lens, Tensor)
+          else jnp.asarray(doc_lens)).astype(jnp.int32)
+    if dl.ndim == 1:
+        dl = dl[None, :]
+    if not isinstance(dl, jax.core.Tracer):
+        worst = int(jnp.max(jnp.sum(dl, axis=1)))
+        if worst > seq:
+            raise ValueError(
+                f"packed_doc_inputs: doc_lens sum to {worst} > seq "
+                f"{seq} — the tail would be silently truncated and its "
+                "labels scored against phantom targets")
+    splits = jnp.concatenate(
+        [jnp.zeros((dl.shape[0], 1), jnp.int32),
+         jnp.cumsum(dl, axis=1)], axis=1)              # [B, D+1]
+    pos = jnp.arange(seq, dtype=jnp.int32)[None, :]    # [1, seq]
+    # document id per position; pos >= total implies pos >= every split,
+    # so padding lands on the one-past id D with no extra masking
+    doc_ids = jnp.sum(pos[:, :, None] >= splits[:, None, 1:],
+                      axis=-1).astype(jnp.int32)       # [B, seq]
+    total = splits[:, -1:]
+    live = pos < total
+    # splits is [B, D+1], so splits[doc_id] is the doc start even for
+    # padding's one-past id (whose result the where() discards anyway)
+    starts = jnp.take_along_axis(splits, doc_ids, axis=1)
+    position_ids = jnp.where(live, pos - starts, 0)
+    # keep a label iff its position AND the next position sit in the
+    # same document (the next-token target stays in-document)
+    nxt = jnp.broadcast_to(jnp.minimum(pos + 1, seq - 1),
+                           doc_ids.shape)
+    next_doc = jnp.take_along_axis(doc_ids, nxt, axis=1)
+    label_keep = live & (doc_ids == next_doc) & (pos + 1 < total)
+    return (Tensor(position_ids), Tensor(doc_ids), Tensor(label_keep))

@@ -89,9 +89,9 @@ def _default_block_sizes(seq_q, seq_kv):
         block_q_dq=bq)
 
 
-def _flash_attention(q, k, v, mask, scale, is_causal):
+def _flash_attention(q, k, v, mask, scale, is_causal, segment_ids=None):
     from jax.experimental.pallas.ops.tpu.flash_attention import (
-        flash_attention)
+        SegmentIds, flash_attention)
     # pallas kernel expects [B, H, S, D]
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
@@ -102,13 +102,19 @@ def _flash_attention(q, k, v, mask, scale, is_causal):
             qh.shape[2], kh.shape[2])
     elif FLASH_BLOCK_SIZES != "kernel":
         kwargs["block_sizes"] = FLASH_BLOCK_SIZES
+    if segment_ids is not None:
+        # packed sequences: block-diagonal masking INSIDE the kernel —
+        # no S x S score/mask tensor ever reaches HBM
+        kwargs["segment_ids"] = SegmentIds(q=segment_ids,
+                                           kv=segment_ids)
     out = flash_attention(qh, kh, vh, causal=is_causal, sm_scale=scale,
                           **kwargs)
     return jnp.swapaxes(out, 1, 2)
 
 
-@primitive(name="scaled_dot_product_attention")
-def _sdpa(q, k, v, mask=None, scale=None, is_causal=False, use_flash=True):
+@primitive(name="scaled_dot_product_attention", nondiff=(3,))
+def _sdpa(q, k, v, segment_ids=None, mask=None, scale=None,
+          is_causal=False, use_flash=True):
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     seq = q.shape[1]
@@ -120,20 +126,41 @@ def _sdpa(q, k, v, mask=None, scale=None, is_causal=False, use_flash=True):
     if (use_flash and mask is None and _flash_available()
             and seq >= FLASH_MIN_SEQ and seq % 128 == 0
             and seq_kv % 128 == 0 and d % 64 == 0):
-        return _flash_attention(q, k, v, mask, scale, is_causal)
+        return _flash_attention(q, k, v, mask, scale, is_causal,
+                                segment_ids=segment_ids)
+    if segment_ids is not None:
+        # dense fallback: derive the block-diagonal mask (short seq /
+        # CPU); combined with causal inside _reference_attention
+        mask = (segment_ids[:, :, None]
+                == segment_ids[:, None, :])[:, None, :, :]
     return _reference_attention(q, k, v, mask, scale, is_causal)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True, scale=None, name=None):
-    """Inputs [batch, seq, num_heads, head_dim] (paddle layout)."""
+                                 training=True, scale=None, name=None,
+                                 segment_ids=None):
+    """Inputs [batch, seq, num_heads, head_dim] (paddle layout).
+
+    ``segment_ids`` [B, S] int32 (packed sequences): attention is
+    blocked to same-segment pairs — via the flash kernel's native
+    SegmentIds at long seq (no S×S tensor), a derived dense mask
+    otherwise."""
     q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    if attn_mask is not None and segment_ids is not None:
+        raise ValueError(
+            "scaled_dot_product_attention: pass attn_mask OR "
+            "segment_ids, not both — silently dropping one would leak "
+            "attention across the other's boundaries (fold any padding "
+            "mask into the segment ids instead)")
     if attn_mask is not None:
         attn_mask = ensure_tensor(attn_mask)
         out = primitive(name="scaled_dot_product_attention_masked")(
             lambda qq, kk, vv, mm: _reference_attention(
                 qq, kk, vv, mm, scale, is_causal))(q, k, v, attn_mask)
+    elif segment_ids is not None:
+        out = _sdpa(q, k, v, ensure_tensor(segment_ids), scale=scale,
+                    is_causal=is_causal)
     else:
         out = _sdpa(q, k, v, scale=scale, is_causal=is_causal)
     if dropout_p > 0.0 and training:

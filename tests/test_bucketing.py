@@ -230,14 +230,21 @@ class TestTokenBudgetBatching:
         waste = 1 - sum(used) / (len(batches) * budget)
         assert waste < 0.02, waste  # vs 0.171 for the x1.5 ladder
 
-    def test_len_matches_next_iteration_under_shuffle(self):
+    def test_len_contract_under_shuffle(self):
         from paddle_tpu.io.bucketing import TokenBudgetBatchSampler
         lens = list(np.random.RandomState(0).randint(1, 10, 40))
         s = TokenBudgetBatchSampler(self._ds(lens), token_budget=16,
                                     shuffle=True)
-        for _ in range(3):
-            n = len(s)
-            assert n == sum(1 for _ in s)  # same permutation as len()
+        # len() BEFORE the epoch sees the same permutation the epoch
+        # will iterate
+        n = len(s)
+        assert n == sum(1 for _ in s)
+        # MID-epoch (and post-epoch) len() reports the running/last
+        # epoch's count, never a pre-drawn future permutation
+        it = iter(s)
+        next(it)
+        running = len(s)
+        assert running == 1 + sum(1 for _ in it)
 
     def test_drop_last_keeps_fullish_bins(self):
         from paddle_tpu.io.bucketing import TokenBudgetBatchSampler
@@ -264,3 +271,19 @@ class TestTokenBudgetBatching:
             [np.zeros((9, 1), np.float32)])
         with pytest.raises(ValueError, match="max_len"):
             rt.to_padded(max_len=7)
+
+    def test_ragged_collate_fixed_rows(self):
+        """max_rows fixes every output shape — one compile, not one per
+        packed row count."""
+        from paddle_tpu.io.bucketing import ragged_collate
+        c = ragged_collate(capacity=16, extra_fields=(1,), max_rows=4)
+        shapes = set()
+        for rows in ([3, 5], [2, 2, 2, 2], [9]):
+            out = c([(np.zeros(l, np.int64), np.int64(0))
+                     for l in rows])
+            shapes.add(tuple(o.shape for o in out))
+            # padded splits repeat the total (zero-length tail rows)
+            assert out[1][-1] == sum(rows)
+        assert len(shapes) == 1
+        with pytest.raises(ValueError, match="max_rows"):
+            c([(np.zeros(1, np.int64), np.int64(0))] * 5)
