@@ -21,6 +21,7 @@ SCRIPTS = [
     "pipeline_1f1b.py",
     "ragged_text_buckets.py",
     "quant_aware_training.py",
+    "packed_pretraining.py",
 ]
 
 
