@@ -174,6 +174,7 @@ def sequence_pool(rt: RaggedTensor, pool_type: str, pad_value=0.0):
     v = _masked_values(rt)
     lens = rt.lengths()._data.astype(v.dtype)
     ptype = pool_type.lower()
+    ptype = {"average": "mean", "avg": "mean"}.get(ptype, ptype)
     if ptype in ("sum", "mean", "sqrt"):
         s = jax.ops.segment_sum(v, ids, num_segments=B + 1)[:B]
         if ptype == "mean":
@@ -183,12 +184,16 @@ def sequence_pool(rt: RaggedTensor, pool_type: str, pad_value=0.0):
             s = s / jnp.sqrt(jnp.maximum(lens, 1)).reshape(
                 (-1,) + (1,) * (v.ndim - 1))
         out = s
-    elif ptype == "max":
-        neg = jnp.finfo(v.dtype).min if jnp.issubdtype(
-            v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+    elif ptype in ("max", "min"):
+        info = jnp.finfo if jnp.issubdtype(v.dtype, jnp.floating) \
+            else jnp.iinfo
+        fill = info(v.dtype).min if ptype == "max" else \
+            info(v.dtype).max
         vm = jnp.where((ids < B).reshape(
-            (-1,) + (1,) * (v.ndim - 1)), rt.values._data, neg)
-        out = jax.ops.segment_max(vm, ids, num_segments=B + 1)[:B]
+            (-1,) + (1,) * (v.ndim - 1)), rt.values._data, fill)
+        seg = jax.ops.segment_max if ptype == "max" else \
+            jax.ops.segment_min
+        out = seg(vm, ids, num_segments=B + 1)[:B]
     elif ptype in ("first", "last"):
         s = rt.row_splits._data
         idx = s[:-1] if ptype == "first" else jnp.maximum(s[1:] - 1, 0)
@@ -196,7 +201,7 @@ def sequence_pool(rt: RaggedTensor, pool_type: str, pad_value=0.0):
     else:
         raise ValueError(
             f"sequence_pool: unknown pool_type {pool_type!r} "
-            "(sum/mean/sqrt/max/first/last)")
+            "(sum/mean|average/sqrt/max/min/first/last)")
     empty = (rt.lengths()._data == 0).reshape(
         (-1,) + (1,) * (v.ndim - 1))
     out = jnp.where(empty, jnp.asarray(pad_value, out.dtype), out)

@@ -81,7 +81,18 @@ def _masked(x, lengths):
 def sequence_pool(x, pool_type, lengths=None, pad_value=0.0, name=None):
     """Pool over the time axis honoring lengths: [B, T, D] -> [B, D]
     (reference sequence_pool with types sum/average/max/min/sqrt/first/last).
-    """
+
+    Also accepts a ``core.ragged.RaggedTensor`` directly — the true-LoD
+    path computes via segment ops with no padding at all."""
+    from ...core.ragged import RaggedTensor
+    if isinstance(x, RaggedTensor):
+        from ...core import ragged as R
+        if lengths is not None:
+            raise ValueError(
+                "sequence_pool(RaggedTensor): lengths are carried by "
+                "row_splits — passing a separate lengths argument "
+                "would silently conflict")
+        return R.sequence_pool(x, pool_type, pad_value=pad_value)
     x = ensure_tensor(x)
     if lengths is None:
         lengths = Tensor(jnp.full(x._data.shape[0], x._data.shape[1],
@@ -121,7 +132,17 @@ def sequence_pool(x, pool_type, lengths=None, pad_value=0.0, name=None):
 
 def sequence_softmax(x, lengths=None, name=None):
     """Softmax over valid timesteps only: [B, T] (reference
-    sequence_softmax_op)."""
+    sequence_softmax_op).  RaggedTensor inputs route to the segment
+    implementation and return a RaggedTensor."""
+    from ...core.ragged import RaggedTensor
+    if isinstance(x, RaggedTensor):
+        from ...core import ragged as R
+        if lengths is not None:
+            raise ValueError(
+                "sequence_softmax(RaggedTensor): lengths are carried by "
+                "row_splits — passing a separate lengths argument "
+                "would silently conflict")
+        return R.sequence_softmax(x)
     x = ensure_tensor(x)
     if lengths is None:
         lengths = Tensor(jnp.full(x._data.shape[0], x._data.shape[1],
@@ -156,7 +177,17 @@ def sequence_expand(x, ref_lengths, name=None):
 
 def sequence_reverse(x, lengths=None, name=None):
     """Reverse each sequence's valid prefix: [B, T, ...] (reference
-    sequence_reverse_op)."""
+    sequence_reverse_op).  RaggedTensor inputs route to the segment
+    implementation."""
+    from ...core.ragged import RaggedTensor
+    if isinstance(x, RaggedTensor):
+        from ...core import ragged as R
+        if lengths is not None:
+            raise ValueError(
+                "sequence_reverse(RaggedTensor): lengths are carried by "
+                "row_splits — passing a separate lengths argument "
+                "would silently conflict")
+        return R.sequence_reverse(x)
     x = ensure_tensor(x)
     if lengths is None:
         lengths = Tensor(jnp.full(x._data.shape[0], x._data.shape[1],

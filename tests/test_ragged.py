@@ -198,3 +198,46 @@ class TestRaggedReviewRegressions:
         out = f(x.values._data, x.row_splits._data,
                 ref.values._data, ref.row_splits._data)
         assert out.shape == (8, 2)
+
+
+class TestFunctionalDispatch:
+    """The 1.x sequence functionals accept RaggedTensor directly —
+    LoD-style API parity on the true-ragged representation."""
+
+    def test_pool_softmax_reverse_route_to_segment_impl(self):
+        from paddle_tpu.nn import functional as F
+        rows = [np.random.RandomState(0).rand(l, 2).astype(np.float32)
+                for l in (3, 5)]
+        rt = R.RaggedTensor.from_rows(rows, capacity=12)
+        out = F.sequence_pool(rt, "average")
+        ref = R.sequence_pool(rt, "mean")
+        np.testing.assert_allclose(out.numpy(), ref.numpy())
+        srows = [np.random.RandomState(1).rand(l).astype(np.float32)
+                 for l in (4, 2)]
+        srt = R.RaggedTensor.from_rows(srows, capacity=8)
+        sm = F.sequence_softmax(srt)
+        assert isinstance(sm, R.RaggedTensor)
+        rv = F.sequence_reverse(rt)
+        for got, r in zip(rv.rows(), rows):
+            np.testing.assert_allclose(got, r[::-1])
+
+    def test_min_and_average_aliases(self):
+        from paddle_tpu.nn import functional as F
+        rows = [np.random.RandomState(2).rand(l, 2).astype(np.float32)
+                for l in (3, 4)]
+        rt = R.RaggedTensor.from_rows(rows, capacity=10)
+        mn = F.sequence_pool(rt, "min").numpy()
+        for b, r in enumerate(rows):
+            np.testing.assert_allclose(mn[b], r.min(axis=0), rtol=1e-6)
+        np.testing.assert_allclose(
+            R.sequence_pool(rt, "average").numpy(),
+            R.sequence_pool(rt, "mean").numpy())
+
+    def test_explicit_lengths_with_ragged_raise(self):
+        from paddle_tpu.nn import functional as F
+        rt = R.RaggedTensor.from_rows(
+            [np.zeros((2, 1), np.float32)])
+        with pytest.raises(ValueError, match="row_splits"):
+            F.sequence_pool(rt, "sum", lengths=np.array([1]))
+        with pytest.raises(ValueError, match="row_splits"):
+            F.sequence_reverse(rt, lengths=np.array([1]))
