@@ -1,0 +1,145 @@
+"""Packed vs padded GPT throughput at realistic document skew (TPU).
+
+Compares real-token throughput of (a) bucketed padded-dense batches vs
+(b) token-budget packed batches with segment-id flash masking, on the
+BASELINE round-3 lognormal corpus. The packed path should win by
+roughly the padding-waste ratio (~17% at this skew) at long budgets
+where flash engages.
+
+Usage: python tools/exp/_exp_packed.py [--budget 4096] [--steps 12]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", type=int, default=4096)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--docs", type=int, default=2048)
+    args = ap.parse_args()
+
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.io.bucketing import (TokenBudgetBatchSampler,
+                                         bucket_for, DEFAULT_BUCKETS)
+    from paddle_tpu.models import GPTModel
+    from paddle_tpu.parallel.train_step import TrainStep
+    from _exp_ragged import make_corpus
+
+    on_tpu = jax.default_backend() != "cpu"
+    cfg = "gpt2-medium" if on_tpu else "tiny"
+    budget = args.budget if on_tpu else 128
+    docs, lengths = make_corpus(args.docs, max_len=budget)
+    out = {"backend": jax.default_backend(), "budget": budget}
+
+    class PackedGPT(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.gpt = GPTModel.from_config(
+                cfg, dropout=0.1, max_position=budget)
+
+        def forward(self, ids, doc_lens, labels):
+            return self.gpt(ids, labels=labels, doc_lens=doc_lens)
+
+    def run_packed():
+        paddle.seed(0)
+        model = PackedGPT()
+        if on_tpu:
+            model.to(dtype="bfloat16")
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters())
+        step = TrainStep(model, opt, loss_fn=None)
+
+        class DS:
+            def __getitem__(self, i):
+                return (docs[i],)
+
+            def __len__(self):
+                return len(docs)
+
+        sampler = TokenBudgetBatchSampler(
+            DS(), token_budget=budget, max_batch_size=64,
+            length_fn=lambda i: int(lengths[i]), shuffle=True)
+        batches = list(sampler)[:args.steps + 2]
+        feeds = []
+        for b in batches:
+            ids = np.zeros((1, budget), np.int32)
+            dl = np.zeros((1, 64), np.int32)
+            off = 0
+            for j, i in enumerate(b):
+                d = docs[i][:int(lengths[i])]  # corpus stores len+1
+                ids[0, off:off + len(d)] = d
+                dl[0, j] = len(d)
+                off += len(d)
+            labels = np.concatenate([ids[0, 1:], [0]])[None, :] \
+                .astype(np.int64)
+            feeds.append((ids, dl, labels, off))
+        step.step(list(feeds[0][:3]))  # compile
+        t0 = time.perf_counter()
+        real = 0
+        for f in feeds[1:args.steps + 1]:
+            loss = step.step(list(f[:3]))
+            real += f[3]
+        loss.numpy()
+        dt = time.perf_counter() - t0
+        return round(real / dt, 1)
+
+    def run_padded():
+        paddle.seed(0)
+        model = GPTModel.from_config(cfg, dropout=0.1, fused_loss=True,
+                                     max_position=budget)
+        if on_tpu:
+            model.to(dtype="bfloat16")
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters())
+        step = TrainStep(model, opt, loss_fn=None)
+        # bucketed batches of 8 rows padded to the bucket
+        order = np.argsort(lengths)[::-1]
+        t0 = None
+        real = done = 0
+        for s0 in range(0, len(order), 8):
+            idx = order[s0:s0 + 8]
+            L = bucket_for(int(max(lengths[i] for i in idx)),
+                           tuple(b for b in DEFAULT_BUCKETS
+                                 if b <= budget) + (budget,))
+            x = np.zeros((8, L), np.int32)
+            y = np.zeros((8, L), np.int64)
+            for r, i in enumerate(idx[:8]):
+                d = docs[i]
+                x[r, :len(d) - 1] = d[:-1]
+                y[r, :len(d) - 1] = d[1:]
+            loss = step.step([x, y])
+            if t0 is None:  # first step = compile; start timing after
+                loss.numpy()
+                t0 = time.perf_counter()
+                continue
+            real += int(sum(lengths[i] for i in idx))
+            done += 1
+            if done >= args.steps:
+                break
+        loss.numpy()
+        dt = time.perf_counter() - t0
+        return round(real / dt, 1)
+
+    out["packed_real_tokens_per_s"] = run_packed()
+    out["padded_real_tokens_per_s"] = run_padded()
+    out["packed_vs_padded"] = round(
+        out["packed_real_tokens_per_s"]
+        / max(out["padded_real_tokens_per_s"], 1e-9), 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -38,4 +38,7 @@ run gen 1800 python tools/exp/_exp_gen_tpu.py
 # 5) ragged wall-clock leg on hardware (BASELINE round-3 table)
 run ragged 2400 python tools/exp/_exp_ragged.py --docs 512 --batch 8 --steps-cap 24
 
+# 6) packed vs padded pretraining throughput (flash segment ids)
+run packed 2400 python tools/exp/_exp_packed.py --budget 4096 --steps 12
+
 echo "=== backlog complete; fold results into BASELINE.md"
