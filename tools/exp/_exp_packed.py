@@ -44,6 +44,11 @@ def main():
     docs, lengths = make_corpus(args.docs, max_len=budget)
     out = {"backend": jax.default_backend(), "budget": budget}
 
+    MAX_ROWS = 64          # docs per packed row (doc_lens width)
+    ROWS_PER_STEP = 8      # packed rows per step == padded batch rows:
+    #                        both legs then move ~8 x budget tokens/step,
+    #                        isolating packing from batch-size/MFU effects
+
     class PackedGPT(paddle.nn.Layer):
         def __init__(self):
             super().__init__()
@@ -70,23 +75,30 @@ def main():
                 return len(docs)
 
         sampler = TokenBudgetBatchSampler(
-            DS(), token_budget=budget, max_batch_size=64,
+            DS(), token_budget=budget, max_batch_size=MAX_ROWS,
             length_fn=lambda i: int(lengths[i]), shuffle=True)
-        batches = list(sampler)[:args.steps + 2]
+        rows = list(sampler)
         feeds = []
-        for b in batches:
-            ids = np.zeros((1, budget), np.int32)
-            dl = np.zeros((1, 64), np.int32)
-            off = 0
-            for j, i in enumerate(b):
-                d = docs[i][:int(lengths[i])]  # corpus stores len+1
-                ids[0, off:off + len(d)] = d
-                dl[0, j] = len(d)
-                off += len(d)
-            labels = np.concatenate([ids[0, 1:], [0]])[None, :] \
-                .astype(np.int64)
-            feeds.append((ids, dl, labels, off))
-        step.step(list(feeds[0][:3]))  # compile
+        for s0 in range(0, len(rows) - ROWS_PER_STEP + 1,
+                        ROWS_PER_STEP):
+            ids = np.zeros((ROWS_PER_STEP, budget), np.int32)
+            dl = np.zeros((ROWS_PER_STEP, MAX_ROWS), np.int32)
+            real = 0
+            for r, b in enumerate(rows[s0:s0 + ROWS_PER_STEP]):
+                off = 0
+                for j, i in enumerate(b):
+                    d = docs[i][:int(lengths[i])]  # corpus stores len+1
+                    ids[r, off:off + len(d)] = d
+                    dl[r, j] = len(d)
+                    off += len(d)
+                real += off
+            labels = np.concatenate(
+                [ids[:, 1:], np.zeros((ROWS_PER_STEP, 1), np.int32)],
+                axis=1).astype(np.int64)
+            feeds.append((ids, dl, labels, real))
+            if len(feeds) >= args.steps + 1:
+                break
+        step.step(list(feeds[0][:3])).numpy()  # compile + SYNC
         t0 = time.perf_counter()
         real = 0
         for f in feeds[1:args.steps + 1]:
@@ -105,30 +117,39 @@ def main():
         opt = optimizer.AdamW(learning_rate=1e-4,
                               parameters=model.parameters())
         step = TrainStep(model, opt, loss_fn=None)
-        # bucketed batches of 8 rows padded to the bucket
-        order = np.argsort(lengths)[::-1]
-        t0 = None
-        real = done = 0
-        for s0 in range(0, len(order), 8):
-            idx = order[s0:s0 + 8]
-            L = bucket_for(int(max(lengths[i] for i in idx)),
-                           tuple(b for b in DEFAULT_BUCKETS
-                                 if b <= budget) + (budget,))
-            x = np.zeros((8, L), np.int32)
-            y = np.zeros((8, L), np.int64)
-            for r, i in enumerate(idx[:8]):
+        ladder = tuple(b for b in DEFAULT_BUCKETS if b <= budget) \
+            + (budget,)
+        # SAME corpus, SAME shuffle-everything sampling as the packed
+        # leg (sorting would benchmark only the tail and hide the
+        # population's padding waste)
+        rs = np.random.RandomState(0)
+        order = rs.permutation(len(docs))
+        batches = []
+        for s0 in range(0, len(order) - ROWS_PER_STEP + 1,
+                        ROWS_PER_STEP):
+            idx = order[s0:s0 + ROWS_PER_STEP]
+            L = bucket_for(int(max(lengths[i] for i in idx)), ladder)
+            x = np.zeros((ROWS_PER_STEP, L), np.int32)
+            y = np.zeros((ROWS_PER_STEP, L), np.int64)
+            real = 0
+            for r, i in enumerate(idx):
                 d = docs[i]
                 x[r, :len(d) - 1] = d[:-1]
                 y[r, :len(d) - 1] = d[1:]
+                real += len(d) - 1
+            batches.append((x, y, real))
+        # pre-compile EVERY bucket shape outside the timed window (a
+        # 20-40s TPU compile inside it would deflate the denominator)
+        seen = set()
+        for x, y, _ in batches:
+            if x.shape[1] not in seen:
+                seen.add(x.shape[1])
+                step.step([x, y]).numpy()
+        t0 = time.perf_counter()
+        real = 0
+        for x, y, r in batches[:args.steps]:
             loss = step.step([x, y])
-            if t0 is None:  # first step = compile; start timing after
-                loss.numpy()
-                t0 = time.perf_counter()
-                continue
-            real += int(sum(lengths[i] for i in idx))
-            done += 1
-            if done >= args.steps:
-                break
+            real += r
         loss.numpy()
         dt = time.perf_counter() - t0
         return round(real / dt, 1)
