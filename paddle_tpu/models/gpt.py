@@ -327,9 +327,12 @@ class GPTModel(nn.Layer):
                  vocab_size=50304, max_position=1024, dropout=0.1,
                  use_mp=False, use_recompute=False, moe_experts=0,
                  moe_every=2, fused_loss=False, recompute_policy=None,
-                 use_sp=False):
+                 use_sp=False, fused_loss_chunk=128):
         super().__init__()
         self.fused_loss = fused_loss
+        # sequence-chunk size of the fused head+CE scan: larger chunks =
+        # fewer scan iterations and bigger matmuls, more live logits HBM
+        self.fused_loss_chunk = fused_loss_chunk
         self.embeddings = GPTEmbeddings(vocab_size, hidden_size,
                                         max_position, dropout, use_mp)
         # moe_experts>0: every `moe_every`-th block (1-based) swaps its FFN
@@ -379,22 +382,192 @@ class GPTModel(nn.Layer):
             return self.head(x), new_caches
         for blk in self.blocks:
             x = blk(x, doc_segments=doc_segments)
-        # the fused chunked head+CE has no ignore_index path, and packed
-        # labels need it — fall through to the standard CE (whose
-        # default ignore_index is already -100) when doc_lens is given
         if labels is not None and self.fused_loss \
-                and not self.head.use_mp and doc_lens is None:
+                and not self.head.use_mp:
             # head + CE fused per sequence chunk: the [B, S, vocab] logits
-            # never hit HBM (see F.fused_linear_cross_entropy)
+            # never hit HBM (see F.fused_linear_cross_entropy).  Packed
+            # mode masks boundary/padding labels via ignore_index — the
+            # materializing CE fallback OOMs at long budgets (39.7GB at
+            # budget 4096 vs 15.75GB HBM)
             h = self.head.ln_f(x)
             return F.fused_linear_cross_entropy(
-                h, self.head.lm_head.weight, labels)
+                h, self.head.lm_head.weight, labels,
+                chunk_size=self.fused_loss_chunk,
+                ignore_index=-100 if doc_lens is not None else None)
         logits = self.head(x)
         if labels is not None:
             b, s, v = logits.shape
             return F.cross_entropy(reshape(logits, [b * s, v]),
                                    reshape(labels, [b * s]))
         return logits
+
+    @staticmethod
+    def _filter_logits(last, temperature, top_k, top_p):
+        """Sampling filters (temperature / top-k / top-p nucleus) on f32
+        logits [B, V].  Pure jnp — shared verbatim by the eager per-token
+        loop and the fused on-device scan so both paths draw from the
+        identical filtered distribution."""
+        import jax
+        import jax.numpy as jnp
+        if temperature != 1.0:
+            last = last / temperature
+        if top_k and top_k > 0:
+            kth = jax.lax.top_k(last, top_k)[0][:, -1:]
+            last = jnp.where(last < kth, -1e9, last)
+        if top_p < 1.0:
+            # clamp so top_p <= 0 means "top token only" (the keep-mask
+            # below would otherwise mask EVERYTHING and sample uniformly)
+            p_eff = max(float(top_p), 1e-9)
+            # nucleus filtering: mask tokens outside the smallest set
+            # whose cumulative probability reaches top_p (sorted
+            # descending; the top token always survives)
+            srt = jnp.sort(last, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(srt, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep entries whose PREFIX (exclusive) mass is still < top_p
+            keep = (cum - probs) < p_eff
+            cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                             keepdims=True)
+            last = jnp.where(last < cutoff, -1e9, last)
+        return last
+
+    def _decode_tick(self, tok, k_bufs, v_bufs, pos):
+        """One-token decode against fixed-size cache buffers: embeddings
+        -> each block's decode -> head.  Shared by the per-token jitted
+        step and the fused whole-decode scan so the two compiled paths
+        cannot diverge.  Returns (last_logits [B, V], new_k, new_v)."""
+        x = self.embeddings(Tensor(tok), position_offset=pos)
+        new_k, new_v = [], []
+        for j, blk in enumerate(self.blocks):
+            x, kb, vb = blk.decode(x, k_bufs[j], v_bufs[j], pos)
+            new_k.append(kb)
+            new_v.append(vb)
+        return self.head(x)._data[:, -1, :], new_k, new_v
+
+    def _fused_generate_fn(self, pnames, params, cache_key, n_steps,
+                           start_pos, do_sample, temperature, top_k,
+                           top_p, out_dtype):
+        """Build (or fetch) the jitted WHOLE-DECODE fn: a lax.scan over
+        ``n_steps`` one-token steps with sampling on device — the entire
+        generation is ONE dispatch and ONE host sync.  The per-token
+        compiled path (``_compiled_decode_fn``) pays a host round-trip
+        per token, which dominates end-to-end latency whenever the
+        device is remote (measured 4.9 tok/s through the dev tunnel's
+        ~200ms round-trip vs compute-bound in-scan decode).  K/V buffers
+        live in the scan carry (donated; updated in place).
+
+        Trade-off vs the per-token step: the scan length and batch/cache
+        shapes are part of the program, so each distinct (batch, total
+        length, n_steps, sampling config) compiles its own executable —
+        callers with naturally varying prompt lengths should bucket
+        them.  The cache is FIFO-bounded to keep resident executables
+        in check."""
+        import jax
+        import jax.numpy as jnp
+        from ..core import autograd
+        from ..jit import _swapped
+
+        cache = getattr(self, "_gen_fn_cache", None)
+        if cache is None:
+            cache = self._gen_fn_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        model = self
+        mbuffers = dict(self.named_buffers())
+        bnames = sorted(mbuffers)
+
+        def pick(last, key):
+            """Sample/argmax the next token from raw logits; returns
+            (tok [B, 1], advanced key)."""
+            last = last.astype(jnp.float32)
+            if do_sample:
+                last = GPTModel._filter_logits(last, temperature,
+                                               top_k, top_p)
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(sub, last, axis=-1)
+            else:
+                nxt = jnp.argmax(last, axis=-1)
+            return nxt.astype(out_dtype).reshape(-1, 1), key
+
+        def pure(p_list, b_list, k_bufs, v_bufs, last0, key0):
+            with _swapped(params, dict(zip(pnames, p_list))), \
+                    _swapped(mbuffers, dict(zip(bnames, b_list))):
+                with autograd.no_grad():
+                    def body(carry, i):
+                        kbs, vbs, last, key = carry
+                        tok, key = pick(last, key)
+                        last, new_k, new_v = model._decode_tick(
+                            tok, kbs, vbs, start_pos + i)
+                        return (tuple(new_k), tuple(new_v), last, key), \
+                            tok
+                    init = (tuple(k_bufs), tuple(v_bufs), last0, key0)
+                    # n_steps-1 scanned forwards; the final token needs
+                    # no forward (the eager loop's 'skip the dead
+                    # forward' break) — sample it from the carry
+                    (_, _, last, key), toks = jax.lax.scan(
+                        body, init,
+                        jnp.arange(n_steps - 1, dtype=jnp.int32))
+                    tok_last, _ = pick(last, key)
+            # toks [N-1, B, 1] -> [B, N-1]; append the final sample
+            toks = jnp.swapaxes(toks[..., 0], 0, 1) \
+                if n_steps > 1 else jnp.zeros(
+                    (tok_last.shape[0], 0), out_dtype)
+            return jnp.concatenate([toks, tok_last], axis=1)
+
+        # no donate_argnums: unlike the per-token step the K/V buffers
+        # are consumed by the scan but never returned, so they cannot
+        # alias an output — donating them only emits a warning
+        fn = jax.jit(pure)
+        if len(cache) >= 8:  # FIFO bound on resident executables
+            cache.pop(next(iter(cache)))
+        cache[cache_key] = (fn, bnames, mbuffers)
+        return cache[cache_key]
+
+    def _compiled_prefill_fn(self, pnames, params, cache_key, b, s, L,
+                             nh, hd, kv_dtype):
+        """Build (or fetch) the jitted prefill: (p_list, b_list,
+        ids [B, S]) -> (last_logits [B, V], k_bufs, v_bufs padded to L).
+        The eager prefill dispatches every op individually — hundreds of
+        host round-trips before the first token when the device is
+        remote; this makes the whole prompt pass (and the cache padding)
+        ONE dispatch."""
+        import jax
+        import jax.numpy as jnp
+        from ..core import autograd
+        from ..jit import _swapped
+
+        cache = getattr(self, "_prefill_fn_cache", None)
+        if cache is None:
+            cache = self._prefill_fn_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        model = self
+        mbuffers = dict(self.named_buffers())
+        bnames = sorted(mbuffers)
+
+        def pure(p_list, b_list, ids_arr):
+            with _swapped(params, dict(zip(pnames, p_list))), \
+                    _swapped(mbuffers, dict(zip(bnames, b_list))):
+                with autograd.no_grad():
+                    empty = [(Tensor(jnp.zeros((b, 0, nh, hd),
+                                               kv_dtype)),
+                              Tensor(jnp.zeros((b, 0, nh, hd),
+                                               kv_dtype)))
+                             for _ in model.blocks]
+                    logits, caches = model.forward(Tensor(ids_arr),
+                                                   caches=empty)
+                    pad = ((0, 0), (0, L - s), (0, 0), (0, 0))
+                    k_bufs = [jnp.pad(ck._data, pad) for ck, _ in caches]
+                    v_bufs = [jnp.pad(cv._data, pad) for _, cv in caches]
+            return logits._data[:, -1, :], k_bufs, v_bufs
+
+        fn = jax.jit(pure)
+        if len(cache) >= 8:  # FIFO bound, matching _gen_fn_cache
+            cache.pop(next(iter(cache)))
+        cache[cache_key] = (fn, bnames, mbuffers)
+        return cache[cache_key]
 
     def _compiled_decode_fn(self, pnames, params, cache_key):
         """Build (or fetch) the jitted one-token decode step: (p_list,
@@ -425,16 +598,9 @@ class GPTModel(nn.Layer):
             with _swapped(params, dict(zip(pnames, p_list))), \
                     _swapped(mbuffers, dict(zip(bnames, b_list))):
                 with autograd.no_grad():
-                    x = model.embeddings(Tensor(tok),
-                                         position_offset=pos)
-                    new_k, new_v = [], []
-                    for i, blk in enumerate(model.blocks):
-                        x, kb, vb = blk.decode(x, k_bufs[i], v_bufs[i],
-                                               pos)
-                        new_k.append(kb)
-                        new_v.append(vb)
-                    logits = model.head(x)
-            return logits._data[:, -1, :], new_k, new_v
+                    last, new_k, new_v = model._decode_tick(
+                        tok, k_bufs, v_bufs, pos)
+            return last, new_k, new_v
 
         fn = jax.jit(pure, donate_argnums=(2, 3))
         cache[cache_key] = (fn, bnames, mbuffers)
@@ -451,6 +617,12 @@ class GPTModel(nn.Layer):
         consumer of the attention cache.  ``compiled=True`` decodes
         through ONE jitted fixed-shape step (dynamic_update_slice into
         preallocated K/V buffers) instead of per-token eager dispatch.
+        ``compiled="fused"`` goes further: the ENTIRE decode loop runs
+        on device as one lax.scan (sampling included) — one dispatch,
+        one host sync, no per-token round-trips (the right mode whenever
+        the device is remote or per-call latency matters; its one
+        trade-off is that early-eos stopping cannot skip the remaining
+        scan steps, though the returned ids are truncated identically).
         Returns [B, S + new] ids.
         """
         import jax
@@ -461,6 +633,8 @@ class GPTModel(nn.Layer):
         ids = input_ids._data if hasattr(input_ids, "_data") else \
             jnp.asarray(input_ids)
         b, s = ids.shape
+        if max_new_tokens <= 0:
+            return T(ids)  # every path: prompt unchanged, no sampling
         max_position = self.embeddings.position_embeddings.weight.shape[0]
         if s + max_new_tokens > max_position:
             raise ValueError(
@@ -487,68 +661,76 @@ class GPTModel(nn.Layer):
         self.eval()
         try:
             with autograd.no_grad():
-                # prefill: empty caches grow from zero-length k/v
-                empty = (T(jnp.zeros((b, 0, nh, hd), kv_dtype)),
-                         T(jnp.zeros((b, 0, nh, hd), kv_dtype)))
-                caches = [empty for _ in self.blocks]
-                logits, caches = self.forward(T(ids), caches=caches)
                 out = [ids]
                 key = rng_mod.key_for(seed)
 
                 step_fn = None
                 if compiled:
-                    # fixed-size buffers: prompt k/v padded to L
+                    # jitted prefill: whole prompt pass + cache padding
+                    # to L in ONE dispatch (the eager prefill is a
+                    # per-op round-trip storm on remote devices)
                     L = s + max_new_tokens
-                    k_bufs, v_bufs = [], []
-                    for ck, cv in caches:
-                        pad = ((0, 0), (0, L - s), (0, 0), (0, 0))
-                        k_bufs.append(jnp.pad(ck._data, pad))
-                        v_bufs.append(jnp.pad(cv._data, pad))
                     params = dict(self.named_parameters())
                     pnames = sorted(params)
+                    bnames_all = tuple(sorted(dict(self.named_buffers())))
+                    pf, pf_bnames, pf_bufs = self._compiled_prefill_fn(
+                        pnames, params,
+                        (b, s, L, str(kv_dtype), tuple(pnames),
+                         bnames_all),
+                        b, s, L, nh, hd, kv_dtype)
+                    p_list = [params[k2]._data for k2 in pnames]
+                    b_list = [pf_bufs[k2]._data for k2 in pf_bnames]
+                    last0, k_bufs, v_bufs = pf(p_list, b_list, ids)
+                else:
+                    # eager prefill: empty caches grow from zero-length
+                    # k/v
+                    empty = (T(jnp.zeros((b, 0, nh, hd), kv_dtype)),
+                             T(jnp.zeros((b, 0, nh, hd), kv_dtype)))
+                    caches = [empty for _ in self.blocks]
+                    logits, caches = self.forward(T(ids), caches=caches)
+                    last0 = logits._data[:, -1, :]
+
+                if compiled == "fused":
+                    fn, fbnames, fbufs = self._fused_generate_fn(
+                        pnames, params,
+                        (b, L, max_new_tokens, str(kv_dtype),
+                         bool(do_sample), float(temperature),
+                         int(top_k or 0), float(top_p), str(ids.dtype),
+                         tuple(pnames), bnames_all),
+                        n_steps=max_new_tokens, start_pos=s,
+                        do_sample=do_sample, temperature=temperature,
+                        top_k=top_k, top_p=top_p, out_dtype=ids.dtype)
+                    b_list = [fbufs[k2]._data for k2 in fbnames]
+                    toks = fn(p_list, b_list, k_bufs, v_bufs, last0, key)
+                    if eos_token_id is not None:
+                        # match the eager loop: stop AFTER the first step
+                        # where every row emitted eos
+                        all_eos = jnp.all(toks == eos_token_id, axis=0)
+                        if bool(jnp.any(all_eos)):
+                            toks = toks[:, :int(jnp.argmax(all_eos)) + 1]
+                    return T(jnp.concatenate([ids, toks], axis=1))
+
+                if compiled:
                     step_fn, dec_bnames, dec_bufs = \
                         self._compiled_decode_fn(
                             pnames, params,
                             (b, L, str(kv_dtype), tuple(pnames),
-                             tuple(sorted(dict(self.named_buffers())))))
-                    p_list = [params[k2]._data for k2 in pnames]
+                             bnames_all))
                     b_list = [dec_bufs[k2]._data for k2 in dec_bnames]
 
                 def sample(last):
                     nonlocal key
                     last = last.astype(jnp.float32)
                     if do_sample:
-                        if temperature != 1.0:
-                            last = last / temperature
-                        if top_k and top_k > 0:
-                            kth = jax.lax.top_k(last, top_k)[0][:, -1:]
-                            last = jnp.where(last < kth, -1e9, last)
-                        if top_p < 1.0:
-                            # clamp so top_p <= 0 means "top token only"
-                            # (the keep-mask below would otherwise mask
-                            # EVERYTHING and sample uniformly)
-                            p_eff = max(float(top_p), 1e-9)
-                            # nucleus filtering: mask tokens outside the
-                            # smallest set whose cumulative probability
-                            # reaches top_p (sorted descending; the top
-                            # token always survives)
-                            srt = jnp.sort(last, axis=-1)[:, ::-1]
-                            probs = jax.nn.softmax(srt, axis=-1)
-                            cum = jnp.cumsum(probs, axis=-1)
-                            # keep entries whose PREFIX (exclusive) mass
-                            # is still < top_p
-                            keep = (cum - probs) < p_eff
-                            cutoff = jnp.min(
-                                jnp.where(keep, srt, jnp.inf), axis=-1,
-                                keepdims=True)
-                            last = jnp.where(last < cutoff, -1e9, last)
+                        last = self._filter_logits(last, temperature,
+                                                   top_k, top_p)
                         key, sub = jax.random.split(key)
                         nxt = jax.random.categorical(sub, last, axis=-1)
                     else:
                         nxt = jnp.argmax(last, axis=-1)
                     return nxt.astype(ids.dtype).reshape(b, 1)
 
-                last = logits._data[:, -1, :]
+                last = last0
                 for step in range(max_new_tokens):
                     nxt = sample(last)
                     out.append(nxt)

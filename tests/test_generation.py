@@ -196,3 +196,66 @@ def test_generate_top_p_compiled_consistent(tiny_gpt):
     comp = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=6,
                              top_p=0.8, seed=11, compiled=True)
     np.testing.assert_array_equal(eager.numpy(), comp.numpy())
+
+
+def test_fused_generate_matches_eager(tiny_gpt):
+    """compiled="fused" (whole decode = one lax.scan jit, sampling on
+    device) must produce exactly the eager KV-cache path's greedy tokens,
+    and be deterministic under a fixed seed when sampling."""
+    ids = np.random.RandomState(3).randint(0, 128, (2, 5)).astype("int32")
+    eager = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=7)
+    fused = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=7,
+                              compiled="fused")
+    np.testing.assert_array_equal(eager.numpy(), fused.numpy())
+    s1 = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                           top_k=5, temperature=0.8, seed=11,
+                           compiled="fused")
+    n_cached = len(tiny_gpt._gen_fn_cache)
+    s2 = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                           top_k=5, temperature=0.8, seed=11,
+                           compiled="fused")
+    np.testing.assert_array_equal(s1.numpy(), s2.numpy())
+    # repeat call reused the cached whole-decode jit (no new entry)
+    assert len(tiny_gpt._gen_fn_cache) == n_cached
+
+
+def test_fused_generate_top_p_matches_eager(tiny_gpt):
+    ids = np.zeros((2, 3), np.int32)
+    eager = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                              top_p=0.8, seed=11, compiled=False)
+    fused = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                              top_p=0.8, seed=11, compiled="fused")
+    np.testing.assert_array_equal(eager.numpy(), fused.numpy())
+
+
+def test_fused_generate_eos_truncation(tiny_gpt):
+    """Fused decode truncates at the first all-rows-eos step exactly like
+    the eager loop's break."""
+    ids = np.random.RandomState(1).randint(0, 128, (2, 4)).astype("int32")
+    ref = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=8)
+    # pick the token the greedy path emits at step 2 for BOTH rows as a
+    # fake eos: if the rows disagree no truncation happens — craft the
+    # check from whatever the model actually emits
+    ref_np = ref.numpy()
+    step_cols = ref_np[:, 4:]
+    eos = None
+    for j in range(step_cols.shape[1]):
+        if (step_cols[:, j] == step_cols[0, j]).all():
+            eos = int(step_cols[0, j])
+            break
+    if eos is None:
+        pytest.skip("greedy rows never agree on a token for this seed")
+    eager = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                              eos_token_id=eos)
+    fused = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                              eos_token_id=eos, compiled="fused")
+    np.testing.assert_array_equal(eager.numpy(), fused.numpy())
+
+
+def test_generate_zero_new_tokens(tiny_gpt):
+    """max_new_tokens=0 returns the prompt unchanged on every path."""
+    ids = np.random.RandomState(9).randint(0, 128, (2, 5)).astype("int32")
+    for mode in (False, True, "fused"):
+        out = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=0,
+                                compiled=mode)
+        np.testing.assert_array_equal(out.numpy(), ids)

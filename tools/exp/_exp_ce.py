@@ -8,8 +8,12 @@ def run(chunk, steps=10):
     import paddle_tpu as paddle
     from paddle_tpu.nn.functional import loss as L
     orig = L.fused_linear_cross_entropy
-    def patched(hidden, weight, labels, chunk_size=128, name=None):
-        return orig(hidden, weight, labels, chunk_size=chunk)
+    # NOTE: superseded by _exp_ce_chunk.py (proper fused_loss_chunk ctor
+    # arg); signature kept in sync with the real functional
+    def patched(hidden, weight, labels, chunk_size=128,
+                ignore_index=None, name=None):
+        return orig(hidden, weight, labels, chunk_size=chunk,
+                    ignore_index=ignore_index)
     L.fused_linear_cross_entropy = patched
     import paddle_tpu.models.gpt as gpt
     gpt.F.fused_linear_cross_entropy = patched

@@ -22,30 +22,42 @@ sys.path.insert(0, os.path.join(os.path.dirname(
 import numpy as np
 
 
-def measure(model, batch, prompt_len, new_tokens, vocab):
+def measure(model, batch, prompt_len, new_tokens, vocab, mode=True):
+    """mode=True: per-token jitted step.  mode="fused": whole decode =
+    one lax.scan jit (one dispatch, one sync — the remote-device mode)."""
     import paddle_tpu as paddle
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(
         rng.randint(0, vocab, (batch, prompt_len)).astype(np.int32))
-    # warmup compiles prefill + decode step
-    model.generate(ids, max_new_tokens=4, compiled=True)
-    # prefill: time a generate that decodes ONE token — dominated by the
+    # warmup compiles prefill + decode step/scan for BOTH measured token
+    # counts (fused: the scan length is part of the program)
+    model.generate(ids, max_new_tokens=1, compiled=mode)
+    model.generate(ids, max_new_tokens=new_tokens, compiled=mode)
+    # prefill: a generate that decodes ONE token — dominated by the
     # prompt pass at these lengths
     t0 = time.perf_counter()
-    model.generate(ids, max_new_tokens=1, compiled=True).numpy()
+    model.generate(ids, max_new_tokens=1, compiled=mode).numpy()
     t_prefill = time.perf_counter() - t0
-    # decode: long continuation minus the prefill share
+    # decode: long continuation minus the measured 1-token call — both
+    # share the same prefill program, so the difference is pure decode
     t0 = time.perf_counter()
-    out = model.generate(ids, max_new_tokens=new_tokens, compiled=True)
+    out = model.generate(ids, max_new_tokens=new_tokens, compiled=mode)
     np.asarray(out.numpy())
     t_total = time.perf_counter() - t0
-    t_decode = max(t_total - t_prefill, 1e-9)
+    # through a jittery tunnel the 1-token call can measure SLOWER than
+    # the full call — the subtraction is then meaningless: report null
+    # and let end_to_end_s (the robust number) speak
+    t_decode = t_total - t_prefill
     return {
         "batch": batch, "prompt_len": prompt_len,
         "new_tokens": new_tokens,
         "prefill_tokens_per_s": round(batch * prompt_len / t_prefill, 1),
         "decode_tokens_per_s": round(
-            batch * (new_tokens - 1) / t_decode, 1),
+            batch * (new_tokens - 1) / t_decode, 1)
+        if t_decode > 1e-3 else None,
+        "end_to_end_s": round(t_total, 3),
+        "new_tokens_per_s_e2e": round(
+            batch * new_tokens / t_total, 1),
     }
 
 
@@ -86,6 +98,11 @@ def main():
         out[f"b{batch}"] = measure(model, batch, args.prompt_len,
                                    args.new_tokens, vocab)
         print(json.dumps({f"b{batch}": out[f"b{batch}"]}), flush=True)
+        out[f"b{batch}_fused"] = measure(model, batch, args.prompt_len,
+                                         args.new_tokens, vocab,
+                                         mode="fused")
+        print(json.dumps({f"b{batch}_fused": out[f"b{batch}_fused"]}),
+              flush=True)
     print(json.dumps(out))
 
 

@@ -53,7 +53,11 @@ def main():
         def __init__(self):
             super().__init__()
             self.gpt = GPTModel.from_config(
-                cfg, dropout=0.1, max_position=budget)
+                cfg, dropout=0.1, max_position=budget, fused_loss=True,
+                # 8 rows x budget-4096 = 32k tokens/step: activations
+                # (24 x 256MB MLP intermediates alone) exceed HBM
+                # without remat — same recipe any long-seq run uses
+                use_recompute=budget >= 2048)
 
         def forward(self, ids, doc_lens, labels):
             return self.gpt(ids, labels=labels, doc_lens=doc_lens)
@@ -111,7 +115,8 @@ def main():
     def run_padded():
         paddle.seed(0)
         model = GPTModel.from_config(cfg, dropout=0.1, fused_loss=True,
-                                     max_position=budget)
+                                     max_position=budget,
+                                     use_recompute=budget >= 2048)
         if on_tpu:
             model.to(dtype="bfloat16")
         opt = optimizer.AdamW(learning_rate=1e-4,

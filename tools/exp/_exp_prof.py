@@ -85,8 +85,63 @@ def parse_xplane(trace_dir):
     if not total:
         return None
     top = sorted(best.items(), key=lambda kv: -kv[1])[:25]
-    return [{"op": k, "ms": round(v / 1e9, 3),
-             "pct": round(100 * v / total, 1)} for k, v in top]
+
+    import re as _re
+
+    def category(op):
+        """Semantic bucket from the HLO op text — so the rollup covers
+        100% of device time, not just the top-N individual ops.  The
+        OPCODE is the token after '= <type>' (matching on the whole
+        line would misbucket fusions whose bodies mention other ops)."""
+        name = op.split(" = ")[0].strip("%").lower()
+        m = _re.search(r"= \S+?\s+([\w-]+)\(", op)
+        opcode = (m.group(1) if m else name.split(".")[0]).lower()
+        if opcode == "while":
+            return "while-loops (fused-CE scan & co)"
+        if opcode == "custom-call":
+            return "custom calls (pallas)"
+        if opcode in ("dot", "convolution") or "convolution" in name \
+                or name.startswith("dot"):
+            return "matmul/conv fusions"
+        if opcode in ("all-reduce", "all-gather", "reduce-scatter",
+                      "collective-permute", "all-to-all"):
+            return "collectives"
+        if opcode in ("copy", "bitcast", "transpose", "reshape",
+                      "copy-start", "copy-done"):
+            return "copies/layout"
+        if opcode.startswith("rng"):
+            return "rng"
+        if opcode == "fusion":
+            if "dynamic-update-slice" in name or "dynamic-slice" in name:
+                return "slice/update fusions"
+            if "reduce" in name:
+                return "reduction fusions"
+            return "elementwise/other fusions"
+        return "other (" + opcode + ")" if opcode else "other"
+
+    cats = {}
+    for k, v in best.items():
+        c = category(k)
+        e = cats.setdefault(c, {"ms": 0.0, "count": 0, "top_op": k,
+                                "top_ms": 0.0})
+        e["ms"] += v / 1e9
+        e["count"] += 1
+        if v / 1e9 > e["top_ms"]:
+            e["top_ms"] = round(v / 1e9, 3)
+            e["top_op"] = k.split(" = ")[0].strip("%")
+    categories = sorted(
+        ({"category": c, "ms": round(e["ms"], 2),
+          "pct": round(100 * e["ms"] * 1e9 / total, 1),
+          "ops": e["count"], "top_op": e["top_op"],
+          "top_ms": e["top_ms"]} for c, e in cats.items()),
+        key=lambda d: -d["ms"])
+    return {
+        "total_device_ms": round(total / 1e9, 2),
+        "categories": categories,
+        "top": [{"op": k.split(" = ")[0].strip("%"),
+                 "ms": round(v / 1e9, 3),
+                 "pct": round(100 * v / total, 1)} for k, v in top],
+    }
 
 
 def main():
