@@ -107,7 +107,15 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     ``core.selected_rows.SelectedRows`` that sparse-aware optimizers
     apply row-wise.  Under jit/static the flag is a no-op by design:
     XLA fuses the scatter-add on the gather VJP, which already never
-    materializes an intermediate."""
+    materializes an intermediate.
+
+    Out-of-range ids do NOT raise (the reference's lookup kernel
+    PADDLE_ENFORCEs; a device-side check would force a host sync per
+    lookup): jnp's gather fill-semantics return NaN rows for float
+    weights.  A model whose loss goes NaN with ids at/above
+    ``weight.shape[0]`` (e.g. positions past max_position) is the
+    symptom; ``paddle.set_flags({'FLAGS_check_nan_inf': True})``
+    localizes it to this op."""
     x, weight = ensure_tensor(x), ensure_tensor(weight)
     if padding_idx is not None and padding_idx < 0:
         padding_idx = weight.shape[0] + padding_idx
