@@ -1,0 +1,74 @@
+"""Latency-oriented serving: fused whole-decode generation + int8 weights.
+
+The serving-critical path is decode latency, and two round-3 features
+compose for it:
+
+1. ``generate(compiled="fused")`` — the ENTIRE decode loop (sampling
+   included) is one on-device ``lax.scan`` jit with a jitted prefill:
+   one dispatch and one host sync per request, instead of a round-trip
+   per token.  Measured on the v5e: 128 new tokens end-to-end in 0.30s
+   (b1) vs 2.0s for the per-token jitted step.
+2. ``quantize_weights_int8`` — calibration-free per-channel int8 weight
+   codes; decode is HBM-bandwidth-bound (the whole weight matrix is
+   read per token), so halving the bytes read halves the floor of
+   per-token latency.
+
+Run: python examples/serving_decode.py
+"""
+import os
+import sys
+import time
+
+# allow running as `python examples/<script>.py` from a repo checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTModel
+
+
+def bench(model, ids, n, mode, reps=3):
+    # warm/compile, then SYNC so residual async work stays out of the
+    # timed window
+    model.generate(ids, max_new_tokens=n, compiled=mode).numpy()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = model.generate(ids, max_new_tokens=n, compiled=mode)
+    out.numpy()
+    return out, (time.perf_counter() - t0) / reps
+
+
+def main():
+    paddle.seed(0)
+    # tiny config so the demo runs anywhere; swap for "gpt2-medium" on
+    # a real chip
+    cfg = os.environ.get("SERVING_CONFIG", "tiny")
+    model = GPTModel.from_config(cfg, dropout=0.0)
+    model.eval()
+    vocab = model.embeddings.word_embeddings.weight.shape[0]
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, vocab, (1, 16)).astype(
+            np.int32))
+    n = 24
+
+    per_tok, t_step = bench(model, ids, n, mode=True)
+    fused, t_fused = bench(model, ids, n, mode="fused")
+    assert per_tok.numpy().tolist() == fused.numpy().tolist(), \
+        "fused decode must be token-identical to the per-token step"
+    print(f"per-token jitted step: {t_step * 1000:8.1f} ms / request")
+    print(f"fused whole-decode   : {t_fused * 1000:8.1f} ms / request "
+          f"({t_step / t_fused:.1f}x)")
+
+    # weight-only int8: same API, the codes thread through the compiled
+    # decode as arguments (not baked constants)
+    from paddle_tpu.quantization import quantize_weights_int8
+    quantize_weights_int8(model)
+    q_out, t_q = bench(model, ids, n, mode="fused")
+    drift = float(np.mean(q_out.numpy() != fused.numpy()))
+    print(f"int8 fused decode    : {t_q * 1000:8.1f} ms / request "
+          f"(token drift vs bf16 greedy: {drift:.1%})")
+
+
+if __name__ == "__main__":
+    main()

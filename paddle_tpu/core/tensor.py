@@ -225,6 +225,14 @@ class Tensor:
 
     def __repr__(self):
         grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        import jax as _jax
+        if isinstance(self._data, _jax.core.Tracer):
+            # under jit there is no concrete value to show — raising
+            # from repr would turn every print/log of a traced tensor
+            # into a TracerArrayConversionError (use @to_static's print
+            # conversion to see runtime values)
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype}"
+                    f"{grad_info}, <traced>)")
         return (f"Tensor(shape={self.shape}, dtype={self.dtype}{grad_info},\n"
                 f"       {np.array2string(self.numpy(), threshold=40)})")
 

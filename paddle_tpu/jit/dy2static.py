@@ -296,6 +296,37 @@ def convert_call(fn):
     return fn
 
 
+def convert_print(*args, sep=" ", end="\n", **kwargs):
+    """reference: dygraph_to_static/print_transformer.py — ``print`` on a
+    traced tensor becomes the Print op; here ``jax.debug.print`` via a
+    host callback that replays full builtin-print semantics (sep/end/
+    file/flush), so the compiled program prints concrete values at run
+    time (an untransformed print would fire once at TRACE time with
+    abstract values).  Host-side values keep builtin print directly."""
+    is_arr = [_is_traced_tensor(a) or isinstance(a, jax.core.Tracer)
+              for a in args]
+    if not any(is_arr):
+        print(*args, sep=sep, end=end, **kwargs)
+        return
+    # the callback only transports arrays; static values (labels,
+    # numbers) are closed over and re-inserted by position
+    arrays = [a._data if isinstance(a, Tensor) else a
+              for a, t in zip(args, is_arr) if t]
+    statics = [a for a, t in zip(args, is_arr) if not t]
+
+    def host_print(*concrete):
+        # real builtin print: honors sep/end/file/flush and never
+        # formats through jax.debug.print's str.format (whose parser
+        # would choke on literal braces in the printed values)
+        it_c, it_s = iter(concrete), iter(statics)
+        merged = [next(it_c) if t else next(it_s) for t in is_arr]
+        print(*merged, sep=sep, end=end, **kwargs)
+
+    # ordered: consecutive prints must emit in program order (builtin
+    # print and the reference Print op are strictly ordered)
+    jax.debug.callback(host_print, *arrays, ordered=True)
+
+
 def convert_logical_not(x):
     if isinstance(x, Tensor):
         from ..ops import logical_not as _lnot
@@ -775,7 +806,25 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     def __init__(self):
         self.counter = 0
+        self.prints = 0
         self._ret_flags = []
+
+    # -- print ------------------------------------------------------------
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        # bare-name print(...) with plain args only (the reference's
+        # print_transformer makes the same syntactic bet); starred/dict
+        # splats keep Python semantics untouched
+        if isinstance(node.func, ast.Name) and node.func.id == "print" \
+                and not any(kw.arg is None for kw in node.keywords) \
+                and not any(isinstance(a, ast.Starred) for a in node.args):
+            self.prints += 1
+            return ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_JST, ctx=ast.Load()),
+                    attr="convert_print", ctx=ast.Load()),
+                args=node.args, keywords=node.keywords)
+        return node
 
     # -- if/else ----------------------------------------------------------
     def visit_If(self, node):
@@ -991,7 +1040,8 @@ def convert_function(fn):
     tree = exits.visit(tree)
     transformer = _ControlFlowTransformer()
     new_tree = transformer.visit(tree)
-    if transformer.counter == 0 and not exits.changed:
+    if transformer.counter == 0 and transformer.prints == 0 \
+            and not exits.changed:
         return None  # nothing to convert — tracing alone is enough
     ast.fix_missing_locations(new_tree)
     code = compile(new_tree, f"<dy2static:{fn.__qualname__}>", "exec")

@@ -692,3 +692,50 @@ class TestExitReviewRegressions:
         with pytest.raises(TypeError) as ei:
             jax.eval_shape(traced, jax.ShapeDtypeStruct((), np.float32))
         assert "loop carry" not in str(ei.value)
+
+
+class TestPrintTransformer:
+    def test_print_traced_tensor_fires_at_runtime(self, capfd):
+        """print(tensor) inside @to_static lowers to jax.debug.print —
+        it must fire on EVERY call with concrete values (an
+        untransformed print fires once at trace time with tracers).
+        reference: dygraph_to_static/print_transformer.py"""
+        import paddle_tpu as paddle
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def f(x):
+            print("val:", x)
+            return x * 2
+
+        f(paddle.to_tensor(np.float32(3.0))).numpy()
+        f(paddle.to_tensor(np.float32(4.0))).numpy()
+        err_out = capfd.readouterr()
+        txt = err_out.out + err_out.err
+        assert "val: 3" in txt, txt
+        assert "val: 4" in txt, txt
+        assert "Traced" not in txt  # no tracer repr leaked
+
+    def test_print_host_values_keep_builtin_semantics(self, capsys):
+        from paddle_tpu.jit.dy2static import convert_print
+        convert_print("a", 1, sep="-", end="!\n")
+        assert capsys.readouterr().out == "a-1!\n"
+
+    def test_print_sep_end_file_honored_when_traced(self, capfd):
+        """Braces in values, custom sep/end, and file=sys.stderr all keep
+        builtin-print semantics through the host callback."""
+        import paddle_tpu as paddle
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def f(x):
+            import sys
+            print("{curly}", x, sep="|", end=";")
+            print(x, file=sys.stderr)
+            return x + 1
+
+        f(paddle.to_tensor(np.float32(2.0))).numpy()
+        out = capfd.readouterr()
+        assert "{curly}|2" in out.out and out.out.rstrip().endswith(";"), \
+            out.out
+        assert "2" in out.err, out.err

@@ -22,6 +22,7 @@ SCRIPTS = [
     "ragged_text_buckets.py",
     "quant_aware_training.py",
     "packed_pretraining.py",
+    "serving_decode.py",
 ]
 
 
