@@ -60,7 +60,9 @@ def convert_ifelse(pred, true_fn, false_fn):
        itself (``1/jnp.where(s > 0, s, 1)``-style "double-where"), or
        keep the predicate a Python value so the branch dispatches for
        real.  Eager (concrete) tensor predicates are unaffected — they
-       pick one branch."""
+       pick one branch.  Converted side effects (``print``/``assert``)
+       ARE gated correctly: they consult the branch-activity mask and
+       stay silent in the unselected branch."""
     if _is_traced_tensor(pred):
         import jax.numpy as jnp
         from ..ops import where as _ops_where, reshape as _ops_reshape
@@ -69,8 +71,20 @@ def convert_ifelse(pred, true_fn, false_fn):
         p_t = pred if pred.ndim == 0 else _ops_reshape(pred, [])
         if str(p_t.dtype) != "bool":
             p_t = _ops_cast(p_t, "bool")
-        t_out = true_fn()
-        f_out = false_fn()
+        # record which branch is semantically active while tracing each
+        # closure: side-effect converters (assert/print) consult this so
+        # the UNSELECTED branch's effects stay silent even though both
+        # branches execute under the where-merge
+        _active_branch_preds.append(p_t._data)
+        try:
+            t_out = true_fn()
+        finally:
+            _active_branch_preds.pop()
+        _active_branch_preds.append(jnp.logical_not(p_t._data))
+        try:
+            f_out = false_fn()
+        finally:
+            _active_branch_preds.pop()
         t_flat, t_isseq = _flatten_branch(t_out)
         f_flat, _ = _flatten_branch(f_out)
         if len(t_flat) != len(f_flat):
@@ -296,6 +310,69 @@ def convert_call(fn):
     return fn
 
 
+# traced bool preds of the enclosing tensor-predicated if branches —
+# pushed/popped by convert_ifelse around each branch closure so that
+# side-effect converters (assert/print) can stay silent in the branch
+# the predicate did not select (both branches EXECUTE under the
+# where-merge; see convert_ifelse's warning)
+_active_branch_preds = []
+
+
+def _branch_active_mask():
+    """AND of the enclosing tensor-if branch predicates, or None when
+    not inside any tensor-predicated branch."""
+    if not _active_branch_preds:
+        return None
+    import jax.numpy as jnp
+    m = _active_branch_preds[0]
+    for p in _active_branch_preds[1:]:
+        m = jnp.logical_and(m, p)
+    return m
+
+
+def convert_assert(test, msg_fn=None):
+    """reference: dygraph_to_static/assert_transformer.py — ``assert`` on
+    a traced tensor becomes the Assert op (runtime check + abort).  Here
+    an ordered host callback raises AssertionError when the predicate
+    fails at run time; an untransformed assert would truthy-test a
+    TRACER and raise a confusing TracerBoolConversionError at trace
+    time.  Host-side predicates keep plain-assert semantics, including
+    not evaluating the (lazy) message unless the assert fails.  Inside
+    a tensor-predicated if, the check is gated on the branch actually
+    being selected."""
+    pred = test._data if isinstance(test, Tensor) else test
+    if not isinstance(pred, jax.core.Tracer):
+        active = _branch_active_mask()
+        if active is None:
+            if not test:
+                raise AssertionError(
+                    msg_fn() if msg_fn is not None else None)
+            return
+        # concrete predicate inside a TRACED branch: still gate on the
+        # branch mask at run time
+        import jax.numpy as jnp
+        pred = jnp.asarray(bool(test))
+
+    import jax.numpy as jnp
+    import numpy as _np
+    ok = jnp.all(pred)
+    active = _branch_active_mask()
+    violated = jnp.logical_not(ok) if active is None else \
+        jnp.logical_and(active, jnp.logical_not(ok))
+    # the message may reference traced values — it can only be built at
+    # trace time (tracer reprs render as <traced>)
+    msg = msg_fn() if msg_fn is not None else None
+
+    def host_check(bad):
+        # plain numpy only: calling back into jax from inside a debug
+        # callback is documented deadlock-bait
+        if bool(_np.asarray(bad)):
+            raise AssertionError(
+                msg if msg is not None else "dy2static assert failed")
+
+    jax.debug.callback(host_check, violated, ordered=True)
+
+
 def convert_print(*args, sep=" ", end="\n", **kwargs):
     """reference: dygraph_to_static/print_transformer.py — ``print`` on a
     traced tensor becomes the Print op; here ``jax.debug.print`` via a
@@ -305,16 +382,25 @@ def convert_print(*args, sep=" ", end="\n", **kwargs):
     abstract values).  Host-side values keep builtin print directly."""
     is_arr = [_is_traced_tensor(a) or isinstance(a, jax.core.Tracer)
               for a in args]
-    if not any(is_arr):
+    active = _branch_active_mask()
+    if not any(is_arr) and active is None:
         print(*args, sep=sep, end=end, **kwargs)
         return
     # the callback only transports arrays; static values (labels,
     # numbers) are closed over and re-inserted by position
+    import jax.numpy as jnp
+    import numpy as _np
     arrays = [a._data if isinstance(a, Tensor) else a
               for a, t in zip(args, is_arr) if t]
     statics = [a for a, t in zip(args, is_arr) if not t]
+    if active is None:
+        active = jnp.asarray(True)
 
-    def host_print(*concrete):
+    def host_print(act, *concrete):
+        # skipped when the enclosing tensor-if branch was not selected
+        # (both branches execute under the where-merge)
+        if not bool(_np.asarray(act)):
+            return
         # real builtin print: honors sep/end/file/flush and never
         # formats through jax.debug.print's str.format (whose parser
         # would choke on literal braces in the printed values)
@@ -324,7 +410,7 @@ def convert_print(*args, sep=" ", end="\n", **kwargs):
 
     # ordered: consecutive prints must emit in program order (builtin
     # print and the reference Print op are strictly ordered)
-    jax.debug.callback(host_print, *arrays, ordered=True)
+    jax.debug.callback(host_print, active, *arrays, ordered=True)
 
 
 def convert_logical_not(x):
@@ -807,7 +893,29 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self.counter = 0
         self.prints = 0
+        self.asserts = 0
         self._ret_flags = []
+
+    # -- assert -----------------------------------------------------------
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        self.asserts += 1
+        # msg wrapped in a thunk: Python's assert evaluates the message
+        # only on failure, and msg expressions may be failure-path-only
+        # safe (or side-effectful)
+        msg_args = []
+        if node.msg:
+            msg_args.append(ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[],
+                                   kwonlyargs=[], kw_defaults=[],
+                                   defaults=[]),
+                body=node.msg))
+        call = ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                               attr="convert_assert", ctx=ast.Load()),
+            args=[node.test] + msg_args,
+            keywords=[])
+        return ast.copy_location(ast.Expr(value=call), node)
 
     # -- print ------------------------------------------------------------
     def visit_Call(self, node):
@@ -1041,7 +1149,7 @@ def convert_function(fn):
     transformer = _ControlFlowTransformer()
     new_tree = transformer.visit(tree)
     if transformer.counter == 0 and transformer.prints == 0 \
-            and not exits.changed:
+            and transformer.asserts == 0 and not exits.changed:
         return None  # nothing to convert — tracing alone is enough
     ast.fix_missing_locations(new_tree)
     code = compile(new_tree, f"<dy2static:{fn.__qualname__}>", "exec")

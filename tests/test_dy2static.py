@@ -739,3 +739,91 @@ class TestPrintTransformer:
         assert "{curly}|2" in out.out and out.out.rstrip().endswith(";"), \
             out.out
         assert "2" in out.err, out.err
+
+
+class TestAssertTransformer:
+    def test_assert_traced_passes_and_fails_at_runtime(self):
+        """assert on a traced predicate becomes a runtime check
+        (reference assert_transformer.py -> Assert op); untransformed it
+        would raise TracerBoolConversionError at trace time."""
+        import pytest as _pytest
+        import paddle_tpu as paddle
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def f(x):
+            assert x > 0, "need positive"
+            return x * 3
+
+        out = f(paddle.to_tensor(np.float32(2.0)))
+        assert float(out.numpy()) == 6.0
+        with _pytest.raises(Exception, match="need positive"):
+            f(paddle.to_tensor(np.float32(-1.0))).numpy()
+
+    def test_assert_host_value_keeps_plain_semantics(self):
+        from paddle_tpu.jit.dy2static import convert_assert
+        convert_assert(True)
+        import pytest as _pytest
+        with _pytest.raises(AssertionError, match="boom"):
+            convert_assert(False, lambda: "boom")
+        with _pytest.raises(AssertionError):
+            convert_assert(0)
+
+    def test_assert_in_unselected_branch_stays_silent(self):
+        """convert_ifelse executes BOTH branches under a traced
+        predicate; an assert (or print) in the branch the predicate did
+        NOT select must not fire (gated on the branch-activity mask)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def f(x):
+            if x > 0:
+                assert x > 1, "pos branch"
+                y = x * 2
+            else:
+                y = -x
+            return y
+
+        # else-path input: the true-branch assert must NOT abort
+        out = f(paddle.to_tensor(np.float32(-5.0)))
+        assert float(out.numpy()) == 5.0
+        # true-path input violating the assert still aborts
+        import pytest as _pytest
+        with _pytest.raises(Exception, match="pos branch"):
+            f(paddle.to_tensor(np.float32(0.5))).numpy()
+
+    def test_print_in_unselected_branch_stays_silent(self, capfd):
+        import paddle_tpu as paddle
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def g(x):
+            if x > 0:
+                print("POSITIVE", x)
+                y = x * 2
+            else:
+                print("NEGATIVE", x)
+                y = -x
+            return y
+
+        g(paddle.to_tensor(np.float32(-3.0))).numpy()
+        out = capfd.readouterr()
+        txt = out.out + out.err
+        assert "NEGATIVE" in txt and "POSITIVE" not in txt, txt
+
+    def test_assert_msg_lazy_on_host(self):
+        """Python's assert evaluates the message only on failure."""
+        from paddle_tpu.jit import to_static
+        import paddle_tpu as paddle
+        calls = []
+
+        @to_static
+        def h(x):
+            assert True, calls.append("evaluated") or "m"
+            return x + 1
+
+        # host predicate True: msg thunk must not run
+        out = h(paddle.to_tensor(np.float32(1.0)))
+        assert float(out.numpy()) == 2.0
+        assert calls == [], calls
