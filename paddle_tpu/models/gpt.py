@@ -603,6 +603,8 @@ class GPTModel(nn.Layer):
             return last, new_k, new_v
 
         fn = jax.jit(pure, donate_argnums=(2, 3))
+        if len(cache) >= 8:  # FIFO bound, matching the other decode caches
+            cache.pop(next(iter(cache)))
         cache[cache_key] = (fn, bnames, mbuffers)
         return cache[cache_key]
 
