@@ -247,3 +247,110 @@ class TestMultiPrecision:
         assert st_lp["moment2"].dtype == jnp.bfloat16
         # the low-precision step still moves params sanely
         assert np.isfinite(net_lp.weight.numpy().astype(np.float32)).all()
+
+
+class TestFusedFlatUpdate:
+    """opt.fuse_update=True groups params into flat slabs and runs the
+    elementwise rule once per group — results must equal the
+    per-parameter path exactly."""
+
+    def _tree_close(self, a, b):
+        import jax
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=0, atol=0)
+
+    @pytest.mark.parametrize("make_opt", [
+        lambda: optimizer.SGD(learning_rate=0.1),
+        lambda: optimizer.Momentum(learning_rate=0.1, momentum=0.9),
+        lambda: optimizer.Adam(learning_rate=1e-3),
+        lambda: optimizer.AdamW(learning_rate=1e-3, weight_decay=0.01),
+        lambda: optimizer.RMSProp(learning_rate=1e-3),
+    ])
+    def test_matches_per_param_path(self, make_opt):
+        import jax.numpy as jnp
+        rng = np.random.RandomState(0)
+        params = {
+            "w1": jnp.asarray(rng.randn(8, 16).astype(np.float32)),
+            "b1": jnp.asarray(rng.randn(16).astype(np.float32)),
+            "w2": jnp.asarray(rng.randn(16, 4).astype(np.float32)),
+            "scalar": jnp.asarray(np.float32(rng.randn())),
+        }
+        grads = {k: jnp.asarray(
+            rng.standard_normal(v.shape).astype(np.float32))
+                 for k, v in params.items()}
+        lr = jnp.asarray(1e-2, jnp.float32)
+
+        opt_a, opt_b = make_opt(), make_opt()
+        state_a = opt_a.init_state_tree(params)
+        state_b = opt_b.init_state_tree(params)
+        opt_b.fuse_update = True
+        assert opt_b._elementwise_rule
+        pa, sa = params, state_a
+        pb, sb = params, state_b
+        for _ in range(3):
+            pa, sa = opt_a.apply_gradients_tree(pa, grads, sa, lr)
+            pb, sb = opt_b.apply_gradients_tree(pb, grads, sb, lr)
+        self._tree_close(pa, pb)
+        self._tree_close(sa, sb)
+
+    def test_adamw_decay_mask_groups(self):
+        """apply_decay_param_fun splits fused groups; masked params get
+        no decay, exactly as per-param."""
+        import jax.numpy as jnp
+        rng = np.random.RandomState(1)
+        params = {"w": jnp.asarray(rng.randn(4, 4).astype(np.float32)),
+                  "ln_bias": jnp.asarray(rng.randn(4).astype(np.float32))}
+        grads = {k: jnp.zeros_like(v) for k, v in params.items()}
+        lr = jnp.asarray(1.0, jnp.float32)
+
+        def make():
+            return optimizer.AdamW(
+                learning_rate=1.0, weight_decay=0.5,
+                apply_decay_param_fun=lambda n: "bias" not in n)
+
+        oa, ob = make(), make()
+        sa, sb = oa.init_state_tree(params), ob.init_state_tree(params)
+        ob.fuse_update = True
+        pa, sa = oa.apply_gradients_tree(params, grads, sa, lr)
+        pb, sb = ob.apply_gradients_tree(params, grads, sb, lr)
+        self._tree_close(pa, pb)
+        # decay moved w but not ln_bias (zero grads isolate decay)
+        assert not np.allclose(np.asarray(pb["w"]),
+                               np.asarray(params["w"]))
+        np.testing.assert_allclose(np.asarray(pb["ln_bias"]),
+                                   np.asarray(params["ln_bias"]))
+
+    def test_lars_never_fuses(self):
+        o = optimizer.LarsMomentum(learning_rate=0.1)
+        o.fuse_update = True
+        assert not o._elementwise_rule  # per-param trust ratio
+
+    def test_train_step_parity_env_flag(self, monkeypatch):
+        """A full compiled TrainStep produces the same loss trajectory
+        with PADDLE_TPU_FUSE_OPT=1."""
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.parallel.train_step import TrainStep
+        rng = np.random.RandomState(2)
+        x = rng.randn(8, 12).astype(np.float32)
+        y = rng.randint(0, 3, (8,)).astype(np.int64)
+
+        def run(fuse):
+            # exercise the REAL env knob, not just the attribute
+            if fuse:
+                monkeypatch.setenv("PADDLE_TPU_FUSE_OPT", "1")
+            else:
+                monkeypatch.delenv("PADDLE_TPU_FUSE_OPT", raising=False)
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(12, 16), nn.ReLU(),
+                                nn.Linear(16, 3))
+            opt = optimizer.AdamW(learning_rate=1e-2,
+                                  parameters=net.parameters())
+            assert opt.fuse_update is fuse
+            step = TrainStep(net, opt, loss_fn=nn.CrossEntropyLoss())
+            return [float(step.step([x], [y]).numpy())
+                    for _ in range(4)]
+
+        np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
