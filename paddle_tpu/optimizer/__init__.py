@@ -103,8 +103,12 @@ class Optimizer:
             is_leaf=lambda x: isinstance(x, jnp.ndarray) or hasattr(
                 x, "shape"))
 
-    def apply_gradients_tree(self, params_tree, grads_tree, state_tree, lr):
-        """Pure whole-tree update; call inside jit."""
+    def apply_gradients_tree(self, params_tree, grads_tree, state_tree,
+                             lr, fuse=None):
+        """Pure whole-tree update; call inside jit.  ``fuse`` overrides
+        ``self.fuse_update`` for this call — TrainStep passes False when
+        params are sharded (the flat-slab concat would all-gather
+        TP/FSDP/pp shards) without mutating the caller's optimizer."""
         if self._grad_clip is not None:
             grads_tree = self._grad_clip.apply_tree(grads_tree)
         flat_kp, treedef = jax.tree_util.tree_flatten_with_path(params_tree)
@@ -114,11 +118,12 @@ class Optimizer:
         flat_g = treedef.flatten_up_to(grads_tree)
         flat_s = treedef.flatten_up_to(state_tree)
         has_mask = hasattr(self, "_decay_for_name")
+        fuse = self.fuse_update if fuse is None else fuse
         # fused path requires all-dense grads: a None grad this call
         # would leave that param's SCALAR state (beta pows) lagging its
         # future group — sharing the group scalar would then silently
         # mis-correct it (see _fused_flat_update's precondition)
-        if self.fuse_update and self._elementwise_rule \
+        if fuse and self._elementwise_rule \
                 and not any(g is None for g in flat_g):
             new_p, new_s = self._fused_flat_update(
                 names, flat_p, flat_g, flat_s, lr, has_mask)

@@ -118,6 +118,22 @@ class TrainStep:
                     spec_map[k] = P()
         self.param_specs = {k: spec_map.get(k, P()) for k in self.pnames}
 
+        # the flat-slab fused optimizer update concatenates params into
+        # one vector — only sound when params are REPLICATED (dp).
+        # Under TP/FSDP shardings the concat would force all-gathers of
+        # every shard each step; keep the per-param path there (those
+        # updates are already shard-local).  Passed per-call (no
+        # mutation of the caller's optimizer)
+        self._fuse_opt = None  # optimizer's own setting
+        if getattr(self.optimizer, "fuse_update", False) and any(
+                spec != P() for spec in self.param_specs.values()):
+            import logging
+            logging.getLogger("paddle_tpu").info(
+                "fuse_update disabled for this TrainStep: params are "
+                "sharded (TP/FSDP); the fused flat-slab update applies "
+                "to replicated-param regimes only")
+            self._fuse_opt = False
+
         self.params = {}
         for k in self.pnames:
             arr = params[k]._data
@@ -340,7 +356,8 @@ class TrainStep:
 
             new_sub, new_opt_sub = self.optimizer.apply_gradients_tree(
                 p_sub, grads,
-                {k: opt_state[k] for k in p_sub}, lr)
+                {k: opt_state[k] for k in p_sub}, lr,
+                fuse=self._fuse_opt)
             new_params = dict(params)
             new_params.update(new_sub)
             new_opt = dict(opt_state)
@@ -400,8 +417,10 @@ class TrainStep:
 
             (loss, (new_bufs, metric_outs)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
+            # pipeline: block params are pp-sharded stacks — the flat
+            # concat would all-gather them; never fuse here
             new_params, new_opt = self.optimizer.apply_gradients_tree(
-                params, grads, opt_state, lr)
+                params, grads, opt_state, lr, fuse=False)
             return loss, new_params, new_bufs, new_opt, metric_outs
 
         donate = (0, 2) if self.donate else ()
@@ -421,7 +440,7 @@ class TrainStep:
                 inputs[0], labels[0] if labels else None, key)
             grads = {"pre": g_pre, "block": g_block, "post": g_post}
             new_params, new_opt = self.optimizer.apply_gradients_tree(
-                params, grads, opt_state, lr)
+                params, grads, opt_state, lr, fuse=False)
             return loss, new_params, new_bufs, new_opt, []
 
         if self.metrics:

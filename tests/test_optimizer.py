@@ -354,3 +354,43 @@ class TestFusedFlatUpdate:
                     for _ in range(4)]
 
         np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
+
+    def test_sharded_params_disable_fusion(self):
+        """TP/FSDP-sharded TrainStep keeps the per-param update (the
+        flat concat would all-gather every shard each step)."""
+        import paddle_tpu as paddle
+        from paddle_tpu import distributed as dist, nn
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.parallel.train_step import TrainStep
+        paddle.seed(0)
+        dist.set_mesh(dist.build_mesh(dp=2, sharding=4))
+        try:
+            self._run_sharded_leg()
+        finally:
+            dist.set_mesh(None)
+
+    def _run_sharded_leg(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.parallel.train_step import TrainStep
+        strategy = DistributedStrategy()
+        strategy.sharding = True
+        strategy.sharding_configs["stage"] = 3
+        strategy.sharding_configs["min_shard_size"] = 1
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                            nn.Linear(32, 4))
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=net.parameters())
+        opt.fuse_update = True
+        step = TrainStep(net, opt, loss_fn=nn.CrossEntropyLoss(),
+                         strategy=strategy)
+        # the optimizer instance is NOT mutated; the step-local override
+        # carries the decision
+        assert opt.fuse_update is True
+        assert step._fuse_opt is False
+        x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+        y = np.zeros((8,), np.int64)
+        loss = float(step.step([x], [y]).numpy())
+        assert np.isfinite(loss)
