@@ -301,6 +301,120 @@ class GPTBlock(nn.Layer):
         return self._inner(x, doc_segments)
 
 
+class GPTScanBlocks(nn.Layer):
+    """All transformer blocks as ONE ``lax.scan`` over stacked params.
+
+    TPU-native alternative to the unrolled LayerList: XLA compiles the
+    block body ONCE instead of ``num_layers`` times — the dominant cost
+    of big-model compiles (GPT-3 1.3B unrolled measured 200s of XLA
+    on-device; see BASELINE.md) — and with ``use_recompute`` the scan
+    body is ``jax.checkpoint``'ed, the canonical remat-over-scan recipe
+    for fitting long models in HBM.
+
+    Initialization is bit-identical to the unrolled model: the
+    per-layer blocks are constructed with the same RNG draws in the
+    same order, then their parameters stacked into [L, ...] leaves (so
+    an unrolled and a scan model built under the same seed agree
+    exactly; ``tests/test_gpt_scan.py``).  Dropout decorrelates across
+    layers by folding the layer index into the step key.  Scope: the
+    dense training/forward path — KV-cache decode, tensor/sequence
+    parallel and MoE variants stay on the unrolled form."""
+
+    def __init__(self, num_layers, hidden_size, num_heads, dropout=0.1,
+                 use_recompute=False, recompute_policy=None):
+        super().__init__()
+        self.num_layers = num_layers
+        self.dropout = dropout
+        self.use_recompute = use_recompute
+        self.recompute_policy = recompute_policy
+        # build blocks ONE at a time, harvest leaves, drop the block —
+        # holding all L blocks plus the stacked copies would peak at 2x
+        # model size during init (RNG draw order stays identical to the
+        # unrolled LayerList, so init remains bit-equal)
+        import jax.numpy as jnp
+        from ..core.tensor import Parameter
+        per_leaf: dict = {}
+        template = None
+        for i in range(num_layers):
+            blk = GPTBlock(hidden_size, num_heads, dropout)
+            if template is None:
+                template = blk
+                self._stack_names = [n for n, _ in
+                                     blk.named_parameters()]
+            for name, p in blk.named_parameters():
+                per_leaf.setdefault(name, []).append(p._data)
+            if i:
+                del blk
+        # template block: structure donor for the single body trace.
+        # object.__setattr__ bypasses sublayer registration — its own
+        # (layer-0) param values are shadowed by the stacked leaves
+        object.__setattr__(self, "_template", template)
+        for name in self._stack_names:
+            parts = per_leaf.pop(name)
+            self.add_parameter(name.replace(".", "__"),
+                               Parameter(jnp.stack(parts)))
+            del parts
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+        from ..core import rng as rng_mod
+        from ..core.dispatch import primitive
+        from ..jit import functional_call
+
+        tmpl = self._template
+        (tmpl.train() if self.training else tmpl.eval())
+        names = self._stack_names
+        # pass the Parameter TENSORS: the primitive wrapper records the
+        # eager tape against them (raw arrays would sever backward)
+        leaves = [self._parameters[n.replace(".", "__")]
+                  for n in names]
+        use_key = self.training and self.dropout > 0.0
+        key = rng_mod.next_key() if use_key else None
+
+        def scan_all(x_arr, key_arr, *stacked):
+            def body(carry, xs):
+                idx = xs[0]
+                layer_leaves = xs[1:]
+                key_l = jax.random.fold_in(key_arr, idx) \
+                    if key_arr is not None else None
+                out, _ = functional_call(
+                    tmpl, dict(zip(names, layer_leaves)), {},
+                    (carry,), training=self.training, rng_key=key_l)
+                return out, None
+
+            if self.use_recompute:
+                from ..distributed.fleet.utils import REMAT_POLICIES
+                policy = self.recompute_policy
+                if isinstance(policy, str):
+                    policy = REMAT_POLICIES[policy]
+                # prevent_cse=False: the scan already provides the
+                # optimization barrier remat needs (jax's documented
+                # remat-over-scan form; default True inserts slower
+                # CSE-workaround ops for nothing)
+                body = jax.checkpoint(body, policy=policy,
+                                      prevent_cse=False)
+            xs = (jnp.arange(self.num_layers, dtype=jnp.int32),
+                  *stacked)
+            y, _ = jax.lax.scan(body, x_arr, xs)
+            return y
+
+        if use_key:
+            op = primitive(name="gpt_scan_blocks", nondiff=(1,))(scan_all)
+            return op(x, key, *leaves)
+        op = primitive(name="gpt_scan_blocks")(
+            lambda x_arr, *stacked: scan_all(x_arr, None, *stacked))
+        return op(x, *leaves)
+
+    def train(self):
+        self._template.train()
+        return super().train()
+
+    def eval(self):
+        self._template.eval()
+        return super().eval()
+
+
 class GPTLMHead(nn.Layer):
     def __init__(self, hidden_size, vocab_size, use_mp=False):
         super().__init__()
@@ -327,7 +441,7 @@ class GPTModel(nn.Layer):
                  vocab_size=50304, max_position=1024, dropout=0.1,
                  use_mp=False, use_recompute=False, moe_experts=0,
                  moe_every=2, fused_loss=False, recompute_policy=None,
-                 use_sp=False, fused_loss_chunk=128):
+                 use_sp=False, fused_loss_chunk=128, scan_layers=False):
         super().__init__()
         self.fused_loss = fused_loss
         # sequence-chunk size of the fused head+CE scan: larger chunks =
@@ -339,16 +453,32 @@ class GPTModel(nn.Layer):
         # for an expert-parallel MoE layer; moe_every=1 -> every block
         if moe_experts and moe_every < 1:
             raise ValueError(f"moe_every must be >= 1, got {moe_every}")
-        self.blocks = nn.LayerList([
-            GPTBlock(hidden_size, num_heads, dropout, use_mp,
-                     use_recompute,
-                     moe_experts=(moe_experts
-                                  if moe_experts
-                                  and (i + 1) % moe_every == 0
-                                  else 0),
-                     recompute_policy=recompute_policy,
-                     use_sp=use_sp)
-            for i in range(num_layers)])
+        self.scan_layers = scan_layers
+        if scan_layers:
+            # one compiled block body instead of num_layers copies (see
+            # GPTScanBlocks); heterogeneous/parallel block variants keep
+            # the unrolled form
+            if use_mp or use_sp or moe_experts:
+                raise ValueError(
+                    "scan_layers supports the dense block only — "
+                    "tensor/sequence-parallel and MoE variants use the "
+                    "unrolled form (their blocks are not homogeneous "
+                    "scan bodies)")
+            self.blocks = GPTScanBlocks(
+                num_layers, hidden_size, num_heads, dropout,
+                use_recompute=use_recompute,
+                recompute_policy=recompute_policy)
+        else:
+            self.blocks = nn.LayerList([
+                GPTBlock(hidden_size, num_heads, dropout, use_mp,
+                         use_recompute,
+                         moe_experts=(moe_experts
+                                      if moe_experts
+                                      and (i + 1) % moe_every == 0
+                                      else 0),
+                         recompute_policy=recompute_policy,
+                         use_sp=use_sp)
+                for i in range(num_layers)])
         self.head = GPTLMHead(hidden_size, vocab_size, use_mp)
 
     def forward(self, input_ids, labels=None, caches=None,
@@ -374,14 +504,23 @@ class GPTModel(nn.Layer):
                                                  labels._data.dtype)))
         x = self.embeddings(input_ids, position_offset=position_offset,
                             position_ids=position_ids)
-        if caches is not None:
-            new_caches = []
-            for blk, cache in zip(self.blocks, caches):
-                x, cache = blk(x, cache=cache)
-                new_caches.append(cache)
-            return self.head(x), new_caches
-        for blk in self.blocks:
-            x = blk(x, doc_segments=doc_segments)
+        if self.scan_layers:
+            if caches is not None or doc_segments is not None:
+                raise NotImplementedError(
+                    "scan_layers covers the dense training/forward "
+                    "path; KV-cache decode and packed sequences use "
+                    "the unrolled model (state_dicts interconvert by "
+                    "stacking/unstacking the block leaves)")
+            x = self.blocks(x)
+        else:
+            if caches is not None:
+                new_caches = []
+                for blk, cache in zip(self.blocks, caches):
+                    x, cache = blk(x, cache=cache)
+                    new_caches.append(cache)
+                return self.head(x), new_caches
+            for blk in self.blocks:
+                x = blk(x, doc_segments=doc_segments)
         if labels is not None and self.fused_loss \
                 and not self.head.use_mp:
             # head + CE fused per sequence chunk: the [B, S, vocab] logits
@@ -632,6 +771,11 @@ class GPTModel(nn.Layer):
         from ..core import rng as rng_mod, autograd
         from ..core.tensor import Tensor as T
 
+        if self.scan_layers:
+            raise NotImplementedError(
+                "generate() needs per-block KV caches — decode with the "
+                "unrolled model (scan and unrolled state_dicts "
+                "interconvert by stacking/unstacking the block leaves)")
         ids = input_ids._data if hasattr(input_ids, "_data") else \
             jnp.asarray(input_ids)
         b, s = ids.shape

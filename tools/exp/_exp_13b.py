@@ -43,6 +43,7 @@ def build(args):
     paddle.seed(0)
     model = GPTModel.from_config(
         "gpt3-1.3b", dropout=args.dropout, fused_loss=True,
+        scan_layers=args.scan,
         use_recompute=not args.no_remat,
         recompute_policy=(None if args.policy == "full" else args.policy)
         if not args.no_remat else None)
@@ -64,6 +65,9 @@ def main():
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--dropout", type=float, default=0.0)
     ap.add_argument("--compile-only", action="store_true")
+    ap.add_argument("--scan", action="store_true",
+                    help="scan-over-layers form (one compiled block "
+                         "body; see GPTScanBlocks)")
     ap.add_argument("--no-remat", action="store_true")
     ap.add_argument("--policy", default="dots",
                     choices=["full", "dots", "nothing", "everything"])
