@@ -120,3 +120,24 @@ def test_unsupported_paths_raise():
     with pytest.raises(NotImplementedError):
         scan(paddle.to_tensor(np.zeros((1, 8), np.int32)),
              doc_lens=paddle.to_tensor(np.array([[8]], np.int32)))
+
+
+def test_scan_layers_dp_mesh():
+    """scan_layers composes with the dp-sharded TrainStep: same losses
+    as single-device."""
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.parallel.train_step import TrainStep
+    x, y = _data(b=8)
+
+    def run(mesh):
+        paddle.seed(0)
+        m = GPTModel.from_config("tiny", dropout=0.0, fused_loss=True,
+                                 max_position=64, scan_layers=True)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=m.parameters())
+        step = TrainStep(m, opt, loss_fn=None, mesh=mesh)
+        return [float(step.step([x, y]).numpy()) for _ in range(3)]
+
+    single = run(None)
+    dp = run(dist.build_mesh(dp=8))
+    np.testing.assert_allclose(single, dp, rtol=1e-5)
