@@ -68,7 +68,8 @@ class BertPooler(nn.Layer):
 class BertModel(nn.Layer):
     def __init__(self, num_layers=12, hidden_size=768, num_heads=12,
                  vocab_size=30522, max_position=512, type_vocab_size=2,
-                 intermediate_size=3072, dropout=0.1, with_pool=True):
+                 intermediate_size=3072, dropout=0.1, with_pool=True,
+                 scan_layers=False):
         super().__init__()
         self.embeddings = BertEmbeddings(vocab_size, hidden_size,
                                          max_position, type_vocab_size,
@@ -76,7 +77,10 @@ class BertModel(nn.Layer):
         enc_layer = nn.TransformerEncoderLayer(
             hidden_size, num_heads, intermediate_size, dropout=dropout,
             activation="gelu")
-        self.encoder = nn.TransformerEncoder(enc_layer, num_layers)
+        # scan_layers: the 12/24-layer encoder compiles ONE body (see
+        # nn.ScanLayers) — same init/math as unrolled
+        self.encoder = nn.TransformerEncoder(enc_layer, num_layers,
+                                             scan_layers=scan_layers)
         self.pooler = BertPooler(hidden_size) if with_pool else None
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):

@@ -20,6 +20,7 @@ from .. import nn
 from ..nn import functional as F
 from ..nn import initializer as I
 from ..core.tensor import Tensor
+from ..nn.layer.scan import ScanLayers
 from ..ops import reshape, transpose, concat
 
 
@@ -301,118 +302,24 @@ class GPTBlock(nn.Layer):
         return self._inner(x, doc_segments)
 
 
-class GPTScanBlocks(nn.Layer):
-    """All transformer blocks as ONE ``lax.scan`` over stacked params.
+class GPTScanBlocks(ScanLayers):
+    """All transformer blocks as ONE ``lax.scan`` over stacked params
+    (see ``nn.ScanLayers`` for the general mechanism and contracts).
 
-    TPU-native alternative to the unrolled LayerList: XLA compiles the
-    block body ONCE instead of ``num_layers`` times — the dominant cost
-    of big-model compiles (GPT-3 1.3B unrolled measured 200s of XLA
-    on-device; see BASELINE.md) — and with ``use_recompute`` the scan
-    body is ``jax.checkpoint``'ed, the canonical remat-over-scan recipe
-    for fitting long models in HBM.
-
-    Initialization is bit-identical to the unrolled model: the
-    per-layer blocks are constructed with the same RNG draws in the
-    same order, then their parameters stacked into [L, ...] leaves (so
-    an unrolled and a scan model built under the same seed agree
-    exactly; ``tests/test_gpt_scan.py``).  Dropout decorrelates across
-    layers by folding the layer index into the step key.  Scope: the
-    dense training/forward path — KV-cache decode, tensor/sequence
-    parallel and MoE variants stay on the unrolled form."""
+    Init is bit-identical to the unrolled ``LayerList`` under the same
+    seed, training parity is exact (``tests/test_gpt_scan.py``), and
+    the 1.3B full-step XLA compile drops 212-460s -> 18.6s on the CPU
+    rehearsal (BASELINE.md round 3).  Scope: the dense training/forward
+    path — KV-cache decode, tensor/sequence parallel and MoE variants
+    stay on the unrolled form (their blocks are not homogeneous scan
+    bodies)."""
 
     def __init__(self, num_layers, hidden_size, num_heads, dropout=0.1,
                  use_recompute=False, recompute_policy=None):
-        super().__init__()
-        self.num_layers = num_layers
-        self.dropout = dropout
-        self.use_recompute = use_recompute
-        self.recompute_policy = recompute_policy
-        # build blocks ONE at a time, harvest leaves, drop the block —
-        # holding all L blocks plus the stacked copies would peak at 2x
-        # model size during init (RNG draw order stays identical to the
-        # unrolled LayerList, so init remains bit-equal)
-        import jax.numpy as jnp
-        from ..core.tensor import Parameter
-        per_leaf: dict = {}
-        template = None
-        for i in range(num_layers):
-            blk = GPTBlock(hidden_size, num_heads, dropout)
-            if template is None:
-                template = blk
-                self._stack_names = [n for n, _ in
-                                     blk.named_parameters()]
-            for name, p in blk.named_parameters():
-                per_leaf.setdefault(name, []).append(p._data)
-            if i:
-                del blk
-        # template block: structure donor for the single body trace.
-        # object.__setattr__ bypasses sublayer registration — its own
-        # (layer-0) param values are shadowed by the stacked leaves
-        object.__setattr__(self, "_template", template)
-        for name in self._stack_names:
-            parts = per_leaf.pop(name)
-            self.add_parameter(name.replace(".", "__"),
-                               Parameter(jnp.stack(parts)))
-            del parts
-
-    def forward(self, x):
-        import jax
-        import jax.numpy as jnp
-        from ..core import rng as rng_mod
-        from ..core.dispatch import primitive
-        from ..jit import functional_call
-
-        tmpl = self._template
-        (tmpl.train() if self.training else tmpl.eval())
-        names = self._stack_names
-        # pass the Parameter TENSORS: the primitive wrapper records the
-        # eager tape against them (raw arrays would sever backward)
-        leaves = [self._parameters[n.replace(".", "__")]
-                  for n in names]
-        use_key = self.training and self.dropout > 0.0
-        key = rng_mod.next_key() if use_key else None
-
-        def scan_all(x_arr, key_arr, *stacked):
-            def body(carry, xs):
-                idx = xs[0]
-                layer_leaves = xs[1:]
-                key_l = jax.random.fold_in(key_arr, idx) \
-                    if key_arr is not None else None
-                out, _ = functional_call(
-                    tmpl, dict(zip(names, layer_leaves)), {},
-                    (carry,), training=self.training, rng_key=key_l)
-                return out, None
-
-            if self.use_recompute:
-                from ..distributed.fleet.utils import REMAT_POLICIES
-                policy = self.recompute_policy
-                if isinstance(policy, str):
-                    policy = REMAT_POLICIES[policy]
-                # prevent_cse=False: the scan already provides the
-                # optimization barrier remat needs (jax's documented
-                # remat-over-scan form; default True inserts slower
-                # CSE-workaround ops for nothing)
-                body = jax.checkpoint(body, policy=policy,
-                                      prevent_cse=False)
-            xs = (jnp.arange(self.num_layers, dtype=jnp.int32),
-                  *stacked)
-            y, _ = jax.lax.scan(body, x_arr, xs)
-            return y
-
-        if use_key:
-            op = primitive(name="gpt_scan_blocks", nondiff=(1,))(scan_all)
-            return op(x, key, *leaves)
-        op = primitive(name="gpt_scan_blocks")(
-            lambda x_arr, *stacked: scan_all(x_arr, None, *stacked))
-        return op(x, *leaves)
-
-    def train(self):
-        self._template.train()
-        return super().train()
-
-    def eval(self):
-        self._template.eval()
-        return super().eval()
+        super().__init__(
+            lambda: GPTBlock(hidden_size, num_heads, dropout),
+            num_layers, use_recompute=use_recompute,
+            recompute_policy=recompute_policy)
 
 
 class GPTLMHead(nn.Layer):

@@ -167,16 +167,45 @@ class TransformerEncoderLayer(Layer):
 
 
 class TransformerEncoder(Layer):
-    def __init__(self, encoder_layer, num_layers, norm=None):
+    """reference: nn/layer/transformer.py TransformerEncoder (:576).
+
+    ``scan_layers=True`` (NEW vs reference) runs the stack as ONE
+    ``lax.scan`` over stacked layer params (see ``nn.ScanLayers``) —
+    the body compiles once instead of ``num_layers`` times.  Init
+    matches the unrolled form exactly (both start from deep copies of
+    ``encoder_layer``).  Cache-based incremental decode requires the
+    unrolled form."""
+
+    def __init__(self, encoder_layer, num_layers, norm=None,
+                 scan_layers=False):
         super().__init__()
         import copy
-        self.layers = LayerList(
-            [encoder_layer if i == 0 else copy.deepcopy(encoder_layer)
-             for i in range(num_layers)])
+        self.scan_layers = scan_layers
+        if scan_layers:
+            from .scan import ScanLayers
+            first = [encoder_layer]
+            self.layers = ScanLayers(
+                lambda: first.pop() if first
+                else copy.deepcopy(encoder_layer),
+                num_layers)
+        else:
+            self.layers = LayerList(
+                [encoder_layer if i == 0 else copy.deepcopy(encoder_layer)
+                 for i in range(num_layers)])
         self.num_layers = num_layers
         self.norm = norm
 
     def forward(self, src, src_mask=None, cache=None):
+        if self.scan_layers:
+            if cache is not None:
+                raise NotImplementedError(
+                    "TransformerEncoder(scan_layers=True) does not do "
+                    "cache-based incremental decode — use the unrolled "
+                    "form")
+            output = self.layers(src, src_mask)
+            if self.norm is not None:
+                output = self.norm(output)
+            return output
         output = src
         new_caches = []
         for i, mod in enumerate(self.layers):
@@ -191,6 +220,10 @@ class TransformerEncoder(Layer):
         return output if cache is None else (output, new_caches)
 
     def gen_cache(self, src):
+        if self.scan_layers:
+            raise NotImplementedError(
+                "gen_cache needs per-layer cache objects — use the "
+                "unrolled TransformerEncoder")
         return [layer.gen_cache(src) for layer in self.layers]
 
 
