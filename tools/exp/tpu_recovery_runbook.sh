@@ -36,7 +36,9 @@ run bert 1800 python bench.py --only bert
 # 3) fused flat-slab optimizer A/B on GPT-2 345M b8
 #    (PADDLE_TPU_FUSE_OPT=1; exact-equivalence tested on CPU)
 run fuseopt_off 1200 python tools/exp/_exp_perf.py 8 8
-PADDLE_TPU_FUSE_OPT=1 run fuseopt_on 1200 python tools/exp/_exp_perf.py 8 8
+# env(1) scopes the flag to this leg only (VAR=x before a bash FUNCTION
+# would persist after the call and contaminate the 13b legs)
+run fuseopt_on 1200 env PADDLE_TPU_FUSE_OPT=1 python tools/exp/_exp_perf.py 8 8
 
 # 4) 1.3B scan-over-layers legs (CPU rehearsal: compile 212-460s -> 18.6s;
 #    compare on-device compile + tok/s vs unrolled 200s / 13,860)
