@@ -2,15 +2,16 @@
 
 Prints ONE JSON line:
   {"metric": "tokens/sec/chip (GPT-2 345M train)", "value": N,
-   "unit": "tokens/s", "vs_baseline": N, "models": {...}}
+   "unit": "tokens/s", "vs_baseline": N}
 
 Headline metric is GPT-2 345M train tokens/s.  vs_baseline is against the
 BASELINE.md north-star: >=70% of A100 step-time throughput.  No number is
 published in the reference repo (BASELINE.json.published == {}), so the
 A100 anchor is 40k tokens/s/chip for GPT-2 345M mixed-precision training
 (Megatron-class implementations on A100-40GB); target = 0.7*40000 = 28000.
-The "models" key carries the other BASELINE configs (ResNet-50, BERT-base)
-so every driver-run leaves a verifiable multi-model record.
+The other BASELINE configs (ResNet-50, BERT-base) land in the side
+artifact BENCH_MODELS.json so every driver-run leaves a verifiable
+multi-model record without widening the stdout contract.
 
 Hardening (round 3): the axon tunnel can hang *indefinitely* at client
 init (observed after a killed remote compile — BENCH_r02 recorded value=0
@@ -20,6 +21,24 @@ group) with a timeout, and the headline benchmark retries with
 exponential backoff — a hung child is SIGKILLed and cannot poison the
 next attempt, because the next attempt is a brand-new process and the
 TPU client only ever lived in the dead child.
+
+Driver-provability (round 4): round 3's version printed its single JSON
+line only after ALL three child benchmarks (worst case ~40 min of retry
+ladders), so a driver window shorter than that recorded rc=124 with an
+EMPTY tail.  Now:
+  * The headline GPT-2 line is printed and flushed the moment its child
+    returns — even if the driver kills this process later, the line is
+    already on stdout.
+  * ALL work fits a total wall-clock budget (default 480 s, override
+    with BENCH_BUDGET_S); per-attempt timeouts are trimmed to the
+    remaining budget, never summed beyond it.
+  * Secondary models (ResNet-50, BERT) run only in leftover budget and
+    land in the side artifact BENCH_MODELS.json, never on stdout —
+    stdout carries exactly one JSON line.
+  * The GPT-2 child probes H2D bandwidth post-compile (two timed ~40 MB
+    device_puts); < 100 MB/s means the tunnel is in its documented
+    post-recovery degraded window, and the line is annotated
+    "degraded_tunnel" so no silent 13x-slow number gets recorded.
 """
 from __future__ import annotations
 
@@ -38,12 +57,15 @@ sys.path.insert(0, REPO)
 A100_ANCHOR_TOKENS_PER_SEC = 40000.0
 TARGET = 0.7 * A100_ANCHOR_TOKENS_PER_SEC
 
-# (timeout_s, sleep_before_s) per attempt for the headline benchmark.
-# First compile through the tunnel is slow (~20-40s warm, minutes cold),
-# so timeouts are generous; backoff gives a flapping tunnel time to
-# recover between attempts.
-GPT2_ATTEMPTS = [(600, 0), (600, 60), (900, 240)]
-SECONDARY_ATTEMPTS = [(600, 0), (600, 60)]
+# Total wall-clock budget across ALL attempts and models.  The driver's
+# capture window is finite; a benchmark that cannot prove itself inside
+# it does not count (BENCH_r03: rc=124, empty tail).
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "480"))
+
+# (timeout_s, sleep_before_s) templates.  Actual timeouts are clamped to
+# the remaining budget at attempt time — the ladder can only shrink.
+GPT2_ATTEMPTS = [(330, 0), (240, 20), (180, 30)]
+SECONDARY_ATTEMPTS = [(240, 0)]
 
 
 # --------------------------------------------------------------------------
@@ -92,11 +114,26 @@ def bench_gpt2():
 
     loss = step.step([x, y])
     loss.numpy()  # compile + sync
+
+    # Degraded-tunnel probe (post-compile, pre-timing): the dev tunnel
+    # runs ~13x slow for ~15 min after a recovery (BASELINE.md
+    # forensics).  Two timed ~40 MB transfers; healthy H2D is hundreds
+    # of MB/s, the degraded window measures < 100.
+    h2d_MBps = None
+    if on_tpu:
+        probe = np.zeros((10_000_000,), np.float32)  # 40 MB
+        bws = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            jax.device_put(probe).block_until_ready()
+            bws.append(probe.nbytes / (time.perf_counter() - t0) / 1e6)
+        h2d_MBps = round(max(bws), 1)
+
     dt = _timed_steps(lambda: step.step([x, y]), steps,
                       lambda: step.step([x, y]).numpy())
     # the sync closure above runs one extra step; subtract it from count
     tokens_per_sec = batch * seq * (steps + 1) / dt
-    return {
+    result = {
         "metric": "tokens/sec/chip (GPT-2 345M train)"
         if on_tpu else "tokens/sec/chip (GPT tiny, CPU smoke)",
         "value": round(tokens_per_sec, 1),
@@ -106,6 +143,11 @@ def bench_gpt2():
                    "dtype": "bfloat16" if on_tpu else "float32",
                    "optimizer": "AdamW", "fused_loss": True},
     }
+    if h2d_MBps is not None:
+        result["h2d_MBps"] = h2d_MBps
+        if h2d_MBps < 100.0:
+            result["degraded_tunnel"] = True
+    return result
 
 
 def bench_resnet50():
@@ -211,14 +253,22 @@ def child_main(name, out_path):
 # Parent orchestrator: never imports jax; children are killable as groups.
 # --------------------------------------------------------------------------
 
-def _run_child(name, attempts):
+def _run_child(name, attempts, deadline):
     """Run one benchmark in an isolated child with timeout+backoff retry.
 
+    Every attempt's timeout is clamped to the time left before
+    ``deadline`` (monotonic); attempts that no longer fit are skipped.
     Returns (result_dict | None, note | None)."""
     last_note = None
     for i, (timeout_s, sleep_s) in enumerate(attempts):
+        remaining = deadline - time.monotonic() - sleep_s
+        if remaining < 45:  # too little time for compile + any steps
+            if last_note is None:
+                last_note = "skipped: budget exhausted"
+            break
         if sleep_s:
             time.sleep(sleep_s)
+        timeout_s = min(timeout_s, remaining)
         fd, out_path = tempfile.mkstemp(prefix=f"bench_{name}_",
                                         suffix=".json")
         os.close(fd)
@@ -243,7 +293,7 @@ def _run_child(name, attempts):
             except (ProcessLookupError, PermissionError):
                 proc.kill()
             proc.wait()
-            last_note = f"attempt {i + 1}: killed after {timeout_s}s hang"
+            last_note = f"attempt {i + 1}: killed after {int(timeout_s)}s hang"
         finally:
             if os.path.exists(out_path):
                 os.unlink(out_path)
@@ -264,45 +314,63 @@ def main():
         child_main(args.child, args.out)
         return
 
+    deadline = time.monotonic() + BUDGET_S
     names = [args.only] if args.only else ["gpt2", "resnet50", "bert"]
-    results, notes = {}, {}
-    for name in names:
-        attempts = GPT2_ATTEMPTS if name == "gpt2" else SECONDARY_ATTEMPTS
-        res, note = _run_child(name, attempts)
-        if res is not None:
-            results[name] = res
-        else:
-            notes[name] = note
-
-    # Headline = gpt2 normally, or the single requested benchmark under
-    # --only so a successful run never reports value=0.
     head_name = "gpt2" if "gpt2" in names else names[0]
-    head = results.get(head_name)
+
+    # Headline FIRST, printed and flushed the moment it lands — the
+    # driver's window may close before the secondaries finish, and a
+    # line already on stdout survives an rc=124 kill.
+    fallback_metric = {
+        "gpt2": "tokens/sec/chip (GPT-2 345M train)",
+        "resnet50": "samples/sec/chip (ResNet-50 train, device-resident)",
+        "bert": "samples/sec/chip (BERT-base seq-128 fine-tune, "
+                "device-resident)",
+    }[head_name]
+    attempts = GPT2_ATTEMPTS if head_name == "gpt2" else SECONDARY_ATTEMPTS
+    head, head_note = _run_child(head_name, attempts, deadline)
     line = {
-        "metric": head["metric"] if head
-        else "tokens/sec/chip (GPT-2 345M train)",
+        "metric": head["metric"] if head else fallback_metric,
         "value": head["value"] if head else 0,
         "unit": head["unit"] if head else "tokens/s",
         "vs_baseline": round(head["value"] / TARGET, 4)
         if head and head_name == "gpt2" else 0,
     }
-    models = {}
-    for name, res in results.items():
+    if head and head.get("degraded_tunnel"):
+        line["degraded_tunnel"] = True
+        line["note"] = (f"h2d={head['h2d_MBps']} MB/s: tunnel in its "
+                        "documented post-recovery degraded window; value "
+                        "understates steady-state (BASELINE.md forensics)")
+    elif head is None:
+        # NOT blamed on the backend: secondaries haven't run yet, so a
+        # model-specific failure is indistinguishable here — the side
+        # artifact records which children (if any) later reached the
+        # device.  Historical context: 32,718 tok/s (BASELINE.md round 3)
+        # whenever the chip was reachable.
+        line["note"] = (f"{head_name} child failed: {head_note}; see "
+                        "BENCH_MODELS.json for secondary outcomes and "
+                        "BASELINE.md for last-good measurements")
+    print(json.dumps(line), flush=True)
+
+    # Secondary models: leftover budget only, side artifact only.
+    results = {head_name: head} if head else {}
+    notes = {} if head else {head_name: head_note}
+    for name in names:
         if name == head_name:
             continue
-        models[name] = {k: res[k] for k in
-                        ("metric", "value", "unit", "config")}
-    if models:
-        line["models"] = models
-    if notes:
-        line["note"] = "; ".join(f"{k}: {v}" for k, v in notes.items())
-        # Only blame the backend when NOTHING reached the device —
-        # a single failing model with others succeeding is model-specific.
-        if not results:
-            line["note"] += ("; TPU backend unavailable — see BASELINE.md "
-                             "round-2 measurements: 32,486 tok/s when the "
-                             "chip was reachable")
-    print(json.dumps(line), flush=True)
+        res, note = _run_child(name, SECONDARY_ATTEMPTS, deadline)
+        if res is not None:
+            results[name] = res
+        else:
+            notes[name] = note
+    artifact = {"headline": line, "models": results, "notes": notes,
+                "budget_s": BUDGET_S,
+                "spent_s": round(BUDGET_S - (deadline - time.monotonic()), 1)}
+    try:
+        with open(os.path.join(REPO, "BENCH_MODELS.json"), "w") as f:
+            json.dump(artifact, f, indent=1)
+    except OSError:
+        pass  # read-only checkout must not break the headline
     if head is None:
         sys.exit(3)
 
