@@ -1,0 +1,160 @@
+"""py_func: run arbitrary Python (numpy) code as an op inside graphs.
+
+Reference parity: ``operators/py_func_op.cc`` (host-side op whose kernel
+re-enters the Python interpreter) + ``python/paddle/fluid/layers/nn.py``
+``py_func`` (user API: ``func`` fills pre-declared ``out`` vars;
+``backward_func`` receives forward inputs + outputs + output-gradients —
+minus ``skip_vars_in_backward_input`` — and returns gradients of ``x``).
+
+TPU-native design: the host round-trip is ``jax.pure_callback`` — XLA
+inserts a host callback custom-call, so the op works inside ``jit``,
+``@to_static`` traces and recorded static Programs alike (the reference
+needed a dedicated C++ operator holding Python function registry ids;
+here the closure IS the registry).  ``backward_func`` becomes the bwd
+rule of a ``jax.custom_vjp`` wrapped around the callback, so the same
+one implementation serves the eager tape, static ``append_backward``
+replay, and ``jax.grad`` through compiled train steps.  Integer inputs
+take ``float0`` cotangents per JAX convention (the reference likewise
+never produces grads for integer vars).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _spec_of(t):
+    """(shape, numpy dtype) of a Tensor / Variable / shaped template."""
+    data = getattr(t, "_data", t)
+    return tuple(int(d) for d in data.shape), np.dtype(data.dtype)
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Record ``out = func(*x)`` executed by the Python interpreter.
+
+    ``out`` declares the result template(s): Tensor/Variable(s) (e.g.
+    from ``static.data`` or ``create_parameter``) whose shape/dtype the
+    callback's results must match — mirroring the reference where the
+    caller pre-creates the out vars (``fluid/layers/nn.py`` py_func).
+    Returns new tensors in the same single/list structure as ``out``.
+    """
+    import jax
+
+    from ..core.dispatch import primitive
+    from ..core.tensor import Tensor
+
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    single_out = not isinstance(out, (list, tuple))
+    if not callable(func):
+        raise TypeError("py_func: func must be callable")
+    out_specs = [_spec_of(o) for o in outs]
+    result_struct = tuple(jax.ShapeDtypeStruct(s, d) for s, d in out_specs)
+
+    skip = skip_vars_in_backward_input
+    skip = [] if skip is None else (
+        list(skip) if isinstance(skip, (list, tuple)) else [skip])
+    known = {id(v) for v in xs} | {id(v) for v in outs}
+    for v in skip:
+        if id(v) not in known:
+            raise ValueError(
+                "py_func: every skip_vars_in_backward_input entry must "
+                "be one of x or out (reference fluid/layers/nn.py "
+                "py_func checks the same)")
+    skip_ids = {id(v) for v in skip}
+    keep_x = [i for i, v in enumerate(xs) if id(v) not in skip_ids]
+    keep_y = [i for i, v in enumerate(outs) if id(v) not in skip_ids]
+
+    def _host_forward(*arrays):
+        res = func(*[np.asarray(a) for a in arrays])
+        res = list(res) if isinstance(res, (list, tuple)) else [res]
+        if len(res) != len(out_specs):
+            raise ValueError(
+                f"py_func: func returned {len(res)} values, out "
+                f"declares {len(out_specs)}")
+        return tuple(
+            np.ascontiguousarray(np.asarray(r), dtype=d).reshape(s)
+            for r, (s, d) in zip(res, out_specs))
+
+    def _callback_forward(*arrays):
+        res = jax.pure_callback(_host_forward, result_struct, *arrays)
+        return tuple(res)
+
+    if backward_func is None:
+        # no grad path at all: mirror the reference, where a py_func
+        # without backward_func contributes no gradient op
+        jax_fn = _callback_forward
+        nondiff = tuple(range(len(xs)))
+    else:
+        nondiff = ()
+
+        def jax_fn(*arrays):
+            import jax.numpy as jnp
+            n_x = len(arrays)
+            grad_pos = [i for i in range(n_x) if np.issubdtype(
+                np.dtype(arrays[i].dtype), np.floating)]
+            grad_struct = tuple(
+                jax.ShapeDtypeStruct(arrays[i].shape, arrays[i].dtype)
+                for i in grad_pos)
+
+            def _host_backward(*bw_arrays):
+                gs = backward_func(*[np.asarray(b) for b in bw_arrays])
+                gs = list(gs) if isinstance(gs, (list, tuple)) else [gs]
+                if len(gs) != n_x:
+                    raise ValueError(
+                        f"py_func: backward_func returned {len(gs)} "
+                        f"gradients for {n_x} inputs")
+                picked = []
+                for i in grad_pos:
+                    g, (shape, dt) = gs[i], (
+                        tuple(int(d) for d in grad_struct[
+                            grad_pos.index(i)].shape),
+                        np.dtype(grad_struct[grad_pos.index(i)].dtype))
+                    picked.append(
+                        np.zeros(shape, dt) if g is None else
+                        np.ascontiguousarray(
+                            np.asarray(g), dtype=dt).reshape(shape))
+                return tuple(picked)
+
+            @jax.custom_vjp
+            def core(*args):
+                return _callback_forward(*args)
+
+            def _fwd(*args):
+                ys = _callback_forward(*args)
+                return ys, (args, ys)
+
+            def _bwd(res, cts):
+                p_args, ys = res
+                # integer/bool outputs carry float0 cotangents, which
+                # cannot cross the callback boundary — hand the host
+                # zeros of the output dtype instead (the reference
+                # likewise passes no real grad for integer outs)
+                cts = [jnp.zeros(y.shape, y.dtype)
+                       if getattr(ct, "dtype", None) == jax.dtypes.float0
+                       else ct for ct, y in zip(cts, ys)]
+                host_in = ([p_args[i] for i in keep_x]
+                           + [ys[i] for i in keep_y] + list(cts))
+                if grad_pos:
+                    gouts = jax.pure_callback(
+                        _host_backward, grad_struct, *host_in)
+                    gouts = list(gouts)
+                else:
+                    gouts = []
+                full = []
+                for i, a in enumerate(p_args):
+                    if i in grad_pos:
+                        full.append(gouts[grad_pos.index(i)])
+                    else:  # integer/bool inputs: float0 cotangents
+                        full.append(np.zeros(a.shape, jax.dtypes.float0))
+                return tuple(full)
+
+            core.defvjp(_fwd, _bwd)
+            return core(*arrays)
+
+    op = primitive(name="py_func", nondiff=nondiff)(jax_fn)
+    res = op(*xs)
+    res = list(res) if isinstance(res, tuple) else [res]
+    if single_out:
+        return res[0]
+    return res

@@ -65,9 +65,7 @@ class name_scope:
         return False
 
 
-def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
-    raise NotImplementedError(
-        "py_func: host callbacks map to jax.pure_callback; not yet wired")
+from .nn import py_func  # noqa: E402,F401  (reference: fluid/layers/nn.py)
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):

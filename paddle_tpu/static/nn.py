@@ -477,8 +477,10 @@ def multi_box_head(*args, **kwargs):
 
 def py_func(func, x, out, backward_func=None,
             skip_vars_in_backward_input=None):
-    raise NotImplementedError(
-        "py_func: host callbacks map to jax.pure_callback; not yet wired")
+    """Reference: fluid/layers/nn.py py_func + operators/py_func_op.cc."""
+    from ..ops.py_func import py_func as _impl
+    return _impl(func, x, out, backward_func=backward_func,
+                 skip_vars_in_backward_input=skip_vars_in_backward_input)
 
 
 from ..ops.compat_ops import create_parameter  # noqa: E402,F401

@@ -1,0 +1,197 @@
+"""py_func: Python/numpy ops inside graphs, with custom gradients.
+
+Mirrors the reference's test_py_func_op.py (fluid/tests/unittests/):
+a numpy-implemented op with a backward_func must run and differentiate
+in eager mode, inside a recorded static Program, and under @to_static.
+Reference semantics: operators/py_func_op.cc + fluid/layers/nn.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def _tanh_np(x):
+    return np.tanh(x)
+
+
+def _tanh_grad_np(x, y, dy):
+    # backward_func signature: inputs + outputs + output-grads
+    return [dy * (1 - y * y)]
+
+
+# --------------------------------------------------------------------------
+# eager
+# --------------------------------------------------------------------------
+
+def test_eager_forward():
+    x = paddle.to_tensor(np.linspace(-2, 2, 12).reshape(3, 4)
+                         .astype("float32"))
+    out = paddle.static.py_func(_tanh_np, x, paddle.zeros([3, 4]))
+    np.testing.assert_allclose(out.numpy(), np.tanh(x.numpy()), rtol=1e-6)
+
+
+def test_eager_backward_custom_grad():
+    xv = np.linspace(-1.5, 1.5, 8).astype("float32")
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    out = paddle.static.py_func(_tanh_np, x, paddle.zeros([8]),
+                                backward_func=_tanh_grad_np)
+    loss = paddle.sum(out * out)
+    loss.backward()
+    y = np.tanh(xv)
+    expect = 2 * y * (1 - y * y)
+    np.testing.assert_allclose(x.grad.numpy(), expect, rtol=1e-5)
+
+
+def test_eager_wrong_backward_is_used():
+    """The CUSTOM rule must be applied, not autodiff of the callback."""
+    def fwd(x):
+        return x * 2.0
+
+    def bwd(x, y, dy):
+        return [np.full_like(dy, 7.0)]  # deliberately not d(2x)/dx
+
+    x = paddle.to_tensor(np.ones(4, "float32"), stop_gradient=False)
+    out = paddle.static.py_func(fwd, x, paddle.zeros([4]),
+                                backward_func=bwd)
+    paddle.sum(out).backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full(4, 7.0))
+
+
+def test_eager_no_backward_func_stops_gradient():
+    x = paddle.to_tensor(np.ones(4, "float32"), stop_gradient=False)
+    out = paddle.static.py_func(_tanh_np, x, paddle.zeros([4]))
+    assert out.stop_gradient
+
+
+def test_multi_io_and_int_input():
+    """Mixed dtypes: float grads flow, integer inputs take none."""
+    def gather_scale(table, idx, scale):
+        return table[idx] * scale, table[idx]
+
+    def gather_scale_grad(table, idx, scale, y0, y1, dy0, dy1):
+        g = np.zeros_like(table)
+        np.add.at(g, idx, dy0 * scale + dy1)
+        return [g, None, np.sum(dy0 * table[idx])]
+
+    tv = np.arange(12, dtype="float32").reshape(4, 3)
+    iv = np.array([0, 2, 2], "int32")
+    table = paddle.to_tensor(tv, stop_gradient=False)
+    idx = paddle.to_tensor(iv)
+    scale = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+    y0, y1 = paddle.static.py_func(
+        gather_scale, [table, idx, scale],
+        [paddle.zeros([3, 3]), paddle.zeros([3, 3])],
+        backward_func=gather_scale_grad)
+    np.testing.assert_allclose(y0.numpy(), tv[iv] * 2.0)
+    paddle.sum(y0 + 0.5 * y1).backward()
+    g = np.zeros_like(tv)
+    np.add.at(g, iv, np.ones((3, 3), "float32") * 2.0 + 0.5)
+    np.testing.assert_allclose(table.grad.numpy(), g)
+    np.testing.assert_allclose(scale.grad.numpy(), tv[iv].sum())
+
+
+def test_int_output_with_backward():
+    """Integer outputs take no real cotangent (float0 inside JAX); the
+    host backward still sees a zeros array of the output dtype."""
+    def fwd(x):
+        return x * 2.0, np.argsort(x).astype("int32")
+
+    def bwd(x, y0, y1, dy0, dy1):
+        assert dy1.dtype.kind == "i" and not dy1.any()
+        return [dy0 * 2.0]
+
+    x = paddle.to_tensor(np.arange(4, dtype="float32"),
+                         stop_gradient=False)
+    y0, y1 = paddle.static.py_func(
+        fwd, x, [paddle.zeros([4]), paddle.zeros([4], dtype="int32")],
+        backward_func=bwd)
+    assert y1.dtype == paddle.int32
+    paddle.sum(y0).backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full(4, 2.0))
+
+
+def test_skip_vars_in_backward_input():
+    seen = {}
+
+    def fwd(x):
+        return x + 1.0
+
+    def bwd(*arrays):
+        # x skipped -> receives (y, dy) only
+        seen["n"] = len(arrays)
+        y, dy = arrays
+        return [dy * 3.0]
+
+    x = paddle.to_tensor(np.ones(5, "float32"), stop_gradient=False)
+    out = paddle.static.py_func(fwd, x, paddle.zeros([5]),
+                                backward_func=bwd,
+                                skip_vars_in_backward_input=x)
+    paddle.sum(out).backward()
+    assert seen["n"] == 2
+    np.testing.assert_allclose(x.grad.numpy(), np.full(5, 3.0))
+
+
+def test_skip_var_must_be_known():
+    x = paddle.to_tensor(np.ones(2, "float32"))
+    stranger = paddle.to_tensor(np.ones(2, "float32"))
+    with pytest.raises(ValueError):
+        paddle.static.py_func(_tanh_np, x, paddle.zeros([2]),
+                              backward_func=_tanh_grad_np,
+                              skip_vars_in_backward_input=stranger)
+
+
+def test_shape_mismatch_raises():
+    def bad(x):
+        return np.ones((2, 2), "float32")
+
+    x = paddle.to_tensor(np.ones(5, "float32"))
+    with pytest.raises(Exception):
+        paddle.static.py_func(bad, x, paddle.zeros([5])).numpy()
+
+
+# --------------------------------------------------------------------------
+# static Program
+# --------------------------------------------------------------------------
+
+def test_static_forward_and_backward():
+    main, startup = static.Program(), static.Program()
+    paddle.enable_static()
+    try:
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 3])
+            out_t = static.data("out_template", [4, 3])
+            y = static.nn.py_func(_tanh_np, x, out_t,
+                                  backward_func=_tanh_grad_np)
+            loss = paddle.sum(y * y)
+            gx, = static.gradients([loss], [x])
+            exe = static.Executor()
+            xv = np.linspace(-1, 1, 12).reshape(4, 3).astype("float32")
+            yv, gv = exe.run(feed={"x": xv}, fetch_list=[y, gx])
+    finally:
+        paddle.disable_static()
+    t = np.tanh(xv)
+    np.testing.assert_allclose(yv, t, rtol=1e-6)
+    np.testing.assert_allclose(gv, 2 * t * (1 - t * t), rtol=1e-5)
+
+
+def test_fluid_layers_alias():
+    from paddle_tpu import fluid
+    assert fluid.layers.py_func is static.nn.py_func
+
+
+# --------------------------------------------------------------------------
+# @to_static
+# --------------------------------------------------------------------------
+
+def test_to_static_with_py_func():
+    @paddle.jit.to_static
+    def f(x):
+        y = paddle.static.py_func(_tanh_np, x, paddle.zeros([6]),
+                                  backward_func=_tanh_grad_np)
+        return paddle.sum(y)
+
+    xv = np.linspace(-1, 1, 6).astype("float32")
+    out = f(paddle.to_tensor(xv))
+    np.testing.assert_allclose(out.numpy(), np.tanh(xv).sum(), rtol=1e-5)
