@@ -21,6 +21,35 @@ def _lib_path():
     return os.path.join(os.path.dirname(__file__), "libptq.so")
 
 
+def source_hash(*names):
+    """sha256 of the named csrc sources, concatenated — the same value
+    the Makefile embeds via -DPTQ_SRC_HASH."""
+    import hashlib
+    h = hashlib.sha256()
+    here = os.path.dirname(__file__)
+    for n in names:
+        with open(os.path.join(here, n), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+def _is_stale(lib):
+    """True when the loaded binary does not match the sources on disk."""
+    if not hasattr(lib, "ptds_reset_order"):
+        return True
+    if not hasattr(lib, "ptq_source_hash"):
+        return True  # predates hash embedding
+    fn = lib.ptq_source_hash
+    fn.restype = ctypes.c_char_p
+    try:
+        expect = source_hash("blocking_queue.cc", "dataset.cc")
+    except OSError:
+        # binary shipped without sources (pruned install): nothing to
+        # compare against — trust the .so rather than crash the loader
+        return False
+    return fn().decode() != expect
+
+
 def load(build_if_missing=True):
     """Load (building on first use) the native queue library, or None."""
     global _LIB, _TRIED
@@ -40,15 +69,27 @@ def load(build_if_missing=True):
         lib = ctypes.CDLL(path)
     except OSError:
         return None
-    if not hasattr(lib, "ptds_reset_order"):
-        # stale library from an older source tree: force a rebuild once
+    if _is_stale(lib):
+        # stale library (older source tree, or a committed .so whose
+        # embedded source hash disagrees with the checkout): rebuild
+        # once.  dlopen caches by path — the stale mapping would be
+        # handed straight back — so load the rebuilt binary through a
+        # fresh temp path.
         try:
+            import shutil
+            import tempfile
             subprocess.run(["make", "-B", "-C", os.path.dirname(__file__)],
                            check=True, capture_output=True, timeout=120)
-            lib = ctypes.CDLL(path)
+            fd, fresh = tempfile.mkstemp(prefix="libptq_", suffix=".so")
+            os.close(fd)
+            try:
+                shutil.copy2(path, fresh)
+                lib = ctypes.CDLL(fresh)
+            finally:
+                os.unlink(fresh)  # the mapping survives the unlink
         except Exception:
             return None
-        if not hasattr(lib, "ptds_reset_order"):
+        if _is_stale(lib):
             return None
     lib.ptq_new.restype = ctypes.c_void_p
     lib.ptq_new.argtypes = [ctypes.c_int64, ctypes.c_int]

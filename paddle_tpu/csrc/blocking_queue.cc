@@ -127,4 +127,13 @@ int64_t ptq_size(void* q) {
 
 void ptq_free(void* q) { delete static_cast<BlockingQueue*>(q); }
 
+const char* ptq_source_hash() {
+  // sha256 of (blocking_queue.cc + dataset.cc) at build time; the
+  // ctypes loader rebuilds when it disagrees with the sources on disk
+#ifndef PTQ_SRC_HASH
+#define PTQ_SRC_HASH "unknown"
+#endif
+  return PTQ_SRC_HASH;
+}
+
 }  // extern "C"

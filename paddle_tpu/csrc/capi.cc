@@ -368,6 +368,15 @@ int PD_GetOutput(PD_Predictor* predictor, const char* name,
   return rc;
 }
 
+const char* PD_SourceHash(void) {
+  // sha256 of (capi.cc + paddle_capi.h) at build time; tests compare it
+  // against the checked-out sources so a stale .so cannot pass silently
+#ifndef PTQ_SRC_HASH
+#define PTQ_SRC_HASH "unknown"
+#endif
+  return PTQ_SRC_HASH;
+}
+
 const char* PD_LastError(void) {
   // copy under the lock into thread-local storage: writers reassign
   // g_last_error under g_mu, so the pointer we hand out must not alias the

@@ -62,13 +62,36 @@ int main(int argc, char** argv) {
 
 
 def _build_lib():
-    if not os.path.exists(LIB):
-        try:
-            subprocess.run(["make", "-C", CSRC, "capi"], check=True,
-                           capture_output=True, timeout=180)
-        except Exception:
-            return False
+    # Always invoke make: its mtime rules rebuild when capi.cc or
+    # paddle_capi.h changed, so the suite never runs against a stale
+    # committed binary (a no-op when up to date).
+    try:
+        subprocess.run(["make", "-C", CSRC, "capi"], check=True,
+                       capture_output=True, timeout=180)
+    except Exception:
+        return False
     return os.path.exists(LIB)
+
+
+def test_so_matches_sources():
+    """The committed .so must embed the hash of the checked-out sources.
+
+    Guards against editing capi.cc without rebuilding: make's mtime rules
+    catch a newer source, and this hash check catches the remaining case
+    (fresh checkout where mtimes are unordered but the binary is old).
+    Deliberately NOT skipped when the build fails — a broken native
+    build is a failure, not an environment quirk."""
+    import ctypes
+    assert _build_lib(), "libpaddle_capi.so failed to build"
+    from paddle_tpu.csrc import source_hash
+    lib = ctypes.CDLL(LIB)
+    assert hasattr(lib, "PD_SourceHash"), \
+        "stale libpaddle_capi.so: predates source-hash embedding"
+    fn = lib.PD_SourceHash
+    fn.restype = ctypes.c_char_p
+    assert fn().decode() == source_hash("capi.cc", "paddle_capi.h"), \
+        ("libpaddle_capi.so is stale: rebuild with "
+         "make -B -C paddle_tpu/csrc capi")
 
 
 @pytest.fixture(scope="module")
