@@ -385,8 +385,7 @@ class TranslatedLayer(Layer):
         self._b_arrays = [jnp.asarray(state["buffers"][k])
                           for k in state["bnames"]]
         for k in state["pnames"]:
-            self.add_parameter(
-                k.replace(".", "__"), Parameter(state["params"][k]))
+            self.add_parameter(k, Parameter(state["params"][k]))
 
     def forward(self, *args):
         arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a)

@@ -436,10 +436,13 @@ class GPTModel(nn.Layer):
             # materializing CE fallback OOMs at long budgets (39.7GB at
             # budget 4096 vs 15.75GB HBM)
             h = self.head.ln_f(x)
+            # ignore_index always on: the unfused fallback CE below
+            # defaults to -100, and -100-padded labels without doc_lens
+            # would otherwise NaN through take_along_axis fill semantics
             return F.fused_linear_cross_entropy(
                 h, self.head.lm_head.weight, labels,
                 chunk_size=self.fused_loss_chunk,
-                ignore_index=-100 if doc_lens is not None else None)
+                ignore_index=-100)
         logits = self.head(x)
         if labels is not None:
             b, s, v = logits.shape

@@ -72,8 +72,11 @@ class ScanLayers(Layer):
         object.__setattr__(self, "_template", template)
         for name in self._stack_names:
             parts = per_leaf.pop(name)
-            self.add_parameter(name.replace(".", "__"),
-                               Parameter(jnp.stack(parts)))
+            # registered under the ORIGINAL dotted name (add_parameter
+            # imposes no attribute-identifier rule): decay masks written
+            # against dotted names keep matching, state_dict keys stay
+            # readable ('linear1.weight' stacked along axis 0)
+            self.add_parameter(name, Parameter(jnp.stack(parts)))
             del parts
 
     # train()/eval() must reach the unregistered template
@@ -97,7 +100,7 @@ class ScanLayers(Layer):
         names = self._stack_names
         # pass the Parameter TENSORS: the primitive wrapper records the
         # eager tape against them (raw arrays would sever backward)
-        leaves = [self._parameters[n.replace(".", "__")]
+        leaves = [self._parameters[n]
                   for n in names]
         # None extras keep their POSITION (the template sees them as
         # None); only real values travel through the op

@@ -176,10 +176,14 @@ class Optimizer:
             skey = tuple(sorted(
                 (k, str(v.dtype), int(v.ndim)) for k, v in s.items())) \
                 if isinstance(s, dict) else ()
+            # grad dtype in the key too: mixed-dtype grads within one
+            # group would be silently promoted by jnp.concatenate,
+            # diverging from the per-param path's native-dtype math
             groups.setdefault(
-                (bool(decay_on), str(p.dtype), skey), []).append(i)
+                (bool(decay_on), str(p.dtype), str(g.dtype), skey),
+                []).append(i)
         new_p, new_s = list(flat_p), list(flat_s)
-        for (decay_on, _, _), idxs in groups.items():
+        for (decay_on, _, _, _), idxs in groups.items():
             # _np.prod(()) == 1.0 (scalars); zero-size params correctly
             # contribute empty slices
             sizes = [int(_np.prod(flat_p[i].shape)) for i in idxs]

@@ -125,13 +125,17 @@ class TrainStep:
         # updates are already shard-local).  Passed per-call (no
         # mutation of the caller's optimizer)
         self._fuse_opt = None  # optimizer's own setting
-        if getattr(self.optimizer, "fuse_update", False) and any(
-                spec != P() for spec in self.param_specs.values()):
-            import logging
-            logging.getLogger("paddle_tpu").info(
-                "fuse_update disabled for this TrainStep: params are "
-                "sharded (TP/FSDP); the fused flat-slab update applies "
-                "to replicated-param regimes only")
+        if any(spec != P() for spec in self.param_specs.values()):
+            # unconditional (not gated on the optimizer's CURRENT
+            # fuse_update): flipping opt.fuse_update=True after
+            # construction must not re-enable the slab path for
+            # sharded params
+            if getattr(self.optimizer, "fuse_update", False):
+                import logging
+                logging.getLogger("paddle_tpu").info(
+                    "fuse_update disabled for this TrainStep: params are "
+                    "sharded (TP/FSDP); the fused flat-slab update applies "
+                    "to replicated-param regimes only")
             self._fuse_opt = False
 
         self.params = {}

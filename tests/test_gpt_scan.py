@@ -207,3 +207,31 @@ class TestTransformerEncoderScan:
         from paddle_tpu.nn.layer.scan import ScanLayers
         with pytest.raises(ValueError):
             ScanLayers(lambda: nn.BatchNorm1D(8), 3)
+
+
+def test_stacked_names_stay_dotted_for_decay_masks():
+    """Stacked params keep their ORIGINAL dotted names, so AdamW
+    apply_decay_param_fun predicates (endswith('.bias') etc.) select the
+    same params under scan_layers as in the unrolled form (round-3
+    advisor finding: the old '__' mangle silently broke the masks)."""
+    from paddle_tpu.parallel.train_step import TrainStep
+    x, y = _data()
+
+    def run(scan_layers):
+        paddle.seed(0)
+        m = GPTModel.from_config("tiny", dropout=0.0, fused_loss=True,
+                                 max_position=64,
+                                 scan_layers=scan_layers)
+        if scan_layers:
+            names = [n for n, _ in m.named_parameters()]
+            assert any(n.endswith(".bias") for n in names), names
+            assert not any("__" in n for n in names), names
+        opt = optimizer.AdamW(
+            learning_rate=1e-3, weight_decay=0.5,
+            parameters=m.parameters(),
+            apply_decay_param_fun=lambda n: not n.endswith(".bias"))
+        step = TrainStep(m, opt, loss_fn=None)
+        return [float(step.step([x, y]).numpy()) for _ in range(4)]
+
+    # a mask mismatch shows up as diverging trajectories at wd=0.5
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-4)
