@@ -39,14 +39,24 @@ def _arr(x):
 
 
 class RaggedTensor:
-    """Flat ``values`` + ``row_splits`` (+ static ``capacity``)."""
+    """Flat ``values`` + ``row_splits`` (+ static ``capacity``).
 
-    __slots__ = ("values", "row_splits", "capacity")
+    Multi-level (nested) LoD — reference ``lod_tensor.h:114`` where LoD
+    is a *vector* of offset levels (paragraphs→sentences→words) — is
+    carried as ``outer_lods``: a tuple of offset vectors, outermost
+    first, each indexing the rows of the next level; ``row_splits``
+    stays the bottom level (the one indexing ``values``), so every
+    existing single-level consumer is untouched.  ``lod()`` /
+    ``recursive_sequence_lengths()`` match the reference LoDTensor
+    accessors."""
 
-    def __init__(self, values, row_splits):
+    __slots__ = ("values", "row_splits", "capacity", "outer_lods")
+
+    def __init__(self, values, row_splits, outer_lods=()):
         self.values = ensure_tensor(values)
         self.row_splits = ensure_tensor(row_splits)
         self.capacity = int(self.values.shape[0])
+        self.outer_lods = tuple(ensure_tensor(s) for s in outer_lods)
 
     # -- construction -----------------------------------------------------
     @classmethod
@@ -107,7 +117,45 @@ class RaggedTensor:
         flat, splits = cls.pack_rows_numpy(rows, capacity)
         return cls(Tensor(flat), Tensor(splits))
 
+    @classmethod
+    def from_nested_rows(cls, nested, capacity=None):
+        """Arbitrary-depth nested lists of row arrays -> ragged with
+        ``lod_level == depth`` (reference: creating a LoDTensor from
+        recursive_sequence_lengths).  Rows must be numpy arrays —
+        grouping levels above them are python lists/tuples (a bare
+        list-of-scalars row is ambiguous with a grouping level; wrap it
+        in np.asarray, or use ``from_rows`` for depth 1)."""
+        lods = []
+        level = list(nested)
+        while level and isinstance(level[0], (list, tuple)):
+            counts = np.array([len(g) for g in level], np.int64)
+            lods.append(np.concatenate(
+                [[0], np.cumsum(counts)]).astype(np.int32))
+            level = [item for g in level for item in g]
+        flat, splits = cls.pack_rows_numpy(level, capacity)
+        return cls(Tensor(flat), Tensor(splits),
+                   outer_lods=tuple(Tensor(s) for s in lods))
+
     # -- views ------------------------------------------------------------
+    @property
+    def lod_level(self):
+        return len(self.outer_lods) + 1
+
+    def lod(self):
+        """Offset form, outermost level first — reference
+        ``LoDTensor.lod()``."""
+        return [list(np.asarray(s.numpy())) for s in self.outer_lods] + \
+            [list(np.asarray(self.row_splits.numpy()))]
+
+    def recursive_sequence_lengths(self):
+        """Length form per level — reference
+        ``LoDTensor.recursive_sequence_lengths()``."""
+        out = []
+        for off in self.lod():
+            a = np.asarray(off)
+            out.append(list(a[1:] - a[:-1]))
+        return out
+
     @property
     def nrows(self):
         return int(self.row_splits.shape[0]) - 1
@@ -148,11 +196,49 @@ class RaggedTensor:
             jnp.asarray(pad_value, v.dtype))
         return Tensor(dense), Tensor(lens)
 
+    def to_padded_nested(self, max_rows, max_len, pad_value=0.0):
+        """Nested (lod_level >= 2) -> ([G, max_rows, max_len, ...],
+        row_lengths [G, max_rows]) using the innermost outer level; for
+        deeper nests apply per remaining level.  Reference analogue:
+        padding a 2-level LoDTensor batch (sentences per doc, words per
+        sentence)."""
+        if not self.outer_lods:
+            raise ValueError(
+                "to_padded_nested: lod_level is 1 — use to_padded")
+        dense, lens = self.to_padded(max_len, pad_value)
+        d, ln = dense._data, lens._data
+        so = self.outer_lods[-1]._data
+        B = self.nrows
+        G = int(so.shape[0]) - 1
+        grp_lens = so[1:] - so[:-1]
+        if not isinstance(grp_lens, jax.core.Tracer) and G:
+            widest = int(jnp.max(grp_lens))
+            if widest > max_rows:
+                raise ValueError(
+                    f"to_padded_nested: a group has {widest} rows > "
+                    f"max_rows {max_rows}")
+        pos = so[:-1][:, None] + jnp.arange(max_rows)[None, :]
+        valid = jnp.arange(max_rows)[None, :] < grp_lens[:, None]
+        g = d[jnp.clip(pos, 0, B - 1)]          # [G, max_rows, L, ...]
+        mask = valid.reshape(valid.shape + (1,) * (g.ndim - 2))
+        g = jnp.where(mask, g, jnp.asarray(pad_value, g.dtype))
+        row_lens = jnp.where(valid, ln[jnp.clip(pos, 0, B - 1)], 0)
+        return Tensor(g), Tensor(row_lens)
+
     def rows(self):
         """Host-side list of per-row numpy arrays (debug/IO)."""
         v = np.asarray(self.values.numpy())
         s = np.asarray(self.row_splits.numpy())
         return [v[s[i]:s[i + 1]] for i in range(len(s) - 1)]
+
+    def nested_rows(self):
+        """Host-side nested lists mirroring ``lod_level`` (debug/IO) —
+        the inverse of ``from_nested_rows``."""
+        out = self.rows()
+        for s in reversed(self.outer_lods):
+            off = np.asarray(s.numpy())
+            out = [out[off[i]:off[i + 1]] for i in range(len(off) - 1)]
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -205,6 +291,12 @@ def sequence_pool(rt: RaggedTensor, pool_type: str, pad_value=0.0):
     empty = (rt.lengths()._data == 0).reshape(
         (-1,) + (1,) * (v.ndim - 1))
     out = jnp.where(empty, jnp.asarray(pad_value, out.dtype), out)
+    if rt.outer_lods:
+        # nested LoD: pooling consumes the bottom level; the result is
+        # ragged over the remaining levels (reference: pooling words ->
+        # sentence vectors, still LoD-organized by paragraph)
+        return RaggedTensor(Tensor(out), rt.outer_lods[-1],
+                            outer_lods=rt.outer_lods[:-1])
     return Tensor(out)
 
 
@@ -227,7 +319,8 @@ def sequence_softmax(rt: RaggedTensor):
     # 1e-38 is denormal — XLA's FTZ would flush it to 0 and
     # make the trash slots 0/0=NaN; stay in normal range
     out = ex / jnp.maximum(den[ids], 1e-30)
-    return RaggedTensor(Tensor(out), rt.row_splits)
+    return RaggedTensor(Tensor(out), rt.row_splits,
+                        outer_lods=rt.outer_lods)
 
 
 def sequence_reverse(rt: RaggedTensor):
@@ -241,41 +334,129 @@ def sequence_reverse(rt: RaggedTensor):
     src = s[ids_c] + (s[ids_c + 1] - 1) - pos
     src = jnp.where(ids < B, src, pos)
     out = rt.values._data[jnp.clip(src, 0, rt.capacity - 1)]
-    return RaggedTensor(Tensor(out), rt.row_splits)
+    return RaggedTensor(Tensor(out), rt.row_splits,
+                        outer_lods=rt.outer_lods)
 
 
-def sequence_expand(rt: RaggedTensor, ref: RaggedTensor):
-    """Repeat each of x's rows to ref's row lengths, flattened
-    (reference: sequence_expand_as_op semantics for one-step rows is a
-    gather; general LoD expand repeats x's row i ref_len[i] times).
-    Here: x row i (ONE step per row) broadcast ref_len[i] times —
-    the CTR/matching use."""
-    if rt.nrows != ref.nrows:
+def _level_splits(rt: RaggedTensor, level):
+    """Offset vector of a LoD level (0 = outermost, -1 = bottom)."""
+    all_lods = rt.outer_lods + (rt.row_splits,)
+    return all_lods[level]._data
+
+
+def sequence_expand(rt: RaggedTensor, ref: RaggedTensor, ref_level=-1,
+                    capacity=None, max_out_rows=None):
+    """Reference ``sequence_expand_op.cc``: repeat x's row i
+    ``ref_len[i]`` times, where ``ref_len`` are the lengths of ref's
+    LoD level ``ref_level``.
+
+    Two regimes, matching the reference's two uses:
+
+    * all x rows are single-step and ``ref_level`` is the bottom level
+      — the broadcast/expand_as pattern (CTR models): x's step i is
+      broadcast across ref's row i; output has ref's LoD.
+    * general whole-row repeat (nested beam-search/NMT pattern): each
+      x ROW is copied ``ref_len[i]`` times; the output gains an outer
+      LoD level grouping the copies (lod_level 2, mirroring the
+      reference where out LoD = ref-level offsets over x's LoD).
+      Shapes stay static: pass ``capacity`` (total out steps bound) and
+      ``max_out_rows`` under jit; both default to the exact concrete
+      totals outside jit.
+    """
+    rl_splits = _level_splits(ref, ref_level)
+    rl = (rl_splits[1:] - rl_splits[:-1]).astype(jnp.int32)
+    N = int(rl.shape[0])
+    if rt.nrows != N:
         raise ValueError(
-            f"sequence_expand: x has {rt.nrows} rows but ref has "
-            f"{ref.nrows}")
+            f"sequence_expand: x has {rt.nrows} rows but ref level "
+            f"{ref_level} has {N} entries")
     x_lens = rt.lengths()._data
-    if not isinstance(x_lens, jax.core.Tracer) and \
-            not bool(jnp.all(x_lens == 1)):
+    lens_traced = isinstance(x_lens, jax.core.Tracer)
+    one_step = (not lens_traced and bool(jnp.all(x_lens == 1)))
+    if lens_traced and capacity is None and max_out_rows is None:
+        # under jit without explicit bounds, keep the round-3 contract:
+        # the caller guarantees one-step rows (the expand_as pattern)
+        one_step = True
+    if one_step and ref_level in (-1, ref.lod_level - 1):
+        # broadcast fast path: one gather, output keeps ref's LoD
+        ids = ref.segment_ids()
+        B = ref.nrows
+        x_first = rt.values._data[
+            jnp.clip(rt.row_splits._data[:-1], 0, rt.capacity - 1)]
+        out = x_first[jnp.clip(ids, 0, B - 1)]
+        live = (ids < B).reshape((-1,) + (1,) * (out.ndim - 1))
+        out = out * live.astype(out.dtype)
+        return RaggedTensor(Tensor(out), ref.row_splits,
+                            outer_lods=ref.outer_lods)
+
+    # general whole-row repeat, static-shaped
+    r_cum = jnp.cumsum(rl)
+    r_total = r_cum[-1]
+    if max_out_rows is None:
+        if isinstance(r_total, jax.core.Tracer):
+            raise ValueError(
+                "sequence_expand: pass max_out_rows under jit — the "
+                "repeated row count is data-dependent")
+        max_out_rows = int(r_total)
+    elif not isinstance(r_total, jax.core.Tracer) and \
+            int(r_total) > max_out_rows:
         raise ValueError(
-            "sequence_expand(ragged): only one-step-per-row inputs are "
-            "supported (the expand_as pattern); repeat-whole-rows needs "
-            "host-side regrouping")
-    ids = ref.segment_ids()
-    B = ref.nrows
-    x_first = rt.values._data[
-        jnp.clip(rt.row_splits._data[:-1], 0, rt.capacity - 1)]
-    out = x_first[jnp.clip(ids, 0, B - 1)]
-    live = (ids < B).reshape((-1,) + (1,) * (out.ndim - 1))
-    out = out * live.astype(out.dtype)
-    return RaggedTensor(Tensor(out), ref.row_splits)
+            f"sequence_expand: max_out_rows {max_out_rows} < actual "
+            f"repeated row count {int(r_total)} — the result would "
+            "silently drop rows")
+    r = jnp.arange(max_out_rows)
+    grp = jnp.searchsorted(r_cum, r, side="right")     # x row per out row
+    grp_c = jnp.clip(grp, 0, N - 1)
+    live_row = r < r_total
+    sx = rt.row_splits._data
+    out_len = jnp.where(live_row, sx[grp_c + 1] - sx[grp_c], 0)
+    out_splits = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32),
+         jnp.cumsum(out_len)]).astype(jnp.int32)
+    total_steps = out_splits[-1]
+    if capacity is None:
+        if isinstance(total_steps, jax.core.Tracer):
+            raise ValueError(
+                "sequence_expand: pass capacity under jit — the total "
+                "output step count is data-dependent")
+        capacity = int(total_steps)
+    elif not isinstance(total_steps, jax.core.Tracer) and \
+            int(total_steps) > capacity:
+        raise ValueError(
+            f"sequence_expand: capacity {capacity} < actual output "
+            f"step count {int(total_steps)} — the scatter would "
+            "silently drop data (pick the bucket like io/bucketing.py)")
+    p = jnp.arange(capacity)
+    row_of_p = jnp.searchsorted(out_splits, p, side="right") - 1
+    row_c = jnp.clip(row_of_p, 0, max_out_rows - 1)
+    local = p - out_splits[row_c]
+    src = sx[jnp.clip(grp[row_c], 0, N - 1)] + local
+    vals = rt.values._data[jnp.clip(src, 0, rt.capacity - 1)]
+    live = (p < total_steps).reshape((-1,) + (1,) * (vals.ndim - 1))
+    vals = vals * live.astype(vals.dtype)
+    outer = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), r_cum]).astype(jnp.int32)
+    return RaggedTensor(Tensor(vals), Tensor(out_splits),
+                        outer_lods=(Tensor(outer),))
 
 
 def sequence_concat(a: RaggedTensor, b: RaggedTensor):
     """Row-wise concat: out row i = a row i ++ b row i (reference:
-    sequence_concat_op)."""
+    sequence_concat_op).  Nested inputs must agree on their outer
+    levels; the output carries them unchanged (bottom-level concat
+    leaves the grouping structure intact)."""
     if a.nrows != b.nrows:
         raise ValueError("sequence_concat: row counts differ")
+    if len(a.outer_lods) != len(b.outer_lods):
+        raise ValueError("sequence_concat: lod_level mismatch")
+    for sa_, sb_ in zip(a.outer_lods, b.outer_lods):
+        da, db = sa_._data, sb_._data
+        if not (isinstance(da, jax.core.Tracer)
+                or isinstance(db, jax.core.Tracer)):
+            if da.shape != db.shape or not bool(jnp.all(da == db)):
+                raise ValueError(
+                    "sequence_concat: outer LoD levels differ between "
+                    "inputs")
     sa, sb = a.row_splits._data, b.row_splits._data
     la, lb = sa[1:] - sa[:-1], sb[1:] - sb[:-1]
     splits = jnp.concatenate(
@@ -299,4 +480,5 @@ def sequence_concat(a: RaggedTensor, b: RaggedTensor):
     dst = jnp.zeros((cap + 1,) + tail, a.values._data.dtype)
     dst = scatter(a.values._data, sa, dst, jnp.zeros(B, jnp.int32))
     dst = scatter(b.values._data, sb, dst, la.astype(jnp.int32))
-    return RaggedTensor(Tensor(dst[:cap]), Tensor(splits))
+    return RaggedTensor(Tensor(dst[:cap]), Tensor(splits),
+                        outer_lods=a.outer_lods)

@@ -127,3 +127,46 @@ def dynamic_decode(decoder, inits=None, max_step_num=20, output_time_major=False
     if return_length:
         return Tensor(seqs), Tensor(lengths)
     return Tensor(seqs)
+
+
+def beam_search_decode(ids, lengths, end_token=None):
+    """Finalize a beam search into a 2-level LoD result (reference:
+    ``beam_search_decode_op.cc`` — sentence ids as a LoDTensor whose
+    level 0 groups beams per source and level 1 delimits each beam's
+    tokens).
+
+    ids: [B, beam, T] (``dynamic_decode`` output), lengths: [B, beam].
+    Returns a ``core.ragged.RaggedTensor`` with ``lod_level == 2``:
+    outer level = source sentence -> its beam hypotheses, bottom level
+    = hypothesis -> tokens.  Shapes stay static (capacity B*beam*T);
+    tokens past each hypothesis' length land in the trash segment.
+    ``end_token``, when given, additionally truncates each hypothesis
+    at its first end token (inclusive), like the reference's end_id.
+    """
+    from ..core.ragged import RaggedTensor
+
+    arr = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+    lens = lengths._data if isinstance(lengths, Tensor) \
+        else jnp.asarray(lengths)
+    B, beam, T = arr.shape
+    lens = lens.astype(jnp.int32).reshape(B * beam)
+    if end_token is not None:
+        flat_ids = arr.reshape(B * beam, T)
+        is_end = flat_ids == int(end_token)
+        has_end = jnp.any(is_end, axis=-1)
+        first_end = jnp.argmax(is_end.astype(jnp.int32), axis=-1)
+        lens = jnp.where(has_end,
+                         jnp.minimum(lens, first_end.astype(jnp.int32)
+                                     + 1), lens)
+    splits = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(lens)]).astype(jnp.int32)
+    cap = B * beam * T
+    # scatter each (row, t) to its flat slot; padding -> trash slot
+    pos = splits[:-1][:, None] + jnp.arange(T)[None, :]
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+    slot = jnp.where(valid, pos, cap)
+    flat = jnp.zeros((cap + 1,), arr.dtype)
+    flat = flat.at[slot.reshape(-1)].set(arr.reshape(-1))
+    outer = (jnp.arange(B + 1) * beam).astype(jnp.int32)
+    return RaggedTensor(Tensor(flat[:cap]), Tensor(splits),
+                        outer_lods=(Tensor(outer),))

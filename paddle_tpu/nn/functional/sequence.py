@@ -22,8 +22,34 @@ def sequence_pad(x, pad_value, maxlen=None, lengths=None, name=None):
     """Pad a list of variable-length rows (given as a flat [sum(L), D] array
     plus lengths) into [B, maxlen, D] + lengths (reference sequence_pad_op).
 
-    x may also be a python list of per-sequence arrays.
+    x may also be a python list of per-sequence arrays, or a
+    ``core.ragged.RaggedTensor`` — nested (lod_level >= 2) ragged input
+    pads the bottom level per group via ``to_padded_nested``, mirroring
+    the reference's LoD-aware padding.
     """
+    from ...core.ragged import RaggedTensor
+    if isinstance(x, RaggedTensor):
+        if lengths is not None:
+            raise ValueError(
+                "sequence_pad(RaggedTensor): lengths are carried by "
+                "row_splits — do not pass them separately")
+        pv = float(pad_value) if np.isscalar(pad_value) else float(
+            ensure_tensor(pad_value).numpy())
+        if x.outer_lods:
+            if len(x.outer_lods) > 1:
+                raise ValueError(
+                    "sequence_pad: lod_level > 2 — pad per level with "
+                    "to_padded_nested / to_padded explicitly (a single "
+                    "dense result would silently flatten the outer "
+                    "grouping)")
+            rsl = x.recursive_sequence_lengths()
+            if maxlen is None:
+                maxlen = max(rsl[-1], default=0)
+            max_rows = max(rsl[-2], default=0)
+            return x.to_padded_nested(max_rows, int(maxlen), pv)
+        if maxlen is None:
+            maxlen = int(x.lengths().numpy().max())
+        return x.to_padded(int(maxlen), pv)
     if isinstance(x, (list, tuple)):
         seqs = [np.asarray(s) for s in x]
         computed = np.asarray([len(s) for s in seqs], np.int64)
@@ -160,11 +186,25 @@ def sequence_softmax(x, lengths=None, name=None):
     return prim(x, lengths)
 
 
-def sequence_expand(x, ref_lengths, name=None):
+def sequence_expand(x, ref_lengths, ref_level=-1, name=None):
     """Repeat row i of x ref_lengths[i] times (reference sequence_expand
     with y's LoD).  Repeat counts must be concrete (output shape depends on
     them); the repeat is tape-aware so gradients accumulate per source row.
+
+    RaggedTensor x with a RaggedTensor ref routes to the true-LoD
+    implementation (``core.ragged.sequence_expand``), which repeats
+    whole variable-length rows and supports nested ref levels via
+    ``ref_level`` (reference sequence_expand_op.cc).
     """
+    from ...core.ragged import RaggedTensor
+    if isinstance(x, RaggedTensor):
+        from ...core import ragged as R
+        if not isinstance(ref_lengths, RaggedTensor):
+            raise ValueError(
+                "sequence_expand(RaggedTensor): pass the reference as a "
+                "RaggedTensor (its LoD level ref_level supplies the "
+                "repeat counts)")
+        return R.sequence_expand(x, ref_lengths, ref_level=ref_level)
     x = ensure_tensor(x)
     rl = tuple(int(v) for v in np.asarray(ensure_tensor(ref_lengths)._data))
 
