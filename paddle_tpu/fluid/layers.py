@@ -10,7 +10,7 @@ from __future__ import annotations
 # graph-building layers (create parameters)
 from ..static.nn import (  # noqa: F401
     fc, conv2d, batch_norm, embedding, dropout,
-    cond, while_loop, case, switch_case, py_func)
+    cond, while_loop, case, switch_case, py_func, multi_box_head)
 
 # tensor ops under their fluid names
 from ..ops.compat_ops import (  # noqa: F401

@@ -150,6 +150,13 @@ def beam_search_decode(ids, lengths, end_token=None):
         else jnp.asarray(lengths)
     B, beam, T = arr.shape
     lens = lens.astype(jnp.int32).reshape(B * beam)
+    if not isinstance(lens, jax.core.Tracer):
+        longest = int(jnp.max(lens)) if lens.size else 0
+        if longest > T:
+            raise ValueError(
+                f"beam_search_decode: a length ({longest}) exceeds the "
+                f"time dimension ({T}) — the row_splits would claim "
+                "tokens the scatter must drop")
     if end_token is not None:
         flat_ids = arr.reshape(B * beam, T)
         is_end = flat_ids == int(end_token)

@@ -468,11 +468,89 @@ def nce(input, label, num_total_classes, sample_weight=None,
     return layer(input, label)
 
 
-def multi_box_head(*args, **kwargs):
-    raise NotImplementedError(
-        "multi_box_head (SSD head): compose prior_box + conv heads from "
-        "paddle.vision.ops directly — the monolithic fluid layer is not "
-        "reimplemented")
+def multi_box_head(inputs, image, base_size, num_classes,
+                   aspect_ratios, min_ratio=None, max_ratio=None,
+                   min_sizes=None, max_sizes=None, steps=None,
+                   step_w=None, step_h=None, offset=0.5,
+                   variance=(0.1, 0.1, 0.2, 0.2), flip=True, clip=False,
+                   kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (reference: fluid/layers/detection.py
+    multi_box_head): per-feature-map prior boxes + 1x1/3x3 conv heads
+    for location and confidence, flattened and concatenated.
+
+    Returns (mbox_locs [N, num_priors, 4],
+             mbox_confs [N, num_priors, num_classes],
+             boxes [num_priors, 4], variances [num_priors, 4]).
+    """
+    from ..vision.ops import prior_box as _prior_box
+    from ..ops.manipulation import concat, reshape, transpose
+
+    inputs = list(inputs)
+    n_in = len(inputs)
+    if min_sizes is None:
+        # the reference's min_ratio/max_ratio ladder (percent units):
+        # first map uses base_size*10%/20%; the rest interpolate
+        if min_ratio is None or max_ratio is None:
+            raise ValueError(
+                "multi_box_head: pass min_sizes/max_sizes or "
+                "min_ratio/max_ratio (reference detection.py:2093)")
+        min_sizes, max_sizes = [], []
+        if n_in > 2:
+            ratio_step = int((max_ratio - min_ratio) / (n_in - 2))
+            for r in range(int(min_ratio), int(max_ratio) + 1,
+                           ratio_step):
+                min_sizes.append(base_size * r / 100.0)
+                max_sizes.append(base_size * (r + ratio_step) / 100.0)
+        elif n_in == 2:
+            # the reference ladder divides by (n_in - 2); give the
+            # second map the full min..max ratio span instead of
+            # crashing
+            min_sizes.append(base_size * min_ratio / 100.0)
+            max_sizes.append(base_size * max_ratio / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+
+    locs, confs, all_boxes, all_vars = [], [], [], []
+    for i, inp in enumerate(inputs):
+        ms = min_sizes[i]
+        ms = ms if isinstance(ms, (list, tuple)) else [ms]
+        mx = None
+        if max_sizes:
+            mx = max_sizes[i]
+            mx = mx if isinstance(mx, (list, tuple)) else [mx]
+        ar = aspect_ratios[i]
+        ar = ar if isinstance(ar, (list, tuple)) else [ar]
+        st = None
+        if steps:
+            st = steps[i] if isinstance(steps[i], (list, tuple)) \
+                else [steps[i], steps[i]]
+        elif step_w or step_h:
+            st = [step_w[i] if step_w else 0.0,
+                  step_h[i] if step_h else 0.0]
+        boxes, vars_ = _prior_box(
+            inp, image, ms, mx, ar, variance, flip, clip,
+            steps=st or (0.0, 0.0), offset=offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        num_priors_per_loc = boxes.shape[2]
+        all_boxes.append(reshape(boxes, [-1, 4]))
+        all_vars.append(reshape(vars_, [-1, 4]))
+
+        # conv heads predict P*4 locs and P*C scores per location
+        loc = conv2d(inp, num_priors_per_loc * 4, kernel_size,
+                     stride=stride, padding=pad)
+        loc = transpose(loc, [0, 2, 3, 1])           # NCHW -> NHWC
+        locs.append(reshape(loc, [loc.shape[0], -1, 4]))
+        conf = conv2d(inp, num_priors_per_loc * num_classes,
+                      kernel_size, stride=stride, padding=pad)
+        conf = transpose(conf, [0, 2, 3, 1])
+        confs.append(reshape(conf, [conf.shape[0], -1, num_classes]))
+
+    mbox_locs = concat(locs, axis=1)
+    mbox_confs = concat(confs, axis=1)
+    boxes = concat(all_boxes, axis=0)
+    variances = concat(all_vars, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
 
 
 def py_func(func, x, out, backward_func=None,

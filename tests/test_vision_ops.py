@@ -454,3 +454,70 @@ class TestDetectionLongTail:
         o = out.numpy()[:k]
         assert (o[:, 1] >= 0.05).all()
         assert (o[:, 0] >= 0).all()
+
+
+class TestMultiBoxHead:
+    """static.nn.multi_box_head (reference fluid/layers/detection.py):
+    SSD head composed from prior_box + conv heads."""
+
+    def test_shapes_align_with_priors(self):
+        rs = np.random.RandomState(0)
+        img = paddle.to_tensor(rs.rand(2, 3, 64, 64).astype("float32"))
+        feats = [paddle.to_tensor(rs.rand(2, 8, s, s).astype("float32"))
+                 for s in (8, 4, 2)]
+        locs, confs, boxes, vars_ = paddle.static.nn.multi_box_head(
+            feats, img, base_size=64, num_classes=5,
+            aspect_ratios=[[2.0], [2.0, 3.0], [2.0]],
+            min_ratio=20, max_ratio=90, flip=True)
+        assert locs.shape[0] == 2 and confs.shape[0] == 2
+        assert locs.shape[1] == boxes.shape[0] == confs.shape[1]
+        assert locs.shape[2] == 4 and confs.shape[2] == 5
+        assert list(vars_.shape) == list(boxes.shape)
+        # per-map prior count must match prior_box directly
+        from paddle_tpu.vision.ops import prior_box
+        b0, _ = prior_box(feats[0], img, [6.4], [12.8], [2.0],
+                          flip=True)
+        expect0 = int(np.prod(b0.shape[:3]))
+        b_np = boxes.numpy()
+        assert b_np.shape[0] > expect0  # later maps add more
+        np.testing.assert_allclose(
+            b_np[:expect0], b0.numpy().reshape(-1, 4), rtol=1e-6)
+
+    def test_explicit_sizes_and_two_maps(self):
+        rs = np.random.RandomState(1)
+        img = paddle.to_tensor(rs.rand(1, 3, 32, 32).astype("float32"))
+        feats = [paddle.to_tensor(rs.rand(1, 4, s, s).astype("float32"))
+                 for s in (4, 2)]
+        locs, confs, boxes, _ = paddle.static.nn.multi_box_head(
+            feats, img, base_size=32, num_classes=3,
+            aspect_ratios=[[2.0], [2.0]],
+            min_sizes=[4.0, 8.0], max_sizes=[8.0, 16.0])
+        assert locs.shape[1] == boxes.shape[0]
+        # ratio fallback for exactly two maps must not crash either
+        locs2, _, boxes2, _ = paddle.static.nn.multi_box_head(
+            feats, img, base_size=32, num_classes=3,
+            aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90)
+        assert locs2.shape[1] == boxes2.shape[0]
+
+    def test_records_in_static_program(self):
+        from paddle_tpu import static
+        main, startup = static.Program(), static.Program()
+        paddle.enable_static()
+        try:
+            with static.program_guard(main, startup):
+                img = static.data("img", [1, 3, 32, 32])
+                f = static.data("f", [1, 4, 4, 4])
+                locs, confs, boxes, _ = static.nn.multi_box_head(
+                    [f], img, base_size=32, num_classes=3,
+                    aspect_ratios=[[2.0]], min_sizes=[4.0],
+                    max_sizes=[8.0])
+                exe = static.Executor()
+                rs = np.random.RandomState(2)
+                lv, cv = exe.run(
+                    feed={"img": rs.rand(1, 3, 32, 32).astype("float32"),
+                          "f": rs.rand(1, 4, 4, 4).astype("float32")},
+                    fetch_list=[locs, confs])
+        finally:
+            paddle.disable_static()
+        assert lv.shape[1] == cv.shape[1]
+        assert np.isfinite(lv).all() and np.isfinite(cv).all()
