@@ -22,7 +22,7 @@ from . import checkpoint  # noqa: F401
 from .launch import launch_main  # noqa: F401
 from .ring import ring_attention  # noqa: F401
 from .moe import MoELayer, ExpertFFN, top_k_gating  # noqa: F401
-from .ps import (SparseTable, DistributedEmbedding,  # noqa: F401
-                 TheOnePS, get_ps_runtime)
+from .ps import (SparseTable, HashedSparseTable,  # noqa: F401
+                 DistributedEmbedding, TheOnePS, get_ps_runtime)
 from ..io.native_dataset import (  # noqa: F401
     InMemoryDataset, QueueDataset)

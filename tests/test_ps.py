@@ -161,3 +161,139 @@ def test_push_cost_independent_of_table_size(mesh):
 
     ts, tb = timed(small), timed(big)
     assert tb < ts * 10, (ts, tb)
+
+
+# ---------------------------------------------------------------------------
+# HashedSparseTable: unbounded ids over a growing slab (round 4)
+
+class TestHashedSparseTable:
+    def test_unbounded_ids_and_growth(self, mesh):
+        from paddle_tpu.distributed import HashedSparseTable
+        paddle.seed(0)
+        t = HashedSparseTable("h1", dim=4, initial_rows=4, optimizer="sgd",
+                              lr=0.5, mesh=mesh)
+        # ids far beyond any fixed capacity (feature hashes)
+        ids = np.array([2**62 + 7, 123456789012345, 2**40, 17, 2**62 + 7],
+                       np.int64)
+        v1 = t.pull(ids).numpy()
+        # same id -> same row
+        np.testing.assert_allclose(v1[0], v1[4])
+        assert t.size == 4
+        # push 12 more distinct ids: slab must grow past initial_rows=4
+        more = np.arange(100, 112, dtype=np.int64)
+        t.pull(more)
+        assert t.size == 16 and t.rows >= 16
+
+    def test_push_updates_only_touched(self, mesh):
+        from paddle_tpu.distributed import HashedSparseTable
+        paddle.seed(1)
+        t = HashedSparseTable("h2", dim=3, initial_rows=4, optimizer="sgd",
+                              lr=1.0, mesh=mesh)
+        ids = np.array([10**15, 5], np.int64)
+        before = t.pull(ids).numpy()
+        other = t.pull(np.array([777], np.int64)).numpy()
+        g = np.ones((2, 3), np.float32)
+        t.push(ids, g)
+        after = t.pull(ids).numpy()
+        np.testing.assert_allclose(after, before - 1.0, rtol=1e-6)
+        np.testing.assert_allclose(
+            t.pull(np.array([777], np.int64)).numpy(), other)
+
+    def test_adam_matches_fixed_table(self, mesh):
+        """Same pushes through hashed and fixed tables agree row-for-row."""
+        from paddle_tpu.distributed import HashedSparseTable
+        rs = np.random.RandomState(0)
+        init = lambda shape, dtype: np.zeros(shape, dtype)
+        t_fix = SparseTable("f3", rows=8, dim=3, optimizer="adam", lr=0.1,
+                            initializer=init, mesh=mesh)
+        t_h = HashedSparseTable("h3", dim=3, initial_rows=2,
+                                optimizer="adam", lr=0.1,
+                                initializer=init, mesh=mesh)
+        big_ids = np.array([2**50, 3, 2**61, 40, 2**50], np.int64)
+        fix_ids = np.array([0, 1, 2, 3, 0], np.int64)  # same collision map
+        for _ in range(3):
+            g = rs.rand(5, 3).astype(np.float32)
+            t_fix.push(fix_ids, g)
+            t_h.push(big_ids, g)
+        np.testing.assert_allclose(
+            t_h.pull(big_ids).numpy(), t_fix.pull(fix_ids).numpy(),
+            rtol=1e-5)
+
+    def test_shrink_evicts_stale(self, mesh):
+        from paddle_tpu.distributed import HashedSparseTable
+        paddle.seed(2)
+        t = HashedSparseTable("h4", dim=2, initial_rows=4, optimizer="sgd",
+                              lr=0.5, mesh=mesh)
+        old = np.array([1, 2], np.int64)
+        t.push(old, np.ones((2, 2), np.float32))
+        for i in range(5):
+            t.push(np.array([100 + i], np.int64),
+                   np.ones((1, 2), np.float32))
+        n = t.shrink(ttl=4)
+        assert n == 2 and t.size == 5
+        # evicted ids return as FRESH rows (slot reused, value reset)
+        fresh = t.pull(old)
+        assert np.isfinite(fresh.numpy()).all()
+
+    def test_save_load_roundtrip(self, tmp_path, mesh):
+        from paddle_tpu.distributed import HashedSparseTable
+        paddle.seed(3)
+        t = HashedSparseTable("h5", dim=3, initial_rows=2,
+                              optimizer="adam", lr=0.1, mesh=mesh)
+        ids = np.array([2**55, 9, 2**44, 123], np.int64)
+        t.push(ids, np.ones((4, 3), np.float32))
+        want = t.pull(ids).numpy()
+        t.save(str(tmp_path))
+        paddle.seed(4)  # different init must not matter after load
+        t2 = HashedSparseTable("h5", dim=3, initial_rows=2,
+                               optimizer="adam", lr=0.1, mesh=mesh)
+        t2.load(str(tmp_path))
+        np.testing.assert_allclose(t2.pull(ids).numpy(), want, rtol=1e-6)
+        assert t2.size == 4
+
+    def test_max_rows_exhaustion_raises(self, mesh):
+        from paddle_tpu.distributed import HashedSparseTable
+        t = HashedSparseTable("h6", dim=2, initial_rows=2, max_rows=4,
+                              optimizer="sgd", mesh=mesh)
+        with pytest.raises(RuntimeError, match="max_rows"):
+            t.pull(np.arange(5, dtype=np.int64))
+
+    def test_runtime_facade_creates_hashed(self, mesh):
+        ps = TheOnePS()
+        t = ps.create_table("h7", rows=None, dim=2, initial_rows=2,
+                            mesh=mesh)
+        from paddle_tpu.distributed import HashedSparseTable
+        assert isinstance(t, HashedSparseTable)
+
+    def test_pull_preserves_ids_shape(self, mesh):
+        from paddle_tpu.distributed import HashedSparseTable
+        t = HashedSparseTable("h8", dim=3, initial_rows=2, mesh=mesh)
+        ids = np.array([[2**50, 5], [7, 2**50]], np.int64)
+        out = t.pull(ids)
+        assert list(out.shape) == [2, 2, 3]
+        np.testing.assert_allclose(out.numpy()[0, 0], out.numpy()[1, 1])
+
+    def test_clamped_growth_keeps_valid_sharding(self, mesh):
+        from paddle_tpu.distributed import HashedSparseTable
+        # shard axis is 2; max_rows=6 forces a non-divisible slab once
+        t = HashedSparseTable("h9", dim=2, initial_rows=4, max_rows=6,
+                              optimizer="sgd", mesh=mesh)
+        t.pull(np.arange(6, dtype=np.int64))       # grows 4 -> 6
+        assert t.rows == 6
+        t.push(np.arange(6, dtype=np.int64), np.ones((6, 2), np.float32))
+        assert np.isfinite(np.asarray(t.weight)).all()
+
+    def test_load_into_default_capacity_table(self, tmp_path, mesh):
+        """Saved slab/max_rows win over the fresh table's constructor
+        args — no need to re-pass the original initial_rows/max_rows."""
+        from paddle_tpu.distributed import HashedSparseTable
+        t = HashedSparseTable("h10", dim=2, initial_rows=2, max_rows=6,
+                              mesh=mesh)
+        ids = np.arange(6, dtype=np.int64) + 2**33
+        t.push(ids, np.ones((6, 2), np.float32))   # grows 2 -> 4 -> 6
+        want = t.pull(ids).numpy()
+        t.save(str(tmp_path))
+        t2 = HashedSparseTable("h10", dim=2, mesh=mesh)  # defaults
+        t2.load(str(tmp_path))
+        assert t2.rows == 6 and t2.max_rows == 6
+        np.testing.assert_allclose(t2.pull(ids).numpy(), want, rtol=1e-6)
