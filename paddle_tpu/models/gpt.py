@@ -309,10 +309,10 @@ class GPTScanBlocks(ScanLayers):
     Init is bit-identical to the unrolled ``LayerList`` under the same
     seed, training parity is exact (``tests/test_gpt_scan.py``), and
     the 1.3B full-step XLA compile drops 212-460s -> 18.6s on the CPU
-    rehearsal (BASELINE.md round 3).  Scope: the dense training/forward
-    path — KV-cache decode, tensor/sequence parallel and MoE variants
-    stay on the unrolled form (their blocks are not homogeneous scan
-    bodies)."""
+    rehearsal (BASELINE.md round 3).  Scope: the dense AND packed
+    (doc_segments flash-masked) training/forward paths — KV-cache
+    decode, tensor/sequence parallel and MoE variants stay on the
+    unrolled form (their blocks are not homogeneous scan bodies)."""
 
     def __init__(self, num_layers, hidden_size, num_heads, dropout=0.1,
                  use_recompute=False, recompute_policy=None):
@@ -412,13 +412,16 @@ class GPTModel(nn.Layer):
         x = self.embeddings(input_ids, position_offset=position_offset,
                             position_ids=position_ids)
         if self.scan_layers:
-            if caches is not None or doc_segments is not None:
+            if caches is not None:
                 raise NotImplementedError(
-                    "scan_layers covers the dense training/forward "
-                    "path; KV-cache decode and packed sequences use "
-                    "the unrolled model (state_dicts interconvert by "
-                    "stacking/unstacking the block leaves)")
-            x = self.blocks(x)
+                    "scan_layers covers the training/forward path; "
+                    "KV-cache decode uses the unrolled model "
+                    "(state_dicts interconvert by stacking/unstacking "
+                    "the block leaves)")
+            # packed mode rides along: doc_segments is a scan-invariant
+            # extra broadcast to every layer (the cache slot stays None,
+            # and ScanLayers drops None extras while keeping positions)
+            x = self.blocks(x, None, doc_segments)
         else:
             if caches is not None:
                 new_caches = []

@@ -31,6 +31,7 @@ class TestPackedForward:
         m.eval()
         return m
 
+    @pytest.mark.slow
     def test_packed_equals_per_document(self):
         """Logits of each packed document equal running it alone."""
         m = self._model()
@@ -79,6 +80,7 @@ class TestPackedForward:
             n += len(d) - 1
         np.testing.assert_allclose(loss_packed, tot / n, rtol=1e-4)
 
+    @pytest.mark.slow
     def test_packed_trains(self):
         paddle.seed(2)
         m = GPTModel.from_config("tiny", dropout=0.0)
@@ -152,6 +154,7 @@ def test_fused_ce_ignore_index_matches_standard():
                                rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_packed_fused_loss_matches_materializing():
     """GPT packed training loss is identical with and without the fused
     chunked CE (the fused path now handles ignore_index)."""
@@ -173,3 +176,57 @@ def test_packed_fused_loss_matches_materializing():
                  doc_lens=paddle.to_tensor(doc_lens))
         losses.append(float(loss.numpy()))
     assert abs(losses[0] - losses[1]) < 1e-5, losses
+
+
+class TestPackedScanLayers:
+    """Packed mode under scan_layers (round 4): doc_segments is a
+    scan-invariant extra broadcast to every block, so the 1.3B-class
+    one-body compile wins apply to packed pretraining too."""
+
+    def test_packed_scan_matches_unrolled(self):
+        from paddle_tpu.parallel.train_step import TrainStep
+        rs = np.random.RandomState(3)
+        packed = rs.randint(0, 128, (2, 16)).astype(np.int32)
+        labels = rs.randint(0, 128, (2, 16)).astype(np.int64)
+        doc_lens = np.array([[7, 9], [16, 0]])
+
+        def run(scan):
+            paddle.seed(5)
+            m = GPTModel.from_config("tiny", dropout=0.0,
+                                     fused_loss=True, max_position=64,
+                                     scan_layers=scan)
+            opt = optimizer.AdamW(learning_rate=1e-3,
+                                  parameters=m.parameters())
+            losses = []
+            for _ in range(4):
+                loss = m(paddle.to_tensor(packed),
+                         labels=paddle.to_tensor(labels),
+                         doc_lens=paddle.to_tensor(doc_lens))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss.numpy()))
+            return losses
+
+        np.testing.assert_allclose(run(False), run(True), rtol=1e-4)
+
+    def test_packed_scan_isolation(self):
+        """Cross-document attention stays masked through the scan path:
+        packing two docs equals running them separately."""
+        paddle.seed(6)
+        m = GPTModel.from_config("tiny", dropout=0.0, max_position=64,
+                                 scan_layers=True)
+        m.eval()
+        rs = np.random.RandomState(6)
+        d0 = rs.randint(0, 128, (5,)).astype(np.int32)
+        d1 = rs.randint(0, 128, (11,)).astype(np.int32)
+        packed = np.concatenate([d0, d1])[None]
+        doc_lens = np.array([[5, 11]])
+        lp = m(paddle.to_tensor(packed),
+               doc_lens=paddle.to_tensor(doc_lens)).numpy()
+        l0 = m(paddle.to_tensor(d0[None])).numpy()
+        l1 = m(paddle.to_tensor(d1[None])).numpy()
+        np.testing.assert_allclose(lp[0, :5], l0[0], rtol=2e-3,
+                                   atol=2e-4)
+        np.testing.assert_allclose(lp[0, 5:], l1[0], rtol=2e-3,
+                                   atol=2e-4)
