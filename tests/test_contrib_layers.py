@@ -327,6 +327,7 @@ class TestCtrOps:
         with pytest.raises(ValueError, match="identical shapes"):
             cl.correlation(x, y, 4, 1, 4, 1, 1)
 
+    @pytest.mark.slow
     def test_bilateral_slice_vs_reference_oracle(self):
         """Transliterated naive_bilateral_slice from the reference
         test_bilateral_slice_op.py (tent weights, clamped corners,

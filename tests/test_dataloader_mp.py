@@ -257,6 +257,7 @@ class TestReviewRegressions:
         assert len(out) == 3
         loader._pool.close()
 
+    @pytest.mark.slow
     def test_iterable_dead_worker_raises(self):
         import os as _os
 
@@ -287,6 +288,7 @@ class TestReviewRegressions:
         e2 = next(iter(loader)).numpy()
         assert not np.allclose(e1, e2), "epochs replayed identical RNG"
 
+    @pytest.mark.slow
     def test_iterable_early_finisher_not_flagged_dead(self):
         import time as _t
 
@@ -306,6 +308,7 @@ class TestReviewRegressions:
 
 
 class TestPoolLifecycle:
+    @pytest.mark.slow
     def test_abandoned_unstarted_iterator_releases_pool(self):
         """An iterator obtained but never advanced must release its
         claim on GC — previously pool.busy stayed True forever and each

@@ -472,6 +472,7 @@ class TestGradientMerge:
                                    net_b[0].weight.numpy(), rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_recompute_matches_plain():
     """Per-tick remat must not change pipeline numerics (only memory)."""
     import numpy as np
@@ -555,7 +556,9 @@ class TestRingAttentionTraining:
         return [float(step.step([ids[:, :-1]], [ids[:, 1:]]).numpy())
                 for _ in range(3)]
 
-    @pytest.mark.parametrize("use_sp,sp", [(True, 4), ("ulysses", 2)])
+    @pytest.mark.parametrize("use_sp,sp", [
+        pytest.param(True, 4, marks=pytest.mark.slow),
+        ("ulysses", 2)])
     def test_sp_model_trains_and_matches_dense(self, use_sp, sp):
         ids = np.random.RandomState(0).randint(0, 128, (4, 33)) \
             .astype(np.int64)
@@ -580,6 +583,7 @@ class TestRingAttentionTraining:
         finally:
             dist.set_mesh(None)
 
+    @pytest.mark.slow
     def test_ring_dropout_trains_and_masks(self):
         """Attention dropout under sp: training runs finite, masks vary
         across steps, dropout=0 path unchanged."""
@@ -617,6 +621,7 @@ class TestRingAttentionTraining:
         finally:
             dist.set_mesh(None)
 
+    @pytest.mark.slow
     def test_ulysses_dropout_trains_and_matches_ring(self):
         """use_sp='ulysses' with dropout>0 trains (the round-2 raise is
         gone); its loss trajectory stays close to ring-sp's — same model,
@@ -652,6 +657,7 @@ class TestRingAttentionTraining:
         finally:
             dist.set_mesh(None)
 
+    @pytest.mark.slow
     def test_ulysses_dropout_eval_unaffected(self):
         """Eval forward with ulysses must equal the dropout=0 model."""
         from paddle_tpu.models import GPTModel

@@ -29,6 +29,7 @@ def test_cached_generate_matches_full_forward(tiny_gpt):
     np.testing.assert_array_equal(out.numpy(), seq)
 
 
+@pytest.mark.slow
 def test_generate_topk_sampling_reproducible(tiny_gpt):
     ids = np.zeros((1, 3), np.int32)
     a = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=5,
@@ -187,6 +188,7 @@ def test_generate_top_p_nucleus(tiny_gpt):
     np.testing.assert_array_equal(zero_p.numpy(), greedy.numpy())
 
 
+@pytest.mark.slow
 def test_generate_top_p_compiled_consistent(tiny_gpt):
     """top_p sampling works through the compiled decode path too and
     matches the eager path token-for-token (same seed, same filter)."""
@@ -228,6 +230,7 @@ def test_fused_generate_top_p_matches_eager(tiny_gpt):
     np.testing.assert_array_equal(eager.numpy(), fused.numpy())
 
 
+@pytest.mark.slow
 def test_fused_generate_eos_truncation(tiny_gpt):
     """Fused decode truncates at the first all-rows-eos step exactly like
     the eager loop's break."""

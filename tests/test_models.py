@@ -15,6 +15,7 @@ rng = np.random.RandomState(11)
 
 
 class TestGPT:
+    @pytest.mark.slow
     def test_forward_shapes(self):
         model = GPTModel.from_config("tiny")
         ids = rng.randint(0, 128, (2, 16)).astype(np.int64)
@@ -56,6 +57,7 @@ class TestGPT:
         out = pipe(paddle_tpu.to_tensor(ids))
         assert out.shape == [2, 8, 128]
 
+    @pytest.mark.slow
     def test_gpt_hybrid_dp_mp_train(self):
         mesh = dist.build_mesh(dp=2, mp=4)
         dist.set_mesh(mesh)
@@ -144,6 +146,7 @@ class TestVisionModels:
         loss = step.step([x], [labels])
         assert np.isfinite(loss.numpy())
 
+    @pytest.mark.slow
     def test_recompute_block(self):
         from paddle_tpu.models.gpt import GPTModel
         paddle_tpu.seed(4)

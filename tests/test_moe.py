@@ -41,6 +41,7 @@ def test_top_k_gating_drops_overflow():
     assert float(dispatch[:, 1].sum()) == 0
 
 
+@pytest.mark.slow
 def test_moe_layer_forward_backward_eager():
     paddle.seed(0)
     layer = MoELayer(16, num_experts=4, k=2)

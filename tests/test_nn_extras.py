@@ -410,6 +410,7 @@ def _fluid_to_square(trans_fluid, N):
     return sq
 
 
+@pytest.mark.slow
 def test_crf_loss_trains():
     # transition + emission params learn to predict a fixed tag sequence
     paddle.seed(13)

@@ -47,6 +47,7 @@ def _gpt_pipe_step(schedule, M=4, steps=1, recompute=False):
 
 
 class TestOneFOneB:
+    @pytest.mark.slow
     def test_matches_gpipe_loss_and_params(self, pp_mesh):
         l_g, st_g = _gpt_pipe_step("F-then-B", steps=3)
         l_f, st_f = _gpt_pipe_step("1F1B", steps=3)
@@ -57,6 +58,7 @@ class TestOneFOneB:
                 np.asarray(st_f.params["block"][k]),
                 rtol=2e-2, atol=2e-4)
 
+    @pytest.mark.slow
     def test_memory_below_gpipe(self, pp_mesh):
         """live-activation criterion: compiled temp memory at M=16 must
         be well below plain GPipe's (O(P) vs O(M) residency)."""

@@ -93,6 +93,7 @@ class TestImperativeQAT:
         assert isinstance(net.conv, QuantizedConv2D)
         assert isinstance(net.fc, QuantizedLinear)
 
+    @pytest.mark.slow
     def test_qat_trains_and_eval_uses_frozen_scale(self):
         paddle.seed(0)
         rs = np.random.RandomState(0)
@@ -318,7 +319,8 @@ class TestPostTrainingQuantization:
         rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
         assert rel < 0.05, rel
 
-    @pytest.mark.parametrize("algo", ["avg", "KL"])
+    @pytest.mark.parametrize("algo", [
+        "avg", pytest.param("KL", marks=pytest.mark.slow)])
     def test_algos_produce_sane_scales(self, algo):
         from paddle_tpu.quantization import PostTrainingQuantization
         paddle.seed(12)
@@ -622,6 +624,7 @@ class TestWeightOnlyInt8:
         assert rel < 0.02, rel
         assert str(q.weight_int8._data.dtype) == "int8"
 
+    @pytest.mark.slow
     def test_gpt_decode_after_weight_only(self):
         """Weight-only int8 GPT generates: same API, token stream close
         to float greedy (small logit perturbation can flip near-ties, so
