@@ -85,14 +85,14 @@ from collections import OrderedDict
 
 _RING_CACHE_CAP = 16
 _ring_jit_cache: "OrderedDict" = OrderedDict()
-_placeholder_key = None
 
 
 def _get_placeholder_key():
-    global _placeholder_key
-    if _placeholder_key is None:
-        _placeholder_key = jax.random.key(0)
-    return _placeholder_key
+    # NEVER cached: the first call can happen inside a jit trace, and a
+    # module-global would then hold that trace's tracer — leaking it
+    # into every later trace (UnexpectedTracerError; found by the slow
+    # lane's test ordering).  Creation is microseconds.
+    return jax.random.key(0)
 
 
 def _mesh_cache_key(mesh):

@@ -588,11 +588,12 @@ def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
 
 def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
              prior_box_var=None, **kwargs):
-    raise NotImplementedError(
-        "ssd_loss: compose iou_similarity + bipartite_match + "
-        "target_assign + smooth_l1/softmax_with_cross_entropy — the "
-        "monolithic op is a composition in the reference too "
-        "(fluid/layers/detection.py ssd_loss)")
+    """Reference fluid/layers/detection.py ssd_loss — real composition
+    (matching + hard negative mining + smooth-L1/CE), see
+    nn/functional/legacy.py:ssd_loss."""
+    from ..nn.functional.legacy import ssd_loss as _impl
+    return _impl(location, confidence, gt_box, gt_label, prior_box,
+                 prior_box_var=prior_box_var, **kwargs)
 
 
 def chunk_eval(input, label, chunk_scheme, num_chunk_types,

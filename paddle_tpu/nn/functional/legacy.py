@@ -947,3 +947,136 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
         "paddle.nn.LSTM(input_size, hidden_size, num_layers, "
         "direction='bidirect' if is_bidirec else 'forward') and call it — "
         "same math, explicit parameters (reference: cudnn_lstm_op.cu)")
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0,
+             overlap_threshold=0.5, neg_pos_ratio=3.0, neg_overlap=0.5,
+             loc_loss_weight=1.0, conf_loss_weight=1.0,
+             match_type="per_prediction", mining_type="max_negative",
+             normalize=True, sample_size=None):
+    """SSD multibox loss (reference: fluid/layers/detection.py
+    ssd_loss, itself a composition of iou_similarity + bipartite_match
+    + target_assign + mine_hard_examples + smooth_l1 + softmax CE).
+
+    location [N, Np, 4], confidence [N, Np, C]; ground truth is a LIST
+    of per-image arrays (``gt_box[i]`` [ng_i, 4], ``gt_label[i]``
+    [ng_i]) — the variable-length analogue of the reference's
+    LoDTensor inputs (a single [Ng, 4] array means batch size 1).
+
+    Matching and hard-negative selection run HOST-SIDE (numpy), exactly
+    like the reference's CPU bipartite_match/mine_hard_examples
+    kernels; the loss itself is jnp, so gradients flow to
+    location/confidence.  Eager-mode training path (the reference
+    never ran this op on accelerators either).  Returns the weighted
+    per-prior loss [N, Np] (normalized by total positives when
+    ``normalize``).
+    """
+    if mining_type not in ("max_negative", "hard_example"):
+        raise ValueError(
+            f"ssd_loss: mining_type must be 'max_negative' or "
+            f"'hard_example', got {mining_type!r} (reference "
+            "detection.py validates the same)")
+    location = ensure_tensor(location)
+    confidence = ensure_tensor(confidence)
+    loc = location._data
+    conf = confidence._data
+    N, Np, _ = loc.shape
+    pb = np.asarray(ensure_tensor(prior_box).numpy(), np.float32)
+    # like box_coder: NO variance scaling unless the caller provides it
+    pbv = np.asarray(ensure_tensor(prior_box_var).numpy(), np.float32) \
+        if prior_box_var is not None else None
+    if not isinstance(gt_box, (list, tuple)):
+        gt_box = [gt_box]
+    if not isinstance(gt_label, (list, tuple)):
+        gt_label = [gt_label]
+    if len(gt_box) != N:
+        raise ValueError(
+            f"ssd_loss: {len(gt_box)} ground-truth entries for batch "
+            f"size {N}")
+
+    def _np_iou(g, p):
+        """[M, 4] x [Np, 4] -> [M, Np] IoU (normalized coords)."""
+        ix1 = np.maximum(g[:, None, 0], p[None, :, 0])
+        iy1 = np.maximum(g[:, None, 1], p[None, :, 1])
+        ix2 = np.minimum(g[:, None, 2], p[None, :, 2])
+        iy2 = np.minimum(g[:, None, 3], p[None, :, 3])
+        inter = np.clip(ix2 - ix1, 0, None) * np.clip(iy2 - iy1, 0,
+                                                      None)
+        ag = ((g[:, 2] - g[:, 0]) * (g[:, 3] - g[:, 1]))[:, None]
+        ap = ((p[:, 2] - p[:, 0]) * (p[:, 3] - p[:, 1]))[None, :]
+        return inter / np.maximum(ag + ap - inter, 1e-10)
+
+    match_idx = np.full((N, Np), -1, np.int32)
+    best_iou = np.zeros((N, Np), np.float32)
+    loc_tgt = np.zeros((N, Np, 4), np.float32)
+    conf_tgt = np.full((N, Np), int(background_label), np.int64)
+    for i in range(N):
+        g = np.asarray(ensure_tensor(gt_box[i]).numpy(),
+                       np.float32).reshape(-1, 4)
+        lbl = np.asarray(ensure_tensor(gt_label[i]).numpy(),
+                         np.int64).reshape(-1)
+        if g.shape[0] == 0:
+            continue
+        iou = _np_iou(g, pb)
+        mi, _ = bipartite_match(iou, match_type, overlap_threshold)
+        mi = np.asarray(mi.numpy()).reshape(-1)
+        match_idx[i] = mi
+        best_iou[i] = iou.max(axis=0)
+        pos = mi >= 0
+        conf_tgt[i, pos] = lbl[np.clip(mi[pos], 0, len(lbl) - 1)]
+        # encode matched gt against priors via the SAME box_coder rule
+        # every other consumer uses (no parallel geometry code)
+        from ...vision.ops import box_coder as _box_coder
+        enc_full = np.asarray(_box_coder(
+            pb, pbv, g, code_type="encode_center_size").numpy(),
+            np.float32)                                  # [M, Np, 4]
+        enc = enc_full[np.clip(mi, 0, len(g) - 1), np.arange(Np)]
+        loc_tgt[i] = np.where(pos[:, None], enc, 0.0)
+
+    pos_mask = (match_idx >= 0)
+    npos = pos_mask.sum()
+
+    # hard negative mining on the HOST over concrete conf losses
+    # (mining is sampling, not a differentiable quantity)
+    conf_np = np.asarray(jax.lax.stop_gradient(conf), np.float32)
+    shifted = conf_np - conf_np.max(-1, keepdims=True)
+    logp_np = shifted - np.log(np.exp(shifted).sum(-1, keepdims=True))
+    ce_np = -np.take_along_axis(logp_np, conf_tgt[..., None],
+                                axis=-1)[..., 0]
+    neg_cand = (~pos_mask) & (best_iou < float(neg_overlap))
+    neg_sel = np.zeros_like(neg_cand)
+    for i in range(N):
+        np_i = int(pos_mask[i].sum())
+        if mining_type == "max_negative":
+            k = int(neg_pos_ratio * np_i)
+        else:  # hard_example (sample_size)
+            k = int(sample_size) if sample_size else int(
+                neg_pos_ratio * np_i)
+        cand = np.where(neg_cand[i])[0]
+        if k > 0 and cand.size:
+            order = cand[np.argsort(-ce_np[i, cand])]
+            neg_sel[i, order[:min(k, order.size)]] = True
+
+    # the LOSS goes through the primitive wrapper: tape-recorded, so
+    # loss.backward() reaches location/confidence (matching targets
+    # and mining masks enter as constants)
+    tgt_c = conf_tgt
+    loc_tgt_c = loc_tgt
+    sel_c = (pos_mask | neg_sel)
+    pos_c = pos_mask
+    denom = max(float(npos), 1.0) if normalize else 1.0
+
+    def fn(loc_a, conf_a):
+        logp = jax.nn.log_softmax(conf_a.astype(jnp.float32), axis=-1)
+        ce = -jnp.take_along_axis(
+            logp, jnp.asarray(tgt_c)[..., None], axis=-1)[..., 0]
+        conf_l = ce * jnp.asarray(sel_c).astype(ce.dtype)
+        diff = loc_a.astype(jnp.float32) - jnp.asarray(loc_tgt_c)
+        ad = jnp.abs(diff)
+        sl1 = jnp.where(ad < 1.0, 0.5 * diff * diff, ad - 0.5).sum(-1)
+        loc_l = sl1 * jnp.asarray(pos_c).astype(sl1.dtype)
+        return (float(conf_loss_weight) * conf_l
+                + float(loc_loss_weight) * loc_l) / denom
+
+    return primitive(name="ssd_loss")(fn)(location, confidence)

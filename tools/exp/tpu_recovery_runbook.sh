@@ -86,5 +86,20 @@ run 13b_scan_b2 2400 python tools/exp/_exp_13b.py --scan --batch 2 --seq 1024 --
 # 7) long-context s4096 (round-2 recorded 24,472 tok/s b3)
 run long 1800 python tools/exp/_exp_long.py
 
+# 8) py_func host-callback smoke ON TPU: pure_callback crosses the axon
+#    tunnel via XLA host callbacks — prove the round-4 op works there
+run pyfunc_smoke 300 python - <<'EOF'
+import numpy as np, paddle_tpu as paddle
+x = paddle.to_tensor(np.linspace(-1, 1, 8).astype("float32"),
+                     stop_gradient=False)
+y = paddle.static.py_func(lambda a: np.tanh(a), x, paddle.zeros([8]),
+                          backward_func=lambda a, b, d: [d * (1 - b * b)])
+paddle.sum(y).backward()
+import json
+print(json.dumps({"pyfunc_fwd_ok": bool(np.allclose(
+    y.numpy(), np.tanh(np.linspace(-1, 1, 8)), atol=1e-5)),
+    "grad_finite": bool(np.isfinite(x.grad.numpy()).all())}))
+EOF
+
 echo "=== backlog complete; fold results into BASELINE.md and archive"
 echo "=== under tools/exp/results_r4/ (cp -r $OUT tools/exp/results_r4)"
