@@ -716,12 +716,179 @@ generate_proposal_labels = _no_dense_analogue(
 generate_mask_labels = _no_dense_analogue(
     "generate_mask_labels", "training-time sampling with data-dependent "
     "shapes; sample on the host")
-rpn_target_assign = _no_dense_analogue(
-    "rpn_target_assign", "training-time sampling with data-dependent "
-    "shapes; compose bipartite_match + target_assign on the host")
-retinanet_detection_output = _no_dense_analogue(
-    "retinanet_detection_output", "compose yolo-style decode + "
-    "multiclass_nms; focal-loss head decode pending")
+def _np_box_iou(g, p):
+    """[ng, 4] x [M, 4] -> [ng, M] corner-box IoU, host-side (the CPU
+    kernel shared by rpn_target_assign and ssd_loss; the Tensor-level
+    twin is fluid.layers.iou_similarity)."""
+    ix1 = np.maximum(g[:, None, 0], p[None, :, 0])
+    iy1 = np.maximum(g[:, None, 1], p[None, :, 1])
+    ix2 = np.minimum(g[:, None, 2], p[None, :, 2])
+    iy2 = np.minimum(g[:, None, 3], p[None, :, 3])
+    inter = np.clip(ix2 - ix1, 0, None) * np.clip(iy2 - iy1, 0, None)
+    ag = ((g[:, 2] - g[:, 0]) * (g[:, 3] - g[:, 1]))[:, None]
+    ap = ((p[:, 2] - p[:, 0]) * (p[:, 3] - p[:, 1]))[None, :]
+    return inter / np.maximum(ag + ap - inter, 1e-10)
+
+
+def _np_encode_center_size(priors, variances, targets):
+    """Per-pair center-size encode [F, 4] (same rule as vision.ops
+    box_coder encode_center_size, host-side for the matched pairs)."""
+    pw = priors[:, 2] - priors[:, 0]
+    ph = priors[:, 3] - priors[:, 1]
+    pcx = priors[:, 0] + pw / 2
+    pcy = priors[:, 1] + ph / 2
+    tw = targets[:, 2] - targets[:, 0]
+    th = targets[:, 3] - targets[:, 1]
+    tcx = targets[:, 0] + tw / 2
+    tcy = targets[:, 1] + th / 2
+    enc = np.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                    np.log(np.abs(tw / pw)),
+                    np.log(np.abs(th / ph))], axis=-1).astype(np.float32)
+    if variances is not None:
+        enc = enc / variances
+    return enc
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256,
+                      rpn_straddle_thresh=0.0, rpn_fg_fraction=0.5,
+                      rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """RPN target assignment for Faster R-CNN training (reference:
+    fluid/layers/detection.py:311 over rpn_target_assign_op.cc).
+
+    bbox_pred [N, M, 4], cls_logits [N, M, 1], anchor_box/anchor_var
+    [M, 4]; ``gt_boxes`` is a LIST of per-image [ng_i, 4] arrays (the
+    LoD analogue; a single array means N == 1), ``is_crowd`` an
+    optional matching list of 0/1 flags, ``im_info`` [N, 3] (h, w,
+    scale) enabling the straddle filter.
+
+    Anchor labeling follows the paper exactly as the reference does:
+    positives are (i) the highest-IoU anchor per gt and (ii) anchors
+    with IoU >= rpn_positive_overlap; negatives have max-IoU <
+    rpn_negative_overlap; the rest are ignored.  Sampling (host-side,
+    like the reference's CPU kernel) keeps at most
+    ``rpn_fg_fraction * rpn_batch_size_per_im`` foregrounds and fills
+    the rest with sampled backgrounds.  An image with no foreground
+    contributes one FAKE fg (anchor 0) whose bbox_inside_weight row is
+    0 — the reference's fake_fg convention.  Returns
+    (predicted_scores [F+B, 1], predicted_location [F, 4],
+    target_label [F+B, 1] int32, target_bbox [F, 4],
+    bbox_inside_weight [F, 4]); the two predictions are gathered
+    through the tape, so gradients reach bbox_pred / cls_logits.
+    """
+    bbox_pred = ensure_tensor(bbox_pred)
+    cls_logits = ensure_tensor(cls_logits)
+    anchors = np.asarray(ensure_tensor(anchor_box).numpy(), np.float32)
+    avar = np.asarray(ensure_tensor(anchor_var).numpy(), np.float32) \
+        if anchor_var is not None else None
+    N, M = bbox_pred.shape[0], bbox_pred.shape[1]
+    if not isinstance(gt_boxes, (list, tuple)):
+        gt_boxes = [gt_boxes]
+    if len(gt_boxes) != N:
+        raise ValueError(
+            f"rpn_target_assign: {len(gt_boxes)} gt entries for batch "
+            f"size {N}")
+    if is_crowd is not None and not isinstance(is_crowd, (list, tuple)):
+        is_crowd = [is_crowd]
+    im_np = np.asarray(ensure_tensor(im_info).numpy(), np.float32) \
+        if im_info is not None else None
+    rng = np.random  # reference uses the process-global engine too
+
+    loc_inds, score_inds = [], []
+    tgt_boxes, tgt_labels, inside_w = [], [], []
+    max_fg = int(rpn_fg_fraction * rpn_batch_size_per_im)
+    for i in range(N):
+        g = np.asarray(ensure_tensor(gt_boxes[i]).numpy(),
+                       np.float32).reshape(-1, 4)
+        if is_crowd is not None:
+            crowd = np.asarray(ensure_tensor(is_crowd[i]).numpy()
+                               ).reshape(-1).astype(bool)
+            g = g[~crowd]
+        # straddle filter: anchors fully inside the image (+thresh)
+        valid = np.arange(M)
+        if im_np is not None and rpn_straddle_thresh >= 0:
+            h, w = float(im_np[i, 0]), float(im_np[i, 1])
+            t = float(rpn_straddle_thresh)
+            keep = ((anchors[:, 0] >= -t) & (anchors[:, 1] >= -t)
+                    & (anchors[:, 2] < w + t) & (anchors[:, 3] < h + t))
+            valid = np.where(keep)[0]
+        av = anchors[valid]
+        fg_local = np.zeros((0,), np.int64)
+        bg_local = np.arange(len(valid))
+        match = np.full((len(valid),), -1, np.int64)
+        if g.shape[0] and len(valid):
+            iou = _np_box_iou(g, av)                # [ng, V]
+            amax = iou.max(axis=0)
+            match = iou.argmax(axis=0)
+            fg_mask = amax >= float(rpn_positive_overlap)
+            # best anchor per gt is fg — but only for gts that overlap
+            # ANY valid anchor (zero-IoU argmax is meaningless)
+            gt_best = iou.argmax(axis=1)
+            fg_mask[gt_best[iou.max(axis=1) > 0]] = True
+            fg_local = np.where(fg_mask)[0]
+            # one label per anchor, fg wins: a weakly-overlapping
+            # gt-best anchor must not ALSO train as background
+            bg_local = np.where(
+                (amax < float(rpn_negative_overlap)) & ~fg_mask)[0]
+        if len(fg_local) > max_fg:
+            sel = rng.permutation(len(fg_local))[:max_fg] \
+                if use_random else np.arange(max_fg)
+            fg_local = fg_local[sel]
+        n_bg = int(rpn_batch_size_per_im) - max(len(fg_local), 1)
+        if len(bg_local) > n_bg:
+            sel = rng.permutation(len(bg_local))[:n_bg] \
+                if use_random else np.arange(n_bg)
+            bg_local = bg_local[sel]
+        fake_fg = len(fg_local) == 0
+        if fake_fg:
+            # reference fake_fg: one zero-weight foreground — anchor 0
+            # of the IMAGE (an empty straddle-filtered `valid` must not
+            # be indexed)
+            fg_anchor = np.zeros((1,), np.int64)
+        else:
+            fg_anchor = valid[fg_local]
+        bg_anchor = valid[bg_local]
+        loc_inds.append(i * M + fg_anchor)
+        score_inds.append(np.concatenate([i * M + fg_anchor,
+                                          i * M + bg_anchor]))
+        if g.shape[0] and not fake_fg:
+            mg = g[match[fg_local]]
+            enc = _np_encode_center_size(
+                anchors[fg_anchor],
+                avar[fg_anchor] if avar is not None else None, mg)
+        else:
+            enc = np.zeros((len(fg_anchor), 4), np.float32)
+        tgt_boxes.append(enc)
+        tgt_labels.append(np.concatenate(
+            [np.ones(len(fg_anchor)), np.zeros(len(bg_anchor))]
+        ).astype(np.int32))
+        w_row = np.ones((len(fg_anchor), 4), np.float32)
+        if fake_fg:
+            w_row[:] = 0.0
+        inside_w.append(w_row)
+
+    loc_idx = np.concatenate(loc_inds).astype(np.int64)
+    score_idx = np.concatenate(score_inds).astype(np.int64)
+
+    def gather_fn(flat, idx):
+        return flat[idx]
+
+    from ... import ops as _ops
+    pred_loc = primitive(name="rpn_gather_loc")(gather_fn)(
+        _ops.reshape(bbox_pred, [N * M, 4]), Tensor(loc_idx))
+    pred_score = primitive(name="rpn_gather_score")(gather_fn)(
+        _ops.reshape(cls_logits, [N * M, 1]), Tensor(score_idx))
+    return (pred_score, pred_loc,
+            Tensor(np.concatenate(tgt_labels)[:, None]),
+            Tensor(np.concatenate(tgt_boxes)),
+            Tensor(np.concatenate(inside_w)))
+def retinanet_detection_output(*args, **kwargs):
+    """Real implementation lives in vision.ops (round-2); this 1.x name
+    delegates (the old raising stub predated it)."""
+    from ...vision.ops import retinanet_detection_output as _impl
+    return _impl(*args, **kwargs)
 retinanet_target_assign = _no_dense_analogue(
     "retinanet_target_assign", "training-time sampling; compose "
     "bipartite_match + target_assign on the host")
@@ -995,18 +1162,6 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
             f"ssd_loss: {len(gt_box)} ground-truth entries for batch "
             f"size {N}")
 
-    def _np_iou(g, p):
-        """[M, 4] x [Np, 4] -> [M, Np] IoU (normalized coords)."""
-        ix1 = np.maximum(g[:, None, 0], p[None, :, 0])
-        iy1 = np.maximum(g[:, None, 1], p[None, :, 1])
-        ix2 = np.minimum(g[:, None, 2], p[None, :, 2])
-        iy2 = np.minimum(g[:, None, 3], p[None, :, 3])
-        inter = np.clip(ix2 - ix1, 0, None) * np.clip(iy2 - iy1, 0,
-                                                      None)
-        ag = ((g[:, 2] - g[:, 0]) * (g[:, 3] - g[:, 1]))[:, None]
-        ap = ((p[:, 2] - p[:, 0]) * (p[:, 3] - p[:, 1]))[None, :]
-        return inter / np.maximum(ag + ap - inter, 1e-10)
-
     match_idx = np.full((N, Np), -1, np.int32)
     best_iou = np.zeros((N, Np), np.float32)
     loc_tgt = np.zeros((N, Np, 4), np.float32)
@@ -1018,7 +1173,7 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
                          np.int64).reshape(-1)
         if g.shape[0] == 0:
             continue
-        iou = _np_iou(g, pb)
+        iou = _np_box_iou(g, pb)
         mi, _ = bipartite_match(iou, match_type, overlap_threshold)
         mi = np.asarray(mi.numpy()).reshape(-1)
         match_idx[i] = mi

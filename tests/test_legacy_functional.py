@@ -505,3 +505,120 @@ class TestSSDLoss:
         loc, conf, pb, gt, gl = self._setup(3)
         with pytest.raises(ValueError):
             fluid.layers.ssd_loss(loc, conf, gt[:1], gl[:1], pb)
+
+
+class TestRPNTargetAssign:
+    """F.rpn_target_assign (reference fluid/layers/detection.py:311):
+    paper-exact anchor labeling + host-side sampling."""
+
+    def _inputs(self, seed=0, M=24):
+        rs = np.random.RandomState(seed)
+        bbox = paddle.to_tensor(rs.randn(2, M, 4).astype("float32"),
+                                stop_gradient=False)
+        cls = paddle.to_tensor(rs.randn(2, M, 1).astype("float32"),
+                               stop_gradient=False)
+        # anchors on a grid, well inside a 100x100 image
+        xs = np.linspace(5, 75, 6)
+        anchors = np.array([[x, y, x + 20, y + 20]
+                            for x in xs for y in xs[:4]],
+                           np.float32)[:M]
+        avar = np.full((M, 4), 0.1, np.float32)
+        im = np.array([[100, 100, 1.0], [100, 100, 1.0]], "float32")
+        return bbox, cls, anchors, avar, im
+
+    def test_labels_and_grad_routing(self):
+        import paddle_tpu.nn.functional as F
+        bbox, cls, anchors, avar, im = self._inputs()
+        gt = [np.array([[10, 10, 32, 32]], "float32"),
+              np.array([[40, 20, 66, 44]], "float32")]
+        score, loc, lbl, tbox, iw = F.rpn_target_assign(
+            bbox, cls, anchors, avar, gt, im_info=im,
+            rpn_batch_size_per_im=16, use_random=False)
+        assert score.shape[0] == lbl.shape[0]
+        assert loc.shape[0] == tbox.shape[0] == iw.shape[0]
+        nfg = int(lbl.numpy().sum())
+        assert nfg >= 2          # best anchor per gt is always fg
+        assert nfg == loc.shape[0]
+        (paddle.sum(score) + paddle.sum(loc)).backward()
+        # gradient only lands on gathered predictions
+        g = np.abs(bbox.grad.numpy()).sum(-1)
+        assert 0 < (g > 0).sum() == nfg
+        assert np.isfinite(tbox.numpy()).all()
+        assert (iw.numpy() == 1.0).all()  # real fg -> weight 1
+
+    def test_fake_fg_when_no_gt(self):
+        import paddle_tpu.nn.functional as F
+        bbox, cls, anchors, avar, im = self._inputs(1)
+        gt = [np.zeros((0, 4), "float32"), np.zeros((0, 4), "float32")]
+        score, loc, lbl, tbox, iw = F.rpn_target_assign(
+            bbox, cls, anchors, avar, gt, im_info=im,
+            rpn_batch_size_per_im=8, use_random=False)
+        # one fake fg per image, zero inside-weight (reference fake_fg)
+        assert loc.shape[0] == 2
+        assert (iw.numpy() == 0.0).all()
+        assert int(lbl.numpy().sum()) == 2  # labels still mark them fg
+
+    def test_straddle_filter_and_batch_cap(self):
+        import paddle_tpu.nn.functional as F
+        bbox, cls, anchors, avar, im = self._inputs(2)
+        anchors[0] = [-30, -30, -5, -5]      # fully outside
+        gt = [np.array([[-30, -30, -5, -5]], "float32"),  # only matches
+              np.array([[40, 20, 66, 44]], "float32")]    # the outside one
+        score, loc, lbl, tbox, iw = F.rpn_target_assign(
+            bbox, cls, anchors, avar, gt, im_info=im,
+            rpn_batch_size_per_im=6, use_random=False)
+        # image 0's only matching anchor was straddle-filtered ->
+        # fake fg with zero weight appears instead
+        assert (iw.numpy().sum(-1) == 0).sum() >= 1
+        # per-image examples never exceed the cap
+        assert score.shape[0] <= 2 * 6 + 1  # +1 fake fg allowance
+
+    def test_crowd_boxes_excluded(self):
+        import paddle_tpu.nn.functional as F
+        bbox, cls, anchors, avar, im = self._inputs(3)
+        gt = [np.array([[10, 10, 32, 32], [40, 20, 66, 44]], "float32"),
+              np.array([[40, 20, 66, 44]], "float32")]
+        crowd = [np.array([0, 1]), np.array([0])]
+        s1, l1, lb1, *_ = F.rpn_target_assign(
+            bbox, cls, anchors, avar, gt, is_crowd=crowd, im_info=im,
+            rpn_batch_size_per_im=16, use_random=False)
+        gt_nc = [gt[0][:1], gt[1]]
+        s2, l2, lb2, *_ = F.rpn_target_assign(
+            bbox, cls, anchors, avar, gt_nc, im_info=im,
+            rpn_batch_size_per_im=16, use_random=False)
+        assert int(lb1.numpy().sum()) == int(lb2.numpy().sum())
+
+    def test_all_anchors_straddled_gives_fake_fg(self):
+        """Every anchor outside the image: no crash, one zero-weight
+        fake fg per image (review regression)."""
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(4)
+        anchors = np.array([[-30, -30, -5, -5]] * 4, np.float32)
+        bbox = paddle.to_tensor(rs.randn(1, 4, 4).astype("float32"))
+        cls = paddle.to_tensor(rs.randn(1, 4, 1).astype("float32"))
+        im = np.array([[100, 100, 1.0]], "float32")
+        gt = [np.array([[10, 10, 40, 40]], "float32")]
+        score, loc, lbl, tbox, iw = F.rpn_target_assign(
+            bbox, cls, anchors, np.full((4, 4), 0.1, np.float32), gt,
+            im_info=im, rpn_batch_size_per_im=4, use_random=False)
+        assert loc.shape[0] == 1 and (iw.numpy() == 0.0).all()
+
+    def test_no_contradictory_fg_bg_labels(self):
+        """A weakly-overlapping gt-best anchor is fg ONLY — never also
+        sampled as background (review regression)."""
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(5)
+        anchors = np.array([[10, 10, 30, 30], [60, 60, 80, 80],
+                            [5, 60, 25, 80]], np.float32)
+        bbox = paddle.to_tensor(rs.randn(1, 3, 4).astype("float32"))
+        cls = paddle.to_tensor(rs.randn(1, 3, 1).astype("float32"))
+        im = np.array([[100, 100, 1.0]], "float32")
+        gt = [np.array([[28, 28, 48, 48]], "float32")]  # IoU ~0.005
+        score, loc, lbl, tbox, iw = F.rpn_target_assign(
+            bbox, cls, anchors, None, gt, im_info=im,
+            rpn_batch_size_per_im=6, use_random=False)
+        # anchor 0 is the gt-best: appears once, labeled fg
+        labels = lbl.numpy().reshape(-1)
+        assert labels[0] == 1 and loc.shape[0] == 1
+        # total rows = unique anchors (no duplicate score rows)
+        assert score.shape[0] == 3
