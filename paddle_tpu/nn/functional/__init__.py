@@ -84,7 +84,8 @@ from .legacy import (  # noqa: F401
     target_assign, polygon_box_transform, distribute_fpn_proposals,
     collect_fpn_proposals, generate_proposals, detection_output,
     psroi_pool, filter_by_instag, continuous_value_model,
-    similarity_focus, reorder_lod_tensor_by_rank, prroi_pool,
+    similarity_focus, reorder_lod_tensor_by_rank, lod_rank_table,
+    LoDRankTable, prroi_pool,
     roi_perspective_transform, deformable_roi_pooling,
     generate_proposal_labels, generate_mask_labels, rpn_target_assign,
     retinanet_detection_output, retinanet_target_assign,

@@ -700,9 +700,66 @@ continuous_value_model = _no_dense_analogue(
     "ads PS pipeline; slice the show/click columns directly")
 similarity_focus = _no_dense_analogue(
     "similarity_focus", "rank-ordered LoD walk; no XLA-friendly form yet")
-reorder_lod_tensor_by_rank = _no_dense_analogue(
-    "reorder_lod_tensor_by_rank", "LoD rank-table reordering — sort the "
-    "(dense, lengths) pair with argsort instead")
+class LoDRankTable:
+    """Host-side rank table (reference: framework/lod_rank_table.h):
+    sequence indices sorted by length, descending, ties stable."""
+
+    def __init__(self, items):
+        self.items = list(items)  # [(original_index, length), ...]
+
+    @property
+    def order(self):
+        return [i for i, _ in self.items]
+
+
+def lod_rank_table(x, level=0):
+    """Build a LoDRankTable from a RaggedTensor's level lengths or a
+    (dense, lengths) pair's lengths (reference:
+    fluid/layers/control_flow.py lod_rank_table)."""
+    from ...core.ragged import RaggedTensor
+    if isinstance(x, RaggedTensor):
+        lens = x.recursive_sequence_lengths()[level]
+    else:
+        lens = list(np.asarray(ensure_tensor(x).numpy()).reshape(-1))
+    order = sorted(range(len(lens)), key=lambda i: -int(lens[i]))
+    return LoDRankTable([(i, int(lens[i])) for i in order])
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Reorder sequences by a LoDRankTable (reference:
+    operators/reorder_lod_tensor_by_rank_op.cc).  Accepts a
+    RaggedTensor (rows permuted; host-side like the reference's CPU
+    kernel) or a dense [B, ...] tensor (rows gathered on device)."""
+    from ...core.ragged import RaggedTensor
+    order = rank_table.order if isinstance(rank_table, LoDRankTable) \
+        else list(np.asarray(ensure_tensor(rank_table).numpy(),
+                             np.int64).reshape(-1))
+    if isinstance(x, RaggedTensor):
+        if x.outer_lods:
+            # nested: the rank table orders TOP-LEVEL groups — permute
+            # whole groups, preserving the inner structure
+            groups = x.nested_rows()
+            if len(order) != len(groups):
+                raise ValueError(
+                    f"reorder_lod_tensor_by_rank: table has "
+                    f"{len(order)} entries but x has {len(groups)} "
+                    "top-level sequences")
+            return RaggedTensor.from_nested_rows(
+                [groups[i] for i in order], capacity=x.capacity)
+        rows = x.rows()
+        if len(order) != len(rows):
+            raise ValueError(
+                f"reorder_lod_tensor_by_rank: table has {len(order)} "
+                f"entries but x has {len(rows)} sequences")
+        return RaggedTensor.from_rows([rows[i] for i in order],
+                                      capacity=x.capacity)
+    x = ensure_tensor(x)
+    idx = Tensor(np.asarray(order, np.int64))
+
+    def fn(xa, ia):
+        return xa[ia]
+
+    return primitive(name="reorder_lod_tensor_by_rank")(fn)(x, idx)
 prroi_pool = _no_dense_analogue(
     "prroi_pool", "precise RoI pooling's exact integral form is pending; "
     "use roi_align (paddle.vision.ops.roi_align)")
@@ -710,9 +767,132 @@ roi_perspective_transform = _no_dense_analogue(
     "roi_perspective_transform", "use grid_sample with a perspective grid")
 deformable_roi_pooling = _no_dense_analogue(
     "deformable_roi_pooling", "use deform_conv2d + roi_align")
-generate_proposal_labels = _no_dense_analogue(
-    "generate_proposal_labels", "training-time sampling with "
-    "data-dependent shapes; sample on the host")
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info=None, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False,
+                             is_cascade_rcnn=False, max_overlap=None,
+                             return_max_overlap=False,
+                             return_rois_num=False):
+    """Sample RoIs + build per-class bbox regression targets for the
+    Fast R-CNN head (reference: fluid/layers/detection.py:2594 over
+    generate_proposal_labels_op.cc).
+
+    Per-image inputs are LISTS (the LoD analogue): ``rpn_rois[i]``
+    [R_i, 4], ``gt_boxes[i]`` [M_i, 4], ``gt_classes[i]`` [M_i] int,
+    ``is_crowd[i]`` [M_i] 0/1.  Ground-truth boxes are appended to the
+    proposals before sampling (so every gt is a candidate), crowd gts
+    are excluded from matching, foregrounds have max-IoU >= fg_thresh
+    (capped at fg_fraction*batch_size_per_im), backgrounds fall in
+    [bg_thresh_lo, bg_thresh_hi).  Targets are encoded center-size
+    deltas divided by ``bbox_reg_weights``, written into the matched
+    class's 4-wide slot of a [R, 4*class_nums] row (slot 1 when
+    ``is_cls_agnostic``); inside == outside weights mark fg rows, as
+    the reference does.  Everything runs host-side (the reference
+    kernel is CPU-only) and every output is stop-gradient (sampled
+    boxes are data, not activations).  Returns
+    (rois [R, 4], labels_int32 [R, 1], bbox_targets [R, 4C],
+    bbox_inside_weights, bbox_outside_weights
+    [+ max_overlap [R]] [+ rois_num [N]]).
+    """
+    if class_nums is None:
+        raise ValueError("generate_proposal_labels: class_nums is "
+                         "required (reference enforces the same)")
+    if is_cascade_rcnn or max_overlap is not None:
+        raise NotImplementedError(
+            "generate_proposal_labels: the Cascade R-CNN sampling path "
+            "(is_cascade_rcnn/max_overlap) is not implemented — only "
+            "first-stage sampling; silent divergence would be worse "
+            "than this error")
+
+    def _aslist(x):
+        return list(x) if isinstance(x, (list, tuple)) else [x]
+    rois_l = _aslist(rpn_rois)
+    gtc_l = _aslist(gt_classes)
+    crowd_l = _aslist(is_crowd) if is_crowd is not None \
+        else [None] * len(rois_l)
+    gtb_l = _aslist(gt_boxes)
+    N = len(rois_l)
+    if not (len(gtb_l) == len(gtc_l) == len(crowd_l) == N):
+        raise ValueError(
+            "generate_proposal_labels: per-image list lengths differ")
+    rng = np.random
+    max_fg = int(round(fg_fraction * batch_size_per_im))
+    # agnostic regression keeps two slots (bg, fg) like the reference
+    C = 2 if is_cls_agnostic else int(class_nums)
+    wvec = np.asarray(bbox_reg_weights, np.float32)
+
+    out_rois, out_lbl, out_tgt, out_in, out_ov, rois_num = \
+        [], [], [], [], [], []
+    for i in range(N):
+        rois = np.asarray(ensure_tensor(rois_l[i]).numpy(),
+                          np.float32).reshape(-1, 4)
+        g = np.asarray(ensure_tensor(gtb_l[i]).numpy(),
+                       np.float32).reshape(-1, 4)
+        gc = np.asarray(ensure_tensor(gtc_l[i]).numpy(),
+                        np.int64).reshape(-1)
+        if crowd_l[i] is not None:
+            crowd = np.asarray(ensure_tensor(crowd_l[i]).numpy()
+                               ).reshape(-1).astype(bool)
+            g, gc = g[~crowd], gc[~crowd]
+        rois = np.concatenate([rois, g], axis=0)  # gts are candidates
+        R = len(rois)
+        if g.shape[0]:
+            iou = _np_box_iou(g, rois)            # [M, R]
+            ov = iou.max(axis=0)
+            match = iou.argmax(axis=0)
+        else:
+            ov = np.zeros((R,), np.float32)
+            match = np.full((R,), -1, np.int64)
+        fg_idx = np.where(ov >= float(fg_thresh))[0]
+        # one label per RoI, fg wins (fg_thresh can sit below
+        # bg_thresh_hi with the defaults — a 0.3-IoU RoI must not be
+        # sampled as BOTH classes)
+        bg_idx = np.where((ov < float(bg_thresh_hi))
+                          & (ov >= float(bg_thresh_lo))
+                          & (ov < float(fg_thresh)))[0]
+        if len(fg_idx) > max_fg:
+            sel = rng.permutation(len(fg_idx))[:max_fg] \
+                if use_random else np.arange(max_fg)
+            fg_idx = fg_idx[sel]
+        n_bg = int(batch_size_per_im) - len(fg_idx)
+        if len(bg_idx) > n_bg:
+            sel = rng.permutation(len(bg_idx))[:n_bg] \
+                if use_random else np.arange(n_bg)
+            bg_idx = bg_idx[sel]
+        keep = np.concatenate([fg_idx, bg_idx]).astype(np.int64)
+        labels = np.zeros((len(keep),), np.int64)
+        labels[:len(fg_idx)] = gc[match[fg_idx]] if len(fg_idx) else []
+        tgt = np.zeros((len(keep), 4 * C), np.float32)
+        win = np.zeros((len(keep), 4 * C), np.float32)
+        if len(fg_idx):
+            enc = _np_encode_center_size(
+                rois[fg_idx], None, g[match[fg_idx]]) / wvec
+            for j in range(len(fg_idx)):
+                c = 1 if is_cls_agnostic else int(labels[j])
+                tgt[j, 4 * c:4 * c + 4] = enc[j]
+                win[j, 4 * c:4 * c + 4] = 1.0
+        out_rois.append(rois[keep])
+        out_lbl.append(labels)
+        out_tgt.append(tgt)
+        out_in.append(win)
+        out_ov.append(ov[keep])
+        rois_num.append(len(keep))
+
+    w_in = np.concatenate(out_in)
+    res = [Tensor(np.concatenate(out_rois).astype(np.float32)),
+           Tensor(np.concatenate(out_lbl).astype(np.int32)[:, None]),
+           Tensor(np.concatenate(out_tgt)),
+           Tensor(w_in),
+           Tensor(w_in.copy())]  # outside == inside (reference)
+    if return_max_overlap:
+        res.append(Tensor(np.concatenate(out_ov)))
+    if return_rois_num:
+        res.append(Tensor(np.asarray(rois_num, np.int32)))
+    return tuple(res)
 generate_mask_labels = _no_dense_analogue(
     "generate_mask_labels", "training-time sampling with data-dependent "
     "shapes; sample on the host")

@@ -622,3 +622,129 @@ class TestRPNTargetAssign:
         assert labels[0] == 1 and loc.shape[0] == 1
         # total rows = unique anchors (no duplicate score rows)
         assert score.shape[0] == 3
+
+
+class TestGenerateProposalLabels:
+    """F.generate_proposal_labels (reference detection.py:2594):
+    RoI sampling + per-class bbox targets for the Fast R-CNN head."""
+
+    def test_sampling_and_targets(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(0)
+        rois = [np.array([[8, 8, 34, 34], [60, 60, 80, 80],
+                          [0, 0, 12, 12]], "float32")]
+        gt = [np.array([[10, 10, 32, 32], [58, 62, 82, 78]], "float32")]
+        gc = [np.array([2, 4])]
+        crowd = [np.array([0, 0])]
+        out = F.generate_proposal_labels(
+            rois, gc, crowd, gt, batch_size_per_im=8, fg_fraction=0.5,
+            fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+            class_nums=5, use_random=False, return_rois_num=True)
+        r, lbl, tgt, win, wout, num = out
+        assert int(num.numpy()[0]) == r.shape[0] <= 8
+        labels = lbl.numpy().reshape(-1)
+        nfg = int((labels > 0).sum())
+        # the two gt boxes themselves are candidates (IoU 1) -> both
+        # classes appear as foreground
+        assert set(labels[labels > 0]) == {2, 4}
+        assert list(tgt.shape) == [r.shape[0], 20]
+        # targets live exactly in the matched class's 4-wide slot
+        for j in range(nfg):
+            c = labels[j]
+            row = win.numpy()[j]
+            assert row[4 * c:4 * c + 4].sum() == 4.0
+            assert row.sum() == 4.0
+        np.testing.assert_allclose(wout.numpy(), win.numpy())
+        # a gt sampled as its own roi encodes to ~zero deltas
+        gt_rows = [j for j in range(nfg)
+                   if np.allclose(tgt.numpy()[j], 0, atol=1e-5)]
+        assert len(gt_rows) >= 1
+
+    def test_cls_agnostic_and_max_overlap(self):
+        import paddle_tpu.nn.functional as F
+        rois = [np.array([[8, 8, 34, 34]], "float32")]
+        gt = [np.array([[10, 10, 32, 32]], "float32")]
+        gc = [np.array([3])]
+        out = F.generate_proposal_labels(
+            rois, gc, [np.array([0])], gt, batch_size_per_im=4,
+            fg_fraction=0.5, fg_thresh=0.5, class_nums=5,
+            is_cls_agnostic=True, use_random=False,
+            return_max_overlap=True)
+        r, lbl, tgt, win, wout, ov = out
+        assert list(tgt.shape) == [r.shape[0], 8]  # (bg, fg) slots
+        assert float(ov.numpy().max()) == 1.0  # gt candidate
+
+    def test_crowd_excluded_and_empty_gt(self):
+        import paddle_tpu.nn.functional as F
+        rois = [np.array([[8, 8, 34, 34]], "float32"),
+                np.array([[1, 1, 20, 20]], "float32")]
+        gt = [np.array([[10, 10, 32, 32]], "float32"),
+              np.zeros((0, 4), "float32")]
+        gc = [np.array([2]), np.zeros((0,), "int64")]
+        crowd = [np.array([1]), np.zeros((0,), "int64")]
+        r, lbl, tgt, *_ = F.generate_proposal_labels(
+            rois, gc, crowd, gt, batch_size_per_im=4, class_nums=3,
+            use_random=False)
+        # image 0's only gt is crowd -> no fg anywhere
+        assert int((lbl.numpy() > 0).sum()) == 0
+
+
+class TestLoDRankReorder:
+    """lod_rank_table + reorder_lod_tensor_by_rank over the round-4
+    nested RaggedTensor (reference: framework/lod_rank_table.h +
+    reorder_lod_tensor_by_rank_op.cc)."""
+
+    def test_rank_table_and_ragged_reorder(self):
+        from paddle_tpu.core.ragged import RaggedTensor
+        rows = [np.full((l, 2), i, np.float32)
+                for i, l in enumerate([2, 5, 3, 5])]
+        rt = RaggedTensor.from_rows(rows)
+        table = F.lod_rank_table(rt)
+        # descending by length, stable ties: lens [2,5,3,5] -> 1,3,2,0
+        assert table.order == [1, 3, 2, 0]
+        out = F.reorder_lod_tensor_by_rank(rt, table)
+        got = [int(r[0, 0]) for r in out.rows()]
+        assert got == [1, 3, 2, 0]
+        assert [len(r) for r in out.rows()] == [5, 5, 3, 2]
+
+    def test_dense_reorder_is_differentiable(self):
+        x = paddle.to_tensor(
+            np.arange(8, dtype="float32").reshape(4, 2),
+            stop_gradient=False)
+        lens = paddle.to_tensor(np.array([1, 4, 2, 3], "int64"))
+        table = F.lod_rank_table(lens)
+        out = F.reorder_lod_tensor_by_rank(x, table)
+        np.testing.assert_array_equal(
+            out.numpy()[:, 0], [2, 6, 4, 0])
+        paddle.sum(out * out).backward()
+        assert np.isfinite(x.grad.numpy()).all() and \
+            float(np.abs(x.grad.numpy()).sum()) > 0
+
+    def test_no_roi_sampled_as_both_classes(self):
+        """fg_thresh below bg_thresh_hi (the defaults): a mid-IoU RoI
+        must appear once, labeled fg (review regression)."""
+        import paddle_tpu.nn.functional as F
+        rois = [np.array([[10, 10, 30, 36]], "float32")]  # IoU ~0.3
+        gt = [np.array([[10, 10, 32, 32]], "float32")]
+        r, lbl, *_ = F.generate_proposal_labels(
+            rois, [np.array([2])], [np.array([0])], gt,
+            batch_size_per_im=8, fg_fraction=0.5, fg_thresh=0.25,
+            bg_thresh_hi=0.5, class_nums=3, use_random=False)
+        rn = r.numpy()
+        dup = [tuple(b) for b in rn.round(3)]
+        assert len(dup) == len(set(dup))  # no duplicated RoI rows
+
+    def test_nested_reorder_moves_whole_groups(self):
+        from paddle_tpu.core.ragged import RaggedTensor
+        nested = [[np.full((2, 1), 0, np.float32),
+                   np.full((3, 1), 1, np.float32)],
+                  [np.full((1, 1), 2, np.float32)]]
+        rt = RaggedTensor.from_nested_rows(nested)
+        table = F.lod_rank_table(rt)     # lens [2, 1] -> order [0, 1]
+        # force a swap with an explicit order tensor
+        out = F.reorder_lod_tensor_by_rank(
+            rt, paddle.to_tensor(np.array([1, 0], "int64")))
+        back = out.nested_rows()
+        assert len(back) == 2 and len(back[0]) == 1 and len(back[1]) == 2
+        assert int(back[0][0][0, 0]) == 2
+        assert table.order == [0, 1]
