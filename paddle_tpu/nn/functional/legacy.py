@@ -910,6 +910,27 @@ def _np_box_iou(g, p):
     return inter / np.maximum(ag + ap - inter, 1e-10)
 
 
+def _label_anchors(g, anchors, pos_thr, neg_thr):
+    """Shared RPN/RetinaNet anchor labeling (paper rules): returns
+    (fg_idx, bg_idx, match) over ``anchors`` given gt boxes ``g``.
+    fg = best-anchor-per-gt (only for gts with nonzero overlap) plus
+    IoU >= pos_thr; bg = max-IoU < neg_thr and not fg (one label per
+    anchor, fg wins)."""
+    M = len(anchors)
+    if g.shape[0] == 0 or M == 0:
+        return (np.zeros((0,), np.int64), np.arange(M),
+                np.full((M,), -1, np.int64))
+    iou = _np_box_iou(g, anchors)
+    amax = iou.max(axis=0)
+    match = iou.argmax(axis=0)
+    fg_mask = amax >= float(pos_thr)
+    gt_best = iou.argmax(axis=1)
+    fg_mask[gt_best[iou.max(axis=1) > 0]] = True
+    fg = np.where(fg_mask)[0]
+    bg = np.where((amax < float(neg_thr)) & ~fg_mask)[0]
+    return fg, bg, match
+
+
 def _np_encode_center_size(priors, variances, targets):
     """Per-pair center-size encode [F, 4] (same rule as vision.ops
     box_coder encode_center_size, host-side for the matched pairs)."""
@@ -995,23 +1016,8 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
                     & (anchors[:, 2] < w + t) & (anchors[:, 3] < h + t))
             valid = np.where(keep)[0]
         av = anchors[valid]
-        fg_local = np.zeros((0,), np.int64)
-        bg_local = np.arange(len(valid))
-        match = np.full((len(valid),), -1, np.int64)
-        if g.shape[0] and len(valid):
-            iou = _np_box_iou(g, av)                # [ng, V]
-            amax = iou.max(axis=0)
-            match = iou.argmax(axis=0)
-            fg_mask = amax >= float(rpn_positive_overlap)
-            # best anchor per gt is fg — but only for gts that overlap
-            # ANY valid anchor (zero-IoU argmax is meaningless)
-            gt_best = iou.argmax(axis=1)
-            fg_mask[gt_best[iou.max(axis=1) > 0]] = True
-            fg_local = np.where(fg_mask)[0]
-            # one label per anchor, fg wins: a weakly-overlapping
-            # gt-best anchor must not ALSO train as background
-            bg_local = np.where(
-                (amax < float(rpn_negative_overlap)) & ~fg_mask)[0]
+        fg_local, bg_local, match = _label_anchors(
+            g, av, rpn_positive_overlap, rpn_negative_overlap)
         if len(fg_local) > max_fg:
             sel = rng.permutation(len(fg_local))[:max_fg] \
                 if use_random else np.arange(max_fg)
@@ -1023,15 +1029,17 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
             bg_local = bg_local[sel]
         fake_fg = len(fg_local) == 0
         if fake_fg:
-            # reference fake_fg: one zero-weight foreground — anchor 0
-            # of the IMAGE (an empty straddle-filtered `valid` must not
-            # be indexed)
+            # reference fake_fg convention (rpn_target_assign_op.cc):
+            # one zero-weight LOCATION row — anchor 0 of the image (an
+            # empty straddle-filtered `valid` must not be indexed);
+            # fake rows never enter the score/label outputs
             fg_anchor = np.zeros((1,), np.int64)
         else:
             fg_anchor = valid[fg_local]
         bg_anchor = valid[bg_local]
+        score_fg = np.zeros((0,), np.int64) if fake_fg else fg_anchor
         loc_inds.append(i * M + fg_anchor)
-        score_inds.append(np.concatenate([i * M + fg_anchor,
+        score_inds.append(np.concatenate([i * M + score_fg,
                                           i * M + bg_anchor]))
         if g.shape[0] and not fake_fg:
             mg = g[match[fg_local]]
@@ -1042,7 +1050,7 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
             enc = np.zeros((len(fg_anchor), 4), np.float32)
         tgt_boxes.append(enc)
         tgt_labels.append(np.concatenate(
-            [np.ones(len(fg_anchor)), np.zeros(len(bg_anchor))]
+            [np.ones(len(score_fg)), np.zeros(len(bg_anchor))]
         ).astype(np.int32))
         w_row = np.ones((len(fg_anchor), 4), np.float32)
         if fake_fg:
@@ -1069,9 +1077,95 @@ def retinanet_detection_output(*args, **kwargs):
     delegates (the old raising stub predated it)."""
     from ...vision.ops import retinanet_detection_output as _impl
     return _impl(*args, **kwargs)
-retinanet_target_assign = _no_dense_analogue(
-    "retinanet_target_assign", "training-time sampling; compose "
-    "bipartite_match + target_assign on the host")
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box,
+                            anchor_var, gt_boxes, gt_labels, is_crowd=None,
+                            im_info=None, num_classes=None,
+                            positive_overlap=0.5, negative_overlap=0.4):
+    """RetinaNet target assignment (reference:
+    fluid/layers/detection.py:70 over the RetinanetTargetAssign kernel
+    in rpn_target_assign_op.cc:805): the RPN labeling rules with NO
+    sampling (focal loss consumes every anchor), foreground labels
+    taken from ``gt_labels`` (1..num_classes), and a per-image
+    ``fg_num = #foreground + 1`` for focal-loss normalization.
+    Returns (predicted_scores [F+B, C], predicted_location [F, 4],
+    target_label [F+B, 1], target_bbox [F, 4], bbox_inside_weight
+    [F, 4], fg_num [N, 1])."""
+    bbox_pred = ensure_tensor(bbox_pred)
+    cls_logits = ensure_tensor(cls_logits)
+    anchors = np.asarray(ensure_tensor(anchor_box).numpy(), np.float32)
+    avar = np.asarray(ensure_tensor(anchor_var).numpy(), np.float32) \
+        if anchor_var is not None else None
+    N, M = bbox_pred.shape[0], bbox_pred.shape[1]
+    C = cls_logits.shape[-1]
+    if num_classes is not None and int(num_classes) != int(C):
+        raise ValueError(
+            f"retinanet_target_assign: num_classes={num_classes} but "
+            f"cls_logits has {C} class columns")
+
+    def _aslist(x):
+        return list(x) if isinstance(x, (list, tuple)) else [x]
+    gtb_l, gtl_l = _aslist(gt_boxes), _aslist(gt_labels)
+    crowd_l = _aslist(is_crowd) if is_crowd is not None \
+        else [None] * N
+    if not (len(gtb_l) == len(gtl_l) == len(crowd_l) == N):
+        raise ValueError(
+            "retinanet_target_assign: per-image list lengths differ")
+
+    loc_inds, score_inds = [], []
+    tgt_boxes, tgt_labels_l, inside_w, fg_nums = [], [], [], []
+    for i in range(N):
+        g = np.asarray(ensure_tensor(gtb_l[i]).numpy(),
+                       np.float32).reshape(-1, 4)
+        lbl = np.asarray(ensure_tensor(gtl_l[i]).numpy(),
+                         np.int64).reshape(-1)
+        if crowd_l[i] is not None:
+            crowd = np.asarray(ensure_tensor(crowd_l[i]).numpy()
+                               ).reshape(-1).astype(bool)
+            g, lbl = g[~crowd], lbl[~crowd]
+        fg, bg, match = _label_anchors(g, anchors, positive_overlap,
+                                       negative_overlap)
+        fake = len(fg) == 0
+        if fake:
+            # fake fg is a LOCATION-only zero-weight row (reference
+            # kernel: fg_fake feeds loc_index, never score_index)
+            fg = np.zeros((1,), np.int64)
+        loc_inds.append(i * M + fg)
+        score_fg = np.zeros((0,), np.int64) if fake else fg
+        score_inds.append(np.concatenate([i * M + score_fg,
+                                          i * M + bg]))
+        if g.shape[0] and not fake:
+            enc = _np_encode_center_size(
+                anchors[fg], avar[fg] if avar is not None else None,
+                g[match[fg]])
+            labels_fg = lbl[match[fg]]
+        else:
+            enc = np.zeros((len(fg), 4), np.float32)
+            labels_fg = np.zeros((0,), np.int64)
+        tgt_boxes.append(enc)
+        tgt_labels_l.append(np.concatenate(
+            [labels_fg, np.zeros(len(bg), np.int64)]).astype(np.int32))
+        w = np.ones((len(fg), 4), np.float32)
+        if fake:
+            w[:] = 0.0
+        inside_w.append(w)
+        fg_nums.append((0 if fake else len(fg)) + 1)
+
+    loc_idx = np.concatenate(loc_inds).astype(np.int64)
+    score_idx = np.concatenate(score_inds).astype(np.int64)
+
+    def gather_fn(flat, idx):
+        return flat[idx]
+
+    from ... import ops as _ops
+    pred_loc = primitive(name="retina_gather_loc")(gather_fn)(
+        _ops.reshape(bbox_pred, [N * M, 4]), Tensor(loc_idx))
+    pred_score = primitive(name="retina_gather_score")(gather_fn)(
+        _ops.reshape(cls_logits, [N * M, C]), Tensor(score_idx))
+    return (pred_score, pred_loc,
+            Tensor(np.concatenate(tgt_labels_l)[:, None]),
+            Tensor(np.concatenate(tgt_boxes)),
+            Tensor(np.concatenate(inside_w)),
+            Tensor(np.asarray(fg_nums, np.int32)[:, None]))
 box_decoder_and_assign = _no_dense_analogue(
     "box_decoder_and_assign", "compose paddle.vision.ops.box_coder with "
     "argmax assignment")

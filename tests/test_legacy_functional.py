@@ -553,10 +553,12 @@ class TestRPNTargetAssign:
         score, loc, lbl, tbox, iw = F.rpn_target_assign(
             bbox, cls, anchors, avar, gt, im_info=im,
             rpn_batch_size_per_im=8, use_random=False)
-        # one fake fg per image, zero inside-weight (reference fake_fg)
+        # one fake fg per image, zero inside-weight (reference fake_fg);
+        # fake rows are LOCATION-only — they never enter scores/labels
         assert loc.shape[0] == 2
         assert (iw.numpy() == 0.0).all()
-        assert int(lbl.numpy().sum()) == 2  # labels still mark them fg
+        assert int(lbl.numpy().sum()) == 0
+        assert score.shape[0] == lbl.shape[0]
 
     def test_straddle_filter_and_batch_cap(self):
         import paddle_tpu.nn.functional as F
@@ -748,3 +750,45 @@ class TestLoDRankReorder:
         assert len(back) == 2 and len(back[0]) == 1 and len(back[1]) == 2
         assert int(back[0][0][0, 0]) == 2
         assert table.order == [0, 1]
+
+
+class TestRetinanetTargetAssign:
+    """F.retinanet_target_assign (reference detection.py:70): RPN rules,
+    no sampling, gt-class labels, fg_num = #fg + 1 per image."""
+
+    def test_all_anchors_used_and_class_labels(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(0)
+        anchors = np.array([[10, 10, 30, 30], [60, 60, 80, 80],
+                            [5, 60, 25, 80], [40, 40, 56, 56]],
+                           np.float32)
+        bbox = paddle.to_tensor(rs.randn(1, 4, 4).astype("float32"),
+                                stop_gradient=False)
+        cls = paddle.to_tensor(rs.randn(1, 4, 3).astype("float32"),
+                               stop_gradient=False)
+        gt = [np.array([[12, 12, 30, 30], [58, 58, 82, 82]], "float32")]
+        gl = [np.array([2, 3])]
+        score, loc, lbl, tbox, iw, fg_num = F.retinanet_target_assign(
+            bbox, cls, anchors, np.full((4, 4), 0.1, np.float32),
+            gt, gl, num_classes=3)
+        labels = lbl.numpy().reshape(-1)
+        assert set(labels[labels > 0]) == {2, 3}       # gt classes
+        assert int(fg_num.numpy()[0, 0]) == int((labels > 0).sum()) + 1
+        assert score.shape[1] == 3                     # C columns kept
+        # no sampling: every fg + every clear bg anchor appears
+        assert score.shape[0] >= loc.shape[0]
+        (paddle.sum(score) + paddle.sum(loc)).backward()
+        assert np.isfinite(cls.grad.numpy()).all()
+
+    def test_fake_fg_and_fg_num_floor(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(1)
+        anchors = np.array([[10, 10, 30, 30]], np.float32)
+        bbox = paddle.to_tensor(rs.randn(1, 1, 4).astype("float32"))
+        cls = paddle.to_tensor(rs.randn(1, 1, 2).astype("float32"))
+        gt = [np.zeros((0, 4), "float32")]
+        gl = [np.zeros((0,), "int64")]
+        score, loc, lbl, tbox, iw, fg_num = F.retinanet_target_assign(
+            bbox, cls, anchors, None, gt, gl)
+        assert int(fg_num.numpy()[0, 0]) == 1          # #fg(0) + 1
+        assert (iw.numpy() == 0.0).all()
