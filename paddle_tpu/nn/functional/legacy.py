@@ -692,9 +692,65 @@ def _no_dense_analogue(name, why):
     return op
 
 
-filter_by_instag = _no_dense_analogue(
-    "filter_by_instag", "instag filtering produces data-dependent shapes "
-    "tied to LoD storage; batch your data by tag on the host instead")
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """Keep instances whose tag set intersects ``filter_tag``
+    (reference: contrib filter_by_instag_op.cc — CPU-only there too).
+
+    ``ins``: list of per-instance arrays (LoD analogue) or a dense
+    [N, ...] tensor when ``is_lod`` is False; ``ins_tag``: list of
+    per-instance int tag arrays; ``filter_tag``: 1-D int array.
+    Returns (filtered rows — a RaggedTensor for LoD input, a dense
+    tensor otherwise; kept index [K, 1] int64; loss_weight [K, 1]
+    float).  When nothing matches, one all-``out_val_if_empty``
+    instance with loss_weight 0 is emitted, exactly like the
+    reference kernel's empty-output convention."""
+    from ...core.ragged import RaggedTensor
+    if isinstance(ins_tag, (list, tuple)):
+        tag_rows = ins_tag
+    else:  # dense [N, k] tag tensor: one tag row per instance
+        tag_rows = list(np.asarray(ensure_tensor(ins_tag).numpy()))
+    tags = [set(np.asarray(ensure_tensor(t).numpy())
+                .reshape(-1).tolist()) for t in tag_rows]
+    fset = set(np.asarray(ensure_tensor(filter_tag).numpy())
+               .reshape(-1).tolist())
+    keep = [i for i, t in enumerate(tags) if t & fset]
+    if is_lod or isinstance(ins, (list, tuple)):
+        rows = [np.asarray(ensure_tensor(r).numpy()) for r in ins]
+        if not rows:
+            raise ValueError(
+                "filter_by_instag: empty instance list — the padded "
+                "no-match output needs at least one instance's shape")
+        if len(rows) != len(tags):
+            raise ValueError(
+                f"filter_by_instag: {len(rows)} instances but "
+                f"{len(tags)} tag rows")
+        if keep:
+            out = RaggedTensor.from_rows([rows[i] for i in keep])
+            lw = np.ones((len(keep), 1), np.float32)
+            idx = np.asarray(keep, np.int64)[:, None]
+        else:
+            out = RaggedTensor.from_rows(
+                [np.full_like(rows[0], out_val_if_empty)])
+            lw = np.zeros((1, 1), np.float32)
+            idx = np.zeros((1, 1), np.int64)
+        return out, Tensor(idx), Tensor(lw)
+    x = np.asarray(ensure_tensor(ins).numpy())
+    if len(x) == 0:
+        raise ValueError(
+            "filter_by_instag: empty instance batch — the padded "
+            "no-match output needs at least one instance's shape")
+    if len(x) != len(tags):
+        raise ValueError(
+            f"filter_by_instag: {len(x)} instances but {len(tags)} "
+            "tag rows")
+    if keep:
+        idx = np.asarray(keep, np.int64)
+        return (Tensor(x[idx]), Tensor(idx[:, None]),
+                Tensor(np.ones((len(keep), 1), np.float32)))
+    return (Tensor(np.full_like(x[:1], out_val_if_empty)),
+            Tensor(np.zeros((1, 1), np.int64)),
+            Tensor(np.zeros((1, 1), np.float32)))
 continuous_value_model = _no_dense_analogue(
     "continuous_value_model", "CVM feature stripping is specific to the "
     "ads PS pipeline; slice the show/click columns directly")
@@ -1166,9 +1222,49 @@ def retinanet_target_assign(bbox_pred, cls_logits, anchor_box,
             Tensor(np.concatenate(tgt_boxes)),
             Tensor(np.concatenate(inside_w)),
             Tensor(np.asarray(fg_nums, np.int32)[:, None]))
-box_decoder_and_assign = _no_dense_analogue(
-    "box_decoder_and_assign", "compose paddle.vision.ops.box_coder with "
-    "argmax assignment")
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, box_clip, name=None):
+    """Per-class box decode + best-foreground assignment (reference:
+    detection/box_decoder_and_assign_op.h — the Cascade R-CNN helper).
+    prior_box [R, 4], prior_box_var [4], target_box [R, 4*C] deltas,
+    box_score [R, C] -> (decode_box [R, 4*C], assign_box [R, 4] =
+    decoded box of the highest-scoring foreground class, or the prior
+    when there is none).  One fused XLA program, differentiable
+    (argmax assignment is a gather; the reference CPU loop is
+    reproduced exactly, incl. the +1 legacy pixel convention and the
+    exp clip)."""
+    prior_box = ensure_tensor(prior_box)
+    pbv = ensure_tensor(prior_box_var)
+    target_box = ensure_tensor(target_box)
+    box_score = ensure_tensor(box_score)
+    clip = float(box_clip)
+
+    def fn(pb, v, tb, sc):
+        R, C = sc.shape
+        pw = pb[:, 2] - pb[:, 0] + 1
+        ph = pb[:, 3] - pb[:, 1] + 1
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        t = tb.reshape(R, C, 4)
+        dw = jnp.minimum(v[2] * t[..., 2], clip)
+        dh = jnp.minimum(v[3] * t[..., 3], clip)
+        cx = v[0] * t[..., 0] * pw[:, None] + pcx[:, None]
+        cy = v[1] * t[..., 1] * ph[:, None] + pcy[:, None]
+        w = jnp.exp(dw) * pw[:, None]
+        h = jnp.exp(dh) * ph[:, None]
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2 - 1, cy + h / 2 - 1], axis=-1)
+        if C > 1:  # best foreground class (j > 0), like the kernel
+            max_j = jnp.argmax(sc[:, 1:], axis=-1) + 1
+            assign = boxes[jnp.arange(R), max_j]
+        else:      # no foreground classes at all -> the prior
+            assign = pb
+        return boxes.reshape(R, C * 4), assign
+
+    return primitive(name="box_decoder_and_assign")(fn)(
+        prior_box, pbv, target_box, box_score)
+
+
 multi_box_head = None  # bound in __init__ from static.nn
 
 

@@ -792,3 +792,92 @@ class TestRetinanetTargetAssign:
             bbox, cls, anchors, None, gt, gl)
         assert int(fg_num.numpy()[0, 0]) == 1          # #fg(0) + 1
         assert (iw.numpy() == 0.0).all()
+
+
+class TestBoxDecoderAndAssign:
+    """F.box_decoder_and_assign vs a numpy transcription of the
+    reference CPU kernel (box_decoder_and_assign_op.h)."""
+
+    def test_matches_reference_kernel(self):
+        rs = np.random.RandomState(0)
+        R, C = 5, 4
+        pb = np.sort(rs.rand(R, 4).astype("float32") * 50, axis=1)
+        pbv = np.array([0.1, 0.1, 0.2, 0.2], "float32")
+        tb = rs.randn(R, 4 * C).astype("float32") * 0.3
+        sc = rs.rand(R, C).astype("float32")
+        clip = 4.135
+        dec, assign = F.box_decoder_and_assign(
+            T(pb), T(pbv), T(tb), T(sc), clip)
+        # numpy transcription
+        want = np.zeros((R, C * 4), np.float32)
+        want_as = np.zeros((R, 4), np.float32)
+        for i in range(R):
+            pw = pb[i, 2] - pb[i, 0] + 1
+            ph = pb[i, 3] - pb[i, 1] + 1
+            pcx, pcy = pb[i, 0] + pw / 2, pb[i, 1] + ph / 2
+            for j in range(C):
+                o = j * 4
+                dw = min(pbv[2] * tb[i, o + 2], clip)
+                dh = min(pbv[3] * tb[i, o + 3], clip)
+                cx = pbv[0] * tb[i, o] * pw + pcx
+                cy = pbv[1] * tb[i, o + 1] * ph + pcy
+                w, h = np.exp(dw) * pw, np.exp(dh) * ph
+                want[i, o:o + 4] = [cx - w / 2, cy - h / 2,
+                                    cx + w / 2 - 1, cy + h / 2 - 1]
+            mj = 1 + int(np.argmax(sc[i, 1:]))
+            want_as[i] = want[i, mj * 4:mj * 4 + 4]
+        np.testing.assert_allclose(dec.numpy(), want, rtol=1e-5)
+        np.testing.assert_allclose(assign.numpy(), want_as, rtol=1e-5)
+
+    def test_differentiable(self):
+        rs = np.random.RandomState(1)
+        pb = np.sort(rs.rand(3, 4).astype("float32") * 20, axis=1)
+        tb = paddle.to_tensor(rs.randn(3, 8).astype("float32") * 0.1,
+                              stop_gradient=False)
+        sc = np.array([[0.1, 0.9], [0.8, 0.2], [0.5, 0.5]], "float32")
+        dec, assign = F.box_decoder_and_assign(
+            T(pb), T(np.ones(4, "float32")), tb, T(sc), 4.135)
+        paddle.sum(assign).backward()
+        g = np.abs(tb.grad.numpy()).reshape(3, 2, 4).sum(-1)
+        # only class-1 deltas received gradient (assign picks j=1)
+        assert (g[:, 1] > 0).all() and (g[:, 0] == 0).all()
+
+
+class TestFilterByInstag:
+    def test_lod_filter_and_empty(self):
+        rows = [np.full((2, 3), i, np.float32) for i in range(4)]
+        tags = [np.array([1]), np.array([2, 7]), np.array([3]),
+                np.array([7])]
+        out, idx, lw = F.filter_by_instag(rows, tags,
+                                          np.array([7]), is_lod=True)
+        assert [int(r[0, 0]) for r in out.rows()] == [1, 3]
+        np.testing.assert_array_equal(idx.numpy().reshape(-1), [1, 3])
+        assert (lw.numpy() == 1.0).all()
+        # no match -> one padded instance with zero loss weight
+        out0, idx0, lw0 = F.filter_by_instag(
+            rows, tags, np.array([99]), is_lod=True,
+            out_val_if_empty=0)
+        assert (lw0.numpy() == 0.0).all()
+        assert float(np.abs(out0.rows()[0]).sum()) == 0.0
+
+    def test_dense_filter(self):
+        x = np.arange(12, dtype="float32").reshape(4, 3)
+        tags = [np.array([5]), np.array([1]), np.array([5]),
+                np.array([2])]
+        out, idx, lw = F.filter_by_instag(T(x), tags, np.array([5]),
+                                          is_lod=False)
+        np.testing.assert_array_equal(out.numpy(), x[[0, 2]])
+
+    def test_dense_tag_tensor_and_empty_batch(self):
+        """Dense [N, k] tag tensors iterate row-wise; empty batches
+        raise cleanly (review regressions)."""
+        x = np.arange(12, dtype="float32").reshape(4, 3)
+        tags = np.array([[5], [1], [5], [2]], "int64")
+        out, idx, lw = F.filter_by_instag(T(x), T(tags), np.array([5]),
+                                          is_lod=False)
+        np.testing.assert_array_equal(out.numpy(), x[[0, 2]])
+        with pytest.raises(ValueError, match="empty"):
+            F.filter_by_instag(T(np.zeros((0, 3), "float32")), [],
+                               np.array([5]), is_lod=False)
+        with pytest.raises(ValueError, match="empty"):
+            F.filter_by_instag([], [], np.array([5]), is_lod=True)
