@@ -751,11 +751,77 @@ def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
     return (Tensor(np.full_like(x[:1], out_val_if_empty)),
             Tensor(np.zeros((1, 1), np.int64)),
             Tensor(np.zeros((1, 1), np.float32)))
-continuous_value_model = _no_dense_analogue(
-    "continuous_value_model", "CVM feature stripping is specific to the "
-    "ads PS pipeline; slice the show/click columns directly")
-similarity_focus = _no_dense_analogue(
-    "similarity_focus", "rank-ordered LoD walk; no XLA-friendly form yet")
+def continuous_value_model(input, cvm, use_cvm=True):
+    """CVM feature transform (reference: cvm_op.h CvmComputeKernel):
+    columns 0/1 are show/click; ``use_cvm`` keeps them as
+    log(show+1) and log(click+1)-log(show+1), otherwise they are
+    stripped.  ``cvm`` is accepted for signature parity (the reference
+    grad kernel routes a historic ads-pipeline gradient through it;
+    here true autodiff gradients flow through the log transform
+    instead, which is strictly more correct)."""
+    input = ensure_tensor(input)
+    if len(input.shape) != 2:
+        raise ValueError(
+            f"continuous_value_model: input rank must be 2, got "
+            f"{len(input.shape)} (reference cvm_op.cc enforces this)")
+
+    def fn(x):
+        if not use_cvm:
+            return x[:, 2:]
+        show = jnp.log(x[:, 0] + 1)
+        click = jnp.log(x[:, 1] + 1) - show
+        return jnp.concatenate(
+            [show[:, None], click[:, None], x[:, 2:]], axis=1)
+
+    return primitive(name="cvm")(fn)(input)
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """Similarity-focus mask (reference: similarity_focus_op.h): for
+    each batch element and each ``index`` along ``axis``, greedily pick
+    the largest cells of the remaining 2-D slice whose row AND column
+    are both unused, then set the full ``axis`` fiber through each
+    picked cell to 1.  Host-side transcription of the reference CPU
+    kernel (its output is a non-differentiable 0/1 mask)."""
+    x = np.asarray(ensure_tensor(input).numpy())
+    if x.ndim != 4:
+        raise ValueError("similarity_focus: input must be 4-D")
+    if axis not in (1, 2, 3):
+        raise ValueError("similarity_focus: axis must be 1, 2 or 3")
+    indexes = [int(i) for i in np.asarray(indexes).reshape(-1)]
+    if len(indexes) == 0:
+        raise ValueError("similarity_focus: indexes must be non-empty")
+    for i in indexes:
+        if not 0 <= i < x.shape[axis]:
+            raise ValueError(
+                f"similarity_focus: index {i} out of range for "
+                f"dim[{axis}] = {x.shape[axis]} (reference enforces "
+                "the same)")
+    B = x.shape[0]
+    out = np.zeros_like(x)
+    other = [d for d in (1, 2, 3) if d != axis]
+    for b in range(B):
+        for index in indexes:
+            sl = np.take(x[b], index, axis=axis - 1)   # 2-D [da, db]
+            da, db = sl.shape
+            order = np.argsort(-sl.reshape(-1), kind="stable")
+            used_a = np.zeros(da, bool)
+            used_b = np.zeros(db, bool)
+            picked = 0
+            for flat in order:
+                ia, ib = divmod(int(flat), db)
+                if used_a[ia] or used_b[ib]:
+                    continue
+                used_a[ia] = used_b[ib] = True
+                picked += 1
+                idx = [b, None, None, None]
+                idx[other[0]] = ia
+                idx[other[1]] = ib
+                idx[axis] = slice(None)
+                out[tuple(idx)] = 1
+                if picked == min(da, db):
+                    break
+    return Tensor(out)
 class LoDRankTable:
     """Host-side rank table (reference: framework/lod_rank_table.h):
     sequence indices sorted by length, descending, ties stable."""

@@ -881,3 +881,62 @@ class TestFilterByInstag:
                                np.array([5]), is_lod=False)
         with pytest.raises(ValueError, match="empty"):
             F.filter_by_instag([], [], np.array([5]), is_lod=True)
+
+
+class TestCVMAndSimilarityFocus:
+    def test_cvm_transform_and_strip(self):
+        x = np.array([[3.0, 1.0, 5.0, 6.0],
+                      [0.0, 0.0, 7.0, 8.0]], np.float32)
+        xt = paddle.to_tensor(x, stop_gradient=False)
+        y = F.continuous_value_model(xt, None, use_cvm=True)
+        np.testing.assert_allclose(
+            y.numpy()[:, 0], np.log(x[:, 0] + 1), rtol=1e-6)
+        np.testing.assert_allclose(
+            y.numpy()[:, 1], np.log(x[:, 1] + 1) - np.log(x[:, 0] + 1),
+            rtol=1e-6)
+        np.testing.assert_allclose(y.numpy()[:, 2:], x[:, 2:])
+        paddle.sum(y).backward()
+        assert np.isfinite(xt.grad.numpy()).all()
+        y2 = F.continuous_value_model(paddle.to_tensor(x), None,
+                                      use_cvm=False)
+        np.testing.assert_allclose(y2.numpy(), x[:, 2:])
+
+    def test_similarity_focus_matches_reference_rule(self):
+        # reference docstring example shape: [B, C, A, B2], axis=1
+        x = np.zeros((1, 2, 3, 3), np.float32)
+        x[0, 0] = [[0.8, 0.1, 0.2], [0.2, 0.5, 0.3], [0.1, 0.3, 0.9]]
+        out = F.similarity_focus(T(x), axis=1, indexes=[0]).numpy()
+        # greedy picks (0,0)=0.8 -> (2,2)=0.9 first actually: sorted
+        # desc 0.9@(2,2), 0.8@(0,0), 0.5@(1,1) -> all rows/cols unique
+        want_cells = {(2, 2), (0, 0), (1, 1)}
+        got = {(i, j) for i in range(3) for j in range(3)
+               if out[0, 0, i, j] == 1}
+        assert got == want_cells
+        # the mask spans the FULL axis: channel 1 identical
+        np.testing.assert_array_equal(out[0, 0], out[0, 1])
+
+    def test_similarity_focus_validation(self):
+        with pytest.raises(ValueError):
+            F.similarity_focus(T(np.zeros((1, 2, 2), np.float32)),
+                               axis=1, indexes=[0])
+        with pytest.raises(ValueError):
+            F.similarity_focus(T(np.zeros((1, 2, 2, 2), np.float32)),
+                               axis=0, indexes=[0])
+        with pytest.raises(ValueError):
+            F.similarity_focus(T(np.zeros((1, 2, 2, 2), np.float32)),
+                               axis=1, indexes=[])
+
+    def test_validation_parity(self):
+        """ndarray indexes accepted; range + rank checks match the
+        reference (review regressions)."""
+        x4 = T(np.random.RandomState(0).rand(1, 2, 3, 3)
+               .astype(np.float32))
+        out = F.similarity_focus(x4, axis=1, indexes=np.array([0, 1]))
+        assert out.shape == [1, 2, 3, 3]
+        with pytest.raises(ValueError, match="out of range"):
+            F.similarity_focus(x4, axis=1, indexes=[5])
+        with pytest.raises(ValueError, match="out of range"):
+            F.similarity_focus(x4, axis=1, indexes=[-1])
+        with pytest.raises(ValueError, match="rank"):
+            F.continuous_value_model(
+                T(np.zeros((2, 3, 4), np.float32)), None)
