@@ -577,13 +577,156 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold,
     return tuple(res) if len(res) > 1 else out
 
 
+import numpy as _np  # noqa: E402  (host-side NMS helpers below)
+
+
+def _poly_area(p):
+    x, y = p[:, 0], p[:, 1]
+    return 0.5 * abs(float(_np.dot(x, _np.roll(y, -1))
+                           - _np.dot(y, _np.roll(x, -1))))
+
+
+def _poly_clip(subject, clip):
+    """Sutherland–Hodgman convex clipping (host-side)."""
+    out = list(subject)
+    for i in _builtins.range(len(clip)):
+        a, b = clip[i], clip[(i + 1) % len(clip)]
+        if not out:
+            return _np.zeros((0, 2), _np.float64)
+        inp, out = out, []
+
+        def inside(p):
+            return ((b[0] - a[0]) * (p[1] - a[1])
+                    - (b[1] - a[1]) * (p[0] - a[0])) >= 0
+
+        def intersect(p, q):
+            d1 = (b[0] - a[0]) * (p[1] - a[1]) \
+                - (b[1] - a[1]) * (p[0] - a[0])
+            d2 = (b[0] - a[0]) * (q[1] - a[1]) \
+                - (b[1] - a[1]) * (q[0] - a[0])
+            t = d1 / (d1 - d2) if d1 != d2 else 0.0
+            return p + t * (q - p)
+
+        for j in _builtins.range(len(inp)):
+            p, q = inp[j], inp[(j + 1) % len(inp)]
+            if inside(q):
+                if not inside(p):
+                    out.append(intersect(p, q))
+                out.append(q)
+            elif inside(p):
+                out.append(intersect(p, q))
+    return _np.asarray(out, _np.float64)
+
+
+def _pair_iou(b1, b2, normalized):
+    """IoU for 4-coord corner boxes or 2k-coord polygons (convex
+    clipping; EAST quads are convex in practice)."""
+    if b1.shape[-1] == 4:
+        off = 0.0 if normalized else 1.0
+        ix1, iy1 = max(b1[0], b2[0]), max(b1[1], b2[1])
+        ix2, iy2 = min(b1[2], b2[2]), min(b1[3], b2[3])
+        iw, ih = max(ix2 - ix1 + off, 0), max(iy2 - iy1 + off, 0)
+        inter = iw * ih
+        a1 = (b1[2] - b1[0] + off) * (b1[3] - b1[1] + off)
+        a2 = (b2[2] - b2[0] + off) * (b2[3] - b2[1] + off)
+        return inter / max(a1 + a2 - inter, 1e-10)
+    p1 = b1.reshape(-1, 2).astype(_np.float64)
+    p2 = b2.reshape(-1, 2).astype(_np.float64)
+
+    def _signed_area(p):  # shoelace WITHOUT abs: sign = orientation
+        x, y = p[:, 0], p[:, 1]
+        return 0.5 * float(_np.dot(x, _np.roll(y, -1))
+                           - _np.dot(y, _np.roll(x, -1)))
+
+    # orient counter-clockwise for the clipper (signed area is robust
+    # to collinear leading vertices, unlike a single corner cross)
+    if _signed_area(p1) < 0:
+        p1 = p1[::-1]
+    if _signed_area(p2) < 0:
+        p2 = p2[::-1]
+    inter_poly = _poly_clip(p1, p2)
+    inter = _poly_area(inter_poly) if len(inter_poly) >= 3 else 0.0
+    union = _poly_area(p1) + _poly_area(p2) - inter
+    return inter / max(union, 1e-10)
+
+
 def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
                        keep_top_k, nms_threshold=0.3, normalized=True,
                        nms_eta=1.0, background_label=-1, name=None):
-    raise NotImplementedError(
-        "locality_aware_nms (EAST text merging): compose a score-weighted "
-        "merge of adjacent boxes with paddle.vision.ops.nms "
-        "(reference: detection/locality_aware_nms_op.cc)")
+    """EAST-style locality-aware NMS (reference:
+    detection/locality_aware_nms_op.cc, CPU-only there too).
+
+    Single image: ``bboxes`` [M, B] with B = 4 (corner boxes) or an
+    even 2k >= 8 (polygons, merged via convex clipping IoU);
+    ``scores`` [C, M].  Pass 1 walks boxes in INPUT order,
+    score-weighted-merging each box into the running accumulator while
+    overlap > nms_threshold (scores add up) — the locality pass that
+    fuses EAST's dense per-pixel quads.  Pass 2 is standard NMS with
+    the adaptive-eta threshold over the merged boxes.  Returns
+    (out [keep_top_k, 2 + B] rows = [label, score, coords...] padded
+    with -1, valid_count).
+    """
+    from ..core.dispatch import ensure_tensor
+    from ..core.tensor import Tensor as _T
+    b = _np.asarray(ensure_tensor(bboxes).numpy(), _np.float64)
+    s = _np.asarray(ensure_tensor(scores).numpy(), _np.float64)
+    C, M = s.shape
+    B = b.shape[-1]
+    if B != 4 and (B < 8 or B % 2):
+        raise ValueError(
+            f"locality_aware_nms: box width must be 4 or an even "
+            f"number >= 8, got {B}")
+    rows = []
+    for cls in _builtins.range(C):
+        if cls == background_label:
+            continue
+        boxes_c = b.copy()
+        sc = s[cls].copy()
+        # pass 1: locality-aware weighted merge, input order
+        skip = _np.ones(M, bool)
+        idx = -1
+        for i in _builtins.range(M):
+            if idx > -1:
+                ov = _pair_iou(boxes_c[i], boxes_c[idx], normalized)
+                if ov > nms_threshold:
+                    boxes_c[idx] = (boxes_c[i] * sc[i]
+                                    + boxes_c[idx] * sc[idx]) \
+                        / (sc[i] + sc[idx])
+                    sc[idx] += sc[i]
+                else:
+                    skip[idx] = False
+                    idx = i
+            else:
+                idx = i
+        if idx > -1:
+            skip[idx] = False
+        cand = [i for i in _builtins.range(M)
+                if sc[i] > score_threshold and not skip[i]]
+        cand.sort(key=lambda i: -sc[i])
+        if nms_top_k > -1:
+            cand = cand[:nms_top_k]
+        # pass 2: standard NMS with adaptive eta
+        kept = []
+        thr = float(nms_threshold)
+        for i in cand:
+            ok = all(_pair_iou(boxes_c[i], boxes_c[j],
+                               normalized) <= thr for j in kept)
+            if ok:
+                kept.append(i)
+                if nms_eta < 1.0 and thr > 0.5:
+                    thr *= nms_eta
+        for i in kept:
+            rows.append([float(cls), float(sc[i])]
+                        + boxes_c[i].tolist())
+    rows.sort(key=lambda r: -r[1])
+    if keep_top_k > -1:  # -1 = keep all (Paddle sentinel)
+        rows = rows[:keep_top_k]
+    count = len(rows)
+    pad_to = keep_top_k if keep_top_k > -1 else max(count, 1)
+    out = _np.full((pad_to, 2 + B), -1.0, _np.float32)
+    if rows:
+        out[:count] = _np.asarray(rows, _np.float32)
+    return _T(out), _T(_np.int32(count))
 
 
 def ssd_loss(location, confidence, gt_box, gt_label, prior_box,

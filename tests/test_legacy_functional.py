@@ -940,3 +940,55 @@ class TestCVMAndSimilarityFocus:
         with pytest.raises(ValueError, match="rank"):
             F.continuous_value_model(
                 T(np.zeros((2, 3, 4), np.float32)), None)
+
+
+class TestLocalityAwareNMS:
+    """fluid.layers.locality_aware_nms (reference
+    detection/locality_aware_nms_op.cc): EAST merge-then-NMS."""
+
+    def test_quads_weighted_merge(self):
+        quads = np.array([
+            [0, 0, 10, 0, 10, 5, 0, 5],
+            [0.5, 0.2, 10.4, 0.1, 10.5, 5.2, 0.4, 5.1],
+            [0.2, 0.1, 10.2, 0, 10.1, 5.1, 0.2, 5.0],
+            [50, 50, 60, 50, 60, 55, 50, 55]], "float32")
+        scores = np.array([[0.9, 0.8, 0.7, 0.95]], "float32")
+        out, cnt = fluid.layers.locality_aware_nms(
+            quads, scores, 0.1, -1, 5, nms_threshold=0.5)
+        o, n = out.numpy(), int(cnt.numpy())
+        assert n == 2
+        # the three overlapping quads merged: score sums to 2.4 and the
+        # merged geometry stays near the cluster
+        merged = o[np.argmax(o[:n, 1])]
+        assert abs(merged[1] - 2.4) < 1e-5
+        assert abs(merged[2]) < 1.0 and abs(merged[3]) < 1.0
+        # padding rows are -1
+        assert (o[n:] == -1.0).all()
+
+    def test_corner_boxes_and_background(self):
+        boxes = np.array([[0, 0, 10, 5], [0.3, 0.1, 10.2, 5.2],
+                          [50, 50, 60, 55]], "float32")
+        sc = np.array([[0.1, 0.1, 0.1],          # class 0 = background
+                       [0.6, 0.5, 0.9]], "float32")
+        out, cnt = fluid.layers.locality_aware_nms(
+            boxes, sc, 0.2, -1, 4, nms_threshold=0.5,
+            background_label=0)
+        o, n = out.numpy(), int(cnt.numpy())
+        assert n == 2
+        assert (o[:n, 0] == 1.0).all()           # only class 1 rows
+        assert abs(o[np.argmax(o[:n, 1]), 1] - 1.1) < 1e-5  # 0.6+0.5
+
+    def test_bad_box_width_raises(self):
+        with pytest.raises(ValueError, match="box width"):
+            fluid.layers.locality_aware_nms(
+                np.zeros((2, 5), "float32"),
+                np.zeros((1, 2), "float32"), 0.1, -1, 4)
+
+    def test_keep_all_sentinel(self):
+        """keep_top_k=-1 keeps every surviving box (review regression)."""
+        boxes = np.array([[0, 0, 10, 5], [50, 50, 60, 55],
+                          [100, 0, 110, 5]], "float32")
+        sc = np.array([[0.6, 0.9, 0.7]], "float32")
+        out, cnt = fluid.layers.locality_aware_nms(
+            boxes, sc, 0.1, -1, -1, nms_threshold=0.5)
+        assert int(cnt.numpy()) == 3 and out.shape[0] == 3
