@@ -885,8 +885,128 @@ def reorder_lod_tensor_by_rank(x, rank_table):
 prroi_pool = _no_dense_analogue(
     "prroi_pool", "precise RoI pooling's exact integral form is pending; "
     "use roi_align (paddle.vision.ops.roi_align)")
-roi_perspective_transform = _no_dense_analogue(
-    "roi_perspective_transform", "use grid_sample with a perspective grid")
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    """Rectify quadrilateral RoIs into [th, tw] patches via the
+    reference's closed-form perspective transform (reference:
+    detection/roi_perspective_transform_op.cc:110
+    get_transform_matrix — incl. its normalized-width estimation and
+    the 1e-5 denominator guard).  ``input`` [N, C, H, W]; ``rois`` is
+    a LIST of per-image [r_i, 8] quads (x1 y1 ... x4 y4, the LoD
+    analogue; a single array means N == 1).  Returns
+    (out [R, C, th, tw], mask [R, 1, th, tw] — 1 where the source
+    pixel is inside the image, and transform_matrix [R, 9]).  The
+    matrices/grids are host-computed from the (concrete) RoIs; the
+    bilinear sampling is tape-recorded, so gradients reach ``input``.
+    """
+    input = ensure_tensor(input)
+    rois_l = list(rois) if isinstance(rois, (list, tuple)) else [rois]
+    N, Cc, H, W = input.shape
+    if len(rois_l) != N:
+        raise ValueError(
+            f"roi_perspective_transform: {len(rois_l)} roi groups for "
+            f"batch size {N}")
+    th, tw = int(transformed_height), int(transformed_width)
+    mats, img_of, quad_pts = [], [], []
+    for b, r in enumerate(rois_l):
+        r = np.asarray(ensure_tensor(r).numpy(),
+                       np.float32).reshape(-1, 8) * float(spatial_scale)
+        for q in r:
+            x, y = q[0::2], q[1::2]
+            quad_pts.append(np.stack([x, y], axis=-1))
+            len1 = np.hypot(x[0] - x[1], y[0] - y[1])
+            len2 = np.hypot(x[1] - x[2], y[1] - y[2])
+            len3 = np.hypot(x[2] - x[3], y[2] - y[3])
+            len4 = np.hypot(x[3] - x[0], y[3] - y[0])
+            est_h = (len2 + len4) / 2.0
+            est_w = (len1 + len3) / 2.0
+            nh = max(2, th)
+            nw = int(round(est_w * (nh - 1) / max(est_h, 1e-5))) + 1
+            nw = max(2, min(nw, tw))
+            dx1, dx2 = x[1] - x[2], x[3] - x[2]
+            dx3 = x[0] - x[1] + x[2] - x[3]
+            dy1, dy2 = y[1] - y[2], y[3] - y[2]
+            dy3 = y[0] - y[1] + y[2] - y[3]
+            den = dx1 * dy2 - dx2 * dy1 + 1e-5
+            m = np.zeros(9, np.float64)
+            m[6] = (dx3 * dy2 - dx2 * dy3) / den / (nw - 1)
+            m[7] = (dx1 * dy3 - dx3 * dy1) / den / (nh - 1)
+            m[8] = 1.0
+            m[3] = (y[1] - y[0] + m[6] * (nw - 1) * y[1]) / (nw - 1)
+            m[4] = (y[3] - y[0] + m[7] * (nh - 1) * y[3]) / (nh - 1)
+            m[5] = y[0]
+            m[0] = (x[1] - x[0] + m[6] * (nw - 1) * x[1]) / (nw - 1)
+            m[1] = (x[3] - x[0] + m[7] * (nh - 1) * x[3]) / (nh - 1)
+            m[2] = x[0]
+            mats.append(m)
+            img_of.append(b)
+    R = len(mats)
+    if R == 0:
+        raise ValueError("roi_perspective_transform: no RoIs given")
+    M = np.stack(mats)                                   # [R, 9]
+    jj, ii = np.meshgrid(np.arange(tw), np.arange(th))   # [th, tw]
+    wq = M[:, 6, None, None] * jj + M[:, 7, None, None] * ii + 1.0
+    sx = (M[:, 0, None, None] * jj + M[:, 1, None, None] * ii
+          + M[:, 2, None, None]) / wq                    # [R, th, tw]
+    sy = (M[:, 3, None, None] * jj + M[:, 4, None, None] * ii
+          + M[:, 5, None, None]) / wq
+    # reference gate = half-pixel image bounds AND the in_quad test
+    # (pixels extrapolated past the quad when nw < tw must be 0/mask 0)
+    in_bounds = ((sx > -0.5) & (sx < W - 0.5)
+                 & (sy > -0.5) & (sy < H - 0.5))
+    quads_xy = np.stack(quad_pts)                        # [R, 4, 2]
+    tol = 1e-4  # the reference's GT/GT_E/LT_E epsilon
+    on_edge = np.zeros(sx.shape, bool)
+    n_cross = np.zeros(sx.shape, np.int32)
+    for e in range(4):
+        x1q = quads_xy[:, e, 0][:, None, None]
+        y1q = quads_xy[:, e, 1][:, None, None]
+        x2q = quads_xy[:, (e + 1) % 4, 0][:, None, None]
+        y2q = quads_xy[:, (e + 1) % 4, 1][:, None, None]
+        horiz = np.abs(y1q - y2q) < tol
+        on_edge |= horiz & (np.abs(sy - y1q) < tol) \
+            & (sx >= np.minimum(x1q, x2q) - tol) \
+            & (sx <= np.maximum(x1q, x2q) + tol)
+        denom = np.where(horiz, 1.0, y2q - y1q)
+        ix = (sy - y1q) * (x2q - x1q) / denom + x1q
+        on_edge |= (~horiz) & (np.abs(ix - sx) < tol) \
+            & (sy >= np.minimum(y1q, y2q) - tol) \
+            & (sy <= np.maximum(y1q, y2q) + tol)
+        skip = horiz | (sy < np.minimum(y1q, y2q) + tol) \
+            | (sy > np.maximum(y1q, y2q) + tol)
+        n_cross += ((~skip) & (ix > sx + tol)).astype(np.int32)
+    inq = on_edge | (n_cross % 2 == 1)
+    in_bounds = (in_bounds & inq).astype(np.float32)
+    img_idx = np.asarray(img_of, np.int64)
+
+    sxc = np.clip(sx, 0, W - 1)
+    syc = np.clip(sy, 0, H - 1)
+    x0 = np.floor(sxc).astype(np.int64)
+    y0 = np.floor(syc).astype(np.int64)
+    x1 = np.minimum(x0 + 1, W - 1)
+    y1 = np.minimum(y0 + 1, H - 1)
+    fx = (sxc - x0).astype(np.float32)
+    fy = (syc - y0).astype(np.float32)
+
+    def fn(xa):
+        per = xa[img_idx]                    # [R, C, H, W]
+        r_ix = jnp.arange(R)[:, None, None]
+
+        def g(yy, xx):
+            return per[r_ix, :, yy, xx]      # [R, th, tw, C]
+
+        fxj = jnp.asarray(fx)[..., None]
+        fyj = jnp.asarray(fy)[..., None]
+        val = (g(y0, x0) * (1 - fxj) * (1 - fyj)
+               + g(y0, x1) * fxj * (1 - fyj)
+               + g(y1, x0) * (1 - fxj) * fyj
+               + g(y1, x1) * fxj * fyj)      # [R, th, tw, C]
+        val = val * jnp.asarray(in_bounds)[..., None]
+        return jnp.transpose(val, (0, 3, 1, 2))
+
+    out = primitive(name="roi_perspective_transform")(fn)(input)
+    return (out, Tensor(in_bounds[:, None].astype(np.float32)),
+            Tensor(M.astype(np.float32)))
 deformable_roi_pooling = _no_dense_analogue(
     "deformable_roi_pooling", "use deform_conv2d + roi_align")
 def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,

@@ -992,3 +992,56 @@ class TestLocalityAwareNMS:
         out, cnt = fluid.layers.locality_aware_nms(
             boxes, sc, 0.1, -1, -1, nms_threshold=0.5)
         assert int(cnt.numpy()) == 3 and out.shape[0] == 3
+
+
+class TestRoIPerspectiveTransform:
+    """F.roi_perspective_transform (reference
+    roi_perspective_transform_op.cc closed-form homography)."""
+
+    def test_axis_aligned_quad_corners_and_grad(self):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.rand(1, 2, 12, 16).astype("float32"),
+                             stop_gradient=False)
+        quad = np.array([[2, 3, 9, 3, 9, 8, 2, 8]], "float32")
+        out, mask, mat = F.roi_perspective_transform(x, [quad], 6, 8)
+        assert list(out.shape) == [1, 2, 6, 8]
+        assert (mask.numpy() == 1).all()
+        # output corner (0, 0) samples the quad's first vertex exactly
+        np.testing.assert_allclose(out.numpy()[0, :, 0, 0],
+                                   x.numpy()[0, :, 3, 2], rtol=1e-5)
+        paddle.sum(out).backward()
+        g = x.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+        assert np.abs(g[0, :, 0, :]).sum() == 0.0  # row 0 unsampled
+
+    def test_out_of_image_masked(self):
+        x = paddle.to_tensor(np.ones((1, 1, 8, 8), "float32"))
+        quad = np.array([[-4, -4, 3, -4, 3, 3, -4, 3]], "float32")
+        out, mask, _ = F.roi_perspective_transform(x, [quad], 4, 4)
+        m = mask.numpy()[0, 0]
+        assert m[0, 0] == 0.0 and m[-1, -1] == 1.0
+        # masked pixels are zeroed in the output
+        assert out.numpy()[0, 0, 0, 0] == 0.0
+
+    def test_multi_image_and_scale(self):
+        rs = np.random.RandomState(1)
+        x = paddle.to_tensor(rs.rand(2, 1, 10, 10).astype("float32"))
+        r0 = np.array([[0, 0, 8, 0, 8, 8, 0, 8]], "float32")
+        r1 = np.array([[2, 2, 16, 2, 16, 16, 2, 16]], "float32")
+        out, mask, mat = F.roi_perspective_transform(
+            x, [r0, r1], 5, 5, spatial_scale=0.5)
+        assert list(out.shape) == [2, 1, 5, 5]
+        # roi 1 scaled by 0.5 -> (1,1)..(8,8), fully in bounds
+        assert (mask.numpy()[1] == 1).all()
+
+    def test_extrapolated_columns_masked(self):
+        """Narrow quad with nw < tw: columns past the quad must be
+        0/mask-0 like the reference's in_quad gate (review
+        regression)."""
+        x = paddle.to_tensor(np.ones((1, 1, 12, 12), "float32"))
+        quad = np.array([[0, 0, 2, 0, 2, 8, 0, 8]], "float32")
+        out, mask, _ = F.roi_perspective_transform(x, [quad], 4, 8)
+        m = mask.numpy()[0, 0]
+        assert m[1, 0] == 1.0          # inside the quad
+        assert (m[:, -1] == 0.0).all() # extrapolated past the quad
+        assert (out.numpy()[0, 0][m == 0] == 0).all()
