@@ -345,7 +345,7 @@ def _level_splits(rt: RaggedTensor, level):
 
 
 def sequence_expand(rt: RaggedTensor, ref: RaggedTensor, ref_level=-1,
-                    capacity=None, max_out_rows=None):
+                    capacity=None, max_out_rows=None, one_step=None):
     """Reference ``sequence_expand_op.cc``: repeat x's row i
     ``ref_len[i]`` times, where ``ref_len`` are the lengths of ref's
     LoD level ``ref_level``.
@@ -362,6 +362,12 @@ def sequence_expand(rt: RaggedTensor, ref: RaggedTensor, ref_level=-1,
       Shapes stay static: pass ``capacity`` (total out steps bound) and
       ``max_out_rows`` under jit; both default to the exact concrete
       totals outside jit.
+
+    Under jit the x row lengths are traced, so the two regimes cannot
+    be told apart: pass ``one_step=True`` to assert the broadcast
+    pattern, or ``capacity``/``max_out_rows`` for the whole-row repeat.
+    Neither raises — a silent one-step fallback on multi-step rows
+    would return only each row's first step.
     """
     rl_splits = _level_splits(ref, ref_level)
     rl = (rl_splits[1:] - rl_splits[:-1]).astype(jnp.int32)
@@ -372,11 +378,22 @@ def sequence_expand(rt: RaggedTensor, ref: RaggedTensor, ref_level=-1,
             f"{ref_level} has {N} entries")
     x_lens = rt.lengths()._data
     lens_traced = isinstance(x_lens, jax.core.Tracer)
-    one_step = (not lens_traced and bool(jnp.all(x_lens == 1)))
-    if lens_traced and capacity is None and max_out_rows is None:
-        # under jit without explicit bounds, keep the round-3 contract:
-        # the caller guarantees one-step rows (the expand_as pattern)
-        one_step = True
+    if not lens_traced:
+        concrete_one = bool(jnp.all(x_lens == 1))
+        if one_step and not concrete_one:
+            raise ValueError(
+                "sequence_expand: one_step=True but x has multi-step "
+                "rows")
+        one_step = concrete_one
+    elif one_step is None:
+        if capacity is None and max_out_rows is None:
+            raise ValueError(
+                "sequence_expand: x row lengths are traced (jit) and no "
+                "bounds were given — pass one_step=True for the "
+                "broadcast/expand_as pattern, or capacity/max_out_rows "
+                "for the whole-row repeat (a silent one-step fallback "
+                "would return only each row's first step)")
+        one_step = False
     if one_step and ref_level in (-1, ref.lod_level - 1):
         # broadcast fast path: one gather, output keeps ref's LoD
         ids = ref.segment_ids()

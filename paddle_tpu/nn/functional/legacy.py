@@ -1111,8 +1111,11 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
         tgt = np.zeros((len(keep), 4 * C), np.float32)
         win = np.zeros((len(keep), 4 * C), np.float32)
         if len(fg_idx):
+            # BoxToDelta(..., bbox_reg_weights, false) at
+            # generate_proposal_labels_op.cc:390: weighted AND legacy +1
             enc = _np_encode_center_size(
-                rois[fg_idx], None, g[match[fg_idx]]) / wvec
+                rois[fg_idx], None, g[match[fg_idx]],
+                normalized=False) / wvec
             for j in range(len(fg_idx)):
                 c = 1 if is_cls_agnostic else int(labels[j])
                 tgt[j, 4 * c:4 * c + 4] = enc[j]
@@ -1173,15 +1176,21 @@ def _label_anchors(g, anchors, pos_thr, neg_thr):
     return fg, bg, match
 
 
-def _np_encode_center_size(priors, variances, targets):
+def _np_encode_center_size(priors, variances, targets, normalized=True):
     """Per-pair center-size encode [F, 4] (same rule as vision.ops
-    box_coder encode_center_size, host-side for the matched pairs)."""
-    pw = priors[:, 2] - priors[:, 0]
-    ph = priors[:, 3] - priors[:, 1]
+    box_coder encode_center_size, host-side for the matched pairs).
+    ``normalized=False`` reproduces the reference BoxToDelta's legacy
+    pixel convention (bbox_util.h:64-72: +1 on widths/heights, centers
+    at corner + w/2 of the +1 width) — every detection-training call
+    site (rpn/retinanet target assign, generate_proposal_labels) uses
+    it, matching BoxToDelta's always-false ``normalized`` argument."""
+    one = 0.0 if normalized else 1.0
+    pw = priors[:, 2] - priors[:, 0] + one
+    ph = priors[:, 3] - priors[:, 1] + one
     pcx = priors[:, 0] + pw / 2
     pcy = priors[:, 1] + ph / 2
-    tw = targets[:, 2] - targets[:, 0]
-    th = targets[:, 3] - targets[:, 1]
+    tw = targets[:, 2] - targets[:, 0] + one
+    th = targets[:, 3] - targets[:, 1] + one
     tcx = targets[:, 0] + tw / 2
     tcy = targets[:, 1] + th / 2
     enc = np.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
@@ -1220,12 +1229,16 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
     target_label [F+B, 1] int32, target_bbox [F, 4],
     bbox_inside_weight [F, 4]); the two predictions are gathered
     through the tape, so gradients reach bbox_pred / cls_logits.
+
+    ``anchor_var`` is accepted for signature parity but does not scale
+    ``target_bbox``: the reference kernel encodes with BoxToDelta
+    (weights=nullptr, normalized=false — rpn_target_assign_op.cc:467),
+    i.e. raw deltas with the legacy +1 pixel convention.
     """
     bbox_pred = ensure_tensor(bbox_pred)
     cls_logits = ensure_tensor(cls_logits)
     anchors = np.asarray(ensure_tensor(anchor_box).numpy(), np.float32)
-    avar = np.asarray(ensure_tensor(anchor_var).numpy(), np.float32) \
-        if anchor_var is not None else None
+    del anchor_var  # signature parity only; see BoxToDelta note below
     N, M = bbox_pred.shape[0], bbox_pred.shape[1]
     if not isinstance(gt_boxes, (list, tuple)):
         gt_boxes = [gt_boxes]
@@ -1285,9 +1298,11 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
                                           i * M + bg_anchor]))
         if g.shape[0] and not fake_fg:
             mg = g[match[fg_local]]
-            enc = _np_encode_center_size(
-                anchors[fg_anchor],
-                avar[fg_anchor] if avar is not None else None, mg)
+            # reference kernel: BoxToDelta(..., weights=nullptr, false)
+            # (rpn_target_assign_op.cc:467) — AnchorVar is accepted for
+            # signature parity but NEVER divides the targets
+            enc = _np_encode_center_size(anchors[fg_anchor], None, mg,
+                                         normalized=False)
         else:
             enc = np.zeros((len(fg_anchor), 4), np.float32)
         tgt_boxes.append(enc)
@@ -1331,12 +1346,13 @@ def retinanet_target_assign(bbox_pred, cls_logits, anchor_box,
     ``fg_num = #foreground + 1`` for focal-loss normalization.
     Returns (predicted_scores [F+B, C], predicted_location [F, 4],
     target_label [F+B, 1], target_bbox [F, 4], bbox_inside_weight
-    [F, 4], fg_num [N, 1])."""
+    [F, 4], fg_num [N, 1]).  Like rpn_target_assign, ``anchor_var``
+    never scales the targets (BoxToDelta weights=nullptr at
+    rpn_target_assign_op.cc:1009)."""
     bbox_pred = ensure_tensor(bbox_pred)
     cls_logits = ensure_tensor(cls_logits)
     anchors = np.asarray(ensure_tensor(anchor_box).numpy(), np.float32)
-    avar = np.asarray(ensure_tensor(anchor_var).numpy(), np.float32) \
-        if anchor_var is not None else None
+    del anchor_var  # signature parity only; see BoxToDelta note below
     N, M = bbox_pred.shape[0], bbox_pred.shape[1]
     C = cls_logits.shape[-1]
     if num_classes is not None and int(num_classes) != int(C):
@@ -1376,9 +1392,10 @@ def retinanet_target_assign(bbox_pred, cls_logits, anchor_box,
         score_inds.append(np.concatenate([i * M + score_fg,
                                           i * M + bg]))
         if g.shape[0] and not fake:
-            enc = _np_encode_center_size(
-                anchors[fg], avar[fg] if avar is not None else None,
-                g[match[fg]])
+            # BoxToDelta(..., weights=nullptr, false) at
+            # rpn_target_assign_op.cc:1009 — anchor_var never divides
+            enc = _np_encode_center_size(anchors[fg], None, g[match[fg]],
+                                         normalized=False)
             labels_fg = lbl[match[fg]]
         else:
             enc = np.zeros((len(fg), 4), np.float32)

@@ -186,7 +186,8 @@ def sequence_softmax(x, lengths=None, name=None):
     return prim(x, lengths)
 
 
-def sequence_expand(x, ref_lengths, ref_level=-1, name=None):
+def sequence_expand(x, ref_lengths, ref_level=-1, name=None,
+                    capacity=None, max_out_rows=None, one_step=None):
     """Repeat row i of x ref_lengths[i] times (reference sequence_expand
     with y's LoD).  Repeat counts must be concrete (output shape depends on
     them); the repeat is tape-aware so gradients accumulate per source row.
@@ -194,7 +195,10 @@ def sequence_expand(x, ref_lengths, ref_level=-1, name=None):
     RaggedTensor x with a RaggedTensor ref routes to the true-LoD
     implementation (``core.ragged.sequence_expand``), which repeats
     whole variable-length rows and supports nested ref levels via
-    ``ref_level`` (reference sequence_expand_op.cc).
+    ``ref_level`` (reference sequence_expand_op.cc).  Under jit the
+    ragged path needs ``one_step=True`` (broadcast/expand_as pattern)
+    or ``capacity``/``max_out_rows`` (whole-row repeat) — forwarded
+    verbatim; see ``core.ragged.sequence_expand``.
     """
     from ...core.ragged import RaggedTensor
     if isinstance(x, RaggedTensor):
@@ -204,7 +208,10 @@ def sequence_expand(x, ref_lengths, ref_level=-1, name=None):
                 "sequence_expand(RaggedTensor): pass the reference as a "
                 "RaggedTensor (its LoD level ref_level supplies the "
                 "repeat counts)")
-        return R.sequence_expand(x, ref_lengths, ref_level=ref_level)
+        return R.sequence_expand(x, ref_lengths, ref_level=ref_level,
+                                 capacity=capacity,
+                                 max_out_rows=max_out_rows,
+                                 one_step=one_step)
     x = ensure_tensor(x)
     rl = tuple(int(v) for v in np.asarray(ensure_tensor(ref_lengths)._data))
 

@@ -503,9 +503,16 @@ def multi_box_head(inputs, image, base_size, num_classes,
                 min_sizes.append(base_size * r / 100.0)
                 max_sizes.append(base_size * (r + ratio_step) / 100.0)
         elif n_in == 2:
-            # the reference ladder divides by (n_in - 2); give the
-            # second map the full min..max ratio span instead of
-            # crashing
+            # the reference ladder divides by (n_in - 2) and would
+            # crash here; give the second map the full min..max ratio
+            # span instead — warn so ported 2-map SSD configs know
+            # their prior sizes deliberately differ
+            import warnings
+            warnings.warn(
+                "multi_box_head: 2 input maps with min_ratio/max_ratio "
+                "— the reference's ratio ladder divides by zero here; "
+                "the second map gets the full min..max span (prior "
+                "sizes differ from any reference run)", UserWarning)
             min_sizes.append(base_size * min_ratio / 100.0)
             max_sizes.append(base_size * max_ratio / 100.0)
         min_sizes = [base_size * 0.10] + min_sizes

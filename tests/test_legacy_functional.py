@@ -625,6 +625,27 @@ class TestRPNTargetAssign:
         # total rows = unique anchors (no duplicate score rows)
         assert score.shape[0] == 3
 
+    def test_box_to_delta_values(self):
+        """target_bbox matches the reference BoxToDelta exactly
+        (bbox_util.h:56): legacy +1 widths/heights, and NO division by
+        anchor_var (weights=nullptr at rpn_target_assign_op.cc:467) —
+        r4 advisor finding."""
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(6)
+        anchors = np.array([[10, 10, 30, 30]], np.float32)
+        bbox = paddle.to_tensor(rs.randn(1, 1, 4).astype("float32"))
+        cls = paddle.to_tensor(rs.randn(1, 1, 1).astype("float32"))
+        gt = [np.array([[12, 14, 34, 38]], "float32")]
+        expect = np.array([(23.5 - 20.5) / 21.0, (26.5 - 20.5) / 21.0,
+                           np.log(23.0 / 21.0), np.log(25.0 / 21.0)],
+                          np.float32)
+        for avar in (None, np.full((1, 4), 0.1, np.float32)):
+            *_, tbox, _ = F.rpn_target_assign(
+                bbox, cls, anchors, avar, gt,
+                rpn_batch_size_per_im=4, use_random=False)
+            np.testing.assert_allclose(tbox.numpy()[0], expect,
+                                       rtol=1e-5)
+
 
 class TestGenerateProposalLabels:
     """F.generate_proposal_labels (reference detection.py:2594):
@@ -661,6 +682,26 @@ class TestGenerateProposalLabels:
         gt_rows = [j for j in range(nfg)
                    if np.allclose(tgt.numpy()[j], 0, atol=1e-5)]
         assert len(gt_rows) >= 1
+
+    def test_box_to_delta_values(self):
+        """Foreground targets match the reference BoxToDelta exactly:
+        legacy +1 widths/heights AND divided by bbox_reg_weights
+        (generate_proposal_labels_op.cc:390) — r4 advisor finding."""
+        import paddle_tpu.nn.functional as F
+        rois = [np.array([[11, 12, 33, 36]], "float32")]  # IoU 0.78
+        gt = [np.array([[12, 14, 34, 38]], "float32")]
+        gc = [np.array([1])]
+        r, lbl, tgt, *_ = F.generate_proposal_labels(
+            rois, gc, [np.array([0])], gt, batch_size_per_im=4,
+            fg_fraction=0.5, fg_thresh=0.5, class_nums=2,
+            use_random=False)
+        labels = lbl.numpy().reshape(-1)
+        assert labels[0] == 1  # the roi row is fg, class 1
+        # ex w=h incl. +1: 23/25; gt w/h: 23/25; centers offset (1, 2)
+        expect = np.array([(1.0 / 23) / 0.1, (2.0 / 25) / 0.1, 0.0, 0.0],
+                          np.float32)
+        np.testing.assert_allclose(tgt.numpy()[0, 4:8], expect,
+                                   rtol=1e-5, atol=1e-6)
 
     def test_cls_agnostic_and_max_overlap(self):
         import paddle_tpu.nn.functional as F

@@ -192,7 +192,8 @@ class TestRaggedReviewRegressions:
         @jax.jit
         def f(xv, xs, rv, rsp):
             out = R.sequence_expand(R.RaggedTensor(xv, xs),
-                                    R.RaggedTensor(rv, rsp))
+                                    R.RaggedTensor(rv, rsp),
+                                    one_step=True)
             return out.values._data
 
         out = f(x.values._data, x.row_splits._data,
