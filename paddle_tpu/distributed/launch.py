@@ -13,13 +13,108 @@ is nothing to spawn at all.  Usage:
 
     python -m paddle_tpu.distributed.launch --nnodes N --node_rank I \
         --master ADDR:PORT train.py [args...]
+
+``--nproc_per_node`` > 1 additionally spawns that many *local* worker
+processes (CPU meshes, multi-client simulations, and the reference's
+multi-process test idiom — test_dist_base.py:668) and monitors them with
+the reference's abort-all watch loop: the first nonzero child exit
+terminates every other worker and the launcher exits with that code.
 """
 from __future__ import annotations
 
 import argparse
 import os
 import runpy
+import signal
+import socket
+import subprocess
 import sys
+import time
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_and_watch(args):
+    """Spawn ``nproc_per_node`` local workers and watch them
+    (reference launch_utils.py:526 ``watch_local_trainers``): any child
+    failure aborts the whole job; the launcher's exit code is the first
+    failing child's."""
+    world = args.nnodes * args.nproc_per_node
+    master = args.master or f"127.0.0.1:{_free_port()}"
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    procs = []
+    logs = []
+    for local in range(args.nproc_per_node):
+        rank = args.node_rank * args.nproc_per_node + local
+        env = dict(os.environ)
+        env["PADDLE_TRAINERS_NUM"] = str(world)
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        env["PADDLE_TRAINER_ENDPOINTS"] = master
+        env["PADDLE_LOCAL_RANK"] = str(local)
+        # children re-enter this file in single-process mode (the
+        # env contract above carries the topology)
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--nnodes", str(world), "--node_rank", str(rank),
+               "--master", master, args.script] + list(args.script_args)
+        if args.log_dir:
+            f = open(os.path.join(args.log_dir, f"workerlog.{rank}"), "w")
+            logs.append(f)
+            procs.append(subprocess.Popen(cmd, env=env, stdout=f,
+                                          stderr=subprocess.STDOUT))
+        else:
+            procs.append(subprocess.Popen(cmd, env=env))
+
+    def _terminate_all(sig=signal.SIGTERM, grace=10.0):
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(sig)
+                except ProcessLookupError:
+                    pass
+        deadline = time.monotonic() + grace
+        for p in procs:
+            while p.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.1)
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    def _forward(signum, frame):
+        _terminate_all()
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _forward)
+    signal.signal(signal.SIGINT, _forward)
+
+    rc = 0
+    try:
+        while True:
+            alive = False
+            for p in procs:
+                code = p.poll()
+                if code is None:
+                    alive = True
+                elif code != 0:
+                    # reference abort-all: one dead trainer kills the job
+                    sys.stderr.write(
+                        f"launch: local worker pid {p.pid} exited with "
+                        f"code {code}; aborting all workers\n")
+                    _terminate_all()
+                    return code
+            if not alive:
+                return rc
+            time.sleep(0.5)
+    finally:
+        for f in logs:
+            f.close()
 
 
 def launch_main(argv=None):
@@ -32,9 +127,19 @@ def launch_main(argv=None):
                                                    "0")))
     parser.add_argument("--master",
                         default=os.environ.get("MASTER_ADDR_PORT", ""))
+    parser.add_argument("--nproc_per_node", type=int, default=1,
+                        help="local worker processes (CPU meshes / "
+                             "multi-client simulation); 1 = SPMD "
+                             "single-process-per-host")
+    parser.add_argument("--log_dir", default=None,
+                        help="per-rank workerlog.N files (reference "
+                             "launch_utils.py log naming)")
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
+
+    if args.nproc_per_node > 1:
+        sys.exit(_spawn_and_watch(args))
 
     os.environ["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
     os.environ["PADDLE_TRAINER_ID"] = str(args.node_rank)
@@ -43,6 +148,12 @@ def launch_main(argv=None):
 
     if args.nnodes > 1:
         import jax
+        # the framework-wide platform override (PADDLE_TPU_PLATFORM) must
+        # apply before the distributed client binds a backend — the axon
+        # TPU plugin ignores the JAX_PLATFORMS env var
+        plat = os.environ.get("PADDLE_TPU_PLATFORM")
+        if plat:
+            jax.config.update("jax_platforms", plat)
         jax.distributed.initialize(
             coordinator_address=args.master or None,
             num_processes=args.nnodes, process_id=args.node_rank)

@@ -478,10 +478,42 @@ def lod_reset(x, y=None, target_lod=None):
 
 
 def lod_append(x, level):
-    raise NotImplementedError(
-        "lod_append: multi-level LoD has no dense analogue — track "
-        "nested lengths explicitly (see nn/functional/sequence.py "
-        "conventions)")
+    """Append one LoD level at the bottom (reference:
+    fluid/layers/nn.py lod_append over lod_reset_op with append=True).
+    The round-4 nested RaggedTensor makes this expressible: the old
+    bottom level becomes an outer level grouping the new one.
+
+    ``x`` dense [N, ...]: returns a RaggedTensor whose rows are given
+    by ``level`` (lengths, sum == N).  ``x`` RaggedTensor: ``level``
+    must contain one entry per current bottom sequence-slot
+    (len(level) == old bottom total) and its lengths re-split the value
+    rows; the old row_splits are pushed onto ``outer_lods``.
+    """
+    import numpy as _np
+    from ..core.ragged import RaggedTensor as _RT
+    from ..core.dispatch import ensure_tensor as _ens
+    from ..core.tensor import Tensor as _T
+
+    lens = _np.asarray(
+        level.numpy() if hasattr(level, "numpy") else level,
+        _np.int64).reshape(-1)
+    splits = _T(_np.concatenate([[0], _np.cumsum(lens)]).astype(
+        _np.int32))
+    if isinstance(x, _RT):
+        total = int(_np.asarray(x.row_splits.numpy())[-1])
+        if len(lens) != total:
+            raise ValueError(
+                f"lod_append: level has {len(lens)} entries but the "
+                f"current bottom level spans {total} (reference "
+                "enforces the level sizes match)")
+        return _RT(x.values, splits,
+                   outer_lods=x.outer_lods + (x.row_splits,))
+    x = _ens(x)
+    if int(_np.sum(lens)) != int(x.shape[0]):
+        raise ValueError(
+            f"lod_append: level sums to {int(_np.sum(lens))} but x has "
+            f"{int(x.shape[0])} rows")
+    return _RT(x, splits)
 
 
 def inplace_abn(input, act=None, **kwargs):
@@ -506,12 +538,104 @@ def hsigmoid(input, label, num_classes, weight=None, bias=None,
 
 
 def sampled_softmax_with_cross_entropy(logits, label, num_samples,
-                                       num_true=1, seed=0, **kwargs):
-    raise NotImplementedError(
-        "sampled_softmax_with_cross_entropy: use the full "
-        "softmax_with_cross_entropy — on TPU the full softmax over the "
-        "MXU is typically faster than sampled variants "
-        "(reference: sample_logits_op.cc)")
+                                       num_true=1,
+                                       remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0, **kwargs):
+    """Sampled softmax CE (reference: sample_logits_op.h:189 + the
+    fluid.layers.sampled_softmax_with_cross_entropy:1026 composition).
+
+    Host-side per-row sampling exactly like the reference CPU-only
+    kernel ("this kernel only runs on cpu", sample_logits_op.h:194):
+    unique log-uniform negatives per example (math/sampler.cc:42), the
+    at-least-once probability adjustment (sample_prob.h:40
+    ``adjust_prob``), logQ subtraction, and the 1e20 accidental-hit
+    knockout (sample_logits_op.h:166).  The gather and the softmax CE
+    run on device through the tape, so gradients reach ``logits`` at
+    the sampled columns only (the reference's scatter-grad).
+
+    Note: on TPU a FULL softmax_with_cross_entropy over the MXU is
+    usually faster unless num_classes is extreme — this exists for
+    training-recipe parity.
+    """
+    import numpy as _np
+    import jax as _jax
+    import jax.numpy as _jnp
+    from ..core.dispatch import ensure_tensor, primitive
+    from ..core.tensor import Tensor as _T
+
+    logits = ensure_tensor(logits)
+    N, K = int(logits.shape[0]), int(logits.shape[1])
+    lab = _np.asarray(ensure_tensor(label).numpy(),
+                      _np.int64).reshape(N, -1)
+    T = int(num_true)
+    S = int(num_samples)
+    if lab.shape[1] != T:
+        raise ValueError(
+            f"sampled_softmax_with_cross_entropy: label has "
+            f"{lab.shape[1]} true classes per row, num_true={T}")
+
+    if use_customized_samples:
+        samples = _np.asarray(ensure_tensor(customized_samples).numpy(),
+                              _np.int64)
+        q = _np.asarray(ensure_tensor(customized_probabilities).numpy(),
+                        _np.float32)
+    else:
+        max_true = max(len(set(lab[i].tolist()))
+                       for i in _np.arange(N)) if N else 0
+        if S > K - max_true:
+            raise ValueError(
+                f"sampled_softmax_with_cross_entropy: num_samples={S} "
+                f"unique negatives cannot be drawn from {K} classes "
+                f"when a row has {max_true} distinct true label(s) — "
+                "the rejection sampler would never terminate; use the "
+                "full softmax_with_cross_entropy instead")
+        rng = _np.random if seed == 0 else _np.random.RandomState(seed)
+        log_range = _np.log(K + 1)
+        samples = _np.empty((N, T + S), _np.int64)
+        q = _np.empty((N, T + S), _np.float32)
+
+        def p_log_uniform(v):
+            return _np.log((v + 2.0) / (v + 1.0)) / log_range
+
+        for i in _np.arange(N):  # builtins.range is shadowed by the op
+            samples[i, :T] = lab[i]
+            seen = set(lab[i].tolist())
+            j, tries = 0, 0
+            while j < S:
+                tries += 1
+                v = int(_np.exp(rng.random_sample() * log_range)) - 1
+                v %= K
+                if v not in seen:
+                    seen.add(v)
+                    samples[i, T + j] = v
+                    j += 1
+            p = p_log_uniform(samples[i].astype(_np.float64))
+            # adjust_prob: P(appears in `tries` draws) for unique
+            # sampling; identity*S when every draw was accepted
+            q[i] = (p * S if tries == S
+                    else -_np.expm1(tries * _np.log1p(-p)))
+
+    # accidental hits: a NEGATIVE column that equals one of the row's
+    # true labels is knocked out before the softmax
+    knock = _np.zeros((N, T + S), _np.float32)
+    if remove_accidental_hits:
+        hit = (samples[:, T:, None] == samples[:, None, :T]).any(-1)
+        knock[:, T:] = _np.where(hit, -1e20, 0.0).astype(_np.float32)
+
+    log_q = _np.clip(_np.log(_np.maximum(q, 1e-30)), -1e20,
+                     1e20).astype(_np.float32)
+    samples_j = _jnp.asarray(samples)
+    adj = _jnp.asarray(knock - log_q)
+
+    def fn(lg):
+        sampled = _jnp.take_along_axis(lg, samples_j, axis=1) + adj
+        logp = _jax.nn.log_softmax(sampled, axis=-1)
+        return -logp[:, :T].mean(axis=-1, keepdims=True)
+
+    return primitive(name="sampled_softmax_with_cross_entropy")(fn)(logits)
 
 
 def matrix_nms(bboxes, scores, score_threshold, post_threshold,

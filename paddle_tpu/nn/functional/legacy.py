@@ -13,6 +13,7 @@ from __future__ import annotations
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ...core.dispatch import primitive, ensure_tensor
 from ...core.tensor import Tensor
@@ -682,16 +683,6 @@ def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
     return primitive(name="psroi_pool")(fn)(input, rois)
 
 
-# -- stubs: ads/LoD-rank machinery with no dense analogue ----------------
-def _no_dense_analogue(name, why):
-    def op(*args, **kwargs):
-        raise NotImplementedError(
-            f"{name}: {why} (reference op kept for API compatibility; "
-            "file an issue with your use case)")
-    op.__name__ = name
-    return op
-
-
 def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
                      out_val_if_empty=0):
     """Keep instances whose tag set intersects ``filter_tag``
@@ -882,9 +873,74 @@ def reorder_lod_tensor_by_rank(x, rank_table):
         return xa[ia]
 
     return primitive(name="reorder_lod_tensor_by_rank")(fn)(x, idx)
-prroi_pool = _no_dense_analogue(
-    "prroi_pool", "precise RoI pooling's exact integral form is pending; "
-    "use roi_align (paddle.vision.ops.roi_align)")
+def _hat_cum(t):
+    """∫_{-1}^{min(t,1)} max(0, 1-|u|) du — the cumulative integral of
+    the bilinear-interpolation hat kernel, closed form (piecewise
+    quadratic, differentiable)."""
+    tc = jnp.clip(t, -1.0, 1.0)
+    return jnp.where(tc <= 0, 0.5 * (tc + 1.0) ** 2,
+                     0.5 + tc - 0.5 * tc ** 2)
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    """Precise RoI pooling (reference: prroi_pool_op.h:178 — the exact
+    integral of the bilinearly-interpolated feature surface over each
+    output bin, PrRoIPooling, arXiv 1807.11590).
+
+    TPU-native design: the reference iterates integer cells per bin and
+    accumulates a 4-term closed form per cell
+    (``PrRoIPoolingMatCalculation``).  The same integral factorizes —
+    the bilinear interpolant is a separable sum of hat kernels, so
+    ∫∫ F = Σ_ij x[i, j]·(∫hat_i dy)·(∫hat_j dx) — giving one
+    [ph, H] × [H, W] × [W, pw] contraction per RoI (MXU work, no
+    per-cell loop), exactly equal to the reference's cell sum.  Fully
+    differentiable, including w.r.t. the RoI coordinates (the reference
+    hand-codes that gradient in ``PrRoIPoolingCoorBackward``; here the
+    piecewise-quadratic hat integrals give it via autodiff).
+
+    input [N, C, H, W]; rois [R, 4] (x1, y1, x2, y2, input-image
+    scale); ``batch_roi_nums`` maps RoIs to images (all image 0 when
+    omitted).  Returns [R, C, ph, pw].
+    """
+    input = ensure_tensor(input)
+    rois = ensure_tensor(rois)
+    if batch_roi_nums is not None:
+        counts = np.asarray(ensure_tensor(batch_roi_nums).numpy(),
+                            np.int64).reshape(-1)
+        batch_idx = np.repeat(np.arange(len(counts)), counts)
+    else:
+        batch_idx = np.zeros(int(rois.shape[0]), np.int64)
+    batch_idx = jnp.asarray(batch_idx, jnp.int32)
+    ph, pw = int(pooled_height), int(pooled_width)
+    scale = float(spatial_scale)
+
+    def fn(x, r):
+        H, W = x.shape[2], x.shape[3]
+
+        def bin_weights(lo, size, n_bins, n_pix):
+            # [n_bins, n_pix]: ∫ over bin b of hat(t - i) dt
+            starts = lo + size * jnp.arange(n_bins, dtype=x.dtype)
+            idx = jnp.arange(n_pix, dtype=x.dtype)
+            return (_hat_cum(starts[:, None] + size - idx[None, :])
+                    - _hat_cum(starts[:, None] - idx[None, :]))
+
+        def one(roi, img):
+            x1, y1, x2, y2 = (roi[i] * scale for i in range(4))
+            rw = jnp.maximum(x2 - x1, 0.0)
+            rh = jnp.maximum(y2 - y1, 0.0)
+            bin_w, bin_h = rw / pw, rh / ph
+            wy = bin_weights(y1, bin_h, ph, H)      # [ph, H]
+            wx = bin_weights(x1, bin_w, pw, W)      # [pw, W]
+            acc = jnp.einsum("pi,cij,qj->cpq", wy, x[img], wx)
+            win = bin_w * bin_h
+            return jnp.where(win > 0, acc / jnp.maximum(win, 1e-12), 0.0)
+
+        if int(r.shape[0]) == 0:
+            return jnp.zeros((0, x.shape[1], ph, pw), x.dtype)
+        return jax.vmap(one)(r, batch_idx)
+
+    return primitive(name="prroi_pool")(fn)(input, rois)
 def roi_perspective_transform(input, rois, transformed_height,
                               transformed_width, spatial_scale=1.0):
     """Rectify quadrilateral RoIs into [th, tw] patches via the
@@ -1007,8 +1063,126 @@ def roi_perspective_transform(input, rois, transformed_height,
     out = primitive(name="roi_perspective_transform")(fn)(input)
     return (out, Tensor(in_bounds[:, None].astype(np.float32)),
             Tensor(M.astype(np.float32)))
-deformable_roi_pooling = _no_dense_analogue(
-    "deformable_roi_pooling", "use deform_conv2d + roi_align")
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1,
+                           part_size=None, sample_per_part=1,
+                           trans_std=0.1, position_sensitive=False,
+                           rois_num=None, name=None):
+    """Deformable (PS-)RoI pooling (reference:
+    deformable_psroi_pooling_op.h:57 CPU kernel + the
+    fluid.layers.nn.deformable_roi_pooling:14563 wrapper semantics):
+    each output bin averages ``sample_per_part``² bilinear samples whose
+    window is shifted by the learned normalized offsets in ``trans``
+    (scaled by trans_std and the RoI size).  position_sensitive=True
+    selects the PS channel group (ctop, gh, gw) per bin.
+
+    input [N, C, H, W]; rois [R, 4] (x1 y1 x2 y2, image scale — the
+    reference ROUNDS them, so RoI coords get no gradient, matching);
+    trans [R, 2·num_classes, part_h, part_w].  ``rois_num`` maps RoIs
+    to images (image 0 when omitted).  Returns
+    [R, output_dim, pooled_height, pooled_width]; out-of-image samples
+    are dropped from the average like the reference (empty bins are 0).
+    """
+    input = ensure_tensor(input)
+    rois = ensure_tensor(rois)
+    ph, pw = int(pooled_height), int(pooled_width)
+    C = int(input.shape[1])
+    out_dim = C if not position_sensitive else C // (ph * pw)
+    gh_, gw_ = int(group_size[0]), int(group_size[1])
+    if part_size is None:
+        part_size = (ph, pw)
+    part_h, part_w = int(part_size[0]), int(part_size[1])
+    spp = int(sample_per_part)
+    scale = float(spatial_scale)
+    tstd = float(trans_std)
+    if no_trans:
+        ncls = 1
+        trans = Tensor(np.zeros((int(rois.shape[0]), 2, part_h, part_w),
+                                np.float32))
+    else:
+        trans = ensure_tensor(trans)
+        ncls = int(trans.shape[1]) // 2
+    if out_dim % ncls:
+        raise ValueError(
+            f"deformable_roi_pooling: output_dim {out_dim} not divisible "
+            f"by num_classes {ncls} (trans dim 1 = 2*num_classes)")
+    if rois_num is not None:
+        counts = np.asarray(ensure_tensor(rois_num).numpy(),
+                            np.int64).reshape(-1)
+        batch_idx = np.repeat(np.arange(len(counts)), counts)
+    else:
+        batch_idx = np.zeros(int(rois.shape[0]), np.int64)
+    batch_idx = jnp.asarray(batch_idx, jnp.int32)
+
+    # static per-bin index maps (reference inner-loop integer math)
+    phs = np.arange(ph)
+    pws = np.arange(pw)
+    part_hi = np.floor(phs / ph * part_h).astype(np.int32)       # [ph]
+    part_wi = np.floor(pws / pw * part_w).astype(np.int32)       # [pw]
+    ghs = np.clip(np.floor(phs * gh_ / ph), 0, gh_ - 1).astype(np.int32)
+    gws = np.clip(np.floor(pws * gw_ / pw), 0, gw_ - 1).astype(np.int32)
+    ctops = np.arange(out_dim)
+    cls_of = (ctops // max(out_dim // ncls, 1)).astype(np.int32)  # [D]
+    cmap = ((ctops[:, None, None] * gh_ + ghs[None, :, None]) * gw_
+            + gws[None, None, :]).astype(np.int32)          # [D, ph, pw]
+
+    def fn(x, r, t):
+        H, W = x.shape[2], x.shape[3]
+
+        def one(roi, img, tr):
+            x1 = jnp.round(roi[0]) * scale - 0.5
+            y1 = jnp.round(roi[1]) * scale - 0.5
+            x2 = (jnp.round(roi[2]) + 1.0) * scale - 0.5
+            y2 = (jnp.round(roi[3]) + 1.0) * scale - 0.5
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            bin_w, bin_h = rw / pw, rh / ph
+            sub_w, sub_h = bin_w / spp, bin_h / spp
+            # learned offsets per (class, bin): [ncls, ph, pw]
+            tr_part = tr[:, part_hi][:, :, part_wi]      # [2c, ph, pw]
+            tx = tr_part[0::2] * tstd                     # [ncls, ph, pw]
+            ty = tr_part[1::2] * tstd
+            wstart = (jnp.asarray(pws, x.dtype)[None, None, :] * bin_w
+                      + x1 + tx * rw)                     # [c, ph, pw]
+            hstart = (jnp.asarray(phs, x.dtype)[None, :, None] * bin_h
+                      + y1 + ty * rh)
+            # sample grids: [ncls, ph, pw, spp_h, spp_w]
+            iw = jnp.arange(spp, dtype=x.dtype)
+            wgrid = (wstart[..., None, None]
+                     + iw[None, None, None, None, :] * sub_w)
+            hgrid = (hstart[..., None, None]
+                     + iw[None, None, None, :, None] * sub_h)
+            valid = ((wgrid >= -0.5) & (wgrid <= W - 0.5)
+                     & (hgrid >= -0.5) & (hgrid <= H - 0.5))
+            hcl = jnp.clip(hgrid, 0.0, H - 1.0)
+            wcl = jnp.clip(wgrid, 0.0, W - 1.0)
+            hlo = jnp.floor(hcl).astype(jnp.int32)
+            wlo = jnp.floor(wcl).astype(jnp.int32)
+            hhi = jnp.minimum(hlo + 1, H - 1)
+            whi = jnp.minimum(wlo + 1, W - 1)
+            dh = hcl - hlo
+            dw = wcl - wlo
+            img_x = x[img]                                # [C, H, W]
+            # per output channel: its PS input channel and class grids
+            cidx = jnp.asarray(cmap)[:, :, :, None, None]
+            # advanced indexing broadcasts [D,ph,pw,1,1] x [D,ph,pw,s,s]
+            sel = lambda hh, ww: img_x[cidx, hh[cls_of], ww[cls_of]]
+            val = ((1 - dh[cls_of]) * (1 - dw[cls_of]) * sel(hlo, wlo)
+                   + dh[cls_of] * (1 - dw[cls_of]) * sel(hhi, wlo)
+                   + (1 - dh[cls_of]) * dw[cls_of] * sel(hlo, whi)
+                   + dh[cls_of] * dw[cls_of] * sel(hhi, whi))
+            vmask = valid[cls_of].astype(x.dtype)
+            cnt = vmask.sum(axis=(-1, -2))
+            s = (val * vmask).sum(axis=(-1, -2))
+            return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1.0), 0.0)
+
+        if int(r.shape[0]) == 0:
+            return jnp.zeros((0, out_dim, ph, pw), x.dtype)
+        return jax.vmap(one)(r, batch_idx, t)
+
+    return primitive(name="deformable_roi_pooling",
+                     nondiff=(1,))(fn)(input, rois, trans)
 def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
                              im_info=None, batch_size_per_im=256,
                              fg_fraction=0.25, fg_thresh=0.25,
@@ -1039,16 +1213,24 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
     (rois [R, 4], labels_int32 [R, 1], bbox_targets [R, 4C],
     bbox_inside_weights, bbox_outside_weights
     [+ max_overlap [R]] [+ rois_num [N]]).
+
+    Cascade R-CNN (``is_cascade_rcnn=True``, round 5): the previous
+    stage's ``max_overlap`` (per-image list) drives FilterRoIs —
+    gt-duplicate proposals (overlap == 1) and degenerate boxes are
+    dropped (generate_proposal_labels_op.cc:41) — and sampling is
+    disabled: EVERY foreground and in-window background survives
+    (SampleFgBgGt's cascade branch at :204), since later stages train
+    on the full refined set.
     """
     if class_nums is None:
         raise ValueError("generate_proposal_labels: class_nums is "
                          "required (reference enforces the same)")
-    if is_cascade_rcnn or max_overlap is not None:
-        raise NotImplementedError(
-            "generate_proposal_labels: the Cascade R-CNN sampling path "
-            "(is_cascade_rcnn/max_overlap) is not implemented — only "
-            "first-stage sampling; silent divergence would be worse "
-            "than this error")
+    if is_cascade_rcnn and max_overlap is None:
+        raise ValueError(
+            "generate_proposal_labels(is_cascade_rcnn=True): pass "
+            "max_overlap (the previous stage's MaxOverlapWithGT) — the "
+            "reference enforces the same "
+            "(generate_proposal_labels_op.cc:127)")
 
     def _aslist(x):
         return list(x) if isinstance(x, (list, tuple)) else [x]
@@ -1057,6 +1239,8 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
     crowd_l = _aslist(is_crowd) if is_crowd is not None \
         else [None] * len(rois_l)
     gtb_l = _aslist(gt_boxes)
+    maxov_l = _aslist(max_overlap) if max_overlap is not None \
+        else [None] * len(rois_l)
     N = len(rois_l)
     if not (len(gtb_l) == len(gtc_l) == len(crowd_l) == N):
         raise ValueError(
@@ -1080,6 +1264,17 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
             crowd = np.asarray(ensure_tensor(crowd_l[i]).numpy()
                                ).reshape(-1).astype(bool)
             g, gc = g[~crowd], gc[~crowd]
+        if is_cascade_rcnn:
+            # FilterRoIs (generate_proposal_labels_op.cc:41): drop the
+            # previous stage's gt-duplicates (max_overlap == 1, a gt
+            # has IoU 1 with itself) and degenerate boxes; an empty
+            # survivor set becomes one zero box like the reference
+            mo = np.asarray(ensure_tensor(maxov_l[i]).numpy(),
+                            np.float32).reshape(-1)
+            keep = ((rois[:, 2] - rois[:, 0] + 1 > 0)
+                    & (rois[:, 3] - rois[:, 1] + 1 > 0) & (mo < 1.0))
+            rois = rois[keep] if keep.any() else \
+                np.zeros((1, 4), np.float32)
         rois = np.concatenate([rois, g], axis=0)  # gts are candidates
         R = len(rois)
         if g.shape[0]:
@@ -1096,15 +1291,16 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
         bg_idx = np.where((ov < float(bg_thresh_hi))
                           & (ov >= float(bg_thresh_lo))
                           & (ov < float(fg_thresh)))[0]
-        if len(fg_idx) > max_fg:
-            sel = rng.permutation(len(fg_idx))[:max_fg] \
-                if use_random else np.arange(max_fg)
-            fg_idx = fg_idx[sel]
-        n_bg = int(batch_size_per_im) - len(fg_idx)
-        if len(bg_idx) > n_bg:
-            sel = rng.permutation(len(bg_idx))[:n_bg] \
-                if use_random else np.arange(n_bg)
-            bg_idx = bg_idx[sel]
+        if not is_cascade_rcnn:  # cascade keeps EVERY fg/bg, no caps
+            if len(fg_idx) > max_fg:
+                sel = rng.permutation(len(fg_idx))[:max_fg] \
+                    if use_random else np.arange(max_fg)
+                fg_idx = fg_idx[sel]
+            n_bg = int(batch_size_per_im) - len(fg_idx)
+            if len(bg_idx) > n_bg:
+                sel = rng.permutation(len(bg_idx))[:n_bg] \
+                    if use_random else np.arange(n_bg)
+                bg_idx = bg_idx[sel]
         keep = np.concatenate([fg_idx, bg_idx]).astype(np.int64)
         labels = np.zeros((len(keep),), np.int64)
         labels[:len(fg_idx)] = gc[match[fg_idx]] if len(fg_idx) else []
@@ -1138,9 +1334,181 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
     if return_rois_num:
         res.append(Tensor(np.asarray(rois_num, np.int32)))
     return tuple(res)
-generate_mask_labels = _no_dense_analogue(
-    "generate_mask_labels", "training-time sampling with data-dependent "
-    "shapes; sample on the host")
+def _poly2mask(poly, h, w):
+    """Rasterize one polygon to an [h, w] {0,1} mask with the COCO RLE
+    boundary semantics the reference uses (mask_util.cc:41 Poly2Mask,
+    itself the pycocotools ``rleFrPoly`` algorithm): 5x-upsampled
+    integer boundary tracing, column-crossing extraction, even-odd
+    column fill.  Host-side numpy (the reference kernel is CPU-only
+    too)."""
+    scale = 5.0
+    poly = np.asarray(poly, np.float64).reshape(-1, 2)
+    k = len(poly)
+
+    def _iround(v):
+        return np.trunc(v + 0.5).astype(np.int64)  # C int cast semantics
+
+    x = _iround(scale * poly[:, 0])
+    y = _iround(scale * poly[:, 1])
+    x = np.append(x, x[0])
+    y = np.append(y, y[0])
+    us, vs = [], []
+    for j in range(k):
+        xs, xe, ys, ye = x[j], x[j + 1], y[j], y[j + 1]
+        dx, dy = abs(xe - xs), abs(ys - ye)
+        flip = (dx >= dy and xs > xe) or (dx < dy and ys > ye)
+        if flip:
+            xs, xe, ys, ye = xe, xs, ye, ys
+        d = np.arange((dx if dx >= dy else dy) + 1, dtype=np.int64)
+        t = d[::-1] if flip else d
+        if dx >= dy:
+            s = (ye - ys) / dx if dx else 0.0
+            us.append(t + xs)
+            vs.append(_iround(ys + s * t))
+        else:
+            s = (xe - xs) / dy if dy else 0.0
+            vs.append(t + ys)
+            us.append(_iround(xs + s * t))
+    u = np.concatenate(us)
+    v = np.concatenate(vs)
+    # crossings where the upsampled column changes -> (x, ceil(y)) in
+    # original resolution; off-lattice or out-of-range columns dropped
+    pts = []
+    for j in range(1, len(u)):
+        if u[j] == u[j - 1]:
+            continue
+        xd = float(u[j] if u[j] < u[j - 1] else u[j] - 1)
+        xd = (xd + 0.5) / scale - 0.5
+        if np.floor(xd) != xd or xd < 0 or xd > w - 1:
+            continue
+        yd = float(min(v[j], v[j - 1]))
+        yd = (yd + 0.5) / scale - 0.5
+        yd = np.ceil(min(max(yd, 0.0), float(h)))
+        pts.append(int(xd) * h + int(yd))
+    # even-odd fill per column via alternating run-length decode
+    a = np.sort(np.asarray(pts + [h * w], np.int64))
+    runs = np.diff(np.concatenate([[0], a]))
+    merged = [runs[0]]
+    j = 1
+    while j < len(runs):
+        if runs[j] > 0:
+            merged.append(runs[j])
+            j += 1
+        else:  # zero-length run: fold the next run into the previous
+            j += 1
+            if j < len(runs):
+                merged[-1] += runs[j]
+                j += 1
+    flat = np.zeros(h * w, np.uint8)
+    pos, val = 0, 0
+    for r in merged:
+        flat[pos:pos + int(r)] = val
+        pos += int(r)
+        val = 1 - val
+    return flat.reshape(w, h).T  # column-major decode -> [h, w]
+
+
+def _polys2mask_wrt_box(polygons, box, M):
+    """Crop+scale polygons into ``box`` and rasterize to [M, M]
+    (mask_util.cc:183 Polys2MaskWrtBox; multiple polygons OR-merge)."""
+    w = max(float(box[2]) - float(box[0]), 1.0)
+    h = max(float(box[3]) - float(box[1]), 1.0)
+    mask = np.zeros((M, M), np.uint8)
+    for p in polygons:
+        p = np.asarray(p, np.float32).reshape(-1, 2)
+        q = np.stack([(p[:, 0] - box[0]) * M / w,
+                      (p[:, 1] - box[1]) * M / h], axis=1)
+        mask |= _poly2mask(q.reshape(-1), M, M)
+    return mask
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    """Mask R-CNN mask targets (reference:
+    detection/generate_mask_labels_op.cc:139 SampleMaskForOneImage over
+    mask_util.cc): every foreground RoI (label > 0) gets the M×M
+    rasterized crop of its highest-overlap gt polygon, expanded into
+    the per-class slot (-1 elsewhere = ignore).
+
+    Per-image LIST inputs (the LoD analogue): ``gt_classes[i]`` [g_i],
+    ``is_crowd[i]`` [g_i], ``gt_segms[i]`` a list (per gt) of lists
+    (per polygon) of flat xy arrays, ``rois[i]`` [r_i, 4],
+    ``labels_int32[i]`` [r_i]; ``im_info`` [N, 3] (h, w, scale).
+    Returns (mask_rois [F, 4], roi_has_mask_int32 [F, 1],
+    mask_int32 [F, num_classes*M*M]) concatenated over images; an image
+    with no foreground contributes the reference's bg fallback row
+    (first bg roi, all -1 mask, class 0).
+    """
+    M = int(resolution)
+    im_np = np.asarray(ensure_tensor(im_info).numpy(), np.float32)
+
+    def _aslist(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v]
+    gtc_l = _aslist(gt_classes)
+    crowd_l = _aslist(is_crowd)
+    segms_l = gt_segms if isinstance(gt_segms, (list, tuple)) \
+        else [gt_segms]
+    rois_l = _aslist(rois)
+    lbl_l = _aslist(labels_int32)
+    N = len(rois_l)
+    if not (len(gtc_l) == len(crowd_l) == len(segms_l) == len(lbl_l)
+            == N):
+        raise ValueError(
+            "generate_mask_labels: per-image list lengths differ")
+
+    out_rois, out_has, out_masks = [], [], []
+    for i in range(N):
+        gc = np.asarray(ensure_tensor(gtc_l[i]).numpy(),
+                        np.int64).reshape(-1)
+        crowd = np.asarray(ensure_tensor(crowd_l[i]).numpy(),
+                           np.int64).reshape(-1)
+        r = np.asarray(ensure_tensor(rois_l[i]).numpy(),
+                       np.float32).reshape(-1, 4)
+        lbl = np.asarray(ensure_tensor(lbl_l[i]).numpy(),
+                         np.int64).reshape(-1)
+        scale = float(im_np[i, 2])
+        # fg gts with polygons (crowds are skipped like the reference)
+        keep = [g for g in range(len(gc))
+                if gc[g] > 0 and crowd[g] == 0]
+        polys = [segms_l[i][g] for g in keep]
+        boxes_from_polys = np.zeros((len(polys), 4), np.float32)
+        for g, pl in enumerate(polys):
+            allp = np.concatenate([np.asarray(p, np.float32).reshape(-1)
+                                   for p in pl]).reshape(-1, 2)
+            boxes_from_polys[g] = [allp[:, 0].min(), allp[:, 1].min(),
+                                   allp[:, 0].max(), allp[:, 1].max()]
+        fg_inds = np.where(lbl > 0)[0]
+        if len(fg_inds) and len(polys):
+            rois_fg = r[fg_inds] / scale
+            ov = _np_box_iou(boxes_from_polys, rois_fg)   # [G, F]
+            match = ov.argmax(axis=0)
+            cls = lbl[fg_inds]
+            masks = np.stack([
+                _polys2mask_wrt_box(polys[match[j]], rois_fg[j], M)
+                for j in range(len(fg_inds))]).reshape(len(fg_inds), -1)
+            has = fg_inds
+            rois_out = rois_fg * scale
+        else:
+            # reference bg fallback: one all-ignore row on the first bg
+            bg = np.where(lbl == 0)[0]
+            has = bg[:1] if len(bg) else np.zeros((1,), np.int64)
+            rois_out = r[has].copy() if len(r) else \
+                np.zeros((1, 4), np.float32)
+            cls = np.zeros((1,), np.int64)
+            masks = np.full((1, M * M), -1, np.int64)
+        expand = np.full((len(cls), int(num_classes) * M * M), -1,
+                         np.int64)
+        for j in range(len(cls)):
+            c = int(cls[j])
+            if c > 0:
+                expand[j, c * M * M:(c + 1) * M * M] = masks[j]
+        out_rois.append(rois_out)
+        out_has.append(has)
+        out_masks.append(expand)
+
+    return (Tensor(np.concatenate(out_rois).astype(np.float32)),
+            Tensor(np.concatenate(out_has).astype(np.int32)[:, None]),
+            Tensor(np.concatenate(out_masks).astype(np.int32)))
 def _np_box_iou(g, p):
     """[ng, 4] x [M, 4] -> [ng, M] corner-box IoU, host-side (the CPU
     kernel shared by rpn_target_assign and ssd_loss; the Tensor-level
@@ -1637,14 +2005,82 @@ def dynamic_lstm(input, size, weight, bias=None, use_peepholes=False,
                  name=None, **kwargs):
     """LSTM over a padded batch (reference: lstm_op.cc dynamic_lstm;
     input is pre-projected [B, T, 4*hidden]).  `weight` [hidden, 4*hidden]
-    is the recurrent matrix.  Peephole connections are not supported
-    (use_peepholes=True raises)."""
+    is the recurrent matrix.
+
+    use_peepholes=True implements the reference peephole cell
+    (math/detail/lstm_kernel.h:36-51): i and f see the PREVIOUS cell
+    state through the check weights, o sees the NEW one.  The check
+    weights ride in ``bias`` exactly like the reference (lstm_op.h:75):
+    [1, 7*hidden] = 4*hidden gate bias ++ check_i ++ check_f ++
+    check_o.  Gate order within the 4*hidden block follows this
+    framework's LSTM convention (i, f, g, o — nn/layer/rnn.py
+    _lstm_step), the same convention the non-peephole path maps
+    ``weight`` with.
+    """
     from ..layer.rnn import LSTMCell, RNN as _RNN
     import jax.numpy as _j
     if use_peepholes:
-        raise NotImplementedError(
-            "dynamic_lstm(use_peepholes=True): peephole weights are not "
-            "implemented — set use_peepholes=False")
+        if bias is None:
+            raise ValueError(
+                "dynamic_lstm(use_peepholes=True): bias must hold the "
+                "check weights ([1, 7*hidden], reference lstm_op.h:75)")
+        d = int(size) // 4
+        input = ensure_tensor(input)
+        weight = ensure_tensor(weight)
+        b = ensure_tensor(bias)
+        if int(np.prod(b.shape)) != 7 * d:
+            raise ValueError(
+                f"dynamic_lstm(use_peepholes=True): bias has "
+                f"{int(np.prod(b.shape))} elements, need 7*hidden = "
+                f"{7 * d} (gate bias + 3 check vectors)")
+        args = [input, weight, b]
+        if h_0 is not None and c_0 is not None:
+            args += [ensure_tensor(h_0), ensure_tensor(c_0)]
+        has_init = len(args) == 5
+        if lengths is not None:
+            args.append(ensure_tensor(lengths))
+
+        def fn(xs_, w, bb, *rest):
+            bb = bb.reshape(-1)
+            gb, wci, wcf, wco = (bb[:4 * d], bb[4 * d:5 * d],
+                                 bb[5 * d:6 * d], bb[6 * d:])
+            ln = rest[-1] if lengths is not None else None
+            if has_init:
+                h0, c0 = rest[0], rest[1]
+            else:
+                z = jnp.zeros((xs_.shape[0], d), xs_.dtype)
+                h0 = c0 = z
+            xs = jnp.swapaxes(xs_, 0, 1)           # [T, B, 4d]
+
+            def step(carry, inp):
+                h, c = carry
+                x_t, t = inp
+                gates = x_t + h @ w + gb
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i = jax.nn.sigmoid(i + c * wci)    # peek at c_prev
+                f = jax.nn.sigmoid(f + c * wcf)
+                g = jnp.tanh(g)
+                c_new = f * c + i * g
+                o = jax.nn.sigmoid(o + c_new * wco)  # peek at c_new
+                h_new = o * jnp.tanh(c_new)
+                if ln is not None:
+                    alive = (t < ln.astype(jnp.int32))[:, None]
+                    h_new = jnp.where(alive, h_new, h)
+                    c_new = jnp.where(alive, c_new, c)
+                return (h_new, c_new), h_new
+
+            ts = jnp.arange(xs.shape[0], dtype=jnp.int32)
+            if is_reverse:
+                ts = ts[::-1]
+                xs = xs[::-1]
+            (hT, cT), outs = lax.scan(step, (h0, c0), (xs, ts))
+            if is_reverse:
+                outs = outs[::-1]
+            return jnp.swapaxes(outs, 0, 1), cT
+
+        nondiff = (len(args) - 1,) if lengths is not None else ()
+        return primitive(name="dynamic_lstm_peephole",
+                         nondiff=nondiff)(fn)(*args)
     input = ensure_tensor(input)
     weight = ensure_tensor(weight)
     d = int(size) // 4
@@ -1676,17 +2112,50 @@ def dynamic_lstmp(input, size, proj_size, weight, proj_weight, bias=None,
     return proj, c
 
 
+_fluid_lstm_registry: dict = {}
+
+
 def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
          dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
          default_initializer=None, seed=-1):
-    """cudnn-style multi-layer LSTM (reference: cudnn_lstm_op.cu).
-    Re-routed to the nn.LSTM layer: the reference's flat-weight cudnn
-    API has no XLA analogue, so build an nn.LSTM and call it instead."""
-    raise NotImplementedError(
-        "fluid.layers.lstm (cudnn flat-weight API): construct "
-        "paddle.nn.LSTM(input_size, hidden_size, num_layers, "
-        "direction='bidirect' if is_bidirec else 'forward') and call it — "
-        "same math, explicit parameters (reference: cudnn_lstm_op.cu)")
+    """cudnn-style multi-layer LSTM (reference: cudnn_lstm_op.cu via
+    fluid/layers/rnn.py lstm).  The reference materializes one flat
+    cudnn weight blob inside the op; here the weights live in an
+    ``nn.LSTM`` module cached by ``name`` (the same registry pattern as
+    ``distributed.split``), so repeated calls — one per training step —
+    train the SAME parameters.  Dropout between layers follows the
+    cudnn semantics (off when ``is_test``).
+
+    Returns (rnn_out [B, T, D*hidden], last_h, last_c
+    [num_layers*D, B, hidden]) like the reference.
+    """
+    import sys as _sys
+    from ..layer.rnn import LSTM as _LSTM
+    input = ensure_tensor(input)
+    if name is None:
+        # unnamed calls key on the CALL SITE, mirroring the reference
+        # where each op call in the program owns its own weight blob —
+        # two different unnamed LSTMs must not silently share weights
+        fr = _sys._getframe(1)
+        ident = (fr.f_code.co_filename, fr.f_lineno)
+    else:
+        ident = name
+    key = (ident, int(input.shape[-1]), int(hidden_size),
+           int(num_layers), bool(is_bidirec))
+    if key not in _fluid_lstm_registry:
+        _fluid_lstm_registry[key] = _LSTM(
+            int(input.shape[-1]), int(hidden_size), int(num_layers),
+            direction="bidirect" if is_bidirec else "forward",
+            dropout=float(dropout_prob))
+    rnn = _fluid_lstm_registry[key]
+    # is_test toggles eval mode per call (dropout keys off Layer.training,
+    # so the cached module serves both modes)
+    rnn.eval() if is_test else rnn.train()
+    states = None
+    if init_h is not None and init_c is not None:
+        states = (ensure_tensor(init_h), ensure_tensor(init_c))
+    out, (h, c) = rnn(input, states)
+    return out, h, c
 
 
 def ssd_loss(location, confidence, gt_box, gt_label, prior_box,

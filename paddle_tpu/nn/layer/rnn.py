@@ -149,9 +149,22 @@ class RNNBase(Layer):
             init_arrays = None
 
         time_major = self.time_major
+        # cudnn semantics: dropout on each layer's OUTPUT except the last,
+        # train mode only (the reference's cudnn descriptor dropout)
+        p_drop = float(self.dropout)
+        use_do = p_drop > 0.0 and self.training and L > 1
+        n_param = len(flat_params)
+        if use_do:
+            from ...core import rng as _rng
+            extra = (_rng.op_key(inputs),)
+        else:
+            extra = ()
 
-        @primitive(name=mode.lower() + "_rnn")
-        def _run(x, *param_arrays):
+        @primitive(name=mode.lower() + "_rnn",
+                   nondiff=(1 + n_param,) if use_do else ())
+        def _run(x, *arrs):
+            param_arrays = arrs[:n_param]
+            dkey = arrs[n_param] if use_do else None
             if not time_major:
                 x = jnp.swapaxes(x, 0, 1)  # -> [T, B, in]
             batch = x.shape[1]
@@ -182,13 +195,20 @@ class RNNBase(Layer):
                     outs_dirs.append(outs)
                 layer_in = (jnp.concatenate(outs_dirs, axis=-1)
                             if D == 2 else outs_dirs[0])
+                if use_do and layer < L - 1:
+                    k = jax.random.fold_in(dkey, layer)
+                    keep = jax.random.bernoulli(k, 1.0 - p_drop,
+                                                layer_in.shape)
+                    layer_in = jnp.where(
+                        keep, layer_in / (1.0 - p_drop),
+                        0.0).astype(layer_in.dtype)
             out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
             h_stack = jnp.stack(final_h)
             if mode == "LSTM":
                 return out, h_stack, jnp.stack(final_c)
             return out, h_stack
 
-        res = _run(inputs, *flat_params)
+        res = _run(inputs, *flat_params, *extra)
         if mode == "LSTM":
             out, h, c = res
             return out, (h, c)

@@ -1,0 +1,17 @@
+"""Abort-all worker: rank 1 dies with a distinctive exit code while rank
+0 would run for minutes — the launcher's watch loop (reference
+launch_utils.py:526) must kill rank 0 and surface rank 1's code."""
+import os
+import sys  # noqa: F401  (kept for symmetry with other workers)
+import time
+
+rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+if rank == 1:
+    time.sleep(2)
+    # a hard death (segfault/OOM-kill analogue): os._exit skips the
+    # jax.distributed shutdown barrier — sys.exit would BLOCK there
+    # waiting for the surviving ranks, which is exactly the scenario
+    # the launcher's watch loop exists to clean up
+    os._exit(7)
+print(f"RESULT alive {rank}", flush=True)
+time.sleep(120)  # the launcher must not wait this out

@@ -421,6 +421,121 @@ class TestReviewRegressions2:
             rois_num=T(np.array([1, 1], np.int64))).numpy()
         assert np.allclose(out[0], 0.0) and np.all(out[1] > 0)
 
+    def test_prroi_pool_exact_integral(self):
+        """prroi_pool (round 5): the separable hat-integral form must
+        equal a midpoint quadrature of the bilinear surface (independent
+        numeric reference, not the reference's cell loop)."""
+        rs = np.random.RandomState(0)
+        x = rs.rand(1, 2, 6, 8).astype(np.float32)
+        roi = np.array([[1.3, 0.7, 6.2, 4.9]], np.float32)
+        out = F.prroi_pool(T(x), T(roi), 1.0, 2, 3).numpy()
+
+        def bilin(img, y, xq):
+            H, W = img.shape
+            y0, x0 = int(np.floor(y)), int(np.floor(xq))
+            v = 0.0
+            for i, wy in ((y0, 1 - (y - y0)), (y0 + 1, y - y0)):
+                for j, wx in ((x0, 1 - (xq - x0)), (x0 + 1, xq - x0)):
+                    if 0 <= i < H and 0 <= j < W:
+                        v += wy * wx * img[i, j]
+            return v
+
+        r = roi[0]
+        bh, bw = (r[3] - r[1]) / 2, (r[2] - r[0]) / 3
+        n = 60
+        for c in range(2):
+            for p in range(2):
+                for q in range(3):
+                    ys = r[1] + p * bh + (np.arange(n) + 0.5) * bh / n
+                    xs = r[0] + q * bw + (np.arange(n) + 0.5) * bw / n
+                    ref = np.mean([bilin(x[0, c], yy, xx)
+                                   for yy in ys for xx in xs])
+                    assert abs(ref - out[0, c, p, q]) < 5e-3
+
+    def test_prroi_pool_roi_gradient(self):
+        """The paper's point: gradients flow to RoI coordinates."""
+        rs = np.random.RandomState(1)
+        x = T(rs.rand(1, 1, 6, 6).astype("float32"), stop_gradient=False)
+        r = T(np.array([[1.2, 1.1, 4.7, 4.3]], "float32"),
+              stop_gradient=False)
+        out = F.prroi_pool(x, r, 1.0, 2, 2)
+        paddle.sum(out).backward()
+        assert np.abs(x.grad.numpy()).sum() > 0
+        assert np.abs(r.grad.numpy()).sum() > 0
+
+    def test_deformable_roi_pooling_matches_loop(self):
+        """deformable_roi_pooling (round 5) vs a direct per-sample loop
+        (deformable_psroi_pooling_op.h:57 semantics): plain mode with
+        offsets, and position-sensitive mode with channel groups."""
+        rs = np.random.RandomState(1)
+        H, W, ph, pw, spp, tstd = 7, 9, 2, 2, 3, 0.1
+        x = rs.rand(1, 4, H, W).astype(np.float32)
+        rois = np.array([[1, 1, 6, 5]], np.float32)
+        trans = rs.randn(1, 2, ph, pw).astype(np.float32)
+
+        def ref_one(img, roi, tr, group, out_dim):
+            gh_, gw_ = group
+            x1 = round(roi[0]) - 0.5
+            y1 = round(roi[1]) - 0.5
+            x2 = round(roi[2]) + 1 - 0.5
+            y2 = round(roi[3]) + 1 - 0.5
+            rw, rh = max(x2 - x1, 0.1), max(y2 - y1, 0.1)
+            bw, bh = rw / pw, rh / ph
+            ncls = tr.shape[0] // 2
+            out = np.zeros((out_dim, ph, pw), np.float32)
+            for ct in range(out_dim):
+                cls = ct // (out_dim // ncls)
+                for p in range(ph):
+                    for q in range(pw):
+                        txv = tr[cls * 2, p, q] * tstd
+                        tyv = tr[cls * 2 + 1, p, q] * tstd
+                        ws = q * bw + x1 + txv * rw
+                        hs = p * bh + y1 + tyv * rh
+                        gh = min(max(int(np.floor(p * gh_ / ph)), 0),
+                                 gh_ - 1)
+                        gw = min(max(int(np.floor(q * gw_ / pw)), 0),
+                                 gw_ - 1)
+                        c = (ct * gh_ + gh) * gw_ + gw
+                        s, cnt = 0.0, 0
+                        for ih in range(spp):
+                            for iw in range(spp):
+                                wq = ws + iw * bw / spp
+                                hq = hs + ih * bh / spp
+                                if not (-0.5 <= wq <= W - 0.5
+                                        and -0.5 <= hq <= H - 0.5):
+                                    continue
+                                wq = min(max(wq, 0.0), W - 1.0)
+                                hq = min(max(hq, 0.0), H - 1.0)
+                                x0 = int(np.floor(wq))
+                                y0 = int(np.floor(hq))
+                                xn, yn = min(x0 + 1, W - 1), \
+                                    min(y0 + 1, H - 1)
+                                dx, dy = wq - x0, hq - y0
+                                s += (img[c, y0, x0] * (1 - dx) * (1 - dy)
+                                      + img[c, yn, x0] * (1 - dx) * dy
+                                      + img[c, y0, xn] * dx * (1 - dy)
+                                      + img[c, yn, xn] * dx * dy)
+                                cnt += 1
+                        out[ct, p, q] = s / cnt if cnt else 0.0
+            return out
+
+        out = F.deformable_roi_pooling(
+            T(x), T(rois), T(trans), pooled_height=ph, pooled_width=pw,
+            sample_per_part=spp, trans_std=tstd).numpy()
+        np.testing.assert_allclose(
+            out[0], ref_one(x[0], rois[0], trans[0], (1, 1), 4),
+            rtol=1e-4, atol=1e-5)
+
+        xps = rs.rand(1, 16, H, W).astype(np.float32)
+        outps = F.deformable_roi_pooling(
+            T(xps), T(rois), None, no_trans=True, group_size=(2, 2),
+            pooled_height=ph, pooled_width=pw, sample_per_part=spp,
+            position_sensitive=True).numpy()
+        zt = np.zeros((2, ph, pw), np.float32)
+        np.testing.assert_allclose(
+            outps[0], ref_one(xps[0], rois[0], zt, (2, 2), 4),
+            rtol=1e-4, atol=1e-5)
+
     def test_lrn_matches_direct_formula(self):
         x = np.random.RandomState(0).rand(1, 4, 3, 3).astype(np.float32)
         out = fluid.layers.lrn(T(x), n=3, k=1.0, alpha=0.1,
@@ -717,6 +832,34 @@ class TestGenerateProposalLabels:
         assert list(tgt.shape) == [r.shape[0], 8]  # (bg, fg) slots
         assert float(ov.numpy().max()) == 1.0  # gt candidate
 
+    def test_cascade_filters_and_keeps_all(self):
+        """is_cascade_rcnn (round 5): max_overlap==1 rois (the previous
+        stage's gt duplicates) are filtered, and NO sampling caps apply
+        (generate_proposal_labels_op.cc:41 + :204)."""
+        import paddle_tpu.nn.functional as F
+        rois = [np.array([[8, 8, 34, 34], [10, 10, 32, 32],
+                          [1, 1, 20, 20], [2, 2, 21, 21]], "float32")]
+        gt = [np.array([[10, 10, 32, 32]], "float32")]
+        gc = [np.array([2])]
+        mo = [np.array([0.6, 1.0, 0.1, 0.12], "float32")]
+        r, lbl, tgt, *_ = F.generate_proposal_labels(
+            rois, gc, [np.array([0])], gt, batch_size_per_im=2,
+            fg_fraction=0.25, fg_thresh=0.5, bg_thresh_hi=0.5,
+            class_nums=3, use_random=False, is_cascade_rcnn=True,
+            max_overlap=mo)
+        labels = lbl.numpy().reshape(-1)
+        # roi 1 (the gt duplicate) was filtered; the gt re-enters as a
+        # candidate, so fgs = roi 0 + appended gt; bgs = rois 2 and 3 —
+        # 4 rows total even though batch_size_per_im is 2 (no caps)
+        assert r.shape[0] == 4
+        assert (labels > 0).sum() == 2 and (labels == 0).sum() == 2
+        # without cascade the same inputs obey the cap
+        r2, *_ = F.generate_proposal_labels(
+            rois, gc, [np.array([0])], gt, batch_size_per_im=2,
+            fg_fraction=0.25, fg_thresh=0.5, bg_thresh_hi=0.5,
+            class_nums=3, use_random=False)
+        assert r2.shape[0] <= 2
+
     def test_crowd_excluded_and_empty_gt(self):
         import paddle_tpu.nn.functional as F
         rois = [np.array([[8, 8, 34, 34]], "float32"),
@@ -730,6 +873,171 @@ class TestGenerateProposalLabels:
             use_random=False)
         # image 0's only gt is crowd -> no fg anywhere
         assert int((lbl.numpy() > 0).sum()) == 0
+
+
+class TestPeepholeLSTM:
+    """dynamic_lstm(use_peepholes=True) — round 5, reference
+    math/detail/lstm_kernel.h:36-51."""
+
+    def _inputs(self, d=3):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 5, 4 * d).astype(np.float32)
+        w = (rs.randn(d, 4 * d) * 0.3).astype(np.float32)
+        b7 = (rs.randn(1, 7 * d) * 0.3).astype(np.float32)
+        return x, w, b7, d
+
+    def test_zero_checks_equal_plain(self):
+        import paddle_tpu.nn.functional as F
+        x, w, b7, d = self._inputs()
+        b7[:, 4 * d:] = 0
+        out_p, _ = F.dynamic_lstm(T(x), 4 * d, T(w), bias=T(b7),
+                                  use_peepholes=True)
+        out_n, _ = F.dynamic_lstm(T(x), 4 * d, T(w),
+                                  bias=T(b7[:, :4 * d]))
+        np.testing.assert_allclose(out_p.numpy(), out_n.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_matches_hand_loop(self):
+        import paddle_tpu.nn.functional as F
+        x, w, b7, d = self._inputs()
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        gb, wci, wcf, wco = (b7[0, :4 * d], b7[0, 4 * d:5 * d],
+                             b7[0, 5 * d:6 * d], b7[0, 6 * d:])
+        h = np.zeros((2, d), np.float32)
+        c = np.zeros((2, d), np.float32)
+        outs = []
+        for t in range(5):
+            gates = x[:, t] + h @ w + gb
+            i, f, g, o = np.split(gates, 4, axis=-1)
+            i = sig(i + c * wci)      # i/f peek at c_prev
+            f = sig(f + c * wcf)
+            g = np.tanh(g)
+            c = f * c + i * g
+            o = sig(o + c * wco)      # o peeks at c_new
+            h = o * np.tanh(c)
+            outs.append(h.copy())
+        out, cT = F.dynamic_lstm(T(x), 4 * d, T(w), bias=T(b7),
+                                 use_peepholes=True)
+        np.testing.assert_allclose(out.numpy(), np.stack(outs, 1),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(cT.numpy(), c, rtol=1e-4, atol=1e-5)
+
+    def test_bias_shape_enforced(self):
+        import paddle_tpu.nn.functional as F
+        x, w, b7, d = self._inputs()
+        with pytest.raises(ValueError, match="7"):
+            F.dynamic_lstm(T(x), 4 * d, T(w), bias=T(b7[:, :4 * d]),
+                           use_peepholes=True)
+
+
+class TestSampledSoftmax:
+    """fluid.layers.sampled_softmax_with_cross_entropy — round 5,
+    reference sample_logits_op.h + math/sampler.cc LogUniformSampler."""
+
+    def test_sparse_grad_and_training(self):
+        import paddle_tpu.optimizer as opt
+        rs = np.random.RandomState(0)
+        N, K, S = 4, 50, 10
+        logits = T(rs.randn(N, K).astype("float32"),
+                   stop_gradient=False)
+        label = T(rs.randint(0, K, (N, 1)).astype("int64"))
+        loss = fluid.layers.sampled_softmax_with_cross_entropy(
+            logits, label, num_samples=S, seed=42)
+        assert loss.shape[0] == N and np.isfinite(loss.numpy()).all()
+        paddle.sum(loss).backward()
+        nz = (np.abs(logits.grad.numpy()) > 0).sum(axis=1)
+        # gradient touches only the T+S sampled columns
+        assert (nz <= S + 1).all() and (nz > 0).all()
+
+    def test_unique_negatives_exclude_true(self):
+        rs = np.random.RandomState(1)
+        K = 20
+        logits = T(rs.randn(2, K).astype("float32"))
+        label = T(np.array([[3], [7]], "int64"))
+        # num_samples = K-1: every non-true class must appear exactly
+        # once (unique log-uniform sampling excludes the true label)
+        loss = fluid.layers.sampled_softmax_with_cross_entropy(
+            logits, label, num_samples=K - 1, seed=5)
+        assert np.isfinite(loss.numpy()).all()
+
+
+class TestFluidLstmAndLodAppend:
+    """round-5 closures: fluid.layers.lstm (registry-cached nn.LSTM
+    reroute) and lod_append (nested RaggedTensor)."""
+
+    def test_lstm_params_persist_across_calls(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(0)
+        x = T(rs.randn(2, 5, 4).astype("float32"))
+        h0 = T(np.zeros((1, 2, 6), np.float32))
+        c0 = T(np.zeros((1, 2, 6), np.float32))
+        out1, h1, c1 = F.lstm(x, h0, c0, 5, 6, 1, name="suite_lstm")
+        out2, *_ = F.lstm(x, h0, c0, 5, 6, 1, name="suite_lstm")
+        np.testing.assert_allclose(out1.numpy(), out2.numpy())
+        assert list(out1.shape) == [2, 5, 6]
+        outb, hb, _ = F.lstm(x, None, None, 5, 6, 2, is_bidirec=True,
+                             name="suite_lstm_bi")
+        assert list(outb.shape) == [2, 5, 12]
+        assert list(hb.shape) == [4, 2, 6]
+
+    def test_lod_append_nests(self):
+        from paddle_tpu.core.ragged import RaggedTensor
+        x = T(np.arange(14).reshape(7, 2).astype("float32"))
+        rt = fluid.layers.lod_append(x, [2, 3, 2])
+        assert rt.nrows == 3
+        rt2 = fluid.layers.lod_append(
+            RaggedTensor.from_rows([np.ones((2, 2), np.float32),
+                                    np.ones((5, 2), np.float32)]),
+            [1] * 7)
+        assert rt2.lod_level == 2 and rt2.nrows == 7
+        with pytest.raises(ValueError, match="level"):
+            fluid.layers.lod_append(x, [2, 3])  # sums to 5, not 7
+
+
+class TestGenerateMaskLabels:
+    """F.generate_mask_labels — round 5, reference
+    generate_mask_labels_op.cc + mask_util.cc COCO rasterization."""
+
+    def test_poly2mask_square_exact(self):
+        from paddle_tpu.nn.functional.legacy import _poly2mask
+        m = _poly2mask([1, 1, 4, 1, 4, 4, 1, 4], 6, 6)
+        want = np.zeros((6, 6), np.uint8)
+        want[1:4, 1:4] = 1
+        np.testing.assert_array_equal(m, want)
+
+    def test_mask_targets_per_class_slot(self):
+        import paddle_tpu.nn.functional as F
+        im_info = np.array([[32, 32, 1.0]], "float32")
+        segms = [[
+            [np.array([4, 4, 12, 4, 12, 12, 4, 12], "float32")],
+            [np.array([16, 16, 28, 16, 28, 28, 16, 28], "float32")],
+        ]]
+        rois = [np.array([[4, 4, 12, 12], [15, 15, 29, 29],
+                          [0, 0, 3, 3]], "float32")]
+        mask_rois, has_mask, mask_int32 = F.generate_mask_labels(
+            im_info, [np.array([2, 1])], [np.array([0, 0])], segms,
+            rois, [np.array([2, 1, 0])], num_classes=3, resolution=4)
+        assert mask_rois.shape[0] == 2          # only the 2 fg rois
+        assert list(has_mask.numpy().ravel()) == [0, 1]
+        mi = mask_int32.numpy().reshape(2, 3, 16)
+        # class slots: roi 0 -> class 2, roi 1 -> class 1; rest ignore
+        assert (mi[0, 2] >= 0).all() and (mi[0, :2] == -1).all()
+        assert (mi[1, 1] >= 0).all() and (mi[1, 2] == -1).all()
+        # roi 0 == its gt box: the full-resolution mask is all ones
+        assert mi[0, 2].sum() == 16
+
+    def test_bg_fallback_row(self):
+        import paddle_tpu.nn.functional as F
+        im_info = np.array([[32, 32, 1.0]], "float32")
+        segms = [[[np.array([4, 4, 12, 4, 12, 12, 4, 12], "float32")]]]
+        _, has, mask = F.generate_mask_labels(
+            im_info, [np.array([2])], [np.array([0])], segms,
+            [np.array([[0, 0, 3, 3]], "float32")],
+            [np.array([0])], num_classes=3, resolution=4)
+        assert mask.shape[0] == 1 and (mask.numpy() == -1).all()
 
 
 class TestLoDRankReorder:
