@@ -23,6 +23,7 @@ from .launch import launch_main  # noqa: F401
 from .ring import ring_attention  # noqa: F401
 from .moe import MoELayer, ExpertFFN, top_k_gating  # noqa: F401
 from .ps import (SparseTable, HashedSparseTable,  # noqa: F401
+                 GeoSparseTable, GeoWorkerTable,
                  DistributedEmbedding, TheOnePS, get_ps_runtime)
 from ..io.native_dataset import (  # noqa: F401
     InMemoryDataset, QueueDataset)

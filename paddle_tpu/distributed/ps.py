@@ -16,8 +16,10 @@ collectives play the role of brpc.  ``SparseTable`` is fixed-capacity;
 ``HashedSparseTable`` lifts that limit with a host-side id→slot map
 over a geometrically-growing device slab (see its docstring for why
 host-side hashing is the honest parity with the reference's CPU hash
-buckets).  Geo-async replication remains out of scope: there are no
-asynchronous replicas under SPMD to reconcile.
+buckets).  ``GeoSparseTable``/``GeoWorkerTable`` (round 5) carry the
+geo-async training mode: worker-local replicas, interval delta flush
+with SSUM merge, per-trainer refresh sets — the reference's
+SparseGeoTable + GeoCommunicator semantics without brpc processes.
 """
 from __future__ import annotations
 
@@ -401,6 +403,145 @@ class HashedSparseTable(SparseTable):
         used = set(self._slot_of.values())
         self._free = [s for s in range(self.rows - 1, -1, -1)
                       if s not in used]
+
+
+class GeoSparseTable(HashedSparseTable):
+    """Geo-async sparse table (reference:
+    ``table/sparse_geo_table.h`` + ``depends/geo_recorder.h:60`` +
+    the trainer-side GeoCommunicator in
+    ``operators/distributed/communicator.cc``): workers train on LOCAL
+    row copies and flush interval-accumulated deltas, the table SUMS
+    raw deltas (the geo SSUM accessor — no optimizer rule on the
+    server), and a per-trainer recorder tracks which ids each worker
+    must refresh (``pull_geo_param``).
+
+    TPU-native shape: the reference's brpc round-trips become direct
+    method calls on the mesh-sharded slab; the async-replica semantics
+    (stale local copies, interval delta merge, cross-trainer refresh)
+    are preserved exactly, which is what changes convergence behavior —
+    see ``tests/test_ps_geo.py`` for the sync-vs-geo convergence
+    experiment the scope note is backed by."""
+
+    def __init__(self, name, dim, trainer_num=1, **kwargs):
+        super().__init__(name, dim, **kwargs)
+        self.trainer_num = int(trainer_num)
+        self._pending = [set() for _ in range(self.trainer_num)]
+
+    def apply_deltas(self, ids, deltas):
+        """Raw scatter-add of geo deltas — the SSUM merge rule
+        (no optimizer state touched; geo tables are configured with the
+        sum accessor in the reference)."""
+        slots = jnp.asarray(self._assign(ids))
+        d = deltas._data if isinstance(deltas, Tensor) else \
+            jnp.asarray(deltas)
+        self.weight = self.weight.at[slots].add(d)
+
+    def geo_push(self, trainer_id, ids, deltas):
+        """A worker's interval flush: merge deltas + record the ids for
+        every OTHER trainer (geo_recorder.h Update)."""
+        self._push_count += 1
+        self.apply_deltas(ids, deltas)
+        for t in range(self.trainer_num):
+            if t != trainer_id:
+                self._pending[t].update(int(i) for i in np.asarray(
+                    ids._data if isinstance(ids, Tensor) else ids
+                ).reshape(-1).tolist())
+
+    def pull_geo_param(self, trainer_id):
+        """GetAndClear (sparse_geo_table.cc:20): the ids other trainers
+        changed since this trainer's last refresh, with fresh values."""
+        ids = np.asarray(sorted(self._pending[trainer_id]), np.int64)
+        self._pending[trainer_id].clear()
+        if ids.size == 0:
+            return ids, None
+        return ids, self.pull(ids)
+
+    # -- persistence: parent artifacts + the per-trainer refresh queues
+    def save(self, dirname, num_shards=None):
+        super().save(dirname, num_shards)
+        with open(os.path.join(dirname, f"{self.name}.geo"),
+                  "wb") as f:
+            pickle.dump({"trainer_num": self.trainer_num,
+                         "pending": [sorted(s) for s in self._pending]},
+                        f, protocol=4)
+
+    def load(self, dirname):
+        super().load(dirname)
+        with open(os.path.join(dirname, f"{self.name}.geo"),
+                  "rb") as f:
+            m = pickle.load(f)
+        self.trainer_num = int(m["trainer_num"])
+        self._pending = [set(s) for s in m["pending"]]
+
+
+class GeoWorkerTable:
+    """Trainer-side geo view (reference GeoCommunicator semantics):
+    pulls populate a local replica, pushes apply plain SGD locally, and
+    every ``geo_need_push_nums`` pushes the accumulated delta
+    ``(local - base) / trainer_num`` is flushed to the GeoSparseTable,
+    followed by a refresh of rows other trainers changed
+    (communicator.cc geo mode: send_threshold + recv per interval)."""
+
+    def __init__(self, table: GeoSparseTable, trainer_id,
+                 geo_need_push_nums=10, lr=None):
+        self.table = table
+        self.trainer_id = int(trainer_id)
+        self.interval = int(geo_need_push_nums)
+        self.lr = float(lr if lr is not None else table.lr)
+        self._local = {}   # id -> np row (trained locally)
+        self._base = {}    # id -> np row at last sync
+        self._pushes = 0
+
+    def _ensure(self, ids_np):
+        missing = [i for i in ids_np.tolist() if i not in self._local]
+        if missing:
+            rows = np.asarray(self.table.pull(
+                np.asarray(missing, np.int64)).numpy())
+            for i, r in zip(missing, rows):
+                self._local[i] = r.astype(np.float32).copy()
+                self._base[i] = r.astype(np.float32).copy()
+
+    def pull(self, ids):
+        ids_np = np.asarray(
+            ids._data if isinstance(ids, Tensor) else ids,
+            np.int64).reshape(-1)
+        self._ensure(ids_np)
+        return Tensor(np.stack([self._local[i]
+                                for i in ids_np.tolist()]))
+
+    def push(self, ids, grads):
+        ids_np = np.asarray(
+            ids._data if isinstance(ids, Tensor) else ids,
+            np.int64).reshape(-1)
+        g = np.asarray(
+            grads._data if isinstance(grads, Tensor)
+            else grads, np.float32).reshape(len(ids_np), -1)
+        self._ensure(ids_np)
+        for i, gi in zip(ids_np.tolist(), g):
+            self._local[i] = self._local[i] - self.lr * gi
+        self._pushes += 1
+        if self._pushes % self.interval == 0:
+            self.flush()
+
+    def flush(self):
+        """Interval delta push + cross-trainer refresh."""
+        ids = np.asarray(sorted(self._local), np.int64)
+        if ids.size:
+            deltas = np.stack(
+                [(self._local[i] - self._base[i])
+                 / self.table.trainer_num for i in ids.tolist()])
+            touched = np.abs(deltas).sum(axis=1) > 0
+            if touched.any():
+                self.table.geo_push(self.trainer_id, ids[touched],
+                                    deltas[touched])
+            for i in ids.tolist():
+                self._base[i] = self._local[i].copy()
+        fresh_ids, fresh = self.table.pull_geo_param(self.trainer_id)
+        if fresh is not None:
+            rows = np.asarray(fresh.numpy())
+            for i, r in zip(fresh_ids.tolist(), rows):
+                self._local[i] = r.astype(np.float32).copy()
+                self._base[i] = r.astype(np.float32).copy()
 
 
 class DistributedEmbedding:

@@ -171,19 +171,77 @@ class DistributeTranspilerConfig:
 
 
 class DistributeTranspiler:
-    """reference: fluid/transpiler/distribute_transpiler.py — rewrote
-    programs into trainer/pserver pairs.  Under SPMD there is no program
-    split; use paddle.distributed.fleet (the_one_ps path) instead."""
+    """reference: fluid/transpiler/distribute_transpiler.py — rewrote a
+    program into trainer/pserver pairs wired over grpc.
+
+    Round-5 sync-mode shim: under SPMD there are no server processes to
+    split a program FOR — parameters are mesh-resident and gradient
+    sync is XLA collectives — so the 1.x entry points map to:
+
+    * ``transpile``            → record the topology env contract
+      (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM, like the reference's
+      env plumbing in ``launch_utils.py``) and keep the program whole;
+    * ``get_trainer_program``  → the ORIGINAL program: every trainer
+      runs the full graph, dp sync is the executor's job;
+    * ``get_pserver_program``  → an EMPTY runnable program (there is no
+      listen_and_serv loop; the "server" role returns immediately) plus
+      a matching startup program via ``get_startup_program`` (or both
+      at once via ``get_pserver_programs``).
+
+    A 1.x PS script therefore runs unmodified in sync mode
+    (``tests/test_transpiler_shim.py``).  Async (sync_mode=False) keeps
+    the guided raise — its semantics live in the geo tables
+    (``paddle.distributed.ps.GeoSparseTable``)."""
 
     def __init__(self, config=None):
         self.config = config or DistributeTranspilerConfig()
+        self.trainer_id = 0
+        self.trainers = 1
+        self._main = None
 
-    def transpile(self, *a, **k):
-        raise NotImplementedError(
-            "DistributeTranspiler.transpile: the grpc PS program rewrite "
-            "has no SPMD analogue — use paddle.distributed.fleet with a "
-            "DistributedStrategy (a_sync for the async-PS semantics); "
-            "sparse tables live in paddle.distributed.ps")
+    def transpile(self, trainer_id, program=None, pservers="",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint=""):
+        if not (sync_mode and self.config.sync_mode):
+            raise NotImplementedError(
+                "DistributeTranspiler(sync_mode=False): the async grpc "
+                "PS rewrite has no SPMD analogue — use "
+                "paddle.distributed.ps.GeoSparseTable/GeoWorkerTable "
+                "for geo-async semantics, or fleet DistributedStrategy "
+                "a_sync")
+        import os as _os
+        from .. import static as _static
+        self.trainer_id = int(trainer_id)
+        self.trainers = int(trainers) if not isinstance(trainers, str) \
+            else len([e for e in trainers.split(",") if e])
+        self.pserver_endpoints = [e for e in str(pservers).split(",")
+                                  if e]
+        self._main = program or _static.default_main_program()
+        self._startup = startup_program or \
+            _static.default_startup_program()
+        _os.environ["PADDLE_TRAINER_ID"] = str(self.trainer_id)
+        _os.environ["PADDLE_TRAINERS_NUM"] = str(self.trainers)
+        return self._main
+
+    def get_trainer_program(self, wait_port=True):
+        if self._main is None:
+            raise RuntimeError(
+                "DistributeTranspiler.get_trainer_program: call "
+                "transpile() first (reference enforces the same order)")
+        return self._main
+
+    def get_pserver_program(self, endpoint):
+        from ..static import Program
+        return Program()   # no server loop under SPMD; returns empty
+
+    def get_pserver_programs(self, endpoint):
+        return self.get_pserver_program(endpoint), \
+            self.get_startup_program(endpoint)
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        from ..static import Program
+        return Program()
 
 
 transpiler = _submodule(

@@ -1,21 +1,409 @@
-"""paddle.onnx (reference: python/paddle/onnx/export.py, delegating to the
-external paddle2onnx package).
+"""paddle.onnx (reference: python/paddle/onnx/export.py, which
+delegates to the external paddle2onnx package).
 
-The TPU build's portable artifact is StableHLO (paddle.jit.save), which is
-what XLA-family runtimes consume; ONNX export would need an external
-converter that is not vendored, so export() saves the StableHLO artifact
-and says so rather than silently writing a different format.
+Round 5: ``export`` is a REAL minimal ONNX exporter.  The reference
+converts its ProgramDesc op-by-op; here the eval-mode forward is traced
+to a jaxpr and each primitive maps to standard ONNX ops (opset 13) —
+`Conv`, `MatMul`, `MaxPool`, elementwise, reductions, `Reshape`, … —
+enough to cover the vision zoo (LeNet, ResNet, VGG-style stacks) and
+any model lowering to the mapped primitive set.  Serialization uses a
+protoc-compiled subset of the public ONNX schema
+(``onnx_export/onnx_subset.proto`` — spec field numbers, so any ONNX
+consumer parses the file); no external onnx package is needed.
+
+Models using primitives outside the mapped set get an error naming the
+primitive, with StableHLO (``paddle.jit.save``) as the full-coverage
+portable artifact.
 """
 from __future__ import annotations
 
+import numpy as np
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    from . import jit as jit_mod
-    if path.endswith(".onnx"):
-        path = path[:-len(".onnx")]
-    jit_mod.save(layer, path, input_spec=input_spec)
-    raise NotImplementedError(
-        "ONNX serialization requires the external paddle2onnx converter "
-        "(not available in this environment). The model WAS exported as a "
-        f"portable StableHLO artifact at '{path}.pdmodel' — load it with "
-        "paddle.jit.load or paddle.inference.Predictor.")
+_ONNX_DTYPE = {"float32": 1, "uint8": 2, "int8": 3, "int16": 5,
+               "int32": 6, "int64": 7, "bool": 9, "float16": 10,
+               "float64": 11, "uint32": 12, "uint64": 13,
+               "bfloat16": 16}
+
+
+def _pb():
+    from .onnx_export import onnx_subset_pb2 as P
+    return P
+
+
+class _Converter:
+    """Walks a closed jaxpr, emitting ONNX nodes (one primitive may
+    expand to several nodes).  Call-like primitives (pjit,
+    custom_jvp/vjp, remat) are inlined recursively."""
+
+    def __init__(self, graph, opset):
+        self.g = graph
+        self.opset = opset
+        self._n = 0
+        self.names = {}       # jax Var -> onnx name
+
+    # -- naming / constants ------------------------------------------------
+    def fresh(self, hint="v"):
+        self._n += 1
+        return f"{hint}_{self._n}"
+
+    def name_of(self, atom):
+        if hasattr(atom, "val"):   # jax core Literal
+            return self.add_const(np.asarray(atom.val))
+        return self.names[atom]
+
+    def add_const(self, arr, name=None):
+        arr = np.asarray(arr)
+        name = name or self.fresh("const")
+        t = self.g.initializer.add()
+        t.name = name
+        t.dims.extend(arr.shape)
+        dt = _ONNX_DTYPE.get(str(arr.dtype))
+        if dt is None:
+            raise NotImplementedError(
+                f"onnx.export: dtype {arr.dtype} has no ONNX mapping")
+        t.data_type = dt
+        t.raw_data = np.ascontiguousarray(arr).tobytes()
+        return name
+
+    def node(self, op, inputs, n_out=1, **attrs):
+        P = _pb()
+        nd = self.g.node.add()
+        nd.op_type = op
+        nd.name = self.fresh(op)
+        nd.input.extend(inputs)
+        outs = [self.fresh(op.lower()) for _ in range(n_out)]
+        nd.output.extend(outs)
+        for k, v in attrs.items():
+            a = nd.attribute.add()
+            a.name = k
+            if isinstance(v, int):
+                a.type = P.AttributeProto.INT
+                a.i = v
+            elif isinstance(v, float):
+                a.type = P.AttributeProto.FLOAT
+                a.f = v
+            elif isinstance(v, str):
+                a.type = P.AttributeProto.STRING
+                a.s = v.encode()
+            elif isinstance(v, (list, tuple)):
+                a.type = P.AttributeProto.INTS
+                a.ints.extend(int(x) for x in v)
+            else:
+                raise TypeError(f"attr {k}: {type(v)}")
+        return outs if n_out > 1 else outs[0]
+
+    # -- jaxpr walk --------------------------------------------------------
+    def run(self, jaxpr, consts, in_names):
+        for var, val in zip(jaxpr.constvars, consts):
+            self.names[var] = self.add_const(np.asarray(val))
+        for var, nm in zip(jaxpr.invars, in_names):
+            self.names[var] = nm
+        for eqn in jaxpr.eqns:
+            self.eqn(eqn)
+        return [self.name_of(v) for v in jaxpr.outvars]
+
+    def inline(self, eqn, closed):
+        inner = closed.jaxpr
+        for var, val in zip(inner.constvars, closed.consts):
+            self.names[var] = self.add_const(np.asarray(val))
+        for var, outer in zip(inner.invars, eqn.invars):
+            self.names[var] = self.name_of(outer)
+        for sub in inner.eqns:
+            self.eqn(sub)
+        for outer, innerv in zip(eqn.outvars, inner.outvars):
+            self.names[outer] = self.name_of(innerv)
+
+    _ELEMENTWISE = {
+        "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+        "max": "Max", "min": "Min", "pow": "Pow", "sqrt": "Sqrt",
+        "exp": "Exp", "log": "Log", "tanh": "Tanh",
+        "logistic": "Sigmoid", "abs": "Abs", "neg": "Neg",
+        "sign": "Sign", "floor": "Floor", "ceil": "Ceil",
+    }
+    _COMPARE = {"gt": "Greater", "lt": "Less", "ge": "GreaterOrEqual",
+                "le": "LessOrEqual", "eq": "Equal"}
+    _REDUCE_ATTR = {"reduce_max": "ReduceMax", "reduce_min": "ReduceMin",
+                    "reduce_prod": "ReduceProd"}
+
+    def eqn(self, eqn):
+        p = str(eqn.primitive)
+        params = eqn.params
+        ins = [self.name_of(a) for a in eqn.invars]
+
+        def out(name):
+            self.names[eqn.outvars[0]] = name
+
+        if p in ("pjit", "jit", "closed_call", "core_call",
+                 "remat", "checkpoint"):
+            return self.inline(eqn, params["jaxpr"])
+        if p in ("custom_jvp_call", "custom_vjp_call"):
+            return self.inline(eqn, params["call_jaxpr"])
+
+        if p in self._ELEMENTWISE:
+            return out(self.node(self._ELEMENTWISE[p], ins))
+        if p in self._COMPARE:
+            return out(self.node(self._COMPARE[p], ins))
+        if p == "ne":
+            eq_out = self.node("Equal", ins)
+            return out(self.node("Not", [eq_out]))
+        if p == "select_n":
+            if len(ins) != 3:
+                raise NotImplementedError(
+                    "onnx.export: select_n with >2 cases")
+            # select_n(pred, on_false, on_true) -> Where(pred, T, F)
+            return out(self.node("Where", [ins[0], ins[2], ins[1]]))
+        if p == "integer_pow":
+            y = int(params["y"])
+            if y == 2:
+                return out(self.node("Mul", [ins[0], ins[0]]))
+            e = self.add_const(np.asarray(float(y), np.float32))
+            return out(self.node("Pow", [ins[0], e]))
+        if p == "rsqrt":
+            s = self.node("Sqrt", ins)
+            return out(self.node("Reciprocal", [s]))
+        if p == "convert_element_type":
+            key = str(np.dtype(params["new_dtype"]))
+            dt = _ONNX_DTYPE.get(key)
+            if dt is None:
+                raise NotImplementedError(
+                    f"onnx.export: cast to {key} has no ONNX mapping — "
+                    "use StableHLO export (paddle.jit.save)")
+            return out(self.node("Cast", ins, to=dt))
+        if p == "stop_gradient":
+            return out(self.node("Identity", ins))
+        if p in ("reshape", "squeeze", "expand_dims"):
+            if p == "reshape" and params.get("dimensions") is not None:
+                raise NotImplementedError(
+                    "onnx.export: reshape with dimensions (fused "
+                    "transpose)")
+            shp = self.add_const(np.asarray(
+                eqn.outvars[0].aval.shape, np.int64))
+            return out(self.node("Reshape", [ins[0], shp]))
+        if p == "transpose":
+            return out(self.node("Transpose", ins,
+                                 perm=list(params["permutation"])))
+        if p == "broadcast_in_dim":
+            tgt = list(params["shape"])
+            bdims = list(params["broadcast_dimensions"])
+            interm = [1] * len(tgt)
+            for src_axis, dst_axis in enumerate(bdims):
+                interm[dst_axis] = eqn.invars[0].aval.shape[src_axis]
+            shp = self.add_const(np.asarray(interm, np.int64))
+            r = self.node("Reshape", [ins[0], shp])
+            tgt_c = self.add_const(np.asarray(tgt, np.int64))
+            return out(self.node("Expand", [r, tgt_c]))
+        if p == "concatenate":
+            return out(self.node("Concat", ins,
+                                 axis=int(params["dimension"])))
+        if p == "slice":
+            if params.get("strides") is None:
+                strides = [1] * len(params["start_indices"])
+            else:
+                strides = list(params["strides"])
+            st = self.add_const(np.asarray(params["start_indices"],
+                                           np.int64))
+            en = self.add_const(np.asarray(params["limit_indices"],
+                                           np.int64))
+            ax = self.add_const(np.arange(len(strides),
+                                          dtype=np.int64))
+            sp = self.add_const(np.asarray(strides, np.int64))
+            return out(self.node("Slice", [ins[0], st, en, ax, sp]))
+        if p == "reduce_sum":
+            axes = self.add_const(np.asarray(params["axes"], np.int64))
+            return out(self.node("ReduceSum", [ins[0], axes],
+                                 keepdims=0))
+        if p in self._REDUCE_ATTR:
+            return out(self.node(self._REDUCE_ATTR[p], ins,
+                                 axes=list(params["axes"]),
+                                 keepdims=0))
+        if p == "dot_general":
+            ((lc, rc), (lb, rb)) = params["dimension_numbers"]
+            lhs_nd = len(eqn.invars[0].aval.shape)
+            ok = (list(lb) == list(range(len(lb)))
+                  and list(rb) == list(range(len(rb)))
+                  and list(lc) == [lhs_nd - 1]
+                  and list(rc) == [len(lb)])
+            if not ok:
+                raise NotImplementedError(
+                    "onnx.export: dot_general layout "
+                    f"{params['dimension_numbers']} (only numpy-matmul "
+                    "layouts map to MatMul)")
+            return out(self.node("MatMul", ins))
+        if p == "conv_general_dilated":
+            dn = params["dimension_numbers"]
+            if (dn.lhs_spec != (0, 1, 2, 3)
+                    or dn.rhs_spec != (0, 1, 2, 3)
+                    or dn.out_spec != (0, 1, 2, 3)):
+                raise NotImplementedError(
+                    "onnx.export: only NCHW/OIHW convolutions map to "
+                    f"Conv (got {dn})")
+            if any(d != 1 for d in params["lhs_dilation"]):
+                raise NotImplementedError(
+                    "onnx.export: lhs_dilation (transposed conv) — "
+                    "use StableHLO export")
+            pads = list(params["padding"])
+            kshape = eqn.invars[1].aval.shape[2:]
+            return out(self.node(
+                "Conv", ins,
+                strides=list(params["window_strides"]),
+                dilations=list(params["rhs_dilation"]),
+                group=int(params["feature_group_count"]),
+                kernel_shape=list(kshape),
+                pads=[pads[0][0], pads[1][0], pads[0][1], pads[1][1]]))
+        if p in ("reduce_window_max", "reduce_window_sum"):
+            wd = list(params["window_dimensions"])
+            ws = list(params["window_strides"])
+            pads = list(params["padding"])
+            if (len(wd) != 4 or wd[0] != 1 or wd[1] != 1
+                    or ws[0] != 1 or ws[1] != 1
+                    or pads[0] != (0, 0) or pads[1] != (0, 0)):
+                raise NotImplementedError(
+                    "onnx.export: reduce_window with windows/strides/"
+                    "padding on batch or channel dims (only NCHW "
+                    "spatial pooling maps to Max/AveragePool)")
+            if any(d != 1 for d in params.get("window_dilation",
+                                              (1,) * len(wd))):
+                raise NotImplementedError(
+                    "onnx.export: dilated pooling windows")
+            if any(d != 1 for d in params.get("base_dilation",
+                                              (1,) * len(wd))):
+                raise NotImplementedError(
+                    "onnx.export: base_dilation in reduce_window — use "
+                    "StableHLO export")
+            kw = dict(kernel_shape=wd[2:], strides=ws[2:],
+                      pads=[pads[2][0], pads[3][0],
+                            pads[2][1], pads[3][1]])
+            if p == "reduce_window_max":
+                return out(self.node("MaxPool", ins, **kw))
+            ap = self.node("AveragePool", ins,
+                           count_include_pad=1, **kw)
+            scale = self.add_const(
+                np.asarray(float(wd[2] * wd[3]), np.float32))
+            return out(self.node("Mul", [ap, scale]))
+        if p == "iota":
+            aval = eqn.outvars[0].aval
+            arr = np.arange(aval.shape[params["dimension"]])
+            full = np.broadcast_to(
+                arr.reshape([-1 if i == params["dimension"] else 1
+                             for i in range(len(aval.shape))]),
+                aval.shape).astype(np.dtype(params["dtype"]))
+            return out(self.add_const(full))
+        if p == "copy":
+            return out(self.node("Identity", ins))
+
+        raise NotImplementedError(
+            f"onnx.export: primitive '{p}' has no ONNX mapping yet — "
+            "the full-coverage portable artifact is StableHLO "
+            "(paddle.jit.save / paddle.inference)")
+
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Export ``layer``'s eval forward as a real ONNX model.
+
+    ``input_spec``: list of InputSpec/arrays with STATIC shapes (the
+    jaxpr trace fixes them; for batch-polymorphic artifacts use
+    paddle.jit.save's StableHLO path).  Writes ``path`` (``.onnx``
+    appended if absent) and returns the path.
+    """
+    import jax
+    from .core.tensor import Tensor
+    from .static import InputSpec
+
+    P = _pb()
+    if int(opset_version) < 13:
+        raise ValueError(
+            f"onnx.export: opset_version={opset_version} — the emitted "
+            "node forms (ReduceSum-with-axes-input, 5-input Slice, "
+            "Where, GreaterOrEqual) require opset >= 13; pass "
+            "opset_version=13 (the default)")
+    if not path.endswith(".onnx"):
+        path = path + ".onnx"
+    if input_spec is None:
+        raise ValueError("onnx.export requires input_spec")
+
+    examples = []
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            if any(d is None or (isinstance(d, int) and d < 0)
+                   for d in spec.shape):
+                raise ValueError(
+                    "onnx.export: dynamic dims are not supported by "
+                    "the minimal exporter — give static shapes, or "
+                    "use paddle.jit.save (StableHLO) for "
+                    "batch-polymorphic artifacts")
+            examples.append(np.zeros(spec.shape,
+                                     spec.dtype or "float32"))
+        else:
+            examples.append(np.asarray(spec))
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+        def fn(*arrays):
+            outs = layer(*[Tensor(a) for a in arrays])
+            if isinstance(outs, (list, tuple)):
+                return [o._data if isinstance(o, Tensor) else o
+                        for o in outs]
+            return outs._data if isinstance(outs, Tensor) else outs
+
+        closed = jax.make_jaxpr(fn)(*examples)
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+
+    model = P.ModelProto()
+    model.ir_version = 7
+    model.producer_name = "paddle_tpu"
+    op = model.opset_import.add()
+    op.domain = ""
+    op.version = int(opset_version)
+    g = model.graph
+    g.name = type(layer).__name__
+
+    in_names = []
+    for i, ex in enumerate(examples):
+        nm = f"input_{i}"
+        in_names.append(nm)
+        vi = g.input.add()
+        vi.name = nm
+        dt = _ONNX_DTYPE.get(str(ex.dtype))
+        if dt is None:
+            raise NotImplementedError(
+                f"onnx.export: input dtype {ex.dtype} has no ONNX "
+                "mapping — use StableHLO export (paddle.jit.save)")
+        vi.type.tensor_type.elem_type = dt
+        for d in ex.shape:
+            vi.type.tensor_type.shape.dim.add().dim_value = d
+
+    conv = _Converter(g, opset_version)
+    out_names = conv.run(closed.jaxpr, closed.consts, in_names)
+
+    # dead-code elimination: jaxprs can carry unconsumed results (e.g.
+    # extra outputs of inlined custom_jvp bodies); keep only nodes and
+    # initializers reachable from the graph outputs
+    needed = set(out_names)
+    keep_nodes = []
+    for nd in reversed(list(g.node)):
+        if any(o in needed for o in nd.output):
+            keep_nodes.append(nd)
+            needed.update(nd.input)
+    del g.node[:]
+    for nd in reversed(keep_nodes):
+        g.node.add().CopyFrom(nd)
+    keep_init = [t for t in g.initializer if t.name in needed]
+    del g.initializer[:]
+    for t in keep_init:
+        g.initializer.add().CopyFrom(t)
+
+    for nm, var in zip(out_names, closed.jaxpr.outvars):
+        vo = g.output.add()
+        vo.name = nm
+        aval = var.aval
+        vo.type.tensor_type.elem_type = _ONNX_DTYPE[str(aval.dtype)]
+        for d in aval.shape:
+            vo.type.tensor_type.shape.dim.add().dim_value = int(d)
+
+    with open(path, "wb") as f:
+        f.write(model.SerializeToString())
+    return path

@@ -86,6 +86,14 @@ class Variable(Tensor):
             "through Executor.run(fetch_list=[...]) to get a value. "
             "(reference parity: fluid Variables have no data until run)")
 
+    def __bool__(self):
+        # the object default (always True) turns `while cond(...)` over
+        # a symbolic Variable into a silent infinite recording loop
+        raise TypeError(
+            f"Variable '{self.name}' is symbolic — its truth value is "
+            "unknown at graph-build time; use static.nn.cond/while_loop "
+            "for data-dependent control flow")
+
     def __repr__(self):
         return (f"Variable(name={self.name}, shape={self.shape}, "
                 f"dtype={self.dtype})")

@@ -188,12 +188,23 @@ class TestFluidSubmodules:
         b = fluid.unique_name.generate("w")
         assert a != b
 
-    def test_transpiler_errors_helpfully(self):
+    def test_transpiler_sync_shim_async_guided(self):
+        # round 5: sync transpile WORKS (shim); async still guides
+        import os
         import paddle_tpu.fluid as fluid
         import pytest
         t = fluid.transpiler.DistributeTranspiler()
-        with pytest.raises(NotImplementedError, match="fleet"):
-            t.transpile(0)
+        paddle.enable_static()
+        try:
+            t.transpile(0, pservers="127.0.0.1:6170", trainers=1)
+            assert t.get_trainer_program() is not None
+            with pytest.raises(NotImplementedError,
+                               match="GeoSparseTable"):
+                t.transpile(0, sync_mode=False)
+        finally:
+            paddle.disable_static()
+            for k in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM"):
+                os.environ.pop(k, None)
 
     def test_deprecated_modules_error(self):
         import paddle_tpu.fluid as fluid

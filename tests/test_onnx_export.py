@@ -1,0 +1,195 @@
+"""paddle.onnx.export (round 5, VERDICT r4 #9): real minimal ONNX
+artifacts for the zoo models, validated NUMERICALLY by executing the
+emitted graph with an independent torch-based evaluator (no onnx
+package in this environment — the evaluator reads the protobuf we
+wrote and re-implements each emitted op with torch/numpy)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def _load(path):
+    from paddle_tpu.onnx_export import onnx_subset_pb2 as P
+    m = P.ModelProto()
+    with open(path, "rb") as f:
+        m.ParseFromString(f.read())
+    return m
+
+
+_NP_OF = {1: np.float32, 3: np.int8, 6: np.int32, 7: np.int64,
+          9: np.bool_, 11: np.float64}
+
+
+def _tensor_value(t):
+    arr = np.frombuffer(t.raw_data, dtype=_NP_OF[t.data_type])
+    return arr.reshape(list(t.dims)).copy()
+
+
+def _run_onnx(model, feeds):
+    """Execute the emitted graph with torch (independent of jax)."""
+    import torch
+    import torch.nn.functional as TF
+
+    env = {}
+    for t in model.graph.initializer:
+        env[t.name] = torch.from_numpy(_tensor_value(t))
+    for vi, arr in zip(model.graph.input, feeds):
+        env[vi.name] = torch.from_numpy(np.asarray(arr))
+
+    def attr(nd, name, default=None):
+        for a in nd.attribute:
+            if a.name == name:
+                if a.type == 7:      # INTS
+                    return list(a.ints)
+                if a.type == 2:      # INT
+                    return int(a.i)
+                if a.type == 1:      # FLOAT
+                    return float(a.f)
+        return default
+
+    for nd in model.graph.node:
+        i = [env[x] for x in nd.input]
+        op = nd.op_type
+        if op == "Conv":
+            pads = attr(nd, "pads")
+            assert pads[0] == pads[2] and pads[1] == pads[3], pads
+            o = TF.conv2d(i[0], i[1], None,
+                          stride=attr(nd, "strides"),
+                          padding=pads[:2],
+                          dilation=attr(nd, "dilations"),
+                          groups=attr(nd, "group", 1))
+        elif op == "MaxPool":
+            pads = attr(nd, "pads")
+            o = TF.max_pool2d(i[0], attr(nd, "kernel_shape"),
+                              stride=attr(nd, "strides"),
+                              padding=pads[:2])
+        elif op == "AveragePool":
+            pads = attr(nd, "pads")
+            o = TF.avg_pool2d(i[0], attr(nd, "kernel_shape"),
+                              stride=attr(nd, "strides"),
+                              padding=pads[:2],
+                              count_include_pad=True)
+        elif op == "MatMul":
+            o = i[0] @ i[1]
+        elif op == "Add":
+            o = i[0] + i[1]
+        elif op == "Sub":
+            o = i[0] - i[1]
+        elif op == "Mul":
+            o = i[0] * i[1]
+        elif op == "Div":
+            o = i[0] / i[1]
+        elif op == "Max":
+            o = torch.maximum(i[0], i[1])
+        elif op == "Min":
+            o = torch.minimum(i[0], i[1])
+        elif op == "Sqrt":
+            o = torch.sqrt(i[0])
+        elif op == "Pow":
+            o = torch.pow(i[0], i[1])
+        elif op == "Exp":
+            o = torch.exp(i[0])
+        elif op == "Sigmoid":
+            o = torch.sigmoid(i[0])
+        elif op == "Tanh":
+            o = torch.tanh(i[0])
+        elif op == "Reciprocal":
+            o = 1.0 / i[0]
+        elif op == "Greater":
+            o = i[0] > i[1]
+        elif op == "Where":
+            o = torch.where(i[0], i[1], i[2])
+        elif op == "Reshape":
+            o = i[0].reshape([int(v) for v in i[1]])
+        elif op == "Expand":
+            o = i[0].expand([int(v) for v in i[1]])
+        elif op == "Transpose":
+            o = i[0].permute(attr(nd, "perm"))
+        elif op == "Concat":
+            o = torch.cat(i, dim=attr(nd, "axis"))
+        elif op == "ReduceSum":
+            o = i[0].sum(dim=[int(v) for v in i[1]])
+        elif op == "ReduceMax":
+            o = torch.amax(i[0], dim=attr(nd, "axes"))
+        elif op == "Cast":
+            to = attr(nd, "to")
+            o = i[0].to(dict(
+                {1: torch.float32, 6: torch.int32, 7: torch.int64,
+                 9: torch.bool})[to])
+        elif op == "Identity":
+            o = i[0]
+        elif op == "Slice":
+            starts, ends, axes, steps = (
+                [int(v) for v in x] for x in i[1:5])
+            o = i[0]
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                o = o.index_select(
+                    ax, torch.arange(s, min(e, o.shape[ax]), st))
+        else:
+            raise AssertionError(f"evaluator: unmapped op {op}")
+        env[nd.output[0]] = o
+    return [env[vo.name].numpy() for vo in model.graph.output]
+
+
+def _export_and_compare(net, shape, tmp_path, name, atol=1e-4):
+    net.eval()
+    x = np.random.RandomState(0).rand(*shape).astype("float32")
+    golden = net(paddle.to_tensor(x)).numpy()
+    path = paddle.onnx.export(
+        net, str(tmp_path / name),
+        input_spec=[static.InputSpec(list(shape), "float32")])
+    model = _load(path)
+    assert model.ir_version == 7
+    assert model.opset_import[0].version == 13
+    out, = _run_onnx(model, [x])
+    np.testing.assert_allclose(out, golden, rtol=1e-3, atol=atol)
+    return model
+
+
+def test_lenet_onnx_numerics(tmp_path):
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(0)
+    m = _export_and_compare(LeNet(num_classes=10), (2, 1, 28, 28),
+                            tmp_path, "lenet")
+    ops = {n.op_type for n in m.graph.node}
+    assert {"Conv", "MaxPool", "MatMul"} <= ops
+
+
+@pytest.mark.slow
+def test_resnet18_onnx_numerics(tmp_path):
+    from paddle_tpu.vision.models import resnet18
+    paddle.seed(1)
+    m = _export_and_compare(resnet18(num_classes=10), (1, 3, 32, 32),
+                            tmp_path, "resnet18", atol=5e-4)
+    assert len(m.graph.node) > 50
+
+
+def test_mlp_softmax_onnx(tmp_path):
+    from paddle_tpu import nn
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4),
+                        nn.Softmax())
+    _export_and_compare(net, (4, 8), tmp_path, "mlp")
+
+
+def test_dynamic_dims_guided(tmp_path):
+    from paddle_tpu import nn
+    with pytest.raises(ValueError, match="StableHLO"):
+        paddle.onnx.export(nn.Linear(4, 2), str(tmp_path / "d"),
+                           input_spec=[static.InputSpec([None, 4],
+                                                        "float32")])
+
+
+def test_unmapped_primitive_guided(tmp_path):
+    from paddle_tpu import nn
+
+    class Sorty(nn.Layer):
+        def forward(self, x):
+            return paddle.sort(x)
+
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        paddle.onnx.export(Sorty(), str(tmp_path / "s"),
+                           input_spec=[static.InputSpec([4, 4],
+                                                        "float32")])

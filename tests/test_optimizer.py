@@ -295,6 +295,43 @@ class TestFusedFlatUpdate:
         self._tree_close(pa, pb)
         self._tree_close(sa, sb)
 
+    def test_mixed_dtype_params_group_separately(self):
+        """The r3 advisor scenario (re-audited r5 before any default
+        flip): bf16 and f32 params in ONE optimizer must produce
+        bitwise-identical results fused vs per-param — the group key
+        separates by param/grad/state dtype so jnp.concatenate never
+        silently promotes."""
+        import jax.numpy as jnp
+        rng = np.random.RandomState(3)
+        params = {
+            "wf32": jnp.asarray(rng.randn(8, 8).astype(np.float32)),
+            "wbf16": jnp.asarray(
+                rng.randn(8, 8).astype(np.float32)).astype(jnp.bfloat16),
+            "bf32": jnp.asarray(rng.randn(8).astype(np.float32)),
+            "bbf16": jnp.asarray(
+                rng.randn(8).astype(np.float32)).astype(jnp.bfloat16),
+        }
+        grads = {k: jnp.asarray(
+            rng.standard_normal(v.shape)).astype(v.dtype)
+            for k, v in params.items()}
+        lr = jnp.asarray(1e-2, jnp.float32)
+        for mp in (True, False):
+            opt_a = optimizer.Adam(learning_rate=1e-3,
+                                   multi_precision=mp)
+            opt_b = optimizer.Adam(learning_rate=1e-3,
+                                   multi_precision=mp)
+            sa = opt_a.init_state_tree(params)
+            sb = opt_b.init_state_tree(params)
+            opt_b.fuse_update = True
+            pa, pb = params, params
+            for _ in range(3):
+                pa, sa = opt_a.apply_gradients_tree(pa, grads, sa, lr)
+                pb, sb = opt_b.apply_gradients_tree(pb, grads, sb, lr)
+            self._tree_close(pa, pb)
+            self._tree_close(sa, sb)
+            for k in params:  # dtypes preserved, no promotion
+                assert pb[k].dtype == params[k].dtype
+
     def test_adamw_decay_mask_groups(self):
         """apply_decay_param_fun splits fused groups; masked params get
         no decay, exactly as per-param."""

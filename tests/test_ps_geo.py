@@ -1,0 +1,136 @@
+"""Geo-async PS mode (round 5, VERDICT r4 #5): the reference's
+SparseGeoTable + GeoCommunicator semantics — local replicas, interval
+delta flush with SSUM merge, cross-trainer refresh — plus the
+HashedSparseTable churn test (grow + shrink(ttl) under a shifting id
+distribution)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distributed as dist
+from paddle_tpu.distributed.ps import (GeoSparseTable, GeoWorkerTable,
+                                       HashedSparseTable, SparseTable)
+
+
+@pytest.fixture()
+def mesh():
+    return dist.build_mesh(dp=4, sharding=2)
+
+
+class TestGeoAsync:
+    def _problem(self):
+        rs = np.random.RandomState(0)
+        ids = np.arange(8, dtype=np.int64)
+        target = rs.randn(8, 4).astype(np.float32)
+        return ids, target
+
+    def _grads(self, rows, target):
+        return rows - target  # dMSE/drow up to the constant
+
+    def test_deltas_merge_and_refresh(self, mesh):
+        """Worker 0's flushed delta reaches worker 1 on ITS next flush
+        (geo_recorder GetAndClear semantics) — not before."""
+        paddle.seed(0)
+        t = GeoSparseTable("geo0", dim=4, trainer_num=2, lr=1.0,
+                          mesh=mesh)
+        w0 = GeoWorkerTable(t, 0, geo_need_push_nums=1)
+        w1 = GeoWorkerTable(t, 1, geo_need_push_nums=1)
+        ids = np.array([5], np.int64)
+        base = w1.pull(ids).numpy().copy()
+        g = np.ones((1, 4), np.float32)
+        w0.push(ids, g)          # interval=1 -> flush: delta = -lr*g/2
+        # w1's local replica is still stale
+        np.testing.assert_array_equal(w1.pull(ids).numpy(), base)
+        w1.push(ids, np.zeros((1, 4), np.float32))  # flush -> refresh
+        got = w1.pull(ids).numpy()
+        # after refresh: global = base - 1.0*g/2 (w1's zero delta
+        # contributed nothing, w0's -lr*g/trainer_num landed)
+        np.testing.assert_allclose(got, base - 0.5, rtol=1e-5)
+
+    def test_geo_matches_sync_convergence(self, mesh):
+        """The scope-note experiment: 2 geo workers (stale replicas,
+        interval-10 delta merge) reach the same quality as the sync
+        table on an embedding regression."""
+        paddle.seed(1)
+        ids, target = self._problem()
+
+        sync = SparseTable("geo_sync", rows=8, dim=4, optimizer="sgd",
+                           lr=0.2, mesh=mesh)
+        sync_losses = []
+        for _ in range(120):
+            rows = sync.pull(ids).numpy()
+            sync_losses.append(float(((rows - target) ** 2).mean()))
+            sync.push(ids, self._grads(rows, target))
+
+        paddle.seed(1)
+        t = GeoSparseTable("geo1", dim=4, trainer_num=2, lr=0.2,
+                          mesh=mesh)
+        workers = [GeoWorkerTable(t, i, geo_need_push_nums=10)
+                   for i in range(2)]
+        geo_losses = []
+        for step in range(120):
+            w = workers[step % 2]     # round-robin async trainers
+            rows = w.pull(ids).numpy()
+            geo_losses.append(float(((rows - target) ** 2).mean()))
+            w.push(ids, self._grads(rows, target))
+        for w in workers:
+            w.flush()
+        final = t.pull(ids).numpy()
+        geo_final = float(((final - target) ** 2).mean())
+
+        assert sync_losses[-1] < 1e-3
+        # geo converges too — staleness costs a constant factor, not
+        # divergence (this is the evidence behind the COVERAGE.md note)
+        assert geo_final < geo_losses[0] * 0.05, \
+            (geo_losses[0], geo_final)
+
+    def test_unflushed_ids_not_visible_globally(self, mesh):
+        paddle.seed(2)
+        t = GeoSparseTable("geo2", dim=4, trainer_num=1, lr=1.0,
+                          mesh=mesh)
+        w = GeoWorkerTable(t, 0, geo_need_push_nums=100)
+        ids = np.array([3], np.int64)
+        before = t.pull(ids).numpy().copy()
+        w.push(ids, np.ones((1, 4), np.float32))
+        # not flushed yet: the global slab is untouched
+        np.testing.assert_array_equal(t.pull(ids).numpy(), before)
+        w.flush()
+        assert not np.allclose(t.pull(ids).numpy(), before)
+
+
+@pytest.mark.slow
+def test_hashed_table_churn_under_shifting_ids(mesh):
+    """VERDICT r4 #5 churn test: a sliding id window forces repeated
+    grow + shrink(ttl) cycles; live-id count and slab bookkeeping stay
+    consistent throughout and evicted slots are recycled."""
+    paddle.seed(3)
+    t = HashedSparseTable("churn", dim=4, initial_rows=256,
+                          optimizer="sgd", lr=0.1, mesh=mesh)
+    rs = np.random.RandomState(0)
+    window = 50_000          # ids per epoch window
+    epochs = 8
+    peak_rows = 0
+    for e in range(epochs):
+        # the window slides: 50% overlap with the previous epoch
+        lo = e * window // 2
+        ids = rs.randint(lo, lo + window, size=4096).astype(np.int64)
+        t.push(ids, np.ones((ids.size, 4), np.float32))
+        peak_rows = max(peak_rows, t.rows)
+        evicted = t.shrink(ttl=2)   # ids untouched for 2 pushes die
+        # bookkeeping invariants after every churn cycle
+        assert t.size + len(t._free) == t.rows
+        assert len(set(t._slot_of.values())) == t.size
+        if e >= 3:
+            assert evicted > 0      # the window moved: old ids die
+    # eviction keeps the slab bounded: after 8 windows the slab holds
+    # far fewer rows than the total distinct ids seen
+    total_seen = epochs * 4096
+    assert t.size < total_seen // 2
+    # slots freed by shrink are actually reused: push a fresh batch and
+    # verify no growth was needed when free slots sufficed
+    free_before = len(t._free)
+    fresh = np.arange(10**9, 10**9 + min(free_before, 1000),
+                      dtype=np.int64)
+    rows_before = t.rows
+    t.push(fresh, np.ones((fresh.size, 4), np.float32))
+    assert t.rows == rows_before    # reuse, not growth

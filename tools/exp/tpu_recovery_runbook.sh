@@ -3,6 +3,14 @@
 # ONE process may use the TPU at a time; steps run strictly sequentially
 # and each is subprocess-isolated so a hang cannot poison the next.
 #
+# Round-5 note: bench.py now runs a ~5s tiny-model canary before the
+# 345M leg — a wedged tunnel aborts in minutes and a live canary's
+# tok/s is published even if the 345M leg dies.  The backlog below is
+# carried from round 4 (the tunnel never came up that round); the
+# fuse-opt A/B gained a mixed-dtype bitwise-equivalence audit
+# (tests/test_optimizer.py::test_mixed_dtype_params_group_separately)
+# so PADDLE_TPU_FUSE_OPT can default on the moment the A/B wins.
+#
 # Round-4 backlog (VERDICT r3 tasks 1-3): driver-provable bench capture,
 # BERT device-resident re-measure (3 runs — explain or erase the
 # 704.9 -> 561.5 drop), 1.3B b1 clean-window re-measure (3 runs — the
