@@ -356,8 +356,25 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
 
 
 def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError(
-        "class_center_sample: PS-class op not yet ported")
+    """PartialFC class-center sampling (post-reference 2.1 op, kept for
+    the 2.x surface): every POSITIVE class in ``label`` is kept and
+    uniform negatives fill up to ``num_samples``; the sampled set is
+    sorted and labels are remapped to positions within it.  Host-side
+    sampling (the op is data-dependent-shape by nature), device gather
+    for the remap."""
+    lab = np.asarray(ensure_tensor(label).numpy(), np.int64).reshape(-1)
+    K, S = int(num_classes), int(num_samples)
+    pos = np.unique(lab)
+    if pos.size >= S:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(K, dtype=np.int64), pos,
+                                assume_unique=True)
+        picked = np.random.permutation(neg_pool.size)[:S - pos.size]
+        sampled = np.sort(np.concatenate([pos, neg_pool[picked]]))
+    remap = np.full((K,), -1, np.int64)
+    remap[sampled] = np.arange(sampled.size)
+    return (Tensor(remap[lab]), Tensor(sampled))
 
 
 def npair_loss(anchor, positive, labels, l2_reg=0.002):

@@ -544,17 +544,29 @@ def unique_consecutive(x, return_inverse=False, return_counts=False,
     arr = np.asarray(x._data)
     if axis is None:
         arr = arr.reshape(-1)
-        change = np.concatenate([[True], arr[1:] != arr[:-1]])
+        n = arr.size
+        change = np.concatenate([[True], arr[1:] != arr[:-1]]) \
+            if n else np.zeros((0,), bool)
         out = arr[change]
-        outs = [Tensor(out)]
-        if return_inverse:
-            outs.append(Tensor(np.cumsum(change).astype(np.int64) - 1))
-        if return_counts:
-            idx = np.flatnonzero(change)
-            counts = np.diff(np.concatenate([idx, [arr.size]]))
-            outs.append(Tensor(counts.astype(np.int64)))
-        return outs[0] if len(outs) == 1 else tuple(outs)
-    raise NotImplementedError
+    else:
+        # axis case: consecutive-duplicate SLICES along axis collapse
+        moved = np.moveaxis(arr, axis, 0)
+        n = moved.shape[0]
+        if n:
+            flat = moved.reshape(n, -1)
+            change = np.concatenate(
+                [[True], np.any(flat[1:] != flat[:-1], axis=1)])
+        else:
+            change = np.zeros((0,), bool)
+        out = np.moveaxis(moved[change], 0, axis)
+    outs = [Tensor(out)]
+    if return_inverse:
+        outs.append(Tensor(np.cumsum(change).astype(np.int64) - 1))
+    if return_counts:
+        idx = np.flatnonzero(change)
+        counts = np.diff(np.concatenate([idx, [n]]))
+        outs.append(Tensor(counts.astype(np.int64)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False,

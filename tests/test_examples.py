@@ -23,6 +23,8 @@ SCRIPTS = [
     "quant_aware_training.py",
     "packed_pretraining.py",
     "serving_decode.py",
+    "geo_async_ps.py",
+    "onnx_export.py",
 ]
 
 

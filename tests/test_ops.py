@@ -248,3 +248,41 @@ class TestClipCumsum:
         np.testing.assert_allclose(
             paddle_tpu.cumsum(paddle_tpu.to_tensor(x), axis=1).numpy(),
             np.cumsum(x, 1), rtol=1e-5)
+
+
+def test_unique_consecutive_flat_and_axis():
+    """round 5: the axis form (consecutive duplicate SLICES) matches
+    torch.unique_consecutive(dim=...)."""
+    import torch
+    import paddle_tpu as paddle
+    x = np.array([1, 1, 2, 2, 2, 3, 1], np.int64)
+    o, inv, cnt = paddle.unique_consecutive(
+        paddle.to_tensor(x), return_inverse=True, return_counts=True)
+    to, tinv, tcnt = torch.unique_consecutive(
+        torch.from_numpy(x), return_inverse=True, return_counts=True)
+    np.testing.assert_array_equal(o.numpy(), to.numpy())
+    np.testing.assert_array_equal(inv.numpy(), tinv.numpy())
+    np.testing.assert_array_equal(cnt.numpy(), tcnt.numpy())
+    x2 = np.array([[1, 1], [1, 1], [2, 2], [1, 1]], np.int64)
+    o2, cnt2 = paddle.unique_consecutive(
+        paddle.to_tensor(x2), return_counts=True, axis=0)
+    to2, tcnt2 = torch.unique_consecutive(
+        torch.from_numpy(x2), return_counts=True, dim=0)
+    np.testing.assert_array_equal(o2.numpy(), to2.numpy())
+    np.testing.assert_array_equal(cnt2.numpy(), tcnt2.numpy())
+
+
+def test_class_center_sample():
+    """round 5: PartialFC sampling — positives always kept, labels
+    remapped into the sorted sampled set."""
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu as paddle
+    np.random.seed(0)
+    lab = np.array([3, 7, 3, 11], np.int64)
+    remapped, sampled = F.class_center_sample(
+        paddle.to_tensor(lab), num_classes=20, num_samples=8)
+    sc, rl = sampled.numpy(), remapped.numpy()
+    assert len(sc) == 8 and {3, 7, 11} <= set(sc.tolist())
+    assert (np.sort(sc) == sc).all()
+    for i, l in enumerate(lab):
+        assert sc[rl[i]] == l

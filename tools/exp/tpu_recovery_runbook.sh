@@ -94,6 +94,47 @@ run 13b_scan_b2 2400 python tools/exp/_exp_13b.py --scan --batch 2 --seq 1024 --
 # 7) long-context s4096 (round-2 recorded 24,472 tok/s b3)
 run long 1800 python tools/exp/_exp_long.py
 
+# 7b) roofline calibration (VERDICT r4 weak-#5/next-#8): compare the
+#     dryrun [dryrun:cost] flops/HBM terms against the XPlane trace
+#     from step 5 for the same single-chip step; record the scale
+#     factor so the MULTICHIP cost lines can say "calibrated vs v5e
+#     single-chip (factor X)" instead of "uncalibrated roofline".
+run roofline_calib 900 python - <<'EOF'
+import json
+import numpy as np, jax, paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.models import GPTModel
+from paddle_tpu.parallel.train_step import TrainStep
+paddle.seed(0)
+model = GPTModel.from_config("gpt2-medium", fused_loss=True)
+model.to(dtype="bfloat16")
+step = TrainStep(model, optimizer.AdamW(
+    learning_rate=1e-4, parameters=model.parameters()), loss_fn=None)
+rng = np.random.RandomState(0)
+ids = rng.randint(0, 50304, (8, 1025)).astype(np.int32)
+x, y = ids[:, :-1], ids[:, 1:]
+_, _, compiled = step.aot_compile([x, y])
+cost = compiled.cost_analysis() or {}
+if isinstance(cost, (list, tuple)):
+    cost = cost[0] if cost else {}
+import time
+loss = step.step([x, y]); loss.numpy()
+t0 = time.perf_counter()
+for _ in range(10):
+    loss = step.step([x, y])
+loss.numpy()
+dt = (time.perf_counter() - t0) / 10
+flops = float(cost.get("flops", 0.0))
+hbm = float(cost.get("bytes accessed", 0.0))
+V5E_FLOPS, V5E_HBM = 197e12, 819e9  # bf16 peak, same anchors as __graft_entry__._V5E_BF16_FLOPS
+roofline_ms = 1e3 * max(flops / V5E_FLOPS, hbm / V5E_HBM)
+print(json.dumps({
+    "measured_step_ms": round(dt * 1e3, 2),
+    "roofline_est_ms": round(roofline_ms, 2),
+    "calibration_factor": round(dt * 1e3 / max(roofline_ms, 1e-9), 3),
+    "flops": flops, "hbm_bytes": hbm}))
+EOF
+
 # 8) py_func host-callback smoke ON TPU: pure_callback crosses the axon
 #    tunnel via XLA host callbacks — prove the round-4 op works there
 run pyfunc_smoke 300 python - <<'EOF'
