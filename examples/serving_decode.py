@@ -60,6 +60,18 @@ def main():
     print(f"fused whole-decode   : {t_fused * 1000:8.1f} ms / request "
           f"({t_step / t_fused:.1f}x)")
 
+    # speculative (round 5): prompt-lookup drafting + windowed verify —
+    # bit-identical to fused greedy; the win shows on repetitive output
+    # (summaries, code, chat), diagnosed via last_spec_forwards
+    spec, t_spec = bench(model, ids, n, mode="speculative")
+    # every speculative token is the model's own argmax; on CPU that is
+    # bit-identical to fused greedy (TPU may round near-ties differently
+    # across window shapes, so report drift instead of asserting there)
+    spec_drift = float(np.mean(spec.numpy() != fused.numpy()))
+    print(f"speculative decode   : {t_spec * 1000:8.1f} ms / request "
+          f"({model.last_spec_forwards} forwards for {n} tokens, "
+          f"drift vs fused: {spec_drift:.1%})")
+
     # weight-only int8: same API, the codes thread through the compiled
     # decode as arguments (not baked constants)
     from paddle_tpu.quantization import quantize_weights_int8

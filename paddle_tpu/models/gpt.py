@@ -125,24 +125,28 @@ class GPTAttention(nn.Layer):
         return qkv[:, 0], qkv[:, 1], qkv[:, 2]
 
     def decode(self, x, k_buf, v_buf, pos):
-        """One-token decode against FIXED-SIZE cache buffers (compiled
-        generation): writes this token's k/v at ``pos`` via
-        dynamic_update_slice and attends over positions <= pos.  Static
-        shapes throughout — one XLA program decodes every step.
+        """Windowed decode against FIXED-SIZE cache buffers (compiled
+        generation): writes the window's k/v at ``pos..pos+S-1`` via
+        dynamic_update_slice and each query attends causally over
+        positions <= its own (S=1 is the classic one-token step; S>1 is
+        the speculative verify window).  Static shapes throughout — one
+        XLA program decodes every step.
 
-        x: Tensor [B, 1, E]; k_buf/v_buf: [B, L, H, hd] arrays;
-        pos: traced int scalar.  Returns (out Tensor, k_buf, v_buf).
+        x: Tensor [B, S, E]; k_buf/v_buf: [B, L, H, hd] arrays;
+        pos: traced int scalar (window start).  Returns
+        (out Tensor [B, S, E], k_buf, v_buf).
         """
         import math as _math
         import jax
         import jax.numpy as jnp
 
+        S = x.shape[1]
         if self.use_mp:
             q, k, v = self._qkv_mp(x)
         else:
             b = x.shape[0]
             qkv = self.qkv_proj(x)
-            qkv = reshape(qkv, [b, 1, 3, self.num_heads, self.head_dim])
+            qkv = reshape(qkv, [b, S, 3, self.num_heads, self.head_dim])
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         qa, ka, va = q._data, k._data, v._data
         k_buf = jax.lax.dynamic_update_slice(
@@ -154,8 +158,10 @@ class GPTAttention(nn.Layer):
                             qa.astype(jnp.float32),
                             k_buf.astype(jnp.float32)) * scale
         L = k_buf.shape[1]
-        visible = jnp.arange(L) <= pos                    # [L]
-        scores = jnp.where(visible[None, None, None, :], scores, -1e30)
+        # query at window offset q sees cache positions <= pos + q
+        visible = (jnp.arange(L)[None, :]
+                   <= pos + jnp.arange(S)[:, None])       # [S, L]
+        scores = jnp.where(visible[None, None, :, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs,
                          v_buf.astype(jnp.float32)).astype(qa.dtype)
@@ -166,7 +172,7 @@ class GPTAttention(nn.Layer):
                 self.out_bias
         else:
             b = x.shape[0]
-            out = reshape(out, [b, 1, self.num_heads * self.head_dim])
+            out = reshape(out, [b, S, self.num_heads * self.head_dim])
             out = self.out_proj(out)
         return out, k_buf, v_buf
 
@@ -488,13 +494,23 @@ class GPTModel(nn.Layer):
         -> each block's decode -> head.  Shared by the per-token jitted
         step and the fused whole-decode scan so the two compiled paths
         cannot diverge.  Returns (last_logits [B, V], new_k, new_v)."""
-        x = self.embeddings(Tensor(tok), position_offset=pos)
+        logits, new_k, new_v = self._decode_window(tok, k_bufs, v_bufs,
+                                                   pos)
+        return logits[:, -1, :], new_k, new_v
+
+    def _decode_window(self, toks, k_bufs, v_bufs, pos):
+        """Windowed decode: run S tokens at positions pos..pos+S-1
+        against the fixed cache buffers in ONE forward, returning the
+        FULL logits [B, S, V] (the speculative verify needs every
+        position; ``_decode_tick`` is the S-agnostic single source both
+        compiled paths and the fused scan build on)."""
+        x = self.embeddings(Tensor(toks), position_offset=pos)
         new_k, new_v = [], []
         for j, blk in enumerate(self.blocks):
             x, kb, vb = blk.decode(x, k_bufs[j], v_bufs[j], pos)
             new_k.append(kb)
             new_v.append(vb)
-        return self.head(x)._data[:, -1, :], new_k, new_v
+        return self.head(x)._data, new_k, new_v
 
     def _fused_generate_fn(self, pnames, params, cache_key, n_steps,
                            start_pos, do_sample, temperature, top_k,
@@ -572,6 +588,122 @@ class GPTModel(nn.Layer):
         # alias an output — donating them only emits a warning
         fn = jax.jit(pure)
         if len(cache) >= 8:  # FIFO bound on resident executables
+            cache.pop(next(iter(cache)))
+        cache[cache_key] = (fn, bnames, mbuffers)
+        return cache[cache_key]
+
+    def _spec_generate_fn(self, pnames, params, cache_key, max_new,
+                          start_pos, draft_k, ngram, out_dtype):
+        """Build (or fetch) the jitted SPECULATIVE whole-decode fn
+        (round 5; NEW vs reference): prompt-lookup drafting + windowed
+        verify, one device dispatch for the entire generation.
+
+        Each iteration drafts ``draft_k`` tokens by finding the most
+        recent previous occurrence of the last ``ngram`` generated
+        tokens (prompt-lookup decoding — no draft model, ideal for
+        summarization/code/chat where output n-grams repeat) and
+        verifies the whole window in ONE forward via
+        ``_decode_window``.  Greedy by construction: every emitted
+        token is the model's own argmax from the windowed forward —
+        drafts only decide how many tokens each forward yields
+        (1..k+1).  On CPU this matches ``compiled='fused'`` greedy
+        bit-for-bit (the tests assert it); on TPU a near-tie logit may
+        round differently between the S=1 and S=W programs (shape-
+        dependent GEMM tiling), so the cross-path guarantee there is
+        "a valid greedy decode", not bit-identity.
+        Rejected-tail cache/sequence slots are overwritten before any
+        later read (the window rewrites from its own start).  B=1 (the
+        latency-serving case; batch rows would advance unevenly).
+
+        Returns (ids [1, max_new], n_forwards) — the second value is
+        the accept-rate diagnostic (forwards == max_new means nothing
+        accepted; forwards ~ max_new/(k+1) at full acceptance).
+        """
+        import jax
+        import jax.numpy as jnp
+        from ..core import autograd
+        from ..jit import _swapped
+
+        cache = getattr(self, "_spec_fn_cache", None)
+        if cache is None:
+            cache = self._spec_fn_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        model = self
+        mbuffers = dict(self.named_buffers())
+        bnames = sorted(mbuffers)
+        W = draft_k + 1
+        T = start_pos + max_new + W        # margin: no update clamping
+
+        def pure(p_list, b_list, k_bufs, v_bufs, last0, ids_arr):
+            with _swapped(params, dict(zip(pnames, p_list))), \
+                    _swapped(mbuffers, dict(zip(bnames, b_list))):
+                with autograd.no_grad():
+                    seq = jnp.zeros((T,), jnp.int32)
+                    seq = jax.lax.dynamic_update_slice(
+                        seq, ids_arr[0].astype(jnp.int32), (0,))
+                    t0 = jnp.argmax(
+                        last0[0].astype(jnp.float32)).astype(jnp.int32)
+                    seq = seq.at[start_pos].set(t0)
+                    win_idx = (jnp.arange(T)[:, None]
+                               + jnp.arange(ngram)[None, :])
+
+                    def draft(seq, pos):
+                        pat = jax.lax.dynamic_slice(
+                            seq, (pos - (ngram - 1),), (ngram,))
+                        wins = seq[jnp.clip(win_idx, 0, T - 1)]
+                        ok = jnp.all(wins == pat[None, :], axis=1)
+                        # occurrences ending strictly before this one
+                        ok &= (jnp.arange(T) + ngram - 1) < pos
+                        found = jnp.any(ok)
+                        j = jnp.where(found,
+                                      T - 1 - jnp.argmax(ok[::-1]), 0)
+                        dstart = jnp.clip(j + ngram, 0, T - draft_k)
+                        d = jax.lax.dynamic_slice(seq, (dstart,),
+                                                  (draft_k,))
+                        # no match: repeat the current token (a guess
+                        # like any other — rejection costs nothing
+                        # beyond the fixed window forward)
+                        return jnp.where(found, d,
+                                         jnp.full((draft_k,), seq[pos]))
+
+                    def cond(c):
+                        # t0 (from the prefill logits) is already in
+                        # the buffer; the loop fills max_new - 1 more
+                        return c[4] < max_new - 1
+
+                    def body(c):
+                        seq, kbs, vbs, pos, n_out, n_fwd = c
+                        cur = jax.lax.dynamic_slice(seq, (pos,), (1,))
+                        d = draft(seq, pos)
+                        w = jnp.concatenate([cur, d])[None, :]
+                        logits, new_k, new_v = model._decode_window(
+                            w, list(kbs), list(vbs), pos)
+                        preds = jnp.argmax(
+                            logits[0].astype(jnp.float32),
+                            axis=-1).astype(jnp.int32)      # [W]
+                        match = d == preds[:draft_k]
+                        # accepted = length of the True prefix
+                        m = jnp.argmin(jnp.concatenate(
+                            [match, jnp.array([False])]))
+                        seq = jax.lax.dynamic_update_slice(
+                            seq, preds, (pos + 1,))
+                        adv = m + 1
+                        return (seq, tuple(new_k), tuple(new_v),
+                                pos + adv, n_out + adv, n_fwd + 1)
+
+                    init = (seq, tuple(k_bufs), tuple(v_bufs),
+                            jnp.asarray(start_pos, jnp.int32),
+                            jnp.asarray(0, jnp.int32),
+                            jnp.asarray(0, jnp.int32))
+                    seq, _, _, _, _, n_fwd = jax.lax.while_loop(
+                        cond, body, init)
+            out = jax.lax.dynamic_slice(seq, (start_pos,), (max_new,))
+            return out[None, :].astype(out_dtype), n_fwd
+
+        fn = jax.jit(pure)
+        if len(cache) >= 8:  # FIFO bound, matching the other caches
             cache.pop(next(iter(cache)))
         cache[cache_key] = (fn, bnames, mbuffers)
         return cache[cache_key]
@@ -662,7 +794,7 @@ class GPTModel(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
                  top_k=0, top_p=1.0, eos_token_id=None, seed=None,
-                 compiled=False):
+                 compiled=False, draft_k=8, lookup_ngram=3):
         """KV-cached autoregressive decoding (greedy / top-k / top-p
         nucleus sampling; ``top_p<=0`` degenerates to top-1).
 
@@ -677,6 +809,13 @@ class GPTModel(nn.Layer):
         the device is remote or per-call latency matters; its one
         trade-off is that early-eos stopping cannot skip the remaining
         scan steps, though the returned ids are truncated identically).
+        ``compiled="speculative"`` (round 5): prompt-lookup drafting +
+        windowed verify — up to ``draft_k + 1`` tokens per forward on
+        repetitive text; every emitted token is the model's own argmax
+        (equals fused greedy bit-for-bit on CPU; on TPU near-tie logits
+        may round differently across window shapes).  B=1, greedy only;
+        ``draft_k``/``lookup_ngram`` tune the draft window.  The
+        accept-rate diagnostic lands in ``self.last_spec_forwards``.
         Returns [B, S + new] ids.
         """
         import jax
@@ -723,12 +862,37 @@ class GPTModel(nn.Layer):
                 out = [ids]
                 key = rng_mod.key_for(seed)
 
+                if compiled == "speculative":
+                    if b != 1:
+                        raise ValueError(
+                            "generate(compiled='speculative'): B=1 "
+                            "only — batch rows accept at different "
+                            "rates and would advance unevenly")
+                    if do_sample:
+                        raise ValueError(
+                            "generate(compiled='speculative') is "
+                            "greedy-exact by construction — sampling "
+                            "needs rejection-sampling machinery; use "
+                            "compiled='fused' for sampled decoding")
+                    if s + max_new_tokens + draft_k > max_position:
+                        raise ValueError(
+                            "generate(compiled='speculative'): the "
+                            "verify window can reach position "
+                            f"{s + max_new_tokens + draft_k - 1} >= "
+                            f"max_position ({max_position}) — lower "
+                            "draft_k or max_new_tokens")
+
                 step_fn = None
                 if compiled:
                     # jitted prefill: whole prompt pass + cache padding
                     # to L in ONE dispatch (the eager prefill is a
-                    # per-op round-trip storm on remote devices)
+                    # per-op round-trip storm on remote devices);
+                    # speculative windows write up to draft_k slots past
+                    # the last accepted position — pad the buffers so
+                    # dynamic_update_slice can never clamp-shift
                     L = s + max_new_tokens
+                    if compiled == "speculative":
+                        L += draft_k + 1
                     params = dict(self.named_parameters())
                     pnames = sorted(params)
                     bnames_all = tuple(sorted(dict(self.named_buffers())))
@@ -749,6 +913,33 @@ class GPTModel(nn.Layer):
                     logits, caches = self.forward(T(ids), caches=caches)
                     last0 = logits._data[:, -1, :]
 
+                def _truncate_at_eos(toks):
+                    # match the eager loop: stop AFTER the first step
+                    # where every row emitted eos (shared by the fused
+                    # and speculative whole-decode paths)
+                    if eos_token_id is None:
+                        return toks
+                    all_eos = jnp.all(toks == eos_token_id, axis=0)
+                    if bool(jnp.any(all_eos)):
+                        toks = toks[:, :int(jnp.argmax(all_eos)) + 1]
+                    return toks
+
+                if compiled == "speculative":
+                    fn, sbnames, sbufs = self._spec_generate_fn(
+                        pnames, params,
+                        (b, L, max_new_tokens, int(draft_k),
+                         int(lookup_ngram), str(kv_dtype),
+                         str(ids.dtype), tuple(pnames), bnames_all),
+                        max_new=max_new_tokens, start_pos=s,
+                        draft_k=int(draft_k), ngram=int(lookup_ngram),
+                        out_dtype=ids.dtype)
+                    b_list = [sbufs[k2]._data for k2 in sbnames]
+                    toks, n_fwd = fn(p_list, b_list, k_bufs, v_bufs,
+                                     last0, ids)
+                    self.last_spec_forwards = int(n_fwd)
+                    return T(jnp.concatenate(
+                        [ids, _truncate_at_eos(toks)], axis=1))
+
                 if compiled == "fused":
                     fn, fbnames, fbufs = self._fused_generate_fn(
                         pnames, params,
@@ -761,13 +952,8 @@ class GPTModel(nn.Layer):
                         top_k=top_k, top_p=top_p, out_dtype=ids.dtype)
                     b_list = [fbufs[k2]._data for k2 in fbnames]
                     toks = fn(p_list, b_list, k_bufs, v_bufs, last0, key)
-                    if eos_token_id is not None:
-                        # match the eager loop: stop AFTER the first step
-                        # where every row emitted eos
-                        all_eos = jnp.all(toks == eos_token_id, axis=0)
-                        if bool(jnp.any(all_eos)):
-                            toks = toks[:, :int(jnp.argmax(all_eos)) + 1]
-                    return T(jnp.concatenate([ids, toks], axis=1))
+                    return T(jnp.concatenate(
+                        [ids, _truncate_at_eos(toks)], axis=1))
 
                 if compiled:
                     step_fn, dec_bnames, dec_bufs = \

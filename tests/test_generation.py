@@ -262,3 +262,78 @@ def test_generate_zero_new_tokens(tiny_gpt):
         out = tiny_gpt.generate(paddle.to_tensor(ids), max_new_tokens=0,
                                 compiled=mode)
         np.testing.assert_array_equal(out.numpy(), ids)
+
+
+class TestSpeculativeDecode:
+    """compiled='speculative' (round 5): prompt-lookup drafting +
+    windowed verify — bit-identical to fused greedy, fewer forwards
+    when the model's own output repeats."""
+
+    def test_exactness_vs_fused(self):
+        paddle.seed(0)
+        model = GPTModel.from_config("tiny", dropout=0.0,
+                                     max_position=256)
+        model.eval()
+        rs = np.random.RandomState(0)
+        for prompt in (rs.randint(0, 128, (1, 16)).astype(np.int32),
+                       np.tile(np.array([5, 9, 17, 23], np.int32),
+                               8)[None, :]):
+            ref = model.generate(paddle.to_tensor(prompt),
+                                 max_new_tokens=20,
+                                 compiled="fused").numpy()
+            spec = model.generate(paddle.to_tensor(prompt),
+                                  max_new_tokens=20,
+                                  compiled="speculative").numpy()
+            np.testing.assert_array_equal(ref, spec)
+            assert 1 <= model.last_spec_forwards <= 20
+
+    def test_cyclic_model_accepts_drafts(self):
+        """A model trained to emit a short cycle: speculation must
+        cover max_new tokens in far fewer forwards (the whole point),
+        while staying exactly greedy."""
+        from paddle_tpu import optimizer
+        from paddle_tpu.parallel.train_step import TrainStep
+        paddle.seed(3)
+        model = GPTModel.from_config("tiny", dropout=0.0,
+                                     max_position=256)
+        # teach it the cycle 11 -> 22 -> 33 -> 44 -> 11 ...
+        cyc = np.tile(np.array([11, 22, 33, 44], np.int32), 16)
+        x = cyc[None, :-1].copy()
+        y = cyc[None, 1:].copy()
+        step = TrainStep(model, optimizer.Adam(
+            learning_rate=5e-3, parameters=model.parameters()),
+            loss_fn=None)
+        for _ in range(60):
+            lv = float(step.step([x, y]).numpy())
+        assert lv < 0.1, lv
+        step.sync_to_layer()   # donated params -> back into the Layer
+        model.eval()
+        prompt = np.tile(np.array([11, 22, 33, 44], np.int32),
+                         3)[None, :]
+        ref = model.generate(paddle.to_tensor(prompt),
+                             max_new_tokens=32,
+                             compiled="fused").numpy()
+        spec = model.generate(paddle.to_tensor(prompt),
+                              max_new_tokens=32,
+                              compiled="speculative",
+                              draft_k=8).numpy()
+        np.testing.assert_array_equal(ref, spec)
+        # 32 tokens in <= ~32/4 forwards once drafts accept
+        assert model.last_spec_forwards <= 10, \
+            model.last_spec_forwards
+
+    def test_guards(self):
+        paddle.seed(0)
+        model = GPTModel.from_config("tiny", dropout=0.0)
+        model.eval()
+        two = np.zeros((2, 8), np.int32)
+        with pytest.raises(ValueError, match="B=1"):
+            model.generate(paddle.to_tensor(two), max_new_tokens=4,
+                           compiled="speculative")
+        one = np.zeros((1, 8), np.int32)
+        with pytest.raises(ValueError, match="greedy"):
+            model.generate(paddle.to_tensor(one), max_new_tokens=4,
+                           top_k=5, compiled="speculative")
+        with pytest.raises(ValueError, match="max_position|draft_k"):
+            model.generate(paddle.to_tensor(one), max_new_tokens=50,
+                           compiled="speculative", draft_k=16)

@@ -135,6 +135,32 @@ print(json.dumps({
     "flops": flops, "hbm_bytes": hbm}))
 EOF
 
+# 7c) speculative decode (round 5): fused vs speculative latency on
+#     the 345M through the tunnel; untrained-weights caveat — real
+#     accept rates need a trained checkpoint, so record forwards too
+run spec_decode 1200 python - <<'PYEOF'
+import json, time
+import numpy as np, paddle_tpu as paddle
+from paddle_tpu.models import GPTModel
+paddle.seed(0)
+model = GPTModel.from_config("gpt2-medium", dropout=0.0)
+model.to(dtype="bfloat16")
+model.eval()
+ids = paddle.to_tensor(np.tile(
+    np.array([11, 22, 33, 44], np.int32), 8)[None, :])
+res = {}
+for mode in ("fused", "speculative"):
+    out = model.generate(ids, max_new_tokens=64, compiled=mode)
+    out.numpy()
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = model.generate(ids, max_new_tokens=64, compiled=mode)
+    out.numpy()
+    res[mode] = round((time.perf_counter() - t0) / 3 * 1e3, 1)
+res["spec_forwards"] = model.last_spec_forwards
+print(json.dumps(res))
+PYEOF
+
 # 8) py_func host-callback smoke ON TPU: pure_callback crosses the axon
 #    tunnel via XLA host callbacks — prove the round-4 op works there
 run pyfunc_smoke 300 python - <<'EOF'
