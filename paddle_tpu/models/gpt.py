@@ -593,7 +593,9 @@ class GPTModel(nn.Layer):
         return cache[cache_key]
 
     def _spec_generate_fn(self, pnames, params, cache_key, max_new,
-                          start_pos, draft_k, ngram, out_dtype):
+                          start_pos, draft_k, ngram, out_dtype,
+                          do_sample=False, temperature=1.0, top_k=0,
+                          top_p=1.0):
         """Build (or fetch) the jitted SPECULATIVE whole-decode fn
         (round 5; NEW vs reference): prompt-lookup drafting + windowed
         verify, one device dispatch for the entire generation.
@@ -611,6 +613,18 @@ class GPTModel(nn.Layer):
         round differently between the S=1 and S=W programs (shape-
         dependent GEMM tiling), so the cross-path guarantee there is
         "a valid greedy decode", not bit-identity.
+
+        ``do_sample=True`` keeps the target distribution EXACT with a
+        deterministic draft: position i of the window gets an
+        independent sample s_i from the filtered conditional; the
+        accepted prefix is ``draft_i == s_i``.  Each kept s_i is
+        conditioned on a prefix that equals the accepted tokens, and
+        its key is independent of the acceptance event, so emitted
+        tokens are true conditional samples (the degenerate-draft case
+        of Leviathan et al. rejection sampling).  The RANDOM STREAM
+        differs from ``compiled='fused'`` (per-position keys vs
+        per-step), so sampled outputs differ run-shape-to-run-shape —
+        both are exact samples; only greedy is cross-path identical.
         Rejected-tail cache/sequence slots are overwritten before any
         later read (the window rewrites from its own start).  B=1 (the
         latency-serving case; batch rows would advance unevenly).
@@ -636,15 +650,25 @@ class GPTModel(nn.Layer):
         W = draft_k + 1
         T = start_pos + max_new + W        # margin: no update clamping
 
-        def pure(p_list, b_list, k_bufs, v_bufs, last0, ids_arr):
+        def pick_row(logits_row, key):
+            """One token from one position's logits: filtered sample or
+            argmax (mirrors _fused_generate_fn's pick, per-position)."""
+            row = logits_row.astype(jnp.float32)
+            if do_sample:
+                row = GPTModel._filter_logits(row[None, :], temperature,
+                                              top_k, top_p)[0]
+                return jax.random.categorical(key, row).astype(jnp.int32)
+            return jnp.argmax(row).astype(jnp.int32)
+
+        def pure(p_list, b_list, k_bufs, v_bufs, last0, ids_arr, key0):
             with _swapped(params, dict(zip(pnames, p_list))), \
                     _swapped(mbuffers, dict(zip(bnames, b_list))):
                 with autograd.no_grad():
                     seq = jnp.zeros((T,), jnp.int32)
                     seq = jax.lax.dynamic_update_slice(
                         seq, ids_arr[0].astype(jnp.int32), (0,))
-                    t0 = jnp.argmax(
-                        last0[0].astype(jnp.float32)).astype(jnp.int32)
+                    t0 = pick_row(last0[0],
+                                  jax.random.fold_in(key0, 2 ** 30))
                     seq = seq.at[start_pos].set(t0)
                     win_idx = (jnp.arange(T)[:, None]
                                + jnp.arange(ngram)[None, :])
@@ -680,9 +704,13 @@ class GPTModel(nn.Layer):
                         w = jnp.concatenate([cur, d])[None, :]
                         logits, new_k, new_v = model._decode_window(
                             w, list(kbs), list(vbs), pos)
-                        preds = jnp.argmax(
-                            logits[0].astype(jnp.float32),
-                            axis=-1).astype(jnp.int32)      # [W]
+                        # per-position keys independent of acceptance:
+                        # kept samples stay true conditional draws
+                        keys = jax.vmap(
+                            lambda i: jax.random.fold_in(
+                                key0, n_fwd * W + i))(jnp.arange(W))
+                        preds = jax.vmap(pick_row)(
+                            logits[0], keys)                # [W]
                         match = d == preds[:draft_k]
                         # accepted = length of the True prefix
                         m = jnp.argmin(jnp.concatenate(
@@ -811,11 +839,14 @@ class GPTModel(nn.Layer):
         scan steps, though the returned ids are truncated identically).
         ``compiled="speculative"`` (round 5): prompt-lookup drafting +
         windowed verify — up to ``draft_k + 1`` tokens per forward on
-        repetitive text; every emitted token is the model's own argmax
-        (equals fused greedy bit-for-bit on CPU; on TPU near-tie logits
-        may round differently across window shapes).  B=1, greedy only;
-        ``draft_k``/``lookup_ngram`` tune the draft window.  The
-        accept-rate diagnostic lands in ``self.last_spec_forwards``.
+        repetitive text; greedy output equals fused greedy bit-for-bit
+        on CPU (on TPU near-tie logits may round differently across
+        window shapes), and sampling draws exact conditional samples
+        via per-position keys + equality acceptance (a different random
+        stream than 'fused', so sampled tokens differ between the two
+        modes — both exact).  B=1; ``draft_k``/``lookup_ngram`` tune
+        the draft window.  Accept-rate diagnostic:
+        ``self.last_spec_forwards``.
         Returns [B, S + new] ids.
         """
         import jax
@@ -868,12 +899,6 @@ class GPTModel(nn.Layer):
                             "generate(compiled='speculative'): B=1 "
                             "only — batch rows accept at different "
                             "rates and would advance unevenly")
-                    if do_sample:
-                        raise ValueError(
-                            "generate(compiled='speculative') is "
-                            "greedy-exact by construction — sampling "
-                            "needs rejection-sampling machinery; use "
-                            "compiled='fused' for sampled decoding")
                     if s + max_new_tokens + draft_k > max_position:
                         raise ValueError(
                             "generate(compiled='speculative'): the "
@@ -929,13 +954,17 @@ class GPTModel(nn.Layer):
                         pnames, params,
                         (b, L, max_new_tokens, int(draft_k),
                          int(lookup_ngram), str(kv_dtype),
-                         str(ids.dtype), tuple(pnames), bnames_all),
+                         str(ids.dtype), bool(do_sample),
+                         float(temperature), int(top_k or 0),
+                         float(top_p), tuple(pnames), bnames_all),
                         max_new=max_new_tokens, start_pos=s,
                         draft_k=int(draft_k), ngram=int(lookup_ngram),
-                        out_dtype=ids.dtype)
+                        out_dtype=ids.dtype, do_sample=do_sample,
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p)
                     b_list = [sbufs[k2]._data for k2 in sbnames]
                     toks, n_fwd = fn(p_list, b_list, k_bufs, v_bufs,
-                                     last0, ids)
+                                     last0, ids, key)
                     self.last_spec_forwards = int(n_fwd)
                     return T(jnp.concatenate(
                         [ids, _truncate_at_eos(toks)], axis=1))

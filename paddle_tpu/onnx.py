@@ -113,6 +113,57 @@ class _Converter:
         for outer, innerv in zip(eqn.outvars, inner.outvars):
             self.names[outer] = self.name_of(innerv)
 
+    def _general_dot(self, eqn, ins):
+        """Any dot_general as Transpose/Reshape/batched-MatMul/Reshape
+        (jax result layout: batch dims, lhs free, rhs free — exactly
+        what [B, F1, C] @ [B, C, F2] produces after regrouping)."""
+        import numpy as _np
+        ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+        lsh = eqn.invars[0].aval.shape
+        rsh = eqn.invars[1].aval.shape
+        lf = [i for i in range(len(lsh)) if i not in lc and i not in lb]
+        rf = [i for i in range(len(rsh)) if i not in rc and i not in rb]
+        B = int(_np.prod([lsh[i] for i in lb])) if lb else 1
+        C = int(_np.prod([lsh[i] for i in lc])) if lc else 1
+        F1 = int(_np.prod([lsh[i] for i in lf])) if lf else 1
+        F2 = int(_np.prod([rsh[i] for i in rf])) if rf else 1
+
+        def regroup(name, perm, shape3):
+            t = self.node("Transpose", [name], perm=perm)
+            shp = self.add_const(np.asarray(shape3, np.int64))
+            return self.node("Reshape", [t, shp])
+
+        a = regroup(ins[0], list(lb) + lf + list(lc), [B, F1, C])
+        bb = regroup(ins[1], list(rb) + list(rc) + rf, [B, C, F2])
+        mm = self.node("MatMul", [a, bb])
+        out_shape = self.add_const(np.asarray(
+            eqn.outvars[0].aval.shape, np.int64))
+        return self.node("Reshape", [mm, out_shape])
+
+    def _gather(self, eqn, ins):
+        """jax gather in its embedding/take-along-axis-0 form -> ONNX
+        Gather; anything fancier raises."""
+        dn = eqn.params["dimension_numbers"]
+        op_shape = eqn.invars[0].aval.shape
+        slice_sizes = tuple(eqn.params["slice_sizes"])
+        ok = (tuple(dn.start_index_map) == (0,)
+              and tuple(dn.collapsed_slice_dims) == (0,)
+              and slice_sizes[0] == 1
+              and slice_sizes[1:] == tuple(op_shape[1:]))
+        if not ok:
+            raise NotImplementedError(
+                "onnx.export: general gather (only take-along-axis-0 / "
+                "embedding-style gathers map to Gather) — use StableHLO "
+                "export")
+        # jax index operand carries a trailing index-vector dim of 1
+        idx_shape = eqn.invars[1].aval.shape
+        idx = ins[1]
+        if idx_shape and idx_shape[-1] == 1:
+            shp = self.add_const(np.asarray(idx_shape[:-1], np.int64))
+            idx = self.node("Reshape", [idx, shp])
+        idx64 = self.node("Cast", [idx], to=7)  # Gather wants int64/32
+        return self.node("Gather", [ins[0], idx64], axis=0)
+
     _ELEMENTWISE = {
         "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
         "max": "Max", "min": "Min", "pow": "Pow", "sqrt": "Sqrt",
@@ -216,19 +267,27 @@ class _Converter:
             return out(self.node(self._REDUCE_ATTR[p], ins,
                                  axes=list(params["axes"]),
                                  keepdims=0))
+        if p == "square":
+            return out(self.node("Mul", [ins[0], ins[0]]))
+        if p == "erf":
+            return out(self.node("Erf", ins))
+        if p == "erfc":
+            e = self.node("Erf", ins)
+            one = self.add_const(np.asarray(
+                1.0, np.dtype(eqn.invars[0].aval.dtype)))
+            return out(self.node("Sub", [one, e]))
+        if p == "gather":
+            return out(self._gather(eqn, ins))
         if p == "dot_general":
             ((lc, rc), (lb, rb)) = params["dimension_numbers"]
             lhs_nd = len(eqn.invars[0].aval.shape)
-            ok = (list(lb) == list(range(len(lb)))
-                  and list(rb) == list(range(len(rb)))
-                  and list(lc) == [lhs_nd - 1]
-                  and list(rc) == [len(lb)])
-            if not ok:
-                raise NotImplementedError(
-                    "onnx.export: dot_general layout "
-                    f"{params['dimension_numbers']} (only numpy-matmul "
-                    "layouts map to MatMul)")
-            return out(self.node("MatMul", ins))
+            simple = (list(lb) == list(range(len(lb)))
+                      and list(rb) == list(range(len(rb)))
+                      and list(lc) == [lhs_nd - 1]
+                      and list(rc) == [len(lb)])
+            if simple:
+                return out(self.node("MatMul", ins))
+            return out(self._general_dot(eqn, ins))
         if p == "conv_general_dilated":
             dn = params["dimension_numbers"]
             if (dn.lhs_spec != (0, 1, 2, 3)

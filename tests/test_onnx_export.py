@@ -99,6 +99,25 @@ def _run_onnx(model, feeds):
             o = 1.0 / i[0]
         elif op == "Greater":
             o = i[0] > i[1]
+        elif op == "Less":
+            o = i[0] < i[1]
+        elif op == "GreaterOrEqual":
+            o = i[0] >= i[1]
+        elif op == "LessOrEqual":
+            o = i[0] <= i[1]
+        elif op == "Equal":
+            o = i[0] == i[1]
+        elif op == "Not":
+            o = ~i[0]
+        elif op == "Neg":
+            o = -i[0]
+        elif op == "Erf":
+            o = torch.erf(i[0])
+        elif op == "Gather":
+            o = i[0].index_select(
+                attr(nd, "axis", 0),
+                i[1].reshape(-1)).reshape(
+                    tuple(i[1].shape) + tuple(i[0].shape[1:]))
         elif op == "Where":
             o = torch.where(i[0], i[1], i[2])
         elif op == "Reshape":
@@ -172,6 +191,50 @@ def test_mlp_softmax_onnx(tmp_path):
     net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4),
                         nn.Softmax())
     _export_and_compare(net, (4, 8), tmp_path, "mlp")
+
+
+def test_gpt_onnx_numerics(tmp_path):
+    """Transformer coverage (round 5 extension): GPT lowers through
+    general dot_general (attention einsums -> transpose/reshape/batched
+    MatMul) and embedding gathers; numerics must match eager."""
+    from paddle_tpu.models import GPTModel
+    paddle.seed(3)
+    model = GPTModel.from_config("tiny")
+    model.eval()
+    ids = np.random.RandomState(3).randint(
+        0, 128, (2, 12)).astype(np.int64)
+    golden = model(paddle.to_tensor(ids)).numpy()
+    path = paddle.onnx.export(
+        model, str(tmp_path / "gpt"),
+        input_spec=[static.InputSpec([2, 12], "int64")])
+    out, = _run_onnx(_load(path), [ids])
+    np.testing.assert_allclose(out, golden, rtol=1e-3, atol=2e-4)
+    assert (out.argmax(-1) == golden.argmax(-1)).all()
+
+
+@pytest.mark.slow
+def test_bert_onnx_numerics(tmp_path):
+    from paddle_tpu.models.bert import BertModel
+    paddle.seed(4)
+    model = BertModel.from_config("tiny")
+    model.eval()
+    ids = np.random.RandomState(4).randint(
+        0, 128, (2, 10)).astype(np.int64)
+    golden = model(paddle.to_tensor(ids))[0].numpy()
+
+    class SeqOut(paddle.nn.Layer):
+        def __init__(self, m):
+            super().__init__()
+            self.m = m
+
+        def forward(self, x):
+            return self.m(x)[0]
+
+    path = paddle.onnx.export(
+        SeqOut(model), str(tmp_path / "bert"),
+        input_spec=[static.InputSpec([2, 10], "int64")])
+    out, = _run_onnx(_load(path), [ids])
+    np.testing.assert_allclose(out, golden, rtol=1e-3, atol=2e-4)
 
 
 def test_dynamic_dims_guided(tmp_path):
