@@ -298,8 +298,58 @@ def bench_bert():
                        "optimizer": "AdamW"}}
 
 
+def bench_decode():
+    """Serving decode: fused whole-decode (one dispatch) tok/s at b1,
+    plus the speculative mode's forward count on a repetitive prompt
+    (round 5) — lands in BENCH_MODELS.json only."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTModel
+
+    on_tpu = jax.default_backend() != "cpu"
+    cfg, n_new, reps = ("gpt2-medium", 64, 3) if on_tpu \
+        else ("tiny", 16, 2)
+
+    paddle.seed(0)
+    model = GPTModel.from_config(cfg, dropout=0.0)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+    ids = paddle.to_tensor(np.tile(
+        np.array([11, 22, 33, 44], np.int32), 8)[None, :])
+
+    def timed(mode):
+        """Whole-request latency (prefill + decode), synced EVERY rep
+        so both modes pay identical host round-trips — speculative
+        blocks internally per call, so an end-of-loop-only sync would
+        bias toward fused on a high-latency tunnel."""
+        model.generate(ids, max_new_tokens=n_new,
+                       compiled=mode).numpy()  # compile + settle
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            model.generate(ids, max_new_tokens=n_new,
+                           compiled=mode).numpy()
+        return (time.perf_counter() - t0) / reps
+
+    fused_s = timed("fused")
+    spec_s = timed("speculative")
+
+    # 'generate', not 'decode': each timed request includes the
+    # 32-token prefill dispatch
+    return {"metric": f"generate tokens/sec b1 ({cfg}, fused, "
+                      "incl. prefill)",
+            "value": round(n_new / fused_s, 1), "unit": "tokens/s",
+            "on_tpu": on_tpu,
+            "speculative_tokens_per_sec": round(n_new / spec_s, 1),
+            "speculative_forwards": int(model.last_spec_forwards),
+            "config": {"max_new_tokens": n_new, "batch": 1,
+                       "prompt": "repetitive 32-token"}}
+
+
 CHILD_BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
-                 "bert": bench_bert, "canary": bench_canary}
+                 "bert": bench_bert, "canary": bench_canary,
+                 "decode": bench_decode}
 
 
 def child_main(name, out_path):
@@ -377,7 +427,8 @@ def main():
         return
 
     deadline = time.monotonic() + BUDGET_S
-    names = [args.only] if args.only else ["gpt2", "resnet50", "bert"]
+    names = [args.only] if args.only else ["gpt2", "resnet50", "bert",
+                                           "decode"]
     head_name = "gpt2" if "gpt2" in names else names[0]
 
     # Headline FIRST, printed and flushed the moment it lands — the
@@ -389,6 +440,7 @@ def main():
         "bert": "samples/sec/chip (BERT-base seq-128 fine-tune, "
                 "device-resident)",
         "canary": "tokens/sec/chip (GPT tiny canary)",
+        "decode": "generate tokens/sec b1 (fused, incl. prefill)",
     }[head_name]
 
     # Wedge canary before the expensive headline leg (full runs only —
