@@ -626,12 +626,16 @@ class GPTModel(nn.Layer):
         per-step), so sampled outputs differ run-shape-to-run-shape —
         both are exact samples; only greedy is cross-path identical.
         Rejected-tail cache/sequence slots are overwritten before any
-        later read (the window rewrites from its own start).  B=1 (the
-        latency-serving case; batch rows would advance unevenly).
+        later read (the window rewrites from its own start).  Batches
+        advance SYNCHRONIZED by the per-step minimum accepted count —
+        committed tokens always lie within every row's own accept run,
+        so each row stays exactly its own greedy/sampled trajectory
+        (sync costs speed on divergent rows, never correctness; B=1 is
+        the latency sweet spot).
 
-        Returns (ids [1, max_new], n_forwards) — the second value is
-        the accept-rate diagnostic (forwards == max_new means nothing
-        accepted; forwards ~ max_new/(k+1) at full acceptance).
+        Returns (ids [B, max_new], n_forwards) — the second value is
+        the accept-rate diagnostic (forwards == max_new - 1 means
+        nothing accepted; ~ max_new/(k+1) at full acceptance).
         """
         import jax
         import jax.numpy as jnp
@@ -661,22 +665,25 @@ class GPTModel(nn.Layer):
             return jnp.argmax(row).astype(jnp.int32)
 
         def pure(p_list, b_list, k_bufs, v_bufs, last0, ids_arr, key0):
+            B = ids_arr.shape[0]
             with _swapped(params, dict(zip(pnames, p_list))), \
                     _swapped(mbuffers, dict(zip(bnames, b_list))):
                 with autograd.no_grad():
-                    seq = jnp.zeros((T,), jnp.int32)
+                    seq = jnp.zeros((B, T), jnp.int32)
                     seq = jax.lax.dynamic_update_slice(
-                        seq, ids_arr[0].astype(jnp.int32), (0,))
-                    t0 = pick_row(last0[0],
-                                  jax.random.fold_in(key0, 2 ** 30))
-                    seq = seq.at[start_pos].set(t0)
+                        seq, ids_arr.astype(jnp.int32), (0, 0))
+                    t0_keys = jax.vmap(
+                        lambda r: jax.random.fold_in(
+                            key0, 2 ** 30 + r))(jnp.arange(B))
+                    t0 = jax.vmap(pick_row)(last0, t0_keys)     # [B]
+                    seq = seq.at[:, start_pos].set(t0)
                     win_idx = (jnp.arange(T)[:, None]
                                + jnp.arange(ngram)[None, :])
 
-                    def draft(seq, pos):
+                    def draft_row(srow, pos):
                         pat = jax.lax.dynamic_slice(
-                            seq, (pos - (ngram - 1),), (ngram,))
-                        wins = seq[jnp.clip(win_idx, 0, T - 1)]
+                            srow, (pos - (ngram - 1),), (ngram,))
+                        wins = srow[jnp.clip(win_idx, 0, T - 1)]
                         ok = jnp.all(wins == pat[None, :], axis=1)
                         # occurrences ending strictly before this one
                         ok &= (jnp.arange(T) + ngram - 1) < pos
@@ -684,13 +691,14 @@ class GPTModel(nn.Layer):
                         j = jnp.where(found,
                                       T - 1 - jnp.argmax(ok[::-1]), 0)
                         dstart = jnp.clip(j + ngram, 0, T - draft_k)
-                        d = jax.lax.dynamic_slice(seq, (dstart,),
+                        d = jax.lax.dynamic_slice(srow, (dstart,),
                                                   (draft_k,))
                         # no match: repeat the current token (a guess
                         # like any other — rejection costs nothing
                         # beyond the fixed window forward)
                         return jnp.where(found, d,
-                                         jnp.full((draft_k,), seq[pos]))
+                                         jnp.full((draft_k,),
+                                                  srow[pos]))
 
                     def cond(c):
                         # t0 (from the prefill logits) is already in
@@ -699,24 +707,36 @@ class GPTModel(nn.Layer):
 
                     def body(c):
                         seq, kbs, vbs, pos, n_out, n_fwd = c
-                        cur = jax.lax.dynamic_slice(seq, (pos,), (1,))
-                        d = draft(seq, pos)
-                        w = jnp.concatenate([cur, d])[None, :]
+                        cur = jax.lax.dynamic_slice(seq, (0, pos),
+                                                    (B, 1))
+                        d = jax.vmap(lambda sr: draft_row(sr, pos))(
+                            seq)                            # [B, k]
+                        w = jnp.concatenate([cur, d], axis=1)
                         logits, new_k, new_v = model._decode_window(
                             w, list(kbs), list(vbs), pos)
-                        # per-position keys independent of acceptance:
-                        # kept samples stay true conditional draws
-                        keys = jax.vmap(
-                            lambda i: jax.random.fold_in(
-                                key0, n_fwd * W + i))(jnp.arange(W))
-                        preds = jax.vmap(pick_row)(
-                            logits[0], keys)                # [W]
-                        match = d == preds[:draft_k]
-                        # accepted = length of the True prefix
-                        m = jnp.argmin(jnp.concatenate(
-                            [match, jnp.array([False])]))
+                        # per-(row, position) keys independent of the
+                        # acceptance event: kept samples stay true
+                        # conditional draws
+                        keys = jax.vmap(jax.vmap(
+                            lambda r, i: jax.random.fold_in(
+                                key0, (n_fwd * B + r) * W + i),
+                            in_axes=(None, 0)), in_axes=(0, None))(
+                            jnp.arange(B), jnp.arange(W))
+                        preds = jax.vmap(jax.vmap(pick_row))(
+                            logits, keys)                   # [B, W]
+                        match = d == preds[:, :draft_k]
+                        # per-row accepted prefix; rows advance in sync
+                        # by the batch MINIMUM (committed tokens stay
+                        # within every row's own accept run, so each
+                        # row remains exactly its own greedy/sampled
+                        # trajectory — sync costs speed, not
+                        # correctness)
+                        m_row = jnp.argmin(jnp.concatenate(
+                            [match, jnp.zeros((B, 1), bool)],
+                            axis=1), axis=1)                # [B]
+                        m = jnp.min(m_row)
                         seq = jax.lax.dynamic_update_slice(
-                            seq, preds, (pos + 1,))
+                            seq, preds, (0, pos + 1))
                         adv = m + 1
                         return (seq, tuple(new_k), tuple(new_v),
                                 pos + adv, n_out + adv, n_fwd + 1)
@@ -727,8 +747,9 @@ class GPTModel(nn.Layer):
                             jnp.asarray(0, jnp.int32))
                     seq, _, _, _, _, n_fwd = jax.lax.while_loop(
                         cond, body, init)
-            out = jax.lax.dynamic_slice(seq, (start_pos,), (max_new,))
-            return out[None, :].astype(out_dtype), n_fwd
+            out = jax.lax.dynamic_slice(seq, (0, start_pos),
+                                        (B, max_new))
+            return out.astype(out_dtype), n_fwd
 
         fn = jax.jit(pure)
         if len(cache) >= 8:  # FIFO bound, matching the other caches
@@ -844,9 +865,10 @@ class GPTModel(nn.Layer):
         window shapes), and sampling draws exact conditional samples
         via per-position keys + equality acceptance (a different random
         stream than 'fused', so sampled tokens differ between the two
-        modes — both exact).  B=1; ``draft_k``/``lookup_ngram`` tune
-        the draft window.  Accept-rate diagnostic:
-        ``self.last_spec_forwards``.
+        modes — both exact).  Batches advance by the per-step minimum
+        accepted count (each row stays its own exact trajectory);
+        ``draft_k``/``lookup_ngram`` tune the draft window.
+        Accept-rate diagnostic: ``self.last_spec_forwards``.
         Returns [B, S + new] ids.
         """
         import jax
@@ -894,11 +916,6 @@ class GPTModel(nn.Layer):
                 key = rng_mod.key_for(seed)
 
                 if compiled == "speculative":
-                    if b != 1:
-                        raise ValueError(
-                            "generate(compiled='speculative'): B=1 "
-                            "only — batch rows accept at different "
-                            "rates and would advance unevenly")
                     if s + max_new_tokens + draft_k > max_position:
                         raise ValueError(
                             "generate(compiled='speculative'): the "
