@@ -466,10 +466,12 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
 
 def case(pred_fn_pairs, default=None, name=None):
     import jax
+    from .program import Variable
     for i, (pred, fn) in enumerate(pred_fn_pairs):
         p = _unwrap_cf(pred)
-        if isinstance(p, jax.core.Tracer):
-            # chain into nested lax.cond
+        if isinstance(pred, Variable) or isinstance(p, jax.core.Tracer):
+            # symbolic predicate (recorded Program or jit trace):
+            # chain through cond, which handles both regimes
             rest = pred_fn_pairs[i + 1:]
             if rest:
                 nxt = lambda: case(rest, default)  # noqa: E731
@@ -477,8 +479,8 @@ def case(pred_fn_pairs, default=None, name=None):
                 nxt = default
             else:
                 raise ValueError(
-                    "case under jit requires a default branch (lax.cond "
-                    "needs an else)")
+                    "case with a symbolic predicate requires a default "
+                    "branch (lax.cond needs an else)")
             return cond(pred, fn, nxt)
         if bool(p):
             return fn()
@@ -496,6 +498,15 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
         # reference semantics (fluid/layers/control_flow.py switch_case):
         # without a default, the LAST branch serves as the default
         default = fns[keys[-1]]
+    from .program import Variable
+    if isinstance(branch_index, Variable):
+        # record-mode Program: equality-chained record-capable conds.
+        # When the default was auto-filled from the LAST branch, skip
+        # that branch's own equality test — it would record the same
+        # subgraph twice as both arms of the final cond
+        chain_keys = keys[:-1] if default is fns[keys[-1]] else keys
+        pairs = [(branch_index == k, fns[k]) for k in chain_keys]
+        return case(pairs, default)
     if not isinstance(idx, jax.core.Tracer):
         return fns.get(int(idx), default)()
     branches = [lambda _, f=fns[k]: jax.tree_util.tree_map(

@@ -283,3 +283,46 @@ class TestWhileInProgram:
                     bool(f)
         finally:
             paddle.disable_static()
+
+
+def test_case_and_switch_case_in_program():
+    """case/switch_case inside a recorded Program route through the
+    record-capable cond chain (round 5)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 2])
+            sel = static.data("sel", [1], dtype="int32")
+            sw = static.nn.switch_case(
+                sel, {0: lambda: paddle.scale(x, 1.0),
+                      2: lambda: paddle.scale(x, 2.0),
+                      5: lambda: paddle.scale(x, 5.0)})
+            big = static.nn.case(
+                [(paddle.greater_than(paddle.sum(x),
+                                      paddle.to_tensor(10.0)),
+                  lambda: paddle.scale(x, 100.0))],
+                default=lambda: x)
+            exe = static.Executor()
+            ones = np.ones((2, 2), np.float32)
+            for s_, want in ((0, 1.0), (2, 2.0), (5, 5.0), (7, 5.0)):
+                v, = exe.run(main,
+                             feed={"x": ones,
+                                   "sel": np.array([s_], np.int32)},
+                             fetch_list=[sw])
+                assert float(np.asarray(v)[0, 0]) == want
+            v_small, = exe.run(main, feed={"x": ones,
+                                           "sel": np.array([0],
+                                                           np.int32)},
+                               fetch_list=[big])
+            assert float(np.asarray(v_small)[0, 0]) == 1.0
+            v_big, = exe.run(main,
+                             feed={"x": ones * 5,
+                                   "sel": np.array([0], np.int32)},
+                             fetch_list=[big])
+            assert float(np.asarray(v_big)[0, 0]) == 500.0
+    finally:
+        paddle.disable_static()
