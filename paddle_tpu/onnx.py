@@ -173,6 +173,9 @@ class _Converter:
     }
     _COMPARE = {"gt": "Greater", "lt": "Less", "ge": "GreaterOrEqual",
                 "le": "LessOrEqual", "eq": "Equal"}
+    # jax reuses and/or/xor/not for BITWISE integer ops; ONNX
+    # And/Or/Xor/Not are bool-only, so the mapping is dtype-gated
+    _LOGICAL = {"and": "And", "or": "Or", "xor": "Xor", "not": "Not"}
     _REDUCE_ATTR = {"reduce_max": "ReduceMax", "reduce_min": "ReduceMin",
                     "reduce_prod": "ReduceProd"}
 
@@ -194,6 +197,13 @@ class _Converter:
             return out(self.node(self._ELEMENTWISE[p], ins))
         if p in self._COMPARE:
             return out(self.node(self._COMPARE[p], ins))
+        if p in self._LOGICAL:
+            if any(str(v.aval.dtype) != "bool" for v in eqn.invars):
+                raise NotImplementedError(
+                    f"onnx.export: bitwise integer '{p}' has no "
+                    "opset-13 mapping (ONNX And/Or/Xor/Not are "
+                    "bool-only) — use StableHLO export")
+            return out(self.node(self._LOGICAL[p], ins))
         if p == "ne":
             eq_out = self.node("Equal", ins)
             return out(self.node("Not", [eq_out]))

@@ -109,6 +109,12 @@ def _run_onnx(model, feeds):
             o = i[0] == i[1]
         elif op == "Not":
             o = ~i[0]
+        elif op == "And":
+            o = i[0] & i[1]
+        elif op == "Or":
+            o = i[0] | i[1]
+        elif op == "Xor":
+            o = i[0] ^ i[1]
         elif op == "Neg":
             o = -i[0]
         elif op == "Erf":
@@ -256,3 +262,45 @@ def test_unmapped_primitive_guided(tmp_path):
         paddle.onnx.export(Sorty(), str(tmp_path / "s"),
                            input_spec=[static.InputSpec([4, 4],
                                                         "float32")])
+
+
+def test_logical_ops_onnx(tmp_path):
+    """bool And/Or/Xor/Not export end-to-end; bitwise int forms keep
+    the guided raise (ONNX logical ops are bool-only)."""
+    from paddle_tpu import nn
+
+    class Logic(nn.Layer):
+        def forward(self, x):
+            a = x > 0.5
+            b = x < 0.8
+            both = paddle.logical_and(a, b)
+            either = paddle.logical_or(a, b)
+            odd = paddle.logical_xor(a, b)
+            keep = paddle.logical_and(paddle.logical_not(odd), either)
+            return paddle.cast(both, "float32") \
+                + paddle.cast(keep, "float32")
+
+    _export_and_compare(Logic(), (3, 5), tmp_path, "logic")
+
+    class BitwiseInt(nn.Layer):
+        def forward(self, x):
+            xi = paddle.cast(x, "int32")
+            return paddle.bitwise_and(xi, xi)
+
+    with pytest.raises(NotImplementedError, match="bool-only"):
+        paddle.onnx.export(BitwiseInt(), str(tmp_path / "bw"),
+                           input_spec=[static.InputSpec([2, 2],
+                                                        "float32")])
+
+
+@pytest.mark.slow
+def test_mobilenet_v2_onnx_numerics(tmp_path):
+    """Depthwise (grouped) convolutions + inverted residuals."""
+    from paddle_tpu.vision.models import mobilenet_v2
+    paddle.seed(5)
+    m = _export_and_compare(mobilenet_v2(num_classes=10),
+                            (1, 3, 32, 32), tmp_path, "mbv2",
+                            atol=5e-4)
+    groups = [a.i for n in m.graph.node if n.op_type == "Conv"
+              for a in n.attribute if a.name == "group"]
+    assert any(g > 1 for g in groups)  # depthwise convs exported
