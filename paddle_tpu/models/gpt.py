@@ -356,6 +356,13 @@ class GPTModel(nn.Layer):
                  moe_every=2, fused_loss=False, recompute_policy=None,
                  use_sp=False, fused_loss_chunk=128, scan_layers=False):
         super().__init__()
+        # decode-twin reconstruction needs the dense hyperparams
+        # (scan_layers forbids mp/sp/moe, so these suffice)
+        self._init_config = dict(
+            num_layers=num_layers, hidden_size=hidden_size,
+            num_heads=num_heads, vocab_size=vocab_size,
+            max_position=max_position, dropout=dropout,
+            fused_loss=fused_loss, fused_loss_chunk=fused_loss_chunk)
         self.fused_loss = fused_loss
         # sequence-chunk size of the fused head+CE scan: larger chunks =
         # fewer scan iterations and bigger matmuls, more live logits HBM
@@ -421,9 +428,9 @@ class GPTModel(nn.Layer):
             if caches is not None:
                 raise NotImplementedError(
                     "scan_layers covers the training/forward path; "
-                    "KV-cache decode uses the unrolled model "
-                    "(state_dicts interconvert by stacking/unstacking "
-                    "the block leaves)")
+                    "for KV-cache decode call generate(), which serves "
+                    "through an auto-synced unrolled twin "
+                    "(_sync_decode_twin)")
             # packed mode rides along: doc_segments is a scan-invariant
             # extra broadcast to every layer (the cache slot stays None,
             # and ScanLayers drops None extras while keeping positions)
@@ -877,10 +884,20 @@ class GPTModel(nn.Layer):
         from ..core.tensor import Tensor as T
 
         if self.scan_layers:
-            raise NotImplementedError(
-                "generate() needs per-block KV caches — decode with the "
-                "unrolled model (scan and unrolled state_dicts "
-                "interconvert by stacking/unstacking the block leaves)")
+            # decode needs per-block KV caches; serve through an
+            # auto-synced unrolled twin (round 5) — weights are sliced
+            # views of the stacked params, re-synced every call so a
+            # freshly-trained scan model decodes its current weights
+            twin = self._sync_decode_twin()
+            out = twin.generate(
+                input_ids, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_token_id=eos_token_id, seed=seed,
+                compiled=compiled, draft_k=draft_k,
+                lookup_ngram=lookup_ngram)
+            self.last_spec_forwards = getattr(
+                twin, "last_spec_forwards", None)
+            return out
         ids = input_ids._data if hasattr(input_ids, "_data") else \
             jnp.asarray(input_ids)
         b, s = ids.shape
@@ -1043,6 +1060,48 @@ class GPTModel(nn.Layer):
             if was_training:
                 self.train()
         return T(jnp.concatenate(out, axis=1))
+
+    def _sync_decode_twin(self):
+        """Unrolled twin for KV-cache decode of a scan_layers model:
+        built once from the stored dense hyperparams, then every call
+        re-points its tensors at the live weights DEVICE-SIDE — block
+        leaves become lazy slices of the stacked arrays, non-block
+        tensors are shared by reference (the ``param._data =``
+        re-pointing idiom of ``parallel/pipeline.py
+        unstack_block_params``; no host round-trip, unlike
+        set_state_dict).  The twin lives in ``__dict__`` directly so it
+        never registers as a sublayer — the scan model's
+        state_dict/parameters stay twin-free.  Slice views cost a
+        second set of block params in HBM while the twin is alive;
+        drop it with ``del model.__dict__['_decode_twin_obj']``."""
+        twin = self.__dict__.get("_decode_twin_obj")
+        if twin is None:
+            twin = GPTModel(**self._init_config, scan_layers=False)
+            twin.eval()
+            self.__dict__["_decode_twin_obj"] = twin
+        L = int(self._init_config["num_layers"])
+        twin_map = dict(twin.named_parameters())
+        twin_map.update(dict(twin.named_buffers()))
+        src_map = dict(self.named_parameters())
+        src_map.update(dict(self.named_buffers()))
+        synced = set()
+        for k, v in src_map.items():
+            if k.startswith("blocks."):
+                rest = k[len("blocks."):]
+                for i in range(L):
+                    tk = f"blocks.{i}.{rest}"
+                    twin_map[tk]._data = v._data[i]  # KeyError = loud
+                    synced.add(tk)
+            else:
+                twin_map[k]._data = v._data
+                synced.add(k)
+        leftover = set(twin_map) - synced
+        if leftover:
+            raise RuntimeError(
+                "decode twin has tensors the scan model never synced "
+                f"(stale random init would decode garbage): "
+                f"{sorted(leftover)[:5]}")
+        return twin
 
     @classmethod
     def from_config(cls, name, **overrides):

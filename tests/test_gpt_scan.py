@@ -115,8 +115,11 @@ def test_dropout_trains_and_is_seeded():
 
 def test_unsupported_paths_raise():
     _, scan = _pair(dropout=0.0)
-    with pytest.raises(NotImplementedError):
-        scan.generate(np.zeros((1, 4), np.int32), max_new_tokens=2)
+    # generate() WORKS since round 5 (decode twin); direct cache feeds
+    # still raise with the twin pointer
+    with pytest.raises(NotImplementedError, match="twin"):
+        scan(paddle.to_tensor(np.zeros((1, 4), np.int32)),
+             caches=[None, None])
     with pytest.raises(ValueError):
         GPTModel.from_config("tiny", scan_layers=True, use_mp=True)
     # packed mode is SUPPORTED under scan since round 4
@@ -239,3 +242,41 @@ def test_stacked_names_stay_dotted_for_decay_masks():
 
     # a mask mismatch shows up as diverging trajectories at wd=0.5
     np.testing.assert_allclose(run(False), run(True), rtol=1e-4)
+
+
+def test_scan_generate_via_decode_twin():
+    """generate() on a scan_layers model (round 5): the auto-synced
+    unrolled twin makes every compiled decode mode work, tokens equal
+    the seed-identical unrolled model's, and the twin follows weight
+    updates."""
+    unrolled, scan = _pair(dropout=0.0)
+    unrolled.eval()
+    scan.eval()
+    ids = np.random.RandomState(0).randint(0, 128, (2, 8)).astype(
+        np.int32)
+    n_state = len(scan.state_dict())
+    a = scan.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy()
+    # the twin must NOT register as a sublayer (checkpoints would
+    # double; optimizers built afterwards would grab twin params)
+    assert len(scan.state_dict()) == n_state
+    b = unrolled.generate(paddle.to_tensor(ids),
+                          max_new_tokens=6).numpy()
+    np.testing.assert_array_equal(a, b)
+    f = scan.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                      compiled="fused").numpy()
+    np.testing.assert_array_equal(a, f)
+    s = scan.generate(paddle.to_tensor(ids[:1]), max_new_tokens=6,
+                      compiled="speculative").numpy()
+    np.testing.assert_array_equal(f[:1], s)
+    assert scan.last_spec_forwards >= 1
+
+    # the twin re-syncs: perturb a stacked leaf with NOISE (a constant
+    # shift would sit in LayerNorm's null space — zero-mean inputs eat
+    # x @ (W + c)), outputs must change
+    name, p = next((n, p) for n, p in scan.named_parameters()
+                   if n.startswith("blocks.") and "qkv" in n)
+    import jax.numpy as jnp
+    noise = np.random.RandomState(1).randn(*p.shape).astype("float32")
+    p._data = p._data + 0.2 * jnp.asarray(noise)
+    c = scan.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy()
+    assert not np.array_equal(a, c)
