@@ -316,9 +316,10 @@ class GPTScanBlocks(ScanLayers):
     seed, training parity is exact (``tests/test_gpt_scan.py``), and
     the 1.3B full-step XLA compile drops 212-460s -> 18.6s on the CPU
     rehearsal (BASELINE.md round 3).  Scope: the dense AND packed
-    (doc_segments flash-masked) training/forward paths — KV-cache
-    decode, tensor/sequence parallel and MoE variants stay on the
-    unrolled form (their blocks are not homogeneous scan bodies)."""
+    (doc_segments flash-masked) training/forward paths; KV-cache
+    decode serves through ``GPTModel._sync_decode_twin`` (round 5).
+    Tensor/sequence parallel and MoE variants stay on the unrolled
+    form (their blocks are not homogeneous scan bodies)."""
 
     def __init__(self, num_layers, hidden_size, num_heads, dropout=0.1,
                  use_recompute=False, recompute_policy=None):
