@@ -401,3 +401,33 @@ class TestSpeculativeDecode:
         with pytest.raises(ValueError, match="max_position|draft_k"):
             model.generate(paddle.to_tensor(one), max_new_tokens=50,
                            compiled="speculative", draft_k=16)
+
+
+def test_speculative_composes_with_weight_only_int8():
+    """The full serving stack: weight-only int8 codes thread through
+    the speculative while_loop as buffers (not baked constants), and
+    int8 speculative greedy equals int8 fused greedy."""
+    import jax.numpy as jnp
+    from paddle_tpu.quantization import quantize_weights_int8
+    paddle.seed(9)
+    model = GPTModel.from_config("tiny", dropout=0.0, max_position=256)
+    model.eval()
+    quantize_weights_int8(model)
+    ids = np.zeros((2, 12), np.int32)
+    fused = model.generate(paddle.to_tensor(ids), max_new_tokens=12,
+                           compiled="fused").numpy()
+    spec = model.generate(paddle.to_tensor(ids), max_new_tokens=12,
+                          compiled="speculative").numpy()
+    np.testing.assert_array_equal(fused, spec)
+    # buffers, not baked constants: mutate a quantized-code buffer and
+    # the SAME cached executable must produce different tokens
+    name, buf = next((n, b) for n, b in model.named_buffers()
+                     if "int8" in str(b._data.dtype))
+    rs = np.random.RandomState(0)
+    buf._data = jnp.asarray(rs.randint(
+        -127, 128, buf._data.shape).astype(np.int8))
+    n_exec = len(model._spec_fn_cache)
+    spec2 = model.generate(paddle.to_tensor(ids), max_new_tokens=12,
+                           compiled="speculative").numpy()
+    assert len(model._spec_fn_cache) == n_exec  # no retrace
+    assert not np.array_equal(spec, spec2), name
