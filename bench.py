@@ -347,9 +347,71 @@ def bench_decode():
                        "prompt": "repetitive 32-token"}}
 
 
+def bench_serving():
+    """serving_throughput: aggregate decode tokens/sec, sequential
+    per-request generate(compiled=True) vs the continuous-batching
+    engine (serving.Engine, fixed slot pool) on staggered concurrent
+    requests.  Lands in BENCH_MODELS.json only."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTModel
+    from paddle_tpu.serving import Engine
+
+    on_tpu = jax.default_backend() != "cpu"
+    cfg, n_new, n_requests = ("gpt2-medium", 32, 8) if on_tpu \
+        else ("tiny", 16, 8)
+
+    paddle.seed(0)
+    model = GPTModel.from_config(cfg, dropout=0.0)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+    vocab = model.embeddings.word_embeddings.weight.shape[0]
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, vocab, (int(l),)).astype(np.int32)
+               for l in rng.randint(8, 16, n_requests)]
+
+    # warm every distinct prompt length so neither leg times compiles
+    # (a full-length warm prompt per s: slicing prompts[0] would
+    # silently truncate at its own length and leave longer programs
+    # compiling inside the timed window)
+    warm = {s: rng.randint(0, vocab, (s,)).astype(np.int32)
+            for s in sorted({len(p) for p in prompts})}
+    for w in warm.values():
+        model.generate(paddle.to_tensor(w[None, :]),
+                       max_new_tokens=n_new, compiled=True).numpy()
+    t0 = time.perf_counter()
+    for p in prompts:
+        model.generate(paddle.to_tensor(p[None, :]),
+                       max_new_tokens=n_new, compiled=True).numpy()
+    seq_tps = n_requests * n_new / (time.perf_counter() - t0)
+
+    engine = Engine(model, num_slots=4)
+    # warm the slot-batched decode + slot prefills for every length
+    for w in warm.values():
+        engine.submit(w, max_new_tokens=2)
+    engine.run_until_idle()
+    t0 = time.perf_counter()
+    reqs = [engine.submit(p, max_new_tokens=n_new) for p in prompts]
+    engine.run_until_idle()
+    for r in reqs:
+        r.result(timeout=1)
+    eng_tps = n_requests * n_new / (time.perf_counter() - t0)
+
+    return {"metric": f"serving aggregate tokens/sec ({cfg}, "
+                      "4-slot continuous batching)",
+            "value": round(eng_tps, 1), "unit": "tokens/s",
+            "on_tpu": on_tpu,
+            "sequential_tokens_per_sec": round(seq_tps, 1),
+            "speedup_vs_sequential": round(eng_tps / seq_tps, 2),
+            "config": {"num_slots": 4, "requests": n_requests,
+                       "max_new_tokens": n_new}}
+
+
 CHILD_BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
                  "bert": bench_bert, "canary": bench_canary,
-                 "decode": bench_decode}
+                 "decode": bench_decode, "serving": bench_serving}
 
 
 def child_main(name, out_path):
@@ -428,7 +490,7 @@ def main():
 
     deadline = time.monotonic() + BUDGET_S
     names = [args.only] if args.only else ["gpt2", "resnet50", "bert",
-                                           "decode"]
+                                           "decode", "serving"]
     head_name = "gpt2" if "gpt2" in names else names[0]
 
     # Headline FIRST, printed and flushed the moment it lands — the
@@ -441,6 +503,7 @@ def main():
                 "device-resident)",
         "canary": "tokens/sec/chip (GPT tiny canary)",
         "decode": "generate tokens/sec b1 (fused, incl. prefill)",
+        "serving": "serving aggregate tokens/sec (continuous batching)",
     }[head_name]
 
     # Wedge canary before the expensive headline leg (full runs only —

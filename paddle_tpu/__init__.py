@@ -94,6 +94,8 @@ from . import distribution  # noqa: E402,F401
 from . import text  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
+from . import monitor  # noqa: E402,F401
+from . import serving  # noqa: E402,F401
 from .framework.io import save, load  # noqa: E402,F401
 from .static import (enable_static, disable_static,  # noqa: E402,F401
                      in_dynamic_mode)

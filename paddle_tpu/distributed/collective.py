@@ -20,7 +20,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec
-from jax import shard_map
+try:  # jax>=0.5 moved shard_map to jax.*
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
 
 from ..core.tensor import Tensor
 from ..core.dispatch import ensure_tensor

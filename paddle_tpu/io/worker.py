@@ -333,6 +333,9 @@ class MultiprocessMapIter:
         self.max_inflight = max(
             2, loader.prefetch_factor * loader.num_workers)
         self.timeout = loader.timeout or None
+        from .. import monitor
+        self._batch_counter = monitor.counter(
+            "io.batches", "batches consumed from worker pools")
         while self.next_submit < self.total and \
                 self.inflight < self.max_inflight:
             self._submit()
@@ -399,6 +402,7 @@ class MultiprocessMapIter:
             batch.release()
         else:
             data = _unflatten(spec, arrays)
+        self._batch_counter.inc()
         return data
 
 
@@ -413,6 +417,9 @@ class MultiprocessIterableIter:
         self.procs = []
         self.done_ids = set()
         self.timeout = loader.timeout or None
+        from .. import monitor
+        self._batch_counter = monitor.counter(
+            "io.batches", "batches consumed from worker pools")
         for wid in range(loader.num_workers):
             p = self.ctx.Process(
                 target=_iterable_worker_loop,
@@ -470,6 +477,7 @@ class MultiprocessIterableIter:
                 batch.release()
             else:
                 data = _unflatten(spec, arrays)
+            self._batch_counter.inc()
             return data
 
     def _shutdown(self):

@@ -176,6 +176,59 @@ class GPTAttention(nn.Layer):
             out = self.out_proj(out)
         return out, k_buf, v_buf
 
+    def decode_slots(self, x, k_buf, v_buf, pos):
+        """One-token decode with PER-SLOT positions (continuous
+        batching, serving/engine.py): each batch row is an independent
+        request slot at its own sequence position, so the cache write
+        and the causal mask are per-row.  Same f32 score math as
+        ``decode`` — row b of a slot batch computes exactly what a B=1
+        ``decode`` at ``pos[b]`` computes, which is what makes the
+        serving engine token-identical to per-request ``generate()``.
+
+        x: Tensor [B, 1, E]; k_buf/v_buf: [B, L, H, hd] arrays;
+        pos: int32 [B] (per-slot write position).  Returns
+        (out Tensor [B, 1, E], k_buf, v_buf).
+        """
+        import math as _math
+        import jax
+        import jax.numpy as jnp
+
+        if x.shape[1] != 1:
+            raise ValueError(
+                f"decode_slots is a one-token step (got S={x.shape[1]});"
+                " windowed decode keeps the shared-position decode()")
+        if self.use_mp:
+            q, k, v = self._qkv_mp(x)
+        else:
+            b = x.shape[0]
+            qkv = self.qkv_proj(x)
+            qkv = reshape(qkv, [b, 1, 3, self.num_heads, self.head_dim])
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        qa, ka, va = q._data, k._data, v._data
+        B = qa.shape[0]
+        rows = jnp.arange(B)
+        k_buf = k_buf.at[rows, pos].set(ka[:, 0].astype(k_buf.dtype))
+        v_buf = v_buf.at[rows, pos].set(va[:, 0].astype(v_buf.dtype))
+        scale = 1.0 / _math.sqrt(self.head_dim)
+        scores = jnp.einsum("bqhd,bkhd->bhqk",
+                            qa.astype(jnp.float32),
+                            k_buf.astype(jnp.float32)) * scale
+        L = k_buf.shape[1]
+        visible = jnp.arange(L)[None, :] <= pos[:, None]       # [B, L]
+        scores = jnp.where(visible[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                         v_buf.astype(jnp.float32)).astype(qa.dtype)
+        out = Tensor(ctx)
+        if self.use_mp:
+            from ..ops import einsum
+            out = einsum("bshd,hde->bse", out, self.out_weight) + \
+                self.out_bias
+        else:
+            out = reshape(out, [B, 1, self.num_heads * self.head_dim])
+            out = self.out_proj(out)
+        return out, k_buf, v_buf
+
     def forward(self, x, cache=None, doc_segments=None):
         b, s, _ = x.shape
         if doc_segments is not None and self.use_sp and cache is None:
@@ -290,6 +343,14 @@ class GPTBlock(nn.Layer):
         """Fixed-buffer one-token decode (see GPTAttention.decode)."""
         attn_out, k_buf, v_buf = self.attn.decode(self.ln1(x), k_buf,
                                                   v_buf, pos)
+        x = x + attn_out
+        x = x + self.mlp(self.ln2(x))
+        return x, k_buf, v_buf
+
+    def decode_slots(self, x, k_buf, v_buf, pos):
+        """Per-slot-position one-token decode (GPTAttention.decode_slots)."""
+        attn_out, k_buf, v_buf = self.attn.decode_slots(self.ln1(x),
+                                                        k_buf, v_buf, pos)
         x = x + attn_out
         x = x + self.mlp(self.ln2(x))
         return x, k_buf, v_buf
@@ -519,6 +580,59 @@ class GPTModel(nn.Layer):
             new_k.append(kb)
             new_v.append(vb)
         return self.head(x)._data, new_k, new_v
+
+    def _decode_tick_slots(self, tok, k_bufs, v_bufs, pos):
+        """One-token decode over a SLOT POOL: like ``_decode_tick`` but
+        ``pos`` is int32 [B] — every batch row is an independent request
+        at its own position (continuous batching; serving/engine.py).
+        Returns (last_logits [B, V], new_k, new_v)."""
+        import jax.numpy as jnp
+        pos = jnp.asarray(pos, jnp.int32)
+        x = self.embeddings(Tensor(tok), position_ids=Tensor(pos[:, None]))
+        new_k, new_v = [], []
+        for j, blk in enumerate(self.blocks):
+            x, kb, vb = blk.decode_slots(x, k_bufs[j], v_bufs[j], pos)
+            new_k.append(kb)
+            new_v.append(vb)
+        return self.head(x)._data[:, -1, :], new_k, new_v
+
+    def _compiled_slot_decode_fn(self, pnames, params, cache_key):
+        """Build (or fetch) the jitted SLOT-POOL decode step: (p_list,
+        b_list, k_bufs, v_bufs, tok [B,1], pos [B]) -> (last_logits
+        [B,V], k_bufs, v_bufs).  The continuous-batching twin of
+        ``_compiled_decode_fn``: B is the fixed slot-pool size, each row
+        decodes at its own position, and ONE XLA program serves every
+        engine tick regardless of which slots are live (inactive rows
+        compute harmlessly into their own cache rows, which admission
+        prefill overwrites wholesale).  K/V pools are donated —
+        in-place update, no per-tick copy."""
+        import jax
+        from ..core import autograd
+        from ..jit import _swapped
+
+        cache = getattr(self, "_slot_decode_fn_cache", None)
+        if cache is None:
+            cache = self._slot_decode_fn_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        model = self
+        mbuffers = dict(self.named_buffers())
+        bnames = sorted(mbuffers)
+
+        def pure(p_list, b_list, k_bufs, v_bufs, tok, pos):
+            with _swapped(params, dict(zip(pnames, p_list))), \
+                    _swapped(mbuffers, dict(zip(bnames, b_list))):
+                with autograd.no_grad():
+                    last, new_k, new_v = model._decode_tick_slots(
+                        tok, k_bufs, v_bufs, pos)
+            return last, new_k, new_v
+
+        fn = jax.jit(pure, donate_argnums=(2, 3))
+        if len(cache) >= 8:  # FIFO bound, matching the other decode caches
+            cache.pop(next(iter(cache)))
+        cache[cache_key] = (fn, bnames, mbuffers)
+        return cache[cache_key]
 
     def _fused_generate_fn(self, pnames, params, cache_key, n_steps,
                            start_pos, do_sample, temperature, top_k,
@@ -806,6 +920,60 @@ class GPTModel(nn.Layer):
 
         fn = jax.jit(pure)
         if len(cache) >= 8:  # FIFO bound, matching _gen_fn_cache
+            cache.pop(next(iter(cache)))
+        cache[cache_key] = (fn, bnames, mbuffers)
+        return cache[cache_key]
+
+    def _compiled_bucket_prefill_fn(self, pnames, params, cache_key, b,
+                                    S, L, nh, hd, kv_dtype):
+        """Build (or fetch) the jitted BUCKETED prefill: (p_list,
+        b_list, ids [B, S], true_len) -> (last_logits [B, V] at
+        position true_len-1, k_bufs, v_bufs padded to L).  The serving
+        engine's compile-bound variant of ``_compiled_prefill_fn``:
+        prompts are right-padded up to bucket length S, so one XLA
+        program serves EVERY prompt length in the bucket (true_len is a
+        traced scalar).  Right padding is parity-safe under the causal
+        mask — positions < true_len never see the pad tail, and the
+        garbage cache rows past true_len are each overwritten by decode
+        before any query can attend to them."""
+        import jax
+        import jax.numpy as jnp
+        from ..core import autograd
+        from ..jit import _swapped
+
+        cache = getattr(self, "_bucket_prefill_fn_cache", None)
+        if cache is None:
+            cache = self._bucket_prefill_fn_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        model = self
+        mbuffers = dict(self.named_buffers())
+        bnames = sorted(mbuffers)
+
+        def pure(p_list, b_list, ids_arr, true_len):
+            with _swapped(params, dict(zip(pnames, p_list))), \
+                    _swapped(mbuffers, dict(zip(bnames, b_list))):
+                with autograd.no_grad():
+                    empty = [(Tensor(jnp.zeros((b, 0, nh, hd),
+                                               kv_dtype)),
+                              Tensor(jnp.zeros((b, 0, nh, hd),
+                                               kv_dtype)))
+                             for _ in model.blocks]
+                    logits, caches = model.forward(Tensor(ids_arr),
+                                                   caches=empty)
+                    pad = ((0, 0), (0, L - S), (0, 0), (0, 0))
+                    k_bufs = [jnp.pad(ck._data, pad) for ck, _ in caches]
+                    v_bufs = [jnp.pad(cv._data, pad) for _, cv in caches]
+                    # the real prompt's last logits, not the pad tail's
+                    V = logits._data.shape[-1]
+                    last = jax.lax.dynamic_slice(
+                        logits._data, (0, true_len - 1, 0),
+                        (b, 1, V))[:, 0]
+            return last, k_bufs, v_bufs
+
+        fn = jax.jit(pure)
+        if len(cache) >= 8:  # FIFO bound, matching _prefill_fn_cache
             cache.pop(next(iter(cache)))
         cache[cache_key] = (fn, bnames, mbuffers)
         return cache[cache_key]

@@ -1,0 +1,39 @@
+"""paddle_tpu.monitor — runtime counters/gauges/histograms + Prometheus
+text exposition.
+
+Reference parity: ``platform/monitor.h`` ``StatValue``/``StatRegistry``
+(+ the STAT_ADD/STAT_SUB macros) — see stats.py.  Consumers: the
+serving engine (queue depth, slot occupancy, tokens/sec, TTFT/TPOT),
+the compiled train step (step counters/latency), and the DataLoader
+worker pool (batches consumed).  Pure stdlib — safe in fork'd worker
+processes and HTTP handler threads; no jax import.
+"""
+from .stats import (  # noqa: F401
+    Counter, Gauge, Histogram, StatValue, StatRegistry, RateMeter,
+    DEFAULT_BUCKETS, default_registry, sanitize_name,
+    stat_add, stat_sub, stat_get,
+)
+from .exposition import render_prometheus  # noqa: F401
+
+
+def counter(name, help=""):
+    """Get-or-create a Counter in the default registry."""
+    return default_registry().counter(name, help)
+
+
+def gauge(name, help=""):
+    """Get-or-create a Gauge in the default registry."""
+    return default_registry().gauge(name, help)
+
+
+def histogram(name, help="", buckets=DEFAULT_BUCKETS):
+    """Get-or-create a Histogram in the default registry."""
+    return default_registry().histogram(name, help, buckets=buckets)
+
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "StatValue", "StatRegistry",
+    "RateMeter", "DEFAULT_BUCKETS", "default_registry", "sanitize_name",
+    "stat_add", "stat_sub", "stat_get", "render_prometheus",
+    "counter", "gauge", "histogram",
+]

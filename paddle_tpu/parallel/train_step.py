@@ -83,6 +83,14 @@ class TrainStep:
             self.grad_merge_k = int(
                 s.gradient_merge_configs.get("k_steps", 1))
 
+        # metric handles resolved once — step() is the hot path
+        from .. import monitor
+        self._m_steps = monitor.counter("train.steps",
+                                        "TrainStep.step calls")
+        self._m_step_time = monitor.histogram(
+            "train.step_time_ms",
+            "host-side dispatch time per train step (ms)")
+
         self.is_pipeline = isinstance(model, PipelineLayer) and \
             self.mesh.shape.get("pp", 1) > 1
         if self.is_pipeline:
@@ -535,6 +543,8 @@ class TrainStep:
 
     def step(self, inputs, labels=()):
         """Run one optimization step on a global batch."""
+        import time as _time
+        t0 = _time.perf_counter()
         in_arrays, lab_arrays = self._place_inputs(inputs, labels)
         key = rng_mod.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
@@ -562,6 +572,11 @@ class TrainStep:
                 self.params, self.buffers, self.opt_state, lr, key,
                 in_arrays, lab_arrays)
         self.optimizer._step_count += 1
+        # dispatch-side step accounting (monitor registry; the step is
+        # async, so the histogram measures host dispatch latency — a
+        # compile lands in the first observation's tail bucket)
+        self._m_steps.inc()
+        self._m_step_time.observe((_time.perf_counter() - t0) * 1e3)
         return Tensor(loss)
 
     def aot_compile(self, inputs, labels=()):

@@ -1,0 +1,21 @@
+"""paddle_tpu.serving — continuous-batching inference engine.
+
+The serving workload class (ROADMAP: "serve heavy traffic from millions
+of users"): an in-process ``Engine`` runs ONE jitted one-token decode
+step over a fixed pool of batch slots, a ``Scheduler`` admits queued
+requests into free slots (prefill on admission, eviction on EOS /
+max_new_tokens), a ``RequestQueue`` enforces per-request deadlines, and
+``serving.httpd`` exposes the whole thing over stdlib HTTP for smoke
+serving.  Metrics (queue depth, slot occupancy, tokens/sec, TTFT/TPOT)
+land in paddle_tpu.monitor and render via ``render_prometheus()``.
+"""
+from .request import (  # noqa: F401
+    Request, RequestQueue, RequestTimeout, QueueFull)
+from .scheduler import Scheduler, Slot  # noqa: F401
+from .engine import Engine  # noqa: F401
+from .httpd import EngineServer, serve  # noqa: F401
+
+__all__ = [
+    "Request", "RequestQueue", "RequestTimeout", "QueueFull",
+    "Scheduler", "Slot", "Engine", "EngineServer", "serve",
+]

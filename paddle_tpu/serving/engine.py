@@ -1,0 +1,536 @@
+"""Continuous-batching inference engine over a fixed slot pool.
+
+The per-request path (``GPTModel.generate``) decodes one request per
+dispatch: whenever a request finishes early, the compiled decode loop
+idles until the next request arrives, and short requests serialize
+behind long ones.  This engine instead runs ONE jitted one-token decode
+step over a fixed pool of ``num_slots`` batch rows (the TPU-shaped
+continuous batching: slot count and cache length are static, so a
+single XLA program serves every tick), admitting queued requests into
+slots the moment they free up:
+
+  tick:  admit(queue -> free slots, prefill each)  ->
+         one slot-batched decode dispatch          ->
+         sample per live slot, evict on EOS/max_new_tokens
+
+Each slot row computes exactly what a B=1 ``GPTAttention.decode`` at
+that slot's position computes (see ``decode_slots``), so under greedy
+decoding the engine's outputs are token-identical to per-request
+``generate()`` — tests/test_serving.py asserts it.
+
+Observability rides on paddle_tpu.monitor: queue depth, slot occupancy,
+tokens/sec, TTFT/TPOT histograms — scrape them through
+``monitor.render_prometheus()`` or the serving.httpd endpoint.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .. import monitor
+from .request import Request, RequestQueue
+from .scheduler import Scheduler
+
+
+def _softmax_np(x):
+    x = x - x.max()
+    e = np.exp(x)
+    return e / e.sum()
+
+
+def _filter_logits_np(row, temperature, top_k, top_p):
+    """Host-side twin of GPTModel._filter_logits for per-slot sampling
+    (each slot needs its own rng stream; greedy slots never call this)."""
+    row = row.astype(np.float64)
+    if temperature != 1.0:
+        row = row / temperature
+    if top_k and top_k > 0:
+        kth = np.sort(row)[-min(top_k, len(row))]
+        row = np.where(row < kth, -1e9, row)
+    if top_p < 1.0:
+        p_eff = max(float(top_p), 1e-9)
+        srt = np.sort(row)[::-1]
+        probs = _softmax_np(srt)
+        cum = np.cumsum(probs)
+        keep = (cum - probs) < p_eff
+        cutoff = srt[keep].min()
+        row = np.where(row < cutoff, -1e9, row)
+    return row
+
+
+class Engine:
+    """In-process continuous-batching engine for a GPT-family model.
+
+    Parameters
+    ----------
+    model : GPTModel (eval'd; ``scan_layers`` models serve through
+        their auto-synced unrolled decode twin, like ``generate``).
+    num_slots : fixed batch-slot pool size (the compiled tick's B).
+    max_seq_len : per-slot KV cache length L (prompt + generated must
+        fit); defaults to the model's max_position.
+    max_queue : admission queue bound (0 = unbounded); a full queue
+        sheds load at ``submit`` with QueueFull.
+    prefill_buckets : bound prefill compiles under varied traffic.
+        ``None`` (default) compiles one prefill program per DISTINCT
+        prompt length — fine for tests/benchmarks with few lengths,
+        but production traffic with arbitrary lengths would thrash the
+        8-entry program cache and stall every slot on each new-length
+        compile.  ``"pow2"`` right-pads prompts up to power-of-two
+        bucket lengths (plus max_seq_len); an iterable of ints uses
+        those bucket lengths.  Right-padding is parity-safe: causal
+        attention keeps positions < s independent of the pad tail, the
+        true last-token logits are sliced at s-1, and the garbage cache
+        rows past s are each overwritten by decode before any query can
+        see them.
+
+    ``step()`` is single-threaded by design — run it from one loop
+    (``run_until_idle`` or the ``start()`` background thread).
+    ``submit()`` is thread-safe and may be called from anywhere
+    (e.g. HTTP handler threads).
+    """
+
+    def __init__(self, model, num_slots=4, max_seq_len=None,
+                 max_queue=0, registry=None, prefill_buckets=None):
+        if getattr(model, "scan_layers", False):
+            model = model._sync_decode_twin()
+        model.eval()
+        self.model = model
+        max_position = \
+            model.embeddings.position_embeddings.weight.shape[0]
+        self.max_seq_len = int(max_seq_len or max_position)
+        if self.max_seq_len > max_position:
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} exceeds the model's "
+                f"position table ({max_position})")
+        self.num_slots = int(num_slots)
+        self.queue = RequestQueue(max_queue=max_queue)
+        self.scheduler = Scheduler(self.num_slots, self.queue)
+
+        import jax.numpy as jnp
+        attn0 = model.blocks[0].attn
+        self._nh, self._hd = attn0.num_heads, attn0.head_dim
+        if attn0.use_mp:
+            kv_dtype = attn0.qkv_weight._data.dtype
+        else:
+            # compute_dtype first: a weight-only-int8 projection's
+            # .weight property would materialize the dequantized matrix
+            kv_dtype = getattr(attn0.qkv_proj, "compute_dtype", None) \
+                or attn0.qkv_proj.weight._data.dtype
+        self._kv_dtype = kv_dtype
+        if prefill_buckets == "pow2":
+            bs, b = [], 8
+            while b < self.max_seq_len:
+                bs.append(b)
+                b *= 2
+            bs.append(self.max_seq_len)
+            self._prefill_buckets = bs
+        elif prefill_buckets:
+            bs = sorted({int(x) for x in prefill_buckets})
+            if bs[0] < 1 or bs[-1] > self.max_seq_len:
+                raise ValueError(
+                    f"prefill_buckets must lie in [1, {self.max_seq_len}]"
+                    f", got {bs}")
+            if bs[-1] < self.max_seq_len:
+                bs.append(self.max_seq_len)  # every legal prompt fits
+            self._prefill_buckets = bs
+        else:
+            self._prefill_buckets = None
+        self._reset_pools()
+        self._rngs = {}  # request id -> np.random.Generator (sampling)
+
+        params = dict(model.named_parameters())
+        self._params = params
+        self._pnames = sorted(params)
+        self._bnames_all = tuple(sorted(dict(model.named_buffers())))
+
+        # -- metrics -----------------------------------------------------
+        reg = registry or monitor.default_registry()
+        self.registry = reg
+        self._m_queue = reg.gauge(
+            "serving.queue_depth", "requests waiting for a slot")
+        self._m_occ = reg.gauge(
+            "serving.slot_occupancy", "busy slots out of num_slots")
+        self._m_slots = reg.gauge(
+            "serving.slot_total", "configured slot pool size")
+        self._m_slots.set(self.num_slots)
+        self._m_tokens = reg.counter(
+            "serving.tokens_total", "generated tokens")
+        self._m_reqs = reg.counter(
+            "serving.requests_total", "submitted requests")
+        self._m_done = reg.counter(
+            "serving.requests_completed", "finished requests")
+        self._m_timeout = reg.counter(
+            "serving.requests_timeout", "requests expired in queue")
+        self._m_ttft = reg.histogram(
+            "serving.ttft_ms", "time to first token (ms)")
+        self._m_tpot = reg.histogram(
+            "serving.tpot_ms", "time per output token after the first "
+            "(ms, per finished request)")
+        self._m_rate = monitor.RateMeter(reg.gauge(
+            "serving.tokens_per_sec", "windowed decode throughput"))
+
+        self._insert_fn = None
+        self._tick_fn = None    # resolved jitted slot-decode handle
+        self._p_arrays = None   # lazy snapshots of param/buffer handles
+        self._b_arrays = None   # (see refresh_params)
+        self._thread = None
+        self._stop = threading.Event()
+        self._drain_on_exit = None  # set to a loop's stop event when
+        #                             that loop must drain on exit
+
+    def _reset_pools(self):
+        """(Re)allocate the per-layer K/V slot pools and per-slot step
+        state.  Also the failure-recovery path: a decode dispatch that
+        dies AFTER consuming its donated pools leaves them deleted, so
+        the loop handler must rebuild before the next tick."""
+        import jax.numpy as jnp
+        shape = (self.num_slots, self.max_seq_len, self._nh, self._hd)
+        self.k_pools = [jnp.zeros(shape, self._kv_dtype)
+                        for _ in self.model.blocks]
+        self.v_pools = [jnp.zeros(shape, self._kv_dtype)
+                        for _ in self.model.blocks]
+        # host-side per-slot step state, shipped to device every tick
+        self._pos = np.zeros(self.num_slots, np.int32)
+        self._cur_tok = np.zeros((self.num_slots, 1), np.int32)
+
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=16, eos_token_id=None,
+               timeout=None, temperature=1.0, top_k=0, top_p=1.0,
+               seed=None):
+        """Queue one generation request; returns its Request handle
+        (block on ``request.result()``)."""
+        if temperature <= 0:
+            raise ValueError(
+                f"temperature must be > 0, got {temperature} (greedy is "
+                "the default when no sampling params are set)")
+        if top_p <= 0 or top_p > 1:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        # coerce in the CALLER's thread: a bad eos/seed must fail this
+        # submit, not crash the shared engine loop mid-decode
+        try:
+            eos_token_id = None if eos_token_id is None \
+                else int(eos_token_id)
+            seed = None if seed is None else int(seed)
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"eos_token_id/seed must be ints or None: {e}") from None
+        req = Request(prompt, max_new_tokens, eos_token_id=eos_token_id,
+                      timeout=timeout, temperature=temperature,
+                      top_k=top_k, top_p=top_p, seed=seed)
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(req.prompt)}) + max_new_tokens "
+                f"({req.max_new_tokens}) = {total} exceeds the slot "
+                f"cache length ({self.max_seq_len})")
+        self.queue.put(req)
+        self._m_reqs.inc()
+        self._m_queue.set(self.queue.depth())
+        return req
+
+    # ------------------------------------------------------------------
+    def _p_list(self):
+        """Parameter arrays in pnames order, snapshotted once — the
+        decode tick is per-token hot path, and the ~n_params dict walk
+        never changes after init.  Call refresh_params() after mutating
+        weights (quantization, checkpoint load) mid-serving."""
+        if self._p_arrays is None:
+            self._p_arrays = [self._params[k]._data
+                              for k in self._pnames]
+        return self._p_arrays
+
+    def _b_list(self):
+        """Buffer arrays sorted by name — every compiled path here
+        (prefill, bucketed prefill, slot decode) orders buffers as
+        sorted(named_buffers()), so one snapshot serves all three."""
+        if self._b_arrays is None:
+            bufs = dict(self.model.named_buffers())
+            self._b_arrays = [bufs[k]._data for k in sorted(bufs)]
+        return self._b_arrays
+
+    def refresh_params(self):
+        """Re-snapshot param/buffer handles after external weight
+        mutation (the compiled programs themselves are keyed on names
+        and dtypes and survive value changes)."""
+        self._p_arrays = None
+        self._b_arrays = None
+
+    def _prefill(self, slot):
+        """Admission prefill: one jitted whole-prompt forward (shared
+        with ``generate(compiled=...)`` via _compiled_prefill_fn, so the
+        math is the compiled path's bit-for-bit; or the bucketed
+        right-padded variant when prefill_buckets bounds compiles),
+        padded to the pool's L and written into the slot's cache rows."""
+        import jax.numpy as jnp
+        req = slot.request
+        s = len(req.prompt)
+        L = self.max_seq_len
+        if self._prefill_buckets is not None:
+            S = next(b for b in self._prefill_buckets if b >= s)
+            pf, _, _ = self.model._compiled_bucket_prefill_fn(
+                self._pnames, self._params,
+                (1, S, L, str(self._kv_dtype), tuple(self._pnames),
+                 self._bnames_all),
+                1, S, L, self._nh, self._hd, self._kv_dtype)
+            ids = np.zeros((1, S), np.int32)
+            ids[0, :s] = req.prompt
+            last0, k_bufs, v_bufs = pf(self._p_list(), self._b_list(),
+                                       ids, jnp.asarray(s, jnp.int32))
+        else:
+            pf, _, _ = self.model._compiled_prefill_fn(
+                self._pnames, self._params,
+                (1, s, L, str(self._kv_dtype), tuple(self._pnames),
+                 self._bnames_all),
+                1, s, L, self._nh, self._hd, self._kv_dtype)
+            last0, k_bufs, v_bufs = pf(self._p_list(), self._b_list(),
+                                       req.prompt[None, :])
+        i = slot.index
+        if self._insert_fn is None:
+            import jax
+
+            def ins(k_pools, v_pools, k_news, v_news, idx):
+                # one dispatch writes the slot row into every layer;
+                # donated pools update in place instead of 2*n_layers
+                # whole-pool copies per admission
+                new_k = [jax.lax.dynamic_update_slice(
+                    kp, kn.astype(kp.dtype), (idx, 0, 0, 0))
+                    for kp, kn in zip(k_pools, k_news)]
+                new_v = [jax.lax.dynamic_update_slice(
+                    vp, vn.astype(vp.dtype), (idx, 0, 0, 0))
+                    for vp, vn in zip(v_pools, v_news)]
+                return new_k, new_v
+
+            self._insert_fn = jax.jit(ins, donate_argnums=(0, 1))
+        import jax.numpy as jnp
+        self.k_pools, self.v_pools = self._insert_fn(
+            self.k_pools, self.v_pools, k_bufs, v_bufs,
+            jnp.asarray(i, jnp.int32))
+        slot.pos = s
+        self._pos[i] = s
+        tok = self._pick(req, np.asarray(last0, np.float32)[0])
+        self._emit(slot, tok)
+
+    def _pick(self, req, row):
+        """Next token from one slot's f32 logits row: argmax (greedy)
+        or filtered sampling on a per-request rng stream."""
+        if not req.do_sample:
+            return int(np.argmax(row))
+        rng = self._rngs.get(req.id)
+        if rng is None:
+            rng = self._rngs[req.id] = np.random.default_rng(
+                req.seed if req.seed is not None else req.id)
+        filt = _filter_logits_np(row, req.temperature, req.top_k,
+                                 req.top_p)
+        return int(rng.choice(len(filt), p=_softmax_np(filt)))
+
+    def _emit(self, slot, tok):
+        """Record one generated token; finish + evict on EOS or
+        max_new_tokens, else arm the slot for the next tick."""
+        req = slot.request
+        now = time.monotonic()
+        req.generated.append(int(tok))
+        if req.first_token_at is None:
+            req.first_token_at = now
+            self._m_ttft.observe((now - req.submitted_at) * 1e3)
+        self._m_tokens.inc()
+        self._m_rate.add(1, now)
+        finished = (len(req.generated) >= req.max_new_tokens or
+                    (req.eos_token_id is not None
+                     and int(tok) == int(req.eos_token_id)))
+        if finished:
+            n_after_first = len(req.generated) - 1
+            if n_after_first > 0:
+                self._m_tpot.observe(
+                    (now - req.first_token_at) / n_after_first * 1e3)
+            self._rngs.pop(req.id, None)
+            i = slot.index
+            self.scheduler.evict(slot)
+            # park the freed row: a frozen pos/tok keeps the inactive
+            # row's (ignored) compute in-bounds until the next prefill
+            # overwrites the whole cache row
+            self._pos[i] = 0
+            self._cur_tok[i, 0] = 0
+            self._m_done.inc()
+            return
+        i = slot.index
+        self._cur_tok[i, 0] = int(tok)
+        self._pos[i] = slot.pos
+
+    def _decode_tick(self, active):
+        """One slot-batched decode dispatch; samples and advances every
+        live slot."""
+        import jax.numpy as jnp
+        if self._tick_fn is None:
+            # resolve once: the key embeds tuple(pnames), an O(n_params)
+            # copy+hash not worth paying per generated token
+            self._tick_fn, _, _ = self.model._compiled_slot_decode_fn(
+                self._pnames, self._params,
+                (self.num_slots, self.max_seq_len, str(self._kv_dtype),
+                 tuple(self._pnames), self._bnames_all))
+        fn = self._tick_fn
+        last, self.k_pools, self.v_pools = fn(
+            self._p_list(), self._b_list(), self.k_pools, self.v_pools,
+            jnp.asarray(self._cur_tok), jnp.asarray(self._pos))
+        rows = np.asarray(last, np.float32)
+        emitted = 0
+        for slot in active:
+            slot.pos += 1
+            self._pos[slot.index] = slot.pos
+            self._emit(slot, self._pick(slot.request,
+                                        rows[slot.index]))
+            emitted += 1
+        return emitted
+
+    def step(self):
+        """One engine tick: admit -> prefill -> slot-batched decode.
+        Returns the number of tokens emitted this tick.
+
+        A tick that raises (transient XLA error, bad dispatch) first
+        RECOVERS the engine — in-flight requests are failed loudly
+        (their waiters unblock) and the donated K/V pools are rebuilt
+        (a dispatch that died after consuming them leaves them deleted)
+        — then re-raises, so every driver (run_until_idle, bench, the
+        background loop) sees a working engine afterwards."""
+        try:
+            return self._step_inner()
+        except Exception as e:
+            for slot in self.scheduler.active_slots():
+                req = self.scheduler.evict(slot, RuntimeError(
+                    f"engine step failed: {e!r}"))
+                if req is not None:
+                    self._rngs.pop(req.id, None)
+                    self._m_done.inc()  # terminal, like timeouts: keep
+                    #   in-flight = total - completed consistent
+            self._reset_pools()
+            self._m_occ.set(0)
+            raise
+
+    def _step_inner(self):
+        now = time.monotonic()
+        # deadline sweep first: with a full pool nothing gets popped,
+        # but queued requests must still time out on schedule
+        timed_out = self.queue.expire(now)
+        admitted, admit_timed_out = self.scheduler.admit(now)
+        timed_out = timed_out + admit_timed_out
+        if timed_out:
+            self._m_timeout.inc(len(timed_out))
+            self._m_done.inc(len(timed_out))
+        emitted = 0
+        for slot in admitted:
+            self._prefill(slot)
+            emitted += 1  # prefill samples the first token
+        active = self.scheduler.active_slots()
+        if active:
+            emitted += self._decode_tick(active)
+        self._m_queue.set(self.queue.depth())
+        self._m_occ.set(self.scheduler.occupancy())
+        return emitted
+
+    def run_until_idle(self, max_steps=100000):
+        """Drive ticks until queue and slots are empty (test/batch
+        convenience); returns total tokens emitted."""
+        total = 0
+        for _ in range(max_steps):
+            if self.scheduler.idle():
+                return total
+            total += self.step()
+        raise RuntimeError(
+            f"engine still busy after {max_steps} steps "
+            f"(occupancy={self.scheduler.occupancy()}, "
+            f"queue={self.queue.depth()})")
+
+    # -- background loop -------------------------------------------------
+    def start(self):
+        """Run the tick loop on a daemon thread (the HTTP endpoint's
+        mode); idle ticks sleep briefly instead of spinning.  Safe to
+        call after a timed-out stop(): the new loop joins the old one
+        before its first tick, so two loops never step concurrently."""
+        prev = self._thread
+        if prev is not None and prev.is_alive() \
+                and not self._stop.is_set():
+            return prev  # loop already running
+        if prev is not None and not prev.is_alive():
+            prev = None
+        # a restart supersedes a pending shutdown drain: the old loop
+        # must not fail requests submitted to the restarted engine
+        # (the flag is the owning loop's stop event, so a stale loop
+        # comparing against its own event can never match after this)
+        self._drain_on_exit = None
+        # each loop carries its OWN stop event: a stop-pending loop
+        # keeps honoring the event it was born with while the new loop
+        # runs against the fresh one
+        stop_evt = self._stop = threading.Event()
+
+        def loop():
+            if prev is not None:
+                prev.join()  # serialize: never two loops in step()
+            try:
+                while not stop_evt.is_set():
+                    if self.scheduler.idle():
+                        self._m_rate.refresh()  # decay tokens/sec to 0
+                        time.sleep(2e-3)
+                        continue
+                    try:
+                        self.step()  # step() already recovered state
+                    except Exception:  # keep the loop alive
+                        time.sleep(0.05)  # no hot spin on repeat failure
+            finally:
+                # a stop() whose join might time out delegates the
+                # drain here (the loop's last act); the identity check
+                # means only THIS loop's stop() can trigger it — a
+                # restart invalidates stale delegations
+                if self._drain_on_exit is stop_evt:
+                    self._drain_on_exit = None
+                    self._drain()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="paddle_tpu-serving-engine")
+        self._thread.start()
+        return self._thread
+
+    def _drain(self):
+        """Fail every queued and in-flight request (shutdown path)."""
+        for req in self.queue.drain():
+            self._m_done.inc()
+        for slot in self.scheduler.active_slots():
+            req = self.scheduler.evict(
+                slot, RuntimeError("engine stopped"))
+            if req is not None:
+                self._rngs.pop(req.id, None)
+                self._m_done.inc()
+        self._m_queue.set(0)
+        self._m_occ.set(0)
+
+    def stop(self, drain=True, join_timeout=30.0):
+        """Stop the background loop; optionally fail queued requests."""
+        evt = self._stop
+        if drain:
+            # delegate BEFORE set+join: a loop that exits inside the
+            # join window must still see the delegation (it drains in
+            # its finally; double-drain below is an idempotent no-op)
+            self._drain_on_exit = evt
+        evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=join_timeout)
+            if t.is_alive():
+                # mid-dispatch (e.g. a long first compile): draining
+                # under the live loop would race it, so the loop drains
+                # on exit instead; the handle stays so a later start()
+                # serializes behind it
+                return
+            self._thread = None
+        if drain:
+            self._drain_on_exit = None
+            self._drain()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

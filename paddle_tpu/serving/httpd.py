@@ -1,0 +1,163 @@
+"""Thin stdlib HTTP endpoint for smoke-serving an Engine.
+
+Not a production frontend — it exists so the engine can be driven and
+scraped end-to-end with nothing but ``curl`` (and so tests exercise the
+full submit -> queue -> slot -> result path over a real socket):
+
+  POST /generate   {"prompt": [1,2,3], "max_new_tokens": 8,
+                    "eos_token_id": null, "timeout": null,
+                    "temperature": 1.0, "top_k": 0, "top_p": 1.0}
+                -> {"ids": [...], "generated": [...], "ttft_ms": ...}
+  GET  /metrics    Prometheus text exposition (monitor registry)
+  GET  /healthz    {"slots_free": n, "queue_depth": n, ...}
+
+Handlers run on ThreadingHTTPServer worker threads and block on
+``Request.result()`` while the engine's own thread decodes — the
+continuous-batching point: N concurrent POSTs share slot ticks.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import monitor
+from .request import QueueFull, RequestTimeout
+
+
+class _Handler(BaseHTTPRequestHandler):
+    engine = None          # bound per-server via the factory below
+    result_timeout = 120.0
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _send(self, code, body, ctype="application/json"):
+        data = body if isinstance(body, bytes) else body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, code, obj):
+        self._send(code, json.dumps(obj))
+
+    def do_GET(self):
+        eng = self.engine
+        if self.path == "/metrics":
+            self._send(200, monitor.render_prometheus(eng.registry),
+                       ctype="text/plain; version=0.0.4")
+        elif self.path == "/healthz":
+            self._send_json(200, {
+                "status": "ok",
+                "slots_total": eng.num_slots,
+                "slots_free": eng.scheduler.free_count(),
+                "queue_depth": eng.queue.depth(),
+            })
+        else:
+            self._send_json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/generate":
+            self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            prompt = body["prompt"]
+        except (KeyError, TypeError, ValueError,
+                json.JSONDecodeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        try:
+            req = self.engine.submit(
+                prompt,
+                max_new_tokens=int(body.get("max_new_tokens", 16)),
+                eos_token_id=body.get("eos_token_id"),
+                timeout=body.get("timeout"),
+                temperature=float(body.get("temperature", 1.0)),
+                top_k=int(body.get("top_k", 0)),
+                top_p=float(body.get("top_p", 1.0)),
+                seed=body.get("seed"))
+        except QueueFull as e:
+            self._send_json(503, {"error": str(e)})
+            return
+        except (TypeError, ValueError) as e:
+            # TypeError covers JSON nulls / non-numeric fields hitting
+            # the int()/float() coercions — still a 400, not a dropped
+            # connection
+            self._send_json(400, {"error": str(e)})
+            return
+        try:
+            ids = req.result(timeout=self.result_timeout)
+        except RequestTimeout as e:
+            self._send_json(504, {"error": str(e)})
+            return
+        except (TimeoutError, RuntimeError) as e:
+            self._send_json(500, {"error": str(e)})
+            return
+        ttft = None
+        if req.first_token_at is not None:
+            ttft = round((req.first_token_at - req.submitted_at) * 1e3, 3)
+        self._send_json(200, {
+            "id": req.id,
+            "ids": [int(x) for x in ids],
+            "generated": [int(x) for x in req.generated],
+            "ttft_ms": ttft,
+        })
+
+
+class EngineServer:
+    """Engine tick loop + ThreadingHTTPServer, each on its own daemon
+    thread.  ``with EngineServer(engine) as srv: ... srv.port``."""
+
+    def __init__(self, engine, host="127.0.0.1", port=0,
+                 result_timeout=120.0):
+        self.engine = engine
+        handler = type("BoundHandler", (_Handler,),
+                       {"engine": engine,
+                        "result_timeout": float(result_timeout)})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.host, self.port = self.httpd.server_address[:2]
+        self._http_thread = None
+
+    @property
+    def address(self):
+        return f"http://{self.host}:{self.port}"
+
+    def start(self):
+        self.engine.start()
+        self._http_thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name="paddle_tpu-serving-http")
+        self._http_thread.start()
+        return self
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=5.0)
+            self._http_thread = None
+        self.engine.stop()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def serve(engine, host="127.0.0.1", port=8000, result_timeout=120.0):
+    """Blocking convenience: start the engine and serve HTTP until
+    KeyboardInterrupt."""
+    srv = EngineServer(engine, host=host, port=port,
+                       result_timeout=result_timeout).start()
+    try:
+        srv._http_thread.join()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.close()

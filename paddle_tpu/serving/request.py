@@ -1,0 +1,169 @@
+"""Serving requests and the admission queue.
+
+A ``Request`` is one generation job: prompt ids in, generated ids out,
+with a threading.Event completion handle so HTTP handler threads (or
+any caller thread) can block on ``result()`` while the engine thread
+decodes.  The ``RequestQueue`` is the admission buffer in front of the
+slot pool — FIFO with per-request deadlines, so a request that waits
+longer than its ``timeout`` is failed loudly instead of silently
+decoding after its caller gave up (the reference's closest analogue is
+the PS heartbeat monitor's lost-worker accounting; here the lost party
+is a request, not a worker).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+
+class RequestTimeout(RuntimeError):
+    """The request exceeded its queue deadline before a slot freed up."""
+
+
+class QueueFull(RuntimeError):
+    """The admission queue is at max_queue; shed load at the edge."""
+
+
+_req_ids = itertools.count()
+
+
+class Request:
+    """One generation job moving through queue -> slot -> done."""
+
+    def __init__(self, prompt, max_new_tokens, eos_token_id=None,
+                 timeout=None, temperature=1.0, top_k=0, top_p=1.0,
+                 seed=None):
+        self.id = next(_req_ids)
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.temperature = float(temperature)
+        self.top_k = int(top_k or 0)
+        self.top_p = float(top_p)
+        self.seed = seed
+        self.generated = []          # ints, appended by the engine
+        self.submitted_at = time.monotonic()
+        self.deadline = (self.submitted_at + float(timeout)
+                         if timeout is not None else None)
+        self.first_token_at = None   # TTFT anchor
+        self.finished_at = None
+        self.error = None
+        self._done = threading.Event()
+
+    @property
+    def do_sample(self):
+        return (self.top_k > 0 or self.temperature != 1.0
+                or self.top_p < 1.0)
+
+    def expired(self, now=None):
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) > self.deadline
+
+    # -- engine side -----------------------------------------------------
+    def _finish(self, error=None):
+        self.error = error
+        self.finished_at = time.monotonic()
+        self._done.set()
+
+    # -- caller side -----------------------------------------------------
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        """Block until the engine finishes this request; returns the
+        full id sequence (prompt + generated) as int32 numpy.  Raises
+        the engine-recorded error (e.g. RequestTimeout) on failure."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.id}: no result after {timeout}s "
+                "(engine not stepping?)")
+        if self.error is not None:
+            raise self.error
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int32)])
+
+    def __repr__(self):
+        state = ("error" if self.error else
+                 "done" if self.done() else "pending")
+        return (f"Request(id={self.id}, prompt_len={len(self.prompt)}, "
+                f"generated={len(self.generated)}, {state})")
+
+
+class RequestQueue:
+    """Thread-safe FIFO admission queue with deadline enforcement."""
+
+    def __init__(self, max_queue=0):
+        self.max_queue = int(max_queue)  # 0 = unbounded
+        self._lock = threading.Lock()
+        self._q = deque()
+
+    def put(self, req):
+        with self._lock:
+            if self.max_queue and len(self._q) >= self.max_queue:
+                raise QueueFull(
+                    f"admission queue full ({self.max_queue}); request "
+                    f"{req.id} shed at the edge")
+            self._q.append(req)
+
+    def pop_ready(self, now=None):
+        """Pop the next request that has not expired; expired requests
+        are failed in place (RequestTimeout) and returned via the
+        second element so the caller can count them.
+
+        Returns (request | None, list_of_timed_out_requests).
+        """
+        now = time.monotonic() if now is None else now
+        timed_out = []
+        with self._lock:
+            while self._q:
+                req = self._q.popleft()
+                if req.expired(now):
+                    req._finish(RequestTimeout(
+                        f"request {req.id} spent "
+                        f"{now - req.submitted_at:.3f}s queued, over its "
+                        f"{req.deadline - req.submitted_at:.3f}s timeout"))
+                    timed_out.append(req)
+                    continue
+                return req, timed_out
+        return None, timed_out
+
+    def expire(self, now=None):
+        """Sweep out every expired request (full-pool case: nothing is
+        being popped, but deadlines must still fire).  Returns the
+        timed-out requests, already failed."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            live, timed_out = [], []
+            for req in self._q:
+                (timed_out if req.expired(now) else live).append(req)
+            self._q = deque(live)
+        for req in timed_out:
+            req._finish(RequestTimeout(
+                f"request {req.id} spent {now - req.submitted_at:.3f}s "
+                f"queued, over its "
+                f"{req.deadline - req.submitted_at:.3f}s timeout"))
+        return timed_out
+
+    def depth(self):
+        with self._lock:
+            return len(self._q)
+
+    def drain(self, error=None):
+        """Fail every queued request (engine shutdown)."""
+        with self._lock:
+            pending = list(self._q)
+            self._q.clear()
+        for req in pending:
+            req._finish(error or RuntimeError("engine stopped"))
+        return pending

@@ -1,0 +1,82 @@
+"""Slot scheduler: maps queued requests onto fixed batch slots.
+
+Continuous batching over a FIXED pool (the TPU-shaped version: slot
+count and cache length are compile-time constants, so one XLA program
+serves every tick — Ragged Paged Attention, PAPERS.md 2604.15464, is
+the kernel-level generalization of the same idea).  The scheduler owns
+only slot METADATA; the engine owns the device arrays.  Admission =
+bind request to a free slot (the engine then prefills it); eviction =
+free the slot on EOS / max_new_tokens / error.
+"""
+from __future__ import annotations
+
+import threading
+
+
+class Slot:
+    __slots__ = ("index", "request", "pos")
+
+    def __init__(self, index):
+        self.index = index
+        self.request = None
+        self.pos = 0   # next cache write position (= tokens cached)
+
+    @property
+    def free(self):
+        return self.request is None
+
+
+class Scheduler:
+    """Admits queued requests into free slots; evicts finished ones."""
+
+    def __init__(self, num_slots, queue):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = int(num_slots)
+        self.queue = queue
+        self.slots = [Slot(i) for i in range(self.num_slots)]
+        self._lock = threading.Lock()
+
+    # -- accounting ------------------------------------------------------
+    def occupancy(self):
+        with self._lock:
+            return sum(1 for s in self.slots if not s.free)
+
+    def free_count(self):
+        return self.num_slots - self.occupancy()
+
+    def active_slots(self):
+        with self._lock:
+            return [s for s in self.slots if not s.free]
+
+    def idle(self):
+        return self.occupancy() == 0 and self.queue.depth() == 0
+
+    # -- admission / eviction -------------------------------------------
+    def admit(self, now=None):
+        """Fill free slots from the queue.  Returns (admitted_slots,
+        timed_out_requests) — the engine prefills each admitted slot
+        and counts the timeouts."""
+        admitted, timed_out = [], []
+        with self._lock:
+            free = [s for s in self.slots if s.free]
+        for slot in free:
+            req, expired = self.queue.pop_ready(now)
+            timed_out.extend(expired)
+            if req is None:
+                break
+            with self._lock:
+                slot.request = req
+                slot.pos = 0
+            admitted.append(slot)
+        return admitted, timed_out
+
+    def evict(self, slot, error=None):
+        """Free a slot and complete its request."""
+        with self._lock:
+            req = slot.request
+            slot.request = None
+            slot.pos = 0
+        if req is not None:
+            req._finish(error)
+        return req

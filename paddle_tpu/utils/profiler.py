@@ -83,20 +83,27 @@ def stop_profiler(sorted_key="total", profile_path=None):
     if events:
         agg = {}
         for name, _, dur in events:
-            tot, cnt = agg.get(name, (0.0, 0))
-            agg[name] = (tot + dur, cnt + 1)
+            tot, cnt, mx, mn = agg.get(name,
+                                       (0.0, 0, -float("inf"),
+                                        float("inf")))
+            agg[name] = (tot + dur, cnt + 1, max(mx, dur), min(mn, dur))
+        # max/min sort by the per-event extreme DURATION (reference
+        # summary semantics: EventSortingKey::kMin also sorts
+        # DESCENDING, like every other key), not by total time
         sort_fns = {"total": lambda kv: -kv[1][0],
                     "calls": lambda kv: -kv[1][1],
                     "ave": lambda kv: -(kv[1][0] / kv[1][1]),
-                    "max": lambda kv: -kv[1][0],
-                    "min": lambda kv: kv[1][0]}
+                    "max": lambda kv: -kv[1][2],
+                    "min": lambda kv: -kv[1][3]}
         rows = sorted(agg.items(),
                       key=sort_fns.get(sorted_key or "total",
                                        sort_fns["total"]))
-        print(f"{'Event':<40} {'Calls':>8} {'Total(ms)':>12} {'Avg(ms)':>12}")
-        for name, (tot, cnt) in rows:
+        print(f"{'Event':<40} {'Calls':>8} {'Total(ms)':>12} "
+              f"{'Avg(ms)':>12} {'Max(ms)':>12} {'Min(ms)':>12}")
+        for name, (tot, cnt, mx, mn) in rows:
             print(f"{name:<40} {cnt:>8} {tot * 1e3:>12.3f} "
-                  f"{tot / cnt * 1e3:>12.3f}")
+                  f"{tot / cnt * 1e3:>12.3f} {mx * 1e3:>12.3f} "
+                  f"{mn * 1e3:>12.3f}")
     return events
 
 
@@ -132,8 +139,10 @@ class Timer:
 
 def reset_profiler():
     """reference: fluid/profiler.py reset_profiler — drop collected
-    host events."""
-    _host_events.clear()
+    host events.  Takes the lock: concurrent RecordEvent.__exit__
+    appends race an unlocked clear()."""
+    with _lock:
+        _host_events.clear()
 
 
 class cuda_profiler:

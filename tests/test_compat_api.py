@@ -156,6 +156,64 @@ def test_profiler_chrome_trace_export(tmp_path, capsys):
     assert "step" in out and "Calls" in out
 
 
+def test_profiler_sorted_key_max_uses_event_durations(tmp_path, capsys,
+                                                      monkeypatch):
+    """sorted_key='max'/'min' must sort by the per-event extreme
+    DURATION, not total time (review satellite): 'a' has the larger
+    total (3x8), 'b' the larger single event (1x20)."""
+    from paddle_tpu.utils import profiler as prof
+    ticks = iter([0.0, 8.0, 10.0, 18.0, 20.0, 28.0, 30.0, 50.0])
+    monkeypatch.setattr(prof.time, "perf_counter", lambda: next(ticks))
+    prof.start_profiler(log_dir=str(tmp_path / "xplane"))
+    for name in ("a", "a", "a", "b"):
+        with prof.RecordEvent(name):
+            pass
+    prof.stop_profiler(sorted_key="max")
+    rows = [l.split()[0] for l in capsys.readouterr().out.splitlines()
+            if l and l.split()[0] in ("a", "b")]
+    assert rows == ["b", "a"]  # max(b)=20 > max(a)=8 despite total a=24
+
+
+def test_profiler_sorted_key_min_descends(tmp_path, capsys, monkeypatch):
+    """'min' sorts by per-event MIN duration, descending like every
+    other key (reference EventSortingKey::kMin)."""
+    from paddle_tpu.utils import profiler as prof
+    ticks = iter([0.0, 8.0, 10.0, 18.0, 20.0, 28.0, 30.0, 50.0])
+    monkeypatch.setattr(prof.time, "perf_counter", lambda: next(ticks))
+    prof.start_profiler(log_dir=str(tmp_path / "xplane"))
+    for name in ("a", "a", "a", "b"):
+        with prof.RecordEvent(name):
+            pass
+    prof.stop_profiler(sorted_key="min")
+    rows = [l.split()[0] for l in capsys.readouterr().out.splitlines()
+            if l and l.split()[0] in ("a", "b")]
+    assert rows == ["b", "a"]  # min(b)=20 > min(a)=8
+
+
+def test_reset_profiler_thread_safe_against_exits(tmp_path):
+    """reset_profiler takes the event-list lock; hammer it against
+    concurrent RecordEvent exits and require no lost-update crash."""
+    import threading
+    from paddle_tpu.utils import profiler as prof
+    prof.start_profiler(log_dir=str(tmp_path / "xplane"))
+    stop = threading.Event()
+
+    def record():
+        while not stop.is_set():
+            with prof.RecordEvent("spin"):
+                pass
+
+    t = threading.Thread(target=record)
+    t.start()
+    try:
+        for _ in range(200):
+            prof.reset_profiler()
+    finally:
+        stop.set()
+        t.join()
+        prof.stop_profiler()
+
+
 def test_dlpack_roundtrip():
     from paddle_tpu.utils import dlpack
     x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))

@@ -23,6 +23,7 @@ SCRIPTS = [
     "quant_aware_training.py",
     "packed_pretraining.py",
     "serving_decode.py",
+    "serving_engine.py",
     "geo_async_ps.py",
     "onnx_export.py",
 ]

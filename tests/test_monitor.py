@@ -1,0 +1,140 @@
+"""monitor: Counter/Gauge/Histogram semantics, the reference StatValue
+registry (platform/monitor.h parity), Prometheus exposition, and the
+train-step / io wiring."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor, nn
+from paddle_tpu.monitor import (Counter, Gauge, Histogram, StatRegistry,
+                                RateMeter, render_prometheus,
+                                stat_add, stat_sub, stat_get)
+
+
+def test_counter_monotonic():
+    c = Counter("c")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_up_down():
+    g = Gauge("g")
+    g.set(3.5)
+    g.inc(2)
+    g.dec()
+    assert g.value == 4.5
+
+
+def test_histogram_cumulative_buckets():
+    h = Histogram("h", buckets=(1, 10, 100))
+    for v in (0.5, 5, 5, 50, 5000):
+        h.observe(v)
+    cum, total, count = h.snapshot()
+    assert cum == [1, 3, 4, 5]  # le=1, le=10, le=100, +Inf
+    assert count == 5 and total == 5060.5
+    assert h.mean() == pytest.approx(1012.1)
+
+
+def test_registry_get_or_create_and_type_conflict():
+    reg = StatRegistry()
+    c1 = reg.counter("x")
+    assert reg.counter("x") is c1
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+    reg.unregister("x")
+    assert isinstance(reg.gauge("x"), Gauge)
+
+
+def test_stat_value_macros():
+    """STAT_ADD/STAT_SUB parity helpers on the default registry."""
+    name = "test.stat_macro_unit"
+    monitor.default_registry().unregister(name)
+    assert stat_get(name) == 0
+    stat_add(name, 7)
+    stat_sub(name, 2)
+    assert stat_get(name) == 5
+    monitor.default_registry().unregister(name)
+
+
+def test_render_prometheus_format():
+    reg = StatRegistry()
+    reg.counter("req.total", "requests").inc(3)
+    reg.gauge("queue.depth").set(2)
+    reg.histogram("lat.ms", buckets=(1, 10)).observe(4)
+    reg.stat("mem.bytes").increase(12)
+    text = render_prometheus(reg)
+    assert "# HELP req_total requests" in text
+    assert "# TYPE req_total counter" in text
+    assert "req_total 3" in text
+    assert "# TYPE queue_depth gauge" in text
+    assert "queue_depth 2" in text
+    assert 'lat_ms_bucket{le="1"} 0' in text
+    assert 'lat_ms_bucket{le="10"} 1' in text
+    assert 'lat_ms_bucket{le="+Inf"} 1' in text
+    assert "lat_ms_sum 4" in text
+    assert "lat_ms_count 1" in text
+    assert "mem_bytes 12" in text  # StatValue renders as gauge
+
+
+def test_registry_reset_keeps_registrations():
+    reg = StatRegistry()
+    c = reg.counter("a")
+    c.inc(9)
+    reg.histogram("b").observe(1)
+    reg.reset()
+    assert reg.counter("a") is c and c.value == 0
+    assert reg.histogram("b").count == 0
+
+
+def test_rate_meter_sets_gauge():
+    g = Gauge("rate")
+    meter = RateMeter(g, window_s=10.0)
+    meter.add(5, now=100.0)
+    meter.add(5, now=101.0)
+    assert g.value == pytest.approx(10.0 / 1.0, rel=0.5)
+
+
+def test_rate_meter_refresh_decays_to_zero():
+    """An idle producer must not freeze the last burst's rate forever:
+    refresh() past the window drops the gauge to 0."""
+    g = Gauge("rate")
+    meter = RateMeter(g, window_s=2.0)
+    meter.add(10, now=100.0)
+    assert g.value > 0
+    meter.refresh(now=100.5)
+    assert g.value > 0  # still inside the window
+    meter.refresh(now=103.0)
+    assert g.value == 0.0
+
+
+def test_train_step_counters_in_exposition():
+    """One TrainStep.step() bumps train.steps and observes the step-time
+    histogram in the DEFAULT registry (the acceptance wiring)."""
+    from paddle_tpu.parallel.train_step import TrainStep
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    paddle.seed(0)
+    net = Net()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    step = TrainStep(net, opt, loss_fn=nn.CrossEntropyLoss())
+    before = monitor.counter("train.steps").value
+    x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    y = np.zeros(8, np.int64)
+    step.step([x], [y])
+    assert monitor.counter("train.steps").value == before + 1
+    hist = monitor.histogram("train.step_time_ms")
+    assert hist.count >= 1
+    text = render_prometheus()
+    assert "train_steps" in text
+    assert "train_step_time_ms_count" in text
