@@ -351,10 +351,14 @@ def bench_serving():
     """serving_throughput: aggregate decode tokens/sec, sequential
     per-request generate(compiled=True) vs the continuous-batching
     engine (serving.Engine, fixed slot pool) on staggered concurrent
-    requests.  Lands in BENCH_MODELS.json only."""
+    requests, PLUS a shared-prefix traffic variant on the paged
+    KV-cache engine (kv_block_size, prefix cache on vs off) reporting
+    aggregate tok/s, prefix-hit rate, and prefill tokens actually
+    computed.  Lands in BENCH_MODELS.json only."""
     import jax
     import numpy as np
     import paddle_tpu as paddle
+    from paddle_tpu import monitor
     from paddle_tpu.models import GPTModel
     from paddle_tpu.serving import Engine
 
@@ -399,14 +403,83 @@ def bench_serving():
         r.result(timeout=1)
     eng_tps = n_requests * n_new / (time.perf_counter() - t0)
 
+    # -- shared-prefix traffic on the paged KV cache -------------------
+    # one system prompt + per-request tails: the prefix cache should
+    # serve the shared span from cached blocks (admission skips its
+    # prefill), measured against the same paged engine with the cache
+    # off.  Block size 8 keeps the tiny CPU config meaningful; the
+    # bench compiles (ctx, tail) paged-prefill programs in the warm
+    # pass so the timed window is decode-bound like the other legs.
+    sys_len, tail_lens = (24, (4, 6, 5, 7)) if not on_tpu else (64, (8, 12, 10, 14))
+    sysp = rng.randint(0, vocab, (sys_len,)).astype(np.int32)
+    sp_prompts = [np.concatenate([sysp, rng.randint(0, vocab, (t,))
+                                  .astype(np.int32)])
+                  for t in (tail_lens * 2)[:n_requests]]
+
+    def run_paged(prefix_on):
+        reg = monitor.StatRegistry()
+        eng = Engine(model, num_slots=4, kv_block_size=8,
+                     prefix_cache=prefix_on, registry=reg)
+        # warm: compile every (ctx, tail) paged prefill shape — COLD
+        # (flush between submits) and HIT (shared warm prefix) — plus
+        # the decode tick, all outside the timed window; warm on a
+        # DISTINCT prefix and flush before timing
+        warm_sys = rng.randint(0, vocab, (sys_len,)).astype(np.int32)
+        seq = sorted(set(tail_lens))
+
+        def warm(t):
+            w = np.concatenate([warm_sys, rng.randint(0, vocab, (t,))
+                                .astype(np.int32)])
+            eng.submit(w, max_new_tokens=2)
+            eng.run_until_idle()
+
+        for t in seq:                       # cold (ctx=0) shapes
+            warm(t)
+            if eng.prefix_cache is not None:
+                eng.prefix_cache.evict(10 ** 9)
+        for t in seq + seq[:1]:             # hit shapes (first seeds)
+            warm(t)
+        if eng.prefix_cache is not None:
+            eng.prefix_cache.evict(10 ** 9)  # start the run cold
+        reg.get("serving.prefill_tokens").reset()
+        reg.get("serving.prefix_hits").reset()
+        reg.get("serving.prefix_hit_tokens").reset()
+        t0 = time.perf_counter()
+        rs = [eng.submit(p, max_new_tokens=n_new) for p in sp_prompts]
+        eng.run_until_idle()
+        for r in rs:
+            r.result(timeout=1)
+        dt = time.perf_counter() - t0
+        return {
+            "tokens_per_sec": round(n_requests * n_new / dt, 1),
+            "prefill_tokens_computed":
+                int(reg.get("serving.prefill_tokens").value),
+            "prefix_hits": int(reg.get("serving.prefix_hits").value),
+            "prefix_hit_tokens":
+                int(reg.get("serving.prefix_hit_tokens").value),
+        }
+
+    paged_on = run_paged(True)
+    paged_off = run_paged(False)
+
     return {"metric": f"serving aggregate tokens/sec ({cfg}, "
                       "4-slot continuous batching)",
             "value": round(eng_tps, 1), "unit": "tokens/s",
             "on_tpu": on_tpu,
             "sequential_tokens_per_sec": round(seq_tps, 1),
             "speedup_vs_sequential": round(eng_tps / seq_tps, 2),
+            "shared_prefix": {
+                "prefix_cache_on": paged_on,
+                "prefix_cache_off": paged_off,
+                "prefix_hit_rate": round(
+                    paged_on["prefix_hits"] / n_requests, 2),
+                "prefill_tokens_saved":
+                    paged_off["prefill_tokens_computed"]
+                    - paged_on["prefill_tokens_computed"],
+            },
             "config": {"num_slots": 4, "requests": n_requests,
-                       "max_new_tokens": n_new}}
+                       "max_new_tokens": n_new, "kv_block_size": 8,
+                       "shared_prefix_len": sys_len}}
 
 
 CHILD_BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,

@@ -32,9 +32,29 @@ import sys
 import time
 
 
-def _free_port():
+def _reserve_port():
+    """Bind an OS-assigned port and KEEP the socket open so no
+    concurrent process can grab it while the launcher prepares the
+    job.  The caller closes it at the last moment before spawning (the
+    coordinator bind lives in a child, and two sockets cannot hold one
+    port, so a residual close-to-child-bind window remains — narrowed,
+    not closed; concurrent multi-launch jobs should pass an explicit
+    --master).  SO_REUSEADDR lets the child's bind succeed immediately
+    despite the just-closed probe.  Returns the bound socket (port via
+    ``sock.getsockname()[1]``)."""
     s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     s.bind(("127.0.0.1", 0))
+    return s
+
+
+def _free_port():
+    """Probe-and-close port pick — RACY by construction (another
+    process can take the port before the caller binds).  Kept for
+    callers that tolerate the race; the launcher itself reserves via
+    ``_reserve_port`` and holds the socket until workers start.
+    Concurrent multi-launch jobs should pass an explicit --master."""
+    s = _reserve_port()
     port = s.getsockname()[1]
     s.close()
     return port
@@ -46,12 +66,27 @@ def _spawn_and_watch(args):
     failure aborts the whole job; the launcher's exit code is the first
     failing child's."""
     world = args.nnodes * args.nproc_per_node
-    master = args.master or f"127.0.0.1:{_free_port()}"
+    reserved = None
+    if args.master:
+        master = args.master
+    else:
+        # hold the probed port until the workers are spawning — a
+        # close-then-rebind window here meant a concurrent launch could
+        # steal the master port (flaky multi-launch failures)
+        reserved = _reserve_port()
+        master = f"127.0.0.1:{reserved.getsockname()[1]}"
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
 
     procs = []
     logs = []
+    if reserved is not None:
+        # release as late as possible (the port cannot stay held: rank
+        # 0's coordinator bind happens inside the first child, and two
+        # sockets cannot bind one port).  The interpreter-boot window
+        # before that bind is unavoidable without an explicit --master;
+        # SO_REUSEADDR on the probe keeps the child's bind instant
+        reserved.close()
     for local in range(args.nproc_per_node):
         rank = args.node_rank * args.nproc_per_node + local
         env = dict(os.environ)

@@ -170,15 +170,18 @@ def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k,
                     keep_top_k, nms_threshold=0.3, normalized=True,
                     nms_eta=1.0, background_label=0,
                     return_index=False, name=None):
-    if return_index:
-        raise NotImplementedError(
-            "multiclass_nms2(return_index=True): the XLA-shaped nms "
-            "returns padded [keep_top_k, 6] rows without source indices")
-    from ...vision.ops import multiclass_nms as impl
+    """Returns the REFERENCE contract: Out, or (Out, Index) when
+    ``return_index`` (Index = each kept detection's source row in
+    ``bboxes``, padded -1).  Note this shim previously delegated to
+    ``multiclass_nms`` and leaked its (Out, valid_count) pair for
+    return_index=False; valid rows are now counted as
+    ``(out[:, 0] >= 0).sum()``."""
+    from ...vision.ops import multiclass_nms2 as impl
     return impl(bboxes, scores, score_threshold=score_threshold,
                 nms_top_k=nms_top_k, keep_top_k=keep_top_k,
                 nms_threshold=nms_threshold, normalized=normalized,
-                nms_eta=nms_eta, background_label=background_label)
+                nms_eta=nms_eta, background_label=background_label,
+                return_index=return_index)
 
 
 def _ps_serving_stub(name):

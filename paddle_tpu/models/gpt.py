@@ -176,27 +176,10 @@ class GPTAttention(nn.Layer):
             out = self.out_proj(out)
         return out, k_buf, v_buf
 
-    def decode_slots(self, x, k_buf, v_buf, pos):
-        """One-token decode with PER-SLOT positions (continuous
-        batching, serving/engine.py): each batch row is an independent
-        request slot at its own sequence position, so the cache write
-        and the causal mask are per-row.  Same f32 score math as
-        ``decode`` — row b of a slot batch computes exactly what a B=1
-        ``decode`` at ``pos[b]`` computes, which is what makes the
-        serving engine token-identical to per-request ``generate()``.
-
-        x: Tensor [B, 1, E]; k_buf/v_buf: [B, L, H, hd] arrays;
-        pos: int32 [B] (per-slot write position).  Returns
-        (out Tensor [B, 1, E], k_buf, v_buf).
-        """
-        import math as _math
-        import jax
-        import jax.numpy as jnp
-
-        if x.shape[1] != 1:
-            raise ValueError(
-                f"decode_slots is a one-token step (got S={x.shape[1]});"
-                " windowed decode keeps the shared-position decode()")
+    def _qkv_step(self, x):
+        """Fused QKV for a one-token slot step: Tensor [B, 1, E] ->
+        (qa, ka, va) arrays [B, 1, H, hd].  Shared by the contiguous
+        and paged slot decode paths."""
         if self.use_mp:
             q, k, v = self._qkv_mp(x)
         else:
@@ -204,21 +187,32 @@ class GPTAttention(nn.Layer):
             qkv = self.qkv_proj(x)
             qkv = reshape(qkv, [b, 1, 3, self.num_heads, self.head_dim])
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        qa, ka, va = q._data, k._data, v._data
+        return q._data, k._data, v._data
+
+    def _slot_attn(self, qa, k_rows, v_rows, pos):
+        """One-token attention over each slot's cache rows: f32
+        scores, per-row causal mask (cache positions <= pos[b]),
+        softmax, value contraction, output projection.  ONE
+        implementation shared by ``decode_slots`` (contiguous rows)
+        and ``decode_slots_paged`` (block-table-gathered rows), so the
+        paged path's token-parity guarantee is structural, not
+        by-convention.  qa [B, 1, H, hd]; k_rows/v_rows [B, L, H, hd];
+        pos int32 [B].  Returns out Tensor [B, 1, E]."""
+        import math as _math
+        import jax
+        import jax.numpy as jnp
+
         B = qa.shape[0]
-        rows = jnp.arange(B)
-        k_buf = k_buf.at[rows, pos].set(ka[:, 0].astype(k_buf.dtype))
-        v_buf = v_buf.at[rows, pos].set(va[:, 0].astype(v_buf.dtype))
         scale = 1.0 / _math.sqrt(self.head_dim)
         scores = jnp.einsum("bqhd,bkhd->bhqk",
                             qa.astype(jnp.float32),
-                            k_buf.astype(jnp.float32)) * scale
-        L = k_buf.shape[1]
+                            k_rows.astype(jnp.float32)) * scale
+        L = k_rows.shape[1]
         visible = jnp.arange(L)[None, :] <= pos[:, None]       # [B, L]
         scores = jnp.where(visible[:, None, None, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs,
-                         v_buf.astype(jnp.float32)).astype(qa.dtype)
+                         v_rows.astype(jnp.float32)).astype(qa.dtype)
         out = Tensor(ctx)
         if self.use_mp:
             from ..ops import einsum
@@ -227,7 +221,71 @@ class GPTAttention(nn.Layer):
         else:
             out = reshape(out, [B, 1, self.num_heads * self.head_dim])
             out = self.out_proj(out)
-        return out, k_buf, v_buf
+        return out
+
+    def decode_slots(self, x, k_buf, v_buf, pos):
+        """One-token decode with PER-SLOT positions (continuous
+        batching, serving/engine.py): each batch row is an independent
+        request slot at its own sequence position, so the cache write
+        and the causal mask are per-row.  Same f32 score math as
+        ``decode`` (via ``_slot_attn``) — row b of a slot batch
+        computes exactly what a B=1 ``decode`` at ``pos[b]`` computes,
+        which is what makes the serving engine token-identical to
+        per-request ``generate()``.
+
+        x: Tensor [B, 1, E]; k_buf/v_buf: [B, L, H, hd] arrays;
+        pos: int32 [B] (per-slot write position).  Returns
+        (out Tensor [B, 1, E], k_buf, v_buf).
+        """
+        import jax.numpy as jnp
+
+        if x.shape[1] != 1:
+            raise ValueError(
+                f"decode_slots is a one-token step (got S={x.shape[1]});"
+                " windowed decode keeps the shared-position decode()")
+        qa, ka, va = self._qkv_step(x)
+        rows = jnp.arange(qa.shape[0])
+        k_buf = k_buf.at[rows, pos].set(ka[:, 0].astype(k_buf.dtype))
+        v_buf = v_buf.at[rows, pos].set(va[:, 0].astype(v_buf.dtype))
+        return self._slot_attn(qa, k_buf, v_buf, pos), k_buf, v_buf
+
+    def decode_slots_paged(self, x, k_pool, v_pool, block_tables, pos):
+        """One-token decode reading K/V through per-slot BLOCK TABLES
+        (paged KV cache — serving/kvcache.py): the physical pools hold
+        fixed-size blocks shared across slots (prefix reuse, COW
+        refcounts), and each slot's logical [L] cache row is the gather
+        of its table's blocks.  The write scatters into the block
+        holding ``pos[b]``; the gathered rows then go through the SAME
+        ``_slot_attn`` as the contiguous path, so slot outputs are
+        token-identical to ``decode_slots`` (and hence ``generate()``).
+
+        x: Tensor [B, 1, E]; k_pool/v_pool: [NB, bs, H, hd] arrays;
+        block_tables: int32 [B, L//bs] (physical block per logical
+        block); pos: int32 [B].  Returns (out [B, 1, E], k_pool,
+        v_pool).
+        """
+        import jax.numpy as jnp
+
+        if x.shape[1] != 1:
+            raise ValueError(
+                f"decode_slots_paged is a one-token step "
+                f"(got S={x.shape[1]})")
+        qa, ka, va = self._qkv_step(x)
+        B = qa.shape[0]
+        NB, bs = k_pool.shape[0], k_pool.shape[1]
+        rows = jnp.arange(B)
+        flat_k = k_pool.reshape(NB * bs, self.num_heads, self.head_dim)
+        flat_v = v_pool.reshape(NB * bs, self.num_heads, self.head_dim)
+        # physical row of logical position pos[b] in slot b's table
+        widx = block_tables[rows, pos // bs] * bs + pos % bs      # [B]
+        flat_k = flat_k.at[widx].set(ka[:, 0].astype(flat_k.dtype))
+        flat_v = flat_v.at[widx].set(va[:, 0].astype(flat_v.dtype))
+        # gather each slot's logical row: [B, L] physical indices
+        gidx = ((block_tables * bs)[:, :, None]
+                + jnp.arange(bs)[None, None, :]).reshape(B, -1)
+        out = self._slot_attn(qa, flat_k[gidx], flat_v[gidx], pos)
+        return (out, flat_k.reshape(k_pool.shape),
+                flat_v.reshape(v_pool.shape))
 
     def forward(self, x, cache=None, doc_segments=None):
         b, s, _ = x.shape
@@ -354,6 +412,14 @@ class GPTBlock(nn.Layer):
         x = x + attn_out
         x = x + self.mlp(self.ln2(x))
         return x, k_buf, v_buf
+
+    def decode_slots_paged(self, x, k_pool, v_pool, block_tables, pos):
+        """Block-table one-token decode (GPTAttention.decode_slots_paged)."""
+        attn_out, k_pool, v_pool = self.attn.decode_slots_paged(
+            self.ln1(x), k_pool, v_pool, block_tables, pos)
+        x = x + attn_out
+        x = x + self.mlp(self.ln2(x))
+        return x, k_pool, v_pool
 
     def forward(self, x, cache=None, doc_segments=None):
         if cache is not None:
@@ -595,6 +661,132 @@ class GPTModel(nn.Layer):
             new_k.append(kb)
             new_v.append(vb)
         return self.head(x)._data[:, -1, :], new_k, new_v
+
+    def _decode_tick_slots_paged(self, tok, k_pools, v_pools,
+                                 block_tables, pos):
+        """One-token decode over a PAGED slot pool: like
+        ``_decode_tick_slots`` but K/V live in shared fixed-size blocks
+        and each slot reads/writes through its block table
+        (serving/kvcache.py).  Returns (last_logits [B, V], new_k,
+        new_v)."""
+        import jax.numpy as jnp
+        pos = jnp.asarray(pos, jnp.int32)
+        x = self.embeddings(Tensor(tok), position_ids=Tensor(pos[:, None]))
+        new_k, new_v = [], []
+        for j, blk in enumerate(self.blocks):
+            x, kb, vb = blk.decode_slots_paged(x, k_pools[j], v_pools[j],
+                                               block_tables, pos)
+            new_k.append(kb)
+            new_v.append(vb)
+        return self.head(x)._data[:, -1, :], new_k, new_v
+
+    def _compiled_slot_paged_decode_fn(self, pnames, params, cache_key):
+        """Build (or fetch) the jitted PAGED slot-pool decode step:
+        (p_list, b_list, k_pools, v_pools, block_tables [B, L//bs],
+        tok [B,1], pos [B]) -> (last_logits [B,V], k_pools, v_pools).
+        The block-table twin of ``_compiled_slot_decode_fn``: the K/V
+        pools are [NB, bs, H, hd] blocks shared across slots, and ONE
+        XLA program still serves every tick — block tables are runtime
+        int32 inputs, not program constants.  Pools donated (in-place
+        update, no per-tick copy)."""
+        import jax
+        from ..core import autograd
+        from ..jit import _swapped
+
+        cache = getattr(self, "_slot_paged_decode_fn_cache", None)
+        if cache is None:
+            cache = self._slot_paged_decode_fn_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        model = self
+        mbuffers = dict(self.named_buffers())
+        bnames = sorted(mbuffers)
+
+        def pure(p_list, b_list, k_pools, v_pools, block_tables, tok,
+                 pos):
+            with _swapped(params, dict(zip(pnames, p_list))), \
+                    _swapped(mbuffers, dict(zip(bnames, b_list))):
+                with autograd.no_grad():
+                    last, new_k, new_v = model._decode_tick_slots_paged(
+                        tok, k_pools, v_pools, block_tables, pos)
+            return last, new_k, new_v
+
+        fn = jax.jit(pure, donate_argnums=(2, 3))
+        if len(cache) >= 8:  # FIFO bound, matching the other decode caches
+            cache.pop(next(iter(cache)))
+        cache[cache_key] = (fn, bnames, mbuffers)
+        return cache[cache_key]
+
+    def _compiled_paged_prefill_fn(self, pnames, params, cache_key,
+                                   s_tail, n_ctx, n_tail, bs, nh, hd,
+                                   kv_dtype):
+        """Build (or fetch) the jitted BLOCK-GRANULAR prefill: (p_list,
+        b_list, k_pools, v_pools, ids_tail [1, s_tail], ctx_blocks
+        [n_ctx], tail_blocks [n_tail]) -> (last_logits [1, V], k_pools,
+        v_pools).  ONE dispatch per admission: gathers the adopted
+        prefix blocks as attention context (``n_ctx`` full blocks =
+        the prefix-cache hit span, whose K/V is NOT recomputed), runs
+        the prompt's non-shared tail at position offset ``n_ctx*bs``,
+        and scatters the tail's K/V into the slot's fresh blocks.
+        ``n_ctx = 0`` is the miss case — then this computes exactly
+        what ``_compiled_prefill_fn`` computes (same forward, empty
+        context), just stored block-granular.  The pad rows of the last
+        (partial) tail block hold garbage that is parity-safe for the
+        same reason as bucketed prefill: the causal gather mask hides
+        positions > pos until decode overwrites them, and partial
+        blocks are never registered in the prefix cache.  Pools
+        donated."""
+        import jax
+        import jax.numpy as jnp
+        from ..core import autograd
+        from ..jit import _swapped
+
+        cache = getattr(self, "_paged_prefill_fn_cache", None)
+        if cache is None:
+            cache = self._paged_prefill_fn_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        model = self
+        mbuffers = dict(self.named_buffers())
+        bnames = sorted(mbuffers)
+        ctx_len = n_ctx * bs
+
+        def pure(p_list, b_list, k_pools, v_pools, ids_arr, ctx_blocks,
+                 tail_blocks):
+            with _swapped(params, dict(zip(pnames, p_list))), \
+                    _swapped(mbuffers, dict(zip(bnames, b_list))):
+                with autograd.no_grad():
+                    caches = [
+                        (Tensor(kp[ctx_blocks].reshape(
+                            1, ctx_len, nh, hd)),
+                         Tensor(vp[ctx_blocks].reshape(
+                             1, ctx_len, nh, hd)))
+                        for kp, vp in zip(k_pools, v_pools)]
+                    logits, caches = model.forward(
+                        Tensor(ids_arr), caches=caches,
+                        position_offset=ctx_len)
+                    pad = ((0, 0), (0, n_tail * bs - s_tail),
+                           (0, 0), (0, 0))
+                    new_k, new_v = [], []
+                    for (ck, cv), kp, vp in zip(caches, k_pools,
+                                                v_pools):
+                        kt = jnp.pad(ck._data[:, ctx_len:], pad)[0] \
+                            .reshape(n_tail, bs, nh, hd)
+                        vt = jnp.pad(cv._data[:, ctx_len:], pad)[0] \
+                            .reshape(n_tail, bs, nh, hd)
+                        new_k.append(kp.at[tail_blocks].set(
+                            kt.astype(kp.dtype)))
+                        new_v.append(vp.at[tail_blocks].set(
+                            vt.astype(vp.dtype)))
+            return logits._data[:, -1, :], new_k, new_v
+
+        fn = jax.jit(pure, donate_argnums=(2, 3))
+        if len(cache) >= 8:  # FIFO bound, matching _prefill_fn_cache
+            cache.pop(next(iter(cache)))
+        cache[cache_key] = (fn, bnames, mbuffers)
+        return cache[cache_key]
 
     def _compiled_slot_decode_fn(self, pnames, params, cache_key):
         """Build (or fetch) the jitted SLOT-POOL decode step: (p_list,

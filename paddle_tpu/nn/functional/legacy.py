@@ -2112,7 +2112,11 @@ def dynamic_lstmp(input, size, proj_size, weight, proj_weight, bias=None,
     return proj, c
 
 
+import itertools as _itertools
+
 _fluid_lstm_registry: dict = {}
+_fluid_lstm_reuse_warned: set = set()
+_fluid_lstm_prog_ids = _itertools.count()
 
 
 def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
@@ -2130,18 +2134,52 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
     [num_layers*D, B, hidden]) like the reference.
     """
     import sys as _sys
+    import warnings as _warnings
     from ..layer.rnn import LSTM as _LSTM
     input = ensure_tensor(input)
     if name is None:
-        # unnamed calls key on the CALL SITE, mirroring the reference
-        # where each op call in the program owns its own weight blob —
-        # two different unnamed LSTMs must not silently share weights
-        fr = _sys._getframe(1)
-        ident = (fr.f_code.co_filename, fr.f_lineno)
+        # Unnamed calls: the reference gives every op CONSTRUCTION its
+        # own weight blob.  Static-graph builds run once, so each call
+        # gets a per-program instance token (program identity + call
+        # ordinal) — two LSTMs built through one factory line stay
+        # distinct, exactly like the reference.  Dynamic mode cannot
+        # tell "training-loop re-call" (must share) from "second
+        # factory-built instance" (must not) at the same line, so it
+        # keys on the call site and warns once on reuse — pass
+        # ``name=`` to disambiguate.
+        try:
+            from ...static import program as _sprog
+            in_static = isinstance(input, _sprog.Variable)
+        except ImportError:
+            in_static = False
+        if in_static:
+            prog = _sprog.default_main_program()
+            # a token minted per program, NOT id(prog): an id can be
+            # recycled by a later program allocated at the same address,
+            # which would silently resurrect the dead program's weights
+            tok = getattr(prog, "_fluid_lstm_token", None)
+            if tok is None:
+                tok = prog._fluid_lstm_token = next(_fluid_lstm_prog_ids)
+            seq = getattr(prog, "_fluid_lstm_seq", 0)
+            prog._fluid_lstm_seq = seq + 1
+            ident = ("program", tok, seq)
+        else:
+            fr = _sys._getframe(1)
+            ident = (fr.f_code.co_filename, fr.f_lineno)
     else:
         ident = name
     key = (ident, int(input.shape[-1]), int(hidden_size),
            int(num_layers), bool(is_bidirec))
+    if name is None and key in _fluid_lstm_registry \
+            and key not in _fluid_lstm_reuse_warned:
+        _fluid_lstm_reuse_warned.add(key)
+        _warnings.warn(
+            "fluid.layers.lstm: unnamed call site "
+            f"{ident[0]}:{ident[1]} is REUSING its cached weights "
+            "(correct for a training loop re-calling the same LSTM; "
+            "wrong if this line is a factory building distinct LSTMs "
+            "— pass name= to give each instance its own parameters)",
+            UserWarning, stacklevel=2)
     if key not in _fluid_lstm_registry:
         _fluid_lstm_registry[key] = _LSTM(
             int(input.shape[-1]), int(hidden_size), int(num_layers),

@@ -40,6 +40,7 @@ class _Converter:
         self.opset = opset
         self._n = 0
         self.names = {}       # jax Var -> onnx name
+        self._const_memo = {}  # (dtype, shape, bytes) -> initializer name
 
     # -- naming / constants ------------------------------------------------
     def fresh(self, hint="v"):
@@ -53,6 +54,16 @@ class _Converter:
 
     def add_const(self, arr, name=None):
         arr = np.asarray(arr)
+        raw = np.ascontiguousarray(arr).tobytes()
+        memo_key = None
+        if name is None:
+            # memoize unnamed constants by value: jaxprs repeat shape
+            # vectors / scale scalars constantly, and emitting each as
+            # its own initializer bloats the file with duplicates
+            memo_key = (str(arr.dtype), arr.shape, raw)
+            hit = self._const_memo.get(memo_key)
+            if hit is not None:
+                return hit
         name = name or self.fresh("const")
         t = self.g.initializer.add()
         t.name = name
@@ -62,7 +73,9 @@ class _Converter:
             raise NotImplementedError(
                 f"onnx.export: dtype {arr.dtype} has no ONNX mapping")
         t.data_type = dt
-        t.raw_data = np.ascontiguousarray(arr).tobytes()
+        t.raw_data = raw
+        if memo_key is not None:
+            self._const_memo[memo_key] = name
         return name
 
     def node(self, op, inputs, n_out=1, **attrs):
@@ -344,10 +357,20 @@ class _Converter:
                             pads[2][1], pads[3][1]])
             if p == "reduce_window_max":
                 return out(self.node("MaxPool", ins, **kw))
+            # the scale constant must match the TENSOR dtype: a float32
+            # scalar against a float64/float16 AveragePool output makes
+            # the Mul operands mismatch — an invalid model with no
+            # export-time error
+            in_dtype = np.dtype(eqn.invars[0].aval.dtype)
+            if in_dtype.kind != "f":
+                raise NotImplementedError(
+                    f"onnx.export: sum-pooling over {in_dtype} — "
+                    "AveragePool (the Mul-rescaled lowering) is "
+                    "float-only; use StableHLO export")
             ap = self.node("AveragePool", ins,
                            count_include_pad=1, **kw)
             scale = self.add_const(
-                np.asarray(float(wd[2] * wd[3]), np.float32))
+                np.asarray(float(wd[2] * wd[3]), in_dtype))
             return out(self.node("Mul", [ap, scale]))
         if p == "iota":
             aval = eqn.outvars[0].aval

@@ -6,16 +6,23 @@ step over a fixed pool of batch slots, a ``Scheduler`` admits queued
 requests into free slots (prefill on admission, eviction on EOS /
 max_new_tokens), a ``RequestQueue`` enforces per-request deadlines, and
 ``serving.httpd`` exposes the whole thing over stdlib HTTP for smoke
-serving.  Metrics (queue depth, slot occupancy, tokens/sec, TTFT/TPOT)
-land in paddle_tpu.monitor and render via ``render_prometheus()``.
+serving.  ``serving.kvcache`` pages the K/V pools into fixed-size
+refcounted blocks (``Engine(kv_block_size=...)``): identical prompt
+prefixes share physical blocks and a token-trie ``PrefixCache`` lets
+admission skip prefill for previously-seen spans, with LRU eviction
+under pool pressure.  Metrics (queue depth, slot occupancy,
+tokens/sec, TTFT/TPOT, KV blocks in use, prefix hits/evictions) land
+in paddle_tpu.monitor and render via ``render_prometheus()``.
 """
 from .request import (  # noqa: F401
     Request, RequestQueue, RequestTimeout, QueueFull)
 from .scheduler import Scheduler, Slot  # noqa: F401
+from .kvcache import BlockPool, NoFreeBlocks, PrefixCache  # noqa: F401
 from .engine import Engine  # noqa: F401
 from .httpd import EngineServer, serve  # noqa: F401
 
 __all__ = [
     "Request", "RequestQueue", "RequestTimeout", "QueueFull",
     "Scheduler", "Slot", "Engine", "EngineServer", "serve",
+    "BlockPool", "PrefixCache", "NoFreeBlocks",
 ]

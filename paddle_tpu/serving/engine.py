@@ -30,6 +30,7 @@ import time
 import numpy as np
 
 from .. import monitor
+from .kvcache import BlockPool, PrefixCache
 from .request import Request, RequestQueue
 from .scheduler import Scheduler
 
@@ -84,6 +85,29 @@ class Engine:
         true last-token logits are sliced at s-1, and the garbage cache
         rows past s are each overwritten by decode before any query can
         see them.
+    kv_block_size : enable the PAGED KV cache (serving/kvcache.py).
+        ``None`` (default) keeps the contiguous per-slot rows; an int
+        (must divide max_seq_len) carves the pools into fixed-size
+        blocks that slots address through block tables — identical
+        prompt prefixes share physical blocks, and admission adopts
+        cached prefixes so prefill skips the shared span entirely.
+        Greedy outputs stay token-identical to the contiguous path
+        (same f32 score math over the gathered rows); on TPU a
+        near-tie logit may round differently between donor and adopter
+        prefill shapes — the same cross-shape caveat as speculative
+        decode.  Not combinable with prefill_buckets (the paged
+        prefill compiles per (context, tail) length instead).
+    kv_blocks : physical block count of the paged pool (default:
+        ``num_slots * max_seq_len / kv_block_size`` — the same HBM as
+        the contiguous layout; prefix sharing then YIELDS headroom
+        that cached prefixes occupy rent-free).  Admission reserves a
+        request's worst-case blocks up front, so decode never
+        allocates; when the pool cannot cover a request even after LRU
+        eviction of unreferenced prefixes, it simply waits in queue.
+    prefix_cache : keep finished prompts' full blocks resident in a
+        token-trie so later requests adopt them (paged mode only;
+        default True).  ``False`` pages without reuse — the A/B
+        baseline for the parity tests and bench.
 
     ``step()`` is single-threaded by design — run it from one loop
     (``run_until_idle`` or the ``start()`` background thread).
@@ -92,7 +116,8 @@ class Engine:
     """
 
     def __init__(self, model, num_slots=4, max_seq_len=None,
-                 max_queue=0, registry=None, prefill_buckets=None):
+                 max_queue=0, registry=None, prefill_buckets=None,
+                 kv_block_size=None, kv_blocks=None, prefix_cache=True):
         if getattr(model, "scan_layers", False):
             model = model._sync_decode_twin()
         model.eval()
@@ -137,6 +162,28 @@ class Engine:
             self._prefill_buckets = bs
         else:
             self._prefill_buckets = None
+        self._paged = kv_block_size is not None
+        if self._paged:
+            bsz = int(kv_block_size)
+            if bsz < 1 or self.max_seq_len % bsz:
+                raise ValueError(
+                    f"kv_block_size must be >= 1 and divide max_seq_len"
+                    f" ({self.max_seq_len}), got {bsz}")
+            if self._prefill_buckets is not None:
+                raise ValueError(
+                    "prefill_buckets cannot combine with kv_block_size:"
+                    " the paged prefill compiles per (context, tail) "
+                    "length instead of per bucket")
+            self._bs = bsz
+            self._bps = self.max_seq_len // bsz  # blocks per full slot
+            managed = (self.num_slots * self._bps if kv_blocks is None
+                       else int(kv_blocks))
+            if managed < self._bps:
+                raise ValueError(
+                    f"kv_blocks={managed} cannot hold even one "
+                    f"max-length request ({self._bps} blocks)")
+            self._kv_managed = managed
+            self._prefix_enabled = bool(prefix_cache)
         self._reset_pools()
         self._rngs = {}  # request id -> np.random.Generator (sampling)
 
@@ -170,6 +217,27 @@ class Engine:
             "(ms, per finished request)")
         self._m_rate = monitor.RateMeter(reg.gauge(
             "serving.tokens_per_sec", "windowed decode throughput"))
+        # paged-KV surface (registered always so dashboards see the
+        # names; they stay zero in contiguous mode)
+        self._m_prefill_tokens = reg.counter(
+            "serving.prefill_tokens", "prompt tokens actually computed"
+            " in prefill (prefix-cache hits skip the shared span)")
+        self._m_kv_blocks = reg.gauge(
+            "serving.kv_blocks_in_use", "paged KV blocks referenced by"
+            " slots or cached prefixes")
+        self._m_kv_total = reg.gauge(
+            "serving.kv_blocks_total", "paged KV pool size in blocks")
+        if self._paged:
+            self._m_kv_total.set(self._kv_managed)
+        self._m_prefix_hits = reg.counter(
+            "serving.prefix_hits", "admissions that adopted a cached "
+            "prompt prefix")
+        self._m_prefix_hit_tokens = reg.counter(
+            "serving.prefix_hit_tokens", "prompt tokens served from "
+            "cached prefix blocks (prefill skipped)")
+        self._m_prefix_evictions = reg.counter(
+            "serving.prefix_evictions", "cached prefix blocks evicted "
+            "(LRU) under pool pressure")
 
         self._insert_fn = None
         self._tick_fn = None    # resolved jitted slot-decode handle
@@ -181,12 +249,29 @@ class Engine:
         #                             that loop must drain on exit
 
     def _reset_pools(self):
-        """(Re)allocate the per-layer K/V slot pools and per-slot step
+        """(Re)allocate the per-layer K/V pools and per-slot step
         state.  Also the failure-recovery path: a decode dispatch that
         dies AFTER consuming its donated pools leaves them deleted, so
-        the loop handler must rebuild before the next tick."""
+        the loop handler must rebuild before the next tick.  In paged
+        mode the block pool, prefix cache, and block tables are rebuilt
+        with the arrays — cached prefixes die with the device rows
+        they described."""
         import jax.numpy as jnp
-        shape = (self.num_slots, self.max_seq_len, self._nh, self._hd)
+        if self._paged:
+            # +1: physical row 0 is the scratch block parked (inactive)
+            # slots read/write through — their garbage compute may not
+            # touch a block some live request owns
+            shape = (self._kv_managed + 1, self._bs, self._nh, self._hd)
+            self.block_pool = BlockPool(self._kv_managed + 1, self._bs,
+                                        reserved_blocks=1)
+            self.prefix_cache = PrefixCache(self.block_pool) \
+                if self._prefix_enabled else None
+            self._block_tables = np.zeros((self.num_slots, self._bps),
+                                          np.int32)
+            self._slot_blocks = [[] for _ in range(self.num_slots)]
+        else:
+            shape = (self.num_slots, self.max_seq_len, self._nh,
+                     self._hd)
         self.k_pools = [jnp.zeros(shape, self._kv_dtype)
                         for _ in self.model.blocks]
         self.v_pools = [jnp.zeros(shape, self._kv_dtype)
@@ -255,9 +340,96 @@ class Engine:
     def refresh_params(self):
         """Re-snapshot param/buffer handles after external weight
         mutation (the compiled programs themselves are keyed on names
-        and dtypes and survive value changes)."""
+        and dtypes and survive value changes).  Cached prefixes are
+        K/V computed under the OLD weights — an adopter would silently
+        decode against stale state — so the prefix cache is flushed
+        (blocks still referenced by in-flight slots stay alive until
+        their eviction)."""
         self._p_arrays = None
         self._b_arrays = None
+        if self._paged and self.prefix_cache is not None:
+            self.prefix_cache.clear()
+
+    # -- paged KV cache (serving/kvcache.py) ---------------------------
+    def _kv_gate(self, req):
+        """Paged admission gate — the scheduler consults it before
+        binding a slot.  Matches the prompt against the prefix cache
+        (adopting the shared span's blocks), then reserves every block
+        the request could need UP FRONT, so decode never allocates and
+        a running request can never die of pool pressure mid-stream.
+        Under pressure, LRU-evicts unreferenced cached prefixes; if the
+        pool still cannot cover the non-shared span, returns False and
+        the request waits at the queue head."""
+        s = len(req.prompt)
+        n_total = -(-(s + req.max_new_tokens) // self._bs)
+        ctx, m = ([], 0)
+        if self.prefix_cache is not None:
+            ctx, m = self.prefix_cache.match(req.prompt)
+        need = n_total - len(ctx)
+        short = need - self.block_pool.free_count()
+        if short > 0 and self.prefix_cache is not None:
+            evicted = self.prefix_cache.evict(short)
+            if evicted:
+                self._m_prefix_evictions.inc(len(evicted))
+        if need > self.block_pool.free_count():
+            self.block_pool.decref(ctx)  # the cache keeps its own refs
+            return False
+        fresh = self.block_pool.alloc(need)
+        req._kv_plan = (ctx, fresh, m)
+        return True
+
+    def _release_slot_kv(self, i):
+        """Return slot i's block references (eviction path): cached
+        prefix blocks fall back to the cache's reference and stay
+        resident; decode-span blocks free."""
+        if not self._paged:
+            return
+        self.block_pool.decref(self._slot_blocks[i])
+        self._slot_blocks[i] = []
+        self._block_tables[i, :] = 0
+
+    def _prefill_paged(self, slot):
+        """Paged admission prefill: ONE jitted dispatch gathers the
+        adopted prefix blocks as attention context, runs the prompt's
+        non-shared tail, and scatters the tail's K/V block-granular
+        into the slot's fresh blocks — a prefix hit neither recomputes
+        nor re-stores the shared span.  The prompt's full blocks are
+        then registered in the prefix cache for later adopters."""
+        import jax.numpy as jnp
+        req = slot.request
+        ctx, fresh, m = req._kv_plan
+        del req._kv_plan
+        i = slot.index
+        blocks = ctx + fresh
+        self._slot_blocks[i] = blocks
+        row = np.zeros(self._bps, np.int32)  # scratch-padded tail
+        row[:len(blocks)] = blocks
+        self._block_tables[i] = row
+        s = len(req.prompt)
+        n_ctx = len(ctx)
+        s_tail = s - m
+        n_tail = -(-s // self._bs) - n_ctx
+        pf, _, _ = self.model._compiled_paged_prefill_fn(
+            self._pnames, self._params,
+            (s_tail, n_ctx, n_tail, self._bs, str(self._kv_dtype),
+             tuple(self._pnames), self._bnames_all),
+            s_tail, n_ctx, n_tail, self._bs, self._nh, self._hd,
+            self._kv_dtype)
+        last0, self.k_pools, self.v_pools = pf(
+            self._p_list(), self._b_list(), self.k_pools, self.v_pools,
+            req.prompt[None, m:],
+            jnp.asarray(np.asarray(ctx, np.int32)),
+            jnp.asarray(np.asarray(fresh[:n_tail], np.int32)))
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt, blocks[:s // self._bs])
+        self._m_prefill_tokens.inc(s_tail)
+        if m:
+            self._m_prefix_hits.inc()
+            self._m_prefix_hit_tokens.inc(m)
+        slot.pos = s
+        self._pos[i] = s
+        tok = self._pick(req, np.asarray(last0, np.float32)[0])
+        self._emit(slot, tok)
 
     def _prefill(self, slot):
         """Admission prefill: one jitted whole-prompt forward (shared
@@ -266,6 +438,8 @@ class Engine:
         right-padded variant when prefill_buckets bounds compiles),
         padded to the pool's L and written into the slot's cache rows."""
         import jax.numpy as jnp
+        if self._paged:
+            return self._prefill_paged(slot)
         req = slot.request
         s = len(req.prompt)
         L = self.max_seq_len
@@ -309,6 +483,7 @@ class Engine:
         self.k_pools, self.v_pools = self._insert_fn(
             self.k_pools, self.v_pools, k_bufs, v_bufs,
             jnp.asarray(i, jnp.int32))
+        self._m_prefill_tokens.inc(s)
         slot.pos = s
         self._pos[i] = s
         tok = self._pick(req, np.asarray(last0, np.float32)[0])
@@ -349,6 +524,7 @@ class Engine:
             self._rngs.pop(req.id, None)
             i = slot.index
             self.scheduler.evict(slot)
+            self._release_slot_kv(i)
             # park the freed row: a frozen pos/tok keeps the inactive
             # row's (ignored) compute in-bounds until the next prefill
             # overwrites the whole cache row
@@ -367,14 +543,30 @@ class Engine:
         if self._tick_fn is None:
             # resolve once: the key embeds tuple(pnames), an O(n_params)
             # copy+hash not worth paying per generated token
-            self._tick_fn, _, _ = self.model._compiled_slot_decode_fn(
-                self._pnames, self._params,
-                (self.num_slots, self.max_seq_len, str(self._kv_dtype),
-                 tuple(self._pnames), self._bnames_all))
+            if self._paged:
+                self._tick_fn, _, _ = \
+                    self.model._compiled_slot_paged_decode_fn(
+                        self._pnames, self._params,
+                        (self.num_slots, self._kv_managed + 1, self._bs,
+                         str(self._kv_dtype), tuple(self._pnames),
+                         self._bnames_all))
+            else:
+                self._tick_fn, _, _ = self.model._compiled_slot_decode_fn(
+                    self._pnames, self._params,
+                    (self.num_slots, self.max_seq_len,
+                     str(self._kv_dtype), tuple(self._pnames),
+                     self._bnames_all))
         fn = self._tick_fn
-        last, self.k_pools, self.v_pools = fn(
-            self._p_list(), self._b_list(), self.k_pools, self.v_pools,
-            jnp.asarray(self._cur_tok), jnp.asarray(self._pos))
+        if self._paged:
+            last, self.k_pools, self.v_pools = fn(
+                self._p_list(), self._b_list(), self.k_pools,
+                self.v_pools, jnp.asarray(self._block_tables),
+                jnp.asarray(self._cur_tok), jnp.asarray(self._pos))
+        else:
+            last, self.k_pools, self.v_pools = fn(
+                self._p_list(), self._b_list(), self.k_pools,
+                self.v_pools, jnp.asarray(self._cur_tok),
+                jnp.asarray(self._pos))
         rows = np.asarray(last, np.float32)
         emitted = 0
         for slot in active:
@@ -414,7 +606,8 @@ class Engine:
         # deadline sweep first: with a full pool nothing gets popped,
         # but queued requests must still time out on schedule
         timed_out = self.queue.expire(now)
-        admitted, admit_timed_out = self.scheduler.admit(now)
+        admitted, admit_timed_out = self.scheduler.admit(
+            now, gate=self._kv_gate if self._paged else None)
         timed_out = timed_out + admit_timed_out
         if timed_out:
             self._m_timeout.inc(len(timed_out))
@@ -428,6 +621,8 @@ class Engine:
             emitted += self._decode_tick(active)
         self._m_queue.set(self.queue.depth())
         self._m_occ.set(self.scheduler.occupancy())
+        if self._paged:
+            self._m_kv_blocks.set(self.block_pool.in_use())
         return emitted
 
     def run_until_idle(self, max_steps=100000):
@@ -499,6 +694,7 @@ class Engine:
         for slot in self.scheduler.active_slots():
             req = self.scheduler.evict(
                 slot, RuntimeError("engine stopped"))
+            self._release_slot_kv(slot.index)
             if req is not None:
                 self._rngs.pop(req.id, None)
                 self._m_done.inc()

@@ -49,12 +49,18 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, monitor.render_prometheus(eng.registry),
                        ctype="text/plain; version=0.0.4")
         elif self.path == "/healthz":
-            self._send_json(200, {
+            info = {
                 "status": "ok",
                 "slots_total": eng.num_slots,
                 "slots_free": eng.scheduler.free_count(),
                 "queue_depth": eng.queue.depth(),
-            })
+            }
+            if getattr(eng, "_paged", False):
+                info["kv_blocks_free"] = eng.block_pool.free_count()
+                info["kv_blocks_cached"] = (
+                    eng.prefix_cache.cached_blocks()
+                    if eng.prefix_cache is not None else 0)
+            self._send_json(200, info)
         else:
             self._send_json(404, {"error": f"no route {self.path}"})
 

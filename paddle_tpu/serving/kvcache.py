@@ -1,0 +1,271 @@
+"""Paged KV-cache: block pool, refcounted copy-on-write, prefix reuse.
+
+PR 1's engine reserves one contiguous ``max_seq_len`` K/V row per slot:
+HBM is held for the worst case of every request, and identical prompt
+prefixes (system prompts, few-shot headers) are recomputed and stored
+once PER REQUEST.  This module is the block-granular fix (the Ragged
+Paged Attention direction, PAPERS.md 2604.15464): the engine's K/V
+pools are carved into fixed-size blocks, a slot's logical cache row is
+the gather of its BLOCK TABLE, identical prefixes share physical
+blocks (refcounted, copy-on-write), and finished prompts stay resident
+in a token-trie ``PrefixCache`` so later requests skip prefill for the
+shared span — with LRU eviction returning blocks under pool pressure.
+
+Host-side METADATA only: the engine owns the device arrays (the same
+split as Scheduler vs Engine), and block ids here are row indices into
+the engine's per-layer ``[num_blocks, block_size, H, hd]`` pools (one
+id indexes every layer — the table is layer-invariant).  Everything is
+driven from the single engine loop thread, so no locking (``submit``
+never touches the cache).
+
+Reference protocol (who holds how many refs on a block):
+
+* ``alloc`` hands blocks out at refcount 1 — the allocating slot's ref.
+* ``PrefixCache.insert`` takes ONE extra ref per newly registered
+  block (the cache's own); already-cached spans are left alone.
+* ``PrefixCache.match`` takes one ref per matched block ON BEHALF OF
+  the adopting slot.
+* Slot eviction decrefs every block in the slot's table exactly once;
+  blocks that were cached drop to the cache's ref and stay resident,
+  decode-span blocks drop to 0 and return to the free list.
+* ``evict`` drops cache refs (LRU, unreferenced leaves first) until
+  enough blocks free up.
+
+The invariant tests live in tests/test_kvcache.py.
+"""
+from __future__ import annotations
+
+
+class NoFreeBlocks(RuntimeError):
+    """The pool cannot satisfy an allocation (even after eviction)."""
+
+
+def _as_ids(blocks):
+    if isinstance(blocks, int):
+        return (blocks,)
+    return blocks
+
+
+class BlockPool:
+    """Fixed-size-block allocator over the engine's K/V pool rows.
+
+    ``reserved_blocks`` low ids are never handed out — the engine pins
+    row 0 as the scratch block that parked (inactive) slots harmlessly
+    read and write through.
+    """
+
+    def __init__(self, num_blocks, block_size, reserved_blocks=0):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if num_blocks - reserved_blocks < 1:
+            raise ValueError(
+                f"pool needs at least one allocatable block "
+                f"({num_blocks} total, {reserved_blocks} reserved)")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.reserved_blocks = int(reserved_blocks)
+        # pop() from the tail hands out low ids first (stable tests)
+        self._free = list(range(self.num_blocks - 1,
+                                self.reserved_blocks - 1, -1))
+        self._ref = [0] * self.num_blocks
+
+    @property
+    def managed_blocks(self):
+        return self.num_blocks - self.reserved_blocks
+
+    def free_count(self):
+        return len(self._free)
+
+    def in_use(self):
+        return self.managed_blocks - len(self._free)
+
+    def refcount(self, block):
+        return self._ref[block]
+
+    def alloc(self, n):
+        """Take ``n`` blocks off the free list at refcount 1."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            raise NoFreeBlocks(
+                f"need {n} blocks, only {len(self._free)} free of "
+                f"{self.managed_blocks} (evict cached prefixes first)")
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._ref[b] = 1
+        return out
+
+    def incref(self, blocks):
+        for b in _as_ids(blocks):
+            if self._ref[b] < 1:
+                raise RuntimeError(
+                    f"incref on free block {b} — a reference can only "
+                    "be shared from a live one")
+            self._ref[b] += 1
+
+    def decref(self, blocks):
+        """Drop one reference per block; blocks reaching refcount 0
+        return to the free list.  Returns the freed ids."""
+        freed = []
+        for b in _as_ids(blocks):
+            if self._ref[b] < 1:
+                raise RuntimeError(f"double free of block {b}")
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                self._free.append(b)
+                freed.append(b)
+        return freed
+
+    def cow(self, block):
+        """Copy-on-write: make the caller's reference to ``block``
+        privately writable.  Sole owner -> the block itself (no copy).
+        Shared -> the caller's ref moves to a fresh block and the
+        caller must copy the device rows; returns ``(writable_block,
+        needs_copy)``.  Raises NoFreeBlocks with the original ref
+        intact if the pool is empty (evict, then retry).
+
+        The serving engine adopts cached prefixes at FULL-block
+        granularity and writes only into freshly allocated blocks, so
+        its steady state never needs the copy — this is the general
+        primitive (partial-block adoption, future mutation paths).
+        """
+        if self._ref[block] < 1:
+            raise RuntimeError(f"cow of free block {block}")
+        if self._ref[block] == 1:
+            return block, False
+        new = self.alloc(1)[0]      # before decref: failure leaves
+        self._ref[block] -= 1       # the shared ref untouched
+        return new, True
+
+
+class _TrieNode:
+    __slots__ = ("key", "block", "parent", "children", "last_used")
+
+    def __init__(self, key, block, parent, last_used):
+        self.key = key
+        self.block = block
+        self.parent = parent
+        self.children = {}
+        self.last_used = last_used
+
+
+class PrefixCache:
+    """Token-trie over FULL blocks of previously-seen prompts.
+
+    Each node covers one block's worth of token ids; node depth i
+    means "positions [i*bs, (i+1)*bs) of some prompt", and its block
+    holds the K/V computed for exactly that token prefix — so an
+    adopter walking the trie from the root gets blocks whose content
+    is what its own prefill would have produced for the shared span.
+    Partial blocks are never cached (the engine trims matches to block
+    boundaries), which keeps adoption pure sharing: writes always land
+    in the adopter's own fresh blocks (``BlockPool.cow`` degenerates
+    to the no-copy case).
+    """
+
+    def __init__(self, pool):
+        self.pool = pool
+        self.block_size = pool.block_size
+        self._children = {}   # root level: key tuple -> _TrieNode
+        self._clock = 0       # LRU stamp (monotonic counter)
+
+    def _tick(self):
+        self._clock += 1
+        return self._clock
+
+    def _iter_nodes(self):
+        stack = list(self._children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            yield node
+
+    def cached_blocks(self):
+        return sum(1 for _ in self._iter_nodes())
+
+    def match(self, tokens):
+        """Longest cached prefix of ``tokens`` in full blocks, capped
+        so at least ONE token is left for the adopter's own prefill
+        (admission still needs a last-position logit to sample from).
+        Takes one pool reference per returned block on behalf of the
+        caller — release with ``pool.decref`` at slot eviction.
+        Returns ``(block_ids, matched_token_count)``."""
+        bs = self.block_size
+        limit = (len(tokens) - 1) // bs
+        blocks = []
+        children = self._children
+        t = self._tick()
+        for i in range(limit):
+            key = tuple(int(x) for x in tokens[i * bs:(i + 1) * bs])
+            node = children.get(key)
+            if node is None:
+                break
+            node.last_used = t
+            blocks.append(node.block)
+            children = node.children
+        self.pool.incref(blocks)
+        return blocks, len(blocks) * bs
+
+    def insert(self, tokens, blocks):
+        """Register ``blocks[i]`` as the cached K/V of ``tokens``'s
+        i-th FULL block.  Existing nodes win (a duplicate block —
+        two same-prefix requests prefilled in the same tick — stays
+        slot-private and frees at eviction); each NEW node takes the
+        cache's own pool reference."""
+        bs = self.block_size
+        children = self._children
+        parent = None
+        t = self._tick()
+        n = min(len(blocks), len(tokens) // bs)
+        for i in range(n):
+            key = tuple(int(x) for x in tokens[i * bs:(i + 1) * bs])
+            node = children.get(key)
+            if node is None:
+                node = _TrieNode(key, blocks[i], parent, t)
+                self.pool.incref(blocks[i])
+                children[key] = node
+            node.last_used = t
+            parent = node
+            children = node.children
+
+    def evict(self, n):
+        """Free at least ``n`` blocks by dropping least-recently-used
+        UNREFERENCED cached prefixes, deepest first (a node with live
+        children or an active adopter — pool refcount > 1 — is never
+        evicted; evicting a leaf exposes its parent as the next
+        candidate).  One trie walk + a heap, not a rescan per freed
+        block — eviction runs inside the engine's step loop and must
+        not stall decode ticks under sustained pressure.  Returns the
+        freed block ids (may be shorter than ``n`` when nothing
+        evictable remains)."""
+        import heapq
+        freed = []
+        heap = [(node.last_used, id(node), node)
+                for node in self._iter_nodes()
+                if not node.children
+                and self.pool.refcount(node.block) == 1]
+        heapq.heapify(heap)
+        while heap and len(freed) < n:
+            _, _, node = heapq.heappop(heap)
+            if node.children or self.pool.refcount(node.block) != 1:
+                continue              # state changed since enqueue
+            owner = (node.parent.children if node.parent
+                     else self._children)
+            if owner.get(node.key) is not node:
+                continue              # already detached
+            owner.pop(node.key)
+            freed.extend(self.pool.decref(node.block))
+            parent = node.parent
+            if parent is not None and not parent.children \
+                    and self.pool.refcount(parent.block) == 1:
+                heapq.heappush(heap,
+                               (parent.last_used, id(parent), parent))
+        return freed
+
+    def clear(self):
+        """Drop every cached prefix (engine reset); returns freed ids."""
+        freed = []
+        for node in list(self._iter_nodes()):
+            freed.extend(self.pool.decref(node.block))
+        self._children = {}
+        return freed

@@ -116,6 +116,15 @@ class RequestQueue:
                     f"{req.id} shed at the edge")
             self._q.append(req)
 
+    def push_front(self, req):
+        """Return a popped-but-not-admitted request to the queue HEAD
+        (the scheduler's gate declined it — e.g. no KV blocks free);
+        FIFO order is preserved.  Exempt from max_queue: the request
+        already held a queue place (a concurrent put may briefly
+        overshoot the bound by one)."""
+        with self._lock:
+            self._q.appendleft(req)
+
     def pop_ready(self, now=None):
         """Pop the next request that has not expired; expired requests
         are failed in place (RequestTimeout) and returned via the

@@ -53,10 +53,17 @@ class Scheduler:
         return self.occupancy() == 0 and self.queue.depth() == 0
 
     # -- admission / eviction -------------------------------------------
-    def admit(self, now=None):
+    def admit(self, now=None, gate=None):
         """Fill free slots from the queue.  Returns (admitted_slots,
         timed_out_requests) — the engine prefills each admitted slot
-        and counts the timeouts."""
+        and counts the timeouts.
+
+        ``gate``: optional resource check consulted per request BEFORE
+        the slot binds (the engine's paged-KV admission gate: prefix
+        cache lookup + up-front block reservation).  A False verdict
+        puts the request back at the queue head and stops this round's
+        admission — FIFO order is preserved and later ticks retry once
+        eviction/completion frees resources."""
         admitted, timed_out = [], []
         with self._lock:
             free = [s for s in self.slots if s.free]
@@ -64,6 +71,9 @@ class Scheduler:
             req, expired = self.queue.pop_ready(now)
             timed_out.extend(expired)
             if req is None:
+                break
+            if gate is not None and not gate(req):
+                self.queue.push_front(req)
                 break
             with self._lock:
                 slot.request = req

@@ -509,19 +509,20 @@ def nms(boxes, scores, iou_threshold=0.3, score_threshold=None, top_k=None,
     return Tensor(kept)
 
 
-def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
-                   keep_top_k, nms_threshold=0.3, normalized=True,
-                   nms_eta=1.0, background_label=0, name=None):
-    """Reference fluid.layers.multiclass_nms, XLA-shaped: returns
-    (out [keep_top_k, 6] rows = [label, score, x1, y1, x2, y2] padded with
-    -1, valid_count scalar).  Single-image input: bboxes [M, 4],
-    scores [C, M].
-    """
+def _multiclass_nms_core(bboxes, scores, score_threshold, nms_top_k,
+                         keep_top_k, nms_threshold, normalized,
+                         background_label):
+    """Shared per-class hard-NMS selection: returns (out [keep_top_k,
+    6] rows = [label, score, x1, y1, x2, y2] padded -1, index
+    [keep_top_k] int32 = each kept row's source row in ``bboxes``
+    padded -1, valid count scalar).  The index rides the exact same
+    selection/sort as the rows — ``nms`` already returns kept ORIGINAL
+    box indices, so threading them out costs one extra gather."""
     bboxes_t = ensure_tensor(bboxes)._data
     scores_t = ensure_tensor(scores)._data
     c, m = scores_t.shape
     iou = _iou_matrix(bboxes_t, normalized)  # shared across classes
-    rows = []
+    rows, idxs = [], []
     for cls in range(c):
         if cls == background_label:
             continue
@@ -536,21 +537,58 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
             jnp.where(valid, cls, -1.0)[:, None],
             jnp.where(valid, scores_t[cls][idx], -1.0)[:, None],
             jnp.where(valid[:, None], bboxes_t[idx], -1.0)], axis=1))
+        idxs.append(jnp.where(valid, keep, -1))
     if not rows:  # only the background class exists
-        return (Tensor(jnp.full((keep_top_k, 6), -1.0, bboxes_t.dtype)),
-                Tensor(jnp.zeros((), jnp.int32)))
+        return (jnp.full((keep_top_k, 6), -1.0, bboxes_t.dtype),
+                jnp.full((keep_top_k,), -1, jnp.int32),
+                jnp.zeros((), jnp.int32))
     allrows = jnp.concatenate(rows, axis=0)
+    allidx = jnp.concatenate(idxs, axis=0)
     if allrows.shape[0] < keep_top_k:  # keep the promised static shape
-        pad = jnp.full((keep_top_k - allrows.shape[0], 6), -1.0,
-                       allrows.dtype)
-        allrows = jnp.concatenate([allrows, pad], axis=0)
+        pad = keep_top_k - allrows.shape[0]
+        allrows = jnp.concatenate(
+            [allrows, jnp.full((pad, 6), -1.0, allrows.dtype)], axis=0)
+        allidx = jnp.concatenate(
+            [allidx, jnp.full((pad,), -1, allidx.dtype)])
     valid = allrows[:, 0] >= 0
     order = jnp.argsort(jnp.where(valid, -allrows[:, 1], jnp.inf))
-    allrows = allrows[order]
-    valid = allrows[:, 0] >= 0
+    allrows, allidx = allrows[order], allidx[order]
     out = allrows[:keep_top_k]
-    count = jnp.minimum(valid.sum(), keep_top_k)
-    return Tensor(out), Tensor(count.astype(jnp.int32))
+    out_idx = allidx[:keep_top_k].astype(jnp.int32)
+    count = jnp.minimum((out[:, 0] >= 0).sum(), keep_top_k)
+    return out, out_idx, count.astype(jnp.int32)
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
+                   keep_top_k, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, name=None):
+    """Reference fluid.layers.multiclass_nms, XLA-shaped: returns
+    (out [keep_top_k, 6] rows = [label, score, x1, y1, x2, y2] padded with
+    -1, valid_count scalar).  Single-image input: bboxes [M, 4],
+    scores [C, M].
+    """
+    out, _, count = _multiclass_nms_core(
+        bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+        nms_threshold, normalized, background_label)
+    return Tensor(out), Tensor(count)
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k,
+                    keep_top_k, nms_threshold=0.3, normalized=True,
+                    nms_eta=1.0, background_label=0,
+                    return_index=False, name=None):
+    """Reference fluid.contrib multiclass_nms2: ``multiclass_nms``
+    that can also return WHERE each kept detection came from.
+    ``return_index=True`` adds index [keep_top_k] int32 — the kept
+    row's source row in ``bboxes`` (padded -1), so
+    ``bboxes[index[i]]`` is out[i]'s box and ``scores[label, index[i]]``
+    its pre-NMS score.  Returns (out, index) or just out."""
+    out, idx, _ = _multiclass_nms_core(
+        bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+        nms_threshold, normalized, background_label)
+    if return_index:
+        return Tensor(out), Tensor(idx)
+    return Tensor(out)
 
 
 # ---- deform_conv2d (reference: vision/ops.py:394, deformable_conv_op) ---

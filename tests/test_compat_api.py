@@ -214,6 +214,31 @@ def test_reset_profiler_thread_safe_against_exits(tmp_path):
         prof.stop_profiler()
 
 
+def test_launch_reserves_master_port_until_spawn():
+    """ADVICE low (launch.py): the probe socket is HELD until workers
+    start, so a concurrent launch cannot steal the master port between
+    probe and bind; SO_REUSEADDR lets the real owner bind the instant
+    the probe closes."""
+    import socket
+    from paddle_tpu.distributed.launch import _free_port, _reserve_port
+    s = _reserve_port()
+    port = s.getsockname()[1]
+    probe = socket.socket()
+    try:
+        with pytest.raises(OSError):  # held: nobody can take it
+            probe.bind(("127.0.0.1", port))
+    finally:
+        probe.close()
+    s.close()
+    owner = socket.socket()  # released: owner binds immediately
+    owner.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        owner.bind(("127.0.0.1", port))
+    finally:
+        owner.close()
+    assert isinstance(_free_port(), int)  # legacy helper still works
+
+
 def test_dlpack_roundtrip():
     from paddle_tpu.utils import dlpack
     x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))

@@ -71,9 +71,17 @@ class TestContribLayers:
         assert callable(cl.tree_conv)
         assert callable(cl.sparse_embedding)
         assert callable(cl.multiclass_nms2)
-        with pytest.raises(NotImplementedError, match="return_index"):
-            cl.multiclass_nms2(None, None, 0.1, 10, 10,
-                               return_index=True)
+        # return_index works now (VERDICT missing #4): index = source
+        # row of each kept detection, padded -1
+        boxes = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+        scores = np.array([[0.1, 0.1], [0.9, 0.8]], np.float32)
+        out, idx = cl.multiclass_nms2(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            0.2, 10, 4, return_index=True)
+        n = int((out.numpy()[:, 0] >= 0).sum())
+        assert n == 2
+        assert sorted(idx.numpy()[:n].tolist()) == [0, 1]
+        assert (idx.numpy()[n:] == -1).all()
 
 
 def _np_match_matrix(x, y, w, xl, yl):

@@ -983,6 +983,50 @@ class TestFluidLstmAndLodAppend:
         assert list(outb.shape) == [2, 5, 12]
         assert list(hb.shape) == [4, 2, 6]
 
+    def test_lstm_unnamed_same_line_shares_and_warns_once(self):
+        """ADVICE medium: an unnamed call-site cache entry reuse is
+        legitimate for a training loop (same line re-called per step,
+        weights must persist) but ambiguous for a factory — the reuse
+        now warns ONCE per site recommending name=."""
+        import warnings
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(2)
+        x = T(rs.randn(2, 4, 3).astype("float32"))
+        outs = []
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            for _ in range(3):
+                o, _, _ = F.lstm(x, None, None, 4, 5, 1)  # one line
+                outs.append(o.numpy())
+        np.testing.assert_allclose(outs[0], outs[1])
+        np.testing.assert_allclose(outs[0], outs[2])
+        assert sum("REUSING" in str(wi.message) for wi in w) == 1
+
+    def test_lstm_static_program_instances_distinct(self):
+        """ADVICE medium: in static-graph builds every construction
+        call owns fresh weights (per-program instance token in the
+        cache key) — two LSTMs built through ONE factory line no
+        longer silently share parameters."""
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu import static
+        from paddle_tpu.nn.functional.legacy import _fluid_lstm_registry
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                xi = static.data("lstm_x", [2, 4, 3], "float32")
+                outs = [F.lstm(xi, None, None, 4, 5, 1)
+                        for _ in range(2)]          # one factory line
+            keys = [k for k in _fluid_lstm_registry
+                    if isinstance(k[0], tuple) and k[0][0] == "program"
+                    and k[0][1] == prog._fluid_lstm_token]
+            assert len(keys) == 2
+            assert (_fluid_lstm_registry[keys[0]]
+                    is not _fluid_lstm_registry[keys[1]])
+            assert len(outs) == 2
+        finally:
+            paddle.disable_static()
+
     def test_lod_append_nests(self):
         from paddle_tpu.core.ragged import RaggedTensor
         x = T(np.arange(14).reshape(7, 2).astype("float32"))

@@ -243,6 +243,58 @@ def test_bert_onnx_numerics(tmp_path):
     np.testing.assert_allclose(out, golden, rtol=1e-3, atol=2e-4)
 
 
+def test_avgpool_scale_matches_tensor_dtype(tmp_path):
+    """ADVICE low: reduce_window_sum's AveragePool rescale constant
+    must carry the TENSOR dtype — a float32 scalar in a float64 graph
+    makes the Mul operands mismatch (invalid model, no export error)."""
+    from paddle_tpu import nn
+
+    class SumPool(nn.Layer):
+        def forward(self, x):
+            # lowers through reduce_window_sum (+ div by the count)
+            return paddle.nn.functional.avg_pool2d(
+                x, kernel_size=2, stride=2)
+
+    # float64 would silently trace as float32 (jax x64 off), so the
+    # narrow/wide pair here is float16 vs float32
+    for dtype, want in (("float32", 1), ("float16", 10)):
+        path = paddle.onnx.export(
+            SumPool(), str(tmp_path / f"sp_{dtype}"),
+            input_spec=[static.InputSpec([1, 1, 4, 4], dtype)])
+        m = _load(path)
+        muls = [n for n in m.graph.node if n.op_type == "Mul"]
+        assert muls, "expected the AveragePool rescale Mul"
+        inits = {t.name: t for t in m.graph.initializer}
+        scale_dts = [inits[x].data_type for n in muls for x in n.input
+                     if x in inits]
+        assert scale_dts and all(dt == want for dt in scale_dts), \
+            (dtype, scale_dts)
+
+
+def test_initializer_dedup(tmp_path):
+    """ADVICE low: unnamed constants are memoized by (dtype, shape,
+    bytes) — a graph repeating the same shape vector / scalar emits ONE
+    initializer, not one per use."""
+    from paddle_tpu import nn
+
+    class TwiceReshaped(nn.Layer):
+        def forward(self, x):
+            a = paddle.reshape(x, [2, 6]) * 2.0
+            b = paddle.reshape(x, [2, 6]) * 2.0  # same shape + scalar
+            return paddle.reshape(a + b, [12])
+
+    path = paddle.onnx.export(
+        TwiceReshaped(), str(tmp_path / "dedup"),
+        input_spec=[static.InputSpec([3, 4], "float32")])
+    m = _load(path)
+    seen = {}
+    for t in m.graph.initializer:
+        key = (t.data_type, tuple(t.dims), t.raw_data)
+        assert key not in seen, \
+            f"duplicate initializer: {t.name} == {seen[key]}"
+        seen[key] = t.name
+
+
 def test_dynamic_dims_guided(tmp_path):
     from paddle_tpu import nn
     with pytest.raises(ValueError, match="StableHLO"):
