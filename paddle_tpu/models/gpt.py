@@ -287,6 +287,76 @@ class GPTAttention(nn.Layer):
         return (out, flat_k.reshape(k_pool.shape),
                 flat_v.reshape(v_pool.shape))
 
+    def prefill_chunk_paged(self, x, k_pool, v_pool, block_table, pos,
+                            true_len):
+        """CHUNKED prefill through ONE slot's block table (budgeted
+        chunked prefill — serving/engine.py ``prefill_chunk``): run a
+        fixed-size window of C prompt tokens at positions
+        ``pos..pos+C-1``, scattering their K/V block-granular through
+        the slot's table and attending causally over the slot's whole
+        gathered logical row — the adopted prefix blocks and earlier
+        chunks' K/V included.  All shapes are static (C, pool, table);
+        ``pos``/``true_len`` are traced scalars, so ONE XLA program
+        serves every chunk of every prompt.  Pad lanes (>= true_len)
+        scatter into physical row 0 — the engine's scratch block, whose
+        content no live request ever reads.
+
+        x: Tensor [1, C, E]; k_pool/v_pool: [NB, bs, H, hd] arrays;
+        block_table: int32 [L//bs] (ONE slot's row); pos/true_len:
+        traced int scalars.  Returns (out Tensor [1, C, E], k_pool,
+        v_pool).
+        """
+        import math as _math
+        import jax
+        import jax.numpy as jnp
+
+        C = x.shape[1]
+        if self.use_mp:
+            q, k, v = self._qkv_mp(x)
+        else:
+            qkv = self.qkv_proj(x)
+            qkv = reshape(qkv, [1, C, 3, self.num_heads, self.head_dim])
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        qa, ka, va = q._data, k._data, v._data
+        NB, bs = k_pool.shape[0], k_pool.shape[1]
+        flat_k = k_pool.reshape(NB * bs, self.num_heads, self.head_dim)
+        flat_v = v_pool.reshape(NB * bs, self.num_heads, self.head_dim)
+        offs = pos + jnp.arange(C)                              # [C]
+        valid = jnp.arange(C) < true_len
+        offs_safe = jnp.where(valid, offs, 0)
+        # pad lanes write the scratch block's row 0 (garbage on garbage)
+        widx = jnp.where(
+            valid, block_table[offs_safe // bs] * bs + offs_safe % bs, 0)
+        flat_k = flat_k.at[widx].set(ka[0].astype(flat_k.dtype))
+        flat_v = flat_v.at[widx].set(va[0].astype(flat_v.dtype))
+        # gather the slot's whole logical [L] row (like
+        # decode_slots_paged, one slot): chunk queries see the adopted
+        # prefix, earlier chunks, and this chunk's own fresh K/V
+        gidx = ((block_table * bs)[:, None]
+                + jnp.arange(bs)[None, :]).reshape(-1)          # [L]
+        k_rows = flat_k[gidx][None]
+        v_rows = flat_v[gidx][None]
+        scale = 1.0 / _math.sqrt(self.head_dim)
+        scores = jnp.einsum("bqhd,bkhd->bhqk",
+                            qa.astype(jnp.float32),
+                            k_rows.astype(jnp.float32)) * scale
+        L = gidx.shape[0]
+        visible = jnp.arange(L)[None, :] <= offs[:, None]       # [C, L]
+        scores = jnp.where(visible[None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                         v_rows.astype(jnp.float32)).astype(qa.dtype)
+        out = Tensor(ctx)
+        if self.use_mp:
+            from ..ops import einsum
+            out = einsum("bshd,hde->bse", out, self.out_weight) + \
+                self.out_bias
+        else:
+            out = reshape(out, [1, C, self.num_heads * self.head_dim])
+            out = self.out_proj(out)
+        return (out, flat_k.reshape(k_pool.shape),
+                flat_v.reshape(v_pool.shape))
+
     def forward(self, x, cache=None, doc_segments=None):
         b, s, _ = x.shape
         if doc_segments is not None and self.use_sp and cache is None:
@@ -417,6 +487,15 @@ class GPTBlock(nn.Layer):
         """Block-table one-token decode (GPTAttention.decode_slots_paged)."""
         attn_out, k_pool, v_pool = self.attn.decode_slots_paged(
             self.ln1(x), k_pool, v_pool, block_tables, pos)
+        x = x + attn_out
+        x = x + self.mlp(self.ln2(x))
+        return x, k_pool, v_pool
+
+    def prefill_chunk_paged(self, x, k_pool, v_pool, block_table, pos,
+                            true_len):
+        """Block-table chunked prefill (GPTAttention.prefill_chunk_paged)."""
+        attn_out, k_pool, v_pool = self.attn.prefill_chunk_paged(
+            self.ln1(x), k_pool, v_pool, block_table, pos, true_len)
         x = x + attn_out
         x = x + self.mlp(self.ln2(x))
         return x, k_pool, v_pool
@@ -679,6 +758,148 @@ class GPTModel(nn.Layer):
             new_k.append(kb)
             new_v.append(vb)
         return self.head(x)._data[:, -1, :], new_k, new_v
+
+    def _chunk_prefill_tick(self, toks, k_bufs, v_bufs, pos, true_len):
+        """One CHUNKED-prefill dispatch against a slot's contiguous
+        cache row: run C prompt tokens at positions pos..pos+C-1
+        through each block's windowed ``decode`` (writes the chunk's
+        K/V, attends causally over earlier chunks + the chunk itself),
+        then run the LM head on the chunk's last REAL position only
+        (``true_len - 1``) — non-final chunks discard their logits, so
+        the head matmul never pays for the whole window.  Returns
+        (last_logits [1, V], new_k, new_v)."""
+        import jax
+        x = self.embeddings(Tensor(toks), position_offset=pos)
+        new_k, new_v = [], []
+        for j, blk in enumerate(self.blocks):
+            x, kb, vb = blk.decode(x, k_bufs[j], v_bufs[j], pos)
+            new_k.append(kb)
+            new_v.append(vb)
+        E = x.shape[-1]
+        last_h = jax.lax.dynamic_slice(
+            x._data, (0, true_len - 1, 0), (1, 1, E))
+        return self.head(Tensor(last_h))._data[:, -1, :], new_k, new_v
+
+    def _chunk_prefill_tick_paged(self, toks, k_pools, v_pools,
+                                  block_table, pos, true_len):
+        """Paged twin of ``_chunk_prefill_tick``: the chunk's K/V
+        scatters block-granular through ONE slot's block table and the
+        attention context is the slot's gathered logical row (adopted
+        prefix blocks included).  Returns (last_logits [1, V], new_k,
+        new_v)."""
+        import jax
+        import jax.numpy as jnp
+        pos = jnp.asarray(pos, jnp.int32)
+        x = self.embeddings(Tensor(toks), position_offset=pos)
+        new_k, new_v = [], []
+        for j, blk in enumerate(self.blocks):
+            x, kb, vb = blk.prefill_chunk_paged(
+                x, k_pools[j], v_pools[j], block_table, pos, true_len)
+            new_k.append(kb)
+            new_v.append(vb)
+        E = x.shape[-1]
+        last_h = jax.lax.dynamic_slice(
+            x._data, (0, true_len - 1, 0), (1, 1, E))
+        return self.head(Tensor(last_h))._data[:, -1, :], new_k, new_v
+
+    def _compiled_chunk_prefill_fn(self, pnames, params, cache_key, C,
+                                   L, nh, hd, kv_dtype):
+        """Build (or fetch) the jitted CONTIGUOUS chunk prefill:
+        (p_list, b_list, k_pools, v_pools, ids [1, C], slot_idx, pos,
+        true_len) -> (last_logits [1, V], k_pools, v_pools).  The
+        serving engine's budgeted-chunked-prefill dispatch: the slot's
+        [L] cache row is sliced out of the [B, L, H, hd] pools, the
+        chunk runs through ``_chunk_prefill_tick``, and the updated row
+        is written back — ONE program per fixed chunk shape serves
+        EVERY chunk of EVERY prompt (slot_idx/pos/true_len are traced),
+        so a fixed ``prefill_chunk`` means a bounded compile set, like
+        ``prefill_buckets``.  Pad lanes of a partial final chunk write
+        garbage rows past the prompt end — parity-safe for the bucketed
+        -prefill reason (decode overwrites each before any query can
+        see it), and the engine requires C | L so the window never
+        clamps onto live rows.  Pools donated."""
+        import jax
+        from ..core import autograd
+        from ..jit import _swapped
+
+        cache = getattr(self, "_chunk_prefill_fn_cache", None)
+        if cache is None:
+            cache = self._chunk_prefill_fn_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        model = self
+        mbuffers = dict(self.named_buffers())
+        bnames = sorted(mbuffers)
+
+        def pure(p_list, b_list, k_pools, v_pools, ids_arr, slot_idx,
+                 pos, true_len):
+            with _swapped(params, dict(zip(pnames, p_list))), \
+                    _swapped(mbuffers, dict(zip(bnames, b_list))):
+                with autograd.no_grad():
+                    k_bufs = [jax.lax.dynamic_slice(
+                        kp, (slot_idx, 0, 0, 0), (1, L, nh, hd))
+                        for kp in k_pools]
+                    v_bufs = [jax.lax.dynamic_slice(
+                        vp, (slot_idx, 0, 0, 0), (1, L, nh, hd))
+                        for vp in v_pools]
+                    last, new_k, new_v = model._chunk_prefill_tick(
+                        ids_arr, k_bufs, v_bufs, pos, true_len)
+                    k_pools = [jax.lax.dynamic_update_slice(
+                        kp, nk.astype(kp.dtype), (slot_idx, 0, 0, 0))
+                        for kp, nk in zip(k_pools, new_k)]
+                    v_pools = [jax.lax.dynamic_update_slice(
+                        vp, nv.astype(vp.dtype), (slot_idx, 0, 0, 0))
+                        for vp, nv in zip(v_pools, new_v)]
+            return last, k_pools, v_pools
+
+        fn = jax.jit(pure, donate_argnums=(2, 3))
+        if len(cache) >= 8:  # FIFO bound, matching _prefill_fn_cache
+            cache.pop(next(iter(cache)))
+        cache[cache_key] = (fn, bnames, mbuffers)
+        return cache[cache_key]
+
+    def _compiled_paged_chunk_prefill_fn(self, pnames, params,
+                                         cache_key):
+        """Build (or fetch) the jitted PAGED chunk prefill: (p_list,
+        b_list, k_pools, v_pools, ids [1, C], block_table [L//bs], pos,
+        true_len) -> (last_logits [1, V], k_pools, v_pools).  The
+        block-table twin of ``_compiled_chunk_prefill_fn``
+        (``_chunk_prefill_tick_paged``): every shape is static and
+        pos/true_len are traced, so ONE program serves every chunk —
+        including resumed chunks after an adopted prefix-cache span
+        (the adopted blocks are already in the table; ``pos`` starts at
+        the adopted token count).  Pools donated."""
+        import jax
+        from ..core import autograd
+        from ..jit import _swapped
+
+        cache = getattr(self, "_paged_chunk_prefill_fn_cache", None)
+        if cache is None:
+            cache = self._paged_chunk_prefill_fn_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        model = self
+        mbuffers = dict(self.named_buffers())
+        bnames = sorted(mbuffers)
+
+        def pure(p_list, b_list, k_pools, v_pools, ids_arr, block_table,
+                 pos, true_len):
+            with _swapped(params, dict(zip(pnames, p_list))), \
+                    _swapped(mbuffers, dict(zip(bnames, b_list))):
+                with autograd.no_grad():
+                    last, new_k, new_v = \
+                        model._chunk_prefill_tick_paged(
+                            ids_arr, k_pools, v_pools, block_table,
+                            pos, true_len)
+            return last, new_k, new_v
+
+        fn = jax.jit(pure, donate_argnums=(2, 3))
+        if len(cache) >= 8:  # FIFO bound, matching the other caches
+            cache.pop(next(iter(cache)))
+        cache[cache_key] = (fn, bnames, mbuffers)
+        return cache[cache_key]
 
     def _compiled_slot_paged_decode_fn(self, pnames, params, cache_key):
         """Build (or fetch) the jitted PAGED slot-pool decode step:

@@ -141,6 +141,32 @@ class Histogram:
         with self._lock:
             return self._sum / self._count if self._count else 0.0
 
+    def percentile(self, q):
+        """Estimate the q-th percentile (``q`` in [0, 100]) from the
+        bucket counts, linearly interpolating within the containing
+        bucket (Prometheus ``histogram_quantile`` semantics: the first
+        bucket interpolates up from 0, and a rank landing in the +Inf
+        overflow bucket returns the highest finite bound — the
+        histogram cannot resolve beyond it).  NaN on an empty
+        histogram.  Bench and tests use this to assert latency bounds
+        (e.g. TPOT p99) without a Prometheus server."""
+        if not 0 <= q <= 100:
+            raise ValueError(f"percentile q must be in [0, 100], got {q}")
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        if total == 0:
+            return float("nan")
+        rank = q / 100.0 * total
+        cum, lo = 0, 0.0
+        for bound, c in zip(self.bounds, counts):
+            if c > 0 and cum + c >= rank:
+                frac = min(max((rank - cum) / c, 0.0), 1.0)
+                return lo + (bound - lo) * frac
+            cum += c
+            lo = bound
+        return self.bounds[-1]
+
     def reset(self):
         with self._lock:
             self._counts = [0] * (len(self.bounds) + 1)

@@ -10,9 +10,15 @@ serving.  ``serving.kvcache`` pages the K/V pools into fixed-size
 refcounted blocks (``Engine(kv_block_size=...)``): identical prompt
 prefixes share physical blocks and a token-trie ``PrefixCache`` lets
 admission skip prefill for previously-seen spans, with LRU eviction
-under pool pressure.  Metrics (queue depth, slot occupancy,
-tokens/sec, TTFT/TPOT, KV blocks in use, prefix hits/evictions) land
-in paddle_tpu.monitor and render via ``render_prometheus()``.
+under pool pressure.  ``Engine(prefill_chunk=...,
+tick_token_budget=...)`` adds budgeted CHUNKED prefill: prompts split
+into fixed-size chunks interleaved with decode so a long prompt can
+no longer stall token emission for the active slots (decode latency
+is bounded by the per-tick token budget, not the longest queued
+prompt).  Metrics (queue depth, slot occupancy, tokens/sec,
+TTFT/TPOT, KV blocks in use, prefix hits/evictions, prefill chunks,
+decode stall) land in paddle_tpu.monitor and render via
+``render_prometheus()``.
 """
 from .request import (  # noqa: F401
     Request, RequestQueue, RequestTimeout, QueueFull)

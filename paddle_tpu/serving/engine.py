@@ -108,6 +108,28 @@ class Engine:
         token-trie so later requests adopt them (paged mode only;
         default True).  ``False`` pages without reuse — the A/B
         baseline for the parity tests and bench.
+    prefill_chunk : enable BUDGETED CHUNKED PREFILL.  ``None``
+        (default) prefills each admitted prompt whole, inline, before
+        the tick's decode dispatch — one long prompt then stalls token
+        emission for every decoding slot by its full prefill time.  An
+        int (must divide max_seq_len) splits each prompt into
+        fixed-size chunks run through ONE compiled chunk program
+        (bounded compiles, like prefill_buckets); each tick spends at
+        most ``tick_token_budget`` prompt tokens on chunks —
+        round-robin across PREFILLING slots, resuming partially
+        prefilled prompts before starting new ones — and then always
+        runs the decode tick for the DECODING slots, so decode latency
+        is bounded by the budget, not the longest queued prompt.
+        Half-prefilled slots are excluded from decode and sampling
+        until their final chunk emits the first token.  Greedy outputs
+        stay token-identical to the unchunked engine and to
+        ``generate()`` (same caveat as bucketed prefill: on TPU a
+        near-tie logit may round differently across program shapes).
+        Works with both the contiguous and paged KV layouts; not
+        combinable with prefill_buckets.
+    tick_token_budget : prompt tokens each tick may spend on prefill
+        chunks (default: one ``prefill_chunk``; must be >= it so every
+        tick makes progress).  Requires prefill_chunk.
 
     ``step()`` is single-threaded by design — run it from one loop
     (``run_until_idle`` or the ``start()`` background thread).
@@ -117,7 +139,8 @@ class Engine:
 
     def __init__(self, model, num_slots=4, max_seq_len=None,
                  max_queue=0, registry=None, prefill_buckets=None,
-                 kv_block_size=None, kv_blocks=None, prefix_cache=True):
+                 kv_block_size=None, kv_blocks=None, prefix_cache=True,
+                 prefill_chunk=None, tick_token_budget=None):
         if getattr(model, "scan_layers", False):
             model = model._sync_decode_twin()
         model.eval()
@@ -130,6 +153,11 @@ class Engine:
                 f"max_seq_len {self.max_seq_len} exceeds the model's "
                 f"position table ({max_position})")
         self.num_slots = int(num_slots)
+        try:  # the HTTP edge validates token ids against this
+            self.vocab_size = int(
+                model.embeddings.word_embeddings.weight.shape[0])
+        except AttributeError:
+            self.vocab_size = None
         self.queue = RequestQueue(max_queue=max_queue)
         self.scheduler = Scheduler(self.num_slots, self.queue)
 
@@ -162,6 +190,34 @@ class Engine:
             self._prefill_buckets = bs
         else:
             self._prefill_buckets = None
+        self._chunk = None
+        self._tick_budget = None
+        if prefill_chunk is not None:
+            c = int(prefill_chunk)
+            if c < 1 or self.max_seq_len % c:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1 and divide max_seq_len"
+                    f" ({self.max_seq_len}), got {c} — dividing keeps "
+                    "the chunk window from clamping onto live cache "
+                    "rows")
+            if self._prefill_buckets is not None:
+                raise ValueError(
+                    "prefill_chunk cannot combine with prefill_buckets:"
+                    " the fixed chunk shape already bounds prefill "
+                    "compiles")
+            b = int(tick_token_budget) if tick_token_budget is not None \
+                else c
+            if b < c:
+                raise ValueError(
+                    f"tick_token_budget ({b}) must cover at least one "
+                    f"prefill_chunk ({c}), or no tick could ever make "
+                    "prefill progress")
+            self._chunk = c
+            self._tick_budget = b
+        elif tick_token_budget is not None:
+            raise ValueError(
+                "tick_token_budget requires prefill_chunk (it bounds "
+                "the chunked-prefill spend per tick)")
         self._paged = kv_block_size is not None
         if self._paged:
             bsz = int(kv_block_size)
@@ -238,7 +294,23 @@ class Engine:
         self._m_prefix_evictions = reg.counter(
             "serving.prefix_evictions", "cached prefix blocks evicted "
             "(LRU) under pool pressure")
+        # chunked-prefill surface (registered always; zero when
+        # prefill_chunk is off)
+        self._m_chunks = reg.counter(
+            "serving.prefill_chunks", "chunked-prefill dispatches")
+        self._m_stall = reg.histogram(
+            "serving.decode_stall_ms", "gap between consecutive decode "
+            "dispatches while slots were decoding — the time decoders "
+            "stalled on interleaved prefill work (ms)")
+        self._m_decode_batch = reg.gauge(
+            "serving.decode_batch", "DECODING slots in the latest "
+            "decode dispatch")
 
+        self._last_decode_end = None  # stall anchor: end of the last
+        #   decode dispatch, cleared when no slot is decoding
+        self._evicted_in_tick = 0     # monotonic eviction counter; the
+        #   tick reads DELTAS to keep the occupancy gauge exact without
+        #   re-locking the scheduler after the decode dispatch
         self._insert_fn = None
         self._tick_fn = None    # resolved jitted slot-decode handle
         self._p_arrays = None   # lazy snapshots of param/buffer handles
@@ -388,14 +460,11 @@ class Engine:
         self._slot_blocks[i] = []
         self._block_tables[i, :] = 0
 
-    def _prefill_paged(self, slot):
-        """Paged admission prefill: ONE jitted dispatch gathers the
-        adopted prefix blocks as attention context, runs the prompt's
-        non-shared tail, and scatters the tail's K/V block-granular
-        into the slot's fresh blocks — a prefix hit neither recomputes
-        nor re-stores the shared span.  The prompt's full blocks are
-        then registered in the prefix cache for later adopters."""
-        import jax.numpy as jnp
+    def _bind_kv_plan(self, slot):
+        """Install the admission gate's block reservation
+        (``req._kv_plan``) into the slot's table and count the prefix
+        hit; returns (ctx, fresh, m).  Shared by the monolithic paged
+        prefill and chunked admission."""
         req = slot.request
         ctx, fresh, m = req._kv_plan
         del req._kv_plan
@@ -405,6 +474,23 @@ class Engine:
         row = np.zeros(self._bps, np.int32)  # scratch-padded tail
         row[:len(blocks)] = blocks
         self._block_tables[i] = row
+        if m:
+            self._m_prefix_hits.inc()
+            self._m_prefix_hit_tokens.inc(m)
+        return ctx, fresh, m
+
+    def _prefill_paged(self, slot):
+        """Paged admission prefill: ONE jitted dispatch gathers the
+        adopted prefix blocks as attention context, runs the prompt's
+        non-shared tail, and scatters the tail's K/V block-granular
+        into the slot's fresh blocks — a prefix hit neither recomputes
+        nor re-stores the shared span.  The prompt's full blocks are
+        then registered in the prefix cache for later adopters."""
+        import jax.numpy as jnp
+        req = slot.request
+        ctx, fresh, m = self._bind_kv_plan(slot)
+        i = slot.index
+        blocks = ctx + fresh
         s = len(req.prompt)
         n_ctx = len(ctx)
         s_tail = s - m
@@ -423,10 +509,8 @@ class Engine:
         if self.prefix_cache is not None:
             self.prefix_cache.insert(req.prompt, blocks[:s // self._bs])
         self._m_prefill_tokens.inc(s_tail)
-        if m:
-            self._m_prefix_hits.inc()
-            self._m_prefix_hit_tokens.inc(m)
         slot.pos = s
+        slot.prefilled = s
         self._pos[i] = s
         tok = self._pick(req, np.asarray(last0, np.float32)[0])
         self._emit(slot, tok)
@@ -485,9 +569,118 @@ class Engine:
             jnp.asarray(i, jnp.int32))
         self._m_prefill_tokens.inc(s)
         slot.pos = s
+        slot.prefilled = s
         self._pos[i] = s
         tok = self._pick(req, np.asarray(last0, np.float32)[0])
         self._emit(slot, tok)
+
+    # -- budgeted chunked prefill (prefill_chunk=...) ------------------
+    def _begin_chunked(self, slot):
+        """Chunked admission: bind the paged block plan (the adopted
+        prefix span counts as already-prefilled tokens) and park the
+        slot PREFILLING — no prompt compute happens at admission;
+        ``_prefill_chunked`` spends the tick budget.  The decode
+        dispatch's (discarded) compute for a half-prefilled slot is
+        parked at the NEXT chunk's start row: its garbage K/V write
+        lands on a row that chunk overwrites before any query can see
+        it (in paged mode that row always sits in the slot's own fresh
+        blocks — the adopted shared blocks all lie before
+        ``prefilled``)."""
+        i = slot.index
+        if self._paged:
+            _, _, m = self._bind_kv_plan(slot)
+            slot.prefilled = m
+        else:
+            slot.prefilled = 0
+        slot.pos = slot.prefilled
+        self._pos[i] = slot.prefilled
+        self._cur_tok[i, 0] = 0
+
+    def _run_chunk(self, slot, n):
+        """One chunk dispatch: compute K/V (and, on the final chunk,
+        the first-token logits) for prompt positions
+        ``[prefilled, prefilled + n)``.  Returns 1 when the final chunk
+        emitted the request's first token, else 0."""
+        import jax.numpy as jnp
+        req = slot.request
+        i = slot.index
+        s = len(req.prompt)
+        p0 = slot.prefilled
+        C = self._chunk
+        ids = np.zeros((1, C), np.int32)  # right-padded final chunk
+        ids[0, :n] = req.prompt[p0:p0 + n]
+        if self._paged:
+            fn, _, _ = self.model._compiled_paged_chunk_prefill_fn(
+                self._pnames, self._params,
+                (C, self._kv_managed + 1, self._bs, self._bps,
+                 str(self._kv_dtype), tuple(self._pnames),
+                 self._bnames_all))
+            last0, self.k_pools, self.v_pools = fn(
+                self._p_list(), self._b_list(), self.k_pools,
+                self.v_pools, ids, jnp.asarray(self._block_tables[i]),
+                jnp.asarray(p0, jnp.int32), jnp.asarray(n, jnp.int32))
+        else:
+            fn, _, _ = self.model._compiled_chunk_prefill_fn(
+                self._pnames, self._params,
+                (C, self.num_slots, self.max_seq_len,
+                 str(self._kv_dtype), tuple(self._pnames),
+                 self._bnames_all),
+                C, self.max_seq_len, self._nh, self._hd,
+                self._kv_dtype)
+            last0, self.k_pools, self.v_pools = fn(
+                self._p_list(), self._b_list(), self.k_pools,
+                self.v_pools, ids, jnp.asarray(i, jnp.int32),
+                jnp.asarray(p0, jnp.int32), jnp.asarray(n, jnp.int32))
+        slot.prefilled = p0 + n
+        slot.pos = slot.prefilled
+        self._m_chunks.inc()
+        self._m_prefill_tokens.inc(n)
+        if slot.prefilled < s:
+            # still PREFILLING: re-park the decode dispatch's garbage
+            # write on the next chunk's start row
+            self._pos[i] = slot.prefilled
+            return 0
+        # final chunk: the prompt's full blocks become adoptable and
+        # the last real position's logits sample the first token (TTFT)
+        if self._paged and self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt,
+                                     self._slot_blocks[i][:s // self._bs])
+        self._pos[i] = s
+        tok = self._pick(req, np.asarray(last0, np.float32)[0])
+        self._emit(slot, tok)
+        return 1
+
+    def _prefill_chunked(self, prefilling):
+        """Spend at most ``tick_token_budget`` prompt tokens on prefill
+        chunks: round-robin over the PREFILLING slots (admission order,
+        so partially-prefilled prompts resume before fresh ones start),
+        one chunk per slot per pass.  Returns (tokens_emitted,
+        newly_decoding_slots, evicted_count) — newly-decoding slots
+        join this same tick's decode dispatch, exactly like monolithic
+        prefill's emit-then-decode."""
+        from collections import deque
+        budget = self._tick_budget
+        emitted, newly, evicted = 0, [], 0
+        queue = deque(prefilling)
+        while queue and budget > 0:
+            slot = queue.popleft()
+            req = slot.request
+            n = min(self._chunk, len(req.prompt) - slot.prefilled)
+            if n > budget:
+                break  # strict per-tick cap (budget >= chunk, so a
+                #        tick's FIRST chunk always fits: progress is
+                #        guaranteed, the cap only defers later chunks)
+            done_first = self._run_chunk(slot, n)
+            budget -= n
+            if done_first:
+                emitted += 1
+                if slot.request is not None:
+                    newly.append(slot)
+                else:
+                    evicted += 1  # EOS / max_new_tokens on first token
+            else:
+                queue.append(slot)
+        return emitted, newly, evicted
 
     def _pick(self, req, row):
         """Next token from one slot's f32 logits row: argmax (greedy)
@@ -524,6 +717,7 @@ class Engine:
             self._rngs.pop(req.id, None)
             i = slot.index
             self.scheduler.evict(slot)
+            self._evicted_in_tick += 1
             self._release_slot_kv(i)
             # park the freed row: a frozen pos/tok keeps the inactive
             # row's (ignored) compute in-bounds until the next prefill
@@ -590,7 +784,10 @@ class Engine:
         try:
             return self._step_inner()
         except Exception as e:
-            for slot in self.scheduler.active_slots():
+            # busy_slots, not active_slots: a chunked tick that dies
+            # mid-prompt leaves half-PREFILLED slots whose waiters must
+            # unblock just like the decoding ones
+            for slot in self.scheduler.busy_slots():
                 req = self.scheduler.evict(slot, RuntimeError(
                     f"engine step failed: {e!r}"))
                 if req is not None:
@@ -598,6 +795,7 @@ class Engine:
                     self._m_done.inc()  # terminal, like timeouts: keep
                     #   in-flight = total - completed consistent
             self._reset_pools()
+            self._last_decode_end = None
             self._m_occ.set(0)
             raise
 
@@ -613,14 +811,36 @@ class Engine:
             self._m_timeout.inc(len(timed_out))
             self._m_done.inc(len(timed_out))
         emitted = 0
-        for slot in admitted:
-            self._prefill(slot)
-            emitted += 1  # prefill samples the first token
-        active = self.scheduler.active_slots()
+        if self._chunk is None:
+            for slot in admitted:
+                self._prefill(slot)
+                emitted += 1  # prefill samples the first token
+            occ, active, _ = self.scheduler.snapshot()
+        else:
+            for slot in admitted:
+                self._begin_chunked(slot)
+            occ, active, prefilling = self.scheduler.snapshot()
+            if prefilling:
+                n_emit, newly, n_evicted = \
+                    self._prefill_chunked(prefilling)
+                emitted += n_emit
+                occ -= n_evicted
+                active = active + newly  # final-chunk slots decode in
+                #   this same tick, like monolithic emit-then-decode
         if active:
+            t0 = time.monotonic()
+            if self._last_decode_end is not None:
+                self._m_stall.observe((t0 - self._last_decode_end) * 1e3)
+            self._m_decode_batch.set(len(active))
+            n_before = self._evicted_in_tick
             emitted += self._decode_tick(active)
+            occ -= self._evicted_in_tick - n_before
+            self._last_decode_end = time.monotonic()
+        else:
+            self._m_decode_batch.set(0)
+            self._last_decode_end = None
         self._m_queue.set(self.queue.depth())
-        self._m_occ.set(self.scheduler.occupancy())
+        self._m_occ.set(occ)
         if self._paged:
             self._m_kv_blocks.set(self.block_pool.in_use())
         return emitted
@@ -691,7 +911,7 @@ class Engine:
         """Fail every queued and in-flight request (shutdown path)."""
         for req in self.queue.drain():
             self._m_done.inc()
-        for slot in self.scheduler.active_slots():
+        for slot in self.scheduler.busy_slots():
             req = self.scheduler.evict(
                 slot, RuntimeError("engine stopped"))
             self._release_slot_kv(slot.index)

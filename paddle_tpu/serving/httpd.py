@@ -32,16 +32,49 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet by default
         pass
 
-    def _send(self, code, body, ctype="application/json"):
+    def _send(self, code, body, ctype="application/json", headers=None):
         data = body if isinstance(body, bytes) else body.encode()
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
-    def _send_json(self, code, obj):
-        self._send(code, json.dumps(obj))
+    def _send_json(self, code, obj, headers=None):
+        self._send(code, json.dumps(obj), headers=headers)
+
+    def _validate_prompt(self, prompt, max_new_tokens):
+        """Reject malformed / over-capacity prompts AT THE EDGE with a
+        clear 400 body, instead of letting them surface as an
+        engine-side failure or a silently-clamped embedding gather.
+        Returns an error string, or None when the request is
+        admissible."""
+        eng = self.engine
+        if not isinstance(prompt, (list, tuple)) or not prompt:
+            return "prompt must be a non-empty list of token ids"
+        if not all(isinstance(t, int) and not isinstance(t, bool)
+                   for t in prompt):
+            return "prompt must contain integer token ids only"
+        if max_new_tokens < 1:
+            return f"max_new_tokens must be >= 1, got {max_new_tokens}"
+        # mirrors Engine.submit's capacity rule (kept in sync with it):
+        # checking here too means a clear 400 with zero engine-side
+        # effects, not an error minted halfway into submit
+        total = len(prompt) + max_new_tokens
+        if total > eng.max_seq_len:
+            return (f"prompt ({len(prompt)} tokens) + max_new_tokens "
+                    f"({max_new_tokens}) = {total} exceeds the engine's "
+                    f"slot capacity ({eng.max_seq_len})")
+        vocab = getattr(eng, "vocab_size", None)
+        if vocab:
+            bad = next((t for t in prompt if not 0 <= t < vocab), None)
+            if bad is not None:
+                return (f"token id {bad} outside the model vocabulary "
+                        f"[0, {vocab}) — it would silently clamp to a "
+                        "different token")
+        return None
 
     def do_GET(self):
         eng = self.engine
@@ -72,14 +105,19 @@ class _Handler(BaseHTTPRequestHandler):
             n = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(n) or b"{}")
             prompt = body["prompt"]
+            max_new = int(body.get("max_new_tokens", 16))
         except (KeyError, TypeError, ValueError,
                 json.JSONDecodeError) as e:
             self._send_json(400, {"error": f"bad request: {e}"})
             return
+        err = self._validate_prompt(prompt, max_new)
+        if err is not None:
+            self._send_json(400, {"error": err})
+            return
         try:
             req = self.engine.submit(
                 prompt,
-                max_new_tokens=int(body.get("max_new_tokens", 16)),
+                max_new_tokens=max_new,
                 eos_token_id=body.get("eos_token_id"),
                 timeout=body.get("timeout"),
                 temperature=float(body.get("temperature", 1.0)),
@@ -87,7 +125,10 @@ class _Handler(BaseHTTPRequestHandler):
                 top_p=float(body.get("top_p", 1.0)),
                 seed=body.get("seed"))
         except QueueFull as e:
-            self._send_json(503, {"error": str(e)})
+            # Retry-After: the queue is full of whole requests, so one
+            # decode's worth of seconds is a reasonable backoff hint
+            self._send_json(503, {"error": str(e)},
+                            headers={"Retry-After": "1"})
             return
         except (TypeError, ValueError) as e:
             # TypeError covers JSON nulls / non-numeric fields hitting

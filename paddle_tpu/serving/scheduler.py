@@ -7,6 +7,14 @@ the kernel-level generalization of the same idea).  The scheduler owns
 only slot METADATA; the engine owns the device arrays.  Admission =
 bind request to a free slot (the engine then prefills it); eviction =
 free the slot on EOS / max_new_tokens / error.
+
+Slot lifecycle (budgeted chunked prefill, serving/engine.py
+``prefill_chunk``): a bound slot whose ``prefilled`` has not reached
+its prompt length is PREFILLING — it holds cache rows but is excluded
+from the decode set (``snapshot().decoding``) and from sampling until
+its final chunk emits the first token.  Monolithic prefill jumps
+``prefilled`` straight to the prompt length at admission, so the
+DECODING condition is uniform across both modes.
 """
 from __future__ import annotations
 
@@ -14,16 +22,28 @@ import threading
 
 
 class Slot:
-    __slots__ = ("index", "request", "pos")
+    __slots__ = ("index", "request", "pos", "prefilled", "seq")
 
     def __init__(self, index):
         self.index = index
         self.request = None
-        self.pos = 0   # next cache write position (= tokens cached)
+        self.pos = 0        # next cache write position (= tokens cached)
+        self.prefilled = 0  # prompt tokens whose K/V is computed; a
+        #                     bound slot with prefilled < len(prompt) is
+        #                     PREFILLING (chunked mode), else DECODING
+        self.seq = 0        # admission order stamp: chunked prefill
+        #                     resumes earlier-admitted (partially done)
+        #                     prompts before starting fresh ones
 
     @property
     def free(self):
         return self.request is None
+
+    @property
+    def decoding(self):
+        """Bound AND fully prefilled — eligible for the decode tick."""
+        req = self.request
+        return req is not None and self.prefilled >= len(req.prompt)
 
 
 class Scheduler:
@@ -36,6 +56,7 @@ class Scheduler:
         self.queue = queue
         self.slots = [Slot(i) for i in range(self.num_slots)]
         self._lock = threading.Lock()
+        self._admit_seq = 0
 
     # -- accounting ------------------------------------------------------
     def occupancy(self):
@@ -43,11 +64,35 @@ class Scheduler:
             return sum(1 for s in self.slots if not s.free)
 
     def free_count(self):
-        return self.num_slots - self.occupancy()
+        # one acquisition, not occupancy() through a second one
+        with self._lock:
+            return sum(1 for s in self.slots if s.free)
 
     def active_slots(self):
+        """Decode-eligible slots (bound and fully prefilled) —
+        half-prefilled chunked slots are excluded until their final
+        chunk emits the first token."""
+        with self._lock:
+            return [s for s in self.slots if s.decoding]
+
+    def busy_slots(self):
+        """Every bound slot, PREFILLING included — the eviction set for
+        failure recovery and shutdown drain (a half-prefilled request's
+        waiter must unblock too)."""
         with self._lock:
             return [s for s in self.slots if not s.free]
+
+    def snapshot(self):
+        """ONE locked pass over the pool: (occupancy, decoding slots,
+        prefilling slots ordered by admission).  The engine's per-tick
+        view — replaces the separate ``occupancy()`` /
+        ``active_slots()`` acquisitions the tick used to pay."""
+        with self._lock:
+            busy = [s for s in self.slots if not s.free]
+            decoding = [s for s in busy if s.decoding]
+            prefilling = sorted((s for s in busy if not s.decoding),
+                                key=lambda s: s.seq)
+        return len(busy), decoding, prefilling
 
     def idle(self):
         return self.occupancy() == 0 and self.queue.depth() == 0
@@ -63,8 +108,14 @@ class Scheduler:
         cache lookup + up-front block reservation).  A False verdict
         puts the request back at the queue head and stops this round's
         admission — FIFO order is preserved and later ticks retry once
-        eviction/completion frees resources."""
-        admitted, timed_out = [], []
+        eviction/completion frees resources.
+
+        Locking: two acquisitions per call (free-slot scan + one batch
+        bind), however many slots admit — admission runs only on the
+        engine loop thread, so deferring the binds cannot race another
+        writer; concurrent readers (``/healthz``) just see the slots
+        bind a moment later."""
+        timed_out, binds = [], []
         with self._lock:
             free = [s for s in self.slots if s.free]
         for slot in free:
@@ -75,11 +126,16 @@ class Scheduler:
             if gate is not None and not gate(req):
                 self.queue.push_front(req)
                 break
+            binds.append((slot, req))
+        if binds:
             with self._lock:
-                slot.request = req
-                slot.pos = 0
-            admitted.append(slot)
-        return admitted, timed_out
+                for slot, req in binds:
+                    slot.request = req
+                    slot.pos = 0
+                    slot.prefilled = 0
+                    self._admit_seq += 1
+                    slot.seq = self._admit_seq
+        return [s for s, _ in binds], timed_out
 
     def evict(self, slot, error=None):
         """Free a slot and complete its request."""
@@ -87,6 +143,7 @@ class Scheduler:
             req = slot.request
             slot.request = None
             slot.pos = 0
+            slot.prefilled = 0
         if req is not None:
             req._finish(error)
         return req

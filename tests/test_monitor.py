@@ -38,6 +38,46 @@ def test_histogram_cumulative_buckets():
     assert h.mean() == pytest.approx(1012.1)
 
 
+def test_histogram_percentile_interpolation():
+    """percentile(q) linearly interpolates within the containing bucket
+    (the helper bench/tests use to assert TPOT p99 bounds)."""
+    h = Histogram("h", buckets=(10, 20, 40))
+    for v in (2, 4, 6, 8, 12, 14, 16, 18, 22, 24):
+        h.observe(v)           # counts: 4 | 4 | 2 | 0(+Inf)
+    assert h.percentile(25) == pytest.approx(6.25)   # rank 2.5 in [0,10]
+    assert h.percentile(50) == pytest.approx(12.5)   # rank 5 in (10,20]
+    assert h.percentile(100) == pytest.approx(40.0)
+    with pytest.raises(ValueError):
+        h.percentile(101)
+    with pytest.raises(ValueError):
+        h.percentile(-1)
+
+
+def test_histogram_percentile_overflow_and_empty():
+    """Empty histogram -> NaN; observations in the +Inf overflow bucket
+    resolve to the highest finite bound (the histogram cannot resolve
+    beyond it — Prometheus histogram_quantile semantics)."""
+    import math
+    h = Histogram("h", buckets=(1, 2))
+    assert math.isnan(h.percentile(99))
+    h.observe(100)
+    assert h.percentile(99) == 2
+
+
+def test_render_prometheus_empty_histogram():
+    """Regression: a never-observed histogram still renders its full
+    bucket series, the +Inf bucket, _sum and _count as zeros — a
+    scraper must see the series exist before the first observation."""
+    reg = StatRegistry()
+    reg.histogram("cold.ms", "never observed", buckets=(5, 50))
+    text = render_prometheus(reg)
+    assert 'cold_ms_bucket{le="5"} 0' in text
+    assert 'cold_ms_bucket{le="50"} 0' in text
+    assert 'cold_ms_bucket{le="+Inf"} 0' in text
+    assert "cold_ms_sum 0" in text
+    assert "cold_ms_count 0" in text
+
+
 def test_registry_get_or_create_and_type_conflict():
     reg = StatRegistry()
     c1 = reg.counter("x")

@@ -1,6 +1,10 @@
 """Continuous-batching serving engine: token parity with per-request
 generate(), slot eviction on EOS, admission under a full pool, queue
-timeouts, and the metrics surface (all CPU, tiny model, tier-1 safe)."""
+timeouts, budgeted CHUNKED PREFILL (parity, per-tick token budget,
+decode-not-stalled mixed workload, mid-chunk failure recovery), HTTP
+edge validation, and the metrics surface (all CPU, tiny model, tier-1
+safe)."""
+import io
 import json
 import threading
 import time
@@ -228,6 +232,238 @@ def test_filter_logits_np_matches_model_filter():
         np.testing.assert_array_equal(kept_got, kept_ref)
         np.testing.assert_allclose(got[kept_got], ref[kept_ref],
                                    rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Budgeted chunked prefill (Engine(prefill_chunk=..., tick_token_budget=...))
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mid_gpt():
+    """2-layer model with a LONG position table: room for the mixed
+    long-prompt/short-decode workload that tiny's 64 positions cannot
+    hold (still seconds-scale on CPU — tier-1 safe)."""
+    paddle.seed(0)
+    m = GPTModel(num_layers=2, hidden_size=64, num_heads=4,
+                 vocab_size=128, max_position=256, dropout=0.0)
+    m.eval()
+    return m
+
+
+def test_chunked_parity_contiguous(tiny_gpt):
+    """prefill_chunk on the contiguous engine: staggered requests stay
+    token-identical to the unchunked engine and generate(), and every
+    chunk of every prompt shares ONE compiled program."""
+    eng = _engine(tiny_gpt, prefill_chunk=4, tick_token_budget=8)
+    ref_eng = _engine(tiny_gpt)                      # unchunked A/B
+    prompts = _prompts(4)
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts[:2]]
+    for _ in range(3):                               # mid-decode arrivals
+        eng.step()
+    reqs += [eng.submit(p, max_new_tokens=8) for p in prompts[2:]]
+    eng.run_until_idle()
+    ref_reqs = [ref_eng.submit(p, max_new_tokens=8) for p in prompts]
+    ref_eng.run_until_idle()
+    for p, r, rr in zip(prompts, reqs, ref_reqs):
+        got = r.result(timeout=1).tolist()
+        assert got == rr.result(timeout=1).tolist()
+        ref = tiny_gpt.generate(paddle.to_tensor(p[None, :]),
+                                max_new_tokens=8).numpy()[0].tolist()
+        assert got == ref
+    # 4 prompt lengths, many chunk dispatches, ONE compiled program
+    assert len(tiny_gpt._chunk_prefill_fn_cache) == 1
+
+
+def test_chunked_parity_paged(tiny_gpt):
+    """prefill_chunk + kv_block_size: chunked paged prefill (including
+    prefix-cache adoption mid-prompt) stays token-identical to
+    generate(), with ONE compiled paged chunk program."""
+    rng = np.random.RandomState(11)
+    sysp = rng.randint(0, 128, (20,)).astype(np.int32)
+    prompts = [np.concatenate([sysp, rng.randint(0, 128, (k,))
+                               .astype(np.int32)]) for k in (3, 5, 4, 6)]
+    refs = [tiny_gpt.generate(paddle.to_tensor(p[None, :]),
+                              max_new_tokens=6).numpy()[0].tolist()
+            for p in prompts]
+    reg = monitor.StatRegistry()
+    eng = _engine(tiny_gpt, registry=reg, kv_block_size=8,
+                  prefill_chunk=4, tick_token_budget=8)
+    first = eng.submit(prompts[0], max_new_tokens=6)
+    eng.run_until_idle()          # prompt 0's blocks now cached
+    rest = [eng.submit(p, max_new_tokens=6) for p in prompts[1:]]
+    eng.run_until_idle()
+    outs = [first.result(timeout=1).tolist()] + \
+        [r.result(timeout=1).tolist() for r in rest]
+    assert outs == refs
+    # adopters skipped the shared 16-token span (2 full 8-token blocks)
+    assert reg.get("serving.prefix_hits").value == 3
+    assert reg.get("serving.prefix_hit_tokens").value == 3 * 16
+    assert len(tiny_gpt._paged_chunk_prefill_fn_cache) == 1
+
+
+def test_chunked_mixed_workload_decode_not_stalled(mid_gpt):
+    """The tentpole behavior (fast tier-1 version of the bench's mixed
+    workload): a LONG prompt arriving during active decode never
+    pauses token emission — each tick spends at most tick_token_budget
+    prompt tokens on chunks and still decodes every DECODING slot."""
+    reg = monitor.StatRegistry()
+    eng = Engine(mid_gpt, num_slots=4, max_seq_len=256, registry=reg,
+                 prefill_chunk=16, tick_token_budget=32)
+    rng = np.random.RandomState(3)
+    shorts = [rng.randint(0, 128, (8,)).astype(np.int32)
+              for _ in range(2)]
+    long_p = rng.randint(0, 128, (150,)).astype(np.int32)
+    srefs = [mid_gpt.generate(paddle.to_tensor(p[None, :]),
+                              max_new_tokens=24).numpy()[0].tolist()
+             for p in shorts]
+    lref = mid_gpt.generate(paddle.to_tensor(long_p[None, :]),
+                            max_new_tokens=8).numpy()[0].tolist()
+    sreqs = [eng.submit(p, max_new_tokens=24) for p in shorts]
+    for _ in range(4):
+        eng.step()                       # shorts actively decoding
+    lreq = eng.submit(long_p, max_new_tokens=8)
+    pf = reg.get("serving.prefill_tokens")
+    ticks_to_first = 0
+    while not lreq.generated:
+        before = [len(r.generated) for r in sreqs]
+        tok_before = pf.value
+        eng.step()
+        ticks_to_first += 1
+        assert ticks_to_first <= 20, "long prompt never finished prefill"
+        # the budget strictly bounds the tick's prefill spend
+        assert pf.value - tok_before <= 32
+        # decode never stalls: every decoding short emitted this tick
+        for r, b in zip(sreqs, before):
+            assert len(r.generated) == b + 1
+        # the decode_batch gauge counts exactly the DECODING slots
+        expect = 3 if lreq.generated else 2
+        assert reg.get("serving.decode_batch").value == expect
+    # 150 prompt tokens / 32-token budget = 5 ticks of chunking;
+    # chunk dispatches = 1 per short prompt + ceil(150/16) for the long
+    assert ticks_to_first == 5
+    assert reg.get("serving.prefill_chunks").value == 2 + 10
+    eng.run_until_idle()
+    assert [r.result(timeout=1).tolist() for r in sreqs] == srefs
+    assert lreq.result(timeout=1).tolist() == lref
+    # the stall histogram observed the interleaved ticks and renders
+    h = reg.get("serving.decode_stall_ms")
+    assert h.count > 0
+    assert h.percentile(99) >= 0.0
+    assert "serving_decode_stall_ms_bucket" in \
+        monitor.render_prometheus(reg)
+
+
+def test_chunked_paged_failure_mid_prompt_recovers(tiny_gpt,
+                                                  monkeypatch):
+    """Step-failure recovery with a PARTIALLY-PREFILLED paged slot in
+    flight: a chunk dispatch that dies mid-prompt fails every waiter
+    loudly (the half-prefilled one included), rebuilds the pools with
+    all block refcounts back to zero, and the next submit completes."""
+    reg = monitor.StatRegistry()
+    eng = Engine(tiny_gpt, num_slots=2, max_seq_len=48, registry=reg,
+                 kv_block_size=8, prefill_chunk=8, tick_token_budget=8)
+    short = _prompts(1)[0]
+    sreq = eng.submit(short, max_new_tokens=12)
+    eng.step()
+    eng.step()                            # short actively decoding
+    long_p = np.random.RandomState(8).randint(0, 128, (30,)) \
+        .astype(np.int32)
+    lreq = eng.submit(long_p, max_new_tokens=4)
+    eng.step()                            # long admitted, 1 of 4 chunks
+    slot = next(s for s in eng.scheduler.busy_slots()
+                if s.request is lreq)
+    assert 0 < slot.prefilled < len(long_p)   # mid-prompt, PREFILLING
+    assert eng.block_pool.in_use() > 0
+
+    def boom(slot, n):
+        raise RuntimeError("synthetic chunk dispatch failure")
+
+    monkeypatch.setattr(eng, "_run_chunk", boom)
+    with pytest.raises(RuntimeError):
+        eng.step()
+    with pytest.raises(RuntimeError, match="engine step failed"):
+        sreq.result(timeout=1)
+    with pytest.raises(RuntimeError, match="engine step failed"):
+        lreq.result(timeout=1)            # the PREFILLING waiter too
+    monkeypatch.undo()
+    assert eng.scheduler.occupancy() == 0
+    assert eng.block_pool.in_use() == 0   # pools rebuilt...
+    assert all(eng.block_pool.refcount(b) == 0
+               for b in range(eng.block_pool.num_blocks))
+    r2 = eng.submit(long_p, max_new_tokens=4)
+    eng.run_until_idle()                  # ...and serving continues
+    ref = tiny_gpt.generate(paddle.to_tensor(long_p[None, :]),
+                            max_new_tokens=4).numpy()[0].tolist()
+    assert r2.result(timeout=1).tolist() == ref
+
+
+def test_chunked_param_validation(tiny_gpt):
+    with pytest.raises(ValueError, match="divide"):
+        _engine(tiny_gpt, prefill_chunk=7)          # 48 % 7 != 0
+    with pytest.raises(ValueError, match="tick_token_budget"):
+        _engine(tiny_gpt, prefill_chunk=8, tick_token_budget=4)
+    with pytest.raises(ValueError, match="requires prefill_chunk"):
+        _engine(tiny_gpt, tick_token_budget=8)
+    with pytest.raises(ValueError, match="prefill_buckets"):
+        _engine(tiny_gpt, prefill_chunk=8, prefill_buckets="pow2")
+
+
+# ---------------------------------------------------------------------------
+# HTTP edge validation (no socket: the handler's POST path is driven
+# directly with a stubbed send)
+# ---------------------------------------------------------------------------
+
+def _post_probe(engine, body):
+    """Drive _Handler.do_POST without a socket; returns (code, body,
+    headers) of the response the handler would have sent."""
+    from paddle_tpu.serving.httpd import _Handler
+
+    h = object.__new__(_Handler)
+    h.engine = engine
+    data = json.dumps(body).encode()
+    h.headers = {"Content-Length": str(len(data))}
+    h.rfile = io.BytesIO(data)
+    h.path = "/generate"
+    sent = {}
+
+    def _send(code, payload, ctype="application/json", headers=None):
+        sent["resp"] = (code, json.loads(payload), headers)
+
+    h._send = _send
+    h.do_POST()
+    return sent["resp"]
+
+
+def test_httpd_validates_prompt_at_edge(tiny_gpt):
+    """Over-capacity / malformed prompts get a clear 400 at the edge
+    instead of surfacing as an engine-side failure or timeout; nothing
+    reaches the queue."""
+    eng = _engine(tiny_gpt)               # never stepped on purpose
+    code, body, _ = _post_probe(
+        eng, {"prompt": list(range(60)), "max_new_tokens": 8})
+    assert code == 400 and "capacity" in body["error"]
+    code, body, _ = _post_probe(eng, {"prompt": [], "max_new_tokens": 2})
+    assert code == 400 and "non-empty" in body["error"]
+    code, body, _ = _post_probe(
+        eng, {"prompt": [1, "x"], "max_new_tokens": 2})
+    assert code == 400 and "integer" in body["error"]
+    code, body, _ = _post_probe(
+        eng, {"prompt": [1, 999], "max_new_tokens": 2})
+    assert code == 400 and "vocabulary" in body["error"]
+    code, body, _ = _post_probe(
+        eng, {"prompt": [1, 2], "max_new_tokens": 0})
+    assert code == 400 and "max_new_tokens" in body["error"]
+    assert eng.queue.depth() == 0
+
+
+def test_httpd_queue_full_sends_retry_after(tiny_gpt):
+    """The 503 shed-load response carries a Retry-After hint."""
+    eng = _engine(tiny_gpt, max_queue=1)  # never stepped: queue stays full
+    eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=2)
+    code, body, headers = _post_probe(
+        eng, {"prompt": [1, 2, 3], "max_new_tokens": 2})
+    assert code == 503 and "full" in body["error"]
+    assert headers and headers.get("Retry-After") == "1"
 
 
 @pytest.mark.slow
