@@ -177,39 +177,44 @@ class GPTAttention(nn.Layer):
         return out, k_buf, v_buf
 
     def _qkv_step(self, x):
-        """Fused QKV for a one-token slot step: Tensor [B, 1, E] ->
-        (qa, ka, va) arrays [B, 1, H, hd].  Shared by the contiguous
-        and paged slot decode paths."""
+        """Fused QKV for a slot-pool window: Tensor [B, S, E] ->
+        (qa, ka, va) arrays [B, S, H, hd] (S=1 is the one-token decode
+        step; S=k+1 is the speculative verify window).  Shared by the
+        contiguous and paged slot decode/verify paths."""
         if self.use_mp:
             q, k, v = self._qkv_mp(x)
         else:
-            b = x.shape[0]
+            b, s = x.shape[0], x.shape[1]
             qkv = self.qkv_proj(x)
-            qkv = reshape(qkv, [b, 1, 3, self.num_heads, self.head_dim])
+            qkv = reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         return q._data, k._data, v._data
 
     def _slot_attn(self, qa, k_rows, v_rows, pos):
-        """One-token attention over each slot's cache rows: f32
-        scores, per-row causal mask (cache positions <= pos[b]),
-        softmax, value contraction, output projection.  ONE
-        implementation shared by ``decode_slots`` (contiguous rows)
-        and ``decode_slots_paged`` (block-table-gathered rows), so the
-        paged path's token-parity guarantee is structural, not
-        by-convention.  qa [B, 1, H, hd]; k_rows/v_rows [B, L, H, hd];
-        pos int32 [B].  Returns out Tensor [B, 1, E]."""
+        """Windowed attention over each slot's cache rows: f32 scores,
+        per-row causal mask (the query at window offset q of slot b
+        sees cache positions <= pos[b] + q), softmax, value
+        contraction, output projection.  ONE implementation shared by
+        ``decode_slots`` / ``decode_slots_paged`` (S=1) and
+        ``verify_slots`` / ``verify_slots_paged`` (S=k+1 speculative
+        verify), so both the paged path's token-parity guarantee AND
+        the speculative verify's greedy parity are structural, not
+        by-convention.  qa [B, S, H, hd]; k_rows/v_rows [B, L, H, hd];
+        pos int32 [B] (window start per slot).  Returns out Tensor
+        [B, S, E]."""
         import math as _math
         import jax
         import jax.numpy as jnp
 
-        B = qa.shape[0]
+        B, S = qa.shape[0], qa.shape[1]
         scale = 1.0 / _math.sqrt(self.head_dim)
         scores = jnp.einsum("bqhd,bkhd->bhqk",
                             qa.astype(jnp.float32),
                             k_rows.astype(jnp.float32)) * scale
         L = k_rows.shape[1]
-        visible = jnp.arange(L)[None, :] <= pos[:, None]       # [B, L]
-        scores = jnp.where(visible[:, None, None, :], scores, -1e30)
+        visible = (jnp.arange(L)[None, None, :]
+                   <= (pos[:, None] + jnp.arange(S)[None, :])[:, :, None])
+        scores = jnp.where(visible[:, None, :, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs,
                          v_rows.astype(jnp.float32)).astype(qa.dtype)
@@ -219,7 +224,7 @@ class GPTAttention(nn.Layer):
             out = einsum("bshd,hde->bse", out, self.out_weight) + \
                 self.out_bias
         else:
-            out = reshape(out, [B, 1, self.num_heads * self.head_dim])
+            out = reshape(out, [B, S, self.num_heads * self.head_dim])
             out = self.out_proj(out)
         return out
 
@@ -281,6 +286,69 @@ class GPTAttention(nn.Layer):
         flat_k = flat_k.at[widx].set(ka[:, 0].astype(flat_k.dtype))
         flat_v = flat_v.at[widx].set(va[:, 0].astype(flat_v.dtype))
         # gather each slot's logical row: [B, L] physical indices
+        gidx = ((block_tables * bs)[:, :, None]
+                + jnp.arange(bs)[None, None, :]).reshape(B, -1)
+        out = self._slot_attn(qa, flat_k[gidx], flat_v[gidx], pos)
+        return (out, flat_k.reshape(k_pool.shape),
+                flat_v.reshape(v_pool.shape))
+
+    def verify_slots(self, x, k_buf, v_buf, pos):
+        """SPECULATIVE VERIFY window with per-slot positions
+        (serving/spec.py): score W = k+1 window tokens per slot in one
+        pass — token 0 is the slot's current (last emitted) token,
+        tokens 1..k are draft proposals.  Each window token's K/V is
+        written at ``pos[b] + offset`` and the queries attend causally
+        through the SAME ``_slot_attn`` as the one-token decode, so
+        window offset q of slot b computes exactly what a ``decode_slots``
+        step at ``pos[b] + q`` would compute given the same prefix —
+        the structural basis of the engine's greedy-parity guarantee.
+        Rejected lanes leave garbage K/V past the accepted prefix; the
+        engine only advances its write cursor over accepted lanes, and
+        the next window re-writes every garbage row before any query
+        can see it (cursor rewind, never a buffer operation).
+
+        x: Tensor [B, W, E]; k_buf/v_buf: [B, L, H, hd] arrays;
+        pos: int32 [B].  Returns (out Tensor [B, W, E], k_buf, v_buf).
+        """
+        import jax.numpy as jnp
+
+        qa, ka, va = self._qkv_step(x)
+        B, W = qa.shape[0], qa.shape[1]
+        rows = jnp.arange(B)[:, None]                       # [B, 1]
+        cols = pos[:, None] + jnp.arange(W)[None, :]        # [B, W]
+        k_buf = k_buf.at[rows, cols].set(ka.astype(k_buf.dtype))
+        v_buf = v_buf.at[rows, cols].set(va.astype(v_buf.dtype))
+        return self._slot_attn(qa, k_buf, v_buf, pos), k_buf, v_buf
+
+    def verify_slots_paged(self, x, k_pool, v_pool, block_tables, pos):
+        """Block-table twin of ``verify_slots`` (paged KV cache): the
+        W window tokens scatter through each slot's block table and
+        the gathered logical rows go through the SAME ``_slot_attn``
+        as ``decode_slots_paged``.  The engine's admission gate
+        reserves the speculative margin up front (``_kv_gate`` adds
+        ``spec_k`` to the worst case), so every window position —
+        rejected lanes included — lands inside the slot's own reserved
+        tail blocks: rollback is a cursor reset, never a pool
+        operation.  Parked slots (all-zero tables) write through the
+        scratch block as usual.
+
+        x: Tensor [B, W, E]; k_pool/v_pool: [NB, bs, H, hd];
+        block_tables: int32 [B, L//bs]; pos: int32 [B].  Returns
+        (out Tensor [B, W, E], k_pool, v_pool).
+        """
+        import jax.numpy as jnp
+
+        qa, ka, va = self._qkv_step(x)
+        B, W = qa.shape[0], qa.shape[1]
+        NB, bs = k_pool.shape[0], k_pool.shape[1]
+        rows = jnp.arange(B)
+        flat_k = k_pool.reshape(NB * bs, self.num_heads, self.head_dim)
+        flat_v = v_pool.reshape(NB * bs, self.num_heads, self.head_dim)
+        offs = pos[:, None] + jnp.arange(W)[None, :]        # [B, W]
+        widx = (block_tables[rows[:, None], offs // bs] * bs
+                + offs % bs)                                # [B, W]
+        flat_k = flat_k.at[widx].set(ka.astype(flat_k.dtype))
+        flat_v = flat_v.at[widx].set(va.astype(flat_v.dtype))
         gidx = ((block_tables * bs)[:, :, None]
                 + jnp.arange(bs)[None, None, :]).reshape(B, -1)
         out = self._slot_attn(qa, flat_k[gidx], flat_v[gidx], pos)
@@ -486,6 +554,22 @@ class GPTBlock(nn.Layer):
     def decode_slots_paged(self, x, k_pool, v_pool, block_tables, pos):
         """Block-table one-token decode (GPTAttention.decode_slots_paged)."""
         attn_out, k_pool, v_pool = self.attn.decode_slots_paged(
+            self.ln1(x), k_pool, v_pool, block_tables, pos)
+        x = x + attn_out
+        x = x + self.mlp(self.ln2(x))
+        return x, k_pool, v_pool
+
+    def verify_slots(self, x, k_buf, v_buf, pos):
+        """Speculative verify window (GPTAttention.verify_slots)."""
+        attn_out, k_buf, v_buf = self.attn.verify_slots(self.ln1(x),
+                                                        k_buf, v_buf, pos)
+        x = x + attn_out
+        x = x + self.mlp(self.ln2(x))
+        return x, k_buf, v_buf
+
+    def verify_slots_paged(self, x, k_pool, v_pool, block_tables, pos):
+        """Block-table speculative verify (GPTAttention.verify_slots_paged)."""
+        attn_out, k_pool, v_pool = self.attn.verify_slots_paged(
             self.ln1(x), k_pool, v_pool, block_tables, pos)
         x = x + attn_out
         x = x + self.mlp(self.ln2(x))
@@ -758,6 +842,101 @@ class GPTModel(nn.Layer):
             new_k.append(kb)
             new_v.append(vb)
         return self.head(x)._data[:, -1, :], new_k, new_v
+
+    def _spec_verify_tick_slots(self, toks, k_bufs, v_bufs, pos):
+        """SPECULATIVE VERIFY over a slot pool: run the W = k+1 window
+        tokens of every slot (current token + k drafts) in ONE forward
+        at per-slot positions ``pos[b]..pos[b]+W-1``, returning the
+        FULL logits — the engine accepts the longest prefix where the
+        target's argmax equals the draft, plus the one bonus token.
+        Like ``_decode_tick_slots`` but windowed (``verify_slots``).
+        Returns (logits [B, W, V], new_k, new_v)."""
+        import jax.numpy as jnp
+        pos = jnp.asarray(pos, jnp.int32)
+        W = toks.shape[1]
+        pids = pos[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+        x = self.embeddings(Tensor(toks), position_ids=Tensor(pids))
+        new_k, new_v = [], []
+        for j, blk in enumerate(self.blocks):
+            x, kb, vb = blk.verify_slots(x, k_bufs[j], v_bufs[j], pos)
+            new_k.append(kb)
+            new_v.append(vb)
+        return self.head(x)._data, new_k, new_v
+
+    def _spec_verify_tick_slots_paged(self, toks, k_pools, v_pools,
+                                      block_tables, pos):
+        """Paged twin of ``_spec_verify_tick_slots``: the window's K/V
+        scatters through per-slot block tables (``verify_slots_paged``).
+        Returns (logits [B, W, V], new_k, new_v)."""
+        import jax.numpy as jnp
+        pos = jnp.asarray(pos, jnp.int32)
+        W = toks.shape[1]
+        pids = pos[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+        x = self.embeddings(Tensor(toks), position_ids=Tensor(pids))
+        new_k, new_v = [], []
+        for j, blk in enumerate(self.blocks):
+            x, kb, vb = blk.verify_slots_paged(
+                x, k_pools[j], v_pools[j], block_tables, pos)
+            new_k.append(kb)
+            new_v.append(vb)
+        return self.head(x)._data, new_k, new_v
+
+    def _compiled_spec_verify_fn(self, pnames, params, cache_key,
+                                 paged=False):
+        """Build (or fetch) the jitted SPECULATIVE VERIFY dispatch for
+        the serving engine (serving/spec.py): contiguous layout
+        (p_list, b_list, k_pools, v_pools, toks [B, W], pos [B]) or
+        paged layout (p_list, b_list, k_pools, v_pools, block_tables
+        [B, L//bs], toks [B, W], pos [B]) -> (logits [B, W, V],
+        k_pools, v_pools).  ONE XLA program per (window, layout) —
+        W and the pool shapes are static, per-slot positions and block
+        tables are runtime inputs, so a fixed ``spec_k`` means exactly
+        one compile per layout however traffic varies (compile-probe
+        asserted in tests/test_serving.py, like the chunk-prefill
+        programs).  Both layouts score the window through the same
+        ``_slot_attn`` as their one-token decode twins, which is what
+        makes speculative greedy outputs token-identical to the
+        non-speculative engine.  Pools donated."""
+        import jax
+        from ..core import autograd
+        from ..jit import _swapped
+
+        cache = getattr(self, "_spec_verify_fn_cache", None)
+        if cache is None:
+            cache = self._spec_verify_fn_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        model = self
+        mbuffers = dict(self.named_buffers())
+        bnames = sorted(mbuffers)
+
+        if paged:
+            def pure(p_list, b_list, k_pools, v_pools, block_tables,
+                     toks, pos):
+                with _swapped(params, dict(zip(pnames, p_list))), \
+                        _swapped(mbuffers, dict(zip(bnames, b_list))):
+                    with autograd.no_grad():
+                        last, new_k, new_v = \
+                            model._spec_verify_tick_slots_paged(
+                                toks, k_pools, v_pools, block_tables,
+                                pos)
+                return last, new_k, new_v
+        else:
+            def pure(p_list, b_list, k_pools, v_pools, toks, pos):
+                with _swapped(params, dict(zip(pnames, p_list))), \
+                        _swapped(mbuffers, dict(zip(bnames, b_list))):
+                    with autograd.no_grad():
+                        last, new_k, new_v = \
+                            model._spec_verify_tick_slots(
+                                toks, k_pools, v_pools, pos)
+                return last, new_k, new_v
+
+        fn = jax.jit(pure, donate_argnums=(2, 3))
+        if len(cache) >= 8:  # FIFO bound, matching the other caches
+            cache.pop(next(iter(cache)))
+        cache[cache_key] = (fn, bnames, mbuffers)
+        return cache[cache_key]
 
     def _chunk_prefill_tick(self, toks, k_bufs, v_bufs, pos, true_len):
         """One CHUNKED-prefill dispatch against a slot's contiguous

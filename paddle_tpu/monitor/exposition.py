@@ -17,6 +17,14 @@ def _fmt(v):
     return str(int(f)) if f == int(f) else repr(f)
 
 
+def _escape_help(text):
+    """Prometheus text format 0.0.4: HELP text must escape ``\\`` as
+    ``\\\\`` and line feeds as ``\\n`` — a raw newline would split the
+    comment mid-line and corrupt the whole exposition (the line after
+    it would parse as a malformed sample)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def render_prometheus(registry=None):
     """Render every metric in ``registry`` (default: the process-wide
     default registry) as Prometheus text exposition."""
@@ -25,7 +33,7 @@ def render_prometheus(registry=None):
     for name, m in registry.items():
         pname = sanitize_name(name)
         if m.help:
-            lines.append(f"# HELP {pname} {m.help}")
+            lines.append(f"# HELP {pname} {_escape_help(m.help)}")
         if isinstance(m, Histogram):
             lines.append(f"# TYPE {pname} histogram")
             cum, total_sum, count = m.snapshot()

@@ -15,15 +15,25 @@ tick_token_budget=...)`` adds budgeted CHUNKED prefill: prompts split
 into fixed-size chunks interleaved with decode so a long prompt can
 no longer stall token emission for the active slots (decode latency
 is bounded by the per-tick token budget, not the longest queued
-prompt).  Metrics (queue depth, slot occupancy, tokens/sec,
-TTFT/TPOT, KV blocks in use, prefix hits/evictions, prefill chunks,
-decode stall) land in paddle_tpu.monitor and render via
-``render_prometheus()``.
+prompt).  ``Engine(spec_k=..., proposer=...)`` turns the decode tick
+into SPECULATIVE draft-and-verify (``serving.spec``): a proposer —
+``PromptLookupProposer`` (n-gram match on the slot's own history, no
+extra model) or ``DraftModelProposer`` (a smaller GPT) — guesses k
+tokens per slot, ONE jitted verify dispatch scores all k+1 positions,
+and the engine keeps the longest argmax-matching prefix plus the
+bonus token: 1..k+1 tokens per dispatch, greedy outputs still
+token-identical to the non-speculative engine.  Metrics (queue depth,
+slot occupancy, tokens/sec, TTFT/TPOT, KV blocks in use, prefix
+hits/evictions, prefill chunks, decode stall, spec
+proposed/accepted/acceptance-rate/tokens-per-tick) land in
+paddle_tpu.monitor and render via ``render_prometheus()``.
 """
 from .request import (  # noqa: F401
     Request, RequestQueue, RequestTimeout, QueueFull)
 from .scheduler import Scheduler, Slot  # noqa: F401
 from .kvcache import BlockPool, NoFreeBlocks, PrefixCache  # noqa: F401
+from .spec import (  # noqa: F401
+    Proposer, PromptLookupProposer, DraftModelProposer)
 from .engine import Engine  # noqa: F401
 from .httpd import EngineServer, serve  # noqa: F401
 
@@ -31,4 +41,5 @@ __all__ = [
     "Request", "RequestQueue", "RequestTimeout", "QueueFull",
     "Scheduler", "Slot", "Engine", "EngineServer", "serve",
     "BlockPool", "PrefixCache", "NoFreeBlocks",
+    "Proposer", "PromptLookupProposer", "DraftModelProposer",
 ]

@@ -130,6 +130,32 @@ class Engine:
     tick_token_budget : prompt tokens each tick may spend on prefill
         chunks (default: one ``prefill_chunk``; must be >= it so every
         tick makes progress).  Requires prefill_chunk.
+    spec_k : enable SPECULATIVE DECODING (serving/spec.py).  ``None``
+        (default) keeps the one-token decode tick; an int k >= 1 makes
+        each decode tick gather k draft tokens per slot from the
+        ``proposer``, verify all k+1 window positions in ONE jitted
+        dispatch (``GPTModel._compiled_spec_verify_fn`` — one compiled
+        program per (k, layout), reusing the decode tick's
+        ``_slot_attn``), accept the longest prefix where the target's
+        argmax equals the draft plus the one bonus token, and advance
+        the slot's position/KV write cursor only over the accepted
+        lanes — rejected lanes leave garbage rows the next window
+        rewrites before any query can see them, so rollback is a pure
+        cursor reset.  Greedy outputs stay token-identical to the
+        non-speculative engine (lossless greedy acceptance); seeded
+        sampling also matches, because the verify window's lane j
+        logits equal the one-token tick's logits for the same prefix
+        and the per-request rng draws once per emitted token either
+        way.  Works with both KV layouts and with chunked prefill.
+        Capacity: the verify window can write up to ``spec_k`` rows
+        past a request's last needed position, so ``submit`` requires
+        prompt + max_new_tokens + spec_k <= max_seq_len and the paged
+        admission gate reserves the extra blocks up front.
+    proposer : draft-token source for speculative decoding (requires
+        spec_k); defaults to ``PromptLookupProposer()`` — n-gram match
+        against the slot's own prompt + emitted history, zero extra
+        model.  ``DraftModelProposer(small_gpt)`` drafts with a
+        smaller model sharing the tokenizer/vocab (cross-checked).
 
     ``step()`` is single-threaded by design — run it from one loop
     (``run_until_idle`` or the ``start()`` background thread).
@@ -140,7 +166,8 @@ class Engine:
     def __init__(self, model, num_slots=4, max_seq_len=None,
                  max_queue=0, registry=None, prefill_buckets=None,
                  kv_block_size=None, kv_blocks=None, prefix_cache=True,
-                 prefill_chunk=None, tick_token_budget=None):
+                 prefill_chunk=None, tick_token_budget=None,
+                 spec_k=None, proposer=None):
         if getattr(model, "scan_layers", False):
             model = model._sync_decode_twin()
         model.eval()
@@ -218,6 +245,35 @@ class Engine:
             raise ValueError(
                 "tick_token_budget requires prefill_chunk (it bounds "
                 "the chunked-prefill spend per tick)")
+        self._spec_k = None
+        self.proposer = None
+        if spec_k is not None:
+            k = int(spec_k)
+            if k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {k}")
+            if k + 2 > self.max_seq_len:
+                raise ValueError(
+                    f"spec_k={k} leaves no room for any request in a "
+                    f"{self.max_seq_len}-position slot (the verify "
+                    "window needs prompt + max_new_tokens + spec_k to "
+                    "fit)")
+            self._spec_k = k
+            if proposer is None:
+                from .spec import PromptLookupProposer
+                proposer = PromptLookupProposer()
+            pv = getattr(proposer, "vocab_size", None)
+            if pv is not None and self.vocab_size is not None \
+                    and int(pv) != self.vocab_size:
+                raise ValueError(
+                    f"proposer vocab ({pv}) != target model vocab "
+                    f"({self.vocab_size}) — a draft from a different "
+                    "tokenizer can never match and only burns the "
+                    "verify window")
+            self.proposer = proposer
+        elif proposer is not None:
+            raise ValueError(
+                "proposer requires spec_k (the draft window width "
+                "fixes the compiled verify program's shape)")
         self._paged = kv_block_size is not None
         if self._paged:
             bsz = int(kv_block_size)
@@ -305,6 +361,27 @@ class Engine:
         self._m_decode_batch = reg.gauge(
             "serving.decode_batch", "DECODING slots in the latest "
             "decode dispatch")
+        # speculative-decoding surface (registered always; zero when
+        # spec_k is off)
+        self._m_spec_proposed = reg.counter(
+            "serving.spec_proposed", "draft lanes proposed to the "
+            "speculative verify dispatch")
+        self._m_spec_accepted = reg.counter(
+            "serving.spec_accepted", "draft lanes accepted (their "
+            "token emitted from a matched lane)")
+        self._m_spec_windows = reg.counter(
+            "serving.spec_windows", "per-slot verify windows scored "
+            "(one speculative dispatch covers every DECODING slot; "
+            "a request's final window may propose fewer than spec_k "
+            "lanes, so accepted/windows is the honest mean-accepted-"
+            "lanes denominator)")
+        self._m_spec_rate = reg.gauge(
+            "serving.spec_acceptance_rate", "accepted / proposed "
+            "draft lanes, cumulative over this engine's lifetime")
+        self._m_spec_tpt = reg.gauge(
+            "serving.spec_tokens_per_tick", "tokens emitted per "
+            "DECODING slot by the latest speculative verify dispatch "
+            "(1.0 = nothing accepted, spec_k+1 = full window)")
 
         self._last_decode_end = None  # stall anchor: end of the last
         #   decode dispatch, cleared when no slot is decoding
@@ -313,6 +390,7 @@ class Engine:
         #   re-locking the scheduler after the decode dispatch
         self._insert_fn = None
         self._tick_fn = None    # resolved jitted slot-decode handle
+        self._spec_fn = None    # resolved jitted spec-verify handle
         self._p_arrays = None   # lazy snapshots of param/buffer handles
         self._b_arrays = None   # (see refresh_params)
         self._thread = None
@@ -379,11 +457,14 @@ class Engine:
                       timeout=timeout, temperature=temperature,
                       top_k=top_k, top_p=top_p, seed=seed)
         total = len(req.prompt) + req.max_new_tokens
-        if total > self.max_seq_len:
+        margin = self._spec_k or 0
+        if total + margin > self.max_seq_len:
+            spec_note = (f" + spec_k ({margin}) speculative window "
+                         "margin" if margin else "")
             raise ValueError(
                 f"prompt ({len(req.prompt)}) + max_new_tokens "
-                f"({req.max_new_tokens}) = {total} exceeds the slot "
-                f"cache length ({self.max_seq_len})")
+                f"({req.max_new_tokens}){spec_note} = {total + margin} "
+                f"exceeds the slot cache length ({self.max_seq_len})")
         self.queue.put(req)
         self._m_reqs.inc()
         self._m_queue.set(self.queue.depth())
@@ -431,9 +512,16 @@ class Engine:
         a running request can never die of pool pressure mid-stream.
         Under pressure, LRU-evicts unreferenced cached prefixes; if the
         pool still cannot cover the non-shared span, returns False and
-        the request waits at the queue head."""
+        the request waits at the queue head.
+
+        Speculative decoding widens the worst case by ``spec_k``: the
+        verify window writes rejected-lane K/V up to spec_k positions
+        past the cursor, and reserving those rows HERE is what makes
+        rollback a cursor reset instead of a pool operation — every
+        window position lands in blocks the slot already owns."""
         s = len(req.prompt)
-        n_total = -(-(s + req.max_new_tokens) // self._bs)
+        n_total = -(-(s + req.max_new_tokens + (self._spec_k or 0))
+                    // self._bs)
         ctx, m = ([], 0)
         if self.prefix_cache is not None:
             ctx, m = self.prefix_cache.match(req.prompt)
@@ -730,10 +818,122 @@ class Engine:
         self._cur_tok[i, 0] = int(tok)
         self._pos[i] = slot.pos
 
+    def _spec_decode_tick(self, active):
+        """One speculative DRAFT-AND-VERIFY dispatch (spec_k=...):
+        gather k draft tokens per live slot from the proposer, score
+        all k+1 window positions in one jitted verify dispatch, then
+        per slot emit the longest prefix where the target's pick
+        equals the draft plus the one bonus token — 1..k+1 tokens per
+        slot per dispatch.  The write cursor advances only over
+        emitted tokens; rejected lanes leave garbage K/V that the next
+        window (which always spans the full k+1 positions from the new
+        cursor) rewrites before any query can see it."""
+        import jax.numpy as jnp
+        k = self._spec_k
+        W = k + 1
+        toks = np.zeros((self.num_slots, W), np.int32)
+        toks[:, 0] = self._cur_tok[:, 0]
+        for slot in active:
+            i = slot.index
+            req = slot.request
+            # clamp to what the request can still consume: the window
+            # emits at most (lanes + 1) tokens before max_new_tokens
+            # evicts, so lanes past remaining-1 could never be
+            # accepted — proposing them would waste proposer work and
+            # permanently deflate the acceptance-rate gauge with
+            # request-length effects that say nothing about draft
+            # quality (the compiled window shape stays the full W;
+            # the tail just rides as pad lanes)
+            n_lanes = min(k, req.max_new_tokens - len(req.generated) - 1)
+            toks[i, 1:] = toks[i, 0]  # pad lanes: repeat the current
+            #   token — window FILLER, never proposals (their garbage
+            #   K/V is rewritten before visibility like any rejected
+            #   lane, and the accept loop below cannot consume them)
+            n_drafted = 0
+            if n_lanes > 0:
+                history = np.concatenate(
+                    [req.prompt, np.asarray(req.generated, np.int32)])
+                d = np.asarray(self.proposer.propose(history, n_lanes),
+                               np.int32).reshape(-1)[:n_lanes]
+                toks[i, 1:1 + len(d)] = d
+                n_drafted = len(d)
+            slot.spec_lanes = n_drafted  # in-flight REAL draft lanes —
+            #   what the proposer returned, not what was asked: a
+            #   shortfall's pad fill must not count as proposed nor be
+            #   consumable as accepted.  (Counted into the proposed
+            #   metric only after the dispatch returns: a failed
+            #   verify must not deflate the lifetime acceptance-rate
+            #   gauge with lanes never scored.)
+        if self._spec_fn is None:
+            self._spec_fn, _, _ = self.model._compiled_spec_verify_fn(
+                self._pnames, self._params,
+                ("paged" if self._paged else "slot", W, self.num_slots,
+                 (self._kv_managed + 1, self._bs) if self._paged
+                 else self.max_seq_len, str(self._kv_dtype),
+                 tuple(self._pnames), self._bnames_all),
+                paged=self._paged)
+        fn = self._spec_fn
+        if self._paged:
+            last, self.k_pools, self.v_pools = fn(
+                self._p_list(), self._b_list(), self.k_pools,
+                self.v_pools, jnp.asarray(self._block_tables),
+                jnp.asarray(toks), jnp.asarray(self._pos))
+        else:
+            last, self.k_pools, self.v_pools = fn(
+                self._p_list(), self._b_list(), self.k_pools,
+                self.v_pools, jnp.asarray(toks), jnp.asarray(self._pos))
+        rows = np.asarray(last, np.float32)           # [B, W, V]
+        self._m_spec_windows.inc(len(active))
+        emitted = 0
+        for slot in active:
+            i = slot.index
+            req = slot.request
+            self._m_spec_proposed.inc(slot.spec_lanes)
+            n_emit = 0
+            n_acc = 0
+            j = 0
+            while True:
+                # lane j's logits are conditioned on exactly the
+                # accepted tokens, so _pick here equals the one-token
+                # tick's _pick for the same prefix (greedy AND seeded
+                # sampling: one rng draw per emitted token either way)
+                tok = self._pick(req, rows[i, j])
+                # only REAL lanes can match: a pad lane that happens
+                # to equal the pick must not be consumed (eviction at
+                # max_new would stop it anyway — this makes the bound
+                # local instead of an invariant-at-a-distance)
+                matched = j < slot.spec_lanes \
+                    and int(toks[i, j + 1]) == tok
+                if matched:
+                    # counted even when this very token finishes the
+                    # request (EOS proposed by a matched lane): the
+                    # draft DID predict an emitted token, and
+                    # n_emit - 1 would silently undercount it
+                    n_acc += 1
+                slot.pos += 1
+                self._pos[i] = slot.pos
+                self._emit(slot, tok)
+                n_emit += 1
+                if slot.request is None or not matched:
+                    break  # finished/evicted, or first draft mismatch
+                j += 1     # draft j verified: consume lane j+1
+            slot.spec_lanes = 0
+            self._m_spec_accepted.inc(n_acc)
+            emitted += n_emit
+        proposed = self._m_spec_proposed.value
+        if proposed:
+            self._m_spec_rate.set(
+                self._m_spec_accepted.value / proposed)
+        self._m_spec_tpt.set(emitted / len(active))
+        return emitted
+
     def _decode_tick(self, active):
         """One slot-batched decode dispatch; samples and advances every
-        live slot."""
+        live slot (speculative mode verifies a whole draft window per
+        slot instead — _spec_decode_tick)."""
         import jax.numpy as jnp
+        if self._spec_k is not None:
+            return self._spec_decode_tick(active)
         if self._tick_fn is None:
             # resolve once: the key embeds tuple(pnames), an O(n_params)
             # copy+hash not worth paying per generated token

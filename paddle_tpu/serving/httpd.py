@@ -79,8 +79,10 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         eng = self.engine
         if self.path == "/metrics":
+            # the full exposition content type: scrapers negotiate on
+            # the version/charset params, not just text/plain
             self._send(200, monitor.render_prometheus(eng.registry),
-                       ctype="text/plain; version=0.0.4")
+                       ctype="text/plain; version=0.0.4; charset=utf-8")
         elif self.path == "/healthz":
             info = {
                 "status": "ok",
@@ -93,6 +95,12 @@ class _Handler(BaseHTTPRequestHandler):
                 info["kv_blocks_cached"] = (
                     eng.prefix_cache.cached_blocks()
                     if eng.prefix_cache is not None else 0)
+            if getattr(eng, "_spec_k", None):
+                info["spec_k"] = eng._spec_k
+                info["spec_acceptance_rate"] = round(
+                    eng._m_spec_rate.value, 4)
+                info["spec_tokens_per_tick"] = round(
+                    eng._m_spec_tpt.value, 4)
             self._send_json(200, info)
         else:
             self._send_json(404, {"error": f"no route {self.path}"})

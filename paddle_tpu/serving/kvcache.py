@@ -31,6 +31,18 @@ Reference protocol (who holds how many refs on a block):
 * ``evict`` drops cache refs (LRU, unreferenced leaves first) until
   enough blocks free up.
 
+Cursor-rewind invariant (speculative decoding, serving/spec.py): a
+slot's KV WRITE CURSOR (``Slot.pos``) may move backward relative to
+rows already written — the verify window writes k+1 rows but the
+engine advances the cursor only over accepted lanes.  The block layer
+stays entirely out of that loop BY CONSTRUCTION: the admission gate
+reserves the worst case INCLUDING the ``spec_k`` window margin, so
+every window position (rejected lanes included) lands in blocks the
+slot already owns, rejected rows are plain garbage inside an owned
+block that the next window overwrites, and rollback therefore never
+allocs, frees, or refcounts a block.  Nothing here tracks a cursor —
+which is the invariant: no pool state can go stale on a rewind.
+
 The invariant tests live in tests/test_kvcache.py.
 """
 from __future__ import annotations

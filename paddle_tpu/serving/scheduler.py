@@ -22,7 +22,8 @@ import threading
 
 
 class Slot:
-    __slots__ = ("index", "request", "pos", "prefilled", "seq")
+    __slots__ = ("index", "request", "pos", "prefilled", "seq",
+                 "spec_lanes")
 
     def __init__(self, index):
         self.index = index
@@ -34,6 +35,15 @@ class Slot:
         self.seq = 0        # admission order stamp: chunked prefill
         #                     resumes earlier-admitted (partially done)
         #                     prompts before starting fresh ones
+        self.spec_lanes = 0  # REAL draft lanes in flight in the
+        #                      current speculative verify dispatch
+        #                      (the engine's accept loop consumes at
+        #                      most this many — pad lanes never
+        #                      match); reset on admit/evict, so a slot
+        #                      that failed mid-verify re-binds clean —
+        #                      the rejected lanes' K/V needs no other
+        #                      cleanup (cursor never advanced over
+        #                      them)
 
     @property
     def free(self):
@@ -133,6 +143,7 @@ class Scheduler:
                     slot.request = req
                     slot.pos = 0
                     slot.prefilled = 0
+                    slot.spec_lanes = 0
                     self._admit_seq += 1
                     slot.seq = self._admit_seq
         return [s for s, _ in binds], timed_out
@@ -144,6 +155,7 @@ class Scheduler:
             slot.request = None
             slot.pos = 0
             slot.prefilled = 0
+            slot.spec_lanes = 0
         if req is not None:
             req._finish(error)
         return req

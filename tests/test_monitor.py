@@ -64,6 +64,22 @@ def test_histogram_percentile_overflow_and_empty():
     assert h.percentile(99) == 2
 
 
+def test_render_prometheus_escapes_help_text():
+    """Regression (Prometheus text format 0.0.4): HELP text containing
+    a raw newline or backslash must be escaped (\\n / \\\\) — an
+    unescaped newline splits the comment mid-line and the spill parses
+    as a malformed sample, corrupting the whole exposition."""
+    reg = StatRegistry()
+    reg.counter("multi.line", "first line\nsecond line").inc(2)
+    reg.gauge("back.slash", "a C:\\path\\to thing").set(1)
+    text = render_prometheus(reg)
+    for line in text.splitlines():  # no comment ever spills a line
+        assert line.startswith("#") or line.split()[0] in (
+            "multi_line", "back_slash")
+    assert "# HELP multi_line first line\\nsecond line" in text
+    assert "# HELP back_slash a C:\\\\path\\\\to thing" in text
+
+
 def test_render_prometheus_empty_histogram():
     """Regression: a never-observed histogram still renders its full
     bucket series, the +Inf bucket, _sum and _count as zeros — a

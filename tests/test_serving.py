@@ -1,7 +1,9 @@
 """Continuous-batching serving engine: token parity with per-request
 generate(), slot eviction on EOS, admission under a full pool, queue
 timeouts, budgeted CHUNKED PREFILL (parity, per-tick token budget,
-decode-not-stalled mixed workload, mid-chunk failure recovery), HTTP
+decode-not-stalled mixed workload, mid-chunk failure recovery),
+SPECULATIVE DECODING (draft-and-verify parity on both KV layouts,
+exact acceptance accounting, in-flight-lane failure recovery), HTTP
 edge validation, and the metrics surface (all CPU, tiny model, tier-1
 safe)."""
 import io
@@ -17,7 +19,9 @@ import paddle_tpu as paddle
 from paddle_tpu import monitor
 from paddle_tpu.models import GPTModel
 from paddle_tpu.serving import (Engine, EngineServer, QueueFull,
-                                RequestQueue, RequestTimeout, Request)
+                                RequestQueue, RequestTimeout, Request,
+                                Proposer, PromptLookupProposer,
+                                DraftModelProposer)
 
 
 @pytest.fixture(scope="module")
@@ -409,6 +413,343 @@ def test_chunked_param_validation(tiny_gpt):
 
 
 # ---------------------------------------------------------------------------
+# Speculative decoding (Engine(spec_k=..., proposer=...), serving/spec.py)
+# ---------------------------------------------------------------------------
+
+def _gen_ref(model, p, n):
+    return model.generate(paddle.to_tensor(p[None, :]),
+                          max_new_tokens=n).numpy()[0].tolist()
+
+
+def test_prompt_lookup_proposer_unit():
+    """n-gram drafting against the history: most recent earlier
+    occurrence wins, the trailing pattern itself never matches, and
+    short/matchless histories draft nothing (the engine pads)."""
+    prop = PromptLookupProposer(ngram=2)
+    #          0  1  2  3  4  5  6  7
+    history = [5, 9, 7, 3, 5, 9, 4, 5, 9]
+    # trailing bigram (5, 9) last occurred at 4..5 -> continue with 4, 5
+    assert prop.propose(history, 2).tolist() == [4, 5]
+    assert prop.propose(history, 4).tolist() == [4, 5, 9]  # clipped tail
+    assert prop.propose([1, 2, 3, 4], 3).tolist() == []    # no match
+    assert prop.propose([1, 2], 3).tolist() == []          # too short
+    with pytest.raises(ValueError):
+        PromptLookupProposer(ngram=0)
+
+
+def test_spec_param_validation(tiny_gpt):
+    with pytest.raises(ValueError, match="spec_k must be"):
+        _engine(tiny_gpt, spec_k=0)
+    with pytest.raises(ValueError, match="requires spec_k"):
+        _engine(tiny_gpt, proposer=PromptLookupProposer())
+    bad = type("P", (Proposer,), {"vocab_size": 999})()
+    with pytest.raises(ValueError, match="vocab"):
+        _engine(tiny_gpt, spec_k=2, proposer=bad)
+    # the speculative window margin tightens the capacity rule
+    eng = _engine(tiny_gpt, spec_k=4, max_seq_len=16)
+    with pytest.raises(ValueError, match="spec_k"):
+        eng.submit(np.zeros(6, np.int32), max_new_tokens=8)  # 6+8+4 > 16
+    eng.submit(np.zeros(4, np.int32), max_new_tokens=8)      # 4+8+4 = 16
+
+
+def test_spec_parity_contiguous_vs_plain_and_chunked(tiny_gpt):
+    """The acceptance criterion: Engine(spec_k=4, PromptLookupProposer)
+    greedy outputs are token-identical to the non-speculative engine
+    (unchunked AND chunked) and to generate(), with staggered
+    mid-decode admissions."""
+    prompts = _prompts(4)
+    outs = {}
+    for name, kw in (("spec", dict(spec_k=4,
+                                   proposer=PromptLookupProposer())),
+                     ("plain", dict()),
+                     ("chunked", dict(prefill_chunk=4,
+                                      tick_token_budget=8)),
+                     ("spec+chunked", dict(spec_k=4, prefill_chunk=4,
+                                           tick_token_budget=8)),
+                     ("spec+chunked+paged", dict(spec_k=4,
+                                                 prefill_chunk=4,
+                                                 tick_token_budget=8,
+                                                 kv_block_size=8))):
+        eng = _engine(tiny_gpt, **kw)
+        reqs = [eng.submit(p, max_new_tokens=8) for p in prompts[:2]]
+        for _ in range(2):
+            eng.step()                   # mid-decode arrivals
+        reqs += [eng.submit(p, max_new_tokens=8) for p in prompts[2:]]
+        eng.run_until_idle()
+        outs[name] = [r.result(timeout=1).tolist() for r in reqs]
+    assert all(o == outs["plain"] for o in outs.values()), \
+        {k: v for k, v in outs.items() if v != outs["plain"]}
+    for p, got in zip(prompts, outs["spec"]):
+        assert got == _gen_ref(tiny_gpt, p, 8)
+
+
+def test_spec_parity_paged_with_prefix_reuse(tiny_gpt):
+    """Speculative decode over the PAGED layout, including adoption of
+    a cached prompt prefix: still token-identical to generate(), and
+    rejected-lane writes never corrupt shared blocks (the adopters'
+    outputs would diverge if they did)."""
+    rng = np.random.RandomState(11)
+    sysp = rng.randint(0, 128, (16,)).astype(np.int32)
+    prompts = [np.concatenate([sysp, rng.randint(0, 128, (k,))
+                               .astype(np.int32)]) for k in (3, 5, 4)]
+    reg = monitor.StatRegistry()
+    eng = _engine(tiny_gpt, registry=reg, kv_block_size=8, spec_k=4)
+    first = eng.submit(prompts[0], max_new_tokens=6)
+    eng.run_until_idle()              # prompt 0's blocks now cached
+    rest = [eng.submit(p, max_new_tokens=6) for p in prompts[1:]]
+    eng.run_until_idle()
+    outs = [first.result(timeout=1).tolist()] + \
+        [r.result(timeout=1).tolist() for r in rest]
+    assert outs == [_gen_ref(tiny_gpt, p, 6) for p in prompts]
+    assert reg.get("serving.prefix_hits").value == 2
+    # every block reference was returned at eviction despite the
+    # speculative margin reservation
+    assert eng.block_pool.in_use() == \
+        eng.prefix_cache.cached_blocks()
+
+
+def test_spec_compile_probe_one_program_per_layout():
+    """The compile-bound guarantee: however many prompts, lengths, and
+    dispatches, a fixed spec_k compiles exactly ONE verify program per
+    KV layout."""
+    paddle.seed(0)
+    model = GPTModel.from_config("tiny", dropout=0.0)
+    model.eval()
+    prompts = _prompts(4)
+    for kw in (dict(), dict(kv_block_size=8)):
+        eng = _engine(model, spec_k=3, **kw)
+        reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        eng.run_until_idle()
+        for r in reqs:
+            r.result(timeout=1)
+    keys = sorted(k[0] for k in model._spec_verify_fn_cache)
+    assert keys == ["paged", "slot"]
+    # re-serving does not grow the cache (no retrace)
+    eng = _engine(model, spec_k=3)
+    eng.submit(prompts[0], max_new_tokens=4)
+    eng.run_until_idle()
+    assert len(model._spec_verify_fn_cache) == 2
+
+
+class _OracleProposer(Proposer):
+    """Drafts the target's own greedy continuation (precomputed) —
+    every lane matches, making the acceptance accounting exactly
+    predictable."""
+
+    def __init__(self, ref_ids):
+        self.ref = [int(x) for x in ref_ids]
+
+    def propose(self, history, k):
+        n = len(history)
+        assert self.ref[:n] == [int(x) for x in history]
+        return np.asarray(self.ref[n:n + k], np.int32)
+
+
+def test_spec_acceptance_accounting_exact(tiny_gpt):
+    """serving.spec_proposed / spec_accepted / spec_acceptance_rate /
+    spec_tokens_per_tick count proposed vs accepted EXACTLY: an oracle
+    proposer accepts every lane, so 11 post-prefill tokens of one
+    request take ceil(11/4) = 3 dispatches of spec_k=3."""
+    p = _prompts(1)[0]
+    ref = _gen_ref(tiny_gpt, p, 12)
+    reg = monitor.StatRegistry()
+    eng = _engine(tiny_gpt, registry=reg, spec_k=3,
+                  proposer=_OracleProposer(ref))
+    req = eng.submit(p, max_new_tokens=12)
+    eng.run_until_idle()
+    assert req.result(timeout=1).tolist() == ref
+    # prefill emits token 1; dispatches emit 4 + 4 + 3 (capped by
+    # max_new_tokens): accepted lanes 3 + 3 + 2, and the final window
+    # PROPOSES only the 2 lanes the request can still consume — a
+    # perfect oracle therefore reads acceptance_rate exactly 1.0
+    # (request length must not deflate the draft-quality gauge)
+    assert reg.get("serving.spec_proposed").value == 8
+    assert reg.get("serving.spec_accepted").value == 8
+    assert reg.get("serving.spec_windows").value == 3
+    assert reg.get("serving.spec_acceptance_rate").value == 1.0
+    assert reg.get("serving.spec_tokens_per_tick").value == 3.0
+    assert reg.get("serving.tokens_total").value == 12
+
+
+def test_spec_empty_proposer_counts_nothing(tiny_gpt):
+    """A proposer that never drafts: the window runs on pad filler
+    only — one token per dispatch, outputs still exact, and NO pad
+    lane is ever counted as proposed or consumed as accepted (the
+    acceptance gauges measure the proposer, not the engine's
+    filler)."""
+
+    class _NeverProposer(Proposer):
+        def propose(self, history, k):
+            return np.zeros(0, np.int32)
+
+    reg = monitor.StatRegistry()
+    eng = _engine(tiny_gpt, registry=reg, spec_k=4,
+                  proposer=_NeverProposer())
+    p = _prompts(1)[0]
+    req = eng.submit(p, max_new_tokens=6)
+    eng.run_until_idle()
+    assert req.result(timeout=1).tolist() == _gen_ref(tiny_gpt, p, 6)
+    assert reg.get("serving.spec_windows").value == 5  # 1 tok each
+    assert reg.get("serving.spec_proposed").value == 0
+    assert reg.get("serving.spec_accepted").value == 0
+    assert reg.get("serving.spec_acceptance_rate").value == 0.0
+
+
+def test_spec_sampling_matches_nonspec_engine(tiny_gpt):
+    """Seeded sampling under speculation: lane j's logits equal the
+    one-token tick's logits for the same prefix and the per-request
+    rng draws once per emitted token either way, so sampled outputs
+    match the non-speculative engine token-for-token."""
+    p = _prompts(1)[0]
+    kw = dict(max_new_tokens=8, temperature=0.8, top_k=20, seed=123)
+    outs = []
+    for spec in (None, 4):
+        eng = _engine(tiny_gpt, spec_k=spec)
+        r = eng.submit(p, **kw)
+        eng.run_until_idle()
+        outs.append(r.result(timeout=1).tolist())
+    assert outs[0] == outs[1]
+
+
+def test_spec_eos_mid_window_matches_generate(tiny_gpt):
+    """EOS emitted from inside an accepted window: the engine stops
+    exactly where generate() stops and discards the window's remaining
+    verified lanes."""
+    p = _prompts(1)[0]
+    full = _gen_ref(tiny_gpt, p, 8)
+    eos = int(full[len(p) + 3])
+    ref = tiny_gpt.generate(paddle.to_tensor(p[None, :]),
+                            max_new_tokens=8,
+                            eos_token_id=eos).numpy()[0].tolist()
+    reg = monitor.StatRegistry()
+    eng = _engine(tiny_gpt, registry=reg, spec_k=4,
+                  proposer=_OracleProposer(full))
+    req = eng.submit(p, max_new_tokens=8, eos_token_id=eos)
+    eng.run_until_idle()
+    assert req.result(timeout=1).tolist() == ref
+    assert eng.scheduler.occupancy() == 0
+    if len(ref) == len(p) + 4:      # EOS really was the 4th token
+        # ONE window: lanes 2-4 emit tokens 2-4; the lane that
+        # correctly drafted the EOS counts as accepted too
+        assert reg.get("serving.spec_proposed").value == 4
+        assert reg.get("serving.spec_accepted").value == 3
+        assert reg.get("serving.spec_windows").value == 1
+
+
+def test_spec_failure_with_inflight_lanes_recovers(tiny_gpt):
+    """Step failure DURING a speculative verify (draft lanes in
+    flight, paged layout): every waiter unblocks loudly, slots carry
+    their lanes into eviction and come back clean, pool refcounts
+    rebuild to zero, and the engine keeps serving."""
+    reg = monitor.StatRegistry()
+    eng = _engine(tiny_gpt, registry=reg, kv_block_size=8, spec_k=4)
+    prompts = _prompts(2)
+    reqs = [eng.submit(p, max_new_tokens=16) for p in prompts]
+    eng.step()                       # resolves the verify dispatch
+    assert all(not r.done() for r in reqs)
+
+    def boom(*a, **kw):
+        raise RuntimeError("synthetic verify dispatch failure")
+
+    eng._spec_fn = boom              # the NEXT verify dies mid-flight
+    with pytest.raises(RuntimeError):
+        eng.step()
+    for r in reqs:
+        with pytest.raises(RuntimeError, match="engine step failed"):
+            r.result(timeout=1)
+    assert eng.scheduler.occupancy() == 0
+    assert all(s.spec_lanes == 0 for s in eng.scheduler.slots)
+    assert eng.block_pool.in_use() == 0
+    assert all(eng.block_pool.refcount(b) == 0
+               for b in range(eng.block_pool.num_blocks))
+    eng._spec_fn = None              # re-resolve on the next tick
+    r2 = eng.submit(prompts[0], max_new_tokens=6)
+    eng.run_until_idle()
+    assert r2.result(timeout=1).tolist() == _gen_ref(tiny_gpt,
+                                                     prompts[0], 6)
+
+
+@pytest.fixture(scope="module")
+def cyclic_gpt():
+    """Tiny model trained to emit a short cycle (the
+    test_generation.py trick): prompt-lookup drafts then accept, so
+    speculation actually pays — the fast tier-1 twin of bench.py's
+    serving_spec repetitive workload."""
+    from paddle_tpu import optimizer
+    from paddle_tpu.parallel.train_step import TrainStep
+    paddle.seed(3)
+    m = GPTModel.from_config("tiny", dropout=0.0, max_position=128)
+    cyc = np.tile(np.array([11, 22, 33, 44], np.int32), 16)
+    step = TrainStep(m, optimizer.Adam(
+        learning_rate=5e-3, parameters=m.parameters()), loss_fn=None)
+    for _ in range(60):
+        lv = float(step.step([cyc[None, :-1].copy(),
+                              cyc[None, 1:].copy()]).numpy())
+    assert lv < 0.1, lv
+    step.sync_to_layer()
+    m.eval()
+    return m
+
+
+def test_spec_accepts_on_repetitive_workload(cyclic_gpt):
+    """The speedup case (fast tier-1 variant of BENCH_r07): on a
+    repetitive workload the prompt-lookup proposer's lanes accept —
+    acceptance_rate > 0, mean accepted lanes > 1 — in far fewer
+    dispatches than tokens, while staying token-identical to the
+    non-speculative engine and generate()."""
+    prompts = [np.tile(np.array([11, 22, 33, 44], np.int32), 3),
+               np.tile(np.array([22, 33, 44, 11], np.int32), 3)]
+    n_new = 24
+    reg = monitor.StatRegistry()
+    eng = Engine(cyclic_gpt, num_slots=2, max_seq_len=64,
+                 registry=reg, spec_k=4)
+    ref_eng = Engine(cyclic_gpt, num_slots=2, max_seq_len=64,
+                     registry=monitor.StatRegistry())
+    reqs = [eng.submit(p, max_new_tokens=n_new) for p in prompts]
+    n_ticks = 0
+    while not eng.scheduler.idle():
+        eng.step()
+        n_ticks += 1
+    ref_reqs = [ref_eng.submit(p, max_new_tokens=n_new)
+                for p in prompts]
+    ref_eng.run_until_idle()
+    for p, r, rr in zip(prompts, reqs, ref_reqs):
+        got = r.result(timeout=1).tolist()
+        assert got == rr.result(timeout=1).tolist()
+        assert got == _gen_ref(cyclic_gpt, p, n_new)
+    proposed = reg.get("serving.spec_proposed").value
+    accepted = reg.get("serving.spec_accepted").value
+    windows = reg.get("serving.spec_windows").value
+    rate = reg.get("serving.spec_acceptance_rate").value
+    assert proposed > 0 and accepted > 0
+    assert rate == pytest.approx(accepted / proposed)
+    assert rate > 0.5                  # the cycle drafts accept
+    assert accepted / windows > 1.0    # mean accepted lanes > 1
+    # 2 * 24 tokens in far fewer than 2 * 24 slot-dispatches
+    assert n_ticks < n_new / 2
+
+
+def test_spec_draft_model_proposer(tiny_gpt):
+    """DraftModelProposer: drafting with the target itself is a
+    perfect oracle — full acceptance, parity intact (a real deployment
+    would use a smaller model sharing the vocab)."""
+    reg = monitor.StatRegistry()
+    eng = _engine(tiny_gpt, registry=reg, spec_k=3,
+                  proposer=DraftModelProposer(tiny_gpt))
+    p = _prompts(1)[0]
+    req = eng.submit(p, max_new_tokens=10)
+    eng.run_until_idle()
+    assert req.result(timeout=1).tolist() == _gen_ref(tiny_gpt, p, 10)
+    # self-drafting accepts every lane: 9 post-prefill tokens in 3
+    # dispatches emitting 4 + 4 + 1; the last window proposes 0 lanes
+    # (only the bonus token fits under max_new), so the draft model
+    # is never even consulted for it
+    assert reg.get("serving.spec_proposed").value == 6
+    assert reg.get("serving.spec_accepted").value == 6
+    assert reg.get("serving.spec_acceptance_rate").value == 1.0
+
+
+# ---------------------------------------------------------------------------
 # HTTP edge validation (no socket: the handler's POST path is driven
 # directly with a stubbed send)
 # ---------------------------------------------------------------------------
@@ -454,6 +795,54 @@ def test_httpd_validates_prompt_at_edge(tiny_gpt):
         eng, {"prompt": [1, 2], "max_new_tokens": 0})
     assert code == 400 and "max_new_tokens" in body["error"]
     assert eng.queue.depth() == 0
+
+
+def _get_probe(engine, path):
+    """Drive _Handler.do_GET without a socket; returns (code, body,
+    ctype) of the response the handler would have sent."""
+    from paddle_tpu.serving.httpd import _Handler
+
+    h = object.__new__(_Handler)
+    h.engine = engine
+    h.path = path
+    sent = {}
+
+    def _send(code, payload, ctype="application/json", headers=None):
+        sent["resp"] = (code, payload, ctype)
+
+    def _send_json(code, obj, headers=None):
+        sent["resp"] = (code, obj, "application/json")
+
+    h._send = _send
+    h._send_json = _send_json
+    h.do_GET()
+    return sent["resp"]
+
+
+def test_httpd_metrics_content_type_and_spec_healthz(tiny_gpt):
+    """/metrics must carry the full exposition content type
+    (version + charset — scrapers negotiate on it), and /healthz
+    reports the speculative-decode gauges when spec_k is on."""
+    eng = _engine(tiny_gpt, spec_k=4)
+    code, _, ctype = _get_probe(eng, "/metrics")
+    assert code == 200
+    assert ctype == "text/plain; version=0.0.4; charset=utf-8"
+    req = eng.submit(_prompts(1)[0], max_new_tokens=6)
+    eng.run_until_idle()
+    req.result(timeout=1)
+    code, health, _ = _get_probe(eng, "/healthz")
+    assert code == 200 and health["status"] == "ok"
+    assert health["spec_k"] == 4
+    assert 0.0 <= health["spec_acceptance_rate"] <= 1.0
+    assert health["spec_tokens_per_tick"] >= 1.0
+    # spec off -> the gauges stay out of the health payload
+    code, health, _ = _get_probe(_engine(tiny_gpt), "/healthz")
+    assert "spec_k" not in health
+    text = monitor.render_prometheus(eng.registry)
+    assert "serving_spec_proposed" in text
+    assert "serving_spec_accepted" in text
+    assert "serving_spec_acceptance_rate" in text
+    assert "serving_spec_tokens_per_tick" in text
 
 
 def test_httpd_queue_full_sends_retry_after(tiny_gpt):
