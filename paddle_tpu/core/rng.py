@@ -127,3 +127,15 @@ def key_for(seed_val: int | None):
     if seed_val:
         return jax.random.key(int(seed_val))
     return next_key()
+
+
+def request_key(seed_lo, seed_hi):
+    """Key for a serving request's sampling stream, built from the
+    seed's two 32-bit words (``Request.seed_words()``): jax without
+    x64 demotes int64 inputs to int32, so a 63-bit request seed must
+    travel as two uint32 lanes and fold back together here.  Works
+    with concrete ints AND traced uint32 values — the serving engine's
+    fused on-device sampling vmaps this over the slot pool, and the
+    eager first-token pick calls it with the same words, so the two
+    paths draw from one stream (key = fold(request_key, token_index))."""
+    return jax.random.fold_in(jax.random.key(seed_lo), seed_hi)

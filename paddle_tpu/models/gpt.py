@@ -24,6 +24,33 @@ from ..nn.layer.scan import ScanLayers
 from ..ops import reshape, transpose, concat
 
 
+_sample_rows_jit = None  # lazily-jitted single-call sampler (below)
+
+
+def sample_rows(last, temperature, top_k, top_p, seed_lo, seed_hi,
+                ctr):
+    """Standalone jitted twin of the fused dispatches' sampling tail:
+    derive per-row keys from the seed words + counters and pick one
+    token per row of ``last`` [B, V] (``GPTModel._sample_lanes``).
+    The serving engine's first-token pick (prefill / final chunk)
+    calls this instead of running the ops eagerly — eager
+    ``lax.cond`` re-traces its branch closures on every call, which
+    would recompile per admission; this wrapper has stable identity,
+    so it compiles once per (B, V) shape for the whole process."""
+    global _sample_rows_jit
+    if _sample_rows_jit is None:
+        import jax
+
+        def pick(last, temperature, top_k, top_p, lo, hi, c):
+            keys = GPTModel._slot_sample_keys(lo, hi, c)
+            return GPTModel._sample_lanes(last, temperature, top_k,
+                                          top_p, keys)
+
+        _sample_rows_jit = jax.jit(pick)
+    return _sample_rows_jit(last, temperature, top_k, top_p, seed_lo,
+                            seed_hi, ctr)
+
+
 GPT_CONFIGS = {
     # name: (n_layer, hidden, heads, ffn_mult, vocab, max_seq)
     "gpt2-small": dict(num_layers=12, hidden_size=768, num_heads=12,
@@ -787,6 +814,78 @@ class GPTModel(nn.Layer):
             last = jnp.where(last < cutoff, -1e9, last)
         return last
 
+    @staticmethod
+    def _filter_logits_lanes(last, temperature, top_k, top_p):
+        """PER-LANE sampling filters on f32 logits [B, V]: temperature
+        / top_k / top_p are [B] arrays — one independent request per
+        batch row (the serving slot pool), every parameter traced, so
+        ONE compiled program serves any per-slot mix.  Same filter
+        sequence and masking values as ``_filter_logits`` (temperature
+        -> top-k -> top-p over the already-masked row), just with the
+        scalars lifted to lanes; ``top_k == 0`` / ``top_p == 1``
+        disable their filter lane-wise, and a ``temperature == 0``
+        greedy-sentinel lane passes through at temperature 1 (its
+        filtered row is discarded — ``_sample_lanes`` argmaxes the raw
+        logits instead)."""
+        import jax
+        import jax.numpy as jnp
+        V = last.shape[-1]
+        t_eff = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+        x = last / t_eff
+        srt = jnp.sort(x, axis=-1)[:, ::-1]
+        k_eff = jnp.clip(top_k, 1, V).astype(jnp.int32)
+        kth = jnp.take_along_axis(srt, (k_eff - 1)[:, None], axis=-1)
+        x = jnp.where((top_k > 0)[:, None] & (x < kth), -1e9, x)
+        p_eff = jnp.maximum(top_p, 1e-9)[:, None]
+        srt2 = jnp.sort(x, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(srt2, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < p_eff
+        cutoff = jnp.min(jnp.where(keep, srt2, jnp.inf), axis=-1,
+                         keepdims=True)
+        return jnp.where((top_p < 1.0)[:, None] & (x < cutoff), -1e9, x)
+
+    @staticmethod
+    def _slot_sample_keys(seed_lo, seed_hi, ctr):
+        """Per-slot sampling keys for the fused dispatches: fold the
+        emitted-token counter into each request's seed-derived key
+        (core/rng.request_key over the uint32 seed words), so token i
+        of a request always draws from fold(request_key, i) — the same
+        stream whether it is emitted by a one-token tick, a verify-
+        window lane, or the eager first-token pick after prefill.
+        seed_lo/seed_hi uint32 [B], ctr int32 [B] -> keys [B]."""
+        import jax
+        from ..core import rng as rng_mod
+        return jax.vmap(lambda lo, hi, c: jax.random.fold_in(
+            rng_mod.request_key(lo, hi), c))(seed_lo, seed_hi, ctr)
+
+    @staticmethod
+    def _sample_lanes(last, temperature, top_k, top_p, keys):
+        """One token per slot row from [B, V] logits with PER-SLOT
+        sampling params and keys: lanes with ``temperature == 0`` (the
+        greedy sentinel) take the raw argmax — bit-identical to the
+        host path's ``np.argmax`` on the same logits — and sampling
+        lanes draw categorically from the lane-filtered distribution.
+        The filter/draw pipeline (two [B, V] sorts + categorical) sits
+        behind a runtime ``lax.cond``: an all-greedy batch — the
+        serving default — skips it entirely instead of computing both
+        sides of a where, while staying ONE compiled program.
+        Returns int32 [B]."""
+        import jax
+        import jax.numpy as jnp
+        last = last.astype(jnp.float32)
+        greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+        def draw(_):
+            filt = GPTModel._filter_logits_lanes(last, temperature,
+                                                 top_k, top_p)
+            sampled = jax.vmap(jax.random.categorical)(keys, filt)
+            return jnp.where(temperature > 0, sampled,
+                             greedy).astype(jnp.int32)
+
+        return jax.lax.cond(jnp.any(temperature > 0), draw,
+                            lambda _: greedy, None)
+
     def _decode_tick(self, tok, k_bufs, v_bufs, pos):
         """One-token decode against fixed-size cache buffers: embeddings
         -> each block's decode -> head.  Shared by the per-token jitted
@@ -880,6 +979,194 @@ class GPTModel(nn.Layer):
             new_k.append(kb)
             new_v.append(vb)
         return self.head(x)._data, new_k, new_v
+
+    def _fused_decode_tick_slots(self, tok, k_bufs, v_bufs, pos, temp,
+                                 top_k, top_p, seed_lo, seed_hi, ctr,
+                                 block_tables=None):
+        """FUSED one-token decode + ON-DEVICE sampling over the slot
+        pool: run the decode tick, then sample every lane in the same
+        dispatch (``_sample_lanes`` with per-slot params and
+        seed+counter-derived keys) and advance the device-resident
+        step state — so a steady-state engine tick uploads nothing and
+        downloads only the [B] sampled ids instead of the [B, V]
+        logits matrix.  ``temperature == 0`` lanes are greedy (raw
+        argmax, bit-identical to the host path on the same logits).
+        Parked rows advance too (their sample is garbage the next
+        admission overwrites); the position clamp keeps their drifting
+        cursor writing in-bounds rows that prefill rewrites wholesale.
+        Returns (ids [B], new_tok [B,1], new_pos [B], new_ctr [B],
+        new_k, new_v)."""
+        import jax.numpy as jnp
+        if block_tables is None:
+            last, new_k, new_v = self._decode_tick_slots(
+                tok, k_bufs, v_bufs, pos)
+            L = k_bufs[0].shape[1]
+        else:
+            last, new_k, new_v = self._decode_tick_slots_paged(
+                tok, k_bufs, v_bufs, block_tables, pos)
+            L = block_tables.shape[1] * k_bufs[0].shape[1]
+        keys = self._slot_sample_keys(seed_lo, seed_hi, ctr)
+        ids = self._sample_lanes(last, temp, top_k, top_p, keys)
+        new_pos = jnp.minimum(pos + 1, L - 1)
+        return ids, ids[:, None], new_pos, ctr + 1, new_k, new_v
+
+    def _fused_spec_verify_tick_slots(self, toks, k_bufs, v_bufs, pos,
+                                      lanes, temp, top_k, top_p,
+                                      seed_lo, seed_hi, ctr,
+                                      block_tables=None):
+        """FUSED speculative verify + ON-DEVICE acceptance: score the
+        W = k+1 window positions, pick every lane's token on device
+        (lane j's key = fold(request_key, ctr + j), so each emitted
+        token's draw matches the one-token tick's draw for the same
+        prefix), and count the accepted prefix — the leading run of
+        REAL draft lanes (j < lanes[b]) whose draft equals the pick —
+        so acceptance no longer needs the [B, W, V] logits pull; the
+        tick downloads picks [B, W] + n_acc [B] only.  The device
+        cursor advances by the n_acc+1 emitted tokens; a request the
+        host finishes mid-window (EOS / max_new) is evicted, which
+        dirties the engine's state mirror and re-uploads corrected
+        cursors before the next tick.  Returns (picks [B, W], n_acc
+        [B], new_tok [B,1], new_pos [B], new_ctr [B], new_k, new_v)."""
+        import jax.numpy as jnp
+        if block_tables is None:
+            logits, new_k, new_v = self._spec_verify_tick_slots(
+                toks, k_bufs, v_bufs, pos)
+            L = k_bufs[0].shape[1]
+        else:
+            logits, new_k, new_v = self._spec_verify_tick_slots_paged(
+                toks, k_bufs, v_bufs, block_tables, pos)
+            L = block_tables.shape[1] * k_bufs[0].shape[1]
+        B, W = toks.shape
+        picks = jnp.stack(
+            [self._sample_lanes(
+                logits[:, j], temp, top_k, top_p,
+                self._slot_sample_keys(seed_lo, seed_hi, ctr + j))
+             for j in range(W)], axis=1)                    # [B, W]
+        match = (toks[:, 1:] == picks[:, :-1]) & \
+            (jnp.arange(W - 1)[None, :] < lanes[:, None])
+        # length of the leading matched prefix: first False index in
+        # match (the appended sentinel catches the all-matched row)
+        n_acc = jnp.argmin(jnp.concatenate(
+            [match, jnp.zeros((B, 1), bool)], axis=1), axis=1)
+        adv = n_acc + 1
+        new_tok = jnp.take_along_axis(picks, n_acc[:, None], axis=1)
+        new_pos = jnp.minimum(pos + adv, L - W)
+        return picks, n_acc, new_tok, new_pos, ctr + adv, new_k, new_v
+
+    def _compiled_fused_decode_fn(self, pnames, params, cache_key,
+                                  paged=False):
+        """Build (or fetch) the jitted FUSED decode+sample tick for
+        ``Engine(sample_mode="device")``: contiguous layout (p_list,
+        b_list, k_pools, v_pools, tok [B,1], pos [B], temp [B],
+        top_k [B], top_p [B], seed_lo [B], seed_hi [B], ctr [B]) or
+        paged layout (+ block_tables [B, L//bs] before tok) ->
+        (ids [B], new_tok [B,1], new_pos [B], new_ctr [B], k_pools,
+        v_pools).  The whole per-tick hot state (current token,
+        position, rng counter) is both input and output, so the engine
+        keeps the returned device handles and a steady-state tick
+        performs ZERO uploads and ONE [B]-int download — the host
+        round-trip that used to bound decode is gone.  ONE XLA program
+        per layout (every sampling param is a traced lane).  Pools
+        donated."""
+        import jax
+        from ..core import autograd
+        from ..jit import _swapped
+
+        cache = getattr(self, "_fused_decode_fn_cache", None)
+        if cache is None:
+            cache = self._fused_decode_fn_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        model = self
+        mbuffers = dict(self.named_buffers())
+        bnames = sorted(mbuffers)
+
+        if paged:
+            def pure(p_list, b_list, k_pools, v_pools, block_tables,
+                     tok, pos, temp, top_k, top_p, seed_lo, seed_hi,
+                     ctr):
+                with _swapped(params, dict(zip(pnames, p_list))), \
+                        _swapped(mbuffers, dict(zip(bnames, b_list))):
+                    with autograd.no_grad():
+                        out = model._fused_decode_tick_slots(
+                            tok, k_pools, v_pools, pos, temp, top_k,
+                            top_p, seed_lo, seed_hi, ctr,
+                            block_tables=block_tables)
+                return out
+        else:
+            def pure(p_list, b_list, k_pools, v_pools, tok, pos, temp,
+                     top_k, top_p, seed_lo, seed_hi, ctr):
+                with _swapped(params, dict(zip(pnames, p_list))), \
+                        _swapped(mbuffers, dict(zip(bnames, b_list))):
+                    with autograd.no_grad():
+                        out = model._fused_decode_tick_slots(
+                            tok, k_pools, v_pools, pos, temp, top_k,
+                            top_p, seed_lo, seed_hi, ctr)
+                return out
+
+        fn = jax.jit(pure, donate_argnums=(2, 3))
+        if len(cache) >= 8:  # FIFO bound, matching the other caches
+            cache.pop(next(iter(cache)))
+        cache[cache_key] = (fn, bnames, mbuffers)
+        return cache[cache_key]
+
+    def _compiled_fused_spec_verify_fn(self, pnames, params, cache_key,
+                                       paged=False):
+        """Build (or fetch) the jitted FUSED speculative verify +
+        on-device sample/accept dispatch (``Engine(spec_k=...,
+        sample_mode="device")``): contiguous layout (p_list, b_list,
+        k_pools, v_pools, toks [B, W], lanes [B], pos [B], temp [B],
+        top_k [B], top_p [B], seed_lo [B], seed_hi [B], ctr [B]) or
+        paged layout (+ block_tables before toks) -> (picks [B, W],
+        n_acc [B], new_tok [B,1], new_pos [B], new_ctr [B], k_pools,
+        v_pools).  ONE XLA program per (window, layout) exactly like
+        ``_compiled_spec_verify_fn`` — the draft window still uploads
+        (drafts come from the host proposer) but the [B, W, V] logits
+        download is replaced by picks + accept counts.  Pools
+        donated."""
+        import jax
+        from ..core import autograd
+        from ..jit import _swapped
+
+        cache = getattr(self, "_fused_spec_verify_fn_cache", None)
+        if cache is None:
+            cache = self._fused_spec_verify_fn_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        model = self
+        mbuffers = dict(self.named_buffers())
+        bnames = sorted(mbuffers)
+
+        if paged:
+            def pure(p_list, b_list, k_pools, v_pools, block_tables,
+                     toks, lanes, pos, temp, top_k, top_p, seed_lo,
+                     seed_hi, ctr):
+                with _swapped(params, dict(zip(pnames, p_list))), \
+                        _swapped(mbuffers, dict(zip(bnames, b_list))):
+                    with autograd.no_grad():
+                        out = model._fused_spec_verify_tick_slots(
+                            toks, k_pools, v_pools, pos, lanes, temp,
+                            top_k, top_p, seed_lo, seed_hi, ctr,
+                            block_tables=block_tables)
+                return out
+        else:
+            def pure(p_list, b_list, k_pools, v_pools, toks, lanes,
+                     pos, temp, top_k, top_p, seed_lo, seed_hi, ctr):
+                with _swapped(params, dict(zip(pnames, p_list))), \
+                        _swapped(mbuffers, dict(zip(bnames, b_list))):
+                    with autograd.no_grad():
+                        out = model._fused_spec_verify_tick_slots(
+                            toks, k_pools, v_pools, pos, lanes, temp,
+                            top_k, top_p, seed_lo, seed_hi, ctr)
+                return out
+
+        fn = jax.jit(pure, donate_argnums=(2, 3))
+        if len(cache) >= 8:  # FIFO bound, matching the other caches
+            cache.pop(next(iter(cache)))
+        cache[cache_key] = (fn, bnames, mbuffers)
+        return cache[cache_key]
 
     def _compiled_spec_verify_fn(self, pnames, params, cache_key,
                                  paged=False):

@@ -22,10 +22,18 @@ extra model) or ``DraftModelProposer`` (a smaller GPT) — guesses k
 tokens per slot, ONE jitted verify dispatch scores all k+1 positions,
 and the engine keeps the longest argmax-matching prefix plus the
 bonus token: 1..k+1 tokens per dispatch, greedy outputs still
-token-identical to the non-speculative engine.  Metrics (queue depth,
-slot occupancy, tokens/sec, TTFT/TPOT, KV blocks in use, prefix
-hits/evictions, prefill chunks, decode stall, spec
-proposed/accepted/acceptance-rate/tokens-per-tick) land in
+token-identical to the non-speculative engine.
+``Engine(sample_mode="device")`` (the default) FUSES sampling into
+the jitted dispatches: per-slot temperature/top_k/top_p as traced
+lanes, rng keys derived on device from the request seed +
+emitted-token counter, device-resident step cursors — a steady-state
+tick uploads nothing and downloads only the sampled ids (+ accept
+counts under speculation) instead of the per-tick logits matrix;
+``sample_mode="host"`` keeps the legacy logits-download + numpy
+sampling numerics.  Metrics (queue depth, slot occupancy, tokens/sec,
+TTFT/TPOT, KV blocks in use, prefix hits/evictions, prefill chunks,
+decode stall, spec proposed/accepted/acceptance-rate/tokens-per-tick,
+d2h bytes per tick, host sample time, fused-sample ticks) land in
 paddle_tpu.monitor and render via ``render_prometheus()``.
 """
 from .request import (  # noqa: F401

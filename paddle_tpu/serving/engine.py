@@ -13,6 +13,12 @@ slots the moment they free up:
          one slot-batched decode dispatch          ->
          sample per live slot, evict on EOS/max_new_tokens
 
+Sampling runs ON DEVICE inside the decode dispatch by default
+(``sample_mode="device"``: traced per-slot params, seed+counter keys,
+device-resident cursors — the tick downloads [B] ids, not [B, V]
+logits); ``sample_mode="host"`` keeps the legacy per-slot numpy
+sampling on downloaded logits.
+
 Each slot row computes exactly what a B=1 ``GPTAttention.decode`` at
 that slot's position computes (see ``decode_slots``), so under greedy
 decoding the engine's outputs are token-identical to per-request
@@ -31,7 +37,7 @@ import numpy as np
 
 from .. import monitor
 from .kvcache import BlockPool, PrefixCache
-from .request import Request, RequestQueue
+from .request import MAX_SEED, Request, RequestQueue
 from .scheduler import Scheduler
 
 
@@ -156,6 +162,26 @@ class Engine:
         against the slot's own prompt + emitted history, zero extra
         model.  ``DraftModelProposer(small_gpt)`` drafts with a
         smaller model sharing the tokenizer/vocab (cross-checked).
+    sample_mode : where per-token sampling runs.  ``"device"`` (the
+        default) FUSES sampling into the jitted decode dispatch:
+        per-slot temperature/top_k/top_p ride as traced [B] lanes
+        (temperature 0 = the greedy sentinel), rng keys derive on
+        device from the request seed + emitted-token counter
+        (``core/rng.request_key`` — a given seed reproduces across
+        engine restarts), and the hot step state (current token,
+        position, rng counter) stays DEVICE-RESIDENT between ticks —
+        a steady-state tick uploads nothing and downloads only the
+        [B] sampled ids (speculative: picks + accept counts, the
+        accepted-lane count also computed on device), instead of the
+        [B, V] (or [B, W, V]) logits matrix the host path pulls every
+        tick.  Greedy outputs are token-identical to the host path on
+        every layout; SAMPLED streams differ from host mode (device
+        draws are jax categorical over fold(seed, token_index) keys,
+        host draws are numpy) but are deterministic per request seed.
+        ``"host"`` keeps the legacy exact numerics: logits download +
+        numpy per-slot sampling (``_pick``).  Watch
+        ``serving.d2h_bytes_per_tick`` / ``serving.sample_ms`` /
+        ``serving.fused_sample_ticks``.
 
     ``step()`` is single-threaded by design — run it from one loop
     (``run_until_idle`` or the ``start()`` background thread).
@@ -167,7 +193,7 @@ class Engine:
                  max_queue=0, registry=None, prefill_buckets=None,
                  kv_block_size=None, kv_blocks=None, prefix_cache=True,
                  prefill_chunk=None, tick_token_budget=None,
-                 spec_k=None, proposer=None):
+                 spec_k=None, proposer=None, sample_mode="device"):
         if getattr(model, "scan_layers", False):
             model = model._sync_decode_twin()
         model.eval()
@@ -274,6 +300,11 @@ class Engine:
             raise ValueError(
                 "proposer requires spec_k (the draft window width "
                 "fixes the compiled verify program's shape)")
+        if sample_mode not in ("device", "host"):
+            raise ValueError(
+                f"sample_mode must be 'device' or 'host', got "
+                f"{sample_mode!r}")
+        self.sample_mode = sample_mode
         self._paged = kv_block_size is not None
         if self._paged:
             bsz = int(kv_block_size)
@@ -382,6 +413,20 @@ class Engine:
             "serving.spec_tokens_per_tick", "tokens emitted per "
             "DECODING slot by the latest speculative verify dispatch "
             "(1.0 = nothing accepted, spec_k+1 = full window)")
+        # sampling-mode surface (registered always; sample_ms stays
+        # empty in device mode, fused_sample_ticks zero in host mode)
+        self._m_d2h = reg.gauge(
+            "serving.d2h_bytes_per_tick", "bytes the latest decode "
+            "dispatch downloaded to the host (host mode pulls the "
+            "[B, V] logits — [B, W, V] speculative; device mode only "
+            "the sampled ids + accept counts)")
+        self._m_sample_ms = reg.histogram(
+            "serving.sample_ms", "host-side per-tick sampling + emit "
+            "loop (ms; host sample_mode only — device mode samples "
+            "inside the dispatch)")
+        self._m_fused_ticks = reg.counter(
+            "serving.fused_sample_ticks", "decode dispatches that "
+            "sampled on device (sample_mode='device')")
 
         self._last_decode_end = None  # stall anchor: end of the last
         #   decode dispatch, cleared when no slot is decoding
@@ -391,6 +436,8 @@ class Engine:
         self._insert_fn = None
         self._tick_fn = None    # resolved jitted slot-decode handle
         self._spec_fn = None    # resolved jitted spec-verify handle
+        self._fused_fn = None   # resolved fused decode+sample handle
+        self._fused_spec_fn = None  # fused verify+sample/accept handle
         self._p_arrays = None   # lazy snapshots of param/buffer handles
         self._b_arrays = None   # (see refresh_params)
         self._thread = None
@@ -426,9 +473,24 @@ class Engine:
                         for _ in self.model.blocks]
         self.v_pools = [jnp.zeros(shape, self._kv_dtype)
                         for _ in self.model.blocks]
-        # host-side per-slot step state, shipped to device every tick
+        # host-side per-slot step state: in host sample_mode these ship
+        # to device every tick; in device mode they are MIRRORS of the
+        # device-resident cursors, re-uploaded only when an admission /
+        # eviction / chunk dirties them (_push_state)
         self._pos = np.zeros(self.num_slots, np.int32)
         self._cur_tok = np.zeros((self.num_slots, 1), np.int32)
+        # per-slot sampling lanes (device mode): temperature 0 is the
+        # greedy sentinel, seed words feed core/rng.request_key, and
+        # _sctr tracks each request's emitted-token count — the rng
+        # fold counter that makes a seed reproduce across restarts
+        self._temp = np.zeros(self.num_slots, np.float32)
+        self._topk = np.zeros(self.num_slots, np.int32)
+        self._topp = np.ones(self.num_slots, np.float32)
+        self._seed_lo = np.zeros(self.num_slots, np.uint32)
+        self._seed_hi = np.zeros(self.num_slots, np.uint32)
+        self._sctr = np.zeros(self.num_slots, np.int32)
+        self._dev_state = None   # device handles of the step state
+        self._state_dirty = True  # device copies stale vs the mirrors
 
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, eos_token_id=None,
@@ -453,6 +515,11 @@ class Engine:
         except (TypeError, ValueError) as e:
             raise ValueError(
                 f"eos_token_id/seed must be ints or None: {e}") from None
+        if seed is not None and not 0 <= seed < MAX_SEED:
+            raise ValueError(
+                f"seed must be in [0, 2**63), got {seed}: the device "
+                "sampling key derivation packs the seed into two "
+                "32-bit words, and the host rng rejects negatives too")
         req = Request(prompt, max_new_tokens, eos_token_id=eos_token_id,
                       timeout=timeout, temperature=temperature,
                       top_k=top_k, top_p=top_p, seed=seed)
@@ -567,6 +634,64 @@ class Engine:
             self._m_prefix_hit_tokens.inc(m)
         return ctx, fresh, m
 
+    # -- per-slot sampling lanes (sample_mode="device") ----------------
+    def _bind_sample_state(self, slot):
+        """Install the admitted request's sampling lane into the state
+        mirrors (admission): temperature 0 marks a greedy lane, the
+        seed words feed the on-device key derivation, and the rng
+        counter restarts at 0 — so two engines given the same seed
+        emit the same sampled tokens.  Dirtying the mirrors makes the
+        next device-mode tick re-upload them (host mode ships state
+        every tick anyway and ignores the lanes)."""
+        req = slot.request
+        i = slot.index
+        if req.do_sample:
+            self._temp[i] = req.temperature
+            self._topk[i] = req.top_k
+            self._topp[i] = req.top_p
+        else:
+            self._temp[i] = 0.0
+            self._topk[i] = 0
+            self._topp[i] = 1.0
+        lo, hi = req.seed_words()
+        self._seed_lo[i] = lo
+        self._seed_hi[i] = hi
+        self._sctr[i] = 0
+        self._state_dirty = True
+
+    def _park_state(self, i):
+        """Park slot i's step + sampling lanes (eviction): frozen
+        zeros keep the inactive row's (discarded) compute in-bounds
+        and greedy-cheap until the next admission overwrites them; the
+        dirty flag makes the next device-mode tick re-upload the
+        corrected cursors — a mid-window eviction may have advanced
+        the device cursor further than the host consumed."""
+        self._pos[i] = 0
+        self._cur_tok[i, 0] = 0
+        self._temp[i] = 0.0
+        self._topk[i] = 0
+        self._topp[i] = 1.0
+        self._seed_lo[i] = 0
+        self._seed_hi[i] = 0
+        self._sctr[i] = 0
+        self._state_dirty = True
+
+    def _push_state(self):
+        """Upload the state mirrors as the device-resident step state
+        (device mode): runs only when an admission / eviction / chunk
+        dirtied them — a steady-state tick reuses the handles the last
+        dispatch returned and uploads NOTHING."""
+        import jax.numpy as jnp
+        self._dev_state = dict(
+            tok=jnp.asarray(self._cur_tok), pos=jnp.asarray(self._pos),
+            ctr=jnp.asarray(self._sctr), temp=jnp.asarray(self._temp),
+            topk=jnp.asarray(self._topk), topp=jnp.asarray(self._topp),
+            slo=jnp.asarray(self._seed_lo),
+            shi=jnp.asarray(self._seed_hi))
+        if self._paged:
+            self._dev_state["tables"] = jnp.asarray(self._block_tables)
+        self._state_dirty = False
+
     def _prefill_paged(self, slot):
         """Paged admission prefill: ONE jitted dispatch gathers the
         adopted prefix blocks as attention context, runs the prompt's
@@ -610,6 +735,7 @@ class Engine:
         right-padded variant when prefill_buckets bounds compiles),
         padded to the pool's L and written into the slot's cache rows."""
         import jax.numpy as jnp
+        self._bind_sample_state(slot)
         if self._paged:
             return self._prefill_paged(slot)
         req = slot.request
@@ -675,6 +801,7 @@ class Engine:
         blocks — the adopted shared blocks all lie before
         ``prefilled``)."""
         i = slot.index
+        self._bind_sample_state(slot)
         if self._paged:
             _, _, m = self._bind_kv_plan(slot)
             slot.prefilled = m
@@ -723,6 +850,8 @@ class Engine:
         slot.pos = slot.prefilled
         self._m_chunks.inc()
         self._m_prefill_tokens.inc(n)
+        self._state_dirty = True  # device-mode cursors must re-park on
+        #   the chunk's new start row before the next fused tick
         if slot.prefilled < s:
             # still PREFILLING: re-park the decode dispatch's garbage
             # write on the next chunk's start row
@@ -771,17 +900,41 @@ class Engine:
         return emitted, newly, evicted
 
     def _pick(self, req, row):
-        """Next token from one slot's f32 logits row: argmax (greedy)
-        or filtered sampling on a per-request rng stream."""
+        """Next token from one slot's f32 logits row: argmax (greedy —
+        identical in both sample modes), device-twin filtered sampling
+        (sample_mode="device"), or filtered numpy sampling on a
+        per-request rng stream (host mode's legacy numerics)."""
         if not req.do_sample:
             return int(np.argmax(row))
+        if self.sample_mode == "device":
+            return self._pick_device(req, row)
         rng = self._rngs.get(req.id)
         if rng is None:
             rng = self._rngs[req.id] = np.random.default_rng(
-                req.seed if req.seed is not None else req.id)
+                req.sample_seed)
         filt = _filter_logits_np(row, req.temperature, req.top_k,
                                  req.top_p)
         return int(rng.choice(len(filt), p=_softmax_np(filt)))
+
+    def _pick_device(self, req, row):
+        """Device-mode first-token pick (prefill / final chunk): the
+        SAME lane filters and key derivation as the fused dispatches
+        (``models.gpt.sample_rows`` — one process-wide compile), run
+        on the one [V] logits row prefill already returned — so token
+        i of a request draws from fold(request_key, i) whether
+        prefill, a one-token tick, or a verify-window lane emitted it,
+        and a seed reproduces across engine restarts."""
+        import jax.numpy as jnp
+        from ..models.gpt import sample_rows
+        lo, hi = req.seed_words()
+        ids = sample_rows(
+            jnp.asarray(row, jnp.float32)[None, :],
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.top_p], jnp.float32),
+            jnp.asarray([lo], jnp.uint32), jnp.asarray([hi], jnp.uint32),
+            jnp.asarray([len(req.generated)], jnp.int32))
+        return int(np.asarray(ids)[0])
 
     def _emit(self, slot, tok):
         """Record one generated token; finish + evict on EOS or
@@ -807,28 +960,24 @@ class Engine:
             self.scheduler.evict(slot)
             self._evicted_in_tick += 1
             self._release_slot_kv(i)
-            # park the freed row: a frozen pos/tok keeps the inactive
-            # row's (ignored) compute in-bounds until the next prefill
-            # overwrites the whole cache row
-            self._pos[i] = 0
-            self._cur_tok[i, 0] = 0
+            # park the freed row (frozen pos/tok keeps the inactive
+            # row's ignored compute in-bounds until the next prefill
+            # overwrites the whole cache row) and dirty the device
+            # mirrors
+            self._park_state(i)
             self._m_done.inc()
             return
         i = slot.index
         self._cur_tok[i, 0] = int(tok)
         self._pos[i] = slot.pos
+        self._sctr[i] = len(req.generated)  # rng fold counter mirror
 
-    def _spec_decode_tick(self, active):
-        """One speculative DRAFT-AND-VERIFY dispatch (spec_k=...):
-        gather k draft tokens per live slot from the proposer, score
-        all k+1 window positions in one jitted verify dispatch, then
-        per slot emit the longest prefix where the target's pick
-        equals the draft plus the one bonus token — 1..k+1 tokens per
-        slot per dispatch.  The write cursor advances only over
-        emitted tokens; rejected lanes leave garbage K/V that the next
-        window (which always spans the full k+1 positions from the new
-        cursor) rewrites before any query can see it."""
-        import jax.numpy as jnp
+    def _draft_window(self, active):
+        """Gather the speculative verify window: [num_slots, W] tokens
+        whose lane 0 is each slot's current token and lanes 1..k are
+        the proposer's drafts (pad lanes repeat the current token).
+        Sets ``slot.spec_lanes`` per live slot.  Shared by the host
+        verify tick and the fused device tick."""
         k = self._spec_k
         W = k + 1
         toks = np.zeros((self.num_slots, W), np.int32)
@@ -864,6 +1013,22 @@ class Engine:
             #   metric only after the dispatch returns: a failed
             #   verify must not deflate the lifetime acceptance-rate
             #   gauge with lanes never scored.)
+        return toks
+
+    def _spec_decode_tick(self, active):
+        """One speculative DRAFT-AND-VERIFY dispatch (spec_k=..., host
+        sampling): gather k draft tokens per live slot from the
+        proposer, score all k+1 window positions in one jitted verify
+        dispatch, then per slot emit the longest prefix where the
+        target's pick equals the draft plus the one bonus token —
+        1..k+1 tokens per slot per dispatch.  The write cursor
+        advances only over emitted tokens; rejected lanes leave
+        garbage K/V that the next window (which always spans the full
+        k+1 positions from the new cursor) rewrites before any query
+        can see it."""
+        import jax.numpy as jnp
+        W = self._spec_k + 1
+        toks = self._draft_window(active)
         if self._spec_fn is None:
             self._spec_fn, _, _ = self.model._compiled_spec_verify_fn(
                 self._pnames, self._params,
@@ -883,7 +1048,9 @@ class Engine:
                 self._p_list(), self._b_list(), self.k_pools,
                 self.v_pools, jnp.asarray(toks), jnp.asarray(self._pos))
         rows = np.asarray(last, np.float32)           # [B, W, V]
+        self._m_d2h.set(rows.nbytes)
         self._m_spec_windows.inc(len(active))
+        t_sample = time.monotonic()
         emitted = 0
         for slot in active:
             i = slot.index
@@ -920,6 +1087,7 @@ class Engine:
             slot.spec_lanes = 0
             self._m_spec_accepted.inc(n_acc)
             emitted += n_emit
+        self._m_sample_ms.observe((time.monotonic() - t_sample) * 1e3)
         proposed = self._m_spec_proposed.value
         if proposed:
             self._m_spec_rate.set(
@@ -927,13 +1095,139 @@ class Engine:
         self._m_spec_tpt.set(emitted / len(active))
         return emitted
 
+    def _fused_spec_tick(self, active):
+        """Speculative draft-and-verify with ON-DEVICE sampling and
+        acceptance (sample_mode="device"): the verify dispatch also
+        picks every window lane's token (greedy or seeded sample) and
+        counts the accepted prefix, so the tick uploads the [B, W]
+        draft window (the proposer is host-side) and downloads only
+        picks [B, W] + accept counts [B] — never the [B, W, V] logits.
+        The emit loop consumes exactly the device-accepted lanes, so
+        the metric accounting matches the host tick's exactly; a
+        mid-window EOS/max_new eviction parks the slot and dirties the
+        state mirrors (the device cursor advanced past what the host
+        consumed)."""
+        import jax.numpy as jnp
+        W = self._spec_k + 1
+        toks = self._draft_window(active)
+        lanes = np.zeros(self.num_slots, np.int32)
+        for slot in active:
+            lanes[slot.index] = slot.spec_lanes
+        if self._state_dirty or self._dev_state is None:
+            self._push_state()
+        st = self._dev_state
+        if self._fused_spec_fn is None:
+            self._fused_spec_fn, _, _ = \
+                self.model._compiled_fused_spec_verify_fn(
+                    self._pnames, self._params,
+                    ("paged" if self._paged else "slot", W,
+                     self.num_slots,
+                     (self._kv_managed + 1, self._bs) if self._paged
+                     else self.max_seq_len, str(self._kv_dtype),
+                     tuple(self._pnames), self._bnames_all),
+                    paged=self._paged)
+        args = [self._p_list(), self._b_list(), self.k_pools,
+                self.v_pools]
+        if self._paged:
+            args.append(st["tables"])
+        args += [jnp.asarray(toks), jnp.asarray(lanes), st["pos"],
+                 st["temp"], st["topk"], st["topp"], st["slo"],
+                 st["shi"], st["ctr"]]
+        (picks, n_acc, new_tok, new_pos, new_ctr, self.k_pools,
+         self.v_pools) = self._fused_spec_fn(*args)
+        st["tok"], st["pos"], st["ctr"] = new_tok, new_pos, new_ctr
+        picks = np.asarray(picks)                     # [B, W] ids
+        n_acc = np.asarray(n_acc)                     # [B] accepted
+        self._m_d2h.set(picks.nbytes + n_acc.nbytes)
+        self._m_fused_ticks.inc()
+        self._m_spec_windows.inc(len(active))
+        emitted = 0
+        for slot in active:
+            i = slot.index
+            self._m_spec_proposed.inc(slot.spec_lanes)
+            acc_i = int(n_acc[i])   # device-counted leading matches
+            n_cnt = 0
+            n_emit = 0
+            j = 0
+            while True:
+                # lane j's pick was drawn on device from the same
+                # key/logits the one-token tick would use for this
+                # prefix; consuming lanes 0..acc_i reproduces the host
+                # accept loop exactly (acc_i counts only REAL lanes)
+                tok = int(picks[i, j])
+                matched = j < acc_i
+                if matched:
+                    # counted even when this token finishes the
+                    # request (EOS drafted by a matched lane) — but
+                    # only over lanes actually consumed: an eviction
+                    # below stops the count like the host loop's break
+                    n_cnt += 1
+                slot.pos += 1
+                self._pos[i] = slot.pos
+                self._emit(slot, tok)
+                n_emit += 1
+                if slot.request is None or not matched:
+                    break
+                j += 1
+            slot.spec_lanes = 0
+            self._m_spec_accepted.inc(n_cnt)
+            emitted += n_emit
+        proposed = self._m_spec_proposed.value
+        if proposed:
+            self._m_spec_rate.set(
+                self._m_spec_accepted.value / proposed)
+        self._m_spec_tpt.set(emitted / len(active))
+        return emitted
+
+    def _fused_decode_tick(self, active):
+        """One fused decode+sample dispatch (sample_mode="device"):
+        the step state lives on device between ticks (uploaded only
+        when admissions/evictions/chunks dirty the mirrors), sampling
+        runs inside the dispatch, and the host downloads exactly [B]
+        int32 ids — the per-tick [B, V] logits pull is gone."""
+        if self._state_dirty or self._dev_state is None:
+            self._push_state()
+        st = self._dev_state
+        if self._fused_fn is None:
+            self._fused_fn, _, _ = self.model._compiled_fused_decode_fn(
+                self._pnames, self._params,
+                ("paged" if self._paged else "slot", self.num_slots,
+                 (self._kv_managed + 1, self._bs) if self._paged
+                 else self.max_seq_len, str(self._kv_dtype),
+                 tuple(self._pnames), self._bnames_all),
+                paged=self._paged)
+        args = [self._p_list(), self._b_list(), self.k_pools,
+                self.v_pools]
+        if self._paged:
+            args.append(st["tables"])
+        args += [st["tok"], st["pos"], st["temp"], st["topk"],
+                 st["topp"], st["slo"], st["shi"], st["ctr"]]
+        (ids, new_tok, new_pos, new_ctr, self.k_pools,
+         self.v_pools) = self._fused_fn(*args)
+        st["tok"], st["pos"], st["ctr"] = new_tok, new_pos, new_ctr
+        ids = np.asarray(ids)                         # [B] int32
+        self._m_d2h.set(ids.nbytes)
+        self._m_fused_ticks.inc()
+        emitted = 0
+        for slot in active:
+            slot.pos += 1
+            self._pos[slot.index] = slot.pos
+            self._emit(slot, int(ids[slot.index]))
+            emitted += 1
+        return emitted
+
     def _decode_tick(self, active):
         """One slot-batched decode dispatch; samples and advances every
         live slot (speculative mode verifies a whole draft window per
-        slot instead — _spec_decode_tick)."""
+        slot instead; sample_mode="device" routes both shapes to their
+        fused on-device-sampling twins)."""
         import jax.numpy as jnp
         if self._spec_k is not None:
+            if self.sample_mode == "device":
+                return self._fused_spec_tick(active)
             return self._spec_decode_tick(active)
+        if self.sample_mode == "device":
+            return self._fused_decode_tick(active)
         if self._tick_fn is None:
             # resolve once: the key embeds tuple(pnames), an O(n_params)
             # copy+hash not worth paying per generated token
@@ -962,6 +1256,8 @@ class Engine:
                 self.v_pools, jnp.asarray(self._cur_tok),
                 jnp.asarray(self._pos))
         rows = np.asarray(last, np.float32)
+        self._m_d2h.set(rows.nbytes)
+        t_sample = time.monotonic()
         emitted = 0
         for slot in active:
             slot.pos += 1
@@ -969,6 +1265,7 @@ class Engine:
             self._emit(slot, self._pick(slot.request,
                                         rows[slot.index]))
             emitted += 1
+        self._m_sample_ms.observe((time.monotonic() - t_sample) * 1e3)
         return emitted
 
     def step(self):
@@ -1115,6 +1412,8 @@ class Engine:
             req = self.scheduler.evict(
                 slot, RuntimeError("engine stopped"))
             self._release_slot_kv(slot.index)
+            self._park_state(slot.index)  # a later start() serves with
+            #   clean device-mode cursors
             if req is not None:
                 self._rngs.pop(req.id, None)
                 self._m_done.inc()

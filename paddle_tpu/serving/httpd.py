@@ -89,6 +89,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "slots_total": eng.num_slots,
                 "slots_free": eng.scheduler.free_count(),
                 "queue_depth": eng.queue.depth(),
+                "sample_mode": getattr(eng, "sample_mode", "host"),
             }
             if getattr(eng, "_paged", False):
                 info["kv_blocks_free"] = eng.block_pool.free_count()

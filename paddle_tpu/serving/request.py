@@ -24,6 +24,14 @@ class RequestTimeout(RuntimeError):
     """The request exceeded its queue deadline before a slot freed up."""
 
 
+# Largest admissible sampling seed (exclusive): the device sampling key
+# derivation packs the seed into two 32-bit words (lo | hi << 32, hi
+# folded into a jax.random key — core/rng.request_key), so a seed must
+# be a non-negative int below 2**63; the host rng path rejects
+# negatives anyway, so submit() enforces one bound for both modes.
+MAX_SEED = 2 ** 63
+
+
 class QueueFull(RuntimeError):
     """The admission queue is at max_queue; shed load at the edge."""
 
@@ -64,6 +72,21 @@ class Request:
     def do_sample(self):
         return (self.top_k > 0 or self.temperature != 1.0
                 or self.top_p < 1.0)
+
+    @property
+    def sample_seed(self):
+        """Effective sampling seed: the submitted seed, or the request
+        id when none was given (reproducible across engine restarts
+        only for explicit seeds — ids are a process-global counter)."""
+        return self.seed if self.seed is not None else self.id
+
+    def seed_words(self):
+        """(lo, hi) 32-bit words of the effective seed — the transport
+        format of the device sampling key derivation (jax without x64
+        cannot carry an int64 seed; core/rng.request_key folds the
+        words back into one key)."""
+        s = self.sample_seed
+        return s & 0xFFFFFFFF, (s >> 32) & 0xFFFFFFFF
 
     def expired(self, now=None):
         if self.deadline is None:

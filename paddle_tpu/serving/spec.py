@@ -16,6 +16,14 @@ engine (tests/test_serving.py asserts it).  Wrong drafts cost nothing
 beyond the fixed window compute — the engine's write cursor simply
 does not advance over rejected lanes.
 
+Under the engine's default ``sample_mode="device"`` the verify
+dispatch ALSO picks each lane's token and counts the accepted prefix
+on device (``GPTModel._compiled_fused_spec_verify_fn``), so a verify
+tick downloads picks ``[B, W]`` + accept counts ``[B]`` instead of
+the full ``[B, W, V]`` logits; ``sample_mode="host"`` keeps the
+legacy logits pull + host accept loop.  Proposers are mode-agnostic —
+they only ever see the host-side token history.
+
 Two proposers ship here:
 
 * ``PromptLookupProposer`` — n-gram match against the slot's own

@@ -3,7 +3,10 @@ generate(), slot eviction on EOS, admission under a full pool, queue
 timeouts, budgeted CHUNKED PREFILL (parity, per-tick token budget,
 decode-not-stalled mixed workload, mid-chunk failure recovery),
 SPECULATIVE DECODING (draft-and-verify parity on both KV layouts,
-exact acceptance accounting, in-flight-lane failure recovery), HTTP
+exact acceptance accounting, in-flight-lane failure recovery), FUSED
+ON-DEVICE SAMPLING (sample_mode="device": greedy host/device parity on
+all four dispatch layouts, seeded determinism across engines,
+device-resident-cursor failure recovery, d2h/sample metrics), HTTP
 edge validation, and the metrics surface (all CPU, tiny model, tier-1
 safe)."""
 import io
@@ -509,26 +512,30 @@ def test_spec_parity_paged_with_prefix_reuse(tiny_gpt):
 
 
 def test_spec_compile_probe_one_program_per_layout():
-    """The compile-bound guarantee: however many prompts, lengths, and
-    dispatches, a fixed spec_k compiles exactly ONE verify program per
-    KV layout."""
+    """The compile-bound guarantee, extended to the FUSED dispatches:
+    however many prompts, lengths, and dispatches, a fixed spec_k
+    compiles exactly ONE verify program per (layout, sample_mode) —
+    device mode fills ``_fused_spec_verify_fn_cache``, host mode
+    ``_spec_verify_fn_cache``."""
     paddle.seed(0)
     model = GPTModel.from_config("tiny", dropout=0.0)
     model.eval()
     prompts = _prompts(4)
-    for kw in (dict(), dict(kv_block_size=8)):
-        eng = _engine(model, spec_k=3, **kw)
-        reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    for mode, cache_name in (("device", "_fused_spec_verify_fn_cache"),
+                             ("host", "_spec_verify_fn_cache")):
+        for kw in (dict(), dict(kv_block_size=8)):
+            eng = _engine(model, spec_k=3, sample_mode=mode, **kw)
+            reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+            eng.run_until_idle()
+            for r in reqs:
+                r.result(timeout=1)
+        keys = sorted(k[0] for k in getattr(model, cache_name))
+        assert keys == ["paged", "slot"], (mode, keys)
+        # re-serving does not grow the cache (no retrace)
+        eng = _engine(model, spec_k=3, sample_mode=mode)
+        eng.submit(prompts[0], max_new_tokens=4)
         eng.run_until_idle()
-        for r in reqs:
-            r.result(timeout=1)
-    keys = sorted(k[0] for k in model._spec_verify_fn_cache)
-    assert keys == ["paged", "slot"]
-    # re-serving does not grow the cache (no retrace)
-    eng = _engine(model, spec_k=3)
-    eng.submit(prompts[0], max_new_tokens=4)
-    eng.run_until_idle()
-    assert len(model._spec_verify_fn_cache) == 2
+        assert len(getattr(model, cache_name)) == 2
 
 
 class _OracleProposer(Proposer):
@@ -651,7 +658,9 @@ def test_spec_failure_with_inflight_lanes_recovers(tiny_gpt):
     def boom(*a, **kw):
         raise RuntimeError("synthetic verify dispatch failure")
 
-    eng._spec_fn = boom              # the NEXT verify dies mid-flight
+    # default sample_mode is "device": the resolved handle is the
+    # fused verify+sample dispatch
+    eng._fused_spec_fn = boom        # the NEXT verify dies mid-flight
     with pytest.raises(RuntimeError):
         eng.step()
     for r in reqs:
@@ -662,7 +671,7 @@ def test_spec_failure_with_inflight_lanes_recovers(tiny_gpt):
     assert eng.block_pool.in_use() == 0
     assert all(eng.block_pool.refcount(b) == 0
                for b in range(eng.block_pool.num_blocks))
-    eng._spec_fn = None              # re-resolve on the next tick
+    eng._fused_spec_fn = None        # re-resolve on the next tick
     r2 = eng.submit(prompts[0], max_new_tokens=6)
     eng.run_until_idle()
     assert r2.result(timeout=1).tolist() == _gen_ref(tiny_gpt,
@@ -750,6 +759,214 @@ def test_spec_draft_model_proposer(tiny_gpt):
 
 
 # ---------------------------------------------------------------------------
+# Fused on-device sampling (Engine(sample_mode="device"), the default)
+# ---------------------------------------------------------------------------
+
+SAMPLE_LAYOUTS = (dict(), dict(kv_block_size=8), dict(spec_k=4),
+                  dict(spec_k=4, kv_block_size=8),
+                  dict(prefill_chunk=4, tick_token_budget=8),
+                  dict(prefill_chunk=4, tick_token_budget=8,
+                       kv_block_size=8))
+
+
+def test_device_sampling_greedy_parity_all_layouts(tiny_gpt):
+    """The tentpole acceptance case (fast tier-1 twin of bench.py's
+    serving_sample): greedy outputs under fused on-device sampling are
+    token-identical to the host sampling path AND to generate() on all
+    four dispatch layouts (contiguous / paged x one-token / spec) plus
+    the chunked-prefill variants — the chunk/fused-tick interplay
+    re-parks the device cursor on each chunk's start row — with
+    staggered mid-decode admissions."""
+    prompts = _prompts(4)
+    refs = [_gen_ref(tiny_gpt, p, 8) for p in prompts]
+    for kw in SAMPLE_LAYOUTS:
+        outs = {}
+        for mode in ("host", "device"):
+            eng = _engine(tiny_gpt, sample_mode=mode, **kw)
+            reqs = [eng.submit(p, max_new_tokens=8)
+                    for p in prompts[:2]]
+            for _ in range(2):
+                eng.step()               # mid-decode arrivals
+            reqs += [eng.submit(p, max_new_tokens=8)
+                     for p in prompts[2:]]
+            eng.run_until_idle()
+            outs[mode] = [r.result(timeout=1).tolist() for r in reqs]
+        assert outs["device"] == outs["host"] == refs, kw
+
+
+def test_device_sampling_parity_with_prefix_reuse(tiny_gpt):
+    """Device sampling over the paged layout WITH prefix-cache
+    adoption: adopters decode against cached blocks through the fused
+    dispatch and stay token-identical to generate() (a stale device
+    cursor or block table would diverge them)."""
+    rng = np.random.RandomState(11)
+    sysp = rng.randint(0, 128, (16,)).astype(np.int32)
+    prompts = [np.concatenate([sysp, rng.randint(0, 128, (k,))
+                               .astype(np.int32)]) for k in (3, 5, 4)]
+    reg = monitor.StatRegistry()
+    eng = _engine(tiny_gpt, registry=reg, kv_block_size=8,
+                  sample_mode="device")
+    first = eng.submit(prompts[0], max_new_tokens=6)
+    eng.run_until_idle()              # prompt 0's blocks now cached
+    rest = [eng.submit(p, max_new_tokens=6) for p in prompts[1:]]
+    eng.run_until_idle()
+    outs = [first.result(timeout=1).tolist()] + \
+        [r.result(timeout=1).tolist() for r in rest]
+    assert outs == [_gen_ref(tiny_gpt, p, 6) for p in prompts]
+    assert reg.get("serving.prefix_hits").value == 2
+    assert reg.get("serving.fused_sample_ticks").value > 0
+
+
+def test_device_sampling_deterministic_across_engines(tiny_gpt):
+    """Seeded device sampling: the rng key derives from the request
+    seed + emitted-token counter (core/rng.request_key), so two
+    engine instances given the same seed emit identical tokens — the
+    reproducible-across-restarts contract."""
+    outs = []
+    for _ in range(2):
+        eng = _engine(tiny_gpt, sample_mode="device")
+        r = eng.submit(_prompts(1)[0], max_new_tokens=6,
+                       temperature=0.8, top_k=20, top_p=0.9, seed=123)
+        eng.run_until_idle()
+        outs.append(r.result(timeout=1).tolist())
+    assert outs[0] == outs[1]
+    # and a 63-bit seed survives the two-word key transport
+    big = 2 ** 62 + 12345
+    outs = []
+    for _ in range(2):
+        eng = _engine(tiny_gpt, sample_mode="device")
+        r = eng.submit(_prompts(1)[0], max_new_tokens=4,
+                       temperature=0.7, seed=big)
+        eng.run_until_idle()
+        outs.append(r.result(timeout=1).tolist())
+    assert outs[0] == outs[1]
+
+
+def test_device_spec_sampling_matches_nonspec(tiny_gpt):
+    """Seeded device sampling under speculation: verify-window lane j
+    draws from fold(request_key, token_index) exactly like the
+    one-token tick, so spec and non-spec device engines emit the same
+    sampled stream."""
+    p = _prompts(1)[0]
+    kw = dict(max_new_tokens=8, temperature=0.8, top_k=20, seed=123)
+    outs = []
+    for spec in (None, 4):
+        eng = _engine(tiny_gpt, spec_k=spec, sample_mode="device")
+        r = eng.submit(p, **kw)
+        eng.run_until_idle()
+        outs.append(r.result(timeout=1).tolist())
+    assert outs[0] == outs[1]
+
+
+def test_fused_compile_probe_one_program_per_layout():
+    """Compile-bound guarantee for the fused one-token tick: however
+    many prompts and ticks, ONE fused decode+sample program per KV
+    layout (sampling params are traced lanes, never constants)."""
+    paddle.seed(0)
+    model = GPTModel.from_config("tiny", dropout=0.0)
+    model.eval()
+    prompts = _prompts(4)
+    for kw in (dict(), dict(kv_block_size=8)):
+        eng = _engine(model, sample_mode="device", **kw)
+        # a sampled and a greedy request share the same program
+        reqs = [eng.submit(p, max_new_tokens=6) for p in prompts[:2]]
+        reqs += [eng.submit(p, max_new_tokens=6, temperature=0.8,
+                            top_p=0.9, seed=7) for p in prompts[2:]]
+        eng.run_until_idle()
+        for r in reqs:
+            r.result(timeout=1)
+    keys = sorted(k[0] for k in model._fused_decode_fn_cache)
+    assert keys == ["paged", "slot"]
+    eng = _engine(model, sample_mode="device")
+    eng.submit(prompts[0], max_new_tokens=4)
+    eng.run_until_idle()
+    assert len(model._fused_decode_fn_cache) == 2
+
+
+def test_device_step_failure_recovers(tiny_gpt):
+    """Step-failure recovery with sample_mode="device" (paged):
+    the device-resident cursors die with the pools, waiters unblock
+    loudly, refcounts rebuild to zero, and the next tick re-uploads
+    rebuilt state — the engine keeps serving with correct outputs."""
+    reg = monitor.StatRegistry()
+    eng = Engine(tiny_gpt, num_slots=2, max_seq_len=48, registry=reg,
+                 kv_block_size=8, sample_mode="device")
+    prompts = _prompts(2)
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.step()
+    eng.step()                           # device state now resident
+    assert not eng._state_dirty
+
+    def boom(*a, **kw):
+        raise RuntimeError("synthetic fused dispatch failure")
+
+    eng._fused_fn = boom
+    with pytest.raises(RuntimeError):
+        eng.step()
+    for r in reqs:
+        with pytest.raises(RuntimeError, match="engine step failed"):
+            r.result(timeout=1)
+    assert eng.scheduler.occupancy() == 0
+    assert eng._state_dirty              # cursors rebuilt on next tick
+    assert eng.block_pool.in_use() == 0
+    assert all(eng.block_pool.refcount(b) == 0
+               for b in range(eng.block_pool.num_blocks))
+    eng._fused_fn = None
+    r2 = eng.submit(prompts[0], max_new_tokens=6)
+    eng.run_until_idle()
+    assert r2.result(timeout=1).tolist() == _gen_ref(tiny_gpt,
+                                                     prompts[0], 6)
+
+
+def test_sample_mode_metrics_and_validation(tiny_gpt):
+    """The observability satellite: host mode reports d2h bytes of the
+    full [B, V] logits pull and fills the sample_ms histogram; device
+    mode downloads only [B] ids, counts fused ticks, and leaves
+    sample_ms empty — all rendered by render_prometheus()."""
+    with pytest.raises(ValueError, match="sample_mode"):
+        _engine(tiny_gpt, sample_mode="gpu")
+    p = _prompts(1)[0]
+    d2h = {}
+    for mode in ("host", "device"):
+        reg = monitor.StatRegistry()
+        eng = _engine(tiny_gpt, registry=reg, sample_mode=mode)
+        r = eng.submit(p, max_new_tokens=6)
+        eng.run_until_idle()
+        r.result(timeout=1)
+        d2h[mode] = reg.get("serving.d2h_bytes_per_tick").value
+        if mode == "host":
+            assert reg.get("serving.sample_ms").count > 0
+            assert reg.get("serving.fused_sample_ticks").value == 0
+        else:
+            assert reg.get("serving.sample_ms").count == 0
+            assert reg.get("serving.fused_sample_ticks").value > 0
+        text = monitor.render_prometheus(reg)
+        assert "serving_d2h_bytes_per_tick" in text
+        assert "serving_sample_ms_bucket" in text
+        assert "serving_fused_sample_ticks" in text
+    # host pulls B*V f32 logits; device only the B int32 ids
+    assert d2h["host"] == 4 * 4 * 128
+    assert d2h["device"] == 4 * 4
+    assert d2h["device"] < d2h["host"]
+
+
+def test_submit_rejects_out_of_range_seed(tiny_gpt):
+    """Seeds that cannot feed the device key derivation (negative /
+    >= 2**63) fail at submit in BOTH modes — a host-mode negative
+    seed used to crash the shared engine loop mid-decode instead."""
+    for mode in ("device", "host"):
+        eng = _engine(tiny_gpt, sample_mode=mode)
+        for bad in (-1, 2 ** 63, 2 ** 64):
+            with pytest.raises(ValueError, match="seed"):
+                eng.submit(_prompts(1)[0], max_new_tokens=2,
+                           temperature=0.8, seed=bad)
+        assert eng.queue.depth() == 0
+    # boundary value is admissible
+    eng = _engine(tiny_gpt)
+    eng.submit(_prompts(1)[0], max_new_tokens=2, seed=2 ** 63 - 1)
+
+
+# ---------------------------------------------------------------------------
 # HTTP edge validation (no socket: the handler's POST path is driven
 # directly with a stubbed send)
 # ---------------------------------------------------------------------------
@@ -794,6 +1011,14 @@ def test_httpd_validates_prompt_at_edge(tiny_gpt):
     code, body, _ = _post_probe(
         eng, {"prompt": [1, 2], "max_new_tokens": 0})
     assert code == 400 and "max_new_tokens" in body["error"]
+    # seeds the device key derivation cannot carry: clear 400 at the
+    # edge (submit raises ValueError; do_POST maps it), never a crash
+    # inside the shared engine loop
+    for bad in (-1, 2 ** 63):
+        code, body, _ = _post_probe(
+            eng, {"prompt": [1, 2], "max_new_tokens": 2,
+                  "temperature": 0.8, "seed": bad})
+        assert code == 400 and "seed" in body["error"], bad
     assert eng.queue.depth() == 0
 
 
@@ -835,9 +1060,13 @@ def test_httpd_metrics_content_type_and_spec_healthz(tiny_gpt):
     assert health["spec_k"] == 4
     assert 0.0 <= health["spec_acceptance_rate"] <= 1.0
     assert health["spec_tokens_per_tick"] >= 1.0
+    assert health["sample_mode"] == "device"     # the default
     # spec off -> the gauges stay out of the health payload
     code, health, _ = _get_probe(_engine(tiny_gpt), "/healthz")
     assert "spec_k" not in health
+    code, health, _ = _get_probe(_engine(tiny_gpt, sample_mode="host"),
+                                 "/healthz")
+    assert health["sample_mode"] == "host"
     text = monitor.render_prometheus(eng.registry)
     assert "serving_spec_proposed" in text
     assert "serving_spec_accepted" in text
