@@ -44,6 +44,14 @@ steady-state tick downloads [B] ids instead of the [B, V] logits
 (compare the printed serving.d2h_bytes_per_tick against
 ``sample_mode="host"``'s legacy numpy path).
 
+Finally it demos TICK-LEVEL TRACING: every engine records phase spans
+(admission / prefill chunks / decode dispatch / d2h / emit),
+per-request lifecycle instants, and compile events into a bounded
+ring buffer — dumped here as a chrome://tracing JSON and summarized
+per phase with tools/trace_view.py (on a live server: GET
+/debug/trace; on a step failure the same ring auto-dumps as the
+flight recorder).
+
 Run: python examples/serving_engine.py
 """
 import os
@@ -290,6 +298,41 @@ def main():
           f"tokens: {runs[0]}")
     print(f"  d2h bytes per decode tick: host {d2h_host} "
           f"([B, V] logits) vs device {d2h_dev} ([B] ids)")
+
+    # -- tracing + flight recorder: where did the tick's time go? -----
+    # every engine keeps a bounded per-thread ring of phase spans
+    # (admission / prefill chunks / spec draft / decode dispatch / d2h
+    # sync / emit with batch/layout/accepted-lane args), per-request
+    # lifecycle instants (queued -> admitted -> prefix-adopted ->
+    # first-token -> finished), and a compile event per new jitted
+    # program (serving.compiles_total).  Dump it as chrome://tracing
+    # JSON — or GET /debug/trace on a live server — and open it in
+    # chrome://tracing / Perfetto, or summarize it in the terminal
+    # with tools/trace_view.py.  On a step failure the engine
+    # auto-dumps the same ring as a post-mortem "flight recorder"
+    # (Engine(flight_dir=...) / Engine.last_flight).
+    import importlib.util
+    import json
+    trace = spec_eng.chrome_trace()   # the speculative demo's engine
+    trace_path = "/tmp/paddle_tpu_serving_trace.json"
+    with open(trace_path, "w") as f:
+        json.dump(trace, f)
+    spec = importlib.util.spec_from_file_location(
+        "trace_view", os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))),
+            "tools", "trace_view.py"))
+    trace_view = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_view)
+    rows = trace_view.summarize(trace["traceEvents"])
+    print(f"\ntick-level tracing (chrome trace dumped to "
+          f"{trace_path} — open in chrome://tracing):")
+    for line in trace_view.format_table(rows[:6]).splitlines():
+        print(" ", line)
+    n_compiles = int(
+        spec_eng.registry.get("serving.compiles_total").value)
+    print(f"  compile events recorded by the spec engine: "
+          f"{n_compiles} (serving.compiles_total — nonzero growth in "
+          f"steady state means the program cache is thrashing)")
 
 
 if __name__ == "__main__":

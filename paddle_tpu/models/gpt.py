@@ -15,6 +15,7 @@ sharding stage2, GPT-3 1.3B hybrid) instantiate from ``GPT_CONFIGS``.
 from __future__ import annotations
 
 import math
+import time
 
 from .. import nn
 from ..nn import functional as F
@@ -1053,6 +1054,72 @@ class GPTModel(nn.Layer):
         new_pos = jnp.minimum(pos + adv, L - W)
         return picks, n_acc, new_tok, new_pos, ctr + adv, new_k, new_v
 
+    # -- compile-event hook (serving observability) --------------------
+    def add_compile_listener(self, cb):
+        """Register ``cb(kind, cache_key, wall_s)`` to fire right after
+        the FIRST call of each freshly built jitted program (the call
+        where jax traces and XLA compiles it).  Production-side
+        compile-thrash detector: the serving engine turns every event
+        into a trace span plus the ``serving.compiles_total`` counter,
+        so a traffic shape that defeats the program caches is visible
+        in /metrics instead of only as mystery latency.  A callback
+        that returns False (or raises) is deregistered — the engine
+        registers a weakref'd method so a collected engine drops off
+        this list by itself."""
+        listeners = getattr(self, "_compile_listeners", None)
+        if listeners is None:
+            listeners = self._compile_listeners = []
+        listeners.append(cb)
+        return cb
+
+    def remove_compile_listener(self, cb):
+        try:
+            getattr(self, "_compile_listeners", []).remove(cb)
+        except ValueError:
+            pass
+
+    def _compile_probe(self, kind, cache_key, fn):
+        """Wrap a freshly jitted dispatch so its first call is timed
+        and announced to ``add_compile_listener`` subscribers; later
+        calls pay one truthiness check.  The wall time covers trace +
+        XLA compile + the first execution — on a cache-warm process the
+        event simply never fires, which is exactly the signal: events
+        appearing in steady state mean the program cache is thrashing."""
+        import threading
+        done = []
+        first_lock = threading.Lock()
+        model = self
+
+        def probed(*args):
+            if done:
+                return fn(*args)
+            t0 = time.perf_counter()
+            out = fn(*args)
+            wall = time.perf_counter() - t0
+            with first_lock:
+                if done:
+                    # two threads raced the same cold program (sibling
+                    # engines over one model): exactly ONE fires the
+                    # event — the loser piggybacked on jax's compile
+                    # lock and must not double-count the compile
+                    return out
+                done.append(True)
+            listeners = getattr(model, "_compile_listeners", None)
+            if listeners:
+                for cb in list(listeners):
+                    try:
+                        alive = cb(kind, cache_key, wall)
+                    except Exception:
+                        alive = False
+                    if alive is False:
+                        try:
+                            listeners.remove(cb)
+                        except ValueError:
+                            pass
+            return out
+
+        return probed
+
     def _compiled_fused_decode_fn(self, pnames, params, cache_key,
                                   paged=False):
         """Build (or fetch) the jitted FUSED decode+sample tick for
@@ -1108,7 +1175,8 @@ class GPTModel(nn.Layer):
         fn = jax.jit(pure, donate_argnums=(2, 3))
         if len(cache) >= 8:  # FIFO bound, matching the other caches
             cache.pop(next(iter(cache)))
-        cache[cache_key] = (fn, bnames, mbuffers)
+        cache[cache_key] = (self._compile_probe(
+            "fused_decode", cache_key, fn), bnames, mbuffers)
         return cache[cache_key]
 
     def _compiled_fused_spec_verify_fn(self, pnames, params, cache_key,
@@ -1165,7 +1233,8 @@ class GPTModel(nn.Layer):
         fn = jax.jit(pure, donate_argnums=(2, 3))
         if len(cache) >= 8:  # FIFO bound, matching the other caches
             cache.pop(next(iter(cache)))
-        cache[cache_key] = (fn, bnames, mbuffers)
+        cache[cache_key] = (self._compile_probe(
+            "fused_spec_verify", cache_key, fn), bnames, mbuffers)
         return cache[cache_key]
 
     def _compiled_spec_verify_fn(self, pnames, params, cache_key,
@@ -1222,7 +1291,8 @@ class GPTModel(nn.Layer):
         fn = jax.jit(pure, donate_argnums=(2, 3))
         if len(cache) >= 8:  # FIFO bound, matching the other caches
             cache.pop(next(iter(cache)))
-        cache[cache_key] = (fn, bnames, mbuffers)
+        cache[cache_key] = (self._compile_probe(
+            "spec_verify", cache_key, fn), bnames, mbuffers)
         return cache[cache_key]
 
     def _chunk_prefill_tick(self, toks, k_bufs, v_bufs, pos, true_len):
@@ -1322,7 +1392,8 @@ class GPTModel(nn.Layer):
         fn = jax.jit(pure, donate_argnums=(2, 3))
         if len(cache) >= 8:  # FIFO bound, matching _prefill_fn_cache
             cache.pop(next(iter(cache)))
-        cache[cache_key] = (fn, bnames, mbuffers)
+        cache[cache_key] = (self._compile_probe(
+            "chunk_prefill", cache_key, fn), bnames, mbuffers)
         return cache[cache_key]
 
     def _compiled_paged_chunk_prefill_fn(self, pnames, params,
@@ -1364,7 +1435,8 @@ class GPTModel(nn.Layer):
         fn = jax.jit(pure, donate_argnums=(2, 3))
         if len(cache) >= 8:  # FIFO bound, matching the other caches
             cache.pop(next(iter(cache)))
-        cache[cache_key] = (fn, bnames, mbuffers)
+        cache[cache_key] = (self._compile_probe(
+            "paged_chunk_prefill", cache_key, fn), bnames, mbuffers)
         return cache[cache_key]
 
     def _compiled_slot_paged_decode_fn(self, pnames, params, cache_key):
@@ -1402,7 +1474,8 @@ class GPTModel(nn.Layer):
         fn = jax.jit(pure, donate_argnums=(2, 3))
         if len(cache) >= 8:  # FIFO bound, matching the other decode caches
             cache.pop(next(iter(cache)))
-        cache[cache_key] = (fn, bnames, mbuffers)
+        cache[cache_key] = (self._compile_probe(
+            "slot_paged_decode", cache_key, fn), bnames, mbuffers)
         return cache[cache_key]
 
     def _compiled_paged_prefill_fn(self, pnames, params, cache_key,
@@ -1472,7 +1545,8 @@ class GPTModel(nn.Layer):
         fn = jax.jit(pure, donate_argnums=(2, 3))
         if len(cache) >= 8:  # FIFO bound, matching _prefill_fn_cache
             cache.pop(next(iter(cache)))
-        cache[cache_key] = (fn, bnames, mbuffers)
+        cache[cache_key] = (self._compile_probe(
+            "paged_prefill", cache_key, fn), bnames, mbuffers)
         return cache[cache_key]
 
     def _compiled_slot_decode_fn(self, pnames, params, cache_key):
@@ -1510,7 +1584,8 @@ class GPTModel(nn.Layer):
         fn = jax.jit(pure, donate_argnums=(2, 3))
         if len(cache) >= 8:  # FIFO bound, matching the other decode caches
             cache.pop(next(iter(cache)))
-        cache[cache_key] = (fn, bnames, mbuffers)
+        cache[cache_key] = (self._compile_probe(
+            "slot_decode", cache_key, fn), bnames, mbuffers)
         return cache[cache_key]
 
     def _fused_generate_fn(self, pnames, params, cache_key, n_steps,
@@ -1590,7 +1665,8 @@ class GPTModel(nn.Layer):
         fn = jax.jit(pure)
         if len(cache) >= 8:  # FIFO bound on resident executables
             cache.pop(next(iter(cache)))
-        cache[cache_key] = (fn, bnames, mbuffers)
+        cache[cache_key] = (self._compile_probe(
+            "fused_generate", cache_key, fn), bnames, mbuffers)
         return cache[cache_key]
 
     def _spec_generate_fn(self, pnames, params, cache_key, max_new,
@@ -1755,7 +1831,8 @@ class GPTModel(nn.Layer):
         fn = jax.jit(pure)
         if len(cache) >= 8:  # FIFO bound, matching the other caches
             cache.pop(next(iter(cache)))
-        cache[cache_key] = (fn, bnames, mbuffers)
+        cache[cache_key] = (self._compile_probe(
+            "spec_generate", cache_key, fn), bnames, mbuffers)
         return cache[cache_key]
 
     def _compiled_prefill_fn(self, pnames, params, cache_key, b, s, L,
@@ -1800,7 +1877,8 @@ class GPTModel(nn.Layer):
         fn = jax.jit(pure)
         if len(cache) >= 8:  # FIFO bound, matching _gen_fn_cache
             cache.pop(next(iter(cache)))
-        cache[cache_key] = (fn, bnames, mbuffers)
+        cache[cache_key] = (self._compile_probe(
+            "prefill", cache_key, fn), bnames, mbuffers)
         return cache[cache_key]
 
     def _compiled_bucket_prefill_fn(self, pnames, params, cache_key, b,
@@ -1854,7 +1932,8 @@ class GPTModel(nn.Layer):
         fn = jax.jit(pure)
         if len(cache) >= 8:  # FIFO bound, matching _prefill_fn_cache
             cache.pop(next(iter(cache)))
-        cache[cache_key] = (fn, bnames, mbuffers)
+        cache[cache_key] = (self._compile_probe(
+            "bucket_prefill", cache_key, fn), bnames, mbuffers)
         return cache[cache_key]
 
     def _compiled_decode_fn(self, pnames, params, cache_key):
@@ -1893,7 +1972,8 @@ class GPTModel(nn.Layer):
         fn = jax.jit(pure, donate_argnums=(2, 3))
         if len(cache) >= 8:  # FIFO bound, matching the other decode caches
             cache.pop(next(iter(cache)))
-        cache[cache_key] = (fn, bnames, mbuffers)
+        cache[cache_key] = (self._compile_probe(
+            "decode", cache_key, fn), bnames, mbuffers)
         return cache[cache_key]
 
     def generate(self, input_ids, max_new_tokens=20, temperature=1.0,

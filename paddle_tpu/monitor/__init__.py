@@ -1,12 +1,17 @@
 """paddle_tpu.monitor — runtime counters/gauges/histograms + Prometheus
-text exposition.
+text exposition + the tick-level span tracer.
 
 Reference parity: ``platform/monitor.h`` ``StatValue``/``StatRegistry``
-(+ the STAT_ADD/STAT_SUB macros) — see stats.py.  Consumers: the
-serving engine (queue depth, slot occupancy, tokens/sec, TTFT/TPOT),
-the compiled train step (step counters/latency), and the DataLoader
-worker pool (batches consumed).  Pure stdlib — safe in fork'd worker
-processes and HTTP handler threads; no jax import.
+(+ the STAT_ADD/STAT_SUB macros) — see stats.py — and
+``platform/profiler.h`` ``RecordEvent`` spans with the
+``tools/timeline.py`` chrome-trace export — see tracing.py (bounded
+per-thread ring buffers, Catapult-native events, the serving engine's
+flight recorder).  Consumers: the serving engine (queue depth, slot
+occupancy, tokens/sec, TTFT/TPOT, tick spans), the compiled train
+step (step counters/latency), and the DataLoader worker pool (batches
+consumed).  Pure stdlib — safe in fork'd worker processes and HTTP
+handler threads; no jax import (TraceAnnotation pass-through imports
+jax lazily, only when asked for).
 """
 from .stats import (  # noqa: F401
     Counter, Gauge, Histogram, StatValue, StatRegistry, RateMeter,
@@ -14,6 +19,10 @@ from .stats import (  # noqa: F401
     stat_add, stat_sub, stat_get,
 )
 from .exposition import render_prometheus  # noqa: F401
+from .tracing import (  # noqa: F401
+    Tracer, NullTracer, RecordEvent, TraceEvent, to_chrome_trace,
+    default_tracer,
+)
 
 
 def counter(name, help=""):
@@ -36,4 +45,6 @@ __all__ = [
     "RateMeter", "DEFAULT_BUCKETS", "default_registry", "sanitize_name",
     "stat_add", "stat_sub", "stat_get", "render_prometheus",
     "counter", "gauge", "histogram",
+    "Tracer", "NullTracer", "RecordEvent", "TraceEvent",
+    "to_chrome_trace", "default_tracer",
 ]

@@ -30,6 +30,10 @@ def render_prometheus(registry=None):
     default registry) as Prometheus text exposition."""
     registry = registry or default_registry()
     lines = []
+    # registry.items() is a sorted snapshot taken under one lock:
+    # registrations landing mid-render (compile-event hooks, a sibling
+    # engine initializing) never mutate the iteration — each metric's
+    # own lock then keeps its sample lines internally consistent
     for name, m in registry.items():
         pname = sanitize_name(name)
         if m.help:

@@ -253,6 +253,13 @@ class StatRegistry:
             self._metrics.pop(name, None)
 
     def items(self):
+        """Point-in-time snapshot of (name, metric) pairs, sorted,
+        taken under ONE lock acquisition.  This is the exposition
+        contract: ``render_prometheus`` iterates the returned LIST, so
+        a metric registered concurrently (e.g. the engine's
+        compile-event hook firing while a /metrics or /debug handler
+        renders) can never mutate the mapping mid-iteration — it
+        simply appears in the next render."""
         with self._lock:
             return sorted(self._metrics.items())
 

@@ -33,8 +33,14 @@ counts under speculation) instead of the per-tick logits matrix;
 sampling numerics.  Metrics (queue depth, slot occupancy, tokens/sec,
 TTFT/TPOT, KV blocks in use, prefix hits/evictions, prefill chunks,
 decode stall, spec proposed/accepted/acceptance-rate/tokens-per-tick,
-d2h bytes per tick, host sample time, fused-sample ticks) land in
-paddle_tpu.monitor and render via ``render_prometheus()``.
+d2h bytes per tick, host sample time, fused-sample ticks, compiles)
+land in paddle_tpu.monitor and render via ``render_prometheus()``.
+Every engine also runs a tick-level span tracer (monitor/tracing.py:
+bounded per-thread rings, phase spans + request lifecycle instants +
+compile events) with chrome-trace export (``Engine.chrome_trace()``,
+``GET /debug/trace``), a live request view (``GET /debug/requests``),
+and an automatic flight-recorder dump on step failure
+(``Engine(flight_dir=...)``).
 """
 from .request import (  # noqa: F401
     Request, RequestQueue, RequestTimeout, QueueFull)

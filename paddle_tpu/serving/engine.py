@@ -30,14 +30,17 @@ tokens/sec, TTFT/TPOT histograms — scrape them through
 """
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
+import weakref
 
 import numpy as np
 
 from .. import monitor
 from .kvcache import BlockPool, PrefixCache
-from .request import MAX_SEED, Request, RequestQueue
+from .request import MAX_SEED, QueueFull, Request, RequestQueue
 from .scheduler import Scheduler
 
 
@@ -182,6 +185,31 @@ class Engine:
         numpy per-slot sampling (``_pick``).  Watch
         ``serving.d2h_bytes_per_tick`` / ``serving.sample_ms`` /
         ``serving.fused_sample_ticks``.
+    tracing : keep a per-engine span tracer (monitor/tracing.py) fed
+        by every tick: admission / prefill / chunk / decode-dispatch /
+        d2h-sync / sample / emit complete-events with args (batch
+        size, layout, accepted spec lanes, KV blocks in use),
+        per-request lifecycle instants (queued -> admitted ->
+        prefix-adopted -> first-token -> finished/evicted), and a
+        compile event + ``serving.compiles_total`` bump for every new
+        jitted program (layout / spec_k / chunk shape / wall time —
+        the production-side compile-thrash detector).  The buffer is a
+        bounded per-thread ring (``trace_capacity`` events), so the
+        cost is two clock reads and a deque append per span and the
+        LAST ~capacity events are always retained — the flight
+        recorder.  Download it live via ``/debug/trace`` or
+        ``Engine.chrome_trace()``; ``tracing=False`` swaps in a no-op
+        tracer (the bench's A/B: overhead is asserted <= 5%).
+    trace_capacity : per-thread ring-buffer bound, in events.
+    trace_annotations : also enter a ``jax.profiler.TraceAnnotation``
+        per span so engine phases land in XPlane/TensorBoard captures
+        (off by default: it imports jax in the span path).
+    flight_dir : directory for automatic flight-recorder dumps.  A
+        failing ``step()`` snapshots the trace ring plus the in-flight
+        request states into ``Engine.last_flight`` (always, in
+        memory, BEFORE recovery tears the slots down) and, when
+        ``flight_dir`` is set, also writes it there as a chrome-trace
+        JSON (``flight_tick<N>_<pid>_<ms>.json``) for post-mortems.
 
     ``step()`` is single-threaded by design — run it from one loop
     (``run_until_idle`` or the ``start()`` background thread).
@@ -193,7 +221,9 @@ class Engine:
                  max_queue=0, registry=None, prefill_buckets=None,
                  kv_block_size=None, kv_blocks=None, prefix_cache=True,
                  prefill_chunk=None, tick_token_budget=None,
-                 spec_k=None, proposer=None, sample_mode="device"):
+                 spec_k=None, proposer=None, sample_mode="device",
+                 tracing=True, trace_capacity=16384,
+                 trace_annotations=False, flight_dir=None):
         if getattr(model, "scan_layers", False):
             model = model._sync_decode_twin()
         model.eval()
@@ -327,6 +357,14 @@ class Engine:
                     f"max-length request ({self._bps} blocks)")
             self._kv_managed = managed
             self._prefix_enabled = bool(prefix_cache)
+        # -- tracing / flight recorder ---------------------------------
+        self.tracer = (monitor.Tracer(capacity=trace_capacity,
+                                      annotate=trace_annotations)
+                       if tracing else monitor.NullTracer())
+        self._flight_dir = flight_dir
+        self.last_flight = None        # chrome-trace dict of the most
+        self.last_flight_path = None   # recent step failure (+ file)
+        self.tick_no = 0
         self._reset_pools()
         self._rngs = {}  # request id -> np.random.Generator (sampling)
 
@@ -427,6 +465,34 @@ class Engine:
         self._m_fused_ticks = reg.counter(
             "serving.fused_sample_ticks", "decode dispatches that "
             "sampled on device (sample_mode='device')")
+        # compile-event surface: every NEW jitted program of this
+        # engine's model (any trigger — this engine, a sibling engine,
+        # generate()) bumps the counter and lands in the trace; a
+        # steady-state increase is the compile-thrash signal the
+        # bounded chunk/spec/bucket shapes exist to prevent
+        self._m_compiles = reg.counter(
+            "serving.compiles_total", "new jitted programs compiled "
+            "since engine start (first-call trace + XLA compile "
+            "events; nonzero growth in steady state = the program "
+            "cache is thrashing)")
+        self._m_compile_ms = reg.histogram(
+            "serving.compile_ms", "wall time of each new program's "
+            "first call (jax trace + XLA compile + first run, ms)")
+        # weakref'd listener: a collected engine returns False from the
+        # callback and the model drops it — engines must not leak into
+        # the model's listener list across their lifetimes
+        wm = weakref.WeakMethod(self._on_compile)
+
+        def _compile_cb(kind, key, wall_s, _wm=wm):
+            bound = _wm()
+            if bound is None:
+                return False
+            bound(kind, key, wall_s)
+            return True
+
+        self._compile_cb = _compile_cb
+        self._compile_cb_active = False
+        self._register_compile_listener()
 
         self._last_decode_end = None  # stall anchor: end of the last
         #   decode dispatch, cleared when no slot is decoding
@@ -532,7 +598,18 @@ class Engine:
                 f"prompt ({len(req.prompt)}) + max_new_tokens "
                 f"({req.max_new_tokens}){spec_note} = {total + margin} "
                 f"exceeds the slot cache length ({self.max_seq_len})")
-        self.queue.put(req)
+        # instant BEFORE put: once the request is in the queue the
+        # engine thread may admit (even first-token) it concurrently,
+        # and the ts-sorted timeline must keep queued -> admitted order
+        self.tracer.instant("req.queued", cat="request", req=req.id,
+                            prompt=int(len(req.prompt)),
+                            max_new=req.max_new_tokens)
+        try:
+            self.queue.put(req)
+        except QueueFull:
+            self.tracer.instant("req.rejected", cat="request",
+                                req=req.id, reason="queue_full")
+            raise
         self._m_reqs.inc()
         self._m_queue.set(self.queue.depth())
         return req
@@ -569,6 +646,122 @@ class Engine:
         self._b_arrays = None
         if self._paged and self.prefix_cache is not None:
             self.prefix_cache.clear()
+
+    # -- tracing / flight recorder / debug surface ---------------------
+    def _register_compile_listener(self):
+        """Subscribe this engine to the model's compile events
+        (idempotent).  ``stop()`` unsubscribes — a stopped engine must
+        not keep counting sibling engines' compiles into its registry
+        — and ``start()`` re-subscribes for the restart path; the
+        weakref inside the callback still covers engines discarded
+        without a stop()."""
+        if self._compile_cb_active:
+            return
+        add = getattr(self.model, "add_compile_listener", None)
+        if add is not None:
+            add(self._compile_cb)
+            self._compile_cb_active = True
+
+    def _unregister_compile_listener(self):
+        if not self._compile_cb_active:
+            return
+        remove = getattr(self.model, "remove_compile_listener", None)
+        if remove is not None:
+            remove(self._compile_cb)
+        self._compile_cb_active = False
+
+    def _on_compile(self, kind, key, wall_s):
+        """Compile-event hook (models/gpt.py ``add_compile_listener``):
+        count it, histogram the wall time, and back-date a trace span
+        over the compile so it nests inside whatever engine phase
+        triggered it."""
+        self._m_compiles.inc()
+        self._m_compile_ms.observe(wall_s * 1e3)
+        # keep only the scalar fields of the program cache key — it
+        # embeds the full parameter-name tuple, useless in a trace
+        brief = ([x for x in key
+                  if isinstance(x, (int, float, str, bool))]
+                 if isinstance(key, tuple) else [str(key)])
+        self.tracer.emit(
+            f"compile:{kind}", time.perf_counter() - wall_s, wall_s,
+            cat="compile",
+            args={"key": brief, "wall_ms": round(wall_s * 1e3, 3)})
+
+    def chrome_trace(self):
+        """Current trace ring as a Catapult JSON dict (chrome://tracing
+        / Perfetto); served by ``/debug/trace``."""
+        return self.tracer.chrome_trace(
+            process_name=f"paddle_tpu-serving pid={os.getpid()}")
+
+    def debug_requests(self):
+        """In-flight slot/request states + queued requests as plain
+        JSON-able dicts — the ``/debug/requests`` payload and the
+        flight recorder's context block.  Readable from any thread
+        while the engine decodes (one locked scheduler pass; the
+        request fields it reads are single-writer ints)."""
+        now = time.monotonic()
+        slots = []
+        for view in self.scheduler.debug_view():
+            req = view.pop("request")
+            if req is not None:
+                view["request_id"] = req.id
+                view["prompt_len"] = int(len(req.prompt))
+                view["generated"] = len(req.generated)
+                view["max_new_tokens"] = req.max_new_tokens
+                view["do_sample"] = bool(req.do_sample)
+                view["first_token"] = req.first_token_at is not None
+                view["age_ms"] = round((now - req.submitted_at) * 1e3,
+                                       3)
+            if self._paged:
+                view["kv_blocks"] = len(self._slot_blocks[view["slot"]])
+            slots.append(view)
+        queued = [{
+            "request_id": r.id, "prompt_len": int(len(r.prompt)),
+            "max_new_tokens": r.max_new_tokens,
+            "queued_ms": round((now - r.submitted_at) * 1e3, 3),
+            "deadline_in_s": (None if r.deadline is None
+                              else round(r.deadline - now, 3)),
+        } for r in self.queue.pending()]
+        return {
+            "tick": self.tick_no, "slots": slots, "queue": queued,
+            "engine": {
+                "num_slots": self.num_slots,
+                "max_seq_len": self.max_seq_len,
+                "layout": "paged" if self._paged else "contiguous",
+                "prefill_chunk": self._chunk,
+                "spec_k": self._spec_k,
+                "sample_mode": self.sample_mode,
+                "tracing": bool(self.tracer.enabled),
+            }}
+
+    def _record_flight(self, exc):
+        """Flight recorder: snapshot the trace ring + in-flight
+        request states at the moment of a step failure, BEFORE
+        recovery tears the slots down.  Always lands on
+        ``self.last_flight``; additionally written to ``flight_dir``
+        as chrome-trace JSON when configured.  Must never mask the
+        real failure, so it swallows its own errors."""
+        try:
+            trace = self.chrome_trace()
+            trace["metadata"] = {
+                "flight-recorder": {
+                    "error": repr(exc),
+                    "tick": self.tick_no,
+                    "dumped_at_unix": round(time.time(), 3),
+                    "requests": self.debug_requests(),
+                }}
+            self.last_flight = trace
+            if self._flight_dir:
+                os.makedirs(self._flight_dir, exist_ok=True)
+                path = os.path.join(
+                    self._flight_dir,
+                    f"flight_tick{self.tick_no}_{os.getpid()}_"
+                    f"{int(time.time() * 1e3)}.json")
+                with open(path, "w") as f:
+                    json.dump(trace, f)
+                self.last_flight_path = path
+        except Exception:
+            pass
 
     # -- paged KV cache (serving/kvcache.py) ---------------------------
     def _kv_gate(self, req):
@@ -632,6 +825,9 @@ class Engine:
         if m:
             self._m_prefix_hits.inc()
             self._m_prefix_hit_tokens.inc(m)
+            self.tracer.instant("req.prefix_adopted", cat="request",
+                                req=req.id, tokens=m,
+                                blocks=len(ctx))
         return ctx, fresh, m
 
     # -- per-slot sampling lanes (sample_mode="device") ----------------
@@ -824,28 +1020,34 @@ class Engine:
         C = self._chunk
         ids = np.zeros((1, C), np.int32)  # right-padded final chunk
         ids[0, :n] = req.prompt[p0:p0 + n]
-        if self._paged:
-            fn, _, _ = self.model._compiled_paged_chunk_prefill_fn(
-                self._pnames, self._params,
-                (C, self._kv_managed + 1, self._bs, self._bps,
-                 str(self._kv_dtype), tuple(self._pnames),
-                 self._bnames_all))
-            last0, self.k_pools, self.v_pools = fn(
-                self._p_list(), self._b_list(), self.k_pools,
-                self.v_pools, ids, jnp.asarray(self._block_tables[i]),
-                jnp.asarray(p0, jnp.int32), jnp.asarray(n, jnp.int32))
-        else:
-            fn, _, _ = self.model._compiled_chunk_prefill_fn(
-                self._pnames, self._params,
-                (C, self.num_slots, self.max_seq_len,
-                 str(self._kv_dtype), tuple(self._pnames),
-                 self._bnames_all),
-                C, self.max_seq_len, self._nh, self._hd,
-                self._kv_dtype)
-            last0, self.k_pools, self.v_pools = fn(
-                self._p_list(), self._b_list(), self.k_pools,
-                self.v_pools, ids, jnp.asarray(i, jnp.int32),
-                jnp.asarray(p0, jnp.int32), jnp.asarray(n, jnp.int32))
+        with self.tracer.span(
+                "prefill.chunk", req=req.id, pos=p0, n=n,
+                layout="paged" if self._paged else "contiguous"):
+            if self._paged:
+                fn, _, _ = self.model._compiled_paged_chunk_prefill_fn(
+                    self._pnames, self._params,
+                    (C, self._kv_managed + 1, self._bs, self._bps,
+                     str(self._kv_dtype), tuple(self._pnames),
+                     self._bnames_all))
+                last0, self.k_pools, self.v_pools = fn(
+                    self._p_list(), self._b_list(), self.k_pools,
+                    self.v_pools, ids,
+                    jnp.asarray(self._block_tables[i]),
+                    jnp.asarray(p0, jnp.int32),
+                    jnp.asarray(n, jnp.int32))
+            else:
+                fn, _, _ = self.model._compiled_chunk_prefill_fn(
+                    self._pnames, self._params,
+                    (C, self.num_slots, self.max_seq_len,
+                     str(self._kv_dtype), tuple(self._pnames),
+                     self._bnames_all),
+                    C, self.max_seq_len, self._nh, self._hd,
+                    self._kv_dtype)
+                last0, self.k_pools, self.v_pools = fn(
+                    self._p_list(), self._b_list(), self.k_pools,
+                    self.v_pools, ids, jnp.asarray(i, jnp.int32),
+                    jnp.asarray(p0, jnp.int32),
+                    jnp.asarray(n, jnp.int32))
         slot.prefilled = p0 + n
         slot.pos = slot.prefilled
         self._m_chunks.inc()
@@ -945,6 +1147,9 @@ class Engine:
         if req.first_token_at is None:
             req.first_token_at = now
             self._m_ttft.observe((now - req.submitted_at) * 1e3)
+            self.tracer.instant(
+                "req.first_token", cat="request", req=req.id,
+                ttft_ms=round((now - req.submitted_at) * 1e3, 3))
         self._m_tokens.inc()
         self._m_rate.add(1, now)
         finished = (len(req.generated) >= req.max_new_tokens or
@@ -966,6 +1171,9 @@ class Engine:
             # mirrors
             self._park_state(i)
             self._m_done.inc()
+            self.tracer.instant("req.finished", cat="request",
+                                req=req.id,
+                                tokens=len(req.generated))
             return
         i = slot.index
         self._cur_tok[i, 0] = int(tok)
@@ -1027,8 +1235,11 @@ class Engine:
         k+1 positions from the new cursor) rewrites before any query
         can see it."""
         import jax.numpy as jnp
+        tr = self.tracer
         W = self._spec_k + 1
-        toks = self._draft_window(active)
+        layout = "paged" if self._paged else "contiguous"
+        with tr.span("spec.draft", batch=len(active), spec_k=W - 1):
+            toks = self._draft_window(active)
         if self._spec_fn is None:
             self._spec_fn, _, _ = self.model._compiled_spec_verify_fn(
                 self._pnames, self._params,
@@ -1038,55 +1249,71 @@ class Engine:
                  tuple(self._pnames), self._bnames_all),
                 paged=self._paged)
         fn = self._spec_fn
-        if self._paged:
-            last, self.k_pools, self.v_pools = fn(
-                self._p_list(), self._b_list(), self.k_pools,
-                self.v_pools, jnp.asarray(self._block_tables),
-                jnp.asarray(toks), jnp.asarray(self._pos))
-        else:
-            last, self.k_pools, self.v_pools = fn(
-                self._p_list(), self._b_list(), self.k_pools,
-                self.v_pools, jnp.asarray(toks), jnp.asarray(self._pos))
-        rows = np.asarray(last, np.float32)           # [B, W, V]
+        with tr.span("decode.dispatch", batch=len(active),
+                     layout=layout, spec_w=W):
+            if self._paged:
+                last, self.k_pools, self.v_pools = fn(
+                    self._p_list(), self._b_list(), self.k_pools,
+                    self.v_pools, jnp.asarray(self._block_tables),
+                    jnp.asarray(toks), jnp.asarray(self._pos))
+            else:
+                last, self.k_pools, self.v_pools = fn(
+                    self._p_list(), self._b_list(), self.k_pools,
+                    self.v_pools, jnp.asarray(toks),
+                    jnp.asarray(self._pos))
+        with tr.span("decode.d2h") as d2h_sp:
+            rows = np.asarray(last, np.float32)       # [B, W, V]
+            d2h_sp.args["bytes"] = rows.nbytes
         self._m_d2h.set(rows.nbytes)
         self._m_spec_windows.inc(len(active))
         t_sample = time.monotonic()
         emitted = 0
-        for slot in active:
-            i = slot.index
-            req = slot.request
-            self._m_spec_proposed.inc(slot.spec_lanes)
-            n_emit = 0
-            n_acc = 0
-            j = 0
-            while True:
-                # lane j's logits are conditioned on exactly the
-                # accepted tokens, so _pick here equals the one-token
-                # tick's _pick for the same prefix (greedy AND seeded
-                # sampling: one rng draw per emitted token either way)
-                tok = self._pick(req, rows[i, j])
-                # only REAL lanes can match: a pad lane that happens
-                # to equal the pick must not be consumed (eviction at
-                # max_new would stop it anyway — this makes the bound
-                # local instead of an invariant-at-a-distance)
-                matched = j < slot.spec_lanes \
-                    and int(toks[i, j + 1]) == tok
-                if matched:
-                    # counted even when this very token finishes the
-                    # request (EOS proposed by a matched lane): the
-                    # draft DID predict an emitted token, and
-                    # n_emit - 1 would silently undercount it
-                    n_acc += 1
-                slot.pos += 1
-                self._pos[i] = slot.pos
-                self._emit(slot, tok)
-                n_emit += 1
-                if slot.request is None or not matched:
-                    break  # finished/evicted, or first draft mismatch
-                j += 1     # draft j verified: consume lane j+1
-            slot.spec_lanes = 0
-            self._m_spec_accepted.inc(n_acc)
-            emitted += n_emit
+        total_acc = 0
+        # `with`, not manual enter/exit: a _pick/_emit failure mid-loop
+        # must still record this span — it is exactly the phase the
+        # flight-recorder dump needs to show
+        with tr.span("decode.sample", batch=len(active),
+                     layout=layout) as sample_sp:
+            for slot in active:
+                i = slot.index
+                req = slot.request
+                self._m_spec_proposed.inc(slot.spec_lanes)
+                n_emit = 0
+                n_acc = 0
+                j = 0
+                while True:
+                    # lane j's logits are conditioned on exactly the
+                    # accepted tokens, so _pick here equals the
+                    # one-token tick's _pick for the same prefix
+                    # (greedy AND seeded sampling: one rng draw per
+                    # emitted token either way)
+                    tok = self._pick(req, rows[i, j])
+                    # only REAL lanes can match: a pad lane that
+                    # happens to equal the pick must not be consumed
+                    # (eviction at max_new would stop it anyway — this
+                    # makes the bound local instead of an
+                    # invariant-at-a-distance)
+                    matched = j < slot.spec_lanes \
+                        and int(toks[i, j + 1]) == tok
+                    if matched:
+                        # counted even when this very token finishes
+                        # the request (EOS proposed by a matched
+                        # lane): the draft DID predict an emitted
+                        # token, and n_emit - 1 would silently
+                        # undercount it
+                        n_acc += 1
+                    slot.pos += 1
+                    self._pos[i] = slot.pos
+                    self._emit(slot, tok)
+                    n_emit += 1
+                    if slot.request is None or not matched:
+                        break  # finished/evicted, or first mismatch
+                    j += 1     # draft j verified: consume lane j+1
+                slot.spec_lanes = 0
+                self._m_spec_accepted.inc(n_acc)
+                total_acc += n_acc
+                emitted += n_emit
+            sample_sp.args.update(emitted=emitted, accepted=total_acc)
         self._m_sample_ms.observe((time.monotonic() - t_sample) * 1e3)
         proposed = self._m_spec_proposed.value
         if proposed:
@@ -1108,8 +1335,11 @@ class Engine:
         state mirrors (the device cursor advanced past what the host
         consumed)."""
         import jax.numpy as jnp
+        tr = self.tracer
         W = self._spec_k + 1
-        toks = self._draft_window(active)
+        layout = "paged" if self._paged else "contiguous"
+        with tr.span("spec.draft", batch=len(active), spec_k=W - 1):
+            toks = self._draft_window(active)
         lanes = np.zeros(self.num_slots, np.int32)
         for slot in active:
             lanes[slot.index] = slot.spec_lanes
@@ -1133,45 +1363,58 @@ class Engine:
         args += [jnp.asarray(toks), jnp.asarray(lanes), st["pos"],
                  st["temp"], st["topk"], st["topp"], st["slo"],
                  st["shi"], st["ctr"]]
-        (picks, n_acc, new_tok, new_pos, new_ctr, self.k_pools,
-         self.v_pools) = self._fused_spec_fn(*args)
+        with tr.span("decode.dispatch", batch=len(active),
+                     layout=layout, spec_w=W, fused=True):
+            (picks, n_acc, new_tok, new_pos, new_ctr, self.k_pools,
+             self.v_pools) = self._fused_spec_fn(*args)
         st["tok"], st["pos"], st["ctr"] = new_tok, new_pos, new_ctr
-        picks = np.asarray(picks)                     # [B, W] ids
-        n_acc = np.asarray(n_acc)                     # [B] accepted
+        with tr.span("decode.d2h") as d2h_sp:
+            picks = np.asarray(picks)                 # [B, W] ids
+            n_acc = np.asarray(n_acc)                 # [B] accepted
+            d2h_sp.args["bytes"] = picks.nbytes + n_acc.nbytes
         self._m_d2h.set(picks.nbytes + n_acc.nbytes)
         self._m_fused_ticks.inc()
         self._m_spec_windows.inc(len(active))
         emitted = 0
-        for slot in active:
-            i = slot.index
-            self._m_spec_proposed.inc(slot.spec_lanes)
-            acc_i = int(n_acc[i])   # device-counted leading matches
-            n_cnt = 0
-            n_emit = 0
-            j = 0
-            while True:
-                # lane j's pick was drawn on device from the same
-                # key/logits the one-token tick would use for this
-                # prefix; consuming lanes 0..acc_i reproduces the host
-                # accept loop exactly (acc_i counts only REAL lanes)
-                tok = int(picks[i, j])
-                matched = j < acc_i
-                if matched:
-                    # counted even when this token finishes the
-                    # request (EOS drafted by a matched lane) — but
-                    # only over lanes actually consumed: an eviction
-                    # below stops the count like the host loop's break
-                    n_cnt += 1
-                slot.pos += 1
-                self._pos[i] = slot.pos
-                self._emit(slot, tok)
-                n_emit += 1
-                if slot.request is None or not matched:
-                    break
-                j += 1
-            slot.spec_lanes = 0
-            self._m_spec_accepted.inc(n_cnt)
-            emitted += n_emit
+        total_acc = 0
+        # `with`, not manual enter/exit: an _emit failure mid-loop must
+        # still record the span for the flight-recorder dump
+        with tr.span("decode.emit", batch=len(active),
+                     layout=layout) as emit_sp:
+            for slot in active:
+                i = slot.index
+                self._m_spec_proposed.inc(slot.spec_lanes)
+                acc_i = int(n_acc[i])  # device-counted leading matches
+                n_cnt = 0
+                n_emit = 0
+                j = 0
+                while True:
+                    # lane j's pick was drawn on device from the same
+                    # key/logits the one-token tick would use for this
+                    # prefix; consuming lanes 0..acc_i reproduces the
+                    # host accept loop exactly (acc_i counts only REAL
+                    # lanes)
+                    tok = int(picks[i, j])
+                    matched = j < acc_i
+                    if matched:
+                        # counted even when this token finishes the
+                        # request (EOS drafted by a matched lane) —
+                        # but only over lanes actually consumed: an
+                        # eviction below stops the count like the host
+                        # loop's break
+                        n_cnt += 1
+                    slot.pos += 1
+                    self._pos[i] = slot.pos
+                    self._emit(slot, tok)
+                    n_emit += 1
+                    if slot.request is None or not matched:
+                        break
+                    j += 1
+                slot.spec_lanes = 0
+                self._m_spec_accepted.inc(n_cnt)
+                total_acc += n_cnt
+                emitted += n_emit
+            emit_sp.args.update(emitted=emitted, accepted=total_acc)
         proposed = self._m_spec_proposed.value
         if proposed:
             self._m_spec_rate.set(
@@ -1202,18 +1445,27 @@ class Engine:
             args.append(st["tables"])
         args += [st["tok"], st["pos"], st["temp"], st["topk"],
                  st["topp"], st["slo"], st["shi"], st["ctr"]]
-        (ids, new_tok, new_pos, new_ctr, self.k_pools,
-         self.v_pools) = self._fused_fn(*args)
+        tr = self.tracer
+        layout = "paged" if self._paged else "contiguous"
+        with tr.span("decode.dispatch", batch=len(active),
+                     layout=layout, fused=True):
+            (ids, new_tok, new_pos, new_ctr, self.k_pools,
+             self.v_pools) = self._fused_fn(*args)
         st["tok"], st["pos"], st["ctr"] = new_tok, new_pos, new_ctr
-        ids = np.asarray(ids)                         # [B] int32
+        with tr.span("decode.d2h") as d2h_sp:
+            ids = np.asarray(ids)                     # [B] int32
+            d2h_sp.args["bytes"] = ids.nbytes
         self._m_d2h.set(ids.nbytes)
         self._m_fused_ticks.inc()
         emitted = 0
-        for slot in active:
-            slot.pos += 1
-            self._pos[slot.index] = slot.pos
-            self._emit(slot, int(ids[slot.index]))
-            emitted += 1
+        with tr.span("decode.emit", batch=len(active), layout=layout) \
+                as emit_sp:
+            for slot in active:
+                slot.pos += 1
+                self._pos[slot.index] = slot.pos
+                self._emit(slot, int(ids[slot.index]))
+                emitted += 1
+            emit_sp.args["emitted"] = emitted
         return emitted
 
     def _decode_tick(self, active):
@@ -1245,26 +1497,35 @@ class Engine:
                      str(self._kv_dtype), tuple(self._pnames),
                      self._bnames_all))
         fn = self._tick_fn
-        if self._paged:
-            last, self.k_pools, self.v_pools = fn(
-                self._p_list(), self._b_list(), self.k_pools,
-                self.v_pools, jnp.asarray(self._block_tables),
-                jnp.asarray(self._cur_tok), jnp.asarray(self._pos))
-        else:
-            last, self.k_pools, self.v_pools = fn(
-                self._p_list(), self._b_list(), self.k_pools,
-                self.v_pools, jnp.asarray(self._cur_tok),
-                jnp.asarray(self._pos))
-        rows = np.asarray(last, np.float32)
+        tr = self.tracer
+        layout = "paged" if self._paged else "contiguous"
+        with tr.span("decode.dispatch", batch=len(active),
+                     layout=layout):
+            if self._paged:
+                last, self.k_pools, self.v_pools = fn(
+                    self._p_list(), self._b_list(), self.k_pools,
+                    self.v_pools, jnp.asarray(self._block_tables),
+                    jnp.asarray(self._cur_tok), jnp.asarray(self._pos))
+            else:
+                last, self.k_pools, self.v_pools = fn(
+                    self._p_list(), self._b_list(), self.k_pools,
+                    self.v_pools, jnp.asarray(self._cur_tok),
+                    jnp.asarray(self._pos))
+        with tr.span("decode.d2h") as d2h_sp:
+            rows = np.asarray(last, np.float32)
+            d2h_sp.args["bytes"] = rows.nbytes
         self._m_d2h.set(rows.nbytes)
         t_sample = time.monotonic()
         emitted = 0
-        for slot in active:
-            slot.pos += 1
-            self._pos[slot.index] = slot.pos
-            self._emit(slot, self._pick(slot.request,
-                                        rows[slot.index]))
-            emitted += 1
+        with tr.span("decode.sample", batch=len(active),
+                     layout=layout) as sample_sp:
+            for slot in active:
+                slot.pos += 1
+                self._pos[slot.index] = slot.pos
+                self._emit(slot, self._pick(slot.request,
+                                            rows[slot.index]))
+                emitted += 1
+            sample_sp.args["emitted"] = emitted
         self._m_sample_ms.observe((time.monotonic() - t_sample) * 1e3)
         return emitted
 
@@ -1278,9 +1539,16 @@ class Engine:
         (a dispatch that died after consuming them leaves them deleted)
         — then re-raises, so every driver (run_until_idle, bench, the
         background loop) sees a working engine afterwards."""
+        # O(1) no-op while subscribed; re-subscribes a synchronous
+        # driver that keeps ticking after a stop()
+        self._register_compile_listener()
         try:
             return self._step_inner()
         except Exception as e:
+            # flight recorder FIRST: the dump must capture the slot /
+            # request states as they were at the failure, not after
+            # the evictions below tear them down
+            self._record_flight(e)
             # busy_slots, not active_slots: a chunked tick that dies
             # mid-prompt leaves half-PREFILLED slots whose waiters must
             # unblock just like the decoding ones
@@ -1291,26 +1559,50 @@ class Engine:
                     self._rngs.pop(req.id, None)
                     self._m_done.inc()  # terminal, like timeouts: keep
                     #   in-flight = total - completed consistent
+                    self.tracer.instant("req.evicted", cat="request",
+                                        req=req.id,
+                                        reason="step_failure")
             self._reset_pools()
             self._last_decode_end = None
             self._m_occ.set(0)
             raise
 
     def _step_inner(self):
+        self.tick_no += 1
+        tr = self.tracer
+        with tr.span("tick", cat="tick", tick=self.tick_no) as tick_sp:
+            emitted = self._tick(tr, tick_sp)
+        return emitted
+
+    def _tick(self, tr, tick_sp):
         now = time.monotonic()
         # deadline sweep first: with a full pool nothing gets popped,
         # but queued requests must still time out on schedule
-        timed_out = self.queue.expire(now)
-        admitted, admit_timed_out = self.scheduler.admit(
-            now, gate=self._kv_gate if self._paged else None)
-        timed_out = timed_out + admit_timed_out
+        with tr.span("admit") as admit_sp:
+            timed_out = self.queue.expire(now)
+            admitted, admit_timed_out = self.scheduler.admit(
+                now, gate=self._kv_gate if self._paged else None)
+            timed_out = timed_out + admit_timed_out
+            admit_sp.args.update(admitted=len(admitted),
+                                 timed_out=len(timed_out))
+        for slot in admitted:
+            tr.instant("req.admitted", cat="request",
+                       req=slot.request.id, slot=slot.index)
         if timed_out:
             self._m_timeout.inc(len(timed_out))
             self._m_done.inc(len(timed_out))
+            for req in timed_out:
+                tr.instant("req.evicted", cat="request", req=req.id,
+                           reason="timeout")
         emitted = 0
         if self._chunk is None:
             for slot in admitted:
-                self._prefill(slot)
+                # read the id up front: an EOS-on-first-token prefill
+                # evicts and clears slot.request before the span ends
+                rid = slot.request.id
+                with tr.span("prefill", req=rid,
+                             prompt=int(len(slot.request.prompt))):
+                    self._prefill(slot)
                 emitted += 1  # prefill samples the first token
             occ, active, _ = self.scheduler.snapshot()
         else:
@@ -1338,8 +1630,11 @@ class Engine:
             self._last_decode_end = None
         self._m_queue.set(self.queue.depth())
         self._m_occ.set(occ)
+        tick_sp.args.update(batch=len(active), emitted=emitted,
+                            occupancy=occ, queue=self.queue.depth())
         if self._paged:
             self._m_kv_blocks.set(self.block_pool.in_use())
+            tick_sp.args["kv_blocks_in_use"] = self.block_pool.in_use()
         return emitted
 
     def run_until_idle(self, max_steps=100000):
@@ -1361,6 +1656,7 @@ class Engine:
         mode); idle ticks sleep briefly instead of spinning.  Safe to
         call after a timed-out stop(): the new loop joins the old one
         before its first tick, so two loops never step concurrently."""
+        self._register_compile_listener()  # restart after a stop()
         prev = self._thread
         if prev is not None and prev.is_alive() \
                 and not self._stop.is_set():
@@ -1417,6 +1713,8 @@ class Engine:
             if req is not None:
                 self._rngs.pop(req.id, None)
                 self._m_done.inc()
+                self.tracer.instant("req.evicted", cat="request",
+                                    req=req.id, reason="shutdown")
         self._m_queue.set(0)
         self._m_occ.set(0)
 
@@ -1436,9 +1734,16 @@ class Engine:
                 # mid-dispatch (e.g. a long first compile): draining
                 # under the live loop would race it, so the loop drains
                 # on exit instead; the handle stays so a later start()
-                # serializes behind it
+                # serializes behind it — and the compile listener stays
+                # subscribed, because that in-flight dispatch may be
+                # the very compile worth recording
                 return
             self._thread = None
+        # only AFTER the loop is confirmed down: a stopped engine must
+        # not keep counting sibling engines' compiles, but compiles
+        # completing inside the join window above still count.
+        # start() — or a synchronous step() — re-subscribes.
+        self._unregister_compile_listener()
         if drain:
             self._drain_on_exit = None
             self._drain()

@@ -9,7 +9,15 @@ full submit -> queue -> slot -> result path over a real socket):
                     "temperature": 1.0, "top_k": 0, "top_p": 1.0}
                 -> {"ids": [...], "generated": [...], "ttft_ms": ...}
   GET  /metrics    Prometheus text exposition (monitor registry)
-  GET  /healthz    {"slots_free": n, "queue_depth": n, ...}
+  GET  /healthz    {"slots_free": n, "queue_depth": n,
+                    "kv_blocks_free": n|null, ...} — always carries
+                   the router-tier load signals (queue depth, free
+                   slots, free KV blocks)
+  GET  /debug/trace     current trace ring as chrome-trace JSON
+                        (open in chrome://tracing / Perfetto, or feed
+                        tools/trace_view.py)
+  GET  /debug/requests  in-flight slot/request states (prefill
+                        progress, spec lanes, KV blocks) + the queue
 
 Handlers run on ThreadingHTTPServer worker threads and block on
 ``Request.result()`` while the engine's own thread decodes — the
@@ -84,15 +92,21 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, monitor.render_prometheus(eng.registry),
                        ctype="text/plain; version=0.0.4; charset=utf-8")
         elif self.path == "/healthz":
+            # queue_depth / slots_free / kv_blocks_free are ALWAYS
+            # present: they are the per-engine load signals a router
+            # tier balances on (kv_blocks_free is null in contiguous
+            # mode — capacity there is slots, not blocks)
             info = {
                 "status": "ok",
                 "slots_total": eng.num_slots,
                 "slots_free": eng.scheduler.free_count(),
                 "queue_depth": eng.queue.depth(),
+                "kv_blocks_free": (
+                    eng.block_pool.free_count()
+                    if getattr(eng, "_paged", False) else None),
                 "sample_mode": getattr(eng, "sample_mode", "host"),
             }
             if getattr(eng, "_paged", False):
-                info["kv_blocks_free"] = eng.block_pool.free_count()
                 info["kv_blocks_cached"] = (
                     eng.prefix_cache.cached_blocks()
                     if eng.prefix_cache is not None else 0)
@@ -103,6 +117,14 @@ class _Handler(BaseHTTPRequestHandler):
                 info["spec_tokens_per_tick"] = round(
                     eng._m_spec_tpt.value, 4)
             self._send_json(200, info)
+        elif self.path == "/debug/trace":
+            # the live trace ring as a downloadable chrome-trace file
+            self._send(
+                200, json.dumps(eng.chrome_trace()),
+                headers={"Content-Disposition":
+                         'attachment; filename="trace.json"'})
+        elif self.path == "/debug/requests":
+            self._send_json(200, eng.debug_requests())
         else:
             self._send_json(404, {"error": f"no route {self.path}"})
 

@@ -191,6 +191,12 @@ class RequestQueue:
         with self._lock:
             return len(self._q)
 
+    def pending(self):
+        """Snapshot of the queued requests in FIFO order (the
+        ``/debug/requests`` surface; the queue keeps its entries)."""
+        with self._lock:
+            return list(self._q)
+
     def drain(self, error=None):
         """Fail every queued request (engine shutdown)."""
         with self._lock:

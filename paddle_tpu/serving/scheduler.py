@@ -92,6 +92,24 @@ class Scheduler:
         with self._lock:
             return [s for s in self.slots if not s.free]
 
+    def debug_view(self):
+        """ONE locked pass over the pool for the debug surface
+        (``/debug/requests``, flight recorder): per-slot metadata as
+        plain dicts, request handle included — the engine enriches
+        and serializes.  Read-only; safe from any thread."""
+        with self._lock:
+            out = []
+            for s in self.slots:
+                req = s.request
+                state = ("free" if req is None else
+                         "decoding" if s.prefilled >= len(req.prompt)
+                         else "prefilling")
+                out.append({"slot": s.index, "state": state,
+                            "request": req, "pos": s.pos,
+                            "prefilled": s.prefilled,
+                            "spec_lanes": s.spec_lanes})
+        return out
+
     def snapshot(self):
         """ONE locked pass over the pool: (occupancy, decoding slots,
         prefilling slots ordered by admission).  The engine's per-tick

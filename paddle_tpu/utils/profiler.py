@@ -5,46 +5,41 @@ trace export (``platform/profiler.cc:196``, ``device_tracer.cc:57``,
 ``tools/timeline.py``).  TPU-native: ``jax.profiler`` emits an XPlane trace
 (TensorBoard / Perfetto-compatible — the chrome://tracing successor);
 RecordEvent maps to ``jax.profiler.TraceAnnotation`` so host spans correlate
-with device activity in the same trace.  Host spans are additionally
-collected in-process so ``stop_profiler(profile_path=...)`` can write a
-standalone chrome://tracing JSON and print the reference-style summary
-table (sorted by total time) without TensorBoard.
+with device activity in the same trace.  Host spans are collected through
+the SAME span tracer the serving engine uses (``monitor/tracing.py``:
+bounded per-thread ring buffers, Catapult-native events), so
+``stop_profiler(profile_path=...)`` writes a standalone chrome://tracing
+JSON via the shared exporter and prints the reference-style summary table
+(sorted by total time) without TensorBoard.
 """
 from __future__ import annotations
 
 import contextlib
 import json
 import os
-import threading
 import time
 
 import jax
 
-_host_events = []        # (name, t0, dur) while profiling is active
-_collecting = False
-_lock = threading.Lock()
+from ..monitor import tracing as _tracing
+
+# The profiler's collection backend: one process-wide tracer, muted
+# until start_profiler() arms it.  annotate=True keeps the historical
+# behavior of entering a jax.profiler.TraceAnnotation per span (so
+# RecordEvent shows up in XPlane captures even outside start/stop).
+_CAPACITY = 1 << 20  # profiling sessions are short; keep every span
+_tracer = _tracing.Tracer(capacity=_CAPACITY, enabled=False,
+                          annotate=True)
 
 
-class RecordEvent:
-    """RAII span (reference: platform/profiler.h RecordEvent)."""
+class RecordEvent(_tracing.RecordEvent):
+    """RAII span (reference: platform/profiler.h RecordEvent) —
+    collected by the shared monitor tracer while profiling is active,
+    always annotated into any live XPlane capture."""
 
     def __init__(self, name):
-        self.name = name
-        self._ann = None
-
-    def __enter__(self):
-        self._ann = jax.profiler.TraceAnnotation(self.name)
-        self._ann.__enter__()
-        self._t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        self.elapsed = time.perf_counter() - self._t0
-        if _collecting:
-            with _lock:
-                _host_events.append((self.name, self._t0, self.elapsed))
-        self._ann.__exit__(*exc)
-        return False
+        super().__init__(name, tracer=_tracer, cat="host",
+                         annotate=True)
 
 
 _active_dir = None
@@ -52,30 +47,30 @@ _active_dir = None
 
 def start_profiler(state="All", tracer_option="Default",
                    log_dir="/tmp/paddle_tpu_profile"):
-    global _active_dir, _collecting
+    global _active_dir
     _active_dir = log_dir
-    with _lock:
-        _host_events.clear()
-    _collecting = True
+    _tracer.clear()
+    _tracer.enabled = True
     jax.profiler.start_trace(log_dir)
 
 
 def stop_profiler(sorted_key="total", profile_path=None):
     """Stop tracing; optionally write a chrome://tracing JSON of host spans
-    (reference: tools/timeline.py output) and print the summary table."""
-    global _active_dir, _collecting
+    (reference: tools/timeline.py output) and print the summary table.
+    Returns the collected spans as (name, t0_s, dur_s) tuples."""
+    global _active_dir
     if _active_dir is None:
         return
     jax.profiler.stop_trace()
     _active_dir = None
-    _collecting = False
-    with _lock:
-        events = list(_host_events)
+    _tracer.enabled = False
+    span_events = [ev for ev in _tracer.events() if ev.ph == "X"]
+    events = [(ev.name, ev.ts / 1e6, ev.dur / 1e6)
+              for ev in span_events]
     if profile_path:
-        trace = {"traceEvents": [
-            {"name": name, "ph": "X", "pid": 0, "tid": 0,
-             "ts": t0 * 1e6, "dur": dur * 1e6, "cat": "host"}
-            for name, t0, dur in events]}
+        # bare event list (no process/thread metadata): the reference
+        # converter emitted exactly one JSON object per recorded span
+        trace = _tracing.to_chrome_trace(span_events)
         os.makedirs(os.path.dirname(os.path.abspath(profile_path)),
                     exist_ok=True)
         with open(profile_path, "w") as f:
@@ -139,10 +134,9 @@ class Timer:
 
 def reset_profiler():
     """reference: fluid/profiler.py reset_profiler — drop collected
-    host events.  Takes the lock: concurrent RecordEvent.__exit__
-    appends race an unlocked clear()."""
-    with _lock:
-        _host_events.clear()
+    host events.  The tracer clears its ring buffers under their lock:
+    concurrent RecordEvent.__exit__ appends race an unlocked clear()."""
+    _tracer.clear()
 
 
 class cuda_profiler:

@@ -194,3 +194,44 @@ def test_train_step_counters_in_exposition():
     text = render_prometheus()
     assert "train_steps" in text
     assert "train_step_time_ms_count" in text
+
+
+def test_render_during_concurrent_registration():
+    """Exposition vs concurrent registration (the engine loop and the
+    /debug handlers now render while compile-event hooks register):
+    registry.items() snapshots under ONE lock, so hammering
+    render_prometheus against get-or-create from another thread must
+    never raise ('dictionary changed size during iteration') and every
+    render must stay a parseable exposition."""
+    import threading
+
+    from paddle_tpu.monitor import StatRegistry, render_prometheus
+
+    reg = StatRegistry()
+    reg.counter("seed.counter", "pre-registered").inc()
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        i = 0
+        try:
+            while not stop.is_set():
+                reg.counter(f"churn.c{i % 97}", "x").inc()
+                reg.histogram(f"churn.h{i % 89}", "y").observe(i)
+                reg.gauge(f"churn.g{i % 83}", "z").set(i)
+                i += 1
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        for _ in range(300):
+            text = render_prometheus(reg)
+            assert "seed_counter 1" in text
+            for line in text.splitlines():
+                assert line.startswith("#") or " " in line
+    finally:
+        stop.set()
+        t.join()
+    assert not errors

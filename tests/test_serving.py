@@ -1156,3 +1156,288 @@ def test_background_loop_and_http(tiny_gpt):
                                     timeout=10) as resp:
             metrics = resp.read().decode()
         assert "serving_requests_completed 3" in metrics
+
+
+# ---------------------------------------------------------------------------
+# Tick-level tracing + flight recorder (monitor/tracing.py wired through
+# the engine: per-tick phase spans, per-request lifecycle instants,
+# compile events, /debug endpoints, auto-dump on step failure)
+# ---------------------------------------------------------------------------
+
+def _events_by_name(trace):
+    out = {}
+    for ev in trace["traceEvents"]:
+        out.setdefault(ev["name"], []).append(ev)
+    return out
+
+
+def test_trace_mixed_engine_spans_and_lifecycle(tiny_gpt):
+    """The acceptance surface: a MIXED run (paged KV + chunked prefill
+    + speculative decode + device sampling) produces a chrome trace
+    whose tick spans nest the phase spans (admit / prefill.chunk /
+    spec.draft / decode.dispatch / d2h / emit) and whose per-request
+    lifecycle instants (queued -> admitted -> prefix-adopted ->
+    first-token -> finished) carry the request ids."""
+    eng = _engine(tiny_gpt, kv_block_size=8, prefill_chunk=8,
+                  tick_token_budget=16, spec_k=3)
+    rng = np.random.RandomState(3)
+    sysp = rng.randint(0, 128, (16,)).astype(np.int32)
+    first = eng.submit(np.concatenate(
+        [sysp, rng.randint(0, 128, (5,)).astype(np.int32)]),
+        max_new_tokens=6)
+    eng.run_until_idle()          # request 1 caches the shared prefix
+    first.result(timeout=1)
+    second = eng.submit(np.concatenate(
+        [sysp, rng.randint(0, 128, (7,)).astype(np.int32)]),
+        max_new_tokens=6, temperature=0.9, top_p=0.9, seed=5)
+    eng.run_until_idle()
+    second.result(timeout=1)
+    trace = eng.chrome_trace()
+    json.loads(json.dumps(trace))                 # valid Catapult JSON
+    by = _events_by_name(trace)
+    for name in ("tick", "admit", "prefill.chunk", "spec.draft",
+                 "decode.dispatch", "decode.d2h", "decode.emit"):
+        assert name in by, f"missing span {name!r}"
+    # phase spans nest inside a tick span on the same thread
+    ticks = by["tick"]
+    for name in ("admit", "prefill.chunk", "decode.dispatch"):
+        for ev in by[name]:
+            assert any(t["tid"] == ev["tid"]
+                       and t["ts"] <= ev["ts"]
+                       and ev["ts"] + ev["dur"]
+                       <= t["ts"] + t["dur"] + 1e-3
+                       for t in ticks), f"{name} not inside any tick"
+    # ts monotonic in the merged export (metadata rows excluded)
+    ts = [e["ts"] for e in trace["traceEvents"] if e["ph"] != "M"]
+    assert ts == sorted(ts)
+    # per-request lifecycle, second request: adopted the cached prefix
+    rid = second.id
+    for name in ("req.queued", "req.admitted", "req.prefix_adopted",
+                 "req.first_token", "req.finished"):
+        assert any(e["args"].get("req") == rid for e in by[name]), \
+            f"lifecycle instant {name!r} missing for request {rid}"
+    # args carry the tick anatomy the timeline reader needs
+    assert all("batch" in t["args"] for t in ticks)
+    assert any("kv_blocks_in_use" in t["args"] for t in ticks)
+    assert any(e["args"].get("accepted") is not None
+               for e in by["decode.emit"])
+
+
+def test_flight_recorder_dumps_on_step_failure(tiny_gpt, monkeypatch,
+                                               tmp_path):
+    """An injected step failure auto-dumps the flight recorder: the
+    in-memory snapshot AND the flight_dir file hold the trace ring
+    plus the in-flight request states AS THEY WERE at the failure
+    (before recovery evicts), and the engine keeps serving after."""
+    eng = _engine(tiny_gpt, flight_dir=str(tmp_path))
+    req = eng.submit(_prompts(1)[0], max_new_tokens=6)
+    eng.step()
+
+    def boom(active):
+        raise RuntimeError("synthetic dispatch failure")
+
+    monkeypatch.setattr(eng, "_decode_tick", boom)
+    with pytest.raises(RuntimeError):
+        eng.step()
+    monkeypatch.undo()
+    assert eng.last_flight is not None
+    assert eng.last_flight_path is not None
+    dumped = json.load(open(eng.last_flight_path))
+    fr = dumped["metadata"]["flight-recorder"]
+    assert "synthetic dispatch failure" in fr["error"]
+    assert fr["tick"] == eng.tick_no
+    slot0 = fr["requests"]["slots"][0]
+    assert slot0["state"] == "decoding"          # pre-eviction state
+    assert slot0["request_id"] == req.id
+    assert slot0["generated"] >= 1
+    # the dump is a loadable chrome trace with the tick spans retained
+    names = {e["name"] for e in dumped["traceEvents"]}
+    assert "tick" in names and "decode.dispatch" in names
+    # step-failure evictions are traced too
+    post = _events_by_name(eng.chrome_trace())
+    assert any(e["args"] == {"req": req.id, "reason": "step_failure"}
+               for e in post["req.evicted"])
+    # engine recovered: still serves to parity
+    p = _prompts(2)[1]
+    r2 = eng.submit(p, max_new_tokens=6)
+    eng.run_until_idle()
+    ref = tiny_gpt.generate(paddle.to_tensor(p[None, :]),
+                            max_new_tokens=6).numpy()[0]
+    np.testing.assert_array_equal(r2.result(timeout=1), ref)
+
+
+def test_debug_endpoints_smoke(tiny_gpt):
+    """/debug/trace downloads the live ring as chrome-trace JSON and
+    /debug/requests reports in-flight slot states (prefill progress,
+    spec window) plus the queue — mid-flight and when idle."""
+    eng = _engine(tiny_gpt, num_slots=1, spec_k=2)
+    r1 = eng.submit(_prompts(1)[0], max_new_tokens=8)
+    r2 = eng.submit(_prompts(2)[1], max_new_tokens=4)  # waits in queue
+    eng.step()
+    code, body, hdr = _get_probe(eng, "/debug/trace")
+    assert code == 200
+    trace = json.loads(body)
+    assert any(e["name"] == "tick" for e in trace["traceEvents"])
+    code, dbg, _ = _get_probe(eng, "/debug/requests")
+    assert code == 200
+    slot = dbg["slots"][0]
+    assert slot["state"] == "decoding"
+    assert slot["request_id"] == r1.id
+    assert slot["prefilled"] == len(r1.prompt)
+    assert slot["pos"] >= len(r1.prompt)
+    assert dbg["queue"][0]["request_id"] == r2.id
+    assert dbg["queue"][0]["queued_ms"] >= 0
+    assert dbg["engine"]["spec_k"] == 2
+    assert dbg["engine"]["tracing"] is True
+    eng.run_until_idle()
+    r1.result(timeout=1)
+    r2.result(timeout=1)
+    code, dbg, _ = _get_probe(eng, "/debug/requests")
+    assert all(s["state"] == "free" for s in dbg["slots"])
+    assert dbg["queue"] == []
+
+
+def test_healthz_always_reports_load_signals(tiny_gpt):
+    """The router-tier load signals (queue_depth, slots_free,
+    kv_blocks_free) are ALWAYS in /healthz — kv_blocks_free is null
+    in contiguous mode, the pool's free count in paged mode."""
+    code, health, _ = _get_probe(_engine(tiny_gpt), "/healthz")
+    assert code == 200
+    assert health["queue_depth"] == 0
+    assert health["slots_free"] == 4
+    assert health["kv_blocks_free"] is None
+    paged = _engine(tiny_gpt, kv_block_size=8)
+    code, health, _ = _get_probe(paged, "/healthz")
+    assert health["kv_blocks_free"] == paged.block_pool.free_count()
+    assert health["kv_blocks_free"] > 0
+
+
+def test_compile_events_counter_and_trace():
+    """Every NEW jitted program fires the compile hook: the
+    serving.compiles_total counter and a compile:<kind> trace span
+    with the program's scalar key + wall time — the production-side
+    compile-thrash detector.  A second engine over the SAME model (a
+    warm program cache) records none."""
+    paddle.seed(0)
+    model = GPTModel.from_config("tiny", dropout=0.0)
+    model.eval()
+    eng = _engine(model)
+    r = eng.submit(_prompts(1)[0], max_new_tokens=4)
+    eng.run_until_idle()
+    r.result(timeout=1)
+    n = eng.registry.get("serving.compiles_total").value
+    assert n >= 2          # at least the prefill + fused decode tick
+    assert eng.registry.get("serving.compile_ms").count == n
+    by = _events_by_name(eng.chrome_trace())
+    kinds = {name for name in by if name.startswith("compile:")}
+    assert "compile:fused_decode" in kinds
+    ev = by["compile:fused_decode"][0]
+    assert ev["args"]["wall_ms"] > 0
+    assert "slot" in ev["args"]["key"]     # the layout survives
+    text = monitor.render_prometheus(eng.registry)
+    assert "serving_compiles_total" in text
+    # warm cache: a sibling engine compiles nothing new
+    eng2 = _engine(model)
+    r = eng2.submit(_prompts(1)[0], max_new_tokens=4)
+    eng2.run_until_idle()
+    r.result(timeout=1)
+    assert eng2.registry.get("serving.compiles_total").value == 0
+
+
+def test_tracing_disabled_is_null(tiny_gpt):
+    """Engine(tracing=False): no events collected, debug endpoints
+    still answer (empty trace), outputs identical to the traced
+    engine — the bench's A/B contract."""
+    p = _prompts(1)[0]
+    on = _engine(tiny_gpt)
+    off = _engine(tiny_gpt, tracing=False)
+    r_on = on.submit(p, max_new_tokens=6)
+    r_off = off.submit(p, max_new_tokens=6)
+    on.run_until_idle()
+    off.run_until_idle()
+    np.testing.assert_array_equal(r_on.result(timeout=1),
+                                  r_off.result(timeout=1))
+    assert on.tracer.events()
+    assert off.tracer.events() == []
+    code, body, _ = _get_probe(off, "/debug/trace")
+    assert code == 200 and json.loads(body)["traceEvents"] == []
+    code, dbg, _ = _get_probe(off, "/debug/requests")
+    assert code == 200 and dbg["engine"]["tracing"] is False
+
+
+def test_trace_ring_bounded_in_engine(tiny_gpt):
+    """trace_capacity bounds the engine's ring under sustained load —
+    the flight recorder retains the latest ticks, never grows."""
+    eng = _engine(tiny_gpt, trace_capacity=48)
+    for _ in range(3):
+        r = eng.submit(_prompts(1)[0], max_new_tokens=8)
+        eng.run_until_idle()
+        r.result(timeout=1)
+    evs = [e for e in eng.tracer.events()]
+    per_thread = {}
+    for e in evs:
+        per_thread[e.tid] = per_thread.get(e.tid, 0) + 1
+    assert all(c <= 48 for c in per_thread.values())
+    # the retained window is the most recent: the last tick is there
+    tick_args = [e.args["tick"] for e in evs if e.name == "tick"]
+    assert tick_args and max(tick_args) == eng.tick_no
+
+
+def test_tracing_overhead_twin_mixed(tiny_gpt):
+    """Fast tier-1 twin of ``bench.py serving_trace``: the mixed
+    configuration (paged + chunked + spec + device sampling) runs with
+    tracing on and off, token streams must match exactly (tracing is
+    pure observation), and the traced run must not be wildly slower —
+    a LOOSE 50% ceiling here so CI noise cannot flap it; the bench
+    asserts the real <= 5% budget on longer, best-of timed arms."""
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, 128, (int(l),)).astype(np.int32)
+               for l in rng.randint(4, 14, 4)]
+
+    def run(tracing):
+        eng = _engine(tiny_gpt, kv_block_size=8, prefill_chunk=8,
+                      tick_token_budget=16, spec_k=3, tracing=tracing)
+        for p in prompts:                        # warm the compiles
+            eng.submit(p, max_new_tokens=2)
+        eng.run_until_idle()
+        best = float("inf")
+        outs = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            rs = [eng.submit(p, max_new_tokens=8, seed=i,
+                             temperature=0.9, top_p=0.9)
+                  for i, p in enumerate(prompts)]
+            eng.run_until_idle()
+            best = min(best, time.perf_counter() - t0)
+            outs = [r.result(timeout=1).tolist() for r in rs]
+        return best, outs
+
+    dt_off, outs_off = run(False)
+    dt_on, outs_on = run(True)
+    assert outs_on == outs_off, \
+        "tracing must not perturb the token streams"
+    assert dt_on <= dt_off * 1.5, \
+        f"traced tick {dt_on * 1e3:.1f}ms vs {dt_off * 1e3:.1f}ms — " \
+        "far beyond the 5% production budget (see BENCH_r09.json)"
+
+
+def test_compile_listener_deregisters_on_stop(tiny_gpt):
+    """stop() unsubscribes the engine from the model's compile events
+    (a stopped engine must not keep counting sibling compiles) and
+    start() re-subscribes for the restart path."""
+    eng = _engine(tiny_gpt)
+    listeners = tiny_gpt._compile_listeners
+    assert eng._compile_cb in listeners
+    eng.stop()
+    assert eng._compile_cb not in listeners
+    eng.stop()                       # idempotent
+    assert eng._compile_cb not in listeners
+    eng.start()
+    assert listeners.count(eng._compile_cb) == 1
+    eng.start()                      # no double-subscribe
+    assert listeners.count(eng._compile_cb) == 1
+    eng.stop()
+    assert eng._compile_cb not in listeners
+    # a synchronous driver that keeps ticking after stop() re-subscribes
+    eng.step()
+    assert listeners.count(eng._compile_cb) == 1

@@ -1,0 +1,271 @@
+"""Span tracer + chrome-trace exporter (monitor/tracing.py) and the
+timeline tools: Catapult JSON validity (round-trips through ``json``,
+monotonic ``ts``, well-formed ``ph`` fields), ring-buffer bounding
+under sustained load, thread safety of concurrent spans against
+concurrent snapshots, the RecordEvent decorator/context-manager API,
+and the tools/trace_view.py + tools/timeline.py CLIs.  Pure stdlib —
+no jax, no model; engine integration lives in tests/test_serving.py."""
+import importlib.util
+import json
+import os
+import threading
+
+import pytest
+
+from paddle_tpu.monitor.tracing import (
+    NullTracer, RecordEvent, Tracer, to_chrome_trace)
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(TOOLS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_span_and_instant_events_valid_catapult():
+    """Spans/instants render as Catapult JSON that json round-trips,
+    with monotonic ts, matched ph fields (X carries dur, i carries
+    scope), and args preserved."""
+    tr = Tracer(capacity=128)
+    with tr.span("tick", cat="tick", tick=1) as sp:
+        tr.instant("req.queued", cat="request", req=7)
+        with tr.span("decode.dispatch", batch=3):
+            pass
+        sp.args["emitted"] = 3
+    trace = tr.chrome_trace(process_name="test")
+    text = json.dumps(trace)
+    back = json.loads(text)
+    evs = back["traceEvents"]
+    phs = {e["ph"] for e in evs}
+    assert phs <= {"X", "i", "M"}
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert all("dur" in e and e["dur"] >= 0 for e in xs)
+    assert all(e["s"] == "t" for e in evs if e["ph"] == "i")
+    ts = [e["ts"] for e in evs if e["ph"] != "M"]
+    assert ts == sorted(ts)
+    tick = next(e for e in xs if e["name"] == "tick")
+    assert tick["args"] == {"tick": 1, "emitted": 3}
+    # nesting: the dispatch span lies inside the tick span
+    disp = next(e for e in xs if e["name"] == "decode.dispatch")
+    assert tick["ts"] <= disp["ts"]
+    assert disp["ts"] + disp["dur"] <= tick["ts"] + tick["dur"] + 1e-6
+    inst = next(e for e in evs if e["ph"] == "i")
+    assert inst["name"] == "req.queued" and inst["args"]["req"] == 7
+
+
+def test_ring_buffer_bounded_under_sustained_load():
+    """The per-thread ring holds at most ``capacity`` events: sustained
+    load drops the OLDEST — the flight-recorder property."""
+    tr = Tracer(capacity=64)
+    for i in range(1000):
+        with tr.span("s", i=i):
+            pass
+    evs = tr.events()
+    assert len(evs) == 64
+    # the retained window is the most recent one
+    assert [e.args["i"] for e in evs] == list(range(936, 1000))
+    with pytest.raises(ValueError):
+        Tracer(capacity=0)
+
+
+def test_thread_safety_concurrent_spans_and_snapshots():
+    """4 writer threads spin spans while the main thread snapshots and
+    exports continuously: no exception, every thread's ring visible,
+    events bounded per thread."""
+    tr = Tracer(capacity=256)
+    stop = threading.Event()
+    errors = []
+
+    def spin(k):
+        try:
+            while not stop.is_set():
+                with tr.span(f"w{k}"):
+                    tr.instant(f"i{k}")
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=spin, args=(k,))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(100):
+            evs = tr.events()
+            json.dumps(tr.chrome_trace())
+            assert all(evs[i].ts <= evs[i + 1].ts
+                       for i in range(len(evs) - 1))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors
+    tids = {e.tid for e in tr.events()}
+    assert len(tids) == 4
+    per_thread = {}
+    for e in tr.events():
+        per_thread[e.tid] = per_thread.get(e.tid, 0) + 1
+    assert all(n <= 256 for n in per_thread.values())
+    names = tr.thread_names()
+    assert set(names) == tids
+
+
+def test_record_event_decorator_and_disable():
+    """RecordEvent doubles as a decorator; a disabled tracer collects
+    nothing and its span() short-circuits to the shared no-op."""
+    tr = Tracer(capacity=32)
+
+    @RecordEvent("work", tr, cat="host", n=1)
+    def work(x):
+        return x * 2
+
+    assert work(21) == 42
+    assert work(2) == 4
+    evs = tr.events()
+    assert [e.name for e in evs] == ["work", "work"]
+    assert evs[0].args == {"n": 1}
+    tr.enabled = False
+    sp = tr.span("muted")
+    with sp:
+        pass
+    tr.instant("muted.i")
+    assert len(tr.events()) == 2  # nothing new landed
+    tr.enabled = True
+    with tr.span("back"):
+        pass
+    assert [e.name for e in tr.events()][-1] == "back"
+    tr.clear()
+    assert tr.events() == []
+
+
+def test_null_tracer_and_emit():
+    """NullTracer supports the full surface as no-ops; Tracer.emit
+    back-dates an externally timed event (the compile hook's path)."""
+    nt = NullTracer()
+    with nt.span("x") as sp:
+        sp.args["k"] = 1
+    nt.instant("y")
+    nt.emit("z", 0.0, 1.0)
+    assert nt.events() == []
+    assert nt.chrome_trace()["traceEvents"] == []
+    tr = Tracer()
+    tr.emit("compile:decode", 10.0, 2.5, cat="compile",
+            args={"wall_ms": 2500})
+    (ev,) = tr.events()
+    assert ev.ts == 10.0 * 1e6 and ev.dur == 2.5 * 1e6
+    assert ev.cat == "compile"
+
+
+def test_to_chrome_trace_bare_event_list():
+    """Without thread/process names the export has exactly one JSON
+    object per event (the profiler compat contract)."""
+    tr = Tracer()
+    with tr.span("a"):
+        pass
+    trace = to_chrome_trace(tr.events())
+    assert len(trace["traceEvents"]) == 1
+    assert trace["traceEvents"][0]["name"] == "a"
+    assert trace["displayTimeUnit"] == "ms"
+
+
+def test_trace_view_summary_percentiles(tmp_path):
+    """tools/trace_view.py aggregates complete-events per name with
+    count/total/p50/p99 (interpolated), category filter included."""
+    tv = _load_tool("trace_view")
+    events = ([{"name": "tick", "ph": "X", "ts": i * 100.0,
+                "dur": (i + 1) * 1000.0, "cat": "tick"}
+               for i in range(100)] +
+              [{"name": "admit", "ph": "X", "ts": 0.0, "dur": 500.0,
+                "cat": "serving"},
+               {"name": "req.queued", "ph": "i", "ts": 0.0,
+                "cat": "request"}])
+    rows = tv.summarize(events)
+    assert [r["name"] for r in rows] == ["tick", "admit"]  # by total
+    tick = rows[0]
+    assert tick["count"] == 100
+    # durs are 1..100 ms; numpy-linear percentiles over them
+    assert tick["p50_ms"] == pytest.approx(50.5)
+    assert tick["p99_ms"] == pytest.approx(99.01)
+    assert rows[1]["count"] == 1 and rows[1]["p50_ms"] == 0.5
+    assert tv.summarize(events, cat="tick")[0]["name"] == "tick"
+    assert len(tv.summarize(events, cat="tick")) == 1
+    # CLI end to end over a file
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps({"traceEvents": events}))
+    assert tv.main([str(path)]) == 0
+    assert tv.main([str(path), "--cat", "nope"]) == 1
+    table = tv.format_table(rows)
+    assert "tick" in table and "p99(ms)" in table
+
+
+def test_timeline_merge_assigns_pids(tmp_path):
+    """tools/timeline.py merges N traces into one timeline with
+    distinct pids, preserves flight-recorder metadata, and accepts
+    both object-form and bare-list files."""
+    tl = _load_tool("timeline")
+    t1 = {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 999, "tid": 0,
+         "args": {"name": "engine"}},
+        {"name": "tick", "ph": "X", "ts": 0.0, "dur": 5.0,
+         "pid": 999, "tid": 1, "cat": "tick"}],
+        "metadata": {"flight-recorder": {"error": "boom"}}}
+    t2 = [{"name": "step", "ph": "X", "ts": 1.0, "dur": 2.0,
+           "pid": 999, "tid": 1, "cat": "host"}]
+    p1, p2 = tmp_path / "a.json", tmp_path / "b.json"
+    p1.write_text(json.dumps(t1))
+    p2.write_text(json.dumps(t2))
+    out = tmp_path / "merged.json"
+    assert tl.main([str(p1), str(p2), "--out", str(out)]) == 0
+    merged = json.loads(out.read_text())
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert pids == {0, 1}
+    assert merged["metadata"]["flight-recorder"]["error"] == "boom"
+    # the bare-list source got a synthesized process_name row
+    metas = [e for e in merged["traceEvents"]
+             if e["ph"] == "M" and e["pid"] == 1]
+    assert metas and metas[0]["args"]["name"].endswith("b.json")
+
+
+def test_timeline_rejects_non_trace(tmp_path):
+    tl = _load_tool("timeline")
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"nope": 1}))
+    with pytest.raises(ValueError, match="traceEvents"):
+        tl.load_trace(str(bad))
+
+
+def test_dead_thread_lanes_pruned_and_idents_not_recycled():
+    """Lanes are per thread LIFETIME: a new thread never inherits a
+    dead thread's lane/name (even if the OS recycles the ident), dead
+    lanes are retained for post-mortems until max_threads, then pruned
+    oldest-first — live lanes never evicted."""
+    tr = Tracer(capacity=16, max_threads=4)
+    with tr.span("main.keepalive"):
+        pass  # the live main-thread lane that must survive pruning
+
+    def one_span(k):
+        t = threading.Thread(target=lambda: tr.instant(f"w{k}"),
+                             name=f"worker-{k}")
+        t.start()
+        t.join()
+
+    for k in range(10):
+        one_span(k)
+    names = tr.thread_names()
+    assert len(names) <= 4                      # bounded
+    assert "MainThread" in names.values()       # live lane retained
+    # every lane id is unique per thread lifetime: 11 threads emitted,
+    # so the newest lane id outgrew the bound — no reuse happened
+    assert max(names) > 4
+    # the retained worker lanes are the most recent ones
+    worker_names = sorted(v for v in names.values()
+                          if v.startswith("worker-"))
+    assert worker_names == [f"worker-{k}" for k in (7, 8, 9)]
+    # and the main lane still collects
+    with tr.span("main.again"):
+        pass
+    assert any(e.name == "main.again" for e in tr.events())

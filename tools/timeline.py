@@ -1,0 +1,101 @@
+"""Chrome-trace timeline assembler (reference: tools/timeline.py).
+
+The reference converter turned profiler protobufs into a
+chrome://tracing JSON; here the serving tracer already speaks Catapult
+natively, so this tool's job is COLLECTION: fetch traces from live
+engines (``GET /debug/trace``), load flight-recorder dumps or
+``stop_profiler(profile_path=...)`` files, normalize bare event lists,
+and merge any number of them into ONE timeline — each source gets its
+own ``pid`` lane so a multi-engine (or engine + profiler) view lines
+up side by side in chrome://tracing / Perfetto.
+
+Usage:
+    python tools/timeline.py trace1.json http://host:port/debug/trace \
+        [--out timeline.json]
+
+With no ``--out`` the merged trace goes to stdout.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def load_trace(source, timeout=10.0):
+    """Load one trace: an ``http(s)://`` URL (a live engine's
+    ``/debug/trace``) or a file path.  Accepts the Catapult object
+    form ({"traceEvents": [...]}) or a bare event list; returns the
+    object form."""
+    if str(source).startswith(("http://", "https://")):
+        with urllib.request.urlopen(source, timeout=timeout) as resp:
+            data = json.loads(resp.read())
+    else:
+        with open(source) as f:
+            data = json.load(f)
+    if isinstance(data, list):  # bare event list -> object form
+        data = {"traceEvents": data}
+    if "traceEvents" not in data or not isinstance(
+            data["traceEvents"], list):
+        raise ValueError(
+            f"{source}: not a chrome trace (no traceEvents array)")
+    return data
+
+
+def merge_traces(traces, labels=None):
+    """Merge trace objects into one timeline.  Each input is assigned
+    its own ``pid`` (0, 1, ...) — sources may come from different
+    processes whose original pids could collide — and gets a
+    ``process_name`` metadata row from ``labels``.  Non-event keys of
+    the FIRST trace carrying them (``metadata`` — e.g. a flight
+    recorder's error context) are preserved."""
+    out = {"traceEvents": [], "displayTimeUnit": "ms"}
+    for pid, trace in enumerate(traces):
+        label = (labels[pid] if labels and pid < len(labels)
+                 else f"trace{pid}")
+        seen_pname = False
+        for ev in trace["traceEvents"]:
+            ev = dict(ev)
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                seen_pname = True
+            ev["pid"] = pid
+            out["traceEvents"].append(ev)
+        if not seen_pname:
+            out["traceEvents"].insert(
+                len(out["traceEvents"]) - len(trace["traceEvents"]),
+                {"name": "process_name", "ph": "M", "pid": pid,
+                 "tid": 0, "args": {"name": label}})
+        for k, v in trace.items():
+            if k not in ("traceEvents", "displayTimeUnit") \
+                    and k not in out:
+                out[k] = v
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="merge serving traces / flight-recorder dumps / "
+                    "live /debug/trace endpoints into one "
+                    "chrome://tracing timeline")
+    p.add_argument("sources", nargs="+",
+                   help="trace file paths and/or /debug/trace URLs")
+    p.add_argument("--out", default=None,
+                   help="output path (default: stdout)")
+    args = p.parse_args(argv)
+    traces = [load_trace(s) for s in args.sources]
+    merged = merge_traces(traces, labels=[str(s) for s in args.sources])
+    text = json.dumps(merged)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        n = len(merged["traceEvents"])
+        print(f"wrote {args.out}: {n} events from "
+              f"{len(traces)} trace(s)", file=sys.stderr)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,105 @@
+"""Terminal trace inspector: per-phase time table from a chrome trace.
+
+chrome://tracing is the full viewer, but most "where did tick N's 11 ms
+go" questions only need aggregates — this prints, per span name, the
+count / total / mean / p50 / p99 duration over every complete-event in
+a trace file (a ``/debug/trace`` download, a flight-recorder dump, or
+a ``stop_profiler(profile_path=...)`` export), so traces are
+inspectable over ssh with nothing but Python.
+
+Usage:
+    python tools/trace_view.py trace.json [--cat serving] [--sort total]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _percentile(sorted_vals, q):
+    """Nearest-rank-with-interpolation percentile over a SORTED list
+    (numpy 'linear' semantics — no numpy dependency here: the tool
+    must run anywhere a trace file lands)."""
+    if not sorted_vals:
+        return float("nan")
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    rank = (q / 100.0) * (len(sorted_vals) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = rank - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def summarize(events, cat=None):
+    """Aggregate complete-events (``ph == "X"``) by name.  Returns rows
+    of dicts: name, count, total_ms, mean_ms, p50_ms, p99_ms — sorted
+    by total descending."""
+    groups = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        if cat is not None and ev.get("cat") != cat:
+            continue
+        groups.setdefault(ev["name"], []).append(
+            float(ev.get("dur", 0.0)) / 1e3)  # us -> ms
+    rows = []
+    for name, durs in groups.items():
+        durs.sort()
+        rows.append({
+            "name": name, "count": len(durs),
+            "total_ms": sum(durs),
+            "mean_ms": sum(durs) / len(durs),
+            "p50_ms": _percentile(durs, 50),
+            "p99_ms": _percentile(durs, 99),
+        })
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows
+
+
+def format_table(rows):
+    lines = [f"{'span':<28} {'count':>7} {'total(ms)':>11} "
+             f"{'mean(ms)':>10} {'p50(ms)':>10} {'p99(ms)':>10}"]
+    for r in rows:
+        lines.append(
+            f"{r['name']:<28} {r['count']:>7} {r['total_ms']:>11.3f} "
+            f"{r['mean_ms']:>10.3f} {r['p50_ms']:>10.3f} "
+            f"{r['p99_ms']:>10.3f}")
+    return "\n".join(lines)
+
+
+def load_events(path):
+    """Events from a trace file: Catapult object form or bare list."""
+    with open(path) as f:
+        data = json.load(f)
+    events = data["traceEvents"] if isinstance(data, dict) else data
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a chrome trace")
+    return events
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="per-span-name time table for a chrome trace file")
+    p.add_argument("trace", help="trace JSON (object or bare list form)")
+    p.add_argument("--cat", default=None,
+                   help="only spans of this category (e.g. serving, "
+                        "tick, compile, host)")
+    p.add_argument("--sort", default="total",
+                   choices=("total", "count", "mean", "p50", "p99"),
+                   help="sort column (descending; default total)")
+    args = p.parse_args(argv)
+    rows = summarize(load_events(args.trace), cat=args.cat)
+    key = {"total": "total_ms", "count": "count", "mean": "mean_ms",
+           "p50": "p50_ms", "p99": "p99_ms"}[args.sort]
+    rows.sort(key=lambda r: -r[key])
+    if not rows:
+        print("no complete-events matched", file=sys.stderr)
+        return 1
+    print(format_table(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
