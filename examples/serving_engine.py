@@ -334,6 +334,48 @@ def main():
           f"{n_compiles} (serving.compiles_total — nonzero growth in "
           f"steady state means the program cache is thrashing)")
 
+    # -- async engine loop: overlap host scheduling with device
+    # compute.  The default engine (async_depth=2) dispatches tick
+    # N+1's fused decode BEFORE consuming tick N's ids — safe because
+    # the stop condition (EOS / max_new) is checked on device, which
+    # freezes finished lanes and sends back a bit-packed done mask —
+    # so admission planning and the emit loop hide behind device
+    # compute.  serving.tick_overlap_ms is the host time hidden per
+    # tick; serving.d2h_wait_ms is the only remaining sync point.
+    def timed_async(depth):
+        reg = monitor.StatRegistry()
+        eng = Engine(model, num_slots=4, registry=reg,
+                     async_depth=depth)
+        for p in prompts:                      # warm the compiles
+            eng.submit(p, max_new_tokens=2)
+        eng.run_until_idle()
+        t0 = time.perf_counter()
+        rs = [eng.submit(p, max_new_tokens=16) for p in prompts]
+        eng.run_until_idle()
+        dt = time.perf_counter() - t0
+        outs = [r.result(timeout=120)[len(p):].tolist()
+                for r, p in zip(rs, prompts)]
+        return len(prompts) * 16 / dt, reg, outs
+
+    tps1, _, outs1 = timed_async(1)
+    tps2, reg2, outs2 = timed_async(2)
+    assert outs2 == outs1, "async greedy streams must match sync"
+    ov = reg2.get("serving.tick_overlap_ms")
+    dw = reg2.get("serving.d2h_wait_ms")
+    print(f"\nasync engine loop (async_depth=2, the default):")
+    print(f"  aggregate tok/s: synchronous {tps1:.0f} vs pipelined "
+          f"{tps2:.0f} ({tps2 / tps1:.2f}x), greedy streams "
+          f"token-identical")
+    print(f"  host work hidden behind device compute: "
+          f"{ov.mean():.3f} ms/tick (serving.tick_overlap_ms), "
+          f"blocking d2h wait {dw.mean():.3f} ms/tick "
+          f"(serving.d2h_wait_ms)")
+    print(f"  steady-state download per tick: "
+          f"{int(reg2.get('serving.d2h_bytes_per_tick').value)} "
+          f"bytes ([B] ids + the bit-packed done mask)")
+    print(f"  summarize overlap from a trace with: "
+          f"python tools/trace_view.py {trace_path} --wall")
+
 
 if __name__ == "__main__":
     main()

@@ -983,7 +983,7 @@ class GPTModel(nn.Layer):
 
     def _fused_decode_tick_slots(self, tok, k_bufs, v_bufs, pos, temp,
                                  top_k, top_p, seed_lo, seed_hi, ctr,
-                                 block_tables=None):
+                                 eos, rem, block_tables=None):
         """FUSED one-token decode + ON-DEVICE sampling over the slot
         pool: run the decode tick, then sample every lane in the same
         dispatch (``_sample_lanes`` with per-slot params and
@@ -992,11 +992,23 @@ class GPTModel(nn.Layer):
         downloads only the [B] sampled ids instead of the [B, V]
         logits matrix.  ``temperature == 0`` lanes are greedy (raw
         argmax, bit-identical to the host path on the same logits).
-        Parked rows advance too (their sample is garbage the next
-        admission overwrites); the position clamp keeps their drifting
-        cursor writing in-bounds rows that prefill rewrites wholesale.
-        Returns (ids [B], new_tok [B,1], new_pos [B], new_ctr [B],
-        new_k, new_v)."""
+
+        DEVICE-SIDE STOP CONDITION (the async engine loop's safety
+        contract): ``eos`` [B] int32 (-1 = none) and ``rem`` [B] int32
+        (remaining token budget) are per-slot lanes checked ON DEVICE.
+        A lane whose sampled id hits its eos, or whose budget runs
+        out, gets ``rem`` zeroed; a lane with ``rem <= 0`` is FROZEN —
+        token, position, and rng counter stop advancing, so a tick
+        dispatched BEFORE the host has consumed the previous tick's
+        ids can never run a finished request past its reserved rows.
+        The frozen state is summarized in the returned bit-packed done
+        mask ([ceil(B/8)] uint8), so the host learns who finished from
+        a few bytes instead of an early sync.  Frozen/parked rows
+        still compute (their K/V write parks on the frozen cursor row
+        — the slot's own reserved row, or the paged scratch block —
+        and is rewritten before any query can see it).
+        Returns (ids [B], done [ceil(B/8)] uint8, new_tok [B,1],
+        new_pos [B], new_ctr [B], new_rem [B], new_k, new_v)."""
         import jax.numpy as jnp
         if block_tables is None:
             last, new_k, new_v = self._decode_tick_slots(
@@ -1007,13 +1019,20 @@ class GPTModel(nn.Layer):
                 tok, k_bufs, v_bufs, block_tables, pos)
             L = block_tables.shape[1] * k_bufs[0].shape[1]
         keys = self._slot_sample_keys(seed_lo, seed_hi, ctr)
-        ids = self._sample_lanes(last, temp, top_k, top_p, keys)
-        new_pos = jnp.minimum(pos + 1, L - 1)
-        return ids, ids[:, None], new_pos, ctr + 1, new_k, new_v
+        sampled = self._sample_lanes(last, temp, top_k, top_p, keys)
+        live = rem > 0
+        ids = jnp.where(live, sampled, tok[:, 0])
+        hit_eos = live & (eos >= 0) & (ids == eos)
+        new_rem = jnp.where(live, jnp.where(hit_eos, 0, rem - 1), rem)
+        done = jnp.packbits((new_rem <= 0).astype(jnp.uint8))
+        new_pos = jnp.where(live, jnp.minimum(pos + 1, L - 1), pos)
+        new_ctr = jnp.where(live, ctr + 1, ctr)
+        return (ids, done, ids[:, None], new_pos, new_ctr, new_rem,
+                new_k, new_v)
 
     def _fused_spec_verify_tick_slots(self, toks, k_bufs, v_bufs, pos,
                                       lanes, temp, top_k, top_p,
-                                      seed_lo, seed_hi, ctr,
+                                      seed_lo, seed_hi, ctr, eos, rem,
                                       block_tables=None):
         """FUSED speculative verify + ON-DEVICE acceptance: score the
         W = k+1 window positions, pick every lane's token on device
@@ -1022,12 +1041,18 @@ class GPTModel(nn.Layer):
         prefix), and count the accepted prefix — the leading run of
         REAL draft lanes (j < lanes[b]) whose draft equals the pick —
         so acceptance no longer needs the [B, W, V] logits pull; the
-        tick downloads picks [B, W] + n_acc [B] only.  The device
-        cursor advances by the n_acc+1 emitted tokens; a request the
-        host finishes mid-window (EOS / max_new) is evicted, which
-        dirties the engine's state mirror and re-uploads corrected
-        cursors before the next tick.  Returns (picks [B, W], n_acc
-        [B], new_tok [B,1], new_pos [B], new_ctr [B], new_k, new_v)."""
+        tick downloads picks [B, W] + counts + the done mask only.
+
+        DEVICE-SIDE STOP CONDITION: ``eos``/``rem`` lanes clamp the
+        emitted window on device — ``n_emit = min(n_acc + 1, rem,
+        lanes-through-the-first-eos-pick)`` — exactly the host emit
+        loop's stopping rule (mismatch, budget exhausted, or EOS
+        emitted), so the device cursor advances by n_emit, a lane
+        whose budget hits zero (or that emits its eos) freezes, and a
+        blind-dispatched next window can never run a finished request
+        past its reserved rows.  Returns (picks [B, W], n_acc [B],
+        n_emit [B], done [ceil(B/8)] uint8, new_tok [B,1], new_pos
+        [B], new_ctr [B], new_rem [B], new_k, new_v)."""
         import jax.numpy as jnp
         if block_tables is None:
             logits, new_k, new_v = self._spec_verify_tick_slots(
@@ -1049,10 +1074,25 @@ class GPTModel(nn.Layer):
         # match (the appended sentinel catches the all-matched row)
         n_acc = jnp.argmin(jnp.concatenate(
             [match, jnp.zeros((B, 1), bool)], axis=1), axis=1)
-        adv = n_acc + 1
-        new_tok = jnp.take_along_axis(picks, n_acc[:, None], axis=1)
-        new_pos = jnp.minimum(pos + adv, L - W)
-        return picks, n_acc, new_tok, new_pos, ctr + adv, new_k, new_v
+        live = rem > 0
+        hit_eos = (eos[:, None] >= 0) & (picks == eos[:, None])
+        # 1-based lane index of the first eos pick (W + 1 = no stop)
+        eos_stop = jnp.where(jnp.any(hit_eos, axis=1),
+                             jnp.argmax(hit_eos, axis=1) + 1, W + 1)
+        n_emit = jnp.where(
+            live, jnp.minimum(jnp.minimum(n_acc + 1, rem), eos_stop),
+            0).astype(jnp.int32)
+        last_idx = jnp.maximum(n_emit - 1, 0)
+        new_tok = jnp.where(
+            live[:, None],
+            jnp.take_along_axis(picks, last_idx[:, None], axis=1),
+            toks[:, :1])
+        new_rem = jnp.where(
+            live, jnp.where(n_emit == eos_stop, 0, rem - n_emit), rem)
+        done = jnp.packbits((new_rem <= 0).astype(jnp.uint8))
+        new_pos = jnp.where(live, jnp.minimum(pos + n_emit, L - W), pos)
+        return (picks, n_acc, n_emit, done, new_tok, new_pos,
+                ctr + n_emit, new_rem, new_k, new_v)
 
     # -- compile-event hook (serving observability) --------------------
     def add_compile_listener(self, cb):
@@ -1125,11 +1165,14 @@ class GPTModel(nn.Layer):
         """Build (or fetch) the jitted FUSED decode+sample tick for
         ``Engine(sample_mode="device")``: contiguous layout (p_list,
         b_list, k_pools, v_pools, tok [B,1], pos [B], temp [B],
-        top_k [B], top_p [B], seed_lo [B], seed_hi [B], ctr [B]) or
-        paged layout (+ block_tables [B, L//bs] before tok) ->
-        (ids [B], new_tok [B,1], new_pos [B], new_ctr [B], k_pools,
+        top_k [B], top_p [B], seed_lo [B], seed_hi [B], ctr [B],
+        eos [B], rem [B]) or paged layout (+ block_tables [B, L//bs]
+        before tok) -> (ids [B], done [ceil(B/8)] uint8, new_tok
+        [B,1], new_pos [B], new_ctr [B], new_rem [B], k_pools,
         v_pools).  The whole per-tick hot state (current token,
-        position, rng counter) is both input and output, so the engine
+        position, rng counter, remaining budget) is both input and
+        output, and the stop condition (EOS / max_new) is checked on
+        device against the eos/rem lanes, so the engine
         keeps the returned device handles and a steady-state tick
         performs ZERO uploads and ONE [B]-int download — the host
         round-trip that used to bound decode is gone.  ONE XLA program
@@ -1152,24 +1195,24 @@ class GPTModel(nn.Layer):
         if paged:
             def pure(p_list, b_list, k_pools, v_pools, block_tables,
                      tok, pos, temp, top_k, top_p, seed_lo, seed_hi,
-                     ctr):
+                     ctr, eos, rem):
                 with _swapped(params, dict(zip(pnames, p_list))), \
                         _swapped(mbuffers, dict(zip(bnames, b_list))):
                     with autograd.no_grad():
                         out = model._fused_decode_tick_slots(
                             tok, k_pools, v_pools, pos, temp, top_k,
-                            top_p, seed_lo, seed_hi, ctr,
+                            top_p, seed_lo, seed_hi, ctr, eos, rem,
                             block_tables=block_tables)
                 return out
         else:
             def pure(p_list, b_list, k_pools, v_pools, tok, pos, temp,
-                     top_k, top_p, seed_lo, seed_hi, ctr):
+                     top_k, top_p, seed_lo, seed_hi, ctr, eos, rem):
                 with _swapped(params, dict(zip(pnames, p_list))), \
                         _swapped(mbuffers, dict(zip(bnames, b_list))):
                     with autograd.no_grad():
                         out = model._fused_decode_tick_slots(
                             tok, k_pools, v_pools, pos, temp, top_k,
-                            top_p, seed_lo, seed_hi, ctr)
+                            top_p, seed_lo, seed_hi, ctr, eos, rem)
                 return out
 
         fn = jax.jit(pure, donate_argnums=(2, 3))
@@ -1185,10 +1228,12 @@ class GPTModel(nn.Layer):
         on-device sample/accept dispatch (``Engine(spec_k=...,
         sample_mode="device")``): contiguous layout (p_list, b_list,
         k_pools, v_pools, toks [B, W], lanes [B], pos [B], temp [B],
-        top_k [B], top_p [B], seed_lo [B], seed_hi [B], ctr [B]) or
-        paged layout (+ block_tables before toks) -> (picks [B, W],
-        n_acc [B], new_tok [B,1], new_pos [B], new_ctr [B], k_pools,
-        v_pools).  ONE XLA program per (window, layout) exactly like
+        top_k [B], top_p [B], seed_lo [B], seed_hi [B], ctr [B],
+        eos [B], rem [B]) or paged layout (+ block_tables before
+        toks) -> (picks [B, W], n_acc [B], n_emit [B], done
+        [ceil(B/8)] uint8, new_tok [B,1], new_pos [B], new_ctr [B],
+        new_rem [B], k_pools, v_pools).  ONE XLA program per
+        (window, layout) exactly like
         ``_compiled_spec_verify_fn`` — the draft window still uploads
         (drafts come from the host proposer) but the [B, W, V] logits
         download is replaced by picks + accept counts.  Pools
@@ -1210,24 +1255,26 @@ class GPTModel(nn.Layer):
         if paged:
             def pure(p_list, b_list, k_pools, v_pools, block_tables,
                      toks, lanes, pos, temp, top_k, top_p, seed_lo,
-                     seed_hi, ctr):
+                     seed_hi, ctr, eos, rem):
                 with _swapped(params, dict(zip(pnames, p_list))), \
                         _swapped(mbuffers, dict(zip(bnames, b_list))):
                     with autograd.no_grad():
                         out = model._fused_spec_verify_tick_slots(
                             toks, k_pools, v_pools, pos, lanes, temp,
-                            top_k, top_p, seed_lo, seed_hi, ctr,
-                            block_tables=block_tables)
+                            top_k, top_p, seed_lo, seed_hi, ctr, eos,
+                            rem, block_tables=block_tables)
                 return out
         else:
             def pure(p_list, b_list, k_pools, v_pools, toks, lanes,
-                     pos, temp, top_k, top_p, seed_lo, seed_hi, ctr):
+                     pos, temp, top_k, top_p, seed_lo, seed_hi, ctr,
+                     eos, rem):
                 with _swapped(params, dict(zip(pnames, p_list))), \
                         _swapped(mbuffers, dict(zip(bnames, b_list))):
                     with autograd.no_grad():
                         out = model._fused_spec_verify_tick_slots(
                             toks, k_pools, v_pools, pos, lanes, temp,
-                            top_k, top_p, seed_lo, seed_hi, ctr)
+                            top_k, top_p, seed_lo, seed_hi, ctr, eos,
+                            rem)
                 return out
 
         fn = jax.jit(pure, donate_argnums=(2, 3))

@@ -35,6 +35,7 @@ import os
 import threading
 import time
 import weakref
+from contextlib import nullcontext
 
 import numpy as np
 
@@ -68,6 +69,51 @@ def _filter_logits_np(row, temperature, top_k, top_p):
         cutoff = srt[keep].min()
         row = np.where(row < cutoff, -1e9, row)
     return row
+
+
+class _InflightTick:
+    """One dispatched-but-not-consumed decode tick (the async engine
+    loop's pipeline entry).  Holds the device handles of the arrays
+    the consume side will materialize (ids/done — spec: picks/counts),
+    the slot->request bindings AS OF DISPATCH TIME (a slot may be
+    evicted and even re-admitted before this tick is consumed; the
+    identity check ``slot.request is req`` is what keeps a frozen
+    lane's garbage out of a newer request's stream), and a small host
+    snapshot of the cursor buffer the dispatch chained from — the
+    flight recorder's view of the in-flight ring."""
+
+    __slots__ = ("tick", "kind", "slots", "reqs", "arrays", "batch",
+                 "layout", "dispatched_at", "cursors", "spec_lanes")
+
+    def __init__(self, tick, kind, slots, arrays, batch, layout,
+                 cursors, spec_lanes=None):
+        self.tick = tick
+        self.kind = kind              # "decode" | "spec"
+        self.slots = slots
+        self.reqs = [s.request for s in slots]
+        self.arrays = arrays          # name -> un-materialized device
+        self.batch = batch            # handle (jax async dispatch)
+        self.layout = layout
+        self.dispatched_at = time.monotonic()
+        self.cursors = cursors        # host view of the chained-from
+        #   state buffer (flight recorder)
+        self.spec_lanes = spec_lanes  # per-slot REAL draft lanes as
+        #   of dispatch (consume must not re-read the slot: it may
+        #   have been rebound by then)
+
+    def meta(self):
+        """JSON-able metadata for the flight recorder / debug
+        surface (never materializes the device arrays — a dump must
+        not block on, or mask, a wedged dispatch)."""
+        return {
+            "tick": self.tick, "kind": self.kind, "batch": self.batch,
+            "layout": self.layout,
+            "slots": [s.index for s in self.slots],
+            "requests": [r.id for r in self.reqs],
+            "in_flight_ms": round(
+                (time.monotonic() - self.dispatched_at) * 1e3, 3),
+            "cursors": self.cursors,
+        }
 
 
 class Engine:
@@ -185,6 +231,36 @@ class Engine:
         numpy per-slot sampling (``_pick``).  Watch
         ``serving.d2h_bytes_per_tick`` / ``serving.sample_ms`` /
         ``serving.fused_sample_ticks``.
+    async_depth : ASYNC ENGINE LOOP pipeline depth.  ``None`` (the
+        default) resolves to 2 in device sample mode and 1 in host
+        mode.  At depth 2 a tick DISPATCHES tick N+1's fused decode
+        BEFORE consuming tick N's ids (jax async dispatch: the
+        returned handles are futures; the only blocking sync is the
+        consume-side ``np.asarray``, traced as ``decode.d2h_wait``),
+        so admission planning and the previous tick's emit/metrics
+        loop run in the gap while the device computes — on real
+        hardware the inter-tick gap is pure host time, and this
+        overlap is what lets kernel-side wins show up as tokens/sec.
+        Blind dispatch is safe because the stop condition (EOS /
+        max_new) moved ON DEVICE: per-slot eos/remaining-budget lanes
+        freeze a finished row inside the dispatch, and a bit-packed
+        done mask rides back with the ids, so a steady-state tick
+        downloads ids + done-mask bytes and never forces an early
+        sync.  The device cursor state is double-buffered: the
+        in-flight tick holds the buffer it chained from while
+        ``_dev_state`` tracks the newest handles; admissions /
+        evictions / chunks dirty only the HOST mirrors (the next
+        buffer), and a dirty event drains the pipeline before the
+        mirrors are re-uploaded — recovery and parity semantics are
+        unchanged, and greedy streams are token-identical to
+        ``async_depth=1`` (which keeps today's synchronous tick
+        bit-for-bit).  Speculative mode consumes before drafting
+        (draft windows are data-dependent on the previous window's
+        accepted tokens), so its overlap is limited to planning.
+        Requires ``sample_mode="device"`` for depth > 1 — the host
+        sampling path needs the logits on the host every tick, so
+        there is no gap to overlap.  Watch ``serving.tick_overlap_ms``
+        / ``serving.d2h_wait_ms`` and the ``host.overlap`` spans.
     tracing : keep a per-engine span tracer (monitor/tracing.py) fed
         by every tick: admission / prefill / chunk / decode-dispatch /
         d2h-sync / sample / emit complete-events with args (batch
@@ -222,7 +298,7 @@ class Engine:
                  kv_block_size=None, kv_blocks=None, prefix_cache=True,
                  prefill_chunk=None, tick_token_budget=None,
                  spec_k=None, proposer=None, sample_mode="device",
-                 tracing=True, trace_capacity=16384,
+                 async_depth=None, tracing=True, trace_capacity=16384,
                  trace_annotations=False, flight_dir=None):
         if getattr(model, "scan_layers", False):
             model = model._sync_decode_twin()
@@ -335,6 +411,19 @@ class Engine:
                 f"sample_mode must be 'device' or 'host', got "
                 f"{sample_mode!r}")
         self.sample_mode = sample_mode
+        if async_depth is None:
+            async_depth = 2 if sample_mode == "device" else 1
+        async_depth = int(async_depth)
+        if async_depth < 1:
+            raise ValueError(
+                f"async_depth must be >= 1, got {async_depth}")
+        if async_depth > 1 and sample_mode != "device":
+            raise ValueError(
+                "async_depth > 1 requires sample_mode='device': the "
+                "host sampling path downloads the logits and samples "
+                "on the host every tick, so there is no device-compute "
+                "gap to overlap")
+        self.async_depth = async_depth
         self._paged = kv_block_size is not None
         if self._paged:
             bsz = int(kv_block_size)
@@ -465,6 +554,23 @@ class Engine:
         self._m_fused_ticks = reg.counter(
             "serving.fused_sample_ticks", "decode dispatches that "
             "sampled on device (sample_mode='device')")
+        # async-loop surface (registered always; overlap stays empty
+        # and async_depth reads 1 when the loop is synchronous)
+        self._m_async_depth = reg.gauge(
+            "serving.async_depth", "engine pipeline depth (1 = "
+            "synchronous tick, 2 = tick N+1 dispatched before tick N "
+            "is consumed)")
+        self._m_async_depth.set(self.async_depth)
+        self._m_overlap = reg.histogram(
+            "serving.tick_overlap_ms", "host work (admission planning "
+            "+ previous tick's emit/metrics) done per tick WHILE a "
+            "decode dispatch was in flight — the scheduling time the "
+            "async loop hides behind device compute (ms)")
+        self._m_d2h_wait = reg.histogram(
+            "serving.d2h_wait_ms", "blocking wait materializing a "
+            "dispatched tick's ids + done mask (ms) — the only sync "
+            "point of the async loop; near-zero means the host fully "
+            "hid its work behind device compute")
         # compile-event surface: every NEW jitted program of this
         # engine's model (any trigger — this engine, a sibling engine,
         # generate()) bumps the counter and lands in the trace; a
@@ -508,6 +614,10 @@ class Engine:
         self._b_arrays = None   # (see refresh_params)
         self._thread = None
         self._stop = threading.Event()
+        self._wake = threading.Event()  # event-driven loop wake:
+        #   submit() sets it, so an idle engine blocks instead of
+        #   polling and admission latency stops paying poll jitter
+        self._overlap_acc = 0.0  # per-tick overlapped-host-work clock
         self._drain_on_exit = None  # set to a loop's stop event when
         #                             that loop must drain on exit
 
@@ -555,8 +665,17 @@ class Engine:
         self._seed_lo = np.zeros(self.num_slots, np.uint32)
         self._seed_hi = np.zeros(self.num_slots, np.uint32)
         self._sctr = np.zeros(self.num_slots, np.int32)
+        # device-side stop-condition lanes: per-slot eos id (-1 =
+        # none) and remaining token budget — a lane whose budget hits
+        # zero freezes inside the dispatch, which is what makes
+        # dispatching tick N+1 before consuming tick N safe
+        self._eos = np.full(self.num_slots, -1, np.int32)
+        self._rem = np.zeros(self.num_slots, np.int32)
         self._dev_state = None   # device handles of the step state
         self._state_dirty = True  # device copies stale vs the mirrors
+        self._ring = []  # dispatched-but-unconsumed ticks, oldest
+        #   first (async_depth > 1); recovery and shutdown clear it —
+        #   the dropped handles die with the rebuilt pools
 
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, eos_token_id=None,
@@ -612,6 +731,8 @@ class Engine:
             raise
         self._m_reqs.inc()
         self._m_queue.set(self.queue.depth())
+        self._wake.set()  # event-driven wake: an idle loop admits
+        #   this request now, not up to a poll interval later
         return req
 
     # ------------------------------------------------------------------
@@ -700,8 +821,17 @@ class Engine:
         while the engine decodes (one locked scheduler pass; the
         request fields it reads are single-writer ints)."""
         now = time.monotonic()
+        # which un-consumed dispatch does each slot's DEVICE cursor
+        # belong to?  (the newest in-flight tick containing the slot;
+        # None = the host-consumed view is current)
+        ring = list(self._ring)
+        cursor_tick = {}
+        for inf in ring:  # oldest -> newest, so the newest wins
+            for s in inf.slots:
+                cursor_tick[s.index] = inf.tick
         slots = []
         for view in self.scheduler.debug_view():
+            view["cursor_tick"] = cursor_tick.get(view["slot"])
             req = view.pop("request")
             if req is not None:
                 view["request_id"] = req.id
@@ -724,6 +854,7 @@ class Engine:
         } for r in self.queue.pending()]
         return {
             "tick": self.tick_no, "slots": slots, "queue": queued,
+            "in_flight_ticks": [inf.tick for inf in ring],
             "engine": {
                 "num_slots": self.num_slots,
                 "max_seq_len": self.max_seq_len,
@@ -731,6 +862,7 @@ class Engine:
                 "prefill_chunk": self._chunk,
                 "spec_k": self._spec_k,
                 "sample_mode": self.sample_mode,
+                "async_depth": self.async_depth,
                 "tracing": bool(self.tracer.enabled),
             }}
 
@@ -749,6 +881,25 @@ class Engine:
                     "tick": self.tick_no,
                     "dumped_at_unix": round(time.time(), 3),
                     "requests": self.debug_requests(),
+                    # async pipeline state at the failure: BOTH cursor
+                    # buffers — the host mirrors (the "next" buffer
+                    # admissions/evictions dirty) and, per un-consumed
+                    # in-flight tick, the buffer its dispatch chained
+                    # from — plus the futures' metadata, all captured
+                    # BEFORE recovery evicts and rebuilds
+                    "async": {
+                        "async_depth": self.async_depth,
+                        "state_dirty": bool(self._state_dirty),
+                        "in_flight": [inf.meta()
+                                      for inf in list(self._ring)],
+                        "next_buffer": {
+                            "pos": self._pos.tolist(),
+                            "cur_tok": self._cur_tok[:, 0].tolist(),
+                            "rem": self._rem.tolist(),
+                            "eos": self._eos.tolist(),
+                            "ctr": self._sctr.tolist(),
+                        },
+                    },
                 }}
             self.last_flight = trace
             if self._flight_dir:
@@ -838,21 +989,37 @@ class Engine:
         counter restarts at 0 — so two engines given the same seed
         emit the same sampled tokens.  Dirtying the mirrors makes the
         next device-mode tick re-upload them (host mode ships state
-        every tick anyway and ignores the lanes)."""
+        every tick anyway and ignores the lanes).
+
+        A GREEDY request's lane binds CONSTANT zero seed words, not
+        its id-derived default seed: its draw is discarded (argmax),
+        but under the rbg PRNG — this repo's TPU-native default — a
+        vmapped categorical's bits depend on the WHOLE key batch, so
+        an unstable junk key (request ids are a process-global
+        counter) would perturb the *seeded neighbors'* streams and
+        break their reproduce-across-restarts contract whenever a
+        greedy request shared the batch."""
         req = slot.request
         i = slot.index
         if req.do_sample:
             self._temp[i] = req.temperature
             self._topk[i] = req.top_k
             self._topp[i] = req.top_p
+            lo, hi = req.seed_words()
         else:
             self._temp[i] = 0.0
             self._topk[i] = 0
             self._topp[i] = 1.0
-        lo, hi = req.seed_words()
+            lo, hi = 0, 0
         self._seed_lo[i] = lo
         self._seed_hi[i] = hi
         self._sctr[i] = 0
+        # device-side stop-condition lanes: the dispatch itself checks
+        # EOS / max_new against these, so a blind-dispatched tick can
+        # never advance a finished request
+        self._eos[i] = (-1 if req.eos_token_id is None
+                        else int(req.eos_token_id))
+        self._rem[i] = req.max_new_tokens
         self._state_dirty = True
 
     def _park_state(self, i):
@@ -870,20 +1037,28 @@ class Engine:
         self._seed_lo[i] = 0
         self._seed_hi[i] = 0
         self._sctr[i] = 0
+        self._eos[i] = -1
+        self._rem[i] = 0  # rem 0 = the device freezes this lane
         self._state_dirty = True
 
     def _push_state(self):
         """Upload the state mirrors as the device-resident step state
         (device mode): runs only when an admission / eviction / chunk
         dirtied them — a steady-state tick reuses the handles the last
-        dispatch returned and uploads NOTHING."""
+        dispatch returned and uploads NOTHING.  The pipeline must be
+        drained first: the mirrors only reflect CONSUMED ticks, so
+        uploading them under an un-consumed dispatch would rewind
+        every other slot's device cursor by a tick."""
+        assert not self._ring, \
+            "_push_state with ticks in flight — drain the ring first"
         import jax.numpy as jnp
         self._dev_state = dict(
             tok=jnp.asarray(self._cur_tok), pos=jnp.asarray(self._pos),
             ctr=jnp.asarray(self._sctr), temp=jnp.asarray(self._temp),
             topk=jnp.asarray(self._topk), topp=jnp.asarray(self._topp),
             slo=jnp.asarray(self._seed_lo),
-            shi=jnp.asarray(self._seed_hi))
+            shi=jnp.asarray(self._seed_hi),
+            eos=jnp.asarray(self._eos), rem=jnp.asarray(self._rem))
         if self._paged:
             self._dev_state["tables"] = jnp.asarray(self._block_tables)
         self._state_dirty = False
@@ -1179,6 +1354,10 @@ class Engine:
         self._cur_tok[i, 0] = int(tok)
         self._pos[i] = slot.pos
         self._sctr[i] = len(req.generated)  # rng fold counter mirror
+        self._rem[i] = req.max_new_tokens - len(req.generated)
+        #   remaining-budget mirror: tracks the device lane exactly
+        #   (both decrement once per emitted token), so steady state
+        #   needs no re-upload
 
     def _draft_window(self, active):
         """Gather the speculative verify window: [num_slots, W] tokens
@@ -1322,20 +1501,18 @@ class Engine:
         self._m_spec_tpt.set(emitted / len(active))
         return emitted
 
-    def _fused_spec_tick(self, active):
-        """Speculative draft-and-verify with ON-DEVICE sampling and
-        acceptance (sample_mode="device"): the verify dispatch also
-        picks every window lane's token (greedy or seeded sample) and
-        counts the accepted prefix, so the tick uploads the [B, W]
-        draft window (the proposer is host-side) and downloads only
-        picks [B, W] + accept counts [B] — never the [B, W, V] logits.
-        The emit loop consumes exactly the device-accepted lanes, so
-        the metric accounting matches the host tick's exactly; a
-        mid-window EOS/max_new eviction parks the slot and dirties the
-        state mirrors (the device cursor advanced past what the host
-        consumed)."""
+    def _dispatch_spec(self, active, tr):
+        """DISPATCH one fused speculative draft-and-verify tick
+        without consuming it: the verify dispatch picks every window
+        lane's token on device, counts the accepted prefix, AND
+        applies the device-side stop condition (EOS / remaining
+        budget clamp the emitted window and freeze finished lanes),
+        so the un-materialized handles carry picks [B, W] + counts +
+        the packed done mask — never the [B, W, V] logits.  Drafting
+        stays host-side and data-dependent on the PREVIOUS window's
+        accepted tokens, which is why the async loop consumes before
+        drafting in spec mode."""
         import jax.numpy as jnp
-        tr = self.tracer
         W = self._spec_k + 1
         layout = "paged" if self._paged else "contiguous"
         with tr.span("spec.draft", batch=len(active), spec_k=W - 1):
@@ -1362,31 +1539,54 @@ class Engine:
             args.append(st["tables"])
         args += [jnp.asarray(toks), jnp.asarray(lanes), st["pos"],
                  st["temp"], st["topk"], st["topp"], st["slo"],
-                 st["shi"], st["ctr"]]
+                 st["shi"], st["ctr"], st["eos"], st["rem"]]
         with tr.span("decode.dispatch", batch=len(active),
                      layout=layout, spec_w=W, fused=True):
-            (picks, n_acc, new_tok, new_pos, new_ctr, self.k_pools,
-             self.v_pools) = self._fused_spec_fn(*args)
-        st["tok"], st["pos"], st["ctr"] = new_tok, new_pos, new_ctr
-        with tr.span("decode.d2h") as d2h_sp:
-            picks = np.asarray(picks)                 # [B, W] ids
-            n_acc = np.asarray(n_acc)                 # [B] accepted
-            d2h_sp.args["bytes"] = picks.nbytes + n_acc.nbytes
-        self._m_d2h.set(picks.nbytes + n_acc.nbytes)
+            (picks, n_acc, n_emit, done, new_tok, new_pos, new_ctr,
+             new_rem, self.k_pools, self.v_pools) = \
+                self._fused_spec_fn(*args)
+        st["tok"], st["pos"], st["ctr"], st["rem"] = \
+            new_tok, new_pos, new_ctr, new_rem
         self._m_fused_ticks.inc()
         self._m_spec_windows.inc(len(active))
+        return _InflightTick(
+            self.tick_no, "spec", list(active),
+            {"picks": picks, "n_acc": n_acc, "n_emit": n_emit,
+             "done": done}, len(active), layout,
+            {"pos": self._pos.tolist(), "rem": self._rem.tolist()},
+            spec_lanes=[slot.spec_lanes for slot in active])
+
+    def _consume_spec(self, inf, mats, done, tr):
+        """Emit a materialized speculative tick: consume exactly the
+        device-accepted lanes per slot (plus the bonus token), with
+        the same acceptance accounting as the host verify loop.  The
+        device-computed emitted-window length (``n_emit``) must match
+        what the host loop consumed — a mismatch means the on-device
+        stop condition diverged from ``_emit`` and raises into the
+        step-failure recovery path."""
+        picks = mats["picks"]
+        n_acc = mats["n_acc"]
+        n_emit_dev = mats["n_emit"]
         emitted = 0
         total_acc = 0
         # `with`, not manual enter/exit: an _emit failure mid-loop must
         # still record the span for the flight-recorder dump
-        with tr.span("decode.emit", batch=len(active),
-                     layout=layout) as emit_sp:
-            for slot in active:
+        with tr.span("decode.emit", batch=inf.batch,
+                     layout=inf.layout) as emit_sp:
+            for slot, req, lanes_i in zip(inf.slots, inf.reqs,
+                                          inf.spec_lanes):
                 i = slot.index
-                self._m_spec_proposed.inc(slot.spec_lanes)
+                if slot.request is not req:
+                    if not done[i]:
+                        raise RuntimeError(
+                            f"async stop-condition drift: slot {i} "
+                            f"was evicted on the host but tick "
+                            f"{inf.tick}'s device lane is not done")
+                    continue
+                self._m_spec_proposed.inc(lanes_i)
                 acc_i = int(n_acc[i])  # device-counted leading matches
                 n_cnt = 0
-                n_emit = 0
+                n_em = 0
                 j = 0
                 while True:
                     # lane j's pick was drawn on device from the same
@@ -1406,28 +1606,48 @@ class Engine:
                     slot.pos += 1
                     self._pos[i] = slot.pos
                     self._emit(slot, tok)
-                    n_emit += 1
+                    n_em += 1
                     if slot.request is None or not matched:
                         break
                     j += 1
                 slot.spec_lanes = 0
+                if n_em != int(n_emit_dev[i]) \
+                        or bool(done[i]) != (slot.request is None):
+                    raise RuntimeError(
+                        f"async stop-condition drift: slot {i} host "
+                        f"emitted {n_em} (finished="
+                        f"{slot.request is None}) vs device n_emit="
+                        f"{int(n_emit_dev[i])} done={bool(done[i])} "
+                        f"at tick {inf.tick}")
                 self._m_spec_accepted.inc(n_cnt)
                 total_acc += n_cnt
-                emitted += n_emit
+                emitted += n_em
             emit_sp.args.update(emitted=emitted, accepted=total_acc)
         proposed = self._m_spec_proposed.value
         if proposed:
             self._m_spec_rate.set(
                 self._m_spec_accepted.value / proposed)
-        self._m_spec_tpt.set(emitted / len(active))
+        self._m_spec_tpt.set(emitted / inf.batch)
         return emitted
 
-    def _fused_decode_tick(self, active):
-        """One fused decode+sample dispatch (sample_mode="device"):
-        the step state lives on device between ticks (uploaded only
-        when admissions/evictions/chunks dirty the mirrors), sampling
-        runs inside the dispatch, and the host downloads exactly [B]
-        int32 ids — the per-tick [B, V] logits pull is gone."""
+    def _fused_spec_tick(self, active):
+        """Synchronous fused speculative tick (async_depth=1 path):
+        dispatch + immediate consume — today's tick shape."""
+        inf = self._dispatch_spec(active, self.tracer)
+        return self._consume(inf, self.tracer)
+
+    def _dispatch_decode(self, active, tr):
+        """DISPATCH one fused decode+sample tick (sample_mode=
+        "device") without consuming it: the step state lives on
+        device between ticks (re-uploaded only when admissions /
+        evictions / chunks dirtied the mirrors — which requires an
+        empty pipeline, see ``_push_state``), sampling AND the stop
+        condition run inside the dispatch, and the returned
+        ``_InflightTick`` holds the un-materialized [B] ids + packed
+        done-mask handles — jax async dispatch means this returns as
+        soon as the program is enqueued, so the host can plan the
+        next tick (or emit the previous one) while the device
+        computes."""
         if self._state_dirty or self._dev_state is None:
             self._push_state()
         st = self._dev_state
@@ -1444,29 +1664,105 @@ class Engine:
         if self._paged:
             args.append(st["tables"])
         args += [st["tok"], st["pos"], st["temp"], st["topk"],
-                 st["topp"], st["slo"], st["shi"], st["ctr"]]
-        tr = self.tracer
+                 st["topp"], st["slo"], st["shi"], st["ctr"],
+                 st["eos"], st["rem"]]
         layout = "paged" if self._paged else "contiguous"
         with tr.span("decode.dispatch", batch=len(active),
                      layout=layout, fused=True):
-            (ids, new_tok, new_pos, new_ctr, self.k_pools,
-             self.v_pools) = self._fused_fn(*args)
-        st["tok"], st["pos"], st["ctr"] = new_tok, new_pos, new_ctr
-        with tr.span("decode.d2h") as d2h_sp:
-            ids = np.asarray(ids)                     # [B] int32
-            d2h_sp.args["bytes"] = ids.nbytes
-        self._m_d2h.set(ids.nbytes)
+            (ids, done, new_tok, new_pos, new_ctr, new_rem,
+             self.k_pools, self.v_pools) = self._fused_fn(*args)
+        st["tok"], st["pos"], st["ctr"], st["rem"] = \
+            new_tok, new_pos, new_ctr, new_rem
         self._m_fused_ticks.inc()
+        return _InflightTick(
+            self.tick_no, "decode", list(active),
+            {"ids": ids, "done": done}, len(active), layout,
+            {"pos": self._pos.tolist(), "rem": self._rem.tolist()})
+
+    def _consume_decode(self, inf, mats, done, tr):
+        """Emit a materialized decode tick's tokens (the consume
+        side: pure host work on already-downloaded arrays, so at
+        async_depth > 1 it runs while the NEXT tick computes).  Lanes
+        whose request was evicted by an earlier tick's consume are
+        skipped via the ``slot.request is req`` identity check — the
+        device froze them (done bit), and the slot may already carry
+        a new request.  Host-vs-device stop-condition drift raises,
+        turning a would-be silent corruption into a recovered step
+        failure."""
+        ids = mats["ids"]
         emitted = 0
-        with tr.span("decode.emit", batch=len(active), layout=layout) \
-                as emit_sp:
-            for slot in active:
+        with tr.span("decode.emit", batch=inf.batch,
+                     layout=inf.layout) as emit_sp:
+            for slot, req in zip(inf.slots, inf.reqs):
+                i = slot.index
+                if slot.request is not req:
+                    if not done[i]:
+                        raise RuntimeError(
+                            f"async stop-condition drift: slot {i} "
+                            f"was evicted on the host but tick "
+                            f"{inf.tick}'s device lane is not done")
+                    continue
                 slot.pos += 1
-                self._pos[slot.index] = slot.pos
-                self._emit(slot, int(ids[slot.index]))
+                self._pos[i] = slot.pos
+                self._emit(slot, int(ids[i]))
                 emitted += 1
+                if bool(done[i]) != (slot.request is None):
+                    raise RuntimeError(
+                        f"async stop-condition drift: slot {i} host "
+                        f"finished={slot.request is None} vs device "
+                        f"done={bool(done[i])} at tick {inf.tick}")
             emit_sp.args["emitted"] = emitted
         return emitted
+
+    def _consume(self, inf, tr):
+        """Materialize and emit one in-flight tick.  The blocking
+        ``np.asarray`` on the ids + done mask is the async loop's ONLY
+        sync point — traced as ``decode.d2h_wait`` (``decode.d2h`` at
+        async_depth=1, today's synchronous name) so the wait is
+        attributed to the download, not smeared into dispatch.  When
+        a newer tick is still in flight, the emit work is wrapped in
+        a ``host.overlap`` span and counted into
+        ``serving.tick_overlap_ms`` — the host time the pipeline hid
+        behind device compute."""
+        wait_name = ("decode.d2h_wait" if self.async_depth > 1
+                     else "decode.d2h")
+        t0 = time.monotonic()
+        with tr.span(wait_name, tick=inf.tick) as d2h_sp:
+            mats = {k: np.asarray(v) for k, v in inf.arrays.items()}
+            nbytes = sum(int(a.nbytes) for a in mats.values())
+            d2h_sp.args["bytes"] = nbytes
+        self._m_d2h_wait.observe((time.monotonic() - t0) * 1e3)
+        self._m_d2h.set(nbytes)
+        done = np.unpackbits(mats["done"],
+                             count=self.num_slots).astype(bool)
+        in_flight = bool(self._ring)
+        t1 = time.monotonic()
+        ov = (tr.span("host.overlap", tick=inf.tick) if in_flight
+              else nullcontext())
+        with ov:
+            if inf.kind == "spec":
+                emitted = self._consume_spec(inf, mats, done, tr)
+            else:
+                emitted = self._consume_decode(inf, mats, done, tr)
+        if in_flight:
+            self._overlap_acc += time.monotonic() - t1
+        return emitted
+
+    def _drain_ring(self, tr):
+        """Consume every in-flight tick, oldest first (the dirty-event
+        barrier: mirrors may only be re-uploaded over an empty
+        pipeline).  Returns tokens emitted."""
+        emitted = 0
+        while self._ring:
+            emitted += self._consume(self._ring.pop(0), tr)
+        return emitted
+
+    def _fused_decode_tick(self, active):
+        """Synchronous fused decode tick (async_depth=1 and the
+        host-driven ``_tick`` path): dispatch + immediate consume —
+        today's tick shape, bit-for-bit."""
+        inf = self._dispatch_decode(active, self.tracer)
+        return self._consume(inf, self.tracer)
 
     def _decode_tick(self, active):
         """One slot-batched decode dispatch; samples and advances every
@@ -1571,7 +1867,130 @@ class Engine:
         self.tick_no += 1
         tr = self.tracer
         with tr.span("tick", cat="tick", tick=self.tick_no) as tick_sp:
-            emitted = self._tick(tr, tick_sp)
+            if self.async_depth > 1:
+                emitted = self._tick_async(tr, tick_sp)
+            else:
+                emitted = self._tick(tr, tick_sp)
+        return emitted
+
+    def _tick_async(self, tr, tick_sp):
+        """One PIPELINED engine tick (async_depth > 1): plan/admit in
+        the gap while the previous tick computes, dispatch tick N+1,
+        then consume tick N's already-materializing ids — so the
+        inter-tick host work (admission, chunk planning, the emit
+        loop) hides behind device compute instead of serializing with
+        it.  Structural events (admission, eviction, chunk) dirty the
+        host mirrors; the pipeline is drained before the mirrors are
+        re-uploaded, so parity with the synchronous tick is exact."""
+        self._overlap_acc = 0.0
+        now = time.monotonic()
+        emitted = 0
+        # -- planning / admission: host work in the gap --------------
+        in_flight = bool(self._ring)
+        t_plan = time.monotonic()
+        ov = (tr.span("host.overlap", phase="plan") if in_flight
+              else nullcontext())
+        with ov:
+            with tr.span("admit") as admit_sp:
+                timed_out = self.queue.expire(now)
+                admitted = []
+                if self.scheduler.admissible():
+                    admitted, admit_timed_out = self.scheduler.admit(
+                        now, gate=self._kv_gate if self._paged
+                        else None)
+                    timed_out = timed_out + admit_timed_out
+                admit_sp.args.update(admitted=len(admitted),
+                                     timed_out=len(timed_out))
+        if in_flight:
+            self._overlap_acc += time.monotonic() - t_plan
+        for slot in admitted:
+            tr.instant("req.admitted", cat="request",
+                       req=slot.request.id, slot=slot.index)
+        if timed_out:
+            self._m_timeout.inc(len(timed_out))
+            self._m_done.inc(len(timed_out))
+            for req in timed_out:
+                tr.instant("req.evicted", cat="request", req=req.id,
+                           reason="timeout")
+        # -- prefill / chunk planning (mutates only the admitted
+        #    slots' lanes; the dirty flag defers the re-upload) ------
+        if self._chunk is None:
+            for slot in admitted:
+                rid = slot.request.id
+                with tr.span("prefill", req=rid,
+                             prompt=int(len(slot.request.prompt))):
+                    self._prefill(slot)
+                emitted += 1  # prefill samples the first token
+        else:
+            for slot in admitted:
+                self._begin_chunked(slot)
+            _, _, prefilling = self.scheduler.snapshot()
+            if prefilling:
+                n_emit, _, _ = self._prefill_chunked(prefilling)
+                emitted += n_emit
+        # -- spec barrier: drafting is data-dependent on the previous
+        #    window's accepted tokens, so spec mode always consumes
+        #    before the dispatch snapshot — but only HERE, after the
+        #    planning/prefill phase above ran in the gap, so spec
+        #    ticks still overlap their plan work with the in-flight
+        #    verify's device compute --------------------------------
+        if self._spec_k is not None and self._ring:
+            emitted += self._drain_ring(tr)
+        # -- dirty barrier: consumed evictions must not leave freed
+        #    slots in the dispatch set, and _push_state may only run
+        #    over an empty pipeline ---------------------------------
+        if self._ring and (self._state_dirty or self._dev_state is None):
+            emitted += self._drain_ring(tr)
+        occ, active, _ = self.scheduler.snapshot()
+        if active and self._ring and self._spec_k is None and \
+                all(self._rem[s.index] <= len(self._ring)
+                    for s in active):
+            # bursty-tail cutoff: the rem mirrors say every active
+            # slot exhausts its budget within the ticks ALREADY in
+            # flight, so one more dispatch would compute only frozen
+            # lanes — consume instead (EOS can still finish a lane
+            # earlier than its budget; that case just falls through
+            # to the done-mask path)
+            emitted += self._drain_ring(tr)
+            occ, active, _ = self.scheduler.snapshot()
+        n_before = self._evicted_in_tick
+        # -- dispatch tick N+1 ---------------------------------------
+        if active:
+            t0 = time.monotonic()
+            if self._last_decode_end is not None:
+                self._m_stall.observe((t0 - self._last_decode_end)
+                                      * 1e3)
+            self._m_decode_batch.set(len(active))
+            inf = (self._dispatch_spec(active, tr)
+                   if self._spec_k is not None
+                   else self._dispatch_decode(active, tr))
+            self._ring.append(inf)
+            self._last_decode_end = time.monotonic()
+        else:
+            self._m_decode_batch.set(0)
+            self._last_decode_end = None
+        # -- consume tick N (the emit loop overlaps N+1's compute);
+        #    with nothing dispatched, drain the tail completely ------
+        keep = (self.async_depth - 1) if active else 0
+        while len(self._ring) > keep:
+            emitted += self._consume(self._ring.pop(0), tr)
+        occ -= self._evicted_in_tick - n_before
+        if self._ring and occ == 0:
+            # every slot freed while the newest dispatch was in
+            # flight: its lanes are all frozen (device-side stop), so
+            # drain the tail — an idle engine must hold no futures
+            emitted += self._drain_ring(tr)
+        self._m_queue.set(self.queue.depth())
+        self._m_occ.set(occ)
+        ov_ms = self._overlap_acc * 1e3
+        self._m_overlap.observe(ov_ms)
+        tick_sp.args.update(batch=len(active), emitted=emitted,
+                            occupancy=occ, queue=self.queue.depth(),
+                            overlap_ms=round(ov_ms, 3),
+                            in_flight=len(self._ring))
+        if self._paged:
+            self._m_kv_blocks.set(self.block_pool.in_use())
+            tick_sp.args["kv_blocks_in_use"] = self.block_pool.in_use()
         return emitted
 
     def _tick(self, tr, tick_sp):
@@ -1680,7 +2099,19 @@ class Engine:
                 while not stop_evt.is_set():
                     if self.scheduler.idle():
                         self._m_rate.refresh()  # decay tokens/sec to 0
-                        time.sleep(2e-3)
+                        # event-driven wake instead of a 2 ms poll: an
+                        # idle engine burns no CPU and a submit() is
+                        # admitted immediately, not a poll later.  The
+                        # clear-then-recheck order closes the race: a
+                        # submit landing between the idle check and
+                        # the clear is caught by the recheck, one
+                        # landing after it re-sets the event.  The
+                        # timeout is only the tokens/sec decay + stop
+                        # heartbeat, not an admission latency bound.
+                        self._wake.clear()
+                        if self.scheduler.idle() \
+                                and not stop_evt.is_set():
+                            self._wake.wait(timeout=0.5)
                         continue
                     try:
                         self.step()  # step() already recovered state
@@ -1702,6 +2133,10 @@ class Engine:
 
     def _drain(self):
         """Fail every queued and in-flight request (shutdown path)."""
+        # drop un-consumed dispatches: their requests fail below, and
+        # the next start() re-uploads clean cursors (every eviction
+        # parks its lanes and dirties the mirrors)
+        self._ring = []
         for req in self.queue.drain():
             self._m_done.inc()
         for slot in self.scheduler.busy_slots():
@@ -1727,6 +2162,7 @@ class Engine:
             # its finally; double-drain below is an idempotent no-op)
             self._drain_on_exit = evt
         evt.set()
+        self._wake.set()  # unblock an idle loop's event wait now
         t = self._thread
         if t is not None:
             t.join(timeout=join_timeout)

@@ -33,6 +33,12 @@ from .. import monitor
 from .request import QueueFull, RequestTimeout
 
 
+def _hist_mean(h):
+    """Mean of a monitor Histogram as a rounded float, 0.0 when
+    missing or empty (the /healthz JSON must never carry a NaN)."""
+    return 0.0 if h is None else round(h.mean(), 3)
+
+
 class _Handler(BaseHTTPRequestHandler):
     engine = None          # bound per-server via the factory below
     result_timeout = 120.0
@@ -105,6 +111,16 @@ class _Handler(BaseHTTPRequestHandler):
                     eng.block_pool.free_count()
                     if getattr(eng, "_paged", False) else None),
                 "sample_mode": getattr(eng, "sample_mode", "host"),
+                # async-loop signals, next to the router-tier load
+                # signals: pipeline depth plus the mean overlapped
+                # host time and mean blocking d2h wait per tick —
+                # overlap >> wait means the loop is hiding its host
+                # work behind device compute
+                "async_depth": getattr(eng, "async_depth", 1),
+                "tick_overlap_ms": _hist_mean(
+                    getattr(eng, "_m_overlap", None)),
+                "d2h_wait_ms": _hist_mean(
+                    getattr(eng, "_m_d2h_wait", None)),
             }
             if getattr(eng, "_paged", False):
                 info["kv_blocks_cached"] = (

@@ -125,6 +125,17 @@ class Scheduler:
     def idle(self):
         return self.occupancy() == 0 and self.queue.depth() == 0
 
+    def admissible(self):
+        """True when an admission attempt could make progress: at
+        least one queued request AND at least one free slot.  The
+        async engine tick's cheap planning probe — admission is a
+        structural (pipeline-draining) event, so the pipelined loop
+        only pays ``admit()`` when this says it could bind."""
+        if self.queue.depth() == 0:
+            return False
+        with self._lock:
+            return any(s.free for s in self.slots)
+
     # -- admission / eviction -------------------------------------------
     def admit(self, now=None, gate=None):
         """Fill free slots from the queue.  Returns (admitted_slots,

@@ -324,10 +324,10 @@ def test_paged_step_failure_recovers(tiny_gpt, monkeypatch):
     req = eng.submit(_prompts(1)[0], max_new_tokens=6)
     eng.step()
 
-    def boom(active):
+    def boom(active, tr):
         raise RuntimeError("synthetic dispatch failure")
 
-    monkeypatch.setattr(eng, "_decode_tick", boom)
+    monkeypatch.setattr(eng, "_dispatch_decode", boom)
     with pytest.raises(RuntimeError):
         eng.step()
     with pytest.raises(RuntimeError, match="engine step failed"):

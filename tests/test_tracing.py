@@ -269,3 +269,42 @@ def test_dead_thread_lanes_pruned_and_idents_not_recycled():
     with tr.span("main.again"):
         pass
     assert any(e.name == "main.again" for e in tr.events())
+
+
+def test_trace_view_wall_summary(tmp_path, capsys):
+    """--wall reports per-tick wall time vs summed phase time: with
+    the async engine loop, host.overlap spans run concurrently with
+    device compute, so phase totals legitimately exceed wall — the
+    summary surfaces the divergence the plain table double-counts."""
+    tv = _load_tool("trace_view")
+    # 2 ticks of 10 ms wall; phases sum to 14 ms per tick because
+    # 5 ms of host.overlap + 2 ms of d2h wait ran concurrently
+    events = []
+    for i in range(2):
+        t0 = i * 20000.0
+        events += [
+            {"name": "tick", "ph": "X", "ts": t0, "dur": 10000.0,
+             "cat": "tick"},
+            {"name": "decode.dispatch", "ph": "X", "ts": t0,
+             "dur": 7000.0, "cat": "serving"},
+            {"name": "host.overlap", "ph": "X", "ts": t0 + 1000.0,
+             "dur": 5000.0, "cat": "serving"},
+            {"name": "decode.d2h_wait", "ph": "X", "ts": t0 + 7000.0,
+             "dur": 2000.0, "cat": "serving"},
+        ]
+    w = tv.wall_summary(events)
+    assert w["ticks"] == 2
+    assert w["wall_ms"] == pytest.approx(20.0)
+    assert w["phase_ms"] == pytest.approx(28.0)
+    assert w["per_tick_wall_ms"] == pytest.approx(10.0)
+    assert w["per_tick_phase_ms"] == pytest.approx(14.0)
+    assert w["overlap_ms"] == pytest.approx(10.0)
+    assert w["d2h_wait_ms"] == pytest.approx(4.0)
+    # CLI: --wall appends the summary after the table
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps({"traceEvents": events}))
+    assert tv.main([str(path), "--wall"]) == 0
+    out = capsys.readouterr().out
+    assert "wall 20.000 ms" in out
+    assert "host.overlap 10.000 ms" in out
+    assert "concurrently" in out

@@ -69,6 +69,58 @@ def format_table(rows):
     return "\n".join(lines)
 
 
+def wall_summary(events):
+    """Per-tick wall time vs summed phase time.  The span table above
+    sums every complete-event independently, which silently
+    DOUBLE-COUNTS concurrent spans — with the async engine loop, host
+    phases (``host.overlap``) run while the device computes, so the
+    per-phase totals legitimately exceed wall time.  This summary
+    makes that divergence explicit: ``wall_ms`` is the summed duration
+    of the ``tick`` spans, ``phase_ms`` the summed duration of every
+    other complete-event, ``overlap_ms``/``d2h_wait_ms`` the async
+    loop's own attribution spans.  phase/wall > 1 means concurrency
+    (work hidden behind device compute), not an accounting bug."""
+    wall = phase = overlap = d2h_wait = 0.0
+    n_ticks = 0
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        dur = float(ev.get("dur", 0.0)) / 1e3  # us -> ms
+        name = ev.get("name")
+        if name == "tick":
+            n_ticks += 1
+            wall += dur
+        else:
+            phase += dur
+            if name == "host.overlap":
+                overlap += dur
+            elif name == "decode.d2h_wait":
+                d2h_wait += dur
+    return {
+        "ticks": n_ticks, "wall_ms": wall, "phase_ms": phase,
+        "per_tick_wall_ms": wall / n_ticks if n_ticks else float("nan"),
+        "per_tick_phase_ms": (phase / n_ticks if n_ticks
+                              else float("nan")),
+        "overlap_ms": overlap, "d2h_wait_ms": d2h_wait,
+    }
+
+
+def format_wall(w):
+    lines = [
+        f"ticks: {w['ticks']}   wall {w['wall_ms']:.3f} ms   "
+        f"summed phases {w['phase_ms']:.3f} ms",
+        f"per tick: wall {w['per_tick_wall_ms']:.3f} ms vs phases "
+        f"{w['per_tick_phase_ms']:.3f} ms",
+        f"host.overlap {w['overlap_ms']:.3f} ms   "
+        f"decode.d2h_wait {w['d2h_wait_ms']:.3f} ms",
+        "(phases exceeding wall = spans ran concurrently — e.g. the "
+        "async engine loop's",
+        " host work hidden behind device compute; the table above "
+        "double-counts them)",
+    ]
+    return "\n".join(lines)
+
+
 def load_events(path):
     """Events from a trace file: Catapult object form or bare list."""
     with open(path) as f:
@@ -89,8 +141,14 @@ def main(argv=None):
     p.add_argument("--sort", default="total",
                    choices=("total", "count", "mean", "p50", "p99"),
                    help="sort column (descending; default total)")
+    p.add_argument("--wall", action="store_true",
+                   help="append a per-tick wall-time vs summed-phase "
+                        "summary (concurrent spans — async engine "
+                        "overlap — make the two diverge; the table "
+                        "alone double-counts them)")
     args = p.parse_args(argv)
-    rows = summarize(load_events(args.trace), cat=args.cat)
+    events = load_events(args.trace)
+    rows = summarize(events, cat=args.cat)
     key = {"total": "total_ms", "count": "count", "mean": "mean_ms",
            "p50": "p50_ms", "p99": "p99_ms"}[args.sort]
     rows.sort(key=lambda r: -r[key])
@@ -98,6 +156,9 @@ def main(argv=None):
         print("no complete-events matched", file=sys.stderr)
         return 1
     print(format_table(rows))
+    if args.wall:
+        print()
+        print(format_wall(wall_summary(events)))
     return 0
 
 
