@@ -40,20 +40,40 @@ bounded per-thread rings, phase spans + request lifecycle instants +
 compile events) with chrome-trace export (``Engine.chrome_trace()``,
 ``GET /debug/trace``), a live request view (``GET /debug/requests``),
 and an automatic flight-recorder dump on step failure
-(``Engine(flight_dir=...)``).
+(``Engine(flight_dir=...)``).  OVERLOAD PROTECTION:
+``submit(priority=..., tenant=...)`` gives requests priority classes
+(higher preempts lower MID-STREAM under slot/KV pressure — the
+victim's blocks return to the prefix cache and its stream resumes
+token-identically on re-admission) and per-tenant weighted-fair
+queue service with token-bucket rate limits
+(``Engine(tenants={...})``); deadline-aware shedding rejects
+requests whose deadline the measured drain rate already cannot meet
+(``DeadlineShed`` with an honest computed Retry-After);
+``stop(drain=True)`` drains gracefully (in-flight streams finish,
+bounded by a timeout); and ``serving.faults`` provides the
+deterministic chaos harness (seeded fault schedule over
+dispatch/d2h/pool/host sites + a tick watchdog that converts wedged
+dispatches into flight-recorded recoveries).
 """
 from .request import (  # noqa: F401
-    Request, RequestQueue, RequestTimeout, QueueFull)
+    Request, RequestQueue, RequestTimeout, QueueFull, Rejected,
+    RateLimited, DeadlineShed, TenantPolicy, TokenBucket)
 from .scheduler import Scheduler, Slot  # noqa: F401
 from .kvcache import BlockPool, NoFreeBlocks, PrefixCache  # noqa: F401
 from .spec import (  # noqa: F401
     Proposer, PromptLookupProposer, DraftModelProposer)
+from .faults import (  # noqa: F401
+    FaultInjector, InjectedFault, TickWatchdog, WatchdogTimeout)
 from .engine import Engine  # noqa: F401
 from .httpd import EngineServer, serve  # noqa: F401
 
 __all__ = [
     "Request", "RequestQueue", "RequestTimeout", "QueueFull",
+    "Rejected", "RateLimited", "DeadlineShed", "TenantPolicy",
+    "TokenBucket",
     "Scheduler", "Slot", "Engine", "EngineServer", "serve",
     "BlockPool", "PrefixCache", "NoFreeBlocks",
     "Proposer", "PromptLookupProposer", "DraftModelProposer",
+    "FaultInjector", "InjectedFault", "TickWatchdog",
+    "WatchdogTimeout",
 ]

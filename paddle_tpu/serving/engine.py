@@ -35,13 +35,15 @@ import os
 import threading
 import time
 import weakref
+from collections import deque
 from contextlib import nullcontext
 
 import numpy as np
 
 from .. import monitor
 from .kvcache import BlockPool, PrefixCache
-from .request import MAX_SEED, QueueFull, Request, RequestQueue
+from .request import (MAX_SEED, DeadlineShed, QueueFull, RateLimited,
+                      Request, RequestQueue, TenantPolicy, TokenBucket)
 from .scheduler import Scheduler
 
 
@@ -286,6 +288,45 @@ class Engine:
         memory, BEFORE recovery tears the slots down) and, when
         ``flight_dir`` is set, also writes it there as a chrome-trace
         JSON (``flight_tick<N>_<pid>_<ms>.json``) for post-mortems.
+    tenants : per-tenant admission policies — dict name ->
+        ``TenantPolicy`` (or a plain dict of its kwargs): ``weight``
+        sets the tenant's weighted-fair share of queue service within
+        a priority tier (start-time fair queuing over token cost, so
+        a flooding tenant cannot starve another past its weight), and
+        ``rate``/``burst`` arm a token bucket charged
+        ``prompt + max_new_tokens`` at submit — over-rate submits
+        raise ``RateLimited`` with an honest ``retry_after``.
+        Unlisted tenants get weight 1 and no rate limit.
+    preemption : allow PRIORITY PREEMPTION (default True).  When the
+        best queued request outranks a running one and admission is
+        blocked (no free slot, or the paged gate is short on blocks),
+        the lowest-priority busy slot is evicted MID-STREAM: in paged
+        mode every full block of its computed history (prompt +
+        emitted-so-far) goes into the prefix cache first, the request
+        requeues at the head of its own lane with its emitted tokens
+        preserved, and re-admission adopts the cached span so the
+        resume skips re-prefill — the resumed stream is
+        token-identical (greedy AND per-seed sampled: the device key
+        folds the emitted-token counter, the host rng stream
+        survives) to an uninterrupted run.  Victims tie-break to the
+        most recently admitted (least sunk work).
+    shed_deadlines : DEADLINE-AWARE LOAD SHEDDING at submit (default
+        True).  Once the drain rate is measured, a request whose
+        deadline (``timeout``) is already blown by the estimated
+        queue wait — (in-flight remaining + queued work at its
+        priority or above) / measured tokens-per-sec — is rejected
+        with ``DeadlineShed`` carrying a computed ``retry_after``
+        instead of burning slot time on a result nobody will read.
+    faults : a ``serving.faults.FaultInjector`` — deterministic,
+        seeded failure points (dispatch raise, d2h hang, pool
+        exhaustion, slow host tick, proposer failure) threaded
+        through the tick for chaos testing; None (default) disables
+        every site at zero cost.
+    watchdog_s : arm a ``TickWatchdog``: a tick exceeding this many
+        seconds (wedged dispatch / hung d2h) is flight-recorded
+        immediately and marked, so cooperative blocking points raise
+        ``WatchdogTimeout`` into the normal step-failure recovery
+        instead of hanging the engine forever.
 
     ``step()`` is single-threaded by design — run it from one loop
     (``run_until_idle`` or the ``start()`` background thread).
@@ -299,7 +340,9 @@ class Engine:
                  prefill_chunk=None, tick_token_budget=None,
                  spec_k=None, proposer=None, sample_mode="device",
                  async_depth=None, tracing=True, trace_capacity=16384,
-                 trace_annotations=False, flight_dir=None):
+                 trace_annotations=False, flight_dir=None,
+                 tenants=None, preemption=True, shed_deadlines=True,
+                 faults=None, watchdog_s=None):
         if getattr(model, "scan_layers", False):
             model = model._sync_decode_twin()
         model.eval()
@@ -317,8 +360,49 @@ class Engine:
                 model.embeddings.word_embeddings.weight.shape[0])
         except AttributeError:
             self.vocab_size = None
-        self.queue = RequestQueue(max_queue=max_queue)
+        # -- overload protection: tenants, priorities, shedding ---------
+        self._tenant_policies = {}
+        self._buckets = {}
+        for name, pol in (tenants or {}).items():
+            if isinstance(pol, dict):
+                pol = TenantPolicy(**pol)
+            elif not isinstance(pol, TenantPolicy):
+                raise ValueError(
+                    f"tenants[{name!r}] must be a TenantPolicy or a "
+                    f"dict of its kwargs, got {type(pol).__name__}")
+            self._tenant_policies[str(name)] = pol
+            if pol.rate is not None:
+                self._buckets[str(name)] = TokenBucket(pol.rate,
+                                                       pol.burst)
+        self.queue = RequestQueue(
+            max_queue=max_queue,
+            weights={n: p.weight
+                     for n, p in self._tenant_policies.items()})
         self.scheduler = Scheduler(self.num_slots, self.queue)
+        self._preemption = bool(preemption)
+        self._shed_deadlines = bool(shed_deadlines)
+        self._preempt_log = deque(maxlen=64)  # recent preemptions —
+        #   rides in flight-recorder dumps so a post-mortem shows WHY
+        #   a slot was evicted
+        self._gate_declined = False  # the paged admission gate turned
+        #   the queue head away this tick (short on blocks) — the
+        #   preemption probe's KV-pressure signal
+        self._draining = False       # stop(drain=True) in progress:
+        #   no new submits, no new admissions; in-flight slots finish
+        self._rate_win = deque(maxlen=64)  # (t, emitted) per emitting
+        #   tick — the measured drain rate behind Retry-After and
+        #   deadline shedding
+        self._ovl_lock = threading.Lock()  # guards _rate_win and
+        #   _preempt_log: the engine thread appends while handler /
+        #   watchdog threads snapshot (an unguarded deque raises
+        #   "mutated during iteration" mid-read)
+        self.faults = faults
+        self.watchdog_s = (None if watchdog_s is None
+                           else float(watchdog_s))
+        self._watchdog = None
+        self._watchdog_fired = False
+        self._tick_started_at = None  # watchdog heartbeat: set at
+        #   tick entry, cleared at exit
 
         import jax.numpy as jnp
         attn0 = model.blocks[0].attn
@@ -584,6 +668,43 @@ class Engine:
         self._m_compile_ms = reg.histogram(
             "serving.compile_ms", "wall time of each new program's "
             "first call (jax trace + XLA compile + first run, ms)")
+        # overload-protection surface: preemption / shedding /
+        # fairness / chaos (registered always; zero when idle)
+        self._m_preempt = reg.counter(
+            "serving.preemptions_total", "mid-stream preemptions: a "
+            "running lower-priority request evicted back to the "
+            "queue (emitted tokens preserved; paged blocks returned "
+            "to the prefix cache)")
+        self._m_resumed = reg.counter(
+            "serving.resumed_total", "re-admissions of previously "
+            "preempted requests (prefix adoption skips the shared "
+            "span's re-prefill in paged mode)")
+        self._m_shed_deadline = reg.counter(
+            "serving.shed_deadline_total", "requests rejected at "
+            "submit because the estimated queue drain already blew "
+            "their deadline (DeadlineShed, honest Retry-After)")
+        self._m_shed_rate = reg.counter(
+            "serving.shed_rate_limited_total", "requests rejected at "
+            "submit by a tenant token bucket (RateLimited)")
+        self._m_shed_queue = reg.counter(
+            "serving.shed_queue_full_total", "requests rejected at "
+            "submit because the admission queue was at max_queue")
+        self._m_drain_tps = reg.gauge(
+            "serving.drain_rate_tps", "measured decode drain rate "
+            "(tokens/sec over the recent emitting-tick window) — the "
+            "denominator of Retry-After and deadline-shed estimates")
+        self._m_watchdog = reg.counter(
+            "serving.watchdog_fires", "ticks the watchdog declared "
+            "wedged (flight-recorded; cooperative blocks raise into "
+            "step recovery)")
+        self._m_faults = reg.counter(
+            "serving.faults_injected", "fault-injection sites fired "
+            "(serving/faults.py — nonzero only under a chaos "
+            "harness)")
+        self._m_proposer_failures = reg.counter(
+            "serving.proposer_failures", "proposer calls that raised "
+            "— degraded to an empty draft window (verify emits the "
+            "bonus token) instead of failing the tick")
         # weakref'd listener: a collected engine returns False from the
         # callback and the model drops it — engines must not leak into
         # the model's listener list across their lifetimes
@@ -635,8 +756,12 @@ class Engine:
             # slots read/write through — their garbage compute may not
             # touch a block some live request owns
             shape = (self._kv_managed + 1, self._bs, self._nh, self._hd)
-            self.block_pool = BlockPool(self._kv_managed + 1, self._bs,
-                                        reserved_blocks=1)
+            self.block_pool = BlockPool(
+                self._kv_managed + 1, self._bs, reserved_blocks=1,
+                # chaos-harness hook: a scheduled "pool_exhaust" tick
+                # turns this alloc into NoFreeBlocks (no-op when no
+                # injector is attached)
+                fault_hook=lambda n: self._fault("pool_exhaust"))
             self.prefix_cache = PrefixCache(self.block_pool) \
                 if self._prefix_enabled else None
             self._block_tables = np.zeros((self.num_slots, self._bps),
@@ -680,9 +805,25 @@ class Engine:
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, eos_token_id=None,
                timeout=None, temperature=1.0, top_k=0, top_p=1.0,
-               seed=None):
+               seed=None, priority=0, tenant=None):
         """Queue one generation request; returns its Request handle
-        (block on ``request.result()``)."""
+        (block on ``request.result()``).
+
+        ``priority``: higher-priority requests are served first and
+        may PREEMPT running lower-priority streams under slot/KV
+        pressure (``Engine(preemption=...)``).  ``tenant``: the
+        weighted-fair / rate-limit accounting bucket
+        (``Engine(tenants=...)``); None = the default tenant.
+
+        Overload shedding happens HERE, at the edge: ``QueueFull``
+        (queue at max_queue), ``RateLimited`` (tenant bucket empty),
+        and ``DeadlineShed`` (the measured drain rate says the
+        deadline is already unmeetable) all carry an honest
+        ``retry_after`` estimate."""
+        if self._draining:
+            raise QueueFull(
+                "engine draining: stop(drain=True) in progress — no "
+                "new admissions", retry_after=None)
         if temperature <= 0:
             raise ValueError(
                 f"temperature must be > 0, got {temperature} (greedy is "
@@ -707,7 +848,8 @@ class Engine:
                 "32-bit words, and the host rng rejects negatives too")
         req = Request(prompt, max_new_tokens, eos_token_id=eos_token_id,
                       timeout=timeout, temperature=temperature,
-                      top_k=top_k, top_p=top_p, seed=seed)
+                      top_k=top_k, top_p=top_p, seed=seed,
+                      priority=priority, tenant=tenant)
         total = len(req.prompt) + req.max_new_tokens
         margin = self._spec_k or 0
         if total + margin > self.max_seq_len:
@@ -717,17 +859,77 @@ class Engine:
                 f"prompt ({len(req.prompt)}) + max_new_tokens "
                 f"({req.max_new_tokens}){spec_note} = {total + margin} "
                 f"exceeds the slot cache length ({self.max_seq_len})")
+        # per-tenant token bucket: sustained over-rate traffic is
+        # turned away before it can occupy queue places
+        bucket = self._buckets.get(req.tenant)
+        if bucket is not None:
+            if req.cost_tokens > bucket.burst:
+                # no amount of waiting admits a request larger than
+                # the bucket itself — a finite Retry-After here would
+                # be a lie that livelocks a well-behaved client
+                self._m_shed_rate.inc()
+                self.tracer.instant(
+                    "req.shed", cat="request", req=req.id,
+                    reason="rate_limited", tenant=req.tenant)
+                raise RateLimited(
+                    f"request {req.id} costs {req.cost_tokens} tokens"
+                    f" but tenant {req.tenant!r}'s bucket holds at "
+                    f"most {bucket.burst:g} — it can never be "
+                    "admitted under this rate limit (split the "
+                    "request or raise the tenant's burst)",
+                    retry_after=None)
+            wait = bucket.take(req.cost_tokens)
+            if wait is not None:
+                self._m_shed_rate.inc()
+                self.tracer.instant(
+                    "req.shed", cat="request", req=req.id,
+                    reason="rate_limited", tenant=req.tenant,
+                    retry_after_s=round(wait, 3))
+                raise RateLimited(
+                    f"tenant {req.tenant!r} over its token rate "
+                    f"({self._tenant_policies[req.tenant].rate:g} "
+                    f"tok/s): request {req.id} needs "
+                    f"{req.cost_tokens} tokens, bucket refills in "
+                    f"{wait:.2f}s", retry_after=round(wait, 3))
+        # deadline-aware shedding: once the drain rate is measured, a
+        # request whose wait estimate already blows its deadline is
+        # rejected NOW with the honest backoff, instead of timing out
+        # in queue (or worse, decoding for a caller that gave up)
+        if self._shed_deadlines and req.deadline is not None:
+            est = self.estimate_queue_wait(priority=req.priority)
+            budget = req.deadline - req.submitted_at
+            if est is not None and est > budget:
+                if bucket is not None:
+                    bucket.refund(req.cost_tokens)  # did no work —
+                    #   a shed must not also drain the rate budget
+                retry = round(est - budget, 3)
+                self._m_shed_deadline.inc()
+                self.tracer.instant(
+                    "req.shed", cat="request", req=req.id,
+                    reason="deadline", est_wait_s=round(est, 3),
+                    retry_after_s=retry)
+                raise DeadlineShed(
+                    f"request {req.id} cannot meet its {budget:.2f}s "
+                    f"deadline: estimated queue wait {est:.2f}s at "
+                    "the measured drain rate; retry after "
+                    f"{retry:.2f}s", retry_after=retry)
         # instant BEFORE put: once the request is in the queue the
         # engine thread may admit (even first-token) it concurrently,
         # and the ts-sorted timeline must keep queued -> admitted order
         self.tracer.instant("req.queued", cat="request", req=req.id,
                             prompt=int(len(req.prompt)),
-                            max_new=req.max_new_tokens)
+                            max_new=req.max_new_tokens,
+                            priority=req.priority, tenant=req.tenant)
         try:
             self.queue.put(req)
-        except QueueFull:
-            self.tracer.instant("req.rejected", cat="request",
-                                req=req.id, reason="queue_full")
+        except QueueFull as e:
+            if bucket is not None:
+                bucket.refund(req.cost_tokens)  # see deadline shed
+            self._m_shed_queue.inc()
+            e.retry_after = self._queue_full_retry_after()
+            self.tracer.instant("req.shed", cat="request",
+                                req=req.id, reason="queue_full",
+                                retry_after_s=e.retry_after)
             raise
         self._m_reqs.inc()
         self._m_queue.set(self.queue.depth())
@@ -767,6 +969,245 @@ class Engine:
         self._b_arrays = None
         if self._paged and self.prefix_cache is not None:
             self.prefix_cache.clear()
+
+    # -- overload protection: drain estimate / shedding / faults -------
+    # drain-rate staleness horizon: entries older than this are
+    # dropped, and a window whose NEWEST entry is older reads None —
+    # an idle gap between bursts must not stretch the measured span
+    # (rate would collapse by orders of magnitude and every deadline
+    # submit after the gap would be spuriously shed)
+    _RATE_HORIZON_S = 10.0
+
+    def drain_rate(self, now=None):
+        """Measured decode drain rate (tokens/sec) over the recent
+        emitting-tick window; None until at least two emitting ticks
+        exist inside the staleness horizon.  The denominator of every
+        Retry-After the engine computes — honest because it is
+        measured, not configured."""
+        now = time.monotonic() if now is None else now
+        with self._ovl_lock:
+            snap = list(self._rate_win)
+        win = [w for w in snap
+               if now - w[0] <= self._RATE_HORIZON_S]
+        if len(win) < 2:
+            return None
+        span = win[-1][0] - win[0][0]
+        if span <= 1e-3:
+            return None
+        # tokens strictly after the window's first stamp, over the
+        # stamped span — the first entry only anchors the clock
+        return sum(n for _, n in win[1:]) / span
+
+    def estimate_queue_wait(self, priority=0):
+        """Seconds until a request submitted NOW at ``priority`` would
+        reach a slot, from the measured drain rate: (in-flight
+        remaining + queued work at its priority or above) / rate.
+        None while the rate is unmeasured (a cold engine never
+        sheds); 0.0 when nothing queues ahead AND the request would
+        be placed next tick anyway — a free slot exists, or priority
+        preemption would evict a lower-priority stream for it — so a
+        partially-loaded engine never sheds against work it would not
+        actually wait for."""
+        rate = self.drain_rate()
+        if rate is None or rate <= 0:
+            return None
+        backlog = self.queue.backlog_tokens(min_priority=priority)
+        # snapshot the request refs ONCE: submit() runs on handler
+        # threads while the engine thread evicts, so re-reading
+        # slot.request after a None-check could observe the eviction
+        # mid-expression
+        reqs = [r for r in (s.request
+                            for s in self.scheduler.busy_slots())
+                if r is not None]
+        if backlog == 0:
+            if len(reqs) < self.num_slots:
+                return 0.0
+            if self._preemption and any(r.priority < priority
+                                        for r in reqs):
+                return 0.0
+        # with preemption on, strictly-lower-priority in-flight
+        # streams are not work this request waits behind — it would
+        # evict them — so only same-or-higher-priority remaining
+        # counts toward the estimate
+        inflight = sum(r.remaining for r in reqs
+                       if not self._preemption
+                       or r.priority >= priority)
+        return (inflight + backlog) / rate
+
+    def _queue_full_retry_after(self):
+        """Honest 503 backoff for a full queue: the measured time for
+        ONE queue place to drain (total backlog / drain rate, per
+        queued request); 1.0s when the rate is still unmeasured."""
+        rate = self.drain_rate()
+        depth = self.queue.depth()
+        if rate is None or rate <= 0 or depth == 0:
+            return 1.0
+        return round(max(self.queue.backlog_tokens() / rate / depth,
+                         0.05), 3)
+
+    def _fault(self, site):
+        """Consult the fault injector at a named failure point: a
+        no-op (None injector or unscheduled tick) costs one attribute
+        read; a scheduled site counts, traces, and performs its
+        action (which may raise into the step-failure recovery)."""
+        f = self.faults
+        if f is not None and f.scheduled(site, self.tick_no):
+            self._m_faults.inc()
+            self.tracer.instant("fault.injected", cat="fault",
+                                site=site, tick=self.tick_no)
+            f.fire(site, self.tick_no, self)
+
+    def _preempt_history(self):
+        """Locked snapshot of the preemption/requeue ring (handler and
+        watchdog threads read it while the engine thread appends)."""
+        with self._ovl_lock:
+            return list(self._preempt_log)
+
+    def _post_admit(self, admitted, timed_out, tr):
+        """Shared post-admission phase of both tick paths.  Reconciles
+        the admitted list against the preemption round — a handler
+        thread can land a higher-priority submit in the window between
+        the admit and preemption phases, so a slot admitted earlier
+        THIS tick may since have been evicted or rebound; keeping one
+        entry per still-bound slot is what stops the prefill loop from
+        binding a consumed ``_kv_plan`` twice or dereferencing a freed
+        slot — then emits the admitted/resumed instants and accounts
+        the timeouts.  Returns the reconciled admitted list."""
+        uniq = []
+        for slot in admitted:
+            if slot.request is not None and slot not in uniq:
+                uniq.append(slot)
+        for slot in uniq:
+            req = slot.request
+            tr.instant("req.admitted", cat="request",
+                       req=req.id, slot=slot.index)
+            if req.preemptions:
+                self._m_resumed.inc()
+                tr.instant("req.resumed", cat="request", req=req.id,
+                           slot=slot.index,
+                           tokens=len(req.generated))
+        if timed_out:
+            self._m_timeout.inc(len(timed_out))
+            self._m_done.inc(len(timed_out))
+            for req in timed_out:
+                self._rngs.pop(req.id, None)  # a preempted-then-
+                #   expired request may hold a host rng stream
+                tr.instant("req.evicted", cat="request", req=req.id,
+                           reason="timeout")
+        return uniq
+
+    # -- priority preemption -------------------------------------------
+    def _preempt(self, slot, tr):
+        """Evict a RUNNING request mid-stream under priority pressure
+        and requeue it with its emitted tokens preserved.  Paged mode
+        first inserts every FULL block of the computed history
+        (prompt + emitted-so-far — ``slot.pos`` rows of K/V) into the
+        prefix cache, so re-admission adopts the span and the resume
+        skips re-prefill; the frozen ``req._ctx`` snapshot is what a
+        re-admission prefills.  The resumed stream is token-identical
+        to an uninterrupted run: greedy trivially, sampled because
+        the device key folds the emitted-token counter (the next draw
+        is draw #len(generated) either way) and the host rng stream
+        stays alive in ``_rngs``.  Caller must have DRAINED the async
+        ring: an in-flight lane whose request vanished un-done would
+        otherwise raise the consume-side drift check."""
+        req = slot.request
+        i = slot.index
+        ctx = (np.concatenate([req.prompt,
+                               np.asarray(req.generated, np.int32)])
+               if req.generated else req.prompt)
+        if self._paged and self.prefix_cache is not None:
+            # slot.pos rows of K/V are computed (decoding slots: the
+            # last emitted token's row is pending, exactly pos rows
+            # valid; prefilling slots: pos == prefilled) — only full
+            # blocks under that bound are adoptable
+            n_full = min(slot.pos // self._bs,
+                         len(self._slot_blocks[i]))
+            if n_full:
+                self.prefix_cache.insert(ctx,
+                                         self._slot_blocks[i][:n_full])
+        plan = getattr(req, "_kv_plan", None)
+        if plan is not None:
+            # admitted-but-not-yet-prefilled victim (a concurrent
+            # higher-priority submit landed between admission and
+            # prefill): its gate reservation was never bound to the
+            # slot, so return it here — adopted prefix refs fall back
+            # to the cache's own, fresh blocks free
+            del req._kv_plan
+            if self._paged:
+                pctx, pfresh, _ = plan
+                self.block_pool.decref(pctx + pfresh)
+        self.scheduler.release(slot)
+        self._release_slot_kv(i)
+        self._park_state(i)
+        req._ctx = ctx
+        req.preemptions += 1
+        self.queue.requeue(req)
+        self._m_preempt.inc()
+        with self._ovl_lock:
+            self._preempt_log.append({
+                "tick": self.tick_no, "request": req.id, "slot": i,
+                "priority": req.priority, "tenant": req.tenant,
+                "generated": len(req.generated),
+                "preemptions": req.preemptions,
+            })
+        tr.instant("req.preempted", cat="request", req=req.id,
+                   slot=i, tokens=len(req.generated),
+                   priority=req.priority)
+
+    def _preempt_round(self, now, tr):
+        """Admission-phase preemption loop: while the best queued
+        priority outranks a running request and admission is blocked
+        — every slot busy, or the paged gate just declined the head
+        for lack of blocks — evict the lowest-priority busy slot
+        (tie-break: most recently admitted, least sunk work) and
+        retry admission.  Returns (admitted_slots, timed_out,
+        emitted) — emitted counts tokens from any async-ring drain
+        the eviction forced."""
+        admitted, timed_out, emitted = [], [], 0
+        if not self._preemption or self._draining:
+            return admitted, timed_out, emitted
+        for _ in range(2 * self.num_slots):
+            pri = self.queue.best_priority()
+            if pri is None:
+                break
+            blocked = (self.scheduler.free_count() == 0
+                       or (self._paged and self._gate_declined))
+            if not blocked:
+                break
+            victims = [s for s in self.scheduler.busy_slots()
+                       if s.request is not None
+                       and s.request.priority < pri]
+            if not victims:
+                break
+            victim = min(victims,
+                         key=lambda s: (s.request.priority, -s.seq))
+            if self._ring:
+                # consume in-flight ticks first: the victim's device
+                # lane is NOT done, and the consume-side drift check
+                # must never see a vanished live request
+                emitted += self._drain_ring(tr)
+                vr = victim.request
+                if vr is None or vr.priority >= pri \
+                        or self.scheduler.free_count() > 0:
+                    # the drain finished the victim — or freed some
+                    # OTHER slot: admit into the capacity that now
+                    # exists instead of evicting a live stream for
+                    # it, then re-probe from the top
+                    self._gate_declined = False
+                    more, t2 = self.scheduler.admit(
+                        now,
+                        gate=self._kv_gate if self._paged else None)
+                    admitted += more
+                    timed_out += t2
+                    continue
+            self._preempt(victim, tr)
+            self._gate_declined = False
+            more, t2 = self.scheduler.admit(
+                now, gate=self._kv_gate if self._paged else None)
+            admitted += more
+            timed_out += t2
+        return admitted, timed_out, emitted
 
     # -- tracing / flight recorder / debug surface ---------------------
     def _register_compile_listener(self):
@@ -842,12 +1283,15 @@ class Engine:
                 view["first_token"] = req.first_token_at is not None
                 view["age_ms"] = round((now - req.submitted_at) * 1e3,
                                        3)
+                view["preemptions"] = req.preemptions
             if self._paged:
                 view["kv_blocks"] = len(self._slot_blocks[view["slot"]])
             slots.append(view)
         queued = [{
             "request_id": r.id, "prompt_len": int(len(r.prompt)),
             "max_new_tokens": r.max_new_tokens,
+            "priority": r.priority, "tenant": r.tenant,
+            "preemptions": r.preemptions,
             "queued_ms": round((now - r.submitted_at) * 1e3, 3),
             "deadline_in_s": (None if r.deadline is None
                               else round(r.deadline - now, 3)),
@@ -855,6 +1299,7 @@ class Engine:
         return {
             "tick": self.tick_no, "slots": slots, "queue": queued,
             "in_flight_ticks": [inf.tick for inf in ring],
+            "preemptions": self._preempt_history()[-16:],
             "engine": {
                 "num_slots": self.num_slots,
                 "max_seq_len": self.max_seq_len,
@@ -864,6 +1309,9 @@ class Engine:
                 "sample_mode": self.sample_mode,
                 "async_depth": self.async_depth,
                 "tracing": bool(self.tracer.enabled),
+                "preemption": self._preemption,
+                "draining": self._draining,
+                "watchdog_s": self.watchdog_s,
             }}
 
     def _record_flight(self, exc):
@@ -881,6 +1329,9 @@ class Engine:
                     "tick": self.tick_no,
                     "dumped_at_unix": round(time.time(), 3),
                     "requests": self.debug_requests(),
+                    # preemption/requeue history: WHY slots were
+                    # evicted in the ticks leading up to the failure
+                    "preemptions": self._preempt_history(),
                     # async pipeline state at the failure: BOTH cursor
                     # buffers — the host mirrors (the "next" buffer
                     # admissions/evictions dirty) and, per un-consumed
@@ -929,13 +1380,21 @@ class Engine:
         verify window writes rejected-lane K/V up to spec_k positions
         past the cursor, and reserving those rows HERE is what makes
         rollback a cursor reset instead of a pool operation — every
-        window position lands in blocks the slot already owns."""
-        s = len(req.prompt)
-        n_total = -(-(s + req.max_new_tokens + (self._spec_k or 0))
+        window position lands in blocks the slot already owns.
+
+        Resume-aware: a preempted request's ``context`` is its frozen
+        prompt+emitted snapshot and ``remaining`` its unemitted
+        budget, so the worst case is the same total the original
+        admission reserved — and the blocks the preemption returned
+        to the prefix cache match here, which is what makes resume a
+        cursor-and-refcount operation instead of a re-prefill."""
+        tokens = req.context
+        s = len(tokens)
+        n_total = -(-(s + req.remaining + (self._spec_k or 0))
                     // self._bs)
         ctx, m = ([], 0)
         if self.prefix_cache is not None:
-            ctx, m = self.prefix_cache.match(req.prompt)
+            ctx, m = self.prefix_cache.match(tokens)
         need = n_total - len(ctx)
         short = need - self.block_pool.free_count()
         if short > 0 and self.prefix_cache is not None:
@@ -944,6 +1403,8 @@ class Engine:
                 self._m_prefix_evictions.inc(len(evicted))
         if need > self.block_pool.free_count():
             self.block_pool.decref(ctx)  # the cache keeps its own refs
+            self._gate_declined = True   # preemption probe: the head
+            #   is being held back by blocks, not by slots
             return False
         fresh = self.block_pool.alloc(need)
         req._kv_plan = (ctx, fresh, m)
@@ -1013,13 +1474,18 @@ class Engine:
             lo, hi = 0, 0
         self._seed_lo[i] = lo
         self._seed_hi[i] = hi
-        self._sctr[i] = 0
+        # rng fold counter = tokens already emitted: 0 on a fresh
+        # admission, len(generated) on a preemption resume — so the
+        # next device draw is draw #len(generated) either way and a
+        # seeded stream is unchanged across a preemption
+        self._sctr[i] = len(req.generated)
         # device-side stop-condition lanes: the dispatch itself checks
         # EOS / max_new against these, so a blind-dispatched tick can
-        # never advance a finished request
+        # never advance a finished request (resume: only the unemitted
+        # budget remains)
         self._eos[i] = (-1 if req.eos_token_id is None
                         else int(req.eos_token_id))
-        self._rem[i] = req.max_new_tokens
+        self._rem[i] = req.remaining
         self._state_dirty = True
 
     def _park_state(self, i):
@@ -1075,7 +1541,8 @@ class Engine:
         ctx, fresh, m = self._bind_kv_plan(slot)
         i = slot.index
         blocks = ctx + fresh
-        s = len(req.prompt)
+        tokens = req.context  # prompt, or the frozen resume snapshot
+        s = len(tokens)
         n_ctx = len(ctx)
         s_tail = s - m
         n_tail = -(-s // self._bs) - n_ctx
@@ -1087,11 +1554,11 @@ class Engine:
             self._kv_dtype)
         last0, self.k_pools, self.v_pools = pf(
             self._p_list(), self._b_list(), self.k_pools, self.v_pools,
-            req.prompt[None, m:],
+            tokens[None, m:],
             jnp.asarray(np.asarray(ctx, np.int32)),
             jnp.asarray(np.asarray(fresh[:n_tail], np.int32)))
         if self.prefix_cache is not None:
-            self.prefix_cache.insert(req.prompt, blocks[:s // self._bs])
+            self.prefix_cache.insert(tokens, blocks[:s // self._bs])
         self._m_prefill_tokens.inc(s_tail)
         slot.pos = s
         slot.prefilled = s
@@ -1110,7 +1577,8 @@ class Engine:
         if self._paged:
             return self._prefill_paged(slot)
         req = slot.request
-        s = len(req.prompt)
+        tokens = req.context  # prompt, or the frozen resume snapshot
+        s = len(tokens)
         L = self.max_seq_len
         if self._prefill_buckets is not None:
             S = next(b for b in self._prefill_buckets if b >= s)
@@ -1120,7 +1588,7 @@ class Engine:
                  self._bnames_all),
                 1, S, L, self._nh, self._hd, self._kv_dtype)
             ids = np.zeros((1, S), np.int32)
-            ids[0, :s] = req.prompt
+            ids[0, :s] = tokens
             last0, k_bufs, v_bufs = pf(self._p_list(), self._b_list(),
                                        ids, jnp.asarray(s, jnp.int32))
         else:
@@ -1130,7 +1598,7 @@ class Engine:
                  self._bnames_all),
                 1, s, L, self._nh, self._hd, self._kv_dtype)
             last0, k_bufs, v_bufs = pf(self._p_list(), self._b_list(),
-                                       req.prompt[None, :])
+                                       tokens[None, :])
         i = slot.index
         if self._insert_fn is None:
             import jax
@@ -1190,11 +1658,12 @@ class Engine:
         import jax.numpy as jnp
         req = slot.request
         i = slot.index
-        s = len(req.prompt)
+        tokens = req.context  # prompt, or the frozen resume snapshot
+        s = len(tokens)
         p0 = slot.prefilled
         C = self._chunk
         ids = np.zeros((1, C), np.int32)  # right-padded final chunk
-        ids[0, :n] = req.prompt[p0:p0 + n]
+        ids[0, :n] = tokens[p0:p0 + n]
         with self.tracer.span(
                 "prefill.chunk", req=req.id, pos=p0, n=n,
                 layout="paged" if self._paged else "contiguous"):
@@ -1234,10 +1703,11 @@ class Engine:
             # write on the next chunk's start row
             self._pos[i] = slot.prefilled
             return 0
-        # final chunk: the prompt's full blocks become adoptable and
-        # the last real position's logits sample the first token (TTFT)
+        # final chunk: the context's full blocks become adoptable and
+        # the last real position's logits sample the first token (TTFT
+        # on a fresh admission; the NEXT stream token on a resume)
         if self._paged and self.prefix_cache is not None:
-            self.prefix_cache.insert(req.prompt,
+            self.prefix_cache.insert(tokens,
                                      self._slot_blocks[i][:s // self._bs])
         self._pos[i] = s
         tok = self._pick(req, np.asarray(last0, np.float32)[0])
@@ -1259,7 +1729,7 @@ class Engine:
         while queue and budget > 0:
             slot = queue.popleft()
             req = slot.request
-            n = min(self._chunk, len(req.prompt) - slot.prefilled)
+            n = min(self._chunk, len(req.context) - slot.prefilled)
             if n > budget:
                 break  # strict per-tick cap (budget >= chunk, so a
                 #        tick's FIRST chunk always fits: progress is
@@ -1389,8 +1859,21 @@ class Engine:
             if n_lanes > 0:
                 history = np.concatenate(
                     [req.prompt, np.asarray(req.generated, np.int32)])
-                d = np.asarray(self.proposer.propose(history, n_lanes),
-                               np.int32).reshape(-1)[:n_lanes]
+                try:
+                    self._fault("spec_draft")
+                    d = np.asarray(
+                        self.proposer.propose(history, n_lanes),
+                        np.int32).reshape(-1)[:n_lanes]
+                except Exception as e:
+                    # a proposer outage DEGRADES (zero drafts — the
+                    # verify window still emits its bonus token, i.e.
+                    # plain decode speed) instead of failing the tick
+                    # and evicting every in-flight request
+                    self._m_proposer_failures.inc()
+                    self.tracer.instant(
+                        "spec.proposer_failed", cat="serving",
+                        req=req.id, error=repr(e))
+                    d = np.zeros(0, np.int32)
                 toks[i, 1:1 + len(d)] = d
                 n_drafted = len(d)
             slot.spec_lanes = n_drafted  # in-flight REAL draft lanes —
@@ -1428,6 +1911,7 @@ class Engine:
                  tuple(self._pnames), self._bnames_all),
                 paged=self._paged)
         fn = self._spec_fn
+        self._fault("dispatch")
         with tr.span("decode.dispatch", batch=len(active),
                      layout=layout, spec_w=W):
             if self._paged:
@@ -1540,6 +2024,7 @@ class Engine:
         args += [jnp.asarray(toks), jnp.asarray(lanes), st["pos"],
                  st["temp"], st["topk"], st["topp"], st["slo"],
                  st["shi"], st["ctr"], st["eos"], st["rem"]]
+        self._fault("dispatch")
         with tr.span("decode.dispatch", batch=len(active),
                      layout=layout, spec_w=W, fused=True):
             (picks, n_acc, n_emit, done, new_tok, new_pos, new_ctr,
@@ -1667,6 +2152,7 @@ class Engine:
                  st["topp"], st["slo"], st["shi"], st["ctr"],
                  st["eos"], st["rem"]]
         layout = "paged" if self._paged else "contiguous"
+        self._fault("dispatch")
         with tr.span("decode.dispatch", batch=len(active),
                      layout=layout, fused=True):
             (ids, done, new_tok, new_pos, new_ctr, new_rem,
@@ -1726,6 +2212,10 @@ class Engine:
         behind device compute."""
         wait_name = ("decode.d2h_wait" if self.async_depth > 1
                      else "decode.d2h")
+        # the injectable wedge: a scheduled d2h_hang blocks here (the
+        # engine's real sync point) until the watchdog converts it
+        # into a WatchdogTimeout raise -> step-failure recovery
+        self._fault("d2h_hang")
         t0 = time.monotonic()
         with tr.span(wait_name, tick=inf.tick) as d2h_sp:
             mats = {k: np.asarray(v) for k, v in inf.arrays.items()}
@@ -1795,6 +2285,7 @@ class Engine:
         fn = self._tick_fn
         tr = self.tracer
         layout = "paged" if self._paged else "contiguous"
+        self._fault("dispatch")
         with tr.span("decode.dispatch", batch=len(active),
                      layout=layout):
             if self._paged:
@@ -1838,6 +2329,9 @@ class Engine:
         # O(1) no-op while subscribed; re-subscribes a synchronous
         # driver that keeps ticking after a stop()
         self._register_compile_listener()
+        if self.watchdog_s is not None and self._watchdog is None:
+            from .faults import TickWatchdog
+            self._watchdog = TickWatchdog(self, self.watchdog_s).start()
         try:
             return self._step_inner()
         except Exception as e:
@@ -1866,11 +2360,27 @@ class Engine:
     def _step_inner(self):
         self.tick_no += 1
         tr = self.tracer
-        with tr.span("tick", cat="tick", tick=self.tick_no) as tick_sp:
-            if self.async_depth > 1:
-                emitted = self._tick_async(tr, tick_sp)
-            else:
-                emitted = self._tick(tr, tick_sp)
+        # watchdog heartbeat: stamped for the tick's whole duration;
+        # a stale stamp is how the watchdog detects a wedged tick
+        self._watchdog_fired = False
+        self._tick_started_at = time.monotonic()
+        try:
+            self._fault("host_slow")
+            with tr.span("tick", cat="tick",
+                         tick=self.tick_no) as tick_sp:
+                if self.async_depth > 1:
+                    emitted = self._tick_async(tr, tick_sp)
+                else:
+                    emitted = self._tick(tr, tick_sp)
+        finally:
+            self._tick_started_at = None
+        if emitted:
+            now = time.monotonic()
+            with self._ovl_lock:
+                self._rate_win.append((now, emitted))
+            rate = self.drain_rate()
+            if rate is not None:
+                self._m_drain_tps.set(round(rate, 1))
         return emitted
 
     def _tick_async(self, tr, tick_sp):
@@ -1888,13 +2398,14 @@ class Engine:
         # -- planning / admission: host work in the gap --------------
         in_flight = bool(self._ring)
         t_plan = time.monotonic()
+        self._gate_declined = False
         ov = (tr.span("host.overlap", phase="plan") if in_flight
               else nullcontext())
         with ov:
             with tr.span("admit") as admit_sp:
                 timed_out = self.queue.expire(now)
                 admitted = []
-                if self.scheduler.admissible():
+                if not self._draining and self.scheduler.admissible():
                     admitted, admit_timed_out = self.scheduler.admit(
                         now, gate=self._kv_gate if self._paged
                         else None)
@@ -1903,15 +2414,13 @@ class Engine:
                                      timed_out=len(timed_out))
         if in_flight:
             self._overlap_acc += time.monotonic() - t_plan
-        for slot in admitted:
-            tr.instant("req.admitted", cat="request",
-                       req=slot.request.id, slot=slot.index)
-        if timed_out:
-            self._m_timeout.inc(len(timed_out))
-            self._m_done.inc(len(timed_out))
-            for req in timed_out:
-                tr.instant("req.evicted", cat="request", req=req.id,
-                           reason="timeout")
+        # priority preemption (outside the overlap span: it may have
+        # to consume the in-flight ring — a real sync, not hidden
+        # host work)
+        p_admitted, p_timed, p_emitted = self._preempt_round(now, tr)
+        emitted += p_emitted
+        admitted = self._post_admit(admitted + p_admitted,
+                                    timed_out + p_timed, tr)
         # -- prefill / chunk planning (mutates only the admitted
         #    slots' lanes; the dirty flag defers the re-upload) ------
         if self._chunk is None:
@@ -1995,25 +2504,26 @@ class Engine:
 
     def _tick(self, tr, tick_sp):
         now = time.monotonic()
+        emitted = 0
+        self._gate_declined = False
         # deadline sweep first: with a full pool nothing gets popped,
         # but queued requests must still time out on schedule
         with tr.span("admit") as admit_sp:
             timed_out = self.queue.expire(now)
-            admitted, admit_timed_out = self.scheduler.admit(
-                now, gate=self._kv_gate if self._paged else None)
-            timed_out = timed_out + admit_timed_out
+            admitted = []
+            if not self._draining:
+                admitted, admit_timed_out = self.scheduler.admit(
+                    now, gate=self._kv_gate if self._paged else None)
+                timed_out = timed_out + admit_timed_out
             admit_sp.args.update(admitted=len(admitted),
                                  timed_out=len(timed_out))
-        for slot in admitted:
-            tr.instant("req.admitted", cat="request",
-                       req=slot.request.id, slot=slot.index)
-        if timed_out:
-            self._m_timeout.inc(len(timed_out))
-            self._m_done.inc(len(timed_out))
-            for req in timed_out:
-                tr.instant("req.evicted", cat="request", req=req.id,
-                           reason="timeout")
-        emitted = 0
+        # priority preemption: evict the lowest-priority running slot
+        # when the best queued request outranks it and admission is
+        # blocked (no async ring at depth 1, so no drain involved)
+        p_admitted, p_timed, p_emitted = self._preempt_round(now, tr)
+        emitted += p_emitted
+        admitted = self._post_admit(admitted + p_admitted,
+                                    timed_out + p_timed, tr)
         if self._chunk is None:
             for slot in admitted:
                 # read the id up front: an EOS-on-first-token prefill
@@ -2087,6 +2597,7 @@ class Engine:
         # (the flag is the owning loop's stop event, so a stale loop
         # comparing against its own event can never match after this)
         self._drain_on_exit = None
+        self._draining = False  # a restarted engine admits again
         # each loop carries its OWN stop event: a stop-pending loop
         # keeps honoring the event it was born with while the new loop
         # runs against the fresh one
@@ -2138,6 +2649,9 @@ class Engine:
         # parks its lanes and dirties the mirrors)
         self._ring = []
         for req in self.queue.drain():
+            # a preempted host-mode request waiting in queue still
+            # holds its numpy rng stream — shutdown must release it
+            self._rngs.pop(req.id, None)
             self._m_done.inc()
         for slot in self.scheduler.busy_slots():
             req = self.scheduler.evict(
@@ -2153,9 +2667,34 @@ class Engine:
         self._m_queue.set(0)
         self._m_occ.set(0)
 
-    def stop(self, drain=True, join_timeout=30.0):
-        """Stop the background loop; optionally fail queued requests."""
+    def stop(self, drain=True, join_timeout=30.0, drain_timeout=None):
+        """Stop the background loop.
+
+        ``drain=True`` (default) is a GRACEFUL DRAIN: submission
+        closes (``submit`` sheds with QueueFull) and no queued
+        request is admitted, but the loop keeps ticking until every
+        IN-FLIGHT stream finishes — their waiters receive complete
+        outputs instead of an "engine stopped" error.  The wait is
+        bounded by ``drain_timeout`` (default: ``join_timeout``);
+        whatever is still running past the bound, plus every
+        queued-but-never-admitted request, is failed by the final
+        hard drain — shutdown always terminates.  ``drain=False``
+        halts the loop in place without failing anything (requests
+        stay pending for a later ``start()``)."""
         evt = self._stop
+        t = self._thread
+        if drain and t is not None and t.is_alive() \
+                and not evt.is_set():
+            # graceful phase: the live loop finishes the in-flight
+            # streams while admissions are held off
+            self._draining = True
+            self._wake.set()
+            limit = (join_timeout if drain_timeout is None
+                     else drain_timeout)
+            deadline = time.monotonic() + max(float(limit), 0.0)
+            while time.monotonic() < deadline \
+                    and self.scheduler.busy_slots():
+                time.sleep(0.002)
         if drain:
             # delegate BEFORE set+join: a loop that exits inside the
             # join window must still see the delegation (it drains in
@@ -2163,7 +2702,9 @@ class Engine:
             self._drain_on_exit = evt
         evt.set()
         self._wake.set()  # unblock an idle loop's event wait now
-        t = self._thread
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None  # a later step()/start() re-arms
         if t is not None:
             t.join(timeout=join_timeout)
             if t.is_alive():
@@ -2172,7 +2713,12 @@ class Engine:
                 # on exit instead; the handle stays so a later start()
                 # serializes behind it — and the compile listener stays
                 # subscribed, because that in-flight dispatch may be
-                # the very compile worth recording
+                # the very compile worth recording.  Clear the drain
+                # flag NOW: the stop event already keeps this loop
+                # from admitting again, and a later synchronous
+                # driver (step() after stop() is supported) must not
+                # find admissions permanently disabled
+                self._draining = False
                 return
             self._thread = None
         # only AFTER the loop is confirmed down: a stopped engine must
@@ -2183,6 +2729,7 @@ class Engine:
         if drain:
             self._drain_on_exit = None
             self._drain()
+        self._draining = False  # a later start() serves normally
 
     def __enter__(self):
         return self

@@ -1,0 +1,238 @@
+"""Deterministic fault injection + tick watchdog for the engine.
+
+The engine's recovery paths (step-failure eviction, pool rebuild,
+flight recorder, async ring drop) were each built against ONE
+hand-injected failure.  Production failures compose: a dispatch error
+lands while a tick is in flight, the pool runs dry during the
+recovery re-admission, the host stalls mid-consume.  This module makes
+that composition testable and REPRODUCIBLE:
+
+* ``FaultInjector`` — named failure points (``SITES``) threaded
+  through engine / kvcache / spec.  Whether a site fires at a given
+  engine tick is a PURE FUNCTION of ``(seed, site, tick)`` (a blake2b
+  hash against the site's configured rate), so a storm's schedule is
+  reproducible from its seed alone, independent of wall-clock timing,
+  thread interleaving, or how many times a site is consulted — plus
+  explicit one-shot entries via ``at(tick, site)`` for targeted tests.
+  The injector records every fired (tick, site) in ``log``; the chaos
+  tests assert the same seed replays the same log.
+
+* ``TickWatchdog`` — a daemon thread that watches the engine's
+  tick-start heartbeat.  A tick that exceeds ``timeout_s`` (a wedged
+  in-flight dispatch, a hung d2h) gets flight-recorded IMMEDIATELY
+  (``Engine.last_flight`` snapshots the in-flight state while it is
+  still observable) and the engine is marked ``_watchdog_fired`` —
+  cooperative blocking points (the injected d2h hang, and any real
+  wait loop that polls the flag) convert the wedge into a
+  ``WatchdogTimeout`` raise, which lands in the EXISTING
+  step-failure recovery path: waiters unblock, pools rebuild, the
+  engine serves on.  A truly uninterruptible wedge (real hardware
+  hang) still gets the flight dump and an unhealthy mark instead of
+  a silent freeze.
+
+Fault sites (who checks them, what firing does):
+
+====================  ===============================  ==============
+site                  checked at                        action
+====================  ===============================  ==============
+``dispatch``          decode / spec-verify dispatch     raises
+                      (engine)                          InjectedFault
+``d2h_hang``          consume-side materialize          hangs
+                      (engine)                          ``hang_s``
+                                                        (watchdog
+                                                        converts to
+                                                        a raise)
+``pool_exhaust``      BlockPool.alloc (kvcache hook)    raises
+                                                        NoFreeBlocks
+``host_slow``         tick start (engine)               sleeps
+                                                        ``slow_s``
+``spec_draft``        proposer call (engine spec        raises inside
+                      draft loop)                       the draft
+                                                        try — the
+                                                        engine
+                                                        degrades to
+                                                        zero drafts
+====================  ===============================  ==============
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import weakref
+
+
+class InjectedFault(RuntimeError):
+    """A FaultInjector site fired (the simulated transient failure)."""
+
+
+class WatchdogTimeout(RuntimeError):
+    """The tick watchdog declared an in-flight tick wedged."""
+
+
+SITES = ("dispatch", "d2h_hang", "pool_exhaust", "host_slow",
+         "spec_draft")
+
+
+class FaultInjector:
+    """Seeded, schedulable failure points.
+
+    Parameters
+    ----------
+    seed : storm seed.  ``scheduled(site, tick)`` hashes
+        ``(seed, site, tick)`` against ``rates[site]`` — a pure
+        function, so the same seed always yields the same schedule.
+    rates : dict site -> fire probability per (site, tick).  Sites
+        absent from the dict never fire stochastically (explicit
+        ``at()`` entries still do).
+    hang_s : simulated d2h hang duration.  The hang is COOPERATIVE:
+        it sleeps in small increments polling the engine's
+        ``_watchdog_fired`` flag, so an armed watchdog converts it
+        into a WatchdogTimeout raise mid-hang; without a watchdog it
+        is just a bounded slow consume.
+    slow_s : host_slow sleep per firing.
+    first_tick / last_tick : stochastic firing window (inclusive;
+        None = unbounded on that side).  A chaos storm bounds it so
+        the engine warms up and drains to idle cleanly around the
+        storm, leaving the invariants checkable — explicit ``at()``
+        entries ignore the window.
+    """
+
+    def __init__(self, seed=0, rates=None, hang_s=0.05, slow_s=0.01,
+                 first_tick=None, last_tick=None):
+        self.seed = int(seed)
+        rates = dict(rates or {})
+        unknown = set(rates) - set(SITES)
+        if unknown:
+            raise ValueError(
+                f"unknown fault sites {sorted(unknown)}; known: {SITES}")
+        self.rates = rates
+        self.hang_s = float(hang_s)
+        self.slow_s = float(slow_s)
+        self.first_tick = first_tick
+        self.last_tick = last_tick
+        self._explicit = set()   # (site, tick) one-shot entries
+        self.log = []            # fired (tick, site), in firing order
+
+    def at(self, tick, site):
+        """Schedule an explicit one-shot firing of ``site`` at engine
+        ``tick`` (exempt from ``last_tick``).  Returns self."""
+        if site not in SITES:
+            raise ValueError(f"unknown fault site {site!r}")
+        self._explicit.add((site, int(tick)))
+        return self
+
+    def _u01(self, site, tick):
+        h = hashlib.blake2b(f"{self.seed}:{site}:{tick}".encode(),
+                            digest_size=8).digest()
+        return int.from_bytes(h, "big") / 2.0 ** 64
+
+    def scheduled(self, site, tick):
+        """Pure schedule query: does ``site`` fire at ``tick``?"""
+        if (site, tick) in self._explicit:
+            return True
+        rate = self.rates.get(site, 0.0)
+        if rate <= 0.0:
+            return False
+        if self.first_tick is not None and tick < self.first_tick:
+            return False
+        if self.last_tick is not None and tick > self.last_tick:
+            return False
+        return self._u01(site, tick) < rate
+
+    def fire(self, site, tick, engine=None):
+        """Record the firing and perform the site's action (may raise;
+        the record lands FIRST so the log is complete even for raising
+        sites)."""
+        self.log.append((tick, site))
+        if site == "dispatch":
+            raise InjectedFault(
+                f"injected dispatch failure at tick {tick}")
+        if site == "pool_exhaust":
+            from .kvcache import NoFreeBlocks
+            raise NoFreeBlocks(
+                f"injected pool exhaustion at tick {tick}")
+        if site == "host_slow":
+            time.sleep(self.slow_s)
+            return
+        if site == "d2h_hang":
+            deadline = time.monotonic() + self.hang_s
+            while time.monotonic() < deadline:
+                if engine is not None and getattr(
+                        engine, "_watchdog_fired", False):
+                    raise WatchdogTimeout(
+                        f"watchdog converted a wedged d2h at tick "
+                        f"{tick} into step recovery")
+                time.sleep(0.002)
+            return
+        if site == "spec_draft":
+            raise InjectedFault(
+                f"injected proposer failure at tick {tick}")
+
+
+
+class TickWatchdog:
+    """Daemon thread converting a wedged engine tick into a recorded,
+    observable failure.
+
+    The engine stamps ``_tick_started_at`` on tick entry and clears it
+    on exit; the watchdog polls the stamp and, when one tick exceeds
+    ``timeout_s``:
+
+    1. flight-records the in-flight state NOW (``Engine.last_flight``
+       — the dump never materializes device futures, so a wedged
+       dispatch cannot block it),
+    2. sets ``engine._watchdog_fired`` so cooperative blocking points
+       raise ``WatchdogTimeout`` into the step-failure recovery path,
+    3. bumps ``serving.watchdog_fires``.
+
+    It holds only a weakref: a collected engine ends the thread.  One
+    firing per wedged tick (the flag clears at the next tick start).
+    """
+
+    def __init__(self, engine, timeout_s):
+        self.timeout_s = float(timeout_s)
+        if self.timeout_s <= 0:
+            raise ValueError(
+                f"watchdog timeout must be > 0, got {timeout_s}")
+        self._engine = weakref.ref(engine)
+        self._stop = threading.Event()
+        self._fired_for = None   # tick id already handled
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="paddle_tpu-serving-watchdog")
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def _run(self):
+        poll = max(self.timeout_s / 4.0, 0.002)
+        while not self._stop.wait(poll):
+            eng = self._engine()
+            if eng is None:
+                return
+            started = eng._tick_started_at
+            if started is None:
+                continue
+            tick = eng.tick_no
+            if tick == self._fired_for:
+                continue
+            if time.monotonic() - started > self.timeout_s:
+                self._fired_for = tick
+                ms = round(self.timeout_s * 1e3, 1)
+                exc = WatchdogTimeout(
+                    f"tick {tick} exceeded the {ms} ms watchdog — "
+                    "in-flight dispatch wedged")
+                try:
+                    eng._record_flight(exc)
+                    eng._m_watchdog.inc()
+                    eng.tracer.instant(
+                        "engine.watchdog", cat="engine", tick=tick,
+                        timeout_ms=round(self.timeout_s * 1e3, 3))
+                except Exception:
+                    pass  # the watchdog must never kill itself
+                eng._watchdog_fired = True

@@ -6,8 +6,13 @@ full submit -> queue -> slot -> result path over a real socket):
 
   POST /generate   {"prompt": [1,2,3], "max_new_tokens": 8,
                     "eos_token_id": null, "timeout": null,
-                    "temperature": 1.0, "top_k": 0, "top_p": 1.0}
+                    "temperature": 1.0, "top_k": 0, "top_p": 1.0,
+                    "priority": 0, "tenant": null}
                 -> {"ids": [...], "generated": [...], "ttft_ms": ...}
+                   overload: 503 QueueFull / DeadlineShed, 429
+                   RateLimited — each with a COMPUTED Retry-After
+                   (queue backlog over the measured drain rate /
+                   token-bucket refill time), not a fixed constant
   GET  /metrics    Prometheus text exposition (monitor registry)
   GET  /healthz    {"slots_free": n, "queue_depth": n,
                     "kv_blocks_free": n|null, ...} — always carries
@@ -30,7 +35,20 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import monitor
-from .request import QueueFull, RequestTimeout
+from .request import RateLimited, Rejected, RequestTimeout
+
+
+def _retry_after_header(e):
+    """Retry-After header dict from a Rejected exception's computed
+    hint (HTTP wants integer delta-seconds; round up, floor 1).
+    ``retry_after=None`` means the engine has NO honest backoff —
+    e.g. an over-burst request that can never pass its rate limit —
+    so no header is sent rather than a made-up constant that would
+    put a compliant client on a retry treadmill."""
+    ra = getattr(e, "retry_after", None)
+    if ra is None:
+        return {}
+    return {"Retry-After": str(max(int(-(-float(ra) // 1)), 1))}
 
 
 def _hist_mean(h):
@@ -122,6 +140,26 @@ class _Handler(BaseHTTPRequestHandler):
                 "d2h_wait_ms": _hist_mean(
                     getattr(eng, "_m_d2h_wait", None)),
             }
+            # overload-protection signals: preemption / shed counts,
+            # the measured drain rate behind Retry-After estimates,
+            # and the graceful-drain / watchdog state
+            def _cnt(name):
+                m = getattr(eng, name, None)
+                return 0 if m is None else int(m.value)
+            # ONE drain_rate() read: the staleness horizon means a
+            # second call can flip to None between two reads
+            rate = getattr(eng, "drain_rate", lambda: None)()
+            info.update({
+                "preemptions_total": _cnt("_m_preempt"),
+                "resumed_total": _cnt("_m_resumed"),
+                "shed_deadline_total": _cnt("_m_shed_deadline"),
+                "shed_rate_limited_total": _cnt("_m_shed_rate"),
+                "shed_queue_full_total": _cnt("_m_shed_queue"),
+                "watchdog_fires": _cnt("_m_watchdog"),
+                "drain_rate_tps": (None if rate is None
+                                   else round(rate, 1)),
+                "draining": bool(getattr(eng, "_draining", False)),
+            })
             if getattr(eng, "_paged", False):
                 info["kv_blocks_cached"] = (
                     eng.prefix_cache.cached_blocks()
@@ -170,12 +208,17 @@ class _Handler(BaseHTTPRequestHandler):
                 temperature=float(body.get("temperature", 1.0)),
                 top_k=int(body.get("top_k", 0)),
                 top_p=float(body.get("top_p", 1.0)),
-                seed=body.get("seed"))
-        except QueueFull as e:
-            # Retry-After: the queue is full of whole requests, so one
-            # decode's worth of seconds is a reasonable backoff hint
-            self._send_json(503, {"error": str(e)},
-                            headers={"Retry-After": "1"})
+                seed=body.get("seed"),
+                priority=int(body.get("priority", 0)),
+                tenant=body.get("tenant"))
+        except Rejected as e:
+            # every shed (QueueFull / DeadlineShed 503, RateLimited
+            # 429) carries the engine's COMPUTED backoff: queue
+            # backlog over the measured drain rate, or the token
+            # bucket's refill time — an honest hint, not a constant
+            code = 429 if isinstance(e, RateLimited) else 503
+            self._send_json(code, {"error": str(e)},
+                            headers=_retry_after_header(e))
             return
         except (TypeError, ValueError) as e:
             # TypeError covers JSON nulls / non-numeric fields hitting

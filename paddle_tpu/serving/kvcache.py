@@ -64,9 +64,17 @@ class BlockPool:
     ``reserved_blocks`` low ids are never handed out — the engine pins
     row 0 as the scratch block that parked (inactive) slots harmlessly
     read and write through.
+
+    ``fault_hook`` (optional): called with the request size before
+    every ``alloc`` — the deterministic chaos harness
+    (serving/faults.py) threads its "pool_exhaust" site through it,
+    raising ``NoFreeBlocks`` on scheduled ticks so recovery paths are
+    exercised against pool pressure that composes with other
+    failures.  None (default) costs nothing.
     """
 
-    def __init__(self, num_blocks, block_size, reserved_blocks=0):
+    def __init__(self, num_blocks, block_size, reserved_blocks=0,
+                 fault_hook=None):
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         if num_blocks - reserved_blocks < 1:
@@ -80,6 +88,7 @@ class BlockPool:
         self._free = list(range(self.num_blocks - 1,
                                 self.reserved_blocks - 1, -1))
         self._ref = [0] * self.num_blocks
+        self._fault_hook = fault_hook
 
     @property
     def managed_blocks(self):
@@ -98,6 +107,8 @@ class BlockPool:
         """Take ``n`` blocks off the free list at refcount 1."""
         if n < 0:
             raise ValueError(f"alloc({n})")
+        if self._fault_hook is not None:
+            self._fault_hook(n)  # chaos harness: may raise NoFreeBlocks
         if n > len(self._free):
             raise NoFreeBlocks(
                 f"need {n} blocks, only {len(self._free)} free of "

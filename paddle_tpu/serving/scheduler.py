@@ -51,9 +51,13 @@ class Slot:
 
     @property
     def decoding(self):
-        """Bound AND fully prefilled — eligible for the decode tick."""
+        """Bound AND fully prefilled — eligible for the decode tick.
+        Measured against ``req.context`` (prompt, or the frozen
+        prompt+emitted resume snapshot after a preemption): a resumed
+        request is only DECODING once its whole interrupted history
+        has K/V again."""
         req = self.request
-        return req is not None and self.prefilled >= len(req.prompt)
+        return req is not None and self.prefilled >= len(req.context)
 
 
 class Scheduler:
@@ -102,12 +106,16 @@ class Scheduler:
             for s in self.slots:
                 req = s.request
                 state = ("free" if req is None else
-                         "decoding" if s.prefilled >= len(req.prompt)
+                         "decoding" if s.prefilled >= len(req.context)
                          else "prefilling")
                 out.append({"slot": s.index, "state": state,
                             "request": req, "pos": s.pos,
                             "prefilled": s.prefilled,
-                            "spec_lanes": s.spec_lanes})
+                            "spec_lanes": s.spec_lanes,
+                            "priority": (None if req is None
+                                         else req.priority),
+                            "tenant": (None if req is None
+                                       else req.tenant)})
         return out
 
     def snapshot(self):
@@ -162,9 +170,24 @@ class Scheduler:
             timed_out.extend(expired)
             if req is None:
                 break
-            if gate is not None and not gate(req):
-                self.queue.push_front(req)
-                break
+            if gate is not None:
+                try:
+                    admit_ok = gate(req)
+                except BaseException:
+                    # a gate that RAISES (e.g. pool failure mid-
+                    # reservation) must not lose popped requests: put
+                    # this one and every not-yet-bound earlier pop
+                    # back in order, so their waiters survive the
+                    # step-failure recovery and later ticks retry
+                    # (stale _kv_plan reservations are overwritten by
+                    # the re-admission gate after the pool rebuilds)
+                    self.queue.push_front(req)
+                    for _, r in reversed(binds):
+                        self.queue.push_front(r)
+                    raise
+                if not admit_ok:
+                    self.queue.push_front(req)
+                    break
             binds.append((slot, req))
         if binds:
             with self._lock:
@@ -176,6 +199,19 @@ class Scheduler:
                     self._admit_seq += 1
                     slot.seq = self._admit_seq
         return [s for s, _ in binds], timed_out
+
+    def release(self, slot):
+        """Unbind a slot WITHOUT completing its request — the
+        PREEMPTION path: the caller (engine) requeues the request with
+        its emitted tokens preserved, so its waiter stays blocked and
+        the stream resumes on re-admission.  Returns the request."""
+        with self._lock:
+            req = slot.request
+            slot.request = None
+            slot.pos = 0
+            slot.prefilled = 0
+            slot.spec_lanes = 0
+        return req
 
     def evict(self, slot, error=None):
         """Free a slot and complete its request."""

@@ -40,6 +40,13 @@ Two proposers ship here:
 
 A proposer is a plain strategy object — stateless across requests —
 so one instance can serve every slot of an engine.
+
+Robustness contract: a proposer that RAISES mid-draft degrades, it
+does not kill the tick — the engine catches the exception, counts
+``serving.proposer_failures``, and runs the verify window with zero
+drafts (plain one-token decode speed) so no in-flight request is
+evicted over a drafting hiccup.  The deterministic chaos harness
+(serving/faults.py, ``spec_draft`` site) exercises exactly this path.
 """
 from __future__ import annotations
 

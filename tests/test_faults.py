@@ -1,0 +1,321 @@
+"""Deterministic fault injection + chaos storms (serving/faults.py).
+
+The injector's schedule is a pure function of (seed, site, tick) —
+asserted directly — and the chaos storms drive the FULL-FEATURE engine
+(paged + chunked + speculative + async depth 2 + priorities/preemption)
+against a seeded multi-failure schedule, then assert the whole
+recovery-invariant set: every waiter unblocked, pool refcounts at
+zero, no cross-slot stream corruption (greedy survivors are
+token-identical to generate()), the async ring empty, and the SAME
+SEED reproducing the same fault schedule, the same error sequence,
+and the same per-request outcomes.  All CPU, tiny model; the short
+storm is tier-1 (``chaos`` marker), the long one also ``slow``.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.models import GPTModel
+from paddle_tpu.serving import (Engine, FaultInjector, InjectedFault,
+                                NoFreeBlocks, PromptLookupProposer,
+                                WatchdogTimeout)
+from paddle_tpu.serving.faults import SITES
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    paddle.seed(0)
+    m = GPTModel.from_config("tiny", dropout=0.0)
+    m.eval()
+    return m
+
+
+def _prompts(lens=(5, 9, 12, 7, 16, 4)):
+    rng = np.random.RandomState(3)
+    return [rng.randint(0, 128, (l,)).astype(np.int32) for l in lens]
+
+
+# ---------------------------------------------------------------------------
+# injector unit behavior
+# ---------------------------------------------------------------------------
+
+def test_injector_schedule_is_pure_and_seeded():
+    """scheduled(site, tick) is a pure function of (seed, site, tick):
+    re-querying never changes it, equal seeds agree everywhere,
+    different seeds diverge somewhere, and rates 0/1 are exact."""
+    a = FaultInjector(seed=7, rates={"dispatch": 0.3})
+    b = FaultInjector(seed=7, rates={"dispatch": 0.3})
+    c = FaultInjector(seed=8, rates={"dispatch": 0.3})
+    sched_a = [a.scheduled("dispatch", t) for t in range(200)]
+    assert sched_a == [a.scheduled("dispatch", t) for t in range(200)]
+    assert sched_a == [b.scheduled("dispatch", t) for t in range(200)]
+    assert sched_a != [c.scheduled("dispatch", t) for t in range(200)]
+    n = sum(sched_a)
+    assert 20 <= n <= 100, f"rate 0.3 fired {n}/200 — hash is biased"
+    # sites are independent streams off one seed
+    assert ([a.scheduled("dispatch", t) for t in range(200)]
+            != [FaultInjector(seed=7, rates={"d2h_hang": 0.3})
+                .scheduled("d2h_hang", t) for t in range(200)])
+    always = FaultInjector(seed=0, rates={"host_slow": 1.0})
+    never = FaultInjector(seed=0, rates={})
+    assert all(always.scheduled("host_slow", t) for t in range(50))
+    assert not any(never.scheduled(s, t)
+                   for s in SITES for t in range(50))
+
+
+def test_injector_explicit_window_and_validation():
+    inj = FaultInjector(seed=0, rates={"dispatch": 1.0},
+                        first_tick=10, last_tick=20)
+    assert not inj.scheduled("dispatch", 9)
+    assert inj.scheduled("dispatch", 10)
+    assert inj.scheduled("dispatch", 20)
+    assert not inj.scheduled("dispatch", 21)
+    inj.at(3, "dispatch")               # explicit beats the window
+    assert inj.scheduled("dispatch", 3)
+    with pytest.raises(ValueError):
+        inj.at(1, "nope")
+    with pytest.raises(ValueError):
+        FaultInjector(rates={"bogus_site": 0.5})
+    with pytest.raises(InjectedFault):
+        inj.fire("dispatch", 3)
+    assert inj.log == [(3, "dispatch")]  # recorded before the raise
+
+
+# ---------------------------------------------------------------------------
+# single-site behavior through the engine
+# ---------------------------------------------------------------------------
+
+def test_dispatch_fault_recovers_engine(tiny_gpt):
+    """An injected dispatch failure lands in the existing step-failure
+    recovery: in-flight waiters unblock with errors, the engine and
+    pool rebuild, and later requests decode to parity."""
+    inj = FaultInjector(seed=0).at(2, "dispatch")
+    eng = Engine(tiny_gpt, num_slots=2, max_seq_len=48,
+                 kv_block_size=8, registry=monitor.StatRegistry(),
+                 faults=inj)
+    p = _prompts()[0]
+    doomed = eng.submit(p, max_new_tokens=8)
+    eng.step()                     # tick 1: admit + first token
+    with pytest.raises(InjectedFault):
+        eng.step()                 # tick 2: injected dispatch raise
+    assert doomed.done() and doomed.error is not None
+    assert inj.log == [(2, "dispatch")]
+    assert eng.registry.get("serving.faults_injected").value == 1
+    ok = eng.submit(p, max_new_tokens=6)
+    eng.run_until_idle()
+    ref = tiny_gpt.generate(paddle.to_tensor(p[None, :]),
+                            max_new_tokens=6).numpy()[0]
+    np.testing.assert_array_equal(ok.result(timeout=1), ref)
+
+
+def test_pool_exhaust_fault_requeues_popped_request(tiny_gpt):
+    """Regression: a gate that RAISES mid-reservation (injected pool
+    exhaustion) must not LOSE the popped request — it returns to the
+    queue head, survives the recovery, and completes on a later
+    tick."""
+    inj = FaultInjector(seed=0).at(3, "pool_exhaust")
+    eng = Engine(tiny_gpt, num_slots=2, max_seq_len=48,
+                 kv_block_size=8, registry=monitor.StatRegistry(),
+                 faults=inj)
+    p = _prompts()[0]
+    eng.step()                     # tick 1 idle
+    eng.step()                     # tick 2 idle
+    survivor = eng.submit(p, max_new_tokens=6)
+    with pytest.raises(NoFreeBlocks):
+        eng.step()                 # tick 3: alloc raises at the gate
+    assert not survivor.done()     # still queued, NOT lost
+    assert eng.queue.depth() == 1
+    eng.run_until_idle()
+    ref = tiny_gpt.generate(paddle.to_tensor(p[None, :]),
+                            max_new_tokens=6).numpy()[0]
+    np.testing.assert_array_equal(survivor.result(timeout=1), ref)
+    assert eng.block_pool.in_use() >= 0  # pool consistent
+    eng.prefix_cache.clear()
+    assert eng.block_pool.in_use() == 0
+
+
+def test_watchdog_converts_wedged_d2h_to_recovery(tiny_gpt):
+    """A wedged consume (injected d2h hang far longer than the
+    watchdog) is flight-recorded by the watchdog thread and converted
+    into a WatchdogTimeout raise -> step recovery: waiters unblock,
+    the engine serves on."""
+    inj = FaultInjector(seed=0, hang_s=5.0).at(3, "d2h_hang")
+    eng = Engine(tiny_gpt, num_slots=2, max_seq_len=48,
+                 registry=monitor.StatRegistry(), faults=inj,
+                 watchdog_s=0.05)
+    p = _prompts()[0]
+    doomed = eng.submit(p, max_new_tokens=8)
+    raised = None
+    for _ in range(6):
+        try:
+            eng.step()
+        except WatchdogTimeout as e:
+            raised = e
+            break
+    assert raised is not None, "watchdog never converted the hang"
+    assert doomed.done() and doomed.error is not None
+    assert eng.registry.get("serving.watchdog_fires").value >= 1
+    # the watchdog's dump (or the recovery's, which overwrites it)
+    # exists and names the wedge context
+    assert eng.last_flight is not None
+    meta = eng.last_flight["metadata"]["flight-recorder"]
+    assert "preemptions" in meta
+    ok = eng.submit(p, max_new_tokens=4)
+    eng.run_until_idle()
+    ref = tiny_gpt.generate(paddle.to_tensor(p[None, :]),
+                            max_new_tokens=4).numpy()[0]
+    np.testing.assert_array_equal(ok.result(timeout=1), ref)
+    eng.stop()
+
+
+def test_proposer_failure_degrades_not_fails(tiny_gpt):
+    """A raising proposer degrades to zero drafts (plain decode
+    speed): no eviction, greedy parity preserved, failures counted."""
+
+    class FlakyProposer(PromptLookupProposer):
+        def __init__(self):
+            super().__init__()
+            self.calls = 0
+
+        def propose(self, history, k):
+            self.calls += 1
+            if self.calls % 2:
+                raise RuntimeError("draft backend down")
+            return super().propose(history, k)
+
+    prop = FlakyProposer()
+    eng = Engine(tiny_gpt, num_slots=2, max_seq_len=48, spec_k=2,
+                 proposer=prop, registry=monitor.StatRegistry())
+    p = _prompts()[0]
+    r = eng.submit(p, max_new_tokens=8)
+    eng.run_until_idle()
+    ref = tiny_gpt.generate(paddle.to_tensor(p[None, :]),
+                            max_new_tokens=8).numpy()[0]
+    np.testing.assert_array_equal(r.result(timeout=1), ref)
+    assert eng.registry.get("serving.proposer_failures").value >= 1
+    assert prop.calls >= 2
+
+
+# ---------------------------------------------------------------------------
+# chaos storms
+# ---------------------------------------------------------------------------
+
+def _storm(model, seed, ticks, refs):
+    """One seeded storm over the full-feature engine.  Returns the
+    reproducibility signature (fault log, per-request outcomes, error
+    sequence) after asserting the invariant set."""
+    inj = FaultInjector(
+        seed=seed,
+        rates={"dispatch": 0.04, "d2h_hang": 0.03,
+               "pool_exhaust": 0.03, "host_slow": 0.05,
+               "spec_draft": 0.08},
+        hang_s=0.5, slow_s=0.002,
+        # the first storm ticks stay fault-free so the scripted
+        # priority burst below exercises preemption deterministically;
+        # nothing fires past the window so the engine drains clean
+        first_tick=12, last_tick=ticks)
+    eng = Engine(model, num_slots=3, max_seq_len=64, kv_block_size=8,
+                 prefill_chunk=8, tick_token_budget=16, spec_k=2,
+                 async_depth=2, watchdog_s=0.04,
+                 registry=monitor.StatRegistry())
+    prompts = _prompts()
+    for i in range(3):             # warm every compile shape
+        eng.submit(prompts[i], max_new_tokens=2)
+    eng.run_until_idle()
+    warm_ticks = eng.tick_no
+    inj.first_tick += warm_ticks
+    inj.last_tick += warm_ticks
+    eng.faults = inj
+    # scripted mixed traffic: greedy + seeded sampling, background
+    # (pri 0) + interactive (pri 3..7) — the t=2 burst lands while all
+    # three slots hold pri-0 streams, forcing a preemption before any
+    # fault fires
+    sched = {
+        0: [(0, 12, 0, None), (1, 10, 0, None), (2, 12, 0, None)],
+        2: [(3, 6, 5, None)],
+        8: [(4, 8, 0, 42)],
+        14: [(5, 10, 3, None)],
+        22: [(0, 8, 0, None), (1, 6, 7, None)],
+        30: [(2, 8, 0, None)],
+    }
+    reqs, errors = [], []
+    for t in range(ticks):
+        for (pi, mn, pri, sd) in sched.get(t, []):
+            kw = ({} if sd is None else
+                  {"temperature": 0.9, "top_p": 0.9, "seed": sd})
+            reqs.append((pi, mn, sd,
+                         eng.submit(prompts[pi], max_new_tokens=mn,
+                                    priority=pri, **kw)))
+        try:
+            eng.step()
+        except Exception as e:    # the background loop's contract:
+            errors.append(type(e).__name__)  # step already recovered
+    for _ in range(800):          # post-storm drain, faults silent
+        if eng.scheduler.idle():
+            break
+        try:
+            eng.step()
+        except Exception as e:
+            errors.append(type(e).__name__)
+    # -- invariants, asserted after EVERY storm -----------------------
+    assert eng.scheduler.idle(), "engine failed to drain after storm"
+    assert not eng._ring, "async ring holds futures at idle"
+    outcomes = []
+    for (pi, mn, sd, r) in reqs:
+        assert r.done(), f"waiter never unblocked: {r}"
+        if r.error is not None:
+            outcomes.append((pi, mn, "err", type(r.error).__name__))
+        else:
+            out = r.result(timeout=0).tolist()
+            if sd is None:        # greedy survivor: exact parity —
+                #   cross-slot corruption would show up here
+                assert out == refs[(pi, mn)], \
+                    f"stream corruption: prompt {pi} max_new {mn}"
+            outcomes.append((pi, mn, "ok", len(out)))
+    assert eng.registry.get("serving.preemptions_total").value >= 1, \
+        "storm never preempted (the scripted burst must)"
+    eng.prefix_cache.clear()      # cache refs released ->
+    assert eng.block_pool.in_use() == 0, "pool refcount leak"
+    assert sum(1 for o in outcomes if o[2] == "ok") >= 1
+    assert len(inj.log) >= 3, "storm fired too few faults to mean much"
+    return inj.log, outcomes, errors
+
+
+@pytest.mark.chaos
+def test_chaos_storm_short_deterministic(tiny_gpt):
+    """Tier-1 chaos: a ~60-tick seeded storm over the full-feature
+    engine holds every recovery invariant, and the same seed
+    reproduces the same fault schedule, error sequence, and
+    per-request outcomes — while a different seed diverges."""
+    prompts = _prompts()
+    refs = {}
+    # every GREEDY (prompt, max_new) pair the storm schedule submits
+    for (pi, mn) in [(0, 12), (1, 10), (2, 12), (3, 6), (5, 10),
+                     (0, 8), (1, 6), (2, 8)]:
+        refs[(pi, mn)] = tiny_gpt.generate(
+            paddle.to_tensor(prompts[pi][None, :]),
+            max_new_tokens=mn).numpy()[0].tolist()
+    a = _storm(tiny_gpt, seed=11, ticks=60, refs=refs)
+    b = _storm(tiny_gpt, seed=11, ticks=60, refs=refs)
+    c = _storm(tiny_gpt, seed=12, ticks=60, refs=refs)
+    assert a[0] == b[0], "same seed, different fault schedule"
+    assert a[1] == b[1], "same seed, different request outcomes"
+    assert a[2] == b[2], "same seed, different error sequence"
+    assert a[0] != c[0], "different seed, same fault schedule"
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_storm_long(tiny_gpt):
+    """Longer storm (3 seeds x 150 ticks): every invariant, every
+    seed."""
+    prompts = _prompts()
+    refs = {}
+    for pi in range(len(prompts)):
+        for mn in (6, 8, 10, 12):
+            refs[(pi, mn)] = tiny_gpt.generate(
+                paddle.to_tensor(prompts[pi][None, :]),
+                max_new_tokens=mn).numpy()[0].tolist()
+    for seed in (21, 22, 23):
+        _storm(tiny_gpt, seed=seed, ticks=150, refs=refs)

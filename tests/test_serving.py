@@ -13,6 +13,7 @@ import io
 import json
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -24,7 +25,8 @@ from paddle_tpu.models import GPTModel
 from paddle_tpu.serving import (Engine, EngineServer, QueueFull,
                                 RequestQueue, RequestTimeout, Request,
                                 Proposer, PromptLookupProposer,
-                                DraftModelProposer)
+                                DraftModelProposer, TenantPolicy,
+                                RateLimited, DeadlineShed, Rejected)
 
 
 @pytest.fixture(scope="module")
@@ -1716,3 +1718,487 @@ def test_greedy_neighbor_does_not_perturb_seeded_stream(tiny_gpt):
     g2, s2 = run()
     assert s1 == s2, "seeded stream must not depend on neighbors' ids"
     assert g1 == g2                      # greedy was always stable
+
+
+# ---------------------------------------------------------------------------
+# overload protection: priorities, preemption, fairness, shedding, drain
+# ---------------------------------------------------------------------------
+
+def _ref(model, p, n):
+    return model.generate(paddle.to_tensor(p[None, :]),
+                          max_new_tokens=n).numpy()[0]
+
+
+@pytest.mark.parametrize("cfg", [
+    {},                                                    # contiguous
+    {"kv_block_size": 8},                                  # paged
+    {"prefill_chunk": 8, "tick_token_budget": 16},         # chunked
+    {"kv_block_size": 8, "prefill_chunk": 8,
+     "tick_token_budget": 16},                             # paged+chunk
+    {"spec_k": 2},                                         # spec
+    {"kv_block_size": 8, "spec_k": 2},                     # paged+spec
+    {"kv_block_size": 8, "async_depth": 2},                # depth 2
+], ids=["contiguous", "paged", "chunked", "paged+chunked", "spec",
+        "paged+spec", "paged+depth2"])
+def test_preempt_resume_greedy_parity(tiny_gpt, cfg):
+    """A high-priority arrival preempts the running low-priority
+    stream mid-decode; BOTH finish token-identical to uninterrupted
+    generate() — across every dispatch layout.  The resumed stream's
+    continuation is exactly where the eviction interrupted it."""
+    eng = _engine(tiny_gpt, num_slots=1, **cfg)
+    p_low, p_high = _prompts(2)
+    low = eng.submit(p_low, max_new_tokens=12, priority=0)
+    for _ in range(5):
+        eng.step()                 # low is mid-stream
+    assert not low.done()
+    high = eng.submit(p_high, max_new_tokens=4, priority=5)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(high.result(timeout=1),
+                                  _ref(tiny_gpt, p_high, 4))
+    np.testing.assert_array_equal(low.result(timeout=1),
+                                  _ref(tiny_gpt, p_low, 12))
+    assert low.preemptions >= 1
+    reg = eng.registry
+    assert reg.get("serving.preemptions_total").value >= 1
+    assert reg.get("serving.resumed_total").value >= 1
+    # refcount hygiene after the preempt/resume cycle
+    if eng._paged:
+        if eng.prefix_cache is not None:
+            eng.prefix_cache.clear()
+        assert eng.block_pool.in_use() == 0
+
+
+def test_preemption_returns_blocks_to_prefix_cache(tiny_gpt):
+    """Paged preemption inserts the computed history's full blocks
+    into the prefix cache, so the resume ADOPTS them instead of
+    re-prefilling the whole interrupted stream."""
+    eng = _engine(tiny_gpt, num_slots=1, kv_block_size=8)
+    p_low, p_high = _prompts(2)
+    low = eng.submit(p_low, max_new_tokens=12)
+    for _ in range(6):             # len(prompt)=5, +6 tokens: past a
+        eng.step()                 # full 8-token block boundary
+    high = eng.submit(p_high, max_new_tokens=4, priority=5)
+    eng.run_until_idle()
+    low.result(timeout=1)
+    # the resume adopted at least the first full block of the frozen
+    # prompt+emitted context
+    assert eng.registry.get("serving.prefix_hit_tokens").value >= 8
+    assert eng.registry.get("serving.prefix_hits").value >= 1
+
+
+def test_preempt_seeded_stream_unchanged(tiny_gpt):
+    """Seeded top-p stream across a preemption == uninterrupted run:
+    the device key folds the emitted-token counter, so resumption
+    must not re-draw."""
+    p_low, p_high = _prompts(2)
+
+    def run(interrupt):
+        eng = _engine(tiny_gpt, num_slots=1, kv_block_size=8)
+        r = eng.submit(p_low, max_new_tokens=10, temperature=0.9,
+                       top_p=0.9, seed=42)
+        if interrupt:
+            for _ in range(4):
+                eng.step()
+            eng.submit(p_high, max_new_tokens=3, priority=9)
+        eng.run_until_idle()
+        return r.result(timeout=1).tolist(), r.preemptions
+
+    plain, n0 = run(False)
+    interrupted, n1 = run(True)
+    assert n0 == 0 and n1 >= 1
+    assert plain == interrupted
+
+
+def test_preempt_seeded_host_mode_stream_unchanged(tiny_gpt):
+    """Host sampling keeps its per-request numpy rng stream alive
+    across a preemption — the resumed draws continue the stream."""
+    p_low, p_high = _prompts(2)
+
+    def run(interrupt):
+        eng = _engine(tiny_gpt, num_slots=1, sample_mode="host")
+        r = eng.submit(p_low, max_new_tokens=10, temperature=0.9,
+                       top_p=0.9, seed=123)
+        if interrupt:
+            for _ in range(4):
+                eng.step()
+            eng.submit(p_high, max_new_tokens=3, priority=9)
+        eng.run_until_idle()
+        return r.result(timeout=1).tolist()
+
+    assert run(False) == run(True)
+
+
+def test_no_preemption_at_equal_priority_or_disabled(tiny_gpt):
+    """Equal priority never preempts (strictly-lower only), and
+    Engine(preemption=False) turns the mechanism off entirely."""
+    p1, p2 = _prompts(2)
+    eng = _engine(tiny_gpt, num_slots=1)
+    a = eng.submit(p1, max_new_tokens=6, priority=3)
+    eng.step()
+    b = eng.submit(p2, max_new_tokens=4, priority=3)
+    eng.run_until_idle()
+    assert a.preemptions == 0 and b.preemptions == 0
+    assert eng.registry.get("serving.preemptions_total").value == 0
+
+    eng2 = _engine(tiny_gpt, num_slots=1, preemption=False)
+    c = eng2.submit(p1, max_new_tokens=6, priority=0)
+    eng2.step()
+    d = eng2.submit(p2, max_new_tokens=4, priority=9)
+    eng2.run_until_idle()
+    assert c.preemptions == 0
+    assert eng2.registry.get("serving.preemptions_total").value == 0
+    # outputs still correct, just FIFO-ordered
+    np.testing.assert_array_equal(c.result(timeout=1),
+                                  _ref(tiny_gpt, p1, 6))
+    np.testing.assert_array_equal(d.result(timeout=1),
+                                  _ref(tiny_gpt, p2, 4))
+
+
+def test_priority_orders_queue_service(tiny_gpt):
+    """Queued high-priority requests are admitted before earlier-
+    submitted low-priority ones (strict tiers)."""
+    eng = _engine(tiny_gpt, num_slots=1, preemption=False)
+    p = _prompts(1)[0]
+    blocker = eng.submit(p, max_new_tokens=4, priority=0)
+    eng.step()
+    low = eng.submit(p, max_new_tokens=4, priority=0)
+    high = eng.submit(p, max_new_tokens=4, priority=2)
+    eng.run_until_idle()
+    for r in (blocker, low, high):
+        r.result(timeout=1)
+    assert high.finished_at < low.finished_at
+
+
+def test_weighted_fair_queue_pop_order():
+    """SFQ unit: with weights {a: 1, b: 3} and equal token costs, a
+    backlogged b gets ~3 of every 4 pops; within one tenant order
+    stays FIFO."""
+    q = RequestQueue(weights={"a": 1.0, "b": 3.0})
+    a_reqs = [Request([1, 2, 3, 4], 4, tenant="a") for _ in range(12)]
+    b_reqs = [Request([1, 2, 3, 4], 4, tenant="b") for _ in range(12)]
+    for ra, rb in zip(a_reqs, b_reqs):
+        q.put(ra)
+        q.put(rb)
+    order = []
+    while q.depth():
+        req, _ = q.pop_ready()
+        order.append(req)
+    share_b = [r.tenant for r in order[:8]].count("b")
+    assert share_b >= 5, f"weight-3 tenant got {share_b}/8 early pops"
+    got_a = [r for r in order if r.tenant == "a"]
+    got_b = [r for r in order if r.tenant == "b"]
+    assert [r.id for r in got_a] == [r.id for r in a_reqs]   # FIFO
+    assert [r.id for r in got_b] == [r.id for r in b_reqs]
+    # strict priority beats fairness
+    q2 = RequestQueue()
+    lo = Request([1], 2, priority=0)
+    hi = Request([1], 2, priority=4)
+    q2.put(lo)
+    q2.put(hi)
+    assert q2.best_priority() == 4
+    assert q2.pop_ready()[0] is hi
+
+
+def test_fairness_flooding_tenant_cannot_starve(tiny_gpt):
+    """Engine-level fairness: tenant "flood" queues 12 requests ahead
+    of tenant "paid" (weight 4); paid's 4 requests all finish well
+    before flood's tail — the flood cannot starve paid past its
+    weight."""
+    eng = _engine(tiny_gpt, num_slots=2,
+                  tenants={"paid": {"weight": 4.0}})
+    p = _prompts(1)[0]
+    flood = [eng.submit(p, max_new_tokens=4, tenant="flood")
+             for _ in range(12)]
+    paid = [eng.submit(p, max_new_tokens=4, tenant="paid")
+            for _ in range(4)]
+    eng.run_until_idle()
+    done = sorted(flood + paid, key=lambda r: r.finished_at)
+    worst_paid = max(done.index(r) for r in paid)
+    assert worst_paid < 10, \
+        f"paid tenant's last finish ranked {worst_paid}/16"
+
+
+def test_tenant_token_bucket_rate_limit(tiny_gpt):
+    """Sustained over-rate traffic from one tenant is shed at submit
+    with RateLimited + honest retry_after; other tenants unaffected."""
+    eng = _engine(tiny_gpt,
+                  tenants={"free": TenantPolicy(rate=10.0,
+                                                burst=20.0)})
+    p = _prompts(1)[0]          # cost = 5 prompt + 4 new = 9 tokens
+    eng.submit(p, max_new_tokens=4, tenant="free")
+    eng.submit(p, max_new_tokens=4, tenant="free")  # burst exhausted
+    with pytest.raises(RateLimited) as ei:
+        for _ in range(5):
+            eng.submit(p, max_new_tokens=4, tenant="free")
+    assert ei.value.retry_after > 0
+    assert eng.registry.get(
+        "serving.shed_rate_limited_total").value >= 1
+    # a different tenant still submits fine
+    eng.submit(p, max_new_tokens=4, tenant="other")
+    eng.run_until_idle()
+
+
+def test_deadline_shed_at_submit(tiny_gpt):
+    """Once the drain rate is measured, a request whose deadline the
+    queue backlog already blows is rejected at submit (DeadlineShed,
+    computed retry_after) instead of timing out in queue."""
+    eng = _engine(tiny_gpt, num_slots=1)
+    p = _prompts(1)[0]
+    warm = eng.submit(p, max_new_tokens=8)
+    eng.run_until_idle()                  # drain rate now measured
+    warm.result(timeout=1)
+    assert eng.drain_rate() is not None
+    eng.submit(p, max_new_tokens=30)      # occupies the only slot
+    for _ in range(30):                   # deep backlog
+        eng.submit(p, max_new_tokens=30)
+    with pytest.raises(DeadlineShed) as ei:
+        eng.submit(p, max_new_tokens=4, timeout=0.001)
+    assert ei.value.retry_after > 0
+    assert eng.registry.get("serving.shed_deadline_total").value == 1
+    # shed_deadlines=False keeps the old behavior (queue, then expire)
+    eng2 = _engine(tiny_gpt, num_slots=1, shed_deadlines=False)
+    w2 = eng2.submit(p, max_new_tokens=8)
+    eng2.run_until_idle()
+    eng2.submit(p, max_new_tokens=30)
+    for _ in range(30):
+        eng2.submit(p, max_new_tokens=30)
+    doomed = eng2.submit(p, max_new_tokens=4, timeout=0.001)
+    assert doomed is not None             # queued, not shed
+
+
+def test_queue_full_retry_after_computed(tiny_gpt):
+    """QueueFull's retry_after comes from the measured drain rate
+    (backlog / rate / depth), not a constant."""
+    eng = _engine(tiny_gpt, num_slots=1, max_queue=2)
+    p = _prompts(1)[0]
+    warm = eng.submit(p, max_new_tokens=8)
+    eng.run_until_idle()
+    warm.result(timeout=1)
+    eng.submit(p, max_new_tokens=16)
+    eng.step()                     # admitted into the only slot
+    eng.submit(p, max_new_tokens=16)
+    eng.submit(p, max_new_tokens=16)   # queue now at max_queue=2
+    with pytest.raises(QueueFull) as ei:
+        eng.submit(p, max_new_tokens=16)
+    assert ei.value.retry_after is not None
+    assert 0 < ei.value.retry_after < 60
+    assert eng.registry.get(
+        "serving.shed_queue_full_total").value == 1
+    eng.run_until_idle()
+
+
+def test_graceful_drain_finishes_inflight(tiny_gpt):
+    """stop(drain=True): in-flight streams FINISH (waiters get
+    complete outputs), queued-but-unadmitted requests fail, submits
+    during the drain are shed, and the wait is bounded."""
+    eng = _engine(tiny_gpt, num_slots=2)
+    p = _prompts(1)[0]
+    eng.start()
+    inflight = [eng.submit(p, max_new_tokens=12) for _ in range(2)]
+    time.sleep(0.05)               # both admitted, mid-stream
+    t0 = time.monotonic()
+    eng.stop(drain=True, drain_timeout=10.0)
+    assert time.monotonic() - t0 < 10.0
+    for r in inflight:
+        out = r.result(timeout=1)  # complete output, no error
+        assert out.shape[0] == len(p) + 12
+    # while the drain flag is up, submission is closed (shed with the
+    # Rejected shape the HTTP edge maps to 503)
+    eng._draining = True
+    with pytest.raises(QueueFull):
+        eng.submit(p, max_new_tokens=2)
+    eng._draining = False
+
+
+def test_graceful_drain_bounds_at_timeout(tiny_gpt):
+    """A drain that cannot finish inside drain_timeout falls back to
+    the hard drain — shutdown always terminates, stragglers fail."""
+    eng = _engine(tiny_gpt, num_slots=1)
+    p = _prompts(1)[0]
+    eng.start()
+    r = eng.submit(p, max_new_tokens=40)
+    time.sleep(0.02)
+    eng.stop(drain=True, drain_timeout=0.0)   # no grace at all
+    assert r.done()
+    # either it squeaked through or it was failed — but never hangs
+    if r.error is None:
+        assert len(r.generated) == 40
+
+
+def test_scheduler_debug_view_carries_priority_tenant(tiny_gpt):
+    eng = _engine(tiny_gpt, num_slots=2)
+    p = _prompts(1)[0]
+    eng.submit(p, max_new_tokens=6, priority=3, tenant="acme")
+    eng.step()
+    view = eng.scheduler.debug_view()
+    bound = [v for v in view if v["state"] != "free"]
+    assert bound and bound[0]["priority"] == 3
+    assert bound[0]["tenant"] == "acme"
+    free = [v for v in view if v["state"] == "free"]
+    assert free and free[0]["priority"] is None
+    dbg = eng.debug_requests()
+    assert dbg["engine"]["preemption"] is True
+    assert dbg["engine"]["draining"] is False
+    assert "preemptions" in dbg
+    eng.run_until_idle()
+
+
+def test_preempt_log_rides_flight_recorder(tiny_gpt, monkeypatch):
+    """The flight-recorder dump carries the preemption/requeue history
+    ring, so a post-mortem shows WHY a slot was evicted."""
+    eng = _engine(tiny_gpt, num_slots=1, kv_block_size=8)
+    p_low, p_high = _prompts(2)
+    low = eng.submit(p_low, max_new_tokens=12)
+    for _ in range(4):
+        eng.step()
+    eng.submit(p_high, max_new_tokens=4, priority=7)
+    eng.step()                     # preemption happens here
+    assert eng.registry.get("serving.preemptions_total").value >= 1
+    boom = RuntimeError("injected")
+    monkeypatch.setattr(
+        eng, "_dispatch_decode",
+        lambda *a, **k: (_ for _ in ()).throw(boom))
+    with pytest.raises(RuntimeError):
+        for _ in range(50):
+            eng.step()
+    meta = eng.last_flight["metadata"]["flight-recorder"]
+    assert meta["preemptions"], "no preemption history in the dump"
+    entry = meta["preemptions"][-1]
+    assert entry["request"] == low.id and entry["priority"] == 0
+    assert entry["generated"] >= 1
+
+
+def test_httpd_overload_surface(tiny_gpt):
+    """HTTP edge: priority/tenant ride the POST body, RateLimited maps
+    to 429 with a Retry-After, and /healthz + /debug/requests expose
+    the overload-protection signals."""
+    eng = _engine(tiny_gpt, max_queue=8,
+                  tenants={"free": TenantPolicy(rate=5.0, burst=10.0)})
+    with EngineServer(eng, port=0) as srv:
+        base = srv.address
+        body = {"prompt": [1, 2, 3], "max_new_tokens": 4,
+                "priority": 2, "tenant": "free"}
+        req = urllib.request.Request(
+            base + "/generate", json.dumps(body).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+        # second submit: the 10-token bucket cannot cover another 7
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/generate", json.dumps(body).encode(),
+                {"Content-Type": "application/json"}))
+            raise AssertionError("expected 429")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+            assert int(e.headers["Retry-After"]) >= 1
+        with urllib.request.urlopen(base + "/healthz") as resp:
+            h = json.loads(resp.read())
+        for key in ("preemptions_total", "resumed_total",
+                    "shed_deadline_total", "shed_rate_limited_total",
+                    "shed_queue_full_total", "watchdog_fires",
+                    "drain_rate_tps", "draining"):
+            assert key in h, key
+        assert h["shed_rate_limited_total"] == 1
+        assert h["draining"] is False
+        with urllib.request.urlopen(base + "/debug/requests") as resp:
+            dbg = json.loads(resp.read())
+        assert "preemptions" in dbg
+        assert dbg["engine"]["preemption"] is True
+
+
+def test_rejected_exception_hierarchy():
+    """QueueFull/RateLimited/DeadlineShed are all Rejected with a
+    retry_after slot — the one shape the HTTP edge needs."""
+    for cls in (QueueFull, RateLimited, DeadlineShed):
+        e = cls("nope", retry_after=2.5)
+        assert isinstance(e, Rejected)
+        assert isinstance(e, RuntimeError)   # old callers keep working
+        assert e.retry_after == 2.5
+    assert QueueFull("x").retry_after is None
+
+
+def test_rate_limit_oversized_request_is_permanent(tiny_gpt):
+    """A request costing more than the bucket's burst can NEVER pass —
+    it is rejected with retry_after=None (honest: no finite backoff
+    admits it) instead of a finite hint that livelocks the client."""
+    eng = _engine(tiny_gpt,
+                  tenants={"t": TenantPolicy(rate=10.0, burst=12.0)})
+    p = _prompts(1)[0]                 # 5 prompt + 20 new = 25 > 12
+    with pytest.raises(RateLimited) as ei:
+        eng.submit(p, max_new_tokens=20, tenant="t")
+    assert ei.value.retry_after is None
+    assert "never" in str(ei.value)
+
+
+def test_bucket_refund_on_queue_full(tiny_gpt):
+    """A QueueFull rejection refunds the token-bucket charge: shed
+    classes must not cascade into RateLimited lockout."""
+    eng = _engine(tiny_gpt, max_queue=1,
+                  tenants={"t": TenantPolicy(rate=10.0, burst=20.0)})
+    p = _prompts(1)[0]                 # cost 5 + 4 = 9 tokens
+    eng.submit(p, max_new_tokens=4, tenant="t")   # bucket: 20 -> 11
+    with pytest.raises(QueueFull):
+        eng.submit(p, max_new_tokens=4, tenant="t")  # refunds the 9
+    # without the refund the bucket would hold ~2 < 9 and this would
+    # be RateLimited; with it the charge is back and the submit only
+    # hits the (still) full queue
+    with pytest.raises(QueueFull):
+        eng.submit(p, max_new_tokens=4, tenant="t")
+    eng.run_until_idle()
+
+
+def test_estimate_wait_zero_with_free_slots(tiny_gpt):
+    """A partially-loaded multi-slot engine must NOT deadline-shed a
+    request that a free slot (or a preemptable victim) would serve
+    immediately."""
+    eng = _engine(tiny_gpt, num_slots=4)
+    p = _prompts(1)[0]
+    warm = eng.submit(p, max_new_tokens=8)
+    eng.run_until_idle()               # drain rate measured
+    warm.result(timeout=1)
+    eng.submit(p, max_new_tokens=30)   # one long stream
+    eng.step()                         # admitted; 3 slots free
+    assert eng.estimate_queue_wait() == 0.0
+    # a short-deadline submit is ACCEPTED, not shed
+    r = eng.submit(p, max_new_tokens=4, timeout=0.5)
+    eng.run_until_idle()
+    assert r.error is None
+    # and with every slot busy at pri 0, a HIGH-pri submit still
+    # estimates 0 (preemption would place it next tick)
+    for _ in range(4):
+        eng.submit(p, max_new_tokens=30)
+    eng.step()
+    assert eng.scheduler.free_count() == 0
+    assert eng.estimate_queue_wait(priority=5) == 0.0
+    assert eng.estimate_queue_wait(priority=0) > 0.0
+    eng.run_until_idle()
+
+
+def test_drain_rate_ignores_stale_window(tiny_gpt):
+    """An idle gap between bursts must not collapse the measured rate
+    (a 10-minute-old window entry would make every post-gap estimate
+    orders of magnitude too slow and shed everything)."""
+    eng = _engine(tiny_gpt)
+    now = time.monotonic()
+    eng._rate_win.append((now - 600.0, 50))
+    eng._rate_win.append((now - 599.9, 50))
+    assert eng.drain_rate() is None          # all entries stale
+    eng._rate_win.append((now - 0.2, 40))
+    eng._rate_win.append((now, 40))
+    rate = eng.drain_rate()
+    assert rate is not None
+    # the stale entries are excluded: rate reflects the recent pair
+    # (~40 tokens / 0.2 s), not 130 tokens / 600 s
+    assert rate > 50
+
+
+def test_queue_vfin_map_stays_bounded():
+    """Tenant names arrive from the network edge: the fairness
+    finish-tag map must not grow with every name ever seen."""
+    q = RequestQueue()
+    for i in range(1000):
+        q.put(Request([1, 2, 3], 4, tenant=f"drive-by-{i}"))
+        got, _ = q.pop_ready()
+        assert got is not None
+    assert len(q._vfin) <= 300

@@ -308,3 +308,64 @@ def test_trace_view_wall_summary(tmp_path, capsys):
     assert "wall 20.000 ms" in out
     assert "host.overlap 10.000 ms" in out
     assert "concurrently" in out
+
+
+def test_trace_view_lifecycle_instants(tmp_path, capsys):
+    """tools/trace_view.py --lifecycle counts instant events by name
+    with a [reason] breakdown — the req.preempted / req.resumed /
+    req.shed overload lifecycle renders alongside the span table."""
+    tv = _load_tool("trace_view")
+    events = [
+        {"name": "tick", "ph": "X", "ts": 0.0, "dur": 100.0,
+         "cat": "tick"},
+        {"name": "req.queued", "ph": "i", "ts": 1.0, "cat": "request",
+         "args": {"req": 1}},
+        {"name": "req.preempted", "ph": "i", "ts": 2.0,
+         "cat": "request", "args": {"req": 1, "slot": 0}},
+        {"name": "req.resumed", "ph": "i", "ts": 3.0,
+         "cat": "request", "args": {"req": 1}},
+        {"name": "req.shed", "ph": "i", "ts": 4.0, "cat": "request",
+         "args": {"req": 2, "reason": "deadline"}},
+        {"name": "req.shed", "ph": "i", "ts": 5.0, "cat": "request",
+         "args": {"req": 3, "reason": "queue_full"}},
+        {"name": "req.shed", "ph": "i", "ts": 6.0, "cat": "request",
+         "args": {"req": 4, "reason": "deadline"}},
+        {"name": "fault.injected", "ph": "i", "ts": 7.0,
+         "cat": "fault", "args": {"site": "dispatch"}},
+    ]
+    rows = dict(tv.lifecycle_summary(events))
+    assert rows["req.preempted"] == 1
+    assert rows["req.resumed"] == 1
+    assert rows["req.shed[deadline]"] == 2
+    assert rows["req.shed[queue_full]"] == 1
+    assert rows["fault.injected"] == 1
+    assert "tick" not in rows            # complete-events excluded
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps({"traceEvents": events}))
+    assert tv.main([str(path), "--lifecycle"]) == 0
+    out = capsys.readouterr().out
+    assert "req.preempted" in out and "req.shed[deadline]" in out
+
+
+def test_timeline_lifecycle_counts(tmp_path, capsys):
+    """tools/timeline.py --lifecycle prints per-source instant counts
+    (stderr) while the merged trace stays intact on stdout."""
+    tl = _load_tool("timeline")
+    t1 = {"traceEvents": [
+        {"name": "req.preempted", "ph": "i", "ts": 1.0,
+         "cat": "request", "args": {"req": 9}},
+        {"name": "req.shed", "ph": "i", "ts": 2.0, "cat": "request",
+         "args": {"req": 10, "reason": "rate_limited"}},
+        {"name": "tick", "ph": "X", "ts": 0.0, "dur": 3.0,
+         "cat": "tick"}]}
+    assert tl.lifecycle_counts(t1) == {"req.preempted": 1,
+                                       "req.shed[rate_limited]": 1}
+    p1 = tmp_path / "a.json"
+    p1.write_text(json.dumps(t1))
+    out_path = tmp_path / "m.json"
+    assert tl.main([str(p1), "--lifecycle",
+                    "--out", str(out_path)]) == 0
+    err = capsys.readouterr().err
+    assert "req.preempted=1" in err
+    merged = json.loads(out_path.read_text())
+    assert len(merged["traceEvents"]) == 4  # 3 events + process_name

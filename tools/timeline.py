@@ -73,6 +73,25 @@ def merge_traces(traces, labels=None):
     return out
 
 
+def lifecycle_counts(trace):
+    """Instant-event counts by name for one trace — the request
+    lifecycle view (req.queued/admitted/first_token/finished/evicted
+    and the overload instants req.preempted / req.resumed /
+    req.shed[reason], fault.injected, engine.watchdog).
+    (trace_view.py's ``lifecycle_summary`` is the sorted-rows twin —
+    both tools stay single-file standalone by design, so a key-format
+    change must be mirrored there.)"""
+    counts = {}
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") != "i":
+            continue
+        reason = (ev.get("args") or {}).get("reason")
+        key = (f"{ev.get('name', '?')}[{reason}]" if reason
+               else ev.get("name", "?"))
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="merge serving traces / flight-recorder dumps / "
@@ -82,8 +101,19 @@ def main(argv=None):
                    help="trace file paths and/or /debug/trace URLs")
     p.add_argument("--out", default=None,
                    help="output path (default: stdout)")
+    p.add_argument("--lifecycle", action="store_true",
+                   help="print per-source request-lifecycle instant "
+                        "counts (incl. req.preempted/resumed/shed) "
+                        "to stderr alongside the merge")
     args = p.parse_args(argv)
     traces = [load_trace(s) for s in args.sources]
+    if args.lifecycle:
+        for src, trace in zip(args.sources, traces):
+            counts = lifecycle_counts(trace)
+            body = ("  ".join(f"{k}={v}" for k, v in
+                              sorted(counts.items()))
+                    or "(no instant events)")
+            print(f"{src}: {body}", file=sys.stderr)
     merged = merge_traces(traces, labels=[str(s) for s in args.sources])
     text = json.dumps(merged)
     if args.out:

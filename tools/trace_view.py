@@ -121,6 +121,35 @@ def format_wall(w):
     return "\n".join(lines)
 
 
+def lifecycle_summary(events):
+    """Count instant events (``ph == "i"``) by name — the request
+    lifecycle: req.queued / admitted / prefix_adopted / first_token /
+    finished / evicted, plus the overload-protection instants
+    ``req.preempted`` / ``req.resumed`` / ``req.shed`` (with a
+    per-reason breakdown) and ``fault.injected`` / ``engine.watchdog``
+    from the chaos harness.  Returns rows of (name, count) sorted by
+    count descending; shed/evicted reasons render as
+    ``name[reason]``.  (timeline.py's ``lifecycle_counts`` is the
+    dict-shaped twin — both tools stay single-file standalone by
+    design, so a key-format change must be mirrored there.)"""
+    counts = {}
+    for ev in events:
+        if ev.get("ph") != "i":
+            continue
+        name = ev.get("name", "?")
+        reason = (ev.get("args") or {}).get("reason")
+        key = f"{name}[{reason}]" if reason else name
+        counts[key] = counts.get(key, 0) + 1
+    return sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+
+
+def format_lifecycle(rows):
+    lines = [f"{'instant':<28} {'count':>7}"]
+    for name, count in rows:
+        lines.append(f"{name:<28} {count:>7}")
+    return "\n".join(lines)
+
+
 def load_events(path):
     """Events from a trace file: Catapult object form or bare list."""
     with open(path) as f:
@@ -146,19 +175,32 @@ def main(argv=None):
                         "summary (concurrent spans — async engine "
                         "overlap — make the two diverge; the table "
                         "alone double-counts them)")
+    p.add_argument("--lifecycle", action="store_true",
+                   help="append an instant-event count table (request "
+                        "lifecycle incl. req.preempted / req.resumed "
+                        "/ req.shed[reason], fault.injected, "
+                        "engine.watchdog)")
     args = p.parse_args(argv)
     events = load_events(args.trace)
     rows = summarize(events, cat=args.cat)
     key = {"total": "total_ms", "count": "count", "mean": "mean_ms",
            "p50": "p50_ms", "p99": "p99_ms"}[args.sort]
     rows.sort(key=lambda r: -r[key])
-    if not rows:
+    if not rows and not args.lifecycle:
         print("no complete-events matched", file=sys.stderr)
         return 1
-    print(format_table(rows))
+    if rows:
+        print(format_table(rows))
     if args.wall:
         print()
         print(format_wall(wall_summary(events)))
+    if args.lifecycle:
+        life = lifecycle_summary(events)
+        print()
+        if life:
+            print(format_lifecycle(life))
+        else:
+            print("no instant events", file=sys.stderr)
     return 0
 
 
