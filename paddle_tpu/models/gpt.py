@@ -383,6 +383,68 @@ class GPTAttention(nn.Layer):
         return (out, flat_k.reshape(k_pool.shape),
                 flat_v.reshape(v_pool.shape))
 
+    def ragged_window_paged(self, x, k_pool, v_pool, block_tables, pos,
+                            width):
+        """RAGGED paged window — the Pallas-kernel twin of the three
+        paged window shapes (``decode_slots_paged`` S=1,
+        ``verify_slots_paged`` S=k+1, ``prefill_chunk_paged`` S=C):
+        per-slot ``pos``/``width``/``block_tables`` are runtime DATA,
+        so one compiled program serves a batch mixing one-token decode
+        lanes, spec-verify windows, and prefill chunks at once
+        (ops/ragged_paged_attn.py).
+
+        The window's K/V scatters through each slot's table with the
+        WIDTH MASK applied here, before the kernel: lanes
+        ``s >= width[b]`` land in physical row 0 — the engine's
+        scratch block — which is the one masking rule that used to be
+        three per-path invariants (parked slots' zero tables, the
+        spec-margin reservation, chunked prefill's ``true_len`` pad
+        lanes; see serving/kvcache.py).  Valid lanes write exactly
+        what their XLA twin writes, and the kernel computes the same
+        f32 gather/mask/softmax as ``_slot_attn``, so greedy AND
+        seeded outputs are token-identical to the XLA path (asserted
+        in tests/test_ragged_attn.py, bitwise on CPU).
+
+        x: Tensor [B, W, E]; k_pool/v_pool: [NB, bs, H, hd];
+        block_tables: int32 [B, L//bs]; pos/width: int32 [B].
+        Returns (out Tensor [B, W, E], k_pool, v_pool).
+        """
+        import jax.numpy as jnp
+        from ..ops.ragged_paged_attn import ragged_paged_attention
+
+        qa, ka, va = self._qkv_step(x)
+        B, W = qa.shape[0], qa.shape[1]
+        NB, bs = k_pool.shape[0], k_pool.shape[1]
+        bps = block_tables.shape[1]
+        rows = jnp.arange(B)
+        flat_k = k_pool.reshape(NB * bs, self.num_heads, self.head_dim)
+        flat_v = v_pool.reshape(NB * bs, self.num_heads, self.head_dim)
+        offs = pos[:, None] + jnp.arange(W)[None, :]        # [B, W]
+        # lanes past width[b] — and any out-of-range offset (runaway
+        # defense: a clip into the table's LAST entry would overwrite
+        # live rows of the slot's own cache) — scatter into the
+        # scratch block's row 0, the parked-lane semantics of the XLA
+        # paths' pos clamps
+        valid = (jnp.arange(W)[None, :] < width[:, None]) \
+            & (offs < bps * bs)
+        offs_safe = jnp.where(valid, offs, 0)
+        blk = block_tables[rows[:, None], offs_safe // bs]
+        widx = jnp.where(valid, blk * bs + offs_safe % bs, 0)
+        flat_k = flat_k.at[widx].set(ka.astype(flat_k.dtype))
+        flat_v = flat_v.at[widx].set(va.astype(flat_v.dtype))
+        ctx = ragged_paged_attention(qa, flat_k, flat_v, block_tables,
+                                     pos, width, block_size=bs)
+        out = Tensor(ctx)
+        if self.use_mp:
+            from ..ops import einsum
+            out = einsum("bshd,hde->bse", out, self.out_weight) + \
+                self.out_bias
+        else:
+            out = reshape(out, [B, W, self.num_heads * self.head_dim])
+            out = self.out_proj(out)
+        return (out, flat_k.reshape(k_pool.shape),
+                flat_v.reshape(v_pool.shape))
+
     def prefill_chunk_paged(self, x, k_pool, v_pool, block_table, pos,
                             true_len):
         """CHUNKED prefill through ONE slot's block table (budgeted
@@ -603,6 +665,15 @@ class GPTBlock(nn.Layer):
         x = x + self.mlp(self.ln2(x))
         return x, k_pool, v_pool
 
+    def ragged_window_paged(self, x, k_pool, v_pool, block_tables, pos,
+                            width):
+        """Ragged Pallas window (GPTAttention.ragged_window_paged)."""
+        attn_out, k_pool, v_pool = self.attn.ragged_window_paged(
+            self.ln1(x), k_pool, v_pool, block_tables, pos, width)
+        x = x + attn_out
+        x = x + self.mlp(self.ln2(x))
+        return x, k_pool, v_pool
+
     def prefill_chunk_paged(self, x, k_pool, v_pool, block_table, pos,
                             true_len):
         """Block-table chunked prefill (GPTAttention.prefill_chunk_paged)."""
@@ -673,15 +744,29 @@ class GPTModel(nn.Layer):
                  vocab_size=50304, max_position=1024, dropout=0.1,
                  use_mp=False, use_recompute=False, moe_experts=0,
                  moe_every=2, fused_loss=False, recompute_policy=None,
-                 use_sp=False, fused_loss_chunk=128, scan_layers=False):
+                 use_sp=False, fused_loss_chunk=128, scan_layers=False,
+                 attn_impl="xla"):
         super().__init__()
+        if attn_impl not in ("xla", "ragged"):
+            raise ValueError(
+                f"attn_impl must be 'xla' or 'ragged', got "
+                f"{attn_impl!r}")
+        # serving-kernel selection default: 'xla' keeps the paged
+        # gather/scatter dispatches (the CPU tier-1 parity oracle);
+        # 'ragged' routes the paged decode / spec-verify / chunked-
+        # prefill attention core through the Pallas ragged paged
+        # attention kernel (ops/ragged_paged_attn.py) — per-slot
+        # window widths as data, ONE compiled program for every paged
+        # window shape.  Engine(attn_impl=...) overrides per engine.
+        self.attn_impl = attn_impl
         # decode-twin reconstruction needs the dense hyperparams
         # (scan_layers forbids mp/sp/moe, so these suffice)
         self._init_config = dict(
             num_layers=num_layers, hidden_size=hidden_size,
             num_heads=num_heads, vocab_size=vocab_size,
             max_position=max_position, dropout=dropout,
-            fused_loss=fused_loss, fused_loss_chunk=fused_loss_chunk)
+            fused_loss=fused_loss, fused_loss_chunk=fused_loss_chunk,
+            attn_impl=attn_impl)
         self.fused_loss = fused_loss
         # sequence-chunk size of the fused head+CE scan: larger chunks =
         # fewer scan iterations and bigger matmuls, more live logits HBM
@@ -1050,9 +1135,15 @@ class GPTModel(nn.Layer):
         emitted), so the device cursor advances by n_emit, a lane
         whose budget hits zero (or that emits its eos) freezes, and a
         blind-dispatched next window can never run a finished request
-        past its reserved rows.  Returns (picks [B, W], n_acc [B],
-        n_emit [B], done [ceil(B/8)] uint8, new_tok [B,1], new_pos
-        [B], new_ctr [B], new_rem [B], new_k, new_v)."""
+        past its reserved rows.  TWIN NOTE: the ragged path's
+        ``_fused_ragged_tick_slots`` mode-0 branch re-implements this
+        accept/eos/rem epilogue with two deliberate divergences
+        (lane-width gating via ``width``; pos clamp L-1 vs L-W — see
+        its comments); a stop-condition change HERE must be mirrored
+        there (the host consume side already shares one loop,
+        ``Engine._emit_window_lane``).  Returns (picks [B, W],
+        n_acc [B], n_emit [B], done [ceil(B/8)] uint8, new_tok [B,1],
+        new_pos [B], new_ctr [B], new_rem [B], new_k, new_v)."""
         import jax.numpy as jnp
         if block_tables is None:
             logits, new_k, new_v = self._spec_verify_tick_slots(
@@ -1093,6 +1184,246 @@ class GPTModel(nn.Layer):
         new_pos = jnp.where(live, jnp.minimum(pos + n_emit, L - W), pos)
         return (picks, n_acc, n_emit, done, new_tok, new_pos,
                 ctr + n_emit, new_rem, new_k, new_v)
+
+    def _ragged_window_tick_slots(self, toks, k_pools, v_pools,
+                                  block_tables, pos, width,
+                                  head_lanes=None):
+        """RAGGED window forward over the paged slot pool: run each
+        slot's ``width[b]`` real window tokens (of the static maximum
+        W) at positions ``pos[b]..`` through every block's
+        ``ragged_window_paged`` — one-token decode lanes, k+1 verify
+        windows, and prefill chunks mixed in ONE dispatch of ONE
+        program.  ``head_lanes`` (int32 [B, K], optional) gathers K
+        window lanes per slot BEFORE the LM head, so the vocab matmul
+        pays for the lanes something actually reads instead of the
+        full static window — lanes are per-position independent
+        through LayerNorm + head, so gather-then-head equals
+        head-then-gather.  Returns (logits [B, W, V] — or [B, K, V]
+        with head_lanes — new_k, new_v)."""
+        import jax.numpy as jnp
+        pos = jnp.asarray(pos, jnp.int32)
+        W = toks.shape[1]
+        maxp = self.embeddings.position_embeddings.weight.shape[0]
+        # clamp only protects the garbage lanes past width (their
+        # embeddings are computed and discarded); real lanes satisfy
+        # pos + s < max_position by the engine's admission contract
+        pids = jnp.minimum(
+            pos[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :],
+            maxp - 1)
+        x = self.embeddings(Tensor(toks), position_ids=Tensor(pids))
+        new_k, new_v = [], []
+        for j, blk in enumerate(self.blocks):
+            x, kb, vb = blk.ragged_window_paged(
+                x, k_pools[j], v_pools[j], block_tables, pos, width)
+            new_k.append(kb)
+            new_v.append(vb)
+        if head_lanes is not None:
+            x = Tensor(jnp.take_along_axis(
+                x._data, head_lanes[:, :, None], axis=1))
+        return self.head(x)._data, new_k, new_v
+
+    def _fused_ragged_tick_slots(self, toks, k_pools, v_pools,
+                                 block_tables, width, mode, lanes, tok,
+                                 pos, temp, top_k, top_p, seed_lo,
+                                 seed_hi, ctr, eos, rem, emit_w=None):
+        """FUSED ragged window + on-device sample / accept-scan /
+        stop-condition epilogue — the ONE program that replaces the
+        fused decode, fused spec-verify, AND paged chunk-prefill
+        dispatches (``Engine(attn_impl="ragged")``).  Per-slot
+        ``mode`` lanes pick the epilogue semantics:
+
+        * mode 0 — decode / spec-verify window: lane 0 is the slot's
+          device-resident current token, lanes 1.. the uploaded
+          drafts; every lane is sampled with key fold(seed, ctr + j),
+          the longest-accepted-prefix scan runs IN the epilogue (the
+          satellite fold: acceptance needs no separate dispatch and
+          the d2h payload stays picks + counts + done), and the
+          eos/rem stop condition clamps/freezes exactly like
+          ``_fused_spec_verify_tick_slots`` (the TWIN — a
+          stop-condition change in either epilogue must be mirrored;
+          see the twin note there) — with zero draft lanes this
+          degenerates to the fused one-token decode (n_emit 1).
+        * mode 1 — prefill chunk: ``width[b]`` prompt tokens are
+          written through the slot's table; nothing samples or
+          emits, the cursor advances by the chunk width on device.
+        * mode 2 — FINAL prefill chunk: like mode 1, plus the last
+          real lane's logits sample the request's next token with the
+          UNSHIFTED key fold(seed, ctr) — the same draw a one-token
+          tick would make for this prefix — delivered on picks lane 0.
+
+        Width-masked lanes (and whole parked slots, width 0) write the
+        scratch block and compute discarded garbage; frozen lanes
+        (rem 0) keep tok/pos/ctr unchanged so blind async dispatch
+        stays safe.  ``emit_w`` (static) caps the SAMPLED lanes at
+        the emit-reachable window — spec_k+1, or 1 without
+        speculation: a chunk-widened window (W = chunk > spec_k+1)
+        can never emit past lane spec_k, so sampling those lanes
+        would burn a full-vocab filter+categorical per tick on picks
+        nobody can read, and the cap also shrinks the picks d2h
+        payload back to the spec path's.  Dropping high lanes is
+        draw-exact: each lane is an independent ``_sample_lanes``
+        call, so low lanes' rbg draws are untouched.  Returns
+        (picks [B, E] where E = min(W, emit_w or W), n_acc [B],
+        n_emit [B], done [ceil(B/8)] uint8, new_tok [B,1], new_pos
+        [B], new_ctr [B], new_rem [B], new_k, new_v)."""
+        import jax.numpy as jnp
+        B, W = toks.shape
+        E = min(W, emit_w) if emit_w else W
+        # mode-0 lanes take lane 0 from the device-resident token
+        # cursor (steady state uploads only the draft/chunk array)
+        window = jnp.where(
+            (mode == 0)[:, None],
+            jnp.concatenate([tok, toks[:, 1:]], axis=1), toks)
+        # the LM head pays only for lanes something reads: the E
+        # emit-reachable lanes (mode-0 picks) plus each slot's LAST
+        # REAL lane (the final-chunk first-token draw) — a
+        # chunk-widened window (W = chunk) never runs a [B, W, V]
+        # vocab matmul for it
+        head_lanes = jnp.concatenate(
+            [jnp.broadcast_to(jnp.arange(E, dtype=jnp.int32)[None, :],
+                              (B, E)),
+             jnp.maximum(width - 1, 0)[:, None]], axis=1)   # [B, E+1]
+        logits, new_k, new_v = self._ragged_window_tick_slots(
+            window, k_pools, v_pools, block_tables, pos, width,
+            head_lanes=head_lanes)                     # [B, E+1, V]
+        L = block_tables.shape[1] * k_pools[0].shape[1]
+        picks = jnp.stack(
+            [self._sample_lanes(
+                logits[:, j], temp, top_k, top_p,
+                self._slot_sample_keys(seed_lo, seed_hi, ctr + j))
+             for j in range(E)], axis=1)                    # [B, E]
+        # final-chunk pick: the last REAL lane's logits with the
+        # unshifted counter key (the stream's next draw, token index
+        # ctr — prefill/chunk emission and decode ticks share one
+        # per-request key sequence).  Drawn per slot through lax.map
+        # — a B=1 body, NOT a vmapped batch: under the repo's rbg
+        # default PRNG a vmapped categorical's bits depend on the
+        # WHOLE key batch, and the XLA oracle's first-token pick
+        # (``sample_rows``) is a B=1 draw — this reproduces it
+        # bit-for-bit, which is what keeps seeded ragged streams
+        # token-identical to the XLA arm.  Behind a lax.cond: ticks
+        # without a final-chunk lane (the steady state) skip the
+        # per-slot scan entirely.
+        import jax
+        last_logits = logits[:, E]  # the gathered last-real lane
+        is_final = mode == 2
+
+        def _first_draws(_):
+            def one(args):
+                row, t, k, p, lo, hi, c = args
+                return self._sample_lanes(
+                    row[None], t[None], k[None], p[None],
+                    self._slot_sample_keys(lo[None], hi[None],
+                                           c[None]))[0]
+            return jax.lax.map(one, (last_logits, temp, top_k, top_p,
+                                     seed_lo, seed_hi, ctr))
+
+        last_pick = jax.lax.cond(
+            jnp.any(is_final), _first_draws,
+            lambda _: jnp.zeros((B,), jnp.int32), None)
+        is_pref = mode == 1
+        # a lane is live only when this dispatch actually carries it
+        # (width > 0): a PREFILLING slot waiting for budget — or a
+        # parked one — is frozen by its zero width, not by a mirror
+        # re-upload (the XLA chunk path dirties state every chunk;
+        # the ragged path's whole point is that it does not)
+        live = (rem > 0) & (width > 0)
+        match = (window[:, 1:E] == picks[:, :E - 1]) & \
+            (jnp.arange(E - 1)[None, :] < lanes[:, None])
+        n_acc = jnp.argmin(jnp.concatenate(
+            [match, jnp.zeros((B, 1), bool)], axis=1), axis=1)
+        hit_eos = (eos[:, None] >= 0) & (picks == eos[:, None])
+        eos_stop = jnp.where(jnp.any(hit_eos, axis=1),
+                             jnp.argmax(hit_eos, axis=1) + 1, E + 1)
+        n_emit0 = jnp.minimum(jnp.minimum(n_acc + 1, rem), eos_stop)
+        fc_eos = (eos >= 0) & (last_pick == eos)
+        n_emit = jnp.where(
+            is_pref, 0,
+            jnp.where(is_final, jnp.minimum(1, rem),
+                      jnp.where(live, n_emit0, 0))).astype(jnp.int32)
+        last_idx = jnp.maximum(n_emit - 1, 0)
+        pick_tok = jnp.take_along_axis(picks, last_idx[:, None],
+                                       axis=1)
+        new_tok = jnp.where(
+            is_final[:, None], last_pick[:, None],
+            jnp.where(is_pref[:, None] | ~live[:, None], tok,
+                      pick_tok))
+        new_rem = jnp.where(
+            is_pref, rem,
+            jnp.where(is_final, jnp.where(fc_eos, 0, rem - 1),
+                      jnp.where(live,
+                                jnp.where(n_emit == eos_stop, 0,
+                                          rem - n_emit), rem)))
+        done = jnp.packbits((new_rem <= 0).astype(jnp.uint8))
+        adv = jnp.where(is_pref | is_final, width,
+                        jnp.where(live, n_emit, 0))
+        # L-1, not the spec twin's L-W: a chunk-widened window's
+        # legitimate prefill positions can exceed L-W (long prompt),
+        # so the stronger clamp would REWIND them; runaway writes are
+        # instead parked in the scratch block by the width+range mask
+        # in ragged_window_paged
+        new_pos = jnp.minimum(pos + adv, L - 1)
+        new_ctr = ctr + n_emit
+        picks = picks.at[:, 0].set(
+            jnp.where(is_final, last_pick, picks[:, 0]))
+        return (picks, n_acc, n_emit, done, new_tok, new_pos, new_ctr,
+                new_rem, new_k, new_v)
+
+    def _compiled_ragged_window_fn(self, pnames, params, cache_key,
+                                   emit_w=None):
+        """Build (or fetch) the jitted FUSED RAGGED WINDOW dispatch
+        (``Engine(attn_impl="ragged")``): (p_list, b_list, k_pools,
+        v_pools, block_tables [B, L//bs], toks [B, W], width [B],
+        mode [B], lanes [B], tok [B,1], pos [B], temp [B], top_k [B],
+        top_p [B], seed_lo [B], seed_hi [B], ctr [B], eos [B],
+        rem [B]) -> (picks [B, min(W, emit_w)], n_acc [B], n_emit
+        [B], done
+        [ceil(B/8)] uint8, new_tok [B,1], new_pos [B], new_ctr [B],
+        new_rem [B], k_pools, v_pools).  The attention core is the
+        Pallas ragged paged attention kernel (interpret mode off-TPU),
+        and EVERY window shape — one-token decode, k+1 spec verify,
+        C-token prefill chunk, mixed in one batch — is per-slot DATA,
+        so the (layout, chunk shape, spec_k) compile matrix collapses
+        to this ONE program per engine config (compile-probe kind
+        ``ragged_window``; asserted by the compile-matrix regression
+        test and the serving_ragged bench).  Pools donated."""
+        import jax
+        from ..core import autograd
+        from ..jit import _swapped
+
+        # emit_w is baked into the compiled program (it fixes the
+        # picks lane count), so it MUST distinguish cache entries —
+        # enforced here rather than trusted to every caller's key
+        cache_key = (cache_key, None if emit_w is None else int(emit_w))
+        cache = getattr(self, "_ragged_window_fn_cache", None)
+        if cache is None:
+            cache = self._ragged_window_fn_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        model = self
+        mbuffers = dict(self.named_buffers())
+        bnames = sorted(mbuffers)
+
+        def pure(p_list, b_list, k_pools, v_pools, block_tables, toks,
+                 width, mode, lanes, tok, pos, temp, top_k, top_p,
+                 seed_lo, seed_hi, ctr, eos, rem):
+            with _swapped(params, dict(zip(pnames, p_list))), \
+                    _swapped(mbuffers, dict(zip(bnames, b_list))):
+                with autograd.no_grad():
+                    out = model._fused_ragged_tick_slots(
+                        toks, k_pools, v_pools, block_tables, width,
+                        mode, lanes, tok, pos, temp, top_k, top_p,
+                        seed_lo, seed_hi, ctr, eos, rem,
+                        emit_w=emit_w)
+            return out
+
+        fn = jax.jit(pure, donate_argnums=(2, 3))
+        if len(cache) >= 8:  # FIFO bound, matching the other caches
+            cache.pop(next(iter(cache)))
+        cache[cache_key] = (self._compile_probe(
+            "ragged_window", cache_key, fn), bnames, mbuffers)
+        return cache[cache_key]
 
     # -- compile-event hook (serving observability) --------------------
     def add_compile_listener(self, cb):

@@ -1,0 +1,141 @@
+"""Ragged paged attention — ONE Pallas kernel for every paged window.
+
+The serving engine's paged attention used to be pure-XLA gather /
+scatter through block tables, with the window width baked into each
+compiled program's SHAPE: a one-token decode tick (S=1), a k-wide
+speculative verify (S=k+1), and a chunked-prefill window (S=C) each
+compiled their own executable, so the engine carried a program matrix
+of roughly one entry per (layout, chunk shape, spec_k).  This module
+is the kernel-level fix, grounded in "Ragged Paged Attention: A
+High-Performance and Flexible LLM Inference Kernel for TPU"
+(PAPERS.md, arxiv 2604.15464): per-slot positions, window widths, and
+block tables become kernel *data* instead of trace-time *shape* —
+
+* the grid runs over SLOTS; each program instance walks its slot's
+  block table (a kv-block loop inside the instance) to gather the
+  slot's logical K/V row from the shared physical pools,
+* ``pos[b]`` (the slot's window start) drives the causal mask, so a
+  short slot is masked by its length instead of padded to the pool's,
+* ``width[b]`` says how many of the W query lanes are REAL this tick —
+  a decode lane uses 1, a spec-verify lane k+1, a prefill-chunk lane
+  its chunk length, and a parked slot 0 (its output lanes are zeroed,
+  never read) — so mixed prefill-chunk + decode + spec traffic shares
+  ONE program whose static width is just the engine's maximum.
+
+Numerics are the XLA oracle's, on purpose: the kernel gathers the
+whole logical row and runs the same f32 score -> -1e30 mask -> softmax
+-> value contraction as ``GPTAttention._slot_attn``, so the engine's
+token-parity guarantees (greedy AND seeded) carry over to the kernel
+path — tier-1 runs this very kernel under ``interpret=True`` on CPU
+and asserts token-for-token equality against the XLA path.  (A
+flash-style online softmax over the kv-block loop would save VMEM on
+long contexts but breaks bit-parity with the oracle; it belongs behind
+the real-TPU tier of the ``pallas`` marker.)
+
+K/V WRITES stay outside the kernel (the callers' width-masked scatter
+— see ``GPTAttention.ragged_window_paged``): lanes past ``width[b]``
+land in physical row 0, the engine's scratch block, which is how the
+scratch-block and spec-margin invariants documented in
+serving/kvcache.py move from per-path code into one masking rule.
+"""
+from __future__ import annotations
+
+import math
+
+
+def _auto_interpret():
+    """Pallas interpret mode unless we are actually on TPU — tier-1
+    (JAX_PLATFORMS=cpu) exercises the real kernel logic token-for-token
+    against the XLA oracle; compiled Mosaic lowering is the TPU tier."""
+    import jax
+    return jax.default_backend() != "tpu"
+
+
+def _ragged_paged_attention_impl(q, k_flat, v_flat, block_tables, pos,
+                                 width, block_size, interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    B, W, H, hd = q.shape
+    nb = block_tables.shape[1]
+    bs = block_size
+    L = nb * bs
+    scale = 1.0 / math.sqrt(hd)
+
+    def kernel(tables_ref, pos_ref, width_ref, q_ref, k_ref, v_ref,
+               o_ref):
+        b = pl.program_id(0)
+        p = pos_ref[b]
+        w = width_ref[b]
+        # kv-block loop: gather this slot's logical [L] row through its
+        # block table (physical block ids are runtime data; nb/bs are
+        # the only static shapes)
+        k_rows = jnp.concatenate(
+            [k_ref[pl.ds(tables_ref[b, j] * bs, bs)]
+             for j in range(nb)], axis=0)                    # [L, H, hd]
+        v_rows = jnp.concatenate(
+            [v_ref[pl.ds(tables_ref[b, j] * bs, bs)]
+             for j in range(nb)], axis=0)
+        qa = q_ref[0].astype(jnp.float32)                    # [W, H, hd]
+        # same contraction / mask / softmax as the XLA oracle
+        # (_slot_attn), per slot: scores [H, W, L] in f32
+        scores = jnp.einsum(
+            "qhd,khd->hqk", qa,
+            k_rows.astype(jnp.float32)) * scale
+        l_ids = jax.lax.broadcasted_iota(jnp.int32, (W, L), 1)
+        s_ids = jax.lax.broadcasted_iota(jnp.int32, (W, L), 0)
+        # query lane s sees cache positions <= pos + s — the slot's
+        # LENGTH does the masking, not a padded shape
+        visible = l_ids <= p + s_ids                         # [W, L]
+        scores = jnp.where(visible[None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("hqk,khd->qhd", probs,
+                         v_rows.astype(jnp.float32))
+        # width as data: lanes past this slot's real window are zeroed
+        # (parked slots — width 0 — return all-zero, never-read lanes)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (W, 1, 1), 0)
+        ctx = jnp.where(lane < w, ctx, 0.0)
+        o_ref[0] = ctx.astype(o_ref.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(block_tables.shape, lambda b: (0, 0)),
+            pl.BlockSpec(pos.shape, lambda b: (0,)),
+            pl.BlockSpec(width.shape, lambda b: (0,)),
+            pl.BlockSpec((1, W, H, hd), lambda b: (b, 0, 0, 0)),
+            pl.BlockSpec(k_flat.shape, lambda b: (0, 0, 0)),
+            pl.BlockSpec(v_flat.shape, lambda b: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, W, H, hd), lambda b: (b, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, W, H, hd), q.dtype),
+        interpret=interpret,
+    )(block_tables, pos, width, q, k_flat, v_flat)
+
+
+def ragged_paged_attention(q, k_flat, v_flat, block_tables, pos, width,
+                           *, block_size, interpret=None):
+    """Ragged paged attention over a slot pool (see module docstring).
+
+    q : [B, W, H, hd] query window per slot (W = the engine's static
+        maximum window; real lanes per slot are ``width[b]``).
+    k_flat / v_flat : [num_blocks * block_size, H, hd] — the paged
+        pools flattened to physical rows (writes already scattered).
+    block_tables : int32 [B, L // block_size] physical block per
+        logical block (row 0 = the scratch block for parked slots).
+    pos : int32 [B] window start per slot (tokens already cached).
+    width : int32 [B] real query lanes this tick (0 = parked; output
+        lanes >= width are zeroed).
+    Returns ctx [B, W, H, hd] in q's dtype.
+    """
+    import jax.numpy as jnp
+
+    if interpret is None:
+        interpret = _auto_interpret()
+    return _ragged_paged_attention_impl(
+        q, k_flat, v_flat,
+        jnp.asarray(block_tables, jnp.int32),
+        jnp.asarray(pos, jnp.int32), jnp.asarray(width, jnp.int32),
+        block_size=int(block_size), interpret=bool(interpret))

@@ -85,12 +85,13 @@ class _InflightTick:
     flight recorder's view of the in-flight ring."""
 
     __slots__ = ("tick", "kind", "slots", "reqs", "arrays", "batch",
-                 "layout", "dispatched_at", "cursors", "spec_lanes")
+                 "layout", "dispatched_at", "cursors", "spec_lanes",
+                 "meta_lanes")
 
     def __init__(self, tick, kind, slots, arrays, batch, layout,
-                 cursors, spec_lanes=None):
+                 cursors, spec_lanes=None, meta_lanes=None):
         self.tick = tick
-        self.kind = kind              # "decode" | "spec"
+        self.kind = kind              # "decode" | "spec" | "ragged"
         self.slots = slots
         self.reqs = [s.request for s in slots]
         self.arrays = arrays          # name -> un-materialized device
@@ -102,6 +103,9 @@ class _InflightTick:
         self.spec_lanes = spec_lanes  # per-slot REAL draft lanes as
         #   of dispatch (consume must not re-read the slot: it may
         #   have been rebound by then)
+        self.meta_lanes = meta_lanes  # ragged dispatch: per listed
+        #   slot (mode, width, lanes) as of dispatch — same
+        #   must-not-re-read rule as spec_lanes
 
     def meta(self):
         """JSON-able metadata for the flight recorder / debug
@@ -233,6 +237,26 @@ class Engine:
         numpy per-slot sampling (``_pick``).  Watch
         ``serving.d2h_bytes_per_tick`` / ``serving.sample_ms`` /
         ``serving.fused_sample_ticks``.
+    attn_impl : which attention implementation serves the paged
+        window dispatches.  ``None`` (default) inherits the model's
+        ``GPTModel(attn_impl=...)`` knob (itself defaulting to
+        ``"xla"``).  ``"xla"`` keeps the pure-XLA gather/scatter
+        programs — one compiled executable per (layout, chunk shape,
+        spec_k) window SHAPE — and remains the CPU tier-1 parity
+        oracle.  ``"ragged"`` (requires the paged layout and device
+        sampling) routes the decode, spec-verify, and chunked-prefill
+        attention core through the Pallas RAGGED PAGED ATTENTION
+        kernel (ops/ragged_paged_attn.py; interpret mode off-TPU, so
+        tier-1 runs the real kernel logic): per-slot positions,
+        window widths, and block tables are kernel DATA, a single
+        dispatch carries one-token decode lanes, k+1 verify windows,
+        and budgeted prefill chunks side by side, the
+        longest-accepted-prefix scan folds into the program's
+        epilogue, and the whole (chunk shape, spec_k) compile matrix
+        collapses to ONE ``ragged_window`` program — watch
+        ``serving.compiles_total`` and the ``decode.ragged`` trace
+        span.  Greedy AND seeded outputs are token-identical to the
+        XLA path (asserted in tests/test_ragged_attn.py).
     async_depth : ASYNC ENGINE LOOP pipeline depth.  ``None`` (the
         default) resolves to 2 in device sample mode and 1 in host
         mode.  At depth 2 a tick DISPATCHES tick N+1's fused decode
@@ -339,10 +363,10 @@ class Engine:
                  kv_block_size=None, kv_blocks=None, prefix_cache=True,
                  prefill_chunk=None, tick_token_budget=None,
                  spec_k=None, proposer=None, sample_mode="device",
-                 async_depth=None, tracing=True, trace_capacity=16384,
-                 trace_annotations=False, flight_dir=None,
-                 tenants=None, preemption=True, shed_deadlines=True,
-                 faults=None, watchdog_s=None):
+                 attn_impl=None, async_depth=None, tracing=True,
+                 trace_capacity=16384, trace_annotations=False,
+                 flight_dir=None, tenants=None, preemption=True,
+                 shed_deadlines=True, faults=None, watchdog_s=None):
         if getattr(model, "scan_layers", False):
             model = model._sync_decode_twin()
         model.eval()
@@ -530,6 +554,35 @@ class Engine:
                     f"max-length request ({self._bps} blocks)")
             self._kv_managed = managed
             self._prefix_enabled = bool(prefix_cache)
+        # -- ragged paged attention (attn_impl="ragged") ----------------
+        if attn_impl is None:
+            attn_impl = getattr(model, "attn_impl", "xla")
+        if attn_impl not in ("xla", "ragged"):
+            raise ValueError(
+                f"attn_impl must be 'xla' or 'ragged', got "
+                f"{attn_impl!r}")
+        if attn_impl == "ragged":
+            if not self._paged:
+                raise ValueError(
+                    "attn_impl='ragged' requires the paged KV layout "
+                    "(kv_block_size=...): the kernel reads K/V through "
+                    "per-slot block tables — the contiguous layout "
+                    "keeps the XLA path")
+            if sample_mode != "device":
+                raise ValueError(
+                    "attn_impl='ragged' requires sample_mode='device':"
+                    " sampling, the acceptance scan, and the stop "
+                    "condition all run in the ragged program's "
+                    "epilogue")
+        self.attn_impl = attn_impl
+        # the ONE ragged program's static window: wide enough for a
+        # one-token decode lane, the k+1 spec-verify window, and a
+        # prefill chunk — per-slot width is runtime data, so the
+        # engine compiles exactly one paged window program however
+        # traffic mixes (the compile-matrix collapse)
+        self._wmax = max(1, (self._spec_k + 1) if self._spec_k else 1,
+                         self._chunk or 1)
+        self._ragged_fn = None  # resolved jitted ragged-window handle
         # -- tracing / flight recorder ---------------------------------
         self.tracer = (monitor.Tracer(capacity=trace_capacity,
                                       annotate=trace_annotations)
@@ -1307,6 +1360,7 @@ class Engine:
                 "prefill_chunk": self._chunk,
                 "spec_k": self._spec_k,
                 "sample_mode": self.sample_mode,
+                "attn_impl": self.attn_impl,
                 "async_depth": self.async_depth,
                 "tracing": bool(self.tracer.enabled),
                 "preemption": self._preemption,
@@ -1518,15 +1572,27 @@ class Engine:
         assert not self._ring, \
             "_push_state with ticks in flight — drain the ring first"
         import jax.numpy as jnp
+        # transfer from PRIVATE COPIES: the PJRT CPU client may run
+        # the host->device copy asynchronously, so handing it the live
+        # mirror races any mirror write that lands before the enqueued
+        # dispatch executes — concretely, the ragged chunk lanes
+        # advance self._pos right after dispatch, and the in-flight
+        # transfer would intermittently capture the POST-chunk cursor
+        # as the pre-state (observed as nondeterministic corruption)
         self._dev_state = dict(
-            tok=jnp.asarray(self._cur_tok), pos=jnp.asarray(self._pos),
-            ctr=jnp.asarray(self._sctr), temp=jnp.asarray(self._temp),
-            topk=jnp.asarray(self._topk), topp=jnp.asarray(self._topp),
-            slo=jnp.asarray(self._seed_lo),
-            shi=jnp.asarray(self._seed_hi),
-            eos=jnp.asarray(self._eos), rem=jnp.asarray(self._rem))
+            tok=jnp.asarray(self._cur_tok.copy()),
+            pos=jnp.asarray(self._pos.copy()),
+            ctr=jnp.asarray(self._sctr.copy()),
+            temp=jnp.asarray(self._temp.copy()),
+            topk=jnp.asarray(self._topk.copy()),
+            topp=jnp.asarray(self._topp.copy()),
+            slo=jnp.asarray(self._seed_lo.copy()),
+            shi=jnp.asarray(self._seed_hi.copy()),
+            eos=jnp.asarray(self._eos.copy()),
+            rem=jnp.asarray(self._rem.copy()))
         if self._paged:
-            self._dev_state["tables"] = jnp.asarray(self._block_tables)
+            self._dev_state["tables"] = \
+                jnp.asarray(self._block_tables.copy())
         self._state_dirty = False
 
     def _prefill_paged(self, slot):
@@ -2041,6 +2107,46 @@ class Engine:
             {"pos": self._pos.tolist(), "rem": self._rem.tolist()},
             spec_lanes=[slot.spec_lanes for slot in active])
 
+    def _emit_window_lane(self, slot, picks_row, acc_i, n_emit_dev_i,
+                          done_i, tick):
+        """Shared per-slot emit loop of the windowed consume paths
+        (``_consume_spec`` and ``_consume_ragged``'s mode-0 lanes):
+        consume the device-accepted lanes plus the bonus token,
+        advancing pos/mirrors through ``_emit``.  Lane j's pick was
+        drawn on device from the same key/logits the one-token tick
+        would use for this prefix, and ``acc_i`` counts only REAL
+        draft lanes, so consuming lanes 0..acc_i reproduces the host
+        accept loop exactly; an accepted lane is counted even when
+        its token finishes the request (EOS drafted by a matched
+        lane), but only over lanes actually consumed.  Host-vs-device
+        stop-condition drift raises into step recovery — ONE
+        implementation, so the two consume paths' drift semantics
+        cannot desynchronize.  Returns (emitted, accepted)."""
+        i = slot.index
+        n_cnt = 0
+        n_em = 0
+        j = 0
+        while True:
+            tok = int(picks_row[j])
+            matched = j < acc_i
+            if matched:
+                n_cnt += 1
+            slot.pos += 1
+            self._pos[i] = slot.pos
+            self._emit(slot, tok)
+            n_em += 1
+            if slot.request is None or not matched:
+                break
+            j += 1
+        slot.spec_lanes = 0
+        if n_em != n_emit_dev_i or done_i != (slot.request is None):
+            raise RuntimeError(
+                f"async stop-condition drift: slot {i} host "
+                f"emitted {n_em} (finished={slot.request is None}) "
+                f"vs device n_emit={n_emit_dev_i} done={done_i} "
+                f"at tick {tick}")
+        return n_em, n_cnt
+
     def _consume_spec(self, inf, mats, done, tr):
         """Emit a materialized speculative tick: consume exactly the
         device-accepted lanes per slot (plus the bonus token), with
@@ -2069,41 +2175,9 @@ class Engine:
                             f"{inf.tick}'s device lane is not done")
                     continue
                 self._m_spec_proposed.inc(lanes_i)
-                acc_i = int(n_acc[i])  # device-counted leading matches
-                n_cnt = 0
-                n_em = 0
-                j = 0
-                while True:
-                    # lane j's pick was drawn on device from the same
-                    # key/logits the one-token tick would use for this
-                    # prefix; consuming lanes 0..acc_i reproduces the
-                    # host accept loop exactly (acc_i counts only REAL
-                    # lanes)
-                    tok = int(picks[i, j])
-                    matched = j < acc_i
-                    if matched:
-                        # counted even when this token finishes the
-                        # request (EOS drafted by a matched lane) —
-                        # but only over lanes actually consumed: an
-                        # eviction below stops the count like the host
-                        # loop's break
-                        n_cnt += 1
-                    slot.pos += 1
-                    self._pos[i] = slot.pos
-                    self._emit(slot, tok)
-                    n_em += 1
-                    if slot.request is None or not matched:
-                        break
-                    j += 1
-                slot.spec_lanes = 0
-                if n_em != int(n_emit_dev[i]) \
-                        or bool(done[i]) != (slot.request is None):
-                    raise RuntimeError(
-                        f"async stop-condition drift: slot {i} host "
-                        f"emitted {n_em} (finished="
-                        f"{slot.request is None}) vs device n_emit="
-                        f"{int(n_emit_dev[i])} done={bool(done[i])} "
-                        f"at tick {inf.tick}")
+                n_em, n_cnt = self._emit_window_lane(
+                    slot, picks[i], int(n_acc[i]),
+                    int(n_emit_dev[i]), bool(done[i]), inf.tick)
                 self._m_spec_accepted.inc(n_cnt)
                 total_acc += n_cnt
                 emitted += n_em
@@ -2200,6 +2274,209 @@ class Engine:
             emit_sp.args["emitted"] = emitted
         return emitted
 
+    # -- ragged paged attention dispatch (attn_impl="ragged") ----------
+    def _plan_ragged_chunks(self, prefilling):
+        """Select this tick's prefill-chunk lanes for the unified
+        ragged dispatch: admission order (partially-prefilled prompts
+        resume first — ``snapshot()`` already sorts by seq), ONE
+        window lane per slot of up to ``min(_wmax, budget left)``
+        tokens, strictly capped by ``tick_token_budget`` like
+        ``_prefill_chunked``.  The lane width is capped by the
+        compiled window ``_wmax`` (= max(prefill_chunk, spec_k+1)),
+        not by ``prefill_chunk``: widths are runtime data, so a
+        spec-widened window prefills faster than the nominal chunk at
+        zero extra cost.  One structural difference from the XLA
+        path: a slot advances at most one window per tick (the XLA
+        path can spend the whole budget re-dispatching one slot's
+        chunks back to back), so per-slot prefill throughput is
+        ``_wmax`` tokens/tick — under ``attn_impl="ragged"`` size
+        ``prefill_chunk`` to the per-tick prompt throughput you want
+        (the budget then mainly arbitrates ACROSS slots).  Returns
+        [(slot, n_tokens, is_final_chunk)]."""
+        plan = []
+        budget = self._tick_budget
+        for slot in prefilling:
+            req = slot.request
+            n = min(self._wmax, budget,
+                    len(req.context) - slot.prefilled)
+            if n <= 0:
+                continue
+            plan.append((slot, n,
+                         slot.prefilled + n >= len(req.context)))
+            budget -= n
+            if budget <= 0:
+                break
+        return plan
+
+    def _dispatch_ragged(self, active, plan, tr):
+        """DISPATCH one unified RAGGED window tick without consuming
+        it: decoding slots ride as mode-0 lanes (width 1, or the k+1
+        verify window with host-proposed drafts), budgeted prefill
+        chunks as mode-1/2 lanes (width = chunk tokens) — ONE call of
+        the ONE compiled ``ragged_window`` program, whatever the mix.
+        Chunk lanes advance the prefill cursor AT DISPATCH (their
+        tokens are known up front — unlike spec drafts there is no
+        data dependence on the in-flight window), so a depth-2 blind
+        dispatch can plan the next chunk, and a final chunk's first
+        token rides home in the device picks: chunked prefill
+        pipelines instead of forcing a drain per chunk like the XLA
+        path's per-chunk programs."""
+        import jax.numpy as jnp
+        W = self._wmax
+        B = self.num_slots
+        spec_w = (self._spec_k + 1) if self._spec_k is not None else 1
+        toks = np.zeros((B, W), np.int32)
+        width = np.zeros(B, np.int32)
+        mode = np.zeros(B, np.int32)
+        lanes = np.zeros(B, np.int32)
+        if self._spec_k is not None and active:
+            with tr.span("spec.draft", batch=len(active),
+                         spec_k=self._spec_k):
+                toks[:, :spec_w] = self._draft_window(active)
+        for slot in active:
+            width[slot.index] = spec_w
+            if self._spec_k is not None:
+                lanes[slot.index] = slot.spec_lanes
+        chunk_toks = 0
+        for slot, n, final in plan:
+            req = slot.request
+            i = slot.index
+            p0 = slot.prefilled
+            toks[i, :n] = req.context[p0:p0 + n]
+            width[i] = n
+            mode[i] = 2 if final else 1
+            chunk_toks += n
+        # push BEFORE the chunk lanes' mirror advance below: a dirty
+        # upload must carry the PRE-dispatch cursors (the program
+        # itself advances them by width)
+        if self._state_dirty or self._dev_state is None:
+            self._push_state()
+        for slot, n, final in plan:
+            i = slot.index
+            # dispatch-time bookkeeping (kept consistent with the
+            # device cursor the program advances; the mirrors equal
+            # the post-consume state, so a drain-then-push re-upload
+            # stays exact)
+            slot.prefilled += n
+            slot.pos = slot.prefilled
+            self._pos[i] = slot.prefilled
+            self._m_chunks.inc()
+            self._m_prefill_tokens.inc(n)
+        st = self._dev_state
+        if self._ragged_fn is None:
+            # emit_w: sample only the emit-reachable lanes (spec_k+1,
+            # or 1 without speculation) — a chunk-widened window's
+            # high lanes can never emit, so their picks would be
+            # computed and discarded every tick
+            self._ragged_fn, _, _ = \
+                self.model._compiled_ragged_window_fn(
+                    self._pnames, self._params,
+                    (self.num_slots, W, spec_w, self._kv_managed + 1,
+                     self._bs, str(self._kv_dtype),
+                     tuple(self._pnames), self._bnames_all),
+                    emit_w=spec_w)
+        self._fault("dispatch")
+        with tr.span("decode.ragged", batch=len(active) + len(plan),
+                     layout="paged", w=W, chunks=len(plan),
+                     chunk_tokens=chunk_toks, fused=True):
+            (picks, n_acc, n_emit, done, new_tok, new_pos, new_ctr,
+             new_rem, self.k_pools, self.v_pools) = self._ragged_fn(
+                self._p_list(), self._b_list(), self.k_pools,
+                self.v_pools, st["tables"], jnp.asarray(toks),
+                jnp.asarray(width), jnp.asarray(mode),
+                jnp.asarray(lanes), st["tok"], st["pos"], st["temp"],
+                st["topk"], st["topp"], st["slo"], st["shi"],
+                st["ctr"], st["eos"], st["rem"])
+        st["tok"], st["pos"], st["ctr"], st["rem"] = \
+            new_tok, new_pos, new_ctr, new_rem
+        self._m_fused_ticks.inc()
+        if self._spec_k is not None and active:
+            self._m_spec_windows.inc(len(active))
+        slots = list(active) + [s for s, _, _ in plan]
+        return _InflightTick(
+            self.tick_no, "ragged", slots,
+            {"picks": picks, "n_acc": n_acc, "n_emit": n_emit,
+             "done": done}, len(slots), "paged",
+            {"pos": self._pos.tolist(), "rem": self._rem.tolist()},
+            meta_lanes=[(int(mode[s.index]), int(width[s.index]),
+                         int(lanes[s.index])) for s in slots])
+
+    def _consume_ragged(self, inf, mats, done, tr):
+        """Emit a materialized ragged tick, per lane MODE: chunk lanes
+        (mode 1) already advanced at dispatch — nothing to emit; a
+        final chunk (mode 2) registers the prompt's full blocks in the
+        prefix cache and emits the device-sampled first token (picks
+        lane 0 — drawn with the unshifted counter key, the stream's
+        next draw); decode / spec lanes (mode 0) run the same
+        accepted-prefix emit loop as ``_consume_spec``, a pure decode
+        lane being its zero-draft degenerate case.  Host-vs-device
+        drift in any mode raises into step recovery."""
+        picks = mats["picks"]
+        n_acc = mats["n_acc"]
+        n_emit_dev = mats["n_emit"]
+        emitted = 0
+        total_acc = 0
+        emitted_spec = 0
+        n_spec = 0
+        with tr.span("decode.emit", batch=inf.batch,
+                     layout=inf.layout) as emit_sp:
+            for slot, req, (mode_i, width_i, lanes_i) in zip(
+                    inf.slots, inf.reqs, inf.meta_lanes):
+                i = slot.index
+                if slot.request is not req:
+                    if not done[i]:
+                        raise RuntimeError(
+                            f"async stop-condition drift: slot {i} "
+                            f"was evicted on the host but tick "
+                            f"{inf.tick}'s device lane is not done")
+                    continue
+                if mode_i == 1:
+                    if int(n_emit_dev[i]):
+                        raise RuntimeError(
+                            f"ragged drift: chunk lane {i} emitted "
+                            f"{int(n_emit_dev[i])} on device at tick "
+                            f"{inf.tick}")
+                    continue
+                if mode_i == 2:
+                    ctxt = req.context
+                    if self.prefix_cache is not None:
+                        self.prefix_cache.insert(
+                            ctxt,
+                            self._slot_blocks[i][:len(ctxt)
+                                                 // self._bs])
+                    self._emit(slot, int(picks[i, 0]))
+                    emitted += 1
+                    if int(n_emit_dev[i]) != 1 or \
+                            bool(done[i]) != (slot.request is None):
+                        raise RuntimeError(
+                            f"ragged drift: final-chunk lane {i} "
+                            f"device n_emit={int(n_emit_dev[i])} "
+                            f"done={bool(done[i])} vs host finished="
+                            f"{slot.request is None} at tick "
+                            f"{inf.tick}")
+                    continue
+                # mode 0: decode / spec window — the same emit loop
+                # as _consume_spec (zero draft lanes = plain decode)
+                if self._spec_k is not None:
+                    self._m_spec_proposed.inc(lanes_i)
+                    n_spec += 1
+                n_em, n_cnt = self._emit_window_lane(
+                    slot, picks[i], int(n_acc[i]),
+                    int(n_emit_dev[i]), bool(done[i]), inf.tick)
+                if self._spec_k is not None:
+                    self._m_spec_accepted.inc(n_cnt)
+                    total_acc += n_cnt
+                emitted_spec += n_em
+                emitted += n_em
+            emit_sp.args.update(emitted=emitted, accepted=total_acc)
+        if self._spec_k is not None and n_spec:
+            proposed = self._m_spec_proposed.value
+            if proposed:
+                self._m_spec_rate.set(
+                    self._m_spec_accepted.value / proposed)
+            self._m_spec_tpt.set(emitted_spec / n_spec)
+        return emitted
+
     def _consume(self, inf, tr):
         """Materialize and emit one in-flight tick.  The blocking
         ``np.asarray`` on the ids + done mask is the async loop's ONLY
@@ -2232,11 +2509,23 @@ class Engine:
         with ov:
             if inf.kind == "spec":
                 emitted = self._consume_spec(inf, mats, done, tr)
+            elif inf.kind == "ragged":
+                emitted = self._consume_ragged(inf, mats, done, tr)
             else:
                 emitted = self._consume_decode(inf, mats, done, tr)
         if in_flight:
             self._overlap_acc += time.monotonic() - t1
         return emitted
+
+    def _note_dispatch_gap(self, n_active):
+        """Pre-dispatch bookkeeping shared by the sync, async, and
+        ragged tick paths (stall histogram + decode-batch gauge):
+        ONE implementation, so the stall accounting cannot diverge
+        between attn_impl modes or pipeline depths."""
+        if self._last_decode_end is not None:
+            self._m_stall.observe(
+                (time.monotonic() - self._last_decode_end) * 1e3)
+        self._m_decode_batch.set(n_active)
 
     def _drain_ring(self, tr):
         """Consume every in-flight tick, oldest first (the dirty-event
@@ -2434,7 +2723,13 @@ class Engine:
             for slot in admitted:
                 self._begin_chunked(slot)
             _, _, prefilling = self.scheduler.snapshot()
-            if prefilling:
+            if prefilling and self.attn_impl != "ragged":
+                # ragged mode: chunks ride as lanes of the unified
+                # dispatch below — and because their tokens are known
+                # up front (no data dependence on the in-flight
+                # window), chunk progress needs NO pipeline drain,
+                # unlike the XLA per-chunk programs whose cursor
+                # updates dirty the mirrors every chunk
                 n_emit, _, _ = self._prefill_chunked(prefilling)
                 emitted += n_emit
         # -- spec barrier: drafting is data-dependent on the previous
@@ -2450,8 +2745,10 @@ class Engine:
         #    over an empty pipeline ---------------------------------
         if self._ring and (self._state_dirty or self._dev_state is None):
             emitted += self._drain_ring(tr)
-        occ, active, _ = self.scheduler.snapshot()
+        occ, active, prefilling = self.scheduler.snapshot()
+        ragged = self.attn_impl == "ragged"
         if active and self._ring and self._spec_k is None and \
+                not (ragged and prefilling) and \
                 all(self._rem[s.index] <= len(self._ring)
                     for s in active):
             # bursty-tail cutoff: the rem mirrors say every active
@@ -2459,20 +2756,22 @@ class Engine:
             # flight, so one more dispatch would compute only frozen
             # lanes — consume instead (EOS can still finish a lane
             # earlier than its budget; that case just falls through
-            # to the done-mask path)
+            # to the done-mask path).  Pending ragged chunk lanes
+            # veto the cutoff: their dispatch still does real work.
             emitted += self._drain_ring(tr)
-            occ, active, _ = self.scheduler.snapshot()
+            occ, active, prefilling = self.scheduler.snapshot()
         n_before = self._evicted_in_tick
+        plan = (self._plan_ragged_chunks(prefilling)
+                if ragged and self._chunk is not None else [])
         # -- dispatch tick N+1 ---------------------------------------
-        if active:
-            t0 = time.monotonic()
-            if self._last_decode_end is not None:
-                self._m_stall.observe((t0 - self._last_decode_end)
-                                      * 1e3)
-            self._m_decode_batch.set(len(active))
-            inf = (self._dispatch_spec(active, tr)
-                   if self._spec_k is not None
-                   else self._dispatch_decode(active, tr))
+        if active or plan:
+            self._note_dispatch_gap(len(active))
+            if ragged:
+                inf = self._dispatch_ragged(active, plan, tr)
+            else:
+                inf = (self._dispatch_spec(active, tr)
+                       if self._spec_k is not None
+                       else self._dispatch_decode(active, tr))
             self._ring.append(inf)
             self._last_decode_end = time.monotonic()
         else:
@@ -2480,7 +2779,7 @@ class Engine:
             self._last_decode_end = None
         # -- consume tick N (the emit loop overlaps N+1's compute);
         #    with nothing dispatched, drain the tail completely ------
-        keep = (self.async_depth - 1) if active else 0
+        keep = (self.async_depth - 1) if (active or plan) else 0
         while len(self._ring) > keep:
             emitted += self._consume(self._ring.pop(0), tr)
         occ -= self._evicted_in_tick - n_before
@@ -2533,23 +2832,35 @@ class Engine:
                              prompt=int(len(slot.request.prompt))):
                     self._prefill(slot)
                 emitted += 1  # prefill samples the first token
-            occ, active, _ = self.scheduler.snapshot()
+            occ, active, prefilling = self.scheduler.snapshot()
         else:
             for slot in admitted:
                 self._begin_chunked(slot)
             occ, active, prefilling = self.scheduler.snapshot()
-            if prefilling:
+            if prefilling and self.attn_impl != "ragged":
+                # ragged mode skips the per-chunk dispatch loop —
+                # chunks ride as window lanes of the unified dispatch
                 n_emit, newly, n_evicted = \
                     self._prefill_chunked(prefilling)
                 emitted += n_emit
                 occ -= n_evicted
                 active = active + newly  # final-chunk slots decode in
                 #   this same tick, like monolithic emit-then-decode
-        if active:
-            t0 = time.monotonic()
-            if self._last_decode_end is not None:
-                self._m_stall.observe((t0 - self._last_decode_end) * 1e3)
-            self._m_decode_batch.set(len(active))
+        if self.attn_impl == "ragged":
+            plan = (self._plan_ragged_chunks(prefilling)
+                    if self._chunk is not None else [])
+            if active or plan:
+                self._note_dispatch_gap(len(active))
+                n_before = self._evicted_in_tick
+                inf = self._dispatch_ragged(active, plan, tr)
+                emitted += self._consume(inf, tr)
+                occ -= self._evicted_in_tick - n_before
+                self._last_decode_end = time.monotonic()
+            else:
+                self._m_decode_batch.set(0)
+                self._last_decode_end = None
+        elif active:
+            self._note_dispatch_gap(len(active))
             n_before = self._evicted_in_tick
             emitted += self._decode_tick(active)
             occ -= self._evicted_in_tick - n_before

@@ -129,6 +129,12 @@ class _Handler(BaseHTTPRequestHandler):
                     eng.block_pool.free_count()
                     if getattr(eng, "_paged", False) else None),
                 "sample_mode": getattr(eng, "sample_mode", "host"),
+                # which attention implementation serves the paged
+                # dispatches: "ragged" = the Pallas ragged paged
+                # attention kernel (one program for decode / spec /
+                # chunk windows), "xla" = the per-shape gather/
+                # scatter programs (the CPU parity oracle)
+                "attn_impl": getattr(eng, "attn_impl", "xla"),
                 # async-loop signals, next to the router-tier load
                 # signals: pipeline depth plus the mean overlapped
                 # host time and mean blocking d2h wait per tick —

@@ -43,7 +43,24 @@ block that the next window overwrites, and rollback therefore never
 allocs, frees, or refcounts a block.  Nothing here tracks a cursor —
 which is the invariant: no pool state can go stale on a rewind.
 
-The invariant tests live in tests/test_kvcache.py.
+Scratch-block / spec-margin writes under ``attn_impl="ragged"``: the
+XLA dispatches enforce the invariants above with three separate
+mechanisms (parked slots' all-zero tables route writes to the
+reserved scratch block — physical row 0; the spec margin absorbs
+rejected verify lanes; chunked prefill's ``true_len`` masks pad
+lanes into row 0).  The ragged Pallas path folds all three into ONE
+KERNEL-SIDE MASKING RULE: every window lane ``s >= width[slot]``
+scatters into physical row 0, where ``width`` is the per-slot REAL
+window width carried as kernel data (0 for a parked slot, the chunk
+length for a prefill lane, k+1 for a verify window whose rejected
+lanes still land inside the reserved margin).  The pool-layer
+contract is unchanged — no live request ever reads row 0, and no
+write ever touches a block the slot does not own — it is simply
+enforced in one place (``GPTAttention.ragged_window_paged`` +
+ops/ragged_paged_attn.py) instead of three.
+
+The invariant tests live in tests/test_kvcache.py (pool/trie) and
+tests/test_ragged_attn.py (kernel-side masking).
 """
 from __future__ import annotations
 

@@ -308,6 +308,36 @@ def test_trace_view_wall_summary(tmp_path, capsys):
     assert "wall 20.000 ms" in out
     assert "host.overlap 10.000 ms" in out
     assert "concurrently" in out
+    # no ragged dispatches in this trace: the kernel line stays out
+    assert "decode.ragged" not in out
+
+
+def test_trace_view_surfaces_ragged_kernel_dispatches(tmp_path,
+                                                      capsys):
+    """--wall breaks out ``decode.ragged`` spans (the Pallas ragged
+    paged attention dispatches of ``Engine(attn_impl="ragged")``) so
+    a trace shows at a glance whether the kernel or the per-shape XLA
+    programs (``decode.dispatch``) served the tick."""
+    tv = _load_tool("trace_view")
+    events = [
+        {"name": "tick", "ph": "X", "ts": 0.0, "dur": 10000.0,
+         "cat": "tick"},
+        {"name": "decode.ragged", "ph": "X", "ts": 500.0,
+         "dur": 6000.0, "cat": "serving",
+         "args": {"chunks": 1, "w": 8}},
+        {"name": "tick", "ph": "X", "ts": 20000.0, "dur": 10000.0,
+         "cat": "tick"},
+        {"name": "decode.ragged", "ph": "X", "ts": 20500.0,
+         "dur": 5000.0, "cat": "serving"},
+    ]
+    w = tv.wall_summary(events)
+    assert w["ragged_dispatches"] == 2
+    assert w["ragged_ms"] == pytest.approx(11.0)
+    path = tmp_path / "ragged.json"
+    path.write_text(json.dumps({"traceEvents": events}))
+    assert tv.main([str(path), "--wall"]) == 0
+    out = capsys.readouterr().out
+    assert "decode.ragged 11.000 ms over 2 Pallas" in out
 
 
 def test_trace_view_lifecycle_instants(tmp_path, capsys):

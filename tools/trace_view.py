@@ -80,8 +80,8 @@ def wall_summary(events):
     other complete-event, ``overlap_ms``/``d2h_wait_ms`` the async
     loop's own attribution spans.  phase/wall > 1 means concurrency
     (work hidden behind device compute), not an accounting bug."""
-    wall = phase = overlap = d2h_wait = 0.0
-    n_ticks = 0
+    wall = phase = overlap = d2h_wait = ragged = 0.0
+    n_ticks = n_ragged = 0
     for ev in events:
         if ev.get("ph") != "X":
             continue
@@ -96,12 +96,20 @@ def wall_summary(events):
                 overlap += dur
             elif name == "decode.d2h_wait":
                 d2h_wait += dur
+            elif name == "decode.ragged":
+                # Pallas ragged-paged-attention dispatches
+                # (Engine(attn_impl="ragged")) — broken out so a
+                # trace shows at a glance whether the kernel or the
+                # per-shape XLA programs (decode.dispatch) served it
+                ragged += dur
+                n_ragged += 1
     return {
         "ticks": n_ticks, "wall_ms": wall, "phase_ms": phase,
         "per_tick_wall_ms": wall / n_ticks if n_ticks else float("nan"),
         "per_tick_phase_ms": (phase / n_ticks if n_ticks
                               else float("nan")),
         "overlap_ms": overlap, "d2h_wait_ms": d2h_wait,
+        "ragged_ms": ragged, "ragged_dispatches": n_ragged,
     }
 
 
@@ -113,6 +121,13 @@ def format_wall(w):
         f"{w['per_tick_phase_ms']:.3f} ms",
         f"host.overlap {w['overlap_ms']:.3f} ms   "
         f"decode.d2h_wait {w['d2h_wait_ms']:.3f} ms",
+    ]
+    if w.get("ragged_dispatches"):
+        lines.append(
+            f"decode.ragged {w['ragged_ms']:.3f} ms over "
+            f"{w['ragged_dispatches']} Pallas ragged-kernel "
+            "dispatches (attn_impl='ragged')")
+    lines += [
         "(phases exceeding wall = spans ran concurrently — e.g. the "
         "async engine loop's",
         " host work hidden behind device compute; the table above "
