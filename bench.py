@@ -1454,6 +1454,216 @@ def bench_serving_ragged():
     return result
 
 
+def bench_serving_router():
+    """RESILIENT MULTI-REPLICA ROUTER (serving/router.py): prefix-
+    affinity routing vs seeded RANDOM routing over a 3-replica fleet
+    on the shared-system-prompt workload (6 distinct 16-token system
+    prompts, 4 requests each, interleaved), the router hop's added
+    p99 latency vs driving one engine directly, and failover recovery
+    on a replica kill (the affinity target of the live traffic dies;
+    the next request pays one refused hop and fails over).  The
+    honest CPU-measurable win is CACHE LOCALITY: affinity lands every
+    repeat of a system prompt on the replica whose prefix cache holds
+    its blocks, so fleet-wide ``serving.prefix_hit_tokens`` rises and
+    the shared span stops being recomputed once per replica it
+    happens to land on.  Model size is irrelevant to routing — the
+    tiny config runs everywhere.  Writes BENCH_r13.json."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import monitor
+    from paddle_tpu.models import GPTModel
+    from paddle_tpu.serving import (Engine, InProcessReplica, Router,
+                                    RouterPolicy)
+    from paddle_tpu.serving.router import affinity_key
+
+    paddle.seed(0)
+    model = GPTModel.from_config("tiny", dropout=0.0)
+    model.eval()
+    vocab = int(model.embeddings.word_embeddings.weight.shape[0])
+    rng = np.random.RandomState(0)
+    BS, MAX_NEW = 8, 4
+    sys_prompts = [rng.randint(0, vocab, (16,)).tolist()
+                   for _ in range(6)]
+    # interleaved: s0 s1 ... s5 s0 s1 ... — every repeat of a class
+    # arrives after its first request finished (cache warm)
+    jobs = [sys_prompts[i % 6]
+            + rng.randint(0, vocab, (1 + i % 3,)).tolist()
+            for i in range(24)]
+    prompt_tokens = sum(len(p) for p in jobs)
+
+    def build_engine():
+        # shared model = shared compile cache (traffic is sequential,
+        # so no two engines trace concurrently)
+        return Engine(model, num_slots=2, max_seq_len=64,
+                      kv_block_size=BS, registry=monitor.StatRegistry())
+
+    def drive(submit):
+        lats = []
+        outs = []
+        for p in jobs:
+            t0 = time.perf_counter()
+            outs.append(submit(p))
+            lats.append((time.perf_counter() - t0) * 1e3)
+        return outs, lats
+
+    def pct(vals, q):
+        return round(float(np.percentile(np.asarray(vals), q)), 3)
+
+    # warm the compile cache (it lives on the shared model) so no arm
+    # pays first-trace costs: every distinct prompt length, twice —
+    # the second submit compiles the prefix-adopted prefill shape
+    # both arms hit in steady state
+    warm = build_engine()
+    warm.start()
+    try:
+        seen = set()
+        for p in jobs:
+            if len(p) in seen:
+                continue
+            seen.add(len(p))
+            for _ in range(2):
+                warm.submit(p, max_new_tokens=MAX_NEW).result(
+                    timeout=60)
+    finally:
+        warm.stop(drain=False)
+
+    def run_arm(affinity):
+        engines = [build_engine() for _ in range(3)]
+        reps = {f"r{i}": InProcessReplica(f"r{i}", engines[i])
+                for i in range(3)}
+        reg = monitor.StatRegistry()
+        r = Router(reps, policy=RouterPolicy(affinity=affinity, seed=0),
+                   kv_block_size=BS, registry=reg)
+        for e in engines:
+            e.start()
+        try:
+            r.probe_once()
+            outs, lats = drive(
+                lambda p: r.generate(list(p),
+                                     max_new_tokens=MAX_NEW)["ids"])
+        finally:
+            for e in engines:
+                e.stop(drain=False)
+        picks = reg.get("router.picks_total").value
+        hits = reg.get("router.affinity_hits_total").value
+        cached = sum(
+            e.registry.get("serving.prefix_hit_tokens").value
+            for e in engines)
+        return outs, {
+            "affinity_pick_rate": round(hits / max(picks, 1), 3),
+            "prefix_hit_tokens": int(cached),
+            "prefix_hit_token_rate": round(cached / prompt_tokens, 3),
+            "replicas_used": len({ev[2] for ev in r.route_log()
+                                  if ev[0] == "serve"}),
+            "p50_ms": pct(lats, 50), "p99_ms": pct(lats, 99),
+        }
+
+    outs_aff, aff = run_arm(affinity=True)
+    outs_rand, rand = run_arm(affinity=False)
+    assert outs_aff == outs_rand, \
+        "greedy results must not depend on the routing policy"
+    assert aff["prefix_hit_tokens"] >= rand["prefix_hit_tokens"], \
+        "affinity routing lost cache locality to random routing"
+
+    # -- router hop overhead: one replica, direct vs through router ----
+    def run_direct():
+        eng = build_engine()
+        eng.start()
+        try:
+            return drive(lambda p: eng.submit(
+                p, max_new_tokens=MAX_NEW).result(timeout=60).tolist())
+        finally:
+            eng.stop(drain=False)
+
+    def run_hop():
+        eng = build_engine()
+        r = Router({"r0": InProcessReplica("r0", eng)},
+                   policy=RouterPolicy(seed=0), kv_block_size=BS,
+                   registry=monitor.StatRegistry())
+        eng.start()
+        try:
+            r.probe_once()
+            return drive(lambda p: r.generate(
+                list(p), max_new_tokens=MAX_NEW)["ids"])
+        finally:
+            eng.stop(drain=False)
+
+    outs_direct, lat_direct = run_direct()
+    outs_hop, lat_hop = run_hop()
+    assert [list(o) for o in outs_direct] == outs_hop
+    hop = {
+        "direct_p50_ms": pct(lat_direct, 50),
+        "direct_p99_ms": pct(lat_direct, 99),
+        "router_p50_ms": pct(lat_hop, 50),
+        "router_p99_ms": pct(lat_hop, 99),
+        "added_p99_ms": round(pct(lat_hop, 99) - pct(lat_direct, 99),
+                              3),
+    }
+
+    # -- failover recovery: kill the live traffic's affinity target ---
+    engines = [build_engine() for _ in range(3)]
+    reps = {f"r{i}": InProcessReplica(f"r{i}", engines[i])
+            for i in range(3)}
+    reg = monitor.StatRegistry()
+    r = Router(reps, policy=RouterPolicy(seed=0, retry_max=3),
+               kv_block_size=BS, registry=reg)
+    for e in engines:
+        e.start()
+    try:
+        r.probe_once()
+        sick = r._affinity_target(affinity_key(jobs[0], BS),
+                                  r._reps()).name
+        for p in jobs[:6]:
+            r.generate(list(p), max_new_tokens=MAX_NEW)
+        reps[sick].kill()
+        t0 = time.perf_counter()
+        out = r.generate(list(jobs[0]), max_new_tokens=MAX_NEW)
+        recovery_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        assert out["replica"] != sick and out["attempts"] == 2
+        assert reg.get("router.failovers_total").value >= 1
+        # after a probe sweep the dead replica stops being picked at
+        # all: steady-state requests pay zero failed hops
+        r.probe_once()
+        t0 = time.perf_counter()
+        out2 = r.generate(list(jobs[1]), max_new_tokens=MAX_NEW)
+        steady_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        assert out2["replica"] != sick and out2["attempts"] == 1
+    finally:
+        for e in engines:
+            e.stop(drain=False)
+    failover = {
+        "killed_replica": sick,
+        "first_request_recovery_ms": recovery_ms,
+        "post_probe_steady_ms": steady_ms,
+        "failovers_total": int(
+            reg.get("router.failovers_total").value),
+    }
+
+    gain = (aff["prefix_hit_tokens"]
+            / max(rand["prefix_hit_tokens"], 1))
+    result = {
+        "metric": "serving router prefix-affinity cache-locality gain "
+                  "(fleet prefix_hit_tokens, affinity vs seeded "
+                  "random, 3 replicas, shared-system-prompt workload)",
+        "value": round(gain, 2),
+        "unit": "x more prompt tokens served from the prefix cache "
+                "(greedy parity between arms asserted; router-hop "
+                "p99 and replica-kill recovery recorded)",
+        "arms": {"affinity": aff, "random": rand},
+        "router_hop": hop,
+        "failover": failover,
+        "config": {"replicas": 3, "num_slots": 2, "max_seq_len": 64,
+                   "kv_block_size": BS, "system_prompts": 6,
+                   "requests": len(jobs), "max_new_tokens": MAX_NEW},
+    }
+    try:
+        with open(os.path.join(REPO, "BENCH_r13.json"), "w") as f:
+            json.dump(result, f, indent=1)
+    except OSError:
+        pass  # read-only checkout: the returned numbers still land
+    return result
+
+
 CHILD_BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
                  "bert": bench_bert, "canary": bench_canary,
                  "decode": bench_decode, "serving": bench_serving,
@@ -1463,7 +1673,8 @@ CHILD_BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
                  "serving_trace": bench_serving_trace,
                  "serving_async": bench_serving_async,
                  "serving_overload": bench_serving_overload,
-                 "serving_ragged": bench_serving_ragged}
+                 "serving_ragged": bench_serving_ragged,
+                 "serving_router": bench_serving_router}
 
 
 def child_main(name, out_path):
@@ -1549,7 +1760,8 @@ def main():
                                            "serving_trace",
                                            "serving_async",
                                            "serving_overload",
-                                           "serving_ragged"]
+                                           "serving_ragged",
+                                           "serving_router"]
     head_name = "gpt2" if "gpt2" in names else names[0]
 
     # Headline FIRST, printed and flushed the moment it lands — the
@@ -1577,6 +1789,8 @@ def main():
                             "improvement (preemption vs FIFO)",
         "serving_ragged": "serving ragged-paged-attention compiled-"
                           "program collapse (Pallas kernel vs XLA)",
+        "serving_router": "serving router prefix-affinity cache-"
+                          "locality gain (affinity vs random routing)",
     }[head_name]
 
     # Wedge canary before the expensive headline leg (full runs only —

@@ -63,9 +63,16 @@ from .kvcache import BlockPool, NoFreeBlocks, PrefixCache  # noqa: F401
 from .spec import (  # noqa: F401
     Proposer, PromptLookupProposer, DraftModelProposer)
 from .faults import (  # noqa: F401
-    FaultInjector, InjectedFault, TickWatchdog, WatchdogTimeout)
+    FaultInjector, InjectedFault, NetDisconnect, NetFault, NetRefused,
+    NetTimeout, TickWatchdog, WatchdogTimeout)
 from .engine import Engine  # noqa: F401
 from .httpd import EngineServer, serve  # noqa: F401
+from .router import (  # noqa: F401
+    CircuitBreaker, HttpReplicaClient, InProcessReplica,
+    NoReplicasAvailable, Replica, ReplicaAbandoned, ReplicaHTTPError,
+    ReplicaUnavailable, RequestFailed, Router, RouterError,
+    RouterPolicy, affinity_key)
+from .routerd import RouterServer  # noqa: F401
 
 __all__ = [
     "Request", "RequestQueue", "RequestTimeout", "QueueFull",
@@ -76,4 +83,10 @@ __all__ = [
     "Proposer", "PromptLookupProposer", "DraftModelProposer",
     "FaultInjector", "InjectedFault", "TickWatchdog",
     "WatchdogTimeout",
+    "NetFault", "NetRefused", "NetTimeout", "NetDisconnect",
+    "Router", "RouterPolicy", "RouterServer", "RouterError",
+    "NoReplicasAvailable", "RequestFailed", "Replica",
+    "ReplicaAbandoned", "ReplicaHTTPError", "ReplicaUnavailable",
+    "CircuitBreaker", "HttpReplicaClient", "InProcessReplica",
+    "affinity_key",
 ]

@@ -53,6 +53,48 @@ site                  checked at                        action
                                                         degrades to
                                                         zero drafts
 ====================  ===============================  ==============
+
+Network-layer sites (the ROUTER tier's chaos vocabulary — checked by
+a replica TRANSPORT, e.g. ``serving.router.InProcessReplica`` or a
+test fake, with the transport's own per-replica operation counter as
+the ``tick``; the schedule stays a pure function of (seed, site,
+tick) so a seeded replica-kill storm replays exactly):
+
+====================  ===============================  ==============
+site                  checked at                        action
+====================  ===============================  ==============
+``net_refuse``        connection open (transport)       raises
+                                                        NetRefused —
+                                                        the replica's
+                                                        port is
+                                                        closed
+``net_blackhole``     request dispatch (transport)      waits
+                                                        ``blackhole_s``
+                                                        cooperatively,
+                                                        then raises
+                                                        NetTimeout —
+                                                        packets
+                                                        vanish, the
+                                                        client's
+                                                        socket
+                                                        timeout fires
+``net_slow``          request dispatch (transport)      sleeps
+                                                        ``net_slow_s``
+                                                        and PROCEEDS
+                                                        (degraded,
+                                                        not dead)
+``net_disconnect``    response body (transport)         raises
+                                                        NetDisconnect
+                                                        mid-body; the
+                                                        transport
+                                                        attaches the
+                                                        tokens
+                                                        emitted so
+                                                        far, so a
+                                                        failover can
+                                                        resume with
+                                                        context
+====================  ===============================  ==============
 """
 from __future__ import annotations
 
@@ -70,8 +112,41 @@ class WatchdogTimeout(RuntimeError):
     """The tick watchdog declared an in-flight tick wedged."""
 
 
-SITES = ("dispatch", "d2h_hang", "pool_exhaust", "host_slow",
-         "spec_draft")
+class NetFault(InjectedFault):
+    """Base of the injected network-layer failures (router transport
+    sites) — subclasses tell the router's retry classifier WHICH
+    failure mode it is looking at."""
+
+
+class NetRefused(NetFault):
+    """Injected connection-refused: the replica's port is closed
+    (process dead or not yet listening).  Instant and retryable."""
+
+
+class NetTimeout(NetFault):
+    """Injected black hole: the request went out, nothing came back,
+    and the client's socket timeout fired.  Retryable — but the
+    request MAY have been executed (the loss could be on the response
+    path), so only idempotent work should be blindly re-sent."""
+
+
+class NetDisconnect(NetFault):
+    """Injected mid-body disconnect: the response stream died after
+    ``emitted`` tokens were already received.  A failover can resume
+    with prompt + emitted as the new context instead of recomputing
+    (and for greedy/seeded traffic, the resumed stream is identical
+    to the uninterrupted one)."""
+
+    def __init__(self, msg, emitted=None):
+        super().__init__(msg)
+        self.emitted = list(emitted or [])
+
+
+ENGINE_SITES = ("dispatch", "d2h_hang", "pool_exhaust", "host_slow",
+                "spec_draft")
+NET_SITES = ("net_refuse", "net_blackhole", "net_slow",
+             "net_disconnect")
+SITES = ENGINE_SITES + NET_SITES
 
 
 class FaultInjector:
@@ -99,6 +174,7 @@ class FaultInjector:
     """
 
     def __init__(self, seed=0, rates=None, hang_s=0.05, slow_s=0.01,
+                 blackhole_s=0.02, net_slow_s=0.005,
                  first_tick=None, last_tick=None):
         self.seed = int(seed)
         rates = dict(rates or {})
@@ -109,6 +185,8 @@ class FaultInjector:
         self.rates = rates
         self.hang_s = float(hang_s)
         self.slow_s = float(slow_s)
+        self.blackhole_s = float(blackhole_s)
+        self.net_slow_s = float(net_slow_s)
         self.first_tick = first_tick
         self.last_tick = last_tick
         self._explicit = set()   # (site, tick) one-shot entries
@@ -140,10 +218,15 @@ class FaultInjector:
             return False
         return self._u01(site, tick) < rate
 
-    def fire(self, site, tick, engine=None):
+    def fire(self, site, tick, engine=None, emitted=None, abort=None):
         """Record the firing and perform the site's action (may raise;
         the record lands FIRST so the log is complete even for raising
-        sites)."""
+        sites).  ``emitted``: the transport's tokens-received-so-far
+        snapshot, attached to a ``net_disconnect`` raise so a failover
+        can resume with context.  ``abort``: optional callable polled
+        during the cooperative ``net_blackhole`` wait (a router that
+        already declared this replica dead need not sit out the full
+        simulated timeout)."""
         self.log.append((tick, site))
         if site == "dispatch":
             raise InjectedFault(
@@ -168,6 +251,30 @@ class FaultInjector:
         if site == "spec_draft":
             raise InjectedFault(
                 f"injected proposer failure at tick {tick}")
+        if site == "net_refuse":
+            raise NetRefused(
+                f"injected connection refused at op {tick}")
+        if site == "net_blackhole":
+            # cooperative: poll the abort hook so a caller that has
+            # other ways of learning the replica is dead (a probe
+            # verdict) converts the black hole into an instant raise
+            deadline = time.monotonic() + self.blackhole_s
+            while time.monotonic() < deadline:
+                if abort is not None and abort():
+                    break
+                time.sleep(0.002)
+            raise NetTimeout(
+                f"injected black hole at op {tick}: no response "
+                f"within the simulated {self.blackhole_s * 1e3:.0f} ms "
+                "client timeout")
+        if site == "net_slow":
+            time.sleep(self.net_slow_s)
+            return
+        if site == "net_disconnect":
+            n = len(emitted or [])
+            raise NetDisconnect(
+                f"injected mid-body disconnect at op {tick} after "
+                f"{n} emitted tokens", emitted=emitted)
 
 
 

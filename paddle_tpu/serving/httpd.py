@@ -17,12 +17,30 @@ full submit -> queue -> slot -> result path over a real socket):
   GET  /healthz    {"slots_free": n, "queue_depth": n,
                     "kv_blocks_free": n|null, ...} — always carries
                    the router-tier load signals (queue depth, free
-                   slots, free KV blocks)
+                   slots, free KV blocks) plus the LIVENESS vs
+                   READINESS split: "live" (process up), "ready"
+                   (accepting new work), "state" distinguishing
+                   "draining" (finishing up — stop routing, let it
+                   land its streams) from "watchdog_fired" (wedged
+                   tick — possibly dying) from "ok"
+  GET  /livez      200 while the process serves (liveness probe)
+  GET  /readyz     200 {"ready": true} when accepting new work;
+                   503 with a machine-readable "reason"
+                   ("draining" | "watchdog_fired") when not — a
+                   router (or k8s-style prober) distinguishes
+                   "dying" from "finishing up" without parsing prose
   GET  /debug/trace     current trace ring as chrome-trace JSON
                         (open in chrome://tracing / Perfetto, or feed
                         tools/trace_view.py)
   GET  /debug/requests  in-flight slot/request states (prefill
                         progress, spec lanes, KV blocks) + the queue
+
+Every 4xx/5xx body is JSON with a machine-readable ``reason``
+(``bad_request`` / ``queue_full`` / ``rate_limited`` /
+``deadline_shed`` / ``draining`` / ``result_timeout`` / ``internal``
+/ ``not_found`` / ``http_<code>`` for stdlib-generated errors) and a
+``Content-Type`` header — the router tier's retry classifier keys on
+``reason``, never on prose.
 
 Handlers run on ThreadingHTTPServer worker threads and block on
 ``Request.result()`` while the engine's own thread decodes — the
@@ -35,7 +53,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import monitor
-from .request import RateLimited, Rejected, RequestTimeout
+from .request import (DeadlineShed, RateLimited, Rejected,
+                      RequestTimeout)
 
 
 def _retry_after_header(e):
@@ -57,9 +76,42 @@ def _hist_mean(h):
     return 0.0 if h is None else round(h.mean(), 3)
 
 
-class _Handler(BaseHTTPRequestHandler):
-    engine = None          # bound per-server via the factory below
-    result_timeout = 120.0
+def _shed_reason(e, draining=False):
+    """Machine-readable reason code for a Rejected exception — the
+    router's retry classifier keys on this, not on the message."""
+    if isinstance(e, RateLimited):
+        return "rate_limited"
+    if isinstance(e, DeadlineShed):
+        return "deadline_shed"
+    # QueueFull covers both a full queue and a draining engine; the
+    # distinction matters to a router (draining = stop routing here
+    # entirely; queue_full = back off and retry here) — the caller
+    # passes the engine's actual drain flag, never message prose
+    if draining:
+        return "draining"
+    return "queue_full"
+
+
+def _readiness(eng):
+    """(ready, state) for the liveness/readiness split: an engine that
+    is DRAINING is finishing up (in-flight streams complete, no new
+    work), one whose WATCHDOG fired is wedged mid-tick (possibly
+    dying) — a prober must treat the two differently, and neither is
+    the same as dead."""
+    if getattr(eng, "_watchdog_fired", False):
+        return False, "watchdog_fired"
+    if getattr(eng, "_draining", False):
+        return False, "draining"
+    return True, "ok"
+
+
+class JsonHandler(BaseHTTPRequestHandler):
+    """Shared JSON-HTTP plumbing for the serving tier's handlers
+    (engine httpd AND routerd): quiet logging, Content-Length'd
+    sends, and the JSON-with-``reason`` error contract — including
+    stdlib-generated errors (malformed request line, unsupported
+    method), which would otherwise emit an HTML body.  The contract
+    lives HERE, once: a router client never parses prose."""
 
     def log_message(self, fmt, *args):  # quiet by default
         pass
@@ -76,6 +128,30 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send_json(self, code, obj, headers=None):
         self._send(code, json.dumps(obj), headers=headers)
+
+    def send_error(self, code, message=None, explain=None):
+        # stdlib send_error closes the connection — keep that: a
+        # stdlib-generated error (unsupported method, malformed
+        # request line) can leave an unread request body on the
+        # socket, and a keep-alive client would desync parsing those
+        # bytes as the next request line
+        self.close_connection = True
+        body = json.dumps({"error": message or f"HTTP {code}",
+                           "reason": f"http_{code}"}).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Connection", "close")
+        self.end_headers()
+        # stdlib suppresses the body for HEAD and bodyless statuses
+        if self.command != "HEAD" and code >= 200 \
+                and code not in (204, 304):
+            self.wfile.write(body)
+
+
+class _Handler(JsonHandler):
+    engine = None          # bound per-server via the factory below
+    result_timeout = 120.0
 
     def _validate_prompt(self, prompt, max_new_tokens):
         """Reject malformed / over-capacity prompts AT THE EDGE with a
@@ -120,14 +196,26 @@ class _Handler(BaseHTTPRequestHandler):
             # present: they are the per-engine load signals a router
             # tier balances on (kv_blocks_free is null in contiguous
             # mode — capacity there is slots, not blocks)
+            ready, state = _readiness(eng)
             info = {
                 "status": "ok",
+                # liveness vs readiness: answering at all = live;
+                # ready only when accepting new work; state carries
+                # WHY not ("draining" vs "watchdog_fired")
+                "live": True,
+                "ready": ready,
+                "state": state,
+                "watchdog_fired": bool(
+                    getattr(eng, "_watchdog_fired", False)),
                 "slots_total": eng.num_slots,
                 "slots_free": eng.scheduler.free_count(),
                 "queue_depth": eng.queue.depth(),
                 "kv_blocks_free": (
                     eng.block_pool.free_count()
                     if getattr(eng, "_paged", False) else None),
+                # the router's prefix-affinity hash aligns on this
+                "kv_block_size": (eng._bs if getattr(eng, "_paged",
+                                                     False) else None),
                 "sample_mode": getattr(eng, "sample_mode", "host"),
                 # which attention implementation serves the paged
                 # dispatches: "ragged" = the Pallas ragged paged
@@ -177,6 +265,23 @@ class _Handler(BaseHTTPRequestHandler):
                 info["spec_tokens_per_tick"] = round(
                     eng._m_spec_tpt.value, 4)
             self._send_json(200, info)
+        elif self.path == "/livez":
+            # liveness only: the process is up and answering — a
+            # draining or wedged engine is still LIVE (restarting it
+            # would kill the streams it is trying to land)
+            self._send_json(200, {"status": "ok", "live": True})
+        elif self.path == "/readyz":
+            ready, state = _readiness(eng)
+            if ready:
+                self._send_json(200, {"status": "ok", "ready": True,
+                                      "state": state})
+            else:
+                # 503 so a dumb prober can act on the status code
+                # alone; "reason" so a smart one can distinguish
+                # draining (finishing up) from watchdog_fired (dying)
+                self._send_json(503, {"status": "unavailable",
+                                      "ready": False, "state": state,
+                                      "reason": state})
         elif self.path == "/debug/trace":
             # the live trace ring as a downloadable chrome-trace file
             self._send(
@@ -186,11 +291,13 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/debug/requests":
             self._send_json(200, eng.debug_requests())
         else:
-            self._send_json(404, {"error": f"no route {self.path}"})
+            self._send_json(404, {"error": f"no route {self.path}",
+                                  "reason": "not_found"})
 
     def do_POST(self):
         if self.path != "/generate":
-            self._send_json(404, {"error": f"no route {self.path}"})
+            self._send_json(404, {"error": f"no route {self.path}",
+                                  "reason": "not_found"})
             return
         try:
             n = int(self.headers.get("Content-Length", 0))
@@ -199,11 +306,13 @@ class _Handler(BaseHTTPRequestHandler):
             max_new = int(body.get("max_new_tokens", 16))
         except (KeyError, TypeError, ValueError,
                 json.JSONDecodeError) as e:
-            self._send_json(400, {"error": f"bad request: {e}"})
+            self._send_json(400, {"error": f"bad request: {e}",
+                                  "reason": "bad_request"})
             return
         err = self._validate_prompt(prompt, max_new)
         if err is not None:
-            self._send_json(400, {"error": err})
+            self._send_json(400, {"error": err,
+                                  "reason": "bad_request"})
             return
         try:
             req = self.engine.submit(
@@ -223,22 +332,29 @@ class _Handler(BaseHTTPRequestHandler):
             # backlog over the measured drain rate, or the token
             # bucket's refill time — an honest hint, not a constant
             code = 429 if isinstance(e, RateLimited) else 503
-            self._send_json(code, {"error": str(e)},
-                            headers=_retry_after_header(e))
+            self._send_json(
+                code,
+                {"error": str(e),
+                 "reason": _shed_reason(e, draining=bool(
+                     getattr(self.engine, "_draining", False)))},
+                headers=_retry_after_header(e))
             return
         except (TypeError, ValueError) as e:
             # TypeError covers JSON nulls / non-numeric fields hitting
             # the int()/float() coercions — still a 400, not a dropped
             # connection
-            self._send_json(400, {"error": str(e)})
+            self._send_json(400, {"error": str(e),
+                                  "reason": "bad_request"})
             return
         try:
             ids = req.result(timeout=self.result_timeout)
         except RequestTimeout as e:
-            self._send_json(504, {"error": str(e)})
+            self._send_json(504, {"error": str(e),
+                                  "reason": "result_timeout"})
             return
         except (TimeoutError, RuntimeError) as e:
-            self._send_json(500, {"error": str(e)})
+            self._send_json(500, {"error": str(e),
+                                  "reason": "internal"})
             return
         ttft = None
         if req.first_token_at is not None:

@@ -1,0 +1,1322 @@
+"""Resilient multi-replica router: the scale-OUT half of serving.
+
+One ``Engine`` scales up (paged KV, chunked prefill, speculation,
+async ticks); this module scales out: a ``Router`` owns a REGISTRY of
+engine replicas, probes each one's health on a jittered interval, and
+spreads traffic over the live ones.  At fleet scale a replica being
+slow, draining, or dead is the steady state, not the exception — so
+robustness is the design center, not an afterthought:
+
+* **Prefix-affinity routing.**  The first ``kv_block_size``-aligned
+  span of the prompt is hashed (``affinity_key``) and mapped to a
+  replica by highest-random-weight (rendezvous) hashing — shared
+  system prompts land on the replica whose prefix cache already holds
+  their blocks, and replica churn only remaps the keys that touched
+  the changed replica.  When the affinity target is sick, breaker-
+  open, or its probed ``queue_depth`` crosses a threshold, the pick
+  falls back to LEAST-LOADED over the healthy set.
+
+* **Health-probed registry.**  ``probe_once()`` (or the background
+  prober ``start()`` runs) hits every replica's ``/healthz``-shaped
+  probe and classifies it ``healthy`` / ``degraded`` / ``draining`` /
+  ``dead``: one failed probe degrades, ``dead_after`` consecutive
+  failures kill, a replica reporting ``draining`` stops receiving new
+  requests (mirroring ``Engine.stop(drain=True)`` — it is finishing,
+  not dying), and a ``watchdog_fired`` replica is degraded until its
+  next clean probe.  Probes also double as the circuit breaker's
+  half-open trial: a clean probe against an OPEN breaker re-admits
+  real traffic through half-open.
+
+* **Retry / hedge / circuit-break.**  Submit-side failures are
+  CLASSIFIED: connection refused, black-hole timeouts (idempotent
+  requests only — a lost response may mean executed work), 5xx, and
+  probe-declared-dead replicas retry with exponential backoff + seeded
+  jitter, honoring a 503's computed ``Retry-After``; 4xx never retry.
+  Each replica carries a ``CircuitBreaker`` (consecutive-failure trip
+  -> OPEN; after a cooldown, HALF_OPEN admits one trial request whose
+  outcome closes or re-opens it).  Optional tail-latency HEDGING for
+  idempotent requests dispatches a second copy to the next-best
+  replica after a p99-derived delay; the first winner cancels the
+  loser.
+
+* **Failover with context.**  A mid-body disconnect carries the
+  tokens already received: a GREEDY request resumes on another replica
+  with ``prompt + emitted`` as its context (the resumed stream is
+  token-identical to the uninterrupted one); sampled requests restart
+  from scratch (a seeded stream re-drawn from token 0 is identical —
+  resuming mid-stream would shift the device sampling counter).  A
+  request still QUEUED on a replica the prober declares dead is
+  abandoned and re-routed without losing anything.  Either way each
+  logical request is delivered exactly once — orphaned work on a
+  half-dead replica is discarded, never double-served.
+
+Everything is observable: ``route.pick`` / ``route.retry`` /
+``route.hedge`` / ``probe`` spans in the router's own tracer,
+``router.*`` metrics (retries, failovers, hedges, affinity hits,
+per-replica health and breaker-state gauges) in the monitor registry,
+and a bounded structured ``route_log()`` whose entries are a pure
+function of the seed + the replica fault schedule — seeded chaos
+storms replay the same routing decisions (tests assert it).
+
+Transports: ``HttpReplicaClient`` speaks to a real ``serving.httpd``
+endpoint; ``InProcessReplica`` wraps a local ``Engine`` directly (the
+tier-1 test / bench / single-host fleet transport) and threads the
+``net_*`` fault sites of ``serving.faults`` through its own
+deterministic per-replica operation counter.  ``serving.routerd``
+puts an HTTP front door on the router itself.
+"""
+from __future__ import annotations
+
+import hashlib
+import itertools
+import threading
+import time
+from collections import deque
+
+from .. import monitor
+from .faults import NetDisconnect, NetRefused, NetTimeout
+from .request import Rejected
+
+# -- replica health states (the probe classifier's vocabulary) ----------
+HEALTHY = "healthy"      # probing clean; full routing weight
+DEGRADED = "degraded"    # one failed probe / watchdog_fired: routable
+#   only when no healthy replica can take the request
+DRAINING = "draining"    # replica reported draining (stop(drain=True)
+#   in progress): finishing its streams, gets NO new requests
+DEAD = "dead"            # dead_after consecutive probe failures: not
+#   routable; in-flight waiters abandon and fail over
+
+# numeric codes for the per-replica health gauge (alert on < 3)
+HEALTH_CODE = {DEAD: 0, DRAINING: 1, DEGRADED: 2, HEALTHY: 3}
+
+# circuit breaker states + gauge codes (alert on > 0)
+CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+BREAKER_CODE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class RouterError(RuntimeError):
+    """Base of router-side request failures."""
+
+
+class NoReplicasAvailable(RouterError):
+    """Every registered replica is dead, draining, or breaker-open."""
+
+
+class RequestFailed(RouterError):
+    """The request exhausted its retries (or hit a non-retryable
+    replica error); ``cause`` is the last replica-side exception."""
+
+    def __init__(self, msg, cause=None):
+        super().__init__(msg)
+        self.cause = cause
+
+
+class ReplicaUnavailable(RuntimeError):
+    """Replica-side load shed (HTTP 503/429 shaped): retryable, with
+    the replica's own computed ``retry_after`` honored by the backoff.
+    """
+
+    def __init__(self, msg, status=503, retry_after=None, reason=None):
+        super().__init__(msg)
+        self.status = int(status)
+        self.retry_after = retry_after
+        self.reason = reason
+
+
+class ReplicaHTTPError(RuntimeError):
+    """Non-shed replica HTTP error; 4xx are the CALLER's fault and
+    never retried, 5xx retry."""
+
+    def __init__(self, msg, status, reason=None):
+        super().__init__(msg)
+        self.status = int(status)
+        self.reason = reason
+
+
+class ReplicaAbandoned(RuntimeError):
+    """The transport abandoned a QUEUED-BUT-UNSTARTED request because
+    the prober declared its replica dead (or the router is stopping):
+    nothing was emitted, so the failover re-dispatches it whole."""
+
+
+def affinity_key(prompt, block_size):
+    """Stable hash of the first ``block_size``-aligned span of the
+    prompt — the prefix-cache granularity: two prompts sharing their
+    aligned head (a system prompt) hash equal and route together,
+    landing on the replica whose ``PrefixCache`` holds those blocks.
+    Prompts shorter than one block hash whole (they still benefit from
+    co-locating identical short prompts)."""
+    ids = [int(t) for t in prompt]
+    bs = max(int(block_size), 1)
+    n = (len(ids) // bs) * bs
+    span = ids[:n] if n else ids
+    return hashlib.blake2b(",".join(map(str, span)).encode(),
+                           digest_size=16).digest()
+
+
+def _u01(seed, *parts):
+    """Deterministic uniform draw in [0, 1) from (seed, parts) — the
+    same blake2b construction as faults.FaultInjector, so every jitter
+    the router applies (backoff spread, probe stagger, random-routing
+    picks) is a pure function of its seed."""
+    h = hashlib.blake2b(
+        ":".join(str(p) for p in (seed,) + parts).encode(),
+        digest_size=8).digest()
+    return int.from_bytes(h, "big") / 2.0 ** 64
+
+
+class CircuitBreaker:
+    """Per-replica circuit breaker.
+
+    CLOSED counts consecutive failures; ``threshold`` of them TRIP to
+    OPEN (all traffic skips the replica).  After ``cooldown_s`` the
+    next admission probe moves to HALF_OPEN, which admits exactly ONE
+    trial request: success closes the breaker, failure re-opens it
+    (fresh cooldown).  A clean HEALTH PROBE against an elapsed OPEN
+    breaker also moves it to HALF_OPEN — probe-driven recovery, so a
+    replica that came back is re-admitted even with no traffic to
+    spend on trials.  Thread-safe; ``on_transition`` (state str) fires
+    outside the decision itself but under the breaker lock, so
+    transition ORDER is exact."""
+
+    def __init__(self, threshold=3, cooldown_s=1.0, on_transition=None):
+        self.threshold = max(int(threshold), 1)
+        self.cooldown_s = float(cooldown_s)
+        self.state = CLOSED
+        self.failures = 0          # consecutive, CLOSED state only
+        self.opened_at = None
+        self.trips = 0
+        self._trial_inflight = False
+        self._lock = threading.Lock()
+        self._on_transition = on_transition
+
+    def _set(self, state, now=None):
+        if state == self.state:
+            return
+        self.state = state
+        if state == OPEN:
+            self.opened_at = time.monotonic() if now is None else now
+            self.trips += 1
+        if self._on_transition is not None:
+            self._on_transition(state)
+
+    def _cooled(self, now):
+        return (self.opened_at is None
+                or now - self.opened_at >= self.cooldown_s)
+
+    def peek(self, now=None):
+        """Would a request be admitted right now? (pure — no
+        half-open slot is consumed)"""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self.state == CLOSED:
+                return True
+            if self.state == OPEN:
+                return self._cooled(now)
+            return not self._trial_inflight  # HALF_OPEN: one trial
+
+    def acquire(self, now=None):
+        """Admit a request (consumes the half-open trial slot when in
+        recovery).  Returns False when the breaker blocks it."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self.state == CLOSED:
+                return True
+            if self.state == OPEN:
+                if not self._cooled(now):
+                    return False
+                self._set(HALF_OPEN, now)
+            if self._trial_inflight:
+                return False
+            self._trial_inflight = True
+            return True
+
+    def record_success(self):
+        with self._lock:
+            self.failures = 0
+            self._trial_inflight = False
+            if self.state != CLOSED:
+                self._set(CLOSED)
+
+    def record_failure(self, now=None):
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._trial_inflight = False
+            if self.state == HALF_OPEN:
+                self._set(OPEN, now)   # failed trial: fresh cooldown
+                return
+            self.failures += 1
+            if self.state == CLOSED and self.failures >= self.threshold:
+                self._set(OPEN, now)
+
+    def release_trial(self):
+        """Hand back an admitted HALF_OPEN trial slot without judging
+        the replica: the attempt was cancelled by the ROUTER (hedge
+        loser, shutdown), so its outcome says nothing — the next
+        request becomes the trial instead of the state wedging."""
+        with self._lock:
+            self._trial_inflight = False
+
+    def on_probe_success(self, now=None):
+        """A clean health probe: if the breaker is OPEN and cooled,
+        move to HALF_OPEN so the next real request is the trial —
+        probe-driven recovery (an idle replica would otherwise stay
+        tripped forever)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self.state == OPEN and self._cooled(now):
+                self._set(HALF_OPEN, now)
+
+
+class RouterPolicy:
+    """Router tuning knobs (every default is production-shaped; tests
+    shrink the time constants).
+
+    probe_interval_s / probe_jitter : background prober period and its
+        +/- fractional seeded jitter (probes from N routers must not
+        synchronize into thundering herds).
+    dead_after : consecutive failed probes before a replica is DEAD
+        (the first failure only degrades it).
+    retry_max : re-dispatch attempts after the first (so a request
+        touches at most ``retry_max + 1`` replicas).
+    backoff_base_s / backoff_cap_s / backoff_jitter : exponential
+        backoff ``min(cap, base * 2^n)`` with +/- ``jitter`` fraction
+        of seeded spread; a replica's ``Retry-After`` hint raises the
+        wait when larger.  Failovers off a DEAD replica skip the
+        backoff — the work is not failing, the host is.
+    hedge / hedge_after_s : tail-latency hedging for IDEMPOTENT
+        requests (greedy, or explicitly seeded).  ``None`` derives the
+        delay from the router's own request-latency p99 (falling back
+        to ``hedge_floor_s`` until enough samples exist).
+    breaker_threshold / breaker_cooldown_s : CircuitBreaker knobs.
+    affinity : True = prefix-affinity with least-loaded fallback;
+        False = seeded RANDOM routing (the bench's baseline arm).
+    affinity_queue_threshold : probed queue_depth beyond which the
+        affinity target is considered overloaded and the pick falls
+        back to least-loaded (cache locality must not create a hot
+        shard).
+    request_timeout_s : per-attempt transport timeout.
+    seed : the determinism root for every jitter draw.
+    """
+
+    def __init__(self, probe_interval_s=1.0, probe_jitter=0.5,
+                 dead_after=3, retry_max=3, backoff_base_s=0.05,
+                 backoff_cap_s=2.0, backoff_jitter=0.5, hedge=False,
+                 hedge_after_s=None, hedge_floor_s=0.1,
+                 breaker_threshold=3, breaker_cooldown_s=1.0,
+                 affinity=True, affinity_queue_threshold=8,
+                 request_timeout_s=60.0, seed=0):
+        if dead_after < 1:
+            raise ValueError(f"dead_after must be >= 1, got {dead_after}")
+        if retry_max < 0:
+            raise ValueError(f"retry_max must be >= 0, got {retry_max}")
+        if breaker_cooldown_s < 0:
+            raise ValueError("breaker_cooldown_s must be >= 0, got "
+                             f"{breaker_cooldown_s}")
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_jitter = float(probe_jitter)
+        self.dead_after = int(dead_after)
+        self.retry_max = int(retry_max)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.backoff_jitter = float(backoff_jitter)
+        self.hedge = bool(hedge)
+        self.hedge_after_s = hedge_after_s
+        self.hedge_floor_s = float(hedge_floor_s)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.affinity = bool(affinity)
+        self.affinity_queue_threshold = int(affinity_queue_threshold)
+        self.request_timeout_s = float(request_timeout_s)
+        self.seed = int(seed)
+
+
+class Replica:
+    """One registry entry: transport client + probed state + breaker.
+    ``signals`` is the latest probe's load view (queue_depth,
+    slots_free, kv_blocks_free, drain_rate_tps, ...)."""
+
+    def __init__(self, name, client, breaker):
+        self.name = str(name)
+        self.client = client
+        self.breaker = breaker
+        self.state = HEALTHY     # optimistic until the first probe —
+        #   a router must route before its prober's first sweep
+        self.signals = {}
+        self.probe_failures = 0  # consecutive
+        self.last_probe_at = None
+        self.inflight = 0        # guarded: handler + hedge threads
+        self._inflight_lock = threading.Lock()
+
+    def track(self, delta):
+        with self._inflight_lock:
+            self.inflight += delta
+
+    def load_key(self):
+        """Least-loaded ordering: probed queue depth first, then the
+        fewest free slots LAST (more headroom wins), name as the
+        deterministic tiebreak."""
+        q = self.signals.get("queue_depth")
+        free = self.signals.get("slots_free")
+        return (q if q is not None else 0,
+                -(free if free is not None else 0), self.name)
+
+    def view(self):
+        """JSON-shaped registry row (the routerd /replicas surface)."""
+        return {
+            "name": self.name, "state": self.state,
+            "breaker": self.breaker.state,
+            "breaker_trips": self.breaker.trips,
+            "probe_failures": self.probe_failures,
+            "inflight": self.inflight,
+            "address": getattr(self.client, "address", None),
+            "signals": dict(self.signals),
+        }
+
+
+class Router:
+    """Front-door tier spreading requests over N engine replicas with
+    health probing, prefix affinity, retries, hedging, circuit
+    breaking, and failover (module docstring has the full story).
+
+    Parameters
+    ----------
+    replicas : dict name -> client, or iterable of (name, client).  A
+        client implements ``probe() -> dict`` (a ``/healthz``-shaped
+        health+load view) and ``generate(payload, should_abort=None)
+        -> dict`` raising the classified transport errors
+        (NetRefused/NetTimeout/NetDisconnect, ReplicaUnavailable,
+        ReplicaHTTPError, ReplicaAbandoned).
+    policy : RouterPolicy.
+    kv_block_size : the affinity hash alignment.  None adopts the
+        first probed replica's ``kv_block_size`` (falling back to 16)
+        — the router should agree with the fleet's prefix-cache
+        granularity without being told twice.
+    registry : monitor.StatRegistry (default: the process default).
+    tracing : keep a router-side span tracer (route.pick/route.retry/
+        route.hedge/probe + request lifecycle instants).
+    """
+
+    def __init__(self, replicas=None, policy=None, kv_block_size=None,
+                 registry=None, tracing=True, trace_capacity=16384):
+        self.policy = policy or RouterPolicy()
+        self._kv_bs = (None if kv_block_size is None
+                       else int(kv_block_size))
+        self.registry = registry or monitor.default_registry()
+        self.tracer = (monitor.Tracer(capacity=trace_capacity)
+                       if tracing else monitor.NullTracer())
+        self._lock = threading.Lock()
+        self._replicas = {}
+        self._rids = itertools.count()
+        self._probe_no = itertools.count()
+        self.log = deque(maxlen=4096)   # structured routing decisions;
+        #   entry ORDER is deterministic for sequential traffic, and
+        #   per-request subsequences are deterministic always
+        self._stopping = False
+        self._probe_thread = None
+        self._probe_stop = threading.Event()
+        reg = self.registry
+        self._m_reqs = reg.counter(
+            "router.requests_total", "requests accepted by the router")
+        self._m_served = reg.counter(
+            "router.served_total", "requests completed and delivered")
+        self._m_failed = reg.counter(
+            "router.failed_total", "requests failed after classification")
+        self._m_retries = reg.counter(
+            "router.retries_total", "re-dispatch attempts (all causes)")
+        self._m_failovers = reg.counter(
+            "router.failovers_total",
+            "re-dispatches caused by a dying/dead replica (abandoned "
+            "queued requests + mid-stream disconnects)")
+        self._m_hedges = reg.counter(
+            "router.hedges_total", "hedge dispatches armed and fired")
+        self._m_hedge_wins = reg.counter(
+            "router.hedge_wins_total",
+            "requests where the hedge finished before the primary")
+        self._m_picks = reg.counter(
+            "router.picks_total", "routing decisions made")
+        self._m_affinity = reg.counter(
+            "router.affinity_hits_total",
+            "picks that landed on the prefix-affinity target")
+        self._m_breaker_trips = reg.counter(
+            "router.breaker_trips_total",
+            "circuit breakers tripped open (all replicas)")
+        self._m_probes = reg.counter(
+            "router.probes_total", "health probes sent")
+        self._m_lat = reg.histogram(
+            "router.request_ms",
+            "end-to-end request latency through the router (ms)")
+        for name, client in (dict(replicas or {})).items():
+            self.add_replica(name, client)
+
+    # -- registry ------------------------------------------------------
+    def add_replica(self, name, client):
+        name = str(name)
+        breaker = CircuitBreaker(
+            threshold=self.policy.breaker_threshold,
+            cooldown_s=self.policy.breaker_cooldown_s,
+            on_transition=lambda st, n=name: self._breaker_event(n, st))
+        rep = Replica(name, client, breaker)
+        with self._lock:
+            if name in self._replicas:
+                raise ValueError(f"replica {name!r} already registered")
+            self._replicas[name] = rep
+        self._gauge_health(rep)
+        self._gauge_breaker(name, CLOSED)
+        return rep
+
+    def remove_replica(self, name):
+        with self._lock:
+            rep = self._replicas.pop(str(name), None)
+        return rep
+
+    def replicas(self):
+        """Registry snapshot: list of ``Replica.view()`` rows."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        return [r.view() for r in reps]
+
+    def _reps(self):
+        with self._lock:
+            return list(self._replicas.values())
+
+    def _gauge_health(self, rep):
+        self.registry.gauge(
+            f"router.replica_health.{rep.name}",
+            "replica health (0 dead / 1 draining / 2 degraded / "
+            "3 healthy)").set(HEALTH_CODE[rep.state])
+
+    def _gauge_breaker(self, name, state):
+        self.registry.gauge(
+            f"router.breaker_state.{name}",
+            "circuit breaker (0 closed / 1 half-open / 2 open)"
+        ).set(BREAKER_CODE[state])
+
+    def _breaker_event(self, name, state):
+        self._gauge_breaker(name, state)
+        if state == OPEN:
+            self._m_breaker_trips.inc()
+        self.log.append(("breaker", name, state))
+        self.tracer.instant("router.breaker", cat="router",
+                            replica=name, state=state)
+
+    # -- health probing ------------------------------------------------
+    def classify_probe(self, info):
+        """Map a ``/healthz``-shaped probe body to a health state —
+        the liveness/readiness split made routable: ``draining`` is
+        FINISHING (stop routing, let it land its streams),
+        ``watchdog_fired`` is possibly WEDGED (degrade until a clean
+        probe), anything else answering at all is healthy."""
+        if info.get("draining") or info.get("state") == DRAINING:
+            # InProcessReplica reports a "draining" bool; httpd's
+            # /healthz reports it via "state" — both mean FINISHING
+            return DRAINING
+        if info.get("watchdog_fired") or info.get("state") == \
+                "watchdog_fired" or info.get("ready") is False:
+            return DEGRADED
+        return HEALTHY
+
+    def probe_once(self, now=None):
+        """One sweep: probe every replica, update state + signals +
+        gauges.  Returns {name: state}.  Probes are SENT concurrently
+        — one hung replica must not head-of-line block health
+        detection for the whole fleet — but results are APPLIED
+        serially in registry order, so the state transitions and the
+        routing log stay deterministic given the probe outcomes.
+        Deterministic tests call this directly; production runs it on
+        the jittered prober thread."""
+        reps = self._reps()
+        results = [None] * len(reps)
+
+        def _probe(i, rep):
+            try:
+                results[i] = (True, rep.client.probe())
+            except Exception as e:
+                results[i] = (False, e)
+
+        if len(reps) == 1:
+            _probe(0, reps[0])
+        else:
+            threads = [threading.Thread(target=_probe, args=(i, rep),
+                                        daemon=True)
+                       for i, rep in enumerate(reps)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        out = {}
+        for rep, (answered, info) in zip(reps, results):
+            self._m_probes.inc()
+            with self.tracer.span("probe", cat="router",
+                                  replica=rep.name) as sp:
+                if not answered:
+                    rep.probe_failures += 1
+                    new = (DEAD if rep.probe_failures
+                           >= self.policy.dead_after else DEGRADED)
+                    if sp is not None and hasattr(sp, "args"):
+                        sp.args["error"] = type(info).__name__
+                else:
+                    rep.probe_failures = 0
+                    rep.signals.update(
+                        {k: info.get(k) for k in
+                         ("queue_depth", "slots_free",
+                          "kv_blocks_free", "drain_rate_tps",
+                          "slots_total", "kv_block_size")})
+                    if self._kv_bs is None \
+                            and info.get("kv_block_size"):
+                        self._kv_bs = int(info["kv_block_size"])
+                    new = self.classify_probe(info)
+                    # probe-driven breaker recovery: the replica
+                    # answers again, so an elapsed OPEN breaker may
+                    # move to HALF_OPEN and trial real traffic
+                    if new in (HEALTHY, DEGRADED):
+                        rep.breaker.on_probe_success()
+                if sp is not None and hasattr(sp, "args"):
+                    sp.args["state"] = new
+            rep.last_probe_at = time.monotonic() if now is None else now
+            if new != rep.state:
+                self.log.append(("probe", rep.name, new))
+                self.tracer.instant("router.replica_state",
+                                    cat="router", replica=rep.name,
+                                    state=new, was=rep.state)
+            rep.state = new
+            self._gauge_health(rep)
+            out[rep.name] = new
+        return out
+
+    def mark_dead(self, name):
+        """Operator/test override: declare a replica dead NOW (its
+        queued-but-unstarted requests abandon and fail over on their
+        next poll)."""
+        with self._lock:
+            rep = self._replicas.get(str(name))
+        if rep is None:
+            raise KeyError(f"no replica {name!r}")
+        if rep.state != DEAD:
+            self.log.append(("probe", rep.name, DEAD))
+        rep.state = DEAD
+        rep.probe_failures = max(rep.probe_failures,
+                                 self.policy.dead_after)
+        self._gauge_health(rep)
+
+    def start(self):
+        """Run the prober on a daemon thread (jittered interval)."""
+        if self._probe_thread is not None \
+                and self._probe_thread.is_alive():
+            return self
+        self._stopping = False
+        self._probe_stop = threading.Event()
+        stop = self._probe_stop
+
+        def loop():
+            while not stop.is_set():
+                try:
+                    self.probe_once()
+                except Exception:
+                    pass  # the prober must outlive any one bad client
+                n = next(self._probe_no)
+                j = self.policy.probe_jitter
+                scale = 1.0 + j * (_u01(self.policy.seed, "probe", n)
+                                   - 0.5)
+                stop.wait(self.policy.probe_interval_s * scale)
+
+        self._probe_thread = threading.Thread(
+            target=loop, daemon=True, name="paddle_tpu-router-prober")
+        self._probe_thread.start()
+        return self
+
+    def stop(self):
+        """Stop the prober and abandon in-flight waits (their
+        transports see ``should_abort`` fire)."""
+        self._stopping = True
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5.0)
+            self._probe_thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- routing -------------------------------------------------------
+    def block_size(self):
+        return self._kv_bs or 16
+
+    def _affinity_target(self, key, reps):
+        """Rendezvous (HRW) hash: every replica scores the key, the
+        max wins — adding/removing a replica only remaps the keys that
+        scored it highest, so the fleet's prefix caches stay warm
+        through churn."""
+        best = None
+        for r in reps:
+            h = hashlib.blake2b(key + r.name.encode(),
+                                digest_size=8).digest()
+            score = int.from_bytes(h, "big")
+            if best is None or score > best[0]:
+                best = (score, r)
+        return best[1] if best else None
+
+    def pick(self, prompt, exclude=(), rid=None, attempt=0):
+        """One routing decision: (replica, how) where how is
+        ``affinity`` / ``load`` / ``random`` / ``last_resort``.
+        Raises NoReplicasAvailable when nothing is routable."""
+        key = affinity_key(prompt, self.block_size())
+        exclude = set(exclude)
+        reps = self._reps()
+        healthy = [r for r in reps if r.name not in exclude
+                   and r.state == HEALTHY and r.breaker.peek()]
+        degraded = [r for r in reps if r.name not in exclude
+                    and r.state == DEGRADED and r.breaker.peek()]
+        pool, how = healthy, None
+        if not pool:
+            pool, how = degraded, "last_resort"
+        if not pool:
+            # everything routable was excluded by earlier failed
+            # attempts: retrying a suspect replica beats failing the
+            # request outright
+            pool = [r for r in reps
+                    if r.state in (HEALTHY, DEGRADED)
+                    and r.breaker.peek()]
+            how = "last_resort"
+        if not pool:
+            raise NoReplicasAvailable(
+                f"no routable replica among {len(reps)}: "
+                + ", ".join(f"{r.name}={r.state}/{r.breaker.state}"
+                            for r in reps))
+        if not self.policy.affinity:
+            # seeded random (the bench's baseline arm): deterministic
+            # per (seed, request, attempt)
+            pool = sorted(pool, key=lambda r: r.name)
+            idx = int(_u01(self.policy.seed, "random", rid, attempt)
+                      * len(pool)) % len(pool)
+            return pool[idx], (how or "random")
+        target = self._affinity_target(key, reps)
+        if how is None and target is not None and target in pool:
+            q = target.signals.get("queue_depth")
+            if q is None or q <= self.policy.affinity_queue_threshold:
+                return target, "affinity"
+        chosen = min(pool, key=lambda r: r.load_key())
+        return chosen, (how or "load")
+
+    # -- the request path ----------------------------------------------
+    def _classify(self, exc, idempotent):
+        """(kind, retryable, retry_after, emitted) for a replica-side
+        exception — the retry policy's one decision table."""
+        if isinstance(exc, ReplicaAbandoned):
+            return "abandoned", True, None, None
+        if isinstance(exc, NetDisconnect):
+            return "disconnect", True, None, exc.emitted
+        if isinstance(exc, NetRefused):
+            return "refused", True, None, None
+        if isinstance(exc, NetTimeout):
+            # the request may have EXECUTED (loss on the response
+            # path): only idempotent work is blindly re-sent
+            return "timeout", idempotent, None, None
+        if isinstance(exc, ReplicaUnavailable):
+            return "unavailable", True, exc.retry_after, None
+        if isinstance(exc, ReplicaHTTPError):
+            return (f"http_{exc.status}", exc.status >= 500, None,
+                    None)
+        return type(exc).__name__, False, None, None
+
+    def _backoff(self, rid, n, hint=None):
+        d = min(self.policy.backoff_cap_s,
+                self.policy.backoff_base_s * (2.0 ** n))
+        j = self.policy.backoff_jitter
+        d *= 1.0 + j * (_u01(self.policy.seed, "backoff", rid, n) - 0.5)
+        if hint is not None:
+            d = max(d, float(hint))
+        return d
+
+    def _hedge_delay(self):
+        if self.policy.hedge_after_s is not None:
+            return float(self.policy.hedge_after_s)
+        if self._m_lat.count >= 20:
+            return max(self._m_lat.percentile(99) / 1e3,
+                       self.policy.hedge_floor_s)
+        return self.policy.hedge_floor_s
+
+    def _attempt(self, rep, payload, rid, abort_extra=None):
+        """One dispatch against one replica: inflight accounting,
+        breaker bookkeeping, abandon hook."""
+
+        def should_abort():
+            return (self._stopping or rep.state == DEAD
+                    or (abort_extra is not None and abort_extra()))
+
+        rep.track(+1)
+        try:
+            resp = rep.client.generate(payload,
+                                       should_abort=should_abort)
+        except Exception as e:
+            if self._stopping \
+                    or (abort_extra is not None and abort_extra()):
+                # a CANCELLED attempt (hedge loser, router shutdown)
+                # is the router's own doing — it must not poison the
+                # replica's breaker; just hand back any trial slot so
+                # a HALF_OPEN breaker cannot wedge
+                rep.breaker.release_trial()
+            elif isinstance(e, ReplicaHTTPError) and e.status < 500:
+                # a 4xx is the CALLER's fault and PROVES the replica
+                # is answering: a health signal, not a failure — a
+                # bad client must not blackball a healthy replica
+                rep.breaker.record_success()
+            else:
+                rep.breaker.record_failure()
+            raise
+        else:
+            rep.breaker.record_success()
+            return resp
+        finally:
+            rep.track(-1)
+
+    def _hedged_attempt(self, rep, payload, rid, prompt, exclude):
+        """Primary + optional delayed hedge; first SUCCESS wins and
+        cancels the loser (abort flag -> the transport abandons or
+        disconnects it; orphaned replica work is discarded).  Returns
+        ``(winner, response, hedged)`` — ``hedged`` True when the
+        second dispatch actually fired, so "attempts" can keep
+        counting DISPATCHES — or raises the primary's error (hedge
+        errors never mask a primary success and vice versa)."""
+        delay = self._hedge_delay()
+        results = {}
+        done = threading.Condition()
+        cancel = {"primary": False, "hedge": False}
+
+        def run(slot, r, pl):
+            try:
+                res = self._attempt(r, pl, rid,
+                                    abort_extra=lambda: cancel[slot])
+            except Exception as e:  # delivered as a value
+                res = e
+            with done:
+                results[slot] = (r, res)
+                done.notify_all()
+
+        t1 = threading.Thread(target=run,
+                              args=("primary", rep, payload),
+                              daemon=True)
+        t1.start()
+        with done:
+            done.wait_for(lambda: "primary" in results, timeout=delay)
+        if "primary" not in results:
+            try:
+                hedge_rep, how = self.pick(
+                    prompt, exclude=exclude | {rep.name}, rid=rid,
+                    attempt=-1)
+            except NoReplicasAvailable:
+                hedge_rep = None
+            if hedge_rep is not None \
+                    and not hedge_rep.breaker.acquire():
+                # the hedge must respect the half-open single-trial
+                # invariant like any other dispatch; a hedge is never
+                # worth racing a recovery trial
+                hedge_rep = None
+            if hedge_rep is not None:
+                self._m_hedges.inc()
+                self.log.append(("hedge", rid, hedge_rep.name))
+                with self.tracer.span("route.hedge", cat="router",
+                                      req=rid, primary=rep.name,
+                                      hedge=hedge_rep.name,
+                                      delay_ms=round(delay * 1e3, 3)):
+                    t2 = threading.Thread(
+                        target=run,
+                        args=("hedge", hedge_rep, dict(payload)),
+                        daemon=True)
+                    t2.start()
+                    with done:
+                        done.wait_for(
+                            lambda: self._hedge_settled(results))
+                win_slot = self._hedge_winner(results)
+                lose_slot = ("hedge" if win_slot == "primary"
+                             else "primary")
+                cancel[lose_slot] = True
+                if win_slot == "hedge":
+                    self._m_hedge_wins.inc()
+                    self.log.append(
+                        ("hedge_win", rid, results["hedge"][0].name))
+                r, res = results[win_slot]
+                if isinstance(res, Exception):
+                    raise res
+                return r, res, True
+        with done:
+            done.wait_for(lambda: "primary" in results)
+        r, res = results["primary"]
+        if isinstance(res, Exception):
+            raise res
+        return r, res, False
+
+    @staticmethod
+    def _hedge_settled(results):
+        """Wait is over once anyone SUCCEEDED or everyone failed."""
+        succ = [s for s, (_, res) in results.items()
+                if not isinstance(res, Exception)]
+        return bool(succ) or len(results) == 2
+
+    @staticmethod
+    def _hedge_winner(results):
+        succ = [s for s, (_, res) in results.items()
+                if not isinstance(res, Exception)]
+        if succ:
+            # primary wins ties (it was dispatched first)
+            return "primary" if "primary" in succ else "hedge"
+        return "primary"
+
+    def generate(self, prompt, max_new_tokens=16, eos_token_id=None,
+                 temperature=1.0, top_k=0, top_p=1.0, seed=None,
+                 priority=0, tenant=None, timeout=None):
+        """Route one generation request; blocks until a replica
+        delivers it (HTTP handler threads are the expected callers —
+        the router is I/O-bound, not compute-bound).  Returns a dict:
+        ``ids`` (prompt + generated), ``generated``, ``replica`` (the
+        serving one), ``attempts``, ``req`` (router-side id), plus the
+        replica's reported fields.  Raises RequestFailed /
+        NoReplicasAvailable after classification + retries."""
+        rid = next(self._rids)
+        self._m_reqs.inc()
+        prompt = [int(t) for t in prompt]
+        do_sample = (int(top_k or 0) > 0 or float(temperature) != 1.0
+                     or float(top_p) < 1.0)
+        idempotent = (not do_sample) or seed is not None
+        greedy = not do_sample
+        deadline = (None if timeout is None
+                    else time.monotonic() + float(timeout))
+        self.tracer.instant("route.accepted", cat="router", req=rid,
+                            prompt=len(prompt), max_new=max_new_tokens)
+        t0 = time.monotonic()
+        emitted = []          # tokens salvaged across disconnects
+        exclude = set()       # replicas that failed THIS request
+        attempt = 0
+        last_exc = None
+        while True:
+            if deadline is not None and time.monotonic() > deadline:
+                self._m_failed.inc()
+                raise RequestFailed(
+                    f"request {rid} ran out its {timeout}s budget "
+                    f"after {attempt} attempt(s)", cause=last_exc)
+            remaining = max_new_tokens - len(emitted)
+            if remaining <= 0 or (eos_token_id is not None and emitted
+                                  and emitted[-1] == int(eos_token_id)):
+                # the disconnect arrived AFTER the final token (budget
+                # spent, or the salvaged tail already ends in EOS):
+                # the stream is whole, nothing to re-dispatch — a
+                # resumed attempt would generate PAST the EOS.
+                # ``attempt`` was already bumped past the disconnect,
+                # so hand _serve the index of the LAST dispatch made —
+                # "attempts" must count dispatches, not loop turns
+                return self._serve(rid, prompt, emitted, [], None,
+                                   attempt - 1, t0)
+            attempt_timeout = self.policy.request_timeout_s
+            if deadline is not None:
+                # one slow attempt must not overrun the caller's
+                # budget: the transport deadline shrinks with it
+                attempt_timeout = min(
+                    attempt_timeout,
+                    max(deadline - time.monotonic(), 0.001))
+            payload = {
+                "prompt": prompt + emitted,
+                "max_new_tokens": remaining,
+                "eos_token_id": eos_token_id,
+                "temperature": temperature, "top_k": top_k,
+                "top_p": top_p, "seed": seed, "priority": priority,
+                "tenant": tenant,
+                "timeout_s": attempt_timeout,
+            }
+            try:
+                with self.tracer.span("route.pick", cat="router",
+                                      req=rid, attempt=attempt) as sp:
+                    rep, how = self.pick(prompt, exclude=exclude,
+                                         rid=rid, attempt=attempt)
+                    if not rep.breaker.acquire():
+                        # raced a concurrent half-open trial: treat as
+                        # a retryable miss
+                        raise ReplicaUnavailable(
+                            f"{rep.name} breaker trial already in "
+                            "flight", retry_after=None)
+                    if sp is not None and hasattr(sp, "args"):
+                        sp.args.update(replica=rep.name, how=how)
+                self._m_picks.inc()
+                if how == "affinity":
+                    self._m_affinity.inc()
+                self.log.append(("pick", rid, rep.name, how, attempt))
+                use_hedge = (self.policy.hedge and idempotent
+                             and attempt == 0)
+                hedged = False
+                if use_hedge:
+                    served_by, resp, hedged = self._hedged_attempt(
+                        rep, payload, rid, prompt, exclude)
+                else:
+                    resp = self._attempt(rep, payload, rid)
+                    served_by = rep
+            except NoReplicasAvailable:
+                self._m_failed.inc()
+                raise
+            except Exception as e:
+                last_exc = e
+                kind, retryable, hint, got = self._classify(
+                    e, idempotent)
+                replica_died = kind in ("abandoned", "disconnect",
+                                        "refused", "timeout")
+                if got:
+                    if greedy:
+                        # greedy failover RESUMES: prompt + emitted is
+                        # the next attempt's context, the continuation
+                        # is token-identical to the uninterrupted run
+                        emitted.extend(int(t) for t in got)
+                    # sampled streams restart from scratch instead: a
+                    # seeded re-run from token 0 is identical, while
+                    # resuming mid-stream would shift the device
+                    # sampling counter and fork the stream
+                self.log.append(("retry" if not replica_died
+                                 else "failover", rid, rep.name, kind))
+                self.tracer.instant("route.failover" if replica_died
+                                    else "route.retry", cat="router",
+                                    req=rid, replica=rep.name,
+                                    kind=kind, attempt=attempt)
+                if replica_died:
+                    self._m_failovers.inc()
+                if not retryable or attempt >= self.policy.retry_max:
+                    self._m_failed.inc()
+                    raise RequestFailed(
+                        f"request {rid} failed on {rep.name} after "
+                        f"{attempt + 1} attempt(s): [{kind}] {e}",
+                        cause=e) from e
+                self._m_retries.inc()
+                exclude.add(rep.name)
+                # dead-replica failovers skip the backoff (the work is
+                # fine, the host is not); transient failures back off
+                # exponentially with seeded jitter, honoring a
+                # replica's own Retry-After when it is larger
+                if not replica_died or hint is not None:
+                    wait = self._backoff(rid, attempt, hint)
+                    if deadline is not None:
+                        wait = min(wait,
+                                   max(deadline - time.monotonic(),
+                                       0.0))
+                    with self.tracer.span(
+                            "route.retry", cat="router", req=rid,
+                            attempt=attempt, kind=kind,
+                            backoff_ms=round(wait * 1e3, 3)):
+                        time.sleep(wait)
+                attempt += 1
+                continue
+            # a fired hedge was a real second dispatch: "attempts"
+            # counts dispatches, whichever slot won
+            return self._serve(rid, prompt, emitted,
+                               resp.get("generated", []),
+                               served_by, attempt + (1 if hedged
+                                                     else 0),
+                               t0, resp)
+
+    def _serve(self, rid, prompt, emitted, new_tokens, rep, attempts,
+               t0, resp=None):
+        generated = [int(t) for t in emitted] \
+            + [int(t) for t in new_tokens]
+        ms = (time.monotonic() - t0) * 1e3
+        self._m_lat.observe(ms)
+        self._m_served.inc()
+        name = rep.name if rep is not None else None
+        self.log.append(("serve", rid, name, attempts))
+        self.tracer.instant("route.served", cat="router", req=rid,
+                            replica=name, attempts=attempts,
+                            ms=round(ms, 3))
+        out = {
+            "req": rid, "replica": name, "attempts": attempts + 1,
+            "ids": prompt + generated, "generated": generated,
+        }
+        if resp:
+            for k in ("ttft_ms", "id"):
+                if k in resp:
+                    out[f"replica_{k}" if k == "id" else k] = resp[k]
+        return out
+
+    # -- observability -------------------------------------------------
+    def route_log(self):
+        """Snapshot of the structured routing log (bounded ring)."""
+        return list(self.log)
+
+    def chrome_trace(self):
+        return self.tracer.chrome_trace(process_name="router")
+
+
+class InProcessReplica:
+    """Replica transport wrapping a LOCAL ``Engine`` — the tier-1
+    fake-network layer: tests, benches, the example fleet, and
+    single-host multi-replica serving all use it, and the ``net_*``
+    fault sites of ``serving.faults`` thread through it with a
+    deterministic per-replica OPERATION counter as the schedule tick
+    (op 0, 1, 2, ... in submission order — wall-clock free, so a
+    seeded storm replays exactly).
+
+    Mid-body disconnects are deterministic: when ``net_disconnect`` is
+    scheduled for an op, the engine is allowed to finish the stream
+    and the transport then "delivers" only the first
+    ``disconnect_after`` tokens before raising — exactly what a
+    client sees when the peer dies mid-response, with a schedule-
+    stable emitted count (the orphaned tail is discarded, never
+    double-served).
+
+    ``kill()`` flips a hard-down switch (every op and probe refuses,
+    like a dead process) — the example's replica-kill demo;
+    ``revive()`` brings it back.
+    """
+
+    def __init__(self, name, engine, faults=None,
+                 disconnect_after=2, poll_s=0.002):
+        self.name = str(name)
+        self.engine = engine
+        self.faults = faults
+        self.disconnect_after = int(disconnect_after)
+        self.poll_s = float(poll_s)
+        self.killed = False
+        self._ops = itertools.count()
+        self._probe_ops = itertools.count()
+        self.served = []     # router-delivered op ids (test surface)
+
+    # the router's /replicas view shows where the replica lives
+    address = "in-process"
+
+    def kill(self):
+        self.killed = True
+
+    def revive(self):
+        self.killed = False
+
+    def _maybe(self, site, tick, **kw):
+        if self.faults is not None \
+                and self.faults.scheduled(site, tick):
+            self.faults.fire(site, tick, **kw)
+
+    def probe(self):
+        t = next(self._probe_ops)
+        if self.killed:
+            raise NetRefused(
+                f"replica {self.name} is down (probe {t})")
+        self._maybe("net_refuse", t)
+        self._maybe("net_blackhole", t)
+        self._maybe("net_slow", t)
+        eng = self.engine
+        paged = getattr(eng, "_paged", False)
+        rate = getattr(eng, "drain_rate", lambda: None)()
+        return {
+            "status": "ok",
+            "queue_depth": eng.queue.depth(),
+            "slots_total": eng.num_slots,
+            "slots_free": eng.scheduler.free_count(),
+            "kv_blocks_free": (eng.block_pool.free_count()
+                               if paged else None),
+            "kv_block_size": (eng._bs if paged else None),
+            "drain_rate_tps": rate,
+            "draining": bool(getattr(eng, "_draining", False)),
+            "watchdog_fired": bool(getattr(eng, "_watchdog_fired",
+                                           False)),
+        }
+
+    def generate(self, payload, should_abort=None):
+        t = next(self._ops)
+        if self.killed:
+            raise NetRefused(f"replica {self.name} is down (op {t})")
+        self._maybe("net_refuse", t)
+        self._maybe("net_blackhole", t, abort=should_abort)
+        self._maybe("net_slow", t)
+        disconnect = (self.faults is not None
+                      and self.faults.scheduled("net_disconnect", t))
+        try:
+            req = self.engine.submit(
+                payload["prompt"],
+                max_new_tokens=payload.get("max_new_tokens", 16),
+                eos_token_id=payload.get("eos_token_id"),
+                temperature=payload.get("temperature", 1.0),
+                top_k=payload.get("top_k", 0),
+                top_p=payload.get("top_p", 1.0),
+                seed=payload.get("seed"),
+                priority=payload.get("priority", 0),
+                tenant=payload.get("tenant"))
+        except Rejected as e:
+            raise ReplicaUnavailable(
+                str(e), status=503,
+                retry_after=getattr(e, "retry_after", None),
+                reason=type(e).__name__) from e
+        except (TypeError, ValueError) as e:
+            # the engine REJECTED the arguments — the caller's fault,
+            # exactly what httpd surfaces as a 400: map it the same so
+            # a bad client cannot poison this replica's breaker (the
+            # router treats 4xx as a health signal, not a failure)
+            raise ReplicaHTTPError(
+                f"replica {self.name} rejected the request: {e}",
+                400, reason="bad_request") from e
+        budget = payload.get("timeout_s")
+        deadline = (None if budget is None
+                    else time.monotonic() + float(budget))
+        while not req.done():
+            if should_abort is not None and should_abort():
+                if req.first_token_at is None:
+                    # queued-but-unstarted on a dying replica: clean
+                    # failover, nothing emitted, nothing lost
+                    raise ReplicaAbandoned(
+                        f"replica {self.name} abandoned queued "
+                        f"request (op {t})")
+                raise NetDisconnect(
+                    f"replica {self.name} died mid-stream (op {t})",
+                    emitted=list(req.generated))
+            if deadline is not None and time.monotonic() > deadline:
+                raise NetTimeout(
+                    f"replica {self.name} exceeded the "
+                    f"{budget}s attempt budget (op {t})")
+            req._done.wait(self.poll_s)
+        if req.error is not None:
+            # an engine-side death mid-request IS the failover case:
+            # deliver the salvageable prefix as a disconnect
+            raise NetDisconnect(
+                f"replica {self.name} failed the request: "
+                f"{req.error} (op {t})", emitted=list(req.generated))
+        gen = [int(x) for x in req.generated]
+        if disconnect:
+            k = min(self.disconnect_after, len(gen))
+            self.faults.fire("net_disconnect", t, emitted=gen[:k])
+        self.served.append(t)
+        ttft = None
+        if req.first_token_at is not None:
+            ttft = round((req.first_token_at - req.submitted_at)
+                         * 1e3, 3)
+        return {
+            "id": req.id,
+            "ids": [int(x) for x in payload["prompt"]] + gen,
+            "generated": gen, "ttft_ms": ttft,
+        }
+
+
+class HttpReplicaClient:
+    """Replica transport over HTTP (``serving.httpd`` endpoints):
+    ``probe()`` = GET /healthz, ``generate()`` = POST /generate.
+    Failure mapping mirrors the injected ``net_*`` vocabulary so the
+    router's classifier has ONE decision table: connection refused ->
+    NetRefused, socket timeout -> NetTimeout, truncated body ->
+    NetDisconnect (no emitted context — the whole-completion API
+    cannot say how far it got), 503/429 -> ReplicaUnavailable with
+    the Retry-After header honored, other HTTP errors ->
+    ReplicaHTTPError carrying the machine-readable ``reason`` the
+    error body now always includes.
+
+    ``should_abort`` cannot interrupt a blocking socket read; a dead
+    replica surfaces as NetTimeout after ``timeout_s`` instead (the
+    in-process transport is the one that abandons instantly)."""
+
+    def __init__(self, address, probe_timeout_s=2.0, timeout_s=60.0):
+        self.address = address.rstrip("/")
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.timeout_s = float(timeout_s)
+
+    def _error_body(self, e):
+        try:
+            import json
+            return json.loads(e.read())
+        except Exception:
+            return {}
+
+    @staticmethod
+    def _retry_after_s(ra):
+        """A Retry-After header is delta-seconds OR an HTTP-date (RFC
+        7231 — proxies in front of a replica emit the date form);
+        unparseable values degrade to None, never to a crash in the
+        error handler."""
+        if not ra:
+            return None
+        try:
+            return float(ra)
+        except ValueError:
+            pass
+        try:
+            import datetime
+            from email.utils import parsedate_to_datetime
+            dt = parsedate_to_datetime(ra)
+            now = datetime.datetime.now(dt.tzinfo)
+            return max((dt - now).total_seconds(), 0.0)
+        except Exception:
+            return None
+
+    def probe(self):
+        import json
+        import urllib.error
+        import urllib.request
+        try:
+            with urllib.request.urlopen(
+                    self.address + "/healthz",
+                    timeout=self.probe_timeout_s) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            body = self._error_body(e)
+            raise ReplicaHTTPError(
+                f"probe {self.address}: HTTP {e.code}", e.code,
+                reason=body.get("reason")) from e
+        except Exception as e:
+            raise self._map_net(e, "probe") from e
+
+    def _map_net(self, e, what):
+        import socket
+        import urllib.error
+        if isinstance(e, urllib.error.URLError):
+            reason = getattr(e, "reason", None)
+            if isinstance(reason, ConnectionRefusedError) \
+                    or isinstance(reason, OSError) \
+                    and getattr(reason, "errno", None) in (111, 61):
+                return NetRefused(
+                    f"{what} {self.address}: connection refused")
+            if isinstance(reason, socket.timeout):
+                return NetTimeout(
+                    f"{what} {self.address}: timed out")
+            if isinstance(reason, (ConnectionResetError,
+                                   ConnectionError)) \
+                    or isinstance(reason, OSError) \
+                    and getattr(reason, "errno", None) == 104:
+                # connect-phase reset (replica died mid-handshake):
+                # retryable like any other transport death
+                return NetDisconnect(
+                    f"{what} {self.address}: connection reset")
+        if isinstance(e, socket.timeout) \
+                or isinstance(e, TimeoutError):
+            return NetTimeout(f"{what} {self.address}: timed out")
+        if isinstance(e, (ConnectionResetError, ConnectionError)):
+            return NetDisconnect(
+                f"{what} {self.address}: connection reset")
+        return e
+
+    def generate(self, payload, should_abort=None):
+        import http.client
+        import json
+        import urllib.error
+        import urllib.request
+        body = {k: v for k, v in payload.items() if k != "timeout_s"}
+        timeout = float(payload.get("timeout_s") or self.timeout_s)
+        data = json.dumps(body).encode()
+        req = urllib.request.Request(
+            self.address + "/generate", data=data,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            bodyj = self._error_body(e)
+            ra = e.headers.get("Retry-After")
+            if e.code in (503, 429):
+                raise ReplicaUnavailable(
+                    bodyj.get("error", f"HTTP {e.code}"),
+                    status=e.code,
+                    retry_after=self._retry_after_s(ra),
+                    reason=bodyj.get("reason")) from e
+            raise ReplicaHTTPError(
+                bodyj.get("error", f"HTTP {e.code}"), e.code,
+                reason=bodyj.get("reason")) from e
+        except http.client.IncompleteRead as e:
+            raise NetDisconnect(
+                f"generate {self.address}: response truncated "
+                "mid-body") from e
+        except (json.JSONDecodeError, ValueError) as e:
+            raise NetDisconnect(
+                f"generate {self.address}: unparseable partial "
+                f"response ({e})") from e
+        except Exception as e:
+            raise self._map_net(e, "generate") from e

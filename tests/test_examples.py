@@ -24,6 +24,7 @@ SCRIPTS = [
     "packed_pretraining.py",
     "serving_decode.py",
     "serving_engine.py",
+    "serving_router.py",
     "geo_async_ps.py",
     "onnx_export.py",
 ]

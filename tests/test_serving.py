@@ -1316,6 +1316,77 @@ def test_healthz_always_reports_load_signals(tiny_gpt):
     code, health, _ = _get_probe(paged, "/healthz")
     assert health["kv_blocks_free"] == paged.block_pool.free_count()
     assert health["kv_blocks_free"] > 0
+    # the router's prefix-affinity hash aligns on the block size
+    assert health["kv_block_size"] == 8
+
+
+def test_healthz_liveness_readiness_split(tiny_gpt):
+    """Liveness vs readiness: a DRAINING engine is live but not ready
+    (state "draining" — finishing up, let it land its streams), a
+    WATCHDOG-FIRED one is live but not ready (state "watchdog_fired"
+    — wedged mid-tick, possibly dying).  /livez answers 200 for both
+    (restarting would kill the streams); /readyz answers 503 with a
+    machine-readable reason so a dumb prober can act on the code and
+    a smart one (the router) on the distinction."""
+    eng = _engine(tiny_gpt)
+    code, h, _ = _get_probe(eng, "/healthz")
+    assert code == 200 and h["live"] and h["ready"]
+    assert h["state"] == "ok"
+    code, h, _ = _get_probe(eng, "/livez")
+    assert code == 200 and h["live"]
+    code, h, _ = _get_probe(eng, "/readyz")
+    assert code == 200 and h["ready"]
+    eng._draining = True
+    code, h, _ = _get_probe(eng, "/healthz")
+    assert code == 200 and h["live"] and not h["ready"]
+    assert h["state"] == "draining"
+    code, h, _ = _get_probe(eng, "/readyz")
+    assert code == 503 and not h["ready"]
+    assert h["reason"] == "draining"
+    code, h, _ = _get_probe(eng, "/livez")
+    assert code == 200                    # draining is NOT dying
+    eng._draining = False
+    eng._watchdog_fired = True
+    code, h, _ = _get_probe(eng, "/readyz")
+    assert code == 503 and h["reason"] == "watchdog_fired"
+    code, h, _ = _get_probe(eng, "/healthz")
+    assert h["state"] == "watchdog_fired" and h["watchdog_fired"]
+    # watchdog beats draining: wedged is the scarier verdict
+    eng._draining = True
+    _, h, _ = _get_probe(eng, "/healthz")
+    assert h["state"] == "watchdog_fired"
+
+
+def test_httpd_errors_always_json_with_reason(tiny_gpt):
+    """Every 4xx/5xx leaving httpd is JSON with a machine-readable
+    ``reason`` and an application/json Content-Type — the router's
+    retry classifier keys on ``reason``, never on prose."""
+    from paddle_tpu.serving.httpd import _shed_reason
+    from paddle_tpu.serving.request import (DeadlineShed, QueueFull,
+                                            RateLimited)
+    eng = _engine(tiny_gpt)
+    code, body, ctype = _get_probe(eng, "/no/such/route")
+    assert code == 404 and ctype == "application/json"
+    assert body["reason"] == "not_found"
+    code, body, _ = _post_probe(eng, {"max_new_tokens": 2})
+    assert code == 400 and body["reason"] == "bad_request"
+    full = _engine(tiny_gpt, max_queue=1)
+    full.submit(np.arange(4, dtype=np.int32), max_new_tokens=2)
+    code, body, headers = _post_probe(
+        full, {"prompt": [1, 2, 3], "max_new_tokens": 2})
+    assert code == 503 and body["reason"] == "queue_full"
+    # the classifier's one decision table for shed-load causes —
+    # "draining" comes from the engine's actual flag, never prose
+    assert _shed_reason(RateLimited("slow down")) == "rate_limited"
+    assert _shed_reason(DeadlineShed("too late")) == "deadline_shed"
+    assert _shed_reason(QueueFull("rejected"), draining=True) == \
+        "draining"
+    assert _shed_reason(QueueFull("queue is full")) == "queue_full"
+    # over the wire: a draining engine's shed carries the reason
+    full._draining = True
+    code, body, _ = _post_probe(
+        full, {"prompt": [1, 2, 3], "max_new_tokens": 2})
+    assert code == 503 and body["reason"] == "draining"
 
 
 def test_compile_events_counter_and_trace():

@@ -12,6 +12,14 @@ up side by side in chrome://tracing / Perfetto.
 Usage:
     python tools/timeline.py trace1.json http://host:port/debug/trace \
         [--out timeline.json]
+    python tools/timeline.py --router http://routerhost:port \
+        [--out timeline.json]
+
+``--router`` expands a routerd base URL into the router's own
+``/debug/trace`` PLUS every replica's, by asking its ``/replicas``
+registry — the whole fleet lands in one timeline, the router's
+route.pick/route.retry/route.hedge/probe spans on pid 0 and each
+replica's ticks on its own pid, labeled ``replica:<name>``.
 
 With no ``--out`` the merged trace goes to stdout.
 """
@@ -41,6 +49,30 @@ def load_trace(source, timeout=10.0):
         raise ValueError(
             f"{source}: not a chrome trace (no traceEvents array)")
     return data
+
+
+def router_sources(base_url, timeout=10.0):
+    """Expand a routerd base URL into (label, trace_source) pairs:
+    the router's own /debug/trace first, then one per replica from
+    its /replicas registry (replicas whose address the router cannot
+    name — e.g. in-process test replicas — are skipped with a note
+    on stderr; there is no URL to fetch)."""
+    base = base_url.rstrip("/")
+    with urllib.request.urlopen(base + "/replicas",
+                                timeout=timeout) as resp:
+        table = json.loads(resp.read())
+    out = [("router", base + "/debug/trace")]
+    for row in table.get("replicas", []):
+        addr = row.get("address")
+        name = row.get("name", "?")
+        if not addr or not str(addr).startswith(("http://",
+                                                 "https://")):
+            print(f"replica {name}: no fetchable address "
+                  f"({addr!r}) — skipped", file=sys.stderr)
+            continue
+        out.append((f"replica:{name}",
+                    str(addr).rstrip("/") + "/debug/trace"))
+    return out
 
 
 def merge_traces(traces, labels=None):
@@ -97,8 +129,12 @@ def main(argv=None):
         description="merge serving traces / flight-recorder dumps / "
                     "live /debug/trace endpoints into one "
                     "chrome://tracing timeline")
-    p.add_argument("sources", nargs="+",
+    p.add_argument("sources", nargs="*",
                    help="trace file paths and/or /debug/trace URLs")
+    p.add_argument("--router", default=None, metavar="URL",
+                   help="routerd base URL: merge the router's trace "
+                        "with every replica's /debug/trace (from its "
+                        "/replicas registry), one pid per replica")
     p.add_argument("--out", default=None,
                    help="output path (default: stdout)")
     p.add_argument("--lifecycle", action="store_true",
@@ -106,15 +142,45 @@ def main(argv=None):
                         "counts (incl. req.preempted/resumed/shed) "
                         "to stderr alongside the merge")
     args = p.parse_args(argv)
-    traces = [load_trace(s) for s in args.sources]
+    pairs = [(str(s), s) for s in args.sources]
+    n_positional = len(pairs)
+    if args.router:
+        pairs += router_sources(args.router)
+    if not pairs:
+        p.error("no sources: give trace files/URLs and/or --router")
+    labels, traces = [], []
+    for i, (lbl, src) in enumerate(pairs):
+        try:
+            tr = load_trace(src)
+        except Exception as e:
+            if i < n_positional:
+                raise         # an explicit source must exist
+            # a fleet source can be a replica that just DIED — the
+            # exact scenario the router demos; merge the survivors
+            # and note the corpse instead of producing nothing
+            print(f"{lbl}: unreachable ({e}) — skipped",
+                  file=sys.stderr)
+            continue
+        if i >= n_positional:
+            # fleet sources are named by the router's registry rows
+            # ("router" / "replica:<name>"): a source's self-reported
+            # process_name carries a host pid, which is ambiguous
+            # when replicas share a host — drop it so the registry
+            # label wins
+            tr["traceEvents"] = [
+                e for e in tr["traceEvents"]
+                if not (e.get("ph") == "M"
+                        and e.get("name") == "process_name")]
+        labels.append(lbl)
+        traces.append(tr)
     if args.lifecycle:
-        for src, trace in zip(args.sources, traces):
+        for src, trace in zip(labels, traces):
             counts = lifecycle_counts(trace)
             body = ("  ".join(f"{k}={v}" for k, v in
                               sorted(counts.items()))
                     or "(no instant events)")
             print(f"{src}: {body}", file=sys.stderr)
-    merged = merge_traces(traces, labels=[str(s) for s in args.sources])
+    merged = merge_traces(traces, labels=labels)
     text = json.dumps(merged)
     if args.out:
         with open(args.out, "w") as f:
