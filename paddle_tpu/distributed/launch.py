@@ -60,6 +60,178 @@ def _free_port():
     return port
 
 
+def _worker_env(base=None, platform=None, device_count=None):
+    """Per-worker env contract for spawned processes: propagate the
+    parent's JAX platform selection explicitly (children of a CPU-mesh
+    simulation must not auto-pick a TPU the parent deliberately
+    avoided) and force a virtual host-device pool when the worker
+    needs an N-device mesh on CPU.  ``device_count`` APPENDS the
+    ``--xla_force_host_platform_device_count`` flag unless the flags
+    already carry one — an explicit operator setting wins."""
+    env = dict(base if base is not None else os.environ)
+    plat = platform or env.get("JAX_PLATFORMS") \
+        or env.get("PADDLE_TPU_PLATFORM")
+    if plat:
+        env["JAX_PLATFORMS"] = plat
+    if device_count:
+        flags = env.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{int(device_count)}").strip()
+    return env
+
+
+class ServingFleet:
+    """Handle over a spawned N-process serving fleet (one
+    ``serving.httpd`` replica per process, each replica itself
+    mesh-sharded when ``mp > 1``).  ``urls`` index-aligns with
+    ``procs``; ``stop()`` terminates everything (idempotent)."""
+
+    def __init__(self, procs, urls, logs):
+        self.procs = procs
+        self.urls = urls
+        self._logs = logs
+
+    def kill(self, i, sig=signal.SIGKILL):
+        """Hard-kill replica ``i`` (failover tests / chaos): the
+        router sees a refused socket, not a graceful drain."""
+        p = self.procs[i]
+        if p.poll() is None:
+            p.send_signal(sig)
+            p.wait()
+
+    def stop(self, grace=5.0):
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except ProcessLookupError:
+                    pass
+        deadline = time.monotonic() + grace
+        for p in self.procs:
+            while p.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for f in self._logs:
+            f.close()
+        self._logs = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def spawn_serving_fleet(n, config="tiny", mp=1, platform="cpu",
+                        seed=0, num_slots=4, max_seq_len=64,
+                        kv_block_size=None, spec_k=None,
+                        prefill_chunk=None, log_dir=None,
+                        ready_timeout_s=120.0, extra_args=()):
+    """Spawn an N-process serving replica fleet and wait until every
+    replica answers ``/healthz`` — the real-process twin of the
+    in-process router tests.  Each worker is
+    ``python -m paddle_tpu.serving.httpd`` with:
+
+    * a port reserved HERE via ``_reserve_port`` and held until the
+      moment of spawn (the training launcher's hold-until-spawn
+      pattern, reused) — so the returned URLs are race-free against
+      concurrent launches, modulo the unavoidable close-to-child-bind
+      window the training path documents;
+    * the per-worker env contract from ``_worker_env``: the JAX
+      platform propagated explicitly and, for ``mp > 1`` on CPU, a
+      forced virtual device pool sized to the replica's mesh — a
+      worker must never silently serve a 1-device mesh because the
+      parent's XLA_FLAGS did not reach it;
+    * the SAME ``--seed``, so greedy failover across replicas is
+      token-identical.
+
+    Returns a ``ServingFleet``; raises RuntimeError (after killing
+    the partial fleet) if any replica fails to become ready."""
+    import urllib.request
+
+    procs, urls, logs = [], [], []
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    env = _worker_env(platform=platform,
+                      device_count=mp if int(mp) > 1 else None)
+    reserved = [_reserve_port() for _ in range(int(n))]
+    try:
+        for i, sock in enumerate(reserved):
+            port = sock.getsockname()[1]
+            cmd = [sys.executable, "-m", "paddle_tpu.serving.httpd",
+                   "--config", str(config), "--mp", str(int(mp)),
+                   "--port", str(port), "--seed", str(int(seed)),
+                   "--num-slots", str(int(num_slots)),
+                   "--max-seq-len", str(int(max_seq_len))]
+            if kv_block_size is not None:
+                cmd += ["--kv-block-size", str(int(kv_block_size))]
+            if spec_k is not None:
+                cmd += ["--spec-k", str(int(spec_k))]
+            if prefill_chunk is not None:
+                cmd += ["--prefill-chunk", str(int(prefill_chunk))]
+            cmd += list(extra_args)
+            # release the reservation at the last moment (httpd's
+            # HTTPServer binds with SO_REUSEADDR, so the just-closed
+            # probe never blocks the child's bind)
+            sock.close()
+            if log_dir:
+                f = open(os.path.join(log_dir, f"replica.{i}.log"),
+                         "w")
+                logs.append(f)
+                procs.append(subprocess.Popen(
+                    cmd, env=env, stdout=f,
+                    stderr=subprocess.STDOUT))
+            else:
+                procs.append(subprocess.Popen(
+                    cmd, env=env, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL))
+            urls.append(f"http://127.0.0.1:{port}")
+    except BaseException:
+        for s in reserved:
+            try:
+                s.close()
+            except OSError:
+                pass
+        for p in procs:
+            p.kill()
+            p.wait()  # reap now; the parent may be long-lived
+        for f in logs:
+            f.close()
+        raise
+    fleet = ServingFleet(procs, urls, logs)
+    deadline = time.monotonic() + float(ready_timeout_s)
+    pending = dict(enumerate(urls))
+    while pending:
+        for i, url in list(pending.items()):
+            if procs[i].poll() is not None:
+                fleet.stop()
+                raise RuntimeError(
+                    f"replica {i} ({url}) exited rc="
+                    f"{procs[i].returncode} before becoming ready"
+                    + (f"; see {log_dir}/replica.{i}.log"
+                       if log_dir else ""))
+            try:
+                with urllib.request.urlopen(url + "/healthz",
+                                            timeout=1.0):
+                    pending.pop(i)
+            except Exception:
+                pass
+        if pending:
+            if time.monotonic() > deadline:
+                fleet.stop()
+                raise RuntimeError(
+                    f"fleet not ready after {ready_timeout_s}s: "
+                    f"replicas {sorted(pending)} never answered "
+                    "/healthz")
+            time.sleep(0.2)
+    return fleet
+
+
 def _spawn_and_watch(args):
     """Spawn ``nproc_per_node`` local workers and watch them
     (reference launch_utils.py:526 ``watch_local_trainers``): any child
@@ -89,7 +261,13 @@ def _spawn_and_watch(args):
         reserved.close()
     for local in range(args.nproc_per_node):
         rank = args.node_rank * args.nproc_per_node + local
-        env = dict(os.environ)
+        # per-worker env contract: propagate the platform choice
+        # explicitly and force the virtual device pool when the
+        # worker runs an N-device CPU mesh — a child that silently
+        # booted 1 CPU device used to fail mesh construction with an
+        # unhelpful "requires N devices, have 1"
+        env = _worker_env(
+            device_count=getattr(args, "devices_per_proc", None))
         env["PADDLE_TRAINERS_NUM"] = str(world)
         env["PADDLE_TRAINER_ID"] = str(rank)
         env["PADDLE_TRAINER_ENDPOINTS"] = master
@@ -169,6 +347,12 @@ def launch_main(argv=None):
     parser.add_argument("--log_dir", default=None,
                         help="per-rank workerlog.N files (reference "
                              "launch_utils.py log naming)")
+    parser.add_argument("--devices_per_proc", type=int, default=None,
+                        help="force each worker's virtual host-device"
+                             " pool to this size (CPU mesh "
+                             "simulation: appends --xla_force_host_"
+                             "platform_device_count per worker unless"
+                             " XLA_FLAGS already carries one)")
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)

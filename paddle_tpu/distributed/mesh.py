@@ -50,7 +50,28 @@ def build_mesh(dp=1, sharding=1, pp=1, mp=1, sp=1, ep=1,
     return Mesh(arr, AXES)
 
 
-def set_mesh(mesh: Mesh):
+def serving_mesh(mp=1, devices=None) -> Mesh:
+    """Tensor-parallel mesh for the SERVING engine: the first ``mp``
+    devices on the canonical hybrid axes with only 'mp' > 1 — so the
+    TP layers' ``PartitionSpec(..., "mp", ...)`` weights shard and
+    everything else replicates.  Unlike ``build_mesh`` this never
+    swallows the whole device pool: a serving replica shards over
+    exactly the chips it was given and leaves the rest to sibling
+    replicas (the launcher spawns one process per replica, each with
+    its own mesh)."""
+    mp = int(mp)
+    if mp < 1:
+        raise ValueError(f"mp must be >= 1, got {mp}")
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < mp:
+        raise ValueError(
+            f"serving_mesh(mp={mp}) needs {mp} devices, have "
+            f"{len(devices)} — on CPU force a virtual pool with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={mp}")
+    return build_mesh(mp=mp, devices=devices[:mp])
+
+
+def set_mesh(mesh: Mesh | None):
     global _global_mesh
     _global_mesh = mesh
     return mesh

@@ -2609,6 +2609,77 @@ class GPTModel(nn.Layer):
                 f"{sorted(leftover)[:5]}")
         return twin
 
+    def to_tensor_parallel(self):
+        """Build the TENSOR-PARALLEL twin of a dense model with the
+        SAME weights: einsum-form attention projections carrying the
+        head axis explicitly ([E,3,H,hd] / [H,hd,E] with 'mp'
+        PartitionSpecs — see GPTAttention use_mp), Column/RowParallel
+        MLP, VocabParallelEmbedding, and the column-parallel LM head
+        (distributed/sharding.py).  The mapping is a pure relayout —
+        ``qkv_proj.weight [E, 3E]`` reshapes to ``[E, 3, H, hd]``
+        exactly as the dense forward's ``[b,s,3E] -> [b,s,3,H,hd]``
+        reshape reads it, and ``out_proj.weight [H*hd, E]`` to
+        ``[H, hd, E]`` — so the twin computes the same math
+        modulo float summation order (XLA blocks the contractions
+        differently), and greedy decode is token-identical in
+        practice (asserted in tests/test_sharded_serving.py).  This
+        is how ``Engine(mesh=...)`` gets a shardable serving model
+        out of a dense checkpoint: pjit/GSPMD consumes the twin's
+        PartitionSpecs and splits heads / FFN / vocab over the 'mp'
+        mesh axis."""
+        if getattr(self, "scan_layers", False):
+            return self._sync_decode_twin().to_tensor_parallel()
+        attn0 = self.blocks[0].attn
+        if attn0.use_mp:
+            return self  # already tensor-parallel
+        for blk in self.blocks:
+            # reject non-dense variants UP FRONT (the copy loop below
+            # assumes plain GPTMLP/GPTAttention blocks; _init_config
+            # deliberately drops moe/sp, so a silent conversion would
+            # build a twin missing those layers)
+            if not hasattr(blk.mlp, "fc1"):
+                raise ValueError(
+                    "to_tensor_parallel supports the dense GPT "
+                    "variant only — MoE blocks already carry their "
+                    "expert-parallel sharding")
+            if blk.attn.use_sp:
+                raise ValueError(
+                    "to_tensor_parallel supports the dense GPT "
+                    "variant only — sequence-parallel attention "
+                    "shards the sequence axis, not heads")
+        cfg = dict(self._init_config)
+        tp = GPTModel(use_mp=True, **cfg)
+        H, hd = attn0.num_heads, attn0.head_dim
+        E = attn0.hidden_size
+        emb_s, emb_t = self.embeddings, tp.embeddings
+        emb_t.word_embeddings.weight._data = \
+            emb_s.word_embeddings.weight._data
+        emb_t.position_embeddings.weight._data = \
+            emb_s.position_embeddings.weight._data
+        for sb, tb in zip(self.blocks, tp.blocks):
+            for ln in ("ln1", "ln2"):
+                getattr(tb, ln).weight._data = \
+                    getattr(sb, ln).weight._data
+                getattr(tb, ln).bias._data = getattr(sb, ln).bias._data
+            sa, ta = sb.attn, tb.attn
+            ta.qkv_weight._data = sa.qkv_proj.weight._data.reshape(
+                E, 3, H, hd)
+            ta.qkv_bias._data = sa.qkv_proj.bias._data.reshape(
+                3, H, hd)[:, None]
+            ta.out_weight._data = sa.out_proj.weight._data.reshape(
+                H, hd, E)
+            ta.out_bias._data = sa.out_proj.bias._data
+            for fc in ("fc1", "fc2"):
+                getattr(tb.mlp, fc).weight._data = \
+                    getattr(sb.mlp, fc).weight._data
+                getattr(tb.mlp, fc).bias._data = \
+                    getattr(sb.mlp, fc).bias._data
+        tp.head.ln_f.weight._data = self.head.ln_f.weight._data
+        tp.head.ln_f.bias._data = self.head.ln_f.bias._data
+        tp.head.lm_head.weight._data = self.head.lm_head.weight._data
+        tp.eval()
+        return tp
+
     @classmethod
     def from_config(cls, name, **overrides):
         cfg = dict(GPT_CONFIGS[name])

@@ -41,7 +41,7 @@ from contextlib import nullcontext
 import numpy as np
 
 from .. import monitor
-from .kvcache import BlockPool, PrefixCache
+from .kvcache import BlockPool, PrefixCache, per_shard_block_bytes
 from .request import (MAX_SEED, DeadlineShed, QueueFull, RateLimited,
                       Request, RequestQueue, TenantPolicy, TokenBucket)
 from .scheduler import Scheduler
@@ -257,6 +257,39 @@ class Engine:
         ``serving.compiles_total`` and the ``decode.ragged`` trace
         span.  Greedy AND seeded outputs are token-identical to the
         XLA path (asserted in tests/test_ragged_attn.py).
+    mesh : TENSOR-PARALLEL SERVING over a device mesh.  ``None``
+        (default) serves on one device.  An int / 1-tuple ``mp``
+        degree (resolved over the first mp devices via
+        ``distributed.mesh.serving_mesh``) or a prebuilt
+        ``jax.sharding.Mesh`` shards the model's attention heads,
+        FFN, and vocab over the mesh's 'mp' axis — the model must be
+        the einsum-form tensor-parallel variant
+        (``GPTModel(use_mp=True)`` or a dense checkpoint's
+        ``to_tensor_parallel()`` twin), whose parameters carry the
+        'mp' PartitionSpecs from distributed/sharding.py.  The
+        per-layer KV pools shard over the SAME mesh on the head axis
+        (each shard holds its heads' K/V of every block), block
+        tables and step cursors replicate, and the fused sampling
+        epilogue stays device-side on the all-gathered logits — so
+        all four hot dispatch paths compile once per config with the
+        sharding baked in, and the steady-state d2h contract ([B]
+        ids + done bits) is unchanged.  Greedy AND seeded outputs
+        are token-identical to the unsharded engine (same math
+        modulo float summation order; asserted in
+        tests/test_sharded_serving.py on a forced multi-device CPU
+        mesh).  One sharded engine per process owns the global mesh
+        (the TP activation constraints read it); unsharded sibling
+        engines are unaffected.  Watch ``serving.mesh_devices`` and
+        the ``shard.sync`` / ``decode.allgather`` spans.
+    kv_budget_mb : size the paged pool from a PER-SHARD HBM budget
+        instead of a block count: ``kv_blocks = budget //
+        per_shard_block_bytes`` where one logical block costs
+        ``n_layers * 2 * block_size * (H/mp) * hd * dtype`` bytes
+        per shard — so the same per-chip budget holds mp x the
+        blocks on a sharded engine (KV capacity scales with the
+        mesh; ``serving.kv_blocks_total`` reflects the aggregate
+        logical pool).  Mutually exclusive with ``kv_blocks``;
+        requires the paged layout.
     async_depth : ASYNC ENGINE LOOP pipeline depth.  ``None`` (the
         default) resolves to 2 in device sample mode and 1 in host
         mode.  At depth 2 a tick DISPATCHES tick N+1's fused decode
@@ -363,7 +396,8 @@ class Engine:
                  kv_block_size=None, kv_blocks=None, prefix_cache=True,
                  prefill_chunk=None, tick_token_budget=None,
                  spec_k=None, proposer=None, sample_mode="device",
-                 attn_impl=None, async_depth=None, tracing=True,
+                 attn_impl=None, mesh=None, kv_budget_mb=None,
+                 async_depth=None, tracing=True,
                  trace_capacity=16384, trace_annotations=False,
                  flight_dir=None, tenants=None, preemption=True,
                  shed_deadlines=True, faults=None, watchdog_s=None):
@@ -439,6 +473,96 @@ class Engine:
             kv_dtype = getattr(attn0.qkv_proj, "compute_dtype", None) \
                 or attn0.qkv_proj.weight._data.dtype
         self._kv_dtype = kv_dtype
+        # -- tensor-parallel serving mesh (mesh=...) -------------------
+        # ``mesh`` accepts an int / 1-tuple mp degree (resolved via
+        # distributed.mesh.serving_mesh over the first mp devices) or a
+        # prebuilt jax Mesh.  With mp > 1 the model must be the
+        # einsum-form tensor-parallel variant (GPTModel(use_mp=True),
+        # or a dense checkpoint's ``to_tensor_parallel()`` twin): its
+        # parameters carry 'mp' PartitionSpecs, and placing params +
+        # KV pools sharded makes every existing jitted dispatch
+        # compile ONCE PER CONFIG with the sharding baked into the
+        # program — GSPMD splits attention heads / FFN / vocab and
+        # inserts the psum/all-gather collectives; the host-side tick
+        # protocol (replicated cursors, [B]-id downloads, the 17 B
+        # steady-state d2h) is unchanged.
+        self.mesh = None
+        self.mp = 1
+        self.mesh_axes = None
+        self._repl_sharding = None
+        self._kv_sharding = None
+        self._kv_block_bytes_per_shard = None
+        if mesh is not None:
+            import jax
+            from jax.sharding import (Mesh, NamedSharding,
+                                      PartitionSpec)
+            from ..distributed import mesh as mesh_mod
+            if isinstance(mesh, (int, np.integer)):
+                mesh = mesh_mod.serving_mesh(int(mesh))
+            elif isinstance(mesh, (tuple, list)):
+                if len(mesh) != 1:
+                    raise ValueError(
+                        f"mesh shape must be (mp,), got {tuple(mesh)}"
+                        " — the serving engine shards over one "
+                        "tensor-parallel axis")
+                mesh = mesh_mod.serving_mesh(int(mesh[0]))
+            elif not isinstance(mesh, Mesh):
+                raise ValueError(
+                    f"mesh must be an int mp degree, an (mp,) tuple, "
+                    f"or a jax Mesh, got {type(mesh).__name__}")
+            self.mesh = mesh
+            self.mp = int(mesh.shape.get("mp", 1))
+            extra = {k: int(v) for k, v in mesh.shape.items()
+                     if k != "mp" and int(v) > 1}
+            if extra:
+                # a dp/pp/... axis would silently REPLICATE params and
+                # KV pools across it (the serving specs only name
+                # 'mp') — mp x dp serving is future work, not a
+                # silent 2x HBM tax
+                raise ValueError(
+                    f"serving mesh must shard only the 'mp' axis; got"
+                    f" extra axes {extra} — build one with "
+                    "distributed.mesh.serving_mesh(mp)")
+            self.mesh_axes = ({k: int(v) for k, v in mesh.shape.items()
+                               if int(v) > 1} or {"mp": 1})
+            if self.mp > 1:
+                if not attn0.use_mp:
+                    raise ValueError(
+                        "mesh with mp > 1 requires the tensor-parallel"
+                        " model form: build with GPTModel(use_mp=True)"
+                        " or convert a dense checkpoint with "
+                        "model.to_tensor_parallel() — the dense fused "
+                        "qkv layout cannot shard its head axis (see "
+                        "distributed/sharding.py)")
+                if self._nh % self.mp:
+                    raise ValueError(
+                        f"num_heads ({self._nh}) must divide by the "
+                        f"mesh's mp degree ({self.mp}) — attention "
+                        "shards whole heads")
+                # the TP layers' activation sharding constraints
+                # (distributed/sharding.py _constraint) read the
+                # process-global mesh; one sharded engine per process
+                # owns it (sibling UNSHARDED engines are unaffected —
+                # dense models carry no constraints)
+                mesh_mod.set_mesh(mesh)
+            self._repl_sharding = NamedSharding(mesh, PartitionSpec())
+            # the head axis is index 2 in BOTH KV layouts
+            # ([B, L, H, hd] contiguous, [NB, bs, H, hd] paged), so
+            # one spec shards each device's pool slice to its heads
+            self._kv_sharding = NamedSharding(
+                mesh, PartitionSpec(None, None, "mp", None))
+            # place params per their TP PartitionSpecs (replicated
+            # when none): every compiled dispatch then sees sharded
+            # weight inputs and GSPMD partitions the program
+            for _, p in model.named_parameters():
+                spec = getattr(p, "partition_spec", None)
+                sh = (NamedSharding(mesh, spec) if spec is not None
+                      else self._repl_sharding)
+                p._data = jax.device_put(p._data, sh)
+            for _, b in model.named_buffers():
+                b._data = jax.device_put(b._data, self._repl_sharding)
+        self._kv_budget_mb = (None if kv_budget_mb is None
+                              else float(kv_budget_mb))
         if prefill_buckets == "pow2":
             bs, b = [], 8
             while b < self.max_seq_len:
@@ -546,14 +670,41 @@ class Engine:
                     "length instead of per bucket")
             self._bs = bsz
             self._bps = self.max_seq_len // bsz  # blocks per full slot
-            managed = (self.num_slots * self._bps if kv_blocks is None
-                       else int(kv_blocks))
+            # per-shard footprint of ONE logical block: each mesh
+            # shard stores only its H/mp heads' K/V rows, so a fixed
+            # per-chip HBM budget (kv_budget_mb) buys mp x the blocks
+            # — sharding the model scales KV capacity, not just
+            # weights (kvcache.per_shard_block_bytes)
+            self._kv_block_bytes_per_shard = per_shard_block_bytes(
+                bsz, self._nh, self._hd, self._kv_dtype,
+                len(model.blocks), self.mp)
+            if kv_budget_mb is not None:
+                if kv_blocks is not None:
+                    raise ValueError(
+                        "kv_budget_mb and kv_blocks are two answers to"
+                        " one question (pool size) — pass one")
+                managed = int(self._kv_budget_mb * 2 ** 20
+                              // self._kv_block_bytes_per_shard)
+            else:
+                managed = (self.num_slots * self._bps
+                           if kv_blocks is None else int(kv_blocks))
             if managed < self._bps:
+                # blame the knob the caller actually turned
+                src = (f"kv_budget_mb={self._kv_budget_mb:g} "
+                       f"(-> {managed} blocks at "
+                       f"{self._kv_block_bytes_per_shard} B/block/"
+                       "shard)" if kv_budget_mb is not None
+                       else f"kv_blocks={managed}")
                 raise ValueError(
-                    f"kv_blocks={managed} cannot hold even one "
-                    f"max-length request ({self._bps} blocks)")
+                    f"{src} cannot hold even one max-length request "
+                    f"({self._bps} blocks)")
             self._kv_managed = managed
             self._prefix_enabled = bool(prefix_cache)
+        elif kv_budget_mb is not None:
+            raise ValueError(
+                "kv_budget_mb requires the paged KV layout "
+                "(kv_block_size=...): the contiguous pools are sized "
+                "by num_slots * max_seq_len, not by a block budget")
         # -- ragged paged attention (attn_impl="ragged") ----------------
         if attn_impl is None:
             attn_impl = getattr(model, "attn_impl", "xla")
@@ -609,6 +760,11 @@ class Engine:
         self._m_slots = reg.gauge(
             "serving.slot_total", "configured slot pool size")
         self._m_slots.set(self.num_slots)
+        self._m_mesh = reg.gauge(
+            "serving.mesh_devices", "devices in this engine's serving "
+            "mesh (tensor-parallel shards; 1 = unsharded single "
+            "device)")
+        self._m_mesh.set(self.mesh.size if self.mesh is not None else 1)
         self._m_tokens = reg.counter(
             "serving.tokens_total", "generated tokens")
         self._m_reqs = reg.counter(
@@ -795,6 +951,37 @@ class Engine:
         self._drain_on_exit = None  # set to a loop's stop event when
         #                             that loop must drain on exit
 
+    def _alloc_pool(self, shape):
+        """One per-layer K/V pool, mesh-sharded on the head axis when
+        the engine serves tensor-parallel: each shard materializes
+        only its H/mp heads' slice (axis 2 in both layouts), so pool
+        HBM per chip shrinks by mp — the headroom kv_budget_mb turns
+        into extra logical blocks.  Sharded pools are allocated by a
+        COMPILED zeros program with the sharding as its output spec,
+        so each device materializes only its own shard — a whole-pool
+        array staged through one device would defeat the very
+        capacity scaling, since an aggregate pool sized for the mesh
+        need not fit any single chip.  (Not
+        make_array_from_callback: its per-shard host callback
+        segfaults intermittently under this jax version.)"""
+        import jax.numpy as jnp
+        if self._kv_sharding is None:
+            return jnp.zeros(shape, self._kv_dtype)
+        import jax
+        fn = getattr(self, "_pool_zeros_fn", None)
+        if fn is None:
+            shape = tuple(shape)
+            dtype = self._kv_dtype
+
+            def zeros():
+                return jnp.zeros(shape, dtype)
+
+            # cached: the pool shape is fixed per engine, and the
+            # step-failure recovery path re-allocates repeatedly
+            fn = self._pool_zeros_fn = jax.jit(
+                zeros, out_shardings=self._kv_sharding)
+        return fn()
+
     def _reset_pools(self):
         """(Re)allocate the per-layer K/V pools and per-slot step
         state.  Also the failure-recovery path: a decode dispatch that
@@ -823,9 +1010,9 @@ class Engine:
         else:
             shape = (self.num_slots, self.max_seq_len, self._nh,
                      self._hd)
-        self.k_pools = [jnp.zeros(shape, self._kv_dtype)
+        self.k_pools = [self._alloc_pool(shape)
                         for _ in self.model.blocks]
-        self.v_pools = [jnp.zeros(shape, self._kv_dtype)
+        self.v_pools = [self._alloc_pool(shape)
                         for _ in self.model.blocks]
         # host-side per-slot step state: in host sample_mode these ship
         # to device every tick; in device mode they are MIRRORS of the
@@ -1361,6 +1548,10 @@ class Engine:
                 "spec_k": self._spec_k,
                 "sample_mode": self.sample_mode,
                 "attn_impl": self.attn_impl,
+                "mesh_shape": self.mesh_axes,
+                "mp": self.mp,
+                "kv_block_bytes_per_shard":
+                    self._kv_block_bytes_per_shard,
                 "async_depth": self.async_depth,
                 "tracing": bool(self.tracer.enabled),
                 "preemption": self._preemption,
@@ -1579,20 +1770,31 @@ class Engine:
         # advance self._pos right after dispatch, and the in-flight
         # transfer would intermittently capture the POST-chunk cursor
         # as the pre-state (observed as nondeterministic corruption)
-        self._dev_state = dict(
-            tok=jnp.asarray(self._cur_tok.copy()),
-            pos=jnp.asarray(self._pos.copy()),
-            ctr=jnp.asarray(self._sctr.copy()),
-            temp=jnp.asarray(self._temp.copy()),
-            topk=jnp.asarray(self._topk.copy()),
-            topp=jnp.asarray(self._topp.copy()),
-            slo=jnp.asarray(self._seed_lo.copy()),
-            shi=jnp.asarray(self._seed_hi.copy()),
-            eos=jnp.asarray(self._eos.copy()),
-            rem=jnp.asarray(self._rem.copy()))
-        if self._paged:
-            self._dev_state["tables"] = \
-                jnp.asarray(self._block_tables.copy())
+        if self._repl_sharding is not None:
+            # mesh-sharded engine: cursors and block tables replicate
+            # to EVERY shard explicitly (an uncommitted single-device
+            # upload would make the first dispatch re-replicate them);
+            # the replication is a cross-shard barrier, traced as
+            # shard.sync so its cost is visible in trace_view --wall
+            import jax
+
+            def put(a):
+                return jax.device_put(a.copy(), self._repl_sharding)
+            sync = (self.tracer.span("shard.sync", shards=self.mp)
+                    if self.mp > 1 else nullcontext())
+        else:
+            def put(a):
+                return jnp.asarray(a.copy())
+            sync = nullcontext()
+        with sync:
+            self._dev_state = dict(
+                tok=put(self._cur_tok), pos=put(self._pos),
+                ctr=put(self._sctr), temp=put(self._temp),
+                topk=put(self._topk), topp=put(self._topp),
+                slo=put(self._seed_lo), shi=put(self._seed_hi),
+                eos=put(self._eos), rem=put(self._rem))
+            if self._paged:
+                self._dev_state["tables"] = put(self._block_tables)
         self._state_dirty = False
 
     def _prefill_paged(self, slot):
@@ -2493,6 +2695,18 @@ class Engine:
         # engine's real sync point) until the watchdog converts it
         # into a WatchdogTimeout raise -> step-failure recovery
         self._fault("d2h_hang")
+        if self.mp > 1:
+            # sharded tick: the [B] ids / picks are replicated OUTPUTS
+            # of a vocab-parallel head — the device finishes the psum
+            # + all-gather collectives before the handles are ready.
+            # Block on compute completion FIRST under its own span so
+            # cross-shard collective time is attributed to
+            # decode.allgather, and the d2h span below measures the
+            # (tiny, unchanged-contract) host copy alone.
+            with tr.span("decode.allgather", tick=inf.tick,
+                         shards=self.mp):
+                for v in inf.arrays.values():
+                    v.block_until_ready()
         t0 = time.monotonic()
         with tr.span(wait_name, tick=inf.tick) as d2h_sp:
             mats = {k: np.asarray(v) for k, v in inf.arrays.items()}

@@ -223,6 +223,20 @@ class _Handler(JsonHandler):
                 # chunk windows), "xla" = the per-shape gather/
                 # scatter programs (the CPU parity oracle)
                 "attn_impl": getattr(eng, "attn_impl", "xla"),
+                # tensor-parallel mesh surface: the router registry
+                # carries these so a fleet view (and timeline.py
+                # --router) can label sharded replicas; kv blocks are
+                # head-sliced UNIFORMLY across shards, so the
+                # per-shard free list is the logical free count on
+                # every shard
+                "mesh_shape": getattr(eng, "mesh_axes", None),
+                "mp": getattr(eng, "mp", 1),
+                "kv_blocks_free_per_shard": (
+                    [eng.block_pool.free_count()]
+                    * getattr(eng, "mp", 1)
+                    if getattr(eng, "_paged", False) else None),
+                "kv_block_bytes_per_shard": getattr(
+                    eng, "_kv_block_bytes_per_shard", None),
                 # async-loop signals, next to the router-tier load
                 # signals: pipeline depth plus the mean overlapped
                 # host time and mean blocking d2h wait per tick —
@@ -420,3 +434,77 @@ def serve(engine, host="127.0.0.1", port=8000, result_timeout=120.0):
         pass
     finally:
         srv.close()
+
+
+def main(argv=None):
+    """Standalone replica process: build a GPT config, optionally
+    shard it over an mp-degree mesh, and serve — what
+    ``distributed.launch.spawn_serving_fleet`` spawns N of (one
+    process per replica, each replica itself mesh-sharded over its
+    own device pool).
+
+        python -m paddle_tpu.serving.httpd --config tiny --mp 2 \\
+            --port 8000 --kv-block-size 8
+
+    ``--seed`` makes every replica of a fleet initialize IDENTICAL
+    weights, so greedy failover across replicas is token-identical
+    (the fleet tests and bench assert it).  ``--mp > 1`` needs that
+    many devices — on CPU the launcher forces a virtual pool via
+    XLA_FLAGS (per-worker env propagation is its job)."""
+    import argparse
+
+    p = argparse.ArgumentParser("paddle_tpu.serving.httpd")
+    p.add_argument("--config", default="tiny",
+                   help="GPT_CONFIGS name (models/gpt.py)")
+    p.add_argument("--mp", type=int, default=1,
+                   help="tensor-parallel degree: shard the model + KV"
+                        " pools over a mesh of this many devices")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--seed", type=int, default=0,
+                   help="weight-init seed (same seed across a fleet "
+                        "= token-identical replicas)")
+    p.add_argument("--num-slots", type=int, default=4)
+    p.add_argument("--max-seq-len", type=int, default=64)
+    p.add_argument("--kv-block-size", type=int, default=None)
+    p.add_argument("--kv-blocks", type=int, default=None)
+    p.add_argument("--kv-budget-mb", type=float, default=None)
+    p.add_argument("--prefill-chunk", type=int, default=None)
+    p.add_argument("--spec-k", type=int, default=None)
+    p.add_argument("--result-timeout", type=float, default=120.0)
+    args = p.parse_args(argv)
+
+    import paddle_tpu as paddle
+    from ..models.gpt import GPTModel
+    from .engine import Engine
+
+    paddle.seed(args.seed)
+    model = GPTModel.from_config(args.config, dropout=0.0)
+    model.eval()
+    mesh = None
+    if args.mp > 1:
+        model = model.to_tensor_parallel()
+        mesh = args.mp
+    engine = Engine(model, num_slots=args.num_slots,
+                    max_seq_len=args.max_seq_len,
+                    kv_block_size=args.kv_block_size,
+                    kv_blocks=args.kv_blocks,
+                    kv_budget_mb=args.kv_budget_mb,
+                    prefill_chunk=args.prefill_chunk,
+                    spec_k=args.spec_k, mesh=mesh)
+    # the port line is the launcher's readiness handshake: printed
+    # AFTER the socket is bound, flushed so a pipe reader sees it
+    srv = EngineServer(engine, host=args.host, port=args.port,
+                       result_timeout=args.result_timeout).start()
+    print(f"serving {args.config} mp={args.mp} on {srv.address}",
+          flush=True)
+    try:
+        srv._http_thread.join()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -65,6 +65,26 @@ tests/test_ragged_attn.py (kernel-side masking).
 from __future__ import annotations
 
 
+def per_shard_block_bytes(block_size, num_heads, head_dim, dtype,
+                          n_layers, mp=1):
+    """PER-SHARD HBM cost of ONE logical KV block across every layer:
+    ``n_layers * 2 (K and V) * block_size * (num_heads/mp) * head_dim
+    * itemsize``.  Under a tensor-parallel mesh (Engine(mesh=...))
+    the pools shard on the head axis, so each device stores only its
+    ``num_heads/mp`` heads' slice of every block — which is why a
+    fixed per-chip budget (``Engine(kv_budget_mb=...)``) buys ``mp``x
+    the logical blocks: KV capacity, the HBM ceiling on concurrent
+    slots, scales with the mesh.  ``num_heads`` must divide by ``mp``
+    (attention shards whole heads)."""
+    import numpy as np
+    mp = int(mp)
+    if mp < 1 or num_heads % mp:
+        raise ValueError(
+            f"num_heads ({num_heads}) must divide by mp ({mp})")
+    return (int(n_layers) * 2 * int(block_size) * (num_heads // mp)
+            * int(head_dim) * np.dtype(dtype).itemsize)
+
+
 class NoFreeBlocks(RuntimeError):
     """The pool cannot satisfy an allocation (even after eviction)."""
 

@@ -561,7 +561,12 @@ class Router:
                         {k: info.get(k) for k in
                          ("queue_depth", "slots_free",
                           "kv_blocks_free", "drain_rate_tps",
-                          "slots_total", "kv_block_size")})
+                          "slots_total", "kv_block_size",
+                          # tensor-parallel replicas advertise their
+                          # mesh: the /replicas registry rows (and
+                          # timeline.py --router) label sharded
+                          # replicas without a second probe protocol
+                          "mesh_shape", "mp")})
                     if self._kv_bs is None \
                             and info.get("kv_block_size"):
                         self._kv_bs = int(info["kv_block_size"])
@@ -1109,6 +1114,8 @@ class InProcessReplica:
             "kv_blocks_free": (eng.block_pool.free_count()
                                if paged else None),
             "kv_block_size": (eng._bs if paged else None),
+            "mesh_shape": getattr(eng, "mesh_axes", None),
+            "mp": getattr(eng, "mp", 1),
             "drain_rate_tps": rate,
             "draining": bool(getattr(eng, "_draining", False)),
             "watchdog_fired": bool(getattr(eng, "_watchdog_fired",

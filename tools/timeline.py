@@ -65,12 +65,19 @@ def router_sources(base_url, timeout=10.0):
     for row in table.get("replicas", []):
         addr = row.get("address")
         name = row.get("name", "?")
+        # mesh-sharded replicas get labeled with their tensor-parallel
+        # degree (the registry carries the probed mesh signals) — a
+        # fleet timeline distinguishes a 4-chip replica's lane from a
+        # single-chip one's at a glance
+        mp = (row.get("signals") or {}).get("mp")
+        label = (f"replica:{name} mp={int(mp)}"
+                 if mp and int(mp) > 1 else f"replica:{name}")
         if not addr or not str(addr).startswith(("http://",
                                                  "https://")):
             print(f"replica {name}: no fetchable address "
                   f"({addr!r}) — skipped", file=sys.stderr)
             continue
-        out.append((f"replica:{name}",
+        out.append((label,
                     str(addr).rstrip("/") + "/debug/trace"))
     return out
 

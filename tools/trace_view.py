@@ -81,7 +81,8 @@ def wall_summary(events):
     loop's own attribution spans.  phase/wall > 1 means concurrency
     (work hidden behind device compute), not an accounting bug."""
     wall = phase = overlap = d2h_wait = ragged = 0.0
-    n_ticks = n_ragged = 0
+    allgather = shard_sync = 0.0
+    n_ticks = n_ragged = n_allgather = 0
     for ev in events:
         if ev.get("ph") != "X":
             continue
@@ -103,6 +104,16 @@ def wall_summary(events):
                 # per-shape XLA programs (decode.dispatch) served it
                 ragged += dur
                 n_ragged += 1
+            elif name == "decode.allgather":
+                # mesh-sharded engines (Engine(mesh=...)): waiting on
+                # the cross-shard psum/all-gather collectives before
+                # the tiny replicated d2h — THE tensor-parallel tax,
+                # visible per trace instead of smeared into d2h_wait
+                allgather += dur
+                n_allgather += 1
+            elif name == "shard.sync":
+                # replicating dirtied cursors/tables to every shard
+                shard_sync += dur
     return {
         "ticks": n_ticks, "wall_ms": wall, "phase_ms": phase,
         "per_tick_wall_ms": wall / n_ticks if n_ticks else float("nan"),
@@ -110,6 +121,8 @@ def wall_summary(events):
                               else float("nan")),
         "overlap_ms": overlap, "d2h_wait_ms": d2h_wait,
         "ragged_ms": ragged, "ragged_dispatches": n_ragged,
+        "allgather_ms": allgather, "allgather_waits": n_allgather,
+        "shard_sync_ms": shard_sync,
     }
 
 
@@ -127,6 +140,12 @@ def format_wall(w):
             f"decode.ragged {w['ragged_ms']:.3f} ms over "
             f"{w['ragged_dispatches']} Pallas ragged-kernel "
             "dispatches (attn_impl='ragged')")
+    if w.get("allgather_waits") or w.get("shard_sync_ms"):
+        lines.append(
+            f"decode.allgather {w['allgather_ms']:.3f} ms over "
+            f"{w['allgather_waits']} sharded ticks   shard.sync "
+            f"{w['shard_sync_ms']:.3f} ms (mesh-sharded engine: "
+            "cross-shard collective wait + cursor replication)")
     lines += [
         "(phases exceeding wall = spans ran concurrently — e.g. the "
         "async engine loop's",
