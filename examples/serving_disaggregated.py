@@ -1,0 +1,189 @@
+"""Disaggregated serving & KV block migration: move a LIVE stream.
+
+``serving_router.py`` survives replica death by re-dispatching; this
+demo moves the actual KV state.  ``Engine.migrate_out`` freezes a
+decoding stream, gathers its full KV blocks into a portable payload,
+and ``migrate_in`` adopts them on a peer — the stream resumes
+TOKEN-IDENTICALLY, never recomputing the prefix, never emitting a
+token twice.  Three production shapes ride on that one primitive:
+
+1. disaggregated prefill/decode — replicas carry roles; the router
+   prefills on the ``prefill`` replica, migrates the warm blocks, and
+   decodes on the ``decode`` replica (token-identical to one mixed
+   replica, and the compute-heavy prefill never competes with latency-
+   sensitive decode ticks);
+2. preempt-and-migrate — ``Router.rebalance`` kicks a live stream off
+   an overloaded replica mid-decode; the blocked caller never notices
+   (exactly-once, same tokens, different replica);
+3. cross-replica prefix warming — an affinity MISS pulls the shared
+   prefix's blocks from the peer's trie instead of recomputing them.
+
+The migration legs are first-class spans — ``migrate.export`` (source
+gather) / ``migrate.wire`` (payload transit) / ``migrate.import``
+(destination adopt) — broken out at the end exactly the way
+``tools/trace_view.py --wall`` renders them.
+
+Run: python examples/serving_disaggregated.py
+"""
+import os
+import sys
+import threading
+import time
+
+# allow running as `python examples/<script>.py` from a repo checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.models import GPTModel
+from paddle_tpu.serving import (Engine, InProcessReplica, Router,
+                                RouterPolicy)
+
+
+def _load_trace_view():
+    """tools/ is scripts, not a package — load trace_view by path."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "trace_view.py")
+    spec = importlib.util.spec_from_file_location("trace_view", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def mk_engine(model):
+    return Engine(model, num_slots=2, max_seq_len=64, kv_block_size=8,
+                  prefill_chunk=8, registry=monitor.StatRegistry())
+
+
+def mk_router(model, roles, **pol):
+    engines = {}
+    for name, role in roles.items():
+        engines[name] = mk_engine(model)
+        engines[name].start()
+    reps = {n: InProcessReplica(n, engines[n], role=roles[n])
+            for n in engines}
+    reg = monitor.StatRegistry()
+    router = Router(reps, policy=RouterPolicy(
+        seed=0, retry_max=3, backoff_base_s=0.005, **pol),
+        kv_block_size=8, registry=reg)
+    router.probe_once()
+    return router, engines
+
+
+def main():
+    paddle.seed(0)
+    model = GPTModel.from_config("tiny", dropout=0.0)
+    model.eval()
+    vocab = int(model.embeddings.word_embeddings.weight.shape[0])
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, vocab, (20,)).tolist()
+    n_new = 12
+
+    # the unmigrated oracle: ONE mixed engine serving the whole stream
+    oracle = mk_engine(model)
+    ro = oracle.submit(prompt, max_new_tokens=n_new)
+    oracle.run_until_idle()
+    ref = list(ro.generated)
+
+    # -- 1. disaggregated prefill/decode -------------------------------
+    print("1. disaggregated prefill/decode "
+          "(roles: pre=prefill, dec=decode):")
+    router, engines = mk_router(model, {"pre": "prefill",
+                                        "dec": "decode"},
+                                disaggregate=True)
+    try:
+        out = router.generate(list(prompt), max_new_tokens=n_new)
+    finally:
+        for e in engines.values():
+            e.stop()
+    assert out["generated"] == ref, "disaggregation must be invisible"
+    mig = [ev for ev in router.route_log() if ev[0] == "migrate"][-1]
+    print(f"   prefilled on 'pre', migrated {mig[4]} KV block(s), "
+          f"decoded on '{out['replica']}' — token-identical to the "
+          f"single mixed engine")
+    print(f"   router.migrations_total = "
+          f"{int(router.registry.get('router.migrations_total').value)}")
+    dec_trace = engines["dec"].chrome_trace()
+
+    # -- 2. preempt-and-migrate (operator rebalance) --------------------
+    print("\n2. preempt-and-migrate — rebalance a LIVE stream:")
+    router, engines = mk_router(model, {"alpha": "mixed",
+                                        "beta": "mixed"})
+    res = {}
+    th = threading.Thread(target=lambda: res.update(
+        out=router.generate(list(prompt), max_new_tokens=44)))
+    th.start()
+    try:
+        src = None
+        deadline = time.time() + 20
+        while time.time() < deadline and src is None:
+            for name, e in engines.items():
+                if any(s.request is not None
+                       and len(s.request.generated) >= 2
+                       for s in e.scheduler.busy_slots()):
+                    src = name
+                    break
+            time.sleep(0.002)
+        assert src is not None
+        verdict = router.rebalance(src, min_tokens=2)
+        th.join(timeout=30)
+    finally:
+        for e in engines.values():
+            e.stop()
+    out = res["out"]
+    moved = [ev for ev in router.route_log() if ev[0] == "migrate"][-1]
+    assert out["replica"] != src and not verdict["completed"]
+    print(f"   stream started on '{src}', rebalanced with "
+          f"{moved[4]} block(s) to '{out['replica']}' mid-decode")
+    print(f"   the blocked caller got all {len(out['generated'])} "
+          f"tokens exactly once — never saw the move")
+
+    # -- 3. cross-replica prefix warming --------------------------------
+    print("\n3. prefix warming on an affinity miss:")
+    router, engines = mk_router(model, {"alpha": "mixed",
+                                        "beta": "mixed"},
+                                prefix_warm=True)
+    try:
+        out1 = router.generate(list(prompt), max_new_tokens=4)
+        target = out1["replica"]
+        other = next(n for n in engines if n != target)
+        # genuinely overload the affinity target (a long stream eats
+        # a slot), refresh the probe, and declare its queue over
+        # threshold: the pick falls back to least-loaded — the OTHER
+        # replica — and the warm path kicks in
+        bg = engines[target].submit(
+            rng.randint(0, vocab, (8,)).tolist(), max_new_tokens=40)
+        router.probe_once()
+        router.policy.affinity_queue_threshold = -1
+        out2 = router.generate(list(prompt), max_new_tokens=4)
+        bg.result(timeout=30)
+    finally:
+        for e in engines.values():
+            e.stop()
+    warm = [ev for ev in router.route_log() if ev[0] == "warm"][-1]
+    assert out2["replica"] == other
+    assert out2["generated"] == out1["generated"]
+    print(f"   affinity target '{target}' was overloaded; '{other}' "
+          f"adopted {warm[4]} warm block(s) from its trie before "
+          f"admission — prefix_hit_tokens="
+          f"{int(engines[other].registry.get('serving.prefix_hit_tokens').value)}")
+
+    # -- the migration legs, as trace_view --wall shows them ------------
+    tv = _load_trace_view()
+    w = tv.wall_summary(dec_trace["traceEvents"]
+                        if isinstance(dec_trace, dict) else dec_trace)
+    print("\nmigration legs in the decode replica's trace "
+          "(tools/trace_view.py --wall):")
+    print(f"   migrate.wire   {w['migrate_wire_ms']:.3f} ms  "
+          f"(payload decode in transit)")
+    print(f"   migrate.import {w['migrate_import_ms']:.3f} ms  "
+          f"(block adopt into pool+trie)")
+    print("\nevery stream delivered exactly once; every migration "
+          "observable.")
+
+
+if __name__ == "__main__":
+    main()

@@ -91,15 +91,24 @@ class ServingFleet:
     def __init__(self, procs, urls, logs):
         self.procs = procs
         self.urls = urls
-        self._logs = logs
+        # index-aligned with procs when per-replica logs exist (None
+        # entries once a kill() released them); empty otherwise
+        self._logs = list(logs)
 
     def kill(self, i, sig=signal.SIGKILL):
         """Hard-kill replica ``i`` (failover tests / chaos): the
-        router sees a refused socket, not a graceful drain."""
+        router sees a refused socket, not a graceful drain.  The
+        child is REAPED here (waited on) and its log handle closed
+        immediately — a chaos storm that kills half the fleet must
+        not accumulate zombies or leaked file descriptors while the
+        surviving replicas keep serving."""
         p = self.procs[i]
         if p.poll() is None:
             p.send_signal(sig)
-            p.wait()
+        p.wait()
+        if i < len(self._logs) and self._logs[i] is not None:
+            self._logs[i].close()
+            self._logs[i] = None
 
     def stop(self, grace=5.0):
         for p in self.procs:
@@ -114,9 +123,10 @@ class ServingFleet:
                 time.sleep(0.05)
             if p.poll() is None:
                 p.kill()
-                p.wait()
+            p.wait()   # reap even the already-dead (killed) children
         for f in self._logs:
-            f.close()
+            if f is not None:
+                f.close()
         self._logs = []
 
     def __enter__(self):
@@ -130,7 +140,7 @@ class ServingFleet:
 def spawn_serving_fleet(n, config="tiny", mp=1, platform="cpu",
                         seed=0, num_slots=4, max_seq_len=64,
                         kv_block_size=None, spec_k=None,
-                        prefill_chunk=None, log_dir=None,
+                        prefill_chunk=None, roles=None, log_dir=None,
                         ready_timeout_s=120.0, extra_args=()):
     """Spawn an N-process serving replica fleet and wait until every
     replica answers ``/healthz`` — the real-process twin of the
@@ -150,10 +160,19 @@ def spawn_serving_fleet(n, config="tiny", mp=1, platform="cpu",
     * the SAME ``--seed``, so greedy failover across replicas is
       token-identical.
 
+    ``roles`` optionally assigns each replica a serving role — an
+    index-aligned list of ``mixed`` / ``prefill`` / ``decode`` passed
+    through as ``--role`` (the disaggregated fleet shape; the router
+    reads it back from each replica's ``/healthz``).
+
     Returns a ``ServingFleet``; raises RuntimeError (after killing
     the partial fleet) if any replica fails to become ready."""
     import urllib.request
 
+    if roles is not None and len(roles) != int(n):
+        raise ValueError(
+            f"roles must have one entry per replica: got "
+            f"{len(roles)} for n={n}")
     procs, urls, logs = [], [], []
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
@@ -174,6 +193,8 @@ def spawn_serving_fleet(n, config="tiny", mp=1, platform="cpu",
                 cmd += ["--spec-k", str(int(spec_k))]
             if prefill_chunk is not None:
                 cmd += ["--prefill-chunk", str(int(prefill_chunk))]
+            if roles is not None:
+                cmd += ["--role", str(roles[i])]
             cmd += list(extra_args)
             # release the reservation at the last moment (httpd's
             # HTTPServer binds with SO_REUSEADDR, so the just-closed

@@ -41,10 +41,67 @@ from contextlib import nullcontext
 import numpy as np
 
 from .. import monitor
-from .kvcache import BlockPool, PrefixCache, per_shard_block_bytes
+from .kvcache import (BlockPool, PrefixCache, export_blocks,
+                      import_blocks, per_shard_block_bytes)
 from .request import (MAX_SEED, DeadlineShed, QueueFull, RateLimited,
                       Request, RequestQueue, TenantPolicy, TokenBucket)
 from .scheduler import Scheduler
+
+
+class Migrated(RuntimeError):
+    """The request was handed off to another replica mid-stream (KV
+    block migration) — the terminal verdict its waiter receives on the
+    SOURCE engine.  ``emitted`` is the token prefix generated here
+    before the handoff (never lost: a holder that cannot complete the
+    migration can always fail over with prompt + emitted as context).
+    ``payload`` is the full migration payload when ``migrate_out(...,
+    deliver="error")`` routed it through the waiter (the waiter owns
+    the import), else None (some other holder owns the payload and
+    this waiter may only salvage ``emitted``)."""
+
+    def __init__(self, msg, payload=None, emitted=None):
+        super().__init__(msg)
+        self.payload = payload
+        self.emitted = list(emitted or [])
+
+
+class _MigrateDemand:
+    """One cross-thread migration order (export / import / prefix
+    warm), registered by any thread and SERVICED BY THE ENGINE THREAD
+    at a tick boundary — the same single-writer discipline as every
+    other pool/slot mutation, so migration never races a dispatch."""
+
+    __slots__ = ("kind", "args", "done", "result", "error",
+                 "registered_at", "waiting")
+
+    def __init__(self, kind, **args):
+        self.kind = kind      # "out" | "in" | "prefix_out" | "prefix_in"
+        self.args = args
+        self.done = threading.Event()
+        self.result = None
+        self.error = None
+        self.registered_at = time.monotonic()
+        self.waiting = False  # an "out" whose target is not yet
+        #   exportable: retried every tick, but must not keep an
+        #   idle engine's loop spinning (the submit that makes it
+        #   actionable wakes the loop anyway)
+
+    def complete(self, result):
+        self.result = result
+        self.done.set()
+
+    def fail(self, error):
+        self.error = error
+        self.done.set()
+
+    def wait(self, timeout=None):
+        if not self.done.wait(timeout):
+            raise TimeoutError(
+                f"migration {self.kind} demand: no verdict after "
+                f"{timeout}s (engine not stepping?)")
+        if self.error is not None:
+            raise self.error
+        return self.result
 
 
 def _softmax_np(x):
@@ -914,6 +971,11 @@ class Engine:
             "serving.proposer_failures", "proposer calls that raised "
             "— degraded to an empty draft window (verify emits the "
             "bonus token) instead of failing the tick")
+        self._m_kv_migrated = reg.counter(
+            "serving.kv_blocks_migrated", "paged KV blocks exported "
+            "toward another replica (stream migration + prefix "
+            "warming; counted on the EXPORT side only, so a shared "
+            "registry never double-counts a transfer)")
         # weakref'd listener: a collected engine returns False from the
         # callback and the model drops it — engines must not leak into
         # the model's listener list across their lifetimes
@@ -947,6 +1009,12 @@ class Engine:
         self._wake = threading.Event()  # event-driven loop wake:
         #   submit() sets it, so an idle engine blocks instead of
         #   polling and admission latency stops paying poll jitter
+        self._mig_lock = threading.Lock()
+        self._migrate_demands = []  # _MigrateDemand orders, registered
+        #   by any thread (migrate_out / migrate_in / export_prefix /
+        #   import_prefix) and serviced by the engine thread at the
+        #   next tick boundary
+        self._migration_log = deque(maxlen=64)  # {"tick","dir",...}
         self._overlap_acc = 0.0  # per-tick overlapped-host-work clock
         self._drain_on_exit = None  # set to a loop's stop event when
         #                             that loop must drain on exit
@@ -1449,6 +1517,529 @@ class Engine:
             timed_out += t2
         return admitted, timed_out, emitted
 
+    # -- KV block migration --------------------------------------------
+    # A migration is a block-table rewrite plus a bytes transfer: the
+    # source gathers its slot's FULL blocks device->host
+    # (kvcache.export_blocks), tears the slot down exactly like a
+    # preemption (prefix insert, release, park) but finishes the
+    # request with ``Migrated`` instead of requeueing it, and the
+    # resume snapshot (prompt, emitted tokens, sampling params, the
+    # EFFECTIVE seed, host-rng state) rides alongside the bytes.  The
+    # destination scatters the blocks into its own pool
+    # (kvcache.import_blocks), registers them under its prefix trie,
+    # and queues an equivalent Request — whose normal admission
+    # prefix-matches the adopted blocks and binds the sample state at
+    # fold-counter len(generated), i.e. the stream resumes through the
+    # SAME proven preemption-resume path, token-identically.
+    #
+    # All four public entry points (migrate_out / migrate_in /
+    # export_prefix / import_prefix) are thread-safe: they register a
+    # _MigrateDemand and the ENGINE THREAD services it at the next
+    # tick boundary (``_service_migrations``), after draining any
+    # in-flight async ring — pool and slot state stay single-writer.
+    # Fault sites: ``migrate_export`` declines an export with the
+    # stream untouched, ``migrate_import`` rolls the destination's
+    # fresh allocation back to refcount 0 (it adopts nothing), and
+    # ``migrate_wire`` is thrown by transports between the two.
+
+    def _register_demand(self, demand):
+        with self._mig_lock:
+            self._migrate_demands.append(demand)
+        self._wake.set()
+        return demand
+
+    def _migrate_pending(self):
+        with self._mig_lock:
+            return len(self._migrate_demands)
+
+    def _migrate_actionable(self):
+        """True when a registered demand can make progress on the next
+        tick — the idle loop's wake condition.  A waiting export (no
+        eligible victim yet) is excluded: whatever makes it actionable
+        (a submit, an import) wakes the loop itself."""
+        with self._mig_lock:
+            return any(not d.waiting for d in self._migrate_demands)
+
+    def _migration_history(self):
+        """Locked snapshot of the migration ring (handler threads read
+        it for ``/debug/requests`` while the engine thread appends)."""
+        with self._mig_lock:
+            return list(self._migration_log)
+
+    def _await_demand(self, d, wait, timeout):
+        if not wait:
+            return d
+        try:
+            return d.wait(timeout)
+        except TimeoutError:
+            # withdraw the order if the engine has not yet picked it
+            # up; if servicing already started the verdict lands in
+            # the demand unobserved — an exported stream's payload
+            # still reaches its waiter via Migrated.emitted salvage
+            with self._mig_lock:
+                if d in self._migrate_demands:
+                    self._migrate_demands.remove(d)
+            raise
+
+    def migrate_out(self, request_id=None, min_tokens=1,
+                    deliver="return", wait=True, timeout=30.0):
+        """Export a LIVE decoding stream off this engine.  With
+        ``request_id=None`` the engine picks a victim (lowest
+        priority, most work remaining); otherwise the named request is
+        exported once it is decoding with ``min_tokens`` emitted.  The
+        stream's waiter unblocks with ``Migrated`` (its ``emitted``
+        always carries the tokens generated here).  ``deliver``:
+
+        - ``"return"`` — the migration payload is this call's return
+          value (``{"payload": ..., "generated": [...], "completed":
+          False}``); the waiter's Migrated carries payload=None.  The
+          HTTP export handler path.
+        - ``"error"`` — the payload rides INSIDE the waiter's Migrated
+          exception and this call returns payload=None; whoever holds
+          the stream (the router's generate loop) owns the import.
+          Exactly-once by construction: there is a single payload
+          holder either way.
+
+        A request that finishes before the export lands returns
+        ``{"completed": True, "generated": [...], "payload": None}``.
+        A scheduled ``migrate_export`` fault raises here and leaves
+        the stream running untouched."""
+        if deliver not in ("return", "error"):
+            raise ValueError(f"deliver must be 'return' or 'error', "
+                             f"got {deliver!r}")
+        d = self._register_demand(_MigrateDemand(
+            "out", request_id=request_id, min_tokens=int(min_tokens),
+            deliver=deliver))
+        return self._await_demand(d, wait, timeout)
+
+    def migrate_in(self, payload, wait=True, timeout=30.0):
+        """Adopt a migrated stream: scatter its KV blocks into this
+        engine's pool + prefix trie (all-or-nothing) and queue an
+        equivalent Request that resumes the stream token-identically.
+        Accepts either a live payload (``kv["data"]`` an ndarray) or
+        the JSON wire form (``kv["data_b64"]`` — decoded here, under
+        the ``migrate.wire`` span, so the byte-level transfer cost is
+        attributable in traces).  Returns ``{"request": Request,
+        "blocks": n}`` — the caller streams ``request.result()`` like
+        any submit.  Raises ValueError (geometry mismatch / malformed
+        payload), QueueFull (draining or full queue), or an injected
+        ``migrate_import`` fault; in every failure the destination
+        owns nothing."""
+        kv = payload.get("kv") if isinstance(payload, dict) else None
+        with self.tracer.span(
+                "migrate.wire", cat="serving",
+                blocks=int(kv.get("n_blocks") or 0) if kv else 0):
+            if not isinstance(payload, dict) \
+                    or not isinstance(payload.get("request"), dict):
+                raise ValueError(
+                    "migration payload must carry a 'request' dict "
+                    "(see Engine.migrate_out)")
+            if kv is not None and "data_b64" in kv:
+                from .kvcache import payload_from_json
+                payload = payload_from_json(payload)
+                kv = payload.get("kv")
+            if not payload["request"].get("prompt"):
+                raise ValueError(
+                    "migration payload request has no prompt")
+            if kv is not None and kv.get("n_blocks") \
+                    and kv.get("data") is None:
+                raise ValueError(
+                    "migration payload kv names n_blocks but carries "
+                    "no data")
+        d = self._register_demand(_MigrateDemand("in", payload=payload))
+        return self._await_demand(d, wait, timeout)
+
+    def export_prefix(self, tokens, wait=True, timeout=30.0):
+        """Cross-replica prefix warming, export side: gather the
+        longest cached prefix of ``tokens`` from this engine's trie.
+        Returns a migration payload with ``request=None`` and a
+        ``prefix`` token list (import with ``import_prefix``), or None
+        when nothing is cached (or the engine is contiguous/has no
+        trie)."""
+        d = self._register_demand(_MigrateDemand(
+            "prefix_out", tokens=[int(t) for t in tokens]))
+        return self._await_demand(d, wait, timeout)
+
+    def import_prefix(self, payload, wait=True, timeout=30.0):
+        """Cross-replica prefix warming, import side: adopt a peer
+        trie's exported blocks into this engine's prefix cache, so the
+        next admission of a prompt sharing that prefix skips its
+        prefill.  Returns ``{"blocks": n, "tokens": n*block_size}``
+        (zeros when the payload is empty or this engine cannot hold
+        it).  Accepts live or JSON wire form, like ``migrate_in``."""
+        if payload is None:
+            return {"blocks": 0, "tokens": 0}
+        kv = payload.get("kv") if isinstance(payload, dict) else None
+        with self.tracer.span(
+                "migrate.wire", cat="serving",
+                blocks=int(kv.get("n_blocks") or 0) if kv else 0):
+            if kv is not None and "data_b64" in kv:
+                from .kvcache import payload_from_json
+                payload = payload_from_json(payload)
+        d = self._register_demand(_MigrateDemand(
+            "prefix_in", payload=payload))
+        return self._await_demand(d, wait, timeout)
+
+    def _service_migrations(self, tr):
+        """Engine-thread service point, called at the top of both tick
+        paths: pop the registered demands, act on each (an "out" whose
+        target is not yet exportable waits for a later tick), and
+        never let a per-demand failure — injected or organic — escape
+        into step recovery.  Returns tokens emitted by any ring drain
+        an export forced."""
+        with self._mig_lock:
+            if not self._migrate_demands:
+                return 0
+            demands = list(self._migrate_demands)
+            self._migrate_demands = []
+        emitted = 0
+        keep = []
+        for d in demands:
+            try:
+                if d.kind == "out":
+                    verdict, n = self._service_migrate_out(d, tr)
+                    emitted += n
+                    if verdict == "wait":
+                        d.waiting = True
+                        keep.append(d)
+                elif d.kind == "in":
+                    self._service_migrate_in(d, tr)
+                elif d.kind == "prefix_out":
+                    self._service_prefix_out(d, tr)
+                else:
+                    self._service_prefix_in(d, tr)
+            except Exception as e:  # noqa: BLE001 — verdict channel
+                d.fail(e)
+        if keep:
+            with self._mig_lock:
+                # demands registered while servicing appended to the
+                # emptied list; waiting orders go back ahead of them
+                self._migrate_demands = keep + self._migrate_demands
+        return emitted
+
+    def _find_out_candidate(self, d):
+        """Resolve an export demand to its current (slot, request).
+        Unpinned demands pick a victim among decoding slots meeting
+        the min_tokens bar — lowest priority first, then most work
+        remaining, then lowest slot index (deterministic under a
+        seeded schedule) — and pin the Request HANDLE so later ticks
+        track the same stream even across its eviction (a stream that
+        finishes before the export lands must resolve as completed,
+        not vanish).  Returns (None, req) when the request exists but
+        is not in a slot, (None, None) when unknown."""
+        req = d.args.get("req")
+        if req is not None:
+            return self.scheduler.find(req.id), req
+        rid = d.args["request_id"]
+        if rid is None:
+            cands = [s for s in self.scheduler.busy_slots()
+                     if s.request is not None and s.decoding
+                     and len(s.request.generated)
+                     >= d.args["min_tokens"]]
+            if not cands:
+                return None, None
+            victim = min(cands, key=lambda s: (s.request.priority,
+                                               -s.request.remaining,
+                                               s.index))
+            d.args["req"] = victim.request
+            return victim, victim.request
+        slot = self.scheduler.find(rid)
+        if slot is not None:
+            d.args["req"] = slot.request
+            return slot, slot.request
+        for r in self.queue.pending():
+            if r.id == rid:
+                d.args["req"] = r
+                return None, r
+        return None, None
+
+    @staticmethod
+    def _finish_out_done(d, req):
+        """The export target reached a terminal state before the
+        export landed: a clean finish completes the demand (nothing
+        to migrate — the tokens are all here), a failed or
+        already-migrated stream fails it with that verdict."""
+        if req.error is not None:
+            d.fail(req.error)
+        else:
+            d.complete({"completed": True, "payload": None,
+                        "generated": [int(t) for t in req.generated]})
+
+    def _service_migrate_out(self, d, tr):
+        """One export attempt.  Returns (verdict, emitted): verdict
+        "wait" re-registers the demand for the next tick, "done" has
+        completed or failed it."""
+        slot, req = self._find_out_candidate(d)
+        if req is None:
+            if d.args["request_id"] is not None:
+                d.fail(KeyError(
+                    f"no live request {d.args['request_id']} to "
+                    "migrate"))
+                return "done", 0
+            return "wait", 0  # no eligible victim yet
+        if req.done():
+            self._finish_out_done(d, req)
+            return "done", 0
+        if slot is None or not slot.decoding \
+                or len(req.generated) < d.args["min_tokens"]:
+            return "wait", 0
+        emitted = 0
+        if self._ring:
+            # freeze point: the slot's device cursor must be the
+            # host-consumed view before its rows are gathered, and the
+            # consume-side drift check must never see a vanished live
+            # request — same discipline as preemption
+            emitted += self._drain_ring(tr)
+            if req.done():
+                self._finish_out_done(d, req)
+                return "done", emitted
+            slot = self.scheduler.find(req.id)
+            if slot is None or not slot.decoding:
+                return "wait", emitted
+        try:
+            self._fault("migrate_export")
+        except Exception as e:  # noqa: BLE001 — injected decline
+            d.fail(e)  # the stream keeps running on this engine
+            return "done", emitted
+        payload = self._export_slot(slot, tr,
+                                    deliver=d.args["deliver"])
+        d.complete({
+            "completed": False,
+            "generated": list(payload["request"]["generated"]),
+            "payload": payload if d.args["deliver"] == "return"
+            else None})
+        return "done", emitted
+
+    def _export_slot(self, slot, tr, deliver):
+        """Freeze + gather + tear down one decoding slot (ring already
+        drained, fault site already consulted).  The teardown is
+        preemption-shaped — full blocks into the trie (the source
+        keeps the warm prefix), release, park — but terminal: the
+        waiter unblocks with ``Migrated`` instead of the request
+        requeueing."""
+        req = slot.request
+        i = slot.index
+        ctx = (np.concatenate([req.prompt,
+                               np.asarray(req.generated, np.int32)])
+               if req.generated else req.prompt)
+        kv = None
+        n_full = 0
+        with tr.span("migrate.export", cat="serving", req=req.id) as sp:
+            if self._paged:
+                # decoding slots hold exactly slot.pos computed rows
+                # (the last emitted token's row is pending) — only
+                # full blocks under that bound travel; the partial
+                # tail is recomputed by the destination's
+                # prefix-adoption prefill
+                n_full = min(slot.pos // self._bs,
+                             len(self._slot_blocks[i]))
+                blocks = self._slot_blocks[i][:n_full]
+                if n_full:
+                    data = export_blocks(self.k_pools, self.v_pools,
+                                         blocks)
+                    kv = {"block_size": self._bs,
+                          "num_heads": self._nh,
+                          "head_dim": self._hd,
+                          "n_layers": len(self.k_pools),
+                          "dtype": str(self._kv_dtype),
+                          "n_blocks": n_full, "data": data}
+                if self.prefix_cache is not None and n_full:
+                    self.prefix_cache.insert(ctx, blocks)
+            rng = self._rngs.pop(req.id, None)
+            # np.random.Generator state is a plain JSON-able dict of
+            # Python ints — the destination rebuilds the exact stream
+            rng_state = (rng.bit_generator.state
+                         if rng is not None else None)
+            payload = {
+                "version": 1,
+                "request": {
+                    "source_id": req.id,
+                    "prompt": [int(t) for t in req.prompt],
+                    "generated": [int(t) for t in req.generated],
+                    "max_new_tokens": req.max_new_tokens,
+                    "eos_token_id": req.eos_token_id,
+                    "temperature": req.temperature,
+                    "top_k": req.top_k, "top_p": req.top_p,
+                    # the EFFECTIVE seed: an unseeded sampled stream
+                    # defaults to its request id, and the destination
+                    # mints a NEW id — carrying the resolved value
+                    # keeps the resumed draws identical either way
+                    "seed": (int(req.sample_seed) if req.do_sample
+                             else req.seed),
+                    "priority": req.priority, "tenant": req.tenant,
+                    "preemptions": req.preemptions,
+                    "rng_state": rng_state,
+                },
+                "kv": kv,
+            }
+            sp.args.update(blocks=n_full, tokens=len(req.generated))
+        self.scheduler.release(slot)
+        self._release_slot_kv(i)
+        self._park_state(i)
+        self._m_kv_migrated.inc(n_full)
+        self._m_done.inc()  # terminal HERE, like a timeout: keeps
+        #   in-flight = submitted - completed consistent per engine
+        with self._mig_lock:
+            self._migration_log.append({
+                "tick": self.tick_no, "dir": "out",
+                "request": req.id, "blocks": n_full,
+                "tokens": len(req.generated)})
+        tr.instant("req.migrated_out", cat="request", req=req.id,
+                   blocks=n_full, tokens=len(req.generated))
+        req._finish(Migrated(
+            f"request {req.id} migrated out after "
+            f"{len(req.generated)} token(s)",
+            payload=payload if deliver == "error" else None,
+            emitted=req.generated))
+        return payload
+
+    def _adopt_blocks(self, kv, ctx, tr):
+        """Validate + allocate + scatter + trie-adopt a payload's KV
+        blocks (engine thread).  Returns the adopted block ids, or []
+        when the payload carries none or this engine cannot hold them
+        (contiguous layout / no trie — the request still imports
+        whole, its admission re-prefills instead of adopting).
+        All-or-nothing: a geometry mismatch, a scheduled
+        ``migrate_import`` fault, or a scatter failure rolls the
+        fresh allocation back to refcount 0 and raises — the
+        destination owns nothing."""
+        if kv is None or not kv.get("n_blocks"):
+            return []
+        if not self._paged or self.prefix_cache is None:
+            return []
+        n = int(kv["n_blocks"])
+        want = {"block_size": self._bs, "num_heads": self._nh,
+                "head_dim": self._hd, "n_layers": len(self.k_pools),
+                "dtype": str(self._kv_dtype)}
+        got = {k: (str(kv.get(k)) if k == "dtype" else kv.get(k))
+               for k in want}
+        if got != want:
+            raise ValueError(
+                f"migration payload geometry {got} does not match "
+                f"this engine ({want}): adopting nothing")
+        short = n - self.block_pool.free_count()
+        if short > 0:
+            evicted = self.prefix_cache.evict(short)
+            if evicted:
+                self._m_prefix_evictions.inc(len(evicted))
+        blocks = self.block_pool.alloc(n)  # may raise NoFreeBlocks
+        try:
+            self._fault("migrate_import")
+            with tr.span("migrate.import", cat="serving", blocks=n):
+                self.k_pools, self.v_pools = import_blocks(
+                    self.k_pools, self.v_pools, blocks, kv["data"])
+            # hand ownership to the trie: insert takes one ref per
+            # NEW node, then the alloc ref drops — the blocks are the
+            # cache's exactly like a finished request's, and the
+            # admission gate's prefix match re-refs them per adopter.
+            # (A depth already cached keeps ITS block; ours frees at
+            # the decref — same tokens, same content, consistent.)
+            self.prefix_cache.insert(ctx, blocks)
+        except BaseException:
+            self.block_pool.decref(blocks)  # refcount 0, freed
+            raise
+        self.block_pool.decref(blocks)
+        return blocks
+
+    def _service_migrate_in(self, d, tr):
+        """Adopt one migrated stream: blocks into pool+trie, then an
+        equivalent Request through the normal queue — admission
+        prefix-matches the adopted blocks and ``_bind_sample_state``
+        rebinds the rng at fold counter len(generated), the proven
+        preemption-resume path."""
+        if self._draining:
+            raise QueueFull("engine is draining: not accepting "
+                            "migrations")
+        payload = d.args["payload"]
+        rq = payload["request"]
+        generated = [int(t) for t in rq.get("generated") or []]
+        ctx = [int(t) for t in rq["prompt"]] + generated
+        blocks = self._adopt_blocks(payload.get("kv"), ctx, tr)
+        req = Request(
+            rq["prompt"], rq["max_new_tokens"],
+            eos_token_id=rq.get("eos_token_id"),
+            temperature=rq.get("temperature", 1.0),
+            top_k=rq.get("top_k", 0), top_p=rq.get("top_p", 1.0),
+            seed=rq.get("seed"), priority=rq.get("priority", 0),
+            tenant=rq.get("tenant"))
+        req.generated = generated
+        req._ctx = np.asarray(ctx, np.int32)
+        req.preemptions = int(rq.get("preemptions") or 0) + 1
+        #   counts the handoff; admission emits req.resumed for it
+        state = rq.get("rng_state")
+        if state is not None and self.sample_mode == "host":
+            g = np.random.default_rng(req.sample_seed)
+            g.bit_generator.state = state
+            self._rngs[req.id] = g
+        self.queue.put(req)
+        self._m_reqs.inc()
+        with self._mig_lock:
+            self._migration_log.append({
+                "tick": self.tick_no, "dir": "in", "request": req.id,
+                "source": rq.get("source_id"), "blocks": len(blocks),
+                "tokens": len(generated)})
+        tr.instant("req.migrated_in", cat="request", req=req.id,
+                   source=rq.get("source_id"), blocks=len(blocks),
+                   tokens=len(generated))
+        d.complete({"request": req, "blocks": len(blocks)})
+
+    def _service_prefix_out(self, d, tr):
+        """Prefix-warming export: gather the trie's longest cached
+        prefix of the demand's tokens.  Completes with None when
+        nothing is cached."""
+        tokens = d.args["tokens"]
+        if not self._paged or self.prefix_cache is None:
+            d.complete(None)
+            return
+        try:
+            self._fault("migrate_export")
+        except Exception as e:  # noqa: BLE001 — injected decline
+            d.fail(e)
+            return
+        blocks, m = self.prefix_cache.match(tokens)
+        if not blocks:
+            d.complete(None)
+            return
+        try:
+            with tr.span("migrate.export", cat="serving",
+                         blocks=len(blocks), prefix=True):
+                data = export_blocks(self.k_pools, self.v_pools,
+                                     blocks)
+        finally:
+            self.block_pool.decref(blocks)  # drop match's adopter refs
+        payload = {
+            "version": 1, "request": None,
+            "prefix": [int(t) for t in tokens[:m]],
+            "kv": {"block_size": self._bs, "num_heads": self._nh,
+                   "head_dim": self._hd,
+                   "n_layers": len(self.k_pools),
+                   "dtype": str(self._kv_dtype),
+                   "n_blocks": len(blocks), "data": data}}
+        self._m_kv_migrated.inc(len(blocks))
+        with self._mig_lock:
+            self._migration_log.append({
+                "tick": self.tick_no, "dir": "prefix_out",
+                "blocks": len(blocks), "tokens": m})
+        d.complete(payload)
+
+    def _service_prefix_in(self, d, tr):
+        """Prefix-warming import: adopt a peer trie's blocks.  The
+        exported prefix covers exactly n_blocks * block_size tokens,
+        so every block registers under the trie."""
+        payload = d.args["payload"]
+        tokens = [int(t) for t in payload.get("prefix") or []]
+        blocks = self._adopt_blocks(payload.get("kv"), tokens, tr)
+        if blocks:
+            with self._mig_lock:
+                self._migration_log.append({
+                    "tick": self.tick_no, "dir": "prefix_in",
+                    "blocks": len(blocks),
+                    "tokens": len(blocks) * self._bs})
+            tr.instant("prefix.warmed", cat="serving",
+                       blocks=len(blocks))
+        d.complete({"blocks": len(blocks),
+                    "tokens": len(blocks) * self._bs if blocks else 0})
+
     # -- tracing / flight recorder / debug surface ---------------------
     def _register_compile_listener(self):
         """Subscribe this engine to the model's compile events
@@ -1540,6 +2131,8 @@ class Engine:
             "tick": self.tick_no, "slots": slots, "queue": queued,
             "in_flight_ticks": [inf.tick for inf in ring],
             "preemptions": self._preempt_history()[-16:],
+            "migrations": self._migration_history()[-16:],
+            "migrations_pending": self._migrate_pending(),
             "engine": {
                 "num_slots": self.num_slots,
                 "max_seq_len": self.max_seq_len,
@@ -2898,6 +3491,10 @@ class Engine:
         self._overlap_acc = 0.0
         now = time.monotonic()
         emitted = 0
+        # cross-replica migration orders first: an export drains the
+        # ring and frees its slot for this very tick's admission, an
+        # import's request enters the queue before the admit phase
+        emitted += self._service_migrations(tr)
         # -- planning / admission: host work in the gap --------------
         in_flight = bool(self._ring)
         t_plan = time.monotonic()
@@ -3018,6 +3615,8 @@ class Engine:
     def _tick(self, tr, tick_sp):
         now = time.monotonic()
         emitted = 0
+        # cross-replica migration orders first (see _tick_async)
+        emitted += self._service_migrations(tr)
         self._gate_declined = False
         # deadline sweep first: with a full pool nothing gets popped,
         # but queued requests must still time out on schedule
@@ -3096,7 +3695,7 @@ class Engine:
         convenience); returns total tokens emitted."""
         total = 0
         for _ in range(max_steps):
-            if self.scheduler.idle():
+            if self.scheduler.idle() and not self._migrate_actionable():
                 return total
             total += self.step()
         raise RuntimeError(
@@ -3133,7 +3732,8 @@ class Engine:
                 prev.join()  # serialize: never two loops in step()
             try:
                 while not stop_evt.is_set():
-                    if self.scheduler.idle():
+                    if self.scheduler.idle() \
+                            and not self._migrate_actionable():
                         self._m_rate.refresh()  # decay tokens/sec to 0
                         # event-driven wake instead of a 2 ms poll: an
                         # idle engine burns no CPU and a submit() is
@@ -3146,6 +3746,7 @@ class Engine:
                         # heartbeat, not an admission latency bound.
                         self._wake.clear()
                         if self.scheduler.idle() \
+                                and not self._migrate_actionable() \
                                 and not stop_evt.is_set():
                             self._wake.wait(timeout=0.5)
                         continue
@@ -3173,6 +3774,10 @@ class Engine:
         # the next start() re-uploads clean cursors (every eviction
         # parks its lanes and dirties the mirrors)
         self._ring = []
+        with self._mig_lock:
+            demands, self._migrate_demands = self._migrate_demands, []
+        for d in demands:
+            d.fail(RuntimeError("engine stopped"))
         for req in self.queue.drain():
             # a preempted host-mode request waiting in queue still
             # holds its numpy rng stream — shutdown must release it

@@ -95,6 +95,29 @@ site                  checked at                        action
                                                         resume with
                                                         context
 ====================  ===============================  ==============
+
+Migration sites (KV block migration between replicas — checked on the
+SOURCE engine's tick for export, the transport's op counter for the
+wire, and the DESTINATION engine's tick for import, so a single seeded
+schedule can kill a migration at any of its three stages):
+
+====================  ===============================  ==============
+site                  checked at                        action
+====================  ===============================  ==============
+``migrate_export``    source engine, before the slot    raises
+                      is frozen and its blocks          InjectedFault
+                      gathered (the stream keeps        — migration
+                      running on the source)            declined
+``migrate_wire``      transport, payload in flight      raises
+                      (the bytes may be lost; the       NetDisconnect
+                      HOLDER of the payload re-sends
+                      or falls back to failover)
+``migrate_import``    destination engine, before the    raises
+                      gathered blocks are adopted       InjectedFault
+                      into its pool/trie (fresh         — destination
+                      allocation rolls back to          owns NOTHING
+                      refcount 0)
+====================  ===============================  ==============
 """
 from __future__ import annotations
 
@@ -146,7 +169,8 @@ ENGINE_SITES = ("dispatch", "d2h_hang", "pool_exhaust", "host_slow",
                 "spec_draft")
 NET_SITES = ("net_refuse", "net_blackhole", "net_slow",
              "net_disconnect")
-SITES = ENGINE_SITES + NET_SITES
+MIGRATE_SITES = ("migrate_export", "migrate_wire", "migrate_import")
+SITES = ENGINE_SITES + NET_SITES + MIGRATE_SITES
 
 
 class FaultInjector:
@@ -275,6 +299,18 @@ class FaultInjector:
             raise NetDisconnect(
                 f"injected mid-body disconnect at op {tick} after "
                 f"{n} emitted tokens", emitted=emitted)
+        if site == "migrate_export":
+            raise InjectedFault(
+                f"injected export failure at tick {tick}: migration "
+                "declined, the stream stays on the source")
+        if site == "migrate_wire":
+            raise NetDisconnect(
+                f"injected wire loss at op {tick}: the migration "
+                "payload vanished in flight", emitted=emitted)
+        if site == "migrate_import":
+            raise InjectedFault(
+                f"injected import failure at tick {tick}: the "
+                "destination adopted nothing")
 
 
 

@@ -34,6 +34,28 @@ full submit -> queue -> slot -> result path over a real socket):
                         tools/trace_view.py)
   GET  /debug/requests  in-flight slot/request states (prefill
                         progress, spec lanes, KV blocks) + the queue
+                        + the recent migration log
+  POST /migrate/export  KV block migration, source side.  Three body
+                        shapes: {"request_id": n} exports a LIVE
+                        stream; {"prompt": [...], ...generate params,
+                        "min_tokens": 1} submits, decodes to
+                        min_tokens, then exports (the disaggregated
+                        PREFILL replica's path); {"prefix_only":
+                        true, "tokens": [...]} exports the longest
+                        cached prefix from the trie (cross-replica
+                        prefix warming).  -> {"completed": bool,
+                        "generated": [...], "payload": {...}|null}
+                        with the payload in JSON wire form
+                        (kvcache.payload_to_json)
+  POST /migrate/import  destination side: body is a wire payload.  A
+                        stream payload is adopted block-for-block and
+                        DECODED TO COMPLETION here — the response is
+                        /generate-shaped (the disaggregated DECODE
+                        replica's path); a prefix payload ("request"
+                        null) warms the trie -> {"blocks": n,
+                        "tokens": n}.  Failure leaves the destination
+                        owning nothing (503 "migrate_failed" /
+                        "queue_full"; 400 on geometry mismatch)
 
 Every 4xx/5xx body is JSON with a machine-readable ``reason``
 (``bad_request`` / ``queue_full`` / ``rate_limited`` /
@@ -53,6 +75,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import monitor
+from .kvcache import payload_to_json
 from .request import (DeadlineShed, RateLimited, Rejected,
                       RequestTimeout)
 
@@ -152,6 +175,10 @@ class JsonHandler(BaseHTTPRequestHandler):
 class _Handler(JsonHandler):
     engine = None          # bound per-server via the factory below
     result_timeout = 120.0
+    role = "mixed"         # disaggregation role advertised on
+    #   /healthz: "prefill" / "decode" / "mixed" — purely a routing
+    #   signal (every endpoint works on every role; the router's
+    #   phase filter is what specializes the replicas)
 
     def _validate_prompt(self, prompt, max_new_tokens):
         """Reject malformed / over-capacity prompts AT THE EDGE with a
@@ -217,6 +244,9 @@ class _Handler(JsonHandler):
                 "kv_block_size": (eng._bs if getattr(eng, "_paged",
                                                      False) else None),
                 "sample_mode": getattr(eng, "sample_mode", "host"),
+                # disaggregated serving: which phase this replica
+                # volunteers for (the router's pick() filters on it)
+                "role": self.role,
                 # which attention implementation serves the paged
                 # dispatches: "ragged" = the Pallas ragged paged
                 # attention kernel (one program for decode / spec /
@@ -309,6 +339,12 @@ class _Handler(JsonHandler):
                                   "reason": "not_found"})
 
     def do_POST(self):
+        if self.path == "/migrate/export":
+            self._migrate_export()
+            return
+        if self.path == "/migrate/import":
+            self._migrate_import()
+            return
         if self.path != "/generate":
             self._send_json(404, {"error": f"no route {self.path}",
                                   "reason": "not_found"})
@@ -380,17 +416,177 @@ class _Handler(JsonHandler):
             "ttft_ms": ttft,
         })
 
+    def _read_body(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n) or b"{}")
+
+    def _migrate_export(self):
+        """Source side of a migration.  The disaggregated-prefill
+        shape submits here, lets the engine decode to ``min_tokens``
+        (so the destination resumes a DECODING stream through the
+        proven preemption-resume binding), then exports."""
+        eng = self.engine
+        try:
+            body = self._read_body()
+        except (TypeError, ValueError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}",
+                                  "reason": "bad_request"})
+            return
+        try:
+            if body.get("prefix_only"):
+                payload = eng.export_prefix(
+                    body.get("tokens") or [],
+                    timeout=self.result_timeout)
+                self._send_json(200, {
+                    "completed": False, "generated": [],
+                    "payload": (None if payload is None
+                                else payload_to_json(payload))})
+                return
+            if "request_id" in body:
+                res = eng.migrate_out(
+                    request_id=int(body["request_id"]),
+                    min_tokens=int(body.get("min_tokens", 1)),
+                    deliver="return", timeout=self.result_timeout)
+            else:
+                prompt = body.get("prompt")
+                max_new = int(body.get("max_new_tokens", 16))
+                err = self._validate_prompt(prompt, max_new)
+                if err is not None:
+                    self._send_json(400, {"error": err,
+                                          "reason": "bad_request"})
+                    return
+                req = eng.submit(
+                    prompt, max_new_tokens=max_new,
+                    eos_token_id=body.get("eos_token_id"),
+                    timeout=body.get("timeout"),
+                    temperature=float(body.get("temperature", 1.0)),
+                    top_k=int(body.get("top_k", 0)),
+                    top_p=float(body.get("top_p", 1.0)),
+                    seed=body.get("seed"),
+                    priority=int(body.get("priority", 0)),
+                    tenant=body.get("tenant"))
+                res = eng.migrate_out(
+                    request_id=req.id,
+                    min_tokens=int(body.get("min_tokens", 1)),
+                    deliver="return", timeout=self.result_timeout)
+        except Rejected as e:
+            code = 429 if isinstance(e, RateLimited) else 503
+            self._send_json(
+                code,
+                {"error": str(e),
+                 "reason": _shed_reason(e, draining=bool(
+                     getattr(eng, "_draining", False)))},
+                headers=_retry_after_header(e))
+            return
+        except KeyError as e:
+            self._send_json(404, {"error": str(e),
+                                  "reason": "not_found"})
+            return
+        except TimeoutError as e:
+            self._send_json(504, {"error": str(e),
+                                  "reason": "result_timeout"})
+            return
+        except (TypeError, ValueError) as e:
+            self._send_json(400, {"error": str(e),
+                                  "reason": "bad_request"})
+            return
+        except Exception as e:  # injected export fault: the stream
+            #   (if any) keeps running HERE — a retryable decline
+            self._send_json(503, {"error": str(e),
+                                  "reason": "migrate_declined"})
+            return
+        payload = res.get("payload")
+        self._send_json(200, {
+            "completed": bool(res.get("completed")),
+            "generated": [int(t) for t in res.get("generated") or []],
+            "payload": (None if payload is None
+                        else payload_to_json(payload))})
+
+    def _migrate_import(self):
+        """Destination side.  A stream payload is adopted and decoded
+        to completion — the response is /generate-shaped, with the
+        pre-migration tokens included, so the caller (router) streams
+        one complete answer.  A prefix payload only warms the trie.
+        Every failure path leaves this replica owning nothing."""
+        eng = self.engine
+        try:
+            body = self._read_body()
+        except (TypeError, ValueError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}",
+                                  "reason": "bad_request"})
+            return
+        try:
+            if body.get("request") is None:
+                res = eng.import_prefix(body,
+                                        timeout=self.result_timeout)
+                self._send_json(200, {"blocks": res["blocks"],
+                                      "tokens": res["tokens"]})
+                return
+            res = eng.migrate_in(body, timeout=self.result_timeout)
+        except Rejected as e:
+            code = 429 if isinstance(e, RateLimited) else 503
+            self._send_json(
+                code,
+                {"error": str(e),
+                 "reason": _shed_reason(e, draining=bool(
+                     getattr(eng, "_draining", False)))},
+                headers=_retry_after_header(e))
+            return
+        except TimeoutError as e:
+            self._send_json(504, {"error": str(e),
+                                  "reason": "result_timeout"})
+            return
+        except (TypeError, ValueError) as e:
+            # malformed payload / geometry mismatch: re-sending the
+            # same bytes here cannot succeed — non-retryable 400
+            self._send_json(400, {"error": str(e),
+                                  "reason": "bad_request"})
+            return
+        except Exception as e:  # injected import fault / pool
+            #   exhaustion: this replica adopted NOTHING, the payload
+            #   holder may import elsewhere — retryable 503
+            self._send_json(503, {"error": str(e),
+                                  "reason": "migrate_failed"})
+            return
+        req = res["request"]
+        try:
+            ids = req.result(timeout=self.result_timeout)
+        except RequestTimeout as e:
+            self._send_json(504, {"error": str(e),
+                                  "reason": "result_timeout"})
+            return
+        except (TimeoutError, RuntimeError) as e:
+            self._send_json(500, {"error": str(e),
+                                  "reason": "internal"})
+            return
+        ttft = None
+        if req.first_token_at is not None:
+            ttft = round((req.first_token_at - req.submitted_at) * 1e3,
+                         3)
+        self._send_json(200, {
+            "id": req.id,
+            "ids": [int(x) for x in ids],
+            "generated": [int(x) for x in req.generated],
+            "ttft_ms": ttft,
+            "migrated_blocks": res["blocks"],
+        })
+
 
 class EngineServer:
     """Engine tick loop + ThreadingHTTPServer, each on its own daemon
     thread.  ``with EngineServer(engine) as srv: ... srv.port``."""
 
     def __init__(self, engine, host="127.0.0.1", port=0,
-                 result_timeout=120.0):
+                 result_timeout=120.0, role="mixed"):
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(f"role must be 'mixed', 'prefill' or "
+                             f"'decode', got {role!r}")
         self.engine = engine
+        self.role = role
         handler = type("BoundHandler", (_Handler,),
                        {"engine": engine,
-                        "result_timeout": float(result_timeout)})
+                        "result_timeout": float(result_timeout),
+                        "role": role})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self.httpd.server_address[:2]
         self._http_thread = None
@@ -472,6 +668,13 @@ def main(argv=None):
     p.add_argument("--prefill-chunk", type=int, default=None)
     p.add_argument("--spec-k", type=int, default=None)
     p.add_argument("--result-timeout", type=float, default=120.0)
+    p.add_argument("--role", default="mixed",
+                   choices=("mixed", "prefill", "decode"),
+                   help="disaggregation role advertised on /healthz: "
+                        "the router routes new prompts to prefill "
+                        "replicas and migrated streams to decode "
+                        "replicas (every endpoint still works on "
+                        "every role)")
     args = p.parse_args(argv)
 
     import paddle_tpu as paddle
@@ -495,7 +698,8 @@ def main(argv=None):
     # the port line is the launcher's readiness handshake: printed
     # AFTER the socket is bound, flushed so a pipe reader sees it
     srv = EngineServer(engine, host=args.host, port=args.port,
-                       result_timeout=args.result_timeout).start()
+                       result_timeout=args.result_timeout,
+                       role=args.role).start()
     print(f"serving {args.config} mp={args.mp} on {srv.address}",
           flush=True)
     try:

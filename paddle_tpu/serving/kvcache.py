@@ -59,10 +59,116 @@ write ever touches a block the slot does not own — it is simply
 enforced in one place (``GPTAttention.ragged_window_paged`` +
 ops/ragged_paged_attn.py) instead of three.
 
+Cross-replica block migration (PR 13): because blocks are fixed-size,
+refcounted, and layer-invariant, moving a live stream between replicas
+is a BLOCK-TABLE REWRITE plus a bytes transfer — ``export_blocks``
+gathers the named rows out of the per-layer device pools into one host
+array (only the exported blocks cross d2h, never the pool), and
+``import_blocks`` scatters them into freshly allocated rows on the
+destination, whose pool/trie then adopt the refs through the normal
+``alloc`` / ``PrefixCache.insert`` protocol.  ``payload_to_json`` /
+``payload_from_json`` are the wire codec (base64 over the HTTP
+transport).  The engine-side choreography — ring drain, slot freeze,
+resume snapshot — lives in serving/engine.py (``migrate_out`` /
+``migrate_in``); this module stays pure bytes + ids.
+
 The invariant tests live in tests/test_kvcache.py (pool/trie) and
 tests/test_ragged_attn.py (kernel-side masking).
 """
 from __future__ import annotations
+
+
+def export_blocks(k_pools, v_pools, block_ids):
+    """Gather the device rows of ``block_ids`` from the engine's
+    per-layer paged pools into ONE host array — the bytes half of a
+    migration (``Engine.migrate_out`` wraps it with the request's
+    resume snapshot).
+
+    ``k_pools`` / ``v_pools``: per-layer pool arrays, each
+    ``[num_blocks, block_size, H, hd]``.  ``block_ids``: the
+    layer-invariant physical rows to export, in table order (a slot's
+    FULL blocks only — the partial tail is recomputed by the
+    destination's own prefill).  Returns a numpy array of shape
+    ``(n_layers, 2, n_blocks, block_size, H, hd)`` with axis 1 = (K,
+    V); the row indexing runs ON DEVICE so only the exported blocks
+    cross the d2h boundary, never the whole pool."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    ids = jnp.asarray([int(b) for b in block_ids], jnp.int32)
+    parts = [jnp.stack((jnp.take(kp, ids, axis=0),
+                        jnp.take(vp, ids, axis=0)))
+             for kp, vp in zip(k_pools, v_pools)]
+    return np.asarray(jax.device_get(jnp.stack(parts)))
+
+
+def import_blocks(k_pools, v_pools, block_ids, data):
+    """Scatter an ``export_blocks`` array into rows ``block_ids`` of
+    the destination's per-layer pools.  Returns new ``(k_pools,
+    v_pools)`` lists — jax arrays are immutable, so the engine
+    reassigns its pool references (safe between dispatches: the
+    decode/prefill programs re-bind the pools at every dispatch).
+    Raises ValueError when the payload geometry does not match the
+    destination pools (block size / heads / head_dim / layer count) —
+    the caller rolls its fresh allocation back, adopting NOTHING."""
+    import jax.numpy as jnp
+    import numpy as np
+    data = np.asarray(data)
+    ids = [int(b) for b in block_ids]
+    want = (len(k_pools), 2, len(ids)) + tuple(k_pools[0].shape[1:])
+    if tuple(data.shape) != want:
+        raise ValueError(
+            f"migration payload shape {tuple(data.shape)} does not "
+            f"match destination pools (want {want}: layers x (K,V) x "
+            "blocks x block_size x heads x head_dim)")
+    idx = jnp.asarray(ids, jnp.int32)
+    new_k, new_v = [], []
+    for li, (kp, vp) in enumerate(zip(k_pools, v_pools)):
+        new_k.append(kp.at[idx].set(jnp.asarray(data[li, 0], kp.dtype)))
+        new_v.append(vp.at[idx].set(jnp.asarray(data[li, 1], vp.dtype)))
+    return new_k, new_v
+
+
+def payload_to_json(payload):
+    """JSON-encode a migration payload for the HTTP wire: the
+    ``kv["data"]`` numpy array becomes base64 bytes + dtype + shape
+    (``data_b64`` / ``data_dtype`` / ``data_shape``); everything else
+    in the payload is already JSON-shaped.  ``payload_from_json``
+    inverts exactly."""
+    import base64
+    import numpy as np
+    out = {k: v for k, v in payload.items() if k != "kv"}
+    kv = payload.get("kv")
+    if kv is not None:
+        kv = dict(kv)
+        data = kv.pop("data", None)
+        if data is not None:
+            arr = np.ascontiguousarray(data)
+            kv["data_b64"] = base64.b64encode(
+                arr.tobytes()).decode("ascii")
+            kv["data_dtype"] = str(arr.dtype)
+            kv["data_shape"] = list(arr.shape)
+        out["kv"] = kv
+    return out
+
+
+def payload_from_json(obj):
+    """Decode a ``payload_to_json`` wire dict back into the in-memory
+    payload form (``kv["data"]`` as a writable numpy array)."""
+    import base64
+    import numpy as np
+    out = {k: v for k, v in obj.items() if k != "kv"}
+    kv = obj.get("kv")
+    if kv is not None:
+        kv = dict(kv)
+        b64 = kv.pop("data_b64", None)
+        if b64 is not None:
+            dtype = np.dtype(kv.pop("data_dtype"))
+            shape = tuple(kv.pop("data_shape"))
+            kv["data"] = np.frombuffer(
+                base64.b64decode(b64), dtype=dtype).reshape(shape).copy()
+        out["kv"] = kv
+    return out
 
 
 def per_shard_block_bytes(block_size, num_heads, head_dim, dtype,

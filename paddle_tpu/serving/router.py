@@ -50,6 +50,24 @@ robustness is the design center, not an afterthought:
   logical request is delivered exactly once — orphaned work on a
   half-dead replica is discarded, never double-served.
 
+* **KV block migration & disaggregated prefill/decode.**  Replicas
+  advertise a ROLE (``prefill`` / ``decode`` / ``mixed``) in their
+  probes.  With ``RouterPolicy(disaggregate=True)`` a new prompt is
+  chunked-prefilled on a prefill-role replica, its warm KV blocks are
+  exported block-granular and imported into a decode-role replica,
+  and the stream finishes there — token-identical to a single mixed
+  replica serving it whole.  ``rebalance()`` preempts a LIVE stream
+  off a hot replica the same way: the victim's blocked waiter catches
+  the migration payload (``StreamMigrated``) and the router re-lands
+  it on a peer — the same logical request continues, delivered
+  exactly once.  On an affinity miss, ``prefix_warm=True`` pulls the
+  affinity target's cached prefix blocks into the chosen replica
+  before dispatching (cross-replica prefix warming).  The
+  ``migrate_export`` / ``migrate_import`` transport ops carry the
+  ``migrate_wire`` fault site on the same per-replica operation
+  counter as the ``net_*`` sites — a seeded wire loss mid-migration
+  replays exactly like every other injected fault.
+
 Everything is observable: ``route.pick`` / ``route.retry`` /
 ``route.hedge`` / ``probe`` spans in the router's own tracer,
 ``router.*`` metrics (retries, failovers, hedges, affinity hits,
@@ -137,6 +155,20 @@ class ReplicaAbandoned(RuntimeError):
     """The transport abandoned a QUEUED-BUT-UNSTARTED request because
     the prober declared its replica dead (or the router is stopping):
     nothing was emitted, so the failover re-dispatches it whole."""
+
+
+class StreamMigrated(RuntimeError):
+    """The replica MIGRATED this stream out mid-decode (a rebalance
+    landed on it): ``payload`` is the block-granular KV + resume
+    snapshot the router re-lands on a peer, ``emitted`` is everything
+    the stream had produced (the salvage fallback when no peer will
+    take the payload).  NOT a failure — the replica did exactly what
+    it was told."""
+
+    def __init__(self, msg, payload=None, emitted=None):
+        super().__init__(msg)
+        self.payload = payload
+        self.emitted = [int(t) for t in (emitted or [])]
 
 
 def affinity_key(prompt, block_size):
@@ -295,6 +327,14 @@ class RouterPolicy:
         affinity target is considered overloaded and the pick falls
         back to least-loaded (cache locality must not create a hot
         shard).
+    disaggregate : route each NEW prompt through a prefill-role
+        replica (chunked prefill + first token), migrate its warm KV
+        blocks to a decode-role replica, and finish the stream there.
+        Fleets with no prefill/decode split fall back to normal
+        routing per-request — the knob degrades, it never strands.
+    prefix_warm : on an affinity MISS, pull the affinity target's
+        cached prefix blocks into the chosen replica before
+        dispatching (cross-replica prefix warming; best-effort).
     request_timeout_s : per-attempt transport timeout.
     seed : the determinism root for every jitter draw.
     """
@@ -305,6 +345,7 @@ class RouterPolicy:
                  hedge_after_s=None, hedge_floor_s=0.1,
                  breaker_threshold=3, breaker_cooldown_s=1.0,
                  affinity=True, affinity_queue_threshold=8,
+                 disaggregate=False, prefix_warm=False,
                  request_timeout_s=60.0, seed=0):
         if dead_after < 1:
             raise ValueError(f"dead_after must be >= 1, got {dead_after}")
@@ -327,6 +368,8 @@ class RouterPolicy:
         self.breaker_cooldown_s = float(breaker_cooldown_s)
         self.affinity = bool(affinity)
         self.affinity_queue_threshold = int(affinity_queue_threshold)
+        self.disaggregate = bool(disaggregate)
+        self.prefix_warm = bool(prefix_warm)
         self.request_timeout_s = float(request_timeout_s)
         self.seed = int(seed)
 
@@ -352,6 +395,13 @@ class Replica:
         with self._inflight_lock:
             self.inflight += delta
 
+    @property
+    def role(self):
+        """Probed serving role: ``prefill`` / ``decode`` / ``mixed``.
+        Unprobed replicas default to mixed — routable everywhere, so
+        a fleet with no role split behaves exactly as before."""
+        return self.signals.get("role") or "mixed"
+
     def load_key(self):
         """Least-loaded ordering: probed queue depth first, then the
         fewest free slots LAST (more headroom wins), name as the
@@ -364,7 +414,7 @@ class Replica:
     def view(self):
         """JSON-shaped registry row (the routerd /replicas surface)."""
         return {
-            "name": self.name, "state": self.state,
+            "name": self.name, "state": self.state, "role": self.role,
             "breaker": self.breaker.state,
             "breaker_trips": self.breaker.trips,
             "probe_failures": self.probe_failures,
@@ -441,6 +491,10 @@ class Router:
         self._m_breaker_trips = reg.counter(
             "router.breaker_trips_total",
             "circuit breakers tripped open (all replicas)")
+        self._m_migrations = reg.counter(
+            "router.migrations_total",
+            "streams moved between replicas by KV block migration "
+            "(disaggregated prefill handoffs + rebalance re-lands)")
         self._m_probes = reg.counter(
             "router.probes_total", "health probes sent")
         self._m_lat = reg.histogram(
@@ -566,7 +620,10 @@ class Router:
                           # mesh: the /replicas registry rows (and
                           # timeline.py --router) label sharded
                           # replicas without a second probe protocol
-                          "mesh_shape", "mp")})
+                          "mesh_shape", "mp",
+                          # disaggregated fleets advertise each
+                          # replica's serving role the same way
+                          "role")})
                     if self._kv_bs is None \
                             and info.get("kv_block_size"):
                         self._kv_bs = int(info["kv_block_size"])
@@ -664,13 +721,23 @@ class Router:
                 best = (score, r)
         return best[1] if best else None
 
-    def pick(self, prompt, exclude=(), rid=None, attempt=0):
+    def pick(self, prompt, exclude=(), rid=None, attempt=0,
+             phase=None):
         """One routing decision: (replica, how) where how is
         ``affinity`` / ``load`` / ``random`` / ``last_resort``.
-        Raises NoReplicasAvailable when nothing is routable."""
+        ``phase`` (``prefill`` / ``decode``) restricts the candidate
+        set to replicas of that ROLE — exact-role replicas when any
+        exist, else role-or-mixed; a phase slice with nothing
+        routable falls back to the whole fleet (disaggregation
+        degrades before it fails).  Raises NoReplicasAvailable when
+        nothing at all is routable."""
         key = affinity_key(prompt, self.block_size())
         exclude = set(exclude)
         reps = self._reps()
+        if phase is not None:
+            exact = [r for r in reps if r.role == phase]
+            reps = exact or [r for r in reps
+                             if r.role in (phase, "mixed")] or reps
         healthy = [r for r in reps if r.name not in exclude
                    and r.state == HEALTHY and r.breaker.peek()]
         degraded = [r for r in reps if r.name not in exclude
@@ -687,6 +754,10 @@ class Router:
                     and r.breaker.peek()]
             how = "last_resort"
         if not pool:
+            if phase is not None:
+                # the role slice is unroutable: degrade to whole-
+                # fleet routing before failing the request outright
+                return self.pick(prompt, exclude, rid, attempt)
             raise NoReplicasAvailable(
                 f"no routable replica among {len(reps)}: "
                 + ", ".join(f"{r.name}={r.state}/{r.breaker.state}"
@@ -744,9 +815,12 @@ class Router:
                        self.policy.hedge_floor_s)
         return self.policy.hedge_floor_s
 
-    def _attempt(self, rep, payload, rid, abort_extra=None):
+    def _attempt(self, rep, payload, rid, abort_extra=None,
+                 op="generate"):
         """One dispatch against one replica: inflight accounting,
-        breaker bookkeeping, abandon hook."""
+        breaker bookkeeping, abandon hook.  ``op`` names the client
+        method (``generate`` / ``migrate_export`` /
+        ``migrate_import``) — all share the transport contract."""
 
         def should_abort():
             return (self._stopping or rep.state == DEAD
@@ -754,8 +828,8 @@ class Router:
 
         rep.track(+1)
         try:
-            resp = rep.client.generate(payload,
-                                       should_abort=should_abort)
+            resp = getattr(rep.client, op)(payload,
+                                           should_abort=should_abort)
         except Exception as e:
             if self._stopping \
                     or (abort_extra is not None and abort_extra()):
@@ -764,6 +838,10 @@ class Router:
                 # replica's breaker; just hand back any trial slot so
                 # a HALF_OPEN breaker cannot wedge
                 rep.breaker.release_trial()
+            elif isinstance(e, StreamMigrated):
+                # a rebalance the ROUTER itself ordered: the replica
+                # did exactly what it was told — a health signal
+                rep.breaker.record_success()
             elif isinstance(e, ReplicaHTTPError) and e.status < 500:
                 # a 4xx is the CALLER's fault and PROVES the replica
                 # is answering: a health signal, not a failure — a
@@ -870,6 +948,189 @@ class Router:
             return "primary" if "primary" in succ else "hedge"
         return "primary"
 
+    # -- KV block migration ---------------------------------------------
+    def _disagg_split(self, exclude):
+        """True when the routable fleet (minus ``exclude``) still has
+        BOTH a prefill-role and a decode-role replica — the
+        precondition for a disaggregated dispatch."""
+        roles = {r.role for r in self._reps()
+                 if r.name not in exclude
+                 and r.state in (HEALTHY, DEGRADED)
+                 and r.breaker.peek()}
+        return "prefill" in roles and "decode" in roles
+
+    def _import_stream(self, mig_payload, rid, prompt, exclude,
+                       timeout_s, phase="decode"):
+        """Land a migration payload on a routable replica (decode
+        role preferred) and block until the resumed stream completes.
+        Returns ``(replica, resp, dispatches)``; ``resp`` is None
+        when every candidate refused the payload.  Safe to retry the
+        SAME payload across candidates: a failed import adopts
+        nothing (the engine rolls its blocks back to refcount 0), and
+        a destination that died mid-resume never delivered — re-
+        importing replays the identical continuation from the
+        migration point, so nothing is duplicated."""
+        body = dict(mig_payload)
+        body["timeout_s"] = timeout_s
+        tried = set(exclude)
+        n = 0
+        for k in range(self.policy.retry_max + 1):
+            try:
+                with self.tracer.span("route.pick", cat="router",
+                                      req=rid, attempt=k,
+                                      phase=phase) as sp:
+                    rep, how = self.pick(prompt, exclude=tried,
+                                         rid=rid, attempt=k,
+                                         phase=phase)
+                    if not rep.breaker.acquire():
+                        raise ReplicaUnavailable(
+                            f"{rep.name} breaker trial already in "
+                            "flight")
+                    if sp is not None and hasattr(sp, "args"):
+                        sp.args.update(replica=rep.name, how=how)
+            except (NoReplicasAvailable, ReplicaUnavailable):
+                break
+            self._m_picks.inc()
+            self.log.append(("pick", rid, rep.name,
+                             f"{phase}/{how}", k))
+            n += 1
+            try:
+                resp = self._attempt(rep, body, rid,
+                                     op="migrate_import")
+            except Exception as e:
+                kind, _, _, _ = self._classify(e, True)
+                self.log.append(("failover", rid, rep.name,
+                                 f"import_{kind}"))
+                self._m_retries.inc()
+                tried.add(rep.name)
+                continue
+            return rep, resp, n
+        return None, None, n
+
+    def _disagg_attempt(self, payload, rid, prompt, exclude,
+                        emitted_sink):
+        """One disaggregated dispatch: chunked prefill + first token
+        on a PREFILL-role replica, migrate the warm KV blocks, finish
+        the stream on a DECODE-role replica.  Returns ``(served_by,
+        resp, dispatches)`` on success or None on failure — the
+        caller's normal path then takes over (``exclude`` and the
+        greedy ``emitted_sink`` are updated in place, so a resumed
+        stream picks up exactly where the wreckage left it)."""
+        try:
+            with self.tracer.span("route.pick", cat="router", req=rid,
+                                  phase="prefill") as sp:
+                pre, how = self.pick(prompt, exclude=exclude, rid=rid,
+                                     attempt=0, phase="prefill")
+                if not pre.breaker.acquire():
+                    raise ReplicaUnavailable(
+                        f"{pre.name} breaker trial already in flight")
+                if sp is not None and hasattr(sp, "args"):
+                    sp.args.update(replica=pre.name, how=how)
+        except (NoReplicasAvailable, ReplicaUnavailable):
+            return None
+        self._m_picks.inc()
+        if how == "affinity":
+            self._m_affinity.inc()
+        self.log.append(("pick", rid, pre.name, f"prefill/{how}", 0))
+        body = dict(payload)
+        body["min_tokens"] = 1
+        try:
+            res = self._attempt(pre, body, rid, op="migrate_export")
+        except Exception as e:
+            kind, _, _, got = self._classify(e, True)
+            self.log.append(("failover", rid, pre.name,
+                             f"export_{kind}"))
+            exclude.add(pre.name)
+            if got and emitted_sink is not None:
+                emitted_sink.extend(int(t) for t in got)
+            return None
+        gen0 = [int(t) for t in res.get("generated") or []]
+        if res.get("completed") or res.get("payload") is None:
+            # the stream finished on the prefill replica (EOS inside
+            # the budget, or the export declined and it served the
+            # request whole): nothing left to migrate
+            resp = {k: v for k, v in res.items()
+                    if k in ("ttft_ms", "id")}
+            resp["generated"] = gen0
+            return pre, resp, 1
+        mig = res["payload"]
+        dec, resp, n = self._import_stream(
+            mig, rid, prompt, set(exclude) | {pre.name},
+            payload.get("timeout_s"))
+        if resp is not None:
+            self._m_migrations.inc()
+            self.log.append(("migrate", rid, pre.name, dec.name,
+                             resp.get("migrated_blocks")))
+            self.tracer.instant(
+                "route.migrated", cat="router", req=rid,
+                source=pre.name, dest=dec.name,
+                blocks=resp.get("migrated_blocks"))
+            return dec, resp, 1 + n
+        # every decode replica refused the payload; the source stream
+        # is already terminated, so salvage the prefill tokens — a
+        # greedy stream resumes from them on the normal path, a
+        # seeded one restarts from scratch (identical either way)
+        if gen0 and emitted_sink is not None:
+            emitted_sink.extend(gen0)
+        exclude.add(pre.name)
+        return None
+
+    def _warm_prefix(self, chosen, prompt, rid):
+        """Cross-replica prefix warming: on an affinity MISS, pull
+        the affinity target's cached prefix blocks for this prompt
+        into the replica about to serve it — its chunked prefill then
+        skips the warmed span.  Best-effort by design: any failure
+        just means a cold prefill."""
+        reps = self._reps()
+        target = self._affinity_target(
+            affinity_key(prompt, self.block_size()), reps)
+        if target is None or target is chosen \
+                or target.state not in (HEALTHY, DEGRADED):
+            return
+        try:
+            res = target.client.migrate_export(
+                {"prefix_only": True,
+                 "tokens": [int(t) for t in prompt]})
+            payload = res.get("payload")
+            if not payload or not payload.get("kv"):
+                return
+            got = chosen.client.migrate_import(payload)
+            self.log.append(("warm", rid, target.name, chosen.name,
+                             got.get("blocks")))
+            self.tracer.instant(
+                "route.prefix_warmed", cat="router", req=rid,
+                source=target.name, dest=chosen.name,
+                blocks=got.get("blocks"))
+        except Exception:
+            pass
+
+    def rebalance(self, source, request_id=None, min_tokens=1,
+                  timeout=10.0):
+        """Preempt-and-migrate: export one LIVE stream off ``source``
+        (the engine picks its lowest-priority victim when
+        ``request_id`` is None), delivering the payload through the
+        victim's own blocked waiter — the router thread serving that
+        stream catches ``StreamMigrated`` and re-lands it on a peer,
+        so the stream moves without ever being double-served.
+        In-process transports only: an HTTP replica's waiter is its
+        remote client, which the router cannot hand a payload to.
+        Returns the export verdict dict."""
+        with self._lock:
+            rep = self._replicas.get(str(source))
+        if rep is None:
+            raise KeyError(f"no replica {source!r}")
+        body = {"request_id": request_id, "deliver": "error",
+                "min_tokens": int(min_tokens),
+                "timeout_s": float(timeout)}
+        res = rep.client.migrate_export(body)
+        self.log.append(("rebalance", source,
+                         bool(res.get("completed")),
+                         len(res.get("generated") or [])))
+        self.tracer.instant("route.rebalance", cat="router",
+                            replica=source,
+                            completed=bool(res.get("completed")))
+        return res
+
     def generate(self, prompt, max_new_tokens=16, eos_token_id=None,
                  temperature=1.0, top_k=0, top_p=1.0, seed=None,
                  priority=0, tenant=None, timeout=None):
@@ -930,6 +1191,24 @@ class Router:
                 "tenant": tenant,
                 "timeout_s": attempt_timeout,
             }
+            if self.policy.disaggregate \
+                    and self._disagg_split(exclude):
+                out = self._disagg_attempt(
+                    payload, rid, prompt, exclude,
+                    emitted if greedy else None)
+                if out is not None:
+                    served_by, resp, n = out
+                    return self._serve(rid, prompt, emitted,
+                                       resp.get("generated", []),
+                                       served_by, attempt + n - 1,
+                                       t0, resp)
+                # the disaggregated attempt burned out (exclude and
+                # any greedy salvage were updated in place): next
+                # turn retries — another split if one is still
+                # routable, the normal path otherwise
+                self._m_retries.inc()
+                attempt += 1
+                continue
             try:
                 with self.tracer.span("route.pick", cat="router",
                                       req=rid, attempt=attempt) as sp:
@@ -947,6 +1226,9 @@ class Router:
                 if how == "affinity":
                     self._m_affinity.inc()
                 self.log.append(("pick", rid, rep.name, how, attempt))
+                if self.policy.prefix_warm and how != "affinity" \
+                        and not emitted:
+                    self._warm_prefix(rep, prompt, rid)
                 use_hedge = (self.policy.hedge and idempotent
                              and attempt == 0)
                 hedged = False
@@ -959,6 +1241,42 @@ class Router:
             except NoReplicasAvailable:
                 self._m_failed.inc()
                 raise
+            except StreamMigrated as e:
+                # a rebalance kicked this stream off its replica mid-
+                # decode: the payload IS the stream (KV blocks +
+                # resume snapshot) — land it on a peer and the SAME
+                # logical request continues there, exactly once
+                self.log.append(("migrate_out", rid, rep.name,
+                                 len(e.emitted)))
+                dest, resp, n = None, None, 0
+                if e.payload is not None:
+                    dest, resp, n = self._import_stream(
+                        e.payload, rid, prompt,
+                        exclude | {rep.name}, attempt_timeout)
+                if resp is not None:
+                    self._m_migrations.inc()
+                    self.log.append(
+                        ("migrate", rid, rep.name, dest.name,
+                         resp.get("migrated_blocks")))
+                    self.tracer.instant(
+                        "route.migrated", cat="router", req=rid,
+                        source=rep.name, dest=dest.name,
+                        blocks=resp.get("migrated_blocks"))
+                    return self._serve(rid, prompt, emitted,
+                                       resp.get("generated", []),
+                                       dest, attempt + n, t0, resp)
+                # nobody took the payload; the source stream is
+                # already terminated, so salvage what it had emitted
+                # and fail over like a disconnect (greedy resumes,
+                # seeded restarts — token-identical either way)
+                if greedy and e.emitted:
+                    emitted.extend(e.emitted)
+                self.log.append(("failover", rid, rep.name,
+                                 "migrate_lost"))
+                self._m_retries.inc()
+                exclude.add(rep.name)
+                attempt += 1
+                continue
             except Exception as e:
                 last_exc = e
                 kind, retryable, hint, got = self._classify(
@@ -1070,12 +1388,16 @@ class InProcessReplica:
     """
 
     def __init__(self, name, engine, faults=None,
-                 disconnect_after=2, poll_s=0.002):
+                 disconnect_after=2, poll_s=0.002, role="mixed"):
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(f"unknown replica role {role!r}")
         self.name = str(name)
         self.engine = engine
         self.faults = faults
         self.disconnect_after = int(disconnect_after)
         self.poll_s = float(poll_s)
+        self.role = role     # advertised in probes; the router's
+        #   disaggregated pick() is what makes it binding
         self.killed = False
         self._ops = itertools.count()
         self._probe_ops = itertools.count()
@@ -1120,6 +1442,7 @@ class InProcessReplica:
             "draining": bool(getattr(eng, "_draining", False)),
             "watchdog_fired": bool(getattr(eng, "_watchdog_fired",
                                            False)),
+            "role": self.role,
         }
 
     def generate(self, payload, should_abort=None):
@@ -1175,6 +1498,16 @@ class InProcessReplica:
                     f"{budget}s attempt budget (op {t})")
             req._done.wait(self.poll_s)
         if req.error is not None:
+            from .engine import Migrated  # lazy: HTTP-only routers
+            #   never import the (jax-heavy) engine module
+            if isinstance(req.error, Migrated):
+                # the stream was MIGRATED out from under this waiter
+                # (a rebalance): hand the payload up — the router
+                # re-lands it and the same logical request continues
+                raise StreamMigrated(
+                    f"replica {self.name} migrated the stream out "
+                    f"(op {t})", payload=req.error.payload,
+                    emitted=req.error.emitted)
             # an engine-side death mid-request IS the failover case:
             # deliver the salvageable prefix as a disconnect
             raise NetDisconnect(
@@ -1193,6 +1526,208 @@ class InProcessReplica:
             "id": req.id,
             "ids": [int(x) for x in payload["prompt"]] + gen,
             "generated": gen, "ttft_ms": ttft,
+        }
+
+    def _wait_out(self, req, t, budget, should_abort):
+        """Block until ``req`` completes (the shared tail of generate
+        / migrate flows): abort, per-attempt budget, and engine-side
+        error mapping all behave exactly like ``generate()``."""
+        deadline = (None if budget is None
+                    else time.monotonic() + float(budget))
+        while not req.done():
+            if should_abort is not None and should_abort():
+                raise NetDisconnect(
+                    f"replica {self.name} died mid-stream (op {t})",
+                    emitted=list(req.generated))
+            if deadline is not None and time.monotonic() > deadline:
+                raise NetTimeout(
+                    f"replica {self.name} exceeded the "
+                    f"{budget}s attempt budget (op {t})")
+            req._done.wait(self.poll_s)
+        if req.error is not None:
+            raise NetDisconnect(
+                f"replica {self.name} failed the request: "
+                f"{req.error} (op {t})", emitted=list(req.generated))
+        return [int(x) for x in req.generated]
+
+    def migrate_export(self, body, should_abort=None):
+        """KV block export (the in-process `/migrate/export`).  Three
+        shapes: ``prefix_only`` exports the trie's cached blocks for
+        a token span; ``deliver=error`` preempts a live stream and
+        hands the payload to its own waiter (the rebalance path —
+        this transport returns no payload); otherwise submit-then-
+        export: run the prompt to ``min_tokens`` and export the warm
+        stream (the disaggregated prefill leg).  The ``migrate_wire``
+        fault site fires AFTER a successful export, on this replica's
+        operation counter — the payload vanishes in flight with the
+        source stream already terminated, the worst-case loss the
+        chaos tests replay."""
+        t = next(self._ops)
+        if self.killed:
+            raise NetRefused(f"replica {self.name} is down (op {t})")
+        self._maybe("net_refuse", t)
+        self._maybe("net_blackhole", t, abort=should_abort)
+        self._maybe("net_slow", t)
+        eng = self.engine
+        budget = body.get("timeout_s")
+        timeout = 30.0 if budget is None else float(budget)
+        if body.get("prefix_only"):
+            try:
+                payload = eng.export_prefix(body.get("tokens") or [],
+                                            timeout=timeout)
+            except Exception as e:
+                raise ReplicaUnavailable(
+                    f"replica {self.name} declined the prefix "
+                    f"export: {e} (op {t})",
+                    reason="migrate_declined") from e
+            self._maybe("migrate_wire", t, emitted=[])
+            return {"completed": False, "generated": [],
+                    "payload": payload}
+        if body.get("deliver") == "error":
+            # rebalance: the payload rides the victim's Migrated
+            # error to its waiter, never over this return path
+            try:
+                res = eng.migrate_out(
+                    request_id=body.get("request_id"),
+                    min_tokens=int(body.get("min_tokens", 1)),
+                    deliver="error", timeout=timeout)
+            except KeyError as e:
+                raise ReplicaHTTPError(
+                    f"replica {self.name}: {e} (op {t})", 404,
+                    reason="not_found") from e
+            except TimeoutError as e:
+                raise NetTimeout(
+                    f"replica {self.name} export timed out "
+                    f"(op {t})") from e
+            except Exception as e:
+                raise ReplicaUnavailable(
+                    f"replica {self.name} declined the export: {e} "
+                    f"(op {t})", reason="migrate_declined") from e
+            return {"completed": bool(res["completed"]),
+                    "generated": [int(x) for x in res["generated"]],
+                    "payload": None}
+        req = None
+        if body.get("request_id") is None:
+            try:
+                req = eng.submit(
+                    body["prompt"],
+                    max_new_tokens=body.get("max_new_tokens", 16),
+                    eos_token_id=body.get("eos_token_id"),
+                    temperature=body.get("temperature", 1.0),
+                    top_k=body.get("top_k", 0),
+                    top_p=body.get("top_p", 1.0),
+                    seed=body.get("seed"),
+                    priority=body.get("priority", 0),
+                    tenant=body.get("tenant"))
+            except Rejected as e:
+                raise ReplicaUnavailable(
+                    str(e), status=503,
+                    retry_after=getattr(e, "retry_after", None),
+                    reason=type(e).__name__) from e
+            except (TypeError, ValueError) as e:
+                raise ReplicaHTTPError(
+                    f"replica {self.name} rejected the request: {e}",
+                    400, reason="bad_request") from e
+            rid = req.id
+        else:
+            rid = body["request_id"]
+        try:
+            res = eng.migrate_out(
+                request_id=rid,
+                min_tokens=int(body.get("min_tokens", 1)),
+                deliver="return", timeout=timeout)
+        except KeyError as e:
+            raise ReplicaHTTPError(
+                f"replica {self.name} has no request {rid!r} "
+                f"(op {t})", 404, reason="not_found") from e
+        except TimeoutError as e:
+            raise NetTimeout(
+                f"replica {self.name} export timed out (op {t})") \
+                from e
+        except Exception as e:
+            if req is None:
+                raise ReplicaUnavailable(
+                    f"replica {self.name} declined the export: {e} "
+                    f"(op {t})", reason="migrate_declined") from e
+            # the engine declined the export of OUR OWN submission
+            # (e.g. an injected migrate_export fault): the stream
+            # stays on the source — serve it whole right here
+            gen = self._wait_out(req, t, budget, should_abort)
+            self.served.append(t)
+            return {"completed": True, "generated": gen,
+                    "payload": None}
+        gen = [int(x) for x in res["generated"]]
+        # the wire crossing: the source stream is ALREADY terminated
+        # when this fires, so the payload is genuinely lost in flight
+        self._maybe("migrate_wire", t, emitted=gen)
+        if res["completed"]:
+            self.served.append(t)
+        return {"completed": bool(res["completed"]),
+                "generated": gen, "payload": res["payload"]}
+
+    def migrate_import(self, body, should_abort=None):
+        """KV block import (the in-process `/migrate/import`): adopt
+        the payload's blocks, resume the stream, and block until it
+        completes — the response is ``generate()``-shaped plus
+        ``migrated_blocks``.  A body with no ``request`` is a prefix
+        warm (adopt into the trie, nothing to resume).  The
+        ``migrate_wire`` site here fires BEFORE the engine sees the
+        payload: the caller still holds it and re-imports elsewhere."""
+        t = next(self._ops)
+        if self.killed:
+            raise NetRefused(f"replica {self.name} is down (op {t})")
+        self._maybe("net_refuse", t)
+        self._maybe("net_blackhole", t, abort=should_abort)
+        self._maybe("net_slow", t)
+        self._maybe("migrate_wire", t)
+        eng = self.engine
+        budget = body.get("timeout_s")
+        timeout = 30.0 if budget is None else float(budget)
+        if body.get("request") is None:
+            try:
+                res = eng.import_prefix(body, timeout=timeout)
+            except Exception as e:
+                raise ReplicaUnavailable(
+                    f"replica {self.name} declined the prefix "
+                    f"import: {e} (op {t})",
+                    reason="migrate_failed") from e
+            return dict(res)
+        try:
+            res = eng.migrate_in(body, timeout=timeout)
+        except Rejected as e:
+            raise ReplicaUnavailable(
+                str(e), status=503,
+                retry_after=getattr(e, "retry_after", None),
+                reason=type(e).__name__) from e
+        except (TypeError, ValueError) as e:
+            # a geometry/shape mismatch is NON-retryable against any
+            # identically-configured replica — surface it as a 400
+            raise ReplicaHTTPError(
+                f"replica {self.name} rejected the payload: {e} "
+                f"(op {t})", 400, reason="bad_request") from e
+        except TimeoutError as e:
+            raise NetTimeout(
+                f"replica {self.name} import timed out (op {t})") \
+                from e
+        except Exception as e:
+            # injected migrate_import fault and friends: the engine
+            # ADOPTED NOTHING (blocks rolled back to refcount 0), so
+            # the caller's payload is safe to retry elsewhere
+            raise ReplicaUnavailable(
+                f"replica {self.name} failed the import: {e} "
+                f"(op {t})", reason="migrate_failed") from e
+        req = res["request"]
+        gen = self._wait_out(req, t, budget, should_abort)
+        self.served.append(t)
+        ttft = None
+        if req.first_token_at is not None:
+            ttft = round((req.first_token_at - req.submitted_at)
+                         * 1e3, 3)
+        rq = body.get("request") or {}
+        prompt = [int(x) for x in rq.get("prompt") or []]
+        return {
+            "id": req.id, "ids": prompt + gen, "generated": gen,
+            "ttft_ms": ttft, "migrated_blocks": res["blocks"],
         }
 
 
@@ -1291,16 +1826,20 @@ class HttpReplicaClient:
                 f"{what} {self.address}: connection reset")
         return e
 
-    def generate(self, payload, should_abort=None):
+    def _post(self, path, payload, what=None):
+        """POST one JSON body and map every transport failure into
+        the router's classified vocabulary (the shared tail of
+        ``generate`` / ``migrate_export`` / ``migrate_import``)."""
         import http.client
         import json
         import urllib.error
         import urllib.request
+        what = what or path.strip("/")
         body = {k: v for k, v in payload.items() if k != "timeout_s"}
         timeout = float(payload.get("timeout_s") or self.timeout_s)
         data = json.dumps(body).encode()
         req = urllib.request.Request(
-            self.address + "/generate", data=data,
+            self.address + path, data=data,
             headers={"Content-Type": "application/json"})
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -1319,11 +1858,26 @@ class HttpReplicaClient:
                 reason=bodyj.get("reason")) from e
         except http.client.IncompleteRead as e:
             raise NetDisconnect(
-                f"generate {self.address}: response truncated "
+                f"{what} {self.address}: response truncated "
                 "mid-body") from e
         except (json.JSONDecodeError, ValueError) as e:
             raise NetDisconnect(
-                f"generate {self.address}: unparseable partial "
+                f"{what} {self.address}: unparseable partial "
                 f"response ({e})") from e
         except Exception as e:
-            raise self._map_net(e, "generate") from e
+            raise self._map_net(e, what) from e
+
+    def generate(self, payload, should_abort=None):
+        return self._post("/generate", payload)
+
+    def migrate_export(self, payload, should_abort=None):
+        """POST /migrate/export — the returned ``payload`` (when one
+        exists) is wire-form (``data_b64``), which the importing
+        engine decodes itself; it round-trips straight into
+        ``migrate_import`` unchanged."""
+        return self._post("/migrate/export", payload,
+                          what="migrate_export")
+
+    def migrate_import(self, payload, should_abort=None):
+        return self._post("/migrate/import", payload,
+                          what="migrate_import")

@@ -12,6 +12,11 @@ replica fleet) the same way engine handlers block on
                     ``no_replicas`` / 502 ``request_failed`` (the
                     classified replica cause is included), 400
                     ``bad_request``.
+  POST /rebalance   operator preempt-and-migrate: body
+                    ``{"source": NAME, "request_id"?, "min_tokens"?}``
+                    exports one live stream off the named replica;
+                    the router re-lands it on a peer (in-process
+                    replica fleets — see ``Router.rebalance``).
   GET  /healthz     router liveness + the replica table summary
                     (counts by health state, breaker states)
   GET  /livez       200 while the process serves
@@ -65,6 +70,15 @@ class _Handler(JsonHandler):
             by_state[r["state"]] = by_state.get(r["state"], 0) + 1
         return rows, by_state
 
+    def _rebalance(self, body):
+        source = body.get("source")
+        if not source:
+            raise ValueError("source (a replica name) is required")
+        return self.router.rebalance(
+            source, request_id=body.get("request_id"),
+            min_tokens=int(body.get("min_tokens", 1)),
+            timeout=float(body.get("timeout", 10.0)))
+
     def do_GET(self):
         rt = self.router
         if self.path == "/metrics":
@@ -73,11 +87,15 @@ class _Handler(JsonHandler):
                              "charset=utf-8")
         elif self.path == "/healthz":
             rows, by_state = self._replica_summary()
+            by_role = {}
+            for r in rows:
+                by_role[r["role"]] = by_role.get(r["role"], 0) + 1
             self._send_json(200, {
                 "status": "ok", "live": True,
                 "ready": any(r["state"] in _ROUTABLE for r in rows),
                 "replicas_total": len(rows),
                 "replicas_by_state": by_state,
+                "replicas_by_role": by_role,
                 "breakers_open": sum(
                     1 for r in rows if r["breaker"] != "closed"),
             })
@@ -105,6 +123,26 @@ class _Handler(JsonHandler):
                                   "reason": "not_found"})
 
     def do_POST(self):
+        if self.path == "/rebalance":
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                out = self._rebalance(body)
+            except KeyError as e:
+                self._send_json(404, {"error": str(e),
+                                      "reason": "not_found"})
+            except (TypeError, ValueError,
+                    json.JSONDecodeError) as e:
+                self._send_json(400, {"error": f"bad request: {e}",
+                                      "reason": "bad_request"})
+            except Exception as e:
+                self._send_json(503, {"error": str(e),
+                                      "reason": "migrate_declined"})
+            else:
+                self._send_json(200, {
+                    "completed": bool(out.get("completed")),
+                    "generated": len(out.get("generated") or [])})
+            return
         if self.path != "/generate":
             self._send_json(404, {"error": f"no route {self.path}",
                                   "reason": "not_found"})
@@ -219,12 +257,22 @@ def main(argv=None):
     p.add_argument("--hedge", action="store_true",
                    help="enable tail-latency hedging for idempotent "
                         "requests")
+    p.add_argument("--disaggregate", action="store_true",
+                   help="prefill/decode disaggregation: prefill on a "
+                        "prefill-role replica, migrate the KV blocks, "
+                        "decode on a decode-role replica")
+    p.add_argument("--prefix-warm", action="store_true",
+                   help="on an affinity miss, warm the chosen "
+                        "replica's prefix cache from the affinity "
+                        "target before dispatching")
     args = p.parse_args(argv)
     if not args.replica:
         p.error("at least one --replica is required")
     policy = RouterPolicy(probe_interval_s=args.probe_interval,
                           affinity=not args.no_affinity,
-                          hedge=args.hedge)
+                          hedge=args.hedge,
+                          disaggregate=args.disaggregate,
+                          prefix_warm=args.prefix_warm)
     router = Router(policy=policy)
     for spec in args.replica:
         name, url = parse_replica_spec(spec)

@@ -130,6 +130,17 @@ class Scheduler:
                                 key=lambda s: s.seq)
         return len(busy), decoding, prefilling
 
+    def find(self, request_id):
+        """The slot a request is bound to, or None (queued / unknown /
+        finished).  One locked scan — the migration service point
+        re-resolves its target after every ring drain, since a drain
+        can finish or evict any slot."""
+        with self._lock:
+            for s in self.slots:
+                if s.request is not None and s.request.id == request_id:
+                    return s
+        return None
+
     def idle(self):
         return self.occupancy() == 0 and self.queue.depth() == 0
 

@@ -25,6 +25,7 @@ SCRIPTS = [
     "serving_decode.py",
     "serving_engine.py",
     "serving_router.py",
+    "serving_disaggregated.py",
     "serving_sharded.py",
     "geo_async_ps.py",
     "onnx_export.py",

@@ -20,7 +20,8 @@ from paddle_tpu.models import GPTModel
 from paddle_tpu.serving import (Engine, FaultInjector, InjectedFault,
                                 NoFreeBlocks, PromptLookupProposer,
                                 WatchdogTimeout)
-from paddle_tpu.serving.faults import SITES
+from paddle_tpu.serving.engine import Migrated
+from paddle_tpu.serving.faults import SITES, NetDisconnect
 
 
 @pytest.fixture(scope="module")
@@ -319,3 +320,164 @@ def test_chaos_storm_long(tiny_gpt):
                 max_new_tokens=mn).numpy()[0].tolist()
     for seed in (21, 22, 23):
         _storm(tiny_gpt, seed=seed, ticks=150, refs=refs)
+
+
+# ---------------------------------------------------------------------------
+# mid-migration chaos: kill the handoff at any of its three stages
+# ---------------------------------------------------------------------------
+
+def _await_demand(eng, demand, limit=200):
+    """Step the engine until a wait=False migration demand resolves.
+    Returns (verdict, None) or (None, error)."""
+    for _ in range(limit):
+        eng.step()
+        try:
+            return demand.wait(0), None
+        except TimeoutError:
+            continue
+        except InjectedFault as e:
+            return None, e
+    raise AssertionError("migration demand never resolved")
+
+
+def _migration_storm(model, seed, refs, ops=(0, 1, 2, 3, 4, 5, 0, 2)):
+    """One seeded storm over a source/destination engine pair: every
+    op starts a stream on the source, exports it mid-decode, and
+    three independent seeded injectors may kill the handoff at any
+    stage — export (source declines, stream keeps running there),
+    wire (payload lost in flight, holder resumes from the emitted
+    prefix), import (destination adopts nothing, the SAME payload
+    retries at a later tick).  Asserts per op that EXACTLY ONE full
+    stream comes out token-identical to the oracle, and at the end
+    that both pools sit at refcount 0 — then returns the
+    reproducibility signature (three fault logs + outcomes)."""
+    inj_src = FaultInjector(seed=seed, rates={"migrate_export": 0.25})
+    inj_dst = FaultInjector(seed=seed + 1,
+                            rates={"migrate_import": 0.25})
+    # the wire injector is driven HERE (the test is the transport),
+    # with the op index as its tick — same purity contract
+    wire = FaultInjector(seed=seed + 2, rates={"migrate_wire": 0.35})
+    src = Engine(model, num_slots=2, max_seq_len=64, kv_block_size=8,
+                 registry=monitor.StatRegistry(), faults=inj_src)
+    dst = Engine(model, num_slots=2, max_seq_len=64, kv_block_size=8,
+                 registry=monitor.StatRegistry(), faults=inj_dst)
+    prompts = _prompts()
+    MAX_NEW = 8
+    outcomes = []
+    for i, pi in enumerate(ops):
+        p = prompts[pi]
+        r = src.submit(p, max_new_tokens=MAX_NEW)
+        for _ in range(400):
+            if len(r.generated) >= 3 or r.done():
+                break
+            src.step()
+        d = src.migrate_out(request_id=r.id, min_tokens=3,
+                            deliver="return", wait=False)
+        verdict, err = _await_demand(src, d)
+        if err is not None:
+            # export killed: declined, the stream NEVER left the
+            # source — it decodes to completion right here
+            for _ in range(400):
+                if r.done():
+                    break
+                src.step()
+            assert r.error is None, r.error
+            assert r.result(timeout=0).tolist() == refs[pi]
+            outcomes.append(("declined", pi))
+            continue
+        if verdict["completed"]:
+            assert r.error is None
+            assert r.result(timeout=0).tolist() == refs[pi]
+            outcomes.append(("completed", pi))
+            continue
+        # the stream is terminal on the source; the payload is ours
+        assert isinstance(r.error, Migrated)
+        payload = verdict["payload"]
+        emitted = [int(t) for t in verdict["generated"]]
+        if wire.scheduled("migrate_wire", i):
+            with pytest.raises(NetDisconnect):
+                wire.fire("migrate_wire", i, emitted=emitted)
+            # payload lost in flight — but the holder still has the
+            # emitted prefix, so the stream RESUMES (greedy) on the
+            # destination from prompt + emitted, never duplicated
+            r2 = dst.submit(list(map(int, p)) + emitted,
+                            max_new_tokens=MAX_NEW - len(emitted))
+            for _ in range(400):
+                if r2.done():
+                    break
+                dst.step()
+            assert r2.error is None, r2.error
+            assert r2.result(timeout=0).tolist() == refs[pi]
+            outcomes.append(("wire_lost", pi, len(emitted)))
+            continue
+        adopted = None
+        tries = 0
+        for _ in range(4):
+            tries += 1
+            got, ierr = _await_demand(
+                dst, dst.migrate_in(payload, wait=False))
+            if got is not None:
+                adopted = got
+                break
+            # import killed: the destination adopted NOTHING — the
+            # identical payload is safe to replay at a later tick
+        if adopted is None:
+            r2 = dst.submit(list(map(int, p)) + emitted,
+                            max_new_tokens=MAX_NEW - len(emitted))
+            for _ in range(400):
+                if r2.done():
+                    break
+                dst.step()
+            assert r2.result(timeout=0).tolist() == refs[pi]
+            outcomes.append(("import_gave_up", pi, tries))
+            continue
+        r2 = adopted["request"]
+        for _ in range(400):
+            if r2.done():
+                break
+            dst.step()
+        assert r2.error is None, r2.error
+        assert r2.result(timeout=0).tolist() == refs[pi]
+        outcomes.append(("migrated", pi, adopted["blocks"], tries))
+    # -- end invariants: both replicas drained, both pools at 0 -------
+    for eng in (src, dst):
+        for _ in range(400):
+            if eng.scheduler.idle():
+                break
+            eng.step()
+        assert eng.scheduler.idle()
+        assert not eng._ring
+        eng.prefix_cache.clear()
+        assert eng.block_pool.in_use() == 0, \
+            "mid-migration chaos leaked KV blocks"
+    assert len(outcomes) == len(ops)  # exactly one verdict per stream
+    return (tuple(inj_src.log), tuple(inj_dst.log), tuple(wire.log),
+            tuple(outcomes))
+
+
+@pytest.mark.chaos
+@pytest.mark.migration
+def test_migration_chaos_storm_deterministic(tiny_gpt):
+    """Seeded mid-migration kill storm: under injected deaths at
+    export, wire, and import, every stream completes EXACTLY once
+    token-identical to its oracle, both pools end at refcount 0, and
+    the same seed replays the same fault/migration log while a
+    different seed diverges."""
+    prompts = _prompts()
+    refs = {pi: tiny_gpt.generate(
+        paddle.to_tensor(prompts[pi][None, :]),
+        max_new_tokens=8).numpy()[0].tolist()
+        for pi in range(len(prompts))}
+    a = _migration_storm(tiny_gpt, seed=5, refs=refs)
+    b = _migration_storm(tiny_gpt, seed=5, refs=refs)
+    c = _migration_storm(tiny_gpt, seed=6, refs=refs)
+    assert a == b, "same seed, different fault/migration history"
+    assert a != c, "different seed, same fault/migration history"
+    # the two seeds together must exercise every migration stage, or
+    # the storm proves nothing
+    fired = {site for sig in (a, c) for log in sig[:3]
+             for (_, site) in log}
+    assert fired == {"migrate_export", "migrate_wire",
+                     "migrate_import"}, fired
+    kinds = {o[0] for sig in (a, c) for o in sig[3]}
+    assert "migrated" in kinds and "declined" in kinds, kinds

@@ -82,7 +82,8 @@ def wall_summary(events):
     (work hidden behind device compute), not an accounting bug."""
     wall = phase = overlap = d2h_wait = ragged = 0.0
     allgather = shard_sync = 0.0
-    n_ticks = n_ragged = n_allgather = 0
+    mig_export = mig_wire = mig_import = 0.0
+    n_ticks = n_ragged = n_allgather = n_migrations = 0
     for ev in events:
         if ev.get("ph") != "X":
             continue
@@ -97,6 +98,19 @@ def wall_summary(events):
                 overlap += dur
             elif name == "decode.d2h_wait":
                 d2h_wait += dur
+            elif name == "migrate.export":
+                # KV block migration legs, broken out per side:
+                # export = device->host gather on the source,
+                # wire = payload encode/decode in transit,
+                # import = host->device scatter + trie adoption on
+                # the destination — together, the stream's total
+                # off-accelerator time during a migration
+                mig_export += dur
+                n_migrations += 1
+            elif name == "migrate.wire":
+                mig_wire += dur
+            elif name == "migrate.import":
+                mig_import += dur
             elif name == "decode.ragged":
                 # Pallas ragged-paged-attention dispatches
                 # (Engine(attn_impl="ragged")) — broken out so a
@@ -123,6 +137,10 @@ def wall_summary(events):
         "ragged_ms": ragged, "ragged_dispatches": n_ragged,
         "allgather_ms": allgather, "allgather_waits": n_allgather,
         "shard_sync_ms": shard_sync,
+        "migrations": n_migrations,
+        "migrate_export_ms": mig_export,
+        "migrate_wire_ms": mig_wire,
+        "migrate_import_ms": mig_import,
     }
 
 
@@ -146,6 +164,14 @@ def format_wall(w):
             f"{w['allgather_waits']} sharded ticks   shard.sync "
             f"{w['shard_sync_ms']:.3f} ms (mesh-sharded engine: "
             "cross-shard collective wait + cursor replication)")
+    if w.get("migrations") or w.get("migrate_import_ms") \
+            or w.get("migrate_wire_ms"):
+        lines.append(
+            f"migrate.export {w['migrate_export_ms']:.3f} ms over "
+            f"{w['migrations']} migration(s)   migrate.wire "
+            f"{w['migrate_wire_ms']:.3f} ms   migrate.import "
+            f"{w['migrate_import_ms']:.3f} ms (KV block migration: "
+            "source gather / payload transit / destination adopt)")
     lines += [
         "(phases exceeding wall = spans ran concurrently — e.g. the "
         "async engine loop's",
