@@ -73,6 +73,9 @@ from .router import (  # noqa: F401
     ReplicaUnavailable, RequestFailed, Router, RouterError,
     RouterPolicy, affinity_key)
 from .routerd import RouterServer  # noqa: F401
+from .supervisor import (  # noqa: F401
+    FleetSupervisor, ProcessReplica, SupervisorPolicy,
+    supervise_fleet)
 
 __all__ = [
     "Request", "RequestQueue", "RequestTimeout", "QueueFull",
@@ -89,4 +92,6 @@ __all__ = [
     "ReplicaAbandoned", "ReplicaHTTPError", "ReplicaUnavailable",
     "CircuitBreaker", "HttpReplicaClient", "InProcessReplica",
     "affinity_key",
+    "FleetSupervisor", "SupervisorPolicy", "ProcessReplica",
+    "supervise_fleet",
 ]

@@ -1581,6 +1581,18 @@ class Engine:
                     self._migrate_demands.remove(d)
             raise
 
+    def live_request_ids(self):
+        """Ids of the requests currently BOUND to slots (prefilling
+        included), in slot order — the SIGTERM drain's worklist: each
+        one is exported to a peer via ``migrate_out(request_id=...)``
+        as soon as it is decoding.  Queued-but-unadmitted requests
+        are deliberately absent: a draining engine admits nothing, so
+        they have emitted nothing and fail over with zero lost work.
+        Thread-safe (``busy_slots`` snapshots under the scheduler
+        lock)."""
+        return [s.request.id for s in self.scheduler.busy_slots()
+                if s.request is not None]
+
     def migrate_out(self, request_id=None, min_tokens=1,
                     deliver="return", wait=True, timeout=30.0):
         """Export a LIVE decoding stream off this engine.  With

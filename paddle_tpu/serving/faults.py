@@ -118,10 +118,59 @@ site                  checked at                        action
                       allocation rolls back to          owns NOTHING
                       refcount 0)
 ====================  ===============================  ==============
+
+Process-level sites (the SUPERVISOR tier's chaos vocabulary —
+checked by the kill-storm driver that owns the replica processes,
+with its own storm step counter as the ``tick``; the supervisor
+itself never consults the schedule, it only observes and heals the
+damage, so supervisor-on and supervisor-off runs of the same seed
+see the IDENTICAL fault sequence):
+
+====================  ===============================  ==============
+site                  checked at                        action
+====================  ===============================  ==============
+``proc_kill9``        storm driver, per storm step      SIGKILL to the
+                      (``fire(..., proc=popen)``)       target replica
+                                                        process — the
+                                                        supervisor
+                                                        sees the exit
+                                                        and restarts
+                                                        it with
+                                                        backoff
+``proc_stop``         storm driver, per storm step      SIGSTOP — a
+                      (``fire(..., proc=popen)``)       WEDGE: the
+                                                        process stays
+                                                        alive but
+                                                        /livez times
+                                                        out; the
+                                                        supervisor
+                                                        declares it
+                                                        wedged after
+                                                        ``wedge_after``
+                                                        failed probes,
+                                                        SIGKILLs, and
+                                                        restarts
+``proc_crashloop``    storm driver, per storm step      calls ``arm()``
+                      (``fire(..., arm=callable)``)     — the driver's
+                                                        hook makes the
+                                                        replica's NEXT
+                                                        boots exit
+                                                        immediately
+                                                        (httpd
+                                                        ``--fail-boot-
+                                                        below``); the
+                                                        supervisor's
+                                                        crash-loop
+                                                        window trips
+                                                        and the
+                                                        replica ends
+                                                        QUARANTINED
+====================  ===============================  ==============
 """
 from __future__ import annotations
 
 import hashlib
+import signal as _signal
 import threading
 import time
 import weakref
@@ -170,7 +219,8 @@ ENGINE_SITES = ("dispatch", "d2h_hang", "pool_exhaust", "host_slow",
 NET_SITES = ("net_refuse", "net_blackhole", "net_slow",
              "net_disconnect")
 MIGRATE_SITES = ("migrate_export", "migrate_wire", "migrate_import")
-SITES = ENGINE_SITES + NET_SITES + MIGRATE_SITES
+PROC_SITES = ("proc_kill9", "proc_stop", "proc_crashloop")
+SITES = ENGINE_SITES + NET_SITES + MIGRATE_SITES + PROC_SITES
 
 
 class FaultInjector:
@@ -242,7 +292,8 @@ class FaultInjector:
             return False
         return self._u01(site, tick) < rate
 
-    def fire(self, site, tick, engine=None, emitted=None, abort=None):
+    def fire(self, site, tick, engine=None, emitted=None, abort=None,
+             proc=None, arm=None):
         """Record the firing and perform the site's action (may raise;
         the record lands FIRST so the log is complete even for raising
         sites).  ``emitted``: the transport's tokens-received-so-far
@@ -250,7 +301,11 @@ class FaultInjector:
         can resume with context.  ``abort``: optional callable polled
         during the cooperative ``net_blackhole`` wait (a router that
         already declared this replica dead need not sit out the full
-        simulated timeout)."""
+        simulated timeout).  ``proc``: the target replica's Popen-like
+        handle for the ``proc_kill9`` / ``proc_stop`` sites (the storm
+        driver owns the processes; without a handle the firing is
+        record-only).  ``arm``: the storm driver's make-the-next-boots-
+        fail hook for ``proc_crashloop``."""
         self.log.append((tick, site))
         if site == "dispatch":
             raise InjectedFault(
@@ -311,6 +366,31 @@ class FaultInjector:
             raise InjectedFault(
                 f"injected import failure at tick {tick}: the "
                 "destination adopted nothing")
+        if site == "proc_kill9":
+            # hard process death: the supervisor sees the exit on its
+            # next sweep and restarts with backoff
+            if proc is not None:
+                try:
+                    proc.send_signal(_signal.SIGKILL)
+                except (ProcessLookupError, OSError):
+                    pass  # already dead: the record still stands
+            return
+        if site == "proc_stop":
+            # SIGSTOP wedge: the process stays alive (poll() is None)
+            # but stops answering — only /livez timeouts reveal it
+            if proc is not None:
+                try:
+                    proc.send_signal(_signal.SIGSTOP)
+                except (ProcessLookupError, OSError):
+                    pass
+            return
+        if site == "proc_crashloop":
+            # exit-on-boot: the driver's hook arms the replica's next
+            # restarts to fail immediately (httpd --fail-boot-below),
+            # driving the supervisor's crash-loop quarantine
+            if arm is not None:
+                arm()
+            return
 
 
 

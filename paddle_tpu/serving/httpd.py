@@ -72,6 +72,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import monitor
@@ -175,6 +176,9 @@ class JsonHandler(BaseHTTPRequestHandler):
 class _Handler(JsonHandler):
     engine = None          # bound per-server via the factory below
     result_timeout = 120.0
+    engine_server = None   # owning EngineServer (drain relay; the
+    #   name "server" is taken — BaseHTTPRequestHandler binds it)
+    incarnation = 0        # supervisor restart generation (/healthz)
     role = "mixed"         # disaggregation role advertised on
     #   /healthz: "prefill" / "decode" / "mixed" — purely a routing
     #   signal (every endpoint works on every role; the router's
@@ -297,7 +301,19 @@ class _Handler(JsonHandler):
                 "drain_rate_tps": (None if rate is None
                                    else round(rate, 1)),
                 "draining": bool(getattr(eng, "_draining", False)),
+                # restart generation stamped by the supervisor tier:
+                # the router registry resets a replica's breaker and
+                # health history when this advances, and DISCARDS any
+                # probe carrying a lower value (a stale read from the
+                # dead predecessor on the same URL)
+                "incarnation": int(getattr(self, "incarnation", 0)),
             })
+            srv = getattr(self, "engine_server", None)
+            if srv is not None:
+                info["drain_migrations_total"] = int(
+                    srv._m_drain_migrations.value)
+                info["drain_fallbacks_total"] = int(
+                    srv._m_drain_fallbacks.value)
             if getattr(eng, "_paged", False):
                 info["kv_blocks_cached"] = (
                     eng.prefix_cache.cached_blocks()
@@ -403,6 +419,30 @@ class _Handler(JsonHandler):
                                   "reason": "result_timeout"})
             return
         except (TimeoutError, RuntimeError) as e:
+            srv = getattr(self, "engine_server", None)
+            if srv is not None:
+                # lazy: only engine-ful processes reach this branch
+                from .engine import Migrated
+                if isinstance(e, Migrated):
+                    # a SIGTERM drain exported this stream mid-decode:
+                    # the drain thread is landing it on a peer and
+                    # relays the peer's COMPLETE response back here —
+                    # the client never learns its stream moved hosts
+                    found, resp = srv.await_relay(
+                        req.id, timeout=self.result_timeout)
+                    if found and resp is not None:
+                        out = dict(resp)
+                        out["migrated"] = True
+                        self._send_json(200, out)
+                        return
+                    if found:
+                        # the drain tried and no peer accepted:
+                        # retryable — the router re-dispatches from
+                        # the prompt (greedy resume, token-identical)
+                        self._send_json(
+                            503, {"error": str(e),
+                                  "reason": "drain_failed"})
+                        return
             self._send_json(500, {"error": str(e),
                                   "reason": "internal"})
             return
@@ -574,26 +614,186 @@ class _Handler(JsonHandler):
 
 class EngineServer:
     """Engine tick loop + ThreadingHTTPServer, each on its own daemon
-    thread.  ``with EngineServer(engine) as srv: ... srv.port``."""
+    thread.  ``with EngineServer(engine) as srv: ... srv.port``.
+
+    ``incarnation`` is this process's restart generation, stamped by
+    the supervisor tier (``serving.supervisor``) and advertised on
+    ``/healthz`` — the router registry keys its breaker/health reset
+    on it so a dead process's stale probes never poison its successor.
+    ``peers`` are sibling replica base URLs: on SIGTERM (or an
+    explicit ``drain_to_peers()``), the server flips ``/readyz`` to
+    draining and migrates every live decoding stream to the first
+    healthy peer over the ``/migrate/import`` wire, relaying the
+    peer's completed response back to the stream's still-blocked
+    ``/generate`` waiter — a supervised rolling restart loses zero
+    tokens.  When no peer accepts, the waiter gets a retryable 503
+    ``drain_failed`` and the router's greedy resume covers it."""
 
     def __init__(self, engine, host="127.0.0.1", port=0,
-                 result_timeout=120.0, role="mixed"):
+                 result_timeout=120.0, role="mixed", incarnation=0,
+                 peers=(), drain_grace_s=30.0):
         if role not in ("mixed", "prefill", "decode"):
             raise ValueError(f"role must be 'mixed', 'prefill' or "
                              f"'decode', got {role!r}")
         self.engine = engine
         self.role = role
+        self.incarnation = int(incarnation)
+        self.peers = [str(u).rstrip("/") for u in (peers or ())]
+        self.drain_grace_s = float(drain_grace_s)
+        # drain relay: request id -> the peer's completed /generate
+        # response (None = no peer accepted); the /generate handler
+        # that caught Migrated consumes its entry
+        self._relay = {}
+        self._relay_cv = threading.Condition()
+        self._drain_active = False
+        self._m_drain_migrations = engine.registry.counter(
+            "supervisor.drain_migrations",
+            "live streams migrated to a peer during a SIGTERM drain")
+        self._m_drain_fallbacks = engine.registry.counter(
+            "supervisor.drain_fallbacks",
+            "drain streams no peer accepted (router greedy resume)")
         handler = type("BoundHandler", (_Handler,),
                        {"engine": engine,
                         "result_timeout": float(result_timeout),
-                        "role": role})
+                        "role": role,
+                        "incarnation": self.incarnation})
         self.httpd = ThreadingHTTPServer((host, port), handler)
+        # bound AFTER construction: the handler type must exist before
+        # the server, the server before self is complete (the name
+        # "server" is taken — BaseHTTPRequestHandler binds it to the
+        # ThreadingHTTPServer per request)
+        handler.engine_server = self
         self.host, self.port = self.httpd.server_address[:2]
         self._http_thread = None
 
     @property
     def address(self):
         return f"http://{self.host}:{self.port}"
+
+    # -- SIGTERM drain -------------------------------------------------
+    def _post_relay(self, rid, resp):
+        with self._relay_cv:
+            self._relay[rid] = resp
+            self._relay_cv.notify_all()
+
+    def await_relay(self, rid, timeout=30.0):
+        """Called by a ``/generate`` handler whose request ended in
+        ``Migrated``: wait for the drain thread to finish shipping the
+        stream and return ``(found, resp)``.  ``found`` False means no
+        drain owns this request (a non-drain migration — the caller
+        keeps its legacy 500 path); resp None means the drain tried
+        and no peer accepted."""
+        deadline = time.monotonic() + float(timeout)
+        with self._relay_cv:
+            while rid not in self._relay:
+                if not self._drain_active:
+                    return False, None
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False, None
+                self._relay_cv.wait(min(left, 0.1))
+            resp = self._relay.pop(rid)
+            self._relay_cv.notify_all()   # the drain's consumed-wait
+            return True, resp
+
+    def _peer_ready(self, url, timeout=2.0):
+        import urllib.request
+        try:
+            with urllib.request.urlopen(url + "/readyz",
+                                        timeout=timeout):
+                return True
+        except Exception:
+            return False
+
+    def _post_json(self, url, obj, timeout=60.0):
+        import urllib.request
+        data = json.dumps(obj).encode()
+        req = urllib.request.Request(
+            url, data=data,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    def drain_to_peers(self, peers=None, grace_s=None):
+        """Graceful recycling: flip readiness to draining, export
+        every live decoding stream and land it on a healthy peer via
+        the KV-migration wire, relay each peer's completed response
+        to the stream's blocked ``/generate`` waiter, and return the
+        accounting ``{"migrated", "fallback", "lost_tokens",
+        "peers"}``.  ``lost_tokens`` counts tokens already emitted on
+        streams NO peer accepted (those wait-listed for the router's
+        greedy re-decode) — a drain with healthy peers reports 0.
+        The engine keeps ticking throughout (mid-prefill streams
+        become exportable a few ticks in); whatever is still live at
+        ``grace_s`` falls to the engine's own graceful stop."""
+        eng = self.engine
+        urls = [str(u).rstrip("/") for u in
+                (self.peers if peers is None else peers)]
+        grace = (self.drain_grace_s if grace_s is None
+                 else float(grace_s))
+        with self._relay_cv:
+            self._drain_active = True
+        eng._draining = True      # /readyz -> 503 draining; submit
+        #   sheds; the queue admits nothing more
+        healthy = [u for u in urls if self._peer_ready(u)]
+        migrated = fallback = lost = 0
+        deadline = time.monotonic() + grace
+        try:
+            while time.monotonic() < deadline:
+                live = eng.live_request_ids()
+                if not live:
+                    break
+                rid = live[0]
+                try:
+                    res = eng.migrate_out(
+                        request_id=rid, min_tokens=1,
+                        deliver="return",
+                        timeout=min(5.0, max(
+                            0.1, deadline - time.monotonic())))
+                except TimeoutError:
+                    continue   # not decoding yet — tick on
+                except KeyError:
+                    continue   # finished between snapshot and export
+                except Exception:
+                    continue   # export declined: the stream keeps
+                    #   running and the engine's stop drain lands it
+                if res.get("completed") or res.get("payload") is None:
+                    continue   # finished during export — the waiter
+                    #   already has its complete result
+                gen = [int(t) for t in res.get("generated") or []]
+                resp = None
+                with eng.tracer.span("drain.migrate", cat="serving",
+                                     request=rid, tokens=len(gen)):
+                    wire = payload_to_json(res["payload"])
+                    for u in healthy:
+                        try:
+                            resp = self._post_json(
+                                u + "/migrate/import", wire)
+                            break
+                        except Exception:
+                            continue
+                if resp is not None:
+                    migrated += 1
+                    self._m_drain_migrations.inc()
+                    self._post_relay(rid, resp)
+                else:
+                    fallback += 1
+                    lost += len(gen)
+                    self._m_drain_fallbacks.inc()
+                    self._post_relay(rid, None)
+            # let the blocked waiters consume their relays before the
+            # server goes down (handler threads are daemons: nothing
+            # else waits for them)
+            waited = time.monotonic() + 5.0
+            with self._relay_cv:
+                while self._relay and time.monotonic() < waited:
+                    self._relay_cv.wait(0.1)
+        finally:
+            with self._relay_cv:
+                self._drain_active = False
+                self._relay_cv.notify_all()
+        return {"migrated": migrated, "fallback": fallback,
+                "lost_tokens": lost, "peers": healthy}
 
     def start(self):
         self.engine.start()
@@ -675,7 +875,38 @@ def main(argv=None):
                         "replicas and migrated streams to decode "
                         "replicas (every endpoint still works on "
                         "every role)")
+    p.add_argument("--incarnation", type=int, default=0,
+                   help="restart generation stamped by the "
+                        "supervisor: advertised on /healthz so the "
+                        "router can reset breaker/health state and "
+                        "discard stale probes from the predecessor")
+    p.add_argument("--peer", action="append", default=[],
+                   metavar="URL",
+                   help="sibling replica base URL (repeatable): the "
+                        "SIGTERM drain migrates live streams to the "
+                        "first healthy peer")
+    p.add_argument("--drain-grace", type=float, default=30.0,
+                   help="seconds the SIGTERM drain may spend "
+                        "migrating live streams before exiting")
+    p.add_argument("--fail-boot-below", type=int, default=None,
+                   metavar="N",
+                   help="chaos: exit(23) at boot while incarnation "
+                        "< N — the proc_crashloop fault site; the "
+                        "supervisor's crash-loop breaker quarantines "
+                        "the replica")
     args = p.parse_args(argv)
+
+    if (args.fail_boot_below is not None
+            and args.incarnation < args.fail_boot_below):
+        # the proc_crashloop site: die BEFORE the heavy model imports
+        # so the crash loop is fast enough to trip the supervisor's
+        # window, exactly like a bad binary rollout would
+        import sys
+        print(f"crashloop: incarnation {args.incarnation} < "
+              f"{args.fail_boot_below}, failing boot", flush=True)
+        sys.exit(23)
+
+    import signal as _signal
 
     import paddle_tpu as paddle
     from ..models.gpt import GPTModel
@@ -695,17 +926,36 @@ def main(argv=None):
                     kv_budget_mb=args.kv_budget_mb,
                     prefill_chunk=args.prefill_chunk,
                     spec_k=args.spec_k, mesh=mesh)
+    # graceful recycling: SIGTERM sets a flag the main thread acts on
+    # (the handler itself must stay trivial — it can interrupt a tick)
+    stop_evt = threading.Event()
+    try:
+        _signal.signal(_signal.SIGTERM, lambda s, f: stop_evt.set())
+    except ValueError:
+        pass   # not the main thread (embedded use): no drain hook
     # the port line is the launcher's readiness handshake: printed
     # AFTER the socket is bound, flushed so a pipe reader sees it
     srv = EngineServer(engine, host=args.host, port=args.port,
                        result_timeout=args.result_timeout,
-                       role=args.role).start()
+                       role=args.role, incarnation=args.incarnation,
+                       peers=args.peer,
+                       drain_grace_s=args.drain_grace).start()
     print(f"serving {args.config} mp={args.mp} on {srv.address}",
           flush=True)
     try:
-        srv._http_thread.join()
+        while not stop_evt.wait(0.2):
+            if not srv._http_thread.is_alive():
+                break
     except KeyboardInterrupt:
         pass
+    try:
+        if stop_evt.is_set():
+            acct = srv.drain_to_peers()
+            # the supervisor/bench parse this accounting line from
+            # the replica log: a rolling restart must report 0 lost
+            print("drain: migrated={migrated} fallback={fallback} "
+                  "lost_tokens={lost_tokens}".format(**acct),
+                  flush=True)
     finally:
         srv.close()
 

@@ -388,6 +388,10 @@ class Replica:
         self.signals = {}
         self.probe_failures = 0  # consecutive
         self.last_probe_at = None
+        self.incarnation = None  # supervisor restart generation from
+        #   the last applied probe: a LOWER probe is a stale read from
+        #   a dead predecessor on the same URL and is discarded; a
+        #   HIGHER one resets breaker + health history atomically
         self.inflight = 0        # guarded: handler + hedge threads
         self._inflight_lock = threading.Lock()
 
@@ -418,6 +422,7 @@ class Replica:
             "breaker": self.breaker.state,
             "breaker_trips": self.breaker.trips,
             "probe_failures": self.probe_failures,
+            "incarnation": self.incarnation,
             "inflight": self.inflight,
             "address": getattr(self.client, "address", None),
             "signals": dict(self.signals),
@@ -555,6 +560,50 @@ class Router:
                             replica=name, state=state)
 
     # -- health probing ------------------------------------------------
+    def _probe_is_stale(self, rep, info):
+        """True when a probe body carries a LOWER incarnation than the
+        registry already applied for this replica — a read that left
+        the dead predecessor before it died, arriving after the
+        supervisor already respawned a successor on the same URL.
+        Applying it would poison the successor's state."""
+        inc = info.get("incarnation")
+        if inc is None or rep.incarnation is None:
+            return False
+        if int(inc) >= rep.incarnation:
+            return False
+        self.log.append(("stale_probe", rep.name, int(inc)))
+        self.tracer.instant("router.stale_probe", cat="router",
+                            replica=rep.name, incarnation=int(inc),
+                            current=rep.incarnation)
+        return True
+
+    def _apply_incarnation(self, rep, inc):
+        """Record a probed incarnation.  A replica returning on the
+        same URL as a NEW incarnation gets its circuit breaker and
+        health history reset ATOMICALLY — a fresh CircuitBreaker is
+        swapped in (attribute assignment: atomic under the GIL), so
+        the successor starts CLOSED with zero failures instead of
+        inheriting half-open/open state, while in-flight attempts
+        still hold the predecessor's breaker object and their stale
+        failures land there harmlessly."""
+        if rep.incarnation is not None and inc > rep.incarnation:
+            rep.breaker = CircuitBreaker(
+                threshold=self.policy.breaker_threshold,
+                cooldown_s=self.policy.breaker_cooldown_s,
+                on_transition=lambda st, n=rep.name:
+                    self._breaker_event(n, st))
+            rep.probe_failures = 0
+            self._gauge_breaker(rep.name, CLOSED)
+            self.log.append(("incarnation", rep.name, inc))
+            self.tracer.instant("router.incarnation", cat="router",
+                                replica=rep.name, incarnation=inc)
+        if rep.incarnation != inc:
+            rep.incarnation = inc
+            self.registry.gauge(
+                f"router.replica_incarnation.{rep.name}",
+                "supervisor restart generation from the last applied "
+                "probe").set(inc)
+
     def classify_probe(self, info):
         """Map a ``/healthz``-shaped probe body to a health state —
         the liveness/readiness split made routable: ``draining`` is
@@ -609,7 +658,16 @@ class Router:
                            >= self.policy.dead_after else DEGRADED)
                     if sp is not None and hasattr(sp, "args"):
                         sp.args["error"] = type(info).__name__
+                elif self._probe_is_stale(rep, info):
+                    # a probe stamped with a LOWER incarnation is the
+                    # dead predecessor's last answer arriving late on
+                    # the same URL: discard it WHOLE — state, signals
+                    # and breaker stay the successor's
+                    new = rep.state
                 else:
+                    inc = info.get("incarnation")
+                    if inc is not None:
+                        self._apply_incarnation(rep, int(inc))
                     rep.probe_failures = 0
                     rep.signals.update(
                         {k: info.get(k) for k in
@@ -622,8 +680,10 @@ class Router:
                           # replicas without a second probe protocol
                           "mesh_shape", "mp",
                           # disaggregated fleets advertise each
-                          # replica's serving role the same way
-                          "role")})
+                          # replica's serving role the same way,
+                          # and supervised ones their restart
+                          # generation
+                          "role", "incarnation")})
                     if self._kv_bs is None \
                             and info.get("kv_block_size"):
                         self._kv_bs = int(info["kv_block_size"])
@@ -1399,6 +1459,9 @@ class InProcessReplica:
         self.role = role     # advertised in probes; the router's
         #   disaggregated pick() is what makes it binding
         self.killed = False
+        self.incarnation = 0  # supervisor restart generation: bumped
+        #   by revive(bump_incarnation=True) to model a supervised
+        #   respawn on the same address
         self._ops = itertools.count()
         self._probe_ops = itertools.count()
         self.served = []     # router-delivered op ids (test surface)
@@ -1409,8 +1472,16 @@ class InProcessReplica:
     def kill(self):
         self.killed = True
 
-    def revive(self):
+    def revive(self, bump_incarnation=False):
+        """Bring a killed replica back.  Default models the SAME
+        process answering again (probe-driven breaker recovery walks
+        OPEN -> HALF_OPEN -> trial); ``bump_incarnation=True`` models
+        a supervised RESPAWN — a new process on the old address whose
+        first probe makes the router reset breaker + health history
+        instead of trialing through half-open."""
         self.killed = False
+        if bump_incarnation:
+            self.incarnation += 1
 
     def _maybe(self, site, tick, **kw):
         if self.faults is not None \
@@ -1443,6 +1514,7 @@ class InProcessReplica:
             "watchdog_fired": bool(getattr(eng, "_watchdog_fired",
                                            False)),
             "role": self.role,
+            "incarnation": self.incarnation,
         }
 
     def generate(self, payload, should_abort=None):

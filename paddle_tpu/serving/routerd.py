@@ -23,9 +23,10 @@ replica fleet) the same way engine handlers block on
   GET  /readyz      200 when at least one replica is routable,
                     503 ``no_replicas`` otherwise
   GET  /replicas    full registry view: per-replica state, breaker,
-                    probed load signals, address — the surface
-                    tools/timeline.py uses to pull every replica's
-                    /debug/trace next to the router's own
+                    probed load signals, supervisor incarnation,
+                    address — the surface tools/timeline.py uses to
+                    pull every replica's /debug/trace next to the
+                    router's own
   GET  /metrics     Prometheus exposition of the router's registry
   GET  /debug/trace the router's span ring (route.pick/route.retry/
                     route.hedge/probe) as chrome-trace JSON

@@ -27,6 +27,7 @@ SCRIPTS = [
     "serving_router.py",
     "serving_disaggregated.py",
     "serving_sharded.py",
+    "serving_selfhealing.py",
     "geo_async_ps.py",
     "onnx_export.py",
 ]

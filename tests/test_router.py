@@ -1178,3 +1178,107 @@ def test_routerd_fleet_failover_over_real_sockets(tiny_gpt):
         if not killed_a:
             sa.close()
         sb.close()
+
+
+# ---------------------------------------------------------------------------
+# supervisor incarnations: breaker reset + stale-probe fencing
+# ---------------------------------------------------------------------------
+
+def _fake_engine():
+    """The minimal engine surface InProcessReplica.probe() reads."""
+    import types
+    return types.SimpleNamespace(
+        queue=types.SimpleNamespace(depth=lambda: 0),
+        scheduler=types.SimpleNamespace(free_count=lambda: 4),
+        num_slots=4)
+
+
+def test_incarnation_bump_resets_breaker_and_history():
+    """A replica respawned on the same URL (supervisor bumps the
+    incarnation) must NOT inherit its dead predecessor's breaker: the
+    successor's first probe swaps in a fresh CLOSED breaker and
+    zeroes the health history, instead of walking OPEN -> HALF_OPEN
+    -> trial like a same-process recovery would."""
+    rep_client = InProcessReplica("a", _fake_engine())
+    r = _router({"a": rep_client}, breaker_threshold=2)
+    rep = r._reps()[0]
+    r.probe_once()
+    assert rep.incarnation == 0
+    assert rep.signals["incarnation"] == 0
+    # predecessor dies mid-traffic: breaker trips OPEN, probes fail
+    old_breaker = rep.breaker
+    old_breaker.record_failure()
+    old_breaker.record_failure()
+    assert old_breaker.state == OPEN
+    rep_client.kill()
+    r.probe_once()
+    assert rep.probe_failures == 1
+    # the supervisor respawns it: NEW incarnation on the old address
+    rep_client.revive(bump_incarnation=True)
+    r.probe_once()
+    assert rep.incarnation == 1
+    assert rep.breaker is not old_breaker      # atomic swap
+    assert rep.breaker.state == CLOSED
+    assert rep.probe_failures == 0
+    assert rep.state == HEALTHY
+    assert ("incarnation", "a", 1) in r.log
+    # the reset is visible on every surface: registry view + gauge
+    assert r.replicas()[0]["incarnation"] == 1
+    g = r.registry.gauge("router.replica_incarnation.a", "")
+    assert g.value == 1
+    # a stale failure landing on the OLD breaker object (an in-flight
+    # attempt that started before the respawn) cannot poison the
+    # successor's fresh breaker
+    old_breaker.record_failure()
+    assert rep.breaker.state == CLOSED
+
+
+def test_stale_probe_from_dead_incarnation_is_discarded():
+    """The stale-probe race: a probe that left incarnation 0 before
+    it died can arrive AFTER the registry already applied the
+    successor's (incarnation 1) probe.  The whole stale body must be
+    discarded — state, signals and breaker stay the successor's."""
+    script = {"inc": 1}
+    client = FakeReplica("a", health=lambda: {
+        "queue_depth": 7 if script["inc"] == 0 else 0,
+        "slots_free": 4,
+        "draining": script["inc"] == 0,   # the corpse reported
+        #   draining; applying it would stop routing to the successor
+        "incarnation": script["inc"]})
+    r = _router({"a": client})
+    rep = r._reps()[0]
+    r.probe_once()
+    assert rep.incarnation == 1 and rep.state == HEALTHY
+    # the delayed predecessor probe arrives late
+    script["inc"] = 0
+    out = r.probe_once()
+    assert out["a"] == HEALTHY                # NOT draining
+    assert rep.incarnation == 1
+    assert rep.signals["queue_depth"] == 0    # stale signals dropped
+    assert ("stale_probe", "a", 0) in r.log
+    # same-incarnation probes keep applying normally
+    script["inc"] = 1
+    r.probe_once()
+    assert rep.state == HEALTHY
+
+
+def test_revive_without_bump_keeps_breaker_recovery_path():
+    """Default revive() models the SAME process answering again: the
+    incarnation does not advance and an OPEN breaker recovers through
+    the probe-driven HALF_OPEN path, exactly as before supervisors
+    existed."""
+    client = InProcessReplica("a", _fake_engine())
+    r = _router({"a": client}, breaker_threshold=1,
+                breaker_cooldown_s=0.0)
+    rep = r._reps()[0]
+    r.probe_once()
+    rep.breaker.record_failure()
+    assert rep.breaker.state == OPEN
+    client.kill()
+    client.revive()
+    old = rep.breaker
+    r.probe_once()
+    assert rep.incarnation == 0
+    assert rep.breaker is old                  # no swap
+    assert rep.breaker.state == HALF_OPEN      # cooled OPEN + probe
+    assert not any(ev[0] == "incarnation" for ev in r.log)

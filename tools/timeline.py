@@ -72,6 +72,12 @@ def router_sources(base_url, timeout=10.0):
         mp = (row.get("signals") or {}).get("mp")
         label = (f"replica:{name} mp={int(mp)}"
                  if mp and int(mp) > 1 else f"replica:{name}")
+        # supervised replicas carry their restart generation — a
+        # respawned replica's lane is visibly a NEW incarnation, not
+        # a continuation of the dead one's
+        inc = row.get("incarnation")
+        if inc is not None and int(inc) > 0:
+            label += f" inc={int(inc)}"
         if not addr or not str(addr).startswith(("http://",
                                                  "https://")):
             print(f"replica {name}: no fetchable address "

@@ -83,7 +83,9 @@ def wall_summary(events):
     wall = phase = overlap = d2h_wait = ragged = 0.0
     allgather = shard_sync = 0.0
     mig_export = mig_wire = mig_import = 0.0
+    sup_restart = drain_mig = 0.0
     n_ticks = n_ragged = n_allgather = n_migrations = 0
+    n_restarts = n_drain_migs = 0
     for ev in events:
         if ev.get("ph") != "X":
             continue
@@ -128,6 +130,17 @@ def wall_summary(events):
             elif name == "shard.sync":
                 # replicating dirtied cursors/tables to every shard
                 shard_sync += dur
+            elif name == "supervisor.restart":
+                # self-healing fleet legs: restart = the supervisor
+                # respawning a dead/wedged replica (boot wait
+                # excluded — it only covers the spawn), drain.migrate
+                # = a SIGTERM'd replica shipping one live stream to a
+                # peer over the migration wire
+                sup_restart += dur
+                n_restarts += 1
+            elif name == "drain.migrate":
+                drain_mig += dur
+                n_drain_migs += 1
     return {
         "ticks": n_ticks, "wall_ms": wall, "phase_ms": phase,
         "per_tick_wall_ms": wall / n_ticks if n_ticks else float("nan"),
@@ -141,6 +154,10 @@ def wall_summary(events):
         "migrate_export_ms": mig_export,
         "migrate_wire_ms": mig_wire,
         "migrate_import_ms": mig_import,
+        "supervisor_restarts": n_restarts,
+        "supervisor_restart_ms": sup_restart,
+        "drain_migrations": n_drain_migs,
+        "drain_migrate_ms": drain_mig,
     }
 
 
@@ -172,6 +189,13 @@ def format_wall(w):
             f"{w['migrate_wire_ms']:.3f} ms   migrate.import "
             f"{w['migrate_import_ms']:.3f} ms (KV block migration: "
             "source gather / payload transit / destination adopt)")
+    if w.get("supervisor_restarts") or w.get("drain_migrations"):
+        lines.append(
+            f"supervisor.restart {w['supervisor_restart_ms']:.3f} ms "
+            f"over {w['supervisor_restarts']} respawn(s)   "
+            f"drain.migrate {w['drain_migrate_ms']:.3f} ms over "
+            f"{w['drain_migrations']} stream(s) (self-healing fleet: "
+            "replica respawn + SIGTERM drain handoff)")
     lines += [
         "(phases exceeding wall = spans ran concurrently — e.g. the "
         "async engine loop's",
