@@ -28,6 +28,14 @@ from ..ops import reshape, transpose, concat
 _sample_rows_jit = None  # lazily-jitted single-call sampler (below)
 
 
+def _is_quant_kv(pool):
+    """True when a paged K/V pool is a ``serving/quant.py``
+    ``QuantKV`` (int8 codes + per-block per-head scales) rather than
+    a plain fp array — the paged attention paths branch on this to
+    quantize at block write and dequantize at gather."""
+    return hasattr(pool, "codes") and hasattr(pool, "scale")
+
+
 def sample_rows(last, temperature, top_k, top_p, seed_lo, seed_hi,
                 ctr):
     """Standalone jitted twin of the fused dispatches' sampling tail:
@@ -292,7 +300,10 @@ class GPTAttention(nn.Layer):
         ``_slot_attn`` as the contiguous path, so slot outputs are
         token-identical to ``decode_slots`` (and hence ``generate()``).
 
-        x: Tensor [B, 1, E]; k_pool/v_pool: [NB, bs, H, hd] arrays;
+        x: Tensor [B, 1, E]; k_pool/v_pool: [NB, bs, H, hd] arrays —
+        or ``QuantKV`` int8 pools (serving/quant.py), in which case
+        the write goes through the touched-block requantizing insert
+        and the gather dequantizes ONLY the gathered blocks;
         block_tables: int32 [B, L//bs] (physical block per logical
         block); pos: int32 [B].  Returns (out [B, 1, E], k_pool,
         v_pool).
@@ -307,6 +318,16 @@ class GPTAttention(nn.Layer):
         B = qa.shape[0]
         NB, bs = k_pool.shape[0], k_pool.shape[1]
         rows = jnp.arange(B)
+        if _is_quant_kv(k_pool):
+            from ..serving.quant import paged_gather, paged_insert
+            blk = block_tables[rows, pos // bs]
+            off = pos % bs
+            k_pool = paged_insert(k_pool, blk, off, ka[:, 0])
+            v_pool = paged_insert(v_pool, blk, off, va[:, 0])
+            out = self._slot_attn(qa, paged_gather(k_pool, block_tables),
+                                  paged_gather(v_pool, block_tables),
+                                  pos)
+            return out, k_pool, v_pool
         flat_k = k_pool.reshape(NB * bs, self.num_heads, self.head_dim)
         flat_v = v_pool.reshape(NB * bs, self.num_heads, self.head_dim)
         # physical row of logical position pos[b] in slot b's table
@@ -370,9 +391,22 @@ class GPTAttention(nn.Layer):
         B, W = qa.shape[0], qa.shape[1]
         NB, bs = k_pool.shape[0], k_pool.shape[1]
         rows = jnp.arange(B)
+        offs = pos[:, None] + jnp.arange(W)[None, :]        # [B, W]
+        if _is_quant_kv(k_pool):
+            from ..serving.quant import paged_gather, paged_insert
+            blk = block_tables[rows[:, None], offs // bs].reshape(-1)
+            off = (offs % bs).reshape(-1)
+            H, hd = self.num_heads, self.head_dim
+            k_pool = paged_insert(k_pool, blk, off,
+                                  ka.reshape(B * W, H, hd))
+            v_pool = paged_insert(v_pool, blk, off,
+                                  va.reshape(B * W, H, hd))
+            out = self._slot_attn(qa, paged_gather(k_pool, block_tables),
+                                  paged_gather(v_pool, block_tables),
+                                  pos)
+            return out, k_pool, v_pool
         flat_k = k_pool.reshape(NB * bs, self.num_heads, self.head_dim)
         flat_v = v_pool.reshape(NB * bs, self.num_heads, self.head_dim)
-        offs = pos[:, None] + jnp.arange(W)[None, :]        # [B, W]
         widx = (block_tables[rows[:, None], offs // bs] * bs
                 + offs % bs)                                # [B, W]
         flat_k = flat_k.at[widx].set(ka.astype(flat_k.dtype))
@@ -417,8 +451,7 @@ class GPTAttention(nn.Layer):
         NB, bs = k_pool.shape[0], k_pool.shape[1]
         bps = block_tables.shape[1]
         rows = jnp.arange(B)
-        flat_k = k_pool.reshape(NB * bs, self.num_heads, self.head_dim)
-        flat_v = v_pool.reshape(NB * bs, self.num_heads, self.head_dim)
+        H, hd = self.num_heads, self.head_dim
         offs = pos[:, None] + jnp.arange(W)[None, :]        # [B, W]
         # lanes past width[b] — and any out-of-range offset (runaway
         # defense: a clip into the table's LAST entry would overwrite
@@ -429,11 +462,35 @@ class GPTAttention(nn.Layer):
             & (offs < bps * bs)
         offs_safe = jnp.where(valid, offs, 0)
         blk = block_tables[rows[:, None], offs_safe // bs]
-        widx = jnp.where(valid, blk * bs + offs_safe % bs, 0)
-        flat_k = flat_k.at[widx].set(ka.astype(flat_k.dtype))
-        flat_v = flat_v.at[widx].set(va.astype(flat_v.dtype))
-        ctx = ragged_paged_attention(qa, flat_k, flat_v, block_tables,
-                                     pos, width, block_size=bs)
+        if _is_quant_kv(k_pool):
+            from ..serving.quant import paged_insert
+            # same masking rule, insert form: masked lanes RMW the
+            # scratch block (blk 0, row 0) instead of scatter-row 0
+            blk_q = jnp.where(valid, blk, 0).reshape(-1)
+            off_q = jnp.where(valid, offs_safe % bs, 0).reshape(-1)
+            k_pool = paged_insert(k_pool, blk_q, off_q,
+                                  ka.reshape(B * W, H, hd))
+            v_pool = paged_insert(v_pool, blk_q, off_q,
+                                  va.reshape(B * W, H, hd))
+            # the kernel gets code rows + the parallel scale pools and
+            # dequantizes per gathered block, inside the kv-block loop
+            ctx = ragged_paged_attention(
+                qa, k_pool.codes.reshape(NB * bs, H, hd),
+                v_pool.codes.reshape(NB * bs, H, hd),
+                block_tables, pos, width, block_size=bs,
+                k_scale=k_pool.scale, v_scale=v_pool.scale)
+            new_k, new_v = k_pool, v_pool
+        else:
+            flat_k = k_pool.reshape(NB * bs, H, hd)
+            flat_v = v_pool.reshape(NB * bs, H, hd)
+            widx = jnp.where(valid, blk * bs + offs_safe % bs, 0)
+            flat_k = flat_k.at[widx].set(ka.astype(flat_k.dtype))
+            flat_v = flat_v.at[widx].set(va.astype(flat_v.dtype))
+            ctx = ragged_paged_attention(qa, flat_k, flat_v,
+                                         block_tables, pos, width,
+                                         block_size=bs)
+            new_k = flat_k.reshape(k_pool.shape)
+            new_v = flat_v.reshape(v_pool.shape)
         out = Tensor(ctx)
         if self.use_mp:
             from ..ops import einsum
@@ -442,8 +499,7 @@ class GPTAttention(nn.Layer):
         else:
             out = reshape(out, [B, W, self.num_heads * self.head_dim])
             out = self.out_proj(out)
-        return (out, flat_k.reshape(k_pool.shape),
-                flat_v.reshape(v_pool.shape))
+        return out, new_k, new_v
 
     def prefill_chunk_paged(self, x, k_pool, v_pool, block_table, pos,
                             true_len):
@@ -477,28 +533,48 @@ class GPTAttention(nn.Layer):
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         qa, ka, va = q._data, k._data, v._data
         NB, bs = k_pool.shape[0], k_pool.shape[1]
-        flat_k = k_pool.reshape(NB * bs, self.num_heads, self.head_dim)
-        flat_v = v_pool.reshape(NB * bs, self.num_heads, self.head_dim)
         offs = pos + jnp.arange(C)                              # [C]
         valid = jnp.arange(C) < true_len
         offs_safe = jnp.where(valid, offs, 0)
-        # pad lanes write the scratch block's row 0 (garbage on garbage)
-        widx = jnp.where(
-            valid, block_table[offs_safe // bs] * bs + offs_safe % bs, 0)
-        flat_k = flat_k.at[widx].set(ka[0].astype(flat_k.dtype))
-        flat_v = flat_v.at[widx].set(va[0].astype(flat_v.dtype))
-        # gather the slot's whole logical [L] row (like
-        # decode_slots_paged, one slot): chunk queries see the adopted
-        # prefix, earlier chunks, and this chunk's own fresh K/V
-        gidx = ((block_table * bs)[:, None]
-                + jnp.arange(bs)[None, :]).reshape(-1)          # [L]
-        k_rows = flat_k[gidx][None]
-        v_rows = flat_v[gidx][None]
+        if _is_quant_kv(k_pool):
+            from ..serving.quant import paged_gather, paged_insert
+            # pad lanes RMW the scratch block (blk 0, row 0) — the
+            # same masking rule as the fp scatter's widx 0
+            blk = jnp.where(valid, block_table[offs_safe // bs], 0)
+            off = jnp.where(valid, offs_safe % bs, 0)
+            k_pool = paged_insert(k_pool, blk, off, ka[0])
+            v_pool = paged_insert(v_pool, blk, off, va[0])
+            k_rows = paged_gather(k_pool, block_table[None, :])
+            v_rows = paged_gather(v_pool, block_table[None, :])
+            new_k, new_v = k_pool, v_pool
+            L = block_table.shape[0] * bs
+        else:
+            flat_k = k_pool.reshape(NB * bs, self.num_heads,
+                                    self.head_dim)
+            flat_v = v_pool.reshape(NB * bs, self.num_heads,
+                                    self.head_dim)
+            # pad lanes write the scratch block's row 0 (garbage on
+            # garbage)
+            widx = jnp.where(
+                valid,
+                block_table[offs_safe // bs] * bs + offs_safe % bs, 0)
+            flat_k = flat_k.at[widx].set(ka[0].astype(flat_k.dtype))
+            flat_v = flat_v.at[widx].set(va[0].astype(flat_v.dtype))
+            # gather the slot's whole logical [L] row (like
+            # decode_slots_paged, one slot): chunk queries see the
+            # adopted prefix, earlier chunks, and this chunk's own
+            # fresh K/V
+            gidx = ((block_table * bs)[:, None]
+                    + jnp.arange(bs)[None, :]).reshape(-1)      # [L]
+            k_rows = flat_k[gidx][None]
+            v_rows = flat_v[gidx][None]
+            new_k = flat_k.reshape(k_pool.shape)
+            new_v = flat_v.reshape(v_pool.shape)
+            L = gidx.shape[0]
         scale = 1.0 / _math.sqrt(self.head_dim)
         scores = jnp.einsum("bqhd,bkhd->bhqk",
                             qa.astype(jnp.float32),
                             k_rows.astype(jnp.float32)) * scale
-        L = gidx.shape[0]
         visible = jnp.arange(L)[None, :] <= offs[:, None]       # [C, L]
         scores = jnp.where(visible[None, None, :, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
@@ -512,8 +588,7 @@ class GPTAttention(nn.Layer):
         else:
             out = reshape(out, [1, C, self.num_heads * self.head_dim])
             out = self.out_proj(out)
-        return (out, flat_k.reshape(k_pool.shape),
-                flat_v.reshape(v_pool.shape))
+        return out, new_k, new_v
 
     def forward(self, x, cache=None, doc_segments=None):
         b, s, _ = x.shape
@@ -1891,17 +1966,35 @@ class GPTModel(nn.Layer):
         bnames = sorted(mbuffers)
         ctx_len = n_ctx * bs
 
+        def _ctx_rows(pool, ctx_blocks):
+            # adopted-prefix context: quantized pools dequantize ONLY
+            # the gathered ctx blocks (codes x per-block scale row),
+            # never the pool
+            if _is_quant_kv(pool):
+                from ..serving.quant import dequantize_blocks
+                rows = dequantize_blocks(pool.codes[ctx_blocks],
+                                         pool.scale[ctx_blocks])
+                return rows.reshape(1, ctx_len, nh, hd)
+            return pool[ctx_blocks].reshape(1, ctx_len, nh, hd)
+
+        def _store_tail(pool, tail, tail_blocks):
+            # tail scatter: whole fresh blocks quantize with a FRESH
+            # per-block scale (pad rows are zeros — no amax inflation)
+            if _is_quant_kv(pool):
+                from ..serving.quant import QuantKV, quantize_blocks
+                qt, st = quantize_blocks(tail)
+                return QuantKV(pool.codes.at[tail_blocks].set(qt),
+                               pool.scale.at[tail_blocks].set(st))
+            return pool.at[tail_blocks].set(tail.astype(pool.dtype))
+
         def pure(p_list, b_list, k_pools, v_pools, ids_arr, ctx_blocks,
                  tail_blocks):
             with _swapped(params, dict(zip(pnames, p_list))), \
                     _swapped(mbuffers, dict(zip(bnames, b_list))):
                 with autograd.no_grad():
-                    caches = [
-                        (Tensor(kp[ctx_blocks].reshape(
-                            1, ctx_len, nh, hd)),
-                         Tensor(vp[ctx_blocks].reshape(
-                             1, ctx_len, nh, hd)))
-                        for kp, vp in zip(k_pools, v_pools)]
+                    caches = [(Tensor(_ctx_rows(kp, ctx_blocks)),
+                               Tensor(_ctx_rows(vp, ctx_blocks)))
+                              for kp, vp in zip(k_pools, v_pools)]
                     logits, caches = model.forward(
                         Tensor(ids_arr), caches=caches,
                         position_offset=ctx_len)
@@ -1914,10 +2007,8 @@ class GPTModel(nn.Layer):
                             .reshape(n_tail, bs, nh, hd)
                         vt = jnp.pad(cv._data[:, ctx_len:], pad)[0] \
                             .reshape(n_tail, bs, nh, hd)
-                        new_k.append(kp.at[tail_blocks].set(
-                            kt.astype(kp.dtype)))
-                        new_v.append(vp.at[tail_blocks].set(
-                            vt.astype(vp.dtype)))
+                        new_k.append(_store_tail(kp, kt, tail_blocks))
+                        new_v.append(_store_tail(vp, vt, tail_blocks))
             return logits._data[:, -1, :], new_k, new_v
 
         fn = jax.jit(pure, donate_argnums=(2, 3))

@@ -52,7 +52,8 @@ def _auto_interpret():
 
 
 def _ragged_paged_attention_impl(q, k_flat, v_flat, block_tables, pos,
-                                 width, block_size, interpret):
+                                 width, block_size, interpret,
+                                 k_scale=None, v_scale=None):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -62,21 +63,38 @@ def _ragged_paged_attention_impl(q, k_flat, v_flat, block_tables, pos,
     bs = block_size
     L = nb * bs
     scale = 1.0 / math.sqrt(hd)
+    quant = k_scale is not None
 
     def kernel(tables_ref, pos_ref, width_ref, q_ref, k_ref, v_ref,
-               o_ref):
+               *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref = rest
+        else:
+            (o_ref,) = rest
         b = pl.program_id(0)
         p = pos_ref[b]
         w = width_ref[b]
-        # kv-block loop: gather this slot's logical [L] row through its
-        # block table (physical block ids are runtime data; nb/bs are
-        # the only static shapes)
-        k_rows = jnp.concatenate(
-            [k_ref[pl.ds(tables_ref[b, j] * bs, bs)]
-             for j in range(nb)], axis=0)                    # [L, H, hd]
-        v_rows = jnp.concatenate(
-            [v_ref[pl.ds(tables_ref[b, j] * bs, bs)]
-             for j in range(nb)], axis=0)
+
+        def rows(pool_ref, scale_ref):
+            # kv-block loop: gather this slot's logical [L] row
+            # through its block table (physical block ids are runtime
+            # data; nb/bs are the only static shapes).  Quantized
+            # pools dequantize PER GATHERED BLOCK — int8 codes times
+            # that block's per-head scale row, right here where the
+            # block enters the contraction, never the whole pool.
+            parts = []
+            for j in range(nb):
+                blk = pool_ref[pl.ds(tables_ref[b, j] * bs, bs)]
+                if scale_ref is not None:
+                    s = scale_ref[pl.ds(tables_ref[b, j], 1)][0]  # [H]
+                    parts.append(blk.astype(jnp.float32)
+                                 * s[None, :, None])
+                else:
+                    parts.append(blk)
+            return jnp.concatenate(parts, axis=0)            # [L, H, hd]
+
+        k_rows = rows(k_ref, ks_ref if quant else None)
+        v_rows = rows(v_ref, vs_ref if quant else None)
         qa = q_ref[0].astype(jnp.float32)                    # [W, H, hd]
         # same contraction / mask / softmax as the XLA oracle
         # (_slot_attn), per slot: scores [H, W, L] in f32
@@ -98,44 +116,68 @@ def _ragged_paged_attention_impl(q, k_flat, v_flat, block_tables, pos,
         ctx = jnp.where(lane < w, ctx, 0.0)
         o_ref[0] = ctx.astype(o_ref.dtype)
 
+    in_specs = [
+        pl.BlockSpec(block_tables.shape, lambda b: (0, 0)),
+        pl.BlockSpec(pos.shape, lambda b: (0,)),
+        pl.BlockSpec(width.shape, lambda b: (0,)),
+        pl.BlockSpec((1, W, H, hd), lambda b: (b, 0, 0, 0)),
+        pl.BlockSpec(k_flat.shape, lambda b: (0, 0, 0)),
+        pl.BlockSpec(v_flat.shape, lambda b: (0, 0, 0)),
+    ]
+    operands = [block_tables, pos, width, q, k_flat, v_flat]
+    if quant:
+        in_specs += [
+            pl.BlockSpec(k_scale.shape, lambda b: (0, 0)),
+            pl.BlockSpec(v_scale.shape, lambda b: (0, 0)),
+        ]
+        operands += [k_scale, v_scale]
     return pl.pallas_call(
         kernel,
         grid=(B,),
-        in_specs=[
-            pl.BlockSpec(block_tables.shape, lambda b: (0, 0)),
-            pl.BlockSpec(pos.shape, lambda b: (0,)),
-            pl.BlockSpec(width.shape, lambda b: (0,)),
-            pl.BlockSpec((1, W, H, hd), lambda b: (b, 0, 0, 0)),
-            pl.BlockSpec(k_flat.shape, lambda b: (0, 0, 0)),
-            pl.BlockSpec(v_flat.shape, lambda b: (0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, W, H, hd), lambda b: (b, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, W, H, hd), q.dtype),
         interpret=interpret,
-    )(block_tables, pos, width, q, k_flat, v_flat)
+    )(*operands)
 
 
 def ragged_paged_attention(q, k_flat, v_flat, block_tables, pos, width,
-                           *, block_size, interpret=None):
+                           *, block_size, interpret=None,
+                           k_scale=None, v_scale=None):
     """Ragged paged attention over a slot pool (see module docstring).
 
     q : [B, W, H, hd] query window per slot (W = the engine's static
         maximum window; real lanes per slot are ``width[b]``).
     k_flat / v_flat : [num_blocks * block_size, H, hd] — the paged
         pools flattened to physical rows (writes already scattered).
+        With ``k_scale``/``v_scale`` these are int8 CODE rows.
     block_tables : int32 [B, L // block_size] physical block per
         logical block (row 0 = the scratch block for parked slots).
     pos : int32 [B] window start per slot (tokens already cached).
     width : int32 [B] real query lanes this tick (0 = parked; output
         lanes >= width are zeroed).
+    k_scale / v_scale : optional f32 [num_blocks, H] per-block
+        per-head dequant multipliers (``Engine(kv_dtype="int8")``):
+        the kernel dequantizes each gathered block in-loop — codes
+        times the block's scale row, adjacent to the contraction —
+        so the logical K/V row never materializes outside VMEM and
+        the whole pool is never dequantized.  Pass both or neither.
     Returns ctx [B, W, H, hd] in q's dtype.
     """
     import jax.numpy as jnp
 
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError(
+            "ragged_paged_attention: pass both k_scale and v_scale "
+            "(quantized pools) or neither (fp pools)")
     if interpret is None:
         interpret = _auto_interpret()
+    if k_scale is not None:
+        k_scale = jnp.asarray(k_scale, jnp.float32)
+        v_scale = jnp.asarray(v_scale, jnp.float32)
     return _ragged_paged_attention_impl(
         q, k_flat, v_flat,
         jnp.asarray(block_tables, jnp.int32),
         jnp.asarray(pos, jnp.int32), jnp.asarray(width, jnp.int32),
-        block_size=int(block_size), interpret=bool(interpret))
+        block_size=int(block_size), interpret=bool(interpret),
+        k_scale=k_scale, v_scale=v_scale)

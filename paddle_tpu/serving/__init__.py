@@ -30,7 +30,15 @@ emitted-token counter, device-resident step cursors — a steady-state
 tick uploads nothing and downloads only the sampled ids (+ accept
 counts under speculation) instead of the per-tick logits matrix;
 ``sample_mode="host"`` keeps the legacy logits-download + numpy
-sampling numerics.  Metrics (queue depth, slot occupancy, tokens/sec,
+sampling numerics.  ``Engine(weight_dtype="int8")`` /
+``Engine(kv_dtype="int8")`` add QUANTIZED serving (``serving.quant``):
+weight-only int8 codes ride the compiled hot paths as traced buffers,
+and the paged K/V pools store int8 codes with per-block per-head f32
+scales (``QuantKV``) so the same ``kv_budget_mb`` holds ~2x the
+logical blocks vs bf16 (~4x vs f32) — quantized blocks stay
+first-class through prefix sharing, preemption, recovery, and the
+migration wire (a ``kv_dtype``-mismatched peer raises
+``KVDtypeMismatch`` instead of adopting garbage).  Metrics (queue depth, slot occupancy, tokens/sec,
 TTFT/TPOT, KV blocks in use, prefix hits/evictions, prefill chunks,
 decode stall, spec proposed/accepted/acceptance-rate/tokens-per-tick,
 d2h bytes per tick, host sample time, fused-sample ticks, compiles)
@@ -59,7 +67,9 @@ from .request import (  # noqa: F401
     Request, RequestQueue, RequestTimeout, QueueFull, Rejected,
     RateLimited, DeadlineShed, TenantPolicy, TokenBucket)
 from .scheduler import Scheduler, Slot  # noqa: F401
-from .kvcache import BlockPool, NoFreeBlocks, PrefixCache  # noqa: F401
+from .kvcache import (  # noqa: F401
+    BlockPool, KVDtypeMismatch, NoFreeBlocks, PrefixCache)
+from .quant import QuantKV, relayout_weights_int8  # noqa: F401
 from .spec import (  # noqa: F401
     Proposer, PromptLookupProposer, DraftModelProposer)
 from .faults import (  # noqa: F401
@@ -83,6 +93,7 @@ __all__ = [
     "TokenBucket",
     "Scheduler", "Slot", "Engine", "EngineServer", "serve",
     "BlockPool", "PrefixCache", "NoFreeBlocks",
+    "KVDtypeMismatch", "QuantKV", "relayout_weights_int8",
     "Proposer", "PromptLookupProposer", "DraftModelProposer",
     "FaultInjector", "InjectedFault", "TickWatchdog",
     "WatchdogTimeout",

@@ -41,8 +41,9 @@ from contextlib import nullcontext
 import numpy as np
 
 from .. import monitor
-from .kvcache import (BlockPool, PrefixCache, export_blocks,
-                      import_blocks, per_shard_block_bytes)
+from .kvcache import (BlockPool, KVDtypeMismatch, PrefixCache,
+                      export_blocks, import_blocks,
+                      per_shard_block_bytes)
 from .request import (MAX_SEED, DeadlineShed, QueueFull, RateLimited,
                       Request, RequestQueue, TenantPolicy, TokenBucket)
 from .scheduler import Scheduler
@@ -457,11 +458,38 @@ class Engine:
                  async_depth=None, tracing=True,
                  trace_capacity=16384, trace_annotations=False,
                  flight_dir=None, tenants=None, preemption=True,
-                 shed_deadlines=True, faults=None, watchdog_s=None):
+                 shed_deadlines=True, faults=None, watchdog_s=None,
+                 weight_dtype=None, kv_dtype=None):
         if getattr(model, "scan_layers", False):
             model = model._sync_decode_twin()
         model.eval()
         self.model = model
+        # -- quantized serving (serving/quant.py) ----------------------
+        # weight relayout runs HERE, before the KV-dtype resolution and
+        # the parameter/buffer snapshots below, so the int8 codes +
+        # scales are registered buffers that ride b_list into every
+        # compiled hot path, and kv pools stay in the projection's
+        # declared compute dtype
+        self._weight_quant = weight_dtype is not None
+        if self._weight_quant:
+            if str(weight_dtype) != "int8":
+                raise ValueError(
+                    f"weight_dtype must be 'int8' (or None to serve "
+                    f"the checkpoint's own dtype), got {weight_dtype!r}")
+            if getattr(model.blocks[0].attn, "use_mp", False):
+                raise ValueError(
+                    "weight_dtype='int8' cannot relayout the tensor-"
+                    "parallel einsum form (use_mp=True): its fused "
+                    "qkv/ffn weights are not nn.Linear layers — "
+                    "quantize the dense checkpoint before "
+                    "to_tensor_parallel(), or serve it dense")
+            from .quant import relayout_weights_int8
+            relayout_weights_int8(model)
+        self._kv_quant = kv_dtype is not None
+        if self._kv_quant and str(kv_dtype) != "int8":
+            raise ValueError(
+                f"kv_dtype must be 'int8' (or None for the compute "
+                f"dtype), got {kv_dtype!r}")
         max_position = \
             model.embeddings.position_embeddings.weight.shape[0]
         self.max_seq_len = int(max_seq_len or max_position)
@@ -530,6 +558,15 @@ class Engine:
             kv_dtype = getattr(attn0.qkv_proj, "compute_dtype", None) \
                 or attn0.qkv_proj.weight._data.dtype
         self._kv_dtype = kv_dtype
+        # the dtype LABEL for compiled-program cache keys, /healthz,
+        # and the migration wire: a quantized pool keeps _kv_dtype as
+        # its f32 COMPUTE dtype (attention math, scratch views) but
+        # must never share programs or migrate blocks with an fp
+        # engine of the same compute dtype
+        self._kv_dtype_str = "int8" if self._kv_quant \
+            else str(self._kv_dtype)
+        self._weight_dtype_str = "int8" if self._weight_quant \
+            else str(self._kv_dtype)
         # -- tensor-parallel serving mesh (mesh=...) -------------------
         # ``mesh`` accepts an int / 1-tuple mp degree (resolved via
         # distributed.mesh.serving_mesh over the first mp devices) or a
@@ -548,7 +585,10 @@ class Engine:
         self.mesh_axes = None
         self._repl_sharding = None
         self._kv_sharding = None
+        self._kv_scale_sharding = None
         self._kv_block_bytes_per_shard = None
+        self._kv_code_bytes_per_shard = None
+        self._kv_scale_bytes_per_shard = None
         if mesh is not None:
             import jax
             from jax.sharding import (Mesh, NamedSharding,
@@ -608,6 +648,10 @@ class Engine:
             # one spec shards each device's pool slice to its heads
             self._kv_sharding = NamedSharding(
                 mesh, PartitionSpec(None, None, "mp", None))
+            # quantized pools' parallel scale pool is [NB, H]: the
+            # head axis shards with its blocks' heads
+            self._kv_scale_sharding = NamedSharding(
+                mesh, PartitionSpec(None, "mp"))
             # place params per their TP PartitionSpecs (replicated
             # when none): every compiled dispatch then sees sharded
             # weight inputs and GSPMD partitions the program
@@ -714,6 +758,20 @@ class Engine:
                 "gap to overlap")
         self.async_depth = async_depth
         self._paged = kv_block_size is not None
+        if self._kv_quant:
+            if not self._paged:
+                raise ValueError(
+                    "kv_dtype='int8' requires the paged KV layout "
+                    "(kv_block_size=...): quantization is per-block — "
+                    "the contiguous pools have no block granularity "
+                    "to hang a scale on")
+            if sample_mode != "device":
+                raise ValueError(
+                    "kv_dtype='int8' requires sample_mode='device': "
+                    "the host sampling paths dispatch the per-layer "
+                    "fp decode programs, which have no dequantizing "
+                    "gather — only the fused device-sampling "
+                    "dispatches thread QuantKV pools")
         if self._paged:
             bsz = int(kv_block_size)
             if bsz < 1 or self.max_seq_len % bsz:
@@ -732,9 +790,21 @@ class Engine:
             # per-chip HBM budget (kv_budget_mb) buys mp x the blocks
             # — sharding the model scales KV capacity, not just
             # weights (kvcache.per_shard_block_bytes)
-            self._kv_block_bytes_per_shard = per_shard_block_bytes(
-                bsz, self._nh, self._hd, self._kv_dtype,
+            # quantized pools store int8 codes plus the parallel f32
+            # scale pool; both count against the budget so capacity
+            # accounting adds up (code + scale components exposed as
+            # serving.kv_block_bytes / serving.kv_scale_bytes)
+            store_dtype = "int8" if self._kv_quant else self._kv_dtype
+            self._kv_code_bytes_per_shard = per_shard_block_bytes(
+                bsz, self._nh, self._hd, store_dtype,
                 len(model.blocks), self.mp)
+            self._kv_block_bytes_per_shard = per_shard_block_bytes(
+                bsz, self._nh, self._hd, store_dtype,
+                len(model.blocks), self.mp,
+                scale_dtype="float32" if self._kv_quant else None)
+            self._kv_scale_bytes_per_shard = (
+                self._kv_block_bytes_per_shard
+                - self._kv_code_bytes_per_shard)
             if kv_budget_mb is not None:
                 if kv_blocks is not None:
                     raise ValueError(
@@ -791,6 +861,9 @@ class Engine:
         self._wmax = max(1, (self._spec_k + 1) if self._spec_k else 1,
                          self._chunk or 1)
         self._ragged_fn = None  # resolved jitted ragged-window handle
+        self._zero_scale_fn = None  # jitted fresh-block scale zeroer
+        #   (kv_dtype='int8'; compiled once per config — see
+        #   _zero_fresh_scales)
         # -- tracing / flight recorder ---------------------------------
         self.tracer = (monitor.Tracer(capacity=trace_capacity,
                                       annotate=trace_annotations)
@@ -847,8 +920,19 @@ class Engine:
             " slots or cached prefixes")
         self._m_kv_total = reg.gauge(
             "serving.kv_blocks_total", "paged KV pool size in blocks")
+        self._m_kv_block_bytes = reg.gauge(
+            "serving.kv_block_bytes", "per-shard K/V ROW bytes of one "
+            "logical block across all layers (int8 code bytes when "
+            "kv_dtype='int8')")
+        self._m_kv_scale_bytes = reg.gauge(
+            "serving.kv_scale_bytes", "per-shard scale-pool bytes of "
+            "one logical block (0 unless kv_dtype='int8') — "
+            "kv_blocks_total * (kv_block_bytes + kv_scale_bytes) "
+            "<= kv_budget_mb")
         if self._paged:
             self._m_kv_total.set(self._kv_managed)
+            self._m_kv_block_bytes.set(self._kv_code_bytes_per_shard)
+            self._m_kv_scale_bytes.set(self._kv_scale_bytes_per_shard)
         self._m_prefix_hits = reg.counter(
             "serving.prefix_hits", "admissions that adopted a cached "
             "prompt prefix")
@@ -1034,20 +1118,37 @@ class Engine:
         segfaults intermittently under this jax version.)"""
         import jax.numpy as jnp
         if self._kv_sharding is None:
+            if self._kv_quant:
+                from .quant import QuantKV
+                return QuantKV(
+                    jnp.zeros(shape, jnp.int8),
+                    jnp.zeros((shape[0], shape[2]), jnp.float32))
             return jnp.zeros(shape, self._kv_dtype)
         import jax
         fn = getattr(self, "_pool_zeros_fn", None)
         if fn is None:
             shape = tuple(shape)
             dtype = self._kv_dtype
+            if self._kv_quant:
+                from .quant import QuantKV
 
-            def zeros():
-                return jnp.zeros(shape, dtype)
+                def zeros():
+                    return QuantKV(
+                        jnp.zeros(shape, jnp.int8),
+                        jnp.zeros((shape[0], shape[2]), jnp.float32))
 
+                out_sh = QuantKV(self._kv_sharding,
+                                 self._kv_scale_sharding)
+            else:
+
+                def zeros():
+                    return jnp.zeros(shape, dtype)
+
+                out_sh = self._kv_sharding
             # cached: the pool shape is fixed per engine, and the
             # step-failure recovery path re-allocates repeatedly
             fn = self._pool_zeros_fn = jax.jit(
-                zeros, out_shardings=self._kv_sharding)
+                zeros, out_shardings=out_sh)
         return fn()
 
     def _reset_pools(self):
@@ -1853,8 +1954,14 @@ class Engine:
                           "num_heads": self._nh,
                           "head_dim": self._hd,
                           "n_layers": len(self.k_pools),
-                          "dtype": str(self._kv_dtype),
-                          "n_blocks": n_full, "data": data}
+                          "dtype": self._kv_dtype_str,
+                          "n_blocks": n_full}
+                    if self._kv_quant:
+                        # quantized export: codes + their per-block
+                        # scales travel together
+                        kv["data"], kv["scales"] = data
+                    else:
+                        kv["data"] = data
                 if self.prefix_cache is not None and n_full:
                     self.prefix_cache.insert(ctx, blocks)
             rng = self._rngs.pop(req.id, None)
@@ -1920,11 +2027,20 @@ class Engine:
         if not self._paged or self.prefix_cache is None:
             return []
         n = int(kv["n_blocks"])
+        # dtype FIRST, as its own machine-readable refusal: int8 codes
+        # adopted by an fp engine (or fp rows by a quantized one)
+        # would be garbage at best — peers must agree on kv_dtype
+        # before geometry even matters
+        peer_dtype = str(kv.get("dtype"))
+        if peer_dtype != self._kv_dtype_str:
+            raise KVDtypeMismatch(
+                f"migration payload kv dtype {peer_dtype!r} does not "
+                f"match this engine's {self._kv_dtype_str!r}: "
+                "adopting nothing (peers must serve the same "
+                "kv_dtype)")
         want = {"block_size": self._bs, "num_heads": self._nh,
-                "head_dim": self._hd, "n_layers": len(self.k_pools),
-                "dtype": str(self._kv_dtype)}
-        got = {k: (str(kv.get(k)) if k == "dtype" else kv.get(k))
-               for k in want}
+                "head_dim": self._hd, "n_layers": len(self.k_pools)}
+        got = {k: kv.get(k) for k in want}
         if got != want:
             raise ValueError(
                 f"migration payload geometry {got} does not match "
@@ -1939,7 +2055,8 @@ class Engine:
             self._fault("migrate_import")
             with tr.span("migrate.import", cat="serving", blocks=n):
                 self.k_pools, self.v_pools = import_blocks(
-                    self.k_pools, self.v_pools, blocks, kv["data"])
+                    self.k_pools, self.v_pools, blocks, kv["data"],
+                    scales=kv.get("scales"))
             # hand ownership to the trie: insert takes one ref per
             # NEW node, then the alloc ref drops — the blocks are the
             # cache's exactly like a finished request's, and the
@@ -2019,14 +2136,17 @@ class Engine:
                                      blocks)
         finally:
             self.block_pool.decref(blocks)  # drop match's adopter refs
+        kv = {"block_size": self._bs, "num_heads": self._nh,
+              "head_dim": self._hd, "n_layers": len(self.k_pools),
+              "dtype": self._kv_dtype_str, "n_blocks": len(blocks)}
+        if self._kv_quant:
+            kv["data"], kv["scales"] = data
+        else:
+            kv["data"] = data
         payload = {
             "version": 1, "request": None,
             "prefix": [int(t) for t in tokens[:m]],
-            "kv": {"block_size": self._bs, "num_heads": self._nh,
-                   "head_dim": self._hd,
-                   "n_layers": len(self.k_pools),
-                   "dtype": str(self._kv_dtype),
-                   "n_blocks": len(blocks), "data": data}}
+            "kv": kv}
         self._m_kv_migrated.inc(len(blocks))
         with self._mig_lock:
             self._migration_log.append({
@@ -2157,6 +2277,10 @@ class Engine:
                 "mp": self.mp,
                 "kv_block_bytes_per_shard":
                     self._kv_block_bytes_per_shard,
+                "weight_dtype": self._weight_dtype_str,
+                "kv_dtype": self._kv_dtype_str,
+                "kv_block_bytes": self._kv_code_bytes_per_shard,
+                "kv_scale_bytes": self._kv_scale_bytes_per_shard,
                 "async_depth": self.async_depth,
                 "tracing": bool(self.tracer.enabled),
                 "preemption": self._preemption,
@@ -2290,7 +2414,61 @@ class Engine:
             self.tracer.instant("req.prefix_adopted", cat="request",
                                 req=req.id, tokens=m,
                                 blocks=len(ctx))
+        if self._kv_quant and fresh:
+            self._zero_fresh_scales(fresh)
         return ctx, fresh, m
+
+    def _zero_fresh_scales(self, fresh):
+        """Zero the SCALE rows of freshly reserved quantized blocks
+        (``kv_dtype='int8'``).  A recycled block's stale int8 codes
+        would otherwise survive into the touched-block
+        read-modify-write's amax recomputation (dequantized garbage
+        raising the fresh block's scale); zeroing just the scale row
+        nullifies them (``codes * 0 = 0``) without touching the code
+        pool — unwritten rows then read exactly 0.0, masked by the
+        same causal-position rule that hides fp stale garbage.  The
+        index vector is padded to ``_bps`` with the scratch block
+        (row 0, whose scale no live request reads), so ONE compiled
+        program serves every admission regardless of reservation
+        size — the no-retracing rule of the paged hot paths."""
+        import jax
+        import jax.numpy as jnp
+        fn = self._zero_scale_fn
+        if fn is None:
+            def zero(k_pools, v_pools, idx):
+                from .quant import QuantKV
+                new_k, new_v = [], []
+                for kp, vp in zip(k_pools, v_pools):
+                    new_k.append(QuantKV(
+                        kp.codes, kp.scale.at[idx].set(0.0)))
+                    new_v.append(QuantKV(
+                        vp.codes, vp.scale.at[idx].set(0.0)))
+                return new_k, new_v
+
+            fn = self._zero_scale_fn = jax.jit(
+                zero, donate_argnums=(0, 1))
+        pad = np.zeros(self._bps, np.int32)
+        pad[:len(fresh)] = fresh
+        self.k_pools, self.v_pools = fn(
+            self.k_pools, self.v_pools, jnp.asarray(pad))
+
+    def _dequant_span(self, tr, batch):
+        """``decode.dequant``: the host-side attribution span of a
+        QUANTIZED dispatch, nested inside ``decode.dispatch`` /
+        ``decode.ragged``.  The per-block dequant itself runs FUSED
+        inside the compiled program (codes x scale adjacent to the
+        gather), so there is no separate host phase to time — this
+        wraps the same dispatch call and records the worst-case code
+        bytes the gather dequantizes (full tables), making quantized
+        dispatches distinguishable in a trace (``tools/trace_view.py
+        --wall`` breaks the span out).  fp engines emit nothing."""
+        if not self._kv_quant:
+            import contextlib
+            return contextlib.nullcontext()
+        return tr.span(
+            "decode.dequant", cat="serving", batch=batch,
+            code_bytes=batch * self._bps
+            * (self._kv_code_bytes_per_shard or 0))
 
     # -- per-slot sampling lanes (sample_mode="device") ----------------
     def _bind_sample_state(self, slot):
@@ -2421,7 +2599,7 @@ class Engine:
         n_tail = -(-s // self._bs) - n_ctx
         pf, _, _ = self.model._compiled_paged_prefill_fn(
             self._pnames, self._params,
-            (s_tail, n_ctx, n_tail, self._bs, str(self._kv_dtype),
+            (s_tail, n_ctx, n_tail, self._bs, self._kv_dtype_str,
              tuple(self._pnames), self._bnames_all),
             s_tail, n_ctx, n_tail, self._bs, self._nh, self._hd,
             self._kv_dtype)
@@ -2457,7 +2635,7 @@ class Engine:
             S = next(b for b in self._prefill_buckets if b >= s)
             pf, _, _ = self.model._compiled_bucket_prefill_fn(
                 self._pnames, self._params,
-                (1, S, L, str(self._kv_dtype), tuple(self._pnames),
+                (1, S, L, self._kv_dtype_str, tuple(self._pnames),
                  self._bnames_all),
                 1, S, L, self._nh, self._hd, self._kv_dtype)
             ids = np.zeros((1, S), np.int32)
@@ -2467,7 +2645,7 @@ class Engine:
         else:
             pf, _, _ = self.model._compiled_prefill_fn(
                 self._pnames, self._params,
-                (1, s, L, str(self._kv_dtype), tuple(self._pnames),
+                (1, s, L, self._kv_dtype_str, tuple(self._pnames),
                  self._bnames_all),
                 1, s, L, self._nh, self._hd, self._kv_dtype)
             last0, k_bufs, v_bufs = pf(self._p_list(), self._b_list(),
@@ -2544,7 +2722,7 @@ class Engine:
                 fn, _, _ = self.model._compiled_paged_chunk_prefill_fn(
                     self._pnames, self._params,
                     (C, self._kv_managed + 1, self._bs, self._bps,
-                     str(self._kv_dtype), tuple(self._pnames),
+                     self._kv_dtype_str, tuple(self._pnames),
                      self._bnames_all))
                 last0, self.k_pools, self.v_pools = fn(
                     self._p_list(), self._b_list(), self.k_pools,
@@ -2556,7 +2734,7 @@ class Engine:
                 fn, _, _ = self.model._compiled_chunk_prefill_fn(
                     self._pnames, self._params,
                     (C, self.num_slots, self.max_seq_len,
-                     str(self._kv_dtype), tuple(self._pnames),
+                     self._kv_dtype_str, tuple(self._pnames),
                      self._bnames_all),
                     C, self.max_seq_len, self._nh, self._hd,
                     self._kv_dtype)
@@ -2780,7 +2958,7 @@ class Engine:
                 self._pnames, self._params,
                 ("paged" if self._paged else "slot", W, self.num_slots,
                  (self._kv_managed + 1, self._bs) if self._paged
-                 else self.max_seq_len, str(self._kv_dtype),
+                 else self.max_seq_len, self._kv_dtype_str,
                  tuple(self._pnames), self._bnames_all),
                 paged=self._paged)
         fn = self._spec_fn
@@ -2887,7 +3065,7 @@ class Engine:
                     ("paged" if self._paged else "slot", W,
                      self.num_slots,
                      (self._kv_managed + 1, self._bs) if self._paged
-                     else self.max_seq_len, str(self._kv_dtype),
+                     else self.max_seq_len, self._kv_dtype_str,
                      tuple(self._pnames), self._bnames_all),
                     paged=self._paged)
         args = [self._p_list(), self._b_list(), self.k_pools,
@@ -2899,7 +3077,8 @@ class Engine:
                  st["shi"], st["ctr"], st["eos"], st["rem"]]
         self._fault("dispatch")
         with tr.span("decode.dispatch", batch=len(active),
-                     layout=layout, spec_w=W, fused=True):
+                     layout=layout, spec_w=W, fused=True), \
+                self._dequant_span(tr, len(active)):
             (picks, n_acc, n_emit, done, new_tok, new_pos, new_ctr,
              new_rem, self.k_pools, self.v_pools) = \
                 self._fused_spec_fn(*args)
@@ -3022,7 +3201,7 @@ class Engine:
                 self._pnames, self._params,
                 ("paged" if self._paged else "slot", self.num_slots,
                  (self._kv_managed + 1, self._bs) if self._paged
-                 else self.max_seq_len, str(self._kv_dtype),
+                 else self.max_seq_len, self._kv_dtype_str,
                  tuple(self._pnames), self._bnames_all),
                 paged=self._paged)
         args = [self._p_list(), self._b_list(), self.k_pools,
@@ -3035,7 +3214,8 @@ class Engine:
         layout = "paged" if self._paged else "contiguous"
         self._fault("dispatch")
         with tr.span("decode.dispatch", batch=len(active),
-                     layout=layout, fused=True):
+                     layout=layout, fused=True), \
+                self._dequant_span(tr, len(active)):
             (ids, done, new_tok, new_pos, new_ctr, new_rem,
              self.k_pools, self.v_pools) = self._fused_fn(*args)
         st["tok"], st["pos"], st["ctr"], st["rem"] = \
@@ -3179,13 +3359,14 @@ class Engine:
                 self.model._compiled_ragged_window_fn(
                     self._pnames, self._params,
                     (self.num_slots, W, spec_w, self._kv_managed + 1,
-                     self._bs, str(self._kv_dtype),
+                     self._bs, self._kv_dtype_str,
                      tuple(self._pnames), self._bnames_all),
                     emit_w=spec_w)
         self._fault("dispatch")
         with tr.span("decode.ragged", batch=len(active) + len(plan),
                      layout="paged", w=W, chunks=len(plan),
-                     chunk_tokens=chunk_toks, fused=True):
+                     chunk_tokens=chunk_toks, fused=True), \
+                self._dequant_span(tr, len(active) + len(plan)):
             (picks, n_acc, n_emit, done, new_tok, new_pos, new_ctr,
              new_rem, self.k_pools, self.v_pools) = self._ragged_fn(
                 self._p_list(), self._b_list(), self.k_pools,
@@ -3382,13 +3563,13 @@ class Engine:
                     self.model._compiled_slot_paged_decode_fn(
                         self._pnames, self._params,
                         (self.num_slots, self._kv_managed + 1, self._bs,
-                         str(self._kv_dtype), tuple(self._pnames),
+                         self._kv_dtype_str, tuple(self._pnames),
                          self._bnames_all))
             else:
                 self._tick_fn, _, _ = self.model._compiled_slot_decode_fn(
                     self._pnames, self._params,
                     (self.num_slots, self.max_seq_len,
-                     str(self._kv_dtype), tuple(self._pnames),
+                     self._kv_dtype_str, tuple(self._pnames),
                      self._bnames_all))
         fn = self._tick_fn
         tr = self.tracer

@@ -76,7 +76,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import monitor
-from .kvcache import payload_to_json
+from .kvcache import KVDtypeMismatch, payload_to_json
 from .request import (DeadlineShed, RateLimited, Rejected,
                       RequestTimeout)
 
@@ -271,6 +271,18 @@ class _Handler(JsonHandler):
                     if getattr(eng, "_paged", False) else None),
                 "kv_block_bytes_per_shard": getattr(
                     eng, "_kv_block_bytes_per_shard", None),
+                # quantized serving (serving/quant.py): dtype labels
+                # plus the code/scale byte split, so the capacity
+                # accounting adds up (code + scale = block bytes) and
+                # a migration source can refuse a kv_dtype-mismatched
+                # peer BEFORE shipping blocks it would reject
+                "weight_dtype": getattr(eng, "_weight_dtype_str",
+                                        None),
+                "kv_dtype": getattr(eng, "_kv_dtype_str", None),
+                "kv_block_bytes": getattr(
+                    eng, "_kv_code_bytes_per_shard", None),
+                "kv_scale_bytes": getattr(
+                    eng, "_kv_scale_bytes_per_shard", None),
                 # async-loop signals, next to the router-tier load
                 # signals: pipeline depth plus the mean overlapped
                 # host time and mean blocking d2h wait per tick —
@@ -575,6 +587,14 @@ class _Handler(JsonHandler):
         except TimeoutError as e:
             self._send_json(504, {"error": str(e),
                                   "reason": "result_timeout"})
+            return
+        except KVDtypeMismatch as e:
+            # quantized/fp peers disagree on the wire kv dtype: the
+            # payload is fine, THIS pairing is wrong — a distinct
+            # machine-readable reason so the sender can filter peers
+            # by the /healthz kv_dtype signal instead of retrying
+            self._send_json(400, {"error": str(e),
+                                  "reason": "kv_dtype_mismatch"})
             return
         except (TypeError, ValueError) as e:
             # malformed payload / geometry mismatch: re-sending the

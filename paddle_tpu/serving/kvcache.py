@@ -72,10 +72,49 @@ transport).  The engine-side choreography — ring drain, slot freeze,
 resume snapshot — lives in serving/engine.py (``migrate_out`` /
 ``migrate_in``); this module stays pure bytes + ids.
 
-The invariant tests live in tests/test_kvcache.py (pool/trie) and
-tests/test_ragged_attn.py (kernel-side masking).
+Quantized pools (PR 16, ``Engine(kv_dtype="int8")``): each per-layer
+pool becomes a ``serving/quant.py`` ``QuantKV`` — int8 codes
+``[num_blocks, block_size, H, hd]`` plus a PARALLEL SCALE POOL of
+per-block per-head f32 dequant multipliers ``[num_blocks, H]``.  The
+scale pool obeys three invariants on top of the protocol above:
+
+* ONE scale row per physical block per layer per K/V — the scale is
+  block metadata, indexed by the same layer-invariant block id as the
+  codes, so nothing in BlockPool/PrefixCache changes (they track ids,
+  not bytes).
+* Scales TRAVEL WITH their block: copy-on-write copies the scale row
+  alongside the code rows, and the migration wire carries both
+  (``export_blocks`` returns ``(codes, scales)`` for quantized pools;
+  ``import_blocks`` scatters both; the JSON codec base64s each).
+* Shared blocks are never re-quantized: writes only land in a slot's
+  own fresh blocks (the same full-block-adoption rule that makes cow
+  degenerate to no-copy), so a block's scale is IMMUTABLE while its
+  refcount is shared — adopters always read exactly the scale the
+  producer wrote.
+
+``import_blocks`` raises ``KVDtypeMismatch`` when the payload and the
+destination pools disagree about quantization (codes into fp pools,
+fp rows into quantized pools) BEFORE any geometry check — a
+dtype-mismatched migration must adopt nothing, with a reason the
+HTTP layer can surface machine-readably.
+
+The invariant tests live in tests/test_kvcache.py (pool/trie),
+tests/test_ragged_attn.py (kernel-side masking), and
+tests/test_quant_serving.py (scale-pool parity + migration).
 """
 from __future__ import annotations
+
+
+class KVDtypeMismatch(ValueError):
+    """Migration payload and destination pools disagree about KV
+    quantization (int8 codes vs fp rows) — the import must adopt
+    nothing.  Subclasses ValueError so pre-quantization callers that
+    caught geometry errors keep working; the HTTP layer maps it to a
+    machine-readable ``reason: "kv_dtype_mismatch"``."""
+
+
+def _is_quant_pool(pool):
+    return hasattr(pool, "codes") and hasattr(pool, "scale")
 
 
 def export_blocks(k_pools, v_pools, block_ids):
@@ -91,28 +130,61 @@ def export_blocks(k_pools, v_pools, block_ids):
     destination's own prefill).  Returns a numpy array of shape
     ``(n_layers, 2, n_blocks, block_size, H, hd)`` with axis 1 = (K,
     V); the row indexing runs ON DEVICE so only the exported blocks
-    cross the d2h boundary, never the whole pool."""
+    cross the d2h boundary, never the whole pool.
+
+    Quantized pools (``QuantKV``) return a ``(codes, scales)`` PAIR:
+    the int8 codes in the shape above plus their per-block per-head
+    scales ``(n_layers, 2, n_blocks, H)`` — scales travel with their
+    blocks, in the same table order."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     ids = jnp.asarray([int(b) for b in block_ids], jnp.int32)
+    if _is_quant_pool(k_pools[0]):
+        codes = [jnp.stack((jnp.take(kp.codes, ids, axis=0),
+                            jnp.take(vp.codes, ids, axis=0)))
+                 for kp, vp in zip(k_pools, v_pools)]
+        scales = [jnp.stack((jnp.take(kp.scale, ids, axis=0),
+                             jnp.take(vp.scale, ids, axis=0)))
+                  for kp, vp in zip(k_pools, v_pools)]
+        return (np.asarray(jax.device_get(jnp.stack(codes))),
+                np.asarray(jax.device_get(jnp.stack(scales))))
     parts = [jnp.stack((jnp.take(kp, ids, axis=0),
                         jnp.take(vp, ids, axis=0)))
              for kp, vp in zip(k_pools, v_pools)]
     return np.asarray(jax.device_get(jnp.stack(parts)))
 
 
-def import_blocks(k_pools, v_pools, block_ids, data):
+def import_blocks(k_pools, v_pools, block_ids, data, scales=None):
     """Scatter an ``export_blocks`` array into rows ``block_ids`` of
     the destination's per-layer pools.  Returns new ``(k_pools,
     v_pools)`` lists — jax arrays are immutable, so the engine
     reassigns its pool references (safe between dispatches: the
     decode/prefill programs re-bind the pools at every dispatch).
-    Raises ValueError when the payload geometry does not match the
-    destination pools (block size / heads / head_dim / layer count) —
-    the caller rolls its fresh allocation back, adopting NOTHING."""
+    Raises ``KVDtypeMismatch`` when payload and pools disagree about
+    quantization (checked FIRST — int8 codes must never be scattered
+    into fp pools as if they were activations, nor fp rows adopted
+    without scales), and plain ValueError when the geometry does not
+    match (block size / heads / head_dim / layer count) — either way
+    the caller rolls its fresh allocation back, adopting NOTHING.
+
+    ``scales``: the per-block per-head scale array that
+    ``export_blocks`` returned alongside quantized codes,
+    ``(n_layers, 2, n_blocks, H)``; required iff the destination
+    pools are ``QuantKV``."""
     import jax.numpy as jnp
     import numpy as np
+    quant = _is_quant_pool(k_pools[0])
+    if quant and scales is None:
+        raise KVDtypeMismatch(
+            "destination pools are int8-quantized (kv_dtype='int8') "
+            "but the migration payload carries no scales — refusing "
+            "to adopt fp rows into a quantized pool")
+    if not quant and scales is not None:
+        raise KVDtypeMismatch(
+            "migration payload carries int8 codes + scales but the "
+            "destination pools are fp (kv_dtype mismatch between "
+            "peers) — refusing to adopt")
     data = np.asarray(data)
     ids = [int(b) for b in block_ids]
     want = (len(k_pools), 2, len(ids)) + tuple(k_pools[0].shape[1:])
@@ -122,6 +194,28 @@ def import_blocks(k_pools, v_pools, block_ids, data):
             f"match destination pools (want {want}: layers x (K,V) x "
             "blocks x block_size x heads x head_dim)")
     idx = jnp.asarray(ids, jnp.int32)
+    if quant:
+        from .quant import QuantKV
+        scales = np.asarray(scales)
+        want_s = (len(k_pools), 2, len(ids), k_pools[0].shape[2])
+        if tuple(scales.shape) != want_s:
+            raise ValueError(
+                f"migration scale shape {tuple(scales.shape)} does "
+                f"not match destination scale pools (want {want_s}: "
+                "layers x (K,V) x blocks x heads)")
+        new_k, new_v = [], []
+        for li, (kp, vp) in enumerate(zip(k_pools, v_pools)):
+            new_k.append(QuantKV(
+                kp.codes.at[idx].set(
+                    jnp.asarray(data[li, 0], kp.codes.dtype)),
+                kp.scale.at[idx].set(
+                    jnp.asarray(scales[li, 0], kp.scale.dtype))))
+            new_v.append(QuantKV(
+                vp.codes.at[idx].set(
+                    jnp.asarray(data[li, 1], vp.codes.dtype)),
+                vp.scale.at[idx].set(
+                    jnp.asarray(scales[li, 1], vp.scale.dtype))))
+        return new_k, new_v
     new_k, new_v = [], []
     for li, (kp, vp) in enumerate(zip(k_pools, v_pools)):
         new_k.append(kp.at[idx].set(jnp.asarray(data[li, 0], kp.dtype)))
@@ -132,7 +226,9 @@ def import_blocks(k_pools, v_pools, block_ids, data):
 def payload_to_json(payload):
     """JSON-encode a migration payload for the HTTP wire: the
     ``kv["data"]`` numpy array becomes base64 bytes + dtype + shape
-    (``data_b64`` / ``data_dtype`` / ``data_shape``); everything else
+    (``data_b64`` / ``data_dtype`` / ``data_shape``), and a quantized
+    payload's ``kv["scales"]`` likewise (``scales_b64`` / ...) —
+    scales travel with their blocks over the wire.  Everything else
     in the payload is already JSON-shaped.  ``payload_from_json``
     inverts exactly."""
     import base64
@@ -141,38 +237,42 @@ def payload_to_json(payload):
     kv = payload.get("kv")
     if kv is not None:
         kv = dict(kv)
-        data = kv.pop("data", None)
-        if data is not None:
-            arr = np.ascontiguousarray(data)
-            kv["data_b64"] = base64.b64encode(
-                arr.tobytes()).decode("ascii")
-            kv["data_dtype"] = str(arr.dtype)
-            kv["data_shape"] = list(arr.shape)
+        for field in ("data", "scales"):
+            arr = kv.pop(field, None)
+            if arr is not None:
+                arr = np.ascontiguousarray(arr)
+                kv[f"{field}_b64"] = base64.b64encode(
+                    arr.tobytes()).decode("ascii")
+                kv[f"{field}_dtype"] = str(arr.dtype)
+                kv[f"{field}_shape"] = list(arr.shape)
         out["kv"] = kv
     return out
 
 
 def payload_from_json(obj):
     """Decode a ``payload_to_json`` wire dict back into the in-memory
-    payload form (``kv["data"]`` as a writable numpy array)."""
+    payload form (``kv["data"]`` — and ``kv["scales"]`` for
+    quantized payloads — as writable numpy arrays)."""
     import base64
     import numpy as np
     out = {k: v for k, v in obj.items() if k != "kv"}
     kv = obj.get("kv")
     if kv is not None:
         kv = dict(kv)
-        b64 = kv.pop("data_b64", None)
-        if b64 is not None:
-            dtype = np.dtype(kv.pop("data_dtype"))
-            shape = tuple(kv.pop("data_shape"))
-            kv["data"] = np.frombuffer(
-                base64.b64decode(b64), dtype=dtype).reshape(shape).copy()
+        for field in ("data", "scales"):
+            b64 = kv.pop(f"{field}_b64", None)
+            if b64 is not None:
+                dtype = np.dtype(kv.pop(f"{field}_dtype"))
+                shape = tuple(kv.pop(f"{field}_shape"))
+                kv[field] = np.frombuffer(
+                    base64.b64decode(b64),
+                    dtype=dtype).reshape(shape).copy()
         out["kv"] = kv
     return out
 
 
 def per_shard_block_bytes(block_size, num_heads, head_dim, dtype,
-                          n_layers, mp=1):
+                          n_layers, mp=1, scale_dtype=None):
     """PER-SHARD HBM cost of ONE logical KV block across every layer:
     ``n_layers * 2 (K and V) * block_size * (num_heads/mp) * head_dim
     * itemsize``.  Under a tensor-parallel mesh (Engine(mesh=...))
@@ -181,14 +281,27 @@ def per_shard_block_bytes(block_size, num_heads, head_dim, dtype,
     fixed per-chip budget (``Engine(kv_budget_mb=...)``) buys ``mp``x
     the logical blocks: KV capacity, the HBM ceiling on concurrent
     slots, scales with the mesh.  ``num_heads`` must divide by ``mp``
-    (attention shards whole heads)."""
+    (attention shards whole heads).
+
+    ``dtype`` is the STORED row dtype — int8 for a quantized pool
+    (``Engine(kv_dtype="int8")``), in which case ``scale_dtype``
+    (f32) adds the parallel scale pool's ``n_layers * 2 *
+    (num_heads/mp)`` per-block per-head multipliers, so the quoted
+    cost is the block's TRUE footprint and the int8/f32 capacity
+    ratio works out to ``4 / (1 + 4/(block_size*head_dim))`` (~3.8x
+    for the small test geometries, ~4x at real ones) instead of a
+    flattering byte-only 4x."""
     import numpy as np
     mp = int(mp)
     if mp < 1 or num_heads % mp:
         raise ValueError(
             f"num_heads ({num_heads}) must divide by mp ({mp})")
-    return (int(n_layers) * 2 * int(block_size) * (num_heads // mp)
-            * int(head_dim) * np.dtype(dtype).itemsize)
+    total = (int(n_layers) * 2 * int(block_size) * (num_heads // mp)
+             * int(head_dim) * np.dtype(dtype).itemsize)
+    if scale_dtype is not None:
+        total += (int(n_layers) * 2 * (num_heads // mp)
+                  * np.dtype(scale_dtype).itemsize)
+    return total
 
 
 class NoFreeBlocks(RuntimeError):

@@ -93,6 +93,7 @@ from collections import deque
 
 from .. import monitor
 from .faults import NetDisconnect, NetRefused, NetTimeout
+from .kvcache import KVDtypeMismatch
 from .request import Rejected
 
 # -- replica health states (the probe classifier's vocabulary) ----------
@@ -679,6 +680,13 @@ class Router:
                           # timeline.py --router) label sharded
                           # replicas without a second probe protocol
                           "mesh_shape", "mp",
+                          # quantized serving: dtype labels + block
+                          # byte split, so migration can pre-filter
+                          # kv_dtype-mismatched peers from the
+                          # registry instead of burning an import
+                          # round-trip on a guaranteed 400
+                          "weight_dtype", "kv_dtype",
+                          "kv_block_bytes", "kv_scale_bytes",
                           # disaggregated fleets advertise each
                           # replica's serving role the same way,
                           # and supervised ones their restart
@@ -1033,6 +1041,17 @@ class Router:
         body = dict(mig_payload)
         body["timeout_s"] = timeout_s
         tried = set(exclude)
+        # quantized serving: a peer whose probed kv_dtype disagrees
+        # with the payload's would reject the import with a
+        # kv_dtype_mismatch 400 anyway — pre-filter it from the
+        # candidate set (unknown signals pass: the import's own
+        # validation stays the source of truth)
+        want_dtype = (mig_payload.get("kv") or {}).get("dtype")
+        if want_dtype is not None:
+            for r in self._reps():
+                have = r.signals.get("kv_dtype")
+                if have is not None and str(have) != str(want_dtype):
+                    tried.add(r.name)
         n = 0
         for k in range(self.policy.retry_max + 1):
             try:
@@ -1509,6 +1528,12 @@ class InProcessReplica:
             "kv_block_size": (eng._bs if paged else None),
             "mesh_shape": getattr(eng, "mesh_axes", None),
             "mp": getattr(eng, "mp", 1),
+            "weight_dtype": getattr(eng, "_weight_dtype_str", None),
+            "kv_dtype": getattr(eng, "_kv_dtype_str", None),
+            "kv_block_bytes": getattr(eng, "_kv_code_bytes_per_shard",
+                                      None),
+            "kv_scale_bytes": getattr(
+                eng, "_kv_scale_bytes_per_shard", None),
             "drain_rate_tps": rate,
             "draining": bool(getattr(eng, "_draining", False)),
             "watchdog_fired": bool(getattr(eng, "_watchdog_fired",
@@ -1771,6 +1796,13 @@ class InProcessReplica:
                 str(e), status=503,
                 retry_after=getattr(e, "retry_after", None),
                 reason=type(e).__name__) from e
+        except KVDtypeMismatch as e:
+            # same machine-readable reason as httpd's 400: the
+            # pairing is wrong, not the payload — the router's
+            # pre-filter keys off this via the probed kv_dtype
+            raise ReplicaHTTPError(
+                f"replica {self.name} rejected the payload: {e} "
+                f"(op {t})", 400, reason="kv_dtype_mismatch") from e
         except (TypeError, ValueError) as e:
             # a geometry/shape mismatch is NON-retryable against any
             # identically-configured replica — surface it as a 400

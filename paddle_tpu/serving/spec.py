@@ -128,12 +128,26 @@ class DraftModelProposer(Proposer):
     thrash the program cache; eager drafting is correct at any length
     with zero compiles.  Histories longer than the draft model's
     position table are tail-truncated — a draft from a clipped context
-    is still just a guess, and verification keeps it honest."""
+    is still just a guess, and verification keeps it honest.
 
-    def __init__(self, draft_model):
+    ``weight_dtype="int8"`` relayouts the draft's transformer blocks
+    through weight-only int8 (serving/quant.py) before first use —
+    drafts are pure guesses that verification keeps honest, so the
+    draft model is the SAFEST place to quantize aggressively: a
+    rounding-flipped draft token costs at most one accepted lane,
+    never output correctness."""
+
+    def __init__(self, draft_model, weight_dtype=None):
+        if weight_dtype not in (None, "int8"):
+            raise ValueError(
+                f"DraftModelProposer: unsupported weight_dtype "
+                f"{weight_dtype!r} (only 'int8')")
         if getattr(draft_model, "scan_layers", False):
             draft_model = draft_model._sync_decode_twin()
         draft_model.eval()
+        if weight_dtype == "int8":
+            from .quant import relayout_weights_int8
+            relayout_weights_int8(draft_model)
         self.model = draft_model
         self.vocab_size = int(
             draft_model.embeddings.word_embeddings.weight.shape[0])

@@ -30,6 +30,7 @@ SCRIPTS = [
     "serving_selfhealing.py",
     "geo_async_ps.py",
     "onnx_export.py",
+    "serving_quantized.py",
 ]
 
 

@@ -83,9 +83,9 @@ def wall_summary(events):
     wall = phase = overlap = d2h_wait = ragged = 0.0
     allgather = shard_sync = 0.0
     mig_export = mig_wire = mig_import = 0.0
-    sup_restart = drain_mig = 0.0
+    sup_restart = drain_mig = dequant = 0.0
     n_ticks = n_ragged = n_allgather = n_migrations = 0
-    n_restarts = n_drain_migs = 0
+    n_restarts = n_drain_migs = n_dequants = 0
     for ev in events:
         if ev.get("ph") != "X":
             continue
@@ -141,6 +141,16 @@ def wall_summary(events):
             elif name == "drain.migrate":
                 drain_mig += dur
                 n_drain_migs += 1
+            elif name == "decode.dequant":
+                # int8-KV engines (Engine(kv_dtype="int8")): the
+                # host-side attribution span of a QUANTIZED dispatch
+                # — gather-side dequant rides inside the compiled
+                # program, so this is the per-tick cost of serving
+                # codes+scales instead of fp blocks, nested inside
+                # decode.dispatch/decode.ragged (double-counted in
+                # phase_ms like every nested span)
+                dequant += dur
+                n_dequants += 1
     return {
         "ticks": n_ticks, "wall_ms": wall, "phase_ms": phase,
         "per_tick_wall_ms": wall / n_ticks if n_ticks else float("nan"),
@@ -158,6 +168,8 @@ def wall_summary(events):
         "supervisor_restart_ms": sup_restart,
         "drain_migrations": n_drain_migs,
         "drain_migrate_ms": drain_mig,
+        "dequant_ms": dequant,
+        "dequant_dispatches": n_dequants,
     }
 
 
@@ -189,6 +201,12 @@ def format_wall(w):
             f"{w['migrate_wire_ms']:.3f} ms   migrate.import "
             f"{w['migrate_import_ms']:.3f} ms (KV block migration: "
             "source gather / payload transit / destination adopt)")
+    if w.get("dequant_dispatches"):
+        lines.append(
+            f"decode.dequant {w['dequant_ms']:.3f} ms over "
+            f"{w['dequant_dispatches']} quantized dispatches "
+            "(kv_dtype='int8': in-program dequant of int8 "
+            "codes+scales at gather)")
     if w.get("supervisor_restarts") or w.get("drain_migrations"):
         lines.append(
             f"supervisor.restart {w['supervisor_restart_ms']:.3f} ms "
