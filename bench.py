@@ -2356,6 +2356,179 @@ def bench_serving_quant():
     return result
 
 
+def bench_serving_lora():
+    """MULTI-ADAPTER LORA SERVING (serving/lora.py) + token streaming
+    (serving/stream.py).  The HEADLINE is consolidation: ONE engine
+    serving a mixed base + N-adapter workload through one compiled
+    program vs N+1 DEDICATED merged-weights engines serving the same
+    requests — the dedicated arm pays per-engine compiles and cannot
+    batch across models, so its requests run on whichever engine owns
+    their model while the multi arm batches everything per tick.
+    Compile-count flatness is ASSERTED in-bench: after warmup the
+    multi arm hot-loads another adapter and serves it with ZERO new
+    compiles, while the dedicated arm's total compile count scales
+    with N.  Greedy parity multi-vs-merged is asserted per adapter.
+    The streaming leg measures CLIENT-side TTFT: a TokenStream
+    consumer's first-token wall time vs the buffered full-response
+    wall on the same engine/workload — the streaming win is the tail
+    of the response, reported as a ratio.  Writes BENCH_r18.json."""
+    import time as _t
+
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import monitor
+    from paddle_tpu.models import GPTModel
+    from paddle_tpu.serving import Engine, LoRAAdapter, TokenStream
+
+    on_tpu = jax.default_backend() != "cpu"
+    cfg = "gpt2-medium" if on_tpu else "tiny"
+    L = 128 if on_tpu else 64
+    n_new = 24 if on_tpu else 12
+    n_reqs = 16
+    N_ADAPTERS = 3
+
+    def fresh_model():
+        paddle.seed(0)
+        model = GPTModel.from_config(cfg, dropout=0.0)
+        model.eval()
+        return model
+
+    base = fresh_model()
+    hidden = int(base.embeddings.word_embeddings.weight.shape[1])
+    n_layers = len(list(base.blocks))
+    adapters = {
+        f"ad{i}": LoRAAdapter.random(4, hidden, n_layers=n_layers,
+                                     seed=10 + i, scale=0.5)
+        for i in range(N_ADAPTERS)}
+    rng = np.random.RandomState(0)
+    vocab = int(base.embeddings.word_embeddings.weight.shape[0])
+    prompts = [rng.randint(0, vocab, (6 + i % 5,)).astype(np.int32)
+               for i in range(n_reqs)]
+    # round-robin model assignment: base, ad0, ad1, ad2, base, ...
+    models = [None if i % (N_ADAPTERS + 1) == 0
+              else f"ad{i % (N_ADAPTERS + 1) - 1}"
+              for i in range(n_reqs)]
+
+    def engine(model, **kw):
+        kw.setdefault("num_slots", 4)
+        kw.setdefault("max_seq_len", L)
+        kw.setdefault("kv_block_size", 8)
+        return Engine(model, registry=monitor.StatRegistry(), **kw)
+
+    # -- multi arm: one engine, one program, everything batched -------
+    multi = engine(base, adapters=dict(adapters),
+                   max_adapters=N_ADAPTERS + 2)
+    # warm the whole compile set: every distinct prompt length owns a
+    # prefill program, so flatness below isolates the LoRA/hot-load
+    # claim from ordinary shape warmup
+    for p in {len(p): p for p in prompts}.values():
+        multi.submit(p, max_new_tokens=2)
+        multi.submit(p, max_new_tokens=2, adapter="ad0")
+    multi.run_until_idle()
+    compiles_warm = multi.registry.get("serving.compiles_total").value
+    t0 = _t.monotonic()
+    reqs = [multi.submit(p, max_new_tokens=n_new, adapter=m)
+            for p, m in zip(prompts, models)]
+    # hot-load an extra adapter MID-TRAFFIC and serve it too
+    multi.load_adapter("hot", LoRAAdapter.random(
+        4, hidden, n_layers=n_layers, seed=99, scale=0.5))
+    reqs.append(multi.submit(prompts[0], max_new_tokens=n_new,
+                             adapter="hot"))
+    multi.run_until_idle()
+    multi_wall = _t.monotonic() - t0
+    multi_tokens = sum(len(r.generated) for r in reqs)
+    compiles_end = multi.registry.get("serving.compiles_total").value
+    assert compiles_end == compiles_warm, (
+        f"hot path recompiled: {compiles_warm} -> {compiles_end}")
+
+    # -- dedicated arm: one merged-weights engine per model -----------
+    dedicated_wall = 0.0
+    dedicated_tokens = 0
+    dedicated_compiles = 0.0
+    outs = {}
+    for name in [None] + sorted(adapters):
+        model = (fresh_model() if name is None
+                 else adapters[name].merge_into(fresh_model()))
+        eng = engine(model)
+        mine = [(i, p) for i, (p, m) in enumerate(zip(prompts, models))
+                if m == name]
+        eng.submit(mine[0][1], max_new_tokens=2)   # warm
+        eng.run_until_idle()
+        t0 = _t.monotonic()
+        rs = [(i, eng.submit(p, max_new_tokens=n_new)) for i, p in mine]
+        eng.run_until_idle()
+        dedicated_wall += _t.monotonic() - t0
+        dedicated_tokens += sum(len(r.generated) for _, r in rs)
+        dedicated_compiles += eng.registry.get(
+            "serving.compiles_total").value
+        for i, r in rs:
+            outs[i] = [int(x) for x in r.generated]
+    for i, r in enumerate(reqs[:n_reqs]):      # parity, every model
+        assert [int(x) for x in r.generated] == outs[i], \
+            f"multi-adapter lane diverged from merged weights: req {i}"
+
+    # -- streaming leg: client TTFT, streamed vs buffered -------------
+    seng = engine(base, adapters=dict(adapters))
+    seng.submit(prompts[0], max_new_tokens=2)
+    seng.run_until_idle()
+    seng.start()
+    t0 = _t.monotonic()
+    sreqs = [seng.submit(p, max_new_tokens=n_new, adapter=m)
+             for p, m in zip(prompts[:8], models[:8])]
+    stream = TokenStream(sreqs[0])
+    toks = stream.drain(timeout=120)
+    ttft_streamed = stream.first_token_t - t0
+    for r in sreqs:
+        r.result(timeout=120)
+    t0 = _t.monotonic()
+    breqs = [seng.submit(p, max_new_tokens=n_new, adapter=m)
+             for p, m in zip(prompts[:8], models[:8])]
+    breqs[0].result(timeout=120)
+    ttft_buffered = _t.monotonic() - t0        # full response wall
+    for r in breqs:
+        r.result(timeout=120)
+    seng.stop()
+    assert toks == [int(x) for x in sreqs[0].generated]
+
+    value = round(multi_tokens / multi_wall, 1)
+    result = {
+        "metric": "serving multi-LoRA consolidation: mixed base+"
+                  f"{N_ADAPTERS}-adapter aggregate tokens/sec, ONE "
+                  "engine / one compiled program (vs dedicated "
+                  "merged-weights engines, greedy parity asserted)",
+        "value": value,
+        "unit": "tokens/s (hot-load mid-traffic asserted zero new "
+                "compiles; dedicated arm serves the same requests on "
+                f"{N_ADAPTERS + 1} serial engines)",
+        "multi": {"tokens_per_s": value,
+                  "wall_s": round(multi_wall, 3),
+                  "tokens": int(multi_tokens),
+                  "compiles": compiles_end,
+                  "adapters_end": multi.adapters.names()},
+        "dedicated": {
+            "tokens_per_s": round(dedicated_tokens / dedicated_wall, 1),
+            "wall_s": round(dedicated_wall, 3),
+            "tokens": int(dedicated_tokens),
+            "compiles": dedicated_compiles,
+            "engines": N_ADAPTERS + 1},
+        "streaming": {
+            "ttft_streamed_s": round(ttft_streamed, 4),
+            "full_response_s": round(ttft_buffered, 4),
+            "ttft_win": round(ttft_buffered / max(ttft_streamed, 1e-9),
+                              2)},
+        "config": {"model": cfg, "num_slots": 4, "max_seq_len": L,
+                   "kv_block_size": 8, "n_adapters": N_ADAPTERS,
+                   "requests": n_reqs, "max_new_tokens": n_new},
+    }
+    try:
+        with open(os.path.join(REPO, "BENCH_r18.json"), "w") as f:
+            json.dump(result, f, indent=1)
+    except OSError:
+        pass  # read-only checkout: the returned numbers still land
+    return result
+
+
 CHILD_BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
                  "bert": bench_bert, "canary": bench_canary,
                  "decode": bench_decode, "serving": bench_serving,
@@ -2370,7 +2543,8 @@ CHILD_BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
                  "serving_sharded": bench_serving_sharded,
                  "serving_migration": bench_serving_migration,
                  "serving_supervisor": bench_serving_supervisor,
-                 "serving_quant": bench_serving_quant}
+                 "serving_quant": bench_serving_quant,
+                 "serving_lora": bench_serving_lora}
 
 
 def child_main(name, out_path):
@@ -2472,7 +2646,8 @@ def main():
                                            "serving_sharded",
                                            "serving_migration",
                                            "serving_supervisor",
-                                           "serving_quant"]
+                                           "serving_quant",
+                                           "serving_lora"]
     head_name = "gpt2" if "gpt2" in names else names[0]
 
     # Headline FIRST, printed and flushed the moment it lands — the
@@ -2512,6 +2687,9 @@ def main():
         "serving_quant": "serving quantized KV capacity ratio at a "
                          "fixed kv_budget_mb (int8 codes+scales vs "
                          "fp)",
+        "serving_lora": "serving multi-LoRA mixed-adapter aggregate "
+                        "tokens/sec, one engine/one program (vs "
+                        "dedicated merged-weights engines)",
     }[head_name]
 
     # Wedge canary before the expensive headline leg (full runs only —

@@ -1,0 +1,112 @@
+"""Multi-adapter LoRA serving + live token streaming, one engine.
+
+Two fine-tuned "models" and the base model served by ONE engine and
+ONE compiled program: each adapter's low-rank factors sit in a bank
+lane on device, gathered per slot by an ``adapter_id`` that is DATA in
+the compiled hot paths — so requests for different adapters batch
+TOGETHER in the same tick, and hot-loading a third adapter mid-traffic
+is a bank write, not a compile.
+
+The client side streams: a ``TokenStream`` attached to each request
+delivers tokens the tick they land (with per-token timestamps — the
+client-measured TTFT is printed), exactly the sequence the buffered
+result carries.
+
+The demo:
+1. serves a mixed batch (base + adapter A + adapter B) concurrently
+   and checks each adapter's stream against an OFFLINE merged-weights
+   oracle (the classic "merge the delta into the checkpoint" deploy);
+2. hot-loads adapter C while traffic is in flight and serves it with
+   ZERO new compiles (the engine's compile counter is printed before
+   and after);
+3. shows pinned unload refusal: an in-flight stream pins its adapter,
+   and unload succeeds only after the stream lands.
+
+Run: python examples/serving_lora.py
+"""
+import os
+import sys
+import time
+
+# allow running as `python examples/<script>.py` from a repo checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.models import GPTModel
+from paddle_tpu.serving import (AdapterInUse, Engine, LoRAAdapter,
+                                TokenStream)
+
+
+def fresh_model():
+    paddle.seed(0)
+    m = GPTModel.from_config("tiny", dropout=0.0)
+    m.eval()
+    return m
+
+
+def main():
+    model = fresh_model()
+    hidden = int(model.embeddings.word_embeddings.weight.shape[1])
+    n_layers = len(list(model.blocks))
+    mk = lambda seed, rank: LoRAAdapter.random(  # noqa: E731
+        rank, hidden, n_layers=n_layers, seed=seed, scale=0.5)
+    adapters = {"sql-assist": mk(11, 4), "chatty": mk(22, 2)}
+
+    eng = Engine(model, num_slots=4, max_seq_len=64, kv_block_size=8,
+                 adapters=dict(adapters), max_adapters=4,
+                 registry=monitor.StatRegistry())
+    eng.start()
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(0, 128, (6,)).astype(np.int32)
+
+    # -- 1. mixed batch: base + both adapters, one tick stream ---------
+    print("== mixed-adapter batch (one engine, one program) ==")
+    reqs = {name: eng.submit(prompt, max_new_tokens=10, adapter=name)
+            for name in (None, "sql-assist", "chatty")}
+    streams = {name: TokenStream(r) for name, r in reqs.items()}
+    t0 = time.monotonic()
+    for name, s in streams.items():
+        toks = s.drain(timeout=30)
+        ttft_ms = (s.first_token_t - t0) * 1e3
+        print(f"  {name or 'base':10s} ttft={ttft_ms:6.1f}ms "
+              f"tokens={toks}")
+    for name, ad in adapters.items():
+        oracle = Engine(ad.merge_into(fresh_model()), num_slots=2,
+                        max_seq_len=64, kv_block_size=8,
+                        registry=monitor.StatRegistry())
+        ref = oracle.submit(prompt, max_new_tokens=10)
+        oracle.run_until_idle()
+        assert streams[name].tokens == [int(t) for t in ref.generated]
+        print(f"  {name:10s} == offline merged-weights oracle: OK")
+
+    # -- 2. hot-load a third adapter mid-traffic -----------------------
+    print("== hot-load under traffic: zero new compiles ==")
+    before = eng.registry.get("serving.compiles_total").value
+    bg = eng.submit(prompt, max_new_tokens=24, adapter="chatty")
+    eng.load_adapter("support-bot", mk(33, 4))
+    r3 = eng.submit(prompt, max_new_tokens=8, adapter="support-bot")
+    toks = TokenStream(r3).drain(timeout=30)
+    after = eng.registry.get("serving.compiles_total").value
+    print(f"  compiles before={before} after={after} "
+          f"(adapters loaded: {eng.adapters.names()})")
+    assert after == before, "hot-load must not compile"
+
+    # -- 3. pinned unload refusal --------------------------------------
+    print("== unload while a stream pins the adapter ==")
+    try:
+        eng.unload_adapter("chatty")
+        raise AssertionError("unload must refuse while pinned")
+    except AdapterInUse as e:
+        print(f"  refused while in flight: {e}")
+    bg.result(timeout=30)
+    eng.unload_adapter("chatty")
+    print(f"  after drain: unloaded; serving {eng.adapters.names()}")
+    eng.stop()
+    print("done.")
+
+
+if __name__ == "__main__":
+    main()

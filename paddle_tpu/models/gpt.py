@@ -15,7 +15,9 @@ sharding stage2, GPT-3 1.3B hybrid) instantiate from ``GPT_CONFIGS``.
 from __future__ import annotations
 
 import math
+import threading
 import time
+from contextlib import contextmanager as _contextmanager
 
 from .. import nn
 from ..nn import functional as F
@@ -34,6 +36,32 @@ def _is_quant_kv(pool):
     a plain fp array — the paged attention paths branch on this to
     quantize at block write and dequantize at gather."""
     return hasattr(pool, "codes") and hasattr(pool, "scale")
+
+
+# Per-slot LoRA context (serving/lora.py).  Thread-local because jax
+# traces on the calling thread while sibling engines over ONE model
+# may trace concurrently — a plain module global would leak one
+# engine's adapter banks into another's program.
+_LORA_TLS = threading.local()
+
+
+@_contextmanager
+def _lora_scope(lora):
+    """Activate per-slot LoRA deltas for every ``_lora_out`` call
+    traced on this thread: ``lora`` is ``(adapter_id [B], a_bank
+    [n_lanes, n_layers, r, E], b_bank [n_lanes, n_layers, E, r])``;
+    empty means base model (the scope is a no-op, so the compiled
+    builders can take ``*lora`` varargs and engines without adapters
+    trace exactly the program they always traced)."""
+    if not lora:
+        yield
+        return
+    prev = getattr(_LORA_TLS, "ctx", None)
+    _LORA_TLS.ctx = tuple(lora)
+    try:
+        yield
+    finally:
+        _LORA_TLS.ctx = prev
 
 
 def sample_rows(last, temperature, top_k, top_p, seed_lo, seed_hi,
@@ -154,6 +182,38 @@ class GPTAttention(nn.Layer):
                                       weight_attr=init)
             self.out_proj = nn.Linear(hidden_size, hidden_size,
                                       weight_attr=init)
+        # which layer's LoRA factors this attention gathers —
+        # GPTModel.__init__ stamps the real index on the unrolled form
+        self._layer_idx = 0
+
+    def _lora_out(self, x):
+        """Output projection plus the per-slot LoRA delta: the one
+        injection point every decode/verify/chunk/ragged/forward path
+        funnels through.  With no active ``_lora_scope`` this IS
+        ``out_proj`` — zero cost, zero behavior change.  Inside a
+        scope, each batch row's ``adapter_id`` gathers its lane's
+        zero-padded [r, E]/[E, r] factors out of the banks as traced
+        DATA (lane 0 is all-zeros = base model), so one compiled
+        program serves every adapter mix:
+
+            y = out_proj(x) + (x @ a_sel^T) @ b_sel^T
+
+        (the LoRA alpha/rank scale is pre-folded into the stored b;
+        serving/lora.py pins this against the merged-weights oracle).
+        """
+        y = self.out_proj(x)
+        ctx = getattr(_LORA_TLS, "ctx", None)
+        if ctx is None:
+            return y
+        import jax.numpy as jnp
+        aid, a_bank, b_bank = ctx
+        li = self._layer_idx
+        a_sel = a_bank[:, li][aid]          # [B, r, E]
+        b_sel = b_bank[:, li][aid]          # [B, E, r]
+        xd = x._data
+        h = jnp.einsum("bse,bre->bsr", xd, a_sel)
+        d = jnp.einsum("bsr,ber->bse", h, b_sel)
+        return y + Tensor(d.astype(y._data.dtype))
 
     def _qkv_mp(self, x):
         from ..ops import einsum
@@ -209,7 +269,7 @@ class GPTAttention(nn.Layer):
         else:
             b = x.shape[0]
             out = reshape(out, [b, S, self.num_heads * self.head_dim])
-            out = self.out_proj(out)
+            out = self._lora_out(out)
         return out, k_buf, v_buf
 
     def _qkv_step(self, x):
@@ -261,7 +321,7 @@ class GPTAttention(nn.Layer):
                 self.out_bias
         else:
             out = reshape(out, [B, S, self.num_heads * self.head_dim])
-            out = self.out_proj(out)
+            out = self._lora_out(out)
         return out
 
     def decode_slots(self, x, k_buf, v_buf, pos):
@@ -498,7 +558,7 @@ class GPTAttention(nn.Layer):
                 self.out_bias
         else:
             out = reshape(out, [B, W, self.num_heads * self.head_dim])
-            out = self.out_proj(out)
+            out = self._lora_out(out)
         return out, new_k, new_v
 
     def prefill_chunk_paged(self, x, k_pool, v_pool, block_table, pos,
@@ -587,7 +647,7 @@ class GPTAttention(nn.Layer):
                 self.out_bias
         else:
             out = reshape(out, [1, C, self.num_heads * self.head_dim])
-            out = self.out_proj(out)
+            out = self._lora_out(out)
         return out, new_k, new_v
 
     def forward(self, x, cache=None, doc_segments=None):
@@ -645,7 +705,7 @@ class GPTAttention(nn.Layer):
                 self.out_bias
         else:
             out = reshape(out, [b, s, self.num_heads * self.head_dim])
-            out = self.out_proj(out)
+            out = self._lora_out(out)
         if cache is not None:
             return out, cache
         return out
@@ -878,6 +938,9 @@ class GPTModel(nn.Layer):
                          recompute_policy=recompute_policy,
                          use_sp=use_sp)
                 for i in range(num_layers)])
+            for i, blk in enumerate(self.blocks):
+                # each attention gathers ITS layer's LoRA factors
+                blk.attn._layer_idx = i
         self.head = GPTLMHead(hidden_size, vocab_size, use_mp)
 
     def forward(self, input_ids, labels=None, caches=None,
@@ -1482,10 +1545,10 @@ class GPTModel(nn.Layer):
 
         def pure(p_list, b_list, k_pools, v_pools, block_tables, toks,
                  width, mode, lanes, tok, pos, temp, top_k, top_p,
-                 seed_lo, seed_hi, ctr, eos, rem):
+                 seed_lo, seed_hi, ctr, eos, rem, *lora):
             with _swapped(params, dict(zip(pnames, p_list))), \
                     _swapped(mbuffers, dict(zip(bnames, b_list))):
-                with autograd.no_grad():
+                with autograd.no_grad(), _lora_scope(lora):
                     out = model._fused_ragged_tick_slots(
                         toks, k_pools, v_pools, block_tables, width,
                         mode, lanes, tok, pos, temp, top_k, top_p,
@@ -1601,10 +1664,10 @@ class GPTModel(nn.Layer):
         if paged:
             def pure(p_list, b_list, k_pools, v_pools, block_tables,
                      tok, pos, temp, top_k, top_p, seed_lo, seed_hi,
-                     ctr, eos, rem):
+                     ctr, eos, rem, *lora):
                 with _swapped(params, dict(zip(pnames, p_list))), \
                         _swapped(mbuffers, dict(zip(bnames, b_list))):
-                    with autograd.no_grad():
+                    with autograd.no_grad(), _lora_scope(lora):
                         out = model._fused_decode_tick_slots(
                             tok, k_pools, v_pools, pos, temp, top_k,
                             top_p, seed_lo, seed_hi, ctr, eos, rem,
@@ -1612,10 +1675,11 @@ class GPTModel(nn.Layer):
                 return out
         else:
             def pure(p_list, b_list, k_pools, v_pools, tok, pos, temp,
-                     top_k, top_p, seed_lo, seed_hi, ctr, eos, rem):
+                     top_k, top_p, seed_lo, seed_hi, ctr, eos, rem,
+                     *lora):
                 with _swapped(params, dict(zip(pnames, p_list))), \
                         _swapped(mbuffers, dict(zip(bnames, b_list))):
-                    with autograd.no_grad():
+                    with autograd.no_grad(), _lora_scope(lora):
                         out = model._fused_decode_tick_slots(
                             tok, k_pools, v_pools, pos, temp, top_k,
                             top_p, seed_lo, seed_hi, ctr, eos, rem)
@@ -1661,10 +1725,10 @@ class GPTModel(nn.Layer):
         if paged:
             def pure(p_list, b_list, k_pools, v_pools, block_tables,
                      toks, lanes, pos, temp, top_k, top_p, seed_lo,
-                     seed_hi, ctr, eos, rem):
+                     seed_hi, ctr, eos, rem, *lora):
                 with _swapped(params, dict(zip(pnames, p_list))), \
                         _swapped(mbuffers, dict(zip(bnames, b_list))):
-                    with autograd.no_grad():
+                    with autograd.no_grad(), _lora_scope(lora):
                         out = model._fused_spec_verify_tick_slots(
                             toks, k_pools, v_pools, pos, lanes, temp,
                             top_k, top_p, seed_lo, seed_hi, ctr, eos,
@@ -1673,10 +1737,10 @@ class GPTModel(nn.Layer):
         else:
             def pure(p_list, b_list, k_pools, v_pools, toks, lanes,
                      pos, temp, top_k, top_p, seed_lo, seed_hi, ctr,
-                     eos, rem):
+                     eos, rem, *lora):
                 with _swapped(params, dict(zip(pnames, p_list))), \
                         _swapped(mbuffers, dict(zip(bnames, b_list))):
-                    with autograd.no_grad():
+                    with autograd.no_grad(), _lora_scope(lora):
                         out = model._fused_spec_verify_tick_slots(
                             toks, k_pools, v_pools, pos, lanes, temp,
                             top_k, top_p, seed_lo, seed_hi, ctr, eos,
@@ -1822,10 +1886,10 @@ class GPTModel(nn.Layer):
         bnames = sorted(mbuffers)
 
         def pure(p_list, b_list, k_pools, v_pools, ids_arr, slot_idx,
-                 pos, true_len):
+                 pos, true_len, *lora):
             with _swapped(params, dict(zip(pnames, p_list))), \
                     _swapped(mbuffers, dict(zip(bnames, b_list))):
-                with autograd.no_grad():
+                with autograd.no_grad(), _lora_scope(lora):
                     k_bufs = [jax.lax.dynamic_slice(
                         kp, (slot_idx, 0, 0, 0), (1, L, nh, hd))
                         for kp in k_pools]
@@ -1875,10 +1939,10 @@ class GPTModel(nn.Layer):
         bnames = sorted(mbuffers)
 
         def pure(p_list, b_list, k_pools, v_pools, ids_arr, block_table,
-                 pos, true_len):
+                 pos, true_len, *lora):
             with _swapped(params, dict(zip(pnames, p_list))), \
                     _swapped(mbuffers, dict(zip(bnames, b_list))):
-                with autograd.no_grad():
+                with autograd.no_grad(), _lora_scope(lora):
                     last, new_k, new_v = \
                         model._chunk_prefill_tick_paged(
                             ids_arr, k_pools, v_pools, block_table,
@@ -1988,10 +2052,10 @@ class GPTModel(nn.Layer):
             return pool.at[tail_blocks].set(tail.astype(pool.dtype))
 
         def pure(p_list, b_list, k_pools, v_pools, ids_arr, ctx_blocks,
-                 tail_blocks):
+                 tail_blocks, *lora):
             with _swapped(params, dict(zip(pnames, p_list))), \
                     _swapped(mbuffers, dict(zip(bnames, b_list))):
-                with autograd.no_grad():
+                with autograd.no_grad(), _lora_scope(lora):
                     caches = [(Tensor(_ctx_rows(kp, ctx_blocks)),
                                Tensor(_ctx_rows(vp, ctx_blocks)))
                               for kp, vp in zip(k_pools, v_pools)]
@@ -2327,10 +2391,10 @@ class GPTModel(nn.Layer):
         mbuffers = dict(self.named_buffers())
         bnames = sorted(mbuffers)
 
-        def pure(p_list, b_list, ids_arr):
+        def pure(p_list, b_list, ids_arr, *lora):
             with _swapped(params, dict(zip(pnames, p_list))), \
                     _swapped(mbuffers, dict(zip(bnames, b_list))):
-                with autograd.no_grad():
+                with autograd.no_grad(), _lora_scope(lora):
                     empty = [(Tensor(jnp.zeros((b, 0, nh, hd),
                                                kv_dtype)),
                               Tensor(jnp.zeros((b, 0, nh, hd),
@@ -2377,10 +2441,10 @@ class GPTModel(nn.Layer):
         mbuffers = dict(self.named_buffers())
         bnames = sorted(mbuffers)
 
-        def pure(p_list, b_list, ids_arr, true_len):
+        def pure(p_list, b_list, ids_arr, true_len, *lora):
             with _swapped(params, dict(zip(pnames, p_list))), \
                     _swapped(mbuffers, dict(zip(bnames, b_list))):
-                with autograd.no_grad():
+                with autograd.no_grad(), _lora_scope(lora):
                     empty = [(Tensor(jnp.zeros((b, 0, nh, hd),
                                                kv_dtype)),
                               Tensor(jnp.zeros((b, 0, nh, hd),

@@ -62,6 +62,17 @@ bounded by a timeout); and ``serving.faults`` provides the
 deterministic chaos harness (seeded fault schedule over
 dispatch/d2h/pool/host sites + a tick watchdog that converts wedged
 dispatches into flight-recorded recoveries).
+``Engine(adapters={name: LoRAAdapter(...)})`` adds MULTI-ADAPTER
+serving (``serving.lora``): every adapter's low-rank factors live in
+two fixed-shape device banks gathered by a per-slot ``adapter_id``
+INSIDE the compiled hot paths — one program serves every adapter,
+hot-load/unload is pure data movement (zero recompiles), and
+in-flight requests pin their adapter against unload.
+``serving.stream`` adds live TOKEN STREAMING: a ``TokenStream``
+attaches to a request with exactly-once replay-then-subscribe
+semantics, httpd/routerd answer ``{"stream": true}`` as SSE, and the
+router's ``generate(on_token=...)`` splices failover/migration
+continuations into one seamless stream.
 """
 from .request import (  # noqa: F401
     Request, RequestQueue, RequestTimeout, QueueFull, Rejected,
@@ -75,13 +86,18 @@ from .spec import (  # noqa: F401
 from .faults import (  # noqa: F401
     FaultInjector, InjectedFault, NetDisconnect, NetFault, NetRefused,
     NetTimeout, TickWatchdog, WatchdogTimeout)
+from .lora import (  # noqa: F401
+    AdapterInUse, AdapterRegistry, LoRAAdapter, RegistryFull,
+    UnknownAdapter)
+from .stream import (  # noqa: F401
+    StreamClosed, StreamEvent, TokenStream, parse_sse, sse_format)
 from .engine import Engine  # noqa: F401
 from .httpd import EngineServer, serve  # noqa: F401
 from .router import (  # noqa: F401
     CircuitBreaker, HttpReplicaClient, InProcessReplica,
     NoReplicasAvailable, Replica, ReplicaAbandoned, ReplicaHTTPError,
     ReplicaUnavailable, RequestFailed, Router, RouterError,
-    RouterPolicy, affinity_key)
+    RouterPolicy, UnknownModel, affinity_key)
 from .routerd import RouterServer  # noqa: F401
 from .supervisor import (  # noqa: F401
     FleetSupervisor, ProcessReplica, SupervisorPolicy,
@@ -98,7 +114,12 @@ __all__ = [
     "FaultInjector", "InjectedFault", "TickWatchdog",
     "WatchdogTimeout",
     "NetFault", "NetRefused", "NetTimeout", "NetDisconnect",
+    "LoRAAdapter", "AdapterRegistry", "AdapterInUse", "RegistryFull",
+    "UnknownAdapter",
+    "TokenStream", "StreamEvent", "StreamClosed", "sse_format",
+    "parse_sse",
     "Router", "RouterPolicy", "RouterServer", "RouterError",
+    "UnknownModel",
     "NoReplicasAvailable", "RequestFailed", "Replica",
     "ReplicaAbandoned", "ReplicaHTTPError", "ReplicaUnavailable",
     "CircuitBreaker", "HttpReplicaClient", "InProcessReplica",

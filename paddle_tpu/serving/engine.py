@@ -44,6 +44,7 @@ from .. import monitor
 from .kvcache import (BlockPool, KVDtypeMismatch, PrefixCache,
                       export_blocks, import_blocks,
                       per_shard_block_bytes)
+from .lora import AdapterRegistry, LoRAAdapter, UnknownAdapter
 from .request import (MAX_SEED, DeadlineShed, QueueFull, RateLimited,
                       Request, RequestQueue, TenantPolicy, TokenBucket)
 from .scheduler import Scheduler
@@ -459,7 +460,8 @@ class Engine:
                  trace_capacity=16384, trace_annotations=False,
                  flight_dir=None, tenants=None, preemption=True,
                  shed_deadlines=True, faults=None, watchdog_s=None,
-                 weight_dtype=None, kv_dtype=None):
+                 weight_dtype=None, kv_dtype=None, adapters=None,
+                 max_adapters=None, max_lora_rank=None):
         if getattr(model, "scan_layers", False):
             model = model._sync_decode_twin()
         model.eval()
@@ -864,6 +866,43 @@ class Engine:
         self._zero_scale_fn = None  # jitted fresh-block scale zeroer
         #   (kv_dtype='int8'; compiled once per config — see
         #   _zero_fresh_scales)
+        # -- multi-adapter (LoRA) lanes (serving/lora.py) ---------------
+        # "which adapter" is per-slot DATA gathered from fixed-shape
+        # banks inside the traced programs, so every adapter — loaded
+        # now or hot-loaded later — shares the engine's one compiled
+        # program per config.
+        self.adapters = None
+        if adapters is not None or max_adapters is not None:
+            if sample_mode != "device":
+                raise ValueError(
+                    "adapters require sample_mode='device': the host "
+                    "sampling paths dispatch per-layer programs that "
+                    "do not thread the per-slot LoRA lanes")
+            if self.mesh is not None or attn0.use_mp:
+                raise ValueError(
+                    "adapters cannot combine with tensor-parallel "
+                    "serving (mesh=... / use_mp models): the LoRA "
+                    "delta rides the dense out_proj form")
+            init = dict(adapters or {})
+            for _nm, _ad in init.items():
+                if not isinstance(_ad, LoRAAdapter):
+                    raise TypeError(
+                        f"adapters[{_nm!r}] must be a LoRAAdapter, "
+                        f"got {type(_ad).__name__}")
+            n_ad = (int(max_adapters) if max_adapters is not None
+                    else max(len(init), 1))
+            if n_ad < len(init):
+                raise ValueError(
+                    f"max_adapters={n_ad} cannot hold the "
+                    f"{len(init)} adapters passed at construction")
+            r_max = (int(max_lora_rank) if max_lora_rank is not None
+                     else max([a.rank for a in init.values()] or [8]))
+            hidden = int(
+                model.embeddings.word_embeddings.weight.shape[1])
+            self.adapters = AdapterRegistry(
+                len(list(model.blocks)), hidden, n_ad, r_max)
+            for _nm in sorted(init):
+                self.adapters.load(_nm, init[_nm])
         # -- tracing / flight recorder ---------------------------------
         self.tracer = (monitor.Tracer(capacity=trace_capacity,
                                       annotate=trace_annotations)
@@ -1205,6 +1244,8 @@ class Engine:
         # dispatching tick N+1 before consuming tick N safe
         self._eos = np.full(self.num_slots, -1, np.int32)
         self._rem = np.zeros(self.num_slots, np.int32)
+        # per-slot LoRA lane (0 = base model); mirrors like the rest
+        self._aid = np.zeros(self.num_slots, np.int32)
         self._dev_state = None   # device handles of the step state
         self._state_dirty = True  # device copies stale vs the mirrors
         self._ring = []  # dispatched-but-unconsumed ticks, oldest
@@ -1214,7 +1255,7 @@ class Engine:
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, eos_token_id=None,
                timeout=None, temperature=1.0, top_k=0, top_p=1.0,
-               seed=None, priority=0, tenant=None):
+               seed=None, priority=0, tenant=None, adapter=None):
         """Queue one generation request; returns its Request handle
         (block on ``request.result()``).
 
@@ -1255,10 +1296,19 @@ class Engine:
                 f"seed must be in [0, 2**63), got {seed}: the device "
                 "sampling key derivation packs the seed into two "
                 "32-bit words, and the host rng rejects negatives too")
+        if adapter is not None:
+            if self.adapters is None:
+                raise UnknownAdapter(
+                    f"adapter {adapter!r} requested but this engine "
+                    "serves none (Engine(adapters=... / "
+                    "max_adapters=N))")
+            adapter = str(adapter)
+            self.adapters.lane(adapter)  # raises UnknownAdapter now,
+            #   in the caller's thread, instead of failing mid-admit
         req = Request(prompt, max_new_tokens, eos_token_id=eos_token_id,
                       timeout=timeout, temperature=temperature,
                       top_k=top_k, top_p=top_p, seed=seed,
-                      priority=priority, tenant=tenant)
+                      priority=priority, tenant=tenant, adapter=adapter)
         total = len(req.prompt) + req.max_new_tokens
         margin = self._spec_k or 0
         if total + margin > self.max_seq_len:
@@ -1328,10 +1378,22 @@ class Engine:
         self.tracer.instant("req.queued", cat="request", req=req.id,
                             prompt=int(len(req.prompt)),
                             max_new=req.max_new_tokens,
-                            priority=req.priority, tenant=req.tenant)
+                            priority=req.priority, tenant=req.tenant,
+                            adapter=req.adapter)
+        if adapter is not None:
+            # pin AFTER every shed check — a shed submit must not
+            # leak a lane reference.  The pin drops via the request's
+            # finish callback; every terminal path (evict, queue
+            # timeout/expire, drain, Migrated) runs _finish.
+            req._adapter_id = self.adapters.pin(adapter)
+            req._finish_cbs.append(
+                lambda _r, _n=adapter: self.adapters.unpin(_n))
         try:
             self.queue.put(req)
         except QueueFull as e:
+            if adapter is not None:
+                self.adapters.unpin(adapter)  # never queued — the
+                #   finish callback will not run
             if bucket is not None:
                 bucket.refund(req.cost_tokens)  # see deadline shed
             self._m_shed_queue.inc()
@@ -1378,6 +1440,36 @@ class Engine:
         self._b_arrays = None
         if self._paged and self.prefix_cache is not None:
             self.prefix_cache.clear()
+
+    # -- LoRA lane plumbing (serving/lora.py) --------------------------
+    def _lora_key(self, key):
+        """Extend a compiled-path cache key with the adapter-bank
+        geometry.  Adapter IDENTITY is data (the per-slot lane index);
+        only n_lanes/r_max — fixed at construction — shape the trace,
+        so loading adapter #2, #3, ... never mints a new program."""
+        if self.adapters is None:
+            return key
+        return key + (("lora", self.adapters.n_lanes,
+                       self.adapters.r_max),)
+
+    def _lora_args_state(self, st):
+        """Trailing ``*lora`` operands of a fused dispatch: the
+        device-resident per-slot lane ids plus the two banks.  Empty
+        when this engine serves no adapters — adapter-free engines
+        trace exactly the programs they always traced."""
+        if self.adapters is None:
+            return ()
+        return (st["aid"], self.adapters.a_bank, self.adapters.b_bank)
+
+    def _lora_args_slot(self, req):
+        """B=1 prefill/chunk variant: one slot's lane as a [1] lane
+        array (prefill programs are per-request, so the lane rides the
+        call instead of the pooled state)."""
+        if self.adapters is None:
+            return ()
+        import jax.numpy as jnp
+        return (jnp.asarray([req._adapter_id], jnp.int32),
+                self.adapters.a_bank, self.adapters.b_bank)
 
     # -- overload protection: drain estimate / shedding / faults -------
     # drain-rate staleness horizon: entries older than this are
@@ -1525,11 +1617,14 @@ class Engine:
         ctx = (np.concatenate([req.prompt,
                                np.asarray(req.generated, np.int32)])
                if req.generated else req.prompt)
-        if self._paged and self.prefix_cache is not None:
+        if self._paged and self.prefix_cache is not None \
+                and not req._adapter_id:
             # slot.pos rows of K/V are computed (decoding slots: the
             # last emitted token's row is pending, exactly pos rows
             # valid; prefilling slots: pos == prefilled) — only full
-            # blocks under that bound are adoptable
+            # blocks under that bound are adoptable.  Adapter lanes
+            # never share: LoRA on out_proj shifts the residual
+            # stream, so layers >= 1 K/V depend on the adapter
             n_full = min(slot.pos // self._bs,
                          len(self._slot_blocks[i]))
             if n_full:
@@ -1793,6 +1888,75 @@ class Engine:
             "prefix_in", payload=payload))
         return self._await_demand(d, wait, timeout)
 
+    # -- hot adapter load / unload (serving/lora.py) -------------------
+    def load_adapter(self, name, adapter, wait=True, timeout=30.0):
+        """Hot-load a LoRA adapter under ``name`` while serving.  The
+        swap rides the migration-demand machinery: the ENGINE THREAD
+        services it at the next tick boundary after draining any
+        in-flight async ring, so the bank write is single-writer and
+        no dispatched tick straddles it.  Pure data movement — bank
+        shapes are fixed at construction, so the compile probe sees
+        nothing.  Raises RegistryFull (no free lane), ValueError
+        (shape mismatch / duplicate name), or an injected
+        ``adapter_load`` fault (banks and inventory untouched)."""
+        if self.adapters is None:
+            raise RuntimeError(
+                "this engine serves no adapters: construct with "
+                "Engine(adapters=...) or max_adapters=N to reserve "
+                "bank lanes")
+        if not isinstance(adapter, LoRAAdapter):
+            raise TypeError(
+                f"expected LoRAAdapter, got {type(adapter).__name__}")
+        d = self._register_demand(_MigrateDemand(
+            "adapter_load", name=str(name), adapter=adapter))
+        return self._adapter_await(d, wait, timeout)
+
+    def unload_adapter(self, name, wait=True, timeout=30.0):
+        """Unload adapter ``name``: refuse (AdapterInUse) while any
+        in-flight request pins it, else zero its lane and free it.
+        Same tick-boundary servicing as ``load_adapter``."""
+        if self.adapters is None:
+            raise RuntimeError("this engine serves no adapters")
+        d = self._register_demand(_MigrateDemand(
+            "adapter_unload", name=str(name)))
+        return self._adapter_await(d, wait, timeout)
+
+    def _adapter_await(self, d, wait, timeout):
+        t = self._thread
+        if t is None or not t.is_alive():
+            # no background loop running: service inline on the
+            # caller's thread (the single-writer rule holds — nothing
+            # else is stepping; synchronous drivers call load/unload
+            # between their own step() calls)
+            self._service_migrations(self.tracer)
+        return self._await_demand(d, wait, timeout)
+
+    def _service_adapter(self, d, tr):
+        """Engine-thread half of load/unload_adapter.  Drains the
+        async ring first — a dispatched tick read the OLD banks and
+        must be consumed against them before the lane flips.  Handles
+        its own failure (d.fail) so the drained-token count always
+        reaches the tick accounting.  Returns tokens emitted by the
+        drain."""
+        emitted = self._drain_ring(tr) if self._ring else 0
+        name = d.args["name"]
+        try:
+            with tr.span("lora.swap", cat="serving", op=d.kind,
+                         adapter=name):
+                self._fault("adapter_load")
+                if d.kind == "adapter_load":
+                    lane = self.adapters.load(name, d.args["adapter"])
+                    tr.instant("adapter.loaded", cat="serving",
+                               adapter=name, lane=lane)
+                else:
+                    lane = self.adapters.unload(name)
+                    tr.instant("adapter.unloaded", cat="serving",
+                               adapter=name, lane=lane)
+            d.complete({"name": name, "lane": lane})
+        except Exception as e:  # noqa: BLE001 — verdict channel
+            d.fail(e)
+        return emitted
+
     def _service_migrations(self, tr):
         """Engine-thread service point, called at the top of both tick
         paths: pop the registered demands, act on each (an "out" whose
@@ -1817,6 +1981,8 @@ class Engine:
                         keep.append(d)
                 elif d.kind == "in":
                     self._service_migrate_in(d, tr)
+                elif d.kind in ("adapter_load", "adapter_unload"):
+                    emitted += self._service_adapter(d, tr)
                 elif d.kind == "prefix_out":
                     self._service_prefix_out(d, tr)
                 else:
@@ -1847,6 +2013,7 @@ class Engine:
         if rid is None:
             cands = [s for s in self.scheduler.busy_slots()
                      if s.request is not None and s.decoding
+                     and not s.request._adapter_id
                      and len(s.request.generated)
                      >= d.args["min_tokens"]]
             if not cands:
@@ -1892,6 +2059,18 @@ class Engine:
             return "wait", 0  # no eligible victim yet
         if req.done():
             self._finish_out_done(d, req)
+            return "done", 0
+        if req._adapter_id:
+            # the payload format carries no adapter identity — a
+            # destination would resume through its BASE lane, silently
+            # changing the model mid-stream.  The router's failover
+            # path (re-submit prompt+emitted with model=) covers
+            # adapter streams instead.
+            d.fail(RuntimeError(
+                f"request {req.id} decodes through adapter "
+                f"{req.adapter!r}: KV migration does not carry "
+                "adapter lanes — drain it, or let the caller fail "
+                "over with prompt+emitted"))
             return "done", 0
         if slot is None or not slot.decoding \
                 or len(req.generated) < d.args["min_tokens"]:
@@ -2218,6 +2397,18 @@ class Engine:
         return self.tracer.chrome_trace(
             process_name=f"paddle_tpu-serving pid={os.getpid()}")
 
+    def streams_active(self):
+        """Live TokenStream sinks across slot-bound + queued requests
+        — the /healthz streaming-load signal (cheap: two locked
+        snapshots, no device work)."""
+        n = 0
+        for s in self.scheduler.busy_slots():
+            if s.request is not None:
+                n += len(s.request._sinks)
+        for r in self.queue.pending():
+            n += len(r._sinks)
+        return n
+
     def debug_requests(self):
         """In-flight slot/request states + queued requests as plain
         JSON-able dicts — the ``/debug/requests`` payload and the
@@ -2234,6 +2425,7 @@ class Engine:
             for s in inf.slots:
                 cursor_tick[s.index] = inf.tick
         slots = []
+        streams_active = 0
         for view in self.scheduler.debug_view():
             view["cursor_tick"] = cursor_tick.get(view["slot"])
             req = view.pop("request")
@@ -2247,20 +2439,27 @@ class Engine:
                 view["age_ms"] = round((now - req.submitted_at) * 1e3,
                                        3)
                 view["preemptions"] = req.preemptions
+                view["adapter"] = req.adapter
+                view["streams"] = len(req._sinks)
+                streams_active += len(req._sinks)
             if self._paged:
                 view["kv_blocks"] = len(self._slot_blocks[view["slot"]])
             slots.append(view)
-        queued = [{
-            "request_id": r.id, "prompt_len": int(len(r.prompt)),
-            "max_new_tokens": r.max_new_tokens,
-            "priority": r.priority, "tenant": r.tenant,
-            "preemptions": r.preemptions,
-            "queued_ms": round((now - r.submitted_at) * 1e3, 3),
-            "deadline_in_s": (None if r.deadline is None
-                              else round(r.deadline - now, 3)),
-        } for r in self.queue.pending()]
+        queued = []
+        for r in self.queue.pending():
+            streams_active += len(r._sinks)
+            queued.append({
+                "request_id": r.id, "prompt_len": int(len(r.prompt)),
+                "max_new_tokens": r.max_new_tokens,
+                "priority": r.priority, "tenant": r.tenant,
+                "preemptions": r.preemptions, "adapter": r.adapter,
+                "queued_ms": round((now - r.submitted_at) * 1e3, 3),
+                "deadline_in_s": (None if r.deadline is None
+                                  else round(r.deadline - now, 3)),
+            })
         return {
             "tick": self.tick_no, "slots": slots, "queue": queued,
+            "streams_active": streams_active,
             "in_flight_ticks": [inf.tick for inf in ring],
             "preemptions": self._preempt_history()[-16:],
             "migrations": self._migration_history()[-16:],
@@ -2286,6 +2485,10 @@ class Engine:
                 "preemption": self._preemption,
                 "draining": self._draining,
                 "watchdog_s": self.watchdog_s,
+                "adapters_loaded": (0 if self.adapters is None
+                                    else len(self.adapters)),
+                "adapters": (None if self.adapters is None
+                             else self.adapters.describe()),
             }}
 
     def _record_flight(self, exc):
@@ -2367,7 +2570,10 @@ class Engine:
         n_total = -(-(s + req.remaining + (self._spec_k or 0))
                     // self._bs)
         ctx, m = ([], 0)
-        if self.prefix_cache is not None:
+        if self.prefix_cache is not None and not req._adapter_id:
+            # adapter lanes never share cached K/V: LoRA on out_proj
+            # shifts the residual stream, so layers >= 1 K/V depend
+            # on the adapter — a base-lane prefix would be wrong
             ctx, m = self.prefix_cache.match(tokens)
         need = n_total - len(ctx)
         short = need - self.block_pool.free_count()
@@ -2514,6 +2720,9 @@ class Engine:
         self._eos[i] = (-1 if req.eos_token_id is None
                         else int(req.eos_token_id))
         self._rem[i] = req.remaining
+        # LoRA lane: which adapter this slot decodes through (0 =
+        # base).  Data like everything else here — never a retrace.
+        self._aid[i] = req._adapter_id
         self._state_dirty = True
 
     def _park_state(self, i):
@@ -2533,6 +2742,7 @@ class Engine:
         self._sctr[i] = 0
         self._eos[i] = -1
         self._rem[i] = 0  # rem 0 = the device freezes this lane
+        self._aid[i] = 0  # parked compute runs the base lane (zeros)
         self._state_dirty = True
 
     def _push_state(self):
@@ -2576,6 +2786,8 @@ class Engine:
                 topk=put(self._topk), topp=put(self._topp),
                 slo=put(self._seed_lo), shi=put(self._seed_hi),
                 eos=put(self._eos), rem=put(self._rem))
+            if self.adapters is not None:
+                self._dev_state["aid"] = put(self._aid)
             if self._paged:
                 self._dev_state["tables"] = put(self._block_tables)
         self._state_dirty = False
@@ -2599,16 +2811,18 @@ class Engine:
         n_tail = -(-s // self._bs) - n_ctx
         pf, _, _ = self.model._compiled_paged_prefill_fn(
             self._pnames, self._params,
-            (s_tail, n_ctx, n_tail, self._bs, self._kv_dtype_str,
-             tuple(self._pnames), self._bnames_all),
+            self._lora_key(
+                (s_tail, n_ctx, n_tail, self._bs, self._kv_dtype_str,
+                 tuple(self._pnames), self._bnames_all)),
             s_tail, n_ctx, n_tail, self._bs, self._nh, self._hd,
             self._kv_dtype)
         last0, self.k_pools, self.v_pools = pf(
             self._p_list(), self._b_list(), self.k_pools, self.v_pools,
             tokens[None, m:],
             jnp.asarray(np.asarray(ctx, np.int32)),
-            jnp.asarray(np.asarray(fresh[:n_tail], np.int32)))
-        if self.prefix_cache is not None:
+            jnp.asarray(np.asarray(fresh[:n_tail], np.int32)),
+            *self._lora_args_slot(req))
+        if self.prefix_cache is not None and not req._adapter_id:
             self.prefix_cache.insert(tokens, blocks[:s // self._bs])
         self._m_prefill_tokens.inc(s_tail)
         slot.pos = s
@@ -2635,21 +2849,25 @@ class Engine:
             S = next(b for b in self._prefill_buckets if b >= s)
             pf, _, _ = self.model._compiled_bucket_prefill_fn(
                 self._pnames, self._params,
-                (1, S, L, self._kv_dtype_str, tuple(self._pnames),
-                 self._bnames_all),
+                self._lora_key(
+                    (1, S, L, self._kv_dtype_str, tuple(self._pnames),
+                     self._bnames_all)),
                 1, S, L, self._nh, self._hd, self._kv_dtype)
             ids = np.zeros((1, S), np.int32)
             ids[0, :s] = tokens
             last0, k_bufs, v_bufs = pf(self._p_list(), self._b_list(),
-                                       ids, jnp.asarray(s, jnp.int32))
+                                       ids, jnp.asarray(s, jnp.int32),
+                                       *self._lora_args_slot(req))
         else:
             pf, _, _ = self.model._compiled_prefill_fn(
                 self._pnames, self._params,
-                (1, s, L, self._kv_dtype_str, tuple(self._pnames),
-                 self._bnames_all),
+                self._lora_key(
+                    (1, s, L, self._kv_dtype_str, tuple(self._pnames),
+                     self._bnames_all)),
                 1, s, L, self._nh, self._hd, self._kv_dtype)
             last0, k_bufs, v_bufs = pf(self._p_list(), self._b_list(),
-                                       tokens[None, :])
+                                       tokens[None, :],
+                                       *self._lora_args_slot(req))
         i = slot.index
         if self._insert_fn is None:
             import jax
@@ -2721,28 +2939,32 @@ class Engine:
             if self._paged:
                 fn, _, _ = self.model._compiled_paged_chunk_prefill_fn(
                     self._pnames, self._params,
-                    (C, self._kv_managed + 1, self._bs, self._bps,
-                     self._kv_dtype_str, tuple(self._pnames),
-                     self._bnames_all))
+                    self._lora_key(
+                        (C, self._kv_managed + 1, self._bs, self._bps,
+                         self._kv_dtype_str, tuple(self._pnames),
+                         self._bnames_all)))
                 last0, self.k_pools, self.v_pools = fn(
                     self._p_list(), self._b_list(), self.k_pools,
                     self.v_pools, ids,
                     jnp.asarray(self._block_tables[i]),
                     jnp.asarray(p0, jnp.int32),
-                    jnp.asarray(n, jnp.int32))
+                    jnp.asarray(n, jnp.int32),
+                    *self._lora_args_slot(req))
             else:
                 fn, _, _ = self.model._compiled_chunk_prefill_fn(
                     self._pnames, self._params,
-                    (C, self.num_slots, self.max_seq_len,
-                     self._kv_dtype_str, tuple(self._pnames),
-                     self._bnames_all),
+                    self._lora_key(
+                        (C, self.num_slots, self.max_seq_len,
+                         self._kv_dtype_str, tuple(self._pnames),
+                         self._bnames_all)),
                     C, self.max_seq_len, self._nh, self._hd,
                     self._kv_dtype)
                 last0, self.k_pools, self.v_pools = fn(
                     self._p_list(), self._b_list(), self.k_pools,
                     self.v_pools, ids, jnp.asarray(i, jnp.int32),
                     jnp.asarray(p0, jnp.int32),
-                    jnp.asarray(n, jnp.int32))
+                    jnp.asarray(n, jnp.int32),
+                    *self._lora_args_slot(req))
         slot.prefilled = p0 + n
         slot.pos = slot.prefilled
         self._m_chunks.inc()
@@ -2757,7 +2979,8 @@ class Engine:
         # final chunk: the context's full blocks become adoptable and
         # the last real position's logits sample the first token (TTFT
         # on a fresh admission; the NEXT stream token on a resume)
-        if self._paged and self.prefix_cache is not None:
+        if self._paged and self.prefix_cache is not None \
+                and not req._adapter_id:
             self.prefix_cache.insert(tokens,
                                      self._slot_blocks[i][:s // self._bs])
         self._pos[i] = s
@@ -2839,7 +3062,15 @@ class Engine:
         max_new_tokens, else arm the slot for the next tick."""
         req = slot.request
         now = time.monotonic()
-        req.generated.append(int(tok))
+        if req._sinks:
+            # live streaming consumers: fan the token out under the
+            # sink lock (exactly-once vs a concurrent attach replay);
+            # spanned so trace_view --wall prices the fan-out
+            with self.tracer.span("stream.emit", cat="serving",
+                                  req=req.id):
+                req._emit_token(int(tok))
+        else:
+            req._emit_token(int(tok))
         if req.first_token_at is None:
             req.first_token_at = now
             self._m_ttft.observe((now - req.submitted_at) * 1e3)
@@ -3062,11 +3293,12 @@ class Engine:
             self._fused_spec_fn, _, _ = \
                 self.model._compiled_fused_spec_verify_fn(
                     self._pnames, self._params,
-                    ("paged" if self._paged else "slot", W,
-                     self.num_slots,
-                     (self._kv_managed + 1, self._bs) if self._paged
-                     else self.max_seq_len, self._kv_dtype_str,
-                     tuple(self._pnames), self._bnames_all),
+                    self._lora_key(
+                        ("paged" if self._paged else "slot", W,
+                         self.num_slots,
+                         (self._kv_managed + 1, self._bs) if self._paged
+                         else self.max_seq_len, self._kv_dtype_str,
+                         tuple(self._pnames), self._bnames_all)),
                     paged=self._paged)
         args = [self._p_list(), self._b_list(), self.k_pools,
                 self.v_pools]
@@ -3074,7 +3306,8 @@ class Engine:
             args.append(st["tables"])
         args += [jnp.asarray(toks), jnp.asarray(lanes), st["pos"],
                  st["temp"], st["topk"], st["topp"], st["slo"],
-                 st["shi"], st["ctr"], st["eos"], st["rem"]]
+                 st["shi"], st["ctr"], st["eos"], st["rem"],
+                 *self._lora_args_state(st)]
         self._fault("dispatch")
         with tr.span("decode.dispatch", batch=len(active),
                      layout=layout, spec_w=W, fused=True), \
@@ -3199,10 +3432,11 @@ class Engine:
         if self._fused_fn is None:
             self._fused_fn, _, _ = self.model._compiled_fused_decode_fn(
                 self._pnames, self._params,
-                ("paged" if self._paged else "slot", self.num_slots,
-                 (self._kv_managed + 1, self._bs) if self._paged
-                 else self.max_seq_len, self._kv_dtype_str,
-                 tuple(self._pnames), self._bnames_all),
+                self._lora_key(
+                    ("paged" if self._paged else "slot", self.num_slots,
+                     (self._kv_managed + 1, self._bs) if self._paged
+                     else self.max_seq_len, self._kv_dtype_str,
+                     tuple(self._pnames), self._bnames_all)),
                 paged=self._paged)
         args = [self._p_list(), self._b_list(), self.k_pools,
                 self.v_pools]
@@ -3210,7 +3444,7 @@ class Engine:
             args.append(st["tables"])
         args += [st["tok"], st["pos"], st["temp"], st["topk"],
                  st["topp"], st["slo"], st["shi"], st["ctr"],
-                 st["eos"], st["rem"]]
+                 st["eos"], st["rem"], *self._lora_args_state(st)]
         layout = "paged" if self._paged else "contiguous"
         self._fault("dispatch")
         with tr.span("decode.dispatch", batch=len(active),
@@ -3358,9 +3592,11 @@ class Engine:
             self._ragged_fn, _, _ = \
                 self.model._compiled_ragged_window_fn(
                     self._pnames, self._params,
-                    (self.num_slots, W, spec_w, self._kv_managed + 1,
-                     self._bs, self._kv_dtype_str,
-                     tuple(self._pnames), self._bnames_all),
+                    self._lora_key(
+                        (self.num_slots, W, spec_w,
+                         self._kv_managed + 1, self._bs,
+                         self._kv_dtype_str, tuple(self._pnames),
+                         self._bnames_all)),
                     emit_w=spec_w)
         self._fault("dispatch")
         with tr.span("decode.ragged", batch=len(active) + len(plan),
@@ -3374,7 +3610,8 @@ class Engine:
                 jnp.asarray(width), jnp.asarray(mode),
                 jnp.asarray(lanes), st["tok"], st["pos"], st["temp"],
                 st["topk"], st["topp"], st["slo"], st["shi"],
-                st["ctr"], st["eos"], st["rem"])
+                st["ctr"], st["eos"], st["rem"],
+                *self._lora_args_state(st))
         st["tok"], st["pos"], st["ctr"], st["rem"] = \
             new_tok, new_pos, new_ctr, new_rem
         self._m_fused_ticks.inc()
@@ -3427,7 +3664,8 @@ class Engine:
                     continue
                 if mode_i == 2:
                     ctxt = req.context
-                    if self.prefix_cache is not None:
+                    if self.prefix_cache is not None \
+                            and not req._adapter_id:
                         self.prefix_cache.insert(
                             ctxt,
                             self._slot_blocks[i][:len(ctxt)

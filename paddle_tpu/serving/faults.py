@@ -166,6 +166,36 @@ site                  checked at                        action
                                                         replica ends
                                                         QUARANTINED
 ====================  ===============================  ==============
+
+Front-end sites (the LoRA + streaming tier — ``adapter_load`` is
+checked by the engine while servicing a hot load/unload demand with
+the servicing tick as the ``tick``; ``stream_disconnect`` by a
+streaming consumer with its per-server stream ordinal as the
+``tick``):
+
+====================  ===============================  ==============
+site                  checked at                        action
+====================  ===============================  ==============
+``adapter_load``      engine, servicing a               raises
+                      load_adapter / unload_adapter     InjectedFault
+                      demand (before touching the       — the demand
+                      banks)                            fails, banks
+                                                        and inventory
+                                                        untouched
+``stream_disconnect`` streaming consumer (TokenStream   raises
+                      / SSE edge), mid-iteration        StreamDisconnect
+                                                        after a
+                                                        schedule-
+                                                        derived number
+                                                        of tokens —
+                                                        the client
+                                                        vanished; the
+                                                        request keeps
+                                                        decoding,
+                                                        delivered
+                                                        tokens stay
+                                                        delivered
+====================  ===============================  ==============
 """
 from __future__ import annotations
 
@@ -202,6 +232,14 @@ class NetTimeout(NetFault):
     path), so only idempotent work should be blindly re-sent."""
 
 
+class StreamDisconnect(NetFault):
+    """Injected streaming-client death: the SSE consumer vanished
+    mid-response.  Server side this is indistinguishable from a TCP
+    reset — the handler stops writing and releases the stream; the
+    tokens already delivered stay delivered (exactly-once), the
+    request itself keeps decoding to completion."""
+
+
 class NetDisconnect(NetFault):
     """Injected mid-body disconnect: the response stream died after
     ``emitted`` tokens were already received.  A failover can resume
@@ -220,7 +258,16 @@ NET_SITES = ("net_refuse", "net_blackhole", "net_slow",
              "net_disconnect")
 MIGRATE_SITES = ("migrate_export", "migrate_wire", "migrate_import")
 PROC_SITES = ("proc_kill9", "proc_stop", "proc_crashloop")
-SITES = ENGINE_SITES + NET_SITES + MIGRATE_SITES + PROC_SITES
+# Front-end sites (LoRA + streaming tier): ``adapter_load`` is checked
+# by the engine while servicing a load/unload demand (tick = the
+# engine tick servicing it) — firing fails THAT demand only, banks and
+# inventory untouched; ``stream_disconnect`` is checked by a streaming
+# consumer (TokenStream / the SSE edge) with its per-server stream
+# ordinal as the tick — firing simulates the client vanishing
+# mid-response, which the server loop sees as a dead socket.
+FRONTEND_SITES = ("adapter_load", "stream_disconnect")
+SITES = (ENGINE_SITES + NET_SITES + MIGRATE_SITES + PROC_SITES
+         + FRONTEND_SITES)
 
 
 class FaultInjector:
@@ -391,6 +438,14 @@ class FaultInjector:
             if arm is not None:
                 arm()
             return
+        if site == "adapter_load":
+            raise InjectedFault(
+                f"injected adapter load/unload failure at tick {tick}: "
+                "the demand fails, banks and inventory untouched")
+        if site == "stream_disconnect":
+            raise StreamDisconnect(
+                f"injected streaming-client death at stream {tick}: "
+                "the SSE consumer vanished mid-response")
 
 
 

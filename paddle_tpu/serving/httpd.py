@@ -7,12 +7,24 @@ full submit -> queue -> slot -> result path over a real socket):
   POST /generate   {"prompt": [1,2,3], "max_new_tokens": 8,
                     "eos_token_id": null, "timeout": null,
                     "temperature": 1.0, "top_k": 0, "top_p": 1.0,
-                    "priority": 0, "tenant": null}
+                    "priority": 0, "tenant": null,
+                    "adapter": null, "stream": false}
                 -> {"ids": [...], "generated": [...], "ttft_ms": ...}
                    overload: 503 QueueFull / DeadlineShed, 429
                    RateLimited — each with a COMPUTED Retry-After
                    (queue backlog over the measured drain rate /
-                   token-bucket refill time), not a fixed constant
+                   token-bucket refill time), not a fixed constant.
+                   "adapter" routes through a loaded LoRA lane (404
+                   {"reason": "unknown_adapter"} otherwise).
+                   "stream": true switches the response to SSE
+                   (text/event-stream, no buffering): one "token"
+                   event per generated token the tick it lands,
+                   ":hb" comment frames on idle gaps, and a terminal
+                   "done" event carrying the full /generate payload
+                   — or a terminal "error" event with the reason and
+                   retry_after when the stream is shed or dies
+                   mid-response (the client never sees a silently
+                   truncated body)
   GET  /metrics    Prometheus text exposition (monitor registry)
   GET  /healthz    {"slots_free": n, "queue_depth": n,
                     "kv_blocks_free": n|null, ...} — always carries
@@ -77,8 +89,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import monitor
 from .kvcache import KVDtypeMismatch, payload_to_json
+from .lora import UnknownAdapter
 from .request import (DeadlineShed, RateLimited, Rejected,
                       RequestTimeout)
+from .stream import TokenStream, sse_format
 
 
 def _retry_after_header(e):
@@ -293,6 +307,20 @@ class _Handler(JsonHandler):
                     getattr(eng, "_m_overlap", None)),
                 "d2h_wait_ms": _hist_mean(
                     getattr(eng, "_m_d2h_wait", None)),
+                # multi-adapter serving: the loaded inventory is a
+                # ROUTING signal — the router's pick() filters
+                # replicas on it for model= requests
+                "adapters": (
+                    eng.adapters.names()
+                    if getattr(eng, "adapters", None) is not None
+                    else []),
+                "adapters_loaded": (
+                    len(eng.adapters)
+                    if getattr(eng, "adapters", None) is not None
+                    else 0),
+                "streams_active": (
+                    eng.streams_active()
+                    if hasattr(eng, "streams_active") else 0),
             }
             # overload-protection signals: preemption / shed counts,
             # the measured drain rate behind Retry-After estimates,
@@ -403,7 +431,14 @@ class _Handler(JsonHandler):
                 top_p=float(body.get("top_p", 1.0)),
                 seed=body.get("seed"),
                 priority=int(body.get("priority", 0)),
-                tenant=body.get("tenant"))
+                tenant=body.get("tenant"),
+                adapter=body.get("adapter"))
+        except UnknownAdapter as e:
+            # 404, not 400: the request is well-formed — THIS replica
+            # lacks the adapter.  The router retries elsewhere on it.
+            self._send_json(404, {"error": str(e),
+                                  "reason": "unknown_adapter"})
+            return
         except Rejected as e:
             # every shed (QueueFull / DeadlineShed 503, RateLimited
             # 429) carries the engine's COMPUTED backoff: queue
@@ -423,6 +458,9 @@ class _Handler(JsonHandler):
             # connection
             self._send_json(400, {"error": str(e),
                                   "reason": "bad_request"})
+            return
+        if body.get("stream"):
+            self._stream_response(req)
             return
         try:
             ids = req.result(timeout=self.result_timeout)
@@ -467,6 +505,109 @@ class _Handler(JsonHandler):
             "generated": [int(x) for x in req.generated],
             "ttft_ms": ttft,
         })
+
+    # -- SSE streaming (POST /generate {"stream": true}) ---------------
+    def _stream_response(self, req):
+        """Server half of token streaming: headers out immediately
+        (text/event-stream, no Content-Length, proxy buffering off),
+        then one ``token`` event per generated token the tick the
+        engine emits it — the handler thread drains the request's
+        TokenStream sink while the engine thread decodes.  Idle gaps
+        emit ``:hb`` comment frames (keep-alive + dead-client
+        detection).  The stream ALWAYS ends with a terminal event:
+        ``done`` carrying the full /generate payload, or ``error``
+        with the machine-readable reason and retry_after — a shed or
+        preempt-timeout mid-stream is an honest terminal frame, never
+        a silently truncated body.  A SIGTERM-drain migration is
+        SPLICED: the peer's relayed tokens beyond what was already
+        streamed continue the same SSE stream seamlessly."""
+        stream = TokenStream(req, heartbeat_s=0.25)
+        self.close_connection = True  # the frame has no length; it
+        #   ends when the connection does
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("X-Accel-Buffering", "no")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        deadline = time.monotonic() + self.result_timeout
+        sent = 0
+        try:
+            for ev in stream:
+                if ev.kind == "token":
+                    self.wfile.write(sse_format(
+                        {"token": int(ev.token),
+                         "index": int(ev.index)}, event="token"))
+                    sent += 1
+                elif ev.kind == "heartbeat":
+                    if time.monotonic() > deadline:
+                        self.wfile.write(sse_format(
+                            {"error": "no terminal event before "
+                             "result_timeout",
+                             "reason": "result_timeout",
+                             "retry_after": None}, event="error"))
+                        return
+                    self.wfile.write(sse_format(comment="hb"))
+                elif ev.kind == "done":
+                    ttft = None
+                    if req.first_token_at is not None:
+                        ttft = round((req.first_token_at
+                                      - req.submitted_at) * 1e3, 3)
+                    self.wfile.write(sse_format({
+                        "id": req.id,
+                        "ids": [int(t) for t in req.prompt]
+                        + [int(t) for t in req.generated],
+                        "generated": [int(t) for t in req.generated],
+                        "ttft_ms": ttft, "streamed": sent,
+                    }, event="done"))
+                    return
+                else:
+                    self._stream_error(req, ev.error, sent)
+                    return
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # the client vanished mid-stream: nothing to answer; the
+            # engine lands the request and this sink dies with the
+            # handler thread
+            pass
+
+    def _stream_error(self, req, err, sent):
+        """Terminal frame for a stream that did not finish cleanly.
+        Migrated + a draining EngineServer is the one recoverable
+        case: await the drain relay and SPLICE the peer's completion
+        into the live stream (tokens beyond ``sent`` — the ones this
+        socket has not yet delivered — then ``done``)."""
+        from .engine import Migrated
+        srv = getattr(self, "engine_server", None)
+        if isinstance(err, Migrated) and srv is not None:
+            found, resp = srv.await_relay(req.id,
+                                          timeout=self.result_timeout)
+            if found and resp is not None:
+                gen = [int(t) for t in resp.get("generated", [])]
+                for j in range(sent, len(gen)):
+                    self.wfile.write(sse_format(
+                        {"token": gen[j], "index": j}, event="token"))
+                out = dict(resp)
+                out["migrated"] = True
+                out["streamed"] = sent + max(len(gen) - sent, 0)
+                self.wfile.write(sse_format(out, event="done"))
+                return
+            self.wfile.write(sse_format(
+                {"error": str(err),
+                 "reason": "drain_failed" if found else "internal",
+                 "retry_after": None}, event="error"))
+            return
+        if isinstance(err, RequestTimeout):
+            reason = "result_timeout"
+        elif isinstance(err, Rejected):
+            reason = _shed_reason(err, draining=bool(
+                getattr(self.engine, "_draining", False)))
+        else:
+            reason = "internal"
+        self.wfile.write(sse_format(
+            {"error": str(err), "reason": reason,
+             "retry_after": getattr(err, "retry_after", None)},
+            event="error"))
 
     def _read_body(self):
         n = int(self.headers.get("Content-Length", 0))

@@ -152,7 +152,7 @@ class Request:
 
     def __init__(self, prompt, max_new_tokens, eos_token_id=None,
                  timeout=None, temperature=1.0, top_k=0, top_p=1.0,
-                 seed=None, priority=0, tenant=None):
+                 seed=None, priority=0, tenant=None, adapter=None):
         self.id = next(_req_ids)
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
@@ -172,7 +172,18 @@ class Request:
         #   admit this one
         self.tenant = (DEFAULT_TENANT if tenant is None
                        else str(tenant))
+        self.adapter = None if adapter is None else str(adapter)
+        self._adapter_id = 0         # LoRA lane (0 = base model);
+        #   resolved by Engine.submit against its adapter registry
         self.generated = []          # ints, appended by the engine
+        # Streaming sinks: TokenStream consumers attached by the HTTP
+        # edge (or any caller).  The lock makes append+fan-out vs
+        # attach-with-replay atomic, so a sink attached between two
+        # emits sees every token exactly once.  _finish_cbs fire once
+        # on completion (adapter unpin, server-side accounting).
+        self._sink_lock = threading.Lock()
+        self._sinks = []
+        self._finish_cbs = []
         self.submitted_at = time.monotonic()
         self.deadline = (self.submitted_at + float(timeout)
                          if timeout is not None else None)
@@ -234,10 +245,30 @@ class Request:
         return (time.monotonic() if now is None else now) > self.deadline
 
     # -- engine side -----------------------------------------------------
+    def _emit_token(self, tok):
+        """Record one generated token and fan it out to any attached
+        streams — atomically, so a stream attaching concurrently
+        replays exactly the tokens it will not be fed live."""
+        with self._sink_lock:
+            self.generated.append(tok)
+            idx = len(self.generated) - 1
+            for s in self._sinks:
+                s.feed(tok, idx)
+
     def _finish(self, error=None):
         self.error = error
         self.finished_at = time.monotonic()
+        with self._sink_lock:
+            sinks, self._sinks = self._sinks, []
+            cbs, self._finish_cbs = self._finish_cbs, []
         self._done.set()
+        for s in sinks:
+            s.close(error)
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception:
+                pass  # completion hooks must not mask the result
 
     # -- caller side -----------------------------------------------------
     def done(self):

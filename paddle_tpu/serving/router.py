@@ -76,6 +76,17 @@ and a bounded structured ``route_log()`` whose entries are a pure
 function of the seed + the replica fault schedule — seeded chaos
 storms replay the same routing decisions (tests assert it).
 
+* **Model routing & token streaming.**  Replicas advertise their
+  loaded LoRA adapter inventory (``adapters``) in probes;
+  ``generate(model=...)`` restricts the pick to replicas serving that
+  adapter (``UnknownModel`` — the front door's 404 — when nobody
+  does).  ``generate(on_token=...)`` streams: the transport forwards
+  each token the moment the replica emits it, hedging is disabled
+  (two live streams cannot both win), and a mid-stream failover
+  resumes on a peer with the continuation SPLICED into the same
+  callback — every global token index is delivered exactly once even
+  across disconnects and migrations.
+
 Transports: ``HttpReplicaClient`` speaks to a real ``serving.httpd``
 endpoint; ``InProcessReplica`` wraps a local ``Engine`` directly (the
 tier-1 test / bench / single-host fleet transport) and threads the
@@ -94,7 +105,9 @@ from collections import deque
 from .. import monitor
 from .faults import NetDisconnect, NetRefused, NetTimeout
 from .kvcache import KVDtypeMismatch
+from .lora import UnknownAdapter
 from .request import Rejected
+from .stream import TokenStream, parse_sse
 
 # -- replica health states (the probe classifier's vocabulary) ----------
 HEALTHY = "healthy"      # probing clean; full routing weight
@@ -119,6 +132,13 @@ class RouterError(RuntimeError):
 
 class NoReplicasAvailable(RouterError):
     """Every registered replica is dead, draining, or breaker-open."""
+
+
+class UnknownModel(RouterError):
+    """``generate(model=...)`` named an adapter NO registered replica
+    advertises in its probed inventory — the caller's fault (the HTTP
+    front door maps it to 404 ``{"reason": "unknown_adapter"}``),
+    never retried."""
 
 
 class RequestFailed(RouterError):
@@ -691,7 +711,12 @@ class Router:
                           # replica's serving role the same way,
                           # and supervised ones their restart
                           # generation
-                          "role", "incarnation")})
+                          "role", "incarnation",
+                          # multi-LoRA serving: the adapter inventory
+                          # is what pick(model=...) routes on, and
+                          # live stream counts label the fleet in
+                          # timeline.py --router
+                          "adapters", "streams_active")})
                     if self._kv_bs is None \
                             and info.get("kv_block_size"):
                         self._kv_bs = int(info["kv_block_size"])
@@ -790,18 +815,30 @@ class Router:
         return best[1] if best else None
 
     def pick(self, prompt, exclude=(), rid=None, attempt=0,
-             phase=None):
+             phase=None, model=None):
         """One routing decision: (replica, how) where how is
         ``affinity`` / ``load`` / ``random`` / ``last_resort``.
         ``phase`` (``prefill`` / ``decode``) restricts the candidate
         set to replicas of that ROLE — exact-role replicas when any
         exist, else role-or-mixed; a phase slice with nothing
         routable falls back to the whole fleet (disaggregation
-        degrades before it fails).  Raises NoReplicasAvailable when
-        nothing at all is routable."""
+        degrades before it fails).  ``model`` restricts it to
+        replicas whose probed adapter inventory lists that LoRA
+        adapter — UnknownModel when NO replica advertises it (the
+        fleet genuinely cannot serve it), NoReplicasAvailable when
+        some do but none is routable right now (retryable).  Raises
+        NoReplicasAvailable when nothing at all is routable."""
         key = affinity_key(prompt, self.block_size())
         exclude = set(exclude)
         reps = self._reps()
+        if model is not None:
+            have = [r for r in reps
+                    if model in (r.signals.get("adapters") or ())]
+            if not have:
+                raise UnknownModel(
+                    f"no replica among {len(reps)} advertises "
+                    f"adapter {model!r}")
+            reps = have
         if phase is not None:
             exact = [r for r in reps if r.role == phase]
             reps = exact or [r for r in reps
@@ -825,7 +862,8 @@ class Router:
             if phase is not None:
                 # the role slice is unroutable: degrade to whole-
                 # fleet routing before failing the request outright
-                return self.pick(prompt, exclude, rid, attempt)
+                return self.pick(prompt, exclude, rid, attempt,
+                                 model=model)
             raise NoReplicasAvailable(
                 f"no routable replica among {len(reps)}: "
                 + ", ".join(f"{r.name}={r.state}/{r.breaker.state}"
@@ -884,20 +922,24 @@ class Router:
         return self.policy.hedge_floor_s
 
     def _attempt(self, rep, payload, rid, abort_extra=None,
-                 op="generate"):
+                 op="generate", on_token=None):
         """One dispatch against one replica: inflight accounting,
         breaker bookkeeping, abandon hook.  ``op`` names the client
         method (``generate`` / ``migrate_export`` /
-        ``migrate_import``) — all share the transport contract."""
+        ``migrate_import``) — all share the transport contract.
+        ``on_token`` (generate only) asks the transport to STREAM:
+        it fires per token as the replica emits it."""
 
         def should_abort():
             return (self._stopping or rep.state == DEAD
                     or (abort_extra is not None and abort_extra()))
 
+        kw = {"should_abort": should_abort}
+        if on_token is not None and op == "generate":
+            kw["on_token"] = on_token
         rep.track(+1)
         try:
-            resp = getattr(rep.client, op)(payload,
-                                           should_abort=should_abort)
+            resp = getattr(rep.client, op)(payload, **kw)
         except Exception as e:
             if self._stopping \
                     or (abort_extra is not None and abort_extra()):
@@ -1212,17 +1254,43 @@ class Router:
 
     def generate(self, prompt, max_new_tokens=16, eos_token_id=None,
                  temperature=1.0, top_k=0, top_p=1.0, seed=None,
-                 priority=0, tenant=None, timeout=None):
+                 priority=0, tenant=None, timeout=None, model=None,
+                 on_token=None):
         """Route one generation request; blocks until a replica
         delivers it (HTTP handler threads are the expected callers —
         the router is I/O-bound, not compute-bound).  Returns a dict:
         ``ids`` (prompt + generated), ``generated``, ``replica`` (the
         serving one), ``attempts``, ``req`` (router-side id), plus the
         replica's reported fields.  Raises RequestFailed /
-        NoReplicasAvailable after classification + retries."""
+        NoReplicasAvailable after classification + retries.
+
+        ``model`` routes to replicas advertising that LoRA adapter
+        (UnknownModel when none does).  ``on_token`` streams: it
+        fires once per generated token, BY GLOBAL INDEX exactly once,
+        even across failovers — a resumed greedy stream forwards only
+        its continuation, a seeded restart suppresses the re-played
+        prefix, and a migrated stream splices the resumed tokens in
+        seamlessly.  Streaming disables hedging (two live streams
+        cannot both win) and the disaggregated split (its tokens
+        arrive via migration responses, not a live stream)."""
         rid = next(self._rids)
         self._m_reqs.inc()
         prompt = [int(t) for t in prompt]
+        sent = 0              # tokens DELIVERED to on_token, by index
+
+        def _deliver(toks, base):
+            # exactly-once by global token index: forward only the
+            # indices the caller has not seen yet (salvaged prefixes
+            # and seeded replays are suppressed, gaps are impossible
+            # because every source is a contiguous run from its base)
+            nonlocal sent
+            if on_token is None:
+                return
+            for i, tok in enumerate(toks):
+                g = base + i
+                if g >= sent:
+                    on_token(int(tok))
+                    sent = g + 1
         do_sample = (int(top_k or 0) > 0 or float(temperature) != 1.0
                      or float(top_p) < 1.0)
         idempotent = (not do_sample) or seed is not None
@@ -1252,6 +1320,7 @@ class Router:
                 # ``attempt`` was already bumped past the disconnect,
                 # so hand _serve the index of the LAST dispatch made —
                 # "attempts" must count dispatches, not loop turns
+                _deliver(emitted, 0)
                 return self._serve(rid, prompt, emitted, [], None,
                                    attempt - 1, t0)
             attempt_timeout = self.policy.request_timeout_s
@@ -1270,7 +1339,22 @@ class Router:
                 "tenant": tenant,
                 "timeout_s": attempt_timeout,
             }
-            if self.policy.disaggregate \
+            if model is not None:
+                payload["adapter"] = model
+            fwd = None
+            if on_token is not None:
+                # catch the caller up on anything salvaged since the
+                # last dispatch, then hand the transport a forwarder
+                # anchored at this attempt's resume point — its
+                # attempt-local token i is global index base + i
+                _deliver(emitted, 0)
+                _base = len(emitted)
+                _ctr = itertools.count()
+
+                def fwd(tok, _b=_base, _c=_ctr):
+                    _deliver([tok], _b + next(_c))
+            if self.policy.disaggregate and on_token is None \
+                    and model is None \
                     and self._disagg_split(exclude):
                 out = self._disagg_attempt(
                     payload, rid, prompt, exclude,
@@ -1292,7 +1376,8 @@ class Router:
                 with self.tracer.span("route.pick", cat="router",
                                       req=rid, attempt=attempt) as sp:
                     rep, how = self.pick(prompt, exclude=exclude,
-                                         rid=rid, attempt=attempt)
+                                         rid=rid, attempt=attempt,
+                                         model=model)
                     if not rep.breaker.acquire():
                         # raced a concurrent half-open trial: treat as
                         # a retryable miss
@@ -1309,15 +1394,16 @@ class Router:
                         and not emitted:
                     self._warm_prefix(rep, prompt, rid)
                 use_hedge = (self.policy.hedge and idempotent
-                             and attempt == 0)
+                             and attempt == 0 and on_token is None)
                 hedged = False
                 if use_hedge:
                     served_by, resp, hedged = self._hedged_attempt(
                         rep, payload, rid, prompt, exclude)
                 else:
-                    resp = self._attempt(rep, payload, rid)
+                    resp = self._attempt(rep, payload, rid,
+                                         on_token=fwd)
                     served_by = rep
-            except NoReplicasAvailable:
+            except (NoReplicasAvailable, UnknownModel):
                 self._m_failed.inc()
                 raise
             except StreamMigrated as e:
@@ -1341,6 +1427,13 @@ class Router:
                         "route.migrated", cat="router", req=rid,
                         source=rep.name, dest=dest.name,
                         blocks=resp.get("migrated_blocks"))
+                    # the import's response carries the stream's FULL
+                    # token history: splice the unseen tail into the
+                    # live stream (indices already forwarded before
+                    # the migration are suppressed by _deliver)
+                    _deliver(emitted
+                             + [int(x) for x in
+                                resp.get("generated", [])], 0)
                     return self._serve(rid, prompt, emitted,
                                        resp.get("generated", []),
                                        dest, attempt + n, t0, resp)
@@ -1407,6 +1500,8 @@ class Router:
                 continue
             # a fired hedge was a real second dispatch: "attempts"
             # counts dispatches, whichever slot won
+            _deliver(emitted
+                     + [int(x) for x in resp.get("generated", [])], 0)
             return self._serve(rid, prompt, emitted,
                                resp.get("generated", []),
                                served_by, attempt + (1 if hedged
@@ -1540,9 +1635,15 @@ class InProcessReplica:
                                            False)),
             "role": self.role,
             "incarnation": self.incarnation,
+            "adapters": (eng.adapters.names()
+                         if getattr(eng, "adapters", None) is not None
+                         else []),
+            "streams_active": (eng.streams_active()
+                               if hasattr(eng, "streams_active")
+                               else 0),
         }
 
-    def generate(self, payload, should_abort=None):
+    def generate(self, payload, should_abort=None, on_token=None):
         t = next(self._ops)
         if self.killed:
             raise NetRefused(f"replica {self.name} is down (op {t})")
@@ -1561,7 +1662,15 @@ class InProcessReplica:
                 top_p=payload.get("top_p", 1.0),
                 seed=payload.get("seed"),
                 priority=payload.get("priority", 0),
-                tenant=payload.get("tenant"))
+                tenant=payload.get("tenant"),
+                adapter=payload.get("adapter"))
+        except UnknownAdapter as e:
+            # same machine-readable 404 as httpd: the adapter was
+            # unloaded between the router's probe and this dispatch —
+            # the caller's model name is wrong HERE, not a failure
+            raise ReplicaHTTPError(
+                f"replica {self.name} rejected the request: {e}",
+                404, reason="unknown_adapter") from e
         except Rejected as e:
             raise ReplicaUnavailable(
                 str(e), status=503,
@@ -1576,6 +1685,10 @@ class InProcessReplica:
                 f"replica {self.name} rejected the request: {e}",
                 400, reason="bad_request") from e
         budget = payload.get("timeout_s")
+        if on_token is not None:
+            return self._stream_generate(req, payload, t, budget,
+                                         should_abort, disconnect,
+                                         on_token)
         deadline = (None if budget is None
                     else time.monotonic() + float(budget))
         while not req.done():
@@ -1623,6 +1736,70 @@ class InProcessReplica:
             "id": req.id,
             "ids": [int(x) for x in payload["prompt"]] + gen,
             "generated": gen, "ttft_ms": ttft,
+        }
+
+    def _stream_generate(self, req, payload, t, budget, should_abort,
+                         disconnect, on_token):
+        """The live half of ``generate``: attach a ``TokenStream`` to
+        the submitted request and forward every token through
+        ``on_token`` the moment the engine emits it.  A scheduled
+        ``net_disconnect`` cuts the stream after ``disconnect_after``
+        FORWARDED tokens (the client's view of a peer dying mid-SSE);
+        every failure carries ``emitted`` = exactly the tokens this
+        transport forwarded, so the router's splice resumes without
+        a gap or a duplicate."""
+        stream = TokenStream(req, heartbeat_s=self.poll_s)
+        deadline = (None if budget is None
+                    else time.monotonic() + float(budget))
+        sent = []
+        limit = self.disconnect_after if disconnect else None
+        for ev in stream:
+            if ev.kind == "token":
+                if limit is not None and len(sent) >= limit:
+                    # the scheduled mid-stream client death: the cut
+                    # tail is orphaned on the replica, never delivered
+                    self.faults.fire("net_disconnect", t,
+                                     emitted=list(sent))
+                on_token(int(ev.token))
+                sent.append(int(ev.token))
+                continue
+            if ev.kind == "heartbeat":
+                if should_abort is not None and should_abort():
+                    if not sent and req.first_token_at is None:
+                        raise ReplicaAbandoned(
+                            f"replica {self.name} abandoned queued "
+                            f"request (op {t})")
+                    raise NetDisconnect(
+                        f"replica {self.name} died mid-stream "
+                        f"(op {t})", emitted=list(sent))
+                if deadline is not None \
+                        and time.monotonic() > deadline:
+                    raise NetTimeout(
+                        f"replica {self.name} exceeded the "
+                        f"{budget}s attempt budget (op {t})")
+                continue
+            break                      # terminal done / error
+        if stream.error is not None:
+            from .engine import Migrated  # lazy: HTTP-only routers
+            #   never import the (jax-heavy) engine module
+            if isinstance(stream.error, Migrated):
+                raise StreamMigrated(
+                    f"replica {self.name} migrated the stream out "
+                    f"(op {t})", payload=stream.error.payload,
+                    emitted=stream.error.emitted)
+            raise NetDisconnect(
+                f"replica {self.name} failed the request: "
+                f"{stream.error} (op {t})", emitted=list(sent))
+        self.served.append(t)
+        ttft = None
+        if req.first_token_at is not None:
+            ttft = round((req.first_token_at - req.submitted_at)
+                         * 1e3, 3)
+        return {
+            "id": req.id,
+            "ids": [int(x) for x in payload["prompt"]] + sent,
+            "generated": sent, "ttft_ms": ttft,
+            "streamed": len(sent),
         }
 
     def _wait_out(self, req, t, budget, should_abort):
@@ -1971,8 +2148,94 @@ class HttpReplicaClient:
         except Exception as e:
             raise self._map_net(e, what) from e
 
-    def generate(self, payload, should_abort=None):
-        return self._post("/generate", payload)
+    def generate(self, payload, should_abort=None, on_token=None):
+        if on_token is None:
+            return self._post("/generate", payload)
+        return self._stream_generate(payload, on_token)
+
+    def _stream_generate(self, payload, on_token):
+        """POST /generate ``{"stream": true}`` and follow the
+        replica's SSE frames (the client half of httpd's
+        ``_stream_response``): every ``token`` frame fires
+        ``on_token`` immediately, ``done`` returns its /generate-
+        shaped payload, a terminal ``error`` frame maps into the
+        classified vocabulary (shed -> ReplicaUnavailable with its
+        retry_after, result_timeout -> NetTimeout, replica-side death
+        -> NetDisconnect carrying exactly the tokens this socket
+        delivered, so a greedy failover resumes without a gap)."""
+        import http.client
+        import json
+        import urllib.error
+        import urllib.request
+        body = {k: v for k, v in payload.items() if k != "timeout_s"}
+        body["stream"] = True
+        timeout = float(payload.get("timeout_s") or self.timeout_s)
+        req = urllib.request.Request(
+            self.address + "/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        sent = []
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                for event, dstr in parse_sse(resp):
+                    try:
+                        d = json.loads(dstr)
+                    except ValueError:
+                        continue
+                    if event == "token":
+                        tok = int(d["token"])
+                        on_token(tok)
+                        sent.append(tok)
+                    elif event == "done":
+                        return d
+                    elif event == "error":
+                        reason = d.get("reason")
+                        msg = (f"generate {self.address}: terminal "
+                               f"stream error [{reason}] "
+                               f"{d.get('error')}")
+                        if reason == "result_timeout":
+                            raise NetTimeout(msg)
+                        if reason in ("internal", "drain_failed",
+                                      None):
+                            raise NetDisconnect(
+                                msg, emitted=list(sent))
+                        raise ReplicaUnavailable(
+                            msg, status=503,
+                            retry_after=d.get("retry_after"),
+                            reason=reason)
+                raise NetDisconnect(
+                    f"generate {self.address}: stream ended without "
+                    "a terminal event", emitted=list(sent))
+        except (NetTimeout, NetDisconnect, ReplicaUnavailable):
+            raise
+        except urllib.error.HTTPError as e:
+            # pre-stream rejection: shed (503/429, Retry-After
+            # honored), unknown_adapter (404), bad_request (400)
+            bodyj = self._error_body(e)
+            ra = e.headers.get("Retry-After")
+            if e.code in (503, 429):
+                raise ReplicaUnavailable(
+                    bodyj.get("error", f"HTTP {e.code}"),
+                    status=e.code,
+                    retry_after=self._retry_after_s(ra),
+                    reason=bodyj.get("reason")) from e
+            raise ReplicaHTTPError(
+                bodyj.get("error", f"HTTP {e.code}"), e.code,
+                reason=bodyj.get("reason")) from e
+        except http.client.IncompleteRead as e:
+            raise NetDisconnect(
+                f"generate {self.address}: stream truncated "
+                "mid-frame", emitted=list(sent)) from e
+        except Exception as e:
+            mapped = self._map_net(e, "generate")
+            if isinstance(mapped, NetDisconnect):
+                # re-raise with the delivered-token context a
+                # mid-stream reset salvages
+                raise NetDisconnect(str(mapped),
+                                    emitted=list(sent)) from e
+            if mapped is e:
+                raise
+            raise mapped from e
 
     def migrate_export(self, payload, should_abort=None):
         """POST /migrate/export — the returned ``payload`` (when one

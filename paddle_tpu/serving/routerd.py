@@ -7,11 +7,18 @@ replica fleet) the same way engine handlers block on
 
   POST /generate    same body as the engine endpoint; the response
                     additionally carries ``replica`` (who served it)
-                    and ``attempts``.  Errors are JSON with a
-                    machine-readable ``reason``: 503
-                    ``no_replicas`` / 502 ``request_failed`` (the
-                    classified replica cause is included), 400
-                    ``bad_request``.
+                    and ``attempts``.  ``model`` (or ``adapter``)
+                    routes to replicas advertising that LoRA adapter
+                    — 404 ``unknown_adapter`` when none does.
+                    ``stream: true`` answers as SSE (token / done /
+                    error frames, exactly like httpd's) fed by the
+                    router's live ``on_token`` stream — a replica
+                    dying mid-response fails over and the resumed
+                    tokens continue the SAME stream seamlessly.
+                    Buffered errors are JSON with a machine-readable
+                    ``reason``: 503 ``no_replicas`` / 502
+                    ``request_failed`` (the classified replica cause
+                    is included), 400 ``bad_request``.
   POST /rebalance   operator preempt-and-migrate: body
                     ``{"source": NAME, "request_id"?, "min_tokens"?}``
                     exports one live stream off the named replica;
@@ -53,7 +60,9 @@ from http.server import ThreadingHTTPServer
 from .. import monitor
 from .httpd import JsonHandler
 from .router import (HttpReplicaClient, NoReplicasAvailable,
-                     RequestFailed, Router, RouterPolicy)
+                     RequestFailed, Router, RouterPolicy,
+                     UnknownModel)
+from .stream import sse_format
 
 # states a /readyz considers routable
 _ROUTABLE = ("healthy", "degraded")
@@ -164,17 +173,25 @@ class _Handler(JsonHandler):
                 seed=body.get("seed"),
                 priority=int(body.get("priority", 0)),
                 tenant=body.get("tenant"),
-                timeout=body.get("timeout"))
+                timeout=body.get("timeout"),
+                model=body.get("model", body.get("adapter")))
         except (KeyError, TypeError, ValueError,
                 json.JSONDecodeError) as e:
             self._send_json(400, {"error": f"bad request: {e}",
                                   "reason": "bad_request"})
+            return
+        if body.get("stream"):
+            self._stream_generate(prompt, kwargs)
             return
         try:
             out = self.router.generate(prompt, **kwargs)
         except NoReplicasAvailable as e:
             self._send_json(503, {"error": str(e),
                                   "reason": "no_replicas"})
+            return
+        except UnknownModel as e:
+            self._send_json(404, {"error": str(e),
+                                  "reason": "unknown_adapter"})
             return
         except RequestFailed as e:
             cause = e.cause
@@ -188,6 +205,92 @@ class _Handler(JsonHandler):
                                   "reason": "bad_request"})
             return
         self._send_json(200, out)
+
+    def _stream_generate(self, prompt, kwargs):
+        """SSE out over the router's live token stream.  The router
+        call runs on a worker thread feeding a queue; this handler
+        thread writes frames as they land (``:hb`` comments when
+        quiet).  The FIRST queue item decides the response shape: a
+        fast failure (unknown adapter, empty fleet) still gets its
+        proper HTTP status, because no SSE header has been committed
+        yet.  A failover mid-stream is invisible here — the router
+        splices the resumed tokens into the same ``on_token`` feed,
+        so the client sees one uninterrupted stream."""
+        import queue as _queue
+        q = _queue.Queue()
+        res = {}
+
+        def run():
+            try:
+                res["out"] = self.router.generate(
+                    prompt, on_token=lambda t: q.put(("tok", t)),
+                    **kwargs)
+            except Exception as e:
+                res["err"] = e
+            q.put(("end", None))
+
+        threading.Thread(target=run, daemon=True,
+                         name="paddle_tpu-routerd-stream").start()
+        kind, val = q.get()
+        if kind == "end" and "err" in res:
+            e = res["err"]
+            if isinstance(e, UnknownModel):
+                self._send_json(404, {"error": str(e),
+                                      "reason": "unknown_adapter"})
+            elif isinstance(e, NoReplicasAvailable):
+                self._send_json(503, {"error": str(e),
+                                      "reason": "no_replicas"})
+            elif isinstance(e, RequestFailed):
+                cause = e.cause
+                self._send_json(502, {
+                    "error": str(e), "reason": "request_failed",
+                    "cause": (type(cause).__name__
+                              if cause is not None else None)})
+            else:
+                self._send_json(500, {"error": str(e),
+                                      "reason": "internal"})
+            return
+        self.close_connection = True
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("X-Accel-Buffering", "no")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        sent = 0
+        try:
+            while kind != "end":
+                if kind == "tok":
+                    self.wfile.write(sse_format(
+                        {"token": int(val), "index": sent},
+                        event="token"))
+                    sent += 1
+                else:
+                    self.wfile.write(sse_format(comment="hb"))
+                self.wfile.flush()
+                try:
+                    kind, val = q.get(timeout=0.25)
+                except _queue.Empty:
+                    kind, val = "hb", None
+            err = res.get("err")
+            if err is None and "out" in res:
+                out = dict(res["out"])
+                out["streamed"] = sent
+                self.wfile.write(sse_format(out, event="done"))
+            else:
+                self.wfile.write(sse_format(
+                    {"error": str(err),
+                     "reason": ("no_replicas"
+                                if isinstance(err, NoReplicasAvailable)
+                                else "request_failed"
+                                if isinstance(err, RequestFailed)
+                                else "internal"),
+                     "retry_after": None}, event="error"))
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # the client vanished mid-stream: the fleet still lands
+            # the request; this socket just stops listening
+            pass
 
 
 class RouterServer:

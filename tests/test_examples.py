@@ -31,6 +31,7 @@ SCRIPTS = [
     "geo_async_ps.py",
     "onnx_export.py",
     "serving_quantized.py",
+    "serving_lora.py",
 ]
 
 

@@ -17,11 +17,13 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu import monitor
 from paddle_tpu.models import GPTModel
-from paddle_tpu.serving import (Engine, FaultInjector, InjectedFault,
+from paddle_tpu.serving import (AdapterInUse, Engine, FaultInjector,
+                                InjectedFault, LoRAAdapter,
                                 NoFreeBlocks, PromptLookupProposer,
-                                WatchdogTimeout)
+                                TokenStream, WatchdogTimeout)
 from paddle_tpu.serving.engine import Migrated
-from paddle_tpu.serving.faults import SITES, NetDisconnect
+from paddle_tpu.serving.faults import (SITES, NetDisconnect,
+                                       StreamDisconnect)
 
 
 @pytest.fixture(scope="module")
@@ -481,3 +483,183 @@ def test_migration_chaos_storm_deterministic(tiny_gpt):
                      "migrate_import"}, fired
     kinds = {o[0] for sig in (a, c) for o in sig[3]}
     assert "migrated" in kinds and "declined" in kinds, kinds
+
+
+# ---------------------------------------------------------------------------
+# front-end chaos: adapter hot-swap + streaming client kills mid-traffic
+# ---------------------------------------------------------------------------
+
+def _pump(stream, it):
+    """Consume every event a stream has buffered RIGHT NOW without
+    blocking: heartbeat_s=0 turns an empty queue into an immediate
+    heartbeat, which is the 'caught up' signal.  A scheduled client
+    kill surfaces as StreamDisconnect out of the iterator — this
+    consumer just dies quietly, like the real one would."""
+    while not stream.closed:
+        try:
+            if next(it).kind == "heartbeat":
+                break
+        except StreamDisconnect:
+            return
+
+
+def _frontend_storm(model, seed, ticks, refs):
+    """One seeded storm over a LoRA-serving engine with live streaming
+    clients.  Mid-traffic the driver hot-loads/unloads adapter lanes —
+    the injected ``adapter_load`` site kills some swaps at the bank
+    write (inventory must stay untouched) and pinned unloads must be
+    REFUSED, not deferred — while seeded ``stream_disconnect`` clients
+    vanish mid-response (the engine must not care).  Asserts the
+    invariant set and returns the reproducibility signature."""
+    n_layers = len(list(model.blocks))
+    hidden = int(model.embeddings.word_embeddings.weight.shape[1])
+    a1 = LoRAAdapter.random(4, hidden, n_layers=n_layers, seed=11,
+                            scale=0.5)
+    a2 = LoRAAdapter.random(2, hidden, n_layers=n_layers, seed=22,
+                            scale=0.5)
+    inj = FaultInjector(seed=seed,
+                        rates={"adapter_load": 0.45, "dispatch": 0.02},
+                        first_tick=0, last_tick=ticks)
+    # the CLIENT-side injector: its "tick" is the stream ordinal, so
+    # which clients vanish is pure (seed, ordinal) — independent of
+    # engine timing
+    cinj = FaultInjector(seed=seed + 7,
+                         rates={"stream_disconnect": 0.5},
+                         first_tick=0, last_tick=10 ** 9)
+    eng = Engine(model, num_slots=3, max_seq_len=64, kv_block_size=8,
+                 adapters={"a1": a1}, max_adapters=4,
+                 registry=monitor.StatRegistry())
+    prompts = _prompts()
+    for i in range(2):                  # warm compiles, faults unarmed
+        eng.submit(prompts[i], max_new_tokens=2)
+    eng.run_until_idle()
+    inj.first_tick += eng.tick_no
+    inj.last_tick += eng.tick_no
+    eng.faults = inj
+    # (tick, prompt_idx, max_new, adapter) — adapter "a2?" means "a2
+    # if its hot-load has landed by then, else base"
+    sched = {
+        0: [(0, 10, None), (1, 8, "a1")],
+        3: [(2, 8, "a1")],
+        7: [(3, 8, "a2?")],
+        12: [(0, 6, None), (4, 8, "a2?")],
+        18: [(1, 8, "a1"), (2, 6, "a2?")],
+    }
+    swap_log, reqs, streams = [], [], []
+    a2_loaded = False
+    for t in range(ticks):
+        if t >= 2 and not a2_loaded:    # hot-load a2, retrying past
+            if t == 2:                  # the FIRST attempt is always
+                inj.at(eng.tick_no, "adapter_load")  # killed mid-swap
+            try:                        # injected adapter_load kills
+                eng.load_adapter("a2", a2)
+                a2_loaded = True
+                swap_log.append(("load", "a2", "ok"))
+            except InjectedFault:
+                swap_log.append(("load", "a2", "fault"))
+                assert eng.adapters.names() == ["a1"], \
+                    "failed load mutated the inventory"
+        if (("unload", "a1", "refused") not in swap_log
+                and eng.adapters.pins("a1") > 0):
+            try:                        # a1 pinned by live streams:
+                eng.unload_adapter("a1")  # must REFUSE, not wait
+                swap_log.append(("unload", "a1", "ok"))
+            except AdapterInUse:
+                swap_log.append(("unload", "a1", "refused"))
+            except InjectedFault:
+                swap_log.append(("unload", "a1", "fault"))
+        for (pi, mn, ad) in sched.get(t, []):
+            if ad == "a2?":
+                ad = "a2" if a2_loaded else None
+            r = eng.submit(prompts[pi], max_new_tokens=mn, adapter=ad)
+            s = TokenStream(r, heartbeat_s=0.0, faults=cinj,
+                            ordinal=len(streams))
+            reqs.append((pi, mn, ad, r))
+            streams.append((s, iter(s)))
+        try:
+            eng.step()
+        except Exception:  # noqa: BLE001 — step already recovered
+            pass
+        for (s, it) in streams:         # live clients keep up; the
+            _pump(s, it)                # scheduled ones vanish here
+    for _ in range(600):
+        if eng.scheduler.idle():
+            break
+        try:
+            eng.step()
+        except Exception:  # noqa: BLE001
+            pass
+    # -- invariants ---------------------------------------------------
+    assert eng.scheduler.idle(), "engine failed to drain after storm"
+    for name in eng.adapters.names():
+        assert eng.adapters.pins(name) == 0, f"{name}: leaked pin"
+    assert eng.streams_active() == 0, "request sinks leaked"
+    outcomes = []
+    for (snum, ((pi, mn, ad, r), (s, it))) in enumerate(
+            zip(reqs, streams)):
+        assert r.done(), f"waiter never unblocked: {r}"
+        if r.error is not None:
+            outcomes.append((pi, mn, ad, "err", type(r.error).__name__))
+            continue
+        out = [int(x) for x in r.generated]
+        assert out == refs[(pi, mn, ad)], \
+            f"stream corruption: prompt {pi} adapter {ad}"
+        if s._disconnect_after is not None:
+            # killed client: what it DID deliver is an exact dup-free
+            # prefix — never a scrambled or doubled suffix
+            assert s.closed and s.tokens == out[:len(s.tokens)], snum
+            assert 1 <= len(s.tokens) < len(out), snum
+            outcomes.append((pi, mn, ad, "cut", len(s.tokens)))
+        else:
+            _pump(s, it)                # consume the terminal event
+            assert s.tokens == out, f"stream {snum}: delivery != land"
+            outcomes.append((pi, mn, ad, "ok", len(s.tokens)))
+    eng.prefix_cache.clear()
+    assert eng.block_pool.in_use() == 0, "pool refcount leak"
+    cut = [o for o in outcomes if o[3] == "cut"]
+    ok = [o for o in outcomes if o[3] == "ok"]
+    assert cut and ok, (cut, ok)
+    assert ("unload", "a1", "refused") in swap_log, swap_log
+    assert a2_loaded and "a2" in eng.adapters.names()
+    return (tuple(inj.log), tuple(cinj.log), tuple(swap_log),
+            tuple(outcomes))
+
+
+@pytest.mark.chaos
+@pytest.mark.lora
+@pytest.mark.stream
+def test_frontend_chaos_storm_deterministic(tiny_gpt):
+    """Seeded LoRA + streaming storm: adapter hot-swaps under injected
+    bank-write kills, pinned unload refusal, and mid-response client
+    disconnects — every surviving request lands token-identical to its
+    merged-weights oracle with zero leaked pins/sinks, every client
+    delivery is exactly-once (full or clean prefix), and the same seed
+    replays the same fault/swap/outcome history."""
+    prompts = _prompts()
+    n_layers = len(list(tiny_gpt.blocks))
+    hidden = int(tiny_gpt.embeddings.word_embeddings.weight.shape[1])
+    oracles = {None: tiny_gpt}
+    for name, lseed, rank in (("a1", 11, 4), ("a2", 22, 2)):
+        ad = LoRAAdapter.random(rank, hidden, n_layers=n_layers,
+                                seed=lseed, scale=0.5)
+        paddle.seed(0)
+        m = GPTModel.from_config("tiny", dropout=0.0)
+        m.eval()
+        oracles[name] = ad.merge_into(m)
+    refs = {}
+    for (pi, mn) in {(0, 10), (1, 8), (2, 8), (3, 8), (0, 6), (4, 8),
+                     (2, 6)}:
+        for ad in (None, "a1", "a2"):
+            # generate() returns prompt + continuation; the storm
+            # compares Request.generated (the continuation alone)
+            refs[(pi, mn, ad)] = oracles[ad].generate(
+                paddle.to_tensor(prompts[pi][None, :]),
+                max_new_tokens=mn).numpy()[0][len(prompts[pi]):].tolist()
+    a = _frontend_storm(tiny_gpt, seed=31, ticks=26, refs=refs)
+    b = _frontend_storm(tiny_gpt, seed=31, ticks=26, refs=refs)
+    c = _frontend_storm(tiny_gpt, seed=33, ticks=26, refs=refs)
+    assert a == b, "same seed, different storm history"
+    assert a != c, "different seed, same storm history"
+    fired = {site for sig in (a, c) for log in sig[:2]
+             for (_, site) in log}
+    assert "adapter_load" in fired and "stream_disconnect" in fired

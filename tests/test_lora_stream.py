@@ -1,0 +1,469 @@
+"""Multi-adapter (LoRA) serving + token streaming.
+
+LoRA lanes: adapter outputs must be token-identical to an OFFLINE
+merged-weights oracle (scale * (B @ A)^T folded into out_proj) on the
+same engine configs — greedy AND seeded sampling — while lane 0 keeps
+serving the base model unchanged; hot-loading adapter #2 into a live
+engine compiles ZERO new programs (the banks are data, never shape);
+unload refuses while in-flight requests pin the adapter.
+
+Streaming: a TokenStream attached to a live request delivers exactly
+the buffered token sequence (replay-then-subscribe makes mid-decode
+attachment exactly-once), across paged x chunked x speculative x
+async-depth engine configs; the HTTP edge answers ``stream: true`` as
+SSE; the router routes ``model=`` by probed adapter inventory (404
+unknown_adapter at the front door) and splices a failover's resumed
+tokens into the same live stream exactly once.
+
+All CPU, tiny model, tier-1 safe.
+"""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.models import GPTModel
+from paddle_tpu.serving import (AdapterInUse, Engine, EngineServer,
+                                FaultInjector, LoRAAdapter,
+                                PromptLookupProposer, RegistryFull,
+                                TokenStream, UnknownAdapter)
+from paddle_tpu.serving.lora import AdapterRegistry
+from paddle_tpu.serving.router import (InProcessReplica, Router,
+                                       RouterPolicy, UnknownModel)
+from paddle_tpu.serving.routerd import RouterServer
+from paddle_tpu.serving.stream import parse_sse
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    paddle.seed(0)
+    m = GPTModel.from_config("tiny", dropout=0.0)
+    m.eval()
+    return m
+
+
+def _fresh_tiny():
+    """A NEW model with the fixture's exact weights — the merged-
+    weights oracle mutates out_proj in place, so it gets its own."""
+    paddle.seed(0)
+    m = GPTModel.from_config("tiny", dropout=0.0)
+    m.eval()
+    return m
+
+
+def _engine(model, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_seq_len", 48)
+    kw.setdefault("registry", monitor.StatRegistry())
+    return Engine(model, **kw)
+
+
+def _prompts(n, lens=(5, 7, 3, 9, 4, 6)):
+    rng = np.random.RandomState(7)
+    return [rng.randint(0, 128, (lens[i % len(lens)],)).astype(np.int32)
+            for i in range(n)]
+
+
+def _adapter(model, seed, rank=4):
+    hidden = int(model.embeddings.word_embeddings.weight.shape[1])
+    # scale large enough that the delta flips greedy argmax on the
+    # tiny model — "adapter != base" assertions need a real bite
+    return LoRAAdapter.random(rank, hidden,
+                              n_layers=len(list(model.blocks)),
+                              seed=seed, scale=0.5)
+
+
+def _tail(req):
+    return [int(t) for t in req.generated]
+
+
+# ---------------------------------------------------------------------------
+# AdapterRegistry / LoRAAdapter units
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lora
+def test_adapter_factors_padding_matches_merged_delta():
+    """Zero-padding a rank-2 adapter into r_max=8 bank slots is
+    mathematically exact: a^T @ b^T reconstructs the merged delta."""
+    ad = LoRAAdapter.random(2, 16, n_layers=3, seed=1)
+    a, b = ad.factors(3, 8)
+    assert a.shape == (3, 8, 16) and b.shape == (3, 16, 8)
+    # y = x W convention: delta W = scale * (B @ A)^T = (a^T b^T)^T
+    for i in range(3):
+        np.testing.assert_allclose((b[i] @ a[i]).T,
+                                   ad.merged_delta(3)[i], rtol=1e-6)
+
+
+@pytest.mark.lora
+def test_registry_lane_lifecycle():
+    reg = AdapterRegistry(2, 16, max_adapters=2, r_max=4)
+    l1 = reg.load("x", LoRAAdapter.random(2, 16, n_layers=2, seed=1))
+    l2 = reg.load("y", LoRAAdapter.random(4, 16, n_layers=2, seed=2))
+    assert {l1, l2} == {1, 2} and reg.names() == ["x", "y"]
+    with pytest.raises(RegistryFull):
+        reg.load("z", LoRAAdapter.random(2, 16, n_layers=2, seed=3))
+    reg.pin("x")
+    with pytest.raises(AdapterInUse):
+        reg.unload("x")
+    reg.unpin("x")
+    assert reg.unload("x") == l1
+    with pytest.raises(UnknownAdapter):
+        reg.lane("x")
+    # the freed lane is reused and the bank row was zeroed
+    assert reg.load("z", LoRAAdapter.random(2, 16, n_layers=2,
+                                            seed=3)) == l1
+    with pytest.raises(ValueError, match="rank 8 exceeds"):
+        reg.load("w", LoRAAdapter.random(8, 16, n_layers=2, seed=4))
+
+
+# ---------------------------------------------------------------------------
+# Merged-weights oracle parity (the tentpole's correctness pin)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lora
+@pytest.mark.parametrize("engine_kw", [
+    {},                                              # fused decode
+    {"kv_block_size": 8},                            # paged
+    {"kv_block_size": 8, "prefill_chunk": 4,
+     "tick_token_budget": 8},                        # paged chunked
+    {"spec_k": 2},                                   # fused verify
+], ids=["plain", "paged", "paged_chunked", "spec"])
+def test_lora_oracle_parity_greedy(tiny_gpt, engine_kw):
+    """Adapter decodes through the banked lanes are token-identical
+    to dedicated engines running the OFFLINE merged weights, while
+    base (lane-0) requests in the same batch stay identical to the
+    no-adapter engine — every hot path, one compiled program."""
+    if "spec_k" in engine_kw:
+        engine_kw = dict(engine_kw, proposer=PromptLookupProposer())
+    a1 = _adapter(tiny_gpt, seed=11)
+    a2 = _adapter(tiny_gpt, seed=22, rank=2)
+    eng = _engine(tiny_gpt, adapters={"a1": a1, "a2": a2},
+                  **engine_kw)
+    prompts = _prompts(3)
+    reqs = [eng.submit(prompts[0], max_new_tokens=8, adapter="a1"),
+            eng.submit(prompts[1], max_new_tokens=8, adapter="a2"),
+            eng.submit(prompts[2], max_new_tokens=8)]  # base lane 0
+    eng.run_until_idle()
+
+    base_eng = _engine(tiny_gpt, **engine_kw)
+    for name, ad, prompt, req in (("a1", a1, prompts[0], reqs[0]),
+                                  ("a2", a2, prompts[1], reqs[1])):
+        oracle = _engine(ad.merge_into(_fresh_tiny()), **engine_kw)
+        ref = oracle.submit(prompt, max_new_tokens=8)
+        oracle.run_until_idle()
+        assert _tail(req) == _tail(ref), name
+    ref = base_eng.submit(prompts[2], max_new_tokens=8)
+    base_eng.run_until_idle()
+    assert _tail(reqs[2]) == _tail(ref)
+    # adapted streams genuinely differ from the base model's
+    assert _tail(reqs[0]) != _tail(reqs[2])
+
+
+@pytest.mark.lora
+def test_lora_oracle_parity_seeded_sampling(tiny_gpt):
+    """Seeded device sampling through an adapter lane matches the
+    merged-weights oracle draw for draw — the lane delta feeds the
+    SAME fused sampler, so identical logits + identical seed means
+    identical tokens."""
+    ad = _adapter(tiny_gpt, seed=33)
+    kw = dict(temperature=0.8, top_k=12, seed=1234)
+    eng = _engine(tiny_gpt, adapters={"ad": ad}, kv_block_size=8)
+    req = eng.submit(_prompts(1)[0], max_new_tokens=8, adapter="ad",
+                     **kw)
+    eng.run_until_idle()
+    oracle = _engine(ad.merge_into(_fresh_tiny()), kv_block_size=8)
+    ref = oracle.submit(_prompts(1)[0], max_new_tokens=8, **kw)
+    oracle.run_until_idle()
+    assert _tail(req) == _tail(ref)
+
+
+@pytest.mark.lora
+def test_lora_hot_load_compiles_nothing(tiny_gpt):
+    """The compile-probe assertion: hot-loading adapter #2 into a
+    LIVE engine and serving it is pure data movement — the compile
+    counter does not move (bank shapes are fixed at construction)."""
+    a1 = _adapter(tiny_gpt, seed=11)
+    a2 = _adapter(tiny_gpt, seed=22)
+    eng = _engine(tiny_gpt, adapters={"a1": a1}, max_adapters=3,
+                  kv_block_size=8)
+    warm = [eng.submit(p, max_new_tokens=6) for p in _prompts(2)]
+    warm.append(eng.submit(_prompts(3)[2], max_new_tokens=6,
+                           adapter="a1"))
+    eng.run_until_idle()
+    before = eng.registry.get("serving.compiles_total").value
+    eng.load_adapter("a2", a2)
+    reqs = [eng.submit(_prompts(1)[0], max_new_tokens=6,
+                       adapter="a2"),
+            eng.submit(_prompts(2)[1], max_new_tokens=6,
+                       adapter="a1"),
+            eng.submit(_prompts(3)[2], max_new_tokens=6)]
+    eng.run_until_idle()
+    assert all(r.done() and r.error is None for r in reqs)
+    assert eng.registry.get("serving.compiles_total").value == before
+    # and the inventory is live on the debug surface
+    dbg = eng.debug_requests()
+    assert dbg["engine"]["adapters_loaded"] == 2
+    assert set(dbg["engine"]["adapters"]) == {"a1", "a2"}
+    eng.unload_adapter("a2")
+    assert eng.adapters.names() == ["a1"]
+
+
+@pytest.mark.lora
+def test_lora_pinned_unload_refused(tiny_gpt):
+    """In-flight requests pin their adapter: unload refuses with
+    AdapterInUse until the stream lands, then succeeds."""
+    ad = _adapter(tiny_gpt, seed=11)
+    eng = _engine(tiny_gpt, adapters={"ad": ad})
+    req = eng.submit(_prompts(1)[0], max_new_tokens=8, adapter="ad")
+    assert eng.adapters.pins("ad") == 1
+    with pytest.raises(AdapterInUse):
+        eng.unload_adapter("ad")
+    eng.run_until_idle()
+    assert req.done() and eng.adapters.pins("ad") == 0
+    eng.unload_adapter("ad")
+    assert eng.adapters.names() == []
+    with pytest.raises(UnknownAdapter):
+        eng.submit(_prompts(1)[0], max_new_tokens=4, adapter="ad")
+
+
+@pytest.mark.lora
+def test_submit_unknown_adapter_raises(tiny_gpt):
+    eng = _engine(tiny_gpt)     # no adapters configured at all
+    with pytest.raises(UnknownAdapter):
+        eng.submit(_prompts(1)[0], max_new_tokens=4, adapter="nope")
+
+
+# ---------------------------------------------------------------------------
+# Token streaming: streamed == buffered on every hot path
+# ---------------------------------------------------------------------------
+
+@pytest.mark.stream
+@pytest.mark.parametrize("engine_kw", [
+    {},
+    {"kv_block_size": 8},
+    {"prefill_chunk": 4, "tick_token_budget": 8},
+    {"kv_block_size": 8, "prefill_chunk": 4, "tick_token_budget": 8},
+    {"spec_k": 2},
+    {"async_depth": 1},
+], ids=["plain", "paged", "chunked", "paged_chunked", "spec",
+        "depth1"])
+def test_streamed_equals_buffered(tiny_gpt, engine_kw):
+    """Token identity between a live TokenStream and the buffered
+    result, with a LoRA adapter in the mix: the per-tick _emit fan-
+    out delivers exactly the tokens the request lands with, on every
+    dispatch layout (paged x chunked x speculative x async depth)."""
+    if "spec_k" in engine_kw:
+        engine_kw = dict(engine_kw, proposer=PromptLookupProposer())
+    ad = _adapter(tiny_gpt, seed=11)
+    eng = _engine(tiny_gpt, adapters={"ad": ad}, **engine_kw)
+    p = _prompts(1)[0]
+    streamed = eng.submit(p, max_new_tokens=8, adapter="ad")
+    live = TokenStream(streamed)          # attached BEFORE any tick
+    buffered = eng.submit(p, max_new_tokens=8, adapter="ad")
+    base = eng.submit(p, max_new_tokens=8)
+    for _ in range(3):
+        eng.step()
+    late = TokenStream(streamed)          # attach MID-decode: replay
+    eng.run_until_idle()
+    want = _tail(buffered)
+    assert live.drain(timeout=1) == want
+    assert late.drain(timeout=1) == want  # replay + live, no dupes
+    assert _tail(streamed) == want
+    assert want != _tail(base)            # the adapter genuinely bites
+
+
+@pytest.mark.stream
+def test_stream_terminal_error_and_emit_span(tiny_gpt):
+    """A shed/failed request ends its stream with a terminal error
+    event (never a silent truncation), and streamed ticks log
+    stream.emit spans for the wall-clock breakdown."""
+    eng = _engine(tiny_gpt)
+    req = eng.submit(_prompts(1)[0], max_new_tokens=6)
+    stream = TokenStream(req)
+    eng.run_until_idle()
+    assert stream.drain(timeout=1) == _tail(req)
+    names = {ev.get("name") for ev in eng.chrome_trace()["traceEvents"]}
+    assert "stream.emit" in names
+    assert eng.streams_active() == 0      # sinks detach with the land
+    # terminal error: a request that dies mid-flight closes its sink
+    req2 = eng.submit(_prompts(2)[1], max_new_tokens=6)
+    s2 = TokenStream(req2)
+    req2._finish(RuntimeError("synthetic mid-stream death"))
+    with pytest.raises(RuntimeError, match="synthetic"):
+        s2.drain(timeout=1)
+
+
+@pytest.mark.stream
+def test_httpd_sse_stream_and_adapter_surface(tiny_gpt):
+    """The HTTP edge end-to-end over a real socket: ``stream: true``
+    answers as SSE whose token frames + done payload are identical
+    to the buffered POST; unknown adapters 404 with the machine
+    reason; /healthz advertises the adapter inventory and live
+    stream count."""
+    ad = _adapter(tiny_gpt, seed=11)
+    eng = _engine(tiny_gpt, adapters={"ad": ad})
+    prompt = [int(t) for t in _prompts(1)[0]]
+    with EngineServer(eng, port=0) as srv:
+        base = srv.address
+
+        def post(body, timeout=30):
+            req = urllib.request.Request(
+                base + "/generate", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            return urllib.request.urlopen(req, timeout=timeout)
+
+        with post({"prompt": prompt, "max_new_tokens": 8,
+                   "adapter": "ad"}) as resp:
+            buffered = json.loads(resp.read())
+        toks, done = [], None
+        with post({"prompt": prompt, "max_new_tokens": 8,
+                   "adapter": "ad", "stream": True}) as resp:
+            assert resp.headers["Content-Type"] == "text/event-stream"
+            for event, dstr in parse_sse(resp):
+                d = json.loads(dstr)
+                if event == "token":
+                    assert d["index"] == len(toks)
+                    toks.append(d["token"])
+                elif event == "done":
+                    done = d
+                    break
+        assert toks == buffered["generated"] == done["generated"]
+        assert done["streamed"] == len(toks)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"prompt": prompt, "adapter": "nope"})
+        assert ei.value.code == 404
+        assert json.loads(ei.value.read())["reason"] \
+            == "unknown_adapter"
+        with urllib.request.urlopen(base + "/healthz",
+                                    timeout=10) as resp:
+            hz = json.loads(resp.read())
+        assert hz["adapters"] == ["ad"]
+        assert hz["adapters_loaded"] == 1
+        assert hz["streams_active"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Router: model= routing + streamed failover splice
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lora
+@pytest.mark.router
+def test_router_routes_by_adapter_inventory(tiny_gpt):
+    """pick(model=...) only considers replicas whose PROBED adapter
+    inventory lists the model; an adapter nobody serves raises
+    UnknownModel, which routerd maps to 404 unknown_adapter."""
+    ad = _adapter(tiny_gpt, seed=11)
+    eng1 = _engine(tiny_gpt, adapters={"ad": ad})
+    eng2 = _engine(tiny_gpt)
+    eng1.start()
+    eng2.start()
+    rt = Router(policy=RouterPolicy(probe_interval_s=0.2))
+    rt.add_replica("r1", InProcessReplica("r1", eng1))
+    rt.add_replica("r2", InProcessReplica("r2", eng2))
+    rt.probe_once()
+    try:
+        rows = {r["name"]: r for r in rt.replicas()}
+        assert rows["r1"]["signals"]["adapters"] == ["ad"]
+        assert rows["r2"]["signals"]["adapters"] == []
+        prompt = [int(t) for t in _prompts(1)[0]]
+        for _ in range(3):   # every dispatch must land on r1
+            out = rt.generate(prompt, max_new_tokens=6, model="ad")
+            assert out["replica"] == "r1"
+        with pytest.raises(UnknownModel):
+            rt.generate(prompt, max_new_tokens=4, model="ghost")
+        with RouterServer(rt) as srv:
+            req = urllib.request.Request(
+                srv.address + "/generate",
+                data=json.dumps({"prompt": prompt,
+                                 "model": "ghost"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 404
+            assert json.loads(ei.value.read())["reason"] \
+                == "unknown_adapter"
+    finally:
+        rt.stop()
+        eng1.stop()
+        eng2.stop()
+
+
+@pytest.mark.stream
+@pytest.mark.router
+def test_router_stream_failover_splices_exactly_once(tiny_gpt):
+    """The acceptance-criterion chaos case: a streamed greedy request
+    whose replica disconnects mid-response resumes on a peer with
+    the continuation spliced into the SAME on_token stream — every
+    token index delivered exactly once, the final sequence identical
+    to an uninterrupted run."""
+    engines, rt = [], Router(policy=RouterPolicy(probe_interval_s=0.2))
+    for i in range(2):
+        eng = _engine(tiny_gpt)
+        eng.start()
+        engines.append(eng)
+        inj = FaultInjector(seed=0)
+        inj.at(0, "net_disconnect")   # first op on EACH replica cuts
+        rt.add_replica(f"r{i}", InProcessReplica(
+            f"r{i}", eng, faults=inj, disconnect_after=3))
+    rt.probe_once()
+    try:
+        p = _prompts(1)[0]
+        ref = engines[0].submit(p, max_new_tokens=10)
+        ref.result(timeout=30)
+        toks = []
+        out = rt.generate([int(t) for t in p], max_new_tokens=10,
+                          on_token=toks.append)
+        assert toks == _tail(ref) == out["generated"]
+        assert out["attempts"] >= 2   # the splice genuinely failed over
+    finally:
+        rt.stop()
+        for eng in engines:
+            eng.stop()
+
+
+@pytest.mark.stream
+@pytest.mark.router
+def test_routerd_sse_stream_parity(tiny_gpt):
+    """routerd's SSE front door: streamed token frames + done payload
+    match the buffered router response for the same model= request."""
+    ad = _adapter(tiny_gpt, seed=11)
+    eng = _engine(tiny_gpt, adapters={"ad": ad})
+    eng.start()
+    rt = Router(policy=RouterPolicy(probe_interval_s=0.2))
+    rt.add_replica("r1", InProcessReplica("r1", eng))
+    rt.probe_once()
+    prompt = [int(t) for t in _prompts(1)[0]]
+    try:
+        with RouterServer(rt) as srv:
+            def post(body, timeout=30):
+                req = urllib.request.Request(
+                    srv.address + "/generate",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"})
+                return urllib.request.urlopen(req, timeout=timeout)
+
+            with post({"prompt": prompt, "max_new_tokens": 8,
+                       "model": "ad"}) as resp:
+                buffered = json.loads(resp.read())
+            toks, done = [], None
+            with post({"prompt": prompt, "max_new_tokens": 8,
+                       "model": "ad", "stream": True}) as resp:
+                assert resp.headers["Content-Type"] \
+                    == "text/event-stream"
+                for event, dstr in parse_sse(resp):
+                    d = json.loads(dstr)
+                    if event == "token":
+                        toks.append(d["token"])
+                    elif event == "done":
+                        done = d
+                        break
+            assert toks == buffered["generated"] == done["generated"]
+            assert done["streamed"] == len(toks)
+            assert done["replica"] == "r1"
+    finally:
+        eng.stop()

@@ -78,6 +78,13 @@ def router_sources(base_url, timeout=10.0):
         inc = row.get("incarnation")
         if inc is not None and int(inc) > 0:
             label += f" inc={int(inc)}"
+        # multi-LoRA replicas carry their probed adapter inventory —
+        # the fleet timeline shows at a glance which lanes can serve
+        # a given model= request
+        adapters = (row.get("signals") or {}).get("adapters")
+        if adapters:
+            label += " adapters=" + ",".join(
+                str(a) for a in adapters)
         if not addr or not str(addr).startswith(("http://",
                                                  "https://")):
             print(f"replica {name}: no fetchable address "

@@ -84,8 +84,10 @@ def wall_summary(events):
     allgather = shard_sync = 0.0
     mig_export = mig_wire = mig_import = 0.0
     sup_restart = drain_mig = dequant = 0.0
+    lora_swap = stream_emit = 0.0
     n_ticks = n_ragged = n_allgather = n_migrations = 0
     n_restarts = n_drain_migs = n_dequants = 0
+    n_lora_swaps = n_stream_emits = 0
     for ev in events:
         if ev.get("ph") != "X":
             continue
@@ -141,6 +143,20 @@ def wall_summary(events):
             elif name == "drain.migrate":
                 drain_mig += dur
                 n_drain_migs += 1
+            elif name == "lora.swap":
+                # multi-adapter serving: hot-load/unload of a LoRA
+                # lane (ring drain + bank .at[lane].set) — the cost
+                # of changing the adapter inventory WITHOUT a
+                # recompile, visible per swap instead of smeared
+                # into the tick gaps
+                lora_swap += dur
+                n_lora_swaps += 1
+            elif name == "stream.emit":
+                # token streaming: per-token fan-out from the tick
+                # loop to attached SSE sinks — the engine-side cost
+                # of live delivery (zero when nobody streams)
+                stream_emit += dur
+                n_stream_emits += 1
             elif name == "decode.dequant":
                 # int8-KV engines (Engine(kv_dtype="int8")): the
                 # host-side attribution span of a QUANTIZED dispatch
@@ -170,6 +186,10 @@ def wall_summary(events):
         "drain_migrate_ms": drain_mig,
         "dequant_ms": dequant,
         "dequant_dispatches": n_dequants,
+        "lora_swap_ms": lora_swap,
+        "lora_swaps": n_lora_swaps,
+        "stream_emit_ms": stream_emit,
+        "stream_emits": n_stream_emits,
     }
 
 
@@ -207,6 +227,17 @@ def format_wall(w):
             f"{w['dequant_dispatches']} quantized dispatches "
             "(kv_dtype='int8': in-program dequant of int8 "
             "codes+scales at gather)")
+    if w.get("lora_swaps"):
+        lines.append(
+            f"lora.swap {w['lora_swap_ms']:.3f} ms over "
+            f"{w['lora_swaps']} adapter swap(s) (hot-load/unload "
+            "into a bank lane: ring drain + .at[lane].set, zero "
+            "recompiles)")
+    if w.get("stream_emits"):
+        lines.append(
+            f"stream.emit {w['stream_emit_ms']:.3f} ms over "
+            f"{w['stream_emits']} streamed token(s) (per-token "
+            "fan-out to attached SSE sinks)")
     if w.get("supervisor_restarts") or w.get("drain_migrations"):
         lines.append(
             f"supervisor.restart {w['supervisor_restart_ms']:.3f} ms "
