@@ -478,7 +478,7 @@ class GPTAttention(nn.Layer):
                 flat_v.reshape(v_pool.shape))
 
     def ragged_window_paged(self, x, k_pool, v_pool, block_tables, pos,
-                            width):
+                            width, variant="stream"):
         """RAGGED paged window — the Pallas-kernel twin of the three
         paged window shapes (``decode_slots_paged`` S=1,
         ``verify_slots_paged`` S=k+1, ``prefill_chunk_paged`` S=C):
@@ -494,10 +494,14 @@ class GPTAttention(nn.Layer):
         three per-path invariants (parked slots' zero tables, the
         spec-margin reservation, chunked prefill's ``true_len`` pad
         lanes; see serving/kvcache.py).  Valid lanes write exactly
-        what their XLA twin writes, and the kernel computes the same
-        f32 gather/mask/softmax as ``_slot_attn``, so greedy AND
-        seeded outputs are token-identical to the XLA path (asserted
-        in tests/test_ragged_attn.py, bitwise on CPU).
+        what their XLA twin writes.  ``variant`` picks the kernel
+        body: ``"stream"`` (default, ``attn_impl="ragged"``) runs the
+        flash-style online-softmax block loop — O(block_size x W)
+        working set, allclose to ``_slot_attn`` with greedy streams
+        token-identical end-to-end; ``"gather"``
+        (``attn_impl="ragged_gather"``) materializes the whole row and
+        stays bitwise-equal to the XLA path on CPU (asserted in
+        tests/test_ragged_attn.py).
 
         x: Tensor [B, W, E]; k_pool/v_pool: [NB, bs, H, hd];
         block_tables: int32 [B, L//bs]; pos/width: int32 [B].
@@ -538,7 +542,8 @@ class GPTAttention(nn.Layer):
                 qa, k_pool.codes.reshape(NB * bs, H, hd),
                 v_pool.codes.reshape(NB * bs, H, hd),
                 block_tables, pos, width, block_size=bs,
-                k_scale=k_pool.scale, v_scale=v_pool.scale)
+                k_scale=k_pool.scale, v_scale=v_pool.scale,
+                variant=variant)
             new_k, new_v = k_pool, v_pool
         else:
             flat_k = k_pool.reshape(NB * bs, H, hd)
@@ -548,7 +553,8 @@ class GPTAttention(nn.Layer):
             flat_v = flat_v.at[widx].set(va.astype(flat_v.dtype))
             ctx = ragged_paged_attention(qa, flat_k, flat_v,
                                          block_tables, pos, width,
-                                         block_size=bs)
+                                         block_size=bs,
+                                         variant=variant)
             new_k = flat_k.reshape(k_pool.shape)
             new_v = flat_v.reshape(v_pool.shape)
         out = Tensor(ctx)
@@ -801,10 +807,11 @@ class GPTBlock(nn.Layer):
         return x, k_pool, v_pool
 
     def ragged_window_paged(self, x, k_pool, v_pool, block_tables, pos,
-                            width):
+                            width, variant="stream"):
         """Ragged Pallas window (GPTAttention.ragged_window_paged)."""
         attn_out, k_pool, v_pool = self.attn.ragged_window_paged(
-            self.ln1(x), k_pool, v_pool, block_tables, pos, width)
+            self.ln1(x), k_pool, v_pool, block_tables, pos, width,
+            variant=variant)
         x = x + attn_out
         x = x + self.mlp(self.ln2(x))
         return x, k_pool, v_pool
@@ -882,17 +889,22 @@ class GPTModel(nn.Layer):
                  use_sp=False, fused_loss_chunk=128, scan_layers=False,
                  attn_impl="xla"):
         super().__init__()
-        if attn_impl not in ("xla", "ragged"):
+        if attn_impl not in ("xla", "ragged", "ragged_gather"):
             raise ValueError(
-                f"attn_impl must be 'xla' or 'ragged', got "
-                f"{attn_impl!r}")
+                f"attn_impl must be 'xla', 'ragged' or "
+                f"'ragged_gather', got {attn_impl!r}")
         # serving-kernel selection default: 'xla' keeps the paged
         # gather/scatter dispatches (the CPU tier-1 parity oracle);
         # 'ragged' routes the paged decode / spec-verify / chunked-
         # prefill attention core through the Pallas ragged paged
         # attention kernel (ops/ragged_paged_attn.py) — per-slot
         # window widths as data, ONE compiled program for every paged
-        # window shape.  Engine(attn_impl=...) overrides per engine.
+        # window shape — in its flash-style online-softmax STREAMING
+        # form (O(block_size x window) working set, long-context
+        # first-class); 'ragged_gather' keeps the materialize-the-row
+        # kernel body (bitwise vs the XLA oracle, O(context) working
+        # set) as the A/B reference.  Engine(attn_impl=...) overrides
+        # per engine.
         self.attn_impl = attn_impl
         # decode-twin reconstruction needs the dense hyperparams
         # (scan_layers forbids mp/sp/moe, so these suffice)
@@ -1325,7 +1337,7 @@ class GPTModel(nn.Layer):
 
     def _ragged_window_tick_slots(self, toks, k_pools, v_pools,
                                   block_tables, pos, width,
-                                  head_lanes=None):
+                                  head_lanes=None, variant="stream"):
         """RAGGED window forward over the paged slot pool: run each
         slot's ``width[b]`` real window tokens (of the static maximum
         W) at positions ``pos[b]..`` through every block's
@@ -1352,7 +1364,8 @@ class GPTModel(nn.Layer):
         new_k, new_v = [], []
         for j, blk in enumerate(self.blocks):
             x, kb, vb = blk.ragged_window_paged(
-                x, k_pools[j], v_pools[j], block_tables, pos, width)
+                x, k_pools[j], v_pools[j], block_tables, pos, width,
+                variant=variant)
             new_k.append(kb)
             new_v.append(vb)
         if head_lanes is not None:
@@ -1363,7 +1376,8 @@ class GPTModel(nn.Layer):
     def _fused_ragged_tick_slots(self, toks, k_pools, v_pools,
                                  block_tables, width, mode, lanes, tok,
                                  pos, temp, top_k, top_p, seed_lo,
-                                 seed_hi, ctr, eos, rem, emit_w=None):
+                                 seed_hi, ctr, eos, rem, emit_w=None,
+                                 variant="stream"):
         """FUSED ragged window + on-device sample / accept-scan /
         stop-condition epilogue — the ONE program that replaces the
         fused decode, fused spec-verify, AND paged chunk-prefill
@@ -1423,7 +1437,7 @@ class GPTModel(nn.Layer):
              jnp.maximum(width - 1, 0)[:, None]], axis=1)   # [B, E+1]
         logits, new_k, new_v = self._ragged_window_tick_slots(
             window, k_pools, v_pools, block_tables, pos, width,
-            head_lanes=head_lanes)                     # [B, E+1, V]
+            head_lanes=head_lanes, variant=variant)    # [B, E+1, V]
         L = block_tables.shape[1] * k_pools[0].shape[1]
         picks = jnp.stack(
             [self._sample_lanes(
@@ -1437,9 +1451,13 @@ class GPTModel(nn.Layer):
         # — a B=1 body, NOT a vmapped batch: under the repo's rbg
         # default PRNG a vmapped categorical's bits depend on the
         # WHOLE key batch, and the XLA oracle's first-token pick
-        # (``sample_rows``) is a B=1 draw — this reproduces it
-        # bit-for-bit, which is what keeps seeded ragged streams
-        # token-identical to the XLA arm.  Behind a lax.cond: ticks
+        # (``sample_rows``) is a B=1 draw — this reproduces the draw
+        # MECHANISM bit-for-bit, which keeps seeded ragged streams
+        # token-identical to the XLA arm under variant="gather"
+        # (bitwise logits); the streaming variant's online softmax
+        # reorders float summation, so its seeded guarantee is
+        # determinism (same seed => same stream), with greedy streams
+        # still token-identical.  Behind a lax.cond: ticks
         # without a final-chunk lane (the steady state) skip the
         # per-slot scan entirely.
         import jax
@@ -1508,7 +1526,7 @@ class GPTModel(nn.Layer):
                 new_rem, new_k, new_v)
 
     def _compiled_ragged_window_fn(self, pnames, params, cache_key,
-                                   emit_w=None):
+                                   emit_w=None, variant="stream"):
         """Build (or fetch) the jitted FUSED RAGGED WINDOW dispatch
         (``Engine(attn_impl="ragged")``): (p_list, b_list, k_pools,
         v_pools, block_tables [B, L//bs], toks [B, W], width [B],
@@ -1529,10 +1547,13 @@ class GPTModel(nn.Layer):
         from ..core import autograd
         from ..jit import _swapped
 
-        # emit_w is baked into the compiled program (it fixes the
-        # picks lane count), so it MUST distinguish cache entries —
-        # enforced here rather than trusted to every caller's key
-        cache_key = (cache_key, None if emit_w is None else int(emit_w))
+        # emit_w and the kernel variant are baked into the compiled
+        # program (emit_w fixes the picks lane count; variant picks
+        # the stream vs gather kernel body), so they MUST distinguish
+        # cache entries — enforced here rather than trusted to every
+        # caller's key
+        cache_key = (cache_key, None if emit_w is None else int(emit_w),
+                     str(variant))
         cache = getattr(self, "_ragged_window_fn_cache", None)
         if cache is None:
             cache = self._ragged_window_fn_cache = {}
@@ -1553,7 +1574,7 @@ class GPTModel(nn.Layer):
                         toks, k_pools, v_pools, block_tables, width,
                         mode, lanes, tok, pos, temp, top_k, top_p,
                         seed_lo, seed_hi, ctr, eos, rem,
-                        emit_w=emit_w)
+                        emit_w=emit_w, variant=variant)
             return out
 
         fn = jax.jit(pure, donate_argnums=(2, 3))

@@ -12,8 +12,8 @@ High-Performance and Flexible LLM Inference Kernel for TPU"
 block tables become kernel *data* instead of trace-time *shape* —
 
 * the grid runs over SLOTS; each program instance walks its slot's
-  block table (a kv-block loop inside the instance) to gather the
-  slot's logical K/V row from the shared physical pools,
+  block table (a kv-block loop inside the instance) against the
+  shared physical pools,
 * ``pos[b]`` (the slot's window start) drives the causal mask, so a
   short slot is masked by its length instead of padded to the pool's,
 * ``width[b]`` says how many of the W query lanes are REAL this tick —
@@ -22,15 +22,42 @@ block tables become kernel *data* instead of trace-time *shape* —
   never read) — so mixed prefill-chunk + decode + spec traffic shares
   ONE program whose static width is just the engine's maximum.
 
-Numerics are the XLA oracle's, on purpose: the kernel gathers the
-whole logical row and runs the same f32 score -> -1e30 mask -> softmax
--> value contraction as ``GPTAttention._slot_attn``, so the engine's
-token-parity guarantees (greedy AND seeded) carry over to the kernel
-path — tier-1 runs this very kernel under ``interpret=True`` on CPU
-and asserts token-for-token equality against the XLA path.  (A
-flash-style online softmax over the kv-block loop would save VMEM on
-long contexts but breaks bit-parity with the oracle; it belongs behind
-the real-TPU tier of the ``pallas`` marker.)
+STREAMING (``variant="stream"``, the default): a flash-style
+ONLINE-SOFTMAX loop.  K/V are consumed one paged block at a time
+inside a ``fori_loop`` over the slot's LIVE blocks (the loop stops at
+the causal horizon ``ceil((pos + width) / block_size)``, so a decode
+tick touches only the blocks that actually hold history), carrying a
+per-(head, lane) running max ``m``, normalizer ``l``, and an output
+accumulator ``acc`` rescaled by ``exp(m_old - m_new)`` per block —
+the standard flash-attention recurrence.  The per-slot working set is
+therefore **O(block_size x window)** — one K block, one V block, one
+[H, W, block_size] score tile, and the [W, H, hd] accumulator —
+*independent of context length*, where the gather variant's is
+O(context_len): multi-thousand-token contexts stop being VMEM-bounded
+and the compiled program stays O(1) in size (the gather variant
+unrolls a Python loop over ``L // block_size`` table entries, so its
+trace/compile cost — and its concatenated [L, H, hd] row — grow
+linearly with the context ceiling).
+
+GATHER (``variant="gather"``, kept behind ``attn_impl=
+"ragged_gather"`` for A/B): the original form — materialize the whole
+logical [L, H, hd] row, then one monolithic f32 score -> -1e30 mask ->
+softmax -> value contraction, BITWISE-equal to the XLA oracle
+(``GPTAttention._slot_attn``) on CPU.
+
+NUMERICS CONTRACT: online softmax reorders float summation (block-
+sequential accumulation instead of one reduction over L), so the
+streaming kernel is **allclose** to the XLA oracle — not bitwise —
+and the engine-level guarantee shifts accordingly: greedy streams are
+asserted TOKEN-IDENTICAL to the XLA oracle end-to-end across the full
+layout matrix (paged x plain/chunked/spec x depth 1+2 x int8 KV x
+adapter lanes; tests/test_ragged_attn.py), while seeded streams are
+asserted deterministic (same seed => same stream) and are bitwise
+arm-identical only under ``variant="gather"``.  Both variants share
+the masking rule, the int8 per-block scale operands, and the callers'
+LoRA bank plumbing; tier-1 runs both under ``interpret=True`` on CPU,
+and the compiled Mosaic lowering is the TPU tier of the ``pallas``
+marker.
 
 K/V WRITES stay outside the kernel (the callers' width-masked scatter
 — see ``GPTAttention.ragged_window_paged``): lanes past ``width[b]``
@@ -42,6 +69,8 @@ from __future__ import annotations
 
 import math
 
+VARIANTS = ("stream", "gather")
+
 
 def _auto_interpret():
     """Pallas interpret mode unless we are actually on TPU — tier-1
@@ -51,9 +80,143 @@ def _auto_interpret():
     return jax.default_backend() != "tpu"
 
 
-def _ragged_paged_attention_impl(q, k_flat, v_flat, block_tables, pos,
-                                 width, block_size, interpret,
-                                 k_scale=None, v_scale=None):
+def kernel_working_set_bytes(*, variant, block_size, blocks_per_slot,
+                             width, num_heads, head_dim):
+    """Analytic per-slot VMEM working-set proxy of one kernel instance
+    (f32 compute bytes of the live K/V tiles + score tile + carry; the
+    serving_longctx bench records it against context length).  The
+    streaming variant is FLAT in ``blocks_per_slot`` — its K/V tile is
+    one block and its carry is the [W, H, hd] accumulator — while the
+    gather variant's whole logical row and [H, W, L] score matrix grow
+    linearly with the context ceiling."""
+    if variant not in VARIANTS:
+        raise ValueError(f"variant must be one of {VARIANTS}, "
+                         f"got {variant!r}")
+    bs, nb, W = int(block_size), int(blocks_per_slot), int(width)
+    H, hd = int(num_heads), int(head_dim)
+    q = W * H * hd * 4
+    if variant == "gather":
+        kv = 2 * nb * bs * H * hd * 4      # the full gathered row, x2
+        scores = H * W * nb * bs * 4       # [H, W, L] score/prob tile
+        return q + kv + scores + W * H * hd * 4
+    kv = 2 * bs * H * hd * 4               # ONE K block + ONE V block
+    scores = H * W * bs * 4                # [H, W, block_size] tile
+    carry = 2 * H * W * 4 + W * H * hd * 4  # m, l + accumulator
+    return q + kv + scores + carry
+
+
+def _stream_impl(q, k_flat, v_flat, block_tables, pos, width,
+                 block_size, interpret, k_scale=None, v_scale=None):
+    """Flash-style online-softmax streaming kernel (module docstring):
+    fori over the slot's live blocks with running (m, l, acc)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    B, W, H, hd = q.shape
+    nb = block_tables.shape[1]
+    bs = block_size
+    scale = 1.0 / math.sqrt(hd)
+    quant = k_scale is not None
+
+    def kernel(tables_ref, pos_ref, width_ref, q_ref, k_ref, v_ref,
+               *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref = rest
+        else:
+            (o_ref,) = rest
+        b = pl.program_id(0)
+        p = pos_ref[b]
+        w = width_ref[b]
+        qa = q_ref[0].astype(jnp.float32)                # [W, H, hd]
+        s_ids = jax.lax.broadcasted_iota(jnp.int32, (W, bs), 0)
+        r_ids = jax.lax.broadcasted_iota(jnp.int32, (W, bs), 1)
+
+        def block(j, scale_ref, pool_ref):
+            # gather ONE paged block: physical block ids are runtime
+            # data; bs is the only static extent.  Quantized pools
+            # dequantize PER STREAMED BLOCK — int8 codes times that
+            # block's per-head scale row, right where the block enters
+            # the recurrence, never the whole pool.
+            idx = tables_ref[b, j]
+            blk = pool_ref[pl.ds(idx * bs, bs)]          # [bs, H, hd]
+            if scale_ref is not None:
+                s = scale_ref[pl.ds(idx, 1)][0]          # [H]
+                return blk.astype(jnp.float32) * s[None, :, None]
+            return blk.astype(jnp.float32)
+
+        def body(j, carry):
+            m, l, acc = carry
+            kb = block(j, ks_ref if quant else None, k_ref)
+            vb = block(j, vs_ref if quant else None, v_ref)
+            sc = jnp.einsum("qhd,khd->hqk", qa, kb) * scale
+            # query lane s sees cache positions <= pos + s — the
+            # slot's LENGTH does the masking, not a padded shape
+            visible = (j * bs + r_ids) <= (p + s_ids)    # [W, bs]
+            sc = jnp.where(visible[None, :, :], sc, -1e30)
+            bm = jnp.max(sc, axis=2)                     # [H, W]
+            new_m = jnp.maximum(m, bm)
+            # multiply by the mask, not just the -1e30 floor: a fully
+            # masked tile must contribute EXACTLY zero mass even while
+            # the running max is still at its -1e30 init (where
+            # exp(sc - new_m) would read exp(0) = 1)
+            pj = jnp.exp(sc - new_m[:, :, None]) \
+                * visible[None, :, :].astype(jnp.float32)
+            corr = jnp.exp(m - new_m)                    # [H, W]
+            l = l * corr + jnp.sum(pj, axis=2)
+            acc = acc * corr[:, :, None] \
+                + jnp.einsum("hqk,khd->hqd", pj, vb)
+            return new_m, l, acc
+
+        # causal horizon: the last visible position is pos + width - 1
+        # (width >= 1; a parked width-0 slot still walks block 0 so
+        # the normalizer never hits zero — its lanes are zeroed below
+        # anyway).  Blocks past the horizon are fully masked, so
+        # skipping them is EXACT — and it is what makes per-tick block
+        # walks O(live context), not O(table length).
+        n_live = jnp.minimum(
+            nb, (p + jnp.maximum(w, 1) - 1) // bs + 1)
+        m0 = jnp.full((H, W), -1e30, jnp.float32)
+        l0 = jnp.zeros((H, W), jnp.float32)
+        a0 = jnp.zeros((H, W, hd), jnp.float32)
+        m, l, acc = jax.lax.fori_loop(0, n_live, body, (m0, l0, a0))
+        ctx = jnp.transpose(acc / l[:, :, None], (1, 0, 2))
+        # width as data: lanes past this slot's real window are zeroed
+        # (parked slots — width 0 — return all-zero, never-read lanes)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (W, 1, 1), 0)
+        ctx = jnp.where(lane < w, ctx, 0.0)
+        o_ref[0] = ctx.astype(o_ref.dtype)
+
+    in_specs = [
+        pl.BlockSpec(block_tables.shape, lambda b: (0, 0)),
+        pl.BlockSpec(pos.shape, lambda b: (0,)),
+        pl.BlockSpec(width.shape, lambda b: (0,)),
+        pl.BlockSpec((1, W, H, hd), lambda b: (b, 0, 0, 0)),
+        pl.BlockSpec(k_flat.shape, lambda b: (0, 0, 0)),
+        pl.BlockSpec(v_flat.shape, lambda b: (0, 0, 0)),
+    ]
+    operands = [block_tables, pos, width, q, k_flat, v_flat]
+    if quant:
+        in_specs += [
+            pl.BlockSpec(k_scale.shape, lambda b: (0, 0)),
+            pl.BlockSpec(v_scale.shape, lambda b: (0, 0)),
+        ]
+        operands += [k_scale, v_scale]
+    return pl.pallas_call(
+        kernel,
+        grid=(B,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, W, H, hd), lambda b: (b, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, W, H, hd), q.dtype),
+        interpret=interpret,
+    )(*operands)
+
+
+def _gather_impl(q, k_flat, v_flat, block_tables, pos, width,
+                 block_size, interpret, k_scale=None, v_scale=None):
+    """Gather-then-softmax kernel (``attn_impl="ragged_gather"``):
+    materialize the full logical row, one monolithic softmax —
+    bitwise-equal to the XLA oracle, O(context_len) working set."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -78,10 +241,10 @@ def _ragged_paged_attention_impl(q, k_flat, v_flat, block_tables, pos,
         def rows(pool_ref, scale_ref):
             # kv-block loop: gather this slot's logical [L] row
             # through its block table (physical block ids are runtime
-            # data; nb/bs are the only static shapes).  Quantized
-            # pools dequantize PER GATHERED BLOCK — int8 codes times
-            # that block's per-head scale row, right here where the
-            # block enters the contraction, never the whole pool.
+            # data; nb/bs are the only static shapes — note the
+            # UNROLLED Python loop: program size and trace time grow
+            # with nb, the gather variant's context-ceiling tax).
+            # Quantized pools dequantize PER GATHERED BLOCK.
             parts = []
             for j in range(nb):
                 blk = pool_ref[pl.ds(tables_ref[b, j] * bs, bs)]
@@ -143,7 +306,8 @@ def _ragged_paged_attention_impl(q, k_flat, v_flat, block_tables, pos,
 
 def ragged_paged_attention(q, k_flat, v_flat, block_tables, pos, width,
                            *, block_size, interpret=None,
-                           k_scale=None, v_scale=None):
+                           k_scale=None, v_scale=None,
+                           variant="stream"):
     """Ragged paged attention over a slot pool (see module docstring).
 
     q : [B, W, H, hd] query window per slot (W = the engine's static
@@ -158,14 +322,23 @@ def ragged_paged_attention(q, k_flat, v_flat, block_tables, pos, width,
         lanes >= width are zeroed).
     k_scale / v_scale : optional f32 [num_blocks, H] per-block
         per-head dequant multipliers (``Engine(kv_dtype="int8")``):
-        the kernel dequantizes each gathered block in-loop — codes
-        times the block's scale row, adjacent to the contraction —
-        so the logical K/V row never materializes outside VMEM and
-        the whole pool is never dequantized.  Pass both or neither.
+        the kernel dequantizes each block in-loop — codes times the
+        block's scale row, adjacent to the contraction — so the
+        logical K/V row never materializes outside VMEM and the whole
+        pool is never dequantized.  Pass both or neither.
+    variant : ``"stream"`` (default) — flash-style online softmax,
+        O(block_size x W) working set, allclose to the oracle;
+        ``"gather"`` — materialize-the-row form, O(context_len)
+        working set, bitwise-equal to the oracle (the A/B reference
+        behind ``attn_impl="ragged_gather"``).
     Returns ctx [B, W, H, hd] in q's dtype.
     """
     import jax.numpy as jnp
 
+    if variant not in VARIANTS:
+        raise ValueError(
+            f"ragged_paged_attention: variant must be one of "
+            f"{VARIANTS}, got {variant!r}")
     if (k_scale is None) != (v_scale is None):
         raise ValueError(
             "ragged_paged_attention: pass both k_scale and v_scale "
@@ -175,7 +348,8 @@ def ragged_paged_attention(q, k_flat, v_flat, block_tables, pos, width,
     if k_scale is not None:
         k_scale = jnp.asarray(k_scale, jnp.float32)
         v_scale = jnp.asarray(v_scale, jnp.float32)
-    return _ragged_paged_attention_impl(
+    impl = _stream_impl if variant == "stream" else _gather_impl
+    return impl(
         q, k_flat, v_flat,
         jnp.asarray(block_tables, jnp.int32),
         jnp.asarray(pos, jnp.int32), jnp.asarray(width, jnp.int32),

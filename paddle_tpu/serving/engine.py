@@ -313,9 +313,23 @@ class Engine:
         longest-accepted-prefix scan folds into the program's
         epilogue, and the whole (chunk shape, spec_k) compile matrix
         collapses to ONE ``ragged_window`` program — watch
-        ``serving.compiles_total`` and the ``decode.ragged`` trace
-        span.  Greedy AND seeded outputs are token-identical to the
-        XLA path (asserted in tests/test_ragged_attn.py).
+        ``serving.compiles_total`` and the ``decode.ragged_stream``
+        trace span (plus ``serving.kv_blocks_walked_per_tick``).
+        The kernel body is the flash-style ONLINE-SOFTMAX streaming
+        loop: K/V are consumed one paged block at a time up to each
+        lane's causal horizon, so the per-slot working set is
+        O(block_size x window) — independent of context length — and
+        long contexts are first-class.  Numerics: allclose to the XLA
+        oracle (online softmax reorders float summation); GREEDY
+        streams are token-identical to the XLA path end-to-end across
+        the full layout matrix, seeded streams are deterministic
+        (same seed => same stream); both asserted in
+        tests/test_ragged_attn.py.  ``"ragged_gather"`` keeps the
+        original materialize-the-row kernel body — O(context) working
+        set, bitwise-equal to the XLA oracle on CPU, greedy AND
+        seeded token-identical — as the A/B reference (trace span
+        ``decode.ragged``; same dispatch path and compile-matrix
+        collapse otherwise).
     mesh : TENSOR-PARALLEL SERVING over a device mesh.  ``None``
         (default) serves on one device.  An int / 1-tuple ``mp``
         degree (resolved over the first mp devices via
@@ -837,24 +851,28 @@ class Engine:
         # -- ragged paged attention (attn_impl="ragged") ----------------
         if attn_impl is None:
             attn_impl = getattr(model, "attn_impl", "xla")
-        if attn_impl not in ("xla", "ragged"):
+        if attn_impl not in ("xla", "ragged", "ragged_gather"):
             raise ValueError(
-                f"attn_impl must be 'xla' or 'ragged', got "
-                f"{attn_impl!r}")
-        if attn_impl == "ragged":
+                f"attn_impl must be 'xla', 'ragged' or "
+                f"'ragged_gather', got {attn_impl!r}")
+        if attn_impl in ("ragged", "ragged_gather"):
             if not self._paged:
                 raise ValueError(
-                    "attn_impl='ragged' requires the paged KV layout "
-                    "(kv_block_size=...): the kernel reads K/V through "
-                    "per-slot block tables — the contiguous layout "
-                    "keeps the XLA path")
+                    f"attn_impl={attn_impl!r} requires the paged KV "
+                    "layout (kv_block_size=...): the kernel reads K/V "
+                    "through per-slot block tables — the contiguous "
+                    "layout keeps the XLA path")
             if sample_mode != "device":
                 raise ValueError(
-                    "attn_impl='ragged' requires sample_mode='device':"
-                    " sampling, the acceptance scan, and the stop "
-                    "condition all run in the ragged program's "
-                    "epilogue")
+                    f"attn_impl={attn_impl!r} requires "
+                    "sample_mode='device': sampling, the acceptance "
+                    "scan, and the stop condition all run in the "
+                    "ragged program's epilogue")
         self.attn_impl = attn_impl
+        # both ragged kernels share the dispatch path; "ragged" is
+        # the streaming (online-softmax) body, "ragged_gather" the
+        # materialize-the-row A/B reference (ops/ragged_paged_attn.py)
+        self._ragged = attn_impl in ("ragged", "ragged_gather")
         # the ONE ragged program's static window: wide enough for a
         # one-token decode lane, the k+1 spec-verify window, and a
         # prefill chunk — per-slot width is runtime data, so the
@@ -1027,6 +1045,19 @@ class Engine:
         self._m_fused_ticks = reg.counter(
             "serving.fused_sample_ticks", "decode dispatches that "
             "sampled on device (sample_mode='device')")
+        self._m_kv_blocks_walked = reg.gauge(
+            "serving.kv_blocks_walked_per_tick", "KV blocks the "
+            "ragged kernel walked in the latest dispatch, summed over "
+            "lanes: the streaming kernel (attn_impl='ragged') stops "
+            "at each lane's causal horizon ceil((pos + width) / "
+            "block_size), so this tracks LIVE context; the gather "
+            "variant (attn_impl='ragged_gather') always concatenates "
+            "the full per-slot table")
+        # max context length any request has reached on this engine
+        # (slot cursor high-water: prefilled prompt + decoded tokens)
+        # — surfaced in /healthz and /debug/requests so the fleet's
+        # long-context exposure is observable per replica
+        self._max_context_len = 0
         # async-loop surface (registered always; overlap stays empty
         # and async_depth reads 1 when the loop is synchronous)
         self._m_async_depth = reg.gauge(
@@ -2472,6 +2503,7 @@ class Engine:
                 "spec_k": self._spec_k,
                 "sample_mode": self.sample_mode,
                 "attn_impl": self.attn_impl,
+                "max_context_len": self._max_context_len,
                 "mesh_shape": self.mesh_axes,
                 "mp": self.mp,
                 "kv_block_bytes_per_shard":
@@ -3079,6 +3111,13 @@ class Engine:
                 ttft_ms=round((now - req.submitted_at) * 1e3, 3))
         self._m_tokens.inc()
         self._m_rate.add(1, now)
+        # context high-water mark: prompt + everything decoded so far
+        # — the max context length this engine has actually served
+        # (reported in /healthz + /debug/requests, copied into the
+        # router's probe signals)
+        ctx_len = len(req.prompt) + len(req.generated)
+        if ctx_len > self._max_context_len:
+            self._max_context_len = ctx_len
         finished = (len(req.generated) >= req.max_new_tokens or
                     (req.eos_token_id is not None
                      and int(tok) == int(req.eos_token_id)))
@@ -3572,6 +3611,25 @@ class Engine:
         # itself advances them by width)
         if self._state_dirty or self._dev_state is None:
             self._push_state()
+        variant = "gather" if self.attn_impl == "ragged_gather" \
+            else "stream"
+        # kv blocks the kernel walks this tick (computed on the
+        # PRE-dispatch cursors, before the chunk lanes' mirror
+        # advance): the streaming loop stops at each lane's causal
+        # horizon ceil((pos + width) / block_size), while the gather
+        # body always concatenates the slot's FULL table — the
+        # per-tick block-walk cost the kv_blocks_walked_per_tick
+        # gauge makes attributable (and the serving_longctx bench
+        # plots flat vs context length for the streaming variant)
+        walked = 0
+        for s in (list(active) + [sl for sl, _, _ in plan]):
+            i = s.index
+            if variant == "gather":
+                walked += self._bps
+            else:
+                live = int(self._pos[i]) + max(int(width[i]), 1)
+                walked += min(self._bps, (live - 1) // self._bs + 1)
+        self._m_kv_blocks_walked.set(walked)
         for slot, n, final in plan:
             i = slot.index
             # dispatch-time bookkeeping (kept consistent with the
@@ -3597,11 +3655,14 @@ class Engine:
                          self._kv_managed + 1, self._bs,
                          self._kv_dtype_str, tuple(self._pnames),
                          self._bnames_all)),
-                    emit_w=spec_w)
+                    emit_w=spec_w, variant=variant)
         self._fault("dispatch")
-        with tr.span("decode.ragged", batch=len(active) + len(plan),
+        span_name = "decode.ragged_stream" if variant == "stream" \
+            else "decode.ragged"
+        with tr.span(span_name, batch=len(active) + len(plan),
                      layout="paged", w=W, chunks=len(plan),
-                     chunk_tokens=chunk_toks, fused=True), \
+                     chunk_tokens=chunk_toks, fused=True,
+                     kv_blocks_walked=walked), \
                 self._dequant_span(tr, len(active) + len(plan)):
             (picks, n_acc, n_emit, done, new_tok, new_pos, new_ctr,
              new_rem, self.k_pools, self.v_pools) = self._ragged_fn(
@@ -3965,7 +4026,7 @@ class Engine:
             for slot in admitted:
                 self._begin_chunked(slot)
             _, _, prefilling = self.scheduler.snapshot()
-            if prefilling and self.attn_impl != "ragged":
+            if prefilling and not self._ragged:
                 # ragged mode: chunks ride as lanes of the unified
                 # dispatch below — and because their tokens are known
                 # up front (no data dependence on the in-flight
@@ -3988,7 +4049,7 @@ class Engine:
         if self._ring and (self._state_dirty or self._dev_state is None):
             emitted += self._drain_ring(tr)
         occ, active, prefilling = self.scheduler.snapshot()
-        ragged = self.attn_impl == "ragged"
+        ragged = self._ragged
         if active and self._ring and self._spec_k is None and \
                 not (ragged and prefilling) and \
                 all(self._rem[s.index] <= len(self._ring)
@@ -4081,7 +4142,7 @@ class Engine:
             for slot in admitted:
                 self._begin_chunked(slot)
             occ, active, prefilling = self.scheduler.snapshot()
-            if prefilling and self.attn_impl != "ragged":
+            if prefilling and not self._ragged:
                 # ragged mode skips the per-chunk dispatch loop —
                 # chunks ride as window lanes of the unified dispatch
                 n_emit, newly, n_evicted = \
@@ -4090,7 +4151,7 @@ class Engine:
                 occ -= n_evicted
                 active = active + newly  # final-chunk slots decode in
                 #   this same tick, like monolithic emit-then-decode
-        if self.attn_impl == "ragged":
+        if self._ragged:
             plan = (self._plan_ragged_chunks(prefilling)
                     if self._chunk is not None else [])
             if active or plan:

@@ -267,10 +267,18 @@ class _Handler(JsonHandler):
                 "role": self.role,
                 # which attention implementation serves the paged
                 # dispatches: "ragged" = the Pallas ragged paged
-                # attention kernel (one program for decode / spec /
-                # chunk windows), "xla" = the per-shape gather/
-                # scatter programs (the CPU parity oracle)
+                # attention kernel in its streaming online-softmax
+                # form (one program for decode / spec / chunk
+                # windows, O(block_size x window) working set),
+                # "ragged_gather" = the materialize-the-row A/B
+                # reference, "xla" = the per-shape gather/scatter
+                # programs (the CPU parity oracle); the router copies
+                # this into its registry signals like kv_dtype
                 "attn_impl": getattr(eng, "attn_impl", "xla"),
+                # long-context exposure: max context length (prompt +
+                # decoded) any request has reached on this replica
+                "max_context_len": getattr(
+                    eng, "_max_context_len", 0),
                 # tensor-parallel mesh surface: the router registry
                 # carries these so a fleet view (and timeline.py
                 # --router) can label sharded replicas; kv blocks are

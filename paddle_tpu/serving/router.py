@@ -716,7 +716,12 @@ class Router:
                           # is what pick(model=...) routes on, and
                           # live stream counts label the fleet in
                           # timeline.py --router
-                          "adapters", "streams_active")})
+                          "adapters", "streams_active",
+                          # kernel variant + long-context exposure:
+                          # which ragged kernel body the replica
+                          # serves (stream vs gather A/B) and the max
+                          # context length it has actually reached
+                          "attn_impl", "max_context_len")})
                     if self._kv_bs is None \
                             and info.get("kv_block_size"):
                         self._kv_bs = int(info["kv_block_size"])
@@ -1641,6 +1646,8 @@ class InProcessReplica:
             "streams_active": (eng.streams_active()
                                if hasattr(eng, "streams_active")
                                else 0),
+            "attn_impl": getattr(eng, "attn_impl", "xla"),
+            "max_context_len": getattr(eng, "_max_context_len", 0),
         }
 
     def generate(self, payload, should_abort=None, on_token=None):

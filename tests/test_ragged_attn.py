@@ -7,6 +7,23 @@ scratch writes, and the one-program compile-matrix collapse the
 ``attn_impl="ragged"`` engine path claims.  Tests marked ``pallas``
 involve the kernel; the compiled-Mosaic variant additionally skips
 off-TPU (the marker's real-hardware tier).
+
+NUMERICS CONTRACT (two kernel bodies):
+
+* ``attn_impl="ragged"`` — the default STREAMING body, a flash-style
+  online-softmax loop over the slot's live blocks.  Online softmax
+  reorders float summation, so the kernel is ALLCLOSE to the oracle
+  (not bitwise); end-to-end, GREEDY streams are asserted
+  token-identical to the XLA arm across the full layout matrix and
+  seeded streams are asserted deterministic (same seed, same stream).
+* ``attn_impl="ragged_gather"`` — the materialize-the-row A/B
+  reference: BITWISE-equal to the oracle on CPU, greedy AND seeded
+  streams token-identical to the XLA arm.
+
+Tests marked ``longctx`` cover prompts spanning many KV blocks — the
+streaming kernel's O(block_size x window) working-set claim; the
+small-shape twins run in tier-1, the multi-thousand-token leg is
+additionally marked slow.
 """
 import math
 
@@ -63,28 +80,12 @@ def _serve_mixed(model, prompts, max_new=6, greedy_only=False, **kw):
 
 # -- kernel unit level ------------------------------------------------
 
-@pytest.mark.pallas
-def test_kernel_matches_oracle_gather_math():
-    """The kernel's gather -> f32 score -> mask -> softmax -> value
-    contraction equals the XLA oracle (``_slot_attn`` over the
-    block-table gather) BITWISE on CPU, per slot, for real lanes;
-    width-masked lanes (and whole parked width-0 slots) are zeroed."""
+def _kernel_oracle(q, k_flat, v_flat, tables, pos, width, bs):
+    """The batched _slot_attn math over the gathered rows."""
     import jax
     import jax.numpy as jnp
-    from paddle_tpu.ops.ragged_paged_attn import ragged_paged_attention
-
-    rng = np.random.RandomState(0)
-    B, W, H, hd = 4, 5, 4, 8
-    bs, nb, NB = 8, 6, 20
-    q = jnp.asarray(rng.randn(B, W, H, hd).astype(np.float32))
-    k_flat = jnp.asarray(rng.randn(NB * bs, H, hd).astype(np.float32))
-    v_flat = jnp.asarray(rng.randn(NB * bs, H, hd).astype(np.float32))
-    tables = jnp.asarray(rng.randint(0, NB, (B, nb)).astype(np.int32))
-    pos = jnp.asarray(np.array([3, 10, 0, 30], np.int32))
-    width = jnp.asarray(np.array([1, 5, 0, 3], np.int32))
-    out = np.asarray(ragged_paged_attention(
-        q, k_flat, v_flat, tables, pos, width, block_size=bs))
-    # oracle: the batched _slot_attn math over the gathered rows
+    B, W, H, hd = q.shape
+    nb = tables.shape[1]
     gidx = ((np.asarray(tables) * bs)[:, :, None]
             + np.arange(bs)[None, None, :]).reshape(B, -1)
     k_rows = np.asarray(k_flat)[gidx]
@@ -100,22 +101,138 @@ def test_kernel_matches_oracle_gather_math():
     scores = jnp.where(jnp.asarray(visible)[:, None, :, :], scores,
                        -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    ctx = np.asarray(jnp.einsum("bhqk,bkhd->bqhd", probs,
-                                jnp.asarray(v_rows, jnp.float32)))
+    return np.asarray(jnp.einsum("bhqk,bkhd->bqhd", probs,
+                                 jnp.asarray(v_rows, jnp.float32)))
+
+
+@pytest.mark.pallas
+@pytest.mark.parametrize("variant", ["stream", "gather"])
+def test_kernel_matches_oracle(variant):
+    """Per slot, for real lanes, against the XLA oracle math
+    (``_slot_attn`` over the block-table gather): the GATHER body is
+    BITWISE-equal on CPU; the STREAMING body's online softmax is
+    allclose (block-sequential accumulation reorders the float sums).
+    Width-masked lanes (and whole parked width-0 slots) are zeroed
+    EXACTLY under both bodies."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.ragged_paged_attn import ragged_paged_attention
+
+    rng = np.random.RandomState(0)
+    B, W, H, hd = 4, 5, 4, 8
+    bs, nb, NB = 8, 6, 20
+    q = jnp.asarray(rng.randn(B, W, H, hd).astype(np.float32))
+    k_flat = jnp.asarray(rng.randn(NB * bs, H, hd).astype(np.float32))
+    v_flat = jnp.asarray(rng.randn(NB * bs, H, hd).astype(np.float32))
+    tables = jnp.asarray(rng.randint(0, NB, (B, nb)).astype(np.int32))
+    pos = jnp.asarray(np.array([3, 10, 0, 30], np.int32))
+    width = jnp.asarray(np.array([1, 5, 0, 3], np.int32))
+    out = np.asarray(ragged_paged_attention(
+        q, k_flat, v_flat, tables, pos, width, block_size=bs,
+        variant=variant))
+    ctx = _kernel_oracle(q, k_flat, v_flat, tables, pos, width, bs)
     for b in range(B):
         w = int(width[b])
         if w:
-            np.testing.assert_array_equal(out[b, :w], ctx[b, :w])
+            if variant == "gather":
+                np.testing.assert_array_equal(out[b, :w], ctx[b, :w])
+            else:
+                np.testing.assert_allclose(out[b, :w], ctx[b, :w],
+                                           rtol=2e-5, atol=2e-6)
         assert np.all(out[b, w:] == 0.0), \
             "width-masked lanes must be zeroed (width is kernel data)"
 
 
 @pytest.mark.pallas
+def test_kernel_variant_validation():
+    import jax.numpy as jnp
+    from paddle_tpu.ops.ragged_paged_attn import (
+        kernel_working_set_bytes, ragged_paged_attention)
+
+    z = jnp.zeros((1, 1, 1, 4), jnp.float32)
+    with pytest.raises(ValueError, match="variant"):
+        ragged_paged_attention(
+            z, jnp.zeros((8, 1, 4)), jnp.zeros((8, 1, 4)),
+            jnp.zeros((1, 1), jnp.int32), jnp.zeros(1, jnp.int32),
+            jnp.ones(1, jnp.int32), block_size=8, variant="bogus")
+    with pytest.raises(ValueError, match="variant"):
+        kernel_working_set_bytes(variant="bogus", block_size=8,
+                                 blocks_per_slot=4, width=4,
+                                 num_heads=2, head_dim=8)
+    # the analytic VMEM proxy: streaming is FLAT in context length,
+    # gather grows linearly with it
+    args = dict(block_size=8, width=4, num_heads=2, head_dim=8)
+    s4 = kernel_working_set_bytes(variant="stream",
+                                  blocks_per_slot=4, **args)
+    s64 = kernel_working_set_bytes(variant="stream",
+                                   blocks_per_slot=64, **args)
+    g4 = kernel_working_set_bytes(variant="gather",
+                                  blocks_per_slot=4, **args)
+    g8 = kernel_working_set_bytes(variant="gather",
+                                  blocks_per_slot=8, **args)
+    g64 = kernel_working_set_bytes(variant="gather",
+                                   blocks_per_slot=64, **args)
+    assert s4 == s64, "streaming working set must not grow with blocks"
+    assert g64 - g4 == 15 * (g8 - g4), "gather grows linearly"
+    assert g64 > 10 * s64
+
+
+@pytest.mark.pallas
+@pytest.mark.longctx
+def test_kernel_stream_allclose_long_tables():
+    """Long-context kernel twin (prompts >= 8x block_size): a table
+    of MANY live blocks, decode + verify + chunk widths mixed, int8
+    per-block scales included — the streaming body stays allclose to
+    the oracle while walking only the live horizon."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.ragged_paged_attn import ragged_paged_attention
+
+    rng = np.random.RandomState(1)
+    B, W, H, hd = 3, 5, 4, 8
+    bs, nb, NB = 8, 16, 48                    # up to 128 ctx tokens
+    q = jnp.asarray(rng.randn(B, W, H, hd).astype(np.float32))
+    k_flat = jnp.asarray(rng.randn(NB * bs, H, hd).astype(np.float32))
+    v_flat = jnp.asarray(rng.randn(NB * bs, H, hd).astype(np.float32))
+    tables = jnp.asarray(rng.randint(0, NB, (B, nb)).astype(np.int32))
+    pos = jnp.asarray(np.array([100, 127 - 5, 64], np.int32))
+    width = jnp.asarray(np.array([1, 5, 3], np.int32))
+    out = np.asarray(ragged_paged_attention(
+        q, k_flat, v_flat, tables, pos, width, block_size=bs,
+        variant="stream"))
+    ctx = _kernel_oracle(q, k_flat, v_flat, tables, pos, width, bs)
+    for b in range(B):
+        w = int(width[b])
+        np.testing.assert_allclose(out[b, :w], ctx[b, :w],
+                                   rtol=2e-5, atol=2e-6)
+        assert np.all(out[b, w:] == 0.0)
+    # int8 codes + per-block scales: stream and gather dequantize the
+    # same blocks, so they agree to float-reassociation tolerance at
+    # long context too
+    ck = jnp.asarray(rng.randint(-127, 128, (NB * bs, H, hd))
+                     .astype(np.int8))
+    cv = jnp.asarray(rng.randint(-127, 128, (NB * bs, H, hd))
+                     .astype(np.int8))
+    ks = jnp.asarray(rng.uniform(0.01, 0.05, (NB, H))
+                     .astype(np.float32))
+    vs = jnp.asarray(rng.uniform(0.01, 0.05, (NB, H))
+                     .astype(np.float32))
+    sq = ragged_paged_attention(q, ck, cv, tables, pos, width,
+                                block_size=bs, k_scale=ks, v_scale=vs,
+                                variant="stream")
+    gq = ragged_paged_attention(q, ck, cv, tables, pos, width,
+                                block_size=bs, k_scale=ks, v_scale=vs,
+                                variant="gather")
+    np.testing.assert_allclose(np.asarray(sq), np.asarray(gq),
+                               rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.pallas
 @pytest.mark.slow
-def test_kernel_compiled_lowering_on_tpu():
+@pytest.mark.parametrize("variant", ["stream", "gather"])
+def test_kernel_compiled_lowering_on_tpu(variant):
     """Real-TPU tier: the same kernel compiled through Mosaic (no
-    interpret) matches interpret mode.  Skips everywhere but TPU —
-    the pallas marker's hardware-gated variant."""
+    interpret) matches interpret mode — for BOTH bodies, streaming
+    online-softmax included.  Skips everywhere but TPU — the pallas
+    marker's hardware-gated variant."""
     import jax
     if jax.default_backend() != "tpu":
         pytest.skip("compiled Mosaic lowering needs a TPU backend")
@@ -130,9 +247,11 @@ def test_kernel_compiled_lowering_on_tpu():
     pos = jnp.asarray(np.array([3, 9], np.int32))
     width = jnp.asarray(np.array([4, 1], np.int32))
     a = ragged_paged_attention(q, k, v, tables, pos, width,
-                               block_size=16, interpret=True)
+                               block_size=16, interpret=True,
+                               variant=variant)
     b = ragged_paged_attention(q, k, v, tables, pos, width,
-                               block_size=16, interpret=False)
+                               block_size=16, interpret=False,
+                               variant=variant)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                rtol=2e-5, atol=2e-5)
 
@@ -149,6 +268,16 @@ def test_attn_impl_validation(tiny_gpt):
         _engine(tiny_gpt, attn_impl="ragged", kv_block_size=None)
     with pytest.raises(ValueError, match="device"):
         _engine(tiny_gpt, attn_impl="ragged", sample_mode="host")
+    # the gather A/B reference shares the ragged constraints
+    with pytest.raises(ValueError, match="paged"):
+        _engine(tiny_gpt, attn_impl="ragged_gather",
+                kv_block_size=None)
+    with pytest.raises(ValueError, match="device"):
+        _engine(tiny_gpt, attn_impl="ragged_gather",
+                sample_mode="host")
+    assert _engine(tiny_gpt,
+                   attn_impl="ragged_gather").attn_impl \
+        == "ragged_gather"
     # the engine inherits the model's knob when not overridden
     paddle.seed(0)
     m = GPTModel.from_config("tiny", dropout=0.0, attn_impl="ragged")
@@ -168,14 +297,47 @@ def test_attn_impl_validation(tiny_gpt):
     dict(prefill_chunk=8, async_depth=2),
     dict(spec_k=3, async_depth=2),
     dict(prefill_chunk=8, spec_k=3, async_depth=2),
+    dict(kv_dtype="int8", async_depth=2),
+    dict(kv_dtype="int8", prefill_chunk=8, spec_k=3, async_depth=2),
 ], ids=["plain-d1", "plain-d2", "chunked-d2", "spec-d2",
-        "chunked-spec-d2"])
+        "chunked-spec-d2", "kvint8-d2", "kvint8-chunked-spec-d2"])
 def test_ragged_parity_vs_xla_oracle(tiny_gpt, cfg):
-    """The acceptance criterion: greedy AND seeded streams under
-    ``attn_impl="ragged"`` (the Pallas kernel, interpret mode) are
-    token-identical to the XLA oracle across paged plain / chunked /
-    spec dispatch shapes at async depth 2 — and the greedy streams
-    equal per-request ``generate()``.
+    """THE acceptance criterion, full layout matrix with the
+    STREAMING kernel as the ``attn_impl="ragged"`` default: GREEDY
+    streams are token-identical to the XLA oracle across paged plain
+    / chunked / spec / int8-KV dispatch shapes at async depth 1 and 2
+    — and equal per-request ``generate()``.  (Seeded-stream
+    guarantees: determinism under streaming —
+    ``test_ragged_stream_seeded_deterministic`` — and bitwise arm
+    identity under the gather body —
+    ``test_ragged_gather_parity_vs_xla_oracle``.)"""
+    prompts = _prompts(4)
+    xla, _ = _serve_mixed(tiny_gpt, prompts, greedy_only=True,
+                          attn_impl="xla", **cfg)
+    rag, eng = _serve_mixed(tiny_gpt, prompts, greedy_only=True,
+                            attn_impl="ragged", **cfg)
+    assert xla == rag
+    if cfg.get("kv_dtype") is None:
+        # int8 engines legitimately diverge from the fp generate()
+        # oracle (quantized cache); fp engines must not
+        for i in range(4):
+            assert rag[i] == _ref(tiny_gpt, prompts[i], 6).tolist()
+    # refcount hygiene: the ragged path's width-masked writes never
+    # leak a block reference
+    if eng.prefix_cache is not None:
+        eng.prefix_cache.clear()
+    assert eng.block_pool.in_use() == 0
+
+
+@pytest.mark.pallas
+@pytest.mark.parametrize("cfg", [
+    dict(async_depth=2),
+    dict(prefill_chunk=8, spec_k=3, async_depth=2),
+], ids=["plain-d2", "chunked-spec-d2"])
+def test_ragged_gather_parity_vs_xla_oracle(tiny_gpt, cfg):
+    """The A/B reference keeps the ORIGINAL contract: greedy AND
+    seeded streams under ``attn_impl="ragged_gather"`` are
+    token-identical to the XLA oracle (bitwise kernel math).
 
     Chunked configs run the concurrent mix ALL-GREEDY plus a
     separate seeded single-request parity check: ragged chunk lanes
@@ -192,29 +354,45 @@ def test_ragged_parity_vs_xla_oracle(tiny_gpt, cfg):
         xla, _ = _serve_mixed(tiny_gpt, prompts, greedy_only=True,
                               attn_impl="xla", **cfg)
         rag, eng = _serve_mixed(tiny_gpt, prompts, greedy_only=True,
-                                attn_impl="ragged", **cfg)
+                                attn_impl="ragged_gather", **cfg)
         seeded = {}
-        for impl in ("xla", "ragged"):
+        for impl in ("xla", "ragged_gather"):
             e2 = _engine(tiny_gpt, attn_impl=impl, **cfg)
             r = e2.submit(prompts[1], max_new_tokens=10,
                           temperature=0.8, top_p=0.9, seed=42)
             e2.run_until_idle()
             seeded[impl] = r.result(timeout=2).tolist()
-        assert seeded["xla"] == seeded["ragged"]
+        assert seeded["xla"] == seeded["ragged_gather"]
     else:
         xla, _ = _serve_mixed(tiny_gpt, prompts, attn_impl="xla",
                               **cfg)
         rag, eng = _serve_mixed(tiny_gpt, prompts,
-                                attn_impl="ragged", **cfg)
+                                attn_impl="ragged_gather", **cfg)
     assert xla == rag
     greedy_lanes = range(4) if chunked else (0, 2)
     for i in greedy_lanes:
         assert rag[i] == _ref(tiny_gpt, prompts[i], 6).tolist()
-    # refcount hygiene: the ragged path's width-masked writes never
-    # leak a block reference
     if eng.prefix_cache is not None:
         eng.prefix_cache.clear()
     assert eng.block_pool.in_use() == 0
+
+
+@pytest.mark.pallas
+def test_ragged_stream_seeded_deterministic(tiny_gpt):
+    """The streaming kernel's seeded contract: same seed => same
+    stream, run-for-run (online softmax reorders float summation, so
+    bitwise-vs-XLA is the gather body's guarantee, not this one —
+    but a seeded stream must still be reproducible)."""
+    p = _prompts(1)[0]
+    runs = []
+    for _ in range(2):
+        eng = _engine(tiny_gpt, attn_impl="ragged", spec_k=2,
+                      async_depth=2)
+        r = eng.submit(p, max_new_tokens=10, temperature=0.8,
+                       top_p=0.9, seed=42)
+        eng.run_until_idle()
+        runs.append(r.result(timeout=2).tolist())
+    assert runs[0] == runs[1]
 
 
 @pytest.mark.pallas
@@ -346,16 +524,22 @@ def test_ragged_spec_d2h_payload_stays_97_bytes(tiny_gpt):
 
 @pytest.mark.pallas
 def test_ragged_healthz_debug_and_trace_span(tiny_gpt):
-    """/healthz and /debug/requests report the kernel selection, and
-    the trace carries ``decode.ragged`` spans (never the XLA path's
-    ``decode.dispatch``) so traces distinguish kernel dispatches."""
+    """/healthz and /debug/requests report the kernel selection AND
+    the max observed context length, the trace carries
+    ``decode.ragged_stream`` spans (never the XLA path's
+    ``decode.dispatch``, nor the gather body's ``decode.ragged``) so
+    traces distinguish kernel dispatches, and the per-tick block-walk
+    gauge is populated."""
     from paddle_tpu.serving.httpd import _Handler
 
     eng = _engine(tiny_gpt, prefill_chunk=8, attn_impl="ragged")
-    r = eng.submit(_prompts(1)[0], max_new_tokens=4)
+    p = _prompts(1)[0]
+    r = eng.submit(p, max_new_tokens=4)
     eng.run_until_idle()
     r.result(timeout=2)
-    assert eng.debug_requests()["engine"]["attn_impl"] == "ragged"
+    dbg = eng.debug_requests()["engine"]
+    assert dbg["attn_impl"] == "ragged"
+    assert dbg["max_context_len"] == len(p) + 4
 
     h = object.__new__(_Handler)
     h.engine = eng
@@ -371,12 +555,230 @@ def test_ragged_healthz_debug_and_trace_span(tiny_gpt):
     h.do_GET()
     code, body = sent["resp"]
     assert code == 200
-    assert _json.loads(body)["attn_impl"] == "ragged"
+    health = _json.loads(body)
+    assert health["attn_impl"] == "ragged"
+    assert health["max_context_len"] == len(p) + 4
 
     names = {ev.get("name")
              for ev in eng.chrome_trace()["traceEvents"]}
-    assert "decode.ragged" in names
+    assert "decode.ragged_stream" in names
+    assert "decode.ragged" not in names
     assert "decode.dispatch" not in names
+    # block-walk attribution: the last dispatch walked >= 1 block
+    assert eng.registry.get(
+        "serving.kv_blocks_walked_per_tick").value >= 1
+
+
+@pytest.mark.pallas
+def test_ragged_gather_trace_span_and_walk_gauge(tiny_gpt):
+    """The A/B arm keeps its own span name (``decode.ragged``) and
+    always walks the FULL per-slot table — its walk gauge reads
+    lanes x blocks_per_slot where the streaming arm's reads the live
+    horizon, which is the per-tick cost the A/B exists to show."""
+    streams = {}
+    for impl in ("ragged", "ragged_gather"):
+        eng = _engine(tiny_gpt, num_slots=2, attn_impl=impl)
+        r = eng.submit(_prompts(1)[0], max_new_tokens=4)
+        eng.run_until_idle()
+        streams[impl] = r.result(timeout=2).tolist()
+        names = {ev.get("name")
+                 for ev in eng.chrome_trace()["traceEvents"]}
+        walked = eng.registry.get(
+            "serving.kv_blocks_walked_per_tick").value
+        if impl == "ragged_gather":
+            assert "decode.ragged" in names
+            assert "decode.ragged_stream" not in names
+            # one live lane on the final tick, full table walked
+            assert walked == eng._bps
+        else:
+            assert "decode.ragged_stream" in names
+            assert walked < eng._bps  # a 5..9-token stream's horizon
+    # A/B serves the same greedy tokens
+    assert streams["ragged"] == streams["ragged_gather"]
+
+
+@pytest.mark.pallas
+@pytest.mark.router
+def test_router_probe_copies_attn_impl_signal(tiny_gpt):
+    """The router prober copies ``attn_impl`` and
+    ``max_context_len`` into the replica's registry signals like it
+    does ``kv_dtype`` — the fleet view can tell which kernel body
+    each replica serves and its long-context exposure."""
+    from paddle_tpu.serving import (InProcessReplica, Router,
+                                    RouterPolicy)
+
+    eng = _engine(tiny_gpt, attn_impl="ragged")
+    r = eng.submit(_prompts(1)[0], max_new_tokens=3)
+    eng.run_until_idle()
+    r.result(timeout=2)
+    rep_client = InProcessReplica("r0", eng)
+    probe = rep_client.probe()
+    assert probe["attn_impl"] == "ragged"
+    assert probe["max_context_len"] > 0
+    router = Router({"r0": rep_client},
+                    policy=RouterPolicy(seed=0), kv_block_size=8,
+                    registry=monitor.StatRegistry())
+    router.probe_once()
+    rep = router._reps()[0]
+    assert rep.signals["attn_impl"] == "ragged"
+    assert rep.signals["max_context_len"] == probe["max_context_len"]
+
+
+# -- long-context serving (the streaming kernel's reason to exist) ----
+
+@pytest.fixture(scope="module")
+def long_gpt():
+    """The tiny config with a raised context ceiling — long-context
+    engines need max_position above the tiny default of 64."""
+    paddle.seed(0)
+    m = GPTModel.from_config("tiny", dropout=0.0, max_position=256)
+    m.eval()
+    return m
+
+
+def _long_prompt(n, seed=3):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 128, (n,)).astype(np.int32)
+
+
+@pytest.mark.pallas
+@pytest.mark.longctx
+@pytest.mark.parametrize("cfg", [
+    dict(),
+    dict(prefill_chunk=8, async_depth=2),
+    dict(kv_dtype="int8", prefill_chunk=8),
+], ids=["plain", "chunked-d2", "kvint8-chunked"])
+def test_longctx_greedy_identity(long_gpt, cfg):
+    """Tier-1 long-context twin: a prompt spanning MANY KV blocks
+    (>= 8x block_size) decodes greedily token-identical across the
+    XLA oracle, the streaming kernel, and the gather A/B — and (fp
+    engines) equals per-request ``generate()``.  This is the
+    engine-level face of the kernel allclose test: reassociated float
+    sums at 13+ blocks still never flip a greedy pick on a real
+    checkpoint's logit margins."""
+    p = _long_prompt(100)                       # 13 blocks of 8
+    streams = {}
+    for impl in ("xla", "ragged", "ragged_gather"):
+        eng = _engine(long_gpt, num_slots=2, max_seq_len=128,
+                      attn_impl=impl, **cfg)
+        r = eng.submit(p, max_new_tokens=8)
+        eng.run_until_idle()
+        streams[impl] = r.result(timeout=5).tolist()
+        assert eng.debug_requests()["engine"]["max_context_len"] \
+            == len(p) + 8
+    assert streams["xla"] == streams["ragged"] \
+        == streams["ragged_gather"]
+    if cfg.get("kv_dtype") is None:
+        assert streams["ragged"] == _ref(long_gpt, p, 8).tolist()
+
+
+@pytest.mark.pallas
+@pytest.mark.longctx
+def test_longctx_preempt_resume(long_gpt):
+    """Preemption-resume of a LONG stream under the streaming kernel:
+    a high-priority arrival evicts a 100-token-context stream
+    mid-decode; the resumed continuation is token-identical to the
+    uninterrupted ``generate()``."""
+    eng = _engine(long_gpt, num_slots=1, max_seq_len=128,
+                  attn_impl="ragged", prefill_chunk=8, async_depth=2)
+    p_long = _long_prompt(100)
+    p_high = _long_prompt(9, seed=5)
+    low = eng.submit(p_long, max_new_tokens=10, priority=0)
+    for _ in range(400):
+        if len(low.generated) >= 2:
+            break
+        eng.step()
+    assert not low.done()
+    high = eng.submit(p_high, max_new_tokens=4, priority=5)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(high.result(timeout=5),
+                                  _ref(long_gpt, p_high, 4))
+    np.testing.assert_array_equal(low.result(timeout=5),
+                                  _ref(long_gpt, p_long, 10))
+    assert low.preemptions >= 1
+    if eng.prefix_cache is not None:
+        eng.prefix_cache.clear()
+    assert eng.block_pool.in_use() == 0
+
+
+@pytest.mark.pallas
+@pytest.mark.longctx
+@pytest.mark.migration
+def test_longctx_migration(long_gpt):
+    """KV block migration of a LONG stream between streaming-kernel
+    engines: export after a few emitted tokens moves the full
+    13-block context, the destination finishes the stream
+    token-identical to the unmigrated oracle."""
+    p = _long_prompt(100)
+    oracle = _engine(long_gpt, num_slots=2, max_seq_len=128,
+                     attn_impl="ragged")
+    r0 = oracle.submit(p, max_new_tokens=10)
+    oracle.run_until_idle()
+    ref = r0.result(timeout=5).tolist()
+
+    src = _engine(long_gpt, num_slots=2, max_seq_len=128,
+                  attn_impl="ragged")
+    dst = _engine(long_gpt, num_slots=2, max_seq_len=128,
+                  attn_impl="ragged")
+    r = src.submit(p, max_new_tokens=10)
+    for _ in range(400):
+        if len(r.generated) >= 3 or r.done():
+            break
+        src.step()
+    assert not r.done()
+    d = src.migrate_out(request_id=r.id, min_tokens=3,
+                        deliver="return", wait=False)
+    verdict = None
+    for _ in range(100):
+        src.step()
+        try:
+            verdict = d.wait(0)
+            break
+        except TimeoutError:
+            continue
+    assert verdict is not None and verdict["payload"] is not None
+    # a 100-token context + emitted tail crosses many blocks
+    assert verdict["payload"]["kv"]["n_blocks"] >= 12
+    got = None
+    dm = dst.migrate_in(verdict["payload"], wait=False)
+    for _ in range(100):
+        dst.step()
+        try:
+            got = dm.wait(0)
+            break
+        except TimeoutError:
+            continue
+    assert got is not None
+    dst.run_until_idle()
+    assert got["request"].result(timeout=5).tolist() == ref
+    src.run_until_idle()
+    if src.prefix_cache is not None:
+        src.prefix_cache.clear()
+    assert src.block_pool.in_use() == 0
+
+
+@pytest.mark.pallas
+@pytest.mark.longctx
+@pytest.mark.slow
+def test_longctx_multithousand_token_leg(tiny_gpt):
+    """The slow multi-thousand-token leg: a 2048-token prompt over a
+    2304-position model, chunked prefill, streaming kernel — greedy
+    decode matches per-request ``generate()`` and the walk gauge
+    reads the live horizon (~256+ blocks), not the table size."""
+    paddle.seed(0)
+    m = GPTModel.from_config("tiny", dropout=0.0, max_position=2304)
+    m.eval()
+    p = _long_prompt(2048, seed=11)
+    eng = Engine(m, num_slots=1, max_seq_len=2304, kv_block_size=16,
+                 registry=monitor.StatRegistry(), attn_impl="ragged",
+                 prefill_chunk=32, async_depth=2)
+    r = eng.submit(p, max_new_tokens=6)
+    eng.run_until_idle()
+    got = r.result(timeout=30).tolist()
+    assert got == _ref(m, p, 6).tolist()
+    walked = eng.registry.get(
+        "serving.kv_blocks_walked_per_tick").value
+    assert walked >= 2048 // 16
 
 
 def test_ragged_step_failure_recovers(tiny_gpt):

@@ -81,11 +81,14 @@ def wall_summary(events):
     loop's own attribution spans.  phase/wall > 1 means concurrency
     (work hidden behind device compute), not an accounting bug."""
     wall = phase = overlap = d2h_wait = ragged = 0.0
+    ragged_stream = 0.0
+    kv_blocks_walked = 0
     allgather = shard_sync = 0.0
     mig_export = mig_wire = mig_import = 0.0
     sup_restart = drain_mig = dequant = 0.0
     lora_swap = stream_emit = 0.0
-    n_ticks = n_ragged = n_allgather = n_migrations = 0
+    n_ticks = n_ragged = n_ragged_stream = n_allgather = 0
+    n_migrations = 0
     n_restarts = n_drain_migs = n_dequants = 0
     n_lora_swaps = n_stream_emits = 0
     for ev in events:
@@ -116,12 +119,24 @@ def wall_summary(events):
             elif name == "migrate.import":
                 mig_import += dur
             elif name == "decode.ragged":
-                # Pallas ragged-paged-attention dispatches
-                # (Engine(attn_impl="ragged")) — broken out so a
-                # trace shows at a glance whether the kernel or the
-                # per-shape XLA programs (decode.dispatch) served it
+                # Pallas ragged-paged-attention dispatches, GATHER
+                # body (Engine(attn_impl="ragged_gather")) — broken
+                # out so a trace shows at a glance whether the kernel
+                # or the per-shape XLA programs (decode.dispatch)
+                # served it
                 ragged += dur
                 n_ragged += 1
+            elif name == "decode.ragged_stream":
+                # streaming online-softmax ragged dispatches
+                # (Engine(attn_impl="ragged"), the default ragged
+                # body) — separate from decode.ragged so an A/B trace
+                # prices the two kernel bodies side by side; the
+                # span's kv_blocks_walked arg sums each lane's causal
+                # horizon, so block-walk cost is attributable per tick
+                ragged_stream += dur
+                n_ragged_stream += 1
+                kv_blocks_walked += int(
+                    ev.get("args", {}).get("kv_blocks_walked", 0))
             elif name == "decode.allgather":
                 # mesh-sharded engines (Engine(mesh=...)): waiting on
                 # the cross-shard psum/all-gather collectives before
@@ -174,6 +189,9 @@ def wall_summary(events):
                               else float("nan")),
         "overlap_ms": overlap, "d2h_wait_ms": d2h_wait,
         "ragged_ms": ragged, "ragged_dispatches": n_ragged,
+        "ragged_stream_ms": ragged_stream,
+        "ragged_stream_dispatches": n_ragged_stream,
+        "kv_blocks_walked": kv_blocks_walked,
         "allgather_ms": allgather, "allgather_waits": n_allgather,
         "shard_sync_ms": shard_sync,
         "migrations": n_migrations,
@@ -202,11 +220,20 @@ def format_wall(w):
         f"host.overlap {w['overlap_ms']:.3f} ms   "
         f"decode.d2h_wait {w['d2h_wait_ms']:.3f} ms",
     ]
+    if w.get("ragged_stream_dispatches"):
+        per = (w["kv_blocks_walked"] / w["ragged_stream_dispatches"]
+               if w["ragged_stream_dispatches"] else 0.0)
+        lines.append(
+            f"decode.ragged_stream {w['ragged_stream_ms']:.3f} ms "
+            f"over {w['ragged_stream_dispatches']} streaming "
+            "online-softmax dispatches (attn_impl='ragged')   "
+            f"kv blocks walked {w['kv_blocks_walked']} "
+            f"({per:.1f}/tick)")
     if w.get("ragged_dispatches"):
         lines.append(
             f"decode.ragged {w['ragged_ms']:.3f} ms over "
             f"{w['ragged_dispatches']} Pallas ragged-kernel "
-            "dispatches (attn_impl='ragged')")
+            "dispatches (gather body, attn_impl='ragged_gather')")
     if w.get("allgather_waits") or w.get("shard_sync_ms"):
         lines.append(
             f"decode.allgather {w['allgather_ms']:.3f} ms over "
