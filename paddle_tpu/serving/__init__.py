@@ -73,6 +73,16 @@ attaches to a request with exactly-once replay-then-subscribe
 semantics, httpd/routerd answer ``{"stream": true}`` as SSE, and the
 router's ``generate(on_token=...)`` splices failover/migration
 continuations into one seamless stream.
+``Engine(kv_host_mb=...)`` adds the HIERARCHICAL KV OFFLOAD tier
+(``serving.offload``): blocks the prefix trie evicts under pool
+pressure demote into a content-addressed host-RAM ``HostBlockStore``
+(async device gathers materialized at tick boundaries, LRU within a
+byte budget) instead of vanishing, and admission consults the store
+after the device trie — a host hit restores the payload into fresh
+device blocks and skips prefill for the span exactly like a device
+prefix hit, token-identical to a never-evicted run; int8 KV payloads
+carry codes+scales, and the router's prefix warming ships a peer's
+host tier before recomputing.
 """
 from .request import (  # noqa: F401
     Request, RequestQueue, RequestTimeout, QueueFull, Rejected,
@@ -91,6 +101,7 @@ from .lora import (  # noqa: F401
     UnknownAdapter)
 from .stream import (  # noqa: F401
     StreamClosed, StreamEvent, TokenStream, parse_sse, sse_format)
+from .offload import HostBlockStore, prefix_key  # noqa: F401
 from .engine import Engine  # noqa: F401
 from .httpd import EngineServer, serve  # noqa: F401
 from .router import (  # noqa: F401
@@ -110,6 +121,7 @@ __all__ = [
     "Scheduler", "Slot", "Engine", "EngineServer", "serve",
     "BlockPool", "PrefixCache", "NoFreeBlocks",
     "KVDtypeMismatch", "QuantKV", "relayout_weights_int8",
+    "HostBlockStore", "prefix_key",
     "Proposer", "PromptLookupProposer", "DraftModelProposer",
     "FaultInjector", "InjectedFault", "TickWatchdog",
     "WatchdogTimeout",

@@ -475,7 +475,8 @@ class Engine:
                  flight_dir=None, tenants=None, preemption=True,
                  shed_deadlines=True, faults=None, watchdog_s=None,
                  weight_dtype=None, kv_dtype=None, adapters=None,
-                 max_adapters=None, max_lora_rank=None):
+                 max_adapters=None, max_lora_rank=None,
+                 kv_host_mb=None):
         if getattr(model, "scan_layers", False):
             model = model._sync_decode_twin()
         model.eval()
@@ -848,6 +849,28 @@ class Engine:
                 "kv_budget_mb requires the paged KV layout "
                 "(kv_block_size=...): the contiguous pools are sized "
                 "by num_slots * max_seq_len, not by a block budget")
+        # -- host-RAM offload tier (serving/offload.py) -----------------
+        # A second, much larger home for KV blocks the device pool
+        # evicts: demotes ride the prefix trie's evict hook (async
+        # gather, materialized at tick boundaries), promotes ride the
+        # admission gate's prefix match (host hit -> import into fresh
+        # blocks, seed the trie, skip prefill for the restored span).
+        self.host_store = None
+        if kv_host_mb is not None:
+            if not self._paged:
+                raise ValueError(
+                    "kv_host_mb requires the paged KV layout "
+                    "(kv_block_size=...): the host tier parks whole "
+                    "blocks — the contiguous pools have none")
+            if not self._prefix_enabled:
+                raise ValueError(
+                    "kv_host_mb requires prefix_cache=True: demotes "
+                    "are fed by the trie's eviction and promotes by "
+                    "admission's prefix match")
+            from .offload import HostBlockStore
+            self.host_store = HostBlockStore(
+                kv_host_mb, self._bs, self._nh, self._hd,
+                len(list(model.blocks)), self._kv_dtype_str)
         # -- ragged paged attention (attn_impl="ragged") ----------------
         if attn_impl is None:
             attn_impl = getattr(model, "attn_impl", "xla")
@@ -1130,6 +1153,22 @@ class Engine:
             "toward another replica (stream migration + prefix "
             "warming; counted on the EXPORT side only, so a shared "
             "registry never double-counts a transfer)")
+        self._m_kv_host_blocks = reg.gauge(
+            "serving.kv_host_blocks", "KV blocks resident in the "
+            "host-RAM offload tier (kv_host_mb=...)")
+        self._m_kv_host_bytes = reg.gauge(
+            "serving.kv_host_bytes", "bytes the host-RAM offload tier "
+            "holds (codes + scales for int8 pools)")
+        self._m_offload_demotes = reg.counter(
+            "serving.offload_demotes", "KV blocks demoted device -> "
+            "host at eviction (materialized at tick boundaries)")
+        self._m_offload_promotes = reg.counter(
+            "serving.offload_promotes", "KV blocks promoted host -> "
+            "device at admission (restored instead of recomputed)")
+        self._m_offload_hit_tokens = reg.counter(
+            "serving.offload_hit_tokens", "prompt tokens whose "
+            "prefill was skipped via a host-tier restore (the "
+            "host-side share of prefix_hit_tokens)")
         # weakref'd listener: a collected engine returns False from the
         # callback and the model drops it — engines must not leak into
         # the model's listener list across their lifetimes
@@ -1241,8 +1280,15 @@ class Engine:
                 # turns this alloc into NoFreeBlocks (no-op when no
                 # injector is attached)
                 fault_hook=lambda n: self._fault("pool_exhaust"))
-            self.prefix_cache = PrefixCache(self.block_pool) \
+            self.prefix_cache = PrefixCache(
+                self.block_pool,
+                evict_hook=(self._offload_demote_hook
+                            if self.host_store is not None else None)) \
                 if self._prefix_enabled else None
+            # pending demote gathers die with the pools they read
+            # (step-failure recovery re-allocates) — drop, don't flush
+            self._offload_pending = []
+            self._offload_pending_keys = set()
             self._block_tables = np.zeros((self.num_slots, self._bps),
                                           np.int32)
             self._slot_blocks = [[] for _ in range(self.num_slots)]
@@ -2336,32 +2382,66 @@ class Engine:
             d.fail(e)
             return
         blocks, m = self.prefix_cache.match(tokens)
-        if not blocks:
+        # host-tier continuation: blocks this engine evicted to host
+        # RAM still beat the peer's recompute — walk the store for
+        # consecutive continuation entries past the device match
+        host_parts = []
+        if self.host_store is not None:
+            from .offload import prefix_key
+            i = m // self._bs
+            limit = (len(tokens) - 1) // self._bs
+            while i < limit:
+                ent = self.host_store.get(
+                    prefix_key(tokens, (i + 1) * self._bs))
+                if ent is None:
+                    break
+                host_parts.append(ent)
+                i += 1
+        if not blocks and not host_parts:
             d.complete(None)
             return
-        try:
-            with tr.span("migrate.export", cat="serving",
-                         blocks=len(blocks), prefix=True):
-                data = export_blocks(self.k_pools, self.v_pools,
-                                     blocks)
-        finally:
-            self.block_pool.decref(blocks)  # drop match's adopter refs
+        data = scales = None
+        if blocks:
+            try:
+                with tr.span("migrate.export", cat="serving",
+                             blocks=len(blocks), prefix=True):
+                    data = export_blocks(self.k_pools, self.v_pools,
+                                         blocks)
+            finally:
+                self.block_pool.decref(blocks)  # drop match's refs
+            if self._kv_quant:
+                data, scales = data
+        if host_parts:
+            hd = np.stack([p[0] for p in host_parts], axis=2)
+            hs = (np.stack([p[1] for p in host_parts], axis=2)
+                  if host_parts[0][1] is not None else None)
+            data = (hd if data is None
+                    else np.concatenate((data, hd), axis=2))
+            if hs is not None:
+                scales = (hs if scales is None
+                          else np.concatenate((scales, hs), axis=2))
+        n_blocks = len(blocks) + len(host_parts)
+        m_total = m + len(host_parts) * self._bs
+        tier = ("mixed" if blocks and host_parts
+                else "host" if host_parts else "device")
         kv = {"block_size": self._bs, "num_heads": self._nh,
               "head_dim": self._hd, "n_layers": len(self.k_pools),
-              "dtype": self._kv_dtype_str, "n_blocks": len(blocks)}
+              "dtype": self._kv_dtype_str, "n_blocks": n_blocks}
         if self._kv_quant:
-            kv["data"], kv["scales"] = data
+            kv["data"], kv["scales"] = data, scales
         else:
             kv["data"] = data
         payload = {
             "version": 1, "request": None,
-            "prefix": [int(t) for t in tokens[:m]],
+            "prefix": [int(t) for t in tokens[:m_total]],
+            "tier": tier,
             "kv": kv}
-        self._m_kv_migrated.inc(len(blocks))
+        self._m_kv_migrated.inc(n_blocks)
         with self._mig_lock:
             self._migration_log.append({
                 "tick": self.tick_no, "dir": "prefix_out",
-                "blocks": len(blocks), "tokens": m})
+                "blocks": n_blocks, "tokens": m_total,
+                "tier": tier})
         d.complete(payload)
 
     def _service_prefix_in(self, d, tr):
@@ -2381,6 +2461,154 @@ class Engine:
                        blocks=len(blocks))
         d.complete({"blocks": len(blocks),
                     "tokens": len(blocks) * self._bs if blocks else 0})
+
+    # -- host-RAM offload tier (serving/offload.py) ---------------------
+    def _offload_demote_hook(self, tokens, block):
+        """PrefixCache evict hook: enqueue an async device gather of
+        the dying block's rows BEFORE the pool reference drops.  The
+        gather is dispatched HERE — jax arrays are immutable and
+        device execution is in-order, so the snapshot stays consistent
+        even though later dispatches donate the pools — but
+        materialized (d2h) at the next tick boundary
+        (``_service_offload``), double-buffered behind the next
+        dispatch so the engine thread never blocks mid-tick.  A
+        scheduled ``offload_demote`` fault, a duplicate content
+        address, or any gather failure degrades to the pre-offload
+        behavior: the block simply frees, the store sees nothing
+        (the trie swallows hook exceptions for the same reason)."""
+        if self.host_store is None:
+            return
+        try:
+            self._fault("offload_demote")
+        except Exception:
+            return  # scheduled demote failure: free without spilling
+        from .offload import prefix_key
+        key = prefix_key(tokens)
+        if key in self.host_store or key in self._offload_pending_keys:
+            return  # content-addressed dedup: this prefix is parked
+        import jax.numpy as jnp
+        ids = jnp.asarray([int(block)], jnp.int32)
+        if self._kv_quant:
+            data = jnp.stack(
+                [jnp.stack((jnp.take(kp.codes, ids, axis=0),
+                            jnp.take(vp.codes, ids, axis=0)))
+                 for kp, vp in zip(self.k_pools, self.v_pools)])
+            scales = jnp.stack(
+                [jnp.stack((jnp.take(kp.scale, ids, axis=0),
+                            jnp.take(vp.scale, ids, axis=0)))
+                 for kp, vp in zip(self.k_pools, self.v_pools)])
+        else:
+            data = jnp.stack(
+                [jnp.stack((jnp.take(kp, ids, axis=0),
+                            jnp.take(vp, ids, axis=0)))
+                 for kp, vp in zip(self.k_pools, self.v_pools)])
+            scales = None
+        self._offload_pending_keys.add(key)
+        self._offload_pending.append((key, data, scales))
+
+    def _service_offload(self, tr):
+        """Tick-boundary transfer drain: materialize the demote
+        gathers the PREVIOUS tick's evictions enqueued and park them
+        in the host store.  Runs right after ``_service_migrations``
+        in both tick paths — by now the gathers have had a full
+        dispatch of device time to complete, so ``np.asarray`` is a
+        copy-out, not a stall (the double buffer)."""
+        if self.host_store is None or not self._offload_pending:
+            return
+        pending = self._offload_pending
+        self._offload_pending = []
+        self._offload_pending_keys = set()
+        store = self.host_store
+        for key, data, scales in pending:
+            with tr.span("offload.demote", cat="serving",
+                         key=key) as sp:
+                try:
+                    d = np.asarray(data)[:, :, 0]
+                    s = (np.asarray(scales)[:, :, 0]
+                         if scales is not None else None)
+                    ok = store.put(key, d, s)
+                except Exception:
+                    ok = False  # a dead gather (pools recovered
+                    #   mid-flight) must not fail the tick
+                if ok:
+                    self._m_offload_demotes.inc()
+                sp.args.update(stored=bool(ok))
+        self._m_kv_host_blocks.set(len(store))
+        self._m_kv_host_bytes.set(store.bytes_used)
+
+    def _flush_offload(self):
+        """Drain pending demotes at loop-idle boundaries
+        (``run_until_idle`` exit, the ``start()`` loop's idle branch,
+        ``_drain``) — an eviction in the last tick before idle must
+        not strand its gather until the next burst of traffic."""
+        try:
+            self._service_offload(self.tracer)
+        except Exception:
+            self._offload_pending = []
+            self._offload_pending_keys = set()
+
+    def _promote_blocks(self, req, tokens, ctx, m, fresh):
+        """Host-tier leg of paged admission: after the device trie
+        matched ``m`` tokens, probe the host store for consecutive
+        continuation blocks and restore them into the leading
+        ``fresh`` reservations — import the payload, seed the device
+        trie, and let ``_bind_kv_plan`` count the span exactly like a
+        device prefix hit.  Returns the number of promoted blocks; 0
+        on miss, scheduled ``offload_promote`` fault, or import
+        failure — the fresh blocks then stay plain prefill targets
+        (recompute), never half-restored."""
+        store = self.host_store
+        if store is None or not fresh:
+            return 0
+        from .offload import prefix_key
+        bs = self._bs
+        first = m // bs
+        limit = (len(tokens) - 1) // bs  # leave >=1 token to prefill
+        keys = []
+        for i in range(first, min(limit, first + len(fresh))):
+            key = prefix_key(tokens, (i + 1) * bs)
+            if key not in store:  # presence probe: no LRU touch
+                break
+            keys.append(key)
+        if not keys:
+            return 0
+        try:
+            self._fault("offload_promote")
+        except Exception:
+            return 0  # scheduled promote failure: fall back to
+            #   recompute — the store entry stays, untouched
+        datas, scls = [], []
+        for key in keys:
+            ent = store.get(key)
+            if ent is None:
+                break  # demote-side LRU raced the probe
+            datas.append(ent[0])
+            scls.append(ent[1])
+        n = len(datas)
+        if not n:
+            return 0
+        blocks = fresh[:n]
+        with self.tracer.span("offload.promote", cat="serving",
+                              req=req.id, blocks=n) as sp:
+            data = np.stack(datas, axis=2)
+            scales = (np.stack(scls, axis=2)
+                      if scls[0] is not None else None)
+            try:
+                self.k_pools, self.v_pools = import_blocks(
+                    self.k_pools, self.v_pools, blocks, data, scales)
+            except Exception:
+                return 0  # pools untouched (import is all-or-nothing)
+            self.prefix_cache.insert(tokens[:(first + n) * bs],
+                                     ctx + blocks)
+            sp.args.update(tokens=n * bs)
+        self._m_offload_promotes.inc(n)
+        self._m_offload_hit_tokens.inc(n * bs)
+        req._host_restored = getattr(req, "_host_restored", 0) + n * bs
+        self.tracer.instant("req.host_restored", cat="request",
+                            req=req.id, blocks=n, tokens=n * bs)
+        self._m_kv_host_blocks.set(len(store))
+        self._m_kv_host_bytes.set(store.bytes_used)
+        return n
 
     # -- tracing / flight recorder / debug surface ---------------------
     def _register_compile_listener(self):
@@ -2472,6 +2700,9 @@ class Engine:
                 view["preemptions"] = req.preemptions
                 view["adapter"] = req.adapter
                 view["streams"] = len(req._sinks)
+                view["restored_from_host"] = getattr(
+                    req, "_host_restored", 0)  # tokens whose prefill
+                #   a host-tier promote skipped (0 = never restored)
                 streams_active += len(req._sinks)
             if self._paged:
                 view["kv_blocks"] = len(self._slot_blocks[view["slot"]])
@@ -2495,6 +2726,8 @@ class Engine:
             "preemptions": self._preempt_history()[-16:],
             "migrations": self._migration_history()[-16:],
             "migrations_pending": self._migrate_pending(),
+            "offload": (None if self.host_store is None
+                        else self.host_store.stats()),
             "engine": {
                 "num_slots": self.num_slots,
                 "max_seq_len": self.max_seq_len,
@@ -2619,6 +2852,15 @@ class Engine:
             #   is being held back by blocks, not by slots
             return False
         fresh = self.block_pool.alloc(need)
+        if self.host_store is not None and not req._adapter_id:
+            # second tier: the device trie answered first, the host
+            # store restores the consecutive continuation (if any)
+            # into the leading fresh blocks
+            n_promo = self._promote_blocks(req, tokens, ctx, m, fresh)
+            if n_promo:
+                ctx = ctx + fresh[:n_promo]
+                fresh = fresh[n_promo:]
+                m += n_promo * self._bs
         req._kv_plan = (ctx, fresh, m)
         return True
 
@@ -3987,6 +4229,9 @@ class Engine:
         # ring and frees its slot for this very tick's admission, an
         # import's request enters the queue before the admit phase
         emitted += self._service_migrations(tr)
+        # ...then the offload drain: last tick's demote gathers have
+        # had a dispatch of device time — copy out behind it
+        self._service_offload(tr)
         # -- planning / admission: host work in the gap --------------
         in_flight = bool(self._ring)
         t_plan = time.monotonic()
@@ -4109,6 +4354,7 @@ class Engine:
         emitted = 0
         # cross-replica migration orders first (see _tick_async)
         emitted += self._service_migrations(tr)
+        self._service_offload(tr)  # tick-boundary demote drain
         self._gate_declined = False
         # deadline sweep first: with a full pool nothing gets popped,
         # but queued requests must still time out on schedule
@@ -4188,6 +4434,7 @@ class Engine:
         total = 0
         for _ in range(max_steps):
             if self.scheduler.idle() and not self._migrate_actionable():
+                self._flush_offload()  # last tick's demotes land
                 return total
             total += self.step()
         raise RuntimeError(
@@ -4236,6 +4483,8 @@ class Engine:
                         # landing after it re-sets the event.  The
                         # timeout is only the tokens/sec decay + stop
                         # heartbeat, not an admission latency bound.
+                        self._flush_offload()  # going idle: land the
+                        #   final tick's demote gathers now
                         self._wake.clear()
                         if self.scheduler.idle() \
                                 and not self._migrate_actionable() \
@@ -4262,6 +4511,8 @@ class Engine:
 
     def _drain(self):
         """Fail every queued and in-flight request (shutdown path)."""
+        self._flush_offload()  # land pending demotes — the host tier
+        #   outlives this loop and warms the next start()
         # drop un-consumed dispatches: their requests fail below, and
         # the next start() re-uploads clean cursors (every eviction
         # parks its lanes and dirties the mirrors)

@@ -196,6 +196,34 @@ site                  checked at                        action
                                                         tokens stay
                                                         delivered
 ====================  ===============================  ==============
+
+Offload sites (the host-RAM KV tier, serving/offload.py — both
+checked on the OWNING engine's tick, so one seeded schedule covers a
+demote and the promote that would have consumed it):
+
+====================  ===============================  ==============
+site                  checked at                        action
+====================  ===============================  ==============
+``offload_demote``    engine, inside the prefix trie's  raises
+                      evict hook, BEFORE the gather is  InjectedFault
+                      enqueued                          — the block
+                                                        frees without
+                                                        spilling, the
+                                                        host store
+                                                        never sees a
+                                                        partial entry
+``offload_promote``   engine admission gate, after the  raises
+                      host probe matched but BEFORE     InjectedFault
+                      any entry is read or imported     — admission
+                                                        falls back to
+                                                        recompute; the
+                                                        fresh device
+                                                        blocks stay
+                                                        plain prefill
+                                                        targets, host
+                                                        entries stay
+                                                        resident
+====================  ===============================  ==============
 """
 from __future__ import annotations
 
@@ -266,8 +294,16 @@ PROC_SITES = ("proc_kill9", "proc_stop", "proc_crashloop")
 # ordinal as the tick — firing simulates the client vanishing
 # mid-response, which the server loop sees as a dead socket.
 FRONTEND_SITES = ("adapter_load", "stream_disconnect")
+# Host-RAM offload tier sites (serving/offload.py): ``offload_demote``
+# is checked by the prefix trie's evict hook — firing drops the spill,
+# the block frees WITHOUT entering the host store (pre-offload
+# behavior, store untouched); ``offload_promote`` is checked by the
+# admission gate's host-tier consult — firing falls back to recompute,
+# the fresh device blocks stay plain prefill targets and the host
+# entries stay resident.  Neither firing can corrupt either tier.
+OFFLOAD_SITES = ("offload_demote", "offload_promote")
 SITES = (ENGINE_SITES + NET_SITES + MIGRATE_SITES + PROC_SITES
-         + FRONTEND_SITES)
+         + FRONTEND_SITES + OFFLOAD_SITES)
 
 
 class FaultInjector:
@@ -446,6 +482,14 @@ class FaultInjector:
             raise StreamDisconnect(
                 f"injected streaming-client death at stream {tick}: "
                 "the SSE consumer vanished mid-response")
+        if site == "offload_demote":
+            raise InjectedFault(
+                f"injected demote failure at tick {tick}: the evicted "
+                "block frees without spilling to the host tier")
+        if site == "offload_promote":
+            raise InjectedFault(
+                f"injected promote failure at tick {tick}: admission "
+                "falls back to recompute, host entries untouched")
 
 
 

@@ -366,6 +366,20 @@ class _Handler(JsonHandler):
                 info["kv_blocks_cached"] = (
                     eng.prefix_cache.cached_blocks()
                     if eng.prefix_cache is not None else 0)
+            store = getattr(eng, "host_store", None)
+            if store is not None:
+                # host-RAM offload tier: warmth the router's
+                # prefix_warm can prefer over a peer's recompute
+                st = store.stats()
+                info["kv_host_blocks"] = st["blocks"]
+                info["kv_host_bytes"] = st["bytes"]
+                info["kv_host_capacity_mb"] = st["capacity_mb"]
+                info["offload_demotes_total"] = _cnt(
+                    "_m_offload_demotes")
+                info["offload_promotes_total"] = _cnt(
+                    "_m_offload_promotes")
+                info["offload_hit_tokens_total"] = _cnt(
+                    "_m_offload_hit_tokens")
             if getattr(eng, "_spec_k", None):
                 info["spec_k"] = eng._spec_k
                 info["spec_acceptance_rate"] = round(

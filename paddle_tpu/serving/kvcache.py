@@ -449,11 +449,22 @@ class PrefixCache:
     boundaries), which keeps adoption pure sharing: writes always land
     in the adopter's own fresh blocks (``BlockPool.cow`` degenerates
     to the no-copy case).
+
+    ``evict_hook`` (optional): called as ``hook(tokens, block)`` for
+    every node ``evict`` is about to drop, BEFORE the pool reference
+    — ``tokens`` is the node's full token prefix (root through the
+    dying block, reconstructed from the parent chain), so the hook can
+    demote the block's device rows to a content-addressed host tier
+    (serving/offload.py) while they are still resident.  Exceptions
+    are swallowed: a failed demote must free the block normally, never
+    wedge eviction mid-walk (``clear`` — the engine-reset path whose
+    device pools may already be gone — never calls it).
     """
 
-    def __init__(self, pool):
+    def __init__(self, pool, evict_hook=None):
         self.pool = pool
         self.block_size = pool.block_size
+        self.evict_hook = evict_hook
         self._children = {}   # root level: key tuple -> _TrieNode
         self._clock = 0       # LRU stamp (monotonic counter)
 
@@ -516,6 +527,20 @@ class PrefixCache:
             parent = node
             children = node.children
 
+    @staticmethod
+    def _prefix_of(node):
+        """The full token prefix ``node``'s block encodes — every
+        ancestor's key plus its own, root-first — i.e. the content a
+        demote hook must hash to address the block."""
+        keys = []
+        while node is not None:
+            keys.append(node.key)
+            node = node.parent
+        out = []
+        for key in reversed(keys):
+            out.extend(key)
+        return tuple(out)
+
     def evict(self, n):
         """Free at least ``n`` blocks by dropping least-recently-used
         UNREFERENCED cached prefixes, deepest first (a node with live
@@ -542,6 +567,11 @@ class PrefixCache:
             if owner.get(node.key) is not node:
                 continue              # already detached
             owner.pop(node.key)
+            if self.evict_hook is not None:
+                try:
+                    self.evict_hook(self._prefix_of(node), node.block)
+                except Exception:
+                    pass  # failed demote: free normally, never wedge
             freed.extend(self.pool.decref(node.block))
             parent = node.parent
             if parent is not None and not parent.children \

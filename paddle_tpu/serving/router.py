@@ -721,7 +721,13 @@ class Router:
                           # which ragged kernel body the replica
                           # serves (stream vs gather A/B) and the max
                           # context length it has actually reached
-                          "attn_impl", "max_context_len")})
+                          "attn_impl", "max_context_len",
+                          # host-RAM offload tier: how much warm KV a
+                          # replica holds PAST its device pool — the
+                          # warmth prefix_warm taps before recompute
+                          "kv_host_blocks", "kv_host_bytes",
+                          "kv_host_capacity_mb",
+                          "offload_hit_tokens_total")})
                     if self._kv_bs is None \
                             and info.get("kv_block_size"):
                         self._kv_bs = int(info["kv_block_size"])
@@ -1221,12 +1227,16 @@ class Router:
             if not payload or not payload.get("kv"):
                 return
             got = chosen.client.migrate_import(payload)
+            # "device" = trie blocks only; "host"/"mixed" = the
+            # source's host-RAM offload tier contributed blocks the
+            # destination would otherwise have recomputed
+            tier = payload.get("tier", "device")
             self.log.append(("warm", rid, target.name, chosen.name,
-                             got.get("blocks")))
+                             got.get("blocks"), tier))
             self.tracer.instant(
                 "route.prefix_warmed", cat="router", req=rid,
                 source=target.name, dest=chosen.name,
-                blocks=got.get("blocks"))
+                blocks=got.get("blocks"), tier=tier)
         except Exception:
             pass
 
@@ -1648,7 +1658,16 @@ class InProcessReplica:
                                else 0),
             "attn_impl": getattr(eng, "attn_impl", "xla"),
             "max_context_len": getattr(eng, "_max_context_len", 0),
-        }
+        } | (
+            # host-RAM offload tier signals, matching /healthz: only
+            # advertised when the tier exists (probers key off
+            # presence)
+            {"kv_host_blocks": len(eng.host_store),
+             "kv_host_bytes": eng.host_store.bytes_used,
+             "kv_host_capacity_mb": eng.host_store.capacity_mb,
+             "offload_hit_tokens_total": int(
+                 eng._m_offload_hit_tokens.value)}
+            if getattr(eng, "host_store", None) is not None else {})
 
     def generate(self, payload, should_abort=None, on_token=None):
         t = next(self._ops)

@@ -32,6 +32,7 @@ SCRIPTS = [
     "onnx_export.py",
     "serving_quantized.py",
     "serving_lora.py",
+    "serving_offload.py",
 ]
 
 

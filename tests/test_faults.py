@@ -663,3 +663,214 @@ def test_frontend_chaos_storm_deterministic(tiny_gpt):
     fired = {site for sig in (a, c) for log in sig[:2]
              for (_, site) in log}
     assert "adapter_load" in fired and "stream_disconnect" in fired
+
+
+# ---------------------------------------------------------------------------
+# offload chaos: kill the host tier's demote/promote mid-traffic
+# ---------------------------------------------------------------------------
+
+def _shared_prompts(base_len=24, suffix_lens=(4, 10, 18, 12),
+                    rng_seed=9):
+    """Prompts sharing a ``base_len``-token prefix (3 full blocks at
+    block_size 8) with distinct VARIED-length suffixes — the shape that
+    makes the host tier earn its keep: evicting the shared span demotes
+    ONE set of content-addressed entries every later prompt can
+    promote, while the longer suffixes add private full blocks so the
+    store holds entries of several depths."""
+    rng = np.random.RandomState(rng_seed)
+    base = rng.randint(0, 128, (base_len,)).astype(np.int32).tolist()
+    return [np.array(base + rng.randint(0, 128, (sl,)).tolist(),
+                     dtype=np.int32) for sl in suffix_lens]
+
+
+def _offload_storm(model, seed, ticks, refs, prompts):
+    """One seeded storm over a host-tier engine under a TIGHT device
+    pool: scripted spills (prefix-cache evict + flush) and shared-prefix
+    resubmissions force demote->promote cycles while the injected
+    ``offload_demote`` site makes evicted blocks free WITHOUT spilling
+    and ``offload_promote`` makes admissions fall back to recompute —
+    mixed with a scripted priority burst (preemption) and a mid-storm
+    ``migrate_out`` handoff to a second replica.  Asserts the invariant
+    set (every waiter unblocked, greedy survivors token-identical, BOTH
+    tiers at zero after clear, host byte accounting exact) and returns
+    the reproducibility signature."""
+    inj = FaultInjector(
+        seed=seed,
+        rates={"offload_demote": 0.35, "offload_promote": 0.5,
+               "dispatch": 0.03},
+        # first ticks fault-free so the scripted burst preempts
+        # deterministically; nothing fires past the window so the
+        # post-storm round-trip below sees a live tier
+        first_tick=4, last_tick=ticks)
+    eng = Engine(model, num_slots=2, max_seq_len=64, kv_block_size=8,
+                 kv_blocks=16, prefill_chunk=8, tick_token_budget=16,
+                 kv_host_mb=64, registry=monitor.StatRegistry())
+    dst = Engine(model, num_slots=2, max_seq_len=64, kv_block_size=8,
+                 registry=monitor.StatRegistry())
+    st = eng.host_store
+    for i in range(2):                 # warm compiles, faults unarmed
+        eng.submit(prompts[i], max_new_tokens=2)
+    eng.run_until_idle()
+    inj.first_tick += eng.tick_no
+    inj.last_tick += eng.tick_no
+    eng.faults = inj
+    # (prompt_idx, max_new, priority, sample_seed); the t=2 burst lands
+    # while both slots hold pri-0 streams -> preemption; resubmission
+    # waves after each spill drive the promote path under fire
+    sched = {
+        0: [(0, 10, 0, None), (1, 8, 0, None)],
+        2: [(2, 6, 5, None)],
+        6: [(3, 12, 0, None)],
+        10: [(0, 6, 0, None), (1, 6, 0, 42)],
+        14: [(2, 8, 0, None)],
+        18: [(0, 8, 0, None)],
+        22: [(1, 10, 0, None)],
+        26: [(3, 6, 0, None)],
+        30: [(0, 10, 0, None)],
+    }
+    # scripted eviction pressure: each spill is one demote consult
+    # tick, so spreading them over many ticks gives the injected
+    # offload_demote schedule real surface to hit
+    spill_at = (4, 8, 12, 16, 20, 24, 28, 32)
+    reqs, errors = [], []
+    r_mig, mig_demand = None, None
+    for t in range(ticks):
+        for (pi, mn, pri, sd) in sched.get(t, []):
+            kw = ({} if sd is None else
+                  {"temperature": 0.9, "top_p": 0.9, "seed": sd})
+            r = eng.submit(prompts[pi], max_new_tokens=mn,
+                           priority=pri, **kw)
+            if t == 6:                 # the migration candidate
+                r_mig = r
+            else:
+                reqs.append((pi, mn, sd, r))
+        if t in spill_at:
+            eng.prefix_cache.evict(10 ** 6)
+            eng._flush_offload()
+        if (t >= 12 and mig_demand is None and r_mig is not None
+                and not r_mig.done() and len(r_mig.generated) >= 3):
+            mig_demand = eng.migrate_out(request_id=r_mig.id,
+                                         min_tokens=3,
+                                         deliver="return", wait=False)
+        try:
+            eng.step()
+        except Exception as e:        # step already recovered
+            errors.append(type(e).__name__)
+    for _ in range(800):              # post-storm drain, faults silent
+        if eng.scheduler.idle():
+            break
+        try:
+            eng.step()
+        except Exception as e:
+            errors.append(type(e).__name__)
+    # -- migration handoff resolves to exactly one full stream --------
+    if mig_demand is None:
+        mig_outcome = ("skipped",)
+        if r_mig is not None:
+            reqs.append((3, 12, None, r_mig))
+    else:
+        verdict, err = _await_demand(eng, mig_demand)
+        assert err is None, err       # no migrate sites in the rates
+        if verdict["completed"]:
+            assert r_mig.error is None
+            assert r_mig.result(timeout=0).tolist() == refs[(3, 12)]
+            mig_outcome = ("completed",)
+        else:
+            assert isinstance(r_mig.error, Migrated)
+            got, ierr = _await_demand(
+                dst, dst.migrate_in(verdict["payload"], wait=False))
+            assert ierr is None and got is not None
+            r2 = got["request"]
+            for _ in range(400):
+                if r2.done():
+                    break
+                dst.step()
+            assert r2.error is None, r2.error
+            assert r2.result(timeout=0).tolist() == refs[(3, 12)]
+            mig_outcome = ("migrated", int(got["blocks"]))
+    # -- invariants, asserted after EVERY storm -----------------------
+    assert eng.scheduler.idle(), "engine failed to drain after storm"
+    assert not eng._ring, "async ring holds futures at idle"
+    outcomes = []
+    for (pi, mn, sd, r) in reqs:
+        assert r.done(), f"waiter never unblocked: {r}"
+        if r.error is not None:
+            outcomes.append((pi, mn, "err", type(r.error).__name__))
+        else:
+            out = r.result(timeout=0).tolist()
+            if sd is None:            # greedy survivor: exact parity —
+                # a corrupted demote/promote payload shows up here
+                assert out == refs[(pi, mn)], \
+                    f"stream corruption: prompt {pi} max_new {mn}"
+            outcomes.append((pi, mn, "ok", len(out)))
+    assert eng.registry.get("serving.preemptions_total").value >= 1, \
+        "storm never preempted (the scripted burst must)"
+    assert len(inj.log) >= 3, "storm fired too few faults to mean much"
+    # -- past the window every stage is live again: one clean
+    #    demote->promote round-trip proves neither tier was corrupted
+    pre_p = int(eng._m_offload_promotes.value)
+    r0 = eng.submit(prompts[0], max_new_tokens=6)
+    eng.run_until_idle()
+    assert r0.result(timeout=0).tolist() == refs[(0, 6)]
+    eng.prefix_cache.evict(10 ** 6)
+    eng._flush_offload()
+    assert len(st) >= 3, "clean spill parked nothing"
+    r1 = eng.submit(prompts[0], max_new_tokens=6)
+    eng.run_until_idle()
+    assert r1.result(timeout=0).tolist() == refs[(0, 6)]
+    assert int(eng._m_offload_promotes.value) >= pre_p + 3, \
+        "clean promote did not restore the spilled prefix"
+    # -- host byte accounting EXACT: every resident entry is one fp32
+    #    block; nothing else may count
+    entry_bytes = int(np.prod(st._want)) * 4
+    assert st.bytes_used == len(st) * entry_bytes, \
+        (st.bytes_used, len(st), entry_bytes)
+    assert st.stats()["bytes"] == st.bytes_used
+    host_sig = (len(st), st.bytes_used,
+                int(eng._m_offload_demotes.value),
+                int(eng._m_offload_promotes.value),
+                int(eng._m_offload_hit_tokens.value),
+                st.hits, st.misses, st.dedup_puts)
+    # -- refcounts -> 0 in BOTH tiers
+    for e in (eng, dst):
+        for _ in range(400):
+            if e.scheduler.idle():
+                break
+            e.step()
+        e.prefix_cache.clear()
+        assert e.block_pool.in_use() == 0, "offload storm leaked blocks"
+    st.clear()
+    assert len(st) == 0 and st.bytes_used == 0, \
+        "host tier accounting survived clear()"
+    return (tuple(inj.log), tuple(outcomes), tuple(errors),
+            mig_outcome, host_sig)
+
+
+@pytest.mark.chaos
+@pytest.mark.offload
+def test_offload_chaos_storm_deterministic(tiny_gpt):
+    """Seeded host-tier storm: under injected demote kills (block frees
+    without spilling) and promote kills (admission recomputes), mixed
+    with preemption and a mid-storm migration handoff, every greedy
+    survivor stays token-identical, host byte accounting stays exact,
+    both tiers drain to zero — and the same seed replays the same
+    fault/outcome/error history while a different seed diverges."""
+    prompts = _shared_prompts()
+    refs = {}
+    for (pi, mn) in [(0, 10), (1, 8), (2, 6), (3, 12), (0, 6),
+                     (2, 8), (0, 8), (1, 10), (3, 6)]:
+        refs[(pi, mn)] = tiny_gpt.generate(
+            paddle.to_tensor(prompts[pi][None, :]),
+            max_new_tokens=mn).numpy()[0].tolist()
+    a = _offload_storm(tiny_gpt, seed=41, ticks=40, refs=refs,
+                       prompts=prompts)
+    b = _offload_storm(tiny_gpt, seed=41, ticks=40, refs=refs,
+                       prompts=prompts)
+    c = _offload_storm(tiny_gpt, seed=43, ticks=40, refs=refs,
+                       prompts=prompts)
+    assert a == b, "same seed, different storm history"
+    assert a != c, "different seed, same storm history"
+    # across the two seeds BOTH offload sites must actually fire, or
+    # the storm proves nothing about the tier under failure
+    fired = {site for sig in (a, c) for (_, site) in sig[0]}
+    assert {"offload_demote", "offload_promote"} <= fired, fired

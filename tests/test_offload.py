@@ -1,0 +1,521 @@
+"""Hierarchical KV offload (serving/offload.py): the host-RAM tier.
+
+Store unit tier: LRU-within-budget, oversize refusal, content-address
+dedup, dtype/geometry refusal — byte accounting stays exact through
+all of it.  Engine tier: demote-on-evict + promote-on-admission
+restore parity against a NEVER-EVICTED oracle (greedy AND seeded,
+across paged x chunked x spec x depth-2, fp and int8 KV — int8
+payloads carry codes+scales so the restore is bit-exact),
+preempt-then-restore, evict-then-readmit hit accounting, natural
+pool-pressure demotes through the tick-boundary drain, fault-site
+degradation (failed demote frees without spilling, failed promote
+recomputes), and the /healthz + router signal surfaces (prefix_warm
+serving a peer's host tier).  All CPU, tiny model, tier-1.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.models import GPTModel
+from paddle_tpu.serving import (Engine, FaultInjector, HostBlockStore,
+                                KVDtypeMismatch, prefix_key)
+
+pytestmark = pytest.mark.offload
+
+PROMPT = list(range(11, 39))       # 28 tokens = 3 full blocks at bs=8
+MAX_NEW = 8
+SEEDED = dict(temperature=0.8, top_k=8, seed=1234)
+
+CONFIGS = {
+    "paged": dict(),
+    "chunked": dict(prefill_chunk=8, tick_token_budget=16),
+    "spec": dict(spec_k=2),
+    "depth2": dict(async_depth=2),
+}
+
+
+def _model():
+    paddle.seed(0)
+    m = GPTModel.from_config("tiny", dropout=0.0)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    return _model()
+
+
+def _engine(model, **kw):
+    cfg = dict(num_slots=4, max_seq_len=64, kv_block_size=8,
+               registry=monitor.StatRegistry())
+    cfg.update(kw)
+    return Engine(model, **cfg)
+
+
+def _serve_one(eng, prompt=PROMPT, n=MAX_NEW, **kw):
+    r = eng.submit(prompt, max_new_tokens=n, **kw)
+    eng.run_until_idle()
+    return [int(t) for t in r.result(timeout=5)]
+
+
+def _spill_all(eng):
+    """Force every unreferenced trie block through the demote path
+    and materialize the gathers (what pool pressure does naturally,
+    made deterministic for the restore tests)."""
+    freed = eng.prefix_cache.evict(10 ** 6)
+    eng._flush_offload()
+    return freed
+
+
+def _sample_kw(seed):
+    return {} if seed is None else dict(SEEDED, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# HostBlockStore unit tier
+# ---------------------------------------------------------------------------
+
+GEOM = dict(block_size=4, num_heads=2, head_dim=4, n_layers=2)
+ENTRY = (GEOM["n_layers"], 2, GEOM["block_size"], GEOM["num_heads"],
+         GEOM["head_dim"])
+ENTRY_BYTES = int(np.prod(ENTRY)) * 4          # float32
+
+
+def _entry(seed=0, dtype=np.float32):
+    return np.random.RandomState(seed).randn(*ENTRY).astype(dtype)
+
+
+def _store(n_entries, **kw):
+    cfg = dict(GEOM, capacity_mb=n_entries * ENTRY_BYTES / 2 ** 20)
+    cfg.update(kw)
+    return HostBlockStore(**cfg)
+
+
+def test_prefix_key_is_a_full_prefix_hash():
+    """Two blocks are interchangeable iff their FULL prefixes match:
+    the key must change when any earlier token changes, even when the
+    block's own token span is identical."""
+    a = prefix_key([1, 2, 3, 4, 5, 6, 7, 8])
+    assert a == prefix_key(np.asarray([1, 2, 3, 4, 5, 6, 7, 8]))
+    assert a == prefix_key([1, 2, 3, 4, 5, 6, 7, 8, 99], n_tokens=8)
+    # same last-block tokens (5..8), different earlier history
+    assert a != prefix_key([9, 2, 3, 4, 5, 6, 7, 8])
+    assert a != prefix_key([1, 2, 3, 4])
+
+
+def test_store_lru_within_budget_and_byte_accounting():
+    st = _store(3)
+    for i in range(3):
+        assert st.put(f"k{i}", _entry(i)) is True
+    assert len(st) == 3 and st.bytes_used == 3 * ENTRY_BYTES
+    # touch k0 (a hit refreshes recency), then overflow: k1 — now the
+    # oldest — is the one evicted
+    assert st.get("k0") is not None
+    assert st.put("k3", _entry(3)) is True
+    assert len(st) == 3 and st.bytes_used == 3 * ENTRY_BYTES
+    assert "k1" not in st and st.evictions == 1
+    assert sorted(st.keys()) == ["k0", "k2", "k3"]
+    # presence probes must NOT age entries: probing k2 repeatedly and
+    # overflowing again still evicts by true recency (k2 is oldest —
+    # k0's ``get`` refreshed it, the probes refreshed nothing)
+    for _ in range(5):
+        assert "k2" in st
+    assert st.put("k4", _entry(4)) is True
+    assert "k2" not in st and "k0" in st
+
+
+def test_store_oversize_refusal_and_clear():
+    st = _store(1)
+    st.capacity_bytes = ENTRY_BYTES - 1   # nothing fits
+    assert st.put("big", _entry()) is False
+    assert st.refusals == 1 and len(st) == 0 and st.bytes_used == 0
+    st.capacity_bytes = ENTRY_BYTES
+    assert st.put("ok", _entry()) is True
+    assert st.clear() == 1
+    assert len(st) == 0 and st.bytes_used == 0
+
+
+def test_store_content_address_dedup():
+    """A duplicate key (same full-prefix hash = same content) refreshes
+    recency without re-copying — dedup_puts counts it, bytes do not
+    move, and the entry stays the ORIGINAL payload."""
+    st = _store(4)
+    e = _entry(0)
+    assert st.put("k", e) is True
+    assert st.put("k", _entry(1)) is True     # same address, new bytes
+    assert st.dedup_puts == 1 and st.refusals == 0
+    assert len(st) == 1 and st.bytes_used == ENTRY_BYTES
+    got, scales = st.get("k")
+    np.testing.assert_array_equal(got, e)     # original content wins
+    assert scales is None
+
+
+def test_store_dtype_and_geometry_refusal():
+    """The store is checked like the migration wire: fp store refuses
+    scales, int8 store refuses bare fp rows (KVDtypeMismatch FIRST),
+    wrong shapes refuse with ValueError — and a refused put leaves the
+    byte accounting untouched."""
+    st = _store(4)
+    sc = np.ones((GEOM["n_layers"], 2, GEOM["num_heads"]), np.float32)
+    with pytest.raises(KVDtypeMismatch):
+        st.put("k", _entry(), scales=sc)
+    qst = _store(4, dtype="int8")
+    with pytest.raises(KVDtypeMismatch):
+        qst.put("k", _entry(dtype=np.int8))
+    with pytest.raises(ValueError):
+        st.put("k", _entry()[:, :1])          # K-only payload
+    with pytest.raises(ValueError):
+        qst.put("k", _entry(dtype=np.int8), scales=sc[:, :, :1])
+    for s in (st, qst):
+        assert len(s) == 0 and s.bytes_used == 0
+    # int8 accounting counts codes + scales
+    assert qst.put("k", _entry(dtype=np.int8), scales=sc) is True
+    assert qst.bytes_used == ENTRY_BYTES // 4 + sc.nbytes
+
+
+def test_store_get_miss_and_discard():
+    st = _store(2)
+    assert st.get("absent") is None and st.misses == 1
+    st.put("k", _entry())
+    assert st.discard("k") is True and st.discard("k") is False
+    assert st.bytes_used == 0
+
+
+# ---------------------------------------------------------------------------
+# Engine(kv_host_mb=...) construction contract
+# ---------------------------------------------------------------------------
+
+def test_kv_host_mb_requires_paged_prefix_and_positive(tiny_gpt):
+    with pytest.raises(ValueError, match="paged"):
+        Engine(tiny_gpt, num_slots=2, max_seq_len=64, kv_host_mb=64,
+               registry=monitor.StatRegistry())
+    with pytest.raises(ValueError, match="prefix_cache"):
+        _engine(tiny_gpt, kv_host_mb=64, prefix_cache=False)
+    with pytest.raises(ValueError, match="kv_host_mb"):
+        _engine(tiny_gpt, kv_host_mb=0)
+    eng = _engine(tiny_gpt, kv_host_mb=64)
+    assert eng.host_store is not None
+    assert eng.host_store.dtype == "float32"
+    assert _engine(tiny_gpt, kv_host_mb=64,
+                   kv_dtype="int8").host_store.dtype == "int8"
+
+
+# ---------------------------------------------------------------------------
+# restore parity: host-restored stream vs never-evicted oracle
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+@pytest.mark.parametrize("kv", ["fp", "int8"])
+@pytest.mark.parametrize("seed", [None, 1234],
+                         ids=["greedy", "seeded"])
+def test_restore_parity_matrix(tiny_gpt, name, kv, seed):
+    """The tentpole acceptance bar: serve a prompt, spill its trie
+    blocks to the host tier, re-serve the SAME prompt — admission
+    restores the span from host RAM (counters prove it) and the
+    restored stream is token-identical to a never-evicted oracle,
+    greedy and seeded, fp and int8 KV, across every dispatch layout.
+    int8 payloads carry codes+scales, so the restored pool content is
+    bit-exact and even a near-tie argmax cannot flip."""
+    cfg = dict(CONFIGS[name])
+    if kv == "int8":
+        cfg["kv_dtype"] = "int8"
+    kw = _sample_kw(seed)
+    oracle = _serve_one(_engine(tiny_gpt, **cfg), **kw)
+    eng = _engine(tiny_gpt, kv_host_mb=64, **cfg)
+    first = _serve_one(eng, **kw)
+    assert first == oracle          # same math, no offload involved
+    spilled = _spill_all(eng)
+    assert spilled and len(eng.host_store) >= len(PROMPT) // 8
+    assert eng._m_offload_demotes.value == len(eng.host_store)
+    restored = _serve_one(eng, **kw)
+    assert restored == oracle, (name, kv, seed)
+    n = int(eng._m_offload_promotes.value)
+    assert n >= len(PROMPT) // 8    # the full-block span came back
+    assert eng._m_offload_hit_tokens.value == n * 8
+    # the restore re-seeded the device trie: a third serve is a pure
+    # DEVICE prefix hit, no further host traffic
+    third = _serve_one(eng, **kw)
+    assert third == oracle
+    assert int(eng._m_offload_promotes.value) == n
+
+
+def test_evict_then_readmit_hit_accounting(tiny_gpt):
+    """Counter/byte bookkeeping through one demote/promote cycle:
+    demotes == spilled trie blocks == store entries, store bytes ==
+    entries * per-entry bytes, promote hits land in BOTH
+    prefix_hit_tokens (the combined device+host signal) and
+    offload_hit_tokens (the host share), and a re-spill of restored
+    content dedups against resident entries instead of re-copying."""
+    eng = _engine(tiny_gpt, kv_host_mb=64)
+    _serve_one(eng)
+    spilled = len(_spill_all(eng))
+    st = eng.host_store
+    n_ent = len(st)
+    assert n_ent == len(PROMPT) // 8  # 3 full blocks spill; the
+    #   decode tail block is partial and never enters the trie
+    assert spilled >= n_ent
+    entry_bytes = st.bytes_used // n_ent
+    assert st.bytes_used == n_ent * entry_bytes
+    assert eng._m_offload_demotes.value == n_ent
+    hit0 = eng._m_prefix_hit_tokens.value
+    _serve_one(eng)
+    n_promo = int(eng._m_offload_promotes.value)
+    assert n_promo == n_ent
+    assert st.hits == n_ent
+    assert eng._m_offload_hit_tokens.value == n_promo * 8
+    assert eng._m_prefix_hit_tokens.value - hit0 >= n_promo * 8
+    # restored blocks re-seeded the trie; spilling them AGAIN finds
+    # their content addresses already resident — no new entries, no
+    # new demotes (hook-level dedup), byte accounting unchanged
+    _spill_all(eng)
+    assert len(st) == n_ent
+    assert st.bytes_used == n_ent * entry_bytes
+    assert eng._m_offload_demotes.value == n_ent
+    # gauges track the store
+    assert eng._m_kv_host_blocks.value == n_ent
+    assert eng._m_kv_host_bytes.value == st.bytes_used
+
+
+def test_natural_pressure_demotes_through_tick_boundary(tiny_gpt):
+    """Under a deliberately tiny device pool, admission's own
+    eviction (inside ``_kv_gate``) feeds the demote queue and the
+    tick-boundary drain materializes it — no manual spill involved —
+    and a later re-serve of the first prompt restores from host."""
+    eng = _engine(tiny_gpt, num_slots=1, kv_blocks=8, kv_host_mb=64)
+    prompts = [PROMPT, [int(t) + 40 for t in PROMPT],
+               [int(t) + 80 for t in PROMPT]]
+    outs = [_serve_one(eng, p) for p in prompts]
+    eng._flush_offload()
+    assert eng._m_offload_demotes.value >= 1  # pressure spilled
+    promo0 = eng._m_offload_promotes.value
+    again = _serve_one(eng, prompts[0])
+    assert again == outs[0]
+    assert eng._m_offload_promotes.value > promo0
+
+
+def test_preempt_then_restore_parity(tiny_gpt):
+    """A preempted stream whose parked trie blocks were then spilled
+    to the host tier resumes token-identically to an uninterrupted
+    oracle: preemption inserts the computed history into the trie,
+    eviction demotes it, and the resume's admission promotes it back
+    instead of re-prefilling."""
+    oracle = _serve_one(_engine(tiny_gpt, num_slots=1), n=12)
+    eng = _engine(tiny_gpt, num_slots=1, kv_host_mb=64)
+    r1 = eng.submit(PROMPT, max_new_tokens=12, priority=0)
+    for _ in range(200):
+        eng.step()
+        if len(r1.generated) >= 2:
+            break
+    assert len(r1.generated) >= 2
+    hi = eng.submit([int(t) + 60 for t in PROMPT], max_new_tokens=4,
+                    priority=5)
+    for _ in range(200):
+        eng.step()
+        if hi.done():
+            break
+    assert r1.preemptions == 1
+    # while the victim waits, its parked history spills to host RAM
+    assert len(_spill_all(eng)) >= 1
+    assert len(eng.host_store) >= len(PROMPT) // 8
+    eng.run_until_idle()
+    assert [int(t) for t in r1.result(timeout=5)] == oracle
+    assert eng._m_offload_promotes.value >= len(PROMPT) // 8
+    dbg = eng.debug_requests()
+    assert dbg["offload"]["blocks"] == len(eng.host_store)
+
+
+# ---------------------------------------------------------------------------
+# fault sites: degradation without corruption
+# ---------------------------------------------------------------------------
+
+def test_offload_demote_fault_frees_without_spilling(tiny_gpt):
+    """A scheduled ``offload_demote`` drops the spill: the block
+    frees normally, the store stays empty, and the engine still
+    serves the prompt correctly (recompute path)."""
+    f = FaultInjector(seed=3, rates={"offload_demote": 1.0})
+    eng = _engine(tiny_gpt, kv_host_mb=64, faults=f)
+    out1 = _serve_one(eng)
+    freed = _spill_all(eng)
+    assert freed                       # eviction itself still works
+    assert len(eng.host_store) == 0    # nothing spilled
+    assert eng._m_offload_demotes.value == 0
+    assert any(site == "offload_demote" for _, site in f.log)
+    assert _serve_one(eng) == out1     # recompute, same tokens
+    assert eng._m_offload_promotes.value == 0
+
+
+def test_offload_promote_fault_falls_back_to_recompute(tiny_gpt):
+    """A scheduled ``offload_promote`` declines the restore: the
+    fresh blocks stay plain prefill targets, the host entries stay
+    resident and untouched, and the output is still identical."""
+    f = FaultInjector(seed=3, rates={"offload_promote": 1.0})
+    eng = _engine(tiny_gpt, kv_host_mb=64, faults=f)
+    out1 = _serve_one(eng)
+    _spill_all(eng)
+    n_ent = len(eng.host_store)
+    assert n_ent >= 1
+    hits0 = eng.host_store.hits
+    assert _serve_one(eng) == out1
+    assert eng._m_offload_promotes.value == 0
+    assert len(eng.host_store) == n_ent        # entries untouched
+    assert eng.host_store.hits == hits0        # never even read
+    assert any(site == "offload_promote" for _, site in f.log)
+
+
+# ---------------------------------------------------------------------------
+# surfaces: /healthz, /debug/requests, router signals, prefix_warm
+# ---------------------------------------------------------------------------
+
+def _get_probe(engine, path):
+    """Drive httpd._Handler.do_GET without a socket; returns (code,
+    body) of the response the handler would have sent."""
+    from paddle_tpu.serving.httpd import _Handler
+
+    h = object.__new__(_Handler)
+    h.engine = engine
+    h.path = path
+    sent = {}
+
+    def _send(code, payload, ctype="application/json", headers=None):
+        sent["resp"] = (code, payload)
+
+    def _send_json(code, obj, headers=None):
+        sent["resp"] = (code, obj)
+
+    h._send = _send
+    h._send_json = _send_json
+    h.do_GET()
+    return sent["resp"]
+
+
+def test_healthz_and_debug_surfaces(tiny_gpt):
+    eng = _engine(tiny_gpt, kv_host_mb=64)
+    code, health = _get_probe(eng, "/healthz")
+    assert code == 200
+    assert health["kv_host_blocks"] == 0
+    assert health["kv_host_capacity_mb"] == 64.0
+    _serve_one(eng)
+    _spill_all(eng)
+    _serve_one(eng)
+    code, health = _get_probe(eng, "/healthz")
+    assert health["kv_host_blocks"] == len(eng.host_store)
+    assert health["kv_host_bytes"] == eng.host_store.bytes_used
+    assert health["offload_demotes_total"] >= 1
+    assert health["offload_promotes_total"] >= 1
+    assert health["offload_hit_tokens_total"] >= 8
+    # an engine WITHOUT the tier advertises nothing (probers key off
+    # the field's presence)
+    code, health = _get_probe(_engine(tiny_gpt), "/healthz")
+    assert "kv_host_blocks" not in health
+    dbg = eng.debug_requests()
+    assert dbg["offload"] == eng.host_store.stats()
+    assert _engine(tiny_gpt).debug_requests()["offload"] is None
+
+
+def test_debug_requests_restored_from_host_span(tiny_gpt):
+    """A live slot whose admission promoted host blocks reports the
+    restored token span in /debug/requests."""
+    eng = _engine(tiny_gpt, kv_host_mb=64)
+    _serve_one(eng)
+    _spill_all(eng)
+    r = eng.submit(PROMPT, max_new_tokens=6)
+    for _ in range(50):
+        eng.step()
+        if len(r.generated) >= 1:
+            break
+    view = [v for v in eng.debug_requests()["slots"]
+            if v.get("request_id") == r.id]
+    assert view and view[0]["restored_from_host"] >= 16
+    eng.run_until_idle()
+
+
+@pytest.mark.router
+def test_router_signals_and_prefix_warm_host_tier(tiny_gpt):
+    """The registry carries the host-tier signals, and prefix warming
+    prefers a peer's HOST tier over recompute: after the source's
+    device trie is spilled to host RAM, an affinity-miss warm still
+    ships the blocks (payload tier 'host'/'mixed') and the chosen
+    replica's prefix-hit counter moves."""
+    from paddle_tpu.serving.router import (InProcessReplica, Router,
+                                           RouterPolicy)
+    engines = [_engine(tiny_gpt, prefill_chunk=8, kv_host_mb=64)
+               for _ in range(2)]
+    for e in engines:
+        e.start()
+    reps = {f"r{i}": InProcessReplica(f"r{i}", engines[i])
+            for i in range(2)}
+    policy = RouterPolicy(probe_interval_s=30.0, retry_max=3,
+                          backoff_base_s=0.001, backoff_cap_s=0.01,
+                          breaker_cooldown_s=0.05, seed=7,
+                          prefix_warm=True, affinity=True)
+    rt = Router(reps, policy=policy, kv_block_size=8,
+                registry=monitor.StatRegistry())
+    rt.probe_once()
+    try:
+        out1 = rt.generate(PROMPT, max_new_tokens=4)
+        aff = out1["replica"]
+        src = engines[int(aff[1:])]
+        other = next(r["name"] for r in rt.replicas()
+                     if r["name"] != aff)
+        idx = int(other[1:])
+        # spill the affinity target's trie: its warmth now lives ONLY
+        # in the host tier (engines are idle between generates)
+        assert len(_spill_all(src)) >= 1
+        rt.probe_once()
+        sig = next(r for r in rt.replicas()
+                   if r["name"] == aff)["signals"]
+        assert sig["kv_host_blocks"] == len(src.host_store)
+        assert sig["kv_host_capacity_mb"] == 64.0
+        hits0 = engines[idx]._m_prefix_hits.value
+        rt.policy.affinity_queue_threshold = -1  # force the miss
+        out2 = rt.generate(PROMPT, max_new_tokens=4)
+    finally:
+        for e in engines:
+            e.stop()
+    assert out2["replica"] == other
+    assert out2["generated"] == out1["generated"]
+    warms = [ev for ev in rt.route_log() if ev[0] == "warm"]
+    assert warms and warms[-1][2] == aff and warms[-1][3] == other
+    assert warms[-1][4] >= 1
+    assert warms[-1][5] in ("host", "mixed")  # host tier served it
+    assert engines[idx]._m_prefix_hits.value > hits0
+
+
+# ---------------------------------------------------------------------------
+# tracing surface: the tier's transfers are attributable from a trace
+# ---------------------------------------------------------------------------
+
+def test_offload_spans_land_in_engine_trace(tiny_gpt):
+    """One spill + one restore leaves ``offload.demote`` spans (with
+    the content address and the stored verdict), an ``offload.promote``
+    span (with the restored block/token counts), and a
+    ``req.host_restored`` lifecycle instant in the engine's chrome
+    trace — and tools/trace_view.py --wall attributes them."""
+    import importlib.util
+    import os
+    eng = _engine(tiny_gpt, kv_host_mb=64)
+    _serve_one(eng)
+    _spill_all(eng)
+    _serve_one(eng)
+    evs = eng.chrome_trace()["traceEvents"]
+    demotes = [e for e in evs if e["name"] == "offload.demote"]
+    promotes = [e for e in evs if e["name"] == "offload.promote"]
+    assert len(demotes) >= 3 and len(promotes) >= 1
+    assert all(e["args"]["stored"] is True and e["args"]["key"]
+               for e in demotes)
+    assert promotes[0]["args"]["blocks"] == 3
+    assert promotes[0]["args"]["tokens"] == 24
+    inst = next(e for e in evs if e["name"] == "req.host_restored")
+    assert inst["args"]["tokens"] == 24
+    spec = importlib.util.spec_from_file_location(
+        "trace_view", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__))), "tools", "trace_view.py"))
+    tv = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tv)
+    w = tv.wall_summary(evs)
+    assert w["offload_demotes"] == len(demotes)
+    assert w["offload_promotes"] == len(promotes)
+    assert "offload.demote" in tv.format_wall(w)

@@ -378,6 +378,46 @@ def test_trace_view_surfaces_ragged_stream_dispatches(tmp_path,
     assert "decode.ragged 2.000 ms over 1 Pallas" in out
 
 
+def test_trace_view_surfaces_offload_transfers(tmp_path, capsys):
+    """--wall breaks out ``offload.demote`` / ``offload.promote``
+    spans (the host-RAM KV tier of ``Engine(kv_host_mb=...)``) so a
+    trace shows at a glance what the second tier's d2h spills and h2d
+    restores cost next to decode itself."""
+    tv = _load_tool("trace_view")
+    events = [
+        {"name": "tick", "ph": "X", "ts": 0.0, "dur": 10000.0,
+         "cat": "tick"},
+        {"name": "offload.demote", "ph": "X", "ts": 500.0,
+         "dur": 1500.0, "cat": "serving",
+         "args": {"key": "ab12", "stored": True}},
+        {"name": "offload.demote", "ph": "X", "ts": 2500.0,
+         "dur": 500.0, "cat": "serving"},
+        {"name": "tick", "ph": "X", "ts": 20000.0, "dur": 10000.0,
+         "cat": "tick"},
+        {"name": "offload.promote", "ph": "X", "ts": 20500.0,
+         "dur": 3000.0, "cat": "serving", "args": {"blocks": 3}},
+    ]
+    w = tv.wall_summary(events)
+    assert w["offload_demotes"] == 2
+    assert w["offload_demote_ms"] == pytest.approx(2.0)
+    assert w["offload_promotes"] == 1
+    assert w["offload_promote_ms"] == pytest.approx(3.0)
+    path = tmp_path / "offload.json"
+    path.write_text(json.dumps({"traceEvents": events}))
+    assert tv.main([str(path), "--wall"]) == 0
+    out = capsys.readouterr().out
+    assert "offload.demote 2.000 ms over 2 block demote(s)" in out
+    assert "offload.promote 3.000 ms over 1 restore(s)" in out
+    assert "host-RAM KV tier" in out
+    # a trace with no offload traffic keeps the line out entirely
+    quiet = [e for e in events if not e["name"].startswith("offload.")]
+    assert not (tv.wall_summary(quiet)["offload_demotes"]
+                or tv.wall_summary(quiet)["offload_promotes"])
+    path.write_text(json.dumps({"traceEvents": quiet}))
+    assert tv.main([str(path), "--wall"]) == 0
+    assert "offload." not in capsys.readouterr().out
+
+
 def test_trace_view_lifecycle_instants(tmp_path, capsys):
     """tools/trace_view.py --lifecycle counts instant events by name
     with a [reason] breakdown — the req.preempted / req.resumed /

@@ -87,10 +87,12 @@ def wall_summary(events):
     mig_export = mig_wire = mig_import = 0.0
     sup_restart = drain_mig = dequant = 0.0
     lora_swap = stream_emit = 0.0
+    off_demote = off_promote = 0.0
     n_ticks = n_ragged = n_ragged_stream = n_allgather = 0
     n_migrations = 0
     n_restarts = n_drain_migs = n_dequants = 0
     n_lora_swaps = n_stream_emits = 0
+    n_off_demotes = n_off_promotes = 0
     for ev in events:
         if ev.get("ph") != "X":
             continue
@@ -172,6 +174,18 @@ def wall_summary(events):
                 # of live delivery (zero when nobody streams)
                 stream_emit += dur
                 n_stream_emits += 1
+            elif name == "offload.demote":
+                # host-RAM KV tier (Engine(kv_host_mb=...)): demote =
+                # materializing an evicted block's async gather into
+                # the host store at a tick boundary, promote = the
+                # admission-gate restore (host payload scattered into
+                # fresh device blocks instead of recomputed) — the
+                # d2h/h2d price of the second tier, per transfer
+                off_demote += dur
+                n_off_demotes += 1
+            elif name == "offload.promote":
+                off_promote += dur
+                n_off_promotes += 1
             elif name == "decode.dequant":
                 # int8-KV engines (Engine(kv_dtype="int8")): the
                 # host-side attribution span of a QUANTIZED dispatch
@@ -208,6 +222,10 @@ def wall_summary(events):
         "lora_swaps": n_lora_swaps,
         "stream_emit_ms": stream_emit,
         "stream_emits": n_stream_emits,
+        "offload_demote_ms": off_demote,
+        "offload_demotes": n_off_demotes,
+        "offload_promote_ms": off_promote,
+        "offload_promotes": n_off_promotes,
     }
 
 
@@ -265,6 +283,13 @@ def format_wall(w):
             f"stream.emit {w['stream_emit_ms']:.3f} ms over "
             f"{w['stream_emits']} streamed token(s) (per-token "
             "fan-out to attached SSE sinks)")
+    if w.get("offload_demotes") or w.get("offload_promotes"):
+        lines.append(
+            f"offload.demote {w['offload_demote_ms']:.3f} ms over "
+            f"{w['offload_demotes']} block demote(s)   "
+            f"offload.promote {w['offload_promote_ms']:.3f} ms over "
+            f"{w['offload_promotes']} restore(s) (host-RAM KV tier: "
+            "evicted-block spill / admission restore)")
     if w.get("supervisor_restarts") or w.get("drain_migrations"):
         lines.append(
             f"supervisor.restart {w['supervisor_restart_ms']:.3f} ms "
