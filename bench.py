@@ -2809,6 +2809,159 @@ def bench_serving_offload():
     return result
 
 
+def bench_serving_dp():
+    """DATA-PARALLEL SERVING MESH (Engine(mesh=(mp, dp))): the 2-D
+    mesh benches on a forced 4-device CPU pool (the child env pins
+    --xla_force_host_platform_device_count=4).  Three legs:
+
+    1. THROUGHPUT + PARITY — the paged+chunked mixed workload on the
+       unsharded engine vs (1, 2) and (2, 2) meshes; greedy outputs
+       asserted token-identical in-bench, and COMPILE-ONCE asserted
+       in-bench: the timed waves add zero programs after the warm
+       wave on every arm.  On CPU the mesh "devices" are threads of
+       one host, so the collective tax is all cost and no bandwidth
+       — ratios are recorded, not gated (on hardware dp multiplies
+       concurrent slots the way mp multiplies per-block capacity).
+    2. KV CAPACITY — a fixed per-shard kv_budget_mb: dp stacks a
+       budget-sized pool range per shard and mp halves the per-shard
+       block bytes, so (2, 2) must hold >= 3.9x the unsharded blocks
+       (exactly 4x for the tiny config), asserted, with each dp
+       shard's equal share recorded.
+    3. DP SLOT SHARDING — each dp shard owns num_slots/dp contiguous
+       batch-slot rows (and their cursors/tables); recorded from the
+       live engine.
+
+    Writes BENCH_r21.json."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import monitor
+    from paddle_tpu.models import GPTModel
+    from paddle_tpu.serving import Engine
+    import jax
+
+    assert len(jax.devices()) >= 4, \
+        f"needs a forced 4-device CPU pool, have {jax.devices()}"
+    vocab = 128
+    rng = np.random.RandomState(0)
+    MAX_NEW = 8
+    prompts = [rng.randint(0, vocab, (4 + i % 7,)).astype(np.int32)
+               for i in range(16)]
+    n_tokens = len(prompts) * MAX_NEW
+
+    def fresh(mesh):
+        # one model PER ARM (same seed -> identical weights): a
+        # sharded engine device_puts its model's params with mesh
+        # shardings, and a shared model would hand the unsharded
+        # arm resharded params — recompiling its warmed programs
+        # and breaking the compile-once assertion below
+        paddle.seed(0)
+        m = GPTModel.from_config("tiny", dropout=0.0)
+        m.eval()
+        return m.to_tensor_parallel() if (mesh and mesh[0] > 1) \
+            else m
+
+    def build(mesh):
+        return Engine(fresh(mesh), num_slots=4, max_seq_len=64,
+                      kv_block_size=8, prefill_chunk=8, mesh=mesh,
+                      registry=monitor.StatRegistry())
+
+    def wave(eng):
+        reqs = [eng.submit(p, max_new_tokens=MAX_NEW)
+                for p in prompts]
+        eng.run_until_idle()
+        return [list(r.generated) for r in reqs]
+
+    # -- leg 1: throughput + parity + compile-once, interleaved -----
+    arms = {"1x1": None, "1x2": (1, 2), "2x2": (2, 2)}
+    engines, outs, compiles = {}, {}, {}
+    for tag, mesh in arms.items():
+        engines[tag] = build(mesh)
+        outs[tag] = wave(engines[tag])  # warm every program
+        compiles[tag] = engines[tag].registry.get(
+            "serving.compiles_total").value
+    assert outs["1x2"] == outs["1x1"], "dp greedy parity violated"
+    assert outs["2x2"] == outs["1x1"], "mp x dp greedy parity violated"
+    best = {tag: 0.0 for tag in arms}
+    for _ in range(3):
+        for tag, eng in engines.items():
+            t0 = time.perf_counter()
+            wave(eng)
+            best[tag] = max(best[tag],
+                            n_tokens / (time.perf_counter() - t0))
+    for tag, eng in engines.items():
+        c = eng.registry.get("serving.compiles_total").value
+        assert c == compiles[tag], \
+            f"{tag}: timed waves recompiled ({compiles[tag]} -> {c})"
+    tokps = {tag: round(v, 1) for tag, v in best.items()}
+
+    # -- leg 2: KV capacity scales mp x dp --------------------------
+    def cap(mesh):
+        return Engine(fresh(mesh), num_slots=4, max_seq_len=64,
+                      kv_block_size=8, kv_budget_mb=1, mesh=mesh,
+                      registry=monitor.StatRegistry())
+
+    c1, c12, c22 = cap(None), cap((1, 2)), cap((2, 2))
+    assert c12._kv_managed == 2 * c1._kv_managed, \
+        (c1._kv_managed, c12._kv_managed)
+    assert c22._kv_managed >= 3.9 * c1._kv_managed, \
+        (c1._kv_managed, c22._kv_managed)
+    per_dp = [c22.block_pool.free_count(d) for d in range(2)]
+    assert per_dp[0] == per_dp[1] == c22._kv_managed // 2, per_dp
+    capacity = {
+        "kv_budget_mb": 1,
+        "kv_blocks_1x1": int(c1._kv_managed),
+        "kv_blocks_1x2": int(c12._kv_managed),
+        "kv_blocks_2x2": int(c22._kv_managed),
+        "block_bytes_per_shard_1x1": int(
+            c1._kv_block_bytes_per_shard),
+        "block_bytes_per_shard_2x2": int(
+            c22._kv_block_bytes_per_shard),
+        "blocks_per_dp_shard_2x2": [int(x) for x in per_dp],
+        "scaling_2x2": round(c22._kv_managed / c1._kv_managed, 3),
+    }
+
+    # -- leg 3: dp slot sharding ------------------------------------
+    e22 = engines["2x2"]
+    slots = {
+        "num_slots": int(e22.num_slots),
+        "dp": int(e22.dp),
+        "slots_per_dp_shard": int(e22.num_slots // e22.dp),
+        "slot_to_shard": [int(e22._slot_shard(i))
+                          for i in range(e22.num_slots)],
+    }
+
+    result = {
+        "metric": "serving dp KV capacity scaling (mesh=(2,2) vs "
+                  "unsharded, fixed per-shard HBM budget)",
+        "value": capacity["scaling_2x2"], "unit": "x",
+        "throughput": {
+            "workload": "16 paged+chunked greedy requests x 8 new "
+                        "tokens, tiny model, best-of-3 interleaved",
+            "tokens_per_sec": tokps,
+            "dp2_over_1x1": round(
+                tokps["1x2"] / max(tokps["1x1"], 1e-9), 3),
+            "mp2dp2_over_1x1": round(
+                tokps["2x2"] / max(tokps["1x1"], 1e-9), 3),
+            "greedy_parity": "asserted",
+            "compile_once": "asserted (zero new programs across the "
+                            "timed waves on every arm)",
+            "note": "4 virtual CPU devices share one host: the "
+                    "cross-shard collectives are pure overhead "
+                    "here, so the sharded arms run SLOWER on CPU; "
+                    "the mesh exists for slot counts and KV pools "
+                    "that exceed one chip",
+        },
+        "capacity": capacity,
+        "slots": slots,
+    }
+    try:
+        with open(os.path.join(REPO, "BENCH_r21.json"), "w") as f:
+            json.dump(result, f, indent=1)
+    except OSError:
+        pass  # read-only checkout: the returned numbers still land
+    return result
+
+
 CHILD_BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
                  "bert": bench_bert, "canary": bench_canary,
                  "decode": bench_decode, "serving": bench_serving,
@@ -2822,6 +2975,7 @@ CHILD_BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
                  "serving_longctx": bench_serving_longctx,
                  "serving_router": bench_serving_router,
                  "serving_sharded": bench_serving_sharded,
+                 "serving_dp": bench_serving_dp,
                  "serving_migration": bench_serving_migration,
                  "serving_supervisor": bench_serving_supervisor,
                  "serving_quant": bench_serving_quant,
@@ -2830,17 +2984,19 @@ CHILD_BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
 
 
 def child_main(name, out_path):
-    if name == "serving_sharded":
-        # the mesh bench needs a multi-device pool BEFORE the backend
-        # binds: force the 2-device virtual CPU host (and the CPU
+    if name in ("serving_sharded", "serving_dp"):
+        # the mesh benches need a multi-device pool BEFORE the
+        # backend binds: force the virtual CPU host (and the CPU
         # platform — sharding 2 "tiny"s over a real TPU says nothing
-        # a CPU mesh doesn't, and the fleet leg spawns CPU children)
+        # a CPU mesh doesn't, and the fleet leg spawns CPU children);
+        # serving_dp runs the (2, 2) mesh, so it needs 4
         os.environ["JAX_PLATFORMS"] = "cpu"
+        need = 4 if name == "serving_dp" else 2
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=2"
-            ).strip()
+                flags + " --xla_force_host_platform_device_count="
+                f"{need}").strip()
     # Import paddle_tpu first: it applies the PADDLE_TPU_PLATFORM override
     # exactly like user code will — one implementation, no drift.
     import paddle_tpu  # noqa: F401
@@ -2927,6 +3083,7 @@ def main():
                                            "serving_longctx",
                                            "serving_router",
                                            "serving_sharded",
+                                           "serving_dp",
                                            "serving_migration",
                                            "serving_supervisor",
                                            "serving_quant",
@@ -2966,6 +3123,8 @@ def main():
                           "locality gain (affinity vs random routing)",
         "serving_sharded": "serving sharded KV capacity scaling "
                            "(mp=2 vs mp=1, fixed per-shard budget)",
+        "serving_dp": "serving dp KV capacity scaling (mesh=(2,2) "
+                      "vs unsharded, fixed per-shard budget)",
         "serving_migration": "serving KV block migration mid-decode "
                              "stream handoff latency (export+import)",
         "serving_supervisor": "serving self-healing supervisor "
@@ -3015,7 +3174,8 @@ def main():
     # with longer timeouts rather than the single secondary attempt
     attempts = (GPT2_ATTEMPTS if head_name == "gpt2" else
                 ASYNC_ATTEMPTS if head_name in ("serving_async",
-                                                "serving_supervisor")
+                                                "serving_supervisor",
+                                                "serving_dp")
                 else SECONDARY_ATTEMPTS)
     head, head_note = _run_child(head_name, attempts, deadline)
     line = {
@@ -3062,7 +3222,8 @@ def main():
             continue
         res, note = _run_child(
             name, ASYNC_ATTEMPTS if name in ("serving_async",
-                                             "serving_supervisor")
+                                             "serving_supervisor",
+                                             "serving_dp")
             else SECONDARY_ATTEMPTS, deadline)
         if res is not None:
             results[name] = res

@@ -1,24 +1,26 @@
-"""Mesh-sharded serving: tensor-parallel engine over a 2-device mesh.
+"""Mesh-sharded serving: an mp x dp engine over a 4-device mesh.
 
-    XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 \
         python serving_sharded.py
     (the script forces the flag itself when unset)
 
-``Engine(mesh=2)`` serves a GPT whose attention heads, FFN, and vocab
-are sharded over a 2-device 'mp' mesh (pjit/GSPMD consumes the
-PartitionSpecs that ``GPTModel.to_tensor_parallel()`` — or building
-with ``use_mp=True`` — puts on the weights), with the paged KV block
-pools sharded over the SAME mesh on the head axis: each shard holds
-its heads' K/V slice of every block, so a fixed per-chip HBM budget
-(``kv_budget_mb``) holds mp x the logical blocks — the capacity
-story — while models too big for one chip serve at all — the
-existence story.  On this CPU demo the two "devices" are threads of
-one host, so expect the collectives to COST; the demo's point is the
-parity and the capacity arithmetic, printed side by side:
+``Engine(mesh=(2, 2))`` serves a GPT sharded BOTH ways at once: the
+attention heads, FFN, and vocab shard over the 'mp' axis (pjit/GSPMD
+consumes the PartitionSpecs that ``GPTModel.to_tensor_parallel()`` —
+or building with ``use_mp=True`` — puts on the weights), while the
+batch slots shard over the 'dp' axis — each dp shard owns its own
+contiguous range of slot rows, KV block-pool rows, block tables, and
+device cursors (params replicate over 'dp').  One compiled program
+spans both axes, so a fixed per-chip HBM budget (``kv_budget_mb``)
+holds mp x dp the logical blocks — the capacity story — while models
+too big for one chip serve at all — the existence story.  On this CPU
+demo the four "devices" are threads of one host, so expect the
+collectives to COST; the demo's point is the parity and the capacity
+arithmetic, printed side by side:
 
 * greedy + seeded outputs token-identical to the unsharded engine,
-* per-shard block bytes halved, logical pool doubled at a fixed
-  budget, per-shard block usage while streams are live,
+* per-shard block bytes halved by mp, per-dp-shard pools stacked by
+  dp: 4x the logical blocks at a fixed budget on the (2, 2) mesh,
 * the ``shard.sync`` / ``decode.allgather`` spans in the tick trace.
 """
 import os
@@ -31,7 +33,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=2").strip()
+        + " --xla_force_host_platform_device_count=4").strip()
 
 import numpy as np  # noqa: E402
 
@@ -60,43 +62,51 @@ def main():
         engine.run_until_idle()
         return [list(r.generated) for r in reqs]
 
-    # a fixed 1 MB per-shard KV budget: the sharded pool holds 2x the
-    # logical blocks because each shard stores only its heads' slice
+    # a fixed 1 MB per-shard KV budget: mp halves the per-shard block
+    # bytes (each mp shard stores only its heads' slice), dp stacks a
+    # budget-sized pool range per shard — (2, 2) holds 4x the blocks
     eng1 = Engine(dense, num_slots=4, max_seq_len=64, kv_block_size=8,
                   kv_budget_mb=1, registry=monitor.StatRegistry())
-    eng2 = Engine(tp, num_slots=4, max_seq_len=64, kv_block_size=8,
-                  kv_budget_mb=1, mesh=2,
+    eng4 = Engine(tp, num_slots=4, max_seq_len=64, kv_block_size=8,
+                  kv_budget_mb=1, mesh=(2, 2),
                   registry=monitor.StatRegistry())
-    print(f"mesh: {eng2.mesh_axes}   devices: "
-          f"{int(eng2.registry.get('serving.mesh_devices').value)}")
-    print(f"per-shard block bytes: mp=1 "
-          f"{eng1._kv_block_bytes_per_shard}  ->  mp=2 "
-          f"{eng2._kv_block_bytes_per_shard}")
-    print(f"kv blocks @ 1MB/shard:  mp=1 {eng1._kv_managed}  ->  "
-          f"mp=2 {eng2._kv_managed}  "
-          f"({eng2._kv_managed / eng1._kv_managed:.1f}x capacity)")
+    print(f"mesh: {eng4.mesh_axes}   devices: "
+          f"{int(eng4.registry.get('serving.mesh_devices').value)}")
+    print(f"per-shard block bytes: unsharded "
+          f"{eng1._kv_block_bytes_per_shard}  ->  mp=2 dp=2 "
+          f"{eng4._kv_block_bytes_per_shard}")
+    print(f"kv blocks @ 1MB/shard:  unsharded {eng1._kv_managed}  ->"
+          f"  mp=2 dp=2 {eng4._kv_managed}  "
+          f"({eng4._kv_managed / eng1._kv_managed:.1f}x capacity)")
+    per_dp = [eng4.block_pool.free_count(d) for d in range(eng4.dp)]
+    print(f"per-dp-shard free blocks: {per_dp} "
+          f"(each dp shard owns its own contiguous pool range)")
 
     # mid-flight per-shard block usage: submit, tick a few times,
-    # peek the pool while streams are live
+    # peek the pool while streams are live — slots round-robin their
+    # dp shard (slot i -> shard i // (num_slots // dp)), so both dp
+    # shards carry live blocks
     for p in prompts:
-        eng2.submit(p, max_new_tokens=8)
+        eng4.submit(p, max_new_tokens=8)
     for _ in range(3):
-        eng2.step()
-    used = eng2.block_pool.in_use()
-    print(f"mid-decode: {used} logical blocks in use = "
-          f"{used * eng2._kv_block_bytes_per_shard} bytes on EACH of "
-          f"{eng2.mp} shards")
-    eng2.run_until_idle()
+        eng4.step()
+    used = eng4.block_pool.in_use()
+    per_dp_used = [per_dp[d] - eng4.block_pool.free_count(d)
+                   for d in range(eng4.dp)]
+    print(f"mid-decode: {used} logical blocks in use "
+          f"(per dp shard: {per_dp_used}), each costing "
+          f"{eng4._kv_block_bytes_per_shard} bytes on its mp slices")
+    eng4.run_until_idle()
 
     for seeded in (False, True):
         a = run(eng1, seeded)
-        b = run(eng2, seeded)
+        b = run(eng4, seeded)
         tag = "seeded" if seeded else "greedy"
         assert a == b, f"{tag} parity violated"
-        print(f"{tag} parity mp=1 vs mp=2: token-identical "
+        print(f"{tag} parity unsharded vs mp=2 dp=2: token-identical "
               f"({sum(len(x) for x in a)} tokens)")
 
-    names = [e["name"] for e in eng2.chrome_trace()["traceEvents"]
+    names = [e["name"] for e in eng4.chrome_trace()["traceEvents"]
              if e.get("ph") == "X"]
     print(f"trace spans: shard.sync x{names.count('shard.sync')}  "
           f"decode.allgather x{names.count('decode.allgather')}")

@@ -221,7 +221,7 @@ class ServingFleet:
         return False
 
 
-def spawn_serving_fleet(n, config="tiny", mp=1, platform="cpu",
+def spawn_serving_fleet(n, config="tiny", mp=1, dp=1, platform="cpu",
                         seed=0, num_slots=4, max_seq_len=64,
                         kv_block_size=None, spec_k=None,
                         prefill_chunk=None, roles=None, log_dir=None,
@@ -238,10 +238,10 @@ def spawn_serving_fleet(n, config="tiny", mp=1, platform="cpu",
       concurrent launches, modulo the unavoidable close-to-child-bind
       window the training path documents;
     * the per-worker env contract from ``_worker_env``: the JAX
-      platform propagated explicitly and, for ``mp > 1`` on CPU, a
-      forced virtual device pool sized to the replica's mesh — a
-      worker must never silently serve a 1-device mesh because the
-      parent's XLA_FLAGS did not reach it;
+      platform propagated explicitly and, for ``mp * dp > 1`` on
+      CPU, a forced virtual device pool sized to the replica's FULL
+      (mp x dp) mesh — a worker must never silently serve a 1-device
+      mesh because the parent's XLA_FLAGS did not reach it;
     * the SAME ``--seed``, so greedy failover across replicas is
       token-identical.
 
@@ -267,8 +267,9 @@ def spawn_serving_fleet(n, config="tiny", mp=1, platform="cpu",
     procs, urls, logs, cmds, log_paths = [], [], [], [], []
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
+    need = int(mp) * int(dp)
     env = _worker_env(platform=platform,
-                      device_count=mp if int(mp) > 1 else None)
+                      device_count=need if need > 1 else None)
     reserved = [_reserve_port() for _ in range(int(n))]
     all_urls = [f"http://127.0.0.1:{s.getsockname()[1]}"
                 for s in reserved]
@@ -277,6 +278,7 @@ def spawn_serving_fleet(n, config="tiny", mp=1, platform="cpu",
             port = sock.getsockname()[1]
             cmd = [sys.executable, "-m", "paddle_tpu.serving.httpd",
                    "--config", str(config), "--mp", str(int(mp)),
+                   "--dp", str(int(dp)),
                    "--port", str(port), "--seed", str(int(seed)),
                    "--num-slots", str(int(num_slots)),
                    "--max-seq-len", str(int(max_seq_len))]

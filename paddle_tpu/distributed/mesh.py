@@ -50,25 +50,29 @@ def build_mesh(dp=1, sharding=1, pp=1, mp=1, sp=1, ep=1,
     return Mesh(arr, AXES)
 
 
-def serving_mesh(mp=1, devices=None) -> Mesh:
-    """Tensor-parallel mesh for the SERVING engine: the first ``mp``
-    devices on the canonical hybrid axes with only 'mp' > 1 — so the
-    TP layers' ``PartitionSpec(..., "mp", ...)`` weights shard and
-    everything else replicates.  Unlike ``build_mesh`` this never
+def serving_mesh(mp=1, dp=1, devices=None) -> Mesh:
+    """2-D ``(mp, dp)`` mesh for the SERVING engine: the first
+    ``mp * dp`` devices on the canonical hybrid axes with only 'mp'
+    and 'dp' > 1 — the TP layers' ``PartitionSpec(..., "mp", ...)``
+    weights shard over 'mp' (and replicate over 'dp'), while the
+    engine shards its batch slots — KV block pools, block tables,
+    device cursors — over 'dp'.  Unlike ``build_mesh`` this never
     swallows the whole device pool: a serving replica shards over
     exactly the chips it was given and leaves the rest to sibling
     replicas (the launcher spawns one process per replica, each with
     its own mesh)."""
-    mp = int(mp)
-    if mp < 1:
-        raise ValueError(f"mp must be >= 1, got {mp}")
+    mp, dp = int(mp), int(dp)
+    if mp < 1 or dp < 1:
+        raise ValueError(f"mp and dp must be >= 1, got mp={mp} dp={dp}")
+    need = mp * dp
     devices = list(devices if devices is not None else jax.devices())
-    if len(devices) < mp:
+    if len(devices) < need:
+        shape = (f"mp={mp}, dp={dp}" if dp > 1 else f"mp={mp}")
         raise ValueError(
-            f"serving_mesh(mp={mp}) needs {mp} devices, have "
+            f"serving_mesh({shape}) needs {need} devices, have "
             f"{len(devices)} — on CPU force a virtual pool with "
-            f"XLA_FLAGS=--xla_force_host_platform_device_count={mp}")
-    return build_mesh(mp=mp, devices=devices[:mp])
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={need}")
+    return build_mesh(mp=mp, dp=dp, devices=devices[:need])
 
 
 def set_mesh(mesh: Mesh | None):

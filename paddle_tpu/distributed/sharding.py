@@ -28,6 +28,45 @@ from ..nn import functional as F
 from . import mesh as mesh_mod
 
 
+# Canonical serving-engine PartitionSpecs for the (mp, dp) mesh
+# (distributed/mesh.serving_mesh).  ONE table so the engine, the
+# shard_map-wrapped ragged kernel (ops/ragged_paged_attn.
+# sharded_ragged_paged_attention), and the tests agree on the layout:
+#
+# * ``kv``     — both KV layouts lead with the dp-sharded axis (slot
+#   rows contiguous, pool rows paged — BlockPool carves its dp block
+#   ranges to match) and carry heads at index 2, sharded over 'mp'.
+# * ``kv_scale`` — quantized pools' [NB, H] scale pool: block rows
+#   with their dp shard, head columns with their mp shard.
+# * ``state``  — [B]-leading cursor / sampling-state vectors: slot
+#   rows over 'dp'.
+# * ``table``  — [B, blocks_per_slot] block tables: slot rows over
+#   'dp', table columns replicated (entries are GLOBAL pool rows;
+#   the kernel wrapper localizes them per shard).
+# * ``replicated`` — everything else (params without TP specs,
+#   buffers, scalars).
+SERVING_SPECS = {
+    "kv": PartitionSpec("dp", None, "mp", None),
+    "kv_scale": PartitionSpec("dp", "mp"),
+    "state": PartitionSpec("dp"),
+    "table": PartitionSpec("dp", None),
+    "replicated": PartitionSpec(),
+}
+
+
+def serving_sharding(mesh, kind):
+    """NamedSharding for one of the canonical serving array kinds
+    (``SERVING_SPECS`` keys) on the given (mp, dp) serving mesh."""
+    from jax.sharding import NamedSharding
+    try:
+        spec = SERVING_SPECS[kind]
+    except KeyError:
+        raise ValueError(
+            f"unknown serving array kind {kind!r}; expected one of "
+            f"{sorted(SERVING_SPECS)}") from None
+    return NamedSharding(mesh, spec)
+
+
 def _first_divisible_dim(shape, world):
     for i, s in enumerate(shape):
         if s % world == 0 and s >= world:

@@ -478,7 +478,8 @@ class GPTAttention(nn.Layer):
                 flat_v.reshape(v_pool.shape))
 
     def ragged_window_paged(self, x, k_pool, v_pool, block_tables, pos,
-                            width, variant="stream"):
+                            width, scratch=None, sharded=False,
+                            variant="stream"):
         """RAGGED paged window — the Pallas-kernel twin of the three
         paged window shapes (``decode_slots_paged`` S=1,
         ``verify_slots_paged`` S=k+1, ``prefill_chunk_paged`` S=C):
@@ -489,26 +490,35 @@ class GPTAttention(nn.Layer):
 
         The window's K/V scatters through each slot's table with the
         WIDTH MASK applied here, before the kernel: lanes
-        ``s >= width[b]`` land in physical row 0 — the engine's
-        scratch block — which is the one masking rule that used to be
+        ``s >= width[b]`` land in the slot's own SCRATCH block
+        (``scratch[b]``; physical row 0 when None — the unsharded
+        engine) — which is the one masking rule that used to be
         three per-path invariants (parked slots' zero tables, the
         spec-margin reservation, chunked prefill's ``true_len`` pad
-        lanes; see serving/kvcache.py).  Valid lanes write exactly
-        what their XLA twin writes.  ``variant`` picks the kernel
-        body: ``"stream"`` (default, ``attn_impl="ragged"``) runs the
-        flash-style online-softmax block loop — O(block_size x W)
-        working set, allclose to ``_slot_attn`` with greedy streams
-        token-identical end-to-end; ``"gather"``
-        (``attn_impl="ragged_gather"``) materializes the whole row and
-        stays bitwise-equal to the XLA path on CPU (asserted in
-        tests/test_ragged_attn.py).
+        lanes; see serving/kvcache.py).  Under a dp mesh the scratch
+        row is per-slot DATA because each dp shard reserves its own
+        scratch block — a masked lane may never write another shard's
+        rows.  Valid lanes write exactly what their XLA twin writes.
+        ``variant`` picks the kernel body: ``"stream"`` (default,
+        ``attn_impl="ragged"``) runs the flash-style online-softmax
+        block loop — O(block_size x W) working set, allclose to
+        ``_slot_attn`` with greedy streams token-identical
+        end-to-end; ``"gather"`` (``attn_impl="ragged_gather"``)
+        materializes the whole row and stays bitwise-equal to the XLA
+        path on CPU (asserted in tests/test_ragged_attn.py).
+        ``sharded=True`` (a 2-D mp x dp serving mesh) routes the
+        kernel through ``sharded_ragged_paged_attention`` — the
+        hand-written shard_map partitioning GSPMD cannot derive for
+        the Mosaic path.
 
         x: Tensor [B, W, E]; k_pool/v_pool: [NB, bs, H, hd];
-        block_tables: int32 [B, L//bs]; pos/width: int32 [B].
+        block_tables: int32 [B, L//bs]; pos/width: int32 [B];
+        scratch: optional int32 [B] per-slot scratch block id.
         Returns (out Tensor [B, W, E], k_pool, v_pool).
         """
         import jax.numpy as jnp
-        from ..ops.ragged_paged_attn import ragged_paged_attention
+        from ..ops.ragged_paged_attn import (
+            ragged_paged_attention, sharded_ragged_paged_attention)
 
         qa, ka, va = self._qkv_step(x)
         B, W = qa.shape[0], qa.shape[1]
@@ -516,11 +526,13 @@ class GPTAttention(nn.Layer):
         bps = block_tables.shape[1]
         rows = jnp.arange(B)
         H, hd = self.num_heads, self.head_dim
+        if scratch is None:
+            scratch = jnp.zeros(B, jnp.int32)
         offs = pos[:, None] + jnp.arange(W)[None, :]        # [B, W]
         # lanes past width[b] — and any out-of-range offset (runaway
         # defense: a clip into the table's LAST entry would overwrite
         # live rows of the slot's own cache) — scatter into the
-        # scratch block's row 0, the parked-lane semantics of the XLA
+        # slot's scratch block, the parked-lane semantics of the XLA
         # paths' pos clamps
         valid = (jnp.arange(W)[None, :] < width[:, None]) \
             & (offs < bps * bs)
@@ -528,9 +540,10 @@ class GPTAttention(nn.Layer):
         blk = block_tables[rows[:, None], offs_safe // bs]
         if _is_quant_kv(k_pool):
             from ..serving.quant import paged_insert
-            # same masking rule, insert form: masked lanes RMW the
-            # scratch block (blk 0, row 0) instead of scatter-row 0
-            blk_q = jnp.where(valid, blk, 0).reshape(-1)
+            # same masking rule, insert form: masked lanes RMW their
+            # slot's scratch block instead of scatter-row
+            blk_q = jnp.where(valid, blk,
+                              scratch[:, None]).reshape(-1)
             off_q = jnp.where(valid, offs_safe % bs, 0).reshape(-1)
             k_pool = paged_insert(k_pool, blk_q, off_q,
                                   ka.reshape(B * W, H, hd))
@@ -538,7 +551,9 @@ class GPTAttention(nn.Layer):
                                   va.reshape(B * W, H, hd))
             # the kernel gets code rows + the parallel scale pools and
             # dequantizes per gathered block, inside the kv-block loop
-            ctx = ragged_paged_attention(
+            attn = (sharded_ragged_paged_attention if sharded
+                    else ragged_paged_attention)
+            ctx = attn(
                 qa, k_pool.codes.reshape(NB * bs, H, hd),
                 v_pool.codes.reshape(NB * bs, H, hd),
                 block_tables, pos, width, block_size=bs,
@@ -548,13 +563,16 @@ class GPTAttention(nn.Layer):
         else:
             flat_k = k_pool.reshape(NB * bs, H, hd)
             flat_v = v_pool.reshape(NB * bs, H, hd)
-            widx = jnp.where(valid, blk * bs + offs_safe % bs, 0)
+            widx = jnp.where(valid, blk * bs + offs_safe % bs,
+                             scratch[:, None] * bs)
             flat_k = flat_k.at[widx].set(ka.astype(flat_k.dtype))
             flat_v = flat_v.at[widx].set(va.astype(flat_v.dtype))
-            ctx = ragged_paged_attention(qa, flat_k, flat_v,
-                                         block_tables, pos, width,
-                                         block_size=bs,
-                                         variant=variant)
+            attn = (sharded_ragged_paged_attention if sharded
+                    else ragged_paged_attention)
+            ctx = attn(qa, flat_k, flat_v,
+                       block_tables, pos, width,
+                       block_size=bs,
+                       variant=variant)
             new_k = flat_k.reshape(k_pool.shape)
             new_v = flat_v.reshape(v_pool.shape)
         out = Tensor(ctx)
@@ -568,7 +586,7 @@ class GPTAttention(nn.Layer):
         return out, new_k, new_v
 
     def prefill_chunk_paged(self, x, k_pool, v_pool, block_table, pos,
-                            true_len):
+                            true_len, scratch=0):
         """CHUNKED prefill through ONE slot's block table (budgeted
         chunked prefill — serving/engine.py ``prefill_chunk``): run a
         fixed-size window of C prompt tokens at positions
@@ -578,13 +596,15 @@ class GPTAttention(nn.Layer):
         chunks' K/V included.  All shapes are static (C, pool, table);
         ``pos``/``true_len`` are traced scalars, so ONE XLA program
         serves every chunk of every prompt.  Pad lanes (>= true_len)
-        scatter into physical row 0 — the engine's scratch block, whose
-        content no live request ever reads.
+        scatter into the slot's SCRATCH block (``scratch``, a traced
+        scalar block id — its dp shard's reserved row; physical row 0
+        on an unsharded engine), whose content no live request ever
+        reads.
 
         x: Tensor [1, C, E]; k_pool/v_pool: [NB, bs, H, hd] arrays;
-        block_table: int32 [L//bs] (ONE slot's row); pos/true_len:
-        traced int scalars.  Returns (out Tensor [1, C, E], k_pool,
-        v_pool).
+        block_table: int32 [L//bs] (ONE slot's row); pos/true_len/
+        scratch: traced int scalars.  Returns (out Tensor [1, C, E],
+        k_pool, v_pool).
         """
         import math as _math
         import jax
@@ -604,9 +624,10 @@ class GPTAttention(nn.Layer):
         offs_safe = jnp.where(valid, offs, 0)
         if _is_quant_kv(k_pool):
             from ..serving.quant import paged_gather, paged_insert
-            # pad lanes RMW the scratch block (blk 0, row 0) — the
-            # same masking rule as the fp scatter's widx 0
-            blk = jnp.where(valid, block_table[offs_safe // bs], 0)
+            # pad lanes RMW the slot's scratch block — the same
+            # masking rule as the fp scatter's scratch widx
+            blk = jnp.where(valid, block_table[offs_safe // bs],
+                            scratch)
             off = jnp.where(valid, offs_safe % bs, 0)
             k_pool = paged_insert(k_pool, blk, off, ka[0])
             v_pool = paged_insert(v_pool, blk, off, va[0])
@@ -619,11 +640,12 @@ class GPTAttention(nn.Layer):
                                     self.head_dim)
             flat_v = v_pool.reshape(NB * bs, self.num_heads,
                                     self.head_dim)
-            # pad lanes write the scratch block's row 0 (garbage on
+            # pad lanes write the slot's scratch block (garbage on
             # garbage)
             widx = jnp.where(
                 valid,
-                block_table[offs_safe // bs] * bs + offs_safe % bs, 0)
+                block_table[offs_safe // bs] * bs + offs_safe % bs,
+                scratch * bs)
             flat_k = flat_k.at[widx].set(ka[0].astype(flat_k.dtype))
             flat_v = flat_v.at[widx].set(va[0].astype(flat_v.dtype))
             # gather the slot's whole logical [L] row (like
@@ -807,20 +829,22 @@ class GPTBlock(nn.Layer):
         return x, k_pool, v_pool
 
     def ragged_window_paged(self, x, k_pool, v_pool, block_tables, pos,
-                            width, variant="stream"):
+                            width, scratch=None, sharded=False,
+                            variant="stream"):
         """Ragged Pallas window (GPTAttention.ragged_window_paged)."""
         attn_out, k_pool, v_pool = self.attn.ragged_window_paged(
             self.ln1(x), k_pool, v_pool, block_tables, pos, width,
-            variant=variant)
+            scratch=scratch, sharded=sharded, variant=variant)
         x = x + attn_out
         x = x + self.mlp(self.ln2(x))
         return x, k_pool, v_pool
 
     def prefill_chunk_paged(self, x, k_pool, v_pool, block_table, pos,
-                            true_len):
+                            true_len, scratch=0):
         """Block-table chunked prefill (GPTAttention.prefill_chunk_paged)."""
         attn_out, k_pool, v_pool = self.attn.prefill_chunk_paged(
-            self.ln1(x), k_pool, v_pool, block_table, pos, true_len)
+            self.ln1(x), k_pool, v_pool, block_table, pos, true_len,
+            scratch=scratch)
         x = x + attn_out
         x = x + self.mlp(self.ln2(x))
         return x, k_pool, v_pool
@@ -1337,6 +1361,7 @@ class GPTModel(nn.Layer):
 
     def _ragged_window_tick_slots(self, toks, k_pools, v_pools,
                                   block_tables, pos, width,
+                                  scratch=None, sharded=False,
                                   head_lanes=None, variant="stream"):
         """RAGGED window forward over the paged slot pool: run each
         slot's ``width[b]`` real window tokens (of the static maximum
@@ -1365,7 +1390,7 @@ class GPTModel(nn.Layer):
         for j, blk in enumerate(self.blocks):
             x, kb, vb = blk.ragged_window_paged(
                 x, k_pools[j], v_pools[j], block_tables, pos, width,
-                variant=variant)
+                scratch=scratch, sharded=sharded, variant=variant)
             new_k.append(kb)
             new_v.append(vb)
         if head_lanes is not None:
@@ -1376,7 +1401,8 @@ class GPTModel(nn.Layer):
     def _fused_ragged_tick_slots(self, toks, k_pools, v_pools,
                                  block_tables, width, mode, lanes, tok,
                                  pos, temp, top_k, top_p, seed_lo,
-                                 seed_hi, ctr, eos, rem, emit_w=None,
+                                 seed_hi, ctr, eos, rem, scratch=None,
+                                 sharded=False, emit_w=None,
                                  variant="stream"):
         """FUSED ragged window + on-device sample / accept-scan /
         stop-condition epilogue — the ONE program that replaces the
@@ -1437,6 +1463,7 @@ class GPTModel(nn.Layer):
              jnp.maximum(width - 1, 0)[:, None]], axis=1)   # [B, E+1]
         logits, new_k, new_v = self._ragged_window_tick_slots(
             window, k_pools, v_pools, block_tables, pos, width,
+            scratch=scratch, sharded=sharded,
             head_lanes=head_lanes, variant=variant)    # [B, E+1, V]
         L = block_tables.shape[1] * k_pools[0].shape[1]
         picks = jnp.stack(
@@ -1526,16 +1553,21 @@ class GPTModel(nn.Layer):
                 new_rem, new_k, new_v)
 
     def _compiled_ragged_window_fn(self, pnames, params, cache_key,
-                                   emit_w=None, variant="stream"):
+                                   emit_w=None, variant="stream",
+                                   sharded=False):
         """Build (or fetch) the jitted FUSED RAGGED WINDOW dispatch
         (``Engine(attn_impl="ragged")``): (p_list, b_list, k_pools,
-        v_pools, block_tables [B, L//bs], toks [B, W], width [B],
+        v_pools, block_tables [B, L//bs], scratch [B], toks [B, W],
+        width [B],
         mode [B], lanes [B], tok [B,1], pos [B], temp [B], top_k [B],
         top_p [B], seed_lo [B], seed_hi [B], ctr [B], eos [B],
         rem [B]) -> (picks [B, min(W, emit_w)], n_acc [B], n_emit
         [B], done
         [ceil(B/8)] uint8, new_tok [B,1], new_pos [B], new_ctr [B],
-        new_rem [B], k_pools, v_pools).  The attention core is the
+        new_rem [B], k_pools, v_pools).  ``scratch`` is each slot's
+        dp shard's scratch block id (all zeros unsharded) and
+        ``sharded=True`` (a 2-D mp x dp mesh) runs the kernel under
+        shard_map.  The attention core is the
         Pallas ragged paged attention kernel (interpret mode off-TPU),
         and EVERY window shape — one-token decode, k+1 spec verify,
         C-token prefill chunk, mixed in one batch — is per-slot DATA,
@@ -1547,13 +1579,14 @@ class GPTModel(nn.Layer):
         from ..core import autograd
         from ..jit import _swapped
 
-        # emit_w and the kernel variant are baked into the compiled
-        # program (emit_w fixes the picks lane count; variant picks
-        # the stream vs gather kernel body), so they MUST distinguish
-        # cache entries — enforced here rather than trusted to every
-        # caller's key
+        # emit_w, the kernel variant, and the sharded lowering are
+        # baked into the compiled program (emit_w fixes the picks
+        # lane count; variant picks the stream vs gather kernel body;
+        # sharded picks shard_map vs plain pallas_call), so they MUST
+        # distinguish cache entries — enforced here rather than
+        # trusted to every caller's key
         cache_key = (cache_key, None if emit_w is None else int(emit_w),
-                     str(variant))
+                     str(variant), bool(sharded))
         cache = getattr(self, "_ragged_window_fn_cache", None)
         if cache is None:
             cache = self._ragged_window_fn_cache = {}
@@ -1564,9 +1597,10 @@ class GPTModel(nn.Layer):
         mbuffers = dict(self.named_buffers())
         bnames = sorted(mbuffers)
 
-        def pure(p_list, b_list, k_pools, v_pools, block_tables, toks,
-                 width, mode, lanes, tok, pos, temp, top_k, top_p,
-                 seed_lo, seed_hi, ctr, eos, rem, *lora):
+        def pure(p_list, b_list, k_pools, v_pools, block_tables,
+                 scratch, toks, width, mode, lanes, tok, pos, temp,
+                 top_k, top_p, seed_lo, seed_hi, ctr, eos, rem,
+                 *lora):
             with _swapped(params, dict(zip(pnames, p_list))), \
                     _swapped(mbuffers, dict(zip(bnames, b_list))):
                 with autograd.no_grad(), _lora_scope(lora):
@@ -1574,6 +1608,7 @@ class GPTModel(nn.Layer):
                         toks, k_pools, v_pools, block_tables, width,
                         mode, lanes, tok, pos, temp, top_k, top_p,
                         seed_lo, seed_hi, ctr, eos, rem,
+                        scratch=scratch, sharded=sharded,
                         emit_w=emit_w, variant=variant)
             return out
 
@@ -1855,12 +1890,14 @@ class GPTModel(nn.Layer):
         return self.head(Tensor(last_h))._data[:, -1, :], new_k, new_v
 
     def _chunk_prefill_tick_paged(self, toks, k_pools, v_pools,
-                                  block_table, pos, true_len):
+                                  block_table, pos, true_len,
+                                  scratch=0):
         """Paged twin of ``_chunk_prefill_tick``: the chunk's K/V
         scatters block-granular through ONE slot's block table and the
         attention context is the slot's gathered logical row (adopted
-        prefix blocks included).  Returns (last_logits [1, V], new_k,
-        new_v)."""
+        prefix blocks included).  ``scratch`` is the slot's dp
+        shard's scratch block id (traced scalar; 0 unsharded).
+        Returns (last_logits [1, V], new_k, new_v)."""
         import jax
         import jax.numpy as jnp
         pos = jnp.asarray(pos, jnp.int32)
@@ -1868,7 +1905,8 @@ class GPTModel(nn.Layer):
         new_k, new_v = [], []
         for j, blk in enumerate(self.blocks):
             x, kb, vb = blk.prefill_chunk_paged(
-                x, k_pools[j], v_pools[j], block_table, pos, true_len)
+                x, k_pools[j], v_pools[j], block_table, pos, true_len,
+                scratch=scratch)
             new_k.append(kb)
             new_v.append(vb)
         E = x.shape[-1]
@@ -1938,7 +1976,10 @@ class GPTModel(nn.Layer):
                                          cache_key):
         """Build (or fetch) the jitted PAGED chunk prefill: (p_list,
         b_list, k_pools, v_pools, ids [1, C], block_table [L//bs], pos,
-        true_len) -> (last_logits [1, V], k_pools, v_pools).  The
+        true_len, scratch) -> (last_logits [1, V], k_pools, v_pools).
+        ``scratch`` (traced scalar) is the slot's dp shard's scratch
+        block id — pad lanes park there, never in another shard's
+        rows.  The
         block-table twin of ``_compiled_chunk_prefill_fn``
         (``_chunk_prefill_tick_paged``): every shape is static and
         pos/true_len are traced, so ONE program serves every chunk —
@@ -1960,14 +2001,14 @@ class GPTModel(nn.Layer):
         bnames = sorted(mbuffers)
 
         def pure(p_list, b_list, k_pools, v_pools, ids_arr, block_table,
-                 pos, true_len, *lora):
+                 pos, true_len, scratch, *lora):
             with _swapped(params, dict(zip(pnames, p_list))), \
                     _swapped(mbuffers, dict(zip(bnames, b_list))):
                 with autograd.no_grad(), _lora_scope(lora):
                     last, new_k, new_v = \
                         model._chunk_prefill_tick_paged(
                             ids_arr, k_pools, v_pools, block_table,
-                            pos, true_len)
+                            pos, true_len, scratch=scratch)
             return last, new_k, new_v
 
         fn = jax.jit(pure, donate_argnums=(2, 3))

@@ -61,9 +61,26 @@ marker.
 
 K/V WRITES stay outside the kernel (the callers' width-masked scatter
 — see ``GPTAttention.ragged_window_paged``): lanes past ``width[b]``
-land in physical row 0, the engine's scratch block, which is how the
-scratch-block and spec-margin invariants documented in
-serving/kvcache.py move from per-path code into one masking rule.
+land in the slot's own dp shard's SCRATCH block (physical row 0 on an
+unsharded engine), which is how the scratch-block and spec-margin
+invariants documented in serving/kvcache.py move from per-path code
+into one masking rule.
+
+SHARDED LOWERING (``sharded_ragged_paged_attention``): GSPMD cannot
+partition a Mosaic-path ``pallas_call`` (the non-interpret TPU
+lowering is opaque to the SPMD partitioner), so a 2-D ``(mp, dp)``
+serving mesh runs the kernel under ``shard_map``: each mesh shard
+executes its OWN grid over its ``B/dp`` slots, with the head axis
+pre-sliced per 'mp' shard and each dp shard holding its contiguous
+range of pool rows.  Per-slot ``(pos, width, block_table)`` stay
+DATA — tables carry global block ids and the wrapper localizes them
+by subtracting the shard's row offset (``axis_index('dp') *
+blocks_per_shard``), which is exact because the engine's admission
+gate only ever hands a slot blocks from its own shard's range.
+Under interpret mode on the forced CPU mesh this partitions
+identically to what a real Mosaic TPU run would lower, and it is
+asserted token-identical to the GSPMD-partitioned XLA oracle across
+the serving layout matrix (tests/test_sharded_serving.py).
 """
 from __future__ import annotations
 
@@ -355,3 +372,101 @@ def ragged_paged_attention(q, k_flat, v_flat, block_tables, pos, width,
         jnp.asarray(pos, jnp.int32), jnp.asarray(width, jnp.int32),
         block_size=int(block_size), interpret=bool(interpret),
         k_scale=k_scale, v_scale=v_scale)
+
+
+def sharded_ragged_paged_attention(q, k_flat, v_flat, block_tables,
+                                   pos, width, *, block_size,
+                                   mesh=None, interpret=None,
+                                   k_scale=None, v_scale=None,
+                                   variant="stream"):
+    """``shard_map``-partitioned ragged paged attention over a 2-D
+    ``(mp, dp)`` serving mesh (module docstring, SHARDED LOWERING).
+
+    Same contract as ``ragged_paged_attention`` plus ``mesh`` (a jax
+    Mesh with 'mp'/'dp' axes; defaults to the process-global serving
+    mesh, ``distributed.mesh.get_mesh()``).  Each mesh shard runs its
+    own kernel grid over the ``B/dp`` slots it owns:
+
+    * q [B, W, H, hd] shards ``P('dp', None, 'mp', None)`` — slot rows
+      over 'dp', whole heads pre-sliced over 'mp';
+    * k_flat/v_flat [NB*bs, H, hd] shard ``P('dp', 'mp', None)`` —
+      each dp shard's contiguous pool-row range, its heads' slice;
+    * block_tables [B, L//bs] shard ``P('dp', None)`` and carry GLOBAL
+      block ids — the body localizes them by subtracting
+      ``axis_index('dp') * blocks_per_shard`` (exact: the engine's
+      admission gate allocates a slot's blocks only from its own
+      shard's range, serving/kvcache.py BlockPool(shards=...));
+    * pos/width [B] shard ``P('dp')``; scales [NB, H] shard
+      ``P('dp', 'mp')``.
+
+    The per-shard body is the UNchanged kernel — the partitioning
+    this wrapper hand-writes is exactly what interpret mode's HLO
+    lowering lets GSPMD derive, which is what the dp parity tests
+    pin; on TPU it is the only way to run the Mosaic kernel on a
+    mesh at all.  Output shards like q.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax.experimental.shard_map import shard_map
+    except ImportError:  # newer jax: promoted out of experimental
+        from jax import shard_map
+    if mesh is None:
+        from ..distributed import mesh as mesh_mod
+        mesh = mesh_mod.get_mesh()
+    if mesh is None:
+        raise ValueError(
+            "sharded_ragged_paged_attention needs a mesh: pass mesh=..."
+            " or set the process-global serving mesh "
+            "(distributed.mesh.set_mesh / Engine(mesh=...))")
+    dp = int(mesh.shape.get("dp", 1))
+    mp = int(mesh.shape.get("mp", 1))
+    B, W, H, hd = q.shape
+    rows = k_flat.shape[0]
+    bs = int(block_size)
+    if B % dp or (rows // bs) % dp:
+        raise ValueError(
+            f"sharded ragged kernel: B={B} slots and "
+            f"{rows // bs} pool blocks must both divide by the mesh's "
+            f"dp degree ({dp})")
+    if H % mp:
+        raise ValueError(
+            f"sharded ragged kernel: H={H} heads must divide by the "
+            f"mesh's mp degree ({mp}) — attention shards whole heads")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError(
+            "sharded_ragged_paged_attention: pass both k_scale and "
+            "v_scale (quantized pools) or neither (fp pools)")
+    quant = k_scale is not None
+    if interpret is None:
+        interpret = _auto_interpret()
+    interpret = bool(interpret)
+
+    def body(q_l, k_l, v_l, tables_l, pos_l, width_l, *scales):
+        # tables hold GLOBAL block ids; this shard's pool slice starts
+        # at row offset axis_index('dp') * blocks_per_shard
+        nb_local = k_l.shape[0] // bs
+        local = tables_l - jax.lax.axis_index("dp") * nb_local
+        ks, vs = scales if scales else (None, None)
+        return ragged_paged_attention(
+            q_l, k_l, v_l, local, pos_l, width_l, block_size=bs,
+            interpret=interpret, k_scale=ks, v_scale=vs,
+            variant=variant)
+
+    qspec = P("dp", None, "mp", None)
+    kvspec = P("dp", "mp", None)
+    in_specs = [qspec, kvspec, kvspec, P("dp", None), P("dp"),
+                P("dp")]
+    args = [q, k_flat, v_flat,
+            jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(pos, jnp.int32),
+            jnp.asarray(width, jnp.int32)]
+    if quant:
+        in_specs += [P("dp", "mp"), P("dp", "mp")]
+        args += [jnp.asarray(k_scale, jnp.float32),
+                 jnp.asarray(v_scale, jnp.float32)]
+    fn = shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                   out_specs=qspec, check_rep=False)
+    return fn(*args)

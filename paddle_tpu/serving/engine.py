@@ -584,25 +584,34 @@ class Engine:
             else str(self._kv_dtype)
         self._weight_dtype_str = "int8" if self._weight_quant \
             else str(self._kv_dtype)
-        # -- tensor-parallel serving mesh (mesh=...) -------------------
-        # ``mesh`` accepts an int / 1-tuple mp degree (resolved via
-        # distributed.mesh.serving_mesh over the first mp devices) or a
-        # prebuilt jax Mesh.  With mp > 1 the model must be the
-        # einsum-form tensor-parallel variant (GPTModel(use_mp=True),
-        # or a dense checkpoint's ``to_tensor_parallel()`` twin): its
-        # parameters carry 'mp' PartitionSpecs, and placing params +
-        # KV pools sharded makes every existing jitted dispatch
-        # compile ONCE PER CONFIG with the sharding baked into the
-        # program — GSPMD splits attention heads / FFN / vocab and
-        # inserts the psum/all-gather collectives; the host-side tick
-        # protocol (replicated cursors, [B]-id downloads, the 17 B
-        # steady-state d2h) is unchanged.
+        # -- 2-D (mp, dp) serving mesh (mesh=...) ----------------------
+        # ``mesh`` accepts an int mp degree, an (mp,) or (mp, dp)
+        # tuple (resolved via distributed.mesh.serving_mesh over the
+        # first mp*dp devices), or a prebuilt jax Mesh.  With mp > 1
+        # the model must be the einsum-form tensor-parallel variant
+        # (GPTModel(use_mp=True), or a dense checkpoint's
+        # ``to_tensor_parallel()`` twin): its parameters carry 'mp'
+        # PartitionSpecs, and placing params + KV pools sharded makes
+        # every existing jitted dispatch compile ONCE PER CONFIG with
+        # the sharding baked into the program — GSPMD splits attention
+        # heads / FFN / vocab and inserts the psum/all-gather
+        # collectives.  With dp > 1 the BATCH shards: each dp shard
+        # owns num_slots/dp slot rows of every [B]-leading cursor
+        # array, the block tables, and a contiguous range of the KV
+        # block pool rows (params replicate over 'dp'), so one
+        # compiled program spans both axes — dp multiplies concurrent
+        # slots the way mp multiplies per-block capacity.  The
+        # host-side tick protocol (host mirrors, [B]-id downloads,
+        # the 17 B steady-state d2h) is unchanged.
         self.mesh = None
         self.mp = 1
+        self.dp = 1
         self.mesh_axes = None
         self._repl_sharding = None
         self._kv_sharding = None
         self._kv_scale_sharding = None
+        self._state_sharding = None
+        self._table_sharding = None
         self._kv_block_bytes_per_shard = None
         self._kv_code_bytes_per_shard = None
         self._kv_scale_bytes_per_shard = None
@@ -614,29 +623,33 @@ class Engine:
             if isinstance(mesh, (int, np.integer)):
                 mesh = mesh_mod.serving_mesh(int(mesh))
             elif isinstance(mesh, (tuple, list)):
-                if len(mesh) != 1:
+                if len(mesh) not in (1, 2):
                     raise ValueError(
-                        f"mesh shape must be (mp,), got {tuple(mesh)}"
-                        " — the serving engine shards over one "
-                        "tensor-parallel axis")
-                mesh = mesh_mod.serving_mesh(int(mesh[0]))
+                        f"mesh shape must be (mp,) or (mp, dp), got "
+                        f"{tuple(mesh)} — the serving engine shards "
+                        "over a tensor-parallel and a data-parallel "
+                        "axis")
+                mesh = mesh_mod.serving_mesh(
+                    int(mesh[0]),
+                    int(mesh[1]) if len(mesh) == 2 else 1)
             elif not isinstance(mesh, Mesh):
                 raise ValueError(
-                    f"mesh must be an int mp degree, an (mp,) tuple, "
-                    f"or a jax Mesh, got {type(mesh).__name__}")
+                    f"mesh must be an int mp degree, an (mp,) / "
+                    f"(mp, dp) tuple, or a jax Mesh, got "
+                    f"{type(mesh).__name__}")
             self.mesh = mesh
             self.mp = int(mesh.shape.get("mp", 1))
+            self.dp = int(mesh.shape.get("dp", 1))
             extra = {k: int(v) for k, v in mesh.shape.items()
-                     if k != "mp" and int(v) > 1}
+                     if k not in ("mp", "dp") and int(v) > 1}
             if extra:
-                # a dp/pp/... axis would silently REPLICATE params and
+                # a pp/sp/... axis would silently REPLICATE params and
                 # KV pools across it (the serving specs only name
-                # 'mp') — mp x dp serving is future work, not a
-                # silent 2x HBM tax
+                # 'mp' and 'dp') — not a silent HBM tax
                 raise ValueError(
-                    f"serving mesh must shard only the 'mp' axis; got"
-                    f" extra axes {extra} — build one with "
-                    "distributed.mesh.serving_mesh(mp)")
+                    f"serving mesh must shard only the 'mp' and 'dp' "
+                    f"axes; got extra axes {extra} — build one with "
+                    "distributed.mesh.serving_mesh(mp, dp)")
             self.mesh_axes = ({k: int(v) for k, v in mesh.shape.items()
                                if int(v) > 1} or {"mp": 1})
             if self.mp > 1:
@@ -653,25 +666,38 @@ class Engine:
                         f"num_heads ({self._nh}) must divide by the "
                         f"mesh's mp degree ({self.mp}) — attention "
                         "shards whole heads")
+            if self.dp > 1 and self.num_slots % self.dp:
+                raise ValueError(
+                    f"num_slots ({self.num_slots}) must divide by the "
+                    f"mesh's dp degree ({self.dp}) — each dp shard "
+                    "owns an equal contiguous range of batch slots")
+            if self.mp * self.dp > 1:
                 # the TP layers' activation sharding constraints
                 # (distributed/sharding.py _constraint) read the
-                # process-global mesh; one sharded engine per process
-                # owns it (sibling UNSHARDED engines are unaffected —
-                # dense models carry no constraints)
+                # process-global mesh, and the shard_map-wrapped
+                # ragged kernel discovers its mesh the same way; one
+                # sharded engine per process owns it (sibling
+                # UNSHARDED engines are unaffected — dense models
+                # carry no constraints and the unsharded kernel path
+                # never consults the mesh)
                 mesh_mod.set_mesh(mesh)
-            self._repl_sharding = NamedSharding(mesh, PartitionSpec())
-            # the head axis is index 2 in BOTH KV layouts
-            # ([B, L, H, hd] contiguous, [NB, bs, H, hd] paged), so
-            # one spec shards each device's pool slice to its heads
-            self._kv_sharding = NamedSharding(
-                mesh, PartitionSpec(None, None, "mp", None))
-            # quantized pools' parallel scale pool is [NB, H]: the
-            # head axis shards with its blocks' heads
-            self._kv_scale_sharding = NamedSharding(
-                mesh, PartitionSpec(None, "mp"))
+            # the canonical serving layout table lives in
+            # distributed/sharding.py (SERVING_SPECS) so the engine,
+            # the shard_map-wrapped ragged kernel, and the tests
+            # agree on one source of truth; specs name 'dp' even at
+            # dp == 1 (a size-1 axis), so the program shape is
+            # uniform across layouts
+            from ..distributed.sharding import serving_sharding
+            self._repl_sharding = serving_sharding(mesh, "replicated")
+            self._kv_sharding = serving_sharding(mesh, "kv")
+            self._kv_scale_sharding = serving_sharding(mesh,
+                                                       "kv_scale")
+            self._state_sharding = serving_sharding(mesh, "state")
+            self._table_sharding = serving_sharding(mesh, "table")
             # place params per their TP PartitionSpecs (replicated
-            # when none): every compiled dispatch then sees sharded
-            # weight inputs and GSPMD partitions the program
+            # when none — and always replicated over 'dp'): every
+            # compiled dispatch then sees sharded weight inputs and
+            # GSPMD partitions the program
             for _, p in model.named_parameters():
                 spec = getattr(p, "partition_spec", None)
                 sh = (NamedSharding(mesh, spec) if spec is not None
@@ -827,21 +853,33 @@ class Engine:
                     raise ValueError(
                         "kv_budget_mb and kv_blocks are two answers to"
                         " one question (pool size) — pass one")
-                managed = int(self._kv_budget_mb * 2 ** 20
-                              // self._kv_block_bytes_per_shard)
+                # per-chip budget -> per-dp-shard block count; every
+                # dp shard owns its own pool range, so the managed
+                # total scales dp x on top of the mp x that the
+                # smaller per-shard block bytes already buy: capacity
+                # scales mp*dp at a fixed per-chip HBM budget
+                managed = self.dp * int(
+                    self._kv_budget_mb * 2 ** 20
+                    // self._kv_block_bytes_per_shard)
             else:
                 managed = (self.num_slots * self._bps
                            if kv_blocks is None else int(kv_blocks))
-            if managed < self._bps:
+                # the dp shard ranges must be equal; round an explicit
+                # kv_blocks UP so capacity is never silently reduced
+                managed += -managed % self.dp
+            if managed // self.dp < self._bps:
                 # blame the knob the caller actually turned
                 src = (f"kv_budget_mb={self._kv_budget_mb:g} "
-                       f"(-> {managed} blocks at "
+                       f"(-> {managed // self.dp} blocks at "
                        f"{self._kv_block_bytes_per_shard} B/block/"
                        "shard)" if kv_budget_mb is not None
-                       else f"kv_blocks={managed}")
+                       else f"kv_blocks={managed}"
+                       + (f" (/{self.dp} dp shards)"
+                          if self.dp > 1 else ""))
                 raise ValueError(
                     f"{src} cannot hold even one max-length request "
-                    f"({self._bps} blocks)")
+                    f"({self._bps} blocks"
+                    + (" per dp shard)" if self.dp > 1 else ")"))
             self._kv_managed = managed
             self._prefix_enabled = bool(prefix_cache)
         elif kv_budget_mb is not None:
@@ -972,8 +1010,7 @@ class Engine:
         self._m_slots.set(self.num_slots)
         self._m_mesh = reg.gauge(
             "serving.mesh_devices", "devices in this engine's serving "
-            "mesh (tensor-parallel shards; 1 = unsharded single "
-            "device)")
+            "mesh (mp x dp shards; 1 = unsharded single device)")
         self._m_mesh.set(self.mesh.size if self.mesh is not None else 1)
         self._m_tokens = reg.counter(
             "serving.tokens_total", "generated tokens")
@@ -1260,6 +1297,13 @@ class Engine:
                 zeros, out_shardings=out_sh)
         return fn()
 
+    def _slot_shard(self, i):
+        """The dp mesh shard that owns batch slot ``i``: slots divide
+        into ``dp`` contiguous ranges of ``num_slots/dp`` rows,
+        matching the ``P('dp', ...)`` sharding of every [B]-leading
+        device array (always 0 when dp == 1)."""
+        return int(i) // (self.num_slots // self.dp)
+
     def _reset_pools(self):
         """(Re)allocate the per-layer K/V pools and per-slot step
         state.  Also the failure-recovery path: a decode dispatch that
@@ -1270,12 +1314,17 @@ class Engine:
         they described."""
         import jax.numpy as jnp
         if self._paged:
-            # +1: physical row 0 is the scratch block parked (inactive)
-            # slots read/write through — their garbage compute may not
-            # touch a block some live request owns
-            shape = (self._kv_managed + 1, self._bs, self._nh, self._hd)
+            # +dp: each dp shard's pool range leads with one reserved
+            # scratch block that its parked (inactive) slots read and
+            # write through — their garbage compute may not touch a
+            # block some live request owns, and under shard_map a
+            # slot can only address rows inside its OWN shard's range
+            # (dp == 1: one scratch block, physical row 0, as before)
+            shape = (self._kv_managed + self.dp, self._bs, self._nh,
+                     self._hd)
             self.block_pool = BlockPool(
-                self._kv_managed + 1, self._bs, reserved_blocks=1,
+                self._kv_managed + self.dp, self._bs,
+                reserved_blocks=1, shards=self.dp,
                 # chaos-harness hook: a scheduled "pool_exhaust" tick
                 # turns this alloc into NoFreeBlocks (no-op when no
                 # injector is attached)
@@ -1289,8 +1338,15 @@ class Engine:
             # (step-failure recovery re-allocates) — drop, don't flush
             self._offload_pending = []
             self._offload_pending_keys = set()
-            self._block_tables = np.zeros((self.num_slots, self._bps),
-                                          np.int32)
+            # per-slot scratch row: slot i belongs to dp shard
+            # i // (num_slots/dp) and parks on THAT shard's reserved
+            # row (all zeros at dp == 1); a parked/padded table entry
+            # is this row, never a literal 0
+            self._slot_scratch = np.asarray(
+                [self.block_pool.scratch_row(self._slot_shard(i))
+                 for i in range(self.num_slots)], np.int32)
+            self._block_tables = np.repeat(
+                self._slot_scratch[:, None], self._bps, axis=1).copy()
             self._slot_blocks = [[] for _ in range(self.num_slots)]
         else:
             shape = (self.num_slots, self.max_seq_len, self._nh,
@@ -2301,12 +2357,17 @@ class Engine:
             raise ValueError(
                 f"migration payload geometry {got} does not match "
                 f"this engine ({want}): adopting nothing")
-        short = n - self.block_pool.free_count()
+        # adopted blocks land in ONE dp shard (the trie is per-shard
+        # so the whole run stays block-local): pick the emptiest
+        shard = max(range(self.dp),
+                    key=lambda d: self.block_pool.free_count(d))
+        short = n - self.block_pool.free_count(shard)
         if short > 0:
-            evicted = self.prefix_cache.evict(short)
+            evicted = self.prefix_cache.evict(short, shard=shard)
             if evicted:
                 self._m_prefix_evictions.inc(len(evicted))
-        blocks = self.block_pool.alloc(n)  # may raise NoFreeBlocks
+        blocks = self.block_pool.alloc(n, shard=shard)
+        #   may raise NoFreeBlocks
         try:
             self._fault("migrate_import")
             with tr.span("migrate.import", cat="serving", blocks=n):
@@ -2739,6 +2800,7 @@ class Engine:
                 "max_context_len": self._max_context_len,
                 "mesh_shape": self.mesh_axes,
                 "mp": self.mp,
+                "dp": self.dp,
                 "kv_block_bytes_per_shard":
                     self._kv_block_bytes_per_shard,
                 "weight_dtype": self._weight_dtype_str,
@@ -2808,7 +2870,7 @@ class Engine:
             pass
 
     # -- paged KV cache (serving/kvcache.py) ---------------------------
-    def _kv_gate(self, req):
+    def _kv_gate(self, req, slot):
         """Paged admission gate — the scheduler consults it before
         binding a slot.  Matches the prompt against the prefix cache
         (adopting the shared span's blocks), then reserves every block
@@ -2817,6 +2879,12 @@ class Engine:
         Under pressure, LRU-evicts unreferenced cached prefixes; if the
         pool still cannot cover the non-shared span, returns False and
         the request waits at the queue head.
+
+        Data-parallel meshes: every lookup/eviction/reservation here
+        is scoped to the BINDING SLOT's dp shard — the slot can only
+        gather rows inside its own shard's pool range, so a prefix
+        cached by another shard is invisible to it and the blocks
+        must come from its own range.
 
         Speculative decoding widens the worst case by ``spec_k``: the
         verify window writes rejected-lane K/V up to spec_k positions
@@ -2831,6 +2899,7 @@ class Engine:
         to the prefix cache match here, which is what makes resume a
         cursor-and-refcount operation instead of a re-prefill."""
         tokens = req.context
+        shard = self._slot_shard(slot.index)
         s = len(tokens)
         n_total = -(-(s + req.remaining + (self._spec_k or 0))
                     // self._bs)
@@ -2839,19 +2908,19 @@ class Engine:
             # adapter lanes never share cached K/V: LoRA on out_proj
             # shifts the residual stream, so layers >= 1 K/V depend
             # on the adapter — a base-lane prefix would be wrong
-            ctx, m = self.prefix_cache.match(tokens)
+            ctx, m = self.prefix_cache.match(tokens, shard=shard)
         need = n_total - len(ctx)
-        short = need - self.block_pool.free_count()
+        short = need - self.block_pool.free_count(shard)
         if short > 0 and self.prefix_cache is not None:
-            evicted = self.prefix_cache.evict(short)
+            evicted = self.prefix_cache.evict(short, shard=shard)
             if evicted:
                 self._m_prefix_evictions.inc(len(evicted))
-        if need > self.block_pool.free_count():
+        if need > self.block_pool.free_count(shard):
             self.block_pool.decref(ctx)  # the cache keeps its own refs
             self._gate_declined = True   # preemption probe: the head
             #   is being held back by blocks, not by slots
             return False
-        fresh = self.block_pool.alloc(need)
+        fresh = self.block_pool.alloc(need, shard=shard)
         if self.host_store is not None and not req._adapter_id:
             # second tier: the device trie answered first, the host
             # store restores the consecutive continuation (if any)
@@ -2872,7 +2941,7 @@ class Engine:
             return
         self.block_pool.decref(self._slot_blocks[i])
         self._slot_blocks[i] = []
-        self._block_tables[i, :] = 0
+        self._block_tables[i, :] = self._slot_scratch[i]
 
     def _bind_kv_plan(self, slot):
         """Install the admission gate's block reservation
@@ -2885,7 +2954,9 @@ class Engine:
         i = slot.index
         blocks = ctx + fresh
         self._slot_blocks[i] = blocks
-        row = np.zeros(self._bps, np.int32)  # scratch-padded tail
+        # scratch-padded tail: the pad is the slot's OWN dp shard's
+        # scratch row (row 0 at dp == 1)
+        row = np.full(self._bps, self._slot_scratch[i], np.int32)
         row[:len(blocks)] = blocks
         self._block_tables[i] = row
         if m:
@@ -2907,8 +2978,10 @@ class Engine:
         nullifies them (``codes * 0 = 0``) without touching the code
         pool — unwritten rows then read exactly 0.0, masked by the
         same causal-position rule that hides fp stale garbage.  The
-        index vector is padded to ``_bps`` with the scratch block
-        (row 0, whose scale no live request reads), so ONE compiled
+        index vector is padded to ``_bps`` by REPEATING the first
+        fresh block (an idempotent re-zero that stays inside the
+        reserving slot's own dp shard — a cross-shard pad row would
+        be unaddressable once the tables go data-parallel), so ONE compiled
         program serves every admission regardless of reservation
         size — the no-retracing rule of the paged hot paths."""
         import jax
@@ -2927,7 +3000,7 @@ class Engine:
 
             fn = self._zero_scale_fn = jax.jit(
                 zero, donate_argnums=(0, 1))
-        pad = np.zeros(self._bps, np.int32)
+        pad = np.full(self._bps, fresh[0], np.int32)
         pad[:len(fresh)] = fresh
         self.k_pools, self.v_pools = fn(
             self.k_pools, self.v_pools, jnp.asarray(pad))
@@ -3038,17 +3111,23 @@ class Engine:
         # transfer would intermittently capture the POST-chunk cursor
         # as the pre-state (observed as nondeterministic corruption)
         if self._repl_sharding is not None:
-            # mesh-sharded engine: cursors and block tables replicate
-            # to EVERY shard explicitly (an uncommitted single-device
-            # upload would make the first dispatch re-replicate them);
-            # the replication is a cross-shard barrier, traced as
-            # shard.sync so its cost is visible in trace_view --wall
+            # mesh-sharded engine: every [num_slots]-leading cursor
+            # row-shards over 'dp' (each dp shard owns ITS slots'
+            # cursors and block-table rows; at dp == 1 the spec
+            # degenerates to replication over 'mp') — an uncommitted
+            # single-device upload would make the first dispatch
+            # re-shard them.  The placement is a cross-shard barrier,
+            # traced as shard.sync so its cost is visible in
+            # trace_view --wall
             import jax
+            state_sh = self._state_sharding or self._repl_sharding
 
             def put(a):
-                return jax.device_put(a.copy(), self._repl_sharding)
-            sync = (self.tracer.span("shard.sync", shards=self.mp)
-                    if self.mp > 1 else nullcontext())
+                return jax.device_put(a.copy(), state_sh)
+            sync = (self.tracer.span("shard.sync",
+                                     shards=self.mp * self.dp,
+                                     mp=self.mp, dp=self.dp)
+                    if self.mp * self.dp > 1 else nullcontext())
         else:
             def put(a):
                 return jnp.asarray(a.copy())
@@ -3064,6 +3143,11 @@ class Engine:
                 self._dev_state["aid"] = put(self._aid)
             if self._paged:
                 self._dev_state["tables"] = put(self._block_tables)
+                # per-slot scratch block ids (constant per engine
+                # config, but rides the state dict so the ragged
+                # dispatch signature stays uniform): masked/parked
+                # lanes park in their OWN dp shard's scratch row
+                self._dev_state["scratch"] = put(self._slot_scratch)
         self._state_dirty = False
 
     def _prefill_paged(self, slot):
@@ -3214,7 +3298,7 @@ class Engine:
                 fn, _, _ = self.model._compiled_paged_chunk_prefill_fn(
                     self._pnames, self._params,
                     self._lora_key(
-                        (C, self._kv_managed + 1, self._bs, self._bps,
+                        (C, self._kv_managed + self.dp, self._bs, self._bps,
                          self._kv_dtype_str, tuple(self._pnames),
                          self._bnames_all)))
                 last0, self.k_pools, self.v_pools = fn(
@@ -3223,6 +3307,7 @@ class Engine:
                     jnp.asarray(self._block_tables[i]),
                     jnp.asarray(p0, jnp.int32),
                     jnp.asarray(n, jnp.int32),
+                    jnp.asarray(int(self._slot_scratch[i]), jnp.int32),
                     *self._lora_args_slot(req))
             else:
                 fn, _, _ = self.model._compiled_chunk_prefill_fn(
@@ -3469,7 +3554,7 @@ class Engine:
             self._spec_fn, _, _ = self.model._compiled_spec_verify_fn(
                 self._pnames, self._params,
                 ("paged" if self._paged else "slot", W, self.num_slots,
-                 (self._kv_managed + 1, self._bs) if self._paged
+                 (self._kv_managed + self.dp, self._bs) if self._paged
                  else self.max_seq_len, self._kv_dtype_str,
                  tuple(self._pnames), self._bnames_all),
                 paged=self._paged)
@@ -3577,7 +3662,7 @@ class Engine:
                     self._lora_key(
                         ("paged" if self._paged else "slot", W,
                          self.num_slots,
-                         (self._kv_managed + 1, self._bs) if self._paged
+                         (self._kv_managed + self.dp, self._bs) if self._paged
                          else self.max_seq_len, self._kv_dtype_str,
                          tuple(self._pnames), self._bnames_all)),
                     paged=self._paged)
@@ -3715,7 +3800,7 @@ class Engine:
                 self._pnames, self._params,
                 self._lora_key(
                     ("paged" if self._paged else "slot", self.num_slots,
-                     (self._kv_managed + 1, self._bs) if self._paged
+                     (self._kv_managed + self.dp, self._bs) if self._paged
                      else self.max_seq_len, self._kv_dtype_str,
                      tuple(self._pnames), self._bnames_all)),
                 paged=self._paged)
@@ -3894,10 +3979,11 @@ class Engine:
                     self._pnames, self._params,
                     self._lora_key(
                         (self.num_slots, W, spec_w,
-                         self._kv_managed + 1, self._bs,
+                         self._kv_managed + self.dp, self._bs,
                          self._kv_dtype_str, tuple(self._pnames),
                          self._bnames_all)),
-                    emit_w=spec_w, variant=variant)
+                    emit_w=spec_w, variant=variant,
+                    sharded=self.mp * self.dp > 1)
         self._fault("dispatch")
         span_name = "decode.ragged_stream" if variant == "stream" \
             else "decode.ragged"
@@ -3909,7 +3995,8 @@ class Engine:
             (picks, n_acc, n_emit, done, new_tok, new_pos, new_ctr,
              new_rem, self.k_pools, self.v_pools) = self._ragged_fn(
                 self._p_list(), self._b_list(), self.k_pools,
-                self.v_pools, st["tables"], jnp.asarray(toks),
+                self.v_pools, st["tables"], st["scratch"],
+                jnp.asarray(toks),
                 jnp.asarray(width), jnp.asarray(mode),
                 jnp.asarray(lanes), st["tok"], st["pos"], st["temp"],
                 st["topk"], st["topp"], st["slo"], st["shi"],
@@ -4022,16 +4109,18 @@ class Engine:
         # engine's real sync point) until the watchdog converts it
         # into a WatchdogTimeout raise -> step-failure recovery
         self._fault("d2h_hang")
-        if self.mp > 1:
-            # sharded tick: the [B] ids / picks are replicated OUTPUTS
-            # of a vocab-parallel head — the device finishes the psum
-            # + all-gather collectives before the handles are ready.
-            # Block on compute completion FIRST under its own span so
-            # cross-shard collective time is attributed to
+        if self.mp * self.dp > 1:
+            # sharded tick: the [B] ids / picks are OUTPUTS of a
+            # vocab-parallel head (replicated over 'mp' by its psum +
+            # all-gather) and row-sharded over 'dp' — the device
+            # finishes the cross-shard collectives before the handles
+            # are ready.  Block on compute completion FIRST under its
+            # own span so collective time is attributed to
             # decode.allgather, and the d2h span below measures the
             # (tiny, unchanged-contract) host copy alone.
             with tr.span("decode.allgather", tick=inf.tick,
-                         shards=self.mp):
+                         shards=self.mp * self.dp, mp=self.mp,
+                         dp=self.dp):
                 for v in inf.arrays.values():
                     v.block_until_ready()
         t0 = time.monotonic()
@@ -4103,7 +4192,7 @@ class Engine:
                 self._tick_fn, _, _ = \
                     self.model._compiled_slot_paged_decode_fn(
                         self._pnames, self._params,
-                        (self.num_slots, self._kv_managed + 1, self._bs,
+                        (self.num_slots, self._kv_managed + self.dp, self._bs,
                          self._kv_dtype_str, tuple(self._pnames),
                          self._bnames_all))
             else:

@@ -279,16 +279,20 @@ class _Handler(JsonHandler):
                 # decoded) any request has reached on this replica
                 "max_context_len": getattr(
                     eng, "_max_context_len", 0),
-                # tensor-parallel mesh surface: the router registry
-                # carries these so a fleet view (and timeline.py
-                # --router) can label sharded replicas; kv blocks are
-                # head-sliced UNIFORMLY across shards, so the
-                # per-shard free list is the logical free count on
-                # every shard
+                # mesh surface: the router registry carries these so
+                # a fleet view (and timeline.py --router) can label
+                # sharded replicas with the full (mp, dp) shape; kv
+                # blocks are head-sliced UNIFORMLY across mp shards
+                # (same logical free count on each), while dp shards
+                # own DISJOINT slot/block ranges and can drain
+                # independently — so the free list enumerates each dp
+                # shard's own count, repeated per mp shard
                 "mesh_shape": getattr(eng, "mesh_axes", None),
                 "mp": getattr(eng, "mp", 1),
+                "dp": getattr(eng, "dp", 1),
                 "kv_blocks_free_per_shard": (
-                    [eng.block_pool.free_count()]
+                    [eng.block_pool.free_count(d)
+                     for d in range(getattr(eng, "dp", 1))]
                     * getattr(eng, "mp", 1)
                     if getattr(eng, "_paged", False) else None),
                 "kv_block_bytes_per_shard": getattr(
@@ -1038,6 +1042,10 @@ def main(argv=None):
     p.add_argument("--mp", type=int, default=1,
                    help="tensor-parallel degree: shard the model + KV"
                         " pools over a mesh of this many devices")
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel degree: shard batch slots (and"
+                        " their KV block ranges) over this many mesh"
+                        " rows — total devices = mp * dp")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--seed", type=int, default=0,
@@ -1099,9 +1107,10 @@ def main(argv=None):
     model = GPTModel.from_config(args.config, dropout=0.0)
     model.eval()
     mesh = None
-    if args.mp > 1:
-        model = model.to_tensor_parallel()
-        mesh = args.mp
+    if args.mp > 1 or args.dp > 1:
+        if args.mp > 1:
+            model = model.to_tensor_parallel()
+        mesh = (args.mp, args.dp)
     engine = Engine(model, num_slots=args.num_slots,
                     max_seq_len=args.max_seq_len,
                     kv_block_size=args.kv_block_size,
@@ -1123,8 +1132,8 @@ def main(argv=None):
                        role=args.role, incarnation=args.incarnation,
                        peers=args.peer,
                        drain_grace_s=args.drain_grace).start()
-    print(f"serving {args.config} mp={args.mp} on {srv.address}",
-          flush=True)
+    print(f"serving {args.config} mp={args.mp} dp={args.dp} "
+          f"on {srv.address}", flush=True)
     try:
         while not stop_evt.wait(0.2):
             if not srv._http_thread.is_alive():

@@ -336,49 +336,90 @@ class BlockPool:
     raising ``NoFreeBlocks`` on scheduled ticks so recovery paths are
     exercised against pool pressure that composes with other
     failures.  None (default) costs nothing.
+
+    ``shards`` (data-parallel serving, ``Engine(mesh=(mp, dp))``): the
+    pool rows divide into ``shards`` CONTIGUOUS equal ranges, one per
+    'dp' mesh shard — shard ``d`` owns global rows ``[d*rps,
+    (d+1)*rps)`` where ``rps = num_blocks // shards`` — and every
+    range reserves its own ``reserved_blocks`` leading rows (shard
+    ``d``'s scratch row is ``scratch_row(d) = d*rps``), so a parked
+    slot's masked writes stay INSIDE its own shard's pool slice (the
+    shard_map kernel instance cannot address another shard's rows).
+    ``alloc(n, shard=d)`` draws only from shard ``d``'s free list and
+    ``decref`` returns a freed block to its OWN shard; a block never
+    migrates between shards because the device pool is physically
+    split at exactly these row boundaries.  ``shards=1`` (default) is
+    bit-identical to the unsharded pool.
     """
 
     def __init__(self, num_blocks, block_size, reserved_blocks=0,
-                 fault_hook=None):
+                 fault_hook=None, shards=1):
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
-        if num_blocks - reserved_blocks < 1:
+        shards = int(shards)
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        if num_blocks % shards:
             raise ValueError(
-                f"pool needs at least one allocatable block "
-                f"({num_blocks} total, {reserved_blocks} reserved)")
+                f"num_blocks ({num_blocks}) must divide into {shards} "
+                "equal dp shard ranges")
+        rps = num_blocks // shards
+        if rps - reserved_blocks < 1:
+            raise ValueError(
+                f"pool needs at least one allocatable block per shard "
+                f"({num_blocks} total / {shards} shard(s), "
+                f"{reserved_blocks} reserved each)")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.reserved_blocks = int(reserved_blocks)
+        self.shards = shards
+        self.rows_per_shard = rps
         # pop() from the tail hands out low ids first (stable tests)
-        self._free = list(range(self.num_blocks - 1,
-                                self.reserved_blocks - 1, -1))
+        self._free = [list(range(d * rps + rps - 1,
+                                 d * rps + reserved_blocks - 1, -1))
+                      for d in range(shards)]
         self._ref = [0] * self.num_blocks
         self._fault_hook = fault_hook
 
     @property
     def managed_blocks(self):
-        return self.num_blocks - self.reserved_blocks
+        return self.num_blocks - self.shards * self.reserved_blocks
 
-    def free_count(self):
-        return len(self._free)
+    def shard_of(self, block):
+        """The dp shard whose pool range holds global row ``block``."""
+        return int(block) // self.rows_per_shard
+
+    def scratch_row(self, shard=0):
+        """Global row id of ``shard``'s reserved scratch block (the
+        first row of its range) — parked slots' tables point here."""
+        if not self.reserved_blocks:
+            raise ValueError("pool has no reserved scratch rows")
+        return int(shard) * self.rows_per_shard
+
+    def free_count(self, shard=None):
+        if shard is None:
+            return sum(len(f) for f in self._free)
+        return len(self._free[shard])
 
     def in_use(self):
-        return self.managed_blocks - len(self._free)
+        return self.managed_blocks - self.free_count()
 
     def refcount(self, block):
         return self._ref[block]
 
-    def alloc(self, n):
-        """Take ``n`` blocks off the free list at refcount 1."""
+    def alloc(self, n, shard=0):
+        """Take ``n`` blocks off ``shard``'s free list at refcount 1."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if self._fault_hook is not None:
             self._fault_hook(n)  # chaos harness: may raise NoFreeBlocks
-        if n > len(self._free):
+        free = self._free[shard]
+        if n > len(free):
             raise NoFreeBlocks(
-                f"need {n} blocks, only {len(self._free)} free of "
-                f"{self.managed_blocks} (evict cached prefixes first)")
-        out = [self._free.pop() for _ in range(n)]
+                f"need {n} blocks, only {len(free)} free of "
+                f"{self.managed_blocks // self.shards} on dp shard "
+                f"{shard} (evict cached prefixes first)")
+        out = [free.pop() for _ in range(n)]
         for b in out:
             self._ref[b] = 1
         return out
@@ -400,7 +441,7 @@ class BlockPool:
                 raise RuntimeError(f"double free of block {b}")
             self._ref[b] -= 1
             if self._ref[b] == 0:
-                self._free.append(b)
+                self._free[self.shard_of(b)].append(b)
                 freed.append(b)
         return freed
 
@@ -421,8 +462,10 @@ class BlockPool:
             raise RuntimeError(f"cow of free block {block}")
         if self._ref[block] == 1:
             return block, False
-        new = self.alloc(1)[0]      # before decref: failure leaves
-        self._ref[block] -= 1       # the shared ref untouched
+        # before decref: failure leaves the shared ref untouched; the
+        # replacement comes from the block's OWN shard range
+        new = self.alloc(1, shard=self.shard_of(block))[0]
+        self._ref[block] -= 1
         return new, True
 
 
@@ -459,60 +502,97 @@ class PrefixCache:
     are swallowed: a failed demote must free the block normally, never
     wedge eviction mid-walk (``clear`` — the engine-reset path whose
     device pools may already be gone — never calls it).
+
+    Data-parallel pools (``BlockPool(shards=dp)``) get ONE TRIE PER
+    SHARD: a slot can only gather blocks inside its own dp shard's
+    pool range, so a cached prefix is only adoptable by slots of the
+    shard that computed it.  ``match(tokens, shard=d)`` walks shard
+    ``d``'s trie; ``match(tokens)`` (shard=None) probes every shard
+    and adopts from the one with the longest cached span (the
+    cross-shard lookup the prefix-warm service uses).  ``insert``
+    routes to the trie of the shard that owns ``blocks[0]``.
     """
 
     def __init__(self, pool, evict_hook=None):
         self.pool = pool
         self.block_size = pool.block_size
         self.evict_hook = evict_hook
-        self._children = {}   # root level: key tuple -> _TrieNode
+        # one root per dp pool shard: key tuple -> _TrieNode
+        self._roots = [dict()
+                       for _ in range(getattr(pool, "shards", 1))]
         self._clock = 0       # LRU stamp (monotonic counter)
 
     def _tick(self):
         self._clock += 1
         return self._clock
 
-    def _iter_nodes(self):
-        stack = list(self._children.values())
+    @staticmethod
+    def _iter_root(root):
+        stack = list(root.values())
         while stack:
             node = stack.pop()
             stack.extend(node.children.values())
             yield node
 
+    def _iter_nodes(self):
+        for root in self._roots:
+            yield from self._iter_root(root)
+
     def cached_blocks(self):
         return sum(1 for _ in self._iter_nodes())
 
-    def match(self, tokens):
+    def _walk(self, tokens, root, limit, stamp=None):
+        blocks = []
+        children = root
+        for i in range(limit):
+            key = tuple(int(x) for x in
+                        tokens[i * self.block_size:
+                               (i + 1) * self.block_size])
+            node = children.get(key)
+            if node is None:
+                break
+            if stamp is not None:
+                node.last_used = stamp
+            blocks.append(node.block)
+            children = node.children
+        return blocks
+
+    def match(self, tokens, shard=None):
         """Longest cached prefix of ``tokens`` in full blocks, capped
         so at least ONE token is left for the adopter's own prefill
         (admission still needs a last-position logit to sample from).
         Takes one pool reference per returned block on behalf of the
         caller — release with ``pool.decref`` at slot eviction.
+        ``shard`` names the dp shard whose trie to walk (the adopting
+        slot's); None probes every shard and adopts from the longest.
         Returns ``(block_ids, matched_token_count)``."""
-        bs = self.block_size
-        limit = (len(tokens) - 1) // bs
-        blocks = []
-        children = self._children
-        t = self._tick()
-        for i in range(limit):
-            key = tuple(int(x) for x in tokens[i * bs:(i + 1) * bs])
-            node = children.get(key)
-            if node is None:
-                break
-            node.last_used = t
-            blocks.append(node.block)
-            children = node.children
+        limit = (len(tokens) - 1) // self.block_size
+        if shard is None:
+            shard = 0
+            if len(self._roots) > 1:
+                shard = max(
+                    range(len(self._roots)),
+                    key=lambda d: len(self._walk(tokens,
+                                                 self._roots[d],
+                                                 limit)))
+        blocks = self._walk(tokens, self._roots[shard], limit,
+                            stamp=self._tick())
         self.pool.incref(blocks)
-        return blocks, len(blocks) * bs
+        return blocks, len(blocks) * self.block_size
 
     def insert(self, tokens, blocks):
         """Register ``blocks[i]`` as the cached K/V of ``tokens``'s
         i-th FULL block.  Existing nodes win (a duplicate block —
         two same-prefix requests prefilled in the same tick — stays
         slot-private and frees at eviction); each NEW node takes the
-        cache's own pool reference."""
+        cache's own pool reference.  The target trie is the one of
+        the dp shard that owns the blocks (all of one slot's blocks
+        live in one shard range by construction)."""
         bs = self.block_size
-        children = self._children
+        if not blocks:
+            return
+        children = self._roots[self.pool.shard_of(blocks[0])
+                               if len(self._roots) > 1 else 0]
         parent = None
         t = self._tick()
         n = min(len(blocks), len(tokens) // bs)
@@ -541,29 +621,33 @@ class PrefixCache:
             out.extend(key)
         return tuple(out)
 
-    def evict(self, n):
+    def evict(self, n, shard=None):
         """Free at least ``n`` blocks by dropping least-recently-used
         UNREFERENCED cached prefixes, deepest first (a node with live
         children or an active adopter — pool refcount > 1 — is never
         evicted; evicting a leaf exposes its parent as the next
         candidate).  One trie walk + a heap, not a rescan per freed
         block — eviction runs inside the engine's step loop and must
-        not stall decode ticks under sustained pressure.  Returns the
-        freed block ids (may be shorter than ``n`` when nothing
-        evictable remains)."""
+        not stall decode ticks under sustained pressure.  ``shard``
+        restricts the walk to one dp shard's trie (pressure on shard
+        ``d`` can only be relieved by shard ``d``'s blocks); None
+        evicts across all shards.  Returns the freed block ids (may
+        be shorter than ``n`` when nothing evictable remains)."""
         import heapq
         freed = []
-        heap = [(node.last_used, id(node), node)
-                for node in self._iter_nodes()
+        roots = (self._roots if shard is None
+                 else [self._roots[shard]])
+        heap = [(node.last_used, id(node), node, root)
+                for root in roots
+                for node in self._iter_root(root)
                 if not node.children
                 and self.pool.refcount(node.block) == 1]
         heapq.heapify(heap)
         while heap and len(freed) < n:
-            _, _, node = heapq.heappop(heap)
+            _, _, node, root = heapq.heappop(heap)
             if node.children or self.pool.refcount(node.block) != 1:
                 continue              # state changed since enqueue
-            owner = (node.parent.children if node.parent
-                     else self._children)
+            owner = (node.parent.children if node.parent else root)
             if owner.get(node.key) is not node:
                 continue              # already detached
             owner.pop(node.key)
@@ -576,8 +660,9 @@ class PrefixCache:
             parent = node.parent
             if parent is not None and not parent.children \
                     and self.pool.refcount(parent.block) == 1:
-                heapq.heappush(heap,
-                               (parent.last_used, id(parent), parent))
+                heapq.heappush(
+                    heap,
+                    (parent.last_used, id(parent), parent, root))
         return freed
 
     def clear(self):
@@ -585,5 +670,5 @@ class PrefixCache:
         freed = []
         for node in list(self._iter_nodes()):
             freed.extend(self.pool.decref(node.block))
-        self._children = {}
+        self._roots = [dict() for _ in self._roots]
         return freed

@@ -695,11 +695,12 @@ class Router:
                          ("queue_depth", "slots_free",
                           "kv_blocks_free", "drain_rate_tps",
                           "slots_total", "kv_block_size",
-                          # tensor-parallel replicas advertise their
-                          # mesh: the /replicas registry rows (and
-                          # timeline.py --router) label sharded
-                          # replicas without a second probe protocol
-                          "mesh_shape", "mp",
+                          # mesh-sharded replicas advertise their
+                          # full (mp, dp) shape: the /replicas
+                          # registry rows (and timeline.py --router)
+                          # label sharded replicas without a second
+                          # probe protocol
+                          "mesh_shape", "mp", "dp",
                           # quantized serving: dtype labels + block
                           # byte split, so migration can pre-filter
                           # kv_dtype-mismatched peers from the
@@ -1638,6 +1639,7 @@ class InProcessReplica:
             "kv_block_size": (eng._bs if paged else None),
             "mesh_shape": getattr(eng, "mesh_axes", None),
             "mp": getattr(eng, "mp", 1),
+            "dp": getattr(eng, "dp", 1),
             "weight_dtype": getattr(eng, "_weight_dtype_str", None),
             "kv_dtype": getattr(eng, "_kv_dtype_str", None),
             "kv_block_bytes": getattr(eng, "_kv_code_bytes_per_shard",

@@ -162,8 +162,11 @@ class Scheduler:
         and counts the timeouts.
 
         ``gate``: optional resource check consulted per request BEFORE
-        the slot binds (the engine's paged-KV admission gate: prefix
-        cache lookup + up-front block reservation).  A False verdict
+        the slot binds, called as ``gate(req, slot)`` with the slot
+        the request WOULD bind to (the engine's paged-KV admission
+        gate: prefix cache lookup + up-front block reservation — under
+        a data-parallel mesh the reservation must come from the
+        binding slot's own dp shard, hence the slot).  A False verdict
         puts the request back at the queue head and stops this round's
         admission — FIFO order is preserved and later ticks retry once
         eviction/completion frees resources.
@@ -183,7 +186,7 @@ class Scheduler:
                 break
             if gate is not None:
                 try:
-                    admit_ok = gate(req)
+                    admit_ok = gate(req, slot)
                 except BaseException:
                     # a gate that RAISES (e.g. pool failure mid-
                     # reservation) must not lose popped requests: put

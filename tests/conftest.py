@@ -47,7 +47,19 @@ def _seed_all():
 
 def pytest_collection_modifyitems(config, items):
     """Skip tests listed in tools/flaky_quarantine.txt (reference parity:
-    tools/get_quick_disable_lt.py flaky quarantine)."""
+    tools/get_quick_disable_lt.py flaky quarantine), and gate
+    mesh-marked tests on the device pool: mp/dp-sharded serving needs
+    >= 4 devices, which the XLA_FLAGS forcing above provides — but a
+    caller-set XLA_FLAGS (respected, line 12) may provide fewer, and
+    those tests must SKIP loudly rather than fail on mesh build."""
+    if len(jax.devices()) < 4:
+        mesh_skip = pytest.mark.skip(
+            reason=f"mesh tests need >= 4 devices, have "
+                   f"{len(jax.devices())} — force a virtual pool via "
+                   "XLA_FLAGS=--xla_force_host_platform_device_count")
+        for item in items:
+            if "mesh" in item.keywords:
+                item.add_marker(mesh_skip)
     qpath = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "tools", "flaky_quarantine.txt")
     if not os.path.exists(qpath):

@@ -31,6 +31,10 @@ from paddle_tpu.serving import Engine, EngineServer
 TOOLS = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "tools")
 
+# every test here drives (or validates against) a multi-device mesh;
+# conftest skips mesh-marked tests when fewer than 4 devices exist
+pytestmark = pytest.mark.mesh
+
 
 def _load_tool(name):
     spec = importlib.util.spec_from_file_location(
@@ -119,8 +123,8 @@ def test_to_tensor_parallel_forward_parity(dense_gpt, tp_gpt):
 def test_mesh_validation(dense_gpt, tp_gpt):
     with pytest.raises(ValueError, match="tensor-parallel"):
         _engine(dense_gpt, mesh=2)  # dense fused-qkv cannot shard
-    with pytest.raises(ValueError, match=r"\(mp,\)"):
-        _engine(tp_gpt, mesh=(2, 2))
+    with pytest.raises(ValueError, match=r"\(mp, dp\)"):
+        _engine(tp_gpt, mesh=(2, 2, 2))  # 3-tuple: no third axis
     with pytest.raises(ValueError, match="jax Mesh"):
         _engine(tp_gpt, mesh="two")
     with pytest.raises(ValueError, match="paged"):
@@ -128,12 +132,19 @@ def test_mesh_validation(dense_gpt, tp_gpt):
     with pytest.raises(ValueError, match="one"):
         _engine(tp_gpt, mesh=2, kv_block_size=8, kv_blocks=16,
                 kv_budget_mb=1)
-    # a prebuilt mesh with non-mp axes > 1 would silently replicate
-    # params/pools across them — rejected like the tuple path
+    # dp shards own equal contiguous slot ranges — ragged splits
+    # would strand slots, so an indivisible num_slots is rejected
+    with pytest.raises(ValueError, match="divide"):
+        _engine(dense_gpt, mesh=(1, 2), num_slots=3,
+                kv_block_size=8)
+    # a prebuilt mesh with non-mp/dp axes > 1 would silently
+    # replicate params/pools across them — rejected like the tuple
+    # path (mp x dp prebuilt meshes are accepted, see the dp parity
+    # matrix)
     import jax
     from paddle_tpu.distributed.mesh import build_mesh
     with pytest.raises(ValueError, match="extra axes"):
-        _engine(tp_gpt, mesh=build_mesh(dp=2, mp=2,
+        _engine(tp_gpt, mesh=build_mesh(sp=2, mp=2,
                                         devices=jax.devices()[:4]))
     # non-dense variants cannot relayout onto the TP specs
     paddle.seed(1)
@@ -266,6 +277,196 @@ def test_sharded_d2h_contract(dense_gpt, tp_gpt):
     assert sizes["unsharded"] == sizes["sharded"] == 17
 
 
+# -- dp: data-parallel batch sharding ---------------------------------
+
+DP_MESHES = [(1, 2), (2, 1), (2, 2)]
+DP_LAYOUTS = [
+    pytest.param(dict(kv_block_size=8), id="paged"),
+    pytest.param(dict(kv_block_size=8, prefill_chunk=8),
+                 id="chunked"),
+    pytest.param(dict(kv_block_size=8, spec_k=3), id="spec"),
+    pytest.param(dict(kv_block_size=8, prefill_chunk=8, spec_k=2,
+                      attn_impl="ragged"), id="ragged"),
+    pytest.param(dict(kv_block_size=8, kv_dtype="int8"), id="int8kv"),
+]
+
+
+def _dp_model(dense, tp, mesh):
+    return tp if mesh[0] > 1 else dense
+
+
+@pytest.mark.parametrize("kw", DP_LAYOUTS)
+def test_dp_parity_matrix(dense_gpt, tp_gpt, kw):
+    """THE dp acceptance case: every (mp, dp) in {(1,2), (2,1),
+    (2,2)} is greedy AND seeded token-identical to the unsharded
+    engine on every paged layout (plain / chunked / spec / ragged /
+    int8 KV), under staggered admissions — one program spans both
+    axes, batch slots sharded over 'dp'."""
+    prompts = _prompts(6)
+    for seeded in (False, True):
+        base = _drive(_engine(dense_gpt, **kw), prompts,
+                      seeded=seeded)
+        for mesh in DP_MESHES:
+            eng = _engine(_dp_model(dense_gpt, tp_gpt, mesh),
+                          mesh=mesh, **kw)
+            got = _drive(eng, prompts, seeded=seeded)
+            assert got == base, \
+                f"dp divergence (mesh={mesh}, {kw}, seeded={seeded})"
+            assert (eng.mp, eng.dp) == mesh
+            assert eng.registry.get("serving.mesh_devices").value \
+                == mesh[0] * mesh[1]
+
+
+def test_dp_parity_depth1(dense_gpt, tp_gpt):
+    """async_depth=1 keeps the synchronous tick under the dp mesh
+    too — batch sharding and pipelining are orthogonal."""
+    kw = dict(kv_block_size=8, async_depth=1)
+    base = _drive(_engine(dense_gpt, **kw), _prompts(5))
+    for mesh in DP_MESHES:
+        got = _drive(_engine(_dp_model(dense_gpt, tp_gpt, mesh),
+                             mesh=mesh, **kw), _prompts(5))
+        assert got == base, f"depth1 divergence (mesh={mesh})"
+
+
+def test_dp_preemption_resume_parity(dense_gpt, tp_gpt):
+    """A mid-stream priority preemption on the dp-sharded engine
+    resumes token-identically to uninterrupted unsharded runs, and
+    with the prefix cache off every shard's block refcounts return
+    to 0 (per-shard free lists fully restored)."""
+    bg = _prompts(2, base=11)
+    hi = _prompts(1, base=13)[0]
+    refs = []
+    for p in bg:
+        ref_eng = _engine(dense_gpt, kv_block_size=8)
+        r = ref_eng.submit(p, max_new_tokens=12)
+        ref_eng.run_until_idle()
+        refs.append(list(r.generated))
+
+    eng = _engine(tp_gpt, mesh=(2, 2), num_slots=2, kv_block_size=8,
+                  prefix_cache=False)
+    victims = [eng.submit(p, max_new_tokens=12, priority=0)
+               for p in bg]
+    for _ in range(3):
+        eng.step()
+    urgent = eng.submit(hi, max_new_tokens=4, priority=5)
+    eng.run_until_idle()
+    assert sum(v.preemptions for v in victims) >= 1
+    assert list(urgent.generated)
+    assert [list(v.generated) for v in victims] == refs
+    assert eng.block_pool.in_use() == 0
+    for d in range(eng.dp):
+        assert eng.block_pool.free_count(d) == \
+            eng._kv_managed // eng.dp
+
+
+def test_dp_compile_once_per_config(tp_gpt):
+    """All hot dispatch paths compile ONCE with the (mp, dp)
+    sharding baked in: a second identical wave adds zero programs."""
+    eng = _engine(tp_gpt, mesh=(2, 2), kv_block_size=8,
+                  prefill_chunk=8, spec_k=2)
+    prompts = _prompts(4)
+    _drive(eng, prompts, stagger=False)
+    c1 = eng.registry.get("serving.compiles_total").value
+    assert c1 > 0
+    _drive(eng, prompts, stagger=False)
+    assert eng.registry.get("serving.compiles_total").value == c1
+
+
+def test_serving_mesh_oversized_names_device_flag():
+    """Satellite regression: asking for more mesh devices than exist
+    fails loudly with the exact XLA flag that forces a virtual CPU
+    pool — not a cryptic reshape error deep in jax."""
+    import jax
+    from paddle_tpu.distributed.mesh import serving_mesh
+    n = len(jax.devices())
+    with pytest.raises(ValueError) as ei:
+        serving_mesh(n, 2)
+    msg = str(ei.value)
+    assert "--xla_force_host_platform_device_count" in msg
+    assert str(2 * n) in msg  # sized to the REQUESTED pool
+    # the happy path still builds exactly (mp, dp)
+    m = serving_mesh(2, 2)
+    assert int(m.shape["mp"]) == 2 and int(m.shape["dp"]) == 2
+
+
+@pytest.mark.pallas
+def test_sharded_ragged_kernel_matches_gspmd_oracle():
+    """Tentpole acceptance at the kernel level: the shard_map-
+    partitioned ragged kernel (grid-per-shard, GLOBAL block tables
+    localized per dp shard, heads pre-sliced per mp shard) matches
+    the GSPMD-partitioned oracle — the SAME kernel jitted over the
+    SAME mesh-sharded operands, with XLA deriving the partitioning
+    from input shardings — and the unsharded single-device run."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.distributed.mesh import serving_mesh
+    from paddle_tpu.ops.ragged_paged_attn import (
+        ragged_paged_attention, sharded_ragged_paged_attention)
+
+    mesh = serving_mesh(2, 2)
+    B, W, H, hd, bs, bps = 4, 4, 4, 8, 8, 4
+    NB = 10  # pool rows per dp shard: 5 blocks
+    rng = np.random.RandomState(0)
+    q = rng.randn(B, W, H, hd).astype(np.float32)
+    k = rng.randn(NB * bs, H, hd).astype(np.float32)
+    v = rng.randn(NB * bs, H, hd).astype(np.float32)
+    # tables carry GLOBAL block ids, but each slot draws only from
+    # its own dp shard's contiguous range — the invariant the
+    # engine's shard-scoped admission gate maintains
+    nb_local = NB // 2
+    tables = np.zeros((B, bps), np.int32)
+    for b in range(B):
+        base = (b // 2) * nb_local
+        tables[b] = base + 1 + (np.arange(bps) % (nb_local - 1))
+    pos = np.array([5, 9, 0, 13], np.int32)
+    width = np.array([3, 4, 0, 2], np.int32)
+
+    shards = {
+        "q": NamedSharding(mesh, P("dp", None, "mp", None)),
+        "kv": NamedSharding(mesh, P("dp", "mp", None)),
+        "tab": NamedSharding(mesh, P("dp", None)),
+        "vec": NamedSharding(mesh, P("dp")),
+    }
+    qd = jax.device_put(q, shards["q"])
+    kd = jax.device_put(k, shards["kv"])
+    vd = jax.device_put(v, shards["kv"])
+    td = jax.device_put(tables, shards["tab"])
+    pd = jax.device_put(pos, shards["vec"])
+    wd = jax.device_put(width, shards["vec"])
+
+    for variant in ("stream", "gather"):
+        unsharded = np.asarray(ragged_paged_attention(
+            q, k, v, tables, pos, width, block_size=bs,
+            interpret=True, variant=variant))
+        oracle = np.asarray(jax.jit(
+            lambda *a: ragged_paged_attention(
+                *a, block_size=bs, interpret=True,
+                variant=variant))(qd, kd, vd, td, pd, wd))
+        got = np.asarray(sharded_ragged_paged_attention(
+            q, k, v, tables, pos, width, block_size=bs, mesh=mesh,
+            interpret=True, variant=variant))
+        np.testing.assert_allclose(got, oracle, atol=1e-5,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(got, unsharded, atol=1e-5,
+                                   rtol=1e-5)
+
+    # int8 quantized pools thread per-block scales through the same
+    # specs (P('dp', 'mp')) and dequantize in-loop per shard
+    codes_k = rng.randint(-127, 128, (NB * bs, H, hd)) \
+        .astype(np.int8)
+    codes_v = rng.randint(-127, 128, (NB * bs, H, hd)) \
+        .astype(np.int8)
+    ks = (rng.rand(NB, H).astype(np.float32) + 0.5) / 127.0
+    vs = (rng.rand(NB, H).astype(np.float32) + 0.5) / 127.0
+    ref_q = np.asarray(ragged_paged_attention(
+        q, codes_k, codes_v, tables, pos, width, block_size=bs,
+        interpret=True, k_scale=ks, v_scale=vs))
+    got_q = np.asarray(sharded_ragged_paged_attention(
+        q, codes_k, codes_v, tables, pos, width, block_size=bs,
+        mesh=mesh, interpret=True, k_scale=ks, v_scale=vs))
+    np.testing.assert_allclose(got_q, ref_q, atol=1e-5, rtol=1e-5)
+
+
 # -- KV capacity scales with the mesh ---------------------------------
 
 def test_kv_capacity_scales_with_mesh(dense_gpt, tp_gpt):
@@ -296,6 +497,33 @@ def test_kv_capacity_scales_with_mesh(dense_gpt, tp_gpt):
     assert len(out.generated) == 4
 
 
+def test_kv_capacity_scales_mp_x_dp(dense_gpt, tp_gpt):
+    """A fixed PER-SHARD HBM budget buys mp x dp the logical blocks:
+    mp shards store only their heads' slice of every block, and each
+    dp shard brings its OWN budget-sized pool range — at (2, 2) the
+    aggregate is >= 3.9x the unsharded pool (exactly 4x for the tiny
+    config's power-of-two dims)."""
+    e1 = _engine(dense_gpt, kv_block_size=8, kv_budget_mb=1)
+    e12 = _engine(dense_gpt, mesh=(1, 2), kv_block_size=8,
+                  kv_budget_mb=1)
+    e22 = _engine(tp_gpt, mesh=(2, 2), kv_block_size=8,
+                  kv_budget_mb=1)
+    assert e12._kv_managed == 2 * e1._kv_managed
+    assert e22._kv_managed >= 3.9 * e1._kv_managed
+    # floor-exact per dp shard against the per-shard budget
+    assert e22._kv_managed == 2 * \
+        (2 ** 20 // e22._kv_block_bytes_per_shard)
+    assert e22.registry.get("serving.kv_blocks_total").value == \
+        e22._kv_managed
+    # each dp shard owns an equal share of the managed pool
+    for d in range(2):
+        assert e22.block_pool.free_count(d) == e22._kv_managed // 2
+    # the budget-sized (2, 2) pool actually serves
+    out = e22.submit(_prompts(1)[0], max_new_tokens=4)
+    e22.run_until_idle()
+    assert len(out.generated) == 4
+
+
 # -- observability: spans, healthz, registry --------------------------
 
 def test_shard_spans_and_wall_breakdown(tp_gpt, tmp_path):
@@ -322,6 +550,7 @@ def test_healthz_and_debug_mesh_surface(tp_gpt):
                                     timeout=10) as resp:
             h = json.loads(resp.read())
         assert h["mp"] == 2
+        assert h["dp"] == 1
         assert h["mesh_shape"] == {"mp": 2}
         free = eng.block_pool.free_count()
         assert h["kv_blocks_free_per_shard"] == [free, free]
@@ -332,6 +561,26 @@ def test_healthz_and_debug_mesh_surface(tp_gpt):
             d = json.loads(resp.read())
         assert d["engine"]["mp"] == 2
         assert d["engine"]["mesh_shape"] == {"mp": 2}
+
+
+def test_healthz_and_debug_dp_surface(tp_gpt):
+    """The (2, 2) engine reports the FULL mesh shape and each dp
+    shard's own free count (repeated per mp shard — mp slices are
+    uniform, dp shards drain independently)."""
+    eng = _engine(tp_gpt, mesh=(2, 2), kv_block_size=8)
+    with EngineServer(eng, port=0) as srv:
+        with urllib.request.urlopen(srv.address + "/healthz",
+                                    timeout=10) as resp:
+            h = json.loads(resp.read())
+        assert h["mp"] == 2 and h["dp"] == 2
+        assert h["mesh_shape"] == {"mp": 2, "dp": 2}
+        per_dp = [eng.block_pool.free_count(d) for d in range(2)]
+        assert h["kv_blocks_free_per_shard"] == per_dp * 2
+        with urllib.request.urlopen(srv.address + "/debug/requests",
+                                    timeout=10) as resp:
+            d = json.loads(resp.read())
+        assert d["engine"]["mp"] == 2 and d["engine"]["dp"] == 2
+        assert d["engine"]["mesh_shape"] == {"mp": 2, "dp": 2}
 
 
 def test_router_registry_carries_mesh(tp_gpt):
@@ -348,6 +597,18 @@ def test_router_registry_carries_mesh(tp_gpt):
     assert row["signals"]["mesh_shape"] == {"mp": 2}
 
 
+def test_router_registry_carries_dp(tp_gpt):
+    from paddle_tpu.serving import InProcessReplica, Router
+    eng = _engine(tp_gpt, mesh=(2, 2), kv_block_size=8)
+    router = Router({"r0": InProcessReplica("r0", eng)},
+                    registry=monitor.StatRegistry())
+    router.probe_once()
+    row = router.replicas()[0]
+    assert row["signals"]["mp"] == 2
+    assert row["signals"]["dp"] == 2
+    assert row["signals"]["mesh_shape"] == {"mp": 2, "dp": 2}
+
+
 def test_timeline_labels_sharded_replicas(monkeypatch):
     """timeline.py --router labels a sharded replica's timeline lane
     with its tensor-parallel degree from the registry signals."""
@@ -356,6 +617,11 @@ def test_timeline_labels_sharded_replicas(monkeypatch):
         {"name": "a", "address": "http://h:1",
          "signals": {"mp": 2, "mesh_shape": {"mp": 2}}},
         {"name": "b", "address": "http://h:2", "signals": {"mp": 1}},
+        {"name": "c", "address": "http://h:3",
+         "signals": {"mp": 2, "dp": 2,
+                     "mesh_shape": {"mp": 2, "dp": 2}}},
+        {"name": "d", "address": "http://h:4",
+         "signals": {"mp": 1, "dp": 2}},
     ]}
 
     class FakeResp:
@@ -374,7 +640,8 @@ def test_timeline_labels_sharded_replicas(monkeypatch):
     monkeypatch.setattr(tl.urllib.request, "urlopen",
                         lambda url, timeout=10.0: FakeResp(table))
     labels = [lab for lab, _ in tl.router_sources("http://r:9")]
-    assert labels == ["router", "replica:a mp=2", "replica:b"]
+    assert labels == ["router", "replica:a mp=2", "replica:b",
+                      "replica:c mp=2 dp=2", "replica:d mp=1 dp=2"]
 
 
 # -- real-process fleet (slow): spawn, route, kill, fail over ---------

@@ -65,13 +65,19 @@ def router_sources(base_url, timeout=10.0):
     for row in table.get("replicas", []):
         addr = row.get("address")
         name = row.get("name", "?")
-        # mesh-sharded replicas get labeled with their tensor-parallel
-        # degree (the registry carries the probed mesh signals) — a
-        # fleet timeline distinguishes a 4-chip replica's lane from a
-        # single-chip one's at a glance
-        mp = (row.get("signals") or {}).get("mp")
+        # mesh-sharded replicas get labeled with their full (mp, dp)
+        # mesh degrees (the registry carries the probed mesh signals)
+        # — a fleet timeline distinguishes a 4-chip "mp=2 dp=2"
+        # replica's lane from a single-chip one's at a glance; dp=1
+        # is omitted so unsharded and pure-mp labels stay stable
+        sig = row.get("signals") or {}
+        mp, dp = sig.get("mp"), sig.get("dp")
         label = (f"replica:{name} mp={int(mp)}"
                  if mp and int(mp) > 1 else f"replica:{name}")
+        if dp and int(dp) > 1:
+            if not (mp and int(mp) > 1):
+                label += f" mp={int(mp or 1)}"
+            label += f" dp={int(dp)}"
         # supervised replicas carry their restart generation — a
         # respawned replica's lane is visibly a NEW incarnation, not
         # a continuation of the dead one's
